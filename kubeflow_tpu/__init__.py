@@ -1,0 +1,21 @@
+"""kubeflow_tpu — a TPU-native ML platform with Kubeflow's capabilities.
+
+A brand-new, TPU-first framework with the capabilities of the Kubeflow
+platform (training-operator, KServe, Katib, Pipelines), designed natively
+for JAX/XLA on TPU rather than ported from the reference's Go/Kubernetes/
+NCCL architecture.
+
+Layer map (see SURVEY.md §7.1):
+  parallel/     mesh builder + sharding-rule engine (DP/FSDP/TP/SP/EP)
+  models/       flax model zoo (MLP, Llama-class decoder, BERT encoder)
+  ops/          Pallas TPU kernels (flash attention, ring attention)
+  train/        train-step factory, trainer loop, MFU meter, checkpointing
+  data/         input pipelines (synthetic + grain)
+  comms/        process bootstrap (jax.distributed) + ICI/DCN mesh contract
+  serve/        model server (AOT compile, batching) — KServe equivalent
+  tune/         HPO engine (random/grid/TPE, median stop) — Katib equivalent
+  pipelines/    DSL → IR → DAG executor with caching — KFP equivalent
+  controlplane/ Python client for the C++ control plane (cpp/)
+"""
+
+__version__ = "0.1.0"

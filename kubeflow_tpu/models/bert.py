@@ -1,0 +1,127 @@
+"""BERT-class bidirectional encoder — the serving model.
+
+Eval config 3 is "KServe InferenceService: BERT-base predictor on TPU v5e"
+(BASELINE.json). The reference serves BERT through KServe's huggingfaceserver
+/ Triton runtimes (SURVEY.md §2.2); here it is a native flax model that the
+serve/ runtime AOT-compiles per shape bucket.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_layers: int = 12
+    num_heads: int = 12
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    num_labels: int = 2  # classification head
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+
+def bert_base(num_labels: int = 2) -> BertConfig:
+    return BertConfig(num_labels=num_labels)
+
+
+def bert_tiny() -> BertConfig:
+    return BertConfig(vocab_size=512, hidden_size=64, intermediate_size=128,
+                      num_layers=2, num_heads=4, max_seq_len=64)
+
+
+class EncoderLayer(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask):
+        cfg = self.cfg
+        head_dim = cfg.hidden_size // cfg.num_heads
+        dense = partial(nn.DenseGeneral, dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+        q = dense(features=(cfg.num_heads, head_dim),
+                  kernel_init=nn.with_logical_partitioning(
+                      nn.initializers.lecun_normal(), ("qkv_embed", "heads", "kv")),
+                  name="q")(x)
+        k = dense(features=(cfg.num_heads, head_dim),
+                  kernel_init=nn.with_logical_partitioning(
+                      nn.initializers.lecun_normal(), ("qkv_embed", "heads", "kv")),
+                  name="k")(x)
+        v = dense(features=(cfg.num_heads, head_dim),
+                  kernel_init=nn.with_logical_partitioning(
+                      nn.initializers.lecun_normal(), ("qkv_embed", "heads", "kv")),
+                  name="v")(x)
+        scores = jnp.einsum("bshd,bthd->bhst", q, k).astype(jnp.float32)
+        scores = scores / jnp.sqrt(head_dim)
+        scores = jnp.where(mask[:, None, None, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        attn = jnp.einsum("bhst,bthd->bshd", probs, v)
+        attn = dense(features=cfg.hidden_size, axis=(-2, -1),
+                     kernel_init=nn.with_logical_partitioning(
+                         nn.initializers.lecun_normal(), ("heads", "kv", "embed")),
+                     name="o")(attn)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="ln_attn")(x + attn)
+        h = dense(features=cfg.intermediate_size,
+                  kernel_init=nn.with_logical_partitioning(
+                      nn.initializers.lecun_normal(), ("embed", "mlp")),
+                  name="ffn_in")(x)
+        h = nn.gelu(h)
+        h = dense(features=cfg.hidden_size,
+                  kernel_init=nn.with_logical_partitioning(
+                      nn.initializers.lecun_normal(), ("mlp", "embed")),
+                  name="ffn_out")(h)
+        return nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                            name="ln_ffn")(x + h)
+
+
+class Bert(nn.Module):
+    """Returns (sequence_output [B,S,H], pooled_logits [B, num_labels])."""
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None):
+        cfg = self.cfg
+        b, s = input_ids.shape
+        if attention_mask is None:
+            attention_mask = jnp.ones((b, s), jnp.bool_)
+        else:
+            attention_mask = attention_mask.astype(jnp.bool_)
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros((b, s), jnp.int32)
+        emb = self.param("word_embeddings", nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), ("vocab", "embed")),
+            (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
+        pos = self.param("position_embeddings", nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), (None, "embed")),
+            (cfg.max_seq_len, cfg.hidden_size), cfg.param_dtype)
+        typ = self.param("token_type_embeddings", nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), (None, "embed")),
+            (cfg.type_vocab_size, cfg.hidden_size), cfg.param_dtype)
+        x = emb[input_ids] + pos[jnp.arange(s)][None] + typ[token_type_ids]
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="ln_embed")(x.astype(cfg.dtype))
+        for i in range(cfg.num_layers):
+            x = EncoderLayer(cfg, name=f"layer_{i}")(x, attention_mask)
+        pooled = nn.tanh(nn.Dense(
+            cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("embed", "embed2")),
+            name="pooler")(x[:, 0]))
+        logits = nn.Dense(
+            cfg.num_labels, dtype=jnp.float32, param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("embed", "vocab")),
+            name="classifier")(pooled)
+        return x, logits

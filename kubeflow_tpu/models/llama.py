@@ -1,0 +1,277 @@
+"""Llama-class decoder-only transformer, TPU-first.
+
+This is the flagship training model (north star: Llama-3-8B fine-tune via
+JAXJob; BASELINE.md). The reference platform never owned a model — PyTorchJob
+launched user containers holding HF/Megatron code (SURVEY.md §2.6). Here the
+model is part of the framework, designed for XLA/TPU:
+
+  * params annotated with logical axes (parallel/sharding.py rules engine)
+    so DP/FSDP/TP/SP compose via GSPMD instead of NCCL process groups;
+  * layers rolled into one `nn.scan` — O(1) HLO size in depth, fast compiles;
+  * bfloat16 activations/matmuls (MXU-native), fp32 RMSNorm/softmax/rope;
+  * selectable attention impl: naive einsum, Pallas flash kernel, or ring
+    attention over the `seq` mesh axis for long context (SURVEY.md §5.7);
+  * `jax.checkpoint` (remat) policy per block to trade FLOPs for HBM.
+
+GQA, RoPE, SwiGLU, RMSNorm match the Llama-3 architecture family.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.parallel.sharding import logical_to_spec
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    head_dim: int = 128
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    rms_eps: float = 1e-5
+    tie_embeddings: bool = False
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    attention_impl: str = "auto"  # auto | naive | flash | ring
+    remat: bool = True
+    scan_layers: bool = True
+    # flash-kernel block sizes (tuned for v5e/v5p VMEM; ops/flash_attention.py)
+    flash_block_q: int = 512
+    flash_block_kv: int = 512
+
+    @property
+    def num_params(self) -> int:
+        """Parameter count (for MFU accounting; BASELINE.md formula)."""
+        h, m, v = self.hidden_size, self.intermediate_size, self.vocab_size
+        qkv = h * self.num_heads * self.head_dim + 2 * h * self.num_kv_heads * self.head_dim
+        attn = qkv + self.num_heads * self.head_dim * h
+        mlp = 3 * h * m
+        norms = 2 * h
+        per_layer = attn + mlp + norms
+        emb = v * h * (1 if self.tie_embeddings else 2)
+        return self.num_layers * per_layer + emb + h
+
+
+def llama3_8b() -> LlamaConfig:
+    return LlamaConfig()
+
+
+def llama_tiny(vocab: int = 512) -> LlamaConfig:
+    """Test-size config — same topology, toy dims."""
+    return LlamaConfig(
+        vocab_size=vocab, hidden_size=64, intermediate_size=128, num_layers=2,
+        num_heads=4, num_kv_heads=2, head_dim=16, max_seq_len=128,
+        remat=False, flash_block_q=64, flash_block_kv=64)
+
+
+def llama_1b() -> LlamaConfig:
+    """Bench-size config that fits a single emulated v5e chip."""
+    return LlamaConfig(
+        vocab_size=32768, hidden_size=2048, intermediate_size=5632,
+        num_layers=16, num_heads=16, num_kv_heads=8, head_dim=128,
+        max_seq_len=2048)
+
+
+class RMSNorm(nn.Module):
+    eps: float
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param(
+            "scale", nn.with_logical_partitioning(nn.initializers.ones, ("norm",)),
+            (x.shape[-1],), jnp.float32)
+        x32 = x.astype(jnp.float32)
+        y = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps)
+        return (y * scale).astype(self.dtype)
+
+
+def rope_table(head_dim: int, max_len: int, theta: float) -> tuple[jax.Array, jax.Array]:
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array,
+               positions: jax.Array) -> jax.Array:
+    """x: [B, S, H, D]; positions: [B, S] absolute positions (for decode)."""
+    cos = cos[positions][:, :, None, :]  # [B,S,1,D/2]
+    sin = sin[positions][:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def naive_attention(q, k, v, *, causal: bool = True,
+                    positions_q=None, positions_kv=None) -> jax.Array:
+    """Reference einsum attention (fp32 softmax). q:[B,S,H,D] k,v:[B,T,K,D]."""
+    b, s, h, d = q.shape
+    t, kh = k.shape[1], k.shape[2]
+    group = h // kh
+    qg = q.reshape(b, s, kh, group, d)
+    scores = jnp.einsum("bskgd,btkd->bkgst", qg, k).astype(jnp.float32)
+    scores = scores / jnp.sqrt(d).astype(jnp.float32)
+    if causal:
+        pq = positions_q if positions_q is not None else jnp.arange(s)[None]
+        pk = positions_kv if positions_kv is not None else jnp.arange(t)[None]
+        mask = pq[:, None, None, :, None] >= pk[:, None, None, None, :]
+        scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bkgst,btkd->bskgd", probs, v)
+    return out.reshape(b, s, h, d)
+
+
+class Attention(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, cos, sin, positions, ring_axis: str | None = None):
+        cfg = self.cfg
+        dense = partial(
+            nn.DenseGeneral, use_bias=False, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype)
+        q = dense(features=(cfg.num_heads, cfg.head_dim),
+                  kernel_init=nn.with_logical_partitioning(
+                      nn.initializers.lecun_normal(), ("qkv_embed", "heads", "kv")),
+                  name="q_proj")(x)
+        k = dense(features=(cfg.num_kv_heads, cfg.head_dim),
+                  kernel_init=nn.with_logical_partitioning(
+                      nn.initializers.lecun_normal(), ("qkv_embed", "heads", "kv")),
+                  name="k_proj")(x)
+        v = dense(features=(cfg.num_kv_heads, cfg.head_dim),
+                  kernel_init=nn.with_logical_partitioning(
+                      nn.initializers.lecun_normal(), ("qkv_embed", "heads", "kv")),
+                  name="v_proj")(x)
+        q = apply_rope(q, cos, sin, positions)
+        k = apply_rope(k, cos, sin, positions)
+        q = nn.with_logical_constraint(q, ("batch", "act_seq", "act_heads", "act_kv"))
+        k = nn.with_logical_constraint(k, ("batch", "act_seq", None, "act_kv"))
+        v = nn.with_logical_constraint(v, ("batch", "act_seq", None, "act_kv"))
+
+        impl = cfg.attention_impl
+        if impl == "auto":
+            if ring_axis is not None:
+                impl = "ring"
+            elif (jax.default_backend() in ("tpu", "axon")
+                  and q.shape[1] % cfg.flash_block_q == 0):
+                impl = "flash"
+            else:
+                impl = "naive"
+        if impl == "ring":
+            from kubeflow_tpu.ops.ring_attention import ring_attention
+            out = ring_attention(q, k, v, axis_name=ring_axis or "seq",
+                                 positions=positions)
+        elif impl == "flash":
+            from kubeflow_tpu.ops.flash_attention import flash_attention
+            out = flash_attention(q, k, v, causal=True,
+                                  block_q=cfg.flash_block_q,
+                                  block_kv=cfg.flash_block_kv)
+        else:
+            out = naive_attention(q, k, v, causal=True, positions_q=positions,
+                                  positions_kv=positions)
+        out = dense(features=cfg.hidden_size, axis=(-2, -1),
+                    kernel_init=nn.with_logical_partitioning(
+                        nn.initializers.lecun_normal(), ("heads", "kv", "embed")),
+                    name="o_proj")(out)
+        return out
+
+
+class MLPBlock(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        dense = partial(nn.DenseGeneral, use_bias=False, dtype=cfg.dtype,
+                        param_dtype=cfg.param_dtype)
+        gate = dense(features=cfg.intermediate_size,
+                     kernel_init=nn.with_logical_partitioning(
+                         nn.initializers.lecun_normal(), ("embed", "mlp")),
+                     name="gate_proj")(x)
+        up = dense(features=cfg.intermediate_size,
+                   kernel_init=nn.with_logical_partitioning(
+                       nn.initializers.lecun_normal(), ("embed", "mlp")),
+                   name="up_proj")(x)
+        h = nn.silu(gate) * up
+        h = nn.with_logical_constraint(h, ("batch", "act_seq", "mlp"))
+        return dense(features=cfg.hidden_size,
+                     kernel_init=nn.with_logical_partitioning(
+                         nn.initializers.lecun_normal(), ("mlp", "embed")),
+                     name="down_proj")(h)
+
+
+class DecoderLayer(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, cos, sin, positions, ring_axis=None):
+        cfg = self.cfg
+        h = RMSNorm(cfg.rms_eps, cfg.dtype, name="input_norm")(x)
+        x = x + Attention(cfg, name="attn")(h, cos, sin, positions, ring_axis)
+        h = RMSNorm(cfg.rms_eps, cfg.dtype, name="post_attn_norm")(x)
+        x = x + MLPBlock(cfg, name="mlp")(h)
+        x = nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
+        return x
+
+
+class Llama(nn.Module):
+    """Causal LM. __call__ returns logits [B, S, V]."""
+
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array, positions: jax.Array | None = None,
+                 ring_axis: str | None = None) -> jax.Array:
+        cfg = self.cfg
+        if positions is None:
+            positions = jnp.broadcast_to(
+                jnp.arange(tokens.shape[1]), tokens.shape)
+        embed = self.param(
+            "embed", nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("vocab", "embed")),
+            (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
+        x = embed.astype(cfg.dtype)[tokens]
+        x = nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
+        cos, sin = rope_table(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+
+        layer_cls = DecoderLayer
+        if cfg.remat:
+            layer_cls = nn.remat(
+                layer_cls, policy=jax.checkpoint_policies.nothing_saveable,
+                static_argnums=(5,))
+        if cfg.scan_layers:
+            x, _ = nn.scan(
+                lambda mdl, carry, _: (mdl(carry, cos, sin, positions, ring_axis), None),
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                length=cfg.num_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(layer_cls(cfg, name="layers"), x, None)
+        else:
+            for i in range(cfg.num_layers):
+                x = layer_cls(cfg, name=f"layer_{i}")(x, cos, sin, positions, ring_axis)
+
+        x = RMSNorm(cfg.rms_eps, cfg.dtype, name="final_norm")(x)
+        if cfg.tie_embeddings:
+            logits = jnp.einsum("bsh,vh->bsv", x, embed.astype(cfg.dtype))
+        else:
+            logits = nn.DenseGeneral(
+                features=cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype,
+                kernel_init=nn.with_logical_partitioning(
+                    nn.initializers.lecun_normal(), ("embed", "vocab")),
+                name="lm_head")(x)
+        return logits

@@ -1,0 +1,47 @@
+"""MNIST-class MLP — the smoke-test model.
+
+Fills the role of the reference's MNIST examples (training-operator
+examples/, used by its e2e suite; SURVEY.md §2.1) and eval config 1
+(TFJob MNIST single-worker CPU).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class MLPConfig:
+    in_dim: int = 784
+    hidden: tuple[int, ...] = (256, 128)
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+
+class MLP(nn.Module):
+    cfg: MLPConfig = MLPConfig()
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        x = x.reshape(x.shape[0], -1).astype(cfg.dtype)
+        for i, h in enumerate(cfg.hidden):
+            x = nn.Dense(
+                h, dtype=cfg.dtype,
+                kernel_init=nn.with_logical_partitioning(
+                    nn.initializers.lecun_normal(), ("embed", "mlp")),
+                bias_init=nn.with_logical_partitioning(
+                    nn.initializers.zeros, ("mlp",)),
+                name=f"dense_{i}")(x)
+            x = nn.relu(x)
+        return nn.Dense(
+            cfg.num_classes, dtype=cfg.dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("embed", "vocab")),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros, ("vocab",)),
+            name="head")(x)

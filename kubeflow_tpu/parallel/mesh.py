@@ -1,0 +1,99 @@
+"""Device-mesh construction for TPU slices.
+
+TPU-first replacement for the reference's pod-topology + NCCL world layout
+(Kubeflow training-operator injects MASTER_ADDR/RANK per pod and delegates the
+actual communicator to NCCL inside user containers; see SURVEY.md §2.6/§2.7).
+Here the mesh IS the communicator: we build a `jax.sharding.Mesh` with named
+axes and let XLA compile collectives onto ICI/DCN from sharding annotations.
+
+Axis vocabulary (all strategies from SURVEY.md §2.6 compose on one mesh):
+  data    pure data parallelism (replicated params, all-reduce grads)
+  fsdp    sharded data parallelism (ZeRO-3 style param/grad/opt sharding)
+  pipe    pipeline stages (microbatched, collective_permute between stages)
+  tensor  megatron-style intra-layer model parallelism
+  seq     sequence/context parallelism (ring attention / all-to-all)
+  expert  MoE expert parallelism (all-to-all token routing)
+
+Multi-slice: `dcn_data`/`dcn_pipe` factors place the slowest-varying mesh dim
+across slices so only DP/PP gradients ride DCN while tensor/seq/expert
+collectives stay on intra-slice ICI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical axis order: slowest-communicating axes first so that, on real
+# hardware, DCN-crossing axes map to the outermost device dimension and
+# tensor/seq (most chatty) map to contiguous ICI neighbours.
+MESH_AXES = ("data", "fsdp", "pipe", "tensor", "seq", "expert")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Parallelism degrees. Product must divide the device count (a value of
+    -1 for exactly one axis means "absorb all remaining devices")."""
+
+    data: int = -1
+    fsdp: int = 1
+    pipe: int = 1
+    tensor: int = 1
+    seq: int = 1
+    expert: int = 1
+    # Number of slices the job spans; >1 splits the leading (data or pipe)
+    # axis across DCN. Informational on emulated backends.
+    num_slices: int = 1
+
+    def axis_sizes(self, num_devices: int) -> tuple[int, ...]:
+        sizes = [self.data, self.fsdp, self.pipe, self.tensor, self.seq, self.expert]
+        wild = [i for i, s in enumerate(sizes) if s == -1]
+        if len(wild) > 1:
+            raise ValueError(f"at most one mesh axis may be -1, got {sizes}")
+        fixed = math.prod(s for s in sizes if s != -1)
+        if wild:
+            if num_devices % fixed:
+                raise ValueError(
+                    f"{num_devices} devices not divisible by fixed axes product {fixed}"
+                )
+            sizes[wild[0]] = num_devices // fixed
+        if math.prod(sizes) != num_devices:
+            raise ValueError(
+                f"mesh {dict(zip(MESH_AXES, sizes))} needs {math.prod(sizes)} devices, "
+                f"have {num_devices}"
+            )
+        return tuple(sizes)
+
+
+def build_mesh(
+    config: MeshConfig | None = None,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build the global mesh. On real multi-host TPU, `jax.devices()` is already
+    ordered so contiguous devices share ICI; `mesh_utils` would refine this for
+    specific topologies — we keep row-major order, which is correct for the
+    virtual CPU meshes used in tests and for single-slice v5e/v5p defaults."""
+    config = config or MeshConfig()
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = config.axis_sizes(len(devices))
+    dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, MESH_AXES)
+
+
+def single_device_mesh(device: jax.Device | None = None) -> Mesh:
+    dev = device or jax.devices()[0]
+    return Mesh(np.asarray([dev]).reshape((1,) * len(MESH_AXES)), MESH_AXES)
+
+
+def mesh_shape(mesh: Mesh) -> dict[str, int]:
+    return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+def dp_like_axes(mesh: Mesh) -> tuple[str, ...]:
+    """Axes over which the batch is sharded (data + fsdp)."""
+    return tuple(a for a in ("data", "fsdp") if mesh.shape[a] > 1) or ("data",)

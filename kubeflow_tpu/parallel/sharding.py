@@ -1,0 +1,127 @@
+"""Sharding-rule engine: logical axis names → mesh axes → PartitionSpec.
+
+The reference platform has no parallelism math of its own (Kubeflow only
+injects rendezvous env vars; SURVEY.md §2.6) — strategy lived inside user
+containers (DDP/FSDP/Megatron/DeepSpeed configs). Here strategy is a
+first-class, declarative table: models annotate parameters/activations with
+*logical* axis names, and a rule table maps those to mesh axes per strategy.
+Changing DP→FSDP→TP→hybrid is a rules swap, not a model rewrite — the GSPMD
+analog of DeepSpeed's zero-stage / Megatron's tp-degree knobs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# A rule maps a logical axis name to one mesh axis, a tuple of mesh axes, or
+# None (replicate). First matching rule wins (flax logical-rules semantics).
+Rules = Sequence[tuple[str, str | tuple[str, ...] | None]]
+
+# Default hybrid rules, MaxText-style: batch over (data, fsdp); parameter
+# embed dim over fsdp (ZeRO-3 gather at use); heads/mlp over tensor;
+# activation sequence over seq (context parallelism); experts over expert.
+DEFAULT_RULES: Rules = (
+    ("batch", ("data", "fsdp")),
+    ("act_seq", "seq"),
+    ("act_embed", None),
+    ("act_heads", "tensor"),
+    ("act_kv", None),
+    ("embed", "fsdp"),
+    ("mlp", "tensor"),
+    ("heads", "tensor"),
+    ("kv", None),
+    ("qkv_embed", "fsdp"),
+    ("vocab", "tensor"),
+    ("expert", "expert"),
+    ("expert_mlp", "tensor"),
+    ("layers", None),
+    ("stage", "pipe"),
+    ("norm", None),
+)
+
+
+def rules_for(strategy: str) -> Rules:
+    """Preset rule tables per named strategy (SURVEY.md §2.6 inventory)."""
+    presets: dict[str, Rules] = {
+        # Pure DP: everything replicated except the batch.
+        "dp": (("batch", ("data", "fsdp")),),
+        # FSDP/ZeRO-3: params sharded on their embed-ish dim over fsdp.
+        "fsdp": (
+            ("batch", ("data", "fsdp")),
+            ("embed", "fsdp"),
+            ("qkv_embed", "fsdp"),
+            ("vocab", "fsdp"),
+            ("mlp", None),
+            ("expert_mlp", None),
+        ),
+        # Megatron TP only.
+        "tensor": (
+            ("batch", "data"),
+            ("mlp", "tensor"),
+            ("heads", "tensor"),
+            ("vocab", "tensor"),
+            ("act_heads", "tensor"),
+        ),
+        # Sequence/context parallel attention (ring attention over `seq`).
+        "context": (
+            ("batch", ("data", "fsdp")),
+            ("act_seq", "seq"),
+            ("embed", "fsdp"),
+        ),
+        "hybrid": DEFAULT_RULES,
+    }
+    try:
+        return presets[strategy]
+    except KeyError:
+        raise ValueError(
+            f"unknown strategy {strategy!r}; have {sorted(presets)}"
+        ) from None
+
+
+def logical_to_spec(
+    logical_axes: Sequence[str | None], rules: Rules = DEFAULT_RULES
+) -> P:
+    """Map a tuple of logical axis names (one per tensor dim) to a PartitionSpec."""
+    table = dict(rules)  # first occurrence wins is preserved by dict for dup-free rules
+    out: list[Any] = []
+    for name in logical_axes:
+        if name is None:
+            out.append(None)
+        else:
+            out.append(table.get(name))
+    # Trailing Nones are implicit in PartitionSpec.
+    while out and out[-1] is None:
+        out.pop()
+    return P(*out)
+
+
+def tree_logical_to_sharding(
+    logical_tree: Any, mesh: Mesh, rules: Rules = DEFAULT_RULES
+) -> Any:
+    """Map a pytree of logical-axis tuples to NamedShardings on `mesh`."""
+    return jax.tree.map(
+        lambda axes: NamedSharding(mesh, logical_to_spec(axes, rules)),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple)
+        and all(isinstance(e, (str, type(None))) for e in x),
+    )
+
+
+def constrain(x: jax.Array, logical_axes: Sequence[str | None], rules: Rules,
+              mesh: Mesh | None = None) -> jax.Array:
+    """Sharding constraint by logical axes (inside jit)."""
+    spec = logical_to_spec(logical_axes, rules)
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, spec) if mesh is not None else spec
+    )
+
+
+def param_spec_tree(params: Any, logical_fn: Mapping[str, Any] | None = None) -> Any:
+    """Extract PartitionSpecs from a flax param tree annotated with
+    `nn.with_logical_partitioning` metadata (flax boxed metadata)."""
+    import flax.linen as nn
+
+    return nn.get_partition_spec(params)

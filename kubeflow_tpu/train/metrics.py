@@ -1,0 +1,104 @@
+"""Step metrics and MFU accounting.
+
+The reference delegates training metrics to user containers and scrapes them
+back via Katib's stdout-regex sidecar (SURVEY.md §5.5); here the runtime owns
+a metrics channel directly: per-step wall time, tokens/sec, and MFU computed
+with the BASELINE.md convention MFU = 6·N·tok/s ÷ (chips · peak BF16 FLOP/s).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import sys
+import time
+
+import jax
+
+# Peak dense BF16 FLOP/s per chip (public spec sheets).
+PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5 lite": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+    "cpu": 1e12,  # nominal, so MFU math stays finite in CPU tests
+}
+
+
+def peak_flops_per_chip() -> float:
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", "cpu").lower()
+    for key, val in PEAK_FLOPS.items():
+        if key in kind:
+            return val
+    return PEAK_FLOPS["cpu"]
+
+
+@dataclasses.dataclass
+class StepTimer:
+    """Tracks smoothed step time / tokens/s / MFU across the training loop."""
+
+    num_params: int
+    tokens_per_step: int
+    num_chips: int = 0
+    warmup_steps: int = 2  # exclude compile steps from averages
+    _count: int = 0
+    _total_time: float = 0.0
+    _last: float | None = None
+
+    def __post_init__(self):
+        self.num_chips = self.num_chips or jax.device_count()
+        self.peak = peak_flops_per_chip()
+
+    def start(self):
+        self._last = time.perf_counter()
+
+    def stop(self) -> dict:
+        now = time.perf_counter()
+        dt = now - (self._last if self._last is not None else now)
+        self._count += 1
+        if self._count > self.warmup_steps:
+            self._total_time += dt
+        return self.snapshot(step_time=dt)
+
+    def snapshot(self, step_time: float | None = None) -> dict:
+        counted = max(self._count - self.warmup_steps, 0)
+        avg = self._total_time / counted if counted else (step_time or 0.0)
+        tps = self.tokens_per_step / avg if avg else 0.0
+        model_flops = 6.0 * self.num_params * tps  # fwd+bwd matmul FLOPs
+        mfu = model_flops / (self.num_chips * self.peak) if avg else 0.0
+        return {
+            "step_time_s": step_time if step_time is not None else avg,
+            "avg_step_time_s": avg,
+            "tokens_per_sec": tps,
+            "tokens_per_sec_per_chip": tps / self.num_chips,
+            "mfu": mfu,
+        }
+
+
+class MetricsLogger:
+    """JSONL metrics stream — consumed by the CLI (`tpukit logs -f`), the HPO
+    metrics collector (tune/), and humans. One JSON object per line, always
+    with "step"."""
+
+    def __init__(self, path: str | None = None, stream=None):
+        self._fh = open(path, "a", buffering=1) if path else None
+        self._stream = stream if stream is not None else sys.stdout
+
+    def log(self, step: int, payload: dict):
+        rec = {"step": int(step)}
+        for k, v in payload.items():
+            try:
+                rec[k] = float(v)
+            except (TypeError, ValueError):
+                rec[k] = v
+        line = json.dumps(rec)
+        if self._fh:
+            self._fh.write(line + "\n")
+        if self._stream:
+            print(line, file=self._stream, flush=True)
+
+    def close(self):
+        if self._fh:
+            self._fh.close()

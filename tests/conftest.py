@@ -1,0 +1,30 @@
+"""Test harness: virtual 8-device CPU mesh.
+
+The reference tests controllers without a cluster via envtest and e2e via
+kind (SURVEY.md §4); our analog for the *device* plane is
+`--xla_force_host_platform_device_count=8` on the CPU backend — real XLA
+collectives over 8 virtual devices on one host. Must run before jax import.
+"""
+
+import os
+
+# The axon sitecustomize imports jax at interpreter start with
+# JAX_PLATFORMS=axon, so env vars are too late here — use jax.config,
+# which works post-import as long as no backend has been touched yet.
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_debug_nans", False)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return devs[:8]

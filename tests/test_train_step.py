@@ -1,0 +1,95 @@
+"""Train-step factory tests: init sharding, step execution, convergence."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from kubeflow_tpu.data.synthetic import mnist_like, token_batches
+from kubeflow_tpu.models.llama import Llama, llama_tiny
+from kubeflow_tpu.models.mlp import MLP
+from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+from kubeflow_tpu.parallel.sharding import DEFAULT_RULES, rules_for
+from kubeflow_tpu.train.step import (
+    TrainState, init_train_state, make_eval_step, make_train_step)
+
+
+def _llama_state(mesh, rules, cfg=None):
+    cfg = cfg or llama_tiny()
+    model = Llama(cfg)
+    tx = optax.adamw(1e-3)
+    tokens = jnp.zeros((4, 32), jnp.int32)
+    state = init_train_state(model, tx, jax.random.key(0), (tokens,), mesh, rules)
+    return model, state
+
+
+def test_llama_init_shards_params(devices8):
+    mesh = build_mesh(MeshConfig(data=2, fsdp=2, tensor=2), devices8)
+    _, state = _llama_state(mesh, DEFAULT_RULES)
+    # scanned layers: params have a leading 'layers' axis, replicated
+    gate = state.params["layers"]["mlp"]["gate_proj"]["kernel"]
+    assert gate.ndim == 3  # [layers, embed, mlp]
+    assert gate.sharding.spec == P(None, "fsdp", "tensor")
+    emb = state.params["embed"]
+    assert emb.sharding.spec == P("tensor", "fsdp")
+
+
+def test_llama_train_step_runs_and_improves(devices8):
+    mesh = build_mesh(MeshConfig(data=2, fsdp=2, tensor=2), devices8)
+    cfg = llama_tiny(vocab=64)
+    model, state = _llama_state(mesh, DEFAULT_RULES, cfg)
+    step = make_train_step(model, mesh, DEFAULT_RULES)
+    data = token_batches(8, 32, cfg.vocab_size, seed=0)
+    batch = next(data)
+    state, m0 = step(state, batch)
+    for _ in range(10):
+        state, m = step(state, next(data))
+    assert np.isfinite(float(m["loss"]))
+    assert int(m["step"]) == 11
+    # random tokens: loss should head toward ln(V) from above-ish; just check
+    # it moved and stayed finite under a sharded mesh
+    assert float(m["loss"]) != float(m0["loss"])
+
+
+def test_mlp_converges_dp(devices8):
+    mesh = build_mesh(MeshConfig(data=8), devices8)
+    model = MLP()
+    tx = optax.adam(1e-2)
+    x = jnp.zeros((8, 784), jnp.float32)
+    state = init_train_state(model, tx, jax.random.key(0), (x,), mesh,
+                             rules_for("dp"))
+
+    def loss_fn(logits, batch):
+        onehot = jax.nn.one_hot(batch["targets"], 10)
+        return optax.softmax_cross_entropy(logits, onehot).mean()
+
+    step = make_train_step(model, mesh, rules_for("dp"), loss_fn=loss_fn)
+    data = mnist_like(64, seed=0)
+    first = None
+    for i in range(300):
+        state, m = step(state, next(data))
+        if first is None:
+            first = float(m["loss"])
+    # the argmax task is noisy; assert a solid monotone improvement instead
+    # of full convergence (2.33 → ~1.5 over 300 steps on this seed)
+    assert float(m["loss"]) < first * 0.75, (first, float(m["loss"]))
+
+
+def test_eval_step(devices8):
+    mesh = build_mesh(MeshConfig(data=8), devices8)
+    cfg = llama_tiny(vocab=64)
+    model, state = _llama_state(mesh, rules_for("dp"), cfg)
+    ev = make_eval_step(model, mesh, rules_for("dp"))
+    batch = next(token_batches(8, 32, cfg.vocab_size))
+    m = ev(state.params, batch)
+    assert np.isfinite(float(m["loss"]))
+    assert 0.0 <= float(m["accuracy"]) <= 1.0
+
+
+def test_fsdp_only_sharding(devices8):
+    mesh = build_mesh(MeshConfig(data=1, fsdp=8), devices8)
+    _, state = _llama_state(mesh, rules_for("fsdp"))
+    gate = state.params["layers"]["mlp"]["gate_proj"]["kernel"]
+    assert tuple(gate.sharding.spec) == (None, "fsdp", None)
