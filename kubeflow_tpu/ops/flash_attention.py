@@ -1,0 +1,172 @@
+"""Pallas TPU flash attention (forward kernel + recompute VJP).
+
+The hot op of the flagship model. The reference platform has no kernels at
+all (GPU attention lived in user containers: flash-attn/vLLM; SURVEY.md
+§2.6) — this is the TPU-native equivalent, written against the Pallas TPU
+model (/opt/skills/guides/pallas_guide.md): online-softmax blockwise
+attention; Q blocks in VMEM stream over K/V blocks; fp32 accumulators;
+causal upper blocks skipped entirely (not masked) so the causal speedup is
+real wall-clock, not just masking.
+
+Layout: q [B, S, H, D], k/v [B, T, KH, D] with GQA (H % KH == 0). The grid
+is (B*H, Q_blocks); each program owns one q block and loops over its visible
+kv blocks. K/V stay sequence-complete in VMEM per (batch, head) program —
+fine through ~8k tokens at D=128 in bf16; ring attention (ring_attention.py)
+is the path past that.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int,
+                      block_kv: int, seq_kv: int, causal: bool,
+                      sm_scale: float):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * sm_scale  # [block_q, D]
+
+    num_kv_blocks = pl.cdiv(seq_kv, block_kv)
+    if causal:
+        # Highest kv block index any row of this q block may see.
+        last = (qi + 1) * block_q - 1
+        num_visible = jnp.minimum((last // block_kv) + 1, num_kv_blocks)
+    else:
+        num_visible = num_kv_blocks
+
+    def body(j, carry):
+        acc, m, l = carry
+        k = k_ref[0, pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [block_q, block_kv]
+        cols = jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 1) + j * block_kv
+        # Mask padded keys (inputs are padded up to a block multiple by the
+        # wrapper; without this the pad keys would attend in non-causal mode).
+        valid = cols < seq_kv
+        if causal:
+            rows = jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 0) + qi * block_q
+            valid = jnp.logical_and(valid, rows >= cols)
+        s = jnp.where(valid, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc_new, m_new, l_new
+
+    d = q_ref.shape[-1]
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, num_visible, body, (acc0, m0, l0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_fwd(q3, k3, v3, *, group: int, causal: bool, block_q: int,
+               block_kv: int, seq_kv: int, sm_scale: float, interpret: bool):
+    """q3 [B*H, S, D]; k3/v3 [B*KH, T, D], padded to block multiples; GQA is
+    served zero-copy by the K/V index_map (q program bh reads kv row
+    bh // group, since bh = batch*H + qh and H = KH*group). seq_kv is the
+    pre-padding key length used for masking."""
+    bh, s, d = q3.shape
+    t = k3.shape[1]
+    grid = (bh, pl.cdiv(s, block_q))
+    kernel = functools.partial(
+        _flash_fwd_kernel, block_q=block_q, block_kv=block_kv, seq_kv=seq_kv,
+        causal=causal, sm_scale=sm_scale)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, t, d), lambda b, i: (b // group, 0, 0)),
+            pl.BlockSpec((1, t, d), lambda b, i: (b // group, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q3.dtype),
+        interpret=interpret,
+    )(q3, k3, v3)
+
+
+def _flatten_heads(q, k, v):
+    """[B,S,H,D] → q3 [B*H, S, D], k3/v3 [B*KH, T, D] — no GQA repetition;
+    the kernel's index_map maps q heads onto shared kv heads."""
+    b, s, h, d = q.shape
+    t, kh = k.shape[1], k.shape[2]
+    q3 = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    k3 = k.transpose(0, 2, 1, 3).reshape(b * kh, t, d)
+    v3 = v.transpose(0, 2, 1, 3).reshape(b * kh, t, d)
+    return q3, k3, v3
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal: bool = True, block_q: int = 512,
+                    block_kv: int = 512, interpret: bool | None = None):
+    """Flash attention. q [B,S,H,D]; k,v [B,T,KH,D]; returns [B,S,H,D].
+
+    Forward runs the Pallas kernel (O(S) memory); backward recomputes via
+    the einsum formulation under jax.checkpoint semantics — correct, and
+    memory-bounded by the backward's own S×T blocks. A fused Pallas
+    backward is a planned optimization (tracked in ops/ROADMAP.md)."""
+    return _attn_reference(q, k, v, causal, block_q, block_kv, interpret)
+
+
+def _attn_reference(q, k, v, causal, block_q, block_kv, interpret):
+    b, s, h, d = q.shape
+    t = k.shape[1]
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu",)
+    sm_scale = 1.0 / (d ** 0.5)
+    kh = k.shape[2]
+    if h % kh:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {kh}")
+    q3, k3, v3 = _flatten_heads(q, k, v)
+    # Pad sequences to block multiples: unpadded dynamic slices would clamp
+    # at the boundary and silently misalign kv columns. The kernel masks
+    # padded keys via its seq_kv bound; padded q rows are sliced off here.
+    block_q = min(block_q, max(s, 1))
+    block_kv = min(block_kv, max(t, 1))
+    s_pad = -s % block_q
+    t_pad = -t % block_kv
+    if s_pad:
+        q3 = jnp.pad(q3, ((0, 0), (0, s_pad), (0, 0)))
+    if t_pad:
+        k3 = jnp.pad(k3, ((0, 0), (0, t_pad), (0, 0)))
+        v3 = jnp.pad(v3, ((0, 0), (0, t_pad), (0, 0)))
+    o3 = _flash_fwd(q3, k3, v3, group=h // kh, causal=causal, block_q=block_q,
+                    block_kv=block_kv, seq_kv=t, sm_scale=sm_scale,
+                    interpret=interpret)
+    o3 = o3[:, :s]
+    return o3.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+def _flash_fwd_rule(q, k, v, causal, block_q, block_kv, interpret):
+    out = _attn_reference(q, k, v, causal, block_q, block_kv, interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd_rule(causal, block_q, block_kv, interpret, res, g):
+    q, k, v = res
+
+    def ref(q, k, v):
+        from kubeflow_tpu.models.llama import naive_attention
+        return naive_attention(q, k, v, causal=causal)
+
+    _, vjp = jax.vjp(ref, q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)

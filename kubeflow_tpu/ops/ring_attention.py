@@ -1,0 +1,158 @@
+"""Ring attention: causal attention over a sequence-sharded mesh axis.
+
+First-class sequence/context parallelism (SURVEY.md §5.7) — the capability
+the reference could only *host* (DeepSpeed-Ulysses / Megatron-CP ran inside
+user containers; the platform just provided pods + NCCL env). Here it is an
+op: K/V shards rotate around the `seq` mesh-axis ring via
+`jax.lax.ppermute` while each device accumulates online-softmax partial
+results for its resident Q shard, so peak memory is O(S/n) per device and
+the permute overlaps with the block compute under XLA's async collectives.
+
+Works under `jit` by nesting a `shard_map` over the seq axis; differentiable
+(each ring step is rematerialized). The all-to-all "Ulysses" alternative is
+`ulysses_attention` below: resharding seq↔heads around a local attention so
+existing per-head kernels apply — preferable when heads ≥ ring size and
+context is moderate.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+from kubeflow_tpu.parallel.mesh import current_mesh
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, q_pos, kv_pos):
+    """One blockwise attention contribution with causal masking by absolute
+    positions. q [b,s,h,d] (local shard), k/v [b,t,kh,d]. Returns fp32
+    (acc [b,s,h,d], m [b,s,h,1], l [b,s,h,1]) partials."""
+    b, s, h, d = q.shape
+    kh = k.shape[2]
+    group = h // kh
+    qg = q.reshape(b, s, kh, group, d).astype(jnp.float32)
+    scores = jnp.einsum("bskgd,btkd->bskgt", qg, k.astype(jnp.float32))
+    scores = scores / (d ** 0.5)
+    mask = q_pos[:, :, None, None, None] >= kv_pos[:, None, None, None, :]
+    scores = jnp.where(mask, scores, NEG_INF)
+    m = jnp.max(scores, axis=-1, keepdims=True)  # [b,s,kh,g,1]
+    # Rows with no visible keys: exp(NEG_INF - NEG_INF) would be 1; zero them
+    # via l and guard m so downstream exp() stays finite.
+    m_safe = jnp.maximum(m, NEG_INF / 2)
+    p = jnp.exp(scores - m_safe) * (m > NEG_INF / 2)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    acc = jnp.einsum("bskgt,btkd->bskgd", p, v.astype(jnp.float32))
+    return (acc.reshape(b, s, h, d), m_safe.reshape(b, s, h, 1),
+            l.reshape(b, s, h, 1))
+
+
+def _merge(carry, update):
+    """Merge two online-softmax partials."""
+    acc, m, l = carry
+    acc_u, m_u, l_u = update
+    m_new = jnp.maximum(m, m_u)
+    a1 = jnp.exp(m - m_new)
+    a2 = jnp.exp(m_u - m_new)
+    return acc * a1 + acc_u * a2, m_new, l * a1 + l_u * a2
+
+
+def ring_attention(q, k, v, axis_name: str = "seq",
+                   positions: jax.Array | None = None,
+                   mesh=None) -> jax.Array:
+    """Causal ring attention. q [B,S,H,D], k/v [B,S,KH,D] — S is the GLOBAL
+    sequence; arrays may be traced under jit with any sharding, the inner
+    shard_map forces P(axis_name) on dim 1. `positions` defaults to
+    arange(S) broadcast over batch (standard packing comes later)."""
+    mesh = mesh or current_mesh()
+    if mesh is None:
+        raise ValueError("ring_attention needs a mesh (with mesh: ...)")
+    n = mesh.shape[axis_name]
+    b, s, h, d = q.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None],
+                                     (b, s))
+    if n == 1:
+        from kubeflow_tpu.models.llama import naive_attention
+        return naive_attention(q, k, v, causal=True, positions_q=positions,
+                               positions_kv=positions)
+
+    # Batch stays sharded over the dp-like axes — replicating it here would
+    # all-gather the global batch onto every seq-ring member.
+    spec = P(("data", "fsdp"), axis_name, None, None)
+    pos_spec = P(("data", "fsdp"), axis_name)
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(spec, spec, spec, pos_spec),
+        out_specs=spec, check_vma=False)
+    def _ring(q, k, v, pos):
+        # All shapes here are per-shard: s_loc = S / n, b_loc = B / dp.
+        def step(i, carry):
+            acc_m_l, kv, kv_pos = carry
+            k_i, v_i = kv
+            update = _block_attn(q, k_i, v_i, pos, kv_pos)
+            acc_m_l = _merge(acc_m_l, update)
+
+            # Rotate K/V (and their positions) to the next ring neighbour —
+            # skipped on the final step, whose rotation would be discarded.
+            def rotate(operand):
+                perm = [(j, (j + 1) % n) for j in range(n)]
+                return jax.tree.map(
+                    lambda x: jax.lax.ppermute(x, axis_name, perm), operand)
+
+            kv, kv_pos = jax.lax.cond(
+                i < n - 1, rotate, lambda o: o, (kv, kv_pos))
+            return acc_m_l, kv, kv_pos
+
+        b_loc, s_loc = q.shape[0], q.shape[1]
+        init = (jnp.zeros((b_loc, s_loc, h, d), jnp.float32),
+                jnp.full((b_loc, s_loc, h, 1), NEG_INF, jnp.float32),
+                jnp.zeros((b_loc, s_loc, h, 1), jnp.float32))
+        (acc, m, l), _, _ = jax.lax.fori_loop(
+            0, n, jax.checkpoint(step), (init, (k, v), pos))
+        return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+    return _ring(q, k, v, positions)
+
+
+def ulysses_attention(q, k, v, axis_name: str = "seq",
+                      mesh=None) -> jax.Array:
+    """DeepSpeed-Ulysses-style context parallelism: all_to_all seq↔heads so
+    each device holds full sequence for H/n heads, runs local (flash)
+    attention, then all_to_all back. Requires H % n == 0 and KH % n == 0."""
+    mesh = mesh or current_mesh()
+    if mesh is None:
+        raise ValueError("ulysses_attention needs a mesh")
+    n = mesh.shape[axis_name]
+    if n == 1:
+        from kubeflow_tpu.models.llama import naive_attention
+        return naive_attention(q, k, v, causal=True)
+
+    spec = P(("data", "fsdp"), axis_name, None, None)
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    def _ulysses(q, k, v):
+        # [b, s/n, h, d] -> all_to_all -> [b, s, h/n, d]
+        def scatter_heads(x):
+            return jax.lax.all_to_all(x, axis_name, split_axis=2,
+                                      concat_axis=1, tiled=True)
+
+        def gather_heads(x):
+            return jax.lax.all_to_all(x, axis_name, split_axis=1,
+                                      concat_axis=2, tiled=True)
+
+        ql, kl, vl = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+        # Local attention is the flash kernel — full-sequence naive scores
+        # here would defeat the point of context parallelism (O(S²) memory).
+        from kubeflow_tpu.ops.flash_attention import flash_attention
+        out = flash_attention(ql, kl, vl, True)
+        return gather_heads(out)
+
+    return _ulysses(q, k, v)

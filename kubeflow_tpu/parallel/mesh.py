@@ -97,3 +97,12 @@ def mesh_shape(mesh: Mesh) -> dict[str, int]:
 def dp_like_axes(mesh: Mesh) -> tuple[str, ...]:
     """Axes over which the batch is sharded (data + fsdp)."""
     return tuple(a for a in ("data", "fsdp") if mesh.shape[a] > 1) or ("data",)
+
+
+def current_mesh() -> Mesh | None:
+    """The mesh installed by `with mesh:` (thread-local). Lets ops like
+    ring_attention find the mesh from inside a model without plumbing."""
+    from jax._src import mesh as mesh_lib  # stable across jax 0.4–0.9
+
+    phys = mesh_lib.thread_resources.env.physical_mesh
+    return None if phys.empty else phys
