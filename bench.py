@@ -1,0 +1,90 @@
+"""Headline benchmark: Llama-class causal-LM training throughput on TPU.
+
+Prints ONE JSON line:
+  {"metric": "tokens_per_sec_per_chip", "value": N, "unit": "tok/s/chip",
+   "vs_baseline": MFU/0.45, ...}
+
+The reference publishes no numbers (BASELINE.md: published={}), so
+vs_baseline is measured MFU against the north-star 45% MFU target for
+Llama-8B-class fine-tuning. Runs on whatever chips are present (the CI
+driver runs it on the 1-chip emulated v5e).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from kubeflow_tpu.models.llama import Llama, LlamaConfig
+    from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+    from kubeflow_tpu.parallel.sharding import DEFAULT_RULES
+    from kubeflow_tpu.train.metrics import StepTimer, peak_flops_per_chip
+    from kubeflow_tpu.train.step import init_train_state, make_train_step
+
+    # ~330M-param bench model: same flagship topology (GQA/RoPE/SwiGLU/scan)
+    # sized to fit comfortably in one emulated v5e's HBM with Adam state.
+    cfg = LlamaConfig(
+        vocab_size=32768, hidden_size=1024, intermediate_size=4096,
+        num_layers=16, num_heads=16, num_kv_heads=8, head_dim=64,
+        max_seq_len=1024, remat=False, attention_impl="auto",
+        flash_block_q=256, flash_block_kv=256)
+    batch, seq = 8, 1024
+
+    n_chips = jax.device_count()
+    mesh = build_mesh(MeshConfig(), jax.devices())
+    model = Llama(cfg)
+    tokens = jnp.zeros((batch, seq), jnp.int32)
+    state = init_train_state(
+        model, optax.adamw(3e-4), jax.random.key(0), (tokens,), mesh,
+        DEFAULT_RULES)
+    step = make_train_step(model, mesh, DEFAULT_RULES)
+
+    rng = np.random.default_rng(0)
+    def make_batch():
+        return {
+            "inputs": rng.integers(0, cfg.vocab_size, (batch, seq),
+                                   dtype=np.int32),
+            "targets": rng.integers(0, cfg.vocab_size, (batch, seq),
+                                    dtype=np.int32),
+        }
+
+    timer = StepTimer(num_params=cfg.num_params, tokens_per_step=batch * seq,
+                      num_chips=n_chips, warmup_steps=2)
+    warmup, timed = 2, 8
+    for i in range(warmup + timed):
+        b = make_batch()
+        timer.start()
+        state, metrics = step(state, b)
+        jax.block_until_ready(metrics["loss"])
+        snap = timer.stop()
+        print(f"step {i}: {snap['step_time_s']*1e3:.1f} ms "
+              f"loss={float(metrics['loss']):.3f}", file=sys.stderr)
+
+    final = timer.snapshot()
+    result = {
+        "metric": "tokens_per_sec_per_chip",
+        "value": round(final["tokens_per_sec_per_chip"], 1),
+        "unit": "tok/s/chip",
+        "vs_baseline": round(final["mfu"] / 0.45, 4),
+        "mfu": round(final["mfu"], 4),
+        "model_params": cfg.num_params,
+        "chips": n_chips,
+        "device_kind": jax.devices()[0].device_kind,
+        "peak_flops_per_chip": peak_flops_per_chip(),
+        "batch": batch,
+        "seq_len": seq,
+        "avg_step_time_s": round(final["avg_step_time_s"], 4),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -115,30 +115,17 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array,
     return out.astype(x.dtype)
 
 
-def naive_attention(q, k, v, *, causal: bool = True,
-                    positions_q=None, positions_kv=None) -> jax.Array:
-    """Reference einsum attention (fp32 softmax). q:[B,S,H,D] k,v:[B,T,K,D]."""
-    b, s, h, d = q.shape
-    t, kh = k.shape[1], k.shape[2]
-    group = h // kh
-    qg = q.reshape(b, s, kh, group, d)
-    scores = jnp.einsum("bskgd,btkd->bkgst", qg, k).astype(jnp.float32)
-    scores = scores / jnp.sqrt(d).astype(jnp.float32)
-    if causal:
-        pq = positions_q if positions_q is not None else jnp.arange(s)[None]
-        pk = positions_kv if positions_kv is not None else jnp.arange(t)[None]
-        mask = pq[:, None, None, :, None] >= pk[:, None, None, None, :]
-        scores = jnp.where(mask, scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    out = jnp.einsum("bkgst,btkd->bskgd", probs, v)
-    return out.reshape(b, s, h, d)
+# Re-exported for compatibility; canonical home is ops/reference.py (ops/
+# must not depend on models/).
+from kubeflow_tpu.ops.reference import naive_attention  # noqa: E402,F401
 
 
 class Attention(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, cos, sin, positions, ring_axis: str | None = None):
+    def __call__(self, x, cos, sin, positions, ring_axis: str | None = None,
+                 standard_positions: bool = True):
         cfg = self.cfg
         dense = partial(
             nn.DenseGeneral, use_bias=False, dtype=cfg.dtype,
@@ -165,11 +152,17 @@ class Attention(nn.Module):
         if impl == "auto":
             if ring_axis is not None:
                 impl = "ring"
-            elif (jax.default_backend() in ("tpu", "axon")
-                  and q.shape[1] % cfg.flash_block_q == 0):
+            elif (standard_positions
+                  and jax.default_backend() in ("tpu", "axon")):
                 impl = "flash"
             else:
                 impl = "naive"
+        if impl == "flash" and not standard_positions:
+            # The flash kernel masks causality by array index; custom
+            # positions (packed/offset sequences) need position-aware masks.
+            raise ValueError(
+                "attention_impl='flash' does not support custom positions; "
+                "use 'naive' or 'ring'")
         if impl == "ring":
             from kubeflow_tpu.ops.ring_attention import ring_attention
             out = ring_attention(q, k, v, axis_name=ring_axis or "seq",
@@ -217,10 +210,12 @@ class DecoderLayer(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, cos, sin, positions, ring_axis=None):
+    def __call__(self, x, cos, sin, positions, ring_axis=None,
+                 standard_positions=True):
         cfg = self.cfg
         h = RMSNorm(cfg.rms_eps, cfg.dtype, name="input_norm")(x)
-        x = x + Attention(cfg, name="attn")(h, cos, sin, positions, ring_axis)
+        x = x + Attention(cfg, name="attn")(h, cos, sin, positions, ring_axis,
+                                            standard_positions)
         h = RMSNorm(cfg.rms_eps, cfg.dtype, name="post_attn_norm")(x)
         x = x + MLPBlock(cfg, name="mlp")(h)
         x = nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
@@ -236,6 +231,7 @@ class Llama(nn.Module):
     def __call__(self, tokens: jax.Array, positions: jax.Array | None = None,
                  ring_axis: str | None = None) -> jax.Array:
         cfg = self.cfg
+        standard_positions = positions is None
         if positions is None:
             positions = jnp.broadcast_to(
                 jnp.arange(tokens.shape[1]), tokens.shape)
@@ -251,10 +247,12 @@ class Llama(nn.Module):
         if cfg.remat:
             layer_cls = nn.remat(
                 layer_cls, policy=jax.checkpoint_policies.nothing_saveable,
-                static_argnums=(5,))
+                static_argnums=(5, 6))
         if cfg.scan_layers:
             x, _ = nn.scan(
-                lambda mdl, carry, _: (mdl(carry, cos, sin, positions, ring_axis), None),
+                lambda mdl, carry, _: (mdl(carry, cos, sin, positions,
+                                           ring_axis, standard_positions),
+                                       None),
                 variable_axes={"params": 0},
                 split_rngs={"params": True},
                 length=cfg.num_layers,
@@ -262,7 +260,8 @@ class Llama(nn.Module):
             )(layer_cls(cfg, name="layers"), x, None)
         else:
             for i in range(cfg.num_layers):
-                x = layer_cls(cfg, name=f"layer_{i}")(x, cos, sin, positions, ring_axis)
+                x = layer_cls(cfg, name=f"layer_{i}")(
+                    x, cos, sin, positions, ring_axis, standard_positions)
 
         x = RMSNorm(cfg.rms_eps, cfg.dtype, name="final_norm")(x)
         if cfg.tie_embeddings:

@@ -162,7 +162,7 @@ def _flash_bwd_rule(causal, block_q, block_kv, interpret, res, g):
     q, k, v = res
 
     def ref(q, k, v):
-        from kubeflow_tpu.models.llama import naive_attention
+        from kubeflow_tpu.ops.reference import naive_attention
         return naive_attention(q, k, v, causal=causal)
 
     _, vjp = jax.vjp(ref, q, k, v)

@@ -1,0 +1,33 @@
+"""Reference (einsum) attention — the numerics golden for every fused path.
+
+Single source of truth for GQA softmax attention: models call it as the
+portable fallback, flash_attention's VJP differentiates through it, and the
+kernel tests compare against it. O(S·T) score materialization — correct at
+any size, only efficient at small ones.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def naive_attention(q, k, v, *, causal: bool = True,
+                    positions_q=None, positions_kv=None) -> jax.Array:
+    """q: [B,S,H,D]; k,v: [B,T,KH,D] with H % KH == 0; fp32 softmax.
+    Causality is masked by absolute positions when given (packed/offset
+    sequences), else by array index."""
+    b, s, h, d = q.shape
+    t, kh = k.shape[1], k.shape[2]
+    group = h // kh
+    qg = q.reshape(b, s, kh, group, d)
+    scores = jnp.einsum("bskgd,btkd->bkgst", qg, k).astype(jnp.float32)
+    scores = scores / jnp.sqrt(d).astype(jnp.float32)
+    if causal:
+        pq = positions_q if positions_q is not None else jnp.arange(s)[None]
+        pk = positions_kv if positions_kv is not None else jnp.arange(t)[None]
+        mask = pq[:, None, None, :, None] >= pk[:, None, None, None, :]
+        scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bkgst,btkd->bskgd", probs, v)
+    return out.reshape(b, s, h, d)

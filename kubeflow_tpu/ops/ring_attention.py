@@ -78,7 +78,7 @@ def ring_attention(q, k, v, axis_name: str = "seq",
         positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None],
                                      (b, s))
     if n == 1:
-        from kubeflow_tpu.models.llama import naive_attention
+        from kubeflow_tpu.ops.reference import naive_attention
         return naive_attention(q, k, v, causal=True, positions_q=positions,
                                positions_kv=positions)
 
@@ -131,7 +131,7 @@ def ulysses_attention(q, k, v, axis_name: str = "seq",
         raise ValueError("ulysses_attention needs a mesh")
     n = mesh.shape[axis_name]
     if n == 1:
-        from kubeflow_tpu.models.llama import naive_attention
+        from kubeflow_tpu.ops.reference import naive_attention
         return naive_attention(q, k, v, causal=True)
 
     spec = P(("data", "fsdp"), axis_name, None, None)
@@ -149,8 +149,10 @@ def ulysses_attention(q, k, v, axis_name: str = "seq",
                                       concat_axis=2, tiled=True)
 
         ql, kl, vl = scatter_heads(q), scatter_heads(k), scatter_heads(v)
-        # Local attention is the flash kernel — full-sequence naive scores
-        # here would defeat the point of context parallelism (O(S²) memory).
+        # Forward via the flash kernel: O(S) memory. NOTE the backward still
+        # recomputes through the einsum reference (O(S²) scores) until the
+        # Pallas backward lands — see ops/ROADMAP.md; prefer ring_attention
+        # for training at very long context.
         from kubeflow_tpu.ops.flash_attention import flash_attention
         out = flash_attention(ql, kl, vl, True)
         return gather_heads(out)

@@ -119,9 +119,10 @@ def constrain(x: jax.Array, logical_axes: Sequence[str | None], rules: Rules,
     )
 
 
-def param_spec_tree(params: Any, logical_fn: Mapping[str, Any] | None = None) -> Any:
-    """Extract PartitionSpecs from a flax param tree annotated with
-    `nn.with_logical_partitioning` metadata (flax boxed metadata)."""
+def param_spec_tree(params: Any) -> Any:
+    """Extract logical PartitionSpecs from a flax param tree annotated with
+    `nn.with_logical_partitioning` metadata; map through rules with
+    `nn.logical_to_mesh_sharding`."""
     import flax.linen as nn
 
     return nn.get_partition_spec(params)

@@ -92,7 +92,8 @@ class MetricsLogger:
             try:
                 rec[k] = float(v)
             except (TypeError, ValueError):
-                rec[k] = v
+                tolist = getattr(v, "tolist", None)
+                rec[k] = tolist() if tolist is not None else str(v)
         line = json.dumps(rec)
         if self._fh:
             self._fh.write(line + "\n")

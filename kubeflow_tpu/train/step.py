@@ -97,10 +97,17 @@ def make_train_step(
             return loss_fn(logits, batch)
         return cross_entropy_loss(logits, batch["targets"], batch.get("mask"))
 
+    def constrain_batch(x):
+        # dim 0 is always the batch; dim 1 is the sequence only for
+        # token-like integer arrays — float features (e.g. MLP inputs
+        # [B, 784]) must not be sharded over the seq axis.
+        axes: tuple = ("batch",)
+        if x.ndim >= 2 and jnp.issubdtype(x.dtype, jnp.integer):
+            axes = ("batch", "act_seq")
+        return nn.with_logical_constraint(x, axes + (None,) * (x.ndim - len(axes)))
+
     def step(state: TrainState, batch: dict):
-        batch = jax.tree.map(
-            lambda x: nn.with_logical_constraint(
-                x, ("batch", "act_seq")[: x.ndim]), batch)
+        batch = jax.tree.map(constrain_batch, batch)
         loss, grads = jax.value_and_grad(compute_loss)(state.params, batch)
         new_state = state.apply_gradients(grads)
         gnorm = optax.global_norm(grads)
@@ -128,9 +135,14 @@ def make_eval_step(model: nn.Module, mesh: jax.sharding.Mesh,
         logits = model.apply({"params": params}, batch["inputs"], **model_kwargs)
         if isinstance(logits, tuple):
             logits = logits[-1]
-        loss = cross_entropy_loss(logits, batch["targets"], batch.get("mask"))
-        acc = jnp.mean(
-            (jnp.argmax(logits, -1) == batch["targets"]).astype(jnp.float32))
+        mask = batch.get("mask")
+        loss = cross_entropy_loss(logits, batch["targets"], mask)
+        hits = (jnp.argmax(logits, -1) == batch["targets"]).astype(jnp.float32)
+        if mask is not None:
+            m = mask.astype(jnp.float32)
+            acc = jnp.sum(hits * m) / jnp.maximum(jnp.sum(m), 1.0)
+        else:
+            acc = jnp.mean(hits)
         return {"loss": loss, "accuracy": acc}
 
     jitted = jax.jit(step)
