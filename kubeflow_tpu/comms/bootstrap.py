@@ -1,0 +1,83 @@
+"""Process bootstrap: the TPU-native replacement for rendezvous env plumbing.
+
+The reference's controllers inject MASTER_ADDR/MASTER_PORT/WORLD_SIZE/RANK
+(PyTorchJob), TF_CONFIG JSON (TFJob), or SSH hostfiles (MPIJob) and leave
+rendezvous to torchrun/NCCL (SURVEY.md §2.7, §3.1). Here the contract is
+three env vars consumed by `jax.distributed.initialize`, and the entire SSH/
+hostfile/NCCL-unique-id plane is deleted — XLA compiles collectives onto
+ICI/DCN directly:
+
+    TPK_COORDINATOR   host:port of process 0's coordination service
+    TPK_NUM_PROCS     total process count (one per TPU VM host)
+    TPK_PROC_ID       this process's index
+
+Optional slice topology (multi-slice jobs over DCN):
+    TPK_NUM_SLICES    number of TPU slices (default 1)
+    TPK_SLICE_ID      this process's slice index
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+
+@dataclasses.dataclass(frozen=True)
+class ProcessEnv:
+    coordinator: str | None
+    num_processes: int
+    process_id: int
+    num_slices: int = 1
+    slice_id: int = 0
+
+    @property
+    def distributed(self) -> bool:
+        return self.num_processes > 1
+
+
+def read_env(environ=None) -> ProcessEnv:
+    env = environ if environ is not None else os.environ
+    coord = env.get("TPK_COORDINATOR")
+    num = int(env.get("TPK_NUM_PROCS", "1"))
+    pid = int(env.get("TPK_PROC_ID", "0"))
+    if num > 1 and not coord:
+        raise ValueError("TPK_NUM_PROCS > 1 requires TPK_COORDINATOR")
+    if not 0 <= pid < num:
+        raise ValueError(f"TPK_PROC_ID {pid} out of range [0, {num})")
+    num_slices = int(env.get("TPK_NUM_SLICES", "1"))
+    slice_id = int(env.get("TPK_SLICE_ID", "0"))
+    if not 0 <= slice_id < num_slices:
+        raise ValueError(
+            f"TPK_SLICE_ID {slice_id} out of range [0, {num_slices})")
+    return ProcessEnv(
+        coordinator=coord, num_processes=num, process_id=pid,
+        num_slices=num_slices, slice_id=slice_id)
+
+
+_initialized = False
+
+
+def initialize(penv: ProcessEnv | None = None) -> ProcessEnv:
+    """Idempotent `jax.distributed.initialize` from the env contract.
+    Single-process (num=1) skips initialization entirely — jit/collectives
+    work locally, which is how unit tests and the 1-chip bench run."""
+    global _initialized
+    penv = penv or read_env()
+    if penv.distributed and not _initialized:
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=penv.coordinator,
+            num_processes=penv.num_processes,
+            process_id=penv.process_id)
+        _initialized = True
+    return penv
+
+
+def shutdown() -> None:
+    global _initialized
+    if _initialized:
+        import jax
+
+        jax.distributed.shutdown()
+        _initialized = False

@@ -1,0 +1,54 @@
+"""Checkpoint/auto-resume on orbax — first-class, unlike the reference.
+
+Kubeflow leaves checkpointing to user code on PVCs (SURVEY.md §5.4); the
+platform's only resume stories are Katib's DB resume and KFP's step cache.
+Here every training job checkpoints through this manager (async, sharded,
+multi-host-safe via orbax), and the JAXJob controller restarts processes
+with `restore=latest` — checkpoint-restart IS the elasticity mechanism
+(§5.3: world-resize in JAX means recompile, so v1 elasticity = resume).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import orbax.checkpoint as ocp
+
+
+class CheckpointManager:
+    """Thin wrapper over ocp.CheckpointManager for TrainState pytrees."""
+
+    def __init__(self, directory: str, *, interval: int = 100,
+                 keep: int = 3, async_save: bool = True):
+        self.directory = str(directory)
+        self.interval = interval
+        options = ocp.CheckpointManagerOptions(
+            save_interval_steps=interval,
+            max_to_keep=keep,
+            enable_async_checkpointing=async_save,
+        )
+        self._mgr = ocp.CheckpointManager(self.directory, options=options)
+
+    def maybe_save(self, step: int, state: Any, *, force: bool = False) -> bool:
+        """Save if `step` hits the interval (orbax enforces the schedule)."""
+        return self._mgr.save(
+            step, args=ocp.args.StandardSave(state), force=force)
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def restore(self, state_template: Any, step: int | None = None) -> Any:
+        """Restore into the (possibly abstract/sharded) template. Returns the
+        template untouched when no checkpoint exists."""
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            return state_template
+        return self._mgr.restore(
+            step, args=ocp.args.StandardRestore(state_template))
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()

@@ -54,13 +54,18 @@ class StepTimer:
     def start(self):
         self._last = time.perf_counter()
 
-    def stop(self) -> dict:
+    def stop(self, n_steps: int = 1) -> dict:
+        """Close a timing window covering `n_steps` device steps (the trainer
+        only blocks on logging steps, so a window spans several steps)."""
         now = time.perf_counter()
         dt = now - (self._last if self._last is not None else now)
-        self._count += 1
-        if self._count > self.warmup_steps:
-            self._total_time += dt
-        return self.snapshot(step_time=dt)
+        prev = self._count
+        self._count += n_steps
+        # Steps beyond the warmup threshold count toward the average.
+        counted = self._count - max(prev, self.warmup_steps)
+        if counted > 0:
+            self._total_time += dt * (counted / n_steps)
+        return self.snapshot(step_time=dt / max(n_steps, 1))
 
     def snapshot(self, step_time: float | None = None) -> dict:
         counted = max(self._count - self.warmup_steps, 0)

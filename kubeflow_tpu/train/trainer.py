@@ -1,0 +1,270 @@
+"""The training runtime: what a JAXJob worker process actually runs.
+
+The reference's equivalent is user-image code launched by torchrun with env
+injected by the operator (SURVEY.md §3.1) — the platform owns nothing inside
+the pod. Here the runtime is first-class: mesh + sharding rules from the job
+spec, jitted SPMD step, metrics/MFU stream, orbax checkpoint/auto-resume,
+and an optional `jax.profiler` trace window (§5.1).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Any, Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from kubeflow_tpu.comms.bootstrap import ProcessEnv, initialize, read_env
+from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+from kubeflow_tpu.parallel.sharding import rules_for
+from kubeflow_tpu.train.checkpoint import CheckpointManager
+from kubeflow_tpu.train.metrics import MetricsLogger, StepTimer
+from kubeflow_tpu.train.step import init_train_state, make_train_step
+
+
+@dataclasses.dataclass
+class TrainJobSpec:
+    """Declarative training job — the in-process analog of a JAXJob CR's
+    `spec.runtime` section. Controllers serialize this as JSON."""
+
+    model: str = "llama_tiny"
+    model_kwargs: dict = dataclasses.field(default_factory=dict)
+    dataset: str = "synthetic_lm"
+    dataset_kwargs: dict = dataclasses.field(default_factory=dict)
+    strategy: str = "hybrid"  # preset name resolved by rules_for()
+    mesh: dict = dataclasses.field(default_factory=dict)  # MeshConfig fields
+    steps: int = 100
+    batch_size: int = 8
+    seq_len: int = 64
+    learning_rate: float = 1e-3
+    warmup_steps: int = 0
+    weight_decay: float = 0.0
+    seed: int = 0
+    ring_attention: bool = False
+    checkpoint: dict = dataclasses.field(default_factory=dict)
+    # {"dir": str, "interval": int, "keep": int}
+    metrics_path: str | None = None
+    profile: dict = dataclasses.field(default_factory=dict)
+    # {"dir": str, "start_step": int, "num_steps": int}
+    log_every: int = 10
+
+    @classmethod
+    def from_json(cls, text: str) -> "TrainJobSpec":
+        data = json.loads(text)
+        fields = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(data) - fields
+        if unknown:
+            raise ValueError(f"unknown TrainJobSpec fields: {sorted(unknown)}")
+        return cls(**data)
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+
+class Trainer:
+    def __init__(self, spec: TrainJobSpec, penv: ProcessEnv | None = None):
+        self.spec = spec
+        self.penv = penv or read_env()
+        initialize(self.penv)
+
+        from kubeflow_tpu.utils import registry
+
+        self.rules = rules_for(spec.strategy)
+        mesh_fields = dict(spec.mesh)
+        mesh_fields.setdefault("num_slices", self.penv.num_slices)
+        self.mesh = build_mesh(MeshConfig(**mesh_fields))
+        self.model, self.info = registry.build_model(
+            spec.model, **spec.model_kwargs)
+
+        sched: optax.Schedule | float
+        if spec.warmup_steps:
+            sched = optax.linear_schedule(0.0, spec.learning_rate,
+                                          spec.warmup_steps)
+        else:
+            sched = spec.learning_rate
+        self.tx = optax.adamw(sched, weight_decay=spec.weight_decay)
+
+        self._ckpt = None
+        if spec.checkpoint.get("dir"):
+            self._ckpt = CheckpointManager(
+                spec.checkpoint["dir"],
+                interval=spec.checkpoint.get("interval", 50),
+                keep=spec.checkpoint.get("keep", 3))
+        self.logger = MetricsLogger(spec.metrics_path)
+
+    # -- data ---------------------------------------------------------------
+
+    @property
+    def local_batch_size(self) -> int:
+        """spec.batch_size is the GLOBAL batch; each process loads its share
+        (the reference's per-worker DataLoader sharding, done for the user)."""
+        n = jax.process_count()
+        if self.spec.batch_size % n:
+            raise ValueError(
+                f"global batch {self.spec.batch_size} not divisible by "
+                f"{n} processes")
+        return self.spec.batch_size // n
+
+    def _data(self) -> Iterator[dict]:
+        from kubeflow_tpu.utils import registry
+
+        kwargs = dict(self.spec.dataset_kwargs)
+        kwargs.setdefault("batch_size", self.local_batch_size)
+        if self.info.get("task") == "lm":
+            kwargs.setdefault("seq_len", self.spec.seq_len)
+            kwargs.setdefault("vocab_size", self.info["vocab_size"])
+        # Distinct stream per process = per-worker dataset sharding.
+        kwargs.setdefault("seed", self.spec.seed + 7919 * jax.process_index())
+        return registry.build_dataset(self.spec.dataset, **kwargs)
+
+    def _globalize(self, batch: dict) -> dict:
+        """Assemble process-local numpy batches into global jax.Arrays
+        sharded over the dp axes (multi-host path; no-op single-process)."""
+        if jax.process_count() == 1:
+            return batch
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def conv(x):
+            spec = P(("data", "fsdp"), *([None] * (x.ndim - 1)))
+            return jax.make_array_from_process_local_data(
+                NamedSharding(self.mesh, spec), np.asarray(x))
+
+        return jax.tree.map(conv, batch)
+
+    def _example_inputs(self) -> tuple:
+        if self.info.get("task") == "lm":
+            return (jnp.zeros((self.spec.batch_size, self.spec.seq_len),
+                              jnp.int32),)
+        shape = (self.spec.batch_size,) + tuple(
+            self.info["example_shape"][1:])
+        return (jnp.zeros(shape, self.info["example_dtype"]),)
+
+    def _loss_fn(self):
+        if self.info.get("task") == "classify":
+            def loss_fn(logits, batch):
+                if isinstance(logits, tuple):
+                    logits = logits[-1]
+                onehot = jax.nn.one_hot(batch["targets"], logits.shape[-1])
+                return optax.softmax_cross_entropy(logits, onehot).mean()
+            return loss_fn
+        return None  # default causal-LM loss
+
+    # -- run ----------------------------------------------------------------
+
+    def run(self) -> dict:
+        spec = self.spec
+        state = init_train_state(
+            self.model, self.tx, jax.random.key(spec.seed),
+            self._example_inputs(), self.mesh, self.rules)
+
+        start_step = 0
+        if self._ckpt is not None:
+            latest = self._ckpt.latest_step()
+            if latest is not None:
+                state = self._ckpt.restore(state)
+                start_step = int(latest)
+                self.logger.log(start_step, {"event": "restored"})
+
+        model_kwargs = {}
+        if spec.ring_attention:
+            model_kwargs["ring_axis"] = "seq"
+        step_fn = make_train_step(self.model, self.mesh, self.rules,
+                                  loss_fn=self._loss_fn(),
+                                  model_kwargs=model_kwargs)
+
+        tokens_per_step = spec.batch_size * (
+            spec.seq_len if self.info.get("task") == "lm" else 1)
+        timer = StepTimer(
+            num_params=self.info.get("num_params") or 0,
+            tokens_per_step=tokens_per_step)
+
+        # Profile window [prof_start, prof_stop): only valid when a dir and a
+        # start inside the run are both given; clamped so the trace always
+        # closes before the loop ends.
+        prof = spec.profile
+        prof_start = prof_stop = None
+        if prof.get("dir") and prof.get("start_step") is not None:
+            prof_start = max(int(prof["start_step"]), start_step)
+            prof_stop = min(prof_start + int(prof.get("num_steps", 3)),
+                            spec.steps)
+            if prof_start >= spec.steps:
+                prof_start = prof_stop = None
+        prof_active = False
+
+        data = self._data()
+        # Skip already-consumed batches on resume for determinism.
+        for _ in range(start_step):
+            next(data)
+
+        last_metrics: dict = {}
+        timer.start()
+        window = 0
+        for step in range(start_step, spec.steps):
+            if prof_start is not None and step == prof_start:
+                jax.profiler.start_trace(prof["dir"])
+                prof_active = True
+            batch = self._globalize(next(data))
+            state, metrics = step_fn(state, batch)
+            window += 1
+            if prof_active and step + 1 == prof_stop:
+                jax.block_until_ready(metrics["loss"])
+                jax.profiler.stop_trace()
+                prof_active = False
+            if self._ckpt is not None:
+                self._ckpt.maybe_save(step + 1, state)
+            if (step + 1) % spec.log_every == 0 or step + 1 == spec.steps:
+                # Block only at logging boundaries — keeping the dispatch
+                # queue full between them lets host data prep overlap device
+                # compute (the per-step numbers are window averages).
+                jax.block_until_ready(metrics["loss"])
+                perf = timer.stop(n_steps=window)
+                window = 0
+                last_metrics = {
+                    "loss": float(metrics["loss"]),
+                    "grad_norm": float(metrics["grad_norm"]),
+                    "tokens_per_sec": perf["tokens_per_sec"],
+                    "mfu": perf["mfu"],
+                    "step_time_s": perf["step_time_s"],
+                }
+                self.logger.log(step + 1, last_metrics)
+                timer.start()
+
+        if self._ckpt is not None:
+            if self._ckpt.latest_step() != spec.steps:
+                self._ckpt.maybe_save(spec.steps, state, force=True)
+            self._ckpt.wait()
+        self.logger.log(spec.steps, {"event": "done", **last_metrics})
+        return {"final_step": spec.steps, **last_metrics}
+
+
+def main(argv: list[str] | None = None) -> int:
+    """`python -m kubeflow_tpu.train.trainer --spec job.json` — the worker
+    entrypoint the JAXJob executor launches (with TPK_* env injected)."""
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--spec", required=True,
+                        help="path to TrainJobSpec JSON")
+    parser.add_argument("--cpu-devices", type=int, default=0,
+                        help="force N virtual CPU devices (test mode)")
+    args = parser.parse_args(argv)
+
+    if args.cpu_devices:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+
+    with open(args.spec) as fh:
+        spec = TrainJobSpec.from_json(fh.read())
+    result = Trainer(spec).run()
+    print(json.dumps({"result": result}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,129 @@
+"""Model / dataset / optimizer registries.
+
+The config-driven analog of the reference's ConfigMap-based registries
+(katib-config's algorithm→image map, KServe's ServingRuntime model-format→
+container recipe; SURVEY.md §5.6): a job spec names a model and dataset by
+string; controllers and runtimes resolve them here.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+_MODELS: dict[str, Callable[..., Any]] = {}
+_DATASETS: dict[str, Callable[..., Any]] = {}
+
+
+def register_model(name: str):
+    def deco(fn):
+        _MODELS[name] = fn
+        return fn
+    return deco
+
+
+def register_dataset(name: str):
+    def deco(fn):
+        _DATASETS[name] = fn
+        return fn
+    return deco
+
+
+def build_model(name: str, **kwargs):
+    """Returns (flax_module, info dict with num_params/batch spec hints)."""
+    _ensure_builtin()
+    try:
+        fn = _MODELS[name]
+    except KeyError:
+        raise ValueError(f"unknown model {name!r}; have {sorted(_MODELS)}") from None
+    return fn(**kwargs)
+
+
+def build_dataset(name: str, **kwargs):
+    _ensure_builtin()
+    try:
+        fn = _DATASETS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown dataset {name!r}; have {sorted(_DATASETS)}") from None
+    return fn(**kwargs)
+
+
+def list_models() -> list[str]:
+    _ensure_builtin()
+    return sorted(_MODELS)
+
+
+_builtin_loaded = False
+
+
+def _ensure_builtin() -> None:
+    global _builtin_loaded
+    if _builtin_loaded:
+        return
+
+    import jax.numpy as jnp  # noqa: F401
+
+    from kubeflow_tpu.models import bert, llama, mlp
+
+    @register_model("mnist_mlp")
+    def _mnist_mlp(**kw):
+        cfg = mlp.MLPConfig(**kw)
+        model = mlp.MLP(cfg)
+        return model, {"task": "classify", "example_shape": (1, cfg.in_dim),
+                       "example_dtype": "float32", "num_params": None}
+
+    def _llama(cfg: llama.LlamaConfig):
+        return llama.Llama(cfg), {
+            "task": "lm", "example_shape": (1, 16), "example_dtype": "int32",
+            "num_params": cfg.num_params, "vocab_size": cfg.vocab_size,
+            "config": cfg}
+
+    @register_model("llama_tiny")
+    def _llama_tiny(**kw):
+        import dataclasses
+        return _llama(dataclasses.replace(llama.llama_tiny(), **kw))
+
+    @register_model("llama_1b")
+    def _llama_1b(**kw):
+        import dataclasses
+        return _llama(dataclasses.replace(llama.llama_1b(), **kw))
+
+    @register_model("llama3_8b")
+    def _llama3_8b(**kw):
+        import dataclasses
+        return _llama(dataclasses.replace(llama.llama3_8b(), **kw))
+
+    @register_model("bert_tiny")
+    def _bert_tiny(**kw):
+        import dataclasses
+        cfg = dataclasses.replace(bert.bert_tiny(), **kw)
+        return bert.Bert(cfg), {
+            "task": "classify", "example_shape": (1, 16),
+            "example_dtype": "int32", "num_params": None, "config": cfg}
+
+    @register_model("bert_base")
+    def _bert_base(**kw):
+        import dataclasses
+        cfg = dataclasses.replace(bert.bert_base(), **kw)
+        return bert.Bert(cfg), {
+            "task": "classify", "example_shape": (1, 128),
+            "example_dtype": "int32", "num_params": None, "config": cfg}
+
+    from kubeflow_tpu.data import synthetic
+
+    @register_dataset("synthetic_lm")
+    def _synthetic_lm(batch_size=8, seq_len=128, vocab_size=512, seed=0, **kw):
+        return synthetic.token_batches(batch_size, seq_len, vocab_size, seed)
+
+    @register_dataset("learnable_lm")
+    def _learnable_lm(batch_size=8, seq_len=32, vocab_size=64, seed=0, **kw):
+        return synthetic.learnable_token_batches(
+            batch_size, seq_len, vocab_size, seed)
+
+    @register_dataset("mnist_like")
+    def _mnist_like(batch_size=64, seed=0, **kw):
+        return synthetic.mnist_like(batch_size, seed)
+
+    # Only mark loaded once every builtin registered — a failed import above
+    # must re-raise on the next call, not leave the registry silently empty.
+    _builtin_loaded = True
