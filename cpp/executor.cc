@@ -1,0 +1,129 @@
+#include "executor.h"
+
+#include <fcntl.h>
+#include <signal.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstring>
+
+extern char** environ;
+
+namespace tpk {
+
+int LocalExecutor::Spawn(const LaunchSpec& spec, std::string* error) {
+  std::vector<char*> argv;
+  for (const auto& a : spec.argv) argv.push_back(const_cast<char*>(a.c_str()));
+  argv.push_back(nullptr);
+
+  // Build env: inherited + overrides.
+  std::vector<std::string> env_storage;
+  for (char** e = environ; *e; ++e) {
+    const char* eq = strchr(*e, '=');
+    if (!eq) continue;
+    std::string key(*e, eq - *e);
+    if (spec.env.count(key)) continue;  // overridden below
+    env_storage.emplace_back(*e);
+  }
+  for (const auto& [k, v] : spec.env) env_storage.push_back(k + "=" + v);
+  std::vector<char*> envp;
+  for (auto& s : env_storage) envp.push_back(const_cast<char*>(s.c_str()));
+  envp.push_back(nullptr);
+
+  pid_t pid = fork();
+  if (pid < 0) {
+    if (error) *error = std::string("fork: ") + strerror(errno);
+    return -1;
+  }
+  if (pid == 0) {
+    // Child. Redirect stdout/stderr to log files if requested.
+    if (!spec.stdout_path.empty()) {
+      int fd = open(spec.stdout_path.c_str(), O_WRONLY | O_CREAT | O_APPEND,
+                    0644);
+      if (fd >= 0) { dup2(fd, 1); close(fd); }
+    }
+    if (!spec.stderr_path.empty()) {
+      int fd = open(spec.stderr_path.c_str(), O_WRONLY | O_CREAT | O_APPEND,
+                    0644);
+      if (fd >= 0) { dup2(fd, 2); close(fd); }
+    }
+    // Own process group so Kill can signal the whole subtree.
+    setpgid(0, 0);
+    execvpe(argv[0], argv.data(), envp.data());  // PATH lookup (bare "python3")
+    fprintf(stderr, "execvpe %s failed: %s\n", argv[0], strerror(errno));
+    _exit(127);
+  }
+  setpgid(pid, pid);  // also from parent: avoids a race with exec
+  return pid;
+}
+
+bool LocalExecutor::LaunchGang(const std::vector<LaunchSpec>& specs,
+                               std::string* error) {
+  std::vector<std::pair<std::string, int>> started;
+  for (const auto& spec : specs) {
+    int pid = Spawn(spec, error);
+    if (pid < 0) {
+      // Gang atomicity: kill everything already started.
+      for (auto& [id, p] : started) kill(-p, SIGKILL);
+      return false;
+    }
+    started.emplace_back(spec.id, pid);
+  }
+  std::lock_guard<std::mutex> lock(mu_);
+  for (auto& [id, pid] : started) {
+    // Purge stale pid mappings from a previous gang of the same job — a
+    // not-yet-reaped old worker must not clobber the new one's status when
+    // its exit finally arrives.
+    for (auto it = by_pid_.begin(); it != by_pid_.end();) {
+      it = (it->second == id) ? by_pid_.erase(it) : std::next(it);
+    }
+    procs_[id] = {ProcessStatus::Phase::kRunning, -1, pid};
+    by_pid_[pid] = id;
+  }
+  return true;
+}
+
+void LocalExecutor::Kill(const std::string& id) {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = procs_.find(id);
+  if (it == procs_.end() ||
+      it->second.phase != ProcessStatus::Phase::kRunning) {
+    return;
+  }
+  kill(-it->second.pid, SIGKILL);  // whole process group
+}
+
+ProcessStatus LocalExecutor::Status(const std::string& id) const {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = procs_.find(id);
+  return it == procs_.end() ? ProcessStatus{} : it->second;
+}
+
+std::vector<std::string> LocalExecutor::Poll() {
+  std::vector<std::string> changed;
+  while (true) {
+    int status = 0;
+    pid_t pid = waitpid(-1, &status, WNOHANG);
+    if (pid <= 0) break;
+    std::lock_guard<std::mutex> lock(mu_);
+    auto it = by_pid_.find(pid);
+    if (it == by_pid_.end()) continue;
+    const std::string& id = it->second;
+    // Belt-and-braces vs stale exits: only record if this pid is still the
+    // one attributed to the id (LaunchGang purges, but be defensive).
+    if (procs_.count(id) && procs_[id].pid == pid) {
+      int code = WIFEXITED(status)    ? WEXITSTATUS(status)
+                 : WIFSIGNALED(status) ? 128 + WTERMSIG(status)
+                                       : 1;
+      procs_[id] = {code == 0 ? ProcessStatus::Phase::kSucceeded
+                              : ProcessStatus::Phase::kFailed,
+                    code, pid};
+      changed.push_back(id);
+    }
+    by_pid_.erase(it);
+  }
+  return changed;
+}
+
+}  // namespace tpk

@@ -1,0 +1,118 @@
+// Process executor — the kubelet stand-in (SURVEY.md §7.1 layer 4).
+//
+// Upstream, the training-operator creates Pods and kubelet runs containers;
+// process exit codes flow back through pod phases. Here the executor
+// fork/execs local worker processes with injected env (the TPK_* bootstrap
+// contract) and reports exits. The interface is narrow so a real TPU-VM/GKE
+// executor can slot in behind it later.
+//
+// The `FakeExecutor` records would-launch specs and lets tests flip process
+// status by hand — the envtest trick from the reference's controller tests
+// (SURVEY.md §4.2), minus Kubernetes.
+
+#pragma once
+
+#include <functional>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace tpk {
+
+struct LaunchSpec {
+  std::string id;           // unique process id: "<job>/<replica>"
+  std::vector<std::string> argv;
+  std::map<std::string, std::string> env;  // added to inherited environ
+  std::string stdout_path;  // log files ("" = inherit)
+  std::string stderr_path;
+};
+
+struct ProcessStatus {
+  enum class Phase { kPending, kRunning, kSucceeded, kFailed };
+  Phase phase = Phase::kPending;
+  int exit_code = -1;
+  int pid = -1;
+};
+
+class ExecutorInterface {
+ public:
+  virtual ~ExecutorInterface() = default;
+  // Launch all specs (gang). Returns false (launching nothing) if any spawn
+  // fails — gang atomicity at the process level.
+  virtual bool LaunchGang(const std::vector<LaunchSpec>& specs,
+                          std::string* error) = 0;
+  virtual void Kill(const std::string& id) = 0;
+  virtual ProcessStatus Status(const std::string& id) const = 0;
+  // Reap exited children; returns ids whose status changed.
+  virtual std::vector<std::string> Poll() = 0;
+};
+
+class LocalExecutor : public ExecutorInterface {
+ public:
+  bool LaunchGang(const std::vector<LaunchSpec>& specs,
+                  std::string* error) override;
+  void Kill(const std::string& id) override;
+  ProcessStatus Status(const std::string& id) const override;
+  std::vector<std::string> Poll() override;
+
+ private:
+  int Spawn(const LaunchSpec& spec, std::string* error);
+
+  mutable std::mutex mu_;
+  std::map<std::string, ProcessStatus> procs_;
+  std::map<int, std::string> by_pid_;
+};
+
+class FakeExecutor : public ExecutorInterface {
+ public:
+  bool LaunchGang(const std::vector<LaunchSpec>& specs,
+                  std::string* error) override {
+    if (fail_next_launch) {
+      if (error) *error = "fake: launch failure injected";
+      fail_next_launch = false;
+      return false;
+    }
+    for (const auto& s : specs) {
+      launched.push_back(s);
+      procs_[s.id] = {ProcessStatus::Phase::kRunning, -1, 9999};
+    }
+    return true;
+  }
+  void Kill(const std::string& id) override {
+    killed.push_back(id);
+    auto it = procs_.find(id);
+    if (it != procs_.end() &&
+        it->second.phase == ProcessStatus::Phase::kRunning) {
+      it->second = {ProcessStatus::Phase::kFailed, 137, -1};
+      changed_.push_back(id);
+    }
+  }
+  ProcessStatus Status(const std::string& id) const override {
+    auto it = procs_.find(id);
+    return it == procs_.end() ? ProcessStatus{} : it->second;
+  }
+  std::vector<std::string> Poll() override {
+    auto out = changed_;
+    changed_.clear();
+    return out;
+  }
+
+  // Test hooks: flip a process's terminal state (the "envtest" lever).
+  void Finish(const std::string& id, int exit_code) {
+    procs_[id] = {exit_code == 0 ? ProcessStatus::Phase::kSucceeded
+                                 : ProcessStatus::Phase::kFailed,
+                  exit_code, -1};
+    changed_.push_back(id);
+  }
+
+  std::vector<LaunchSpec> launched;
+  std::vector<std::string> killed;
+  bool fail_next_launch = false;
+
+ private:
+  std::map<std::string, ProcessStatus> procs_;
+  std::vector<std::string> changed_;
+};
+
+}  // namespace tpk

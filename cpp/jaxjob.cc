@@ -1,0 +1,367 @@
+#include "jaxjob.h"
+
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <signal.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <ctime>
+
+namespace tpk {
+
+namespace {
+
+double NowWall() { return static_cast<double>(time(nullptr)); }
+
+std::string Timestamp(double now_s) {
+  char buf[32];
+  time_t t = static_cast<time_t>(now_s);
+  struct tm tmv;
+  gmtime_r(&t, &tmv);
+  strftime(buf, sizeof(buf), "%Y-%m-%dT%H:%M:%SZ", &tmv);
+  return buf;
+}
+
+// Find a free TCP port for the jax.distributed coordinator.
+int FreePort() {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return 0;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = 0;
+  int port = 0;
+  if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+    socklen_t len = sizeof(addr);
+    if (getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len) == 0) {
+      port = ntohs(addr.sin_port);
+    }
+  }
+  close(fd);
+  return port;
+}
+
+bool IsTerminal(const std::string& phase) {
+  return phase == "Succeeded" || phase == "Failed";
+}
+
+}  // namespace
+
+JaxJobController::JaxJobController(Store* store, ExecutorInterface* executor,
+                                   Scheduler* scheduler, std::string workdir,
+                                   std::string python)
+    : store_(store),
+      executor_(executor),
+      scheduler_(scheduler),
+      workdir_(std::move(workdir)),
+      python_(std::move(python)) {
+  mkdir(workdir_.c_str(), 0755);
+}
+
+std::string JaxJobController::ProcId(const std::string& job, int replica) {
+  return job + "/" + std::to_string(replica);
+}
+
+Allocation JaxJobController::AllocFromStatus(const Json& status) const {
+  Allocation alloc;
+  for (const auto& [name, n] : status.get("allocation").items()) {
+    alloc.slices[name] = static_cast<int>(n.as_int());
+  }
+  return alloc;
+}
+
+void JaxJobController::SetPhase(JobView& job, const std::string& phase,
+                                const std::string& reason,
+                                const std::string& message, double now_s) {
+  const std::string prev = job.status.get("phase").as_string();
+  job.status["phase"] = phase;
+  Json cond = Json::Object();
+  cond["type"] = phase;
+  cond["status"] = "True";
+  cond["reason"] = reason;
+  cond["message"] = message;
+  cond["lastTransitionTime"] = Timestamp(now_s ? now_s : NowWall());
+  if (!job.status.has("conditions")) job.status["conditions"] = Json::Array();
+  if (prev != phase) {
+    job.status["conditions"].push_back(cond);
+  }
+}
+
+void JaxJobController::KillAll(const JobView& job) {
+  int replicas = static_cast<int>(job.spec.get("replicas").as_int(1));
+  for (int i = 0; i < replicas; ++i) {
+    executor_->Kill(ProcId(job.res.name, i));
+  }
+}
+
+void JaxJobController::ReleaseAlloc(JobView& job) {
+  if (job.status.get("allocation").is_object() &&
+      job.status.get("allocation").size() > 0) {
+    scheduler_->Release(AllocFromStatus(job.status));
+    job.status["allocation"] = Json::Object();
+  }
+}
+
+void JaxJobController::LaunchGang(JobView& job) {
+  const std::string& name = job.res.name;
+  int replicas = static_cast<int>(job.spec.get("replicas").as_int(1));
+  int devices = static_cast<int>(job.spec.get("devices_per_proc").as_int(1));
+  int num_slices = static_cast<int>(job.spec.get("num_slices").as_int(1));
+
+  auto alloc = scheduler_->Allocate(replicas * devices, num_slices);
+  if (!alloc) {
+    SetPhase(job, "Pending", "Unschedulable",
+             "insufficient slice capacity for gang", now_s_);
+    return;
+  }
+
+  // Job workdir: spec file + per-replica logs.
+  std::string dir = workdir_ + "/" + name;
+  mkdir(dir.c_str(), 0755);
+  std::string spec_path = dir + "/runtime.json";
+  {
+    Json runtime = job.spec.get("runtime");
+    FILE* f = fopen(spec_path.c_str(), "w");
+    if (f) {
+      std::string text = runtime.is_null() ? "{}" : runtime.dump();
+      fwrite(text.data(), 1, text.size(), f);
+      fclose(f);
+    }
+  }
+
+  int port = FreePort();
+  std::string coordinator = "127.0.0.1:" + std::to_string(port);
+  int cpu_devices =
+      static_cast<int>(job.spec.get("cpu_devices_per_proc").as_int(0));
+
+  std::vector<LaunchSpec> specs;
+  for (int i = 0; i < replicas; ++i) {
+    LaunchSpec s;
+    s.id = ProcId(name, i);
+    s.argv = {python_, "-m", "kubeflow_tpu.train.trainer", "--spec",
+              spec_path};
+    if (cpu_devices > 0) {
+      s.argv.push_back("--cpu-devices");
+      s.argv.push_back(std::to_string(cpu_devices));
+      // Keep the axon sitecustomize from force-selecting the TPU platform
+      // in CPU-mode workers (it overrides JAX_PLATFORMS via jax.config).
+      s.env["PALLAS_AXON_POOL_IPS"] = "";
+    }
+    if (job.spec.get("command").is_array()) {
+      s.argv.clear();
+      for (const auto& a : job.spec.get("command").elements()) {
+        s.argv.push_back(a.as_string());
+      }
+    }
+    if (replicas > 1) {
+      s.env["TPK_COORDINATOR"] = coordinator;
+    }
+    s.env["TPK_NUM_PROCS"] = std::to_string(replicas);
+    s.env["TPK_PROC_ID"] = std::to_string(i);
+    s.env["TPK_NUM_SLICES"] = std::to_string(num_slices);
+    s.env["TPK_SLICE_ID"] = std::to_string(i * num_slices / replicas);
+    s.env["TPK_JOB_NAME"] = name;
+    s.stdout_path = dir + "/worker-" + std::to_string(i) + ".log";
+    s.stderr_path = dir + "/worker-" + std::to_string(i) + ".err";
+    specs.push_back(std::move(s));
+  }
+
+  std::string error;
+  if (!executor_->LaunchGang(specs, &error)) {
+    scheduler_->Release(*alloc);
+    SetPhase(job, "Pending", "LaunchFailed", error, now_s_);
+    return;
+  }
+
+  Json alloc_json = Json::Object();
+  for (const auto& [slice, n] : alloc->slices) alloc_json[slice] = n;
+  job.status["allocation"] = alloc_json;
+  job.status["coordinator"] = coordinator;
+  job.status["active"] = true;
+  // Record worker pids so a restarted control plane can reap the orphans
+  // it can no longer waitpid (Recover()).
+  Json pids = Json::Array();
+  for (int i = 0; i < replicas; ++i) {
+    pids.push_back(executor_->Status(ProcId(name, i)).pid);
+  }
+  job.status["pids"] = pids;
+  if (!job.status.has("startTime")) {
+    job.status["startTime"] = Timestamp(now_s_ ? now_s_ : NowWall());
+    job.status["startUnix"] = now_s_ ? now_s_ : NowWall();
+  }
+  SetPhase(job, "Running", "GangLaunched",
+           "all " + std::to_string(replicas) + " workers launched", now_s_);
+}
+
+void JaxJobController::HandleExits(JobView& job) {
+  const std::string& name = job.res.name;
+  int replicas = static_cast<int>(job.spec.get("replicas").as_int(1));
+  int succeeded = 0, failed = 0, running = 0;
+  int first_fail_code = 0;
+  for (int i = 0; i < replicas; ++i) {
+    auto st = executor_->Status(ProcId(name, i));
+    switch (st.phase) {
+      case ProcessStatus::Phase::kSucceeded: ++succeeded; break;
+      case ProcessStatus::Phase::kFailed:
+        ++failed;
+        if (!first_fail_code) first_fail_code = st.exit_code;
+        break;
+      case ProcessStatus::Phase::kRunning: ++running; break;
+      case ProcessStatus::Phase::kPending: break;
+    }
+  }
+  Json pstat = Json::Object();
+  pstat["succeeded"] = succeeded;
+  pstat["failed"] = failed;
+  pstat["running"] = running;
+  job.status["processes"] = pstat;
+
+  if (succeeded == replicas) {
+    job.status["active"] = false;
+    ReleaseAlloc(job);
+    job.status["completionUnix"] = now_s_ ? now_s_ : NowWall();
+    SetPhase(job, "Succeeded", "AllWorkersSucceeded",
+             "all workers exited 0", now_s_);
+    metrics_.jobs_succeeded++;
+    return;
+  }
+  if (failed == 0) return;  // still running
+
+  // A worker failed: gang semantics = kill the rest, then decide restart.
+  KillAll(job);
+  job.status["active"] = false;
+  ReleaseAlloc(job);
+
+  const std::string policy =
+      job.spec.get("restart_policy").as_string().empty()
+          ? "OnFailure"
+          : job.spec.get("restart_policy").as_string();
+  int64_t backoff = job.spec.get("backoff_limit").as_int(3);
+  int64_t restarts = job.status.get("restarts").as_int(0);
+
+  bool retryable = policy == "OnFailure";
+  if (policy == "ExitCode") {
+    // Upstream training-operator semantics: 1–127 permanent, 128+ retryable.
+    retryable = first_fail_code >= 128;
+  }
+  if (retryable && restarts < backoff) {
+    job.status["restarts"] = restarts + 1;
+    metrics_.gang_restarts++;
+    SetPhase(job, "Restarting", "WorkerFailed",
+             "worker exited " + std::to_string(first_fail_code) +
+                 "; gang restart " + std::to_string(restarts + 1) + "/" +
+                 std::to_string(backoff),
+             now_s_);
+    // Relaunch happens on the next Reconcile pass (status write below
+    // triggers a watch event → reconcile).
+    return;
+  }
+  job.status["completionUnix"] = now_s_ ? now_s_ : NowWall();
+  SetPhase(job, "Failed",
+           retryable ? "BackoffLimitExceeded" : "PermanentFailure",
+           "worker exited " + std::to_string(first_fail_code), now_s_);
+  metrics_.jobs_failed++;
+}
+
+void JaxJobController::Recover() {
+  // Control-plane restart with a WAL: jobs marked active reference worker
+  // processes this process never spawned (reparented orphans) and slice
+  // allocations in a scheduler that was rebuilt empty. Kill the orphans
+  // (best effort, by recorded pgid), drop the stale allocation, and mark
+  // the gang Restarting — the relaunch resumes from the latest checkpoint.
+  for (const auto& res : store_->List("JAXJob")) {
+    JobView job{res, res.spec, res.status};
+    if (!job.status.get("active").as_bool(false)) continue;
+    for (const auto& p : job.status.get("pids").elements()) {
+      int pid = static_cast<int>(p.as_int(-1));
+      if (pid > 1) kill(-pid, SIGKILL);
+    }
+    job.status["active"] = false;
+    job.status["allocation"] = Json::Object();
+    int64_t restarts = job.status.get("restarts").as_int(0);
+    job.status["restarts"] = restarts + 1;  // counts toward backoff: a
+    // crash-looping control plane must not restart gangs forever
+    metrics_.gang_restarts++;
+    SetPhase(job, "Restarting", "ControlPlaneRestarted",
+             "orphaned gang reaped after control-plane restart", NowWall());
+    store_->UpdateStatus("JAXJob", res.name, job.status);
+  }
+}
+
+void JaxJobController::Reconcile(const std::string& name) {
+  metrics_.reconciles++;
+  auto res = store_->Get("JAXJob", name);
+  if (!res) return;
+  JobView job{*res, res->spec, res->status};
+  const std::string phase = job.status.get("phase").as_string();
+
+  if (res->deleted) return;
+
+  if (IsTerminal(phase)) {
+    return;  // GC handled by Tick (TTL)
+  }
+
+  if (phase.empty()) {
+    metrics_.jobs_created++;
+    SetPhase(job, "Created", "JobCreated", "accepted", now_s_);
+  }
+
+  bool active = job.status.get("active").as_bool(false);
+  if (!active) {
+    // Created, Pending, or Restarting → try to launch the gang.
+    LaunchGang(job);
+  } else {
+    HandleExits(job);
+  }
+
+  // Only write when something changed — UpdateStatus emits a watch event
+  // which re-enqueues this reconcile; an unconditional write would loop.
+  if (job.status.dump() != res->status.dump()) {
+    store_->UpdateStatus("JAXJob", name, job.status);
+  }
+}
+
+void JaxJobController::Tick(double now_s) {
+  now_s_ = now_s;
+  // 1) Reap process exits → reconcile owners.
+  for (const auto& id : executor_->Poll()) {
+    auto slash = id.find('/');
+    if (slash != std::string::npos) {
+      Reconcile(id.substr(0, slash));
+    }
+  }
+  // 2) Deadlines, TTL GC, and level-triggered retries for non-terminal jobs.
+  for (const auto& res : store_->List("JAXJob")) {
+    JobView job{res, res.spec, res.status};
+    const std::string phase = job.status.get("phase").as_string();
+    if (IsTerminal(phase)) {
+      int64_t ttl = job.spec.get("ttl_seconds_after_finished").as_int(-1);
+      double done = job.status.get("completionUnix").as_number(0);
+      if (ttl >= 0 && done > 0 && now_s - done > ttl) {
+        store_->Delete("JAXJob", res.name);
+      }
+      continue;
+    }
+    int64_t deadline = job.spec.get("active_deadline_seconds").as_int(0);
+    double started = job.status.get("startUnix").as_number(0);
+    if (deadline > 0 && started > 0 && now_s - started > deadline &&
+        job.status.get("active").as_bool(false)) {
+      KillAll(job);
+      job.status["active"] = false;
+      ReleaseAlloc(job);
+      job.status["completionUnix"] = now_s;
+      SetPhase(job, "Failed", "DeadlineExceeded",
+               "activeDeadlineSeconds exceeded", now_s);
+      metrics_.jobs_failed++;
+      store_->UpdateStatus("JAXJob", res.name, job.status);
+      continue;
+    }
+    if (phase == "Pending" || phase == "Restarting" || phase.empty()) {
+      Reconcile(res.name);
+    }
+  }
+}
+
+}  // namespace tpk

@@ -1,0 +1,98 @@
+// JAXJob controller — the north-star CRD controller (SURVEY.md §7.1 item 5).
+//
+// Reconciles JAXJob resources into gangs of worker processes with the TPK_*
+// bootstrap env injected (replacing PyTorchJob's MASTER_ADDR/RANK + c10d
+// rendezvous; SURVEY.md §3.1). Semantics carried over from the reference's
+// common JobController (⟨training-operator: pkg/controller.v1/common/⟩):
+//   - conditions state machine Created → Running → Succeeded/Failed
+//     (+ Pending while un-schedulable, Restarting between gang relaunches)
+//   - RestartPolicy Never | OnFailure | ExitCode. ExitCode semantics match
+//     upstream training-operator (NOT SURVEY.md §5.3, which inverted them):
+//     exit 1–127 = permanent failure, 128+ (signal: preemption/OOM-kill)
+//     = retryable.
+//   - backoffLimit counts gang restarts; activeDeadlineSeconds bounds
+//     wall-clock; ttlSecondsAfterFinished garbage-collects the resource.
+//   - gang scheduling: whole-slice atomic allocation + all-or-nothing
+//     process launch (Volcano PodGroup minMember equivalent).
+//   - restart = relaunch from latest orbax checkpoint (the runtime
+//     auto-resumes; §5.3/§5.4 checkpoint-restart elasticity).
+
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "executor.h"
+#include "json.h"
+#include "scheduler.h"
+#include "store.h"
+
+namespace tpk {
+
+struct ControllerMetrics {
+  int64_t jobs_created = 0;
+  int64_t jobs_succeeded = 0;
+  int64_t jobs_failed = 0;
+  int64_t gang_restarts = 0;
+  int64_t reconciles = 0;
+
+  Json ToJson() const {
+    Json j = Json::Object();
+    j["jobs_created"] = jobs_created;
+    j["jobs_succeeded"] = jobs_succeeded;
+    j["jobs_failed"] = jobs_failed;
+    j["gang_restarts"] = gang_restarts;
+    j["reconciles"] = reconciles;
+    return j;
+  }
+};
+
+class JaxJobController {
+ public:
+  JaxJobController(Store* store, ExecutorInterface* executor,
+                   Scheduler* scheduler, std::string workdir,
+                   std::string python = "python3");
+
+  // Crash recovery: reap orphaned gangs from a previous control-plane
+  // incarnation and mark them Restarting. Call once after Store::Load.
+  void Recover();
+
+  // Level-triggered reconcile of one job by name. Safe to call repeatedly.
+  void Reconcile(const std::string& name);
+
+  // Called by the event loop: reap process exits, drive reconciles, enforce
+  // deadlines/TTLs. `now_s` injectable for tests.
+  void Tick(double now_s);
+
+  ControllerMetrics& metrics() { return metrics_; }
+
+  // Process id helper: "<job>/<replica-index>".
+  static std::string ProcId(const std::string& job, int replica);
+
+ private:
+  struct JobView {
+    Resource res;
+    Json spec;
+    Json status;
+  };
+
+  void LaunchGang(JobView& job);
+  void HandleExits(JobView& job);
+  void SetPhase(JobView& job, const std::string& phase,
+                const std::string& reason, const std::string& message,
+                double now_s);
+  void KillAll(const JobView& job);
+  void ReleaseAlloc(JobView& job);
+  Allocation AllocFromStatus(const Json& status) const;
+
+  Store* store_;
+  ExecutorInterface* executor_;
+  Scheduler* scheduler_;
+  std::string workdir_;
+  std::string python_;
+  ControllerMetrics metrics_;
+  double now_s_ = 0;
+};
+
+}  // namespace tpk

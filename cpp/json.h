@@ -1,0 +1,320 @@
+// Minimal JSON value + parser + serializer (header-only, no deps).
+//
+// The control plane speaks newline-delimited JSON over a unix socket and
+// persists a JSONL WAL; resources carry arbitrary user spec documents, so we
+// need a dynamic value type. ~300 lines covers the subset we use: null/bool/
+// number/string/array/object, UTF-8 passthrough, \uXXXX escapes (BMP).
+//
+// Reference parity note: upstream Kubeflow's controllers lean on Kubernetes'
+// apimachinery for (un)structured objects; this plus store.h is our
+// equivalent kernel surface (SURVEY.md §1 L0/L1).
+
+#pragma once
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <cstdio>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace tpk {
+
+class Json;
+using JsonArray = std::vector<Json>;
+using JsonObject = std::map<std::string, Json>;
+
+class Json {
+ public:
+  enum class Type { kNull, kBool, kNumber, kString, kArray, kObject };
+
+  Json() : type_(Type::kNull) {}
+  Json(std::nullptr_t) : type_(Type::kNull) {}
+  Json(bool b) : type_(Type::kBool), bool_(b) {}
+  Json(int v) : type_(Type::kNumber), num_(v) {}
+  Json(int64_t v) : type_(Type::kNumber), num_(static_cast<double>(v)) {}
+  Json(double v) : type_(Type::kNumber), num_(v) {}
+  Json(const char* s) : type_(Type::kString), str_(s) {}
+  Json(std::string s) : type_(Type::kString), str_(std::move(s)) {}
+  Json(JsonArray a) : type_(Type::kArray), arr_(std::move(a)) {}
+  Json(JsonObject o) : type_(Type::kObject), obj_(std::move(o)) {}
+
+  static Json Object() { return Json(JsonObject{}); }
+  static Json Array() { return Json(JsonArray{}); }
+
+  Type type() const { return type_; }
+  bool is_null() const { return type_ == Type::kNull; }
+  bool is_bool() const { return type_ == Type::kBool; }
+  bool is_number() const { return type_ == Type::kNumber; }
+  bool is_string() const { return type_ == Type::kString; }
+  bool is_array() const { return type_ == Type::kArray; }
+  bool is_object() const { return type_ == Type::kObject; }
+
+  bool as_bool(bool dflt = false) const {
+    return is_bool() ? bool_ : dflt;
+  }
+  double as_number(double dflt = 0) const {
+    return is_number() ? num_ : dflt;
+  }
+  int64_t as_int(int64_t dflt = 0) const {
+    return is_number() ? static_cast<int64_t>(num_) : dflt;
+  }
+  const std::string& as_string() const {
+    static const std::string empty;
+    return is_string() ? str_ : empty;
+  }
+
+  // Object access. get() returns null Json for missing keys.
+  const Json& get(const std::string& key) const {
+    static const Json null_json;
+    if (!is_object()) return null_json;
+    auto it = obj_.find(key);
+    return it == obj_.end() ? null_json : it->second;
+  }
+  Json& operator[](const std::string& key) {
+    if (type_ == Type::kNull) { type_ = Type::kObject; }
+    if (!is_object()) throw std::runtime_error("json: not an object");
+    return obj_[key];
+  }
+  bool has(const std::string& key) const {
+    return is_object() && obj_.count(key) > 0;
+  }
+  void erase(const std::string& key) { if (is_object()) obj_.erase(key); }
+  const JsonObject& items() const {
+    static const JsonObject empty;
+    return is_object() ? obj_ : empty;
+  }
+
+  // Array access.
+  const JsonArray& elements() const {
+    static const JsonArray empty;
+    return is_array() ? arr_ : empty;
+  }
+  void push_back(Json v) {
+    if (type_ == Type::kNull) { type_ = Type::kArray; }
+    if (!is_array()) throw std::runtime_error("json: not an array");
+    arr_.push_back(std::move(v));
+  }
+  size_t size() const {
+    return is_array() ? arr_.size() : (is_object() ? obj_.size() : 0);
+  }
+
+  std::string dump() const {
+    std::ostringstream os;
+    write(os);
+    return os.str();
+  }
+
+  static Json parse(const std::string& text) {
+    size_t pos = 0;
+    Json v = parse_value(text, pos);
+    skip_ws(text, pos);
+    if (pos != text.size()) throw std::runtime_error("json: trailing data");
+    return v;
+  }
+
+ private:
+  void write(std::ostringstream& os) const {
+    switch (type_) {
+      case Type::kNull: os << "null"; break;
+      case Type::kBool: os << (bool_ ? "true" : "false"); break;
+      case Type::kNumber: {
+        if (std::isfinite(num_) && num_ == std::floor(num_) &&
+            std::fabs(num_) < 1e15) {
+          os << static_cast<int64_t>(num_);
+        } else if (std::isfinite(num_)) {
+          char buf[32];
+          snprintf(buf, sizeof(buf), "%.17g", num_);
+          os << buf;
+        } else {
+          os << "null";  // JSON has no Inf/NaN
+        }
+        break;
+      }
+      case Type::kString: write_string(os, str_); break;
+      case Type::kArray: {
+        os << '[';
+        for (size_t i = 0; i < arr_.size(); ++i) {
+          if (i) os << ',';
+          arr_[i].write(os);
+        }
+        os << ']';
+        break;
+      }
+      case Type::kObject: {
+        os << '{';
+        bool first = true;
+        for (const auto& [k, v] : obj_) {
+          if (!first) os << ',';
+          first = false;
+          write_string(os, k);
+          os << ':';
+          v.write(os);
+        }
+        os << '}';
+        break;
+      }
+    }
+  }
+
+  static void write_string(std::ostringstream& os, const std::string& s) {
+    os << '"';
+    for (unsigned char c : s) {
+      switch (c) {
+        case '"': os << "\\\""; break;
+        case '\\': os << "\\\\"; break;
+        case '\n': os << "\\n"; break;
+        case '\r': os << "\\r"; break;
+        case '\t': os << "\\t"; break;
+        default:
+          if (c < 0x20) {
+            char buf[8];
+            snprintf(buf, sizeof(buf), "\\u%04x", c);
+            os << buf;
+          } else {
+            os << c;
+          }
+      }
+    }
+    os << '"';
+  }
+
+  static void skip_ws(const std::string& t, size_t& p) {
+    while (p < t.size() && (t[p] == ' ' || t[p] == '\t' || t[p] == '\n' ||
+                            t[p] == '\r')) {
+      ++p;
+    }
+  }
+
+  static Json parse_value(const std::string& t, size_t& p) {
+    skip_ws(t, p);
+    if (p >= t.size()) throw std::runtime_error("json: unexpected end");
+    char c = t[p];
+    if (c == '{') return parse_object(t, p);
+    if (c == '[') return parse_array(t, p);
+    if (c == '"') return Json(parse_string(t, p));
+    if (c == 't') { expect(t, p, "true"); return Json(true); }
+    if (c == 'f') { expect(t, p, "false"); return Json(false); }
+    if (c == 'n') { expect(t, p, "null"); return Json(); }
+    return parse_number(t, p);
+  }
+
+  static void expect(const std::string& t, size_t& p, const char* lit) {
+    size_t n = strlen(lit);
+    if (t.compare(p, n, lit) != 0) throw std::runtime_error("json: bad literal");
+    p += n;
+  }
+
+  static Json parse_object(const std::string& t, size_t& p) {
+    Json obj = Json::Object();
+    ++p;  // '{'
+    skip_ws(t, p);
+    if (p < t.size() && t[p] == '}') { ++p; return obj; }
+    while (true) {
+      skip_ws(t, p);
+      if (p >= t.size() || t[p] != '"')
+        throw std::runtime_error("json: expected key");
+      std::string key = parse_string(t, p);
+      skip_ws(t, p);
+      if (p >= t.size() || t[p] != ':')
+        throw std::runtime_error("json: expected ':'");
+      ++p;
+      obj[key] = parse_value(t, p);
+      skip_ws(t, p);
+      if (p < t.size() && t[p] == ',') { ++p; continue; }
+      if (p < t.size() && t[p] == '}') { ++p; break; }
+      throw std::runtime_error("json: expected ',' or '}'");
+    }
+    return obj;
+  }
+
+  static Json parse_array(const std::string& t, size_t& p) {
+    Json arr = Json::Array();
+    ++p;  // '['
+    skip_ws(t, p);
+    if (p < t.size() && t[p] == ']') { ++p; return arr; }
+    while (true) {
+      arr.push_back(parse_value(t, p));
+      skip_ws(t, p);
+      if (p < t.size() && t[p] == ',') { ++p; continue; }
+      if (p < t.size() && t[p] == ']') { ++p; break; }
+      throw std::runtime_error("json: expected ',' or ']'");
+    }
+    return arr;
+  }
+
+  static std::string parse_string(const std::string& t, size_t& p) {
+    ++p;  // '"'
+    std::string out;
+    while (p < t.size() && t[p] != '"') {
+      char c = t[p];
+      if (c == '\\') {
+        ++p;
+        if (p >= t.size()) break;
+        char e = t[p];
+        switch (e) {
+          case '"': out += '"'; break;
+          case '\\': out += '\\'; break;
+          case '/': out += '/'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case 'n': out += '\n'; break;
+          case 'r': out += '\r'; break;
+          case 't': out += '\t'; break;
+          case 'u': {
+            if (p + 4 >= t.size())
+              throw std::runtime_error("json: bad \\u escape");
+            unsigned code = std::stoul(t.substr(p + 1, 4), nullptr, 16);
+            p += 4;
+            // Encode BMP codepoint as UTF-8 (surrogate pairs unsupported;
+            // they round-trip as two 3-byte sequences, acceptable here).
+            if (code < 0x80) {
+              out += static_cast<char>(code);
+            } else if (code < 0x800) {
+              out += static_cast<char>(0xC0 | (code >> 6));
+              out += static_cast<char>(0x80 | (code & 0x3F));
+            } else {
+              out += static_cast<char>(0xE0 | (code >> 12));
+              out += static_cast<char>(0x80 | ((code >> 6) & 0x3F));
+              out += static_cast<char>(0x80 | (code & 0x3F));
+            }
+            break;
+          }
+          default: throw std::runtime_error("json: bad escape");
+        }
+        ++p;
+      } else {
+        out += c;
+        ++p;
+      }
+    }
+    if (p >= t.size()) throw std::runtime_error("json: unterminated string");
+    ++p;  // closing '"'
+    return out;
+  }
+
+  static Json parse_number(const std::string& t, size_t& p) {
+    size_t start = p;
+    if (p < t.size() && (t[p] == '-' || t[p] == '+')) ++p;
+    while (p < t.size() &&
+           (isdigit(static_cast<unsigned char>(t[p])) || t[p] == '.' ||
+            t[p] == 'e' || t[p] == 'E' || t[p] == '-' || t[p] == '+')) {
+      ++p;
+    }
+    if (p == start) throw std::runtime_error("json: bad number");
+    return Json(std::stod(t.substr(start, p - start)));
+  }
+
+  Type type_;
+  bool bool_ = false;
+  double num_ = 0;
+  std::string str_;
+  JsonArray arr_;
+  JsonObject obj_;
+};
+
+}  // namespace tpk

@@ -1,0 +1,107 @@
+// tpk-controlplane: the control-plane binary.
+//
+//   tpk-controlplane --socket /tmp/tpk.sock --workdir /tmp/tpk
+//       --slices local=8 [--python python3] [--wal /tmp/tpk/wal.jsonl]
+//
+// One process = store + scheduler + JAXJob controller + API server, the
+// single-binary equivalent of {kube-apiserver, etcd, scheduler, kubelet,
+// training-operator} for local process execution (SURVEY.md §7.1-7.2).
+
+#include <signal.h>
+
+#include <cstdio>
+#include <cstring>
+#include <ctime>
+#include <string>
+
+#include "executor.h"
+#include "jaxjob.h"
+#include "scheduler.h"
+#include "server.h"
+#include "store.h"
+
+namespace {
+volatile sig_atomic_t g_stop = 0;
+void OnSignal(int) { g_stop = 1; }
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string socket_path = "/tmp/tpk.sock";
+  std::string workdir = "/tmp/tpk";
+  std::string wal;
+  std::string python = "python3";
+  std::vector<std::pair<std::string, int>> slices = {{"local", 8}};
+
+  for (int i = 1; i < argc; ++i) {
+    std::string arg = argv[i];
+    auto next = [&]() -> std::string {
+      return i + 1 < argc ? argv[++i] : "";
+    };
+    if (arg == "--socket") socket_path = next();
+    else if (arg == "--workdir") workdir = next();
+    else if (arg == "--wal") wal = next();
+    else if (arg == "--python") python = next();
+    else if (arg == "--slices") {
+      slices.clear();
+      std::string val = next();  // "name=cap,name=cap"
+      size_t pos = 0;
+      while (pos < val.size()) {
+        size_t comma = val.find(',', pos);
+        if (comma == std::string::npos) comma = val.size();
+        std::string part = val.substr(pos, comma - pos);
+        size_t eq = part.find('=');
+        if (eq != std::string::npos) {
+          slices.emplace_back(part.substr(0, eq),
+                              atoi(part.c_str() + eq + 1));
+        }
+        pos = comma + 1;
+      }
+    } else if (arg == "--help") {
+      printf("usage: tpk-controlplane --socket PATH --workdir DIR "
+             "[--wal FILE] [--python BIN] [--slices name=cap,...]\n");
+      return 0;
+    }
+  }
+
+  signal(SIGINT, OnSignal);
+  signal(SIGTERM, OnSignal);
+  signal(SIGPIPE, SIG_IGN);
+
+  tpk::Store store(wal);
+  int replayed = store.Load();
+  tpk::Scheduler scheduler;
+  for (const auto& [name, cap] : slices) scheduler.AddSlice(name, cap);
+  tpk::LocalExecutor executor;
+  tpk::JaxJobController jaxjob(&store, &executor, &scheduler, workdir, python);
+  jaxjob.Recover();
+  tpk::Server server(&store, &scheduler, &jaxjob, socket_path, workdir);
+
+  std::string error;
+  if (!server.Start(&error)) {
+    fprintf(stderr, "tpk-controlplane: cannot listen on %s: %s\n",
+            socket_path.c_str(), error.c_str());
+    return 1;
+  }
+  fprintf(stderr,
+          "tpk-controlplane: listening on %s (workdir=%s, %d WAL records, "
+          "%zu slices)\n",
+          socket_path.c_str(), workdir.c_str(), replayed, slices.size());
+
+  // Watch: any JAXJob change → reconcile (informer-style edge trigger).
+  std::vector<std::string> dirty;
+  store.Watch("JAXJob", [&dirty](const tpk::WatchEvent& ev) {
+    dirty.push_back(ev.resource.name);
+  });
+
+  while (!g_stop) {
+    server.PollOnce(50);
+    store.DrainWatches();
+    for (const auto& name : dirty) jaxjob.Reconcile(name);
+    dirty.clear();
+    jaxjob.Tick(static_cast<double>(time(nullptr)));
+    store.DrainWatches();
+    dirty.clear();  // Tick's own status writes don't need a second pass
+  }
+  fprintf(stderr, "tpk-controlplane: shutting down\n");
+  return 0;
+}

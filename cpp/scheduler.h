@@ -1,0 +1,84 @@
+// Gang/slice scheduler — topology-aware, all-or-nothing placement.
+//
+// Upstream parity: training-operator's gang scheduling delegates to Volcano/
+// scheduler-plugins PodGroups with minMember = Σreplicas (SURVEY.md §2.1
+// JobController.SyncPodGroup); a partial gang deadlocks a TPU slice, so
+// placement must be atomic. Here slices are declared capacity pools (device
+// counts); a job asks for `replicas × devices_per_proc` devices on one slice
+// (or spans slices for multi-slice jobs), and allocation either fully
+// succeeds or leaves state untouched.
+
+#pragma once
+
+#include <algorithm>
+#include <map>
+#include <optional>
+#include <string>
+#include <vector>
+
+namespace tpk {
+
+struct SliceInfo {
+  std::string name;
+  int capacity = 0;  // devices
+  int used = 0;
+  int free() const { return capacity - used; }
+};
+
+struct Allocation {
+  // slice name → devices taken. Multi-slice jobs hold several entries.
+  std::map<std::string, int> slices;
+};
+
+class Scheduler {
+ public:
+  void AddSlice(const std::string& name, int capacity) {
+    slices_[name] = {name, capacity, 0};
+  }
+
+  std::vector<SliceInfo> Slices() const {
+    std::vector<SliceInfo> out;
+    for (const auto& [_, s] : slices_) out.push_back(s);
+    return out;
+  }
+
+  // Gang-allocate `devices` across `num_slices` slices (devices must divide
+  // evenly). Single-slice jobs prefer the fullest slice that fits
+  // (bin-packing keeps large contiguous slices free for big gangs).
+  std::optional<Allocation> Allocate(int devices, int num_slices = 1) {
+    if (devices <= 0 || num_slices <= 0 || devices % num_slices) {
+      return std::nullopt;
+    }
+    int per_slice = devices / num_slices;
+    // Candidate slices with room, fullest-first.
+    std::vector<SliceInfo*> fits;
+    for (auto& [_, s] : slices_) {
+      if (s.free() >= per_slice) fits.push_back(&s);
+    }
+    if (static_cast<int>(fits.size()) < num_slices) return std::nullopt;
+    std::sort(fits.begin(), fits.end(), [](SliceInfo* a, SliceInfo* b) {
+      return a->free() < b->free();
+    });
+    Allocation alloc;
+    for (int i = 0; i < num_slices; ++i) {
+      fits[i]->used += per_slice;
+      alloc.slices[fits[i]->name] = per_slice;
+    }
+    return alloc;
+  }
+
+  void Release(const Allocation& alloc) {
+    for (const auto& [name, n] : alloc.slices) {
+      auto it = slices_.find(name);
+      if (it != slices_.end()) {
+        it->second.used -= n;
+        if (it->second.used < 0) it->second.used = 0;
+      }
+    }
+  }
+
+ private:
+  std::map<std::string, SliceInfo> slices_;
+};
+
+}  // namespace tpk

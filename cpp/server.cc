@@ -1,0 +1,232 @@
+#include "server.h"
+
+#include <fcntl.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstring>
+
+namespace tpk {
+
+Server::Server(Store* store, Scheduler* scheduler, JaxJobController* jaxjob,
+               std::string socket_path, std::string workdir)
+    : store_(store),
+      scheduler_(scheduler),
+      jaxjob_(jaxjob),
+      socket_path_(std::move(socket_path)),
+      workdir_(std::move(workdir)) {}
+
+Server::~Server() { Stop(); }
+
+bool Server::Start(std::string* error) {
+  listen_fd_ = socket(AF_UNIX, SOCK_STREAM, 0);
+  if (listen_fd_ < 0) {
+    if (error) *error = strerror(errno);
+    return false;
+  }
+  unlink(socket_path_.c_str());
+  sockaddr_un addr{};
+  addr.sun_family = AF_UNIX;
+  strncpy(addr.sun_path, socket_path_.c_str(), sizeof(addr.sun_path) - 1);
+  if (bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+      listen(listen_fd_, 16) < 0) {
+    if (error) *error = strerror(errno);
+    close(listen_fd_);
+    listen_fd_ = -1;
+    return false;
+  }
+  return true;
+}
+
+void Server::Stop() {
+  for (auto& c : clients_) close(c.fd);
+  clients_.clear();
+  if (listen_fd_ >= 0) {
+    close(listen_fd_);
+    listen_fd_ = -1;
+    unlink(socket_path_.c_str());
+  }
+}
+
+Json Server::Dispatch(const Json& req) {
+  Json resp = Json::Object();
+  const std::string op = req.get("op").as_string();
+  const std::string kind = req.get("kind").as_string();
+  const std::string name = req.get("name").as_string();
+
+  auto fill = [&](const Store::Result& r) {
+    resp["ok"] = r.ok;
+    if (!r.ok) {
+      resp["error"] = r.error;
+    } else {
+      resp["resource"] = Store::ToJson(r.resource);
+    }
+  };
+
+  if (op == "ping") {
+    resp["ok"] = true;
+    resp["pong"] = true;
+  } else if (op == "create") {
+    fill(store_->Create(kind, name, req.get("spec")));
+  } else if (op == "get") {
+    auto r = store_->Get(kind, name);
+    resp["ok"] = r.has_value();
+    if (r) {
+      resp["resource"] = Store::ToJson(*r);
+    } else {
+      resp["error"] = "not found: " + kind + "/" + name;
+    }
+  } else if (op == "list") {
+    resp["ok"] = true;
+    Json items = Json::Array();
+    for (const auto& r : store_->List(kind)) {
+      items.push_back(Store::ToJson(r));
+    }
+    resp["items"] = items;
+  } else if (op == "update_spec") {
+    fill(store_->UpdateSpec(kind, name, req.get("spec"),
+                            req.get("expected_version").is_number()
+                                ? req.get("expected_version").as_int()
+                                : -1));
+  } else if (op == "update_status") {
+    fill(store_->UpdateStatus(kind, name, req.get("status"),
+                              req.get("expected_version").is_number()
+                                  ? req.get("expected_version").as_int()
+                                  : -1));
+  } else if (op == "delete") {
+    fill(store_->Delete(kind, name));
+  } else if (op == "metrics") {
+    resp["ok"] = true;
+    resp["metrics"] = jaxjob_ ? jaxjob_->metrics().ToJson() : Json::Object();
+  } else if (op == "slices") {
+    resp["ok"] = true;
+    Json arr = Json::Array();
+    for (const auto& s : scheduler_->Slices()) {
+      Json j = Json::Object();
+      j["name"] = s.name;
+      j["capacity"] = s.capacity;
+      j["used"] = s.used;
+      arr.push_back(j);
+    }
+    resp["slices"] = arr;
+  } else if (op == "logs") {
+    // Tail a worker's log file.
+    int replica = static_cast<int>(req.get("replica").as_int(0));
+    int64_t max_bytes = req.get("max_bytes").as_int(65536);
+    std::string path = workdir_ + "/" + name + "/worker-" +
+                       std::to_string(replica) +
+                       (req.get("stderr").as_bool(false) ? ".err" : ".log");
+    FILE* f = fopen(path.c_str(), "r");
+    if (!f) {
+      resp["ok"] = false;
+      resp["error"] = "no log at " + path;
+    } else {
+      fseek(f, 0, SEEK_END);
+      long size = ftell(f);
+      long start = size > max_bytes ? size - max_bytes : 0;
+      fseek(f, start, SEEK_SET);
+      std::string content(size - start, '\0');
+      size_t got = fread(content.data(), 1, content.size(), f);
+      content.resize(got);
+      fclose(f);
+      resp["ok"] = true;
+      resp["path"] = path;
+      resp["content"] = content;
+      // Followers track absolute file offsets: `size` is the total file
+      // length, `offset` where `content` starts within it.
+      resp["size"] = static_cast<int64_t>(size);
+      resp["offset"] = static_cast<int64_t>(start);
+    }
+  } else {
+    resp["ok"] = false;
+    resp["error"] = "unknown op: " + op;
+  }
+  return resp;
+}
+
+void Server::HandleLine(Client& c, const std::string& line) {
+  Json resp;
+  try {
+    Json req = Json::parse(line);
+    resp = Dispatch(req);
+  } catch (const std::exception& e) {
+    resp = Json::Object();
+    resp["ok"] = false;
+    resp["error"] = std::string("bad request: ") + e.what();
+  }
+  c.out_buf += resp.dump();
+  c.out_buf += '\n';
+}
+
+int Server::PollOnce(int timeout_ms) {
+  if (listen_fd_ < 0) return 0;
+  std::vector<pollfd> fds;
+  fds.push_back({listen_fd_, POLLIN, 0});
+  for (auto& c : clients_) {
+    short events = POLLIN;
+    if (!c.out_buf.empty()) events |= POLLOUT;
+    fds.push_back({c.fd, events, 0});
+  }
+  int n = poll(fds.data(), fds.size(), timeout_ms);
+  if (n <= 0) return 0;
+
+  int served = 0;
+  if (fds[0].revents & POLLIN) {
+    int fd = accept(listen_fd_, nullptr, nullptr);
+    if (fd >= 0) {
+      // Non-blocking: a stalled client must never block the event loop
+      // (this thread also runs reconciles and exit reaping).
+      fcntl(fd, F_SETFL, fcntl(fd, F_GETFL, 0) | O_NONBLOCK);
+      clients_.push_back({fd, "", ""});
+    }
+  }
+  std::vector<int> dead;
+  for (size_t i = 1; i < fds.size(); ++i) {
+    Client& c = clients_[i - 1];
+    if (fds[i].revents & (POLLIN | POLLHUP | POLLERR)) {
+      char buf[4096];
+      ssize_t got = read(c.fd, buf, sizeof(buf));
+      if (got <= 0) {
+        dead.push_back(static_cast<int>(i - 1));
+        continue;
+      }
+      c.in_buf.append(buf, got);
+      size_t nl;
+      while ((nl = c.in_buf.find('\n')) != std::string::npos) {
+        std::string line = c.in_buf.substr(0, nl);
+        c.in_buf.erase(0, nl + 1);
+        if (!line.empty()) {
+          HandleLine(c, line);
+          ++served;
+        }
+      }
+    }
+    if (!c.out_buf.empty()) {
+      // Opportunistic non-blocking write (fd is O_NONBLOCK): fresh responses
+      // from this pass go out immediately instead of waiting a poll cycle.
+      ssize_t sent = write(c.fd, c.out_buf.data(), c.out_buf.size());
+      if (sent > 0) {
+        c.out_buf.erase(0, sent);
+      } else if (sent < 0 && errno != EAGAIN && errno != EWOULDBLOCK) {
+        dead.push_back(static_cast<int>(i - 1));
+        continue;
+      }
+      // Cap pending output: a client that never reads gets disconnected
+      // rather than growing the buffer unboundedly.
+      if (c.out_buf.size() > (8u << 20)) {
+        dead.push_back(static_cast<int>(i - 1));
+      }
+    }
+  }
+  // Remove dead clients (reverse order keeps indices valid).
+  for (auto it = dead.rbegin(); it != dead.rend(); ++it) {
+    close(clients_[*it].fd);
+    clients_.erase(clients_.begin() + *it);
+  }
+  return served;
+}
+
+}  // namespace tpk

@@ -1,0 +1,58 @@
+// API server: newline-delimited JSON over a unix domain socket.
+//
+// The kube-apiserver surface of the rebuild (SURVEY.md §1 L0, §7.1 item 4):
+// clients (Python SDK, tpukit CLI) connect to <socket>, send one JSON
+// request per line, receive one JSON response per line. Ops mirror the
+// resource verbs (create/get/list/update_spec/delete) plus control-plane
+// introspection (metrics/slices/logs/ping). Auth is a stub (filesystem
+// permissions on the socket), matching the descope note in SURVEY.md §7.4.
+
+#pragma once
+
+#include <functional>
+#include <map>
+#include <string>
+#include <vector>
+
+#include "jaxjob.h"
+#include "json.h"
+#include "scheduler.h"
+#include "store.h"
+
+namespace tpk {
+
+class Server {
+ public:
+  Server(Store* store, Scheduler* scheduler, JaxJobController* jaxjob,
+         std::string socket_path, std::string workdir);
+  ~Server();
+
+  bool Start(std::string* error);
+
+  // One event-loop pass: accept clients, read/dispatch requests, write
+  // responses. timeout_ms bounds the poll wait. Returns requests served.
+  int PollOnce(int timeout_ms);
+
+  void Stop();
+
+  Json Dispatch(const Json& req);  // public for unit tests
+
+ private:
+  struct Client {
+    int fd;
+    std::string in_buf;
+    std::string out_buf;
+  };
+
+  void HandleLine(Client& c, const std::string& line);
+
+  Store* store_;
+  Scheduler* scheduler_;
+  JaxJobController* jaxjob_;
+  std::string socket_path_;
+  std::string workdir_;
+  int listen_fd_ = -1;
+  std::vector<Client> clients_;
+};
+
+}  // namespace tpk

@@ -1,0 +1,199 @@
+#include "store.h"
+
+#include <cstdio>
+
+namespace tpk {
+
+Store::Store(std::string wal_path) : wal_path_(std::move(wal_path)) {
+  if (!wal_path_.empty()) {
+    wal_ = fopen(wal_path_.c_str(), "a");
+  }
+}
+
+Store::~Store() {
+  if (wal_) fclose(wal_);
+}
+
+int Store::Load() {
+  if (wal_path_.empty()) return 0;
+  FILE* f = fopen(wal_path_.c_str(), "r");
+  if (!f) return 0;
+  int applied = 0;
+  std::string line;
+  char buf[1 << 16];
+  std::lock_guard<std::mutex> lock(mu_);
+  while (fgets(buf, sizeof(buf), f)) {
+    line = buf;
+    if (line.empty() || line == "\n") continue;
+    try {
+      Json rec = Json::parse(line);
+      Resource r;
+      r.kind = rec.get("kind").as_string();
+      r.name = rec.get("name").as_string();
+      r.spec = rec.get("spec");
+      r.status = rec.get("status");
+      r.resource_version = rec.get("resourceVersion").as_int();
+      r.generation = rec.get("generation").as_int();
+      r.deleted = rec.get("deleted").as_bool();
+      auto key = std::make_pair(r.kind, r.name);
+      if (r.deleted) {
+        data_.erase(key);
+      } else {
+        data_[key] = r;
+      }
+      if (r.resource_version >= next_version_) {
+        next_version_ = r.resource_version + 1;
+      }
+      ++applied;
+    } catch (const std::exception&) {
+      // Torn tail write (crash mid-append): stop replay at the corruption.
+      break;
+    }
+  }
+  fclose(f);
+  return applied;
+}
+
+Json Store::ToJson(const Resource& r) {
+  Json out = Json::Object();
+  out["kind"] = r.kind;
+  out["name"] = r.name;
+  out["spec"] = r.spec;
+  out["status"] = r.status;
+  out["resourceVersion"] = r.resource_version;
+  out["generation"] = r.generation;
+  if (r.deleted) out["deleted"] = true;
+  return out;
+}
+
+void Store::WalWrite(const Resource& r) {
+  if (!wal_) return;
+  std::string line = ToJson(r).dump();
+  fwrite(line.data(), 1, line.size(), wal_);
+  fputc('\n', wal_);
+  fflush(wal_);
+}
+
+void Store::Append(const WatchEvent& ev) { pending_.push_back(ev); }
+
+Store::Result Store::Create(const std::string& kind, const std::string& name,
+                            Json spec) {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto key = std::make_pair(kind, name);
+  if (data_.count(key)) {
+    return {false, "already exists: " + kind + "/" + name, {}};
+  }
+  Resource r;
+  r.kind = kind;
+  r.name = name;
+  r.spec = std::move(spec);
+  r.status = Json::Object();
+  r.resource_version = next_version_++;
+  r.generation = 1;
+  data_[key] = r;
+  WalWrite(r);
+  Append({WatchEvent::Type::kAdded, r});
+  return {true, "", r};
+}
+
+Store::Result Store::UpdateSpec(const std::string& kind,
+                                const std::string& name, Json spec,
+                                int64_t expected_version) {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = data_.find({kind, name});
+  if (it == data_.end()) return {false, "not found: " + kind + "/" + name, {}};
+  if (expected_version >= 0 &&
+      it->second.resource_version != expected_version) {
+    return {false, "conflict: version mismatch", {}};
+  }
+  it->second.spec = std::move(spec);
+  it->second.resource_version = next_version_++;
+  it->second.generation++;
+  WalWrite(it->second);
+  Append({WatchEvent::Type::kModified, it->second});
+  return {true, "", it->second};
+}
+
+Store::Result Store::UpdateStatus(const std::string& kind,
+                                  const std::string& name, Json status,
+                                  int64_t expected_version) {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = data_.find({kind, name});
+  if (it == data_.end()) return {false, "not found: " + kind + "/" + name, {}};
+  if (expected_version >= 0 &&
+      it->second.resource_version != expected_version) {
+    return {false, "conflict: version mismatch", {}};
+  }
+  it->second.status = std::move(status);
+  it->second.resource_version = next_version_++;
+  WalWrite(it->second);
+  Append({WatchEvent::Type::kModified, it->second});
+  return {true, "", it->second};
+}
+
+Store::Result Store::Delete(const std::string& kind, const std::string& name) {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = data_.find({kind, name});
+  if (it == data_.end()) return {false, "not found: " + kind + "/" + name, {}};
+  Resource r = it->second;
+  r.deleted = true;
+  r.resource_version = next_version_++;
+  data_.erase(it);
+  WalWrite(r);
+  Append({WatchEvent::Type::kDeleted, r});
+  return {true, "", r};
+}
+
+std::optional<Resource> Store::Get(const std::string& kind,
+                                   const std::string& name) const {
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = data_.find({kind, name});
+  if (it == data_.end()) return std::nullopt;
+  return it->second;
+}
+
+std::vector<Resource> Store::List(const std::string& kind) const {
+  std::lock_guard<std::mutex> lock(mu_);
+  std::vector<Resource> out;
+  for (const auto& [key, r] : data_) {
+    if (kind.empty() || key.first == kind) out.push_back(r);
+  }
+  return out;
+}
+
+int Store::Watch(const std::string& kind, WatchFn fn) {
+  std::lock_guard<std::mutex> lock(mu_);
+  int id = next_watch_id_++;
+  watchers_.push_back({id, kind, std::move(fn)});
+  return id;
+}
+
+void Store::Unwatch(int id) {
+  std::lock_guard<std::mutex> lock(mu_);
+  for (auto it = watchers_.begin(); it != watchers_.end(); ++it) {
+    if (it->id == id) {
+      watchers_.erase(it);
+      return;
+    }
+  }
+}
+
+int Store::DrainWatches() {
+  std::vector<WatchEvent> events;
+  std::vector<Watcher> watchers;
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    events.swap(pending_);
+    watchers = watchers_;
+  }
+  for (const auto& ev : events) {
+    for (const auto& w : watchers) {
+      if (w.kind.empty() || w.kind == ev.resource.kind) {
+        w.fn(ev);
+      }
+    }
+  }
+  return static_cast<int>(events.size());
+}
+
+}  // namespace tpk

@@ -1,0 +1,100 @@
+// In-process state store with watch streams — the etcd+apiserver stand-in.
+//
+// Upstream, every Kubeflow controller is a reconcile loop over watches served
+// by kube-apiserver/etcd (SURVEY.md §1 L0: the platform's true kernel, which
+// the reference does NOT implement). The rebuild must supply it: resources
+// are (kind, name) → {spec, status, resourceVersion, generation}; writers get
+// optimistic concurrency via resourceVersion compare-and-swap; watchers get
+// ordered ADDED/MODIFIED/DELETED events; a JSONL WAL makes state survive
+// restarts (controller restart ≈ apiserver restart + informer resync).
+
+#pragma once
+
+#include <functional>
+#include <mutex>
+#include <optional>
+#include <string>
+#include <vector>
+
+#include "json.h"
+
+namespace tpk {
+
+struct Resource {
+  std::string kind;
+  std::string name;
+  Json spec;
+  Json status;         // controllers own this; conditions live here
+  int64_t resource_version = 0;  // bumped on every write
+  int64_t generation = 0;        // bumped on spec writes only
+  bool deleted = false;
+};
+
+struct WatchEvent {
+  enum class Type { kAdded, kModified, kDeleted };
+  Type type;
+  Resource resource;
+};
+
+// A watch is a callback; it fires under no lock (events are queued and
+// drained by Store::DrainWatches from the owner's loop thread), preserving
+// per-resource ordering. This mirrors informer semantics closely enough for
+// controller logic while staying single-threaded-friendly.
+using WatchFn = std::function<void(const WatchEvent&)>;
+
+class Store {
+ public:
+  // wal_path empty = in-memory only (unit tests).
+  explicit Store(std::string wal_path = "");
+  ~Store();
+
+  // Replays the WAL if present. Returns number of records applied.
+  int Load();
+
+  // CRUD. All return the stored resource (with bumped versions) or an error
+  // string. expected_version: -1 = unconditional, else CAS.
+  struct Result {
+    bool ok;
+    std::string error;
+    Resource resource;
+  };
+  Result Create(const std::string& kind, const std::string& name, Json spec);
+  Result UpdateSpec(const std::string& kind, const std::string& name,
+                    Json spec, int64_t expected_version = -1);
+  Result UpdateStatus(const std::string& kind, const std::string& name,
+                      Json status, int64_t expected_version = -1);
+  Result Delete(const std::string& kind, const std::string& name);
+  std::optional<Resource> Get(const std::string& kind,
+                              const std::string& name) const;
+  std::vector<Resource> List(const std::string& kind) const;
+
+  // Watches: all events for `kind` ("" = all kinds). Returns watch id.
+  int Watch(const std::string& kind, WatchFn fn);
+  void Unwatch(int id);
+
+  // Deliver queued events to watchers. Called from the owning event loop.
+  // Returns number of events delivered.
+  int DrainWatches();
+
+  static Json ToJson(const Resource& r);
+
+ private:
+  void Append(const WatchEvent& ev);
+  void WalWrite(const Resource& r);
+
+  mutable std::mutex mu_;
+  std::string wal_path_;
+  FILE* wal_ = nullptr;
+  std::map<std::pair<std::string, std::string>, Resource> data_;
+  int64_t next_version_ = 1;
+  struct Watcher {
+    int id;
+    std::string kind;
+    WatchFn fn;
+  };
+  std::vector<Watcher> watchers_;
+  std::vector<WatchEvent> pending_;
+  int next_watch_id_ = 1;
+};
+
+}  // namespace tpk

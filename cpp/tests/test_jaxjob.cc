@@ -1,0 +1,203 @@
+// JAXJob controller semantics against the FakeExecutor — the envtest analog
+// (SURVEY.md §4.2): no processes start; tests flip process status by hand
+// and assert on the conditions state machine, gang atomicity, restart
+// policies, backoff, deadlines, and TTL GC.
+#include <cstdio>
+
+#include "executor.h"
+#include "jaxjob.h"
+#include "scheduler.h"
+#include "store.h"
+
+using tpk::FakeExecutor;
+using tpk::JaxJobController;
+using tpk::Json;
+using tpk::Scheduler;
+using tpk::Store;
+
+#define CHECK(cond)                                                   \
+  do {                                                                \
+    if (!(cond)) {                                                    \
+      fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+      return 1;                                                       \
+    }                                                                 \
+  } while (0)
+
+namespace {
+
+std::string Phase(Store& store, const std::string& name) {
+  auto r = store.Get("JAXJob", name);
+  return r ? r->status.get("phase").as_string() : "<gone>";
+}
+
+Json BaseSpec(int replicas) {
+  Json spec = Json::Object();
+  spec["replicas"] = replicas;
+  spec["devices_per_proc"] = 1;
+  return spec;
+}
+
+struct Harness {
+  Store store;
+  Scheduler sched;
+  FakeExecutor exec;
+  JaxJobController ctl{&store, &exec, &sched, "/tmp/tpk_test_ctl"};
+  double now = 1000.0;
+
+  Harness(int capacity = 8) { sched.AddSlice("local", capacity); }
+
+  void Settle() {
+    // Drive watch → reconcile → watch until quiescent (bounded).
+    for (int i = 0; i < 10; ++i) {
+      ctl.Tick(now);
+      std::vector<std::string> dirty;
+      int w = store.Watch("JAXJob", [&dirty](const tpk::WatchEvent& ev) {
+        dirty.push_back(ev.resource.name);
+      });
+      int n = store.DrainWatches();
+      store.Unwatch(w);
+      for (const auto& d : dirty) ctl.Reconcile(d);
+      if (n == 0) break;
+    }
+  }
+};
+
+}  // namespace
+
+int main() {
+  // --- Happy path: create → Running → all succeed → Succeeded ----------
+  {
+    Harness h;
+    h.store.Create("JAXJob", "j1", BaseSpec(2));
+    h.Settle();
+    CHECK(Phase(h.store, "j1") == "Running");
+    CHECK(h.exec.launched.size() == 2);
+    // env contract injected
+    CHECK(h.exec.launched[0].env.at("TPK_NUM_PROCS") == "2");
+    CHECK(h.exec.launched[0].env.at("TPK_PROC_ID") == "0");
+    CHECK(h.exec.launched[1].env.at("TPK_PROC_ID") == "1");
+    CHECK(h.exec.launched[0].env.count("TPK_COORDINATOR") == 1);
+
+    h.exec.Finish("j1/0", 0);
+    h.Settle();
+    CHECK(Phase(h.store, "j1") == "Running");  // one worker still up
+    h.exec.Finish("j1/1", 0);
+    h.Settle();
+    CHECK(Phase(h.store, "j1") == "Succeeded");
+    CHECK(h.ctl.metrics().jobs_succeeded == 1);
+    // Allocation released.
+    CHECK(h.sched.Slices()[0].used == 0);
+  }
+
+  // --- Gang pending when capacity insufficient, runs after release -----
+  {
+    Harness h(4);
+    h.store.Create("JAXJob", "big", BaseSpec(3));
+    Json small = BaseSpec(2);
+    h.store.Create("JAXJob", "small", small);
+    h.Settle();
+    // big took 3 of 4; small can't fit its gang of 2 → Pending, NOT partial.
+    CHECK(Phase(h.store, "big") == "Running");
+    CHECK(Phase(h.store, "small") == "Pending");
+    CHECK(h.exec.launched.size() == 3);  // no partial gang
+
+    h.exec.Finish("big/0", 0);
+    h.exec.Finish("big/1", 0);
+    h.exec.Finish("big/2", 0);
+    h.Settle();
+    CHECK(Phase(h.store, "big") == "Succeeded");
+    CHECK(Phase(h.store, "small") == "Running");
+  }
+
+  // --- OnFailure: worker dies → gang killed → restart → backoff limit --
+  {
+    Harness h;
+    Json spec = BaseSpec(2);
+    spec["restart_policy"] = "OnFailure";
+    spec["backoff_limit"] = 1;
+    h.store.Create("JAXJob", "flaky", spec);
+    h.Settle();
+    CHECK(Phase(h.store, "flaky") == "Running");
+
+    h.exec.Finish("flaky/0", 1);
+    h.Settle();
+    // Restarted once: peer killed, new gang launched (4 launches total).
+    CHECK(Phase(h.store, "flaky") == "Running");
+    CHECK(h.exec.killed.size() >= 1);
+    CHECK(h.exec.launched.size() == 4);
+    auto r = h.store.Get("JAXJob", "flaky");
+    CHECK(r->status.get("restarts").as_int() == 1);
+
+    h.exec.Finish("flaky/1", 1);
+    h.Settle();
+    CHECK(Phase(h.store, "flaky") == "Failed");  // backoff exhausted
+    CHECK(h.ctl.metrics().jobs_failed == 1);
+    CHECK(h.sched.Slices()[0].used == 0);
+  }
+
+  // --- Never policy: first failure is terminal -------------------------
+  {
+    Harness h;
+    Json spec = BaseSpec(2);
+    spec["restart_policy"] = "Never";
+    h.store.Create("JAXJob", "oneshot", spec);
+    h.Settle();
+    h.exec.Finish("oneshot/0", 2);
+    h.Settle();
+    CHECK(Phase(h.store, "oneshot") == "Failed");
+    CHECK(h.exec.launched.size() == 2);  // no relaunch
+  }
+
+  // --- ExitCode policy: 1–127 permanent, 128+ retryable ----------------
+  {
+    Harness h;
+    Json spec = BaseSpec(1);
+    spec["restart_policy"] = "ExitCode";
+    spec["backoff_limit"] = 5;
+    h.store.Create("JAXJob", "sigkilled", spec);
+    h.Settle();
+    h.exec.Finish("sigkilled/0", 137);  // SIGKILL → retryable
+    h.Settle();
+    CHECK(Phase(h.store, "sigkilled") == "Running");
+    auto r = h.store.Get("JAXJob", "sigkilled");
+    CHECK(r->status.get("restarts").as_int() == 1);
+
+    h.exec.Finish("sigkilled/0", 3);  // app error → permanent
+    h.Settle();
+    CHECK(Phase(h.store, "sigkilled") == "Failed");
+  }
+
+  // --- Launch failure: allocation released, job Pending ----------------
+  {
+    Harness h;
+    h.exec.fail_next_launch = true;
+    h.store.Create("JAXJob", "nolaunch", BaseSpec(2));
+    h.ctl.Reconcile("nolaunch");
+    CHECK(Phase(h.store, "nolaunch") == "Pending");
+    CHECK(h.sched.Slices()[0].used == 0);
+    // Next reconcile pass succeeds.
+    h.Settle();
+    CHECK(Phase(h.store, "nolaunch") == "Running");
+  }
+
+  // --- activeDeadlineSeconds → Failed; TTL → deleted --------------------
+  {
+    Harness h;
+    Json spec = BaseSpec(1);
+    spec["active_deadline_seconds"] = 10;
+    spec["ttl_seconds_after_finished"] = 5;
+    h.store.Create("JAXJob", "slow", spec);
+    h.Settle();
+    CHECK(Phase(h.store, "slow") == "Running");
+    h.now += 11;
+    h.Settle();
+    CHECK(Phase(h.store, "slow") == "Failed");
+    CHECK(h.exec.killed.size() >= 1);
+    h.now += 6;
+    h.Settle();
+    CHECK(!h.store.Get("JAXJob", "slow").has_value());  // GC'd
+  }
+
+  printf("test_jaxjob OK\n");
+  return 0;
+}

@@ -1,0 +1,64 @@
+// JSON round-trip and edge-case tests.
+#include <cassert>
+#include <cstdio>
+
+#include "json.h"
+
+using tpk::Json;
+
+#define CHECK(cond)                                              \
+  do {                                                           \
+    if (!(cond)) {                                               \
+      fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+      return 1;                                                  \
+    }                                                            \
+  } while (0)
+
+int main() {
+  // Parse basics.
+  Json v = Json::parse(R"({"a": 1, "b": [true, null, "x\n"], "c": -2.5})");
+  CHECK(v.get("a").as_int() == 1);
+  CHECK(v.get("b").elements().size() == 3);
+  CHECK(v.get("b").elements()[0].as_bool());
+  CHECK(v.get("b").elements()[1].is_null());
+  CHECK(v.get("b").elements()[2].as_string() == "x\n");
+  CHECK(v.get("c").as_number() == -2.5);
+  CHECK(v.get("missing").is_null());
+
+  // Round trip preserves structure.
+  Json again = Json::parse(v.dump());
+  CHECK(again.dump() == v.dump());
+
+  // Integers stay integral in output.
+  Json n(42);
+  CHECK(n.dump() == "42");
+  Json big(static_cast<int64_t>(1234567890123LL));
+  CHECK(big.dump() == "1234567890123");
+
+  // String escapes round-trip.
+  Json s(std::string("quote\" slash\\ tab\t nl\n"));
+  CHECK(Json::parse(s.dump()).as_string() == s.as_string());
+
+  // \u escape decodes to UTF-8.
+  Json u = Json::parse(R"("é")");
+  CHECK(u.as_string() == "\xc3\xa9");
+
+  // Nested object building.
+  Json obj = Json::Object();
+  obj["x"]["y"] = 5;  // auto-vivify
+  CHECK(obj.get("x").get("y").as_int() == 5);
+
+  // Errors.
+  bool threw = false;
+  try { Json::parse("{bad}"); } catch (...) { threw = true; }
+  CHECK(threw);
+  threw = false;
+  try { Json::parse("[1,2") ; } catch (...) { threw = true; }
+  CHECK(threw);
+  threw = false;
+  try { Json::parse("1 2"); } catch (...) { threw = true; }
+  CHECK(threw);
+
+  printf("test_json OK\n");
+  return 0;
+}

@@ -1,0 +1,53 @@
+// Gang/slice scheduler tests: atomicity, bin-packing, multi-slice.
+#include <cstdio>
+
+#include "scheduler.h"
+
+using tpk::Scheduler;
+
+#define CHECK(cond)                                                   \
+  do {                                                                \
+    if (!(cond)) {                                                    \
+      fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+      return 1;                                                       \
+    }                                                                 \
+  } while (0)
+
+int main() {
+  Scheduler s;
+  s.AddSlice("a", 8);
+  s.AddSlice("b", 4);
+
+  // Bin-packing: prefers the fullest slice that fits.
+  auto a1 = s.Allocate(4);
+  CHECK(a1.has_value());
+  CHECK(a1->slices.count("b") == 1);  // b (free 4) is tighter than a (free 8)
+
+  // Too big → nullopt, state untouched (atomicity).
+  CHECK(!s.Allocate(9).has_value());
+  auto a2 = s.Allocate(8);
+  CHECK(a2.has_value() && a2->slices.count("a") == 1);
+
+  // Everything full now.
+  CHECK(!s.Allocate(1).has_value());
+  s.Release(*a1);
+  CHECK(s.Allocate(4).has_value());
+
+  // Multi-slice gang: needs per-slice room in N distinct slices.
+  Scheduler m;
+  m.AddSlice("s0", 4);
+  m.AddSlice("s1", 4);
+  auto span = m.Allocate(8, /*num_slices=*/2);
+  CHECK(span.has_value());
+  CHECK(span->slices.size() == 2);
+  CHECK(span->slices.at("s0") == 4 && span->slices.at("s1") == 4);
+  CHECK(!m.Allocate(2, 2).has_value());  // both slices now full
+  m.Release(*span);
+  CHECK(m.Allocate(2, 2).has_value());
+
+  // Indivisible request rejected.
+  CHECK(!m.Allocate(3, 2).has_value());
+
+  printf("test_scheduler OK\n");
+  return 0;
+}

@@ -1,0 +1,205 @@
+"""tpukit — the CLI for the whole platform (SURVEY.md §7.1 item 9).
+
+Replaces the reference's kubectl+web-UI surface (L5 descoped to CLI per
+§7.0): submit/get/list/logs/delete for JAXJobs, control-plane lifecycle,
+slice and metrics introspection.
+
+  tpukit controlplane --socket /tmp/tpk.sock --workdir /tmp/tpk --slices local=8
+  tpukit submit examples/mnist_jaxjob.yaml
+  tpukit get job mnist
+  tpukit list jobs
+  tpukit logs mnist -r 0 [-f]
+  tpukit delete job mnist
+  tpukit slices | tpukit metrics
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _client(args) -> "Client":
+    from kubeflow_tpu.controlplane.client import Client
+
+    return Client(args.socket)
+
+
+def _load_spec(path: str) -> dict:
+    with open(path) as fh:
+        text = fh.read()
+    if path.endswith((".yaml", ".yml")):
+        import yaml
+
+        return yaml.safe_load(text)
+    return json.loads(text)
+
+
+def cmd_controlplane(args) -> int:
+    from kubeflow_tpu.controlplane.client import find_binary
+    import subprocess
+
+    cmd = [find_binary(), "--socket", args.socket, "--workdir", args.workdir,
+           "--slices", args.slices, "--python", sys.executable]
+    if args.wal:
+        cmd += ["--wal", args.wal]
+    print("exec:", " ".join(cmd), file=sys.stderr)
+    return subprocess.call(cmd)
+
+
+def cmd_submit(args) -> int:
+    doc = _load_spec(args.file)
+    # YAML docs may be CR-style ({kind, metadata:{name}, spec}) or bare spec.
+    kind = doc.get("kind", "JAXJob")
+    name = args.name or doc.get("metadata", {}).get("name")
+    spec = doc.get("spec", doc if "kind" not in doc else {})
+    if not name:
+        print("error: job name required (metadata.name or --name)",
+              file=sys.stderr)
+        return 2
+    c = _client(args)
+    c.create(kind, name, spec)
+    print(f"{kind}/{name} created")
+    if args.wait:
+        phase = c.wait_for_phase(name, timeout=args.timeout)
+        print(f"{kind}/{name} {phase}")
+        return 0 if phase == "Succeeded" else 1
+    return 0
+
+
+def _kind_alias(kind: str) -> str:
+    aliases = {"job": "JAXJob", "jobs": "JAXJob", "jaxjob": "JAXJob",
+               "inferenceservice": "InferenceService", "isvc": "InferenceService",
+               "experiment": "Experiment", "experiments": "Experiment",
+               "pipeline": "Pipeline", "pipelines": "Pipeline",
+               "run": "PipelineRun", "runs": "PipelineRun"}
+    return aliases.get(kind.lower(), kind)
+
+
+def cmd_get(args) -> int:
+    c = _client(args)
+    res = c.get(_kind_alias(args.kind), args.name)
+    print(json.dumps(res, indent=2))
+    return 0
+
+
+def cmd_list(args) -> int:
+    c = _client(args)
+    items = c.list(_kind_alias(args.kind))
+    fmt = "{:<24} {:<12} {:<10} {:<8}"
+    print(fmt.format("NAME", "PHASE", "RESTARTS", "GEN"))
+    for r in items:
+        st = r.get("status", {})
+        print(fmt.format(r["name"], st.get("phase", ""),
+                         str(st.get("restarts", 0)), str(r.get("generation"))))
+    return 0
+
+
+def cmd_logs(args) -> int:
+    c = _client(args)
+    if not args.follow:
+        sys.stdout.write(c.logs(args.name, args.replica, stderr=args.stderr))
+        return 0
+    seen = 0  # absolute file offset already printed
+
+    def emit():
+        nonlocal seen
+        r = c.logs_ex(args.name, args.replica, stderr=args.stderr,
+                      max_bytes=1 << 20)
+        size, offset, content = r["size"], r["offset"], r["content"]
+        if size > seen:
+            # Print only bytes past `seen`; if the tail window already
+            # scrolled past them, print the whole window (gap is lost).
+            start = max(seen - offset, 0)
+            sys.stdout.write(content[start:])
+            sys.stdout.flush()
+            seen = size
+
+    while True:
+        try:
+            emit()
+        except Exception:
+            pass  # log file may not exist yet
+        phase = c.phase(args.name)
+        if phase in ("Succeeded", "Failed"):
+            emit()
+            print(f"\n--- job {phase} ---", file=sys.stderr)
+            return 0 if phase == "Succeeded" else 1
+        time.sleep(1.0)
+
+
+def cmd_delete(args) -> int:
+    c = _client(args)
+    c.delete(_kind_alias(args.kind), args.name)
+    print(f"{args.kind}/{args.name} deleted")
+    return 0
+
+
+def cmd_slices(args) -> int:
+    for s in _client(args).slices():
+        print(f"{s['name']}: {s['used']}/{s['capacity']} devices used")
+    return 0
+
+
+def cmd_metrics(args) -> int:
+    print(json.dumps(_client(args).metrics(), indent=2))
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="tpukit")
+    parser.add_argument("--socket", default="/tmp/tpk.sock")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("controlplane", help="run the control plane")
+    p.add_argument("--workdir", default="/tmp/tpk")
+    p.add_argument("--slices", default="local=8")
+    p.add_argument("--wal", default="")
+    p.set_defaults(fn=cmd_controlplane)
+
+    p = sub.add_parser("submit", help="submit a job spec (yaml/json)")
+    p.add_argument("file")
+    p.add_argument("--name")
+    p.add_argument("--wait", action="store_true")
+    p.add_argument("--timeout", type=float, default=600.0)
+    p.set_defaults(fn=cmd_submit)
+
+    p = sub.add_parser("get")
+    p.add_argument("kind")
+    p.add_argument("name")
+    p.set_defaults(fn=cmd_get)
+
+    p = sub.add_parser("list")
+    p.add_argument("kind")
+    p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("logs")
+    p.add_argument("name")
+    p.add_argument("-r", "--replica", type=int, default=0)
+    p.add_argument("--stderr", action="store_true")
+    p.add_argument("-f", "--follow", action="store_true")
+    p.set_defaults(fn=cmd_logs)
+
+    p = sub.add_parser("delete")
+    p.add_argument("kind")
+    p.add_argument("name")
+    p.set_defaults(fn=cmd_delete)
+
+    p = sub.add_parser("slices")
+    p.set_defaults(fn=cmd_slices)
+
+    p = sub.add_parser("metrics")
+    p.set_defaults(fn=cmd_metrics)
+
+    args = parser.parse_args(argv)
+    try:
+        return args.fn(args)
+    except Exception as e:  # CLI boundary: render errors, not tracebacks
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

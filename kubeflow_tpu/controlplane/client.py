@@ -1,0 +1,191 @@
+"""Python client for the C++ control plane (cpp/server.cc protocol).
+
+The SDK surface of the rebuild — fills the role of the reference's
+kubernetes python client + `TrainingClient` (⟨training-operator: sdk/python —
+TrainingClient⟩, SURVEY.md §3.2): newline-delimited JSON over the control
+plane's unix socket, with job-level conveniences (submit, wait, logs).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import time
+from typing import Any, Iterator
+
+
+class ControlPlaneError(RuntimeError):
+    pass
+
+
+class Client:
+    def __init__(self, socket_path: str = "/tmp/tpk.sock",
+                 timeout: float = 30.0):
+        self.socket_path = socket_path
+        self.timeout = timeout
+        self._sock: socket.socket | None = None
+        self._buf = b""
+
+    # -- transport ----------------------------------------------------------
+
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            s.settimeout(self.timeout)
+            s.connect(self.socket_path)
+            self._sock = s
+        return self._sock
+
+    def close(self) -> None:
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None
+
+    def request(self, **req: Any) -> dict:
+        try:
+            s = self._connect()
+            s.sendall(json.dumps(req).encode() + b"\n")
+            while b"\n" not in self._buf:
+                chunk = s.recv(65536)
+                if not chunk:
+                    raise ControlPlaneError(
+                        "connection closed by control plane")
+                self._buf += chunk
+        except (OSError, ControlPlaneError):
+            # A timeout or half-read leaves request/response pairing
+            # undefined on this connection — reset it so the next request
+            # starts clean instead of reading a stale reply.
+            self.close()
+            self._buf = b""
+            raise
+        line, self._buf = self._buf.split(b"\n", 1)
+        resp = json.loads(line)
+        if not resp.get("ok"):
+            raise ControlPlaneError(resp.get("error", "unknown error"))
+        return resp
+
+    # -- resource verbs -------------------------------------------------------
+
+    def create(self, kind: str, name: str, spec: dict) -> dict:
+        return self.request(op="create", kind=kind, name=name,
+                            spec=spec)["resource"]
+
+    def get(self, kind: str, name: str) -> dict:
+        return self.request(op="get", kind=kind, name=name)["resource"]
+
+    def list(self, kind: str) -> list[dict]:
+        return self.request(op="list", kind=kind)["items"]
+
+    def update_spec(self, kind: str, name: str, spec: dict,
+                    expected_version: int | None = None) -> dict:
+        req: dict[str, Any] = dict(op="update_spec", kind=kind, name=name,
+                                   spec=spec)
+        if expected_version is not None:
+            req["expected_version"] = expected_version
+        return self.request(**req)["resource"]
+
+    def delete(self, kind: str, name: str) -> None:
+        self.request(op="delete", kind=kind, name=name)
+
+    def metrics(self) -> dict:
+        return self.request(op="metrics")["metrics"]
+
+    def slices(self) -> list[dict]:
+        return self.request(op="slices")["slices"]
+
+    def logs(self, name: str, replica: int = 0, stderr: bool = False,
+             max_bytes: int = 65536) -> str:
+        return self.logs_ex(name, replica, stderr, max_bytes)["content"]
+
+    def logs_ex(self, name: str, replica: int = 0, stderr: bool = False,
+                max_bytes: int = 65536) -> dict:
+        """Returns {content, size, offset}: `size` is the full log length,
+        `offset` is where `content` starts (for follow-mode bookkeeping)."""
+        return self.request(op="logs", name=name, replica=replica,
+                            stderr=stderr, max_bytes=max_bytes)
+
+    def ping(self) -> bool:
+        try:
+            return bool(self.request(op="ping").get("pong"))
+        except (OSError, ControlPlaneError):
+            return False
+
+    # -- job conveniences (TrainingClient parity) -----------------------------
+
+    def submit_jaxjob(self, name: str, spec: dict) -> dict:
+        return self.create("JAXJob", name, spec)
+
+    def phase(self, name: str) -> str:
+        return self.get("JAXJob", name).get("status", {}).get("phase", "")
+
+    def wait_for_phase(self, name: str, phases=("Succeeded", "Failed"),
+                       timeout: float = 300.0, poll: float = 0.5) -> str:
+        """Blocks until the job reaches one of `phases` (like
+        TrainingClient.wait_for_job_conditions)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            p = self.phase(name)
+            if p in phases:
+                return p
+            time.sleep(poll)
+        raise TimeoutError(
+            f"job {name} did not reach {phases} in {timeout}s "
+            f"(last phase: {self.phase(name)!r})")
+
+    def stream_metrics(self, name: str, replica: int = 0) -> Iterator[dict]:
+        """Parses the worker's JSONL metric lines from its log."""
+        for line in self.logs(name, replica, max_bytes=1 << 20).splitlines():
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict) and "step" in rec:
+                yield rec
+
+
+def find_binary() -> str:
+    """Locates tpk-controlplane: $TPK_CONTROLPLANE_BIN, then the build tree."""
+    env = os.environ.get("TPK_CONTROLPLANE_BIN")
+    if env and os.path.exists(env):
+        return env
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    for rel in ("build/tpk-controlplane", "cpp/build/tpk-controlplane"):
+        cand = os.path.join(here, rel)
+        if os.path.exists(cand):
+            return cand
+    raise FileNotFoundError(
+        "tpk-controlplane binary not found; build with "
+        "`cmake -S cpp -B build && cmake --build build` or set "
+        "TPK_CONTROLPLANE_BIN")
+
+
+def start_controlplane(socket_path: str, workdir: str,
+                       slices: str = "local=8", wal: str | None = None,
+                       python: str | None = None,
+                       wait_s: float = 10.0) -> subprocess.Popen:
+    """Starts the control-plane binary and waits for its socket."""
+    import sys
+
+    cmd = [find_binary(), "--socket", socket_path, "--workdir", workdir,
+           "--slices", slices, "--python", python or sys.executable]
+    if wal:
+        cmd += ["--wal", wal]
+    proc = subprocess.Popen(cmd)
+    client = Client(socket_path)
+    deadline = time.time() + wait_s
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            raise ControlPlaneError(
+                f"control plane exited rc={proc.returncode}")
+        try:
+            if client.ping():
+                client.close()
+                return proc
+        except (FileNotFoundError, ConnectionRefusedError, OSError):
+            pass
+        time.sleep(0.1)
+    proc.terminate()
+    raise TimeoutError(f"control plane socket {socket_path} never came up")

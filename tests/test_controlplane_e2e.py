@@ -1,0 +1,151 @@
+"""Control-plane e2e (SURVEY.md §7.2 minimum slice): the C++ binary gang-
+launches real worker processes over jax.distributed on virtual CPU devices;
+we drive it through the Python client + tpukit CLI exactly as a user would."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BIN = os.path.join(REPO, "build", "tpk-controlplane")
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(BIN), reason="tpk-controlplane not built")
+
+
+@pytest.fixture()
+def controlplane(tmp_path):
+    from kubeflow_tpu.controlplane.client import Client, start_controlplane
+
+    sock = str(tmp_path / "tpk.sock")
+    workdir = str(tmp_path / "work")
+    env_backup = dict(os.environ)
+    os.environ["TPK_CONTROLPLANE_BIN"] = BIN
+    proc = start_controlplane(sock, workdir, slices="local=8",
+                              wal=str(tmp_path / "wal.jsonl"))
+    client = Client(sock)
+    try:
+        yield client, sock, workdir, tmp_path
+    finally:
+        client.close()
+        proc.terminate()
+        proc.wait(timeout=10)
+        os.environ.clear()
+        os.environ.update(env_backup)
+
+
+def _mnist_spec(steps=30):
+    return {
+        "replicas": 2,
+        "devices_per_proc": 2,
+        "cpu_devices_per_proc": 2,
+        "restart_policy": "OnFailure",
+        "backoff_limit": 2,
+        "runtime": {
+            "model": "mnist_mlp",
+            "dataset": "mnist_like",
+            "strategy": "dp",
+            "mesh": {"data": 4},
+            "steps": steps,
+            "batch_size": 64,
+            "learning_rate": 0.01,
+            "log_every": 10,
+        },
+    }
+
+
+def test_mnist_jaxjob_end_to_end(controlplane):
+    client, sock, workdir, tmp = controlplane
+    client.submit_jaxjob("mnist", _mnist_spec())
+    phase = client.wait_for_phase("mnist", timeout=240)
+    assert phase == "Succeeded", client.get("JAXJob", "mnist")
+
+    # Conditions walked the state machine.
+    conds = [c["type"] for c in
+             client.get("JAXJob", "mnist")["status"]["conditions"]]
+    assert conds[0] == "Created"
+    assert "Running" in conds
+    assert conds[-1] == "Succeeded"
+
+    # Worker logs carry the metrics stream; loss decreased.
+    metrics = list(client.stream_metrics("mnist", replica=0))
+    losses = [m["loss"] for m in metrics if "loss" in m]
+    assert losses and losses[-1] < losses[0]
+
+    # Gang resources came back.
+    slices = client.slices()
+    assert slices[0]["used"] == 0
+    assert client.metrics()["jobs_succeeded"] == 1
+
+
+def test_cli_surface(controlplane):
+    client, sock, workdir, tmp = controlplane
+    env = dict(os.environ, PYTHONPATH=REPO)
+
+    def cli(*args):
+        return subprocess.run(
+            [sys.executable, "-m", "kubeflow_tpu.cli", "--socket", sock,
+             *args], capture_output=True, text=True, cwd=REPO, env=env)
+
+    r = cli("submit", os.path.join(REPO, "examples", "mnist_jaxjob.yaml"),
+            "--wait", "--timeout", "240")
+    assert r.returncode == 0, r.stderr
+    assert "Succeeded" in r.stdout
+
+    r = cli("list", "jobs")
+    assert "mnist" in r.stdout and "Succeeded" in r.stdout
+
+    r = cli("logs", "mnist")
+    assert '"loss"' in r.stdout
+
+    r = cli("slices")
+    assert "local: 0/8" in r.stdout
+
+    r = cli("delete", "job", "mnist")
+    assert r.returncode == 0
+    r = cli("get", "job", "mnist")
+    assert r.returncode == 1 and "not found" in r.stderr
+
+
+def test_gang_restart_with_checkpoint_resume(controlplane):
+    """Kill a worker mid-run: controller kills the gang, restarts it, and
+    the runtime auto-resumes from the latest checkpoint → job Succeeds with
+    restarts=1 (SURVEY.md §5.3 checkpoint-restart elasticity)."""
+    client, sock, workdir, tmp = controlplane
+    ckpt_dir = tmp / "ckpt"
+    spec = _mnist_spec(steps=2000)  # long enough to outlive the kill window
+    spec["runtime"]["checkpoint"] = {
+        "dir": str(ckpt_dir), "interval": 25, "keep": 2}
+    client.submit_jaxjob("elastic", spec)
+
+    # SIGKILL a worker (preemption simulation → exit 137, retryable under
+    # OnFailure) — but only once a checkpoint exists, so the restart resumes.
+    def worker_pids():
+        r = subprocess.run(["pgrep", "-f", "elastic/runtime.json"],
+                           capture_output=True, text=True)
+        return [int(p) for p in r.stdout.split()]
+
+    deadline = time.time() + 180
+    victim = None
+    while time.time() < deadline and victim is None:
+        has_ckpt = ckpt_dir.exists() and any(
+            d.name.isdigit() for d in ckpt_dir.iterdir())
+        pids = worker_pids()
+        if has_ckpt and pids and client.phase("elastic") == "Running":
+            victim = pids[0]
+        else:
+            time.sleep(0.5)
+    assert victim is not None, "no checkpointed running worker appeared"
+    os.kill(victim, 9)
+
+    phase = client.wait_for_phase("elastic", timeout=240)
+    status = client.get("JAXJob", "elastic")["status"]
+    assert phase == "Succeeded", status
+    assert status["restarts"] >= 1
+    # The restarted worker logged a restore event.
+    logs = client.logs("elastic", 0, max_bytes=1 << 20)
+    assert '"event": "restored"' in logs or '"restored"' in logs
