@@ -1,0 +1,19 @@
+"""Model serving — the KServe-equivalent subsystem (SURVEY.md §2.2, §7.1.6).
+
+Data plane: Model/JAXModel (AOT bucketed inference), Batcher (request
+coalescing), ModelServer (v1 + v2 open-inference HTTP), storage initializer,
+and ServingRuntime-style format registry.
+"""
+
+from kubeflow_tpu.serve.batcher import Batcher
+from kubeflow_tpu.serve.model import JAXModel, Model
+from kubeflow_tpu.serve.runtimes import (export_for_serving, list_runtimes,
+                                         load_model, register_runtime)
+from kubeflow_tpu.serve.server import ModelRepository, ModelServer
+from kubeflow_tpu.serve.storage import download
+
+__all__ = [
+    "Batcher", "JAXModel", "Model", "ModelRepository", "ModelServer",
+    "download", "export_for_serving", "list_runtimes", "load_model",
+    "register_runtime",
+]

@@ -1,0 +1,130 @@
+"""Request coalescing batcher — the TPU-side answer to KServe's agent batcher.
+
+The reference batches in a Go sidecar in front of the model container
+(⟨kserve: pkg/agent — batcher⟩, SURVEY.md §2.2). On TPU the batcher must sit
+*inside* the server, because its whole point is MXU utilization: many small
+concurrent requests become one padded device call on an AOT executable
+(see model.JAXModel). Policy matches the reference's: flush at
+`max_batch_size` or after `max_latency_ms`, whichever first.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+
+class _Item:
+    __slots__ = ("inputs", "future", "n")
+
+    def __init__(self, inputs: Sequence[np.ndarray]):
+        self.inputs = [np.asarray(x) for x in inputs]
+        self.n = self.inputs[0].shape[0]
+        self.future: Future = Future()
+
+    def signature(self) -> tuple:
+        """Items only coalesce when per-example shapes and dtypes agree —
+        one malformed request must not poison a batch of valid ones."""
+        return tuple((a.shape[1:], str(a.dtype)) for a in self.inputs)
+
+
+class Batcher:
+    """Coalesces concurrent predict calls into single model calls.
+
+    `predict_fn` takes a list of stacked input arrays and returns a list of
+    output arrays whose leading dim equals the total batch.
+    """
+
+    def __init__(self, predict_fn: Callable[[list[np.ndarray]], list],
+                 max_batch_size: int = 32, max_latency_ms: float = 5.0):
+        self._predict = predict_fn
+        self.max_batch_size = max_batch_size
+        self.max_latency_s = max_latency_ms / 1e3
+        self._q: queue.Queue[_Item | None] = queue.Queue()
+        self._pending: _Item | None = None
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="tpk-batcher")
+        self._closed = False
+        self.stats = {"batches": 0, "items": 0, "examples": 0}
+        self._thread.start()
+
+    def submit(self, inputs: Sequence[np.ndarray]) -> Future:
+        if self._closed:
+            raise RuntimeError("batcher is closed")
+        item = _Item(inputs)
+        if item.n > self.max_batch_size:
+            # Oversized requests bypass coalescing; JAXModel chunks them.
+            try:
+                item.future.set_result(self._predict(item.inputs))
+            except BaseException as e:  # noqa: BLE001 - deliver to caller
+                item.future.set_exception(e)
+            return item.future
+        self._q.put(item)
+        return item.future
+
+    def predict(self, inputs: Sequence[np.ndarray],
+                timeout: float | None = 30.0) -> list:
+        return self.submit(inputs).result(timeout)
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._q.put(None)
+            self._thread.join(timeout=5.0)
+
+    # -- worker -------------------------------------------------------------
+
+    def _gather(self) -> list[_Item] | None:
+        """Blocks for the first item, then drains until size limit or until
+        max_latency has elapsed since the FIRST item (a fixed deadline, not a
+        per-item idle timeout — trickling arrivals must not extend it)."""
+        first = self._pending or self._q.get()
+        self._pending = None
+        if first is None:
+            return None
+        batch, total = [first], first.n
+        sig = first.signature()
+        deadline = time.monotonic() + self.max_latency_s
+        while total < self.max_batch_size:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                nxt = self._q.get(timeout=remaining)
+            except queue.Empty:
+                break
+            if nxt is None:
+                self._q.put(None)  # re-post sentinel for the outer loop
+                break
+            if nxt.signature() != sig or total + nxt.n > self.max_batch_size:
+                self._pending = nxt  # incompatible/overflow: next batch's head
+                break
+            batch.append(nxt)
+            total += nxt.n
+        return batch
+
+    def _loop(self) -> None:
+        while True:
+            batch = self._gather()
+            if batch is None:
+                return
+            try:
+                stacked = [np.concatenate(parts)
+                           for parts in zip(*(i.inputs for i in batch))]
+                outs = self._predict(stacked)
+            except BaseException as e:  # noqa: BLE001 - deliver to callers
+                for item in batch:
+                    item.future.set_exception(e)
+                continue
+            self.stats["batches"] += 1
+            self.stats["items"] += len(batch)
+            self.stats["examples"] += sum(i.n for i in batch)
+            off = 0
+            for item in batch:
+                item.future.set_result([o[off:off + item.n] for o in outs])
+                off += item.n

@@ -1,0 +1,347 @@
+"""ModelServer — the serving data plane (KServe model server equivalent).
+
+Speaks both protocols the reference serves (⟨kserve: python/kserve —
+ModelServer, v1/v2 endpoints⟩, SURVEY.md §2.2/§3.3):
+
+  v1:  POST /v1/models/{name}:predict      {"instances": [...]}
+       GET  /v1/models/{name}              readiness
+       GET  /v1/models                     list
+  v2:  GET  /v2/health/{live,ready}
+       GET  /v2/models/{name}[/ready]      metadata / readiness
+       POST /v2/models/{name}/infer        open-inference tensors
+       POST /v2/repository/models/{name}/{load,unload}
+  ops: GET  /metrics                       prometheus text format
+
+Inference runs through the coalescing Batcher (batcher.py) so concurrent
+requests share one padded AOT device call; handlers stay async and await the
+batcher future, keeping the event loop free (the reference gets the same
+effect from uvicorn workers + the agent sidecar batcher).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import threading
+import time
+from typing import Any
+
+import numpy as np
+import tornado.httpserver
+import tornado.ioloop
+import tornado.netutil
+import tornado.web
+
+from kubeflow_tpu.serve.batcher import Batcher
+from kubeflow_tpu.serve.model import Model, _v2_dtype, v2_to_numpy_dtype
+
+
+class ModelRepository:
+    """Name → Model with load/unload — the multi-model surface the reference
+    exposes via its repository API + agent model puller."""
+
+    def __init__(self):
+        self._models: dict[str, Model] = {}
+        self._batchers: dict[str, Batcher] = {}
+        self._dirs: dict[str, str] = {}
+        self._lock = threading.Lock()
+
+    def register(self, model: Model, *, load: bool = True,
+                 max_batch_size: int = 32, max_latency_ms: float = 5.0,
+                 model_dir: str | None = None) -> Model:
+        if load and not model.ready:
+            model.load()
+        with self._lock:
+            self._models[model.name] = model
+            if model_dir:
+                self._dirs[model.name] = model_dir
+            old = self._batchers.pop(model.name, None)
+            self._batchers[model.name] = Batcher(
+                model.predict, max_batch_size=max_batch_size,
+                max_latency_ms=max_latency_ms)
+        if old:
+            old.close()
+        return model
+
+    def get(self, name: str) -> Model:
+        try:
+            return self._models[name]
+        except KeyError:
+            raise tornado.web.HTTPError(
+                404, reason=f"model {name!r} not found") from None
+
+    def batcher(self, name: str) -> Batcher:
+        self.get(name)
+        return self._batchers[name]
+
+    def names(self) -> list[str]:
+        return sorted(self._models)
+
+    def load(self, name: str) -> Model:
+        """(Re)load by name — from its recorded model dir if registered that
+        way, else by flipping the in-process model's lifecycle."""
+        with self._lock:
+            model_dir = self._dirs.get(name)
+        if model_dir:
+            from kubeflow_tpu.serve import runtimes
+            model = runtimes.load_model(model_dir, name=name)
+            return self.register(model, model_dir=model_dir)
+        model = self.get(name)
+        model.load()
+        return model
+
+    def unload(self, name: str) -> None:
+        model = self.get(name)
+        model.unload()
+
+    def close(self) -> None:
+        for b in self._batchers.values():
+            b.close()
+
+
+# -- handlers ---------------------------------------------------------------
+
+
+class _Base(tornado.web.RequestHandler):
+    def initialize(self, server: "ModelServer"):
+        self.server = server
+        self.repo = server.repo
+
+    def write_json(self, obj: Any, status: int = 200) -> None:
+        self.set_status(status)
+        self.set_header("Content-Type", "application/json")
+        self.finish(json.dumps(obj))
+
+    def body_json(self) -> dict:
+        try:
+            return json.loads(self.request.body or b"{}")
+        except json.JSONDecodeError as e:
+            raise tornado.web.HTTPError(400, reason=f"bad JSON: {e}") from None
+
+    def write_error(self, status_code: int, **kwargs) -> None:
+        reason = self._reason
+        if "exc_info" in kwargs:
+            exc = kwargs["exc_info"][1]
+            if not isinstance(exc, tornado.web.HTTPError):
+                reason = f"{type(exc).__name__}: {exc}"
+        self.write_json({"error": reason}, status=status_code)
+
+
+class V1ListHandler(_Base):
+    def get(self):
+        self.write_json({"models": self.repo.names()})
+
+
+class V1ModelHandler(_Base):
+    def get(self, name: str):
+        model = self.repo.get(name)
+        if not model.ready:
+            raise tornado.web.HTTPError(
+                503, reason=f"model {name!r} not ready")
+        self.write_json({"name": name, "ready": model.ready})
+
+
+class V1PredictHandler(_Base):
+    async def post(self, name: str):
+        model = self.repo.get(name)
+        body = model.preprocess(self.body_json())
+        instances = body.get("instances")
+        if instances is None:
+            raise tornado.web.HTTPError(
+                400, reason='v1 request needs "instances"')
+        t0 = time.monotonic()
+        # v1 protocol is single-tensor: "instances" stack along batch dim 0.
+        spec = getattr(model, "input_spec", None)
+        inputs = [np.asarray(instances, dtype=spec[0][1] if spec else None)]
+        fut = self.repo.batcher(name).submit(inputs)
+        outs = await asyncio.wrap_future(fut)
+        outs = model.postprocess(outs)
+        self.server.observe(name, len(instances), time.monotonic() - t0)
+        preds = outs[0] if isinstance(outs, (list, tuple)) else outs
+        self.write_json({"predictions": np.asarray(preds).tolist()})
+
+
+class V2HealthHandler(_Base):
+    def get(self, kind: str):
+        if kind == "ready" and not all(
+                m.ready for m in map(self.repo.get, self.repo.names())):
+            raise tornado.web.HTTPError(503, reason="models loading")
+        self.write_json({"live" if kind == "live" else "ready": True})
+
+
+class V2ModelHandler(_Base):
+    def get(self, name: str, sub: str = ""):
+        model = self.repo.get(name)
+        if sub == "/ready":
+            if not model.ready:
+                raise tornado.web.HTTPError(
+                    503, reason=f"model {name!r} not ready")
+            self.write_json({"name": name, "ready": True})
+        else:
+            self.write_json(model.metadata())
+
+
+class V2InferHandler(_Base):
+    async def post(self, name: str):
+        model = self.repo.get(name)
+        body = model.preprocess(self.body_json())
+        tensors = body.get("inputs")
+        if not tensors:
+            raise tornado.web.HTTPError(400, reason='v2 request needs "inputs"')
+        inputs = []
+        for t in tensors:
+            dtype = v2_to_numpy_dtype(t.get("datatype", "FP32"))
+            arr = np.asarray(t["data"], dtype=dtype).reshape(t["shape"])
+            inputs.append(arr)
+        t0 = time.monotonic()
+        fut = self.repo.batcher(name).submit(inputs)
+        outs = await asyncio.wrap_future(fut)
+        outs = model.postprocess(outs)
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+        self.server.observe(name, int(inputs[0].shape[0]),
+                            time.monotonic() - t0)
+        self.write_json({
+            "model_name": name, "id": body.get("id", ""),
+            "outputs": [{
+                "name": f"output_{i}", "shape": list(np.shape(o)),
+                "datatype": _v2_dtype(str(np.asarray(o).dtype)),
+                "data": np.asarray(o).ravel().tolist(),
+            } for i, o in enumerate(outs)]})
+
+
+class RepositoryHandler(_Base):
+    def post(self, name: str, verb: str):
+        if verb == "load":
+            self.repo.load(name)
+        else:
+            self.repo.unload(name)
+        self.write_json({"name": name, "state":
+                         "READY" if verb == "load" else "UNAVAILABLE"})
+
+
+class MetricsHandler(_Base):
+    def get(self):
+        self.set_header("Content-Type", "text/plain; version=0.0.4")
+        self.finish(self.server.prometheus_text())
+
+
+class ModelServer:
+    """Hosts a ModelRepository over HTTP; runs inline or on a daemon thread."""
+
+    def __init__(self, repo: ModelRepository | None = None):
+        self.repo = repo or ModelRepository()
+        self._counters: dict[str, dict] = {}
+        self._lock = threading.Lock()
+        self._loop: tornado.ioloop.IOLoop | None = None
+        self._thread: threading.Thread | None = None
+        self.port: int | None = None
+
+    def observe(self, model: str, examples: int, seconds: float) -> None:
+        with self._lock:
+            c = self._counters.setdefault(
+                model, {"requests": 0, "examples": 0, "seconds": 0.0})
+            c["requests"] += 1
+            c["examples"] += examples
+            c["seconds"] += seconds
+
+    def prometheus_text(self) -> str:
+        lines = [
+            "# TYPE tpk_serve_requests_total counter",
+            "# TYPE tpk_serve_examples_total counter",
+            "# TYPE tpk_serve_request_seconds_total counter",
+        ]
+        with self._lock:
+            for model, c in sorted(self._counters.items()):
+                tag = f'{{model="{model}"}}'
+                lines += [
+                    f"tpk_serve_requests_total{tag} {c['requests']}",
+                    f"tpk_serve_examples_total{tag} {c['examples']}",
+                    f"tpk_serve_request_seconds_total{tag} {c['seconds']:.6f}",
+                ]
+        return "\n".join(lines) + "\n"
+
+    def app(self) -> tornado.web.Application:
+        kw = {"server": self}
+        return tornado.web.Application([
+            (r"/v1/models", V1ListHandler, kw),
+            (r"/v1/models/([^/:]+)", V1ModelHandler, kw),
+            (r"/v1/models/([^/:]+):predict", V1PredictHandler, kw),
+            (r"/v2/health/(live|ready)", V2HealthHandler, kw),
+            (r"/v2/models/([^/]+)/infer", V2InferHandler, kw),
+            (r"/v2/repository/models/([^/]+)/(load|unload)",
+             RepositoryHandler, kw),
+            (r"/v2/models/([^/]+)(/ready)?", V2ModelHandler, kw),
+            (r"/metrics", MetricsHandler, kw),
+        ])
+
+    def _serve(self, port: int, ready: threading.Event) -> None:
+        asyncio.set_event_loop(asyncio.new_event_loop())
+        self._loop = tornado.ioloop.IOLoop.current()
+        sockets = tornado.netutil.bind_sockets(port, address="127.0.0.1")
+        server = tornado.httpserver.HTTPServer(self.app())
+        server.add_sockets(sockets)
+        self.port = sockets[0].getsockname()[1]
+        ready.set()
+        self._loop.start()
+
+    def start_background(self, port: int = 0) -> int:
+        """Starts on a daemon thread; returns the bound port (tests, local)."""
+        ready = threading.Event()
+        self._thread = threading.Thread(
+            target=self._serve, args=(port, ready), daemon=True,
+            name="tpk-model-server")
+        self._thread.start()
+        if not ready.wait(10.0):
+            raise TimeoutError("model server failed to bind")
+        assert self.port is not None
+        return self.port
+
+    def stop(self) -> None:
+        if self._loop is not None:
+            self._loop.add_callback(self._loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        self.repo.close()
+
+    def run(self, port: int) -> None:
+        """Blocking serve — the in-pod entrypoint."""
+        self._serve(port, threading.Event())
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="tpk-model-server")
+    p.add_argument("--model-dir", action="append", default=[],
+                   help="model bundle dir (repeatable; see runtimes.py)")
+    p.add_argument("--storage-uri", action="append", default=[],
+                   help="uri to materialize then serve (file://, pvc://)")
+    p.add_argument("--name", action="append", default=[],
+                   help="override name for the i-th model")
+    p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--max-batch-size", type=int, default=32)
+    p.add_argument("--max-latency-ms", type=float, default=5.0)
+    args = p.parse_args(argv)
+
+    from kubeflow_tpu.serve import runtimes, storage
+
+    dirs = list(args.model_dir)
+    for i, uri in enumerate(args.storage_uri):
+        dirs.append(storage.download(uri, f"/tmp/tpk-models/{i}"))
+
+    server = ModelServer()
+    for i, d in enumerate(dirs):
+        name = args.name[i] if i < len(args.name) else None
+        model = runtimes.load_model(d, name=name)
+        server.repo.register(model, model_dir=d,
+                             max_batch_size=args.max_batch_size,
+                             max_latency_ms=args.max_latency_ms)
+        print(json.dumps({"event": "model_loaded", "name": model.name,
+                          "load_time_s": model.load_time_s}), flush=True)
+    print(json.dumps({"event": "serving", "port": args.port}), flush=True)
+    server.run(args.port)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,48 @@
+"""Storage initializer — KServe's model-download initContainer, in-process.
+
+The reference runs ⟨kserve: python/kserve/kserve/storage — Storage.download⟩
+as an initContainer pulling s3/gcs/pvc/http URIs to /mnt/models before the
+server starts (SURVEY.md §3.3). This environment has zero egress, so local
+schemes are real and remote schemes fail with a clear error instead of a
+silent stub.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tarfile
+import zipfile
+
+LOCAL_SCHEMES = ("file://", "pvc://", "")
+REMOTE_SCHEMES = ("s3://", "gs://", "gcs://", "http://", "https://", "hdfs://")
+
+
+def download(uri: str, dest: str) -> str:
+    """Materializes `uri` under `dest`; returns the model directory path."""
+    os.makedirs(dest, exist_ok=True)
+    for scheme in REMOTE_SCHEMES:
+        if uri.startswith(scheme):
+            raise NotImplementedError(
+                f"remote storage {scheme} requires network egress; mount the "
+                f"model locally and use file:// (reference parity: KServe "
+                f"storage-initializer would fetch this)")
+    path = uri[len("file://"):] if uri.startswith("file://") else uri
+    if uri.startswith("pvc://"):
+        # pvc://{claim}/{path} — claims are mounted under $TPK_PVC_ROOT.
+        root = os.environ.get("TPK_PVC_ROOT", "/mnt/pvc")
+        path = os.path.join(root, uri[len("pvc://"):])
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"model uri {uri!r} -> {path!r} not found")
+    if os.path.isdir(path):
+        return path  # local dirs are served in place, no copy
+    if tarfile.is_tarfile(path):
+        with tarfile.open(path) as tf:
+            tf.extractall(dest, filter="data")
+        return dest
+    if zipfile.is_zipfile(path):
+        with zipfile.ZipFile(path) as zf:
+            zf.extractall(dest)
+        return dest
+    shutil.copy2(path, dest)
+    return dest
