@@ -113,7 +113,13 @@ Json Server::Dispatch(const Json& req) {
     }
     resp["slices"] = arr;
   } else if (op == "logs") {
-    // Tail a worker's log file.
+    // Tail a worker's log file. The name becomes a path component, so it
+    // must pass the same validation Create enforces (no '/', no '..').
+    if (!Store::ValidName(name)) {
+      resp["ok"] = false;
+      resp["error"] = "invalid name: " + name;
+      return resp;
+    }
     int replica = static_cast<int>(req.get("replica").as_int(0));
     int64_t max_bytes = req.get("max_bytes").as_int(65536);
     std::string path = workdir_ + "/" + name + "/worker-" +

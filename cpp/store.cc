@@ -1,6 +1,8 @@
 #include "store.h"
 
+#include <cctype>
 #include <cstdio>
+#include <cstdlib>
 
 namespace tpk {
 
@@ -20,10 +22,14 @@ int Store::Load() {
   if (!f) return 0;
   int applied = 0;
   std::string line;
-  char buf[1 << 16];
+  // getline(3): records (full JAXJob specs) can exceed any fixed buffer; a
+  // truncated read would mis-parse and silently drop every later record.
+  char* lbuf = nullptr;
+  size_t lcap = 0;
+  ssize_t llen;
   std::lock_guard<std::mutex> lock(mu_);
-  while (fgets(buf, sizeof(buf), f)) {
-    line = buf;
+  while ((llen = getline(&lbuf, &lcap, f)) != -1) {
+    line.assign(lbuf, static_cast<size_t>(llen));
     if (line.empty() || line == "\n") continue;
     try {
       Json rec = Json::parse(line);
@@ -50,8 +56,23 @@ int Store::Load() {
       break;
     }
   }
+  free(lbuf);
   fclose(f);
   return applied;
+}
+
+bool Store::ValidName(const std::string& name) {
+  // DNS-label-ish, like the reference's metadata.name validation: resource
+  // names become filesystem paths (workdir/<name>/worker-N.log) and proc-id
+  // prefixes (<name>/<replica>), so '/', '..', and control chars are unsafe.
+  if (name.empty() || name.size() > 253 || name[0] == '.') return false;
+  for (char c : name) {
+    if (!(std::isalnum(static_cast<unsigned char>(c)) || c == '-' ||
+          c == '_' || c == '.')) {
+      return false;
+    }
+  }
+  return true;
 }
 
 Json Store::ToJson(const Resource& r) {
@@ -78,6 +99,10 @@ void Store::Append(const WatchEvent& ev) { pending_.push_back(ev); }
 
 Store::Result Store::Create(const std::string& kind, const std::string& name,
                             Json spec) {
+  if (!ValidName(name) || !ValidName(kind)) {
+    return {false, "invalid name: must match [A-Za-z0-9._-]{1,253}, not "
+                   "leading '.': " + kind + "/" + name, {}};
+  }
   std::lock_guard<std::mutex> lock(mu_);
   auto key = std::make_pair(kind, name);
   if (data_.count(key)) {

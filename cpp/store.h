@@ -78,6 +78,10 @@ class Store {
 
   static Json ToJson(const Resource& r);
 
+  // True when `name` is safe as a resource name / path component
+  // ([A-Za-z0-9._-], <=253 chars, no leading '.').
+  static bool ValidName(const std::string& name);
+
  private:
   void Append(const WatchEvent& ev);
   void WalWrite(const Resource& r);

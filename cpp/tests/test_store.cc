@@ -96,6 +96,38 @@ int main() {
     unlink(wal.c_str());
   }
 
+  // Name validation: names become path components and proc-id prefixes.
+  {
+    Store s;
+    CHECK(!s.Create("JAXJob", "a/b", Json::Object()).ok);
+    CHECK(!s.Create("JAXJob", "..", Json::Object()).ok);
+    CHECK(!s.Create("JAXJob", "", Json::Object()).ok);
+    CHECK(!s.Create("JAXJob", ".hidden", Json::Object()).ok);
+    CHECK(s.Create("JAXJob", "ok-name_1.2", Json::Object()).ok);
+    CHECK(!Store::ValidName(std::string(300, 'a')));
+  }
+
+  // WAL records larger than 64KB must replay intact (regression: fixed-size
+  // fgets buffer truncated them and dropped all later records).
+  {
+    std::string wal = "/tmp/tpk_test_store_bigwal.jsonl";
+    std::remove(wal.c_str());
+    {
+      Store w(wal);
+      Json spec = Json::Object();
+      spec["blob"] = std::string(200 * 1024, 'x');
+      CHECK(w.Create("JAXJob", "big", spec).ok);
+      CHECK(w.Create("JAXJob", "after", Json::Object()).ok);
+    }
+    Store r(wal);
+    CHECK(r.Load() == 2);
+    CHECK(r.Get("JAXJob", "big").has_value());
+    CHECK(r.Get("JAXJob", "after").has_value());
+    CHECK(r.Get("JAXJob", "big")->spec.get("blob").as_string().size() ==
+          200 * 1024);
+    std::remove(wal.c_str());
+  }
+
   printf("test_store OK\n");
   return 0;
 }
