@@ -290,6 +290,13 @@ void JaxJobController::Recover() {
   }
 }
 
+void JaxJobController::OnDeleted(const Resource& res) {
+  if (!res.status.get("active").as_bool(false)) return;
+  JobView job{res, res.spec, res.status};
+  KillAll(job);
+  ReleaseAlloc(job);
+}
+
 void JaxJobController::Reconcile(const std::string& name) {
   metrics_.reconciles++;
   auto res = store_->Get("JAXJob", name);

@@ -61,6 +61,11 @@ class JaxJobController {
   // Level-triggered reconcile of one job by name. Safe to call repeatedly.
   void Reconcile(const std::string& name);
 
+  // Watch hook for kDeleted events: a deleted job can no longer be fetched
+  // by name, so the gang must be killed and its allocation released here
+  // (upstream: kubelet kills containers when the pod object goes away).
+  void OnDeleted(const Resource& res);
+
   // Called by the event loop: reap process exits, drive reconciles, enforce
   // deadlines/TTLs. `now_s` injectable for tests.
   void Tick(double now_s);

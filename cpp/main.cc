@@ -19,6 +19,7 @@
 #include "scheduler.h"
 #include "server.h"
 #include "store.h"
+#include "tune.h"
 
 namespace {
 volatile sig_atomic_t g_stop = 0;
@@ -74,7 +75,10 @@ int main(int argc, char** argv) {
   tpk::LocalExecutor executor;
   tpk::JaxJobController jaxjob(&store, &executor, &scheduler, workdir, python);
   jaxjob.Recover();
-  tpk::Server server(&store, &scheduler, &jaxjob, socket_path, workdir);
+  tpk::SubprocessSuggestion suggestion(python);
+  tpk::ExperimentController tune(&store, &suggestion, workdir);
+  tpk::Server server(&store, &scheduler, &jaxjob, socket_path, workdir,
+                     &tune);
 
   std::string error;
   if (!server.Start(&error)) {
@@ -88,9 +92,22 @@ int main(int argc, char** argv) {
           socket_path.c_str(), workdir.c_str(), replayed, slices.size());
 
   // Watch: any JAXJob change → reconcile (informer-style edge trigger).
+  // Deletes are handled inline: the resource is already gone from the
+  // store, so the controller must kill the gang from the event's snapshot.
   std::vector<std::string> dirty;
-  store.Watch("JAXJob", [&dirty](const tpk::WatchEvent& ev) {
-    dirty.push_back(ev.resource.name);
+  store.Watch("JAXJob", [&dirty, &jaxjob](const tpk::WatchEvent& ev) {
+    if (ev.type == tpk::WatchEvent::Type::kDeleted) {
+      jaxjob.OnDeleted(ev.resource);
+    } else {
+      dirty.push_back(ev.resource.name);
+    }
+  });
+  // Experiment/Trial deletes cascade to their children (apiserver GC).
+  store.Watch("Experiment", [&tune](const tpk::WatchEvent& ev) {
+    if (ev.type == tpk::WatchEvent::Type::kDeleted) tune.OnDeleted(ev.resource);
+  });
+  store.Watch("Trial", [&tune](const tpk::WatchEvent& ev) {
+    if (ev.type == tpk::WatchEvent::Type::kDeleted) tune.OnDeleted(ev.resource);
   });
 
   while (!g_stop) {
@@ -98,9 +115,14 @@ int main(int argc, char** argv) {
     store.DrainWatches();
     for (const auto& name : dirty) jaxjob.Reconcile(name);
     dirty.clear();
-    jaxjob.Tick(static_cast<double>(time(nullptr)));
+    double now = static_cast<double>(time(nullptr));
+    jaxjob.Tick(now);
+    tune.Tick(now);
+    // Tune's writes (trial JAXJob create/delete) need a jaxjob pass before
+    // the next poll so child gangs launch/die promptly.
     store.DrainWatches();
-    dirty.clear();  // Tick's own status writes don't need a second pass
+    for (const auto& name : dirty) jaxjob.Reconcile(name);
+    dirty.clear();
   }
   fprintf(stderr, "tpk-controlplane: shutting down\n");
   return 0;

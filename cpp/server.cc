@@ -12,10 +12,12 @@
 namespace tpk {
 
 Server::Server(Store* store, Scheduler* scheduler, JaxJobController* jaxjob,
-               std::string socket_path, std::string workdir)
+               std::string socket_path, std::string workdir,
+               ExperimentController* tune)
     : store_(store),
       scheduler_(scheduler),
       jaxjob_(jaxjob),
+      tune_(tune),
       socket_path_(std::move(socket_path)),
       workdir_(std::move(workdir)) {}
 
@@ -100,7 +102,9 @@ Json Server::Dispatch(const Json& req) {
     fill(store_->Delete(kind, name));
   } else if (op == "metrics") {
     resp["ok"] = true;
-    resp["metrics"] = jaxjob_ ? jaxjob_->metrics().ToJson() : Json::Object();
+    Json m = jaxjob_ ? jaxjob_->metrics().ToJson() : Json::Object();
+    if (tune_) m["tune"] = tune_->metrics().ToJson();
+    resp["metrics"] = m;
   } else if (op == "slices") {
     resp["ok"] = true;
     Json arr = Json::Array();

@@ -18,13 +18,15 @@
 #include "json.h"
 #include "scheduler.h"
 #include "store.h"
+#include "tune.h"
 
 namespace tpk {
 
 class Server {
  public:
   Server(Store* store, Scheduler* scheduler, JaxJobController* jaxjob,
-         std::string socket_path, std::string workdir);
+         std::string socket_path, std::string workdir,
+         ExperimentController* tune = nullptr);
   ~Server();
 
   bool Start(std::string* error);
@@ -49,6 +51,7 @@ class Server {
   Store* store_;
   Scheduler* scheduler_;
   JaxJobController* jaxjob_;
+  ExperimentController* tune_;
   std::string socket_path_;
   std::string workdir_;
   int listen_fd_ = -1;

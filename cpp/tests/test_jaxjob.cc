@@ -198,6 +198,21 @@ int main() {
     CHECK(!h.store.Get("JAXJob", "slow").has_value());  // GC'd
   }
 
+  // --- Delete of a Running job kills the gang + releases devices --------
+  {
+    Harness h;
+    h.store.Create("JAXJob", "doomed", BaseSpec(2));
+    h.Settle();
+    CHECK(Phase(h.store, "doomed") == "Running");
+    CHECK(h.sched.Slices()[0].used == 2);
+
+    auto r = h.store.Delete("JAXJob", "doomed");
+    CHECK(r.ok);
+    h.ctl.OnDeleted(r.resource);  // what main.cc's watch does on kDeleted
+    CHECK(h.exec.killed.size() == 2);
+    CHECK(h.sched.Slices()[0].used == 0);
+  }
+
   printf("test_jaxjob OK\n");
   return 0;
 }

@@ -1,0 +1,383 @@
+// Experiment/Trial controller semantics against FakeExecutor +
+// FakeSuggestion — the envtest analog for the Katib-equivalent layer
+// (SURVEY.md §4.2): no processes or suggestion services start; tests flip
+// job status by hand, write fake worker logs, and assert on the
+// experiment state machine, parallelism cap, optimal tracking, goal/
+// failure-budget completion, substitution, metric parsing, and medianstop.
+#include <sys/stat.h>
+
+#include <cstdio>
+#include <string>
+
+#include "executor.h"
+#include "jaxjob.h"
+#include "scheduler.h"
+#include "store.h"
+#include "tune.h"
+
+using tpk::ExperimentController;
+using tpk::FakeExecutor;
+using tpk::FakeSuggestion;
+using tpk::JaxJobController;
+using tpk::Json;
+using tpk::Scheduler;
+using tpk::Store;
+
+#define CHECK(cond)                                                   \
+  do {                                                                \
+    if (!(cond)) {                                                    \
+      fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+      return 1;                                                       \
+    }                                                                 \
+  } while (0)
+
+namespace {
+
+const char* kWorkdir = "/tmp/tpk_test_tune";
+
+std::string ExpPhase(Store& store, const std::string& name) {
+  auto r = store.Get("Experiment", name);
+  return r ? r->status.get("phase").as_string() : "<gone>";
+}
+
+std::string TrialPhase(Store& store, const std::string& name) {
+  auto r = store.Get("Trial", name);
+  return r ? r->status.get("phase").as_string() : "<gone>";
+}
+
+void WriteLog(const std::string& job, const std::string& content) {
+  mkdir(kWorkdir, 0755);
+  std::string dir = std::string(kWorkdir) + "/" + job;
+  mkdir(dir.c_str(), 0755);
+  FILE* f = fopen((dir + "/worker-0.log").c_str(), "w");
+  fwrite(content.data(), 1, content.size(), f);
+  fclose(f);
+}
+
+Json Assignment(double lr) {
+  Json a = Json::Object();
+  a["lr"] = lr;
+  return a;
+}
+
+Json BaseExpSpec(int max_trials, int parallel) {
+  Json spec = Json::Object();
+  Json params = Json::Array();
+  Json lr = Json::Object();
+  lr["name"] = "lr";
+  lr["type"] = "double";
+  lr["min"] = 0.001;
+  lr["max"] = 0.1;
+  params.push_back(lr);
+  spec["parameters"] = params;
+  Json obj = Json::Object();
+  obj["metric"] = "loss";
+  obj["goal"] = "minimize";
+  spec["objective"] = obj;
+  Json algo = Json::Object();
+  algo["name"] = "random";
+  spec["algorithm"] = algo;
+  spec["max_trials"] = max_trials;
+  spec["parallel_trials"] = parallel;
+  Json tmpl = Json::Object();
+  tmpl["replicas"] = 1;
+  tmpl["devices_per_proc"] = 1;
+  Json cmd = Json::Array();
+  cmd.push_back("trainer");
+  cmd.push_back("--lr=${lr}");
+  tmpl["command"] = cmd;
+  spec["trial_template"] = tmpl;
+  return spec;
+}
+
+struct Harness {
+  Store store;
+  Scheduler sched;
+  FakeExecutor exec;
+  FakeSuggestion sugg;
+  JaxJobController jobs{&store, &exec, &sched, kWorkdir};
+  ExperimentController ctl{&store, &sugg, kWorkdir};
+  double now = 1000.0;
+
+  Harness(int capacity = 8) { sched.AddSlice("local", capacity); }
+
+  // Emulates main.cc's loop: tune tick + jaxjob reconciles + delete routing.
+  void Settle(int rounds = 8) {
+    for (int i = 0; i < rounds; ++i) {
+      std::vector<std::string> dirty;
+      int w = store.Watch("", [&](const tpk::WatchEvent& ev) {
+        if (ev.type == tpk::WatchEvent::Type::kDeleted) {
+          if (ev.resource.kind == "JAXJob") {
+            jobs.OnDeleted(ev.resource);
+          } else {
+            ctl.OnDeleted(ev.resource);
+          }
+        } else if (ev.resource.kind == "JAXJob") {
+          dirty.push_back(ev.resource.name);
+        }
+      });
+      jobs.Tick(now);
+      ctl.Tick(now);
+      store.DrainWatches();
+      for (const auto& d : dirty) jobs.Reconcile(d);
+      store.DrainWatches();
+      store.Unwatch(w);
+    }
+  }
+};
+
+}  // namespace
+
+int main() {
+  // --- Substitution: ${p}, ${trialParameters.p}, ${trialName}, typing ---
+  {
+    Json params = Json::Object();
+    params["lr"] = 0.003;
+    params["opt"] = "adam";
+    params["layers"] = 4;
+    Json tmpl = Json::Object();
+    tmpl["a"] = "--lr=${lr} --opt=${trialParameters.opt}";
+    tmpl["b"] = "${lr}";             // whole-token: stays a number
+    tmpl["c"] = "${trialName}";
+    tmpl["d"] = "${unknown} stays";
+    Json arr = Json::Array();
+    arr.push_back("n=${layers}");
+    tmpl["e"] = arr;
+    Json out = ExperimentController::Substitute(tmpl, params, "exp-0");
+    CHECK(out.get("a").as_string() == "--lr=0.003 --opt=adam");
+    CHECK(out.get("b").is_number() && out.get("b").as_number() == 0.003);
+    CHECK(out.get("c").as_string() == "exp-0");
+    CHECK(out.get("d").as_string() == "${unknown} stays");
+    CHECK(out.get("e").elements()[0].as_string() == "n=4");
+  }
+
+  // --- Metric parsing: JSONL + stdout-regex fallback, word boundaries ---
+  {
+    std::string log =
+        "{\"step\": 1, \"loss\": 0.9, \"tokens_per_sec\": 100}\n"
+        "garbage line\n"
+        "{\"step\": 2, \"loss\": 0.5}\n"
+        "epoch done: val_loss=0.44 loss=0.40\n"
+        "not_my_loss=9.9\n";
+    auto obs = ExperimentController::ParseMetrics(log, "loss");
+    CHECK(obs.size() == 3);
+    CHECK(obs[0].first == 1 && obs[0].second == 0.9);
+    CHECK(obs[1].first == 2 && obs[1].second == 0.5);
+    CHECK(obs[2].second == 0.40);  // `loss=0.40`, not val_loss / not_my_loss
+    auto val = ExperimentController::ParseMetrics(log, "val_loss");
+    CHECK(val.size() == 1 && val[0].second == 0.44);
+  }
+
+  // --- Happy path: parallelism cap, trials run, optimal tracked --------
+  {
+    Harness h;
+    h.sugg.queue = {Assignment(0.01), Assignment(0.02), Assignment(0.03)};
+    h.store.Create("Experiment", "opt", BaseExpSpec(3, 2));
+    h.Settle();
+    CHECK(ExpPhase(h.store, "opt") == "Running");
+    // Parallelism 2: only two trials (and their jobs) exist so far.
+    CHECK(h.store.List("Trial").size() == 2);
+    CHECK(TrialPhase(h.store, "opt-0") == "Running");
+    // Substituted command reached the executor.
+    CHECK(h.exec.launched.size() == 2);
+    CHECK(h.exec.launched[0].argv[1] == "--lr=0.01");
+
+    // Trial 0 finishes well, trial 1 poorly.
+    WriteLog("opt-0", "{\"step\": 1, \"loss\": 0.30}\n");
+    h.exec.Finish("opt-0/0", 0);
+    WriteLog("opt-1", "{\"step\": 1, \"loss\": 0.80}\n");
+    h.exec.Finish("opt-1/0", 0);
+    h.Settle();
+    CHECK(TrialPhase(h.store, "opt-0") == "Succeeded");
+    // Third trial was launched after capacity freed.
+    CHECK(h.store.List("Trial").size() == 3);
+    WriteLog("opt-2", "{\"step\": 1, \"loss\": 0.50}\n");
+    h.exec.Finish("opt-2/0", 0);
+    h.Settle();
+
+    CHECK(ExpPhase(h.store, "opt") == "Succeeded");
+    auto exp = h.store.Get("Experiment", "opt");
+    CHECK(exp->status.get("optimal").get("trial").as_string() == "opt-0");
+    CHECK(exp->status.get("optimal").get("value").as_number() == 0.30);
+    CHECK(exp->status.get("trials").get("succeeded").as_int() == 3);
+    CHECK(h.ctl.metrics().experiments_succeeded == 1);
+    CHECK(h.ctl.metrics().trials_created == 3);
+  }
+
+  // --- Goal reached: stops early, kills the in-flight trial -------------
+  {
+    Harness h;
+    h.sugg.queue = {Assignment(0.01), Assignment(0.02), Assignment(0.03),
+                    Assignment(0.04)};
+    Json spec = BaseExpSpec(4, 2);
+    spec["objective"]["target"] = 0.2;
+    h.store.Create("Experiment", "goal", spec);
+    h.Settle();
+    WriteLog("goal-0", "loss=0.15\n");  // beats target via regex path
+    h.exec.Finish("goal-0/0", 0);
+    h.Settle();
+    CHECK(ExpPhase(h.store, "goal") == "Succeeded");
+    auto exp = h.store.Get("Experiment", "goal");
+    CHECK(exp->status.get("conditions")
+              .elements()
+              .back()
+              .get("reason")
+              .as_string() == "GoalReached");
+    // In-flight trial 1 was stopped and its job deleted.
+    CHECK(TrialPhase(h.store, "goal-1") == "Stopped");
+    CHECK(!h.store.Get("JAXJob", "goal-1").has_value());
+    // Only 2 trials ever created (no new ones after goal).
+    CHECK(h.store.List("Trial").size() == 2);
+  }
+
+  // --- Failure budget: trials fail → experiment Failed ------------------
+  {
+    Harness h;
+    h.sugg.queue = {Assignment(0.01), Assignment(0.02), Assignment(0.03),
+                    Assignment(0.04)};
+    Json spec = BaseExpSpec(4, 1);
+    spec["max_failed_trials"] = 1;
+    h.store.Create("Experiment", "bad", spec);
+    h.Settle();
+    h.exec.Finish("bad-0/0", 1);  // job fails (Never not set → OnFailure
+    h.Settle();                   // default backoff 3... use spec override)
+    // Default restart policy retries; exhaust backoff.
+    for (int i = 0; i < 4; ++i) {
+      h.exec.Finish("bad-0/0", 1);
+      h.Settle();
+    }
+    CHECK(TrialPhase(h.store, "bad-0") == "Failed");
+    h.Settle();
+    h.exec.Finish("bad-1/0", 1);
+    h.Settle();
+    for (int i = 0; i < 4; ++i) {
+      h.exec.Finish("bad-1/0", 1);
+      h.Settle();
+    }
+    CHECK(ExpPhase(h.store, "bad") == "Failed");
+    CHECK(h.ctl.metrics().experiments_failed == 1);
+  }
+
+  // --- Missing metric in log → trial Failed (MetricsUnavailable) --------
+  {
+    Harness h;
+    h.sugg.queue = {Assignment(0.01)};
+    h.store.Create("Experiment", "nometric", BaseExpSpec(1, 1));
+    h.Settle();
+    WriteLog("nometric-0", "training finished, no metrics emitted\n");
+    h.exec.Finish("nometric-0/0", 0);
+    h.Settle();
+    CHECK(TrialPhase(h.store, "nometric-0") == "Failed");
+  }
+
+  // --- Suggestion failure: backoff + retry, error surfaced --------------
+  {
+    Harness h;
+    h.sugg.fail_next = true;
+    h.sugg.queue = {Assignment(0.01)};
+    h.store.Create("Experiment", "flaky", BaseExpSpec(1, 1));
+    h.Settle(1);
+    CHECK(ExpPhase(h.store, "flaky") == "Running");
+    auto exp = h.store.Get("Experiment", "flaky");
+    CHECK(!exp->status.get("suggestionError").as_string().empty());
+    CHECK(h.ctl.metrics().suggestion_errors == 1);
+    int calls = h.sugg.calls;
+    h.Settle();  // same timestamp: retry suppressed by backoff
+    CHECK(h.sugg.calls == calls);
+    CHECK(h.store.List("Trial").empty());
+    h.now += 5;  // past the backoff window → retry succeeds
+    h.Settle();
+    CHECK(h.store.List("Trial").size() == 1);
+  }
+
+  // --- Persistent suggestion failure → experiment Failed ----------------
+  {
+    Harness h;
+    h.store.Create("Experiment", "dead", BaseExpSpec(2, 1));
+    for (int i = 0; i < 6; ++i) {
+      h.sugg.fail_next = true;
+      h.Settle(1);
+      h.now += 60;  // clear any backoff window
+    }
+    CHECK(ExpPhase(h.store, "dead") == "Failed");
+    auto exp = h.store.Get("Experiment", "dead");
+    CHECK(exp->status.get("conditions")
+              .elements()
+              .back()
+              .get("reason")
+              .as_string() == "SuggestionUnavailable");
+    CHECK(h.ctl.metrics().experiments_failed == 1);
+  }
+
+  // --- Grid exhaustion: fewer suggestions than budget → Succeeded -------
+  {
+    Harness h;
+    h.sugg.queue = {Assignment(0.01)};  // only one point "in the grid"
+    h.store.Create("Experiment", "grid", BaseExpSpec(10, 2));
+    h.Settle();
+    CHECK(h.store.List("Trial").size() == 1);
+    WriteLog("grid-0", "loss=0.5\n");
+    h.exec.Finish("grid-0/0", 0);
+    h.Settle();
+    CHECK(ExpPhase(h.store, "grid") == "Succeeded");
+    auto exp = h.store.Get("Experiment", "grid");
+    CHECK(exp->status.get("conditions")
+              .elements()
+              .back()
+              .get("reason")
+              .as_string() == "SearchSpaceExhausted");
+  }
+
+  // --- Medianstop: running trial worse than median gets stopped ---------
+  {
+    Harness h;
+    h.sugg.queue = {Assignment(0.01), Assignment(0.02), Assignment(0.03),
+                    Assignment(0.04)};
+    Json spec = BaseExpSpec(4, 4);
+    Json es = Json::Object();
+    es["algorithm"] = "medianstop";
+    es["min_trials"] = 3;
+    es["start_step"] = 2;
+    spec["early_stopping"] = es;
+    h.store.Create("Experiment", "estop", spec);
+    h.Settle();
+    CHECK(h.store.List("Trial").size() == 4);
+    for (int i = 0; i < 3; ++i) {
+      std::string t = "estop-" + std::to_string(i);
+      WriteLog(t, "loss=0.3\n");
+      h.exec.Finish(t + "/0", 0);
+    }
+    // Trial 3 reports much worse intermediate values over >= start_step.
+    WriteLog("estop-3",
+             "{\"step\": 1, \"loss\": 2.0}\n{\"step\": 2, \"loss\": 1.9}\n");
+    h.Settle();
+    CHECK(TrialPhase(h.store, "estop-3") == "EarlyStopped");
+    CHECK(!h.store.Get("JAXJob", "estop-3").has_value());  // job deleted
+    CHECK(h.ctl.metrics().trials_early_stopped == 1);
+    // EarlyStopped still carries its observation; experiment completes.
+    auto t3 = h.store.Get("Trial", "estop-3");
+    CHECK(t3->status.get("observation").get("value").as_number() == 1.9);
+    h.Settle();
+    CHECK(ExpPhase(h.store, "estop") == "Succeeded");
+  }
+
+  // --- Experiment delete cascades: trials + jobs GC'd, gang killed ------
+  {
+    Harness h;
+    h.sugg.queue = {Assignment(0.01), Assignment(0.02)};
+    h.store.Create("Experiment", "gc", BaseExpSpec(2, 2));
+    h.Settle();
+    CHECK(h.store.List("Trial").size() == 2);
+    CHECK(h.sched.Slices()[0].used == 2);
+
+    h.store.Delete("Experiment", "gc");
+    h.Settle();
+    CHECK(h.store.List("Trial").empty());
+    CHECK(!h.store.Get("JAXJob", "gc-0").has_value());
+    CHECK(h.exec.killed.size() == 2);       // gangs killed
+    CHECK(h.sched.Slices()[0].used == 0);   // devices released
+  }
+
+  printf("test_tune OK\n");
+  return 0;
+}

@@ -1,0 +1,793 @@
+#include "tune.h"
+
+#include <fcntl.h>
+#include <poll.h>
+#include <signal.h>
+#include <sys/stat.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <chrono>
+#include <cmath>
+#include <cstdio>
+#include <cstring>
+#include <ctime>
+
+namespace tpk {
+
+namespace {
+
+double NowWall() { return static_cast<double>(time(nullptr)); }
+
+std::string Timestamp(double now_s) {
+  char buf[32];
+  time_t t = static_cast<time_t>(now_s ? now_s : NowWall());
+  struct tm tmv;
+  gmtime_r(&t, &tmv);
+  strftime(buf, sizeof(buf), "%Y-%m-%dT%H:%M:%SZ", &tmv);
+  return buf;
+}
+
+bool IsTerminalExp(const std::string& phase) {
+  return phase == "Succeeded" || phase == "Failed";
+}
+
+bool IsTerminalTrial(const std::string& phase) {
+  return phase == "Succeeded" || phase == "Failed" ||
+         phase == "EarlyStopped" || phase == "Stopped";
+}
+
+std::string FormatParam(const Json& v) {
+  if (v.is_string()) return v.as_string();
+  if (v.is_bool()) return v.as_bool() ? "true" : "false";
+  if (v.is_number()) {
+    char buf[64];
+    snprintf(buf, sizeof(buf), "%.10g", v.as_number());
+    return buf;
+  }
+  return v.dump();
+}
+
+// value at a string position: is this a word boundary?
+bool IsWordChar(char c) {
+  return isalnum(static_cast<unsigned char>(c)) || c == '_';
+}
+
+}  // namespace
+
+// --------------------------------------------------------------------------
+// Template substitution
+// --------------------------------------------------------------------------
+
+Json ExperimentController::Substitute(const Json& tmpl, const Json& params,
+                                      const std::string& trial_name) {
+  if (tmpl.is_string()) {
+    const std::string& s = tmpl.as_string();
+    // Whole-string token keeps the parameter's JSON type: {"lr": "${lr}"}
+    // becomes a number in the materialized job spec.
+    if (s.size() > 3 && s.compare(0, 2, "${") == 0 && s.back() == '}' &&
+        s.find("${", 2) == std::string::npos) {
+      std::string key = s.substr(2, s.size() - 3);
+      if (key.rfind("trialParameters.", 0) == 0) key = key.substr(16);
+      if (key == "trialName") return Json(trial_name);
+      if (params.has(key)) return params.get(key);
+    }
+    std::string out;
+    size_t pos = 0;
+    while (pos < s.size()) {
+      size_t open = s.find("${", pos);
+      if (open == std::string::npos) {
+        out.append(s, pos, std::string::npos);
+        break;
+      }
+      size_t close = s.find('}', open + 2);
+      if (close == std::string::npos) {
+        out.append(s, pos, std::string::npos);
+        break;
+      }
+      out.append(s, pos, open - pos);
+      std::string key = s.substr(open + 2, close - open - 2);
+      if (key.rfind("trialParameters.", 0) == 0) key = key.substr(16);
+      if (key == "trialName") {
+        out += trial_name;
+      } else if (params.has(key)) {
+        out += FormatParam(params.get(key));
+      } else {
+        // Unknown token stays visible — easier to debug than silent "".
+        out.append(s, open, close - open + 1);
+      }
+      pos = close + 1;
+    }
+    return Json(out);
+  }
+  if (tmpl.is_array()) {
+    Json arr = Json::Array();
+    for (const auto& e : tmpl.elements()) {
+      arr.push_back(Substitute(e, params, trial_name));
+    }
+    return arr;
+  }
+  if (tmpl.is_object()) {
+    Json obj = Json::Object();
+    for (const auto& [k, v] : tmpl.items()) {
+      obj[k] = Substitute(v, params, trial_name);
+    }
+    return obj;
+  }
+  return tmpl;
+}
+
+// --------------------------------------------------------------------------
+// Metric extraction (the metrics-collector stand-in)
+// --------------------------------------------------------------------------
+
+std::vector<std::pair<double, double>> ExperimentController::ParseMetrics(
+    const std::string& log_text, const std::string& metric) {
+  std::vector<std::pair<double, double>> out;
+  size_t pos = 0;
+  double seq = 0;
+  while (pos < log_text.size()) {
+    size_t nl = log_text.find('\n', pos);
+    if (nl == std::string::npos) nl = log_text.size();
+    std::string line = log_text.substr(pos, nl - pos);
+    pos = nl + 1;
+    if (line.empty()) continue;
+
+    size_t first = line.find_first_not_of(" \t");
+    if (first != std::string::npos && line[first] == '{') {
+      // JSONL path: the runtime's step-metrics records.
+      try {
+        Json rec = Json::parse(line.substr(first));
+        if (rec.is_object() && rec.has(metric) &&
+            rec.get(metric).is_number()) {
+          double step = rec.get("step").is_number()
+                            ? rec.get("step").as_number()
+                            : seq;
+          out.emplace_back(step, rec.get(metric).as_number());
+          seq += 1;
+          continue;
+        }
+      } catch (const std::exception&) {
+        // fall through to the text scan
+      }
+    }
+    // stdout-regex fallback: `metric = value` (Katib StdOut collector).
+    size_t at = 0;
+    while ((at = line.find(metric, at)) != std::string::npos) {
+      size_t end = at + metric.size();
+      bool lb = at == 0 || !IsWordChar(line[at - 1]);
+      if (!lb || (end < line.size() && IsWordChar(line[end]))) {
+        at = end;
+        continue;
+      }
+      size_t q = end;
+      while (q < line.size() && (line[q] == ' ' || line[q] == '\t')) ++q;
+      if (q < line.size() && line[q] == '=') {
+        ++q;
+        while (q < line.size() && (line[q] == ' ' || line[q] == '\t')) ++q;
+        char* endp = nullptr;
+        double v = strtod(line.c_str() + q, &endp);
+        if (endp && endp != line.c_str() + q) {
+          out.emplace_back(seq, v);
+          seq += 1;
+          break;  // one observation per line
+        }
+      }
+      at = end;
+    }
+  }
+  return out;
+}
+
+std::string ExperimentController::ReadWorkerLog(
+    const std::string& job_name) const {
+  std::string path = workdir_ + "/" + job_name + "/worker-0.log";
+  FILE* f = fopen(path.c_str(), "r");
+  if (!f) return "";
+  constexpr long kMax = 4 << 20;
+  fseek(f, 0, SEEK_END);
+  long size = ftell(f);
+  long start = size > kMax ? size - kMax : 0;
+  fseek(f, start, SEEK_SET);
+  std::string content(size - start, '\0');
+  size_t got = fread(content.data(), 1, content.size(), f);
+  content.resize(got);
+  fclose(f);
+  return content;
+}
+
+double ExperimentController::ObjectiveValue(
+    const std::vector<std::pair<double, double>>& obs, const Json& objective,
+    bool* ok) const {
+  if (obs.empty()) {
+    *ok = false;
+    return 0;
+  }
+  *ok = true;
+  const std::string goal = objective.get("goal").as_string().empty()
+                               ? "minimize"
+                               : objective.get("goal").as_string();
+  std::string strategy = objective.get("strategy").as_string();
+  if (strategy.empty()) strategy = goal == "maximize" ? "max" : "min";
+  if (strategy == "latest") return obs.back().second;
+  double best = obs[0].second;
+  for (const auto& [step, v] : obs) {
+    if (strategy == "max" ? v > best : v < best) best = v;
+  }
+  return best;
+}
+
+// --------------------------------------------------------------------------
+// Controller
+// --------------------------------------------------------------------------
+
+ExperimentController::ExperimentController(Store* store,
+                                           SuggestionInterface* suggestion,
+                                           std::string workdir)
+    : store_(store),
+      suggestion_(suggestion),
+      workdir_(std::move(workdir)) {}
+
+void ExperimentController::SetPhase(Json* status, const std::string& phase,
+                                    const std::string& reason,
+                                    const std::string& message) {
+  const std::string prev = status->get("phase").as_string();
+  (*status)["phase"] = phase;
+  if (!status->has("conditions")) (*status)["conditions"] = Json::Array();
+  if (prev != phase) {
+    Json cond = Json::Object();
+    cond["type"] = phase;
+    cond["status"] = "True";
+    cond["reason"] = reason;
+    cond["message"] = message;
+    cond["lastTransitionTime"] = Timestamp(now_s_);
+    (*status)["conditions"].push_back(cond);
+  }
+}
+
+void ExperimentController::ReconcileTrial(const Json& exp_spec,
+                                          const std::string& exp_name,
+                                          const Resource& trial) {
+  (void)exp_name;
+  Json status = trial.status;
+  const std::string phase = status.get("phase").as_string();
+  if (IsTerminalTrial(phase)) return;
+
+  auto job = store_->Get("JAXJob", trial.name);
+  if (!job) {
+    if (phase.empty()) {
+      // Materialize the child job (idempotent: keyed by trial name).
+      auto r = store_->Create("JAXJob", trial.name,
+                              trial.spec.get("job_spec"));
+      if (!r.ok) {
+        SetPhase(&status, "Failed", "JobCreateFailed", r.error);
+      } else {
+        SetPhase(&status, "Running", "JobCreated", "child JAXJob created");
+      }
+    } else {
+      SetPhase(&status, "Failed", "JobMissing",
+               "child JAXJob disappeared");
+    }
+    store_->UpdateStatus("Trial", trial.name, status);
+    return;
+  }
+
+  const Json& objective = exp_spec.get("objective");
+  const std::string metric = objective.get("metric").as_string();
+  const std::string jphase = job->status.get("phase").as_string();
+
+  if (jphase == "Succeeded") {
+    auto obs = ParseMetrics(ReadWorkerLog(trial.name), metric);
+    bool ok = false;
+    double value = ObjectiveValue(obs, objective, &ok);
+    if (!ok) {
+      SetPhase(&status, "Failed", "MetricsUnavailable",
+               "objective metric '" + metric + "' not found in worker log");
+    } else {
+      Json observation = Json::Object();
+      observation["metric"] = metric;
+      observation["value"] = value;
+      status["observation"] = observation;
+      SetPhase(&status, "Succeeded", "JobSucceeded", "observation recorded");
+    }
+  } else if (jphase == "Failed") {
+    SetPhase(&status, "Failed", "JobFailed",
+             "child JAXJob failed: " + jphase);
+  } else {
+    // Running: refresh intermediate history for early stopping — but only
+    // when the log actually grew (this path runs every event-loop pass).
+    struct stat st;
+    std::string log_path = workdir_ + "/" + trial.name + "/worker-0.log";
+    long size = stat(log_path.c_str(), &st) == 0 ? st.st_size : 0;
+    auto seen = log_size_seen_.find(trial.name);
+    bool grew = seen == log_size_seen_.end() || seen->second != size;
+    if (grew) log_size_seen_[trial.name] = size;
+    auto obs = grew ? ParseMetrics(ReadWorkerLog(trial.name), metric)
+                    : std::vector<std::pair<double, double>>{};
+    size_t prev = status.get("history").is_array()
+                      ? status.get("history").size()
+                      : 0;
+    if (grew && obs.size() != prev) {
+      Json hist = Json::Array();
+      size_t start = obs.size() > 256 ? obs.size() - 256 : 0;
+      for (size_t i = start; i < obs.size(); ++i) {
+        Json pt = Json::Array();
+        pt.push_back(obs[i].first);
+        pt.push_back(obs[i].second);
+        hist.push_back(pt);
+      }
+      status["history"] = hist;
+    }
+    if (phase.empty()) {
+      SetPhase(&status, "Running", "JobCreated", "child JAXJob created");
+    }
+  }
+  if (IsTerminalTrial(status.get("phase").as_string())) {
+    log_size_seen_.erase(trial.name);
+  }
+  if (status.dump() != trial.status.dump()) {
+    store_->UpdateStatus("Trial", trial.name, status);
+  }
+}
+
+void ExperimentController::MaybeEarlyStop(
+    const Json& exp_spec, const std::string& exp_name,
+    const std::vector<Resource>& trials) {
+  (void)exp_name;
+  const Json& es = exp_spec.get("early_stopping");
+  if (!es.is_object()) return;
+  const std::string algo = es.get("algorithm").as_string();
+  if (!algo.empty() && algo != "medianstop") return;
+  int64_t min_trials = es.get("min_trials").as_int(3);
+  int64_t start_step = es.get("start_step").as_int(5);
+
+  const Json& objective = exp_spec.get("objective");
+  const std::string goal = objective.get("goal").as_string().empty()
+                               ? "minimize"
+                               : objective.get("goal").as_string();
+  const bool maximize = goal == "maximize";
+
+  std::vector<double> done;
+  for (const auto& t : trials) {
+    if (t.status.get("phase").as_string() == "Succeeded" &&
+        t.status.get("observation").is_object()) {
+      done.push_back(t.status.get("observation").get("value").as_number());
+    }
+  }
+  if (done.empty() || static_cast<int64_t>(done.size()) < min_trials) return;
+  std::sort(done.begin(), done.end());
+  double median = done[done.size() / 2];
+
+  for (const auto& stale : trials) {
+    // Re-fetch: ReconcileTrial ran in this same pass and may have just
+    // moved the trial to Succeeded — deciding on the captured snapshot
+    // would clobber that transition with a blind EarlyStopped overwrite.
+    auto cur = store_->Get("Trial", stale.name);
+    if (!cur) continue;
+    const Resource& t = *cur;
+    if (t.status.get("phase").as_string() != "Running") continue;
+    const Json& hist = t.status.get("history");
+    if (!hist.is_array() || hist.size() == 0 ||
+        static_cast<int64_t>(hist.size()) < start_step) {
+      continue;
+    }
+    double best = hist.elements()[0].elements()[1].as_number();
+    for (const auto& pt : hist.elements()) {
+      double v = pt.elements()[1].as_number();
+      if (maximize ? v > best : v < best) best = v;
+    }
+    const bool worse = maximize ? best < median : best > median;
+    if (!worse) continue;
+
+    store_->Delete("JAXJob", t.name);  // watch → gang killed
+    log_size_seen_.erase(t.name);
+    Json status = t.status;
+    Json observation = Json::Object();
+    observation["metric"] = objective.get("metric").as_string();
+    observation["value"] = best;
+    status["observation"] = observation;
+    SetPhase(&status, "EarlyStopped", "MedianStop",
+             "best-so-far worse than median of completed trials");
+    store_->UpdateStatus("Trial", t.name, status);
+    metrics_.trials_early_stopped++;
+  }
+}
+
+void ExperimentController::Reconcile(const std::string& name) {
+  auto res = store_->Get("Experiment", name);
+  if (!res || res->deleted) return;
+  Json spec = res->spec;
+  Json status = res->status;
+  const std::string phase = status.get("phase").as_string();
+  if (IsTerminalExp(phase)) return;
+
+  if (phase.empty()) {
+    metrics_.experiments_created++;
+    SetPhase(&status, "Created", "ExperimentCreated", "accepted");
+  }
+
+  // Gather this experiment's trials, ordered by index.
+  std::vector<Resource> trials;
+  for (const auto& t : store_->List("Trial")) {
+    if (t.spec.get("experiment").as_string() == name) trials.push_back(t);
+  }
+  std::sort(trials.begin(), trials.end(),
+            [](const Resource& a, const Resource& b) {
+              return a.spec.get("index").as_int() <
+                     b.spec.get("index").as_int();
+            });
+
+  for (const auto& t : trials) ReconcileTrial(spec, name, t);
+  MaybeEarlyStop(spec, name, trials);
+
+  // Re-read post-reconcile state and count.
+  Counts c;
+  int64_t max_index = -1;
+  Json trial_history = Json::Array();
+  std::string best_trial;
+  Json best_params;
+  double best_value = 0;
+  bool have_best = false;
+  const Json& objective = spec.get("objective");
+  const bool maximize = objective.get("goal").as_string() == "maximize";
+
+  for (auto& t : trials) {
+    auto fresh = store_->Get("Trial", t.name);
+    if (fresh) t = *fresh;
+    c.created++;
+    max_index = std::max(max_index, t.spec.get("index").as_int());
+    const std::string tp = t.status.get("phase").as_string();
+    if (tp == "Succeeded") {
+      c.succeeded++;
+    } else if (tp == "Failed") {
+      c.failed++;
+    } else if (tp == "EarlyStopped") {
+      c.early_stopped++;
+    } else if (tp == "Stopped") {
+      // killed at experiment completion; counts only as created
+    } else {
+      c.active++;
+    }
+
+    Json h = Json::Object();
+    h["params"] = t.spec.get("params");
+    h["status"] = tp;
+    if (t.status.get("observation").is_object()) {
+      double v = t.status.get("observation").get("value").as_number();
+      h["value"] = v;
+      if (!have_best || (maximize ? v > best_value : v < best_value)) {
+        have_best = true;
+        best_value = v;
+        best_trial = t.name;
+        best_params = t.spec.get("params");
+      }
+    }
+    trial_history.push_back(h);
+  }
+
+  Json tc = Json::Object();
+  tc["created"] = c.created;
+  tc["succeeded"] = c.succeeded;
+  tc["failed"] = c.failed;
+  tc["earlyStopped"] = c.early_stopped;
+  tc["running"] = c.active;
+  status["trials"] = tc;
+  if (have_best) {
+    Json opt = Json::Object();
+    opt["trial"] = best_trial;
+    opt["params"] = best_params;
+    opt["value"] = best_value;
+    status["optimal"] = opt;
+  }
+
+  auto stop_active = [&]() {
+    for (const auto& t : trials) {
+      const std::string tp = t.status.get("phase").as_string();
+      if (IsTerminalTrial(tp)) continue;
+      store_->Delete("JAXJob", t.name);
+      log_size_seen_.erase(t.name);
+      Json ts = t.status;
+      SetPhase(&ts, "Stopped", "ExperimentCompleted",
+               "experiment reached a terminal phase");
+      store_->UpdateStatus("Trial", t.name, ts);
+    }
+  };
+
+  int64_t max_trials = spec.get("max_trials").as_int(10);
+  int64_t parallel = spec.get("parallel_trials").as_int(1);
+  int64_t max_failed = spec.get("max_failed_trials").as_int(3);
+  double target = objective.get("target").as_number(NAN);
+
+  // 1) Goal reached?
+  if (have_best && !std::isnan(target) &&
+      (maximize ? best_value >= target : best_value <= target)) {
+    stop_active();
+    SetPhase(&status, "Succeeded", "GoalReached",
+             "objective target met by " + best_trial);
+    metrics_.experiments_succeeded++;
+    store_->UpdateStatus("Experiment", name, status);
+    return;
+  }
+  // 2) Failure budget blown?
+  if (max_failed >= 0 && c.failed > max_failed) {
+    stop_active();
+    SetPhase(&status, "Failed", "MaxFailedTrialsReached",
+             std::to_string(c.failed) + " trials failed");
+    metrics_.experiments_failed++;
+    store_->UpdateStatus("Experiment", name, status);
+    return;
+  }
+  // 3) Budget exhausted and everything settled?
+  bool exhausted = status.get("searchSpaceExhausted").as_bool(false);
+  if ((c.created >= max_trials || exhausted) && c.active == 0) {
+    if (have_best) {
+      SetPhase(&status, "Succeeded", exhausted ? "SearchSpaceExhausted"
+                                               : "MaxTrialsReached",
+               "best value " + FormatParam(Json(best_value)));
+      metrics_.experiments_succeeded++;
+    } else {
+      SetPhase(&status, "Failed", "NoObservations",
+               "no trial produced an observation");
+      metrics_.experiments_failed++;
+    }
+    store_->UpdateStatus("Experiment", name, status);
+    return;
+  }
+
+  // 4) Spawn more trials up to the parallelism cap.
+  int64_t want = std::min(parallel - c.active,
+                          max_trials - c.created);
+  // Failed suggestion calls retry with exponential backoff (the event loop
+  // reconciles ~20x/s — unbounded retry would fork crash-looping services
+  // at that rate) and fail the experiment after a persistent streak.
+  int64_t sugg_fails = status.get("suggestionFailures").as_int(0);
+  double last_attempt = status.get("lastSuggestionAttempt").as_number(0);
+  double backoff_s = sugg_fails > 0
+                         ? std::min(1 << std::min<int64_t>(sugg_fails, 5),
+                                    30)
+                         : 0;
+  if (want > 0 && !exhausted &&
+      (sugg_fails == 0 || now_s_ >= last_attempt + backoff_s)) {
+    Json assignments;
+    std::string error;
+    if (!suggestion_->GetSuggestions(spec, trial_history,
+                                     static_cast<int>(want), &assignments,
+                                     &error)) {
+      metrics_.suggestion_errors++;
+      status["suggestionError"] = error;
+      status["suggestionFailures"] = sugg_fails + 1;
+      status["lastSuggestionAttempt"] = now_s_;
+      if (sugg_fails + 1 >= 5) {
+        stop_active();
+        SetPhase(&status, "Failed", "SuggestionUnavailable",
+                 "suggestion service failed " +
+                     std::to_string(sugg_fails + 1) + "x: " + error);
+        metrics_.experiments_failed++;
+        store_->UpdateStatus("Experiment", name, status);
+        return;
+      }
+      SetPhase(&status, "Running", "SuggestionFailed", error);
+    } else {
+      if (status.has("suggestionError")) {
+        status["suggestionError"] = Json();
+        status["suggestionFailures"] = 0;
+      }
+      if (assignments.size() == 0) {
+        // Grid (or any finite space) ran dry: stop proposing; completion
+        // is decided above once running trials settle.
+        status["searchSpaceExhausted"] = true;
+      }
+      for (const auto& a : assignments.elements()) {
+        int64_t index = ++max_index;
+        std::string tname = name + "-" + std::to_string(index);
+        Json tspec = Json::Object();
+        tspec["experiment"] = name;
+        tspec["index"] = index;
+        tspec["params"] = a;
+        tspec["job_spec"] =
+            Substitute(spec.get("trial_template"), a, tname);
+        auto r = store_->Create("Trial", tname, tspec);
+        if (r.ok) metrics_.trials_created++;
+      }
+      SetPhase(&status, "Running", "TrialsLaunched", "suggestions applied");
+    }
+  } else if (phase.empty() || phase == "Created") {
+    SetPhase(&status, "Running", "Reconciling", "trials in flight");
+  }
+
+  if (status.dump() != res->status.dump()) {
+    store_->UpdateStatus("Experiment", name, status);
+  }
+}
+
+void ExperimentController::Tick(double now_s) {
+  now_s_ = now_s;
+  for (const auto& res : store_->List("Experiment")) {
+    if (!IsTerminalExp(res.status.get("phase").as_string())) {
+      Reconcile(res.name);
+    }
+  }
+}
+
+void ExperimentController::OnDeleted(const Resource& res) {
+  // Cascade GC (upstream: ownerReferences + apiserver garbage collection).
+  if (res.kind == "Experiment") {
+    for (const auto& t : store_->List("Trial")) {
+      if (t.spec.get("experiment").as_string() != res.name) continue;
+      store_->Delete("JAXJob", t.name);  // watch → gang killed
+      store_->Delete("Trial", t.name);
+    }
+  } else if (res.kind == "Trial") {
+    store_->Delete("JAXJob", res.name);
+    log_size_seen_.erase(res.name);
+  }
+}
+
+// --------------------------------------------------------------------------
+// SubprocessSuggestion
+// --------------------------------------------------------------------------
+
+SubprocessSuggestion::SubprocessSuggestion(std::string python)
+    : python_(std::move(python)) {}
+
+SubprocessSuggestion::~SubprocessSuggestion() { Shutdown(); }
+
+void SubprocessSuggestion::Shutdown() {
+  if (in_fd_ >= 0) {
+    close(in_fd_);
+    in_fd_ = -1;
+  }
+  if (out_fd_ >= 0) {
+    close(out_fd_);
+    out_fd_ = -1;
+  }
+  out_buf_.clear();
+  if (pid_ > 0) {
+    kill(pid_, SIGKILL);  // may be hung; SIGTERM could leave a zombie wait
+    waitpid(pid_, nullptr, 0);
+    pid_ = -1;
+  }
+}
+
+bool SubprocessSuggestion::EnsureRunning(std::string* error) {
+  if (pid_ > 0) {
+    int wstatus = 0;
+    if (waitpid(pid_, &wstatus, WNOHANG) == pid_) {
+      pid_ = -1;  // died; clean up pipes and respawn below
+      if (in_fd_ >= 0) close(in_fd_);
+      in_fd_ = -1;
+      if (out_fd_ >= 0) close(out_fd_);
+      out_fd_ = -1;
+      out_buf_.clear();
+    } else {
+      return true;
+    }
+  }
+  int to_child[2], from_child[2];
+  if (pipe(to_child) != 0) {
+    if (error) *error = std::string("pipe: ") + strerror(errno);
+    return false;
+  }
+  if (pipe(from_child) != 0) {
+    if (error) *error = std::string("pipe: ") + strerror(errno);
+    close(to_child[0]);
+    close(to_child[1]);
+    return false;
+  }
+  pid_t pid = fork();
+  if (pid < 0) {
+    if (error) *error = std::string("fork: ") + strerror(errno);
+    close(to_child[0]);
+    close(to_child[1]);
+    close(from_child[0]);
+    close(from_child[1]);
+    return false;
+  }
+  if (pid == 0) {
+    dup2(to_child[0], 0);
+    dup2(from_child[1], 1);
+    close(to_child[0]);
+    close(to_child[1]);
+    close(from_child[0]);
+    close(from_child[1]);
+    execlp(python_.c_str(), python_.c_str(), "-m",
+           "kubeflow_tpu.tune.service", (char*)nullptr);
+    _exit(127);
+  }
+  close(to_child[0]);
+  close(from_child[1]);
+  pid_ = pid;
+  in_fd_ = to_child[1];
+  out_fd_ = from_child[0];
+  // Non-blocking writes: a wedged child that stops reading stdin must not
+  // block the event loop once the request outgrows the pipe buffer.
+  fcntl(in_fd_, F_SETFL, fcntl(in_fd_, F_GETFL, 0) | O_NONBLOCK);
+  return true;
+}
+
+bool SubprocessSuggestion::GetSuggestions(const Json& experiment_spec,
+                                          const Json& trials, int count,
+                                          Json* assignments,
+                                          std::string* error) {
+  if (!EnsureRunning(error)) return false;
+  Json req = Json::Object();
+  req["op"] = "get_suggestions";
+  Json exp = Json::Object();
+  exp["parameters"] = experiment_spec.get("parameters");
+  exp["objective"] = experiment_spec.get("objective");
+  exp["algorithm"] = experiment_spec.get("algorithm");
+  req["experiment"] = exp;
+  req["trials"] = trials;
+  req["count"] = count;
+  req["seed"] = experiment_spec.get("seed").as_int(0);
+  std::string line = req.dump() + "\n";
+  // Bounded write + read: this runs inside the control plane's only event
+  // loop, so a hung service must not freeze the API server / job reaping —
+  // kill and respawn on deadline instead.
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms_);
+  size_t off = 0;
+  while (off < line.size()) {
+    ssize_t sent = write(in_fd_, line.data() + off, line.size() - off);
+    if (sent > 0) {
+      off += sent;
+      continue;
+    }
+    if (sent < 0 && errno != EAGAIN && errno != EWOULDBLOCK) {
+      Shutdown();
+      if (error) *error = "suggestion service write failed";
+      return false;
+    }
+    auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
+                    deadline - std::chrono::steady_clock::now())
+                    .count();
+    pollfd wfd{in_fd_, POLLOUT, 0};
+    if (left <= 0 || poll(&wfd, 1, static_cast<int>(left)) <= 0) {
+      Shutdown();
+      if (error) *error = "suggestion service timed out (write)";
+      return false;
+    }
+  }
+  std::string resp_line;
+  while (true) {
+    size_t nl = out_buf_.find('\n');
+    if (nl != std::string::npos) {
+      resp_line = out_buf_.substr(0, nl);
+      out_buf_.erase(0, nl + 1);
+      break;
+    }
+    auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
+                    deadline - std::chrono::steady_clock::now())
+                    .count();
+    pollfd pfd{out_fd_, POLLIN, 0};
+    int pr = left <= 0 ? 0 : poll(&pfd, 1, static_cast<int>(left));
+    if (pr <= 0) {
+      Shutdown();
+      if (error) *error = "suggestion service timed out";
+      return false;
+    }
+    char buf[4096];
+    ssize_t got = read(out_fd_, buf, sizeof(buf));
+    if (got <= 0) {
+      Shutdown();
+      if (error) *error = "suggestion service closed (EOF)";
+      return false;
+    }
+    out_buf_.append(buf, got);
+  }
+  try {
+    Json resp = Json::parse(resp_line);
+    if (!resp.get("ok").as_bool(false)) {
+      if (error) *error = resp.get("error").as_string();
+      return false;
+    }
+    *assignments = resp.get("assignments");
+    return true;
+  } catch (const std::exception& e) {
+    if (error) *error = std::string("bad suggestion response: ") + e.what();
+    return false;
+  }
+}
+
+}  // namespace tpk

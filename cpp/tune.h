@@ -1,0 +1,165 @@
+// Experiment/Trial controllers — the Katib-equivalent HPO layer
+// (SURVEY.md §2.3, §3.4, §7.1 item 7).
+//
+// Semantics carried over from the reference's three Go reconcilers:
+//   - ExperimentReconciler (⟨katib: pkg/controller.v1beta1/experiment/⟩):
+//     goal / maxTrials / maxFailedTrials accounting, parallelism cap,
+//     optimal-trial tracking in status.
+//   - SuggestionReconciler (⟨katib: pkg/controller.v1beta1/suggestion/⟩):
+//     here a single shared suggestion service process spoken to over
+//     JSON-lines pipes (the gRPC GetSuggestions contract, different wire).
+//   - TrialReconciler (⟨katib: pkg/controller.v1beta1/trial/⟩): materializes
+//     the trialTemplate with ${param} substitution into a child JAXJob and
+//     harvests the objective metric when it finishes.
+// The metrics-collector sidecar (⟨katib: cmd/metricscollector⟩) collapses
+// into direct log parsing: the runtime emits JSONL step metrics to the
+// worker log, with a `metric=value` stdout-regex fallback for arbitrary
+// user commands — feature parity with the reference's collector kinds.
+// Early stopping implements the medianstop rule
+// (⟨katib: pkg/earlystopping/v1beta1⟩).
+
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <string>
+#include <vector>
+
+#include "json.h"
+#include "store.h"
+
+namespace tpk {
+
+// GetSuggestions(experiment, trials, count) — the api.proto Suggestion
+// service contract.
+class SuggestionInterface {
+ public:
+  virtual ~SuggestionInterface() = default;
+  virtual bool GetSuggestions(const Json& experiment_spec, const Json& trials,
+                              int count, Json* assignments,
+                              std::string* error) = 0;
+};
+
+// Spawns `python -m kubeflow_tpu.tune.service` lazily and speaks
+// newline-delimited JSON over its stdin/stdout. Respawns on EOF/death.
+class SubprocessSuggestion : public SuggestionInterface {
+ public:
+  explicit SubprocessSuggestion(std::string python = "python3");
+  ~SubprocessSuggestion() override;
+  bool GetSuggestions(const Json& experiment_spec, const Json& trials,
+                      int count, Json* assignments,
+                      std::string* error) override;
+
+ private:
+  bool EnsureRunning(std::string* error);
+  void Shutdown();
+
+  std::string python_;
+  int pid_ = -1;
+  int in_fd_ = -1;   // write end of child's stdin
+  int out_fd_ = -1;  // read end of child's stdout
+  std::string out_buf_;
+  int timeout_ms_ = 15000;
+};
+
+// Test double: serves assignments from a queue (the envtest lever).
+class FakeSuggestion : public SuggestionInterface {
+ public:
+  bool GetSuggestions(const Json&, const Json& trials, int count,
+                      Json* assignments, std::string* error) override {
+    ++calls;
+    last_trials = trials;
+    if (fail_next) {
+      fail_next = false;
+      if (error) *error = "fake: suggestion failure injected";
+      return false;
+    }
+    *assignments = Json::Array();
+    for (int i = 0; i < count && !queue.empty(); ++i) {
+      assignments->push_back(queue.front());
+      queue.erase(queue.begin());
+    }
+    return true;
+  }
+  std::vector<Json> queue;
+  Json last_trials;
+  int calls = 0;
+  bool fail_next = false;
+};
+
+struct TuneMetrics {
+  int64_t experiments_created = 0;
+  int64_t experiments_succeeded = 0;
+  int64_t experiments_failed = 0;
+  int64_t trials_created = 0;
+  int64_t trials_early_stopped = 0;
+  int64_t suggestion_errors = 0;
+
+  Json ToJson() const {
+    Json j = Json::Object();
+    j["experiments_created"] = experiments_created;
+    j["experiments_succeeded"] = experiments_succeeded;
+    j["experiments_failed"] = experiments_failed;
+    j["trials_created"] = trials_created;
+    j["trials_early_stopped"] = trials_early_stopped;
+    j["suggestion_errors"] = suggestion_errors;
+    return j;
+  }
+};
+
+class ExperimentController {
+ public:
+  ExperimentController(Store* store, SuggestionInterface* suggestion,
+                       std::string workdir);
+
+  // Level-triggered reconcile of one experiment. Safe to call repeatedly.
+  void Reconcile(const std::string& name);
+
+  // Reconciles every non-terminal experiment (driven from the event loop;
+  // trial/job state changes are picked up level-style each pass).
+  void Tick(double now_s);
+
+  // Watch hook for kDeleted events on Experiment/Trial: cascades deletion
+  // to child Trials and JAXJobs (upstream: ownerReferences + apiserver GC).
+  void OnDeleted(const Resource& res);
+
+  TuneMetrics& metrics() { return metrics_; }
+
+  // ${param} / ${trialParameters.param} / ${trialName} substitution over
+  // every string in a JSON template. Exposed for tests.
+  static Json Substitute(const Json& tmpl, const Json& params,
+                         const std::string& trial_name);
+
+  // Parses (step, value) observations for `metric` out of a worker log:
+  // JSONL objects with the metric as a key, else `metric=value` text.
+  // Exposed for tests.
+  static std::vector<std::pair<double, double>> ParseMetrics(
+      const std::string& log_text, const std::string& metric);
+
+ private:
+  struct Counts {
+    int created = 0, succeeded = 0, failed = 0, early_stopped = 0,
+        active = 0;
+  };
+
+  void ReconcileTrial(const Json& exp_spec, const std::string& exp_name,
+                      const Resource& trial);
+  void MaybeEarlyStop(const Json& exp_spec, const std::string& exp_name,
+                      const std::vector<Resource>& trials);
+  std::string ReadWorkerLog(const std::string& job_name) const;
+  double ObjectiveValue(const std::vector<std::pair<double, double>>& obs,
+                        const Json& objective, bool* ok) const;
+  void SetPhase(Json* status, const std::string& phase,
+                const std::string& reason, const std::string& message);
+
+  Store* store_;
+  SuggestionInterface* suggestion_;
+  std::string workdir_;
+  TuneMetrics metrics_;
+  double now_s_ = 0;
+  // Per-job log size at last parse: the event loop reconciles ~20x/s and
+  // worker logs reach MBs — only re-parse when the file has grown.
+  std::map<std::string, long> log_size_seen_;
+};
+
+}  // namespace tpk

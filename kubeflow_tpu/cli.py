@@ -63,7 +63,7 @@ def cmd_submit(args) -> int:
     c.create(kind, name, spec)
     print(f"{kind}/{name} created")
     if args.wait:
-        phase = c.wait_for_phase(name, timeout=args.timeout)
+        phase = c.wait_for_phase(name, timeout=args.timeout, kind=kind)
         print(f"{kind}/{name} {phase}")
         return 0 if phase == "Succeeded" else 1
     return 0
@@ -73,6 +73,7 @@ def _kind_alias(kind: str) -> str:
     aliases = {"job": "JAXJob", "jobs": "JAXJob", "jaxjob": "JAXJob",
                "inferenceservice": "InferenceService", "isvc": "InferenceService",
                "experiment": "Experiment", "experiments": "Experiment",
+               "trial": "Trial", "trials": "Trial",
                "pipeline": "Pipeline", "pipelines": "Pipeline",
                "run": "PipelineRun", "runs": "PipelineRun"}
     return aliases.get(kind.lower(), kind)

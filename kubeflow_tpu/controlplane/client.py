@@ -117,22 +117,23 @@ class Client:
     def submit_jaxjob(self, name: str, spec: dict) -> dict:
         return self.create("JAXJob", name, spec)
 
-    def phase(self, name: str) -> str:
-        return self.get("JAXJob", name).get("status", {}).get("phase", "")
+    def phase(self, name: str, kind: str = "JAXJob") -> str:
+        return self.get(kind, name).get("status", {}).get("phase", "")
 
     def wait_for_phase(self, name: str, phases=("Succeeded", "Failed"),
-                       timeout: float = 300.0, poll: float = 0.5) -> str:
-        """Blocks until the job reaches one of `phases` (like
+                       timeout: float = 300.0, poll: float = 0.5,
+                       kind: str = "JAXJob") -> str:
+        """Blocks until the resource reaches one of `phases` (like
         TrainingClient.wait_for_job_conditions)."""
         deadline = time.time() + timeout
         while time.time() < deadline:
-            p = self.phase(name)
+            p = self.phase(name, kind)
             if p in phases:
                 return p
             time.sleep(poll)
         raise TimeoutError(
-            f"job {name} did not reach {phases} in {timeout}s "
-            f"(last phase: {self.phase(name)!r})")
+            f"{kind} {name} did not reach {phases} in {timeout}s "
+            f"(last phase: {self.phase(name, kind)!r})")
 
     def stream_metrics(self, name: str, replica: int = 0) -> Iterator[dict]:
         """Parses the worker's JSONL metric lines from its log."""

@@ -1,0 +1,19 @@
+"""Hyperparameter tuning — the Katib-equivalent subsystem (SURVEY.md §2.3).
+
+Layout:
+  algorithms.py — random / grid / TPE search (suggestion algorithms)
+  service.py    — suggestion service the C++ control plane spawns
+  sdk.py        — ExperimentClient + tune() convenience (KatibClient parity)
+
+The Experiment/Trial reconcilers live in the C++ control plane
+(cpp/tune.cc), mirroring the reference's Go controllers.
+"""
+
+from kubeflow_tpu.tune.algorithms import (  # noqa: F401
+    ALGORITHMS,
+    AlgorithmError,
+    suggest,
+    suggest_grid,
+    suggest_random,
+    suggest_tpe,
+)

@@ -1,0 +1,264 @@
+"""Hyperparameter search algorithms — the Katib suggestion-service algorithms.
+
+Reimplements the reference's suggestion algorithms natively (⟨katib:
+pkg/suggestion/v1beta1/⟩, SURVEY.md §2.3): `random`, `grid`, and `tpe`
+(Tree-structured Parzen Estimator — the reference wraps hyperopt's TPE for
+its "Bayesian" configs; hyperopt is not installed here, so TPE is
+implemented directly from the Bergstra et al. 2011 recipe).
+
+Parameter space schema (Experiment.spec.parameters):
+    {"name": "lr",     "type": "double", "min": 1e-5, "max": 1e-1, "log": true}
+    {"name": "layers", "type": "int",    "min": 1,    "max": 8,   "step": 2}
+    {"name": "opt",    "type": "categorical", "values": ["adam", "sgd"]}
+
+History entries (one per observed trial):
+    {"params": {"lr": 3e-4, ...}, "value": 0.92, "status": "Succeeded"}
+
+All algorithms are pure functions of (parameters, history, count, seed):
+stateless between calls, like the reference's GetSuggestions(experiment,
+trials) contract — the full trial history rides in each request.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+import random as _random
+from typing import Any, Sequence
+
+
+class AlgorithmError(ValueError):
+    pass
+
+
+def _check_space(parameters: Sequence[dict]) -> None:
+    if not parameters:
+        raise AlgorithmError("experiment has no parameters")
+    for p in parameters:
+        name, typ = p.get("name"), p.get("type", "double")
+        if not name:
+            raise AlgorithmError(f"parameter missing name: {p}")
+        if typ in ("double", "int"):
+            if "min" not in p or "max" not in p:
+                raise AlgorithmError(f"{name}: {typ} needs min/max")
+            if p["max"] < p["min"]:
+                raise AlgorithmError(f"{name}: max < min")
+            if p.get("log") and p["min"] <= 0:
+                raise AlgorithmError(f"{name}: log scale needs min > 0")
+        elif typ == "categorical":
+            if not p.get("values"):
+                raise AlgorithmError(f"{name}: categorical needs values")
+        else:
+            raise AlgorithmError(f"{name}: unknown type {typ!r}")
+
+
+def _sample_param(p: dict, rng: _random.Random) -> Any:
+    typ = p.get("type", "double")
+    if typ == "categorical":
+        return rng.choice(p["values"])
+    lo, hi = p["min"], p["max"]
+    if p.get("log"):
+        v = math.exp(rng.uniform(math.log(lo), math.log(hi)))
+    else:
+        v = rng.uniform(lo, hi)
+    if typ == "int":
+        step = int(p.get("step", 1) or 1)
+        k = round((v - int(lo)) / step)
+        return min(max(int(lo) + step * k, int(lo)), int(hi))
+    return v
+
+
+def _key(assignment: dict) -> tuple:
+    return tuple(sorted((k, repr(v)) for k, v in assignment.items()))
+
+
+def suggest_random(parameters: Sequence[dict], history: Sequence[dict],
+                   count: int, seed: int = 0, settings: dict | None = None,
+                   ) -> list[dict]:
+    """Uniform (log-uniform where marked) independent sampling; avoids
+    re-proposing assignments already in the history when it can."""
+    _check_space(parameters)
+    rng = _random.Random(f"{seed}:{len(history)}")
+    seen = {_key(h.get("params", {})) for h in history}
+    out: list[dict] = []
+    for _ in range(count):
+        for _attempt in range(20):
+            a = {p["name"]: _sample_param(p, rng) for p in parameters}
+            if _key(a) not in seen:
+                break
+        seen.add(_key(a))
+        out.append(a)
+    return out
+
+
+def _grid_axis(p: dict) -> list:
+    typ = p.get("type", "double")
+    if typ == "categorical":
+        return list(p["values"])
+    lo, hi = p["min"], p["max"]
+    if typ == "int":
+        step = int(p.get("step", 1) or 1)
+        return list(range(int(lo), int(hi) + 1, step))
+    # double axis: explicit step, else `num` points (default 5), log-aware.
+    if p.get("step"):
+        n = int(math.floor((hi - lo) / p["step"] + 1e-9)) + 1
+        return [lo + i * p["step"] for i in range(n)]
+    num = int(p.get("num", 5))
+    if num == 1:
+        return [lo]
+    if p.get("log"):
+        llo, lhi = math.log(lo), math.log(hi)
+        return [math.exp(llo + i * (lhi - llo) / (num - 1)) for i in range(num)]
+    return [lo + i * (hi - lo) / (num - 1) for i in range(num)]
+
+
+def suggest_grid(parameters: Sequence[dict], history: Sequence[dict],
+                 count: int, seed: int = 0, settings: dict | None = None,
+                 ) -> list[dict]:
+    """Cartesian-product sweep in deterministic order, resuming past the
+    points already tried. Returns fewer than `count` when the grid is
+    exhausted (the experiment controller treats that as 'space done')."""
+    _check_space(parameters)
+    names = [p["name"] for p in parameters]
+    axes = [_grid_axis(p) for p in parameters]
+    seen = {_key(h.get("params", {})) for h in history}
+    out: list[dict] = []
+    for combo in itertools.product(*axes):
+        if len(out) >= count:
+            break
+        a = dict(zip(names, combo))
+        if _key(a) in seen:
+            continue
+        seen.add(_key(a))
+        out.append(a)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# TPE (Bergstra et al., "Algorithms for Hyper-Parameter Optimization", 2011).
+# Split observations at the γ-quantile into good/bad sets, model each with a
+# 1-d Parzen (kernel-density) mixture per parameter, sample candidates from
+# the good model l(x), and keep those maximizing l(x)/g(x) — equivalent to
+# maximizing Expected Improvement under the two-density model.
+# ---------------------------------------------------------------------------
+
+def _to_unit(p: dict, v: Any) -> float:
+    """Map a double/int value into [0,1] (log-aware) for density modeling."""
+    lo, hi = p["min"], p["max"]
+    if p.get("log"):
+        lo, hi, v = math.log(lo), math.log(hi), math.log(max(v, 1e-300))
+    return 0.5 if hi == lo else (v - lo) / (hi - lo)
+
+
+def _from_unit(p: dict, u: float) -> Any:
+    lo, hi = p["min"], p["max"]
+    u = min(max(u, 0.0), 1.0)
+    if p.get("log"):
+        v = math.exp(math.log(lo) + u * (math.log(hi) - math.log(lo)))
+    else:
+        v = lo + u * (hi - lo)
+    if p.get("type") == "int":
+        step = int(p.get("step", 1) or 1)
+        v = int(lo) + step * round((v - int(lo)) / step)
+        v = min(max(v, int(lo)), int(hi))
+    return v
+
+
+def _parzen_sample(xs: list[float], rng: _random.Random) -> float:
+    """Draw from a mixture of gaussians centered at `xs` (unit scale) with a
+    Scott-style bandwidth, plus one wide prior component for exploration."""
+    bw = max(1.0 / max(len(xs), 1) ** 0.5 * 0.5, 0.05)
+    i = rng.randrange(len(xs) + 1)
+    if i == len(xs):  # prior component: uniform-ish wide gaussian
+        return rng.gauss(0.5, 0.5)
+    return rng.gauss(xs[i], bw)
+
+
+def _parzen_logpdf(xs: list[float], x: float) -> float:
+    bw = max(1.0 / max(len(xs), 1) ** 0.5 * 0.5, 0.05)
+    comps = [math.exp(-0.5 * ((x - c) / bw) ** 2) / bw for c in xs]
+    comps.append(math.exp(-0.5 * ((x - 0.5) / 0.5) ** 2) / 0.5)  # prior
+    dens = sum(comps) / (len(xs) + 1) / math.sqrt(2 * math.pi)
+    return math.log(max(dens, 1e-300))
+
+
+def _cat_probs(values: list, obs: list, smooth: float = 1.0) -> list[float]:
+    counts = [smooth + sum(1 for o in obs if o == v) for v in values]
+    total = sum(counts)
+    return [c / total for c in counts]
+
+
+def suggest_tpe(parameters: Sequence[dict], history: Sequence[dict],
+                count: int, seed: int = 0, settings: dict | None = None,
+                ) -> list[dict]:
+    _check_space(parameters)
+    s = settings or {}
+    gamma = float(s.get("gamma", 0.25))
+    n_candidates = int(s.get("n_candidates", 24))
+    n_startup = int(s.get("n_startup", 8))
+    goal = s.get("goal", "minimize")
+
+    obs = [h for h in history
+           if h.get("value") is not None and h.get("params")]
+    if len(obs) < n_startup:
+        return suggest_random(parameters, history, count, seed, settings)
+
+    sign = -1.0 if goal == "maximize" else 1.0
+    ranked = sorted(obs, key=lambda h: sign * float(h["value"]))
+    n_good = max(1, int(math.ceil(gamma * len(ranked))))
+    good, bad = ranked[:n_good], ranked[n_good:] or ranked[-1:]
+
+    rng = _random.Random(f"{seed}:{len(history)}:tpe")
+    seen = {_key(h.get("params", {})) for h in history}
+    out: list[dict] = []
+    for _ in range(count):
+        best_a, best_score = None, -math.inf
+        for _c in range(n_candidates):
+            a, score = {}, 0.0
+            for p in parameters:
+                name = p["name"]
+                if p.get("type") == "categorical":
+                    values = p["values"]
+                    pg = _cat_probs(values, [h["params"].get(name)
+                                             for h in good])
+                    pb = _cat_probs(values, [h["params"].get(name)
+                                             for h in bad])
+                    idx = rng.choices(range(len(values)), weights=pg)[0]
+                    a[name] = values[idx]
+                    score += math.log(pg[idx]) - math.log(pb[idx])
+                else:
+                    gx = [_to_unit(p, h["params"][name]) for h in good
+                          if name in h["params"]]
+                    bx = [_to_unit(p, h["params"][name]) for h in bad
+                          if name in h["params"]]
+                    u = _parzen_sample(gx or [0.5], rng)
+                    a[name] = _from_unit(p, u)
+                    u_eff = _to_unit(p, a[name])  # score what we'll run
+                    score += (_parzen_logpdf(gx or [0.5], u_eff)
+                              - _parzen_logpdf(bx or [0.5], u_eff))
+            if score > best_score and _key(a) not in seen:
+                best_a, best_score = a, score
+        if best_a is None:  # every candidate was a duplicate
+            best_a = suggest_random(parameters, history, 1,
+                                    seed + len(out) + 1, settings)[0]
+        seen.add(_key(best_a))
+        out.append(best_a)
+    return out
+
+
+ALGORITHMS = {
+    "random": suggest_random,
+    "grid": suggest_grid,
+    "tpe": suggest_tpe,
+    "bayesian": suggest_tpe,  # reference's "Bayesian" configs use TPE
+}
+
+
+def suggest(algorithm: str, parameters: Sequence[dict],
+            history: Sequence[dict], count: int, seed: int = 0,
+            settings: dict | None = None) -> list[dict]:
+    fn = ALGORITHMS.get(algorithm)
+    if fn is None:
+        raise AlgorithmError(
+            f"unknown algorithm {algorithm!r}; have {sorted(ALGORITHMS)}")
+    return fn(parameters, history, count, seed=seed, settings=settings)

@@ -1,0 +1,128 @@
+"""Tuning SDK — KatibClient parity (⟨katib: sdk/python — KatibClient,
+tune()⟩, SURVEY.md §2.3/§3.4).
+
+`TuneClient` wraps the control-plane client with Experiment conveniences;
+`TuneClient.tune()` reproduces the reference's `tune()` UX: hand it a plain
+Python function and a search space, and it fabricates the Experiment —
+the function's source is packaged into the trial command with parameters
+substituted by the C++ trial controller (the reference packages the
+function into a container image; here the "image" is a `python -c` stanza).
+"""
+
+from __future__ import annotations
+
+import inspect
+import textwrap
+import time
+from typing import Any, Callable, Sequence
+
+from kubeflow_tpu.controlplane.client import Client
+
+
+class TuneClient:
+    def __init__(self, client: Client):
+        self.client = client
+
+    # -- CRUD conveniences ---------------------------------------------------
+
+    def create_experiment(self, name: str, *, parameters: Sequence[dict],
+                          objective: dict, algorithm: dict | str = "random",
+                          trial_template: dict, max_trials: int = 10,
+                          parallel_trials: int = 1,
+                          max_failed_trials: int = 3,
+                          early_stopping: dict | None = None,
+                          seed: int = 0) -> dict:
+        if isinstance(algorithm, str):
+            algorithm = {"name": algorithm}
+        spec = {
+            "parameters": list(parameters),
+            "objective": objective,
+            "algorithm": algorithm,
+            "trial_template": trial_template,
+            "max_trials": max_trials,
+            "parallel_trials": parallel_trials,
+            "max_failed_trials": max_failed_trials,
+            "seed": seed,
+        }
+        if early_stopping:
+            spec["early_stopping"] = early_stopping
+        return self.client.create("Experiment", name, spec)
+
+    def get(self, name: str) -> dict:
+        return self.client.get("Experiment", name)
+
+    def trials(self, name: str) -> list[dict]:
+        return [t for t in self.client.list("Trial")
+                if t["spec"].get("experiment") == name]
+
+    def wait(self, name: str, timeout: float = 600.0,
+             poll: float = 0.5) -> str:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            phase = self.get(name).get("status", {}).get("phase", "")
+            if phase in ("Succeeded", "Failed"):
+                return phase
+            time.sleep(poll)
+        raise TimeoutError(f"experiment {name} still "
+                           f"{self.get(name).get('status', {}).get('phase')} "
+                           f"after {timeout}s")
+
+    def optimal_trial(self, name: str) -> dict:
+        """{'trial': ..., 'params': {...}, 'value': ...} of the best trial."""
+        opt = self.get(name).get("status", {}).get("optimal")
+        if not opt:
+            raise RuntimeError(f"experiment {name} has no observations yet")
+        return opt
+
+    # -- tune(): python function → Experiment --------------------------------
+
+    def tune(self, name: str, objective_fn: Callable[[dict], Any], *,
+             parameters: Sequence[dict], metric: str = "objective",
+             goal: str = "minimize", target: float | None = None,
+             algorithm: dict | str = "tpe", max_trials: int = 10,
+             parallel_trials: int = 1, seed: int = 0,
+             python: str = "python3") -> dict:
+        """Wraps `objective_fn(params) -> float | dict` into an Experiment.
+
+        The function must be self-contained (its own imports inside the
+        body), mirroring the reference tune()'s packaging constraint. It
+        receives the parameter dict and returns the objective value (or a
+        dict of metrics including `metric`); trial workers print
+        `metric=value` lines the trial controller's collector parses.
+        """
+        source = textwrap.dedent(inspect.getsource(objective_fn))
+        if objective_fn.__name__ == "<lambda>":
+            raise ValueError("objective_fn must be a named function")
+        # Typed parameter literal: numbers stay bare so the dict is valid
+        # python after ${...} substitution; categoricals are quoted.
+        items = []
+        for p in parameters:
+            key = p["name"]
+            token = "${%s}" % key
+            if p.get("type") == "categorical":
+                items.append(f'"{key}": "{token}"')
+            else:
+                items.append(f'"{key}": {token}')
+        params_literal = "{" + ", ".join(items) + "}"
+        runner = "\n".join([
+            source,
+            f"params = {params_literal}",
+            f"result = {objective_fn.__name__}(params)",
+            "metrics = result if isinstance(result, dict) else "
+            f"{{{metric!r}: result}}",
+            "for k, v in metrics.items():",
+            "    print(f\"{k}={v}\", flush=True)",
+        ])
+        objective = {"metric": metric, "goal": goal}
+        if target is not None:
+            objective["target"] = target
+        trial_template = {
+            "replicas": 1,
+            "devices_per_proc": 1,
+            "command": [python, "-c", runner],
+        }
+        return self.create_experiment(
+            name, parameters=parameters, objective=objective,
+            algorithm=algorithm, trial_template=trial_template,
+            max_trials=max_trials, parallel_trials=parallel_trials,
+            seed=seed)

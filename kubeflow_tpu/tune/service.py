@@ -1,0 +1,71 @@
+"""Suggestion service — JSON-lines over stdin/stdout.
+
+The reference deploys one gRPC suggestion service per experiment and the
+experiment controller calls `GetSuggestions(experiment, trials)` on it
+(⟨katib: pkg/controller.v1beta1/suggestion/⟩ + ⟨pkg/apis/manager/v1beta1 —
+api.proto Suggestion service⟩, SURVEY.md §3.4). Here the C++ control plane
+spawns ONE shared service process and speaks the same request shape over
+pipes — newline-delimited JSON instead of gRPC (grpc C++ is not in the
+toolchain; the transport is an implementation detail of the same contract).
+
+Request:
+    {"op": "get_suggestions",
+     "experiment": {"parameters": [...], "objective": {...},
+                    "algorithm": {"name": "tpe", "settings": {...}}},
+     "trials": [{"params": {...}, "value": 0.91, "status": "Succeeded"}],
+     "count": 2, "seed": 7}
+Response:
+    {"ok": true, "assignments": [{"lr": 0.003, "opt": "adam"}, ...]}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+from kubeflow_tpu.tune.algorithms import AlgorithmError, suggest
+
+
+def handle(req: dict) -> dict:
+    op = req.get("op")
+    if op == "ping":
+        return {"ok": True, "pong": True}
+    if op != "get_suggestions":
+        return {"ok": False, "error": f"unknown op: {op!r}"}
+    exp = req.get("experiment") or {}
+    algo = exp.get("algorithm") or {}
+    objective = exp.get("objective") or {}
+    settings = dict(algo.get("settings") or {})
+    # TPE needs the optimization direction; carry it from the objective.
+    settings.setdefault("goal", objective.get("goal", "minimize"))
+    try:
+        assignments = suggest(
+            algo.get("name", "random"),
+            exp.get("parameters") or [],
+            req.get("trials") or [],
+            int(req.get("count", 1)),
+            seed=int(req.get("seed", 0)),
+            settings=settings,
+        )
+    except AlgorithmError as e:
+        return {"ok": False, "error": str(e)}
+    return {"ok": True, "assignments": assignments}
+
+
+def main() -> int:
+    # Line-buffered loop; EOF on stdin = controller went away, exit cleanly.
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            resp = handle(json.loads(line))
+        except Exception as e:  # never kill the service on one bad request
+            resp = {"ok": False, "error": f"bad request: {e}"}
+        sys.stdout.write(json.dumps(resp) + "\n")
+        sys.stdout.flush()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,111 @@
+"""Tune e2e (eval config 4 analog, CPU-sized): a TPE Experiment driven
+through the real C++ control plane — real suggestion-service subprocess,
+real trial worker processes — optimizing a known quadratic. The kind-cluster
+Katib e2e pattern (⟨katib: test/e2e/v1beta1⟩, SURVEY.md §4.5) without
+containers."""
+
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BIN = os.path.join(REPO, "build", "tpk-controlplane")
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(BIN), reason="tpk-controlplane not built")
+
+
+@pytest.fixture()
+def controlplane(tmp_path):
+    from kubeflow_tpu.controlplane.client import Client, start_controlplane
+
+    sock = str(tmp_path / "tpk.sock")
+    workdir = str(tmp_path / "work")
+    env_backup = dict(os.environ)
+    os.environ["TPK_CONTROLPLANE_BIN"] = BIN
+    # Suggestion service + trial commands resolve kubeflow_tpu from here.
+    os.environ["PYTHONPATH"] = REPO + os.pathsep + env_backup.get(
+        "PYTHONPATH", "")
+    proc = start_controlplane(sock, workdir, slices="local=8")
+    client = Client(sock)
+    try:
+        yield client
+    finally:
+        client.close()
+        proc.terminate()
+        proc.wait(timeout=10)
+        os.environ.clear()
+        os.environ.update(env_backup)
+
+
+def quadratic(params):
+    import math
+
+    lr = params["lr"]
+    depth = params["depth"]
+    return (math.log10(lr) + 2) ** 2 + 0.1 * (depth - 4) ** 2
+
+
+def test_tpe_experiment_end_to_end(controlplane):
+    from kubeflow_tpu.tune.sdk import TuneClient
+
+    tc = TuneClient(controlplane)
+    tc.tune(
+        "quad", quadratic,
+        parameters=[
+            {"name": "lr", "type": "double", "min": 1e-4, "max": 1.0,
+             "log": True},
+            {"name": "depth", "type": "int", "min": 1, "max": 8},
+        ],
+        metric="objective", goal="minimize",
+        algorithm={"name": "tpe", "settings": {"n_startup": 3}},
+        max_trials=6, parallel_trials=2, seed=7,
+        python=sys.executable)
+
+    phase = tc.wait("quad", timeout=180)
+    exp = tc.get("quad")
+    assert phase == "Succeeded", exp
+
+    status = exp["status"]
+    assert status["trials"]["created"] == 6
+    assert status["trials"]["succeeded"] == 6
+
+    # Optimal is tracked and equals the best trial's recomputable value.
+    opt = tc.optimal_trial("quad")
+    assert opt["value"] == pytest.approx(quadratic(opt["params"]), rel=1e-6)
+    values = []
+    for t in tc.trials("quad"):
+        obs = t["status"]["observation"]
+        assert obs["metric"] == "objective"
+        values.append(obs["value"])
+    assert opt["value"] == pytest.approx(min(values))
+
+    # Controller metrics surfaced through the API server.
+    m = controlplane.metrics()["tune"]
+    assert m["experiments_succeeded"] == 1
+    assert m["trials_created"] == 6
+
+
+def test_goal_target_stops_early(controlplane):
+    from kubeflow_tpu.tune.sdk import TuneClient
+
+    tc = TuneClient(controlplane)
+    # Target is trivially reachable → experiment must stop well before
+    # max_trials and report GoalReached.
+    tc.tune(
+        "easy", quadratic,
+        parameters=[
+            {"name": "lr", "type": "double", "min": 1e-3, "max": 1e-1,
+             "log": True},
+            {"name": "depth", "type": "int", "min": 3, "max": 5},
+        ],
+        metric="objective", goal="minimize", target=5.0,
+        algorithm="random", max_trials=50, parallel_trials=1, seed=3,
+        python=sys.executable)
+    phase = tc.wait("easy", timeout=120)
+    exp = tc.get("easy")
+    assert phase == "Succeeded", exp
+    reasons = [c["reason"] for c in exp["status"]["conditions"]]
+    assert "GoalReached" in reasons
+    assert exp["status"]["trials"]["created"] < 50
