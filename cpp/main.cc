@@ -16,6 +16,7 @@
 
 #include "executor.h"
 #include "jaxjob.h"
+#include "pipelines.h"
 #include "scheduler.h"
 #include "server.h"
 #include "store.h"
@@ -77,8 +78,11 @@ int main(int argc, char** argv) {
   jaxjob.Recover();
   tpk::SubprocessSuggestion suggestion(python);
   tpk::ExperimentController tune(&store, &suggestion, workdir);
+  tpk::LineageStore lineage(workdir + "/lineage.jsonl");
+  int lineage_records = lineage.Load();
+  tpk::PipelineRunController pipelines(&store, &lineage, workdir, python);
   tpk::Server server(&store, &scheduler, &jaxjob, socket_path, workdir,
-                     &tune);
+                     &tune, &pipelines);
 
   std::string error;
   if (!server.Start(&error)) {
@@ -88,8 +92,9 @@ int main(int argc, char** argv) {
   }
   fprintf(stderr,
           "tpk-controlplane: listening on %s (workdir=%s, %d WAL records, "
-          "%zu slices)\n",
-          socket_path.c_str(), workdir.c_str(), replayed, slices.size());
+          "%d lineage records, %zu slices)\n",
+          socket_path.c_str(), workdir.c_str(), replayed, lineage_records,
+          slices.size());
 
   // Watch: any JAXJob change → reconcile (informer-style edge trigger).
   // Deletes are handled inline: the resource is already gone from the
@@ -109,6 +114,11 @@ int main(int argc, char** argv) {
   store.Watch("Trial", [&tune](const tpk::WatchEvent& ev) {
     if (ev.type == tpk::WatchEvent::Type::kDeleted) tune.OnDeleted(ev.resource);
   });
+  store.Watch("PipelineRun", [&pipelines](const tpk::WatchEvent& ev) {
+    if (ev.type == tpk::WatchEvent::Type::kDeleted) {
+      pipelines.OnDeleted(ev.resource);
+    }
+  });
 
   while (!g_stop) {
     server.PollOnce(50);
@@ -118,8 +128,9 @@ int main(int argc, char** argv) {
     double now = static_cast<double>(time(nullptr));
     jaxjob.Tick(now);
     tune.Tick(now);
-    // Tune's writes (trial JAXJob create/delete) need a jaxjob pass before
-    // the next poll so child gangs launch/die promptly.
+    pipelines.Tick(now);
+    // Tune/pipeline writes (child JAXJob create/delete) need a jaxjob pass
+    // before the next poll so child gangs launch/die promptly.
     store.DrainWatches();
     for (const auto& name : dirty) jaxjob.Reconcile(name);
     dirty.clear();

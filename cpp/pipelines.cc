@@ -1,0 +1,584 @@
+#include "pipelines.h"
+
+#include <dirent.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+
+#include <algorithm>
+#include <cstdio>
+#include <cstring>
+#include <ctime>
+
+#include "sha256.h"
+
+namespace tpk {
+
+namespace {
+
+double NowWall() { return static_cast<double>(time(nullptr)); }
+
+std::string Timestamp(double now_s) {
+  char buf[32];
+  time_t t = static_cast<time_t>(now_s ? now_s : NowWall());
+  struct tm tmv;
+  gmtime_r(&t, &tmv);
+  strftime(buf, sizeof(buf), "%Y-%m-%dT%H:%M:%SZ", &tmv);
+  return buf;
+}
+
+bool IsTerminalRun(const std::string& phase) {
+  return phase == "Succeeded" || phase == "Failed";
+}
+
+bool TaskDone(const std::string& phase) {
+  return phase == "Succeeded" || phase == "Cached";
+}
+
+void MkdirP(const std::string& path) {
+  std::string cur;
+  for (size_t i = 0; i <= path.size(); ++i) {
+    if (i == path.size() || path[i] == '/') {
+      if (!cur.empty()) mkdir(cur.c_str(), 0755);
+      if (i < path.size()) cur += '/';
+    } else {
+      cur += path[i];
+    }
+  }
+}
+
+bool WriteFile(const std::string& path, const std::string& content) {
+  FILE* f = fopen(path.c_str(), "w");
+  if (!f) return false;
+  fwrite(content.data(), 1, content.size(), f);
+  fclose(f);
+  return true;
+}
+
+void ListDirSorted(const std::string& dir, const std::string& rel,
+                   std::vector<std::string>* out) {
+  DIR* d = opendir(dir.c_str());
+  if (!d) return;
+  std::vector<std::string> names;
+  while (struct dirent* e = readdir(d)) {
+    std::string n = e->d_name;
+    if (n == "." || n == "..") continue;
+    names.push_back(n);
+  }
+  closedir(d);
+  std::sort(names.begin(), names.end());
+  for (const auto& n : names) {
+    std::string full = dir + "/" + n;
+    std::string r = rel.empty() ? n : rel + "/" + n;
+    struct stat st;
+    if (stat(full.c_str(), &st) == 0 && S_ISDIR(st.st_mode)) {
+      ListDirSorted(full, r, out);
+    } else {
+      out->push_back(r);
+    }
+  }
+}
+
+}  // namespace
+
+// --------------------------------------------------------------------------
+// LineageStore
+// --------------------------------------------------------------------------
+
+LineageStore::LineageStore(std::string path) : path_(std::move(path)) {}
+
+LineageStore::~LineageStore() {
+  if (file_) fclose(file_);
+}
+
+int LineageStore::Load() {
+  if (path_.empty()) return 0;
+  int applied = 0;
+  FILE* f = fopen(path_.c_str(), "r");
+  if (f) {
+    char* line = nullptr;
+    size_t cap = 0;
+    ssize_t got;
+    while ((got = getline(&line, &cap, f)) > 0) {
+      try {
+        Json rec = Json::parse(std::string(line, got));
+        const std::string fp = rec.get("fingerprint").as_string();
+        if (!fp.empty()) {
+          by_fp_[fp] = rec;
+          ++applied;
+        }
+      } catch (const std::exception&) {
+        // torn tail write (crash mid-append): ignore, like the WAL replay
+      }
+    }
+    free(line);
+    fclose(f);
+  }
+  file_ = fopen(path_.c_str(), "a");
+  return applied;
+}
+
+void LineageStore::Record(const std::string& fingerprint,
+                          const std::string& run, const std::string& task,
+                          const Json& outputs) {
+  Json rec = Json::Object();
+  rec["fingerprint"] = fingerprint;
+  rec["run"] = run;
+  rec["task"] = task;
+  rec["outputs"] = outputs;
+  rec["ts"] = NowWall();
+  by_fp_[fingerprint] = rec;
+  if (!path_.empty() && !file_) file_ = fopen(path_.c_str(), "a");
+  if (file_) {
+    std::string line = rec.dump() + "\n";
+    fwrite(line.data(), 1, line.size(), file_);
+    fflush(file_);
+  }
+}
+
+Json LineageStore::Lookup(const std::string& fingerprint) const {
+  auto it = by_fp_.find(fingerprint);
+  return it == by_fp_.end() ? Json() : it->second;
+}
+
+// --------------------------------------------------------------------------
+// PipelineRunController
+// --------------------------------------------------------------------------
+
+PipelineRunController::PipelineRunController(Store* store,
+                                             LineageStore* lineage,
+                                             std::string workdir,
+                                             std::string python)
+    : store_(store),
+      lineage_(lineage),
+      workdir_(std::move(workdir)),
+      python_(std::move(python)) {
+  MkdirP(workdir_);
+}
+
+std::string PipelineRunController::DirDigest(const std::string& dir) {
+  struct stat st;
+  if (stat(dir.c_str(), &st) != 0 || !S_ISDIR(st.st_mode)) return "";
+  std::vector<std::string> files;
+  ListDirSorted(dir, "", &files);
+  Sha256 h;
+  for (const auto& rel : files) {
+    // Unambiguous framing: name (NUL-free by construction) + NUL + 8-byte
+    // content length + content. Plain separators would let crafted file
+    // bytes alias a different tree and poison the step cache.
+    h.Update(rel);
+    h.Update("\0", 1);
+    std::string path = dir + "/" + rel;
+    struct stat fs;
+    uint64_t size = stat(path.c_str(), &fs) == 0
+                        ? static_cast<uint64_t>(fs.st_size)
+                        : 0;
+    uint8_t lenb[8];
+    for (int i = 0; i < 8; ++i) lenb[i] = (size >> (56 - 8 * i)) & 0xff;
+    h.Update(lenb, 8);
+    FILE* f = fopen(path.c_str(), "rb");
+    if (!f) continue;
+    char buf[65536];
+    size_t got;
+    while ((got = fread(buf, 1, sizeof(buf), f)) > 0) h.Update(buf, got);
+    fclose(f);
+  }
+  return h.HexDigest();
+}
+
+std::vector<std::string> PipelineRunController::TaskDeps(const Json& task) {
+  std::vector<std::string> deps;
+  for (const auto& d : task.get("depends_on").elements()) {
+    deps.push_back(d.as_string());
+  }
+  for (const auto& [k, arg] : task.get("arguments").items()) {
+    (void)k;
+    if (arg.is_object() && arg.has("task")) {
+      deps.push_back(arg.get("task").as_string());
+    }
+  }
+  std::sort(deps.begin(), deps.end());
+  deps.erase(std::unique(deps.begin(), deps.end()), deps.end());
+  return deps;
+}
+
+bool PipelineRunController::ResolveIR(const Resource& res, RunView* run,
+                                      std::string* error) {
+  Json ir;
+  if (run->status.get("pipelineSnapshot").is_object()) {
+    // Frozen at first reconcile: editing the named Pipeline mid-run must
+    // not desync the task list from status.tasks.
+    ir = run->status.get("pipelineSnapshot");
+  } else if (res.spec.get("pipeline_spec").is_object()) {
+    ir = res.spec.get("pipeline_spec");
+  } else {
+    const std::string pname = res.spec.get("pipeline").as_string();
+    if (pname.empty()) {
+      *error = "spec needs `pipeline` (name) or inline `pipeline_spec`";
+      return false;
+    }
+    auto p = store_->Get("Pipeline", pname);
+    if (!p) {
+      *error = "pipeline not found: " + pname;
+      return false;
+    }
+    ir = p->spec;
+  }
+  if (!ir.get("tasks").is_object() || ir.get("tasks").size() == 0) {
+    *error = "pipeline IR has no tasks";
+    return false;
+  }
+  Json params = Json::Object();
+  for (const auto& [k, v] : ir.get("params").items()) params[k] = v;
+  for (const auto& [k, v] : res.spec.get("params").items()) {
+    if (!params.has(k)) {
+      *error = "unknown pipeline param override: " + k;
+      return false;
+    }
+    params[k] = v;
+  }
+  run->ir = ir;
+  run->params = params;
+  return true;
+}
+
+bool PipelineRunController::ValidateDag(const Json& tasks,
+                                        std::string* error) const {
+  // Existence + cycle check (iterative DFS, colors: 0 white 1 gray 2 black).
+  std::map<std::string, int> color;
+  for (const auto& [name, task] : tasks.items()) {
+    (void)task;
+    color[name] = 0;
+  }
+  for (const auto& [name, task] : tasks.items()) {
+    for (const auto& d : TaskDeps(task)) {
+      if (!tasks.has(d)) {
+        *error = "task `" + name + "` depends on unknown task `" + d + "`";
+        return false;
+      }
+    }
+  }
+  std::vector<std::pair<std::string, size_t>> stack;
+  for (const auto& [root, task] : tasks.items()) {
+    (void)task;
+    if (color[root] != 0) continue;
+    stack.push_back({root, 0});
+    color[root] = 1;
+    while (!stack.empty()) {
+      auto& [name, idx] = stack.back();
+      auto deps = TaskDeps(tasks.get(name));
+      if (idx < deps.size()) {
+        std::string next = deps[idx++];
+        if (color[next] == 1) {
+          *error = "dependency cycle through `" + next + "`";
+          return false;
+        }
+        if (color[next] == 0) {
+          color[next] = 1;
+          stack.push_back({next, 0});
+        }
+      } else {
+        color[name] = 2;
+        stack.pop_back();
+      }
+    }
+  }
+  return true;
+}
+
+void PipelineRunController::SetPhase(Json* status, const std::string& phase,
+                                     const std::string& reason,
+                                     const std::string& message) {
+  const std::string prev = status->get("phase").as_string();
+  (*status)["phase"] = phase;
+  if (!status->has("conditions")) (*status)["conditions"] = Json::Array();
+  if (prev != phase) {
+    Json cond = Json::Object();
+    cond["type"] = phase;
+    cond["status"] = "True";
+    cond["reason"] = reason;
+    cond["message"] = message;
+    cond["lastTransitionTime"] = Timestamp(now_s_);
+    (*status)["conditions"].push_back(cond);
+  }
+}
+
+void PipelineRunController::LaunchTask(RunView& run, const std::string& tname,
+                                       const Json& task) {
+  const std::string& rname = run.res.name;
+  const Json& comp = task.get("component");
+  Json tstatus = run.status.get("tasks").get(tname);
+
+  // Resolve arguments → params + input artifact paths/digests.
+  Json params = Json::Object();
+  Json inputs = Json::Object();
+  Json input_digests = Json::Object();
+  for (const auto& [arg_name, arg] : task.get("arguments").items()) {
+    if (arg.has("value")) {
+      params[arg_name] = arg.get("value");
+    } else if (arg.has("param")) {
+      params[arg_name] = run.params.get(arg.get("param").as_string());
+    } else if (arg.has("task")) {
+      const std::string src = arg.get("task").as_string();
+      const std::string out = arg.get("output").as_string();
+      const Json& src_status = run.status.get("tasks").get(src);
+      inputs[arg_name] = src_status.get("outputs").get(out);
+      input_digests[arg_name] = src_status.get("digests").get(out);
+    }
+  }
+
+  // Step-cache fingerprint: component spec + resolved params + input
+  // content digests (the KFP v2 cache-key recipe).
+  Json fp_doc = Json::Object();
+  fp_doc["component"] = comp;
+  fp_doc["params"] = params;
+  fp_doc["inputs"] = input_digests;
+  const std::string fp = Sha256::Hash(fp_doc.dump());
+  tstatus["fingerprint"] = fp;
+
+  if (comp.get("cache").as_bool(true)) {
+    Json hit = lineage_->Lookup(fp);
+    if (hit.is_object()) {
+      // Reuse only if every cached artifact still exists on disk.
+      bool all_present = true;
+      Json outputs = Json::Object();
+      Json digests = Json::Object();
+      for (const auto& [oname, rec] : hit.get("outputs").items()) {
+        const std::string path = rec.get("path").as_string();
+        struct stat st;
+        if (stat(path.c_str(), &st) != 0) {
+          all_present = false;
+          break;
+        }
+        outputs[oname] = path;
+        digests[oname] = rec.get("digest");
+      }
+      if (all_present) {
+        tstatus["phase"] = "Cached";
+        tstatus["outputs"] = outputs;
+        tstatus["digests"] = digests;
+        tstatus["cachedFrom"] = hit.get("run").as_string();
+        run.status["tasks"][tname] = tstatus;
+        metrics_.cache_hits++;
+        return;
+      }
+    }
+  }
+
+  // Materialize output dirs + task spec, launch the launcher as a JAXJob.
+  Json outputs = Json::Object();
+  for (const auto& o : comp.get("outputs").elements()) {
+    outputs[o.as_string()] = workdir_ + "/" + rname + "/artifacts/" + tname +
+                             "/" + o.as_string();
+  }
+  Json task_spec = Json::Object();
+  task_spec["component"] = comp;
+  task_spec["params"] = params;
+  task_spec["inputs"] = inputs;
+  task_spec["outputs"] = outputs;
+  MkdirP(workdir_ + "/" + rname + "/tasks");
+  const std::string spec_path =
+      workdir_ + "/" + rname + "/tasks/" + tname + ".json";
+  if (!WriteFile(spec_path, task_spec.dump())) {
+    tstatus["phase"] = "Failed";
+    tstatus["message"] = "cannot write task spec: " + spec_path;
+    run.status["tasks"][tname] = tstatus;
+    return;
+  }
+
+  // Task names contain no '.', so <run>.<task> cannot collide across
+  // (run, task) pairs the way '-' joining can (run "a-b"+task "t" vs run
+  // "a"+task "b-t").
+  const std::string job = rname + "." + tname;
+  // A leftover job under this name (crash between job-create and status
+  // write, or a deleted earlier run) is stale by construction — this task
+  // is Pending, so nothing of ours is running. Replace it.
+  if (store_->Get("JAXJob", job)) store_->Delete("JAXJob", job);
+  Json job_spec = Json::Object();
+  job_spec["replicas"] = comp.get("replicas").as_int(1);
+  job_spec["devices_per_proc"] = 1;
+  if (comp.get("cpu_devices_per_proc").as_int(0) > 0) {
+    job_spec["cpu_devices_per_proc"] = comp.get("cpu_devices_per_proc");
+  }
+  int64_t retries = comp.get("retries").as_int(0);
+  job_spec["restart_policy"] = retries > 0 ? "OnFailure" : "Never";
+  if (retries > 0) job_spec["backoff_limit"] = retries;
+  Json cmd = Json::Array();
+  cmd.push_back(python_);
+  cmd.push_back("-m");
+  cmd.push_back("kubeflow_tpu.pipelines.launcher");
+  cmd.push_back("--spec");
+  cmd.push_back(spec_path);
+  job_spec["command"] = cmd;
+  auto r = store_->Create("JAXJob", job, job_spec);
+  if (!r.ok) {
+    tstatus["phase"] = "Failed";
+    tstatus["message"] = "job create failed: " + r.error;
+  } else {
+    tstatus["phase"] = "Running";
+    tstatus["job"] = job;
+    tstatus["outputs"] = outputs;
+    metrics_.tasks_launched++;
+  }
+  run.status["tasks"][tname] = tstatus;
+}
+
+void PipelineRunController::CheckRunningTask(RunView& run,
+                                             const std::string& tname,
+                                             const Json& task) {
+  (void)task;
+  Json tstatus = run.status.get("tasks").get(tname);
+  const std::string job = tstatus.get("job").as_string();
+  auto j = store_->Get("JAXJob", job);
+  if (!j) {
+    tstatus["phase"] = "Failed";
+    tstatus["message"] = "child job disappeared: " + job;
+    run.status["tasks"][tname] = tstatus;
+    return;
+  }
+  const std::string jphase = j->status.get("phase").as_string();
+  if (jphase == "Succeeded") {
+    Json digests = Json::Object();
+    Json lineage_outputs = Json::Object();
+    for (const auto& [oname, opath] : tstatus.get("outputs").items()) {
+      const std::string digest = DirDigest(opath.as_string());
+      digests[oname] = digest;
+      Json rec = Json::Object();
+      rec["path"] = opath;
+      rec["digest"] = digest;
+      lineage_outputs[oname] = rec;
+    }
+    tstatus["digests"] = digests;
+    tstatus["phase"] = "Succeeded";
+    lineage_->Record(tstatus.get("fingerprint").as_string(), run.res.name,
+                     tname, lineage_outputs);
+    store_->Delete("JAXJob", job);  // harvested; GC the child resource
+  } else if (jphase == "Failed") {
+    tstatus["phase"] = "Failed";
+    tstatus["message"] = "task job failed";
+    store_->Delete("JAXJob", job);
+  }
+  run.status["tasks"][tname] = tstatus;
+}
+
+void PipelineRunController::Reconcile(const std::string& name) {
+  auto res = store_->Get("PipelineRun", name);
+  if (!res || res->deleted) return;
+  const std::string phase = res->status.get("phase").as_string();
+  if (IsTerminalRun(phase)) return;
+
+  RunView run{*res, Json(), Json(), res->status};
+  if (phase.empty()) {
+    metrics_.runs_created++;
+    SetPhase(&run.status, "Created", "RunCreated", "accepted");
+  }
+
+  std::string error;
+  if (!ResolveIR(*res, &run, &error)) {
+    SetPhase(&run.status, "Failed", "InvalidPipeline", error);
+    metrics_.runs_failed++;
+    store_->UpdateStatus("PipelineRun", name, run.status);
+    return;
+  }
+  const Json& tasks = run.ir.get("tasks");
+
+  if (!run.status.get("tasks").is_object()) {
+    if (!ValidateDag(tasks, &error)) {
+      SetPhase(&run.status, "Failed", "InvalidPipeline", error);
+      metrics_.runs_failed++;
+      store_->UpdateStatus("PipelineRun", name, run.status);
+      return;
+    }
+    Json tmap = Json::Object();
+    for (const auto& [tname, task] : tasks.items()) {
+      (void)task;
+      Json ts = Json::Object();
+      ts["phase"] = "Pending";
+      tmap[tname] = ts;
+    }
+    run.status["tasks"] = tmap;
+    run.status["pipelineSnapshot"] = run.ir;  // freeze for later passes
+  }
+
+  // Drive every task one step.
+  for (const auto& [tname, task] : tasks.items()) {
+    const std::string tphase =
+        run.status.get("tasks").get(tname).get("phase").as_string();
+    if (tphase == "Running") {
+      CheckRunningTask(run, tname, task);
+    } else if (tphase == "Pending") {
+      bool ready = true;
+      for (const auto& d : TaskDeps(task)) {
+        if (!TaskDone(
+                run.status.get("tasks").get(d).get("phase").as_string())) {
+          ready = false;
+          break;
+        }
+      }
+      if (ready) LaunchTask(run, tname, task);
+    }
+  }
+
+  // Aggregate.
+  int done = 0, failed = 0, running = 0, total = 0;
+  for (const auto& [tname, ts] : run.status.get("tasks").items()) {
+    (void)tname;
+    ++total;
+    const std::string tp = ts.get("phase").as_string();
+    if (TaskDone(tp)) ++done;
+    else if (tp == "Failed") ++failed;
+    else if (tp == "Running") ++running;
+  }
+
+  if (failed > 0) {
+    // Fail fast: stop in-flight tasks, skip the rest (Argo failFast).
+    for (const auto& [tname, ts] : run.status.get("tasks").items()) {
+      const std::string tp = ts.get("phase").as_string();
+      if (tp == "Running") {
+        store_->Delete("JAXJob", ts.get("job").as_string());
+        Json stopped = ts;
+        stopped["phase"] = "Stopped";
+        run.status["tasks"][tname] = stopped;
+      } else if (tp == "Pending") {
+        Json skipped = ts;
+        skipped["phase"] = "Skipped";
+        run.status["tasks"][tname] = skipped;
+      }
+    }
+    SetPhase(&run.status, "Failed", "TaskFailed",
+             std::to_string(failed) + " task(s) failed");
+    metrics_.runs_failed++;
+  } else if (done == total) {
+    SetPhase(&run.status, "Succeeded", "AllTasksSucceeded",
+             std::to_string(total) + " tasks done");
+    metrics_.runs_succeeded++;
+  } else {
+    SetPhase(&run.status, "Running", "Executing",
+             std::to_string(done) + "/" + std::to_string(total) + " done, " +
+                 std::to_string(running) + " running");
+  }
+
+  if (run.status.dump() != res->status.dump()) {
+    store_->UpdateStatus("PipelineRun", name, run.status);
+  }
+}
+
+void PipelineRunController::Tick(double now_s) {
+  now_s_ = now_s;
+  for (const auto& res : store_->List("PipelineRun")) {
+    if (!IsTerminalRun(res.status.get("phase").as_string())) {
+      Reconcile(res.name);
+    }
+  }
+}
+
+void PipelineRunController::OnDeleted(const Resource& res) {
+  if (res.kind != "PipelineRun") return;
+  for (const auto& [tname, ts] : res.status.get("tasks").items()) {
+    (void)tname;
+    if (ts.get("phase").as_string() == "Running") {
+      store_->Delete("JAXJob", ts.get("job").as_string());
+    }
+  }
+}
+
+}  // namespace tpk

@@ -1,0 +1,120 @@
+// Pipeline/PipelineRun controllers — the KFP-equivalent orchestration layer
+// (SURVEY.md §2.4, §3.5, §7.1 item 8).
+//
+// Collapses the reference's pipeline stack into control-plane-native form:
+//   - api-server IR→Argo compilation (⟨pipelines: backend/src/apiserver⟩):
+//     here the compiled IR (tpk-pipeline/v1 JSON from the Python DSL) is
+//     stored as a Pipeline resource and executed directly — no Workflow CR
+//     intermediary, the controller IS the DAG engine.
+//   - per-node driver (⟨pipelines: backend/src/v2/driver⟩): input/DAG
+//     resolution happens in Reconcile; each ready task becomes a child
+//     JAXJob running the Python launcher.
+//   - step cache (⟨pipelines: backend/src/apiserver⟩ cache +
+//     ⟨backend/src/v2/driver⟩ cache key): fingerprint = sha256(component
+//     spec, resolved params, input artifact digests) looked up in the
+//     lineage store before launching.
+//   - MLMD lineage (google/ml-metadata, the stack's one C++ component):
+//     LineageStore below — append-only JSONL of executions with
+//     content-addressed artifact digests (own schema per SURVEY.md §7.4).
+
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <string>
+#include <vector>
+
+#include "json.h"
+#include "store.h"
+
+namespace tpk {
+
+// Append-only execution/artifact log with fingerprint lookup (MLMD +
+// KFP-cache stand-in). One JSONL record per completed task execution.
+class LineageStore {
+ public:
+  // path empty = in-memory only (unit tests).
+  explicit LineageStore(std::string path = "");
+  ~LineageStore();
+
+  int Load();  // replays the log; returns records applied
+
+  // Record a completed execution. `outputs` maps name -> {path, digest}.
+  void Record(const std::string& fingerprint, const std::string& run,
+              const std::string& task, const Json& outputs);
+
+  // Most recent execution with this fingerprint, or null Json.
+  Json Lookup(const std::string& fingerprint) const;
+
+  int64_t size() const { return static_cast<int64_t>(by_fp_.size()); }
+
+ private:
+  std::string path_;
+  FILE* file_ = nullptr;
+  std::map<std::string, Json> by_fp_;
+};
+
+struct PipelineMetrics {
+  int64_t runs_created = 0;
+  int64_t runs_succeeded = 0;
+  int64_t runs_failed = 0;
+  int64_t tasks_launched = 0;
+  int64_t cache_hits = 0;
+
+  Json ToJson() const {
+    Json j = Json::Object();
+    j["runs_created"] = runs_created;
+    j["runs_succeeded"] = runs_succeeded;
+    j["runs_failed"] = runs_failed;
+    j["tasks_launched"] = tasks_launched;
+    j["cache_hits"] = cache_hits;
+    return j;
+  }
+};
+
+class PipelineRunController {
+ public:
+  PipelineRunController(Store* store, LineageStore* lineage,
+                        std::string workdir,
+                        std::string python = "python3");
+
+  void Reconcile(const std::string& name);
+  void Tick(double now_s);
+
+  // Watch hook for kDeleted: kills child task jobs of a deleted run.
+  void OnDeleted(const Resource& res);
+
+  PipelineMetrics& metrics() { return metrics_; }
+
+  // sha256 over dir contents (sorted relative paths + bytes); exposed for
+  // tests. Returns "" if the directory is missing.
+  static std::string DirDigest(const std::string& dir);
+
+  // Dependency closure of a task: depends_on + argument producers.
+  static std::vector<std::string> TaskDeps(const Json& task);
+
+ private:
+  struct RunView {
+    Resource res;
+    Json ir;       // resolved pipeline IR
+    Json params;   // resolved pipeline params
+    Json status;
+  };
+
+  bool ResolveIR(const Resource& res, RunView* run, std::string* error);
+  bool ValidateDag(const Json& tasks, std::string* error) const;
+  void LaunchTask(RunView& run, const std::string& tname, const Json& task);
+  void CheckRunningTask(RunView& run, const std::string& tname,
+                        const Json& task);
+  void SetPhase(Json* status, const std::string& phase,
+                const std::string& reason, const std::string& message);
+
+  Store* store_;
+  LineageStore* lineage_;
+  std::string workdir_;
+  std::string python_;
+  PipelineMetrics metrics_;
+  double now_s_ = 0;
+};
+
+}  // namespace tpk

@@ -13,11 +13,12 @@ namespace tpk {
 
 Server::Server(Store* store, Scheduler* scheduler, JaxJobController* jaxjob,
                std::string socket_path, std::string workdir,
-               ExperimentController* tune)
+               ExperimentController* tune, PipelineRunController* pipelines)
     : store_(store),
       scheduler_(scheduler),
       jaxjob_(jaxjob),
       tune_(tune),
+      pipelines_(pipelines),
       socket_path_(std::move(socket_path)),
       workdir_(std::move(workdir)) {}
 
@@ -104,6 +105,7 @@ Json Server::Dispatch(const Json& req) {
     resp["ok"] = true;
     Json m = jaxjob_ ? jaxjob_->metrics().ToJson() : Json::Object();
     if (tune_) m["tune"] = tune_->metrics().ToJson();
+    if (pipelines_) m["pipelines"] = pipelines_->metrics().ToJson();
     resp["metrics"] = m;
   } else if (op == "slices") {
     resp["ok"] = true;

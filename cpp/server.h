@@ -16,6 +16,7 @@
 
 #include "jaxjob.h"
 #include "json.h"
+#include "pipelines.h"
 #include "scheduler.h"
 #include "store.h"
 #include "tune.h"
@@ -26,7 +27,8 @@ class Server {
  public:
   Server(Store* store, Scheduler* scheduler, JaxJobController* jaxjob,
          std::string socket_path, std::string workdir,
-         ExperimentController* tune = nullptr);
+         ExperimentController* tune = nullptr,
+         PipelineRunController* pipelines = nullptr);
   ~Server();
 
   bool Start(std::string* error);
@@ -52,6 +54,7 @@ class Server {
   Scheduler* scheduler_;
   JaxJobController* jaxjob_;
   ExperimentController* tune_;
+  PipelineRunController* pipelines_;
   std::string socket_path_;
   std::string workdir_;
   int listen_fd_ = -1;

@@ -1,0 +1,389 @@
+// PipelineRun controller semantics against the FakeExecutor — envtest-style
+// (SURVEY.md §4.2): DAG ordering, dependency gating, fail-fast, cycle/ref
+// validation, the content-hash step cache, lineage persistence, and SHA-256
+// vectors. No real processes; tests flip job status and write artifact
+// files by hand.
+#include <sys/stat.h>
+
+#include <cstdio>
+#include <string>
+
+#include "executor.h"
+#include "jaxjob.h"
+#include "pipelines.h"
+#include "scheduler.h"
+#include "sha256.h"
+#include "store.h"
+
+using tpk::FakeExecutor;
+using tpk::JaxJobController;
+using tpk::Json;
+using tpk::LineageStore;
+using tpk::PipelineRunController;
+using tpk::Scheduler;
+using tpk::Sha256;
+using tpk::Store;
+
+#define CHECK(cond)                                                   \
+  do {                                                                \
+    if (!(cond)) {                                                    \
+      fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+      return 1;                                                       \
+    }                                                                 \
+  } while (0)
+
+namespace {
+
+const char* kWorkdir = "/tmp/tpk_test_pipe";
+
+std::string RunPhase(Store& store, const std::string& name) {
+  auto r = store.Get("PipelineRun", name);
+  return r ? r->status.get("phase").as_string() : "<gone>";
+}
+
+std::string TaskPhase(Store& store, const std::string& run,
+                      const std::string& task) {
+  auto r = store.Get("PipelineRun", run);
+  return r ? r->status.get("tasks").get(task).get("phase").as_string()
+           : "<gone>";
+}
+
+void WriteArtifact(const std::string& run, const std::string& task,
+                   const std::string& output, const std::string& content) {
+  std::string dir = std::string(kWorkdir) + "/" + run + "/artifacts/" +
+                    task + "/" + output;
+  std::string cur;
+  for (char c : dir + "/") {
+    if (c == '/') {
+      if (!cur.empty()) mkdir(cur.c_str(), 0755);
+    }
+    cur += c;
+  }
+  FILE* f = fopen((dir + "/data.txt").c_str(), "w");
+  fwrite(content.data(), 1, content.size(), f);
+  fclose(f);
+}
+
+// Three-task linear pipeline: a -> b -> c, param n feeds a.
+Json LinearIR() {
+  auto comp = [](const std::string& name, std::vector<std::string> ins,
+                 std::vector<std::string> outs) {
+    Json c = Json::Object();
+    c["name"] = name;
+    c["kind"] = "python";
+    c["source"] = "def " + name + "(**kw): pass\n";
+    c["params"] = Json::Object();
+    c["defaults"] = Json::Object();
+    Json in = Json::Array(), out = Json::Array();
+    for (const auto& i : ins) in.push_back(i);
+    for (const auto& o : outs) out.push_back(o);
+    c["inputs"] = in;
+    c["outputs"] = out;
+    c["replicas"] = 1;
+    c["cache"] = true;
+    return c;
+  };
+  Json ir = Json::Object();
+  ir["schema"] = "tpk-pipeline/v1";
+  ir["name"] = "linear";
+  Json params = Json::Object();
+  params["n"] = 5;
+  ir["params"] = params;
+  Json tasks = Json::Object();
+  {
+    Json t = Json::Object();
+    t["component"] = comp("a", {}, {"out"});
+    t["component"]["params"]["n"] = "int";
+    Json args = Json::Object();
+    Json ref = Json::Object();
+    ref["param"] = "n";
+    args["n"] = ref;
+    t["arguments"] = args;
+    t["depends_on"] = Json::Array();
+    tasks["a"] = t;
+  }
+  {
+    Json t = Json::Object();
+    t["component"] = comp("b", {"data"}, {"out"});
+    Json args = Json::Object();
+    Json ref = Json::Object();
+    ref["task"] = "a";
+    ref["output"] = "out";
+    args["data"] = ref;
+    t["arguments"] = args;
+    t["depends_on"] = Json::Array();
+    tasks["b"] = t;
+  }
+  {
+    Json t = Json::Object();
+    t["component"] = comp("c", {"data"}, {"report"});
+    Json args = Json::Object();
+    Json ref = Json::Object();
+    ref["task"] = "b";
+    ref["output"] = "out";
+    args["data"] = ref;
+    t["arguments"] = args;
+    t["depends_on"] = Json::Array();
+    tasks["c"] = t;
+  }
+  ir["tasks"] = tasks;
+  return ir;
+}
+
+struct Harness {
+  Store store;
+  Scheduler sched;
+  FakeExecutor exec;
+  LineageStore lineage;  // in-memory
+  JaxJobController jobs{&store, &exec, &sched, kWorkdir};
+  PipelineRunController ctl{&store, &lineage, kWorkdir};
+  double now = 1000.0;
+
+  Harness(int capacity = 8) { sched.AddSlice("local", capacity); }
+
+  void Settle(int rounds = 8) {
+    for (int i = 0; i < rounds; ++i) {
+      std::vector<std::string> dirty;
+      int w = store.Watch("", [&](const tpk::WatchEvent& ev) {
+        if (ev.type == tpk::WatchEvent::Type::kDeleted) {
+          if (ev.resource.kind == "JAXJob") jobs.OnDeleted(ev.resource);
+          if (ev.resource.kind == "PipelineRun") ctl.OnDeleted(ev.resource);
+        } else if (ev.resource.kind == "JAXJob") {
+          dirty.push_back(ev.resource.name);
+        }
+      });
+      jobs.Tick(now);
+      ctl.Tick(now);
+      store.DrainWatches();
+      for (const auto& d : dirty) jobs.Reconcile(d);
+      store.DrainWatches();
+      store.Unwatch(w);
+    }
+  }
+
+  Json RunSpec(const Json& ir) {
+    Json spec = Json::Object();
+    spec["pipeline_spec"] = ir;
+    return spec;
+  }
+};
+
+}  // namespace
+
+int main() {
+  // --- SHA-256 vectors --------------------------------------------------
+  {
+    CHECK(Sha256::Hash("") ==
+          "e3b0c44298fc1c149afbf4c8996fb92427ae41e4649b934ca495991b7852b855");
+    CHECK(Sha256::Hash("abc") ==
+          "ba7816bf8f01cfea414140de5dae2223b00361a396177a9cb410ff61f20015ad");
+    // Multi-block (>64 bytes).
+    CHECK(Sha256::Hash(std::string(1000, 'a')) ==
+          "41edece42d63e8d9bf515a9ba6932e1c20cbc9f5a5d134645adb5db1b9737ea3");
+  }
+
+  // --- DAG execution order + artifact flow ------------------------------
+  {
+    Harness h;
+    h.store.Create("PipelineRun", "r1", h.RunSpec(LinearIR()));
+    h.Settle();
+    CHECK(RunPhase(h.store, "r1") == "Running");
+    // Only `a` is launched; b/c gated on deps.
+    CHECK(TaskPhase(h.store, "r1", "a") == "Running");
+    CHECK(TaskPhase(h.store, "r1", "b") == "Pending");
+    CHECK(h.exec.launched.size() == 1);
+    // Launcher command hit the executor.
+    CHECK(h.exec.launched[0].argv[2] == "kubeflow_tpu.pipelines.launcher");
+
+    WriteArtifact("r1", "a", "out", "AAA");
+    h.exec.Finish("r1.a/0", 0);
+    h.Settle();
+    CHECK(TaskPhase(h.store, "r1", "a") == "Succeeded");
+    CHECK(TaskPhase(h.store, "r1", "b") == "Running");
+    // b's task spec received a's artifact path.
+    auto run = h.store.Get("PipelineRun", "r1");
+    std::string a_out = run->status.get("tasks").get("a").get("outputs")
+                            .get("out").as_string();
+    CHECK(a_out.find("/r1/artifacts/a/out") != std::string::npos);
+
+    WriteArtifact("r1", "b", "out", "BBB");
+    h.exec.Finish("r1.b/0", 0);
+    WriteArtifact("r1", "c", "report", "CCC");
+    h.Settle();
+    h.exec.Finish("r1.c/0", 0);
+    h.Settle();
+    CHECK(RunPhase(h.store, "r1") == "Succeeded");
+    CHECK(h.ctl.metrics().tasks_launched == 3);
+    CHECK(h.lineage.size() == 3);
+    // Child jobs are GC'd once harvested (no unbounded store/WAL growth).
+    CHECK(!h.store.Get("JAXJob", "r1.a").has_value());
+    CHECK(!h.store.Get("JAXJob", "r1.c").has_value());
+    // Digests recorded and non-empty.
+    run = h.store.Get("PipelineRun", "r1");
+    CHECK(!run->status.get("tasks").get("a").get("digests").get("out")
+               .as_string().empty());
+  }
+
+  // --- Step cache: identical second run reuses everything ---------------
+  {
+    Harness h;
+    h.store.Create("PipelineRun", "r1", h.RunSpec(LinearIR()));
+    h.Settle();
+    WriteArtifact("r1", "a", "out", "AAA");
+    h.exec.Finish("r1.a/0", 0);
+    h.Settle();
+    WriteArtifact("r1", "b", "out", "BBB");
+    h.exec.Finish("r1.b/0", 0);
+    h.Settle();
+    WriteArtifact("r1", "c", "report", "CCC");
+    h.exec.Finish("r1.c/0", 0);
+    h.Settle();
+    CHECK(RunPhase(h.store, "r1") == "Succeeded");
+
+    h.store.Create("PipelineRun", "r2", h.RunSpec(LinearIR()));
+    h.Settle();
+    CHECK(RunPhase(h.store, "r2") == "Succeeded");  // all cache hits
+    CHECK(h.ctl.metrics().cache_hits == 3);
+    CHECK(h.exec.launched.size() == 3);  // no new launches
+    CHECK(TaskPhase(h.store, "r2", "b") == "Cached");
+    auto run = h.store.Get("PipelineRun", "r2");
+    CHECK(run->status.get("tasks").get("b").get("cachedFrom").as_string() ==
+          "r1");
+
+    // Changed param → a's fingerprint differs → a re-runs; b/c then see new
+    // upstream digests only if a's output changes. Write identical output:
+    // b and c still cache-hit (content-addressed, not run-addressed).
+    Json spec = h.RunSpec(LinearIR());
+    Json overrides = Json::Object();
+    overrides["n"] = 6;
+    spec["params"] = overrides;
+    h.store.Create("PipelineRun", "r3", spec);
+    h.Settle();
+    CHECK(TaskPhase(h.store, "r3", "a") == "Running");  // cache miss
+    WriteArtifact("r3", "a", "out", "AAA");             // same content
+    h.exec.Finish("r3.a/0", 0);
+    h.Settle();
+    CHECK(RunPhase(h.store, "r3") == "Succeeded");
+    CHECK(TaskPhase(h.store, "r3", "b") == "Cached");
+    CHECK(h.exec.launched.size() == 4);  // only a re-ran
+  }
+
+  // --- Fail fast: running tasks stopped, pending skipped ----------------
+  {
+    Harness h;
+    // Diamond: a -> {b, c} -> d; b fails while c runs.
+    Json ir = LinearIR();
+    Json tasks = ir.get("tasks");
+    Json d = Json::Object();
+    d["component"] = tasks.get("c").get("component");
+    d["component"]["name"] = "d";
+    Json args = Json::Object();
+    Json ref = Json::Object();
+    ref["task"] = "c";
+    ref["output"] = "report";
+    args["data"] = ref;
+    d["arguments"] = args;
+    d["depends_on"] = Json::Array();
+    tasks["d"] = d;
+    // Rewire c to depend on a (parallel with b).
+    Json cref = Json::Object();
+    cref["task"] = "a";
+    cref["output"] = "out";
+    tasks["c"]["arguments"]["data"] = cref;
+    ir["tasks"] = tasks;
+
+    h.store.Create("PipelineRun", "r1", h.RunSpec(ir));
+    h.Settle();
+    WriteArtifact("r1", "a", "out", "AAA2");
+    h.exec.Finish("r1.a/0", 0);
+    h.Settle();
+    CHECK(TaskPhase(h.store, "r1", "b") == "Running");
+    CHECK(TaskPhase(h.store, "r1", "c") == "Running");
+
+    h.exec.Finish("r1.b/0", 1);  // b fails (restart Never)
+    h.Settle();
+    CHECK(RunPhase(h.store, "r1") == "Failed");
+    CHECK(TaskPhase(h.store, "r1", "b") == "Failed");
+    CHECK(TaskPhase(h.store, "r1", "c") == "Stopped");
+    CHECK(TaskPhase(h.store, "r1", "d") == "Skipped");
+    // c's job was deleted → gang killed, devices back.
+    CHECK(!h.store.Get("JAXJob", "r1.c").has_value());
+    CHECK(h.sched.Slices()[0].used == 0);
+    CHECK(h.ctl.metrics().runs_failed == 1);
+  }
+
+  // --- Validation: unknown dep + cycle → Failed InvalidPipeline ---------
+  {
+    Harness h;
+    Json ir = LinearIR();
+    ir["tasks"]["b"]["arguments"]["data"]["task"] = "ghost";
+    h.store.Create("PipelineRun", "bad", h.RunSpec(ir));
+    h.Settle(1);
+    CHECK(RunPhase(h.store, "bad") == "Failed");
+
+    Json ir2 = LinearIR();
+    // a depends on c → cycle a→b→c→a (via depends_on).
+    Json dep = Json::Array();
+    dep.push_back("c");
+    ir2["tasks"]["a"]["depends_on"] = dep;
+    h.store.Create("PipelineRun", "cyc", h.RunSpec(ir2));
+    h.Settle(1);
+    CHECK(RunPhase(h.store, "cyc") == "Failed");
+    auto r = h.store.Get("PipelineRun", "cyc");
+    CHECK(r->status.get("conditions").elements().back().get("message")
+              .as_string().find("cycle") != std::string::npos);
+  }
+
+  // --- Named pipeline resource + param overrides ------------------------
+  {
+    Harness h;
+    h.store.Create("Pipeline", "lin", LinearIR());
+    Json spec = Json::Object();
+    spec["pipeline"] = "lin";
+    Json overrides = Json::Object();
+    overrides["n"] = 9;
+    spec["params"] = overrides;
+    h.store.Create("PipelineRun", "byname", spec);
+    h.Settle();
+    CHECK(RunPhase(h.store, "byname") == "Running");
+    // Resolved param value rode into the task spec file.
+    FILE* f = fopen((std::string(kWorkdir) + "/byname/tasks/a.json").c_str(),
+                    "r");
+    CHECK(f != nullptr);
+    char buf[4096];
+    size_t got = fread(buf, 1, sizeof(buf), f);
+    fclose(f);
+    CHECK(std::string(buf, got).find("\"n\":9") != std::string::npos);
+
+    Json bad = Json::Object();
+    bad["pipeline"] = "nope";
+    h.store.Create("PipelineRun", "orphan", bad);
+    h.Settle(1);
+    CHECK(RunPhase(h.store, "orphan") == "Failed");
+  }
+
+  // --- Lineage persistence: reload serves cache across restarts ---------
+  {
+    std::string lpath = std::string(kWorkdir) + "/lineage_test.jsonl";
+    remove(lpath.c_str());
+    {
+      LineageStore l1(lpath);
+      l1.Load();
+      Json outputs = Json::Object();
+      Json rec = Json::Object();
+      rec["path"] = "/tmp/x";
+      rec["digest"] = "d1";
+      outputs["out"] = rec;
+      l1.Record("fp1", "r1", "a", outputs);
+    }
+    LineageStore l2(lpath);
+    CHECK(l2.Load() == 1);
+    Json hit = l2.Lookup("fp1");
+    CHECK(hit.is_object());
+    CHECK(hit.get("outputs").get("out").get("digest").as_string() == "d1");
+    CHECK(l2.Lookup("nope").is_null());
+  }
+
+  printf("test_pipelines OK\n");
+  return 0;
+}

@@ -1,0 +1,84 @@
+"""KFP-equivalent pipeline example: preprocess → train → evaluate on the
+MNIST-class runtime, with artifact handoff and step caching.
+
+Compile to IR and submit, or drive with the SDK:
+
+    tpukit compile examples/pipeline_train_eval.py -o /tmp/ir.json
+    python examples/pipeline_train_eval.py  # runs against /tmp/tpk.sock
+
+The train step runs a real (CPU-sized) MNIST-MLP training via the
+kubeflow_tpu runtime; preprocess/evaluate are plain python steps.
+"""
+
+from kubeflow_tpu.pipelines import (
+    InputArtifact,
+    OutputArtifact,
+    component,
+    pipeline,
+)
+
+
+@component
+def make_config(config: OutputArtifact, steps: int = 60, lr: float = 0.05):
+    import json
+    import os
+
+    runtime = {
+        "model": "mnist_mlp",
+        "dataset": "mnist_like",
+        "strategy": "dp",
+        "mesh": {"data": 2},
+        "steps": steps,
+        "batch_size": 64,
+        "learning_rate": lr,
+        "log_every": 20,
+    }
+    with open(os.path.join(config, "runtime.json"), "w") as fh:
+        json.dump(runtime, fh)
+
+
+@component(cpu_devices_per_proc=2)
+def train(config: InputArtifact, model: OutputArtifact):
+    import json
+    import os
+
+    spec = json.load(open(os.path.join(config, "runtime.json")))
+    spec["checkpoint"] = {"dir": model, "interval": 50, "keep": 1}
+    path = os.path.join(config, "resolved.json")
+    with open(path, "w") as fh:
+        json.dump(spec, fh)
+    from kubeflow_tpu.train.trainer import main as trainer_main
+
+    rc = trainer_main(["--spec", path, "--cpu-devices", "2"])
+    if rc:
+        raise RuntimeError(f"training failed rc={rc}")
+
+
+@component
+def evaluate(model: InputArtifact, report: OutputArtifact):
+    import json
+    import os
+
+    steps = sorted(d for d in os.listdir(model) if d.isdigit())
+    with open(os.path.join(report, "report.json"), "w") as fh:
+        json.dump({"checkpoints": len(steps),
+                   "latest_step": int(steps[-1]) if steps else None}, fh)
+
+
+@pipeline
+def mnist_pipeline(steps: int = 60, lr: float = 0.05):
+    cfg = make_config(steps=steps, lr=lr)
+    m = train(config=cfg.output("config"))
+    evaluate(model=m.output("model"))
+
+
+if __name__ == "__main__":
+    from kubeflow_tpu.controlplane.client import Client
+    from kubeflow_tpu.pipelines.sdk import PipelineClient
+
+    pc = PipelineClient(Client())
+    pc.create_pipeline("mnist-pipeline", mnist_pipeline)
+    pc.create_run("mnist-run", pipeline="mnist-pipeline")
+    print("phase:", pc.wait("mnist-run"))
+    for name, t in pc.tasks("mnist-run").items():
+        print(f"  {name}: {t['phase']}")

@@ -138,6 +138,46 @@ def cmd_delete(args) -> int:
     return 0
 
 
+def cmd_compile(args) -> int:
+    """Compile @pipeline objects from a python file to IR JSON
+    (kfp-compiler CLI parity)."""
+    import importlib.util
+
+    from kubeflow_tpu.pipelines.dsl import Pipeline, compile_pipeline
+
+    spec = importlib.util.spec_from_file_location("user_pipeline", args.file)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    # Dedup aliases (two module names bound to one Pipeline) by identity.
+    pipelines = {id(p): p for p in vars(mod).values()
+                 if isinstance(p, Pipeline)}
+    if args.pipeline:
+        pipelines = {k: v for k, v in pipelines.items()
+                     if v.name == args.pipeline}
+        if not pipelines:
+            print(f"error: no pipeline named {args.pipeline!r}",
+                  file=sys.stderr)
+            return 2
+    if not pipelines:
+        print("error: no @pipeline objects found", file=sys.stderr)
+        return 2
+    if len(pipelines) > 1:
+        names = sorted(p.name for p in pipelines.values())
+        print(f"error: multiple pipelines {names}; pick one with "
+              f"--pipeline", file=sys.stderr)
+        return 2
+    (p,) = pipelines.values()
+    ir = compile_pipeline(p)
+    text = json.dumps(ir, indent=2, sort_keys=True)
+    if args.output:
+        with open(args.output, "w") as fh:
+            fh.write(text + "\n")
+        print(f"wrote {args.output}")
+    else:
+        print(text)
+    return 0
+
+
 def cmd_slices(args) -> int:
     for s in _client(args).slices():
         print(f"{s['name']}: {s['used']}/{s['capacity']} devices used")
@@ -187,6 +227,12 @@ def main(argv=None) -> int:
     p.add_argument("kind")
     p.add_argument("name")
     p.set_defaults(fn=cmd_delete)
+
+    p = sub.add_parser("compile", help="compile a @pipeline file to IR JSON")
+    p.add_argument("file")
+    p.add_argument("-o", "--output")
+    p.add_argument("--pipeline", help="pipeline name if the file has several")
+    p.set_defaults(fn=cmd_compile)
 
     p = sub.add_parser("slices")
     p.set_defaults(fn=cmd_slices)

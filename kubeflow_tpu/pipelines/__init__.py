@@ -1,0 +1,24 @@
+"""Pipelines — the KFP-equivalent subsystem (SURVEY.md §2.4).
+
+Layout:
+  dsl.py      — @component / @pipeline / container_component authoring API,
+                plus compile_pipeline(): DAG trace → IR JSON (PipelineSpec
+                analog)
+  launcher.py — per-step executor run inside worker processes
+  sdk.py      — PipelineClient: create/run/wait against the control plane
+
+The PipelineRun DAG driver, content-hash step cache, and lineage store
+(MLMD stand-in) live in the C++ control plane (cpp/pipelines.cc).
+"""
+
+from kubeflow_tpu.pipelines.dsl import (  # noqa: F401
+    Component,
+    InputArtifact,
+    OutputArtifact,
+    Pipeline,
+    PipelineError,
+    compile_pipeline,
+    component,
+    container_component,
+    pipeline,
+)

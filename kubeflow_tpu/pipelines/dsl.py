@@ -1,0 +1,326 @@
+"""Pipeline DSL — the KFP v2 authoring surface (⟨pipelines: sdk/python/kfp —
+dsl⟩, SURVEY.md §2.4/§3.5).
+
+`@component` wraps a self-contained Python function; `@pipeline` wraps a
+function that calls components to build a DAG. `compile_pipeline()` emits
+the IR (the PipelineSpec-proto analog, here plain JSON) that the C++
+pipeline controller executes. Artifacts flow by path: a component declares
+`InputArtifact` / `OutputArtifact` parameters, the launcher hands it real
+filesystem paths at run time.
+
+    @component
+    def preprocess(out: OutputArtifact, n: int = 100):
+        ...write files under `out`...
+
+    @component
+    def train(data: InputArtifact, model: OutputArtifact, lr: float = 0.1):
+        ...
+
+    @pipeline
+    def demo(n: int = 100, lr: float = 0.1):
+        p = preprocess(n=n)
+        train(data=p.output("out"), lr=lr)
+
+    ir = compile_pipeline(demo)
+"""
+
+from __future__ import annotations
+
+import inspect
+import textwrap
+import threading
+import typing
+from typing import Any, Callable
+
+
+class PipelineError(ValueError):
+    pass
+
+
+class InputArtifact:
+    """Annotation marker: parameter receives the path of an upstream
+    artifact."""
+
+
+class OutputArtifact:
+    """Annotation marker: parameter receives a fresh directory path the
+    component must populate."""
+
+
+_PARAM_TYPES = {int: "int", float: "double", str: "string", bool: "bool"}
+
+
+class ParamRef:
+    """Reference to a pipeline-level parameter."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+
+class OutputRef:
+    """Reference to a task's output artifact."""
+
+    def __init__(self, task: "Task", output: str):
+        self.task = task
+        self.output = output
+
+
+class Task:
+    def __init__(self, name: str, component: "Component",
+                 arguments: dict[str, Any]):
+        self.name = name
+        self.component = component
+        self.arguments = arguments
+        self.after: list[Task] = []
+
+    def output(self, name: str) -> OutputRef:
+        if name not in self.component.outputs:
+            raise PipelineError(
+                f"component {self.component.name!r} has no output {name!r}; "
+                f"declared outputs: {self.component.outputs}")
+        return OutputRef(self, name)
+
+    @property
+    def outputs(self) -> dict[str, OutputRef]:
+        return {o: OutputRef(self, o) for o in self.component.outputs}
+
+    def after_task(self, *tasks: "Task") -> "Task":
+        """Explicit ordering edge with no data dependency (dsl .after())."""
+        self.after.extend(tasks)
+        return self
+
+
+class _PipelineContext(threading.local):
+    def __init__(self):
+        self.tasks: list[Task] | None = None
+
+
+_ctx = _PipelineContext()
+
+
+class Component:
+    """A packaged python-function step (KFP lightweight component), or a
+    raw-command step when built via `container_component` (KFP container
+    component analog)."""
+
+    def __init__(self, fn: Callable | None, replicas: int = 1,
+                 cpu_devices_per_proc: int = 0, cache: bool = True):
+        self.fn = fn
+        self.replicas = replicas
+        self.cpu_devices_per_proc = cpu_devices_per_proc
+        self.cache = cache
+        self.kind = "python"
+        self.argv: list[str] = []
+        self.params: dict[str, str] = {}      # name -> type
+        self.defaults: dict[str, Any] = {}
+        self.inputs: list[str] = []           # InputArtifact params
+        self.outputs: list[str] = []          # OutputArtifact params
+        if fn is None:       # container_component fills the fields itself
+            self.name = ""
+            self.source = ""
+            return
+        self.name = fn.__name__
+        try:
+            self.source = textwrap.dedent(inspect.getsource(fn))
+        except OSError:
+            # No retrievable source (REPL, or the launcher re-exec'ing a
+            # packaged component). Such a Component can run but not be
+            # re-compiled into IR — to_ir() enforces that.
+            self.source = ""
+
+        # get_type_hints resolves string annotations (files using
+        # `from __future__ import annotations`) against fn's globals.
+        try:
+            hints = typing.get_type_hints(fn)
+        except Exception:
+            hints = {}
+        sig = inspect.signature(fn)
+        for pname, p in sig.parameters.items():
+            ann = hints.get(pname, p.annotation)
+            if ann is InputArtifact:
+                self.inputs.append(pname)
+            elif ann is OutputArtifact:
+                self.outputs.append(pname)
+            elif ann in _PARAM_TYPES:
+                self.params[pname] = _PARAM_TYPES[ann]
+                if p.default is not inspect.Parameter.empty:
+                    self.defaults[pname] = p.default
+            else:
+                raise PipelineError(
+                    f"component {self.name!r} parameter {pname!r} needs an "
+                    f"annotation: int/float/str/bool, InputArtifact, or "
+                    f"OutputArtifact")
+
+    def __call__(self, **arguments: Any) -> Task:
+        if _ctx.tasks is None:
+            raise PipelineError(
+                f"component {self.name!r} called outside a @pipeline "
+                f"function")
+        for k, v in arguments.items():
+            if k in self.inputs:
+                if not isinstance(v, OutputRef):
+                    raise PipelineError(
+                        f"{self.name}.{k} is an InputArtifact; pass "
+                        f"task.output(...)")
+            elif k in self.params:
+                if isinstance(v, OutputRef):
+                    raise PipelineError(
+                        f"{self.name}.{k} is a parameter; got an artifact")
+            elif k in self.outputs:
+                raise PipelineError(
+                    f"{self.name}.{k} is an OutputArtifact; it is produced, "
+                    f"not passed")
+            else:
+                raise PipelineError(
+                    f"component {self.name!r} has no parameter {k!r}")
+        missing = [i for i in self.inputs if i not in arguments]
+        if missing:
+            raise PipelineError(
+                f"component {self.name!r} missing input artifacts: {missing}")
+        # Required params (no default) must be bound now — catching this at
+        # compile time beats burning a gang on a TypeError in the launcher.
+        unbound = [p for p in self.params
+                   if p not in arguments and p not in self.defaults]
+        if unbound:
+            raise PipelineError(
+                f"component {self.name!r} missing required params: {unbound}")
+        # Unique task name within the pipeline: name, name-2, name-3, ...
+        base = self.name
+        existing = {t.name for t in _ctx.tasks}
+        name, i = base, 1
+        while name in existing:
+            i += 1
+            name = f"{base}-{i}"
+        task = Task(name, self, arguments)
+        _ctx.tasks.append(task)
+        return task
+
+    def to_ir(self) -> dict:
+        if self.kind == "python" and not self.source:
+            raise PipelineError(
+                f"component {self.name!r} has no retrievable source (was it "
+                f"defined in a REPL?); define it in a file")
+        return {
+            "name": self.name,
+            "kind": self.kind,
+            "source": self.source,
+            "argv": list(self.argv),
+            "params": dict(self.params),
+            "defaults": dict(self.defaults),
+            "inputs": list(self.inputs),
+            "outputs": list(self.outputs),
+            "replicas": self.replicas,
+            "cpu_devices_per_proc": self.cpu_devices_per_proc,
+            "cache": self.cache,
+        }
+
+
+def component(fn: Callable | None = None, *, replicas: int = 1,
+              cpu_devices_per_proc: int = 0, cache: bool = True):
+    """Decorator: python function → Component (KFP @dsl.component)."""
+    def wrap(f: Callable) -> Component:
+        return Component(f, replicas=replicas,
+                         cpu_devices_per_proc=cpu_devices_per_proc,
+                         cache=cache)
+    return wrap(fn) if fn is not None else wrap
+
+
+def container_component(name: str, argv: list[str], *,
+                        params: dict[str, type] | None = None,
+                        defaults: dict[str, Any] | None = None,
+                        inputs: list[str] | None = None,
+                        outputs: list[str] | None = None,
+                        cache: bool = True) -> Component:
+    """Raw-command step. `argv` may use `{{params.x}}`, `{{inputs.a}}`,
+    `{{outputs.b}}` placeholders, resolved by the launcher at run time."""
+    c = Component(None, cache=cache)
+    c.kind = "command"
+    c.name = name
+    c.argv = list(argv)
+    c.params = {k: _PARAM_TYPES[t] for k, t in (params or {}).items()}
+    c.defaults = dict(defaults or {})
+    c.inputs = list(inputs or [])
+    c.outputs = list(outputs or [])
+    return c
+
+
+class Pipeline:
+    def __init__(self, fn: Callable):
+        self.fn = fn
+        self.name = fn.__name__
+        self.params: dict[str, Any] = {}
+        try:  # resolve PEP-563 string annotations like Component does
+            hints = typing.get_type_hints(fn)
+        except Exception:
+            hints = {}
+        sig = inspect.signature(fn)
+        for pname, p in sig.parameters.items():
+            if hints.get(pname, p.annotation) not in _PARAM_TYPES:
+                raise PipelineError(
+                    f"pipeline {self.name!r} parameter {pname!r} needs an "
+                    f"int/float/str/bool annotation")
+            self.params[pname] = (None if p.default is
+                                  inspect.Parameter.empty else p.default)
+
+
+def pipeline(fn: Callable) -> Pipeline:
+    """Decorator: DAG-building function → Pipeline (KFP @dsl.pipeline)."""
+    return Pipeline(fn)
+
+
+def _arg_ir(value: Any) -> dict:
+    if isinstance(value, ParamRef):
+        return {"param": value.name}
+    if isinstance(value, OutputRef):
+        return {"task": value.task.name, "output": value.output}
+    if isinstance(value, (int, float, str, bool)):
+        return {"value": value}
+    raise PipelineError(f"unsupported argument value: {value!r}")
+
+
+def compile_pipeline(p: Pipeline, **param_overrides: Any) -> dict:
+    """Traces the pipeline function and emits the IR document.
+
+    The KFP compiler analog (⟨pipelines: sdk/python/kfp/compiler⟩): tasks
+    carry their full component spec (self-contained IR — no registry
+    lookups at run time), arguments reference literals, pipeline params, or
+    upstream outputs; `depends_on` holds explicit .after() edges (data
+    edges are implied by arguments and recomputed by the controller).
+    """
+    params = dict(p.params)
+    for k, v in param_overrides.items():
+        if k not in params:
+            raise PipelineError(f"pipeline {p.name!r} has no param {k!r}")
+        params[k] = v
+    missing = [k for k, v in params.items() if v is None]
+    if missing:
+        raise PipelineError(
+            f"pipeline {p.name!r} params need values: {missing}")
+
+    if _ctx.tasks is not None:
+        raise PipelineError("nested pipeline compilation is not supported")
+    _ctx.tasks = []
+    try:
+        p.fn(**{k: ParamRef(k) for k in params})
+        tasks = _ctx.tasks
+    finally:
+        _ctx.tasks = None
+
+    if not tasks:
+        raise PipelineError(f"pipeline {p.name!r} has no tasks")
+
+    ir_tasks: dict[str, dict] = {}
+    for t in tasks:
+        args = {k: _arg_ir(v) for k, v in t.arguments.items()}
+        # Unpassed params fall back to component defaults at launch time.
+        ir_tasks[t.name] = {
+            "component": t.component.to_ir(),
+            "arguments": args,
+            "depends_on": sorted({a.name for a in t.after}),
+        }
+    return {
+        "schema": "tpk-pipeline/v1",
+        "name": p.name,
+        "params": params,
+        "tasks": ir_tasks,
+    }

@@ -1,0 +1,116 @@
+"""Task launcher — the per-step executor inside the worker process.
+
+The KFP v2 launcher analog (⟨pipelines: backend/src/v2/component — launcher⟩,
+SURVEY.md §2.4/§3.5): the C++ pipeline controller resolves a task's inputs
+and writes a task-spec JSON; this process materializes output directories,
+runs the user step (packaged python function or raw command with
+placeholders), and exits 0 only if every declared output was produced.
+Artifact upload/download collapses to filesystem paths (local artifact
+store); lineage recording stays in the controller, which digests the
+outputs on success.
+
+Task spec:
+    {"component": {...component IR...},
+     "params":  {"n": 100},                  # fully resolved values
+     "inputs":  {"data": "/.../artifacts/preprocess/out"},
+     "outputs": {"model": "/.../artifacts/train/model"}}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+
+class LauncherError(RuntimeError):
+    pass
+
+
+def _resolve_placeholders(text: str, params: dict, inputs: dict,
+                          outputs: dict) -> str:
+    for key, val in params.items():
+        text = text.replace("{{params.%s}}" % key, str(val))
+    for key, val in inputs.items():
+        text = text.replace("{{inputs.%s}}" % key, val)
+    for key, val in outputs.items():
+        text = text.replace("{{outputs.%s}}" % key, val)
+    return text
+
+
+def run_task(spec: dict) -> None:
+    comp = spec["component"]
+    params = dict(comp.get("defaults") or {})
+    params.update(spec.get("params") or {})
+    inputs = spec.get("inputs") or {}
+    outputs = spec.get("outputs") or {}
+
+    for name, path in inputs.items():
+        if not os.path.exists(path):
+            raise LauncherError(f"input artifact {name!r} missing at {path}")
+    for path in outputs.values():
+        os.makedirs(path, exist_ok=True)
+
+    kind = comp.get("kind", "python")
+    if kind == "python":
+        # Re-hydrate the Component by exec'ing its captured source with the
+        # DSL names in scope, then call the underlying function with params
+        # + artifact paths (the KFP "lightweight python component" flow).
+        from kubeflow_tpu.pipelines import dsl
+
+        scope = {"component": dsl.component,
+                 "container_component": dsl.container_component,
+                 "InputArtifact": dsl.InputArtifact,
+                 "OutputArtifact": dsl.OutputArtifact}
+        # dont_inherit: exec must not leak this module's `from __future__
+        # import annotations` into the component (it would stringify the
+        # signature annotations the DSL dispatches on).
+        code = compile(comp["source"], f"<component {comp['name']}>",
+                       "exec", dont_inherit=True)
+        exec(code, scope)  # noqa: S102 — the source IS the step
+        obj = scope.get(comp["name"])
+        if isinstance(obj, dsl.Component):
+            fn = obj.fn
+        elif callable(obj):
+            fn = obj
+        else:
+            raise LauncherError(
+                f"component source did not define {comp['name']!r}")
+        fn(**params, **inputs, **outputs)
+    elif kind == "command":
+        argv = [_resolve_placeholders(a, params, inputs, outputs)
+                for a in comp.get("argv") or []]
+        if not argv:
+            raise LauncherError("command component has empty argv")
+        rc = subprocess.call(argv)
+        if rc != 0:
+            raise LauncherError(f"command exited {rc}: {argv}")
+    else:
+        raise LauncherError(f"unknown component kind {kind!r}")
+
+    missing = [n for n, p in outputs.items()
+               if not os.path.exists(p) or not os.listdir(p)]
+    if missing:
+        raise LauncherError(
+            f"component {comp.get('name')!r} did not populate declared "
+            f"outputs: {missing}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tpk-launcher")
+    ap.add_argument("--spec", required=True, help="task spec JSON path")
+    args = ap.parse_args(argv)
+    with open(args.spec) as fh:
+        spec = json.load(fh)
+    try:
+        run_task(spec)
+    except Exception as e:
+        print(f"launcher: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,61 @@
+"""Pipelines SDK — kfp.Client parity (⟨pipelines: sdk/python/kfp — client⟩,
+SURVEY.md §2.4): upload compiled pipelines, create runs, wait, inspect task
+states and artifact paths, all against the control plane's API server."""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+from kubeflow_tpu.controlplane.client import Client
+from kubeflow_tpu.pipelines.dsl import Pipeline, compile_pipeline
+
+
+class PipelineClient:
+    def __init__(self, client: Client):
+        self.client = client
+
+    def create_pipeline(self, name: str, pipeline: Pipeline | dict,
+                        **params: Any) -> dict:
+        """Uploads a compiled pipeline (Pipeline object or IR dict)."""
+        ir = (compile_pipeline(pipeline, **params)
+              if isinstance(pipeline, Pipeline) else pipeline)
+        return self.client.create("Pipeline", name, ir)
+
+    def create_run(self, name: str, *, pipeline: str | Pipeline | dict,
+                   params: dict | None = None) -> dict:
+        """Starts a run of a named pipeline (str) or an inline one."""
+        spec: dict[str, Any] = {"params": params or {}}
+        if isinstance(pipeline, str):
+            spec["pipeline"] = pipeline
+        elif isinstance(pipeline, Pipeline):
+            # Inline compile: defaults must exist; run-time overrides ride
+            # in spec.params like the named-pipeline path.
+            spec["pipeline_spec"] = compile_pipeline(pipeline)
+        else:
+            spec["pipeline_spec"] = pipeline
+        return self.client.create("PipelineRun", name, spec)
+
+    def get_run(self, name: str) -> dict:
+        return self.client.get("PipelineRun", name)
+
+    def wait(self, name: str, timeout: float = 600.0,
+             poll: float = 0.5) -> str:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            phase = self.get_run(name).get("status", {}).get("phase", "")
+            if phase in ("Succeeded", "Failed"):
+                return phase
+            time.sleep(poll)
+        raise TimeoutError(
+            f"run {name} still "
+            f"{self.get_run(name).get('status', {}).get('phase')!r} after "
+            f"{timeout}s")
+
+    def tasks(self, name: str) -> dict[str, dict]:
+        """Task name → {phase, outputs, digests, fingerprint, ...}."""
+        return self.get_run(name).get("status", {}).get("tasks", {})
+
+    def artifacts(self, name: str, task: str) -> dict[str, str]:
+        """Output name → artifact directory path for a completed task."""
+        return self.tasks(name).get(task, {}).get("outputs", {})

@@ -1,0 +1,175 @@
+"""Pipeline DSL/compiler: tracing, dependency inference, validation, and a
+golden-IR diff — the KFP compiler-test pattern (⟨pipelines:
+sdk/python/kfp/compiler/compiler_test.py + test_data/⟩, SURVEY.md §4.3)."""
+
+import json
+import os
+
+import pytest
+
+from kubeflow_tpu.pipelines import (
+    InputArtifact,
+    OutputArtifact,
+    PipelineError,
+    compile_pipeline,
+    component,
+    container_component,
+    pipeline,
+)
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden")
+
+
+@component
+def preprocess(out: OutputArtifact, n: int = 100):
+    import os
+
+    with open(os.path.join(out, "data.txt"), "w") as fh:
+        fh.write("x" * n)
+
+
+@component
+def train(data: InputArtifact, model: OutputArtifact, lr: float = 0.1):
+    import os
+    import shutil
+
+    shutil.copy(os.path.join(data, "data.txt"),
+                os.path.join(model, "weights.txt"))
+    with open(os.path.join(model, "lr.txt"), "w") as fh:
+        fh.write(str(lr))
+
+
+@component
+def evaluate(model: InputArtifact, report: OutputArtifact):
+    import os
+
+    with open(os.path.join(report, "report.txt"), "w") as fh:
+        fh.write("ok")
+
+
+@pipeline
+def demo(n: int = 100, lr: float = 0.1):
+    p = preprocess(n=n)
+    t = train(data=p.output("out"), lr=lr)
+    evaluate(model=t.output("model"))
+
+
+def test_compile_structure():
+    ir = compile_pipeline(demo)
+    assert ir["schema"] == "tpk-pipeline/v1"
+    assert ir["name"] == "demo"
+    assert ir["params"] == {"n": 100, "lr": 0.1}
+    assert set(ir["tasks"]) == {"preprocess", "train", "evaluate"}
+    # Data edges ride in arguments; the controller recomputes the DAG.
+    assert ir["tasks"]["train"]["arguments"]["data"] == {
+        "task": "preprocess", "output": "out"}
+    assert ir["tasks"]["train"]["arguments"]["lr"] == {"param": "lr"}
+    assert ir["tasks"]["evaluate"]["arguments"]["model"] == {
+        "task": "train", "output": "model"}
+    comp = ir["tasks"]["preprocess"]["component"]
+    assert comp["outputs"] == ["out"] and comp["params"] == {"n": "int"}
+    assert "def preprocess" in comp["source"]
+
+
+def test_param_overrides_and_validation():
+    ir = compile_pipeline(demo, n=5)
+    assert ir["params"]["n"] == 5
+    with pytest.raises(PipelineError):
+        compile_pipeline(demo, bogus=1)
+
+    @pipeline
+    def needs_value(n: int):  # no default
+        preprocess(n=n)
+
+    with pytest.raises(PipelineError):
+        compile_pipeline(needs_value)
+    assert compile_pipeline(needs_value, n=3)["params"]["n"] == 3
+
+
+def test_duplicate_component_calls_get_unique_names():
+    @pipeline
+    def twice(n: int = 1):
+        a = preprocess(n=n)
+        b = preprocess(n=n)
+        train(data=a.output("out"))
+        train(data=b.output("out"))
+
+    ir = compile_pipeline(twice)
+    assert set(ir["tasks"]) == {"preprocess", "preprocess-2",
+                                "train", "train-2"}
+
+
+def test_explicit_after_edges():
+    @pipeline
+    def ordered(n: int = 1):
+        a = preprocess(n=n)
+        b = preprocess(n=n)
+        b_task = b  # no data edge a→b; force ordering
+        b_task.after_task(a)
+
+    ir = compile_pipeline(ordered)
+    assert ir["tasks"]["preprocess-2"]["depends_on"] == ["preprocess"]
+
+
+def test_argument_validation():
+    with pytest.raises(PipelineError):  # artifact passed to a param
+        @pipeline
+        def bad1(n: int = 1):
+            p = preprocess(n=n)
+            train(data=p.output("out"), lr=p.output("out"))
+        compile_pipeline(bad1)
+
+    with pytest.raises(PipelineError):  # literal passed to an artifact
+        @pipeline
+        def bad2(n: int = 1):
+            train(data="not-an-artifact")
+        compile_pipeline(bad2)
+
+    with pytest.raises(PipelineError):  # missing input artifact
+        @pipeline
+        def bad3(n: int = 1):
+            train(lr=0.1)
+        compile_pipeline(bad3)
+
+    with pytest.raises(PipelineError):  # unknown output name
+        @pipeline
+        def bad4(n: int = 1):
+            p = preprocess(n=n)
+            train(data=p.output("nope"))
+        compile_pipeline(bad4)
+
+    with pytest.raises(PipelineError):  # component call outside pipeline
+        preprocess(n=1)
+
+
+def test_component_annotation_required():
+    with pytest.raises(PipelineError):
+        @component
+        def untyped(x):  # no annotation
+            pass
+
+
+def test_container_component_ir():
+    cc = container_component(
+        "shell-step", ["bash", "-c", "cp {{inputs.src}}/* {{outputs.dst}}/"
+                       " && echo n={{params.n}}"],
+        params={"n": int}, defaults={"n": 3}, inputs=["src"],
+        outputs=["dst"])
+    ir = cc.to_ir()
+    assert ir["kind"] == "command" and ir["argv"][0] == "bash"
+    assert ir["params"] == {"n": "int"} and ir["defaults"] == {"n": 3}
+
+
+def test_golden_ir():
+    """The compiled IR is a stable contract consumed by the C++ controller;
+    diff against the checked-in golden file (regenerate deliberately with
+    REGEN_GOLDEN=1 when the schema changes)."""
+    ir = compile_pipeline(demo)
+    path = os.path.join(GOLDEN, "demo_pipeline.json")
+    if os.environ.get("REGEN_GOLDEN") == "1" or not os.path.exists(path):
+        os.makedirs(GOLDEN, exist_ok=True)
+        with open(path, "w") as fh:
+            json.dump(ir, fh, indent=2, sort_keys=True)
+    with open(path) as fh:
+        golden = json.load(fh)
+    assert ir == golden

@@ -1,0 +1,91 @@
+"""Launcher unit tests: python + command components run in-process against
+real tmp dirs; declared-output enforcement (the KServe/KFP pattern of
+testing the in-pod runtime without a cluster, SURVEY.md §4.4)."""
+
+import os
+
+import pytest
+
+from kubeflow_tpu.pipelines import (
+    InputArtifact,
+    OutputArtifact,
+    component,
+    container_component,
+)
+from kubeflow_tpu.pipelines.launcher import LauncherError, run_task
+
+
+@component
+def writer(out: OutputArtifact, text: str = "hello", n: int = 2):
+    import os
+
+    with open(os.path.join(out, "f.txt"), "w") as fh:
+        fh.write(text * n)
+
+
+@component
+def reader(src: InputArtifact, dst: OutputArtifact):
+    import os
+    import shutil
+
+    shutil.copy(os.path.join(src, "f.txt"), os.path.join(dst, "copy.txt"))
+
+
+def _spec(comp, params=None, inputs=None, outputs=None):
+    return {"component": comp.to_ir(), "params": params or {},
+            "inputs": inputs or {}, "outputs": outputs or {}}
+
+
+def test_python_component_roundtrip(tmp_path):
+    out = str(tmp_path / "out")
+    run_task(_spec(writer, params={"text": "ab", "n": 3},
+                   outputs={"out": out}))
+    assert open(os.path.join(out, "f.txt")).read() == "ababab"
+
+    dst = str(tmp_path / "dst")
+    run_task(_spec(reader, inputs={"src": out}, outputs={"dst": dst}))
+    assert open(os.path.join(dst, "copy.txt")).read() == "ababab"
+
+
+def test_defaults_applied(tmp_path):
+    out = str(tmp_path / "out")
+    run_task(_spec(writer, outputs={"out": out}))  # text=hello, n=2
+    assert open(os.path.join(out, "f.txt")).read() == "hellohello"
+
+
+def test_missing_input_fails(tmp_path):
+    with pytest.raises(LauncherError, match="input artifact"):
+        run_task(_spec(reader, inputs={"src": str(tmp_path / "nope")},
+                       outputs={"dst": str(tmp_path / "dst")}))
+
+
+def test_unpopulated_output_fails(tmp_path):
+    @component
+    def lazy(out: OutputArtifact):
+        pass  # never writes anything
+
+    with pytest.raises(LauncherError, match="did not populate"):
+        run_task(_spec(lazy, outputs={"out": str(tmp_path / "out")}))
+
+
+def test_command_component(tmp_path):
+    cc = container_component(
+        "copy", ["bash", "-c",
+                 "cp {{inputs.src}}/f.txt {{outputs.dst}}/g.txt && "
+                 "echo n={{params.n}} >> {{outputs.dst}}/g.txt"],
+        params={"n": int}, inputs=["src"], outputs=["dst"])
+    src = str(tmp_path / "src")
+    os.makedirs(src)
+    with open(os.path.join(src, "f.txt"), "w") as fh:
+        fh.write("data\n")
+    dst = str(tmp_path / "dst")
+    run_task(_spec(cc, params={"n": 7}, inputs={"src": src},
+                   outputs={"dst": dst}))
+    content = open(os.path.join(dst, "g.txt")).read()
+    assert content == "data\nn=7\n"
+
+
+def test_command_failure_propagates(tmp_path):
+    cc = container_component("fail", ["bash", "-c", "exit 3"])
+    with pytest.raises(LauncherError, match="exited 3"):
+        run_task(_spec(cc))
