@@ -18,6 +18,7 @@
 #include "jaxjob.h"
 #include "pipelines.h"
 #include "scheduler.h"
+#include "serve.h"
 #include "server.h"
 #include "store.h"
 #include "tune.h"
@@ -81,8 +82,15 @@ int main(int argc, char** argv) {
   tpk::LineageStore lineage(workdir + "/lineage.jsonl");
   int lineage_records = lineage.Load();
   tpk::PipelineRunController pipelines(&store, &lineage, workdir, python);
+  // 250ms probe cap: probes run synchronously in this single-threaded loop,
+  // so a slow replica must not stall scheduling/API for long (servers are
+  // loopback-local; healthy ones answer in ms).
+  tpk::HttpProbe probe(250);
+  tpk::ServeController serve(&store, &executor, &scheduler, &probe, workdir,
+                             python);
+  serve.Recover();
   tpk::Server server(&store, &scheduler, &jaxjob, socket_path, workdir,
-                     &tune, &pipelines);
+                     &tune, &pipelines, &serve);
 
   std::string error;
   if (!server.Start(&error)) {
@@ -119,6 +127,11 @@ int main(int argc, char** argv) {
       pipelines.OnDeleted(ev.resource);
     }
   });
+  store.Watch("InferenceService", [&serve](const tpk::WatchEvent& ev) {
+    if (ev.type == tpk::WatchEvent::Type::kDeleted) {
+      serve.OnDeleted(ev.resource);
+    }
+  });
 
   while (!g_stop) {
     server.PollOnce(50);
@@ -129,6 +142,7 @@ int main(int argc, char** argv) {
     jaxjob.Tick(now);
     tune.Tick(now);
     pipelines.Tick(now);
+    serve.Tick(now);
     // Tune/pipeline writes (child JAXJob create/delete) need a jaxjob pass
     // before the next poll so child gangs launch/die promptly.
     store.DrainWatches();

@@ -1,0 +1,551 @@
+#include "serve.h"
+
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <poll.h>
+#include <signal.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <chrono>
+#include <cmath>
+#include <cstdio>
+#include <cstring>
+#include <ctime>
+
+namespace tpk {
+
+namespace {
+
+double NowWall() { return static_cast<double>(time(nullptr)); }
+
+std::string Timestamp(double now_s) {
+  char buf[32];
+  time_t t = static_cast<time_t>(now_s ? now_s : NowWall());
+  struct tm tmv;
+  gmtime_r(&t, &tmv);
+  strftime(buf, sizeof(buf), "%Y-%m-%dT%H:%M:%SZ", &tmv);
+  return buf;
+}
+
+int FreePort() {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return 0;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = 0;
+  int port = 0;
+  if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+    socklen_t len = sizeof(addr);
+    if (getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len) == 0) {
+      port = ntohs(addr.sin_port);
+    }
+  }
+  close(fd);
+  return port;
+}
+
+Allocation AllocFromJson(const Json& j) {
+  Allocation a;
+  for (const auto& [name, n] : j.items()) {
+    a.slices[name] = static_cast<int>(n.as_int());
+  }
+  return a;
+}
+
+Json AllocToJson(const Allocation& a) {
+  Json j = Json::Object();
+  for (const auto& [name, n] : a.slices) j[name] = n;
+  return j;
+}
+
+}  // namespace
+
+// --------------------------------------------------------------------------
+// HttpProbe
+// --------------------------------------------------------------------------
+
+bool HttpProbe::Get(int port, const std::string& path, std::string* body,
+                    int* status) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return false;
+  fcntl(fd, F_SETFL, fcntl(fd, F_GETFL, 0) | O_NONBLOCK);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms_);
+  auto left_ms = [&]() {
+    return static_cast<int>(
+        std::max<long long>(0, std::chrono::duration_cast<
+                                   std::chrono::milliseconds>(
+                                   deadline - std::chrono::steady_clock::now())
+                                   .count()));
+  };
+  if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+    if (errno != EINPROGRESS) {
+      close(fd);
+      return false;
+    }
+    pollfd pfd{fd, POLLOUT, 0};
+    if (poll(&pfd, 1, left_ms()) <= 0) {
+      close(fd);
+      return false;
+    }
+    int err = 0;
+    socklen_t len = sizeof(err);
+    if (getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &len) != 0 || err != 0) {
+      close(fd);
+      return false;
+    }
+  }
+  std::string req = "GET " + path + " HTTP/1.0\r\nHost: 127.0.0.1\r\n\r\n";
+  size_t off = 0;
+  while (off < req.size()) {
+    ssize_t sent = write(fd, req.data() + off, req.size() - off);
+    if (sent > 0) {
+      off += sent;
+      continue;
+    }
+    if (errno != EAGAIN && errno != EWOULDBLOCK) {
+      close(fd);
+      return false;
+    }
+    pollfd pfd{fd, POLLOUT, 0};
+    if (poll(&pfd, 1, left_ms()) <= 0) {
+      close(fd);
+      return false;
+    }
+  }
+  std::string resp;
+  while (true) {
+    char buf[4096];
+    ssize_t got = read(fd, buf, sizeof(buf));
+    if (got > 0) {
+      resp.append(buf, got);
+      if (resp.size() > (1u << 20)) break;  // cap
+      continue;
+    }
+    if (got == 0) break;
+    if (errno != EAGAIN && errno != EWOULDBLOCK) break;
+    pollfd pfd{fd, POLLIN, 0};
+    if (poll(&pfd, 1, left_ms()) <= 0) break;
+  }
+  close(fd);
+  if (resp.compare(0, 5, "HTTP/") != 0) return false;
+  size_t sp = resp.find(' ');
+  *status = sp == std::string::npos ? 0 : atoi(resp.c_str() + sp + 1);
+  size_t hdr_end = resp.find("\r\n\r\n");
+  *body = hdr_end == std::string::npos ? "" : resp.substr(hdr_end + 4);
+  return true;
+}
+
+bool HttpProbe::Ready(int port) {
+  std::string body;
+  int status = 0;
+  return Get(port, "/v2/health/ready", &body, &status) && status == 200;
+}
+
+bool HttpProbe::Metrics(int port, std::string* body) {
+  int status = 0;
+  return Get(port, "/metrics", body, &status) && status == 200;
+}
+
+// --------------------------------------------------------------------------
+// ServeController
+// --------------------------------------------------------------------------
+
+ServeController::ServeController(Store* store, ExecutorInterface* executor,
+                                 Scheduler* scheduler, ProbeInterface* probe,
+                                 std::string workdir, std::string python)
+    : store_(store),
+      executor_(executor),
+      scheduler_(scheduler),
+      probe_(probe),
+      workdir_(std::move(workdir)),
+      python_(std::move(python)) {
+  mkdir(workdir_.c_str(), 0755);
+}
+
+std::string ServeController::ProcId(const std::string& name, int replica) {
+  // "srv" segment keeps these ids disjoint from JAXJob's "<job>/<index>".
+  return name + "/srv" + std::to_string(replica);
+}
+
+double ServeController::ParseRequestsTotal(const std::string& text) {
+  double total = 0;
+  size_t pos = 0;
+  const std::string key = "tpk_serve_requests_total";
+  while (pos < text.size()) {
+    size_t nl = text.find('\n', pos);
+    if (nl == std::string::npos) nl = text.size();
+    std::string line = text.substr(pos, nl - pos);
+    pos = nl + 1;
+    if (line.compare(0, key.size(), key) != 0) continue;
+    size_t sp = line.rfind(' ');
+    if (sp != std::string::npos) total += atof(line.c_str() + sp + 1);
+  }
+  return total;
+}
+
+void ServeController::EnsureReplica(View& v, int index) {
+  Json replicas = v.status.get("replicaState").is_array()
+                      ? v.status.get("replicaState")
+                      : Json::Array();
+  while (static_cast<int>(replicas.size()) <= index) {
+    replicas.push_back(Json());
+  }
+  Json rs = replicas.elements()[index];
+  const std::string id = ProcId(v.res.name, index);
+
+  // 0 = launched, 1 = no capacity (cheap, retry level-style), 2 = spawn
+  // failure (must back off — retrying forks at tick rate).
+  auto launch = [&](Json& rec) -> int {
+    int devices =
+        static_cast<int>(v.spec.get("devices_per_replica").as_int(1));
+    Allocation alloc;
+    if (!rec.get("alloc").is_object() || rec.get("alloc").size() == 0) {
+      auto got = scheduler_->Allocate(devices, 1);
+      if (!got) {
+        rec = Json::Object();
+        rec["pendingReason"] = "insufficient device capacity";
+        return 1;
+      }
+      alloc = *got;
+      rec["alloc"] = AllocToJson(alloc);
+    }
+    int port = FreePort();
+    const Json& model = v.spec.get("model");
+    LaunchSpec s;
+    s.id = id;
+    s.argv = {python_, "-m", "kubeflow_tpu.serve.server",
+              "--port", std::to_string(port)};
+    if (!model.get("model_dir").as_string().empty()) {
+      s.argv.push_back("--model-dir");
+      s.argv.push_back(model.get("model_dir").as_string());
+    } else if (!model.get("storage_uri").as_string().empty()) {
+      s.argv.push_back("--storage-uri");
+      s.argv.push_back(model.get("storage_uri").as_string());
+    }
+    if (!model.get("name").as_string().empty()) {
+      s.argv.push_back("--name");
+      s.argv.push_back(model.get("name").as_string());
+    }
+    if (v.spec.get("max_batch_size").is_number()) {
+      s.argv.push_back("--max-batch-size");
+      s.argv.push_back(
+          std::to_string(v.spec.get("max_batch_size").as_int()));
+    }
+    if (v.spec.get("max_latency_ms").is_number()) {
+      char buf[32];
+      snprintf(buf, sizeof(buf), "%g",
+               v.spec.get("max_latency_ms").as_number());
+      s.argv.push_back("--max-latency-ms");
+      s.argv.push_back(buf);
+    }
+    int cpu = static_cast<int>(v.spec.get("cpu_devices").as_int(0));
+    if (cpu > 0) {
+      s.argv.push_back("--cpu-devices");
+      s.argv.push_back(std::to_string(cpu));
+      s.env["PALLAS_AXON_POOL_IPS"] = "";  // keep axon off CPU workers
+    }
+    s.env["TPK_SERVICE"] = v.res.name;
+    std::string dir = workdir_ + "/" + v.res.name;
+    mkdir(dir.c_str(), 0755);
+    s.stdout_path = dir + "/server-" + std::to_string(index) + ".log";
+    s.stderr_path = dir + "/server-" + std::to_string(index) + ".err";
+    std::string error;
+    if (!executor_->LaunchGang({s}, &error)) {
+      rec["pendingReason"] = "launch failed: " + error;
+      return 2;
+    }
+    rec["id"] = id;
+    rec["port"] = port;
+    rec["pid"] = executor_->Status(id).pid;
+    rec["ready"] = false;
+    rec["backoffUntil"] = Json();
+    rec["pendingReason"] = Json();
+    metrics_.replica_starts++;
+    return 0;
+  };
+
+  auto schedule_backoff = [&](Json& rec) {
+    int64_t restarts = rec.get("restarts").as_int(0);
+    rec["restarts"] = restarts + 1;
+    double delay =
+        std::min(60.0, std::pow(2.0, std::min<int64_t>(restarts, 6)));
+    rec["backoffUntil"] = now_s_ + delay;
+  };
+
+  if (rs.is_null() || !rs.get("id").is_string()) {
+    if (rs.is_null()) rs = Json::Object();
+    // Spawn failures back off (forking at tick rate is a fork bomb);
+    // capacity waits retry level-style — Allocate is cheap and the device
+    // may free any moment.
+    if (!rs.get("backoffUntil").is_number() ||
+        now_s_ >= rs.get("backoffUntil").as_number(0)) {
+      if (launch(rs) == 2) schedule_backoff(rs);
+    }
+    Json arr = Json::Array();
+    for (size_t i = 0; i < replicas.size(); ++i) {
+      arr.push_back(static_cast<int>(i) == index ? rs
+                                                 : replicas.elements()[i]);
+    }
+    v.status["replicaState"] = arr;
+    return;
+  }
+
+  auto st = executor_->Status(id);
+  if (st.phase == ProcessStatus::Phase::kRunning) {
+    bool ready = rs.get("ready").as_bool(false);
+    // Not-ready replicas probe every 1s; ready ones re-probe every 10s —
+    // the kubelet liveness analog, so a wedged-but-alive server drops out
+    // of the endpoint list instead of staying Ready forever.
+    double interval = ready ? 10.0 : 1.0;
+    if (now_s_ - rs.get("lastProbe").as_number(0) >= interval) {
+      rs["lastProbe"] = now_s_;
+      if (probe_->Ready(static_cast<int>(rs.get("port").as_int()))) {
+        rs["probeFails"] = 0;
+        if (!ready) {
+          rs["ready"] = true;
+          rs["readySince"] = now_s_;
+        }
+      } else if (ready) {
+        int64_t fails = rs.get("probeFails").as_int(0) + 1;
+        rs["probeFails"] = fails;
+        if (fails >= 2) rs["ready"] = false;  // wedged: pull endpoint
+      }
+    }
+  } else {
+    // Server exited — crash-loop with exponential backoff. A long stable
+    // run resets the streak so one crash a day doesn't accrue forever.
+    rs["ready"] = false;
+    if (!rs.get("backoffUntil").is_number()) {
+      if (rs.get("readySince").is_number() &&
+          now_s_ - rs.get("readySince").as_number(0) > 300) {
+        rs["restarts"] = 0;
+      }
+      schedule_backoff(rs);
+      rs["readySince"] = Json();
+      metrics_.replica_restarts++;
+    } else if (now_s_ >= rs.get("backoffUntil").as_number(0)) {
+      if (launch(rs) == 2) schedule_backoff(rs);  // keeps alloc, new port
+    }
+  }
+  Json arr = Json::Array();
+  for (size_t i = 0; i < replicas.size(); ++i) {
+    arr.push_back(static_cast<int>(i) == index ? rs
+                                               : replicas.elements()[i]);
+  }
+  v.status["replicaState"] = arr;
+}
+
+void ServeController::StopReplica(View& v, int index) {
+  const Json& replicas = v.status.get("replicaState");
+  if (!replicas.is_array() ||
+      index >= static_cast<int>(replicas.size())) {
+    return;
+  }
+  const Json& rs = replicas.elements()[index];
+  if (rs.is_object()) {
+    if (rs.get("id").is_string()) {
+      executor_->Kill(rs.get("id").as_string());
+    }
+    if (rs.get("alloc").is_object() && rs.get("alloc").size() > 0) {
+      scheduler_->Release(AllocFromJson(rs.get("alloc")));
+    }
+  }
+}
+
+int ServeController::DesiredReplicas(View& v) {
+  int64_t min_r = v.spec.get("min_replicas").as_int(
+      v.spec.get("replicas").as_int(1));
+  int64_t max_r = v.spec.get("max_replicas").as_int(min_r);
+  double target = v.spec.get("target_rps").as_number(0);
+  if (target <= 0 || max_r <= min_r) {
+    return static_cast<int>(v.spec.get("replicas").as_int(min_r));
+  }
+  // Throughput autoscaler: rps over the scrape interval / target per
+  // replica (KPA stand-in; no scale-to-zero).
+  Json as = v.status.get("autoscale").is_object()
+                ? v.status.get("autoscale")
+                : Json::Object();
+  int desired = static_cast<int>(as.get("desired").as_int(min_r));
+  double interval = v.spec.get("scale_interval_s").as_number(10);
+  double last_t = as.get("lastTime").as_number(0);
+  if (now_s_ - last_t >= interval) {
+    double total = 0;
+    bool any = false;
+    const Json& replicas = v.status.get("replicaState");
+    if (replicas.is_array()) {
+      for (const auto& rs : replicas.elements()) {
+        if (!rs.is_object() || !rs.get("ready").as_bool(false)) continue;
+        std::string body;
+        if (probe_->Metrics(static_cast<int>(rs.get("port").as_int()),
+                            &body)) {
+          total += ParseRequestsTotal(body);
+          any = true;
+        }
+      }
+    }
+    // A failed scrape keeps the previous baseline: zeroing lastTotal would
+    // make the next success count the full historical total as fresh load
+    // and spuriously scale to max.
+    if (any) {
+      if (last_t > 0) {
+        double rps =
+            std::max(0.0, total - as.get("lastTotal").as_number(0)) /
+            (now_s_ - last_t);
+        desired = static_cast<int>(std::ceil(rps / target));
+        desired = std::max(desired, static_cast<int>(min_r));
+        desired = std::min(desired, static_cast<int>(max_r));
+        if (desired != static_cast<int>(as.get("desired").as_int(min_r))) {
+          metrics_.scale_events++;
+          as["lastScaleTime"] = now_s_;
+        }
+      }
+      as["lastTotal"] = total;
+      as["lastTime"] = now_s_;
+      as["desired"] = desired;
+      v.status["autoscale"] = as;
+    }
+  }
+  return desired;
+}
+
+void ServeController::Reconcile(const std::string& name) {
+  auto res = store_->Get("InferenceService", name);
+  if (!res || res->deleted) return;
+  View v{*res, res->spec, res->status};
+
+  if (v.status.get("phase").as_string().empty()) {
+    metrics_.services_created++;
+  }
+
+  int desired = DesiredReplicas(v);
+  desired = std::max(desired, 0);
+
+  // Scale down: stop surplus replicas (highest index first).
+  Json replicas = v.status.get("replicaState").is_array()
+                      ? v.status.get("replicaState")
+                      : Json::Array();
+  if (static_cast<int>(replicas.size()) > desired) {
+    for (int i = static_cast<int>(replicas.size()) - 1; i >= desired; --i) {
+      StopReplica(v, i);
+    }
+    Json trimmed = Json::Array();
+    for (int i = 0; i < desired; ++i) {
+      trimmed.push_back(replicas.elements()[i]);
+    }
+    v.status["replicaState"] = trimmed;
+  }
+  // Scale up / keep alive.
+  for (int i = 0; i < desired; ++i) {
+    EnsureReplica(v, i);
+  }
+
+  // Aggregate status + endpoints.
+  int running = 0, ready = 0;
+  Json endpoints = Json::Array();
+  const Json& rss = v.status.get("replicaState");
+  if (rss.is_array()) {
+    for (size_t i = 0; i < rss.size(); ++i) {
+      const Json& rs = rss.elements()[i];
+      if (!rs.is_object() || !rs.get("id").is_string()) continue;
+      auto st = executor_->Status(rs.get("id").as_string());
+      if (st.phase == ProcessStatus::Phase::kRunning) {
+        ++running;
+        if (rs.get("ready").as_bool(false)) {
+          ++ready;
+          Json ep = Json::Object();
+          ep["replica"] = static_cast<int>(i);
+          ep["url"] = "http://127.0.0.1:" +
+                      std::to_string(rs.get("port").as_int());
+          endpoints.push_back(ep);
+        }
+      }
+    }
+  }
+  Json counts = Json::Object();
+  counts["desired"] = desired;
+  counts["running"] = running;
+  counts["ready"] = ready;
+  v.status["replicas"] = counts;
+  v.status["endpoints"] = endpoints;
+
+  std::string phase;
+  if (desired == 0) {
+    phase = "Ready";  // scaled to zero by hand
+  } else if (ready == desired) {
+    phase = "Ready";
+  } else if (running > 0) {
+    phase = "Running";
+  } else {
+    phase = "Pending";
+  }
+  const std::string prev = v.status.get("phase").as_string();
+  v.status["phase"] = phase;
+  if (prev != phase) {
+    if (!v.status.has("conditions")) v.status["conditions"] = Json::Array();
+    Json cond = Json::Object();
+    cond["type"] = phase;
+    cond["status"] = "True";
+    cond["reason"] = phase == "Ready" ? "AllReplicasReady" : "Reconciling";
+    cond["message"] = std::to_string(ready) + "/" +
+                      std::to_string(desired) + " replicas ready";
+    cond["lastTransitionTime"] = Timestamp(now_s_);
+    v.status["conditions"].push_back(cond);
+  }
+
+  if (v.status.dump() != res->status.dump()) {
+    store_->UpdateStatus("InferenceService", name, v.status);
+  }
+}
+
+void ServeController::Tick(double now_s) {
+  now_s_ = now_s;
+  for (const auto& res : store_->List("InferenceService")) {
+    Reconcile(res.name);
+  }
+}
+
+void ServeController::OnDeleted(const Resource& res) {
+  const Json& replicas = res.status.get("replicaState");
+  if (!replicas.is_array()) return;
+  for (const auto& rs : replicas.elements()) {
+    if (!rs.is_object()) continue;
+    if (rs.get("id").is_string()) {
+      executor_->Kill(rs.get("id").as_string());
+    }
+    if (rs.get("alloc").is_object() && rs.get("alloc").size() > 0) {
+      scheduler_->Release(AllocFromJson(rs.get("alloc")));
+    }
+  }
+}
+
+void ServeController::Recover() {
+  // Orphaned server processes from a previous control-plane incarnation:
+  // kill by recorded pid and relaunch fresh (allocations were rebuilt
+  // empty with the scheduler).
+  for (const auto& res : store_->List("InferenceService")) {
+    const Json& replicas = res.status.get("replicaState");
+    if (!replicas.is_array() || replicas.size() == 0) continue;
+    for (const auto& rs : replicas.elements()) {
+      int pid = static_cast<int>(
+          rs.is_object() ? rs.get("pid").as_int(-1) : -1);
+      // Whole process group, like JaxJobController::Recover — the server
+      // may have forked helpers (storage initializer) that must die too.
+      if (pid > 1) kill(-pid, SIGKILL);
+    }
+    Json status = res.status;
+    status["replicaState"] = Json::Array();
+    status["phase"] = "Pending";
+    store_->UpdateStatus("InferenceService", res.name, status);
+  }
+}
+
+}  // namespace tpk

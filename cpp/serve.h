@@ -1,0 +1,130 @@
+// InferenceService controller — the KServe control plane
+// (SURVEY.md §2.2, §3.3, §7.1 item 6).
+//
+// The reference reconciles `InferenceService` into Knative Services or raw
+// Deployments (⟨kserve: pkg/controller/v1beta1/inferenceservice/ —
+// InferenceServiceReconciler⟩) and delegates keep-alive/readiness/scaling
+// to kubelet probes + Knative KPA. Without Kubernetes, those collapse into
+// this controller: it keeps N long-running model-server replicas alive on
+// allocated devices, restarts crashed replicas with exponential backoff
+// (crash-loop semantics), probes `/v2/health/ready` for readiness, and
+// scales replica count between min/max from request throughput scraped off
+// each replica's `/metrics` (the simple concurrency autoscaler that stands
+// in for Knative KPA; scale-to-zero descoped per SURVEY.md §7.4).
+//
+// Spec:
+//   {"model": {"name": "m", "model_dir": "/bundle"} | {"storage_uri": ...},
+//    "replicas": 1,                     // manual scale (no autoscaler)
+//    "min_replicas": 1, "max_replicas": 4, "target_rps": 50,  // autoscaler
+//    "devices_per_replica": 1, "cpu_devices": 0,
+//    "max_batch_size": 32, "max_latency_ms": 5.0}
+
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <set>
+#include <string>
+
+#include "executor.h"
+#include "json.h"
+#include "scheduler.h"
+#include "store.h"
+
+namespace tpk {
+
+// Readiness + metrics probing, injectable for tests.
+class ProbeInterface {
+ public:
+  virtual ~ProbeInterface() = default;
+  virtual bool Ready(int port) = 0;
+  // Fetches /metrics; returns false if unreachable.
+  virtual bool Metrics(int port, std::string* body) = 0;
+};
+
+// Blocking-with-deadline HTTP/1.0 GET against 127.0.0.1 (the model servers
+// bind loopback; remote executors would bring their own prober).
+class HttpProbe : public ProbeInterface {
+ public:
+  explicit HttpProbe(int timeout_ms = 1500) : timeout_ms_(timeout_ms) {}
+  bool Ready(int port) override;
+  bool Metrics(int port, std::string* body) override;
+
+ private:
+  bool Get(int port, const std::string& path, std::string* body,
+           int* status);
+  int timeout_ms_;
+};
+
+class FakeProbe : public ProbeInterface {
+ public:
+  bool Ready(int port) override { return ready.count(port) > 0; }
+  bool Metrics(int port, std::string* body) override {
+    auto it = metrics.find(port);
+    if (it == metrics.end()) return false;
+    *body = it->second;
+    return true;
+  }
+  std::set<int> ready;
+  std::map<int, std::string> metrics;
+};
+
+struct ServeMetrics {
+  int64_t services_created = 0;
+  int64_t replica_starts = 0;
+  int64_t replica_restarts = 0;
+  int64_t scale_events = 0;
+
+  Json ToJson() const {
+    Json j = Json::Object();
+    j["services_created"] = services_created;
+    j["replica_starts"] = replica_starts;
+    j["replica_restarts"] = replica_restarts;
+    j["scale_events"] = scale_events;
+    return j;
+  }
+};
+
+class ServeController {
+ public:
+  ServeController(Store* store, ExecutorInterface* executor,
+                  Scheduler* scheduler, ProbeInterface* probe,
+                  std::string workdir, std::string python = "python3");
+
+  void Reconcile(const std::string& name);
+  void Tick(double now_s);
+  void OnDeleted(const Resource& res);
+
+  // Crash recovery: reap orphaned server processes after a control-plane
+  // restart (their pids are recorded in status).
+  void Recover();
+
+  ServeMetrics& metrics() { return metrics_; }
+
+  static std::string ProcId(const std::string& name, int replica);
+
+  // Sum of tpk_serve_requests_total across a Prometheus text body.
+  static double ParseRequestsTotal(const std::string& metrics_text);
+
+ private:
+  struct View {
+    Resource res;
+    Json spec;
+    Json status;
+  };
+
+  void EnsureReplica(View& v, int index);
+  void StopReplica(View& v, int index);
+  int DesiredReplicas(View& v);
+
+  Store* store_;
+  ExecutorInterface* executor_;
+  Scheduler* scheduler_;
+  ProbeInterface* probe_;
+  std::string workdir_;
+  std::string python_;
+  ServeMetrics metrics_;
+  double now_s_ = 0;
+};
+
+}  // namespace tpk

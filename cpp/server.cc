@@ -13,12 +13,14 @@ namespace tpk {
 
 Server::Server(Store* store, Scheduler* scheduler, JaxJobController* jaxjob,
                std::string socket_path, std::string workdir,
-               ExperimentController* tune, PipelineRunController* pipelines)
+               ExperimentController* tune, PipelineRunController* pipelines,
+               ServeController* serve)
     : store_(store),
       scheduler_(scheduler),
       jaxjob_(jaxjob),
       tune_(tune),
       pipelines_(pipelines),
+      serve_(serve),
       socket_path_(std::move(socket_path)),
       workdir_(std::move(workdir)) {}
 
@@ -106,6 +108,7 @@ Json Server::Dispatch(const Json& req) {
     Json m = jaxjob_ ? jaxjob_->metrics().ToJson() : Json::Object();
     if (tune_) m["tune"] = tune_->metrics().ToJson();
     if (pipelines_) m["pipelines"] = pipelines_->metrics().ToJson();
+    if (serve_) m["serve"] = serve_->metrics().ToJson();
     resp["metrics"] = m;
   } else if (op == "slices") {
     resp["ok"] = true;

@@ -18,6 +18,7 @@
 #include "json.h"
 #include "pipelines.h"
 #include "scheduler.h"
+#include "serve.h"
 #include "store.h"
 #include "tune.h"
 
@@ -28,7 +29,8 @@ class Server {
   Server(Store* store, Scheduler* scheduler, JaxJobController* jaxjob,
          std::string socket_path, std::string workdir,
          ExperimentController* tune = nullptr,
-         PipelineRunController* pipelines = nullptr);
+         PipelineRunController* pipelines = nullptr,
+         ServeController* serve = nullptr);
   ~Server();
 
   bool Start(std::string* error);
@@ -55,6 +57,7 @@ class Server {
   JaxJobController* jaxjob_;
   ExperimentController* tune_;
   PipelineRunController* pipelines_;
+  ServeController* serve_;
   std::string socket_path_;
   std::string workdir_;
   int listen_fd_ = -1;

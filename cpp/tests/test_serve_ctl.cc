@@ -1,0 +1,286 @@
+// InferenceService controller semantics against FakeExecutor + FakeProbe —
+// envtest-style (SURVEY.md §4.2): replica launch + readiness, crash-loop
+// backoff with streak reset, manual scaling, throughput autoscaling,
+// delete cleanup, and Prometheus parsing. No processes or HTTP.
+#include <cstdio>
+#include <string>
+
+#include "executor.h"
+#include "scheduler.h"
+#include "serve.h"
+#include "store.h"
+
+using tpk::FakeExecutor;
+using tpk::FakeProbe;
+using tpk::Json;
+using tpk::Scheduler;
+using tpk::ServeController;
+using tpk::Store;
+
+#define CHECK(cond)                                                   \
+  do {                                                                \
+    if (!(cond)) {                                                    \
+      fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+      return 1;                                                       \
+    }                                                                 \
+  } while (0)
+
+namespace {
+
+std::string Phase(Store& store, const std::string& name) {
+  auto r = store.Get("InferenceService", name);
+  return r ? r->status.get("phase").as_string() : "<gone>";
+}
+
+int Port(Store& store, const std::string& name, int replica) {
+  auto r = store.Get("InferenceService", name);
+  return static_cast<int>(r->status.get("replicaState")
+                              .elements()[replica]
+                              .get("port")
+                              .as_int());
+}
+
+Json BaseSpec(int replicas) {
+  Json spec = Json::Object();
+  Json model = Json::Object();
+  model["name"] = "m";
+  model["model_dir"] = "/tmp/bundle";
+  spec["model"] = model;
+  spec["replicas"] = replicas;
+  spec["devices_per_replica"] = 1;
+  return spec;
+}
+
+struct Harness {
+  Store store;
+  Scheduler sched;
+  FakeExecutor exec;
+  FakeProbe probe;
+  ServeController ctl{&store, &exec, &sched, &probe, "/tmp/tpk_test_serve"};
+  double now = 1000.0;
+
+  Harness(int capacity = 8) { sched.AddSlice("local", capacity); }
+
+  void Tick() {
+    ctl.Tick(now);
+    store.DrainWatches();
+  }
+};
+
+}  // namespace
+
+int main() {
+  // --- Prometheus parsing ----------------------------------------------
+  {
+    std::string text =
+        "# TYPE tpk_serve_requests_total counter\n"
+        "tpk_serve_requests_total{model=\"a\"} 120\n"
+        "tpk_serve_requests_total{model=\"b\"} 30.5\n"
+        "tpk_serve_examples_total{model=\"a\"} 999\n";
+    CHECK(ServeController::ParseRequestsTotal(text) == 150.5);
+    CHECK(ServeController::ParseRequestsTotal("") == 0);
+  }
+
+  // --- Launch + readiness gating ---------------------------------------
+  {
+    Harness h;
+    h.store.Create("InferenceService", "svc", BaseSpec(2));
+    h.Tick();
+    CHECK(h.exec.launched.size() == 2);
+    CHECK(h.exec.launched[0].argv[2] == "kubeflow_tpu.serve.server");
+    CHECK(h.exec.launched[0].env.at("TPK_SERVICE") == "svc");
+    CHECK(h.sched.Slices()[0].used == 2);
+    CHECK(Phase(h.store, "svc") == "Running");  // up but not ready
+
+    // Distinct ports; mark both ready via the probe.
+    int p0 = Port(h.store, "svc", 0), p1 = Port(h.store, "svc", 1);
+    CHECK(p0 != p1 && p0 > 0);
+    h.probe.ready = {p0, p1};
+    h.now += 2;  // probe rate limit
+    h.Tick();
+    CHECK(Phase(h.store, "svc") == "Ready");
+    auto r = h.store.Get("InferenceService", "svc");
+    CHECK(r->status.get("endpoints").size() == 2);
+    CHECK(r->status.get("endpoints").elements()[0].get("url").as_string() ==
+          "http://127.0.0.1:" + std::to_string(p0));
+    CHECK(h.ctl.metrics().replica_starts == 2);
+  }
+
+  // --- Crash loop: backoff, relaunch on new port, streak reset ----------
+  {
+    Harness h;
+    h.store.Create("InferenceService", "svc", BaseSpec(1));
+    h.Tick();
+    int p0 = Port(h.store, "svc", 0);
+    h.probe.ready = {p0};
+    h.now += 2;
+    h.Tick();
+    CHECK(Phase(h.store, "svc") == "Ready");
+
+    h.exec.Finish("svc/srv0", 1);  // server dies
+    h.Tick();
+    CHECK(Phase(h.store, "svc") == "Pending");
+    CHECK(h.ctl.metrics().replica_restarts == 1);
+    CHECK(h.exec.launched.size() == 1);  // backoff: not yet relaunched
+    h.now += 3;                          // past 2^0=1s... and 2s backoff
+    h.Tick();
+    CHECK(h.exec.launched.size() == 2);  // relaunched
+    int p1 = Port(h.store, "svc", 0);
+    CHECK(p1 != 0);
+    // Device allocation was retained across the restart (1 used, not 2).
+    CHECK(h.sched.Slices()[0].used == 1);
+
+    // Ready for >300s resets the crash streak.
+    h.probe.ready.insert(p1);
+    h.now += 2;
+    h.Tick();
+    CHECK(Phase(h.store, "svc") == "Ready");
+    h.now += 400;
+    h.Tick();
+    h.exec.Finish("svc/srv0", 137);
+    h.Tick();
+    auto r = h.store.Get("InferenceService", "svc");
+    CHECK(r->status.get("replicaState").elements()[0].get("restarts")
+              .as_int() == 1);  // streak reset, back to 1 (not 2)
+  }
+
+  // --- Manual scale down releases devices; delete cleans up -------------
+  {
+    Harness h;
+    h.store.Create("InferenceService", "svc", BaseSpec(3));
+    h.Tick();
+    CHECK(h.sched.Slices()[0].used == 3);
+
+    Json spec = BaseSpec(1);
+    h.store.UpdateSpec("InferenceService", "svc", spec);
+    h.Tick();
+    CHECK(h.exec.killed.size() == 2);
+    CHECK(h.sched.Slices()[0].used == 1);
+    auto r = h.store.Get("InferenceService", "svc");
+    CHECK(r->status.get("replicaState").size() == 1);
+
+    auto del = h.store.Delete("InferenceService", "svc");
+    h.ctl.OnDeleted(del.resource);
+    CHECK(h.exec.killed.size() == 3);
+    CHECK(h.sched.Slices()[0].used == 0);
+  }
+
+  // --- Throughput autoscaler: scale up on load, down when idle ----------
+  {
+    Harness h;
+    Json spec = BaseSpec(1);
+    spec["min_replicas"] = 1;
+    spec["max_replicas"] = 4;
+    spec["target_rps"] = 10;
+    spec["scale_interval_s"] = 10;
+    h.store.Create("InferenceService", "svc", spec);
+    h.Tick();
+    int p0 = Port(h.store, "svc", 0);
+    h.probe.ready = {p0};
+    h.probe.metrics[p0] = "tpk_serve_requests_total{model=\"m\"} 0\n";
+    h.now += 2;
+    h.Tick();  // first scrape: baseline
+    h.now += 11;
+    h.Tick();
+    CHECK(h.store.Get("InferenceService", "svc")
+              ->status.get("replicas").get("desired").as_int() == 1);
+
+    // 350 requests in ~10s → 35 rps → ceil(35/10)=4 replicas.
+    h.probe.metrics[p0] = "tpk_serve_requests_total{model=\"m\"} 350\n";
+    h.now += 11;
+    h.Tick();
+    auto r = h.store.Get("InferenceService", "svc");
+    CHECK(r->status.get("replicas").get("desired").as_int() == 4);
+    CHECK(h.ctl.metrics().scale_events == 1);
+    CHECK(h.exec.launched.size() == 4);
+
+    // All replicas ready, traffic stops → back to min.
+    for (int i = 0; i < 4; ++i) {
+      int p = Port(h.store, "svc", i);
+      h.probe.ready.insert(p);
+      h.probe.metrics[p] =
+          "tpk_serve_requests_total{model=\"m\"} " +
+          std::to_string(i == 0 ? 350 : 0) + "\n";
+    }
+    h.now += 2;
+    h.Tick();
+    CHECK(Phase(h.store, "svc") == "Ready");
+    h.now += 11;
+    h.Tick();  // scrape: totals unchanged → 0 rps → min
+    h.now += 1;
+    h.Tick();
+    r = h.store.Get("InferenceService", "svc");
+    CHECK(r->status.get("replicas").get("desired").as_int() == 1);
+    CHECK(h.sched.Slices()[0].used == 1);
+  }
+
+  // --- Liveness: wedged-but-alive server drops out of endpoints ---------
+  {
+    Harness h;
+    h.store.Create("InferenceService", "svc", BaseSpec(1));
+    h.Tick();
+    int p0 = Port(h.store, "svc", 0);
+    h.probe.ready = {p0};
+    h.now += 2;
+    h.Tick();
+    CHECK(Phase(h.store, "svc") == "Ready");
+
+    h.probe.ready.clear();  // server wedges: alive but unresponsive
+    h.now += 11;
+    h.Tick();  // probe fail #1 — still Ready (transient tolerance)
+    CHECK(Phase(h.store, "svc") == "Ready");
+    h.now += 11;
+    h.Tick();  // probe fail #2 — endpoint pulled
+    CHECK(Phase(h.store, "svc") == "Running");
+    CHECK(h.store.Get("InferenceService", "svc")
+              ->status.get("endpoints").size() == 0);
+    // Server answers again → back to Ready.
+    h.probe.ready = {p0};
+    h.now += 2;
+    h.Tick();
+    CHECK(Phase(h.store, "svc") == "Ready");
+  }
+
+  // --- Autoscaler: failed scrape keeps baseline (no spurious max) -------
+  {
+    Harness h;
+    Json spec = BaseSpec(1);
+    spec["min_replicas"] = 1;
+    spec["max_replicas"] = 4;
+    spec["target_rps"] = 10;
+    spec["scale_interval_s"] = 10;
+    h.store.Create("InferenceService", "svc", spec);
+    h.Tick();
+    int p0 = Port(h.store, "svc", 0);
+    h.probe.ready = {p0};
+    h.probe.metrics[p0] = "tpk_serve_requests_total{model=\"m\"} 200\n";
+    h.now += 2;
+    h.Tick();  // baseline total=200
+    h.probe.metrics.erase(p0);  // scrape outage
+    h.now += 11;
+    h.Tick();
+    // Outage over; totals unchanged → rps 0 over the long window, not
+    // (200-0)/10 → desired stays at min, no burst to max.
+    h.probe.metrics[p0] = "tpk_serve_requests_total{model=\"m\"} 200\n";
+    h.now += 11;
+    h.Tick();
+    CHECK(h.store.Get("InferenceService", "svc")
+              ->status.get("replicas").get("desired").as_int() == 1);
+    CHECK(h.ctl.metrics().scale_events == 0);
+  }
+
+  // --- Unschedulable: capacity 0 → Pending with reason ------------------
+  {
+    Harness h(0);
+    h.store.Create("InferenceService", "svc", BaseSpec(1));
+    h.Tick();
+    CHECK(Phase(h.store, "svc") == "Pending");
+    auto r = h.store.Get("InferenceService", "svc");
+    CHECK(r->status.get("replicaState").elements()[0].get("pendingReason")
+              .as_string().find("capacity") != std::string::npos);
+    CHECK(h.exec.launched.empty());
+  }
+
+  printf("test_serve_ctl OK\n");
+  return 0;
+}

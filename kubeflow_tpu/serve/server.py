@@ -321,7 +321,15 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--port", type=int, default=8080)
     p.add_argument("--max-batch-size", type=int, default=32)
     p.add_argument("--max-latency-ms", type=float, default=5.0)
+    p.add_argument("--cpu-devices", type=int, default=0,
+                   help="force N virtual CPU devices (test mode)")
     args = p.parse_args(argv)
+
+    if args.cpu_devices:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", args.cpu_devices)
 
     from kubeflow_tpu.serve import runtimes, storage
 

@@ -1,0 +1,127 @@
+"""InferenceService e2e (eval config 3 shape, CPU-sized): the C++ controller
+launches real model-server processes from an exported bundle, probes
+readiness over real HTTP, restarts a killed server, and scales on demand —
+the KServe predictor path with the controller standing in for
+Knative/kubelet (SURVEY.md §3.3)."""
+
+import json
+import os
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BIN = os.path.join(REPO, "build", "tpk-controlplane")
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(BIN), reason="tpk-controlplane not built")
+
+
+@pytest.fixture()
+def controlplane(tmp_path):
+    from kubeflow_tpu.controlplane.client import Client, start_controlplane
+
+    sock = str(tmp_path / "tpk.sock")
+    workdir = str(tmp_path / "work")
+    env_backup = dict(os.environ)
+    os.environ["TPK_CONTROLPLANE_BIN"] = BIN
+    os.environ["PYTHONPATH"] = REPO + os.pathsep + env_backup.get(
+        "PYTHONPATH", "")
+    proc = start_controlplane(sock, workdir, slices="local=8")
+    client = Client(sock)
+    try:
+        yield client, workdir, tmp_path
+    finally:
+        client.close()
+        proc.terminate()
+        proc.wait(timeout=10)
+        os.environ.clear()
+        os.environ.update(env_backup)
+
+
+def _wait_phase(client, name, want, timeout=120.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        phase = client.phase(name, kind="InferenceService")
+        if phase == want:
+            return
+        time.sleep(0.5)
+    raise TimeoutError(
+        f"{name} never reached {want}; status="
+        f"{client.get('InferenceService', name)['status']}")
+
+
+def _post(url, body):
+    req = urllib.request.Request(
+        url, method="POST", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def test_inference_service_lifecycle(controlplane):
+    from kubeflow_tpu.serve import export_for_serving
+
+    client, workdir, tmp = controlplane
+    bundle = str(tmp / "bundle")
+    export_for_serving(bundle, model="mnist_mlp",
+                       model_kwargs={"in_dim": 16, "hidden": [8],
+                                     "num_classes": 4},
+                       batch_buckets=(1, 4), seed=7)
+
+    client.create("InferenceService", "clf", {
+        "model": {"name": "clf", "model_dir": bundle},
+        "replicas": 1,
+        "devices_per_replica": 1,
+        "cpu_devices": 1,
+    })
+    _wait_phase(client, "clf", "Ready", timeout=120)
+
+    status = client.get("InferenceService", "clf")["status"]
+    assert status["replicas"] == {"desired": 1, "running": 1, "ready": 1}
+    url = status["endpoints"][0]["url"]
+
+    # v1 predict against the live endpoint.
+    x = np.random.default_rng(0).normal(size=(3, 16)).astype(np.float32)
+    out = _post(f"{url}/v1/models/clf:predict", {"instances": x.tolist()})
+    assert np.asarray(out["predictions"]).shape == (3, 4)
+
+    # Kill the server process → controller restarts it → Ready again with a
+    # fresh endpoint (crash-loop path).
+    pid = client.get("InferenceService", "clf")["status"]["replicaState"][0][
+        "pid"]
+    os.kill(pid, 9)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if client.phase("clf", kind="InferenceService") != "Ready":
+            break
+        time.sleep(0.2)
+    _wait_phase(client, "clf", "Ready", timeout=120)
+    status = client.get("InferenceService", "clf")["status"]
+    assert status["replicaState"][0]["restarts"] >= 1
+    out = _post(f"{status['endpoints'][0]['url']}/v1/models/clf:predict",
+                {"instances": x.tolist()})
+    assert np.asarray(out["predictions"]).shape == (3, 4)
+    assert client.metrics()["serve"]["replica_restarts"] >= 1
+
+    # Manual scale to 2 → both become Ready with distinct endpoints.
+    spec = client.get("InferenceService", "clf")["spec"]
+    spec["replicas"] = 2
+    client.update_spec("InferenceService", "clf", spec)
+    _wait_phase(client, "clf", "Ready", timeout=120)
+    status = client.get("InferenceService", "clf")["status"]
+    urls = {e["url"] for e in status["endpoints"]}
+    assert len(urls) == 2
+
+    # Delete → processes killed, devices released.
+    client.delete("InferenceService", "clf")
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if client.slices()[0]["used"] == 0:
+            break
+        time.sleep(0.2)
+    assert client.slices()[0]["used"] == 0
+    with pytest.raises(Exception):
+        _post(f"{url}/v1/models/clf:predict", {"instances": x.tolist()})
