@@ -1,5 +1,7 @@
 #include "jaxjob.h"
 
+#include "util.h"
+
 #include <netinet/in.h>
 #include <sys/socket.h>
 #include <signal.h>
@@ -12,36 +14,6 @@
 namespace tpk {
 
 namespace {
-
-double NowWall() { return static_cast<double>(time(nullptr)); }
-
-std::string Timestamp(double now_s) {
-  char buf[32];
-  time_t t = static_cast<time_t>(now_s);
-  struct tm tmv;
-  gmtime_r(&t, &tmv);
-  strftime(buf, sizeof(buf), "%Y-%m-%dT%H:%M:%SZ", &tmv);
-  return buf;
-}
-
-// Find a free TCP port for the jax.distributed coordinator.
-int FreePort() {
-  int fd = socket(AF_INET, SOCK_STREAM, 0);
-  if (fd < 0) return 0;
-  sockaddr_in addr{};
-  addr.sin_family = AF_INET;
-  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
-  addr.sin_port = 0;
-  int port = 0;
-  if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
-    socklen_t len = sizeof(addr);
-    if (getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len) == 0) {
-      port = ntohs(addr.sin_port);
-    }
-  }
-  close(fd);
-  return port;
-}
 
 bool IsTerminal(const std::string& phase) {
   return phase == "Succeeded" || phase == "Failed";

@@ -1,5 +1,7 @@
 #include "serve.h"
 
+#include "util.h"
+
 #include <fcntl.h>
 #include <netinet/in.h>
 #include <poll.h>
@@ -18,35 +20,6 @@
 namespace tpk {
 
 namespace {
-
-double NowWall() { return static_cast<double>(time(nullptr)); }
-
-std::string Timestamp(double now_s) {
-  char buf[32];
-  time_t t = static_cast<time_t>(now_s ? now_s : NowWall());
-  struct tm tmv;
-  gmtime_r(&t, &tmv);
-  strftime(buf, sizeof(buf), "%Y-%m-%dT%H:%M:%SZ", &tmv);
-  return buf;
-}
-
-int FreePort() {
-  int fd = socket(AF_INET, SOCK_STREAM, 0);
-  if (fd < 0) return 0;
-  sockaddr_in addr{};
-  addr.sin_family = AF_INET;
-  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
-  addr.sin_port = 0;
-  int port = 0;
-  if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
-    socklen_t len = sizeof(addr);
-    if (getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len) == 0) {
-      port = ntohs(addr.sin_port);
-    }
-  }
-  close(fd);
-  return port;
-}
 
 Allocation AllocFromJson(const Json& j) {
   Allocation a;
@@ -378,28 +351,45 @@ int ServeController::DesiredReplicas(View& v) {
   double interval = v.spec.get("scale_interval_s").as_number(10);
   double last_t = as.get("lastTime").as_number(0);
   if (now_s_ - last_t >= interval) {
-    double total = 0;
-    bool any = false;
+    // Per-replica (per-port) counter deltas: a restarted replica resets its
+    // counter to 0, and a replica whose scrape fails must be skipped — a
+    // global total would read either case as negative load and scale the
+    // service down under real traffic.
+    Json baselines = as.get("perReplica").is_object()
+                         ? as.get("perReplica")
+                         : Json::Object();
+    double delta = 0;
+    bool scraped = false, attempted = false;
     const Json& replicas = v.status.get("replicaState");
     if (replicas.is_array()) {
       for (const auto& rs : replicas.elements()) {
         if (!rs.is_object() || !rs.get("ready").as_bool(false)) continue;
+        attempted = true;
         std::string body;
-        if (probe_->Metrics(static_cast<int>(rs.get("port").as_int()),
-                            &body)) {
-          total += ParseRequestsTotal(body);
-          any = true;
+        int port = static_cast<int>(rs.get("port").as_int());
+        if (!probe_->Metrics(port, &body)) continue;  // baseline persists
+        double t = ParseRequestsTotal(body);
+        std::string key = std::to_string(port);
+        if (baselines.has(key)) {
+          double prev = baselines.get(key).as_number(0);
+          // Counter went backwards ⇒ server restarted on the same port:
+          // everything it now reports happened inside this window.
+          delta += t >= prev ? t - prev : t;
         }
+        // First successful scrape of a port only sets its baseline.
+        baselines[key] = t;
+        scraped = true;
       }
     }
-    // A failed scrape keeps the previous baseline: zeroing lastTotal would
-    // make the next success count the full historical total as fresh load
-    // and spuriously scale to max.
-    if (any) {
+    if (attempted) {
+      // Record the attempt time even when every scrape failed, so a
+      // wedged /metrics endpoint is retried once per interval, not once
+      // per 50ms loop tick.
+      as["lastTime"] = now_s_;
+    }
+    if (scraped) {
       if (last_t > 0) {
-        double rps =
-            std::max(0.0, total - as.get("lastTotal").as_number(0)) /
-            (now_s_ - last_t);
+        double rps = delta / (now_s_ - last_t);
         desired = static_cast<int>(std::ceil(rps / target));
         desired = std::max(desired, static_cast<int>(min_r));
         desired = std::min(desired, static_cast<int>(max_r));
@@ -408,11 +398,10 @@ int ServeController::DesiredReplicas(View& v) {
           as["lastScaleTime"] = now_s_;
         }
       }
-      as["lastTotal"] = total;
-      as["lastTime"] = now_s_;
+      as["perReplica"] = baselines;
       as["desired"] = desired;
-      v.status["autoscale"] = as;
     }
+    v.status["autoscale"] = as;
   }
   return desired;
 }
@@ -499,6 +488,17 @@ void ServeController::Reconcile(const std::string& name) {
                       std::to_string(desired) + " replicas ready";
     cond["lastTransitionTime"] = Timestamp(now_s_);
     v.status["conditions"].push_back(cond);
+    // Services have no terminal phase, so a crash-looping one flaps
+    // forever: keep only the newest conditions or the status (and every
+    // WAL rewrite of it) grows without bound.
+    const Json& conds = v.status.get("conditions");
+    if (conds.size() > 20) {
+      Json trimmed = Json::Array();
+      for (size_t i = conds.size() - 20; i < conds.size(); ++i) {
+        trimmed.push_back(conds.elements()[i]);
+      }
+      v.status["conditions"] = trimmed;
+    }
   }
 
   if (v.status.dump() != res->status.dump()) {

@@ -269,6 +269,35 @@ int main() {
     CHECK(h.ctl.metrics().scale_events == 0);
   }
 
+  // --- Autoscaler: replica counter reset (restart) is not negative load -
+  {
+    Harness h;
+    Json spec = BaseSpec(2);
+    spec["min_replicas"] = 2;  // autoscaler floor (spec.replicas unused
+    spec["max_replicas"] = 4;  // once target_rps engages the autoscaler)
+    spec["target_rps"] = 2;
+    spec["scale_interval_s"] = 10;
+    h.store.Create("InferenceService", "svc", spec);
+    h.Tick();
+    int p0 = Port(h.store, "svc", 0), p1 = Port(h.store, "svc", 1);
+    h.probe.ready = {p0, p1};
+    h.probe.metrics[p0] = "tpk_serve_requests_total{model=\"m\"} 100\n";
+    h.probe.metrics[p1] = "tpk_serve_requests_total{model=\"m\"} 100\n";
+    h.now += 2;
+    h.Tick();  // replicas become ready
+    h.now += 11;
+    h.Tick();  // baseline per replica recorded
+    // Replica 1 "restarted": counter reset to 10; replica 0 advanced 50.
+    h.probe.metrics[p0] = "tpk_serve_requests_total{model=\"m\"} 150\n";
+    h.probe.metrics[p1] = "tpk_serve_requests_total{model=\"m\"} 10\n";
+    h.now += 11;
+    h.Tick();
+    // delta = 50 + 10 = 60 over ~11s → ~5.5 rps → ceil(5.5/2) = 3, NOT a
+    // collapse to min from a "negative" global delta.
+    auto r = h.store.Get("InferenceService", "svc");
+    CHECK(r->status.get("replicas").get("desired").as_int() == 3);
+  }
+
   // --- Unschedulable: capacity 0 → Pending with reason ------------------
   {
     Harness h(0);

@@ -1,5 +1,7 @@
 #include "tune.h"
 
+#include "util.h"
+
 #include <fcntl.h>
 #include <poll.h>
 #include <signal.h>
@@ -17,17 +19,6 @@
 namespace tpk {
 
 namespace {
-
-double NowWall() { return static_cast<double>(time(nullptr)); }
-
-std::string Timestamp(double now_s) {
-  char buf[32];
-  time_t t = static_cast<time_t>(now_s ? now_s : NowWall());
-  struct tm tmv;
-  gmtime_r(&t, &tmv);
-  strftime(buf, sizeof(buf), "%Y-%m-%dT%H:%M:%SZ", &tmv);
-  return buf;
-}
 
 bool IsTerminalExp(const std::string& phase) {
   return phase == "Succeeded" || phase == "Failed";

@@ -1,0 +1,47 @@
+// Shared control-plane helpers (single home for what grew copies in each
+// controller: wall clock, RFC3339 timestamps, ephemeral port probing).
+
+#pragma once
+
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <ctime>
+#include <string>
+
+namespace tpk {
+
+inline double NowWall() { return static_cast<double>(time(nullptr)); }
+
+inline std::string Timestamp(double now_s) {
+  char buf[32];
+  time_t t = static_cast<time_t>(now_s ? now_s : NowWall());
+  struct tm tmv;
+  gmtime_r(&t, &tmv);
+  strftime(buf, sizeof(buf), "%Y-%m-%dT%H:%M:%SZ", &tmv);
+  return buf;
+}
+
+// Finds a free TCP port on loopback (coordinator/server endpoints). The
+// usual bind(0)/close race applies; callers treat collisions as a normal
+// launch failure and retry.
+inline int FreePort() {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return 0;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = 0;
+  int port = 0;
+  if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+    socklen_t len = sizeof(addr);
+    if (getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len) == 0) {
+      port = ntohs(addr.sin_port);
+    }
+  }
+  close(fd);
+  return port;
+}
+
+}  // namespace tpk

@@ -208,6 +208,7 @@ class MLPBlock(nn.Module):
 
 class DecoderLayer(nn.Module):
     cfg: LlamaConfig
+    mlp_cls: Any = None  # defaults to MLPBlock; models/moe.py swaps in MoE
 
     @nn.compact
     def __call__(self, x, cos, sin, positions, ring_axis=None,
@@ -217,7 +218,7 @@ class DecoderLayer(nn.Module):
         x = x + Attention(cfg, name="attn")(h, cos, sin, positions, ring_axis,
                                             standard_positions)
         h = RMSNorm(cfg.rms_eps, cfg.dtype, name="post_attn_norm")(x)
-        x = x + MLPBlock(cfg, name="mlp")(h)
+        x = x + (self.mlp_cls or MLPBlock)(cfg, name="mlp")(h)
         x = nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
         return x
 
@@ -226,6 +227,7 @@ class Llama(nn.Module):
     """Causal LM. __call__ returns logits [B, S, V]."""
 
     cfg: LlamaConfig
+    mlp_cls: Any = None  # per-layer FFN class (None = dense MLPBlock)
 
     @nn.compact
     def __call__(self, tokens: jax.Array, positions: jax.Array | None = None,
@@ -253,14 +255,14 @@ class Llama(nn.Module):
                 lambda mdl, carry, _: (mdl(carry, cos, sin, positions,
                                            ring_axis, standard_positions),
                                        None),
-                variable_axes={"params": 0},
+                variable_axes={"params": 0, "aux_loss": 0},
                 split_rngs={"params": True},
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
-            )(layer_cls(cfg, name="layers"), x, None)
+            )(layer_cls(cfg, self.mlp_cls, name="layers"), x, None)
         else:
             for i in range(cfg.num_layers):
-                x = layer_cls(cfg, name=f"layer_{i}")(
+                x = layer_cls(cfg, self.mlp_cls, name=f"layer_{i}")(
                     x, cos, sin, positions, ring_axis, standard_positions)
 
         x = RMSNorm(cfg.rms_eps, cfg.dtype, name="final_norm")(x)

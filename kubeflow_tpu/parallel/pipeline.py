@@ -1,0 +1,120 @@
+"""Pipeline parallelism: GPipe microbatch schedule over the `pipe` mesh axis.
+
+SURVEY.md §2.6 PP row: the reference launches DeepSpeed/Megatron pipeline
+engines (p2p send/recv of microbatches over NCCL) inside user containers.
+The TPU-native equivalent is a *compiled* schedule: stage-sharded weights
+(leading `stage` axis over the `pipe` mesh axis), a `lax.scan` over
+microbatch ticks, and `lax.ppermute` rotating activations stage→stage+1
+over the ICI ring. XLA overlaps the permute with the next tick's compute;
+reverse-mode AD differentiates straight through (ppermute transposes to the
+reverse rotation), so the same schedule serves fwd+bwd — no hand-written
+backward pipeline.
+
+The bubble is the standard GPipe (P-1)/(M+P-1) fraction: every stage
+computes on every tick, with garbage in the fill/drain ticks masked out of
+the result (wasted FLOPs, simple schedule — the 1F1B refinement is a
+schedule swap inside `pipeline_apply`, not an API change).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from jax import shard_map
+
+
+def stack_stage_params(per_stage_params: list[Any]) -> Any:
+    """Stacks per-stage pytrees into one pytree with a leading stage axis
+    (shard it over `pipe` via the `stage` logical axis / PartitionSpec)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    x: jax.Array,
+    *,
+    mesh: Mesh,
+    num_microbatches: int,
+    axis: str = "pipe",
+) -> jax.Array:
+    """Applies `stage_fn` P times in sequence, pipelined over microbatches.
+
+    stage_params: pytree whose leaves have leading dim P (one slice per
+      stage), sharded over mesh axis `axis`.
+    x: [B, ...] global batch, B divisible by num_microbatches; activations
+      must keep a constant shape across stages (transformer trunk shape).
+    Returns stage_{P-1}(...stage_0(x)) with identical numerics to the
+    sequential loop — the schedule only changes *when* each stage runs.
+    """
+    num_stages = mesh.shape[axis]
+    batch = x.shape[0]
+    if batch % num_microbatches:
+        raise ValueError(
+            f"batch {batch} not divisible by microbatches {num_microbatches}")
+    if num_microbatches < num_stages:
+        raise ValueError(
+            f"need microbatches ({num_microbatches}) >= stages "
+            f"({num_stages}) to fill the pipeline")
+    mb = batch // num_microbatches
+    xm = x.reshape(num_microbatches, mb, *x.shape[1:])
+
+    pspec = jax.tree.map(lambda _: P(axis), stage_params)
+    other = P()  # inputs/outputs replicated over the pipe axis
+
+    @partial(shard_map, mesh=mesh, in_specs=(pspec, other),
+             out_specs=other, check_vma=False)
+    def run(params, xm):
+        stage = jax.lax.axis_index(axis)
+        # Each shard holds its stage's slice with a leading dim of 1.
+        params = jax.tree.map(lambda p: p[0], params)
+        ticks = num_microbatches + num_stages - 1
+        outputs = jnp.zeros_like(xm)
+        buf = jnp.zeros_like(xm[0])  # activation arriving at this stage
+
+        def tick(carry, t):
+            buf, outputs = carry
+            in_idx = jnp.clip(t, 0, num_microbatches - 1)
+            h_in = jnp.where(stage == 0, xm[in_idx], buf)
+            h_out = stage_fn(params, h_in)
+            # Rotate stage -> stage+1 (last -> 0 carries drain garbage,
+            # overwritten before stage 0 reads it... stage 0 always reads
+            # xm, so the wraparound value is simply unused).
+            buf = jax.lax.ppermute(
+                h_out, axis,
+                [(i, (i + 1) % num_stages) for i in range(num_stages)])
+            # Last stage emits microbatch t-(P-1) once the pipe is full.
+            out_idx = jnp.clip(t - (num_stages - 1), 0,
+                               num_microbatches - 1)
+            valid = t >= num_stages - 1
+            prev = outputs[out_idx]
+            outputs = outputs.at[out_idx].set(
+                jnp.where(valid, h_out, prev))
+            return (buf, outputs), None
+
+        (buf, outputs), _ = jax.lax.scan(
+            tick, (buf, outputs), jnp.arange(ticks))
+        # Only the last stage holds real outputs; give every shard the
+        # same result (out_specs replicate over `axis`).
+        outputs = jnp.where(stage == num_stages - 1, outputs, 0.0)
+        outputs = jax.lax.psum(outputs, axis)
+        return outputs
+
+    out = run(stage_params, xm)
+    return out.reshape(batch, *out.shape[2:])
+
+
+def sequential_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
+                     stage_params: Any, x: jax.Array) -> jax.Array:
+    """Reference semantics of pipeline_apply (no pipelining) — for tests
+    and single-device fallback."""
+    num_stages = jax.tree.leaves(stage_params)[0].shape[0]
+    for i in range(num_stages):
+        params_i = jax.tree.map(lambda p: p[i], stage_params)
+        x = stage_fn(params_i, x)
+    return x

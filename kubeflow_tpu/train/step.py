@@ -90,12 +90,22 @@ def make_train_step(
     model_kwargs = model_kwargs or {}
 
     def compute_loss(params, batch):
-        logits = model.apply({"params": params}, batch["inputs"], **model_kwargs)
+        # mutable=["aux_loss"]: MoE routers sow load-balance penalties there
+        # (models/moe.py); dense models leave it empty.
+        logits, mutated = model.apply(
+            {"params": params}, batch["inputs"], mutable=["aux_loss"],
+            **model_kwargs)
         if isinstance(logits, tuple):  # models returning (hidden, logits)
             logits = logits[-1]
         if loss_fn is not None:
-            return loss_fn(logits, batch)
-        return cross_entropy_loss(logits, batch["targets"], batch.get("mask"))
+            main = loss_fn(logits, batch)
+        else:
+            main = cross_entropy_loss(logits, batch["targets"],
+                                      batch.get("mask"))
+        aux = jnp.zeros((), jnp.float32)
+        for leaf in jax.tree.leaves(mutated.get("aux_loss", {})):
+            aux = aux + jnp.sum(leaf)
+        return main + aux, aux
 
     def constrain_batch(x):
         # dim 0 is always the batch; dim 1 is the sequence only for
@@ -108,11 +118,12 @@ def make_train_step(
 
     def step(state: TrainState, batch: dict):
         batch = jax.tree.map(constrain_batch, batch)
-        loss, grads = jax.value_and_grad(compute_loss)(state.params, batch)
+        (loss, aux), grads = jax.value_and_grad(compute_loss, has_aux=True)(
+            state.params, batch)
         new_state = state.apply_gradients(grads)
         gnorm = optax.global_norm(grads)
-        return new_state, {"loss": loss, "grad_norm": gnorm,
-                           "step": new_state.step}
+        return new_state, {"loss": loss, "aux_loss": aux,
+                           "grad_norm": gnorm, "step": new_state.step}
 
     jitted = jax.jit(step, donate_argnums=(0,))
 

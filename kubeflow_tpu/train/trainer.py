@@ -232,6 +232,9 @@ class Trainer:
                     "mfu": perf["mfu"],
                     "step_time_s": perf["step_time_s"],
                 }
+                # MoE models report the router load-balance penalty too.
+                if float(metrics.get("aux_loss", 0.0)) > 0:
+                    last_metrics["aux_loss"] = float(metrics["aux_loss"])
                 self.logger.log(step + 1, last_metrics)
                 timer.start()
 

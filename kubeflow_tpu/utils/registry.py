@@ -93,6 +93,25 @@ def _ensure_builtin() -> None:
         import dataclasses
         return _llama(dataclasses.replace(llama.llama3_8b(), **kw))
 
+    from kubeflow_tpu.models import moe
+
+    def _moe(cfg):
+        return moe.MoELlama(cfg), {
+            "task": "lm", "example_shape": (1, 16), "example_dtype": "int32",
+            "num_params": cfg.num_params,
+            "active_params": cfg.active_params,
+            "vocab_size": cfg.vocab_size, "config": cfg}
+
+    @register_model("moe_tiny")
+    def _moe_tiny(**kw):
+        import dataclasses
+        return _moe(dataclasses.replace(moe.moe_tiny(), **kw))
+
+    @register_model("mixtral_8x7b")
+    def _mixtral_8x7b(**kw):
+        import dataclasses
+        return _moe(dataclasses.replace(moe.mixtral_8x7b(), **kw))
+
     @register_model("bert_tiny")
     def _bert_tiny(**kw):
         import dataclasses
