@@ -135,6 +135,49 @@ class Client:
             f"{kind} {name} did not reach {phases} in {timeout}s "
             f"(last phase: {self.phase(name, kind)!r})")
 
+    def train(self, name: str, *, model: str, dataset: str = "synthetic_lm",
+              model_kwargs: dict | None = None,
+              dataset_kwargs: dict | None = None,
+              num_workers: int = 1, devices_per_worker: int = 1,
+              cpu_devices_per_worker: int = 0,
+              steps: int = 100, batch_size: int = 8,
+              learning_rate: float = 1e-3, strategy: str = "dp",
+              mesh: dict | None = None, num_slices: int = 1,
+              checkpoint: dict | None = None,
+              restart_policy: str = "OnFailure", backoff_limit: int = 3,
+              log_every: int = 10, **runtime_extra) -> dict:
+        """High-level fine-tune entry point — `TrainingClient.train()`
+        parity (⟨training-operator: sdk/python — train()⟩, SURVEY.md §3.2):
+        fabricates the JAXJob from model/dataset names in the runtime
+        registry instead of requiring a hand-written spec."""
+        runtime = {
+            "model": model, "dataset": dataset,
+            "strategy": strategy, "steps": steps,
+            "batch_size": batch_size, "learning_rate": learning_rate,
+            "log_every": log_every,
+        }
+        if model_kwargs:
+            runtime["model_kwargs"] = model_kwargs
+        if dataset_kwargs:
+            runtime["dataset_kwargs"] = dataset_kwargs
+        if mesh:
+            runtime["mesh"] = mesh
+        if checkpoint:
+            runtime["checkpoint"] = checkpoint
+        runtime.update(runtime_extra)
+        spec = {
+            "replicas": num_workers,
+            "devices_per_proc": devices_per_worker,
+            "restart_policy": restart_policy,
+            "backoff_limit": backoff_limit,
+            "runtime": runtime,
+        }
+        if num_slices > 1:
+            spec["num_slices"] = num_slices
+        if cpu_devices_per_worker:
+            spec["cpu_devices_per_proc"] = cpu_devices_per_worker
+        return self.create("JAXJob", name, spec)
+
     def stream_metrics(self, name: str, replica: int = 0) -> Iterator[dict]:
         """Parses the worker's JSONL metric lines from its log."""
         for line in self.logs(name, replica, max_bytes=1 << 20).splitlines():

@@ -151,6 +151,17 @@ class V1PredictHandler(_Base):
             raise tornado.web.HTTPError(
                 400, reason='v1 request needs "instances"')
         t0 = time.monotonic()
+        if getattr(model, "wants_raw_payload", False):
+            # InferenceGraphs take the whole JSON body (routing fields
+            # included) and bypass the batcher — per-request routing can't
+            # survive cross-request coalescing.
+            out = await asyncio.get_event_loop().run_in_executor(
+                None, model.predict, body)
+            preds = out.get("instances") if isinstance(out, dict) else out
+            self.server.observe(name, len(instances),
+                                time.monotonic() - t0)
+            self.write_json({"predictions": np.asarray(preds).tolist()})
+            return
         # v1 protocol is single-tensor: "instances" stack along batch dim 0.
         spec = getattr(model, "input_spec", None)
         inputs = [np.asarray(instances, dtype=spec[0][1] if spec else None)]
@@ -195,8 +206,17 @@ class V2InferHandler(_Base):
             arr = np.asarray(t["data"], dtype=dtype).reshape(t["shape"])
             inputs.append(arr)
         t0 = time.monotonic()
-        fut = self.repo.batcher(name).submit(inputs)
-        outs = await asyncio.wrap_future(fut)
+        if getattr(model, "wants_raw_payload", False):
+            # Graph path: first tensor becomes "instances"; v2 request
+            # parameters ride along as routing fields.
+            payload = dict(body.get("parameters") or {})
+            payload["instances"] = inputs[0]
+            out = await asyncio.get_event_loop().run_in_executor(
+                None, model.predict, payload)
+            outs = [out.get("instances") if isinstance(out, dict) else out]
+        else:
+            fut = self.repo.batcher(name).submit(inputs)
+            outs = await asyncio.wrap_future(fut)
         outs = model.postprocess(outs)
         if not isinstance(outs, (list, tuple)):
             outs = [outs]

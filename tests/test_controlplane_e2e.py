@@ -82,6 +82,22 @@ def test_mnist_jaxjob_end_to_end(controlplane):
     assert client.metrics()["jobs_succeeded"] == 1
 
 
+def test_train_sdk(controlplane):
+    """TrainingClient.train() parity: the high-level call fabricates the
+    JAXJob spec from registry names (SURVEY.md §3.2)."""
+    client, sock, workdir, tmp = controlplane
+    client.train(
+        "sdktrain", model="mnist_mlp", dataset="mnist_like",
+        num_workers=1, devices_per_worker=2, cpu_devices_per_worker=2,
+        steps=120, batch_size=64, learning_rate=0.01,
+        strategy="dp", mesh={"data": 2}, log_every=20)
+    phase = client.wait_for_phase("sdktrain", timeout=240)
+    assert phase == "Succeeded", client.get("JAXJob", "sdktrain")
+    losses = [m["loss"] for m in client.stream_metrics("sdktrain")
+              if "loss" in m]
+    assert losses and min(losses[-2:]) < losses[0], losses
+
+
 def test_cli_surface(controlplane):
     client, sock, workdir, tmp = controlplane
     env = dict(os.environ, PYTHONPATH=REPO)

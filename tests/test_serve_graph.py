@@ -1,0 +1,231 @@
+"""InferenceGraph executor: node semantics (sequence/switch/ensemble/
+splitter), validation, and a graph served through the real HTTP model
+server composing sibling models (⟨kserve: cmd/router⟩ parity,
+SURVEY.md §2.2)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.serve import Model, ModelServer
+from kubeflow_tpu.serve.graph import GraphError, GraphExecutor, GraphModel
+
+
+def _registry_predict(registry):
+    def predict(name, payload):
+        return registry[name](payload)
+    return predict
+
+
+def test_sequence_chains_outputs():
+    fns = {"a": lambda p: p + "a", "b": lambda p: p + "b"}
+    g = GraphExecutor(
+        {"root": "seq",
+         "nodes": {"seq": {"type": "sequence",
+                           "steps": [{"model": "a"}, {"model": "b"}]}}},
+        _registry_predict(fns))
+    assert g("x") == "xab"
+
+
+def test_switch_routes_by_field_with_default():
+    fns = {"en": lambda p: "english", "xx": lambda p: "fallback"}
+    g = GraphExecutor(
+        {"root": "sw",
+         "nodes": {"sw": {"type": "switch", "field": "lang",
+                          "cases": {"en": {"model": "en"}},
+                          "default": {"model": "xx"}}}},
+        _registry_predict(fns))
+    assert g({"lang": "en"}) == "english"
+    assert g({"lang": "fr"}) == "fallback"
+    assert g({}) == "fallback"
+
+    g2 = GraphExecutor(
+        {"root": "sw",
+         "nodes": {"sw": {"type": "switch", "field": "lang",
+                          "cases": {"en": {"model": "en"}}}}},
+        _registry_predict(fns))
+    with pytest.raises(GraphError, match="no case"):
+        g2({"lang": "fr"})
+
+
+def test_ensemble_merges():
+    fns = {"m1": lambda p: [np.array([2.0, 4.0])],
+           "m2": lambda p: [np.array([4.0, 8.0])]}
+    spec = {"root": "e",
+            "nodes": {"e": {"type": "ensemble",
+                            "members": [{"model": "m1"}, {"model": "m2"}],
+                            "merge": "average"}}}
+    g = GraphExecutor(spec, _registry_predict(fns))
+    np.testing.assert_allclose(g(None)[0], [3.0, 6.0])
+
+    spec["nodes"]["e"]["merge"] = "concat"
+    outs = GraphExecutor(spec, _registry_predict(fns))(None)
+    np.testing.assert_allclose(outs[0], [2.0, 4.0, 4.0, 8.0])
+
+    spec["nodes"]["e"]["merge"] = "all"
+    outs = GraphExecutor(spec, _registry_predict(fns))(None)
+    assert outs == [[2.0, 4.0], [4.0, 8.0]]
+
+
+def test_splitter_weight_validation():
+    with pytest.raises(GraphError, match="weights"):
+        GraphExecutor(
+            {"root": "s",
+             "nodes": {"s": {"type": "splitter",
+                             "targets": [{"model": "a"}, {"model": "b"}],
+                             "weights": [0, 0]}}}, lambda n, p: p)
+    with pytest.raises(GraphError, match="weights"):
+        GraphExecutor(
+            {"root": "s",
+             "nodes": {"s": {"type": "splitter",
+                             "targets": [{"model": "a"}],
+                             "weights": [-1]}}}, lambda n, p: p)
+
+
+def test_splitter_respects_weights():
+    hits = {"v1": 0, "v2": 0}
+
+    def mk(name):
+        def fn(p):
+            hits[name] += 1
+            return name
+        return fn
+
+    g = GraphExecutor(
+        {"root": "s",
+         "nodes": {"s": {"type": "splitter",
+                         "targets": [{"model": "v1"}, {"model": "v2"}],
+                         "weights": [0.9, 0.1]}}},
+        _registry_predict({"v1": mk("v1"), "v2": mk("v2")}), seed=0)
+    for _ in range(300):
+        g(None)
+    assert hits["v1"] > 200 and hits["v2"] > 5  # ~270/30 expected
+
+
+def test_nested_nodes_and_validation():
+    fns = {"a": lambda p: p + 1, "b": lambda p: p * 10}
+    g = GraphExecutor(
+        {"root": "outer",
+         "nodes": {"outer": {"type": "sequence",
+                             "steps": [{"model": "a"}, {"node": "inner"}]},
+                   "inner": {"type": "sequence",
+                             "steps": [{"model": "b"}]}}},
+        _registry_predict(fns))
+    assert g(1) == 20
+
+    with pytest.raises(GraphError, match="root"):
+        GraphExecutor({"root": "nope", "nodes": {}}, lambda n, p: p)
+    with pytest.raises(GraphError, match="unknown node"):
+        GraphExecutor(
+            {"root": "s",
+             "nodes": {"s": {"type": "sequence",
+                             "steps": [{"node": "ghost"}]}}},
+            lambda n, p: p)
+    with pytest.raises(GraphError, match="unknown type"):
+        GraphExecutor({"root": "s", "nodes": {"s": {"type": "wat"}}},
+                      lambda n, p: p)
+    # Cycle: a -> a recursion guard trips instead of hanging.
+    g = GraphExecutor(
+        {"root": "a",
+         "nodes": {"a": {"type": "sequence", "steps": [{"node": "a"}]}}},
+        lambda n, p: p)
+    with pytest.raises(GraphError, match="depth"):
+        g(None)
+
+
+class Doubler(Model):
+    def predict(self, inputs):
+        return [np.asarray(inputs[0]) * 2]
+
+
+class AddOne(Model):
+    def predict(self, inputs):
+        return [np.asarray(inputs[0]) + 1]
+
+
+def test_graph_served_over_http():
+    """GraphModel registered like any model: /v1 predict walks the graph
+    against sibling models in the same repository."""
+    srv = ModelServer()
+    srv.repo.register(Doubler("dbl"))
+    srv.repo.register(AddOne("inc"))
+    graph = GraphModel(
+        "pipeline",
+        {"root": "seq",
+         "nodes": {"seq": {"type": "sequence",
+                           "steps": [{"model": "dbl"}, {"model": "inc"}]}}},
+        srv.repo)
+    srv.repo.register(graph)
+    port = srv.start_background()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/models/pipeline:predict",
+            method="POST",
+            data=json.dumps({"instances": [[1.0, 2.0]]}).encode())
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            out = json.loads(resp.read())
+        np.testing.assert_allclose(out["predictions"], [[3.0, 5.0]])
+
+        # Graph shows up in the v2 metadata surface.
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v2/models/pipeline",
+                timeout=10) as resp:
+            meta = json.loads(resp.read())
+        assert meta["platform"] == "tpk-inference-graph"
+    finally:
+        srv.stop()
+
+
+def test_switch_routes_on_request_fields_over_http():
+    """The raw-payload path: switch nodes see the JSON body's routing
+    fields, which the tensor-extracting handler path would strip."""
+    srv = ModelServer()
+    srv.repo.register(Doubler("dbl"))
+    srv.repo.register(AddOne("inc"))
+    graph = GraphModel(
+        "router",
+        {"root": "sw",
+         "nodes": {"sw": {"type": "switch", "field": "mode",
+                          "cases": {"double": {"model": "dbl"}},
+                          "default": {"model": "inc"}}}},
+        srv.repo)
+    srv.repo.register(graph)
+    port = srv.start_background()
+    try:
+        def predict(body):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/models/router:predict",
+                method="POST", data=json.dumps(body).encode())
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return json.loads(resp.read())["predictions"]
+
+        assert predict({"instances": [[3.0]], "mode": "double"}) == [[6.0]]
+        assert predict({"instances": [[3.0]], "mode": "other"}) == [[4.0]]
+        assert predict({"instances": [[3.0]]}) == [[4.0]]
+    finally:
+        srv.stop()
+
+
+def test_mutual_graph_recursion_capped():
+    srv = ModelServer()
+    a = GraphModel("ga", {"root": "s", "nodes": {
+        "s": {"type": "sequence", "steps": [{"model": "gb"}]}}}, srv.repo)
+    b = GraphModel("gb", {"root": "s", "nodes": {
+        "s": {"type": "sequence", "steps": [{"model": "ga"}]}}}, srv.repo)
+    srv.repo.register(a)
+    srv.repo.register(b)
+    with pytest.raises(GraphError, match="depth"):
+        a.predict({"instances": [[1.0]]})
+
+
+def test_graph_self_reference_rejected():
+    srv = ModelServer()
+    graph = GraphModel(
+        "loop",
+        {"root": "s",
+         "nodes": {"s": {"type": "sequence", "steps": [{"model": "loop"}]}}},
+        srv.repo)
+    with pytest.raises(GraphError, match="itself"):
+        graph.predict([np.array([1.0])])
