@@ -1,0 +1,138 @@
+// Admission validation — the validating-webhook layer (SURVEY.md §2.1
+// "Webhooks": upstream each job kind has a validating admission webhook
+// rejecting malformed specs before they reach the controllers; here the
+// API server validates on create/update_spec so users get a clean error at
+// submit time instead of a controller-side Failed phase later).
+
+#pragma once
+
+#include <string>
+
+#include "json.h"
+
+namespace tpk {
+
+// Returns "" when valid, else a human-readable rejection reason.
+inline std::string ValidateSpec(const std::string& kind, const Json& spec) {
+  if (!spec.is_object()) return "spec must be an object";
+
+  auto positive_int = [&](const char* field, int64_t dflt,
+                          int64_t min) -> std::string {
+    const Json& v = spec.get(field);
+    if (v.is_null()) {
+      return dflt >= min ? ""
+                         : std::string(field) + " is required";
+    }
+    if (!v.is_number()) return std::string(field) + " must be a number";
+    if (v.as_int() < min) {
+      return std::string(field) + " must be >= " + std::to_string(min);
+    }
+    return "";
+  };
+
+  if (kind == "JAXJob") {
+    std::string err;
+    if (!(err = positive_int("replicas", 1, 1)).empty()) return err;
+    if (!(err = positive_int("devices_per_proc", 1, 1)).empty()) return err;
+    if (!(err = positive_int("backoff_limit", 3, 0)).empty()) return err;
+    if (!(err = positive_int("num_slices", 1, 1)).empty()) return err;
+    const std::string policy = spec.get("restart_policy").as_string();
+    if (!policy.empty() && policy != "Never" && policy != "OnFailure" &&
+        policy != "ExitCode") {
+      return "restart_policy must be Never | OnFailure | ExitCode";
+    }
+    if (spec.get("command").is_array() &&
+        spec.get("command").size() == 0) {
+      return "command must be a non-empty argv array";
+    }
+    return "";
+  }
+
+  if (kind == "Experiment") {
+    if (!spec.get("parameters").is_array() ||
+        spec.get("parameters").size() == 0) {
+      return "parameters must be a non-empty array";
+    }
+    for (const auto& p : spec.get("parameters").elements()) {
+      if (p.get("name").as_string().empty()) {
+        return "every parameter needs a name";
+      }
+      const std::string t = p.get("type").as_string();
+      if (t == "categorical") {
+        if (!p.get("values").is_array() || p.get("values").size() == 0) {
+          return "categorical parameter " + p.get("name").as_string() +
+                 " needs values";
+        }
+      } else if (t.empty() || t == "double" || t == "int") {
+        if (!p.get("min").is_number() || !p.get("max").is_number()) {
+          return "parameter " + p.get("name").as_string() +
+                 " needs numeric min/max";
+        }
+      } else {
+        return "parameter " + p.get("name").as_string() +
+               ": unknown type " + t;
+      }
+    }
+    if (spec.get("objective").get("metric").as_string().empty()) {
+      return "objective.metric is required";
+    }
+    if (!spec.get("trial_template").is_object()) {
+      return "trial_template (a JAXJob spec) is required";
+    }
+    std::string err;
+    if (!(err = positive_int("max_trials", 10, 1)).empty()) return err;
+    if (!(err = positive_int("parallel_trials", 1, 1)).empty()) return err;
+    return ValidateSpec("JAXJob", spec.get("trial_template")).empty()
+               ? ""
+               : "trial_template: " +
+                     ValidateSpec("JAXJob", spec.get("trial_template"));
+  }
+
+  if (kind == "PipelineRun" || kind == "ScheduledPipelineRun") {
+    if (spec.get("pipeline").as_string().empty() &&
+        !spec.get("pipeline_spec").is_object()) {
+      return "spec needs `pipeline` (name) or inline `pipeline_spec`";
+    }
+    if (kind == "ScheduledPipelineRun") {
+      const Json& sched = spec.get("schedule");
+      if (!sched.is_object()) return "schedule is required";
+      bool has_interval = sched.get("interval_seconds").is_number();
+      bool has_cron = !sched.get("cron").as_string().empty();
+      if (has_interval == has_cron) {
+        return "schedule needs exactly one of interval_seconds | cron";
+      }
+      if (has_interval && sched.get("interval_seconds").as_number() <= 0) {
+        return "schedule.interval_seconds must be > 0";
+      }
+    }
+    return "";
+  }
+
+  if (kind == "InferenceService") {
+    const Json& model = spec.get("model");
+    if (!model.is_object()) return "model is required";
+    if (model.get("model_dir").as_string().empty() &&
+        model.get("storage_uri").as_string().empty()) {
+      return "model needs model_dir or storage_uri";
+    }
+    std::string err;
+    if (!(err = positive_int("devices_per_replica", 1, 1)).empty()) {
+      return err;
+    }
+    int64_t min_r = spec.get("min_replicas").as_int(0);
+    int64_t max_r = spec.get("max_replicas").as_int(min_r);
+    if (min_r < 0) return "min_replicas must be >= 0";
+    if (max_r < min_r) return "max_replicas must be >= min_replicas";
+    if (spec.get("replicas").is_number() &&
+        spec.get("replicas").as_int() < 0) {
+      return "replicas must be >= 0";
+    }
+    return "";
+  }
+
+  // Unknown kinds (Pipeline IR, Trial internals, user resources) pass —
+  // the store is schema-free by design, like CRDs without a webhook.
+  return "";
+}
+
+}  // namespace tpk

@@ -132,6 +132,122 @@ Json LineageStore::Lookup(const std::string& fingerprint) const {
 }
 
 // --------------------------------------------------------------------------
+// ScheduleController
+// --------------------------------------------------------------------------
+
+namespace {
+
+// One cron field: "*", "*/n", or comma-separated values.
+bool CronFieldMatches(const std::string& field, int value, int base,
+                      std::string* error) {
+  if (field == "*") return true;
+  if (field.rfind("*/", 0) == 0) {
+    int n = atoi(field.c_str() + 2);
+    if (n <= 0) {
+      if (error) *error = "bad cron step: " + field;
+      return false;
+    }
+    return (value - base) % n == 0;
+  }
+  size_t pos = 0;
+  while (pos <= field.size()) {
+    size_t comma = field.find(',', pos);
+    if (comma == std::string::npos) comma = field.size();
+    std::string part = field.substr(pos, comma - pos);
+    char* end = nullptr;
+    long v = strtol(part.c_str(), &end, 10);
+    if (end == part.c_str() || *end != '\0') {
+      if (error) *error = "bad cron value: " + part;
+      return false;
+    }
+    if (static_cast<int>(v) == value) return true;
+    pos = comma + 1;
+  }
+  return false;
+}
+
+}  // namespace
+
+bool ScheduleController::CronMatches(const std::string& cron, time_t t,
+                                     std::string* error) {
+  std::vector<std::string> fields;
+  size_t pos = 0;
+  while (pos < cron.size()) {
+    size_t sp = cron.find(' ', pos);
+    if (sp == std::string::npos) sp = cron.size();
+    if (sp > pos) fields.push_back(cron.substr(pos, sp - pos));
+    pos = sp + 1;
+  }
+  if (fields.size() != 5) {
+    if (error) *error = "cron needs 5 fields (m h dom mon dow)";
+    return false;
+  }
+  struct tm tmv;
+  gmtime_r(&t, &tmv);
+  const int values[5] = {tmv.tm_min, tmv.tm_hour, tmv.tm_mday,
+                         tmv.tm_mon + 1, tmv.tm_wday};
+  const int bases[5] = {0, 0, 1, 1, 0};
+  for (int i = 0; i < 5; ++i) {
+    if (!CronFieldMatches(fields[i], values[i], bases[i], error)) {
+      return false;
+    }
+  }
+  return true;
+}
+
+void ScheduleController::Tick(double now_s) {
+  for (const auto& res : store_->List("ScheduledPipelineRun")) {
+    if (res.spec.get("suspend").as_bool(false)) continue;
+    Json status = res.status;
+    int64_t created = status.get("runsCreated").as_int(0);
+    int64_t max_runs = res.spec.get("max_runs").as_int(-1);
+    if (max_runs >= 0 && created >= max_runs) continue;
+
+    const Json& sched = res.spec.get("schedule");
+    double last = status.get("lastRunUnix").as_number(0);
+    bool fire = false;
+    if (sched.get("interval_seconds").is_number()) {
+      fire = now_s - last >= sched.get("interval_seconds").as_number();
+    } else {
+      const std::string cron = sched.get("cron").as_string();
+      std::string err;
+      time_t t = static_cast<time_t>(now_s);
+      // Fire at most once per matching minute.
+      bool same_minute =
+          last > 0 && static_cast<int64_t>(last) / 60 ==
+                          static_cast<int64_t>(now_s) / 60;
+      fire = !same_minute && CronMatches(cron, t, &err);
+      if (!err.empty() && status.get("scheduleError").as_string() != err) {
+        status["scheduleError"] = err;
+        store_->UpdateStatus("ScheduledPipelineRun", res.name, status);
+        continue;
+      }
+    }
+    if (!fire) continue;
+
+    Json run_spec = Json::Object();
+    if (res.spec.get("pipeline_spec").is_object()) {
+      run_spec["pipeline_spec"] = res.spec.get("pipeline_spec");
+    } else {
+      run_spec["pipeline"] = res.spec.get("pipeline");
+    }
+    if (res.spec.get("params").is_object()) {
+      run_spec["params"] = res.spec.get("params");
+    }
+    std::string run_name = res.name + "-" + std::to_string(created + 1);
+    auto r = store_->Create("PipelineRun", run_name, run_spec);
+    if (r.ok) {
+      ++runs_created_;
+      status["runsCreated"] = created + 1;
+      status["lastRunUnix"] = now_s;
+      status["lastRunTime"] = Timestamp(now_s);
+      status["lastRun"] = run_name;
+      store_->UpdateStatus("ScheduledPipelineRun", res.name, status);
+    }
+  }
+}
+
+// --------------------------------------------------------------------------
 // PipelineRunController
 // --------------------------------------------------------------------------
 

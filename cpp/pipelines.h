@@ -72,6 +72,29 @@ struct PipelineMetrics {
   }
 };
 
+// ScheduledPipelineRun — the ScheduledWorkflow/recurring-run controller
+// (⟨pipelines: backend/src/crd/controller/scheduledworkflow⟩, SURVEY.md
+// §2.4): spec {pipeline|pipeline_spec, params, schedule:
+// {interval_seconds: N} | {cron: "m h dom mon dow"}, suspend, max_runs}.
+// Each firing materializes a PipelineRun named <name>-<n>.
+class ScheduleController {
+ public:
+  explicit ScheduleController(Store* store) : store_(store) {}
+
+  void Tick(double now_s);
+
+  int64_t runs_created() const { return runs_created_; }
+
+  // Does `cron` ("m h dom mon dow"; fields: *, */n, or comma list) match
+  // the given UTC time? Exposed for tests.
+  static bool CronMatches(const std::string& cron, time_t t,
+                          std::string* error = nullptr);
+
+ private:
+  Store* store_;
+  int64_t runs_created_ = 0;
+};
+
 class PipelineRunController {
  public:
   PipelineRunController(Store* store, LineageStore* lineage,

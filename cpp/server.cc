@@ -1,5 +1,7 @@
 #include "server.h"
 
+#include "admission.h"
+
 #include <fcntl.h>
 #include <poll.h>
 #include <sys/socket.h>
@@ -75,7 +77,13 @@ Json Server::Dispatch(const Json& req) {
     resp["ok"] = true;
     resp["pong"] = true;
   } else if (op == "create") {
-    fill(store_->Create(kind, name, req.get("spec")));
+    std::string veto = ValidateSpec(kind, req.get("spec"));
+    if (!veto.empty()) {
+      resp["ok"] = false;
+      resp["error"] = "invalid " + kind + " spec: " + veto;
+    } else {
+      fill(store_->Create(kind, name, req.get("spec")));
+    }
   } else if (op == "get") {
     auto r = store_->Get(kind, name);
     resp["ok"] = r.has_value();
@@ -92,10 +100,16 @@ Json Server::Dispatch(const Json& req) {
     }
     resp["items"] = items;
   } else if (op == "update_spec") {
-    fill(store_->UpdateSpec(kind, name, req.get("spec"),
-                            req.get("expected_version").is_number()
-                                ? req.get("expected_version").as_int()
-                                : -1));
+    std::string veto = ValidateSpec(kind, req.get("spec"));
+    if (!veto.empty()) {
+      resp["ok"] = false;
+      resp["error"] = "invalid " + kind + " spec: " + veto;
+    } else {
+      fill(store_->UpdateSpec(kind, name, req.get("spec"),
+                              req.get("expected_version").is_number()
+                                  ? req.get("expected_version").as_int()
+                                  : -1));
+    }
   } else if (op == "update_status") {
     fill(store_->UpdateStatus(kind, name, req.get("status"),
                               req.get("expected_version").is_number()
