@@ -1,4 +1,4 @@
-"""Pallas TPU flash attention (forward kernel + recompute VJP).
+"""Pallas TPU flash attention (fused forward + fused two-pass backward).
 
 The hot op of the flagship model. The reference platform has no kernels at
 all (GPU attention lived in user containers: flash-attn/vLLM; SURVEY.md
@@ -7,6 +7,15 @@ model (/opt/skills/guides/pallas_guide.md): online-softmax blockwise
 attention; Q blocks in VMEM stream over K/V blocks; fp32 accumulators;
 causal upper blocks skipped entirely (not masked) so the causal speedup is
 real wall-clock, not just masking.
+
+Backward is the standard two-pass flash recipe with saved row stats:
+the forward additionally writes LSE (logsumexp per q row); the backward
+precomputes delta = rowsum(dO·O), then
+  * a dq kernel over (batch·head, q blocks) streaming visible kv blocks,
+  * a dk/dv kernel over (batch·kv-head, kv blocks) streaming the visible q
+    blocks of every q head in the GQA group (zero-copy: the grouped q/dO
+    views are reshapes, never materialized per-head copies).
+Neither pass materializes an O(S·T) score matrix in HBM.
 
 Layout: q [B, S, H, D], k/v [B, T, KH, D] with GQA (H % KH == 0). The grid
 is (B*H, Q_blocks); each program owns one q block and loops over its visible
@@ -22,12 +31,12 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+from jax.experimental.pallas import tpu as pltpu  # noqa: F401  (TPU lowering)
 
 NEG_INF = -1e30
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int,
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
                       block_kv: int, seq_kv: int, causal: bool,
                       sm_scale: float):
     qi = pl.program_id(1)
@@ -73,6 +82,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int,
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc, m, l = jax.lax.fori_loop(0, num_visible, body, (acc0, m0, l0))
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    # Row logsumexp of the scaled scores — the backward's softmax residual.
+    lse_ref[0] = m + jnp.log(jnp.maximum(l, 1e-30))
 
 
 def _flash_fwd(q3, k3, v3, *, group: int, causal: bool, block_q: int,
@@ -80,7 +91,7 @@ def _flash_fwd(q3, k3, v3, *, group: int, causal: bool, block_q: int,
     """q3 [B*H, S, D]; k3/v3 [B*KH, T, D], padded to block multiples; GQA is
     served zero-copy by the K/V index_map (q program bh reads kv row
     bh // group, since bh = batch*H + qh and H = KH*group). seq_kv is the
-    pre-padding key length used for masking."""
+    pre-padding key length used for masking. Returns (o3, lse [B*H, S])."""
     bh, s, d = q3.shape
     t = k3.shape[1]
     grid = (bh, pl.cdiv(s, block_q))
@@ -95,10 +106,127 @@ def _flash_fwd(q3, k3, v3, *, group: int, causal: bool, block_q: int,
             pl.BlockSpec((1, t, d), lambda b, i: (b // group, 0, 0)),
             pl.BlockSpec((1, t, d), lambda b, i: (b // group, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, s, d), q3.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), q3.dtype),
+            jax.ShapeDtypeStruct((bh, s, 1), jnp.float32),
+        ],
         interpret=interpret,
     )(q3, k3, v3)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, *, block_q: int, block_kv: int, seq_q: int,
+                         seq_kv: int, causal: bool, sm_scale: float):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * sm_scale      # [bq, D]
+    do = do_ref[0].astype(jnp.float32)               # [bq, D]
+    lse = lse_ref[0]                                 # [bq, 1]
+    delta = delta_ref[0]                             # [bq, 1]
+    rows = jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_kv), 0) + qi * block_q
+
+    num_kv_blocks = pl.cdiv(seq_kv, block_kv)
+    if causal:
+        last = (qi + 1) * block_q - 1
+        num_visible = jnp.minimum((last // block_kv) + 1, num_kv_blocks)
+    else:
+        num_visible = num_kv_blocks
+
+    def body(j, acc):
+        k = k_ref[0, pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        cols = jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 1) + j * block_kv
+        valid = jnp.logical_and(cols < seq_kv, rows < seq_q)
+        if causal:
+            valid = jnp.logical_and(valid, rows >= cols)
+        # p from saved row stats; masked (incl. padded q rows, whose lse is
+        # garbage) to exactly zero so no NaN/inf leaks into the matmuls.
+        p = jnp.where(valid, jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return acc + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    d = q_ref.shape[-1]
+    acc = jax.lax.fori_loop(0, num_visible, body,
+                            jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0] = (acc * sm_scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
+                          dk_ref, dv_ref, *, block_q: int, block_kv: int,
+                          seq_q: int, seq_kv: int, seq_q_pad: int, group: int,
+                          causal: bool, sm_scale: float):
+    j = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)                 # [bkv, D]
+    v = v_ref[0].astype(jnp.float32)
+    cols = jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_kv), 1) + j * block_kv
+    kv_valid = cols < seq_kv
+
+    num_q_blocks = seq_q_pad // block_q
+    if causal:
+        first = jnp.minimum((j * block_kv) // block_q, num_q_blocks)
+    else:
+        first = 0
+
+    d = q_ref.shape[-1]
+    dk0 = jnp.zeros((block_kv, d), jnp.float32)
+    dv0 = jnp.zeros((block_kv, d), jnp.float32)
+
+    def make_body(g):
+        base = g * seq_q_pad
+
+        def body(qi, carry):
+            dk, dv = carry
+            off = base + qi * block_q
+            q = q_ref[0, pl.ds(off, block_q), :].astype(
+                jnp.float32) * sm_scale
+            do = do_ref[0, pl.ds(off, block_q), :].astype(jnp.float32)
+            lse = lse_ref[0, pl.ds(off, block_q), :]
+            delta = delta_ref[0, pl.ds(off, block_q), :]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            rows = jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 0) + qi * block_q
+            valid = jnp.logical_and(kv_valid, rows < seq_q)
+            if causal:
+                valid = jnp.logical_and(valid, rows >= cols)
+            p = jnp.where(valid, jnp.exp(s - lse), 0.0)
+            dv_new = dv + jax.lax.dot_general(
+                p, do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dp = jax.lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            ds = p * (dp - delta)
+            dk_new = dk + jax.lax.dot_general(
+                ds, q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return dk_new, dv_new
+
+        return body
+
+    dk, dv = dk0, dv0
+    for g in range(group):  # static, small (GQA group)
+        dk, dv = jax.lax.fori_loop(first, num_q_blocks, make_body(g),
+                                   (dk, dv))
+    # q in the score matmul carried sm_scale; dk restores the q-side factor
+    # so dk is d/dk of (q·k·scale): ds already includes the scale via q.
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
 def _flatten_heads(q, k, v):
@@ -112,61 +240,138 @@ def _flatten_heads(q, k, v):
     return q3, k3, v3
 
 
+def _pad_seq(x3, block):
+    pad = -x3.shape[1] % block
+    if pad:
+        x3 = jnp.pad(x3, ((0, 0), (0, pad), (0, 0)))
+    return x3
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q, k, v, causal: bool = True, block_q: int = 512,
                     block_kv: int = 512, interpret: bool | None = None):
     """Flash attention. q [B,S,H,D]; k,v [B,T,KH,D]; returns [B,S,H,D].
 
-    Forward runs the Pallas kernel (O(S) memory); backward recomputes via
-    the einsum formulation under jax.checkpoint semantics — correct, and
-    memory-bounded by the backward's own S×T blocks. A fused Pallas
-    backward is a planned optimization (tracked in ops/ROADMAP.md)."""
-    return _attn_reference(q, k, v, causal, block_q, block_kv, interpret)
+    Forward and backward both run fused Pallas kernels (O(S) memory); the
+    backward uses the saved LSE row stats (two-pass dq then dk/dv)."""
+    out, _ = _attn_impl(q, k, v, causal, block_q, block_kv, interpret)
+    return out
 
 
-def _attn_reference(q, k, v, causal, block_q, block_kv, interpret):
-    b, s, h, d = q.shape
-    t = k.shape[1]
+def _resolve(q, k, block_q, block_kv, interpret):
     if interpret is None:
         interpret = jax.default_backend() not in ("tpu",)
-    sm_scale = 1.0 / (d ** 0.5)
-    kh = k.shape[2]
+    s, t = q.shape[1], k.shape[1]
+    block_q = min(block_q, max(s, 1))
+    block_kv = min(block_kv, max(t, 1))
+    return block_q, block_kv, interpret
+
+
+def _attn_impl(q, k, v, causal, block_q, block_kv, interpret):
+    b, s, h, d = q.shape
+    t, kh = k.shape[1], k.shape[2]
     if h % kh:
         raise ValueError(f"q heads {h} not a multiple of kv heads {kh}")
+    block_q, block_kv, interpret = _resolve(q, k, block_q, block_kv,
+                                            interpret)
+    sm_scale = 1.0 / (d ** 0.5)
     q3, k3, v3 = _flatten_heads(q, k, v)
     # Pad sequences to block multiples: unpadded dynamic slices would clamp
     # at the boundary and silently misalign kv columns. The kernel masks
     # padded keys via its seq_kv bound; padded q rows are sliced off here.
-    block_q = min(block_q, max(s, 1))
-    block_kv = min(block_kv, max(t, 1))
-    s_pad = -s % block_q
-    t_pad = -t % block_kv
-    if s_pad:
-        q3 = jnp.pad(q3, ((0, 0), (0, s_pad), (0, 0)))
-    if t_pad:
-        k3 = jnp.pad(k3, ((0, 0), (0, t_pad), (0, 0)))
-        v3 = jnp.pad(v3, ((0, 0), (0, t_pad), (0, 0)))
-    o3 = _flash_fwd(q3, k3, v3, group=h // kh, causal=causal, block_q=block_q,
-                    block_kv=block_kv, seq_kv=t, sm_scale=sm_scale,
-                    interpret=interpret)
-    o3 = o3[:, :s]
-    return o3.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    q3 = _pad_seq(q3, block_q)
+    k3 = _pad_seq(k3, block_kv)
+    v3 = _pad_seq(v3, block_kv)
+    o3, lse = _flash_fwd(q3, k3, v3, group=h // kh, causal=causal,
+                         block_q=block_q, block_kv=block_kv, seq_kv=t,
+                         sm_scale=sm_scale, interpret=interpret)
+    out = o3[:, :s].reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    return out, (o3, lse)
 
 
 def _flash_fwd_rule(q, k, v, causal, block_q, block_kv, interpret):
-    out = _attn_reference(q, k, v, causal, block_q, block_kv, interpret)
-    return out, (q, k, v)
+    out, (o3, lse) = _attn_impl(q, k, v, causal, block_q, block_kv,
+                                interpret)
+    return out, (q, k, v, o3, lse)
 
 
 def _flash_bwd_rule(causal, block_q, block_kv, interpret, res, g):
-    q, k, v = res
+    q, k, v, o3, lse = res
+    b, s, h, d = q.shape
+    t, kh = k.shape[1], k.shape[2]
+    group = h // kh
+    block_q, block_kv, interpret = _resolve(q, k, block_q, block_kv,
+                                            interpret)
+    sm_scale = 1.0 / (d ** 0.5)
 
-    def ref(q, k, v):
-        from kubeflow_tpu.ops.reference import naive_attention
-        return naive_attention(q, k, v, causal=causal)
+    q3, k3, v3 = _flatten_heads(q, k, v)
+    q3 = _pad_seq(q3, block_q)
+    k3 = _pad_seq(k3, block_kv)
+    v3 = _pad_seq(v3, block_kv)
+    do3 = _pad_seq(g.transpose(0, 2, 1, 3).reshape(b * h, s, d), block_q)
+    s_pad, t_pad = q3.shape[1], k3.shape[1]
+    bh, bkh = b * h, b * kh
 
-    _, vjp = jax.vjp(ref, q, k, v)
-    return vjp(g)
+    # delta_i = rowsum(dO_i · O_i) — the softmax-normalization term.
+    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+
+    dq_kernel = functools.partial(
+        _flash_bwd_dq_kernel, block_q=block_q, block_kv=block_kv, seq_q=s,
+        seq_kv=t, causal=causal, sm_scale=sm_scale)
+    dq3 = pl.pallas_call(
+        dq_kernel,
+        grid=(bh, s_pad // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bi, i: (bi, i, 0)),
+            pl.BlockSpec((1, t_pad, d), lambda bi, i: (bi // group, 0, 0)),
+            pl.BlockSpec((1, t_pad, d), lambda bi, i: (bi // group, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bi, i: (bi, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bi, i: (bi, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bi, i: (bi, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bi, i: (bi, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s_pad, d), q.dtype),
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse, delta)
+
+    # Grouped (per kv head) views of the q-side tensors: pure reshapes of the
+    # [B*H, ...] layout since q head h serves kv head h // group.
+    qg = q3.reshape(bkh, group * s_pad, d)
+    dog = do3.reshape(bkh, group * s_pad, d)
+    lseg = lse.reshape(bkh, group * s_pad, 1)
+    deltag = delta.reshape(bkh, group * s_pad, 1)
+
+    dkv_kernel = functools.partial(
+        _flash_bwd_dkv_kernel, block_q=block_q, block_kv=block_kv, seq_q=s,
+        seq_kv=t, seq_q_pad=s_pad, group=group, causal=causal,
+        sm_scale=sm_scale)
+    dk3, dv3 = pl.pallas_call(
+        dkv_kernel,
+        grid=(bkh, t_pad // block_kv),
+        in_specs=[
+            pl.BlockSpec((1, group * s_pad, d), lambda bi, j: (bi, 0, 0)),
+            pl.BlockSpec((1, group * s_pad, d), lambda bi, j: (bi, 0, 0)),
+            pl.BlockSpec((1, group * s_pad, 1), lambda bi, j: (bi, 0, 0)),
+            pl.BlockSpec((1, group * s_pad, 1), lambda bi, j: (bi, 0, 0)),
+            pl.BlockSpec((1, block_kv, d), lambda bi, j: (bi, j, 0)),
+            pl.BlockSpec((1, block_kv, d), lambda bi, j: (bi, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_kv, d), lambda bi, j: (bi, j, 0)),
+            pl.BlockSpec((1, block_kv, d), lambda bi, j: (bi, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bkh, t_pad, d), k.dtype),
+            jax.ShapeDtypeStruct((bkh, t_pad, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(qg, dog, lseg, deltag, k3, v3)
+
+    dq = dq3[:, :s].reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    dk = dk3[:, :t].reshape(b, kh, t, d).transpose(0, 2, 1, 3)
+    dv = dv3[:, :t].reshape(b, kh, t, d).transpose(0, 2, 1, 3)
+    return dq, dk, dv
 
 
 flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)

@@ -110,3 +110,31 @@ def test_flash_ragged_seq_lengths():
         out = flash_attention(q, k, v, causal, 32, 32)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.parametrize("s,t,h,kh,causal,bq,bkv", [
+    (80, 80, 4, 2, True, 32, 32),    # ragged (s % block != 0), GQA
+    (64, 64, 4, 1, False, 32, 32),   # non-causal, group=4 (MQA)
+    (64, 96, 4, 2, False, 32, 32),   # cross-attention s != t
+    (33, 70, 8, 2, True, 32, 32),    # ragged both sides, group=4, causal
+])
+def test_flash_gradients_broad(s, t, h, kh, causal, bq, bkv):
+    """Backward-kernel regression net: ragged rows (rows < seq_q mask),
+    non-causal path, cross-attention, and larger GQA groups — each exercises
+    a distinct branch of the dq/dkv kernels."""
+    ks = jax.random.split(jax.random.key(7), 3)
+    q = jax.random.normal(ks[0], (2, s, h, 16))
+    k = jax.random.normal(ks[1], (2, t, kh, 16))
+    v = jax.random.normal(ks[2], (2, t, kh, 16))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal, bq, bkv) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(naive_attention(q, k, v, causal=causal) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-3, atol=5e-3)
