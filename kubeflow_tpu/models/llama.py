@@ -120,12 +120,38 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array,
 from kubeflow_tpu.ops.reference import naive_attention  # noqa: E402,F401
 
 
+def init_cache(cfg: LlamaConfig, batch: int, max_len: int | None = None,
+               dtype: Any = None) -> dict:
+    """Decode KV cache: {"k","v"} of [L, B, T, KH, D] (layer-stacked so the
+    scanned trunk consumes it as a per-layer scan input). Functional — the
+    cache is passed into and returned from `Llama.__call__`, never stored as
+    a flax variable, so serving can AOT-compile prefill/decode as pure fns
+    (the TPU answer to vLLM's mutable paged cache; SURVEY.md §2.2
+    huggingfaceserver row)."""
+    t = max_len or cfg.max_seq_len
+    shape = (cfg.num_layers, batch, t, cfg.num_kv_heads, cfg.head_dim)
+    dt = dtype or cfg.dtype
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+def _update_cache(cache_k, cache_v, k, v, index):
+    """Write new k/v [B,S,KH,D] into per-layer cache [B,T,KH,D] at per-row
+    sequence offsets index [B] (rows advance independently under continuous
+    batching)."""
+    def row(ck, cv, kk, vv, i):
+        return (jax.lax.dynamic_update_slice(ck, kk, (i, 0, 0)),
+                jax.lax.dynamic_update_slice(cv, vv, (i, 0, 0)))
+    return jax.vmap(row)(cache_k, cache_v, k.astype(cache_k.dtype),
+                         v.astype(cache_v.dtype), index)
+
+
 class Attention(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
     def __call__(self, x, cos, sin, positions, ring_axis: str | None = None,
-                 standard_positions: bool = True):
+                 standard_positions: bool = True, cache: dict | None = None,
+                 cache_index: jax.Array | None = None):
         cfg = self.cfg
         dense = partial(
             nn.DenseGeneral, use_bias=False, dtype=cfg.dtype,
@@ -147,6 +173,29 @@ class Attention(nn.Module):
         q = nn.with_logical_constraint(q, ("batch", "act_seq", "act_heads", "act_kv"))
         k = nn.with_logical_constraint(k, ("batch", "act_seq", None, "act_kv"))
         v = nn.with_logical_constraint(v, ("batch", "act_seq", None, "act_kv"))
+
+        new_cache = None
+        if cache is not None:
+            ck, cv = _update_cache(cache["k"], cache["v"], k, v, cache_index)
+            new_cache = {"k": ck, "v": cv}
+            if x.shape[1] == 1:
+                # Single-token decode: attend over the whole cache; causality
+                # and the not-yet-written tail (incl. stale entries from a
+                # previous slot occupant) are both masked by absolute
+                # positions (positions_kv > positions_q).
+                t = ck.shape[1]
+                out = naive_attention(
+                    q, ck, cv, causal=True, positions_q=positions,
+                    positions_kv=jnp.broadcast_to(jnp.arange(t), (ck.shape[0], t)))
+                out = dense(features=cfg.hidden_size, axis=(-2, -1),
+                            kernel_init=nn.with_logical_partitioning(
+                                nn.initializers.lecun_normal(),
+                                ("heads", "kv", "embed")),
+                            name="o_proj")(out)
+                return out, new_cache
+            # Prefill (cache_index must be 0): nothing precedes the new
+            # tokens, so attention over just k/v is exact — the fast flash
+            # path below serves it; the cache write above is the only extra.
 
         impl = cfg.attention_impl
         if impl == "auto":
@@ -179,7 +228,7 @@ class Attention(nn.Module):
                     kernel_init=nn.with_logical_partitioning(
                         nn.initializers.lecun_normal(), ("heads", "kv", "embed")),
                     name="o_proj")(out)
-        return out
+        return out, new_cache
 
 
 class MLPBlock(nn.Module):
@@ -212,15 +261,17 @@ class DecoderLayer(nn.Module):
 
     @nn.compact
     def __call__(self, x, cos, sin, positions, ring_axis=None,
-                 standard_positions=True):
+                 standard_positions=True, cache=None, cache_index=None):
         cfg = self.cfg
         h = RMSNorm(cfg.rms_eps, cfg.dtype, name="input_norm")(x)
-        x = x + Attention(cfg, name="attn")(h, cos, sin, positions, ring_axis,
-                                            standard_positions)
+        attn_out, new_cache = Attention(cfg, name="attn")(
+            h, cos, sin, positions, ring_axis, standard_positions, cache,
+            cache_index)
+        x = x + attn_out
         h = RMSNorm(cfg.rms_eps, cfg.dtype, name="post_attn_norm")(x)
         x = x + (self.mlp_cls or MLPBlock)(cfg, name="mlp")(h)
         x = nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
-        return x
+        return x, new_cache
 
 
 class Llama(nn.Module):
@@ -231,8 +282,17 @@ class Llama(nn.Module):
 
     @nn.compact
     def __call__(self, tokens: jax.Array, positions: jax.Array | None = None,
-                 ring_axis: str | None = None) -> jax.Array:
+                 ring_axis: str | None = None, cache: dict | None = None,
+                 cache_index: jax.Array | None = None):
+        """Returns logits [B,S,V]; with `cache` (see init_cache) returns
+        (logits, updated_cache) — prefill when S>1 (cache_index must be 0),
+        single-token decode when S==1 (positions default to cache_index)."""
         cfg = self.cfg
+        if cache is not None:
+            if cache_index is None:
+                cache_index = jnp.zeros((tokens.shape[0],), jnp.int32)
+            if positions is None and tokens.shape[1] == 1:
+                positions = cache_index[:, None]
         standard_positions = positions is None
         if positions is None:
             positions = jnp.broadcast_to(
@@ -250,20 +310,31 @@ class Llama(nn.Module):
             layer_cls = nn.remat(
                 layer_cls, policy=jax.checkpoint_policies.nothing_saveable,
                 static_argnums=(5, 6))
+        new_cache = None
         if cfg.scan_layers:
-            x, _ = nn.scan(
-                lambda mdl, carry, _: (mdl(carry, cos, sin, positions,
-                                           ring_axis, standard_positions),
-                                       None),
+            # `cache` (leading layer dim) rides as the scan's per-layer input
+            # and the updated cache comes back as its per-layer output.
+            x, new_cache = nn.scan(
+                lambda mdl, carry, layer_cache: mdl(
+                    carry, cos, sin, positions, ring_axis,
+                    standard_positions, layer_cache, cache_index),
                 variable_axes={"params": 0, "aux_loss": 0},
                 split_rngs={"params": True},
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
-            )(layer_cls(cfg, self.mlp_cls, name="layers"), x, None)
+            )(layer_cls(cfg, self.mlp_cls, name="layers"), x, cache)
         else:
+            layer_caches = []
             for i in range(cfg.num_layers):
-                x = layer_cls(cfg, self.mlp_cls, name=f"layer_{i}")(
-                    x, cos, sin, positions, ring_axis, standard_positions)
+                layer_cache = None if cache is None else jax.tree.map(
+                    lambda c: c[i], cache)
+                x, lc = layer_cls(cfg, self.mlp_cls, name=f"layer_{i}")(
+                    x, cos, sin, positions, ring_axis, standard_positions,
+                    layer_cache, cache_index)
+                layer_caches.append(lc)
+            if cache is not None:
+                new_cache = jax.tree.map(
+                    lambda *ls: jnp.stack(ls), *layer_caches)
 
         x = RMSNorm(cfg.rms_eps, cfg.dtype, name="final_norm")(x)
         if cfg.tie_embeddings:
@@ -275,4 +346,6 @@ class Llama(nn.Module):
                 kernel_init=nn.with_logical_partitioning(
                     nn.initializers.lecun_normal(), ("embed", "vocab")),
                 name="lm_head")(x)
+        if cache is not None:
+            return logits, new_cache
         return logits

@@ -1,0 +1,348 @@
+"""Generative LLM serving: KV-cache decode, sampling, continuous batching.
+
+The reference's flagship LLM runtime is the vLLM-backed huggingfaceserver
+(⟨kserve: python/huggingfaceserver⟩, SURVEY.md §2.2/§3.3 rebuild note).
+Its design — paged KV blocks, per-step GPU kernel launches, token-level
+continuous batching — does not map to XLA. The TPU-native shape:
+
+  * **Functional cache**: one global slot-batched cache [L, B_slots, T, KH,
+    D] carried through pure jitted fns (models/llama.py `init_cache`);
+    stale slot content needs no eviction — absolute-position masking hides
+    anything past a slot's write index.
+  * **AOT everything**: prefill compiled per prompt-length bucket,
+    decode compiled once — the hot path never traces.
+  * **Chunked decode**: one dispatch runs `lax.scan` over K decode steps
+    with on-device sampling, returning K tokens/slot. On the axon tunnel a
+    host sync costs ~66 ms (PROFILE.md §1), so per-token sync decoding
+    would cap at ~15 tok/s; chunking amortizes the latency K×.
+  * **Continuous batching at chunk boundaries**: finished slots are
+    re-admitted (prefill → cache insert at the slot index) between decode
+    dispatches — the scheduling granularity is the chunk, not the token,
+    which is the right trade under compiled static shapes.
+
+Sampling: greedy (temperature 0) or temperature sampling, per-slot, on
+device. top-k/top-p: ops/ROADMAP.md.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_tpu.serve.model import Model
+
+
+def sample_tokens(logits: jax.Array, temperature: jax.Array,
+                  key: jax.Array) -> jax.Array:
+    """Per-row sampling: argmax where temperature<=0, else categorical at
+    that temperature. logits [B, V], temperature [B] -> [B] int32."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    safe_t = jnp.maximum(temperature, 1e-4)[:, None]
+    sampled = jax.random.categorical(
+        key, logits.astype(jnp.float32) / safe_t, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature > 0, sampled, greedy)
+
+
+class GenerationEngine:
+    """Slot-based continuous-batching decode loop over one global cache.
+
+    `submit()` is thread-safe and blocks until the request completes; the
+    worker thread multiplexes all in-flight requests onto the slot batch.
+    """
+
+    def __init__(self, model, params, cfg, *, slots: int = 4,
+                 max_len: int = 256, chunk: int = 16,
+                 prefill_buckets: Sequence[int] = (32, 128), seed: int = 0):
+        self.model, self.cfg = model, cfg
+        self.max_len, self.chunk, self.n_slots = int(max_len), int(chunk), int(slots)
+        self.prefill_buckets = sorted(
+            {min(int(b), self.max_len) for b in prefill_buckets})
+        self._params = jax.device_put(params)
+        self._key = jax.random.key(seed)
+        self._queue: queue.Queue = queue.Queue()
+        self._wake = threading.Event()
+        self._stop = False
+        self.stats = {"requests": 0, "prompt_tokens": 0, "decode_tokens": 0,
+                      "decode_seconds": 0.0, "decode_dispatches": 0}
+        self._compile()
+        from kubeflow_tpu.models.llama import init_cache
+        self._cache = jax.jit(
+            lambda: init_cache(cfg, self.n_slots, self.max_len))()
+        self._warmup()
+        self._slots = [None] * self.n_slots  # per-slot host state
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="tpk-generate")
+        self._thread.start()
+
+    # -- compiled device functions ------------------------------------------
+
+    def _compile(self):
+        model, cfg = self.model, self.cfg
+        from kubeflow_tpu.models.llama import init_cache
+
+        def prefill(params, tokens, length, temperature, key):
+            """tokens [1, S_bucket] right-padded; returns (frag_cache,
+            first sampled token [1])."""
+            cache = init_cache(cfg, 1, self.max_len)
+            logits, cache = model.apply(
+                {"params": params}, tokens, cache=cache,
+                cache_index=jnp.zeros((1,), jnp.int32))
+            last = jnp.take_along_axis(
+                logits, (length - 1)[:, None, None], axis=1)[:, 0]  # [1, V]
+            tok = sample_tokens(last, temperature, key)
+            return cache, tok
+
+        def insert(cache, frag, slot):
+            """Write a prefill fragment (slot-batch 1) into slot `slot`."""
+            return jax.tree.map(
+                lambda c, f: jax.lax.dynamic_update_slice(
+                    c, f.astype(c.dtype),
+                    (0, slot) + (0,) * (c.ndim - 2)), cache, frag)
+
+        def decode_chunk(params, cache, last_tok, index, temperature, key):
+            """K decode steps under one dispatch; on-device sampling.
+            last_tok/index/temperature [B]; returns (cache, tokens [B, K])."""
+            def step(carry, _):
+                cache, tok, idx, key = carry
+                key, sub = jax.random.split(key)
+                logits, cache = model.apply(
+                    {"params": params}, tok[:, None], cache=cache,
+                    cache_index=jnp.minimum(idx, self.max_len - 1))
+                nxt = sample_tokens(logits[:, 0], temperature, sub)
+                return (cache, nxt, idx + 1, key), nxt
+
+            (cache, _, _, _), toks = jax.lax.scan(
+                step, (cache, last_tok, index, key), None, length=self.chunk)
+            return cache, toks.T
+
+        prefill_jit = jax.jit(prefill)
+        self._prefill = {b: prefill_jit for b in self.prefill_buckets}
+        self._insert = jax.jit(insert, donate_argnums=(0,))
+        self._decode = jax.jit(decode_chunk, donate_argnums=(1,))
+
+    def _warmup(self):
+        """Pay every compile before serving: one prefill per bucket, one
+        insert, one chunked decode (jit caches keyed on static shapes)."""
+        zero_t = jnp.zeros((1,), jnp.float32)
+        one_l = jnp.ones((1,), jnp.int32)
+        frag = None
+        for b in self.prefill_buckets:
+            frag, _ = self._prefill[b](
+                self._params, jnp.zeros((1, b), jnp.int32), one_l, zero_t,
+                self._key)
+        self._cache = self._insert(self._cache, frag, jnp.int32(0))
+        n = self.n_slots
+        self._cache, _ = self._decode(
+            self._params, self._cache, jnp.zeros((n,), jnp.int32),
+            jnp.zeros((n,), jnp.int32), jnp.zeros((n,), jnp.float32),
+            self._key)
+
+    # -- public API ----------------------------------------------------------
+
+    def submit(self, input_ids: Sequence[int], *, max_tokens: int = 32,
+               temperature: float = 0.0, eos_id: int | None = None,
+               timeout: float = 300.0) -> dict:
+        if not input_ids:
+            raise ValueError("input_ids must be non-empty")
+        if len(input_ids) > self.max_len - 1:
+            raise ValueError(
+                f"prompt of {len(input_ids)} tokens exceeds max_len "
+                f"{self.max_len}")
+        req = {
+            "input_ids": [int(t) for t in input_ids],
+            "max_tokens": int(max_tokens),
+            "temperature": float(temperature),
+            "eos_id": eos_id,
+            "out": [],
+            "done": threading.Event(),
+            "error": None,
+            "t0": time.monotonic(),
+        }
+        self._queue.put(req)
+        self._wake.set()
+        if not req["done"].wait(timeout):
+            req["error"] = f"generation timed out after {timeout}s"
+        if req["error"]:
+            raise RuntimeError(req["error"])
+        return {
+            "output_ids": req["out"],
+            "num_input_tokens": len(req["input_ids"]),
+            "num_output_tokens": len(req["out"]),
+            "latency_s": time.monotonic() - req["t0"],
+        }
+
+    def close(self):
+        self._stop = True
+        self._wake.set()
+        self._thread.join(timeout=5.0)
+
+    # -- worker --------------------------------------------------------------
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.prefill_buckets:
+            if n <= b:
+                return b
+        return self.prefill_buckets[-1]
+
+    def _admit(self, slot: int, req: dict) -> None:
+        ids = req["input_ids"]
+        bucket = self._bucket_for(len(ids))
+        if len(ids) > bucket:  # longer than the largest bucket: truncate tail
+            ids = ids[-bucket:]
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, :len(ids)] = ids
+        self._key, sub = jax.random.split(self._key)
+        frag, tok0 = self._prefill[bucket](
+            self._params, jnp.asarray(toks),
+            jnp.asarray([len(ids)], jnp.int32),
+            jnp.asarray([req["temperature"]], jnp.float32), sub)
+        self._cache = self._insert(self._cache, frag, jnp.int32(slot))
+        first = int(tok0[0])
+        self._slots[slot] = {"req": req, "idx": len(ids), "last": first}
+        self.stats["requests"] += 1
+        self.stats["prompt_tokens"] += len(ids)
+        self._emit(slot, [first])
+
+    def _emit(self, slot: int, tokens: list[int]) -> None:
+        """Append generated tokens to the slot's request; retire on EOS /
+        budget / context exhaustion."""
+        st = self._slots[slot]
+        req = st["req"]
+        for t in tokens:
+            if req["done"].is_set():
+                break
+            req["out"].append(t)
+            if ((req["eos_id"] is not None and t == req["eos_id"])
+                    or len(req["out"]) >= req["max_tokens"]):
+                req["done"].set()
+                break
+        if st["idx"] >= self.max_len - 1:
+            req["done"].set()
+        if req["done"].is_set():
+            self._slots[slot] = None
+
+    def _loop(self) -> None:
+        while not self._stop:
+            # Admit waiting requests into free slots (chunk boundary).
+            for slot in range(self.n_slots):
+                if self._slots[slot] is None:
+                    try:
+                        req = self._queue.get_nowait()
+                    except queue.Empty:
+                        break
+                    try:
+                        self._admit(slot, req)
+                    except Exception as e:  # surface to the caller
+                        req["error"] = f"{type(e).__name__}: {e}"
+                        req["done"].set()
+                        self._slots[slot] = None
+            active = [i for i, s in enumerate(self._slots) if s is not None]
+            if not active:
+                self._wake.wait(0.05)
+                self._wake.clear()
+                continue
+            # One chunked decode dispatch over the whole slot batch.
+            last = np.zeros((self.n_slots,), np.int32)
+            idx = np.zeros((self.n_slots,), np.int32)
+            temps = np.zeros((self.n_slots,), np.float32)
+            for i in active:
+                st = self._slots[i]
+                last[i], idx[i] = st["last"], st["idx"]
+                temps[i] = st["req"]["temperature"]
+            self._key, sub = jax.random.split(self._key)
+            t0 = time.monotonic()
+            self._cache, toks = self._decode(
+                self._params, self._cache, jnp.asarray(last),
+                jnp.asarray(idx), jnp.asarray(temps), sub)
+            toks = np.asarray(toks)  # sync point: [B, chunk]
+            dt = time.monotonic() - t0
+            self.stats["decode_seconds"] += dt
+            self.stats["decode_dispatches"] += 1
+            self.stats["decode_tokens"] += len(active) * self.chunk
+            for i in active:
+                st = self._slots[i]
+                st["idx"] += self.chunk
+                st["last"] = int(toks[i, -1])
+                self._emit(i, [int(t) for t in toks[i]])
+
+    def throughput(self) -> float:
+        s = self.stats
+        return s["decode_tokens"] / s["decode_seconds"] if s["decode_seconds"] else 0.0
+
+
+class GenerativeJAXModel(Model):
+    """KServe-Model-shaped wrapper: load() builds the engine (AOT compiles
+    prefill buckets + decode); generate() is the request surface. Also
+    answers plain predict() with a full-forward logits call for protocol
+    parity (v1/v2 infer on a generative model)."""
+
+    def __init__(self, name: str, model, params, cfg, *,
+                 generation: dict | None = None):
+        super().__init__(name)
+        self._model, self._params, self.cfg = model, params, cfg
+        self._gen_cfg = dict(generation or {})
+        self.engine: GenerationEngine | None = None
+        self.eos_id = self._gen_cfg.pop("eos_id", None)
+        self.tokenizer = self._gen_cfg.pop("tokenizer", None)
+
+    def load(self) -> bool:
+        t0 = time.monotonic()
+        self.engine = GenerationEngine(
+            self._model, self._params, self.cfg, **self._gen_cfg)
+        self.load_time_s = time.monotonic() - t0
+        self.ready = True
+        return True
+
+    def unload(self) -> None:
+        self.ready = False
+        if self.engine:
+            self.engine.close()
+            self.engine = None
+
+    def generate(self, payload: dict) -> dict:
+        if not self.ready or self.engine is None:
+            raise RuntimeError(f"model {self.name} is not loaded")
+        ids = payload.get("input_ids")
+        text = payload.get("text")
+        if ids is None and text is not None:
+            if self.tokenizer != "bytes":
+                raise ValueError(
+                    "this model takes token ids ('input_ids'); no tokenizer "
+                    "is bundled")
+            ids = list(text.encode("utf-8"))
+        if ids is None:
+            raise ValueError("request needs 'input_ids' (or 'text')")
+        out = self.engine.submit(
+            ids,
+            max_tokens=int(payload.get("max_tokens", 32)),
+            temperature=float(payload.get("temperature", 0.0)),
+            eos_id=payload.get("eos_id", self.eos_id),
+            timeout=float(payload.get("timeout", 300.0)))
+        if self.tokenizer == "bytes":
+            out["text"] = bytes(
+                t for t in out["output_ids"] if 0 <= t < 256).decode(
+                    "utf-8", errors="replace")
+        out["decode_tokens_per_sec"] = round(self.engine.throughput(), 2)
+        return out
+
+    def predict(self, inputs):
+        """Full-forward logits (no cache) — v1/v2 infer parity."""
+        toks = jnp.asarray(np.asarray(inputs[0], np.int32))
+        logits = self._model.apply({"params": self._params}, toks)
+        return [np.asarray(logits, np.float32)]
+
+    def metadata(self) -> dict:
+        md = super().metadata()
+        md.update({
+            "generative": True,
+            "max_len": self._gen_cfg.get("max_len", 256),
+            "vocab_size": getattr(self.cfg, "vocab_size", None),
+            "stats": dict(self.engine.stats) if self.engine else {},
+        })
+        return md

@@ -103,6 +103,15 @@ def _jax_registry_runtime(model_dir: str, spec: dict) -> Model:
         example = np.zeros((1, *example_shape), dtype=dtype)
         params = nn.meta.unbox(module.init(rng, example)["params"])
 
+    if spec.get("generative"):
+        # LLM bundle: KV-cache decode engine instead of a fixed forward
+        # (⟨kserve: python/huggingfaceserver⟩ equivalent; generation.py).
+        from kubeflow_tpu.serve.generation import GenerativeJAXModel
+
+        return GenerativeJAXModel(
+            spec.get("name") or spec["model"], module, params,
+            info.get("config"), generation=dict(spec["generative"]))
+
     def apply_fn(params, x):
         out = module.apply({"params": params}, x)
         return out[-1] if isinstance(out, tuple) else out

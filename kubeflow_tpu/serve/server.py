@@ -173,6 +173,31 @@ class V1PredictHandler(_Base):
         self.write_json({"predictions": np.asarray(preds).tolist()})
 
 
+class GenerateHandler(_Base):
+    """POST /v1/models/{name}:generate and /v2/models/{name}/generate —
+    the generative data plane (KServe huggingfaceserver's generate surface).
+    Body: {"input_ids": [...] | "text": "...", "max_tokens", "temperature",
+    "eos_id"}. Bypasses the coalescing batcher: the generation engine does
+    its own continuous batching across concurrent requests."""
+
+    async def post(self, name: str):
+        model = self.repo.get(name)
+        gen = getattr(model, "generate", None)
+        if gen is None:
+            raise tornado.web.HTTPError(
+                400, reason=f"model {name!r} is not generative")
+        body = self.body_json()
+        t0 = time.monotonic()
+        try:
+            out = await asyncio.get_event_loop().run_in_executor(
+                None, gen, body)
+        except (ValueError, RuntimeError) as e:
+            raise tornado.web.HTTPError(400, reason=str(e)) from None
+        self.server.observe(name, out.get("num_output_tokens", 0),
+                            time.monotonic() - t0)
+        self.write_json({"model_name": name, **out})
+
+
 class V2HealthHandler(_Base):
     def get(self, kind: str):
         if kind == "ready" and not all(
@@ -288,6 +313,8 @@ class ModelServer:
             (r"/v1/models", V1ListHandler, kw),
             (r"/v1/models/([^/:]+)", V1ModelHandler, kw),
             (r"/v1/models/([^/:]+):predict", V1PredictHandler, kw),
+            (r"/v1/models/([^/:]+):generate", GenerateHandler, kw),
+            (r"/v2/models/([^/]+)/generate", GenerateHandler, kw),
             (r"/v2/health/(live|ready)", V2HealthHandler, kw),
             (r"/v2/models/([^/]+)/infer", V2InferHandler, kw),
             (r"/v2/repository/models/([^/]+)/(load|unload)",
