@@ -46,9 +46,24 @@ class MeshConfig:
     tensor: int = 1
     seq: int = 1
     expert: int = 1
-    # Number of slices the job spans; >1 splits the leading (data or pipe)
-    # axis across DCN. Informational on emulated backends.
+    # Number of slices the job spans; >1 builds a two-level mesh where the
+    # slice index becomes the slowest-varying factor of the `data` (or, if
+    # data doesn't divide, `pipe`) axis — so only DP gradient all-reduces /
+    # PP boundary permutes cross DCN while fsdp/tensor/seq/expert
+    # collectives stay on intra-slice ICI (SURVEY.md §5.8(c), eval config 5).
     num_slices: int = 1
+
+    def dcn_axis(self, num_devices: int) -> str | None:
+        """Which mesh axis carries the cross-slice (DCN) factor."""
+        if self.num_slices <= 1:
+            return None
+        sizes = dict(zip(MESH_AXES, self.axis_sizes(num_devices)))
+        for axis in ("data", "pipe"):
+            if sizes[axis] % self.num_slices == 0:
+                return axis
+        raise ValueError(
+            f"num_slices={self.num_slices} must divide the data or pipe "
+            f"axis; got mesh {sizes}")
 
     def axis_sizes(self, num_devices: int) -> tuple[int, ...]:
         sizes = [self.data, self.fsdp, self.pipe, self.tensor, self.seq, self.expert]
@@ -70,6 +85,31 @@ class MeshConfig:
         return tuple(sizes)
 
 
+def _slice_groups(
+    devices: Sequence[jax.Device], num_slices: int
+) -> list[list[jax.Device]]:
+    """Partition devices into per-slice groups, slice-major.
+
+    Preference order mirrors how slices actually manifest: real multi-slice
+    TPU devices carry `slice_index`; the emulated multi-slice e2e runs one
+    process per slice (group by `process_index`); single-process virtual
+    meshes fall back to contiguous blocks (the driver's dryrun)."""
+    n = len(devices)
+    if n % num_slices:
+        raise ValueError(f"{n} devices not divisible by {num_slices} slices")
+    per = n // num_slices
+    for attr in ("slice_index", "process_index"):
+        keys = {getattr(d, attr, None) for d in devices}
+        if None not in keys and len(keys) == num_slices:
+            groups = [
+                [d for d in devices if getattr(d, attr) == k]
+                for k in sorted(keys)
+            ]
+            if all(len(g) == per for g in groups):
+                return groups
+    return [list(devices[i * per:(i + 1) * per]) for i in range(num_slices)]
+
+
 def build_mesh(
     config: MeshConfig | None = None,
     devices: Sequence[jax.Device] | None = None,
@@ -77,11 +117,30 @@ def build_mesh(
     """Build the global mesh. On real multi-host TPU, `jax.devices()` is already
     ordered so contiguous devices share ICI; `mesh_utils` would refine this for
     specific topologies — we keep row-major order, which is correct for the
-    virtual CPU meshes used in tests and for single-slice v5e/v5p defaults."""
+    virtual CPU meshes used in tests and for single-slice v5e/v5p defaults.
+
+    With `num_slices > 1` the device array is assembled slice-major: the
+    slice index is the outermost factor of the DCN-crossing axis (data,
+    else pipe), so every other axis's collectives stay within one slice.
+    This is the two-level ICI/DCN layout the reference world gets from
+    NCCL rail-aware topology files — here it is just array layout, and XLA
+    emits hierarchical collectives from it."""
     config = config or MeshConfig()
     devices = list(devices if devices is not None else jax.devices())
     sizes = config.axis_sizes(len(devices))
-    dev_array = np.asarray(devices).reshape(sizes)
+    if config.num_slices > 1:
+        s = config.num_slices
+        axis = config.dcn_axis(len(devices))
+        idx = MESH_AXES.index(axis)
+        groups = _slice_groups(devices, s)
+        inner = list(sizes)
+        inner[idx] //= s
+        arr = np.asarray(groups).reshape([s] + inner)
+        # Move the slice factor so it leads the DCN axis, then merge.
+        perm = list(range(1, idx + 1)) + [0] + list(range(idx + 1, len(inner) + 1))
+        dev_array = arr.transpose(perm).reshape(sizes)
+    else:
+        dev_array = np.asarray(devices).reshape(sizes)
     return Mesh(dev_array, MESH_AXES)
 
 
