@@ -1,0 +1,124 @@
+"""Grain-backed input pipeline with checkpointable iterator state.
+
+The reference delegates input entirely to per-framework user code
+(tf.data / torch DataLoader inside the operator-launched images — SURVEY.md
+§2.6 note, §7.1 item 1); resume-determinism is the user's problem. Here the
+loader is first-class and *checkpointable*: the grain iterator exposes
+`get_state()/set_state()` (a small JSON dict), the trainer saves it through
+orbax alongside the TrainState, and resume restores the exact stream
+position instead of replaying `next(data)` O(steps) times.
+
+Sharding story matches the platform: each process builds the same pipeline
+with its `(process_index, process_count)` shard, so the global batch is
+assembled from disjoint per-process streams — the grain analog of the
+reference's per-worker DataLoader sharding, done for the user.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Mapping
+
+import numpy as np
+
+
+class _Windows:
+    """Random-access view of a flat token array as non-overlapping
+    (seq_len+1)-token windows: window i -> tokens[i*S : i*S + S + 1].
+    The +1 overlap gives the shifted-by-one LM targets."""
+
+    def __init__(self, tokens: np.ndarray, seq_len: int):
+        if tokens.ndim != 1:
+            raise ValueError(f"tokens must be flat, got shape {tokens.shape}")
+        self._tokens = tokens
+        self._seq = int(seq_len)
+        self._n = max((len(tokens) - 1) // self._seq, 0)
+        if self._n == 0:
+            raise ValueError(
+                f"{len(tokens)} tokens can't fill one window of "
+                f"{seq_len + 1}")
+
+    def __len__(self) -> int:
+        return self._n
+
+    def __getitem__(self, i: int) -> np.ndarray:
+        s = int(i) * self._seq
+        return np.asarray(self._tokens[s:s + self._seq + 1], np.int32)
+
+
+def load_tokens(source: Any) -> np.ndarray:
+    """Resolve a token source to a flat int32 array.
+
+    Accepts an in-memory array/list, an `.npy` file (memory-mapped so epoch
+    shuffles never load the corpus into RAM), a raw `.bin`/`.tokens` file of
+    little-endian int32, or a `.txt`/other text file tokenized as UTF-8
+    bytes (vocab 256 — the bring-up tokenizer, same trick the serving
+    path's `tokenizer="bytes"` mode uses)."""
+    if isinstance(source, (list, tuple, np.ndarray)):
+        return np.asarray(source, np.int32).reshape(-1)
+    path = os.fspath(source)
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"token source {path!r} does not exist")
+    if path.endswith(".npy"):
+        return np.load(path, mmap_mode="r")
+    if path.endswith((".bin", ".tokens")):
+        return np.memmap(path, dtype=np.int32, mode="r")
+    with open(path, "rb") as fh:
+        return np.frombuffer(fh.read(), dtype=np.uint8).astype(np.int32)
+
+
+def lm_dataset(
+    source: Any,
+    *,
+    batch_size: int,
+    seq_len: int,
+    seed: int = 0,
+    shuffle: bool = True,
+    num_epochs: int | None = None,
+    process_index: int | None = None,
+    process_count: int | None = None,
+):
+    """Build the grain pipeline: windows -> per-process shard -> (shuffle)
+    -> repeat -> batch -> {"inputs", "targets"}.
+
+    Returns a `grain.MapDataset`; `iter()` on it yields a checkpointable
+    iterator (get_state/set_state). `batch_size` here is the PER-PROCESS
+    batch (the trainer passes its `local_batch_size`)."""
+    import grain.python as gp
+
+    if process_index is None or process_count is None:
+        import jax
+
+        process_index = jax.process_index()
+        process_count = jax.process_count()
+
+    tokens = load_tokens(source)
+    ds = gp.MapDataset.source(_Windows(tokens, seq_len))
+    if process_count > 1:
+        ds = ds[process_index::process_count]
+    if len(ds) < batch_size:
+        raise ValueError(
+            f"shard has {len(ds)} windows < batch_size {batch_size}; "
+            f"corpus too small for ({process_count} procs, seq_len "
+            f"{seq_len})")
+    if shuffle:
+        ds = ds.shuffle(seed=seed)
+    ds = ds.repeat(num_epochs)
+    ds = ds.batch(batch_size, drop_remainder=True)
+    return ds.map(lambda b: {"inputs": b[:, :-1], "targets": b[:, 1:]})
+
+
+def iterator_state(it: Any) -> Mapping[str, Any] | None:
+    """The iterator's resume state, or None for plain generators."""
+    get = getattr(it, "get_state", None)
+    return get() if callable(get) else None
+
+
+def restore_iterator(it: Any, state: Mapping[str, Any] | None) -> bool:
+    """Seek a checkpointable iterator to a saved state. Returns True when
+    the seek happened (caller then skips replay)."""
+    set_state = getattr(it, "set_state", None)
+    if state is None or not callable(set_state):
+        return False
+    set_state(dict(state))
+    return True

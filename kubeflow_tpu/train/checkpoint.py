@@ -29,10 +29,17 @@ class CheckpointManager:
         )
         self._mgr = ocp.CheckpointManager(self.directory, options=options)
 
-    def maybe_save(self, step: int, state: Any, *, force: bool = False) -> bool:
-        """Save if `step` hits the interval (orbax enforces the schedule)."""
-        return self._mgr.save(
-            step, args=ocp.args.StandardSave(state), force=force)
+    def maybe_save(self, step: int, state: Any, *, data_state: Any = None,
+                   force: bool = False) -> bool:
+        """Save if `step` hits the interval (orbax enforces the schedule).
+        `data_state` is the input iterator's resume state (a small JSON
+        dict from grain get_state()) saved alongside the TrainState so
+        resume continues the exact data stream (SURVEY.md §5.4)."""
+        items = {"state": ocp.args.StandardSave(state)}
+        if data_state is not None:
+            items["data"] = ocp.args.JsonSave(data_state)
+        return self._mgr.save(step, args=ocp.args.Composite(**items),
+                              force=force)
 
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
@@ -43,8 +50,26 @@ class CheckpointManager:
         step = step if step is not None else self.latest_step()
         if step is None:
             return state_template
-        return self._mgr.restore(
-            step, args=ocp.args.StandardRestore(state_template))
+        out = self._mgr.restore(step, args=ocp.args.Composite(
+            state=ocp.args.StandardRestore(state_template)))
+        return out["state"]
+
+    def restore_data_state(self, step: int | None = None) -> Any | None:
+        """The saved input-iterator state, or None when the checkpoint
+        predates it (plain-generator jobs)."""
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            return None
+        try:
+            meta = self._mgr.item_metadata(step)
+        except Exception:
+            return None
+        items = getattr(meta, "keys", lambda: [])()
+        if "data" not in items:
+            return None
+        out = self._mgr.restore(
+            step, args=ocp.args.Composite(data=ocp.args.JsonRestore()))
+        return out["data"]
 
     def wait(self) -> None:
         self._mgr.wait_until_finished()

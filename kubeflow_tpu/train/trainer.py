@@ -197,10 +197,18 @@ class Trainer:
                 prof_start = prof_stop = None
         prof_active = False
 
-        data = self._data()
-        # Skip already-consumed batches on resume for determinism.
-        for _ in range(start_step):
-            next(data)
+        from kubeflow_tpu.data.loader import (
+            iterator_state, restore_iterator)
+
+        dataset = self._data()
+        data = iter(dataset)
+        if start_step:
+            # Checkpointable iterators (grain) seek in O(1); plain
+            # generators fall back to replaying consumed batches.
+            saved = self._ckpt.restore_data_state()
+            if not restore_iterator(data, saved):
+                for _ in range(start_step):
+                    next(data)
 
         last_metrics: dict = {}
         timer.start()
@@ -217,7 +225,8 @@ class Trainer:
                 jax.profiler.stop_trace()
                 prof_active = False
             if self._ckpt is not None:
-                self._ckpt.maybe_save(step + 1, state)
+                self._ckpt.maybe_save(step + 1, state,
+                                      data_state=iterator_state(data))
             if (step + 1) % spec.log_every == 0 or step + 1 == spec.steps:
                 # Block only at logging boundaries — keeping the dispatch
                 # queue full between them lets host data prep overlap device
@@ -240,7 +249,9 @@ class Trainer:
 
         if self._ckpt is not None:
             if self._ckpt.latest_step() != spec.steps:
-                self._ckpt.maybe_save(spec.steps, state, force=True)
+                self._ckpt.maybe_save(spec.steps, state,
+                                      data_state=iterator_state(data),
+                                      force=True)
             self._ckpt.wait()
         self.logger.log(spec.steps, {"event": "done", **last_metrics})
         return {"final_step": spec.steps, **last_metrics}

@@ -143,6 +143,17 @@ def _ensure_builtin() -> None:
     def _mnist_like(batch_size=64, seed=0, **kw):
         return synthetic.mnist_like(batch_size, seed)
 
+    @register_dataset("token_file")
+    def _token_file(path, batch_size=8, seq_len=128, seed=0, shuffle=True,
+                    **kw):
+        """Grain-backed tokenized corpus (.npy/.bin/.txt) with
+        checkpointable iterator state — the production input path."""
+        from kubeflow_tpu.data import loader
+
+        return loader.lm_dataset(
+            path, batch_size=batch_size, seq_len=seq_len, seed=seed,
+            shuffle=shuffle)
+
     # Only mark loaded once every builtin registered — a failed import above
     # must re-raise on the next call, not leave the registry silently empty.
     _builtin_loaded = True
