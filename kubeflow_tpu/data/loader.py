@@ -77,6 +77,7 @@ def lm_dataset(
     num_epochs: int | None = None,
     process_index: int | None = None,
     process_count: int | None = None,
+    vocab_size: int | None = None,
 ):
     """Build the grain pipeline: windows -> per-process shard -> (shuffle)
     -> repeat -> batch -> {"inputs", "targets"}.
@@ -93,6 +94,14 @@ def lm_dataset(
         process_count = jax.process_count()
 
     tokens = load_tokens(source)
+    if vocab_size is not None:
+        # One O(corpus) scan at startup beats training silently on clamped
+        # out-of-vocab ids (embedding take clamps, loss stays finite).
+        lo, hi = int(np.min(tokens)), int(np.max(tokens))
+        if lo < 0 or hi >= vocab_size:
+            raise ValueError(
+                f"corpus token ids span [{lo}, {hi}] but the model vocab "
+                f"is {vocab_size} — wrong tokenizer for this model?")
     ds = gp.MapDataset.source(_Windows(tokens, seq_len))
     if process_count > 1:
         ds = ds[process_index::process_count]

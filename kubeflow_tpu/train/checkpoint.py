@@ -44,12 +44,29 @@ class CheckpointManager:
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
 
+    def should_save(self, step: int) -> bool:
+        """Whether `step` is on the save schedule — lets the trainer skip
+        collecting iterator state on the steps that won't save."""
+        return self._mgr.should_save(step)
+
+    def _items(self, step: int) -> list:
+        try:
+            meta = self._mgr.item_metadata(step)
+        except Exception:
+            return []
+        return list(getattr(meta, "keys", lambda: [])())
+
     def restore(self, state_template: Any, step: int | None = None) -> Any:
         """Restore into the (possibly abstract/sharded) template. Returns the
-        template untouched when no checkpoint exists."""
+        template untouched when no checkpoint exists. Checkpoints written
+        before the composite (state+data) layout restore via the legacy
+        single-item path, so an upgraded runtime still resumes older jobs."""
         step = step if step is not None else self.latest_step()
         if step is None:
             return state_template
+        if "state" not in self._items(step):
+            return self._mgr.restore(
+                step, args=ocp.args.StandardRestore(state_template))
         out = self._mgr.restore(step, args=ocp.args.Composite(
             state=ocp.args.StandardRestore(state_template)))
         return out["state"]
@@ -60,12 +77,7 @@ class CheckpointManager:
         step = step if step is not None else self.latest_step()
         if step is None:
             return None
-        try:
-            meta = self._mgr.item_metadata(step)
-        except Exception:
-            return None
-        items = getattr(meta, "keys", lambda: [])()
-        if "data" not in items:
+        if "data" not in self._items(step):
             return None
         out = self._mgr.restore(
             step, args=ocp.args.Composite(data=ocp.args.JsonRestore()))

@@ -225,8 +225,14 @@ class Trainer:
                 jax.profiler.stop_trace()
                 prof_active = False
             if self._ckpt is not None:
-                self._ckpt.maybe_save(step + 1, state,
-                                      data_state=iterator_state(data))
+                # Only collect iterator state on steps that will save —
+                # get_state() walks the grain pipeline and doesn't belong
+                # in the non-blocking hot loop.
+                self._ckpt.maybe_save(
+                    step + 1, state,
+                    data_state=(iterator_state(data)
+                                if self._ckpt.should_save(step + 1)
+                                else None))
             if (step + 1) % spec.log_every == 0 or step + 1 == spec.steps:
                 # Block only at logging boundaries — keeping the dispatch
                 # queue full between them lets host data prep overlap device

@@ -145,14 +145,16 @@ def _ensure_builtin() -> None:
 
     @register_dataset("token_file")
     def _token_file(path, batch_size=8, seq_len=128, seed=0, shuffle=True,
-                    **kw):
+                    vocab_size=None, **kw):
         """Grain-backed tokenized corpus (.npy/.bin/.txt) with
-        checkpointable iterator state — the production input path."""
+        checkpointable iterator state — the production input path. The
+        trainer passes the model's vocab_size so a wrong-tokenizer corpus
+        fails at startup instead of training on clamped ids."""
         from kubeflow_tpu.data import loader
 
         return loader.lm_dataset(
             path, batch_size=batch_size, seq_len=seq_len, seed=seed,
-            shuffle=shuffle)
+            shuffle=shuffle, vocab_size=vocab_size)
 
     # Only mark loaded once every builtin registered — a failed import above
     # must re-raise on the next call, not leave the registry silently empty.

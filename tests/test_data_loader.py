@@ -116,3 +116,30 @@ def test_trainer_resume_continues_exact_stream(tmp_path):
     mgr = CheckpointManager(str(ck), interval=3)
     assert mgr.restore_data_state() is not None
     mgr.close()
+
+
+def test_vocab_validation_catches_wrong_tokenizer():
+    bad = np.array([0, 5, 700, 3, 9, 1, 2, 4] * 10, dtype=np.int32)
+    with pytest.raises(ValueError, match="vocab"):
+        loader.lm_dataset(bad, batch_size=1, seq_len=8, vocab_size=512,
+                          process_index=0, process_count=1)
+
+
+def test_legacy_checkpoint_restores(tmp_path):
+    """Checkpoints written with the pre-composite layout (StandardSave at
+    the root) still restore through the upgraded manager."""
+    import orbax.checkpoint as ocp
+
+    from kubeflow_tpu.train.checkpoint import CheckpointManager
+
+    state = {"w": np.arange(4.0, dtype=np.float32)}
+    legacy = ocp.CheckpointManager(str(tmp_path / "ck"))
+    legacy.save(7, args=ocp.args.StandardSave(state))
+    legacy.wait_until_finished()
+    legacy.close()
+
+    mgr = CheckpointManager(str(tmp_path / "ck"), interval=1)
+    out = mgr.restore({"w": np.zeros(4, np.float32)})
+    np.testing.assert_array_equal(out["w"], state["w"])
+    assert mgr.restore_data_state() is None
+    mgr.close()

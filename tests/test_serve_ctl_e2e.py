@@ -125,3 +125,49 @@ def test_inference_service_lifecycle(controlplane):
     assert client.slices()[0]["used"] == 0
     with pytest.raises(Exception):
         _post(f"{url}/v1/models/clf:predict", {"instances": x.tolist()})
+
+
+def test_bert_predictor_v1_and_v2(controlplane):
+    """Eval config 3 (BASELINE.json): a BERT-family predictor served through
+    the ISVC controller, answering BOTH the v1 predict protocol and the v2
+    open-inference protocol against the same live endpoint. CPU-sized
+    (bert_tiny) per the reference's kind-e2e philosophy; bert_base is the
+    same module at production dims."""
+    from kubeflow_tpu.serve import export_for_serving
+
+    client, workdir, tmp = controlplane
+    bundle = str(tmp / "bert")
+    export_for_serving(bundle, model="bert_tiny", batch_buckets=(1, 2, 4),
+                       seed=3)
+
+    client.create("InferenceService", "bert", {
+        "model": {"name": "bert", "model_dir": bundle},
+        "replicas": 1,
+        "devices_per_replica": 1,
+        "cpu_devices": 1,
+    })
+    _wait_phase(client, "bert", "Ready", timeout=180)
+    url = client.get("InferenceService", "bert")["status"]["endpoints"][0][
+        "url"]
+
+    toks = np.random.default_rng(0).integers(0, 512, (2, 16), dtype=np.int32)
+
+    # v1 predict: [batch, seq] token ids -> [batch, num_labels] logits.
+    v1 = _post(f"{url}/v1/models/bert:predict", {"instances": toks.tolist()})
+    v1_logits = np.asarray(v1["predictions"], np.float32)
+    assert v1_logits.shape == (2, 2)
+    assert np.isfinite(v1_logits).all()
+
+    # v2 open-inference: same tensors, explicit shape/datatype envelope.
+    v2 = _post(f"{url}/v2/models/bert/infer", {
+        "inputs": [{"name": "input_ids", "shape": [2, 16],
+                    "datatype": "INT32",
+                    "data": toks.reshape(-1).tolist()}]})
+    out0 = v2["outputs"][0]
+    v2_logits = np.asarray(out0["data"], np.float32).reshape(out0["shape"])
+    assert list(out0["shape"]) == [2, 2]
+
+    # Both protocols hit the same compiled model: identical logits.
+    np.testing.assert_allclose(v1_logits, v2_logits, rtol=1e-5, atol=1e-5)
+
+    client.delete("InferenceService", "bert")

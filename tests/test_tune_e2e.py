@@ -109,3 +109,59 @@ def test_goal_target_stops_early(controlplane):
     reasons = [c["reason"] for c in exp["status"]["conditions"]]
     assert "GoalReached" in reasons
     assert exp["status"]["trials"]["created"] < 50
+
+
+def test_tpe_over_real_training_trials(controlplane):
+    """Eval config 4 for real: a 16-trial TPE Bayesian sweep whose trials
+    are actual (tiny, CPU-sized) JAXJob training runs — the trial command
+    boots the Trainer runtime, and the controller's metrics collector reads
+    the trainer's JSONL "loss" stream (SURVEY.md §3.4, §5.5) rather than a
+    synthetic objective."""
+    from kubeflow_tpu.tune.sdk import TuneClient
+
+    runner = "; ".join([
+        "import jax",
+        "jax.config.update('jax_platforms', 'cpu')",
+        "from kubeflow_tpu.train.trainer import Trainer, TrainJobSpec",
+        ("spec = TrainJobSpec(model='llama_tiny', dataset='learnable_lm', "
+         "mesh={'data': 1}, steps=8, batch_size=4, seq_len=16, "
+         "learning_rate=${lr}, warmup_steps=${warmup}, log_every=4, "
+         "seed=5)"),
+        "Trainer(spec).run()",
+    ])
+    tc = TuneClient(controlplane)
+    tc.create_experiment(
+        "lmtune",
+        parameters=[
+            {"name": "lr", "type": "double", "min": 1e-4, "max": 3e-2,
+             "log": True},
+            {"name": "warmup", "type": "int", "min": 0, "max": 4},
+        ],
+        objective={"metric": "loss", "goal": "minimize"},
+        algorithm={"name": "tpe", "settings": {"n_startup": 5}},
+        trial_template={
+            "replicas": 1,
+            "devices_per_proc": 1,
+            "command": [sys.executable, "-c", runner],
+        },
+        max_trials=16, parallel_trials=4, seed=11)
+
+    phase = tc.wait("lmtune", timeout=600)
+    exp = tc.get("lmtune")
+    assert phase == "Succeeded", exp
+
+    status = exp["status"]
+    assert status["trials"]["created"] == 16
+    assert status["trials"]["succeeded"] == 16
+
+    # Every observation is a real training loss (finite, positive), and the
+    # tracked optimum is the minimum over trials.
+    values = []
+    for t in tc.trials("lmtune"):
+        obs = t["status"]["observation"]
+        assert obs["metric"] == "loss"
+        assert 0.0 < obs["value"] < 20.0
+        values.append(obs["value"])
+    opt = tc.optimal_trial("lmtune")
+    assert opt["value"] == pytest.approx(min(values))
+    assert 1e-4 <= opt["params"]["lr"] <= 3e-2
