@@ -82,6 +82,7 @@ int main(int argc, char** argv) {
   tpk::LineageStore lineage(workdir + "/lineage.jsonl");
   int lineage_records = lineage.Load();
   tpk::PipelineRunController pipelines(&store, &lineage, workdir, python);
+  tpk::ScheduleController schedule(&store);
   // 250ms probe cap: probes run synchronously in this single-threaded loop,
   // so a slow replica must not stall scheduling/API for long (servers are
   // loopback-local; healthy ones answer in ms).
@@ -141,6 +142,7 @@ int main(int argc, char** argv) {
     double now = static_cast<double>(time(nullptr));
     jaxjob.Tick(now);
     tune.Tick(now);
+    schedule.Tick(now);
     pipelines.Tick(now);
     serve.Tick(now);
     // Tune/pipeline writes (child JAXJob create/delete) need a jaxjob pass

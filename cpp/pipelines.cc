@@ -25,6 +25,41 @@ bool TaskDone(const std::string& phase) {
   return phase == "Succeeded" || phase == "Cached";
 }
 
+// Terminal task phases: nothing more will happen to this task.
+bool TaskTerminal(const std::string& phase) {
+  return TaskDone(phase) || phase == "Failed" || phase == "Skipped" ||
+         phase == "Stopped";
+}
+
+std::string ReadSmallFile(const std::string& path) {
+  FILE* f = fopen(path.c_str(), "rb");
+  if (!f) return "";
+  std::string out;
+  char buf[4096];
+  size_t got;
+  while ((got = fread(buf, 1, sizeof(buf), f)) > 0) out.append(buf, got);
+  fclose(f);
+  return out;
+}
+
+// The launcher records a component's return value under this implicit
+// output artifact (pipelines/launcher.py RESULT_OUTPUT).
+constexpr const char* kResultOutput = "__result__";
+
+// Read back a task's recorded return value from its result artifact.
+// Returns a null Json when absent/unparsable.
+Json ReadResultValue(const Json& outputs) {
+  const std::string dir = outputs.get(kResultOutput).as_string();
+  if (dir.empty()) return Json();
+  const std::string text = ReadSmallFile(dir + "/value.json");
+  if (text.empty()) return Json();
+  try {
+    return Json::parse(text);
+  } catch (const std::exception&) {
+    return Json();
+  }
+}
+
 void MkdirP(const std::string& path) {
   std::string cur;
   for (size_t i = 0; i <= path.size(); ++i) {
@@ -302,6 +337,24 @@ std::vector<std::string> PipelineRunController::TaskDeps(const Json& task) {
     if (arg.is_object() && arg.has("task")) {
       deps.push_back(arg.get("task").as_string());
     }
+    if (arg.is_object() && arg.get("collect").is_array()) {
+      for (const auto& e : arg.get("collect").elements()) {
+        if (e.has("task")) deps.push_back(e.get("task").as_string());
+      }
+    }
+  }
+  // Condition operands referencing task results are data dependencies too.
+  for (const auto& clause : task.get("when").elements()) {
+    for (const char* side : {"lhs", "rhs"}) {
+      const Json& op = clause.get(side);
+      if (op.is_object() && op.has("task")) {
+        deps.push_back(op.get("task").as_string());
+      }
+    }
+  }
+  // An exit handler waits on its whole scope.
+  for (const auto& s : task.get("scope").elements()) {
+    deps.push_back(s.as_string());
   }
   std::sort(deps.begin(), deps.end());
   deps.erase(std::unique(deps.begin(), deps.end()), deps.end());
@@ -392,6 +445,65 @@ bool PipelineRunController::ValidateDag(const Json& tasks,
   return true;
 }
 
+namespace {
+
+bool NumericValue(const Json& v, double* out) {
+  if (v.is_number()) {
+    *out = v.as_number();
+    return true;
+  }
+  if (v.is_bool()) {
+    *out = v.as_bool(false) ? 1.0 : 0.0;
+    return true;
+  }
+  return false;
+}
+
+Json ResolveOperand(const Json& op, const Json& params,
+                    const Json& task_statuses) {
+  if (op.has("value")) return op.get("value");
+  if (op.has("param")) return params.get(op.get("param").as_string());
+  if (op.has("task")) {
+    return task_statuses.get(op.get("task").as_string()).get("result");
+  }
+  return Json();
+}
+
+// Evaluate one `when` clause. Returns false (with *error set) when the
+// operands are not comparable — a authoring bug surfaced as task failure.
+bool EvalClause(const Json& clause, const Json& params,
+                const Json& task_statuses, bool* result,
+                std::string* error) {
+  const Json a = ResolveOperand(clause.get("lhs"), params, task_statuses);
+  const Json b = ResolveOperand(clause.get("rhs"), params, task_statuses);
+  const std::string op = clause.get("op").as_string();
+  int cmp;
+  double x, y;
+  if (NumericValue(a, &x) && NumericValue(b, &y)) {
+    cmp = x < y ? -1 : (x > y ? 1 : 0);
+  } else if (a.is_string() && b.is_string()) {
+    const int c = a.as_string().compare(b.as_string());
+    cmp = c < 0 ? -1 : (c > 0 ? 1 : 0);
+  } else {
+    *error = "condition operands not comparable: " + a.dump() + " " + op +
+             " " + b.dump();
+    return false;
+  }
+  if (op == "==") *result = cmp == 0;
+  else if (op == "!=") *result = cmp != 0;
+  else if (op == ">") *result = cmp > 0;
+  else if (op == ">=") *result = cmp >= 0;
+  else if (op == "<") *result = cmp < 0;
+  else if (op == "<=") *result = cmp <= 0;
+  else {
+    *error = "unknown condition op: " + op;
+    return false;
+  }
+  return true;
+}
+
+}  // namespace
+
 void PipelineRunController::SetPhase(Json* status, const std::string& phase,
                                      const std::string& reason,
                                      const std::string& message) {
@@ -424,6 +536,34 @@ void PipelineRunController::LaunchTask(RunView& run, const std::string& tname,
       params[arg_name] = arg.get("value");
     } else if (arg.has("param")) {
       params[arg_name] = run.params.get(arg.get("param").as_string());
+    } else if (arg.get("collect").is_array()) {
+      // ParallelFor fan-in: arrays of upstream artifact paths (launcher
+      // stages a symlink dir) or of recorded return values (a json param).
+      Json paths = Json::Array();
+      Json digests = Json::Array();
+      Json values = Json::Array();
+      bool artifacts = false;
+      for (const auto& e : arg.get("collect").elements()) {
+        const std::string src = e.get("task").as_string();
+        const Json& src_status = run.status.get("tasks").get(src);
+        if (e.has("output")) {
+          artifacts = true;
+          const std::string out = e.get("output").as_string();
+          paths.push_back(src_status.get("outputs").get(out));
+          digests.push_back(src_status.get("digests").get(out));
+        } else {
+          values.push_back(src_status.get("result"));
+        }
+      }
+      if (artifacts) {
+        inputs[arg_name] = paths;
+        input_digests[arg_name] = digests;
+      } else {
+        params[arg_name] = values;
+      }
+    } else if (arg.has("result")) {
+      const std::string src = arg.get("task").as_string();
+      params[arg_name] = run.status.get("tasks").get(src).get("result");
     } else if (arg.has("task")) {
       const std::string src = arg.get("task").as_string();
       const std::string out = arg.get("output").as_string();
@@ -464,6 +604,9 @@ void PipelineRunController::LaunchTask(RunView& run, const std::string& tname,
         tstatus["outputs"] = outputs;
         tstatus["digests"] = digests;
         tstatus["cachedFrom"] = hit.get("run").as_string();
+        if (!comp.get("returns").as_string().empty()) {
+          tstatus["result"] = ReadResultValue(outputs);
+        }
         run.status["tasks"][tname] = tstatus;
         metrics_.cache_hits++;
         return;
@@ -476,6 +619,11 @@ void PipelineRunController::LaunchTask(RunView& run, const std::string& tname,
   for (const auto& o : comp.get("outputs").elements()) {
     outputs[o.as_string()] = workdir_ + "/" + rname + "/artifacts/" + tname +
                              "/" + o.as_string();
+  }
+  if (!comp.get("returns").as_string().empty()) {
+    // Implicit artifact for the component's return value.
+    outputs[kResultOutput] =
+        workdir_ + "/" + rname + "/artifacts/" + tname + "/" + kResultOutput;
   }
   Json task_spec = Json::Object();
   task_spec["component"] = comp;
@@ -532,7 +680,6 @@ void PipelineRunController::LaunchTask(RunView& run, const std::string& tname,
 void PipelineRunController::CheckRunningTask(RunView& run,
                                              const std::string& tname,
                                              const Json& task) {
-  (void)task;
   Json tstatus = run.status.get("tasks").get(tname);
   const std::string job = tstatus.get("job").as_string();
   auto j = store_->Get("JAXJob", job);
@@ -556,6 +703,9 @@ void PipelineRunController::CheckRunningTask(RunView& run,
     }
     tstatus["digests"] = digests;
     tstatus["phase"] = "Succeeded";
+    if (!task.get("component").get("returns").as_string().empty()) {
+      tstatus["result"] = ReadResultValue(tstatus.get("outputs"));
+    }
     lineage_->Record(tstatus.get("fingerprint").as_string(), run.res.name,
                      tname, lineage_outputs);
     store_->Delete("JAXJob", job);  // harvested; GC the child resource
@@ -606,39 +756,24 @@ void PipelineRunController::Reconcile(const std::string& name) {
     run.status["pipelineSnapshot"] = run.ir;  // freeze for later passes
   }
 
-  // Drive every task one step.
+  // 1. Harvest running tasks.
   for (const auto& [tname, task] : tasks.items()) {
-    const std::string tphase =
-        run.status.get("tasks").get(tname).get("phase").as_string();
-    if (tphase == "Running") {
+    if (run.status.get("tasks").get(tname).get("phase").as_string() ==
+        "Running") {
       CheckRunningTask(run, tname, task);
-    } else if (tphase == "Pending") {
-      bool ready = true;
-      for (const auto& d : TaskDeps(task)) {
-        if (!TaskDone(
-                run.status.get("tasks").get(d).get("phase").as_string())) {
-          ready = false;
-          break;
-        }
-      }
-      if (ready) LaunchTask(run, tname, task);
     }
   }
 
-  // Aggregate.
-  int done = 0, failed = 0, running = 0, total = 0;
+  // 2. Fail fast on any failure (Argo failFast): stop in-flight tasks and
+  // skip pending ones — EXCEPT exit handlers, which must still run.
+  bool any_failed = false;
   for (const auto& [tname, ts] : run.status.get("tasks").items()) {
     (void)tname;
-    ++total;
-    const std::string tp = ts.get("phase").as_string();
-    if (TaskDone(tp)) ++done;
-    else if (tp == "Failed") ++failed;
-    else if (tp == "Running") ++running;
+    if (ts.get("phase").as_string() == "Failed") any_failed = true;
   }
-
-  if (failed > 0) {
-    // Fail fast: stop in-flight tasks, skip the rest (Argo failFast).
+  if (any_failed) {
     for (const auto& [tname, ts] : run.status.get("tasks").items()) {
+      if (tasks.get(tname).get("exit_handler").as_bool(false)) continue;
       const std::string tp = ts.get("phase").as_string();
       if (tp == "Running") {
         store_->Delete("JAXJob", ts.get("job").as_string());
@@ -648,15 +783,98 @@ void PipelineRunController::Reconcile(const std::string& name) {
       } else if (tp == "Pending") {
         Json skipped = ts;
         skipped["phase"] = "Skipped";
+        skipped["reason"] = "RunFailed";
         run.status["tasks"][tname] = skipped;
       }
     }
+  }
+
+  // 3. Schedule pending tasks: exit handlers fire when their scope is
+  // terminal; ordinary tasks skip-cascade, evaluate their `when` clauses,
+  // then launch.
+  for (const auto& [tname, task] : tasks.items()) {
+    Json ts = run.status.get("tasks").get(tname);
+    if (ts.get("phase").as_string() != "Pending") continue;
+
+    if (task.get("exit_handler").as_bool(false)) {
+      bool scope_terminal = true;
+      for (const auto& s : task.get("scope").elements()) {
+        if (!TaskTerminal(run.status.get("tasks")
+                              .get(s.as_string())
+                              .get("phase")
+                              .as_string())) {
+          scope_terminal = false;
+          break;
+        }
+      }
+      if (scope_terminal) LaunchTask(run, tname, task);
+      continue;
+    }
+
+    bool ready = true, skip = false;
+    for (const auto& d : TaskDeps(task)) {
+      const std::string dp =
+          run.status.get("tasks").get(d).get("phase").as_string();
+      if (dp == "Skipped" || dp == "Stopped") {
+        skip = true;  // dependents of skipped tasks are skipped (KFP)
+      } else if (!TaskDone(dp)) {
+        ready = false;
+      }
+    }
+    if (skip) {
+      ts["phase"] = "Skipped";
+      ts["reason"] = "UpstreamSkipped";
+      run.status["tasks"][tname] = ts;
+      continue;
+    }
+    if (!ready) continue;
+    bool when_ok = true;
+    std::string eval_error;
+    for (const auto& clause : task.get("when").elements()) {
+      bool holds = false;
+      if (!EvalClause(clause, run.params, run.status.get("tasks"), &holds,
+                      &eval_error)) {
+        ts["phase"] = "Failed";
+        ts["message"] = eval_error;
+        run.status["tasks"][tname] = ts;
+        when_ok = false;
+        break;
+      }
+      if (!holds) {
+        ts["phase"] = "Skipped";
+        ts["reason"] = "ConditionFalse";
+        ts["condition"] = clause;
+        run.status["tasks"][tname] = ts;
+        when_ok = false;
+        break;
+      }
+    }
+    if (when_ok) LaunchTask(run, tname, task);
+  }
+
+  // 4. Aggregate: the run ends only when every task (exit handlers
+  // included) is terminal; skipped tasks count as complete.
+  int done = 0, failed = 0, running = 0, skipped = 0, total = 0;
+  bool all_terminal = true;
+  for (const auto& [tname, ts] : run.status.get("tasks").items()) {
+    (void)tname;
+    ++total;
+    const std::string tp = ts.get("phase").as_string();
+    if (!TaskTerminal(tp)) all_terminal = false;
+    if (TaskDone(tp)) ++done;
+    else if (tp == "Failed") ++failed;
+    else if (tp == "Running") ++running;
+    else if (tp == "Skipped" || tp == "Stopped") ++skipped;
+  }
+
+  if (all_terminal && failed > 0) {
     SetPhase(&run.status, "Failed", "TaskFailed",
              std::to_string(failed) + " task(s) failed");
     metrics_.runs_failed++;
-  } else if (done == total) {
+  } else if (all_terminal) {
     SetPhase(&run.status, "Succeeded", "AllTasksSucceeded",
-             std::to_string(total) + " tasks done");
+             std::to_string(done) + " done, " + std::to_string(skipped) +
+                 " skipped");
     metrics_.runs_succeeded++;
   } else {
     SetPhase(&run.status, "Running", "Executing",

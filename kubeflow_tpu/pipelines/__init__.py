@@ -12,9 +12,13 @@ The PipelineRun DAG driver, content-hash step cache, and lineage store
 """
 
 from kubeflow_tpu.pipelines.dsl import (  # noqa: F401
+    Collected,
     Component,
+    Condition,
+    ExitHandler,
     InputArtifact,
     OutputArtifact,
+    ParallelFor,
     Pipeline,
     PipelineError,
     compile_pipeline,

@@ -32,12 +32,33 @@ class LauncherError(RuntimeError):
 def _resolve_placeholders(text: str, params: dict, inputs: dict,
                           outputs: dict) -> str:
     for key, val in params.items():
+        if isinstance(val, (list, dict)):
+            val = json.dumps(val)
         text = text.replace("{{params.%s}}" % key, str(val))
     for key, val in inputs.items():
         text = text.replace("{{inputs.%s}}" % key, val)
     for key, val in outputs.items():
         text = text.replace("{{outputs.%s}}" % key, val)
     return text
+
+
+RESULT_OUTPUT = "__result__"  # implicit artifact carrying the return value
+
+
+def _stage_collected(name: str, paths: list) -> str:
+    """Materialize a fan-in input: a directory of numbered symlinks to the
+    per-iteration artifacts, handed to the component as one path."""
+    import tempfile
+
+    stage = tempfile.mkdtemp(prefix=f"tpk-collect-{name}-")
+    for i, p in enumerate(paths):
+        if not os.path.exists(p):
+            raise LauncherError(
+                f"collected input {name!r}[{i}] missing at {p}")
+        # Zero-padded so lexicographic listing preserves iteration order
+        # past 10 items.
+        os.symlink(os.path.abspath(p), os.path.join(stage, f"{i:05d}"))
+    return stage
 
 
 def run_task(spec: dict) -> None:
@@ -47,11 +68,14 @@ def run_task(spec: dict) -> None:
     inputs = spec.get("inputs") or {}
     outputs = spec.get("outputs") or {}
 
-    for name, path in inputs.items():
-        if not os.path.exists(path):
+    for name, path in list(inputs.items()):
+        if isinstance(path, list):  # Collected fan-in over loop iterations
+            inputs[name] = _stage_collected(name, path)
+        elif not os.path.exists(path):
             raise LauncherError(f"input artifact {name!r} missing at {path}")
     for path in outputs.values():
         os.makedirs(path, exist_ok=True)
+    result_dir = outputs.pop(RESULT_OUTPUT, None)
 
     kind = comp.get("kind", "python")
     if kind == "python":
@@ -78,7 +102,13 @@ def run_task(spec: dict) -> None:
         else:
             raise LauncherError(
                 f"component source did not define {comp['name']!r}")
-        fn(**params, **inputs, **outputs)
+        ret = fn(**params, **inputs, **outputs)
+        if comp.get("returns") and result_dir:
+            # The return value is the task's output parameter — recorded
+            # as a tiny artifact the controller reads back for
+            # dsl.Condition / Collected consumers.
+            with open(os.path.join(result_dir, "value.json"), "w") as fh:
+                json.dump(ret, fh)
     elif kind == "command":
         argv = [_resolve_placeholders(a, params, inputs, outputs)
                 for a in comp.get("argv") or []]

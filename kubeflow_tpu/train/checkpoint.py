@@ -50,9 +50,11 @@ class CheckpointManager:
         return self._mgr.should_save(step)
 
     def _items(self, step: int) -> list:
-        try:
-            meta = self._mgr.item_metadata(step)
-        except Exception:
+        """Item names in a step's checkpoint. Legacy (single-item) layouts
+        yield None metadata → []; real metadata errors propagate so a
+        transient failure doesn't silently misroute restore()."""
+        meta = self._mgr.item_metadata(step)
+        if meta is None:
             return []
         return list(getattr(meta, "keys", lambda: [])())
 
@@ -77,7 +79,11 @@ class CheckpointManager:
         step = step if step is not None else self.latest_step()
         if step is None:
             return None
-        if "data" not in self._items(step):
+        try:
+            has_data = "data" in self._items(step)
+        except Exception:
+            return None  # worst case: the trainer falls back to replay
+        if not has_data:
             return None
         out = self._mgr.restore(
             step, args=ocp.args.Composite(data=ocp.args.JsonRestore()))

@@ -173,3 +173,243 @@ def test_golden_ir():
     with open(path) as fh:
         golden = json.load(fh)
     assert ir == golden
+
+
+# -- control flow: Condition / ParallelFor / ExitHandler / results -----------
+
+from kubeflow_tpu.pipelines import (  # noqa: E402
+    Collected,
+    Condition,
+    ExitHandler,
+    ParallelFor,
+)
+
+
+@component
+def score(seed: int = 0) -> float:
+    return 0.25 * (seed + 1)
+
+
+@component
+def deploy(threshold: float = 0.5):
+    pass
+
+
+@component
+def shard_train(model: OutputArtifact, lr: float = 0.1) -> float:
+    import os
+
+    with open(os.path.join(model, "w.txt"), "w") as fh:
+        fh.write(str(lr))
+    return lr
+
+
+@component
+def merge(models: InputArtifact, losses: list, out: OutputArtifact):
+    import os
+
+    with open(os.path.join(out, "merged.txt"), "w") as fh:
+        fh.write(f"{len(os.listdir(models))}:{sum(losses)}")
+
+
+@component
+def cleanup(msg: str = "bye"):
+    print(msg)
+
+
+def test_returns_annotation_and_result_ref():
+    assert score.returns == "double"
+    assert deploy.returns is None
+
+    @pipeline
+    def p():
+        s = score(seed=1)
+        with Condition(s.result, ">", 0.5):
+            deploy()
+
+    ir = compile_pipeline(p)
+    assert ir["tasks"]["score"]["component"]["returns"] == "double"
+    when = ir["tasks"]["deploy"]["when"]
+    assert when == [{"lhs": {"task": "score", "result": True}, "op": ">",
+                     "rhs": {"value": 0.5}}]
+    # The condition operand is a scheduling dependency.
+    with pytest.raises(PipelineError, match="returns nothing"):
+        @pipeline
+        def bad():
+            d = deploy()
+            _ = d.result
+        compile_pipeline(bad)
+
+
+def test_nested_conditions_and():
+    @pipeline
+    def p(cutoff: float = 0.1):
+        s = score(seed=1)
+        with Condition(s.result, ">", 0.2):
+            with Condition(s.result, "<", 0.9):
+                deploy()
+
+    ir = compile_pipeline(p)
+    assert len(ir["tasks"]["deploy"]["when"]) == 2
+
+
+def test_parallel_for_unrolls_with_fan_in():
+    @pipeline
+    def p():
+        with ParallelFor([0.1, 0.2, 0.3]) as lr:
+            t = shard_train(lr=lr)
+        merge(models=Collected(t.output("model")),
+              losses=Collected(t.result))
+
+    ir = compile_pipeline(p)
+    names = sorted(ir["tasks"])
+    assert names == ["merge", "shard_train-it0", "shard_train-it1",
+                     "shard_train-it2"]
+    for i, lr in enumerate([0.1, 0.2, 0.3]):
+        assert ir["tasks"][f"shard_train-it{i}"]["arguments"]["lr"] == {
+            "value": lr}
+    margs = ir["tasks"]["merge"]["arguments"]
+    assert [e["task"] for e in margs["models"]["collect"]] == [
+        "shard_train-it0", "shard_train-it1", "shard_train-it2"]
+    assert all(e.get("result") for e in margs["losses"]["collect"])
+
+
+def test_parallel_for_dict_items_and_intra_loop_edges():
+    @component
+    def consume(data: InputArtifact, tag: str = ""):
+        pass
+
+    @pipeline
+    def p():
+        with ParallelFor([{"lr": 0.1, "tag": "a"},
+                          {"lr": 0.9, "tag": "b"}]) as item:
+            t = shard_train(lr=item.lr)
+            consume(data=t.output("model"), tag=item["tag"])
+
+    ir = compile_pipeline(p)
+    assert ir["tasks"]["consume-it1"]["arguments"]["data"]["task"] == \
+        "shard_train-it1"
+    assert ir["tasks"]["consume-it1"]["arguments"]["tag"] == {"value": "b"}
+
+
+def test_loop_output_escape_requires_collected():
+    @pipeline
+    def p():
+        with ParallelFor([1, 2]) as it:
+            t = shard_train(lr=it)
+        merge(models=t.output("model"), losses=Collected(t.result))
+
+    with pytest.raises(PipelineError, match="Collected"):
+        compile_pipeline(p)
+
+
+def test_exit_handler_ir_and_no_cache():
+    @pipeline
+    def p():
+        with ExitHandler(cleanup(msg="done")):
+            s = score(seed=3)
+            with Condition(s.result, ">", 2.0):
+                deploy()
+
+    ir = compile_pipeline(p)
+    eh = ir["tasks"]["cleanup"]
+    assert eh["exit_handler"] is True
+    assert sorted(eh["scope"]) == ["deploy", "score"]
+    assert eh["component"]["cache"] is False
+
+
+def test_exit_task_rejects_task_refs():
+    @component
+    def notify(val: float = 0.0):
+        pass
+
+    with pytest.raises(PipelineError, match="exit task"):
+        @pipeline
+        def p():
+            s = score(seed=1)
+            with ExitHandler(notify(val=s.result)):
+                deploy()
+        compile_pipeline(p)
+
+
+def test_retries_in_ir():
+    @component(retries=2)
+    def flaky():
+        pass
+
+    @pipeline
+    def p():
+        flaky()
+
+    ir = compile_pipeline(p)
+    assert ir["tasks"]["flaky"]["component"]["retries"] == 2
+
+
+def test_golden_ir_control_flow():
+    """Golden IR for the control-flow surface (condition + loop + fan-in +
+    exit handler) — regenerate deliberately with REGEN_GOLDEN=1."""
+    @pipeline
+    def flow(cutoff: float = 0.2):
+        with ExitHandler(cleanup(msg="done")):
+            with ParallelFor([0.1, 0.2]) as lr:
+                t = shard_train(lr=lr)
+            merge(models=Collected(t.output("model")),
+                  losses=Collected(t.result))
+            with Condition(cutoff, ">", 0.15):
+                deploy(threshold=cutoff)
+
+    ir = compile_pipeline(flow)
+    path = os.path.join(GOLDEN, "control_flow_pipeline.json")
+    if os.environ.get("REGEN_GOLDEN") == "1" or not os.path.exists(path):
+        with open(path, "w") as fh:
+            json.dump(ir, fh, indent=2, sort_keys=True)
+    with open(path) as fh:
+        golden = json.load(fh)
+    assert ir == golden
+
+
+def test_nested_parallel_for_collected_fans_in_all_iterations():
+    @pipeline
+    def p():
+        with ParallelFor([1, 2]) as outer:
+            with ParallelFor([10, 20]) as inner:
+                t = shard_train(lr=outer)
+        merge(models=Collected(t.output("model")),
+              losses=Collected(t.result))
+
+    ir = compile_pipeline(p)
+    collect = ir["tasks"]["merge"]["arguments"]["losses"]["collect"]
+    names = sorted(e["task"] for e in collect)
+    # 2x2 unroll: every final clone is fanned in, none of the deleted
+    # intermediate inner clones leak into the IR.
+    assert names == sorted(ir["tasks"].keys() - {"merge"})
+    assert len(names) == 4
+    for e in collect:
+        assert e["task"] in ir["tasks"]
+
+
+def test_loop_var_nested_key_path():
+    @component
+    def tagger(tag: str = ""):
+        pass
+
+    @pipeline
+    def p():
+        with ParallelFor([{"a": {"b": "deep0"}, "b": "shallow0"},
+                          {"a": {"b": "deep1"}, "b": "shallow1"}]) as item:
+            tagger(tag=item.a.b)
+
+    ir = compile_pipeline(p)
+    assert ir["tasks"]["tagger-it0"]["arguments"]["tag"] == {"value": "deep0"}
+    assert ir["tasks"]["tagger-it1"]["arguments"]["tag"] == {"value": "deep1"}
+
+
+def test_exit_handler_inside_condition_rejected():
+    with pytest.raises(PipelineError, match="unconditionally"):
+        @pipeline
+        def p():
+            s = score(seed=1)
+            with Condition(s.result, ">", 0.5):
+                with ExitHandler(cleanup(msg="x")):
+                    deploy()
+        compile_pipeline(p)

@@ -152,3 +152,191 @@ def test_failed_step_fails_run(controlplane):
     # The launcher error is visible in the task job's stderr.
     err = client.logs("bad.boom", 0, stderr=True)
     assert "kaboom" in err
+
+
+# --- control flow e2e: Condition / ParallelFor fan-in / ExitHandler / retry -
+
+from kubeflow_tpu.pipelines import (  # noqa: E402
+    Collected,
+    Condition,
+    ExitHandler,
+    ParallelFor,
+    container_component,
+)
+
+
+@component
+def accuracy(n: int = 1) -> float:
+    return n / 10.0
+
+
+@component
+def deploy(report: OutputArtifact, threshold: float = 0.5):
+    import os
+
+    with open(os.path.join(report, "deployed.txt"), "w") as fh:
+        fh.write("yes")
+
+
+@component
+def shard(model: OutputArtifact, lr: float = 0.1) -> float:
+    import json
+    import os
+
+    with open(os.path.join(model, "w.json"), "w") as fh:
+        json.dump({"lr": lr}, fh)
+    return lr * 10
+
+
+@component
+def combine(models: InputArtifact, losses: list, out: OutputArtifact):
+    import json
+    import os
+
+    shards = sorted(os.listdir(models))
+    lrs = [json.load(open(os.path.join(models, s, "w.json")))["lr"]
+           for s in shards]
+    with open(os.path.join(out, "merged.json"), "w") as fh:
+        json.dump({"n": len(shards), "lrs": lrs,
+                   "loss_sum": sum(losses)}, fh)
+
+
+@component(cache=False)
+def audit(note: str = "ran"):
+    print(f"audit={note}")
+
+
+def test_condition_branches(controlplane):
+    from kubeflow_tpu.pipelines.sdk import PipelineClient
+
+    @pipeline
+    def gated(n: int = 1):
+        a = accuracy(n=n)
+        with Condition(a.result, ">=", 0.5):
+            deploy()
+
+    client, workdir, tmp = controlplane
+    pc = PipelineClient(client)
+
+    # n=9 -> accuracy 0.9 -> deploy runs.
+    pc.create_run("hi", pipeline=gated, params={"n": 9})
+    assert pc.wait("hi", timeout=120) == "Succeeded", pc.get_run("hi")
+    t = pc.tasks("hi")
+    assert t["accuracy"]["phase"] == "Succeeded"
+    assert t["accuracy"]["result"] == pytest.approx(0.9)
+    assert t["deploy"]["phase"] == "Succeeded"
+
+    # n=2 -> 0.2 -> deploy (and only deploy) is skipped; run still succeeds.
+    pc.create_run("lo", pipeline=gated, params={"n": 2})
+    assert pc.wait("lo", timeout=120) == "Succeeded", pc.get_run("lo")
+    t = pc.tasks("lo")
+    assert t["deploy"]["phase"] == "Skipped"
+    assert t["deploy"]["reason"] == "ConditionFalse"
+
+
+def test_parallel_for_fan_in(controlplane):
+    from kubeflow_tpu.pipelines.sdk import PipelineClient
+
+    @pipeline
+    def sweep(base: float = 0.1):
+        with ParallelFor([0.1, 0.2, 0.4]) as lr:
+            t = shard(lr=lr)
+        combine(models=Collected(t.output("model")),
+                losses=Collected(t.result))
+
+    client, workdir, tmp = controlplane
+    pc = PipelineClient(client)
+    pc.create_run("sweep", pipeline=sweep)
+    assert pc.wait("sweep", timeout=180) == "Succeeded", pc.get_run("sweep")
+    t = pc.tasks("sweep")
+    assert {t[f"shard-it{i}"]["phase"] for i in range(3)} == {"Succeeded"}
+    out = pc.artifacts("sweep", "combine")["out"]
+    merged = json.load(open(os.path.join(out, "merged.json")))
+    assert merged["n"] == 3
+    assert sorted(merged["lrs"]) == [0.1, 0.2, 0.4]
+    assert merged["loss_sum"] == pytest.approx((0.1 + 0.2 + 0.4) * 10)
+
+
+def test_exit_handler_runs_on_failure(controlplane):
+    from kubeflow_tpu.pipelines.sdk import PipelineClient
+
+    @component
+    def explode(out: OutputArtifact):
+        raise RuntimeError("boom")
+
+    @pipeline
+    def guarded(n: int = 1):
+        with ExitHandler(audit(note="always")):
+            e = explode()
+            fit(data=e.output("out"))
+
+    client, workdir, tmp = controlplane
+    pc = PipelineClient(client)
+    pc.create_run("guarded", pipeline=guarded)
+    assert pc.wait("guarded", timeout=120) == "Failed", pc.get_run("guarded")
+    t = pc.tasks("guarded")
+    assert t["explode"]["phase"] == "Failed"
+    assert t["fit"]["phase"] == "Skipped"
+    # The exit task still ran after the failure.
+    assert t["audit"]["phase"] == "Succeeded"
+    out = client.logs("guarded.audit", 0)
+    assert "audit=always" in out
+
+
+def test_per_task_retry_succeeds_on_second_attempt(controlplane, tmp_path):
+    from kubeflow_tpu.pipelines.sdk import PipelineClient
+
+    client, workdir, tmp = controlplane
+    marker = str(tmp_path / "attempted")
+    flaky = container_component(
+        "flaky",
+        ["bash", "-c",
+         f"if [ -e {marker} ]; then echo ok > {{{{outputs.res}}}}/ok.txt; "
+         f"else touch {marker}; exit 1; fi"],
+        outputs=["res"], retries=2, cache=False)
+
+    @pipeline
+    def retrying(n: int = 1):
+        flaky()
+
+    pc = PipelineClient(client)
+    pc.create_run("retrying", pipeline=retrying)
+    assert pc.wait("retrying", timeout=120) == "Succeeded", pc.get_run(
+        "retrying")
+    assert pc.tasks("retrying")["flaky"]["phase"] == "Succeeded"
+
+
+def test_scheduled_pipeline_run_interval(controlplane):
+    """Recurring runs (ScheduledWorkflow analog): an interval schedule
+    creates runs until max_runs, each executing the pipeline."""
+    import time
+
+    from kubeflow_tpu.pipelines.sdk import PipelineClient
+
+    @pipeline
+    def tick(n: int = 1):
+        accuracy(n=n)
+
+    client, workdir, tmp = controlplane
+    pc = PipelineClient(client)
+    pc.create_pipeline("tick", tick)
+    client.create("ScheduledPipelineRun", "ticker", {
+        "pipeline": "tick",
+        "schedule": {"interval_seconds": 1},
+        "max_runs": 2,
+        "params": {"n": 3},
+    })
+    deadline = time.time() + 90
+    runs = []
+    while time.time() < deadline:
+        runs = [r for r in client.list("PipelineRun")
+                if r["name"].startswith("ticker-")]
+        if len(runs) >= 2 and all(
+                r.get("status", {}).get("phase") in ("Succeeded", "Failed")
+                for r in runs):
+            break
+        time.sleep(0.5)
+    assert len(runs) == 2, [r["name"] for r in runs]
+    assert all(r["status"]["phase"] == "Succeeded" for r in runs)
+    st = client.get("ScheduledPipelineRun", "ticker")["status"]
+    assert st["runsCreated"] == 2
