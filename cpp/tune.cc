@@ -538,13 +538,19 @@ void ExperimentController::Reconcile(const std::string& name) {
                          ? std::min(1 << std::min<int64_t>(sugg_fails, 5),
                                     30)
                          : 0;
-  if (want > 0 && !exhausted &&
+  // A pending algorithm (hyperband waiting on a rung) is re-polled at 1s —
+  // not every 50ms tick, and without counting as a failure.
+  bool pending_wait =
+      status.get("suggestionPending").as_bool(false) &&
+      now_s_ < status.get("lastSuggestionAttempt").as_number(0) + 1.0;
+  if (want > 0 && !exhausted && !pending_wait &&
       (sugg_fails == 0 || now_s_ >= last_attempt + backoff_s)) {
     Json assignments;
+    bool pending = false;
     std::string error;
     if (!suggestion_->GetSuggestions(spec, trial_history,
                                      static_cast<int>(want), &assignments,
-                                     &error)) {
+                                     &error, &pending)) {
       metrics_.suggestion_errors++;
       status["suggestionError"] = error;
       status["suggestionFailures"] = sugg_fails + 1;
@@ -564,10 +570,17 @@ void ExperimentController::Reconcile(const std::string& name) {
         status["suggestionError"] = Json();
         status["suggestionFailures"] = 0;
       }
-      if (assignments.size() == 0) {
+      if (assignments.size() == 0 && pending) {
+        // Algorithm is waiting on running trials (rung promotion): retry
+        // later; NOT exhaustion.
+        status["suggestionPending"] = true;
+        status["lastSuggestionAttempt"] = now_s_;
+      } else if (assignments.size() == 0) {
         // Grid (or any finite space) ran dry: stop proposing; completion
         // is decided above once running trials settle.
         status["searchSpaceExhausted"] = true;
+      } else if (status.get("suggestionPending").as_bool(false)) {
+        status["suggestionPending"] = false;
       }
       for (const auto& a : assignments.elements()) {
         int64_t index = ++max_index;
@@ -700,7 +713,8 @@ bool SubprocessSuggestion::EnsureRunning(std::string* error) {
 bool SubprocessSuggestion::GetSuggestions(const Json& experiment_spec,
                                           const Json& trials, int count,
                                           Json* assignments,
-                                          std::string* error) {
+                                          std::string* error, bool* pending) {
+  if (pending) *pending = false;
   if (!EnsureRunning(error)) return false;
   Json req = Json::Object();
   req["op"] = "get_suggestions";
@@ -774,6 +788,7 @@ bool SubprocessSuggestion::GetSuggestions(const Json& experiment_spec,
       return false;
     }
     *assignments = resp.get("assignments");
+    if (pending) *pending = resp.get("pending").as_bool(false);
     return true;
   } catch (const std::exception& e) {
     if (error) *error = std::string("bad suggestion response: ") + e.what();

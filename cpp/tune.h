@@ -35,9 +35,12 @@ namespace tpk {
 class SuggestionInterface {
  public:
   virtual ~SuggestionInterface() = default;
+  // `pending` (may be null): empty assignments + pending=true means "the
+  // algorithm is waiting on running trials" (hyperband rung promotion) —
+  // NOT search-space exhaustion; the controller retries later.
   virtual bool GetSuggestions(const Json& experiment_spec, const Json& trials,
                               int count, Json* assignments,
-                              std::string* error) = 0;
+                              std::string* error, bool* pending = nullptr) = 0;
 };
 
 // Spawns `python -m kubeflow_tpu.tune.service` lazily and speaks
@@ -47,8 +50,8 @@ class SubprocessSuggestion : public SuggestionInterface {
   explicit SubprocessSuggestion(std::string python = "python3");
   ~SubprocessSuggestion() override;
   bool GetSuggestions(const Json& experiment_spec, const Json& trials,
-                      int count, Json* assignments,
-                      std::string* error) override;
+                      int count, Json* assignments, std::string* error,
+                      bool* pending = nullptr) override;
 
  private:
   bool EnsureRunning(std::string* error);
@@ -66,7 +69,8 @@ class SubprocessSuggestion : public SuggestionInterface {
 class FakeSuggestion : public SuggestionInterface {
  public:
   bool GetSuggestions(const Json&, const Json& trials, int count,
-                      Json* assignments, std::string* error) override {
+                      Json* assignments, std::string* error,
+                      bool* pending = nullptr) override {
     ++calls;
     last_trials = trials;
     if (fail_next) {
@@ -74,7 +78,12 @@ class FakeSuggestion : public SuggestionInterface {
       if (error) *error = "fake: suggestion failure injected";
       return false;
     }
+    if (pending) *pending = pending_next;
     *assignments = Json::Array();
+    if (pending_next) {
+      pending_next = false;
+      return true;
+    }
     for (int i = 0; i < count && !queue.empty(); ++i) {
       assignments->push_back(queue.front());
       queue.erase(queue.begin());
@@ -85,6 +94,7 @@ class FakeSuggestion : public SuggestionInterface {
   Json last_trials;
   int calls = 0;
   bool fail_next = false;
+  bool pending_next = false;
 };
 
 struct TuneMetrics {

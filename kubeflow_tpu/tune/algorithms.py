@@ -246,19 +246,157 @@ def suggest_tpe(parameters: Sequence[dict], history: Sequence[dict],
     return out
 
 
+# ---------------------------------------------------------------------------
+# Hyperband (Li et al., "Hyperband: A Novel Bandit-Based Approach to
+# Hyperparameter Optimization", JMLR 2018) — the reference ships it as a
+# Katib suggestion service ⟨katib: pkg/suggestion/v1beta1/hyperband⟩.
+#
+# One parameter is the RESOURCE (settings["resource"], e.g. "steps"): the
+# algorithm owns its value. Brackets of successive halving run rung by
+# rung; each rung re-proposes the top 1/eta configs at eta× the budget.
+# The function is stateless: the bracket/rung position is reconstructed
+# from the (ordered) trial history on every call. When a rung is waiting
+# on results it returns ([], pending=True) — "ask again later", distinct
+# from exhaustion.
+# ---------------------------------------------------------------------------
+
+TERMINAL_TRIAL = ("Succeeded", "Failed", "EarlyStopped", "Stopped")
+
+
+def hyperband_plan(min_r: float, max_r: float, eta: float) -> list[list[dict]]:
+    """Bracket/rung table: brackets[s] is a list of rungs {n, r} — n configs
+    at budget r; later rungs keep the top n/eta at eta×r."""
+    if not (max_r > 0 and min_r > 0 and max_r >= min_r):
+        raise AlgorithmError("hyperband needs 0 < min_resource <= max_resource")
+    if eta <= 1:
+        raise AlgorithmError("hyperband eta must be > 1")
+    s_max = int(math.floor(math.log(max_r / min_r) / math.log(eta)))
+    brackets = []
+    for s in range(s_max, -1, -1):
+        n = int(math.ceil((s_max + 1) * eta ** s / (s + 1)))
+        rungs = []
+        for i in range(s + 1):
+            n_i = max(int(math.floor(n * eta ** (-i))), 1)
+            r_i = max_r * eta ** (i - s)
+            rungs.append({"n": n_i, "r": r_i})
+        brackets.append(rungs)
+    return brackets
+
+
+def _resource_value(p: dict, r: float):
+    if p.get("type") == "int":
+        return int(min(max(round(r), p["min"]), p["max"]))
+    return float(min(max(r, p["min"]), p["max"]))
+
+
+def suggest_hyperband(parameters: Sequence[dict], history: Sequence[dict],
+                      count: int, seed: int = 0,
+                      settings: dict | None = None) -> dict:
+    """Returns {"assignments": [...], "pending": bool}. pending=True means
+    the current rung is waiting on running trials — nothing to propose yet
+    but the space is NOT exhausted."""
+    _check_space(parameters)
+    s = settings or {}
+    resource = s.get("resource")
+    by_name = {p["name"]: p for p in parameters}
+    if not resource or resource not in by_name:
+        raise AlgorithmError(
+            "hyperband needs settings.resource naming a search parameter "
+            f"(have {sorted(by_name)})")
+    rp = by_name[resource]
+    if rp.get("type") not in ("int", "double"):
+        raise AlgorithmError("hyperband resource must be int or double")
+    min_r = float(s.get("min_resource", rp["min"]))
+    max_r = float(s.get("max_resource", rp["max"]))
+    eta = float(s.get("eta", 3.0))
+    goal = s.get("goal", "minimize")
+    sign = -1.0 if goal == "maximize" else 1.0
+    search = [p for p in parameters if p["name"] != resource]
+    if not search:
+        raise AlgorithmError("hyperband needs at least one non-resource "
+                             "parameter")
+
+    brackets = hyperband_plan(min_r, max_r, eta)
+
+    # Replay history through the plan. Each rung's EFFECTIVE size adapts to
+    # how many configs actually succeeded in the previous rung, so failed
+    # trials shrink later rungs instead of desyncing the slot mapping.
+    hist = list(history)
+    pos = 0  # next unconsumed history index
+    for b, rungs in enumerate(brackets):
+        prev_entries: list[dict] = []
+        for i, rung in enumerate(rungs):
+            if i == 0:
+                size = rung["n"]
+            else:
+                # Promotion needs the WHOLE previous rung settled — a
+                # running trial is not a failed one, so the rung size
+                # cannot be decided (let alone clamped) until then.
+                if any(e.get("status") not in TERMINAL_TRIAL
+                       for e in prev_entries):
+                    return {"assignments": [], "pending": True}
+                promotable = [e for e in prev_entries
+                              if e.get("value") is not None]
+                size = min(rung["n"], len(promotable))
+                if size == 0:
+                    break  # bracket dead: every config failed
+            assigned = hist[pos:pos + size]
+            if len(assigned) < size:
+                # This rung is (partially) unproposed — we are here.
+                k = len(assigned)
+                if i == 0:
+                    rng = _random.Random(f"{seed}:hb:{b}:{len(history)}")
+                    out = []
+                    for j in range(k, min(size, k + count)):
+                        a = {p["name"]: _sample_param(p, rng)
+                             for p in search}
+                        a[resource] = _resource_value(rp, rung["r"])
+                        out.append(a)
+                    return {"assignments": out, "pending": not out}
+                ranked = sorted(
+                    (e for e in prev_entries if e.get("value") is not None),
+                    key=lambda e: sign * float(e["value"]))
+                out = []
+                for j in range(k, min(size, k + count)):
+                    a = dict(ranked[j]["params"])
+                    a[resource] = _resource_value(rp, rung["r"])
+                    out.append(a)
+                return {"assignments": out, "pending": not out}
+            pos += size
+            prev_entries = assigned
+        # bracket fully proposed; continue to next bracket
+    return {"assignments": [], "pending": False}  # plan exhausted
+
+
 ALGORITHMS = {
     "random": suggest_random,
     "grid": suggest_grid,
     "tpe": suggest_tpe,
     "bayesian": suggest_tpe,  # reference's "Bayesian" configs use TPE
+    "hyperband": suggest_hyperband,
 }
+
+
+def suggest_full(algorithm: str, parameters: Sequence[dict],
+                 history: Sequence[dict], count: int, seed: int = 0,
+                 settings: dict | None = None) -> dict:
+    """Normalized service entry point: always returns
+    {"assignments": [...], "pending": bool} (plain-list algorithms never
+    report pending)."""
+    fn = ALGORITHMS.get(algorithm)
+    if fn is None:
+        raise AlgorithmError(
+            f"unknown algorithm {algorithm!r}; have {sorted(ALGORITHMS)}")
+    out = fn(parameters, history, count, seed=seed, settings=settings)
+    if isinstance(out, dict):
+        return {"assignments": list(out.get("assignments", [])),
+                "pending": bool(out.get("pending", False))}
+    return {"assignments": list(out), "pending": False}
 
 
 def suggest(algorithm: str, parameters: Sequence[dict],
             history: Sequence[dict], count: int, seed: int = 0,
             settings: dict | None = None) -> list[dict]:
-    fn = ALGORITHMS.get(algorithm)
-    if fn is None:
-        raise AlgorithmError(
-            f"unknown algorithm {algorithm!r}; have {sorted(ALGORITHMS)}")
-    return fn(parameters, history, count, seed=seed, settings=settings)
+    """Assignments only (drops the pending signal; see suggest_full)."""
+    return suggest_full(algorithm, parameters, history, count, seed=seed,
+                        settings=settings)["assignments"]

@@ -23,7 +23,7 @@ from __future__ import annotations
 import json
 import sys
 
-from kubeflow_tpu.tune.algorithms import AlgorithmError, suggest
+from kubeflow_tpu.tune.algorithms import AlgorithmError, suggest_full
 
 
 def handle(req: dict) -> dict:
@@ -39,7 +39,7 @@ def handle(req: dict) -> dict:
     # TPE needs the optimization direction; carry it from the objective.
     settings.setdefault("goal", objective.get("goal", "minimize"))
     try:
-        assignments = suggest(
+        out = suggest_full(
             algo.get("name", "random"),
             exp.get("parameters") or [],
             req.get("trials") or [],
@@ -49,7 +49,10 @@ def handle(req: dict) -> dict:
         )
     except AlgorithmError as e:
         return {"ok": False, "error": str(e)}
-    return {"ok": True, "assignments": assignments}
+    # `pending` distinguishes "waiting on running trials" (hyperband rung
+    # promotion) from exhaustion when assignments is empty.
+    return {"ok": True, "assignments": out["assignments"],
+            "pending": out["pending"]}
 
 
 def main() -> int:

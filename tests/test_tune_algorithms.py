@@ -155,3 +155,129 @@ def test_service_handle_roundtrip():
     bad = dict(req)
     bad["experiment"] = {"parameters": [], "algorithm": {"name": "random"}}
     assert not handle(bad)["ok"]
+
+
+# -- hyperband (Li et al. 2018; ⟨katib: pkg/suggestion/v1beta1/hyperband⟩) ---
+
+HB_SPACE = [
+    {"name": "lr", "type": "double", "min": 0.01, "max": 1.0, "log": True},
+    {"name": "steps", "type": "int", "min": 1, "max": 9},
+]
+HB_SETTINGS = {"resource": "steps", "min_resource": 1, "max_resource": 9,
+               "eta": 3}
+
+
+def test_hyperband_plan_shape():
+    plan = alg.hyperband_plan(1, 9, 3)
+    # s_max = 2 -> 3 brackets
+    assert [[ (r["n"], round(r["r"])) for r in b] for b in plan] == [
+        [(9, 1), (3, 3), (1, 9)],
+        [(5, 3), (1, 9)],
+        [(3, 9)],
+    ]
+
+
+def test_hyperband_validation():
+    with pytest.raises(alg.AlgorithmError, match="resource"):
+        alg.suggest_hyperband(HB_SPACE, [], 1, settings={})
+    with pytest.raises(alg.AlgorithmError, match="eta"):
+        alg.suggest_hyperband(HB_SPACE, [], 1,
+                              settings=dict(HB_SETTINGS, eta=1.0))
+    with pytest.raises(alg.AlgorithmError, match="non-resource"):
+        alg.suggest_hyperband([HB_SPACE[1]], [], 1, settings=HB_SETTINGS)
+
+
+def _drive_hyperband(objective, settings=HB_SETTINGS, max_rounds=200):
+    """Simulate the experiment controller: propose, run, observe, repeat.
+    Returns the full history."""
+    history = []
+    pend_streak = 0
+    for _ in range(max_rounds):
+        out = alg.suggest_hyperband(HB_SPACE, history, 4, seed=7,
+                                    settings=settings)
+        if not out["assignments"]:
+            if not out["pending"]:
+                return history  # exhausted
+            pend_streak += 1
+            assert pend_streak < 3, "pending with no running trials"
+            continue
+        pend_streak = 0
+        for a in out["assignments"]:
+            history.append({"params": a, "status": "Succeeded",
+                            "value": objective(a)})
+    raise AssertionError("hyperband never exhausted")
+
+
+def test_hyperband_rung_pruning_and_promotion():
+    # Loss improves with lr near 0.1 and with more steps.
+    def objective(a):
+        import math
+        return (math.log10(a["lr"]) + 1) ** 2 + 1.0 / a["steps"]
+
+    history = _drive_hyperband(objective)
+    # Total trials == sum of all rung sizes (no failures -> full plan).
+    plan = alg.hyperband_plan(1, 9, 3)
+    assert len(history) == sum(r["n"] for b in plan for r in b)
+
+    # Bracket 0: rung sizes 9/3/1 with budgets 1/3/9; promoted configs are
+    # exactly the top performers of the rung below.
+    b0r0 = history[:9]
+    b0r1 = history[9:12]
+    b0r2 = history[12:13]
+    assert all(h["params"]["steps"] == 1 for h in b0r0)
+    assert all(h["params"]["steps"] == 3 for h in b0r1)
+    assert b0r2[0]["params"]["steps"] == 9
+    top3 = sorted(b0r0, key=lambda h: h["value"])[:3]
+    assert {h["params"]["lr"] for h in b0r1} == {
+        h["params"]["lr"] for h in top3}
+    top1 = min(b0r1, key=lambda h: h["value"])
+    assert b0r2[0]["params"]["lr"] == top1["params"]["lr"]
+
+
+def test_hyperband_pending_while_rung_running():
+    out = alg.suggest_hyperband(HB_SPACE, [], 4, seed=7,
+                                settings=HB_SETTINGS)
+    history = [{"params": a, "status": "Running"}
+               for a in out["assignments"]]
+    # Fill rung 0 completely but leave trials running.
+    while True:
+        out = alg.suggest_hyperband(HB_SPACE, history, 4, seed=7,
+                                    settings=HB_SETTINGS)
+        if not out["assignments"]:
+            break
+        history.extend({"params": a, "status": "Running"}
+                       for a in out["assignments"])
+        if len(history) > 9:
+            break
+    assert len(history) == 9  # rung 0 of bracket 0
+    out = alg.suggest_hyperband(HB_SPACE, history, 4, seed=7,
+                                settings=HB_SETTINGS)
+    assert out["assignments"] == []
+    assert out["pending"] is True  # waiting, NOT exhausted
+
+
+def test_hyperband_failed_trials_shrink_rung():
+    # All rung-0 trials fail except two -> rung 1 clamps to 2, not 3.
+    def run():
+        history = []
+        out = alg.suggest_hyperband(HB_SPACE, history, 9, seed=7,
+                                    settings=HB_SETTINGS)
+        for i, a in enumerate(out["assignments"]):
+            if i < 2:
+                history.append({"params": a, "status": "Succeeded",
+                                "value": float(i)})
+            else:
+                history.append({"params": a, "status": "Failed"})
+        return history
+
+    history = run()
+    out = alg.suggest_hyperband(HB_SPACE, history, 9, seed=7,
+                                settings=HB_SETTINGS)
+    assert len(out["assignments"]) == 2
+    assert all(a["steps"] == 3 for a in out["assignments"])
+
+
+def test_suggest_full_wraps_plain_algorithms():
+    out = alg.suggest_full("random", SPACE, [], 3, seed=1)
+    assert len(out["assignments"]) == 3
+    assert out["pending"] is False

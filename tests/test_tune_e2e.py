@@ -165,3 +165,54 @@ def test_tpe_over_real_training_trials(controlplane):
     opt = tc.optimal_trial("lmtune")
     assert opt["value"] == pytest.approx(min(values))
     assert 1e-4 <= opt["params"]["lr"] <= 3e-2
+
+
+def hb_objective(params):
+    import math
+
+    # Better (lower) near lr=0.1; more budget refines the estimate.
+    noise = 1.0 / params["budget"]
+    return (math.log10(params["lr"]) + 1) ** 2 + 0.1 * noise
+
+
+def test_hyperband_experiment_end_to_end(controlplane):
+    """Hyperband against the live control plane: the pending protocol keeps
+    the experiment alive while rungs settle; promoted trials re-run at
+    eta-times the budget; the experiment exhausts the bracket plan and
+    succeeds."""
+    from kubeflow_tpu.tune.algorithms import hyperband_plan
+    from kubeflow_tpu.tune.sdk import TuneClient
+
+    tc = TuneClient(controlplane)
+    tc.tune(
+        "hb", hb_objective,
+        parameters=[
+            {"name": "lr", "type": "double", "min": 1e-3, "max": 1.0,
+             "log": True},
+            {"name": "budget", "type": "int", "min": 1, "max": 9},
+        ],
+        metric="objective", goal="minimize",
+        algorithm={"name": "hyperband",
+                   "settings": {"resource": "budget", "min_resource": 1,
+                                "max_resource": 9, "eta": 3}},
+        max_trials=40, parallel_trials=4, seed=13,
+        python=sys.executable)
+
+    phase = tc.wait("hb", timeout=420)
+    exp = tc.get("hb")
+    assert phase == "Succeeded", exp
+
+    plan = hyperband_plan(1, 9, 3)
+    plan_size = sum(r["n"] for b in plan for r in b)
+    status = exp["status"]
+    assert status["trials"]["created"] == plan_size  # full bracket plan
+    assert status["trials"]["succeeded"] == plan_size
+    reasons = [c["reason"] for c in status["conditions"]]
+    assert "SearchSpaceExhausted" in reasons
+
+    # Budgets escalate: some trials ran at 1, promoted ones at 3 and 9.
+    budgets = sorted({t["spec"]["params"]["budget"]
+                      for t in tc.trials("hb")})
+    assert budgets == [1, 3, 9]
+    opt = tc.optimal_trial("hb")
+    assert opt["params"]["budget"] == 9  # best came from a final rung
