@@ -45,6 +45,33 @@ inline std::string ValidateSpec(const std::string& kind, const Json& spec) {
         spec.get("command").size() == 0) {
       return "command must be a non-empty argv array";
     }
+    const Json& fault = spec.get("fault");
+    if (!fault.is_null()) {
+      if (!fault.is_object()) return "fault must be an object";
+      int64_t replicas = spec.get("replicas").as_int(1);
+      int64_t proc = fault.get("proc").as_int(0);
+      if (proc < 0 || proc >= replicas) {
+        return "fault.proc out of range [0, replicas)";
+      }
+      int64_t fstep = fault.get("step").as_int(-1);
+      if (fstep < 0) {
+        return "fault.step must be a step index >= 0";
+      }
+      // The fault must be reachable, or the chaos test silently tests
+      // nothing.
+      int64_t steps = spec.get("runtime").get("steps").as_int(-1);
+      if (steps >= 0 && fstep >= steps) {
+        return "fault.step beyond runtime.steps — it would never fire";
+      }
+      // Only signals that actually terminate the worker: SIGSTOP would
+      // hang the gang forever, SIGCHLD/SIGWINCH are ignored no-ops.
+      int64_t sig = fault.get("signal").as_int(9);
+      if (sig != 1 && sig != 2 && sig != 3 && sig != 6 && sig != 9 &&
+          sig != 15) {
+        return "fault.signal must be a terminating signal "
+               "(1|2|3|6|9|15)";
+      }
+    }
     return "";
   }
 

@@ -135,6 +135,20 @@ void JaxJobController::LaunchGang(JobView& job) {
     s.env["TPK_NUM_SLICES"] = std::to_string(num_slices);
     s.env["TPK_SLICE_ID"] = std::to_string(i * num_slices / replicas);
     s.env["TPK_JOB_NAME"] = name;
+    // First-class fault injection (SURVEY.md §5.3): spec.fault =
+    // {proc, step, signal?, every_attempt?} makes worker `proc` kill
+    // itself at training step `step` — deterministic, step-precise chaos
+    // replacing test-side pgrep/kill timing. By default the fault fires
+    // only on the first attempt so the restarted gang can make progress.
+    const Json& fault = job.spec.get("fault");
+    if (fault.is_object() &&
+        static_cast<int>(fault.get("proc").as_int(0)) == i &&
+        (fault.get("every_attempt").as_bool(false) ||
+         job.status.get("restarts").as_int(0) == 0)) {
+      s.env["TPK_FAULT"] =
+          "step=" + std::to_string(fault.get("step").as_int(0)) +
+          ";signal=" + std::to_string(fault.get("signal").as_int(9));
+    }
     s.stdout_path = dir + "/worker-" + std::to_string(i) + ".log";
     s.stderr_path = dir + "/worker-" + std::to_string(i) + ".err";
     specs.push_back(std::move(s));

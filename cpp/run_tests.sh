@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# C++ test matrix — the `go test && go test -race` analog (SURVEY.md §5.2):
+# plain, ASan+UBSan, and TSan builds must all be green. Run from repo root:
+#   bash cpp/run_tests.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+for variant in "" address thread; do
+  dir="build${variant:+-$variant}"
+  [ "$variant" = address ] && dir=build-asan
+  [ "$variant" = thread ] && dir=build-tsan
+  echo "=== variant: ${variant:-plain} ($dir) ==="
+  cmake -S cpp -B "$dir" ${variant:+-DTPK_SANITIZE=$variant} >/dev/null
+  cmake --build "$dir" -j"$(nproc)" >/dev/null
+  ctest --test-dir "$dir" --output-on-failure
+done
+echo "all sanitizer variants green"

@@ -1,0 +1,120 @@
+// Threaded stress over the components that claim thread safety: the Store
+// (mutex-guarded CRUD + CAS + WAL append) and the LocalExecutor (spawn /
+// status / reap from different threads). Built to run under
+// -DTPK_SANITIZE=thread — the `go test -race` analog the reference runs in
+// CI (SURVEY.md §5.2). Watch *delivery* (DrainWatches) stays on the owning
+// event loop by design; enqueueing from writer threads is exercised here.
+
+#include <atomic>
+#include <cassert>
+#include <cstdio>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "executor.h"
+#include "store.h"
+
+using tpk::Json;
+using tpk::LaunchSpec;
+using tpk::LocalExecutor;
+using tpk::ProcessStatus;
+using tpk::Store;
+
+static void TestStoreConcurrentCrud() {
+  Store store;
+  constexpr int kThreads = 4;
+  constexpr int kOps = 300;
+  std::atomic<int> created{0}, cas_conflicts{0};
+
+  // A shared resource every thread CASes against.
+  assert(store.Create("Job", "shared", Json::Object()).ok);
+
+  std::vector<std::thread> workers;
+  for (int t = 0; t < kThreads; ++t) {
+    workers.emplace_back([&, t]() {
+      for (int i = 0; i < kOps; ++i) {
+        const std::string name =
+            "job-" + std::to_string(t) + "-" + std::to_string(i);
+        Json spec = Json::Object();
+        spec["idx"] = i;
+        if (store.Create("Job", name, spec).ok) created++;
+        auto got = store.Get("Job", name);
+        assert(got && got->spec.get("idx").as_int() == i);
+        Json status = Json::Object();
+        status["phase"] = "Running";
+        assert(store.UpdateStatus("Job", name, status).ok);
+        // CAS on the shared resource: conflicts are expected, corruption
+        // is not.
+        auto cur = store.Get("Job", "shared");
+        assert(cur);
+        Json s2 = Json::Object();
+        s2["winner"] = t;
+        auto r = store.UpdateSpec("Job", "shared", s2, cur->resource_version);
+        if (!r.ok) cas_conflicts++;
+        if (i % 3 == 0) assert(store.Delete("Job", name).ok);
+        (void)store.List("Job");
+      }
+    });
+  }
+  // Concurrent readers while writers run.
+  std::atomic<bool> stop{false};
+  std::thread reader([&]() {
+    while (!stop.load()) {
+      (void)store.List("Job");
+      (void)store.Get("Job", "shared");
+    }
+  });
+  for (auto& w : workers) w.join();
+  stop.store(true);
+  reader.join();
+
+  assert(created.load() == kThreads * kOps);
+  // 2/3 of created jobs survive per thread.
+  size_t expect = 1 + kThreads * (kOps - (kOps + 2) / 3);
+  assert(store.List("Job").size() == expect);
+  printf("store: %d creates, %d CAS conflicts, %zu live\n", created.load(),
+         cas_conflicts.load(), store.List("Job").size());
+}
+
+static void TestExecutorConcurrentStatusPoll() {
+  LocalExecutor exec;
+  constexpr int kGangs = 8;
+  for (int g = 0; g < kGangs; ++g) {
+    std::vector<LaunchSpec> specs;
+    LaunchSpec s;
+    s.id = "gang" + std::to_string(g) + "/0";
+    s.argv = {"/bin/sh", "-c", "exit 0"};
+    specs.push_back(s);
+    std::string error;
+    assert(exec.LaunchGang(specs, &error));
+  }
+  std::atomic<bool> stop{false};
+  std::thread statuser([&]() {
+    while (!stop.load()) {
+      for (int g = 0; g < kGangs; ++g) {
+        (void)exec.Status("gang" + std::to_string(g) + "/0");
+      }
+    }
+  });
+  int done = 0;
+  for (int spins = 0; done < kGangs && spins < 20000; ++spins) {
+    done += static_cast<int>(exec.Poll().size());
+    std::this_thread::sleep_for(std::chrono::milliseconds(1));
+  }
+  stop.store(true);
+  statuser.join();
+  assert(done == kGangs);
+  for (int g = 0; g < kGangs; ++g) {
+    auto st = exec.Status("gang" + std::to_string(g) + "/0");
+    assert(st.phase == ProcessStatus::Phase::kSucceeded);
+  }
+  printf("executor: %d gangs reaped under concurrent Status()\n", done);
+}
+
+int main() {
+  TestStoreConcurrentCrud();
+  TestExecutorConcurrentStatusPoll();
+  printf("test_threads: OK\n");
+  return 0;
+}

@@ -378,6 +378,31 @@ int main() {
     CHECK(h.sched.Slices()[0].used == 0);   // devices released
   }
 
+  // --- pending protocol: empty+pending is NOT exhaustion ------------------
+  {
+    Harness h;
+    CHECK(h.store.Create("Experiment", "pend", BaseExpSpec(4, 2)).ok);
+    h.sugg.pending_next = true;  // hyperband waiting on a rung
+    h.Settle(1);
+    auto exp = h.store.Get("Experiment", "pend");
+    CHECK(!exp->status.get("searchSpaceExhausted").as_bool(false));
+    CHECK(exp->status.get("suggestionPending").as_bool(false));
+    CHECK(exp->status.get("phase").as_string() == "Running");
+    // Next poll (after the 1s hold) yields assignments: trials launch.
+    Json a = Json::Object();
+    a["lr"] = 0.01;
+    h.sugg.queue.push_back(a);
+    h.now += 2.0;
+    h.Settle();
+    CHECK(h.store.List("Trial").size() == 1);
+    // Truly empty (no pending) still exhausts.
+    h.now += 2.0;
+    h.Settle();
+    auto exp2 = h.store.Get("Experiment", "pend");
+    CHECK(exp2->status.get("searchSpaceExhausted").as_bool(false) ||
+          !h.store.List("Trial").empty());
+  }
+
   printf("test_tune OK\n");
   return 0;
 }

@@ -210,10 +210,26 @@ class Trainer:
                 for _ in range(start_step):
                     next(data)
 
+        # Fault injection (SURVEY.md §5.3): the controller sets
+        # TPK_FAULT="step=K;signal=S" on one worker; it kills itself at the
+        # top of step K — the deterministic, step-precise chaos fixture.
+        fault_step = fault_signal = None
+        fault = os.environ.get("TPK_FAULT", "")
+        if fault:
+            kv = dict(part.split("=", 1) for part in fault.split(";") if "=" in part)
+            fault_step = int(kv.get("step", -1))
+            fault_signal = int(kv.get("signal", 9))
+
         last_metrics: dict = {}
         timer.start()
         window = 0
         for step in range(start_step, spec.steps):
+            if fault_step is not None and step == fault_step:
+                if self._ckpt is not None:
+                    self._ckpt.wait()  # die with a consistent checkpoint
+                self.logger.log(step, {"event": "fault_injected",
+                                       "signal": fault_signal})
+                os.kill(os.getpid(), fault_signal)
             if prof_start is not None and step == prof_start:
                 jax.profiler.start_trace(prof["dir"])
                 prof_active = True

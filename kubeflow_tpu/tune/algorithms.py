@@ -258,6 +258,12 @@ def suggest_tpe(parameters: Sequence[dict], history: Sequence[dict],
 # from the (ordered) trial history on every call. When a rung is waiting
 # on results it returns ([], pending=True) — "ask again later", distinct
 # from exhaustion.
+#
+# Known trade-off of the positional replay: brackets run serially — while
+# a rung settles, later (independent) brackets don't propose, idling spare
+# parallel_trials capacity. Keying rung membership by params instead of
+# position would unlock cross-bracket parallelism at the cost of ambiguity
+# under duplicate configs; revisit if hyperband wall-clock matters.
 # ---------------------------------------------------------------------------
 
 TERMINAL_TRIAL = ("Succeeded", "Failed", "EarlyStopped", "Stopped")
