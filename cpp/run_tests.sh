@@ -6,9 +6,11 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 for variant in "" address thread; do
-  dir="build${variant:+-$variant}"
-  [ "$variant" = address ] && dir=build-asan
-  [ "$variant" = thread ] && dir=build-tsan
+  case "$variant" in
+    address) dir=build-asan ;;
+    thread)  dir=build-tsan ;;
+    *)       dir=build ;;
+  esac
   echo "=== variant: ${variant:-plain} ($dir) ==="
   cmake -S cpp -B "$dir" ${variant:+-DTPK_SANITIZE=$variant} >/dev/null
   cmake --build "$dir" -j"$(nproc)" >/dev/null

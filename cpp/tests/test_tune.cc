@@ -395,12 +395,15 @@ int main() {
     h.now += 2.0;
     h.Settle();
     CHECK(h.store.List("Trial").size() == 1);
-    // Truly empty (no pending) still exhausts.
+    // Finish the trial; the next suggestion is empty WITHOUT pending —
+    // that is real exhaustion, and the experiment completes.
+    WriteLog("pend-0", "{\"step\": 1, \"loss\": 0.5}\n");
+    h.exec.Finish("pend-0/0", 0);
     h.now += 2.0;
     h.Settle();
     auto exp2 = h.store.Get("Experiment", "pend");
-    CHECK(exp2->status.get("searchSpaceExhausted").as_bool(false) ||
-          !h.store.List("Trial").empty());
+    CHECK(exp2->status.get("searchSpaceExhausted").as_bool(false));
+    CHECK(exp2->status.get("phase").as_string() == "Succeeded");
   }
 
   printf("test_tune OK\n");

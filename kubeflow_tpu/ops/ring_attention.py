@@ -121,6 +121,129 @@ def ring_attention(q, k, v, axis_name: str = "seq",
     return _ring(q, k, v, positions)
 
 
+def zigzag_indices(s: int, n: int) -> jax.Array:
+    """Zigzag sequence layout for a ring of n devices (SURVEY.md §5.7
+    "causal load-balance"): the sequence splits into 2n chunks and shard i
+    holds chunks (i, 2n-1-i) — one early, one late — so every ring member
+    owns the same amount of causally-visible work: sum over its chunks of
+    (chunk_id+1) = (i+1) + (2n-i) = 2n+1, constant in i. Contiguous
+    layout instead gives member i work ∝ i+1: the last member does n× the
+    first's, and under lockstep SPMD the ring runs at the slowest
+    member's pace.
+
+    Returns the permutation `idx` such that `x[:, idx]` is zigzag-ordered;
+    invert with jnp.argsort(idx)."""
+    if s % (2 * n):
+        raise ValueError(f"seq len {s} must divide 2*ring ({2 * n})")
+    c = s // (2 * n)
+    chunks = jnp.arange(s, dtype=jnp.int32).reshape(2 * n, c)
+    order = []
+    for i in range(n):
+        order += [i, 2 * n - 1 - i]
+    return chunks[jnp.asarray(order)].reshape(-1)
+
+
+def _maybe_block_attn(q, k, v, q_pos, kv_pos):
+    """_block_attn, skipped entirely (zero partials) when the causal mask
+    kills the whole block — the predicate comes from absolute positions, so
+    skipping can never change numerics, only save the dense FLOPs."""
+    b, s, h, d = q.shape
+
+    def compute(_):
+        return _block_attn(q, k, v, q_pos, kv_pos)
+
+    def skip(_):
+        return (jnp.zeros((b, s, h, d), jnp.float32),
+                jnp.full((b, s, h, 1), NEG_INF, jnp.float32),
+                jnp.zeros((b, s, h, 1), jnp.float32))
+
+    visible = jnp.max(q_pos) >= jnp.min(kv_pos)
+    return jax.lax.cond(visible, compute, skip, None)
+
+
+def zigzag_ring_attention(q, k, v, axis_name: str = "seq", mesh=None,
+                          pre_permuted: bool = False) -> jax.Array:
+    """Causal ring attention with the zigzag layout. Inputs/outputs are in
+    NORMAL sequence order unless `pre_permuted` (the efficient path: lay
+    the batch out with zigzag_indices in the input pipeline and skip the
+    runtime gather). Each ring step splits the resident Q and incoming KV
+    into their two chunks and computes only the causally-visible
+    sub-blocks — ~2× less dense work at the lockstep pace vs the
+    contiguous schedule."""
+    mesh = mesh or current_mesh()
+    if mesh is None:
+        raise ValueError("zigzag_ring_attention needs a mesh")
+    n = mesh.shape[axis_name]
+    b, s, h, d = q.shape
+    if n == 1:
+        from kubeflow_tpu.ops.reference import naive_attention
+        return naive_attention(q, k, v, causal=True)
+
+    idx = zigzag_indices(s, n)
+    if not pre_permuted:
+        q, k, v = (x[:, idx] for x in (q, k, v))
+    positions = jnp.broadcast_to(idx[None].astype(jnp.int32), (b, s))
+
+    spec = P(("data", "fsdp"), axis_name, None, None)
+    pos_spec = P(("data", "fsdp"), axis_name)
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(spec, spec, spec, pos_spec),
+        out_specs=spec, check_vma=False)
+    def _ring(q, k, v, pos):
+        b_loc, s_loc = q.shape[0], q.shape[1]
+        half = s_loc // 2  # chunk boundary inside the zigzag shard
+
+        def split(x):
+            return x[:, :half], x[:, half:]
+
+        q_lo, q_hi = split(q)
+        p_lo, p_hi = split(pos)
+
+        def step(i, carry):
+            (lo_part, hi_part), kv, kv_pos = carry
+            k_i, v_i = kv
+            k_lo, k_hi = split(k_i)
+            v_lo, v_hi = split(v_i)
+            kp_lo, kp_hi = split(kv_pos)
+            # 4 sub-blocks; fully-masked ones cost ~nothing (lax.cond).
+            for kk, vv, kp in ((k_lo, v_lo, kp_lo), (k_hi, v_hi, kp_hi)):
+                lo_part = _merge(lo_part,
+                                 _maybe_block_attn(q_lo, kk, vv, p_lo, kp))
+                hi_part = _merge(hi_part,
+                                 _maybe_block_attn(q_hi, kk, vv, p_hi, kp))
+
+            def rotate(operand):
+                perm = [(j, (j + 1) % n) for j in range(n)]
+                return jax.tree.map(
+                    lambda x: jax.lax.ppermute(x, axis_name, perm), operand)
+
+            kv, kv_pos = jax.lax.cond(
+                i < n - 1, rotate, lambda o: o, (kv, kv_pos))
+            return (lo_part, hi_part), kv, kv_pos
+
+        def zero_part(width):
+            return (jnp.zeros((b_loc, width, h, d), jnp.float32),
+                    jnp.full((b_loc, width, h, 1), NEG_INF, jnp.float32),
+                    jnp.zeros((b_loc, width, h, 1), jnp.float32))
+
+        init = (zero_part(half), zero_part(s_loc - half))
+        (lo, hi), _, _ = jax.lax.fori_loop(
+            0, n, jax.checkpoint(step), (init, (k, v), pos))
+
+        def finish(part):
+            acc, _, l = part
+            return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+        return jnp.concatenate([finish(lo), finish(hi)], axis=1)
+
+    out = _ring(q, k, v, positions)
+    if pre_permuted:
+        return out
+    return out[:, jnp.argsort(idx)]
+
+
 def ulysses_attention(q, k, v, axis_name: str = "seq",
                       mesh=None) -> jax.Array:
     """DeepSpeed-Ulysses-style context parallelism: all_to_all seq↔heads so
