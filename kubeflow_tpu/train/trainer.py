@@ -200,15 +200,37 @@ class Trainer:
         from kubeflow_tpu.data.loader import (
             iterator_state, restore_iterator)
 
+        def pack_data_state():
+            st = iterator_state(data)
+            if st is None:
+                return None
+            # The iterator state is only valid for the same per-process
+            # shard layout; tag it so an elastic resize (different world
+            # size) restarts the stream instead of mis-seeking.
+            return {"process_count": jax.process_count(), "state": st}
+
         dataset = self._data()
         data = iter(dataset)
         if start_step:
-            # Checkpointable iterators (grain) seek in O(1); plain
-            # generators fall back to replaying consumed batches.
             saved = self._ckpt.restore_data_state()
-            if not restore_iterator(data, saved):
+            if saved is None:
+                # Plain generators: replay consumed batches.
                 for _ in range(start_step):
                     next(data)
+            elif (isinstance(saved, dict) and "process_count" in saved):
+                if saved["process_count"] == jax.process_count():
+                    # Checkpointable iterators (grain) seek in O(1).
+                    restore_iterator(data, saved.get("state"))
+                else:
+                    # Resized world: per-process shards changed; a fresh
+                    # stream is the correct (and standard) resume behavior.
+                    self.logger.log(start_step, {
+                        "event": "data_stream_restarted",
+                        "reason": "world size changed"})
+            else:
+                # Pre-tag checkpoint: raw iterator state, same-world by
+                # assumption (the tag didn't exist to say otherwise).
+                restore_iterator(data, saved)
 
         # Fault injection (SURVEY.md §5.3): the controller sets
         # TPK_FAULT="step=K;signal=S" on one worker; it kills itself at the
@@ -246,7 +268,7 @@ class Trainer:
                 # in the non-blocking hot loop.
                 self._ckpt.maybe_save(
                     step + 1, state,
-                    data_state=(iterator_state(data)
+                    data_state=(pack_data_state()
                                 if self._ckpt.should_save(step + 1)
                                 else None))
             if (step + 1) % spec.log_every == 0 or step + 1 == spec.steps:
@@ -272,7 +294,7 @@ class Trainer:
         if self._ckpt is not None:
             if self._ckpt.latest_step() != spec.steps:
                 self._ckpt.maybe_save(spec.steps, state,
-                                      data_state=iterator_state(data),
+                                      data_state=pack_data_state(),
                                       force=True)
             self._ckpt.wait()
         self.logger.log(spec.steps, {"event": "done", **last_metrics})

@@ -158,3 +158,50 @@ def test_fault_spec_validation(controlplane):
     spec["fault"] = {"proc": 5, "step": 3}
     with pytest.raises(Exception, match="fault.proc"):
         client.submit_jaxjob("badfault", spec)
+
+
+def test_elastic_resubmit_at_different_replica_count(controlplane):
+    """Elastic resize through the control plane (SURVEY.md §5.3): a 2-worker
+    job checkpoints and completes; resubmitting at 1 worker (half the
+    devices) against the same checkpoint dir resumes — params reshard to
+    the new mesh, and the grain stream restarts because the world size
+    changed."""
+    import numpy as np
+
+    client, sock, workdir, tmp = controlplane
+    corpus = tmp / "corpus.npy"
+    np.save(corpus, np.random.default_rng(2).integers(
+        0, 64, 40000, dtype=np.int32))
+    ck = tmp / "ck"
+
+    def spec(replicas, steps):
+        return {
+            "replicas": replicas,
+            "devices_per_proc": 2,
+            "cpu_devices_per_proc": 2,
+            "restart_policy": "OnFailure",
+            "runtime": {
+                "model": "llama_tiny",
+                "dataset": "token_file",
+                "dataset_kwargs": {"path": str(corpus)},
+                "mesh": {"data": 2 * replicas},
+                "steps": steps,
+                "batch_size": 8,
+                "seq_len": 16,
+                "learning_rate": 1e-3,
+                "log_every": 5,
+                "checkpoint": {"dir": str(ck), "interval": 10},
+            },
+        }
+
+    client.submit_jaxjob("big", spec(replicas=2, steps=20))
+    assert client.wait_for_phase("big", timeout=240) == "Succeeded", \
+        client.get("JAXJob", "big")["status"]
+    client.delete("JAXJob", "big")
+
+    client.submit_jaxjob("small", spec(replicas=1, steps=40))
+    assert client.wait_for_phase("small", timeout=240) == "Succeeded", \
+        client.get("JAXJob", "small")["status"]
+    logs = client.logs("small", 0, max_bytes=1 << 20)
+    assert '"restored"' in logs                  # resumed from step 20
+    assert '"data_stream_restarted"' in logs     # world resized 2 -> 1

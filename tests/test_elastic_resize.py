@@ -1,0 +1,69 @@
+"""Elastic resize via checkpoint-restart (SURVEY.md §5.3, the TPU analog
+of PyTorchJob's ElasticPolicy): a job resubmitted at a DIFFERENT topology
+resumes the same orbax checkpoint — params reshard to the new mesh, the
+optimizer state follows, and the data stream restarts cleanly when the
+world size changed."""
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.train.trainer import Trainer, TrainJobSpec
+
+
+def _spec(steps, ckdir, mesh, path):
+    return TrainJobSpec(
+        model="llama_tiny", dataset="token_file",
+        dataset_kwargs={"path": str(path)},
+        mesh=mesh, steps=steps, batch_size=8, seq_len=16,
+        learning_rate=1e-3, log_every=4,
+        checkpoint={"dir": str(ckdir), "interval": 4})
+
+
+def test_resume_across_mesh_resize(tmp_path):
+    """Train on a (data=4, tensor=2) mesh, then resume the same checkpoint
+    on a pure data=8 mesh: orbax reshards every param/opt leaf to the new
+    topology and training continues with decreasing loss."""
+    path = tmp_path / "corpus.npy"
+    np.save(path, np.random.default_rng(0).integers(
+        0, 64, 40000, dtype=np.int32))
+    ck = tmp_path / "ck"
+
+    r1 = Trainer(_spec(8, ck, {"data": 4, "tensor": 2}, path)).run()
+    assert r1["final_step"] == 8
+
+    r2 = Trainer(_spec(16, ck, {"data": 8}, path)).run()
+    assert r2["final_step"] == 16
+    assert np.isfinite(r2["loss"])
+    # Resumed training kept improving on the same learnable-ish stream.
+    assert r2["loss"] <= r1["loss"] * 1.2
+
+    # And back down to a smaller mesh (8 -> 2x2) for good measure.
+    r3 = Trainer(_spec(24, ck, {"data": 2, "fsdp": 2, "tensor": 2},
+                       path)).run()
+    assert r3["final_step"] == 24
+    assert np.isfinite(r3["loss"])
+
+
+def test_data_state_process_count_guard(tmp_path):
+    """The saved iterator state is tagged with the world size; a resume in
+    a matching world seeks the stream, and the tag is present in the
+    checkpoint for the resize path to inspect."""
+    from kubeflow_tpu.train.checkpoint import CheckpointManager
+
+    path = tmp_path / "corpus.npy"
+    np.save(path, np.random.default_rng(1).integers(
+        0, 64, 20000, dtype=np.int32))
+    ck = tmp_path / "ck"
+    Trainer(_spec(4, ck, {"data": -1}, path)).run()
+
+    mgr = CheckpointManager(str(ck), interval=4)
+    saved = mgr.restore_data_state()
+    assert isinstance(saved, dict)
+    assert saved["process_count"] == 1
+    assert saved["state"] is not None
+    mgr.close()
+
+    # Same-world resume still bit-identical to an uninterrupted run.
+    r_resumed = Trainer(_spec(8, ck, {"data": -1}, path)).run()
+    r_full = Trainer(_spec(8, tmp_path / "full", {"data": -1}, path)).run()
+    assert r_full["loss"] == pytest.approx(r_resumed["loss"], abs=1e-6)
