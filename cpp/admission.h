@@ -154,6 +154,24 @@ inline std::string ValidateSpec(const std::string& kind, const Json& spec) {
         spec.get("replicas").as_int() < 0) {
       return "replicas must be >= 0";
     }
+    const Json& logger = spec.get("logger");
+    if (logger.is_object()) {
+      const std::string mode = logger.get("mode").as_string();
+      if (!mode.empty() && mode != "metadata" && mode != "all") {
+        return "logger.mode must be metadata | all";
+      }
+    }
+    const Json& canary = spec.get("canary");
+    if (!canary.is_null()) {
+      if (!canary.is_object()) return "canary must be an object";
+      if (canary.get("model_dir").as_string().empty()) {
+        return "canary needs model_dir";
+      }
+      int64_t pct = canary.get("traffic_percent").as_int(10);
+      if (pct < 0 || pct > 100) {
+        return "canary.traffic_percent must be in [0, 100]";
+      }
+    }
     return "";
   }
 

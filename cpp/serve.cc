@@ -229,6 +229,19 @@ void ServeController::EnsureReplica(View& v, int index) {
     s.env["TPK_SERVICE"] = v.res.name;
     std::string dir = workdir_ + "/" + v.res.name;
     mkdir(dir.c_str(), 0755);
+    // Request logger (KServe agent logger): spec.logger = true or
+    // {"mode": "all"|"metadata"} → per-replica JSONL request log.
+    const Json& logger = v.spec.get("logger");
+    if (logger.as_bool(false) || logger.is_object()) {
+      s.argv.push_back("--request-log");
+      s.argv.push_back(dir + "/requests-" + std::to_string(index) +
+                       ".jsonl");
+      const std::string mode = logger.get("mode").as_string();
+      if (!mode.empty()) {
+        s.argv.push_back("--request-log-mode");
+        s.argv.push_back(mode);
+      }
+    }
     s.stdout_path = dir + "/server-" + std::to_string(index) + ".log";
     s.stderr_path = dir + "/server-" + std::to_string(index) + ".err";
     std::string error;
@@ -242,6 +255,11 @@ void ServeController::EnsureReplica(View& v, int index) {
     rec["ready"] = false;
     rec["backoffUntil"] = Json();
     rec["pendingReason"] = Json();
+    // Record what this replica serves, so a spec change (canary promote /
+    // model update) triggers a rolling restart instead of being ignored.
+    rec["model_dir"] = !model.get("model_dir").as_string().empty()
+                           ? model.get("model_dir")
+                           : model.get("storage_uri");
     metrics_.replica_starts++;
     return 0;
   };
@@ -274,6 +292,43 @@ void ServeController::EnsureReplica(View& v, int index) {
 
   auto st = executor_->Status(id);
   if (st.phase == ProcessStatus::Phase::kRunning) {
+    // Model changed under this replica (e.g. canary promoted): bounce it —
+    // ROLLING: at most one not-ready replica at a time, so a multi-replica
+    // service keeps serving through a model update (a 1-replica service
+    // unavoidably blips). backoffUntil=0 routes the relaunch through the
+    // "backoff elapsed" branch immediately, without counting a crash.
+    const Json& model = v.spec.get("model");
+    const std::string want =
+        !model.get("model_dir").as_string().empty()
+            ? model.get("model_dir").as_string()
+            : model.get("storage_uri").as_string();
+    if (rs.get("model_dir").is_string() &&
+        rs.get("model_dir").as_string() != want) {
+      bool others_ready = true;
+      for (size_t i = 0; i < replicas.size(); ++i) {
+        if (static_cast<int>(i) == index) continue;
+        const Json& other = replicas.elements()[i];
+        if (other.is_object() && other.get("id").is_string() &&
+            !other.get("ready").as_bool(false)) {
+          others_ready = false;
+          break;
+        }
+      }
+      if (others_ready) {
+        executor_->Kill(id);
+        rs["ready"] = false;
+        rs["backoffUntil"] = 0.0;
+        rs["rollout"] = true;
+        Json arr2 = Json::Array();
+        for (size_t i = 0; i < replicas.size(); ++i) {
+          arr2.push_back(static_cast<int>(i) == index
+                             ? rs
+                             : replicas.elements()[i]);
+        }
+        v.status["replicaState"] = arr2;
+        return;
+      }
+    }
     bool ready = rs.get("ready").as_bool(false);
     // Not-ready replicas probe every 1s; ready ones re-probe every 10s —
     // the kubelet liveness analog, so a wedged-but-alive server drops out
@@ -464,6 +519,72 @@ void ServeController::Reconcile(const std::string& name) {
   counts["running"] = running;
   counts["ready"] = ready;
   v.status["replicas"] = counts;
+
+  // Canary rollout (KServe canaryTrafficPercent): spec.canary =
+  // {model_dir, traffic_percent, replicas?} materializes a shadow
+  // "<name>-canary" service running the candidate model; the primary's
+  // endpoint list carries BOTH tracks with traffic weights. Promote =
+  // update spec.model.model_dir to the canary dir and drop spec.canary
+  // (replicas roll to the new model); rollback = drop spec.canary.
+  const Json& canary = v.spec.get("canary");
+  const std::string child_name = name + "-canary";
+  const bool is_child = !v.spec.get("canary_of").as_string().empty();
+  if (!is_child && canary.is_object() &&
+      !canary.get("model_dir").as_string().empty()) {
+    int64_t pct = canary.get("traffic_percent").as_int(10);
+    pct = std::max<int64_t>(0, std::min<int64_t>(100, pct));
+    Json cspec = Json::Object();
+    for (const auto& [k, val] : v.spec.items()) {
+      if (k == "canary" || k == "min_replicas" || k == "max_replicas" ||
+          k == "target_rps") {
+        continue;  // the canary track doesn't autoscale
+      }
+      cspec[k] = val;
+    }
+    Json cmodel = v.spec.get("model");
+    cmodel["model_dir"] = canary.get("model_dir");
+    cspec["model"] = cmodel;
+    cspec["replicas"] = canary.get("replicas").as_int(1);
+    cspec["canary_of"] = name;
+    auto child = store_->Get("InferenceService", child_name);
+    if (!child) {
+      store_->Create("InferenceService", child_name, cspec);
+      metrics_.canary_rollouts++;
+    } else if (child->spec.dump() != cspec.dump()) {
+      store_->UpdateSpec("InferenceService", child_name, cspec);
+    }
+    // Weighted endpoint union: stable gets 100-pct, canary pct.
+    Json weighted = Json::Array();
+    for (const auto& ep : endpoints.elements()) {
+      Json e = ep;
+      e["track"] = "stable";
+      e["weight"] = 100 - pct;
+      weighted.push_back(e);
+    }
+    int canary_ready = 0;
+    if (child) {
+      for (const auto& ep : child->status.get("endpoints").elements()) {
+        Json e = ep;
+        e["track"] = "canary";
+        e["weight"] = pct;
+        weighted.push_back(e);
+        ++canary_ready;
+      }
+    }
+    endpoints = weighted;
+    Json cstat = Json::Object();
+    cstat["service"] = child_name;
+    cstat["traffic_percent"] = pct;
+    cstat["ready"] = canary_ready;
+    v.status["canary"] = cstat;
+  } else if (!is_child) {
+    // No canary configured: tear down a stale child of ours.
+    auto child = store_->Get("InferenceService", child_name);
+    if (child && child->spec.get("canary_of").as_string() == name) {
+      store_->Delete("InferenceService", child_name);
+    }
+    if (v.status.has("canary")) v.status["canary"] = Json();
+  }
   v.status["endpoints"] = endpoints;
 
   std::string phase;
@@ -515,15 +636,23 @@ void ServeController::Tick(double now_s) {
 
 void ServeController::OnDeleted(const Resource& res) {
   const Json& replicas = res.status.get("replicaState");
-  if (!replicas.is_array()) return;
-  for (const auto& rs : replicas.elements()) {
-    if (!rs.is_object()) continue;
-    if (rs.get("id").is_string()) {
-      executor_->Kill(rs.get("id").as_string());
+  if (replicas.is_array()) {
+    for (const auto& rs : replicas.elements()) {
+      if (!rs.is_object()) continue;
+      if (rs.get("id").is_string()) {
+        executor_->Kill(rs.get("id").as_string());
+      }
+      if (rs.get("alloc").is_object() && rs.get("alloc").size() > 0) {
+        scheduler_->Release(AllocFromJson(rs.get("alloc")));
+      }
     }
-    if (rs.get("alloc").is_object() && rs.get("alloc").size() > 0) {
-      scheduler_->Release(AllocFromJson(rs.get("alloc")));
-    }
+  }
+  // Deleting a primary cascades to its canary shadow (whose own kDeleted
+  // event then kills the canary replicas through this same path).
+  const std::string child_name = res.name + "-canary";
+  auto child = store_->Get("InferenceService", child_name);
+  if (child && child->spec.get("canary_of").as_string() == res.name) {
+    store_->Delete("InferenceService", child_name);
   }
 }
 

@@ -74,6 +74,7 @@ struct ServeMetrics {
   int64_t replica_starts = 0;
   int64_t replica_restarts = 0;
   int64_t scale_events = 0;
+  int64_t canary_rollouts = 0;
 
   Json ToJson() const {
     Json j = Json::Object();
@@ -81,6 +82,7 @@ struct ServeMetrics {
     j["replica_starts"] = replica_starts;
     j["replica_restarts"] = replica_restarts;
     j["scale_events"] = scale_events;
+    j["canary_rollouts"] = canary_rollouts;
     return j;
   }
 };

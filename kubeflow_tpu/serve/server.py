@@ -127,6 +127,14 @@ class _Base(tornado.web.RequestHandler):
                 reason = f"{type(exc).__name__}: {exc}"
         self.write_json({"error": reason}, status=status_code)
 
+    def on_finish(self) -> None:
+        # Inference traffic only — health/metadata probes would drown the
+        # log (the reference's logger samples the data plane, not probes).
+        rl = self.server.request_logger
+        if rl is not None and self.request.method == "POST":
+            args = self.path_args or (None,)
+            rl.log(self, args[0])
+
 
 class V1ListHandler(_Base):
     def get(self):
@@ -272,11 +280,52 @@ class MetricsHandler(_Base):
         self.finish(self.server.prometheus_text())
 
 
+class RequestLogger:
+    """Inference request log — the KServe agent logger equivalent (⟨kserve:
+    pkg/agent — request logger⟩, SURVEY.md §2.2). The reference emits
+    CloudEvents to a sink URL; here each request appends one JSONL record
+    to a local file (ts, path, model, status, latency, sizes; payloads too
+    in mode="all"), which the platform's log plumbing ships like any other
+    worker log."""
+
+    def __init__(self, path: str, mode: str = "metadata"):
+        if mode not in ("metadata", "all"):
+            raise ValueError(f"request log mode {mode!r}: metadata | all")
+        self.mode = mode
+        self._fh = open(path, "a", buffering=1)
+        self._lock = threading.Lock()
+
+    def log(self, handler: tornado.web.RequestHandler,
+            model: str | None) -> None:
+        req = handler.request
+        rec = {
+            "ts": time.time(),
+            "method": req.method,
+            "path": req.path,
+            "model": model,
+            "status": handler.get_status(),
+            "latency_ms": round(req.request_time() * 1e3, 3),
+            "request_bytes": len(req.body or b""),
+        }
+        if self.mode == "all":
+            try:
+                rec["request"] = json.loads(req.body or b"{}")
+            except json.JSONDecodeError:
+                rec["request"] = None
+        with self._lock:
+            self._fh.write(json.dumps(rec) + "\n")
+
+    def close(self) -> None:
+        self._fh.close()
+
+
 class ModelServer:
     """Hosts a ModelRepository over HTTP; runs inline or on a daemon thread."""
 
-    def __init__(self, repo: ModelRepository | None = None):
+    def __init__(self, repo: ModelRepository | None = None,
+                 request_logger: RequestLogger | None = None):
         self.repo = repo or ModelRepository()
+        self.request_logger = request_logger
         self._counters: dict[str, dict] = {}
         self._lock = threading.Lock()
         self._loop: tornado.ioloop.IOLoop | None = None
@@ -370,6 +419,10 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--max-latency-ms", type=float, default=5.0)
     p.add_argument("--cpu-devices", type=int, default=0,
                    help="force N virtual CPU devices (test mode)")
+    p.add_argument("--request-log", default=None,
+                   help="JSONL inference request log path (agent logger)")
+    p.add_argument("--request-log-mode", default="metadata",
+                   choices=["metadata", "all"])
     args = p.parse_args(argv)
 
     if args.cpu_devices:
@@ -384,7 +437,9 @@ def main(argv: list[str] | None = None) -> int:
     for i, uri in enumerate(args.storage_uri):
         dirs.append(storage.download(uri, f"/tmp/tpk-models/{i}"))
 
-    server = ModelServer()
+    logger = (RequestLogger(args.request_log, args.request_log_mode)
+              if args.request_log else None)
+    server = ModelServer(request_logger=logger)
     for i, d in enumerate(dirs):
         name = args.name[i] if i < len(args.name) else None
         model = runtimes.load_model(d, name=name)

@@ -171,3 +171,93 @@ def test_bert_predictor_v1_and_v2(controlplane):
     np.testing.assert_allclose(v1_logits, v2_logits, rtol=1e-5, atol=1e-5)
 
     client.delete("InferenceService", "bert")
+
+
+def test_canary_rollout_promote_and_request_logger(controlplane):
+    """Canary traffic split (KServe canaryTrafficPercent, SURVEY.md §2.2):
+    spec.canary materializes a shadow service on the candidate model; the
+    primary's endpoints carry both tracks with weights. Promoting rewrites
+    the primary's model and rolls its replicas; the request logger records
+    inference traffic as JSONL."""
+    from kubeflow_tpu.serve import export_for_serving
+
+    client, workdir, tmp = controlplane
+    stable = str(tmp / "stable")
+    candidate = str(tmp / "candidate")
+    export_for_serving(stable, model="mnist_mlp",
+                       model_kwargs={"in_dim": 8, "hidden": [8],
+                                     "num_classes": 3},
+                       batch_buckets=(1, 4), seed=1)
+    export_for_serving(candidate, model="mnist_mlp",
+                       model_kwargs={"in_dim": 8, "hidden": [16],
+                                     "num_classes": 3},
+                       batch_buckets=(1, 4), seed=2)
+
+    client.create("InferenceService", "clf2", {
+        "model": {"name": "clf2", "model_dir": stable},
+        "replicas": 1,
+        "devices_per_replica": 1,
+        "cpu_devices": 1,
+        "logger": {"mode": "metadata"},
+        "canary": {"model_dir": candidate, "traffic_percent": 25},
+    })
+    _wait_phase(client, "clf2", "Ready", timeout=180)
+
+    # Both tracks come up; weights follow traffic_percent.
+    deadline = time.time() + 120
+    eps = []
+    while time.time() < deadline:
+        status = client.get("InferenceService", "clf2")["status"]
+        eps = status.get("endpoints", [])
+        if {e.get("track") for e in eps} == {"stable", "canary"}:
+            break
+        time.sleep(0.5)
+    tracks = {e["track"]: e for e in eps}
+    assert tracks["stable"]["weight"] == 75
+    assert tracks["canary"]["weight"] == 25
+    assert status["canary"]["traffic_percent"] == 25
+
+    # Both endpoints actually serve the same protocol.
+    x = np.random.default_rng(0).normal(size=(2, 8)).astype(np.float32)
+    for e in tracks.values():
+        out = _post(f"{e['url']}/v1/models/clf2:predict",
+                    {"instances": x.tolist()})
+        assert np.asarray(out["predictions"]).shape == (2, 3)
+
+    # Promote: primary takes the candidate model, canary field dropped ->
+    # shadow torn down, replicas roll to the new model dir.
+    spec = client.get("InferenceService", "clf2")["spec"]
+    spec["model"]["model_dir"] = candidate
+    del spec["canary"]
+    client.update_spec("InferenceService", "clf2", spec)
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        if client.get("InferenceService", "clf2").get("status", {}).get(
+                "phase") != "Ready":
+            break
+        time.sleep(0.2)
+    _wait_phase(client, "clf2", "Ready", timeout=180)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        names = {r["name"] for r in client.list("InferenceService")}
+        if "clf2-canary" not in names:
+            break
+        time.sleep(0.5)
+    assert "clf2-canary" not in names
+    status = client.get("InferenceService", "clf2")["status"]
+    assert all(e.get("track", "stable") == "stable"
+               for e in status["endpoints"])
+    out = _post(f"{status['endpoints'][0]['url']}/v1/models/clf2:predict",
+                {"instances": x.tolist()})
+    assert np.asarray(out["predictions"]).shape == (2, 3)
+    assert client.metrics()["serve"]["canary_rollouts"] >= 1
+
+    # Request logger captured the inference calls.
+    log_path = os.path.join(workdir, "clf2", "requests-0.jsonl")
+    assert os.path.exists(log_path)
+    recs = [json.loads(l) for l in open(log_path) if l.strip()]
+    assert any(r["model"] == "clf2" and r["status"] == 200
+               and r["method"] == "POST" and r["latency_ms"] > 0
+               for r in recs)
+
+    client.delete("InferenceService", "clf2")
