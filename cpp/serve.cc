@@ -197,6 +197,12 @@ void ServeController::EnsureReplica(View& v, int index) {
     s.id = id;
     s.argv = {python_, "-m", "kubeflow_tpu.serve.server",
               "--port", std::to_string(port)};
+    int grpc_port = 0;
+    if (v.spec.get("grpc").as_bool(false)) {
+      grpc_port = FreePort();
+      s.argv.push_back("--grpc-port");
+      s.argv.push_back(std::to_string(grpc_port));
+    }
     if (!model.get("model_dir").as_string().empty()) {
       s.argv.push_back("--model-dir");
       s.argv.push_back(model.get("model_dir").as_string());
@@ -251,6 +257,7 @@ void ServeController::EnsureReplica(View& v, int index) {
     }
     rec["id"] = id;
     rec["port"] = port;
+    if (grpc_port > 0) rec["grpc_port"] = grpc_port;
     rec["pid"] = executor_->Status(id).pid;
     rec["ready"] = false;
     rec["backoffUntil"] = Json();
@@ -318,7 +325,6 @@ void ServeController::EnsureReplica(View& v, int index) {
         executor_->Kill(id);
         rs["ready"] = false;
         rs["backoffUntil"] = 0.0;
-        rs["rollout"] = true;
         Json arr2 = Json::Array();
         for (size_t i = 0; i < replicas.size(); ++i) {
           arr2.push_back(static_cast<int>(i) == index
@@ -509,6 +515,10 @@ void ServeController::Reconcile(const std::string& name) {
           ep["replica"] = static_cast<int>(i);
           ep["url"] = "http://127.0.0.1:" +
                       std::to_string(rs.get("port").as_int());
+          if (rs.get("grpc_port").is_number()) {
+            ep["grpc"] = "127.0.0.1:" +
+                         std::to_string(rs.get("grpc_port").as_int());
+          }
           endpoints.push_back(ep);
         }
       }
@@ -547,36 +557,46 @@ void ServeController::Reconcile(const std::string& name) {
     cspec["replicas"] = canary.get("replicas").as_int(1);
     cspec["canary_of"] = name;
     auto child = store_->Get("InferenceService", child_name);
-    if (!child) {
-      store_->Create("InferenceService", child_name, cspec);
-      metrics_.canary_rollouts++;
-    } else if (child->spec.dump() != cspec.dump()) {
-      store_->UpdateSpec("InferenceService", child_name, cspec);
-    }
-    // Weighted endpoint union: stable gets 100-pct, canary pct.
-    Json weighted = Json::Array();
-    for (const auto& ep : endpoints.elements()) {
-      Json e = ep;
-      e["track"] = "stable";
-      e["weight"] = 100 - pct;
-      weighted.push_back(e);
-    }
-    int canary_ready = 0;
-    if (child) {
-      for (const auto& ep : child->status.get("endpoints").elements()) {
-        Json e = ep;
-        e["track"] = "canary";
-        e["weight"] = pct;
-        weighted.push_back(e);
-        ++canary_ready;
+    if (child && child->spec.get("canary_of").as_string() != name) {
+      // A pre-existing unrelated service holds the shadow's name: refuse
+      // to adopt it (updating would hijack — and later delete — a user's
+      // service); surface the conflict instead.
+      Json cstat = Json::Object();
+      cstat["error"] = "canary blocked: service " + child_name +
+                       " already exists and is not this service's shadow";
+      v.status["canary"] = cstat;
+    } else {
+      if (!child) {
+        store_->Create("InferenceService", child_name, cspec);
+        metrics_.canary_rollouts++;
+      } else if (child->spec.dump() != cspec.dump()) {
+        store_->UpdateSpec("InferenceService", child_name, cspec);
       }
+      // Weighted endpoint union: stable gets 100-pct, canary pct.
+      Json weighted = Json::Array();
+      for (const auto& ep : endpoints.elements()) {
+        Json e = ep;
+        e["track"] = "stable";
+        e["weight"] = 100 - pct;
+        weighted.push_back(e);
+      }
+      int canary_ready = 0;
+      if (child) {
+        for (const auto& ep : child->status.get("endpoints").elements()) {
+          Json e = ep;
+          e["track"] = "canary";
+          e["weight"] = pct;
+          weighted.push_back(e);
+          ++canary_ready;
+        }
+      }
+      endpoints = weighted;
+      Json cstat = Json::Object();
+      cstat["service"] = child_name;
+      cstat["traffic_percent"] = pct;
+      cstat["ready"] = canary_ready;
+      v.status["canary"] = cstat;
     }
-    endpoints = weighted;
-    Json cstat = Json::Object();
-    cstat["service"] = child_name;
-    cstat["traffic_percent"] = pct;
-    cstat["ready"] = canary_ready;
-    v.status["canary"] = cstat;
   } else if (!is_child) {
     // No canary configured: tear down a stale child of ours.
     auto child = store_->Get("InferenceService", child_name);

@@ -1,0 +1,278 @@
+"""gRPC data plane: the Open Inference Protocol v2 over grpcio.
+
+The reference's model server answers REST *and* gRPC (⟨kserve:
+python/kserve — ModelServer grpc servicer⟩, SURVEY.md §2.2); this is the
+gRPC half, sharing the same ModelRepository/Batcher as the HTTP server so
+both protocols hit one compiled model. Service stubs are hand-rolled with
+`grpc.method_handlers_generic_handler` (messages come from the checked-in
+protoc gencode; the grpc python codegen plugin is not in this toolchain —
+the wire format is identical either way).
+
+Tensor encoding: typed `contents` fields or packed little-endian
+`raw_input_contents` (both directions), matching the public protocol.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent import futures
+from typing import TYPE_CHECKING
+
+import grpc
+import ml_dtypes  # noqa: F401 — registers bfloat16 with numpy
+import numpy as np
+
+from kubeflow_tpu.serve import open_inference_pb2 as pb
+from kubeflow_tpu.serve.model import _v2_dtype, v2_to_numpy_dtype
+
+if TYPE_CHECKING:  # avoid a cycle; server.py imports us lazily
+    from kubeflow_tpu.serve.server import ModelServer
+
+SERVICE = "inference.GRPCInferenceService"
+
+# v2 datatype -> InferTensorContents field; the numpy<->v2 dtype mapping
+# itself lives in serve/model.py so REST and gRPC can't drift. FP16/BF16
+# have no typed contents field in the protocol: raw encoding only.
+_CONTENTS_FIELD = {
+    "BOOL": "bool_contents",
+    "INT8": "int_contents", "INT16": "int_contents",
+    "INT32": "int_contents", "INT64": "int64_contents",
+    "UINT8": "uint_contents", "UINT16": "uint_contents",
+    "UINT32": "uint_contents", "UINT64": "uint64_contents",
+    "FP16": None, "BF16": None,
+    "FP32": "fp32_contents", "FP64": "fp64_contents",
+}
+
+
+def tensor_to_numpy(tensor, raw: bytes | None) -> np.ndarray:
+    dt = tensor.datatype.upper()
+    if dt not in _CONTENTS_FIELD:
+        raise ValueError(f"unsupported datatype {tensor.datatype!r}")
+    np_dtype = np.dtype(v2_to_numpy_dtype(dt))
+    shape = tuple(tensor.shape)
+    if raw is not None and len(raw):
+        return np.frombuffer(raw, dtype=np_dtype).reshape(shape)
+    field = _CONTENTS_FIELD[dt]
+    if field is None:
+        raise ValueError(f"{dt} tensors must use raw_input_contents")
+    vals = getattr(tensor.contents, field)
+    return np.asarray(list(vals), dtype=np_dtype).reshape(shape)
+
+
+def numpy_to_tensor(name: str, arr: np.ndarray):
+    arr = np.asarray(arr)
+    dt = _v2_dtype(str(arr.dtype))
+    if _CONTENTS_FIELD.get(dt) is None:
+        arr = arr.astype(np.float32)  # bf16/fp16 -> FP32 typed field
+        dt = "FP32"
+    out = pb.ModelInferResponse.InferOutputTensor(
+        name=name, datatype=dt, shape=list(arr.shape))
+    getattr(out.contents, _CONTENTS_FIELD[dt]).extend(
+        arr.reshape(-1).tolist())
+    return out, None
+
+
+class InferenceServicer:
+    """The five open-inference RPCs over a ModelServer's repository."""
+
+    def __init__(self, server: "ModelServer"):
+        self.server = server
+        self.repo = server.repo
+
+    def ServerLive(self, request, context):
+        return pb.ServerLiveResponse(live=True)
+
+    def ServerReady(self, request, context):
+        return pb.ServerReadyResponse(ready=True)
+
+    def _model(self, name, context):
+        try:
+            return self.repo.get(name)
+        except Exception:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"model {name!r} not found")
+
+    def ModelReady(self, request, context):
+        model = self._model(request.name, context)
+        return pb.ModelReadyResponse(ready=bool(model.ready))
+
+    def ModelMetadata(self, request, context):
+        model = self._model(request.name, context)
+        md = model.metadata()
+        resp = pb.ModelMetadataResponse(
+            name=md.get("name", request.name), versions=["1"],
+            platform=md.get("platform", "kubeflow-tpu"))
+        for t in md.get("inputs", []):
+            resp.inputs.add(name=t["name"], datatype=t["datatype"],
+                            shape=[int(s) for s in t["shape"]])
+        for t in md.get("outputs", []):
+            resp.outputs.add(name=t["name"], datatype=t["datatype"],
+                             shape=[int(s) for s in t["shape"]])
+        return resp
+
+    def ModelInfer(self, request, context):
+        import time
+
+        from kubeflow_tpu.serve.model import Model
+
+        name = request.model_name
+        model = self._model(name, context)
+        if not model.ready:
+            context.abort(grpc.StatusCode.UNAVAILABLE,
+                          f"model {name!r} not ready")
+        nraw = len(request.raw_input_contents)
+        if nraw and nraw != len(request.inputs):
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                "raw_input_contents is all-or-nothing: one entry per input")
+        try:
+            inputs = []
+            for i, tensor in enumerate(request.inputs):
+                raw = request.raw_input_contents[i] if nraw else None
+                inputs.append(tensor_to_numpy(tensor, raw))
+        except ValueError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        params = {k: _param_value(v)
+                  for k, v in request.parameters.items()}
+        # Protocol parity with the HTTP V2 handler: a custom preprocess
+        # sees the same v2-shaped body either way.
+        if type(model).preprocess is not Model.preprocess:
+            body = model.preprocess({
+                "id": request.id, "parameters": params,
+                "inputs": [{
+                    "name": t.name, "datatype": t.datatype,
+                    "shape": list(t.shape), "data": arr,
+                } for t, arr in zip(request.inputs, inputs)]})
+            inputs = [np.asarray(
+                t["data"],
+                dtype=v2_to_numpy_dtype(t.get("datatype", "FP32"))
+            ).reshape(t["shape"]) for t in body.get("inputs", [])]
+            if not inputs:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                              "preprocess returned no inputs")
+        t0 = time.monotonic()
+        try:
+            if getattr(model, "wants_raw_payload", False):
+                # Graph/raw-payload models take the whole payload dict and
+                # bypass the batcher (same as the HTTP handlers).
+                payload = dict(params)
+                payload["instances"] = inputs[0]
+                out = model.predict(payload)
+                outs = [out.get("instances")
+                        if isinstance(out, dict) else out]
+            else:
+                fut = self.server.repo.batcher(name).submit(inputs)
+                outs = fut.result(timeout=120)
+            outs = model.postprocess(outs)
+        except Exception as e:  # surfaced as a proper gRPC status
+            context.abort(grpc.StatusCode.INTERNAL,
+                          f"{type(e).__name__}: {e}")
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+        self.server.observe(name, int(np.asarray(inputs[0]).shape[0]),
+                            time.monotonic() - t0)
+        resp = pb.ModelInferResponse(model_name=name, id=request.id)
+        for j, arr in enumerate(outs):
+            # Always typed contents (FP16 upcast to FP32): mixing typed and
+            # raw outputs would break the protocol's positional raw list.
+            tensor, _ = numpy_to_tensor(f"output_{j}", np.asarray(arr))
+            resp.outputs.append(tensor)
+        return resp
+
+
+def _param_value(p):
+    """InferParameter oneof -> python value."""
+    which = p.WhichOneof("parameter_choice")
+    return getattr(p, which) if which else None
+
+
+def _unary(fn, req_cls, resp_cls):
+    return grpc.unary_unary_rpc_method_handler(
+        fn, request_deserializer=req_cls.FromString,
+        response_serializer=resp_cls.SerializeToString)
+
+
+def build_grpc_server(server: "ModelServer", port: int = 0,
+                      max_workers: int = 8):
+    """Returns (grpc.Server, bound_port). Serves on 127.0.0.1."""
+    servicer = InferenceServicer(server)
+    handlers = grpc.method_handlers_generic_handler(SERVICE, {
+        "ServerLive": _unary(servicer.ServerLive, pb.ServerLiveRequest,
+                             pb.ServerLiveResponse),
+        "ServerReady": _unary(servicer.ServerReady, pb.ServerReadyRequest,
+                              pb.ServerReadyResponse),
+        "ModelReady": _unary(servicer.ModelReady, pb.ModelReadyRequest,
+                             pb.ModelReadyResponse),
+        "ModelMetadata": _unary(servicer.ModelMetadata,
+                                pb.ModelMetadataRequest,
+                                pb.ModelMetadataResponse),
+        "ModelInfer": _unary(servicer.ModelInfer, pb.ModelInferRequest,
+                             pb.ModelInferResponse),
+    })
+    gserver = grpc.server(futures.ThreadPoolExecutor(
+        max_workers=max_workers, thread_name_prefix="tpk-grpc"))
+    gserver.add_generic_rpc_handlers((handlers,))
+    bound = gserver.add_insecure_port(f"127.0.0.1:{port}")
+    if bound == 0:
+        # Fail loudly: advertising a dead port would leave the replica
+        # Ready (HTTP probe passes) while gRPC clients get refused forever;
+        # a crash here routes through the controller's relaunch instead.
+        raise RuntimeError(f"cannot bind gRPC port {port}")
+    return gserver, bound
+
+
+class InferenceClient:
+    """Minimal typed client over the same generic-handler trick — what the
+    reference's InferenceGRPCClient provides (tests + SDK use)."""
+
+    def __init__(self, target: str):
+        self._channel = grpc.insecure_channel(target)
+
+    def _call(self, method, req, resp_cls):
+        rpc = self._channel.unary_unary(
+            f"/{SERVICE}/{method}",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=resp_cls.FromString)
+        return rpc(req)
+
+    def server_live(self) -> bool:
+        return self._call("ServerLive", pb.ServerLiveRequest(),
+                          pb.ServerLiveResponse).live
+
+    def model_ready(self, name: str) -> bool:
+        return self._call("ModelReady", pb.ModelReadyRequest(name=name),
+                          pb.ModelReadyResponse).ready
+
+    def model_metadata(self, name: str):
+        return self._call("ModelMetadata",
+                          pb.ModelMetadataRequest(name=name),
+                          pb.ModelMetadataResponse)
+
+    def infer(self, name: str, arrays: list[np.ndarray], *,
+              raw: bool = False) -> list[np.ndarray]:
+        arrays = [np.asarray(a) for a in arrays]
+        # raw_input_contents is all-or-nothing; FP16/BF16 force raw.
+        use_raw = raw or any(
+            _CONTENTS_FIELD.get(_v2_dtype(str(a.dtype))) is None
+            for a in arrays)
+        req = pb.ModelInferRequest(model_name=name)
+        for i, arr in enumerate(arrays):
+            dt = _v2_dtype(str(arr.dtype))
+            t = req.inputs.add(name=f"input_{i}", datatype=dt,
+                               shape=list(arr.shape))
+            if use_raw:
+                req.raw_input_contents.append(
+                    np.ascontiguousarray(arr).tobytes())
+            else:
+                getattr(t.contents, _CONTENTS_FIELD[dt]).extend(
+                    arr.reshape(-1).tolist())
+        resp = self._call("ModelInfer", req, pb.ModelInferResponse)
+        outs = []
+        for j, t in enumerate(resp.outputs):
+            raw_out = (resp.raw_output_contents[j]
+                       if j < len(resp.raw_output_contents) else None)
+            outs.append(tensor_to_numpy(t, raw_out))
+        return outs
+
+    def close(self):
+        self._channel.close()

@@ -170,7 +170,9 @@ class JAXModel(Model):
 _V2_DTYPES = {
     "float32": "FP32", "float16": "FP16", "bfloat16": "BF16",
     "float64": "FP64", "int32": "INT32", "int64": "INT64",
-    "int8": "INT8", "uint8": "UINT8", "bool": "BOOL",
+    "int8": "INT8", "int16": "INT16", "uint8": "UINT8",
+    "uint16": "UINT16", "uint32": "UINT32", "uint64": "UINT64",
+    "bool": "BOOL",
 }
 _NP_DTYPES = {v: k for k, v in _V2_DTYPES.items()}
 

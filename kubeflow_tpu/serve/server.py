@@ -128,10 +128,15 @@ class _Base(tornado.web.RequestHandler):
         self.write_json({"error": reason}, status=status_code)
 
     def on_finish(self) -> None:
-        # Inference traffic only — health/metadata probes would drown the
-        # log (the reference's logger samples the data plane, not probes).
+        # Inference traffic only — health/metadata probes and repository
+        # control calls would pollute the data-plane log (the reference's
+        # logger samples the data plane, not the control plane).
         rl = self.server.request_logger
-        if rl is not None and self.request.method == "POST":
+        path = self.request.path
+        if (rl is not None and self.request.method == "POST"
+                and (path.endswith(":predict") or path.endswith(":generate")
+                     or path.endswith("/infer")
+                     or path.endswith("/generate"))):
             args = self.path_args or (None,)
             rl.log(self, args[0])
 
@@ -331,6 +336,17 @@ class ModelServer:
         self._loop: tornado.ioloop.IOLoop | None = None
         self._thread: threading.Thread | None = None
         self.port: int | None = None
+        self._grpc = None
+        self.grpc_port: int | None = None
+
+    def start_grpc(self, port: int = 0) -> int:
+        """Open Inference Protocol v2 over gRPC (grpc_server.py), sharing
+        this server's repository/batchers. Returns the bound port."""
+        from kubeflow_tpu.serve.grpc_server import build_grpc_server
+
+        self._grpc, self.grpc_port = build_grpc_server(self, port)
+        self._grpc.start()
+        return self.grpc_port
 
     def observe(self, model: str, examples: int, seconds: float) -> None:
         with self._lock:
@@ -395,6 +411,8 @@ class ModelServer:
         return self.port
 
     def stop(self) -> None:
+        if self._grpc is not None:
+            self._grpc.stop(grace=1.0)
         if self._loop is not None:
             self._loop.add_callback(self._loop.stop)
         if self._thread is not None:
@@ -423,6 +441,8 @@ def main(argv: list[str] | None = None) -> int:
                    help="JSONL inference request log path (agent logger)")
     p.add_argument("--request-log-mode", default="metadata",
                    choices=["metadata", "all"])
+    p.add_argument("--grpc-port", type=int, default=None,
+                   help="also serve the v2 open-inference gRPC protocol")
     args = p.parse_args(argv)
 
     if args.cpu_devices:
@@ -448,6 +468,10 @@ def main(argv: list[str] | None = None) -> int:
                              max_latency_ms=args.max_latency_ms)
         print(json.dumps({"event": "model_loaded", "name": model.name,
                           "load_time_s": model.load_time_s}), flush=True)
+    if args.grpc_port is not None:
+        bound = server.start_grpc(args.grpc_port)
+        print(json.dumps({"event": "grpc_serving", "port": bound}),
+              flush=True)
     print(json.dumps({"event": "serving", "port": args.port}), flush=True)
     server.run(args.port)
     return 0

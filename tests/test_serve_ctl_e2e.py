@@ -261,3 +261,44 @@ def test_canary_rollout_promote_and_request_logger(controlplane):
                for r in recs)
 
     client.delete("InferenceService", "clf2")
+
+
+def test_grpc_data_plane_via_controller(controlplane):
+    """spec.grpc=true: replicas serve the v2 open-inference gRPC protocol
+    alongside REST, and the endpoint list carries the gRPC address."""
+    from kubeflow_tpu.serve import export_for_serving
+    from kubeflow_tpu.serve.grpc_server import InferenceClient
+
+    client, workdir, tmp = controlplane
+    bundle = str(tmp / "gbundle")
+    export_for_serving(bundle, model="mnist_mlp",
+                       model_kwargs={"in_dim": 8, "hidden": [8],
+                                     "num_classes": 3},
+                       batch_buckets=(1, 4), seed=4)
+    client.create("InferenceService", "gclf", {
+        "model": {"name": "gclf", "model_dir": bundle},
+        "replicas": 1,
+        "devices_per_replica": 1,
+        "cpu_devices": 1,
+        "grpc": True,
+    })
+    _wait_phase(client, "gclf", "Ready", timeout=180)
+    ep = client.get("InferenceService", "gclf")["status"]["endpoints"][0]
+    assert "grpc" in ep, ep
+
+    g = InferenceClient(ep["grpc"])
+    try:
+        assert g.server_live()
+        assert g.model_ready("gclf")
+        x = np.random.default_rng(1).normal(size=(2, 8)).astype(np.float32)
+        outs = g.infer("gclf", [x])
+        assert outs[0].shape == (2, 3)
+        # REST and gRPC agree on the same compiled model.
+        rest = _post(f"{ep['url']}/v1/models/gclf:predict",
+                     {"instances": x.tolist()})
+        np.testing.assert_allclose(
+            outs[0], np.asarray(rest["predictions"], np.float32),
+            rtol=1e-5, atol=1e-5)
+    finally:
+        g.close()
+    client.delete("InferenceService", "gclf")
