@@ -75,6 +75,16 @@ inline std::string ValidateSpec(const std::string& kind, const Json& spec) {
     return "";
   }
 
+  if (kind == "Profile") {
+    // Multi-tenancy stub (SURVEY.md §2.5/§7.4): a Profile is a namespace
+    // with a device quota; its name IS the namespace.
+    if (!spec.get("max_devices").is_null() &&
+        spec.get("max_devices").as_int(-1) < 0) {
+      return "max_devices must be >= 0";
+    }
+    return "";
+  }
+
   if (kind == "Experiment") {
     if (!spec.get("parameters").is_array() ||
         spec.get("parameters").size() == 0) {

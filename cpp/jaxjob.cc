@@ -44,6 +44,18 @@ Allocation JaxJobController::AllocFromStatus(const Json& status) const {
   return alloc;
 }
 
+namespace {
+
+// The one normalization rule for tenancy: resources without a namespace
+// live in "default". Python mirrors this in controlplane/client.py
+// (namespace_of) — keep the two in sync.
+std::string NamespaceOf(const Json& spec) {
+  const std::string ns = spec.get("namespace").as_string();
+  return ns.empty() ? "default" : ns;
+}
+
+}  // namespace
+
 void JaxJobController::SetPhase(JobView& job, const std::string& phase,
                                 const std::string& reason,
                                 const std::string& message, double now_s) {
@@ -56,8 +68,24 @@ void JaxJobController::SetPhase(JobView& job, const std::string& phase,
   cond["message"] = message;
   cond["lastTransitionTime"] = Timestamp(now_s ? now_s : NowWall());
   if (!job.status.has("conditions")) job.status["conditions"] = Json::Array();
-  if (prev != phase) {
+  const Json& conds = job.status.get("conditions");
+  const std::string last_reason =
+      conds.size() > 0
+          ? conds.elements()[conds.size() - 1].get("reason").as_string()
+          : "";
+  // Record phase transitions AND reason changes within a phase (a Pending
+  // job moving Unschedulable -> QuotaExceeded must not keep showing the
+  // stale reason). Bounded: non-terminal reasons can flap.
+  if (prev != phase || last_reason != reason) {
     job.status["conditions"].push_back(cond);
+    if (job.status.get("conditions").size() > 20) {
+      Json trimmed = Json::Array();
+      const Json& all = job.status.get("conditions");
+      for (size_t i = all.size() - 20; i < all.size(); ++i) {
+        trimmed.push_back(all.elements()[i]);
+      }
+      job.status["conditions"] = trimmed;
+    }
   }
 }
 
@@ -81,6 +109,35 @@ void JaxJobController::LaunchGang(JobView& job) {
   int replicas = static_cast<int>(job.spec.get("replicas").as_int(1));
   int devices = static_cast<int>(job.spec.get("devices_per_proc").as_int(1));
   int num_slices = static_cast<int>(job.spec.get("num_slices").as_int(1));
+
+  // Namespace device quota — the Profile-controller stub (SURVEY.md §2.5
+  // row "Profile", §7.4 descope: namespace field + quota, no RBAC/Istio).
+  // A Profile resource named like the namespace caps the devices its
+  // running JAXJobs may hold; jobs without a namespace live in "default".
+  const std::string ns = NamespaceOf(job.spec);
+  auto profile = store_->Get("Profile", ns);
+  if (profile) {
+    int64_t quota = profile->spec.get("max_devices").as_int(-1);
+    if (quota >= 0) {
+      int64_t used = 0;
+      for (const auto& other : store_->List("JAXJob")) {
+        if (other.name == name) continue;
+        if (NamespaceOf(other.spec) != ns) continue;
+        const Json& oalloc = other.status.get("allocation");
+        if (oalloc.is_object() && oalloc.size() > 0) {
+          used += other.spec.get("replicas").as_int(1) *
+                  other.spec.get("devices_per_proc").as_int(1);
+        }
+      }
+      if (used + static_cast<int64_t>(replicas) * devices > quota) {
+        SetPhase(job, "Pending", "QuotaExceeded",
+                 "namespace " + ns + " quota " + std::to_string(quota) +
+                     " devices; " + std::to_string(used) + " in use",
+                 now_s_);
+        return;
+      }
+    }
+  }
 
   auto alloc = scheduler_->Allocate(replicas * devices, num_slices);
   if (!alloc) {

@@ -257,7 +257,9 @@ void ServeController::EnsureReplica(View& v, int index) {
     }
     rec["id"] = id;
     rec["port"] = port;
-    if (grpc_port > 0) rec["grpc_port"] = grpc_port;
+    // Unconditional: a relaunch after spec.grpc was disabled must clear
+    // the old port or status would advertise a dead gRPC endpoint.
+    rec["grpc_port"] = grpc_port > 0 ? Json(grpc_port) : Json();
     rec["pid"] = executor_->Status(id).pid;
     rec["ready"] = false;
     rec["backoffUntil"] = Json();

@@ -213,6 +213,46 @@ int main() {
     CHECK(h.sched.Slices()[0].used == 0);
   }
 
+  // --- Namespace device quota (Profile stub, SURVEY.md §2.5/§7.4) -------
+  {
+    Harness h;  // 8 local devices
+    Json prof = Json::Object();
+    prof["max_devices"] = 4;
+    h.store.Create("Profile", "team-a", prof);
+
+    Json a = BaseSpec(4);  // 4 devices in team-a: fills the quota
+    a["namespace"] = "team-a";
+    h.store.Create("JAXJob", "qa", a);
+    h.Settle();
+    CHECK(Phase(h.store, "qa") == "Running");
+
+    Json b = BaseSpec(2);  // 2 more in team-a: over quota despite capacity
+    b["namespace"] = "team-a";
+    h.store.Create("JAXJob", "qb", b);
+    h.Settle();
+    CHECK(Phase(h.store, "qb") == "Pending");
+    {
+      auto r = h.store.Get("JAXJob", "qb");
+      const Json& conds = r->status.get("conditions");
+      CHECK(conds.size() > 0);
+      CHECK(conds.elements()[conds.size() - 1].get("reason").as_string() ==
+            "QuotaExceeded");
+    }
+
+    Json c = BaseSpec(2);  // other namespaces are unconstrained
+    c["namespace"] = "team-b";
+    h.store.Create("JAXJob", "qc", c);
+    h.Settle();
+    CHECK(Phase(h.store, "qc") == "Running");
+
+    // Freeing team-a capacity lets the queued job launch.
+    h.store.Delete("JAXJob", "qa");
+    h.Settle();
+    h.ctl.Tick(h.now + 10);
+    h.Settle();
+    CHECK(Phase(h.store, "qb") == "Running");
+  }
+
   printf("test_jaxjob OK\n");
   return 0;
 }

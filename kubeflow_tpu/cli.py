@@ -88,12 +88,16 @@ def cmd_get(args) -> int:
 
 def cmd_list(args) -> int:
     c = _client(args)
-    items = c.list(_kind_alias(args.kind))
-    fmt = "{:<24} {:<12} {:<10} {:<8}"
-    print(fmt.format("NAME", "PHASE", "RESTARTS", "GEN"))
+    items = c.list(_kind_alias(args.kind),
+                   namespace=getattr(args, "namespace", None))
+    fmt = "{:<24} {:<12} {:<12} {:<10} {:<8}"
+    print(fmt.format("NAME", "NAMESPACE", "PHASE", "RESTARTS", "GEN"))
+    from kubeflow_tpu.controlplane.client import namespace_of
+
     for r in items:
         st = r.get("status", {})
-        print(fmt.format(r["name"], st.get("phase", ""),
+        ns = namespace_of(r)
+        print(fmt.format(r["name"], ns, st.get("phase", ""),
                          str(st.get("restarts", 0)), str(r.get("generation"))))
     return 0
 
@@ -214,6 +218,8 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("list")
     p.add_argument("kind")
+    p.add_argument("--namespace", "-n", default=None,
+                   help="filter to one namespace (Profile name)")
     p.set_defaults(fn=cmd_list)
 
     p = sub.add_parser("logs")

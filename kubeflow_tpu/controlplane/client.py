@@ -20,6 +20,12 @@ class ControlPlaneError(RuntimeError):
     pass
 
 
+def namespace_of(resource: dict) -> str:
+    """The one tenancy normalization rule: resources without a namespace
+    live in "default" (mirror of NamespaceOf in cpp/jaxjob.cc)."""
+    return resource.get("spec", {}).get("namespace") or "default"
+
+
 class Client:
     def __init__(self, socket_path: str = "/tmp/tpk.sock",
                  timeout: float = 30.0):
@@ -75,8 +81,12 @@ class Client:
     def get(self, kind: str, name: str) -> dict:
         return self.request(op="get", kind=kind, name=name)["resource"]
 
-    def list(self, kind: str) -> list[dict]:
-        return self.request(op="list", kind=kind)["items"]
+    def list(self, kind: str, namespace: str | None = None) -> list[dict]:
+        """List resources, optionally filtered to one namespace."""
+        items = self.request(op="list", kind=kind)["items"]
+        if namespace is None:
+            return items
+        return [r for r in items if namespace_of(r) == namespace]
 
     def update_spec(self, kind: str, name: str, spec: dict,
                     expected_version: int | None = None) -> dict:

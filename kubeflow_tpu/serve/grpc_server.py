@@ -60,16 +60,19 @@ def tensor_to_numpy(tensor, raw: bytes | None) -> np.ndarray:
 
 
 def numpy_to_tensor(name: str, arr: np.ndarray):
+    """(InferOutputTensor, raw bytes). Outputs use raw_output_contents —
+    one memcpy instead of per-element typed-field churn on the hot path,
+    and BF16/FP16 keep their dtype instead of upcasting."""
     arr = np.asarray(arr)
     dt = _v2_dtype(str(arr.dtype))
-    if _CONTENTS_FIELD.get(dt) is None:
-        arr = arr.astype(np.float32)  # bf16/fp16 -> FP32 typed field
+    if v2_to_numpy_dtype(dt) != str(arr.dtype):
+        # dtype outside the protocol (e.g. complex): ship as FP32 rather
+        # than mislabeling raw bytes via _v2_dtype's FP32 fallback.
+        arr = arr.astype(np.float32)
         dt = "FP32"
     out = pb.ModelInferResponse.InferOutputTensor(
         name=name, datatype=dt, shape=list(arr.shape))
-    getattr(out.contents, _CONTENTS_FIELD[dt]).extend(
-        arr.reshape(-1).tolist())
-    return out, None
+    return out, np.ascontiguousarray(arr).tobytes()
 
 
 class InferenceServicer:
@@ -172,11 +175,13 @@ class InferenceServicer:
         self.server.observe(name, int(np.asarray(inputs[0]).shape[0]),
                             time.monotonic() - t0)
         resp = pb.ModelInferResponse(model_name=name, id=request.id)
+        # All outputs raw (positional, one entry per tensor) — the
+        # protocol's all-or-nothing rule holds by construction.
         for j, arr in enumerate(outs):
-            # Always typed contents (FP16 upcast to FP32): mixing typed and
-            # raw outputs would break the protocol's positional raw list.
-            tensor, _ = numpy_to_tensor(f"output_{j}", np.asarray(arr))
+            tensor, raw_bytes = numpy_to_tensor(f"output_{j}",
+                                                np.asarray(arr))
             resp.outputs.append(tensor)
+            resp.raw_output_contents.append(raw_bytes)
         return resp
 
 
