@@ -283,10 +283,13 @@ class Llama(nn.Module):
     @nn.compact
     def __call__(self, tokens: jax.Array, positions: jax.Array | None = None,
                  ring_axis: str | None = None, cache: dict | None = None,
-                 cache_index: jax.Array | None = None):
+                 cache_index: jax.Array | None = None,
+                 return_hidden: bool = False):
         """Returns logits [B,S,V]; with `cache` (see init_cache) returns
         (logits, updated_cache) — prefill when S>1 (cache_index must be 0),
-        single-token decode when S==1 (positions default to cache_index)."""
+        single-token decode when S==1 (positions default to cache_index).
+        `return_hidden` skips the unembedding and returns the post-norm
+        hidden states [B,S,H] (chunked-CE training path)."""
         cfg = self.cfg
         if cache is not None:
             if cache_index is None:
@@ -337,6 +340,11 @@ class Llama(nn.Module):
                     lambda *ls: jnp.stack(ls), *layer_caches)
 
         x = RMSNorm(cfg.rms_eps, cfg.dtype, name="final_norm")(x)
+        if return_hidden:
+            # Chunked-CE training path (train/step.py): the caller computes
+            # logits blockwise against the unembedding so the [B·S, V] fp32
+            # logits buffer is never materialized (ops/ROADMAP.md item 1).
+            return x
         if cfg.tie_embeddings:
             logits = jnp.einsum("bsh,vh->bsv", x, embed.astype(cfg.dtype))
         else:

@@ -48,6 +48,67 @@ def cross_entropy_loss(logits: jax.Array, targets: jax.Array,
     return jnp.mean(nll)
 
 
+def chunked_cross_entropy(hidden: jax.Array, head: jax.Array,
+                          targets: jax.Array,
+                          mask: jax.Array | None = None, *,
+                          chunk: int = 1024,
+                          head_is_vocab_major: bool = False) -> jax.Array:
+    """Fused blockwise cross entropy (ops/ROADMAP.md item 1): logits are
+    computed per token-chunk against the unembedding and never
+    materialized as the [B·S, V] fp32 buffer that dominates peak memory at
+    the bench point (PROFILE.md §3). `jax.checkpoint` on the chunk body
+    makes the backward recompute each chunk's logits — FLOPs traded for
+    the logits buffer, the same deal as flash attention.
+
+    hidden [B,S,D]; head [D,V] (lm_head kernel) or [V,D] with
+    `head_is_vocab_major` (tied embedding); targets [B,S].
+    """
+    b, s, d = hidden.shape
+    n = b * s
+    h = hidden.reshape(n, d)
+    t = targets.reshape(n)
+    m = (jnp.ones((n,), jnp.float32) if mask is None
+         else mask.reshape(n).astype(jnp.float32))
+    chunk = min(chunk, n)
+    pad = (-n) % chunk
+    if pad:
+        h = jnp.pad(h, ((0, pad), (0, 0)))
+        t = jnp.pad(t, (0, pad))
+        m = jnp.pad(m, (0, pad))  # padded rows carry mask 0
+    nblk = (n + pad) // chunk
+    hb = h.reshape(nblk, chunk, d)
+    tb = t.reshape(nblk, chunk)
+    mb = m.reshape(nblk, chunk)
+
+    spec = "cd,vd->cv" if head_is_vocab_major else "cd,dv->cv"
+
+    def block(carry, xs):
+        hx, tx, mx = xs
+        logits = jnp.einsum(spec, hx, head.astype(hx.dtype)).astype(
+            jnp.float32)
+        logz = jax.scipy.special.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, tx[:, None], axis=-1)[:, 0]
+        tot, cnt = carry
+        return (tot + jnp.sum((logz - gold) * mx), cnt + jnp.sum(mx)), None
+
+    (tot, cnt), _ = jax.lax.scan(
+        jax.checkpoint(block), (jnp.zeros((), jnp.float32),
+                                jnp.zeros((), jnp.float32)), (hb, tb, mb))
+    return tot / jnp.maximum(cnt, 1.0)
+
+
+def _unembed_head(params: Any) -> tuple[jax.Array, bool]:
+    """(head weights, vocab_major) for the chunked-CE path: the lm_head
+    kernel [D,V], or the tied embedding [V,D]."""
+    if "lm_head" in params:
+        return params["lm_head"]["kernel"], False
+    if "embed" in params:
+        return params["embed"], True
+    raise ValueError(
+        "chunked loss needs an 'lm_head' or tied 'embed' param "
+        f"(have {sorted(params)})")
+
+
 def init_train_state(
     model: nn.Module,
     tx: optax.GradientTransformation,
@@ -82,26 +143,48 @@ def make_train_step(
     rules: Rules = DEFAULT_RULES,
     loss_fn: Callable | None = None,
     model_kwargs: dict | None = None,
+    loss_impl: str = "full",
+    loss_chunk: int = 1024,
 ) -> Callable[[TrainState, dict], tuple[TrainState, dict]]:
     """Build the jitted train step for a causal-LM-style batch:
       batch = {"inputs": [B,S] int32, "targets": [B,S] int32,
                "mask": optional [B,S]}
-    Returns (new_state, metrics) with donated state."""
+    Returns (new_state, metrics) with donated state.
+
+    loss_impl="chunked" computes cross entropy blockwise against the
+    unembedding (model must support return_hidden) — the [B·S, V] fp32
+    logits buffer never materializes; backward recomputes per chunk."""
     model_kwargs = model_kwargs or {}
+    if loss_impl not in ("full", "chunked"):
+        raise ValueError(f"loss_impl {loss_impl!r}: full | chunked")
+    if loss_impl == "chunked" and loss_fn is not None:
+        raise ValueError("loss_impl='chunked' implies the built-in LM loss")
+    if loss_chunk < 1:
+        raise ValueError(f"loss_chunk must be >= 1, got {loss_chunk}")
 
     def compute_loss(params, batch):
         # mutable=["aux_loss"]: MoE routers sow load-balance penalties there
         # (models/moe.py); dense models leave it empty.
-        logits, mutated = model.apply(
+        kwargs = dict(model_kwargs)
+        if loss_impl == "chunked":
+            kwargs["return_hidden"] = True
+        out, mutated = model.apply(
             {"params": params}, batch["inputs"], mutable=["aux_loss"],
-            **model_kwargs)
-        if isinstance(logits, tuple):  # models returning (hidden, logits)
-            logits = logits[-1]
-        if loss_fn is not None:
-            main = loss_fn(logits, batch)
+            **kwargs)
+        if loss_impl == "chunked":
+            head, vocab_major = _unembed_head(params)
+            main = chunked_cross_entropy(
+                out, head, batch["targets"], batch.get("mask"),
+                chunk=loss_chunk, head_is_vocab_major=vocab_major)
         else:
-            main = cross_entropy_loss(logits, batch["targets"],
-                                      batch.get("mask"))
+            logits = out
+            if isinstance(logits, tuple):  # models returning (hidden, logits)
+                logits = logits[-1]
+            if loss_fn is not None:
+                main = loss_fn(logits, batch)
+            else:
+                main = cross_entropy_loss(logits, batch["targets"],
+                                          batch.get("mask"))
         aux = jnp.zeros((), jnp.float32)
         for leaf in jax.tree.leaves(mutated.get("aux_loss", {})):
             aux = aux + jnp.sum(leaf)

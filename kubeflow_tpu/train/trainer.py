@@ -47,6 +47,10 @@ class TrainJobSpec:
     weight_decay: float = 0.0
     seed: int = 0
     ring_attention: bool = False
+    # "full" materializes [B,S,V] logits; "chunked" is the fused blockwise
+    # CE (no logits buffer — the long-context/large-vocab memory saver).
+    loss_impl: str = "full"
+    loss_chunk: int = 1024
     checkpoint: dict = dataclasses.field(default_factory=dict)
     # {"dir": str, "interval": int, "keep": int}
     metrics_path: str | None = None
@@ -176,7 +180,9 @@ class Trainer:
             model_kwargs["ring_axis"] = "seq"
         step_fn = make_train_step(self.model, self.mesh, self.rules,
                                   loss_fn=self._loss_fn(),
-                                  model_kwargs=model_kwargs)
+                                  model_kwargs=model_kwargs,
+                                  loss_impl=spec.loss_impl,
+                                  loss_chunk=spec.loss_chunk)
 
         tokens_per_step = spec.batch_size * (
             spec.seq_len if self.info.get("task") == "lm" else 1)

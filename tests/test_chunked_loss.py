@@ -1,0 +1,131 @@
+"""Chunked fused cross-entropy (ops/ROADMAP.md item 1): identical numerics
+and gradients to the full-logits path, without materializing [B·S, V]."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from kubeflow_tpu.models.llama import Llama, llama_tiny
+from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+from kubeflow_tpu.parallel.sharding import DEFAULT_RULES
+from kubeflow_tpu.train.step import (
+    chunked_cross_entropy,
+    cross_entropy_loss,
+    init_train_state,
+    make_train_step,
+)
+
+
+def _case(b=2, s=24, d=16, v=97, seed=0):
+    ks = jax.random.split(jax.random.key(seed), 3)
+    hidden = jax.random.normal(ks[0], (b, s, d), jnp.float32)
+    head = jax.random.normal(ks[1], (d, v), jnp.float32) * 0.1
+    targets = jax.random.randint(ks[2], (b, s), 0, v)
+    return hidden, head, targets
+
+
+def test_matches_full_loss_including_padding():
+    hidden, head, targets = _case()
+    full = cross_entropy_loss(
+        jnp.einsum("bsd,dv->bsv", hidden, head), targets)
+    for chunk in (7, 16, 48, 4096):  # non-divisible, divisible, > n
+        out = chunked_cross_entropy(hidden, head, targets, chunk=chunk)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(full),
+                                   rtol=1e-6, atol=1e-6)
+
+
+def test_mask_and_vocab_major_head():
+    hidden, head, targets = _case()
+    mask = (jnp.arange(24)[None, :] < 17).astype(jnp.float32).repeat(2, 0)
+    full = cross_entropy_loss(
+        jnp.einsum("bsd,dv->bsv", hidden, head), targets, mask)
+    out = chunked_cross_entropy(hidden, head.T, targets, mask, chunk=10,
+                                head_is_vocab_major=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(full),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_gradients_match_full():
+    hidden, head, targets = _case(s=16)
+
+    def loss_full(h, w):
+        return cross_entropy_loss(jnp.einsum("bsd,dv->bsv", h, w), targets)
+
+    def loss_chunked(h, w):
+        return chunked_cross_entropy(h, w, targets, chunk=5)
+
+    gf = jax.grad(loss_full, argnums=(0, 1))(hidden, head)
+    gc = jax.grad(loss_chunked, argnums=(0, 1))(hidden, head)
+    for a, b in zip(gf, gc):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_train_step_chunked_matches_full(devices8):
+    """Whole-step equivalence on the sharded mesh: starting from the same
+    state, one chunked-loss step lands on the same loss/grad-norm as the
+    full-logits step (fp32 params/tiny model: tight tolerance)."""
+    mesh = build_mesh(MeshConfig(data=2, fsdp=2, tensor=2), devices8)
+    cfg = llama_tiny()
+    model = Llama(cfg)
+    toks = jnp.zeros((8, 16), jnp.int32)
+    rng = np.random.default_rng(0)
+    batch = {
+        "inputs": rng.integers(0, cfg.vocab_size, (8, 16), dtype=np.int32),
+        "targets": rng.integers(0, cfg.vocab_size, (8, 16), dtype=np.int32),
+    }
+
+    results = {}
+    for impl in ("full", "chunked"):
+        state = init_train_state(model, optax.adamw(1e-3),
+                                 jax.random.key(1), (toks,), mesh,
+                                 DEFAULT_RULES)
+        step = make_train_step(model, mesh, DEFAULT_RULES, loss_impl=impl,
+                               loss_chunk=32)
+        _, metrics = step(state, batch)
+        results[impl] = (float(metrics["loss"]),
+                         float(metrics["grad_norm"]))
+    assert results["full"][0] == pytest.approx(results["chunked"][0],
+                                               rel=2e-4)
+    assert results["full"][1] == pytest.approx(results["chunked"][1],
+                                               rel=2e-3)
+
+
+def test_tied_embeddings_chunked(devices8):
+    mesh = build_mesh(MeshConfig(data=-1), devices8)
+    cfg = dataclasses.replace(llama_tiny(), tie_embeddings=True)
+    model = Llama(cfg)
+    toks = jnp.zeros((8, 16), jnp.int32)
+    state = init_train_state(model, optax.adamw(1e-3), jax.random.key(2),
+                             (toks,), mesh, DEFAULT_RULES)
+    step = make_train_step(model, mesh, DEFAULT_RULES, loss_impl="chunked")
+    rng = np.random.default_rng(1)
+    batch = {
+        "inputs": rng.integers(0, cfg.vocab_size, (8, 16), dtype=np.int32),
+        "targets": rng.integers(0, cfg.vocab_size, (8, 16), dtype=np.int32),
+    }
+    prev = None
+    for _ in range(3):
+        state, metrics = step(state, batch)
+        cur = float(metrics["loss"])
+        assert np.isfinite(cur)
+        if prev is not None:
+            assert cur < prev
+        prev = cur
+
+
+def test_bad_loss_impl_rejected(devices8):
+    mesh = build_mesh(MeshConfig(data=-1), devices8)
+    with pytest.raises(ValueError, match="loss_impl"):
+        make_train_step(Llama(llama_tiny()), mesh, loss_impl="nope")
+
+
+def test_bad_loss_chunk_rejected(devices8):
+    mesh = build_mesh(MeshConfig(data=-1), devices8)
+    with pytest.raises(ValueError, match="loss_chunk"):
+        make_train_step(Llama(llama_tiny()), mesh, loss_impl="chunked",
+                        loss_chunk=0)
