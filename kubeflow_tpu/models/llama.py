@@ -344,7 +344,7 @@ class Llama(nn.Module):
             # Chunked-CE training path (train/step.py): the caller computes
             # logits blockwise against the unembedding so the [B·S, V] fp32
             # logits buffer is never materialized (ops/ROADMAP.md item 1).
-            return x
+            return (x, new_cache) if cache is not None else x
         if cfg.tie_embeddings:
             logits = jnp.einsum("bsh,vh->bsv", x, embed.astype(cfg.dtype))
         else:

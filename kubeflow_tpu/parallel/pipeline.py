@@ -12,8 +12,18 @@ backward pipeline.
 
 The bubble is the standard GPipe (P-1)/(M+P-1) fraction: every stage
 computes on every tick, with garbage in the fill/drain ticks masked out of
-the result (wasted FLOPs, simple schedule — the 1F1B refinement is a
-schedule swap inside `pipeline_apply`, not an API change).
+the result.
+
+On memory: `jax.checkpoint` on the tick body makes the backward recompute
+each tick's stage internals from its boundary carry, so the forward stores
+one boundary activation per tick — O(ticks·microbatch) ≈ O(batch) — instead
+of every stage's *internals* for every microbatch (depth × batch). That
+removes the depth factor GPipe-without-remat pays; it is NOT 1F1B's
+stronger O(P·microbatch) in-flight bound, which needs backward ticks
+interleaved before the forward drains. Hand-interleaving fwd/bwd under XLA
+would mean a custom VJP schedule for a constant-factor activation saving
+the boundary-only footprint already makes small; deliberately not
+implemented (documented trade-off).
 """
 
 from __future__ import annotations
@@ -74,11 +84,9 @@ def pipeline_apply(
         # Each shard holds its stage's slice with a leading dim of 1.
         params = jax.tree.map(lambda p: p[0], params)
         ticks = num_microbatches + num_stages - 1
-        outputs = jnp.zeros_like(xm)
         buf = jnp.zeros_like(xm[0])  # activation arriving at this stage
 
-        def tick(carry, t):
-            buf, outputs = carry
+        def tick(buf, t):
             in_idx = jnp.clip(t, 0, num_microbatches - 1)
             h_in = jnp.where(stage == 0, xm[in_idx], buf)
             h_out = stage_fn(params, h_in)
@@ -88,17 +96,15 @@ def pipeline_apply(
             buf = jax.lax.ppermute(
                 h_out, axis,
                 [(i, (i + 1) % num_stages) for i in range(num_stages)])
-            # Last stage emits microbatch t-(P-1) once the pipe is full.
-            out_idx = jnp.clip(t - (num_stages - 1), 0,
-                               num_microbatches - 1)
-            valid = t >= num_stages - 1
-            prev = outputs[out_idx]
-            outputs = outputs.at[out_idx].set(
-                jnp.where(valid, h_out, prev))
-            return (buf, outputs), None
+            # h_out rides out as scan ys: emitted once per tick instead of
+            # scattering into a carried [M, ...] buffer, so the remat'd
+            # backward only stores per-tick boundary activations.
+            return buf, h_out
 
-        (buf, outputs), _ = jax.lax.scan(
-            tick, (buf, outputs), jnp.arange(ticks))
+        buf, emitted = jax.lax.scan(
+            jax.checkpoint(tick), buf, jnp.arange(ticks))
+        # The last stage's emissions for ticks P-1.. are microbatches 0..M.
+        outputs = emitted[num_stages - 1:]
         # Only the last stage holds real outputs; give every shard the
         # same result (out_specs replicate over `axis`).
         outputs = jnp.where(stage == num_stages - 1, outputs, 0.0)
