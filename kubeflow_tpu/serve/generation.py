@@ -20,8 +20,8 @@ continuous batching — does not map to XLA. The TPU-native shape:
     dispatches — the scheduling granularity is the chunk, not the token,
     which is the right trade under compiled static shapes.
 
-Sampling: greedy (temperature 0) or temperature sampling, per-slot, on
-device. top-k/top-p: ops/ROADMAP.md.
+Sampling: greedy (temperature 0), temperature, top-k, and nucleus
+(top-p) sampling — all per-slot and on device.
 """
 
 from __future__ import annotations
@@ -37,15 +37,41 @@ import numpy as np
 
 from kubeflow_tpu.serve.model import Model
 
+NEG_INF = -1e30
+
 
 def sample_tokens(logits: jax.Array, temperature: jax.Array,
-                  key: jax.Array) -> jax.Array:
+                  key: jax.Array, top_k: jax.Array | None = None,
+                  top_p: jax.Array | None = None) -> jax.Array:
     """Per-row sampling: argmax where temperature<=0, else categorical at
-    that temperature. logits [B, V], temperature [B] -> [B] int32."""
+    that temperature with optional per-row nucleus/top-k truncation.
+    logits [B, V]; temperature/top_p [B] f32; top_k [B] int32 (<=0 means
+    disabled) -> [B] int32. All on device — one fused dispatch per step."""
+    v = logits.shape[-1]
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     safe_t = jnp.maximum(temperature, 1e-4)[:, None]
-    sampled = jax.random.categorical(
-        key, logits.astype(jnp.float32) / safe_t, axis=-1).astype(jnp.int32)
+    scaled = logits.astype(jnp.float32) / safe_t
+    if top_k is not None or top_p is not None:
+        sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]  # [B, V]
+        keep = jnp.ones_like(scaled, bool)
+        if top_k is not None:
+            idx = jnp.clip(top_k - 1, 0, v - 1)[:, None]
+            kth = jnp.take_along_axis(sorted_desc, idx, axis=-1)  # [B,1]
+            keep &= jnp.where(top_k[:, None] > 0, scaled >= kth, True)
+        if top_p is not None:
+            probs = jax.nn.softmax(sorted_desc, axis=-1)
+            # Keep the smallest prefix whose mass reaches p (top token
+            # always survives): a token is kept iff the mass STRICTLY
+            # before it is < p.
+            cum_before = jnp.cumsum(probs, axis=-1) - probs
+            # Cutoff value: the smallest sorted logit still kept.
+            kept_sorted = cum_before < top_p[:, None]
+            cutoff_idx = jnp.maximum(
+                jnp.sum(kept_sorted, axis=-1, keepdims=True) - 1, 0)
+            cutoff = jnp.take_along_axis(sorted_desc, cutoff_idx, axis=-1)
+            keep &= jnp.where(top_p[:, None] < 1.0, scaled >= cutoff, True)
+        scaled = jnp.where(keep, scaled, NEG_INF)
+    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
     return jnp.where(temperature > 0, sampled, greedy)
 
 
@@ -86,7 +112,8 @@ class GenerationEngine:
         model, cfg = self.model, self.cfg
         from kubeflow_tpu.models.llama import init_cache
 
-        def prefill(params, tokens, length, temperature, key):
+        def prefill(params, tokens, length, temperature, top_k, top_p,
+                    key):
             """tokens [1, S_bucket] right-padded; returns (frag_cache,
             first sampled token [1])."""
             cache = init_cache(cfg, 1, self.max_len)
@@ -95,7 +122,7 @@ class GenerationEngine:
                 cache_index=jnp.zeros((1,), jnp.int32))
             last = jnp.take_along_axis(
                 logits, (length - 1)[:, None, None], axis=1)[:, 0]  # [1, V]
-            tok = sample_tokens(last, temperature, key)
+            tok = sample_tokens(last, temperature, key, top_k, top_p)
             return cache, tok
 
         def insert(cache, frag, slot):
@@ -105,48 +132,65 @@ class GenerationEngine:
                     c, f.astype(c.dtype),
                     (0, slot) + (0,) * (c.ndim - 2)), cache, frag)
 
-        def decode_chunk(params, cache, last_tok, index, temperature, key):
-            """K decode steps under one dispatch; on-device sampling.
-            last_tok/index/temperature [B]; returns (cache, tokens [B, K])."""
-            def step(carry, _):
-                cache, tok, idx, key = carry
-                key, sub = jax.random.split(key)
-                logits, cache = model.apply(
-                    {"params": params}, tok[:, None], cache=cache,
-                    cache_index=jnp.minimum(idx, self.max_len - 1))
-                nxt = sample_tokens(logits[:, 0], temperature, sub)
-                return (cache, nxt, idx + 1, key), nxt
+        def make_decode(truncate: bool):
+            def decode_chunk(params, cache, last_tok, index, temperature,
+                             top_k, top_p, key):
+                """K decode steps under one dispatch; on-device sampling.
+                last_tok/index/temperature [B]; returns (cache,
+                tokens [B, K]). The non-truncating variant skips the
+                full-vocab sort/cumsum — all-greedy/plain-temperature
+                traffic (the defaults) must not pay O(V log V) per token."""
+                def step(carry, _):
+                    cache, tok, idx, key = carry
+                    key, sub = jax.random.split(key)
+                    logits, cache = model.apply(
+                        {"params": params}, tok[:, None], cache=cache,
+                        cache_index=jnp.minimum(idx, self.max_len - 1))
+                    if truncate:
+                        nxt = sample_tokens(logits[:, 0], temperature, sub,
+                                            top_k, top_p)
+                    else:
+                        nxt = sample_tokens(logits[:, 0], temperature, sub)
+                    return (cache, nxt, idx + 1, key), nxt
 
-            (cache, _, _, _), toks = jax.lax.scan(
-                step, (cache, last_tok, index, key), None, length=self.chunk)
-            return cache, toks.T
+                (cache, _, _, _), toks = jax.lax.scan(
+                    step, (cache, last_tok, index, key), None,
+                    length=self.chunk)
+                return cache, toks.T
+            return decode_chunk
 
         prefill_jit = jax.jit(prefill)
         self._prefill = {b: prefill_jit for b in self.prefill_buckets}
         self._insert = jax.jit(insert, donate_argnums=(0,))
-        self._decode = jax.jit(decode_chunk, donate_argnums=(1,))
+        self._decode_trunc = jax.jit(make_decode(True), donate_argnums=(1,))
+        self._decode_plain = jax.jit(make_decode(False), donate_argnums=(1,))
 
     def _warmup(self):
         """Pay every compile before serving: one prefill per bucket, one
         insert, one chunked decode (jit caches keyed on static shapes)."""
         zero_t = jnp.zeros((1,), jnp.float32)
         one_l = jnp.ones((1,), jnp.int32)
+        zero_k = jnp.zeros((1,), jnp.int32)
+        one_p = jnp.ones((1,), jnp.float32)
         frag = None
         for b in self.prefill_buckets:
             frag, _ = self._prefill[b](
                 self._params, jnp.zeros((1, b), jnp.int32), one_l, zero_t,
-                self._key)
+                zero_k, one_p, self._key)
         self._cache = self._insert(self._cache, frag, jnp.int32(0))
         n = self.n_slots
-        self._cache, _ = self._decode(
-            self._params, self._cache, jnp.zeros((n,), jnp.int32),
-            jnp.zeros((n,), jnp.int32), jnp.zeros((n,), jnp.float32),
-            self._key)
+        for fn in (self._decode_plain, self._decode_trunc):
+            self._cache, _ = fn(
+                self._params, self._cache, jnp.zeros((n,), jnp.int32),
+                jnp.zeros((n,), jnp.int32), jnp.zeros((n,), jnp.float32),
+                jnp.zeros((n,), jnp.int32), jnp.ones((n,), jnp.float32),
+                self._key)
 
     # -- public API ----------------------------------------------------------
 
     def submit(self, input_ids: Sequence[int], *, max_tokens: int = 32,
-               temperature: float = 0.0, eos_id: int | None = None,
+               temperature: float = 0.0, top_k: int = 0,
+               top_p: float = 1.0, eos_id: int | None = None,
                timeout: float = 300.0) -> dict:
         if not input_ids:
             raise ValueError("input_ids must be non-empty")
@@ -154,10 +198,16 @@ class GenerationEngine:
             raise ValueError(
                 f"prompt of {len(input_ids)} tokens exceeds max_len "
                 f"{self.max_len}")
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        if top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {top_k}")
         req = {
             "input_ids": [int(t) for t in input_ids],
             "max_tokens": int(max_tokens),
             "temperature": float(temperature),
+            "top_k": int(top_k),
+            "top_p": float(top_p),
             "eos_id": eos_id,
             "out": [],
             "done": threading.Event(),
@@ -201,7 +251,9 @@ class GenerationEngine:
         frag, tok0 = self._prefill[bucket](
             self._params, jnp.asarray(toks),
             jnp.asarray([len(ids)], jnp.int32),
-            jnp.asarray([req["temperature"]], jnp.float32), sub)
+            jnp.asarray([req["temperature"]], jnp.float32),
+            jnp.asarray([req.get("top_k", 0)], jnp.int32),
+            jnp.asarray([req.get("top_p", 1.0)], jnp.float32), sub)
         self._cache = self._insert(self._cache, frag, jnp.int32(slot))
         first = int(tok0[0])
         self._slots[slot] = {"req": req, "idx": len(ids), "last": first}
@@ -251,15 +303,25 @@ class GenerationEngine:
             last = np.zeros((self.n_slots,), np.int32)
             idx = np.zeros((self.n_slots,), np.int32)
             temps = np.zeros((self.n_slots,), np.float32)
+            ks = np.zeros((self.n_slots,), np.int32)
+            ps = np.ones((self.n_slots,), np.float32)
             for i in active:
                 st = self._slots[i]
                 last[i], idx[i] = st["last"], st["idx"]
                 temps[i] = st["req"]["temperature"]
+                ks[i] = st["req"].get("top_k", 0)
+                ps[i] = st["req"].get("top_p", 1.0)
             self._key, sub = jax.random.split(self._key)
             t0 = time.monotonic()
-            self._cache, toks = self._decode(
+            # Truncation costs a full-vocab sort per step; only pay it
+            # when some active request actually asked for top-k/top-p.
+            decode = (self._decode_trunc
+                      if any(ks[i] > 0 or ps[i] < 1.0 for i in active)
+                      else self._decode_plain)
+            self._cache, toks = decode(
                 self._params, self._cache, jnp.asarray(last),
-                jnp.asarray(idx), jnp.asarray(temps), sub)
+                jnp.asarray(idx), jnp.asarray(temps), jnp.asarray(ks),
+                jnp.asarray(ps), sub)
             toks = np.asarray(toks)  # sync point: [B, chunk]
             dt = time.monotonic() - t0
             self.stats["decode_seconds"] += dt
@@ -322,6 +384,8 @@ class GenerativeJAXModel(Model):
             ids,
             max_tokens=int(payload.get("max_tokens", 32)),
             temperature=float(payload.get("temperature", 0.0)),
+            top_k=int(payload.get("top_k", 0)),
+            top_p=float(payload.get("top_p", 1.0)),
             eos_id=payload.get("eos_id", self.eos_id),
             timeout=float(payload.get("timeout", 300.0)))
         if self.tokenizer == "bytes":

@@ -180,3 +180,57 @@ def test_generative_metadata_and_v2_infer(gen_server):
                                     "data": [5, 9, 2, 44]}]})
     assert code == 200, body
     assert body["outputs"][0]["shape"] == [1, 4, CFG.vocab_size]
+
+
+def test_sampling_top_k_top_p():
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.serve.generation import sample_tokens
+
+    # Distribution heavily favors tokens 0..2; token 3 gets ~0 mass.
+    logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.15, 0.05]])).repeat(256, 0)
+    key = jax.random.key(0)
+    temp = jnp.ones((256,), jnp.float32)
+
+    # top_k=1 == greedy even at temperature 1.
+    toks = sample_tokens(logits, temp, key,
+                         top_k=jnp.full((256,), 1, jnp.int32),
+                         top_p=jnp.ones((256,), jnp.float32))
+    assert set(np.asarray(toks).tolist()) == {0}
+
+    # top_k=2: only the two most likely tokens ever sampled.
+    toks = sample_tokens(logits, temp, key,
+                         top_k=jnp.full((256,), 2, jnp.int32),
+                         top_p=jnp.ones((256,), jnp.float32))
+    assert set(np.asarray(toks).tolist()) <= {0, 1}
+
+    # top_p=0.8: keeps the smallest prefix reaching 0.8 mass = {0, 1}.
+    toks = sample_tokens(logits, temp, key,
+                         top_k=jnp.zeros((256,), jnp.int32),
+                         top_p=jnp.full((256,), 0.8, jnp.float32))
+    assert set(np.asarray(toks).tolist()) <= {0, 1}
+
+    # disabled (k=0, p=1): all tokens reachable at high temperature.
+    toks = sample_tokens(logits, jnp.full((256,), 3.0), key,
+                         top_k=jnp.zeros((256,), jnp.int32),
+                         top_p=jnp.ones((256,), jnp.float32))
+    assert len(set(np.asarray(toks).tolist())) >= 3
+
+
+def test_engine_top_p_requests(tiny):
+    from kubeflow_tpu.serve.generation import GenerationEngine
+
+    model, params = tiny
+    eng = GenerationEngine(model, params, CFG, slots=2, max_len=64,
+                           chunk=4, prefill_buckets=(16,))
+    try:
+        out = eng.submit([5, 9, 3], max_tokens=8, temperature=0.9,
+                         top_k=5, top_p=0.9)
+        assert len(out["output_ids"]) == 8
+        with pytest.raises(ValueError, match="top_p"):
+            eng.submit([1], top_p=0.0)
+        with pytest.raises(ValueError, match="top_k"):
+            eng.submit([1], top_k=-1)
+    finally:
+        eng.close()
