@@ -1,0 +1,85 @@
+"""Control-flow pipeline example: ParallelFor sweep with fan-in, a
+Condition gating deployment on the measured score, and an ExitHandler that
+always runs. Execute against a running control plane:
+
+    python examples/pipeline_control_flow.py --socket /tmp/tpk.sock
+"""
+
+import argparse
+
+from kubeflow_tpu.pipelines import (
+    Collected,
+    Condition,
+    ExitHandler,
+    InputArtifact,
+    OutputArtifact,
+    ParallelFor,
+    component,
+    pipeline,
+)
+
+
+@component
+def train_shard(model: OutputArtifact, lr: float = 0.1) -> float:
+    """Returns its validation loss (the output parameter)."""
+    import json
+    import os
+
+    loss = (lr - 0.2) ** 2 + 0.05
+    with open(os.path.join(model, "weights.json"), "w") as fh:
+        json.dump({"lr": lr}, fh)
+    return loss
+
+
+@component
+def pick_best(models: InputArtifact, losses: list, best: OutputArtifact) -> float:
+    import json
+    import os
+    import shutil
+
+    shards = sorted(os.listdir(models))
+    i = min(range(len(losses)), key=lambda j: losses[j])
+    shutil.copy(os.path.join(models, shards[i], "weights.json"),
+                os.path.join(best, "weights.json"))
+    return float(losses[i])
+
+
+@component
+def deploy(best: InputArtifact):
+    print("deploying", best)
+
+
+@component(cache=False)
+def notify(msg: str = "done"):
+    print("pipeline finished:", msg)
+
+
+@pipeline
+def sweep_and_deploy(threshold: float = 0.2):
+    with ExitHandler(notify(msg="sweep complete")):
+        with ParallelFor([0.05, 0.1, 0.2, 0.4]) as lr:
+            t = train_shard(lr=lr)
+        b = pick_best(models=Collected(t.output("model")),
+                      losses=Collected(t.result))
+        with Condition(b.result, "<", threshold):
+            deploy(best=b.output("best"))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--socket", default="/tmp/tpk.sock")
+    args = ap.parse_args()
+
+    from kubeflow_tpu.controlplane.client import Client
+    from kubeflow_tpu.pipelines.sdk import PipelineClient
+
+    pc = PipelineClient(Client(args.socket))
+    pc.create_run("sweep-1", pipeline=sweep_and_deploy)
+    phase = pc.wait("sweep-1", timeout=600)
+    print("run:", phase)
+    for name, t in sorted(pc.tasks("sweep-1").items()):
+        print(f"  {name}: {t['phase']} {t.get('reason', '')}")
+
+
+if __name__ == "__main__":
+    main()

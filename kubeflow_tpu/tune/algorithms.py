@@ -374,12 +374,130 @@ def suggest_hyperband(parameters: Sequence[dict], history: Sequence[dict],
     return {"assignments": [], "pending": False}  # plan exhausted
 
 
+# ---------------------------------------------------------------------------
+# CMA-ES (Hansen, "The CMA Evolution Strategy: A Tutorial", 2016) — the
+# reference ships it via optuna's sampler ⟨katib: pkg/suggestion/v1beta1⟩.
+# Generation-based: λ candidates are drawn from N(m, σ²C) in the unit cube;
+# once the whole generation is evaluated, (m, σ, C) update from the ranked
+# results (rank-μ + rank-one with step-size/covariance path cumulation).
+# Stateless like the others: the evolution state is recomputed by replaying
+# completed generations out of the trial history; an incomplete generation
+# reports pending.
+# ---------------------------------------------------------------------------
+
+
+def suggest_cmaes(parameters: Sequence[dict], history: Sequence[dict],
+                  count: int, seed: int = 0,
+                  settings: dict | None = None) -> dict:
+    import numpy as np
+
+    _check_space(parameters)
+    if any(p.get("type") == "categorical" for p in parameters):
+        raise AlgorithmError(
+            "cmaes supports numeric parameters only (categorical: use tpe)")
+    s = settings or {}
+    dim = len(parameters)
+    lam = int(s.get("population", 4 + int(3 * math.log(dim + 1))))
+    sigma0 = float(s.get("sigma", 0.3))
+    goal = s.get("goal", "minimize")
+    sign = -1.0 if goal == "maximize" else 1.0
+
+    mu = lam // 2
+    w = np.log(mu + 0.5) - np.log(np.arange(1, mu + 1))
+    w /= w.sum()
+    mu_eff = 1.0 / np.sum(w ** 2)
+    cc = (4 + mu_eff / dim) / (dim + 4 + 2 * mu_eff / dim)
+    cs = (mu_eff + 2) / (dim + mu_eff + 5)
+    c1 = 2 / ((dim + 1.3) ** 2 + mu_eff)
+    cmu = min(1 - c1, 2 * (mu_eff - 2 + 1 / mu_eff) /
+              ((dim + 2) ** 2 + mu_eff))
+    damps = 1 + 2 * max(0.0, math.sqrt((mu_eff - 1) / (dim + 1)) - 1) + cs
+    chi_n = math.sqrt(dim) * (1 - 1 / (4 * dim) + 1 / (21 * dim ** 2))
+
+    m = np.full(dim, 0.5)
+    sigma = sigma0
+    C = np.eye(dim)
+    ps = np.zeros(dim)
+    pc = np.zeros(dim)
+
+    def sample_generation(g: int) -> list[np.ndarray]:
+        # SeedSequence over plain ints: stable across processes (str hash
+        # is salted per process — it must never enter the seed path, or a
+        # restarted suggestion service would desync from the history).
+        rng = np.random.default_rng(
+            np.random.SeedSequence([abs(int(seed)), 0xC3A, g]))
+        try:
+            A = np.linalg.cholesky(C)
+        except np.linalg.LinAlgError:
+            A = np.linalg.cholesky(C + 1e-10 * np.eye(dim))
+        return [np.clip(m + sigma * A @ rng.standard_normal(dim), 0, 1)
+                for _ in range(lam)]
+
+    hist = list(history)
+    pos = 0
+    g = 0
+    while True:
+        gen = hist[pos:pos + lam]
+        if len(gen) < lam:
+            # Current generation (partially) unproposed.
+            k = len(gen)
+            xs = sample_generation(g)
+            out = []
+            for x in xs[k:k + count]:
+                out.append({p["name"]: _from_unit(p, float(x[i]))
+                            for i, p in enumerate(parameters)})
+            return {"assignments": out, "pending": not out}
+        if any(e.get("status") not in TERMINAL_TRIAL for e in gen):
+            return {"assignments": [], "pending": True}
+        # Generation complete: update the strategy state and continue. The
+        # evaluated points are read back from the RECORDED params (mapped
+        # into the unit cube), not re-drawn from the RNG — objective values
+        # must be credited at the point actually run (int snapping!), and
+        # the replay must survive history perturbations and restarts.
+        scored = []
+        for e in gen:
+            if e.get("value") is not None and e.get("params"):
+                x = np.array([_to_unit(p, e["params"][p["name"]])
+                              for p in parameters])
+                scored.append((sign * float(e["value"]), x))
+        if len(scored) >= 2:
+            scored.sort(key=lambda t: t[0])
+            sel = [x for _, x in scored[:mu]]
+            while len(sel) < mu:  # failed trials shrink the parent pool
+                sel.append(sel[-1])
+            X = np.stack(sel)
+            m_old = m
+            m = w @ X
+            try:
+                A_inv = np.linalg.inv(np.linalg.cholesky(C))
+            except np.linalg.LinAlgError:
+                A_inv = np.eye(dim)
+            y = (m - m_old) / max(sigma, 1e-12)
+            ps = (1 - cs) * ps + math.sqrt(cs * (2 - cs) * mu_eff) * (
+                A_inv @ y)
+            h_sig = (np.linalg.norm(ps) /
+                     math.sqrt(1 - (1 - cs) ** (2 * (g + 1))) <
+                     (1.4 + 2 / (dim + 1)) * chi_n)
+            pc = (1 - cc) * pc + (
+                math.sqrt(cc * (2 - cc) * mu_eff) * y if h_sig else 0)
+            ys = (X - m_old) / max(sigma, 1e-12)
+            C = ((1 - c1 - cmu) * C + c1 * np.outer(pc, pc) +
+                 cmu * (ys.T * w) @ ys)
+            C = (C + C.T) / 2  # keep symmetric under fp drift
+            sigma *= math.exp(min(
+                1.0, (cs / damps) * (np.linalg.norm(ps) / chi_n - 1)))
+            sigma = float(np.clip(sigma, 1e-8, 1.0))
+        pos += lam
+        g += 1
+
+
 ALGORITHMS = {
     "random": suggest_random,
     "grid": suggest_grid,
     "tpe": suggest_tpe,
     "bayesian": suggest_tpe,  # reference's "Bayesian" configs use TPE
     "hyperband": suggest_hyperband,
+    "cmaes": suggest_cmaes,
 }
 
 
