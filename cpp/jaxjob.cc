@@ -177,6 +177,10 @@ void JaxJobController::LaunchGang(JobView& job) {
       // Keep the axon sitecustomize from force-selecting the TPU platform
       // in CPU-mode workers (it overrides JAX_PLATFORMS via jax.config).
       s.env["PALLAS_AXON_POOL_IPS"] = "";
+      // Custom-command workers (e.g. the pipeline launcher) don't get
+      // the --cpu-devices flag (the default argv is replaced below); the
+      // launcher honors the env form instead (pipelines/launcher.py).
+      s.env["TPK_CPU_DEVICES"] = std::to_string(cpu_devices);
     }
     if (job.spec.get("command").is_array()) {
       s.argv.clear();

@@ -103,10 +103,14 @@ def run_task(spec: dict) -> None:
             raise LauncherError(
                 f"component source did not define {comp['name']!r}")
         ret = fn(**params, **inputs, **outputs)
-        if comp.get("returns") and result_dir:
+        if (comp.get("returns") and result_dir
+                and int(os.environ.get("TPK_PROC_ID", "0")) == 0):
             # The return value is the task's output parameter — recorded
             # as a tiny artifact the controller reads back for
-            # dsl.Condition / Collected consumers.
+            # dsl.Condition / Collected consumers. Process 0 only: in a
+            # multi-replica gang every process runs this code against the
+            # same shared path, and concurrent writes could interleave
+            # into invalid JSON.
             with open(os.path.join(result_dir, "value.json"), "w") as fh:
                 json.dump(ret, fh)
     elif kind == "command":
@@ -114,7 +118,16 @@ def run_task(spec: dict) -> None:
                 for a in comp.get("argv") or []]
         if not argv:
             raise LauncherError("command component has empty argv")
-        rc = subprocess.call(argv)
+        env = dict(os.environ)
+        cpu = env.get("TPK_CPU_DEVICES")
+        if cpu:
+            # jax config can't cross the process boundary; give the child
+            # the env form of CPU test mode instead.
+            env["JAX_PLATFORMS"] = "cpu"
+            env["XLA_FLAGS"] = (
+                f"{env.get('XLA_FLAGS', '')} "
+                f"--xla_force_host_platform_device_count={cpu}").strip()
+        rc = subprocess.call(argv, env=env)
         if rc != 0:
             raise LauncherError(f"command exited {rc}: {argv}")
     else:
@@ -134,6 +147,16 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     with open(args.spec) as fh:
         spec = json.load(fh)
+    # The gang launcher signals CPU test mode via env (the argv form
+    # belongs to the trainer entrypoint). Configure before a python
+    # component body touches a jax backend; command components get the
+    # env form injected at exec instead (no jax import paid here).
+    cpu = os.environ.get("TPK_CPU_DEVICES")
+    if cpu and spec.get("component", {}).get("kind", "python") == "python":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", int(cpu))
     try:
         run_task(spec)
     except Exception as e:

@@ -340,3 +340,106 @@ def test_scheduled_pipeline_run_interval(controlplane):
     assert all(r["status"]["phase"] == "Succeeded" for r in runs)
     st = client.get("ScheduledPipelineRun", "ticker")["status"]
     assert st["runsCreated"] == 2
+
+
+# --- eval config 5 shape: preprocess -> distributed train -> gated eval -----
+
+
+@component
+def tokenize(corpus: OutputArtifact, n_tokens: int = 30000):
+    import os
+
+    import numpy as np
+
+    np.save(os.path.join(corpus, "tokens.npy"),
+            np.random.default_rng(7).integers(0, 64, n_tokens,
+                                              dtype=np.int32))
+
+
+@component(replicas=2, cpu_devices_per_proc=2)
+def train_lm(corpus: InputArtifact, ckpt: OutputArtifact,
+             lr: float = 3e-3) -> float:
+    """A REAL distributed training step inside the pipeline: 2 processes,
+    jax.distributed over the TPK_* env the gang launcher injects, hybrid
+    2-slice mesh, grain corpus from the upstream artifact."""
+    import os
+
+    from kubeflow_tpu.train.trainer import Trainer, TrainJobSpec
+
+    spec = TrainJobSpec(
+        model="llama_tiny", dataset="token_file",
+        dataset_kwargs={"path": os.path.join(corpus, "tokens.npy")},
+        mesh={"data": 2, "fsdp": 2, "num_slices": 2},
+        steps=8, batch_size=8, seq_len=16, learning_rate=lr,
+        loss_impl="chunked", log_every=4,
+        checkpoint={"dir": ckpt, "interval": 8})
+    result = Trainer(spec).run()
+    return float(result["loss"])
+
+
+@component(cpu_devices_per_proc=2)
+def evaluate_lm(corpus: InputArtifact, ckpt: InputArtifact,
+                report: OutputArtifact) -> float:
+    import json
+    import os
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from kubeflow_tpu.models.llama import Llama, llama_tiny
+    from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+    from kubeflow_tpu.parallel.sharding import DEFAULT_RULES
+    from kubeflow_tpu.train.checkpoint import CheckpointManager
+    from kubeflow_tpu.train.step import init_train_state, make_eval_step
+
+    cfg = llama_tiny()
+    model = Llama(cfg)
+    mesh = build_mesh(MeshConfig(data=-1))
+    toks = jnp.zeros((8, 16), jnp.int32)
+    state = init_train_state(model, optax.adamw(1e-3), jax.random.key(0),
+                             (toks,), mesh, DEFAULT_RULES)
+    mgr = CheckpointManager(ckpt, interval=1)
+    assert mgr.latest_step() is not None, "train step produced no ckpt"
+    state = mgr.restore(state)
+    mgr.close()
+
+    ev = make_eval_step(model, mesh, DEFAULT_RULES)
+    data = np.load(os.path.join(corpus, "tokens.npy"))[-200:]
+    batch = {"inputs": data[:128].reshape(8, 16).astype(np.int32),
+             "targets": data[1:129].reshape(8, 16).astype(np.int32)}
+    metrics = ev(state.params, batch)
+    loss = float(metrics["loss"])
+    with open(os.path.join(report, "report.json"), "w") as fh:
+        json.dump({"eval_loss": loss}, fh)
+    return loss
+
+
+def test_pipeline_with_distributed_training_step(controlplane):
+    """Eval config 5's shape end-to-end: a pipeline whose train step is a
+    REAL 2-process jax.distributed gang on the hybrid 2-slice mesh,
+    consuming an upstream corpus artifact, checkpointing into an output
+    artifact that a Condition-gated eval step restores."""
+    from kubeflow_tpu.pipelines.sdk import PipelineClient
+
+    @pipeline
+    def lm_flow(lr: float = 3e-3):
+        c = tokenize()
+        t = train_lm(corpus=c.output("corpus"), lr=lr)
+        with Condition(t.result, "<", 50.0):  # training actually ran
+            evaluate_lm(corpus=c.output("corpus"), ckpt=t.output("ckpt"))
+
+    client, workdir, tmp = controlplane
+    pc = PipelineClient(client)
+    pc.create_run("lmflow", pipeline=lm_flow)
+    assert pc.wait("lmflow", timeout=600) == "Succeeded", pc.get_run(
+        "lmflow")
+    t = pc.tasks("lmflow")
+    assert t["train_lm"]["phase"] == "Succeeded"
+    assert 0 < t["train_lm"]["result"] < 50
+    assert t["evaluate_lm"]["phase"] == "Succeeded"
+    report = pc.artifacts("lmflow", "evaluate_lm")["report"]
+    rep = json.load(open(os.path.join(report, "report.json")))
+    assert 0 < rep["eval_loss"] < 50
+    assert rep["eval_loss"] == pytest.approx(t["evaluate_lm"]["result"])
