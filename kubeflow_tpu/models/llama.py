@@ -44,7 +44,7 @@ class LlamaConfig:
     tie_embeddings: bool = False
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
-    attention_impl: str = "auto"  # auto | naive | flash | ring
+    attention_impl: str = "auto"  # auto | naive | flash | ring | zigzag
     remat: bool = True
     scan_layers: bool = True
     # flash-kernel block sizes (tuned for v5e/v5p VMEM; ops/flash_attention.py)
@@ -216,6 +216,23 @@ class Attention(nn.Module):
             from kubeflow_tpu.ops.ring_attention import ring_attention
             out = ring_attention(q, k, v, axis_name=ring_axis or "seq",
                                  positions=positions)
+        elif impl == "zigzag":
+            # Balanced causal ring schedule: the CALLER must feed tokens in
+            # zigzag order (ops.ring_attention.zigzag_indices) and pass the
+            # matching absolute `positions` for RoPE — the trainer does both
+            # when spec.ring_attention == "zigzag" (train/trainer.py).
+            if standard_positions:
+                # Default arange positions mean the data was NOT permuted:
+                # the kernel would mask by zigzag positions on straight
+                # data — silently corrupt attention. Refuse loudly.
+                raise ValueError(
+                    "attention_impl='zigzag' needs zigzag-permuted tokens "
+                    "and their explicit absolute positions (the trainer's "
+                    "ring_attention='zigzag' mode supplies both)")
+            from kubeflow_tpu.ops.ring_attention import zigzag_ring_attention
+            out = zigzag_ring_attention(q, k, v,
+                                        axis_name=ring_axis or "seq",
+                                        pre_permuted=True)
         elif impl == "flash":
             from kubeflow_tpu.ops.flash_attention import flash_attention
             out = flash_attention(q, k, v, causal=True,

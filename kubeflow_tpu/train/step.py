@@ -116,14 +116,19 @@ def init_train_state(
     example_inputs: tuple,
     mesh: jax.sharding.Mesh,
     rules: Rules = DEFAULT_RULES,
+    example_kwargs: dict | None = None,
 ) -> TrainState:
     """Initialize params already laid out per the sharding rules: we eval_shape
     the init, derive NamedShardings from logical metadata, then run the real
     init jitted with those out_shardings — params are born sharded, never
-    materialized replicated (essential at 8B scale)."""
+    materialized replicated (essential at 8B scale).
+
+    `example_kwargs` rides into model.init for impls whose trace needs the
+    full call contract (e.g. zigzag attention requires explicit positions)."""
+    example_kwargs = example_kwargs or {}
 
     def _init(rng):
-        variables = model.init(rng, *example_inputs)
+        variables = model.init(rng, *example_inputs, **example_kwargs)
         params = variables["params"]
         return TrainState(step=jnp.zeros((), jnp.int32), params=params,
                           opt_state=tx.init(params), tx=tx)

@@ -46,7 +46,9 @@ class TrainJobSpec:
     warmup_steps: int = 0
     weight_decay: float = 0.0
     seed: int = 0
-    ring_attention: bool = False
+    # False | True/"ring" (contiguous ring CP) | "zigzag" (balanced causal
+    # schedule: the trainer permutes batches + positions to match).
+    ring_attention: bool | str = False
     # "full" materializes [B,S,V] logits; "chunked" is the fused blockwise
     # CE (no logits buffer — the long-context/large-vocab memory saver).
     loss_impl: str = "full"
@@ -79,6 +81,11 @@ class Trainer:
 
         from kubeflow_tpu.utils import registry
 
+        if spec.ring_attention == "zigzag":
+            # Keep the kernel and the data contract in lockstep: the spec
+            # is the single switch, the model impl follows.
+            spec.model_kwargs = dict(spec.model_kwargs,
+                                     attention_impl="zigzag")
         self.rules = rules_for(spec.strategy)
         mesh_fields = dict(spec.mesh)
         mesh_fields.setdefault("num_slices", self.penv.num_slices)
@@ -163,9 +170,32 @@ class Trainer:
 
     def run(self) -> dict:
         spec = self.spec
+
+        model_kwargs = {}
+        if spec.ring_attention:
+            model_kwargs["ring_axis"] = "seq"
+        # Zigzag context parallelism (SURVEY.md §5.7 causal load balance):
+        # spec.ring_attention == "zigzag" is the single switch — the
+        # trainer lays batches out in zigzag order and passes the matching
+        # absolute positions for RoPE; the LM loss is invariant (inputs
+        # and targets move together). Model-side impl is forced to match
+        # in __init__ so spec and kernel can't drift.
+        zigzag_idx = None
+        init_kwargs = None
+        if spec.ring_attention == "zigzag":
+            from kubeflow_tpu.ops.ring_attention import zigzag_indices
+
+            n_seq = self.mesh.shape["seq"]
+            zigzag_idx = np.asarray(zigzag_indices(spec.seq_len, n_seq))
+            model_kwargs["positions"] = jnp.broadcast_to(
+                jnp.asarray(zigzag_idx, jnp.int32)[None],
+                (spec.batch_size, spec.seq_len))
+            init_kwargs = model_kwargs  # zigzag's init needs positions too
+
         state = init_train_state(
             self.model, self.tx, jax.random.key(spec.seed),
-            self._example_inputs(), self.mesh, self.rules)
+            self._example_inputs(), self.mesh, self.rules,
+            example_kwargs=init_kwargs)
 
         start_step = 0
         if self._ckpt is not None:
@@ -175,9 +205,6 @@ class Trainer:
                 start_step = int(latest)
                 self.logger.log(start_step, {"event": "restored"})
 
-        model_kwargs = {}
-        if spec.ring_attention:
-            model_kwargs["ring_axis"] = "seq"
         step_fn = make_train_step(self.model, self.mesh, self.rules,
                                   loss_fn=self._loss_fn(),
                                   model_kwargs=model_kwargs,
@@ -261,7 +288,11 @@ class Trainer:
             if prof_start is not None and step == prof_start:
                 jax.profiler.start_trace(prof["dir"])
                 prof_active = True
-            batch = self._globalize(next(data))
+            raw = next(data)
+            if zigzag_idx is not None:
+                raw = {k: np.asarray(v)[:, zigzag_idx]
+                       for k, v in raw.items()}
+            batch = self._globalize(raw)
             state, metrics = step_fn(state, batch)
             window += 1
             if prof_active and step + 1 == prof_stop:
