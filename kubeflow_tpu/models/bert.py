@@ -27,6 +27,11 @@ class BertConfig:
     max_seq_len: int = 512
     type_vocab_size: int = 2
     layer_norm_eps: float = 1e-12
+    # "gelu" is EXACT erf GELU — the canonical BERT activation and what HF
+    # checkpoints mean by it. (Changed round 2 from flax's tanh-approx
+    # default; no exported checkpoints predate the change.) Also accepts
+    # "gelu_new"/"gelu_pytorch_tanh" (tanh approximation) and "relu".
+    hidden_act: str = "gelu"
     num_labels: int = 2  # classification head
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
@@ -76,7 +81,14 @@ class EncoderLayer(nn.Module):
                   kernel_init=nn.with_logical_partitioning(
                       nn.initializers.lecun_normal(), ("embed", "mlp")),
                   name="ffn_in")(x)
-        h = nn.gelu(h)
+        if cfg.hidden_act == "gelu":  # exact erf GELU (BERT canonical)
+            h = nn.gelu(h, approximate=False)
+        elif cfg.hidden_act in ("gelu_new", "gelu_pytorch_tanh"):
+            h = nn.gelu(h, approximate=True)
+        elif cfg.hidden_act == "relu":
+            h = nn.relu(h)
+        else:
+            raise ValueError(f"unsupported hidden_act {cfg.hidden_act!r}")
         h = dense(features=cfg.hidden_size,
                   kernel_init=nn.with_logical_partitioning(
                       nn.initializers.lecun_normal(), ("mlp", "embed")),

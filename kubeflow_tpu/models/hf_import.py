@@ -1,0 +1,304 @@
+"""HuggingFace checkpoint import — safetensors → kubeflow_tpu param trees.
+
+The reference's LLM runtime loads HF-format checkpoints directly
+(⟨kserve: python/huggingfaceserver — huggingface_model.py⟩; SURVEY.md §2.2):
+a user points an InferenceService at a directory of `config.json` +
+`*.safetensors` and serving just works. This module gives the TPU rebuild
+the same entry point: it reads HF Llama / BERT checkpoints and produces
+this framework's flax param trees (scanned-layer stacked for Llama), so
+fine-tuned open-weights models drop into both `serve/` and `train/`.
+
+Only the tensor *layout* is translated (torch Linear stores [out, in];
+flax DenseGeneral stores [in, ...out]); no HF code runs at import time and
+nothing here depends on torch. RoPE needs no permutation: HF-format Llama
+uses the rotate-half convention, which is exactly `models/llama.py
+apply_rope`'s split-in-halves form.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_tpu.models.bert import Bert, BertConfig
+from kubeflow_tpu.models.llama import Llama, LlamaConfig
+
+
+def load_safetensors_dir(path: str) -> dict[str, np.ndarray]:
+    """All tensors from a HF checkpoint dir (single-file or sharded with a
+    model.safetensors.index.json)."""
+    from safetensors.numpy import load_file
+
+    index = os.path.join(path, "model.safetensors.index.json")
+    if os.path.exists(index):
+        with open(index) as f:
+            weight_map = json.load(f)["weight_map"]
+        tensors: dict[str, np.ndarray] = {}
+        for shard in sorted(set(weight_map.values())):
+            tensors.update(load_file(os.path.join(path, shard)))
+        return tensors
+    single = os.path.join(path, "model.safetensors")
+    if os.path.exists(single):
+        return load_file(single)
+    cands = sorted(f for f in os.listdir(path) if f.endswith(".safetensors"))
+    if not cands:
+        raise FileNotFoundError(f"no .safetensors files under {path}")
+    tensors = {}
+    for f in cands:
+        tensors.update(load_file(os.path.join(path, f)))
+    return tensors
+
+
+def read_hf_config(path: str) -> dict:
+    with open(os.path.join(path, "config.json")) as f:
+        return json.load(f)
+
+
+# ---------------------------------------------------------------------------
+# Llama
+# ---------------------------------------------------------------------------
+
+def llama_config_from_hf(hf: dict, **overrides: Any) -> LlamaConfig:
+    """Map HF LlamaConfig fields onto ours. `overrides` win (e.g. dtype,
+    attention_impl, max_seq_len truncation for serving memory).
+
+    Unsupported config features fail loudly here — importing a checkpoint
+    whose math this model family does not implement must never produce
+    silently-wrong logits."""
+    heads = hf["num_attention_heads"]
+    fields = dict(
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["hidden_size"],
+        intermediate_size=hf["intermediate_size"],
+        num_layers=hf["num_hidden_layers"],
+        num_heads=heads,
+        num_kv_heads=hf.get("num_key_value_heads", heads),
+        head_dim=hf.get("head_dim") or hf["hidden_size"] // heads,
+        max_seq_len=hf.get("max_position_embeddings", 8192),
+        rope_theta=float(hf.get("rope_theta", 10000.0)),
+        rms_eps=float(hf.get("rms_norm_eps", 1e-5)),
+        tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
+    )
+    scaling = hf.get("rope_scaling")
+    if scaling:
+        rtype = scaling.get("rope_type") or scaling.get("type")
+        if rtype not in ("llama3", "default"):
+            raise ValueError(
+                f"unsupported rope_scaling type {rtype!r} (only the "
+                "Llama-3.1 'llama3' frequency remap is implemented)")
+        if rtype == "llama3":
+            fields.update(
+                rope_scaling_factor=float(scaling["factor"]),
+                rope_scaling_low_freq_factor=float(
+                    scaling.get("low_freq_factor", 1.0)),
+                rope_scaling_high_freq_factor=float(
+                    scaling.get("high_freq_factor", 4.0)),
+                rope_scaling_original_max_len=int(
+                    scaling.get("original_max_position_embeddings", 8192)))
+    if hf.get("sliding_window"):
+        raise ValueError(
+            "sliding-window attention (Mistral-style) is not implemented; "
+            "refusing to import — full attention would change the logits")
+    fields.update(overrides)
+    return LlamaConfig(**fields)
+
+
+def _stack(tensors: dict[str, np.ndarray], fmt: str, n: int,
+           transform) -> np.ndarray:
+    return np.stack([transform(tensors[fmt.format(i=i)]) for i in range(n)])
+
+
+def import_llama(path: str, *, scan_layers: bool = True,
+                 **config_overrides: Any) -> tuple[LlamaConfig, dict]:
+    """HF Llama checkpoint dir → (LlamaConfig, flax params).
+
+    The returned tree matches `Llama(cfg).init(...)` exactly (asserted by
+    tests/test_hf_import.py), with the scanned trunk's leading layer axis
+    when scan_layers=True.
+    """
+    hf = read_hf_config(path)
+    arch = (hf.get("architectures") or ["LlamaForCausalLM"])[0]
+    if "Llama" not in arch and "Mistral" not in arch:
+        raise ValueError(f"import_llama cannot load architecture {arch!r}")
+    cfg = llama_config_from_hf(hf, scan_layers=scan_layers,
+                               **config_overrides)
+    t = load_safetensors_dir(path)
+    h, nh, nkh, hd = (cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads,
+                      cfg.head_dim)
+    L = cfg.num_layers
+    pd = np.dtype(jnp.dtype(cfg.param_dtype).name)
+
+    def qk(w, heads):  # torch [heads*hd, H] -> flax [H, heads, hd]
+        return np.ascontiguousarray(w.T).reshape(h, heads, hd)
+
+    def ov(w):  # torch [H, nh*hd] -> flax [nh, hd, H]
+        return np.ascontiguousarray(w.T).reshape(nh, hd, h)
+
+    def lin(w):  # torch [out, in] -> flax [in, out]
+        return np.ascontiguousarray(w.T)
+
+    p = "model.layers.{i}."
+    layers = {
+        "input_norm": {"scale": _stack(
+            t, p + "input_layernorm.weight", L, lambda w: w)},
+        "post_attn_norm": {"scale": _stack(
+            t, p + "post_attention_layernorm.weight", L, lambda w: w)},
+        "attn": {
+            "q_proj": {"kernel": _stack(
+                t, p + "self_attn.q_proj.weight", L, lambda w: qk(w, nh))},
+            "k_proj": {"kernel": _stack(
+                t, p + "self_attn.k_proj.weight", L, lambda w: qk(w, nkh))},
+            "v_proj": {"kernel": _stack(
+                t, p + "self_attn.v_proj.weight", L, lambda w: qk(w, nkh))},
+            "o_proj": {"kernel": _stack(
+                t, p + "self_attn.o_proj.weight", L, ov)},
+        },
+        "mlp": {
+            "gate_proj": {"kernel": _stack(
+                t, p + "mlp.gate_proj.weight", L, lin)},
+            "up_proj": {"kernel": _stack(
+                t, p + "mlp.up_proj.weight", L, lin)},
+            "down_proj": {"kernel": _stack(
+                t, p + "mlp.down_proj.weight", L, lin)},
+        },
+    }
+    params: dict[str, Any] = {
+        "embed": t["model.embed_tokens.weight"],
+        "final_norm": {"scale": t["model.norm.weight"]},
+    }
+    if not cfg.tie_embeddings:  # tied: the unembedding reuses `embed`
+        if "lm_head.weight" not in t:
+            raise KeyError(
+                "checkpoint says tie_word_embeddings=false but has no "
+                "lm_head.weight — refusing to guess (corrupt export?)")
+        params["lm_head"] = {"kernel": lin(t["lm_head.weight"])}
+    if scan_layers:
+        params["layers"] = layers
+    else:
+        for i in range(L):
+            params[f"layer_{i}"] = jax.tree.map(lambda x: x[i], layers)
+    params = jax.tree.map(lambda x: jnp.asarray(np.asarray(x, pd)), params)
+    return cfg, params
+
+
+# ---------------------------------------------------------------------------
+# BERT
+# ---------------------------------------------------------------------------
+
+def bert_config_from_hf(hf: dict, **overrides: Any) -> BertConfig:
+    pet = hf.get("position_embedding_type", "absolute")
+    if pet != "absolute":
+        raise ValueError(
+            f"unsupported position_embedding_type {pet!r} (only absolute "
+            "position embeddings are implemented)")
+    act = hf.get("hidden_act", "gelu")
+    if act not in ("gelu", "gelu_new", "gelu_pytorch_tanh", "relu"):
+        raise ValueError(f"unsupported hidden_act {act!r}")
+    fields = dict(
+        hidden_act=act,
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["hidden_size"],
+        intermediate_size=hf["intermediate_size"],
+        num_layers=hf["num_hidden_layers"],
+        num_heads=hf["num_attention_heads"],
+        max_seq_len=hf.get("max_position_embeddings", 512),
+        type_vocab_size=hf.get("type_vocab_size", 2),
+        layer_norm_eps=float(hf.get("layer_norm_eps", 1e-12)),
+        num_labels=len(hf.get("id2label") or {0: 0, 1: 1}),
+    )
+    fields.update(overrides)
+    return BertConfig(**fields)
+
+
+def import_bert(path: str, *, allow_headless: bool = False,
+                **config_overrides: Any) -> tuple[BertConfig, dict]:
+    """HF BertForSequenceClassification checkpoint dir → (BertConfig,
+    flax params) matching `Bert(cfg).init(...)`.
+
+    A headless encoder export (no classifier.weight) raises unless
+    `allow_headless=True` — zero-init heads are only meaningful when the
+    caller is about to fine-tune them, never for serving."""
+    hf = read_hf_config(path)
+    cfg = bert_config_from_hf(hf, **config_overrides)
+    t = load_safetensors_dir(path)
+    # Some exports omit the "bert." prefix on the encoder.
+    pre = "bert." if any(k.startswith("bert.") for k in t) else ""
+    h, nh = cfg.hidden_size, cfg.num_heads
+    hd = h // nh
+    pd = np.dtype(jnp.dtype(cfg.param_dtype).name)
+
+    def lin(w):
+        return np.ascontiguousarray(w.T)
+
+    def ln(name):  # torch LayerNorm {weight,bias} -> flax {scale,bias}
+        return {"scale": t[name + ".weight"], "bias": t[name + ".bias"]}
+
+    def qkv(stem):  # [H, H] weight + [H] bias -> [H, nh, hd] + [nh, hd]
+        return {"kernel": lin(t[stem + ".weight"]).reshape(h, nh, hd),
+                "bias": t[stem + ".bias"].reshape(nh, hd)}
+
+    params: dict[str, Any] = {
+        "word_embeddings": t[pre + "embeddings.word_embeddings.weight"],
+        "position_embeddings": t[pre + "embeddings.position_embeddings.weight"],
+        "token_type_embeddings": t[pre + "embeddings.token_type_embeddings.weight"],
+        "ln_embed": ln(pre + "embeddings.LayerNorm"),
+    }
+    for i in range(cfg.num_layers):
+        lp = f"{pre}encoder.layer.{i}."
+        od = t[lp + "attention.output.dense.weight"]  # [H, H]
+        params[f"layer_{i}"] = {
+            "q": qkv(lp + "attention.self.query"),
+            "k": qkv(lp + "attention.self.key"),
+            "v": qkv(lp + "attention.self.value"),
+            "o": {"kernel": lin(od).reshape(nh, hd, h),
+                  "bias": t[lp + "attention.output.dense.bias"]},
+            "ln_attn": ln(lp + "attention.output.LayerNorm"),
+            "ffn_in": {"kernel": lin(t[lp + "intermediate.dense.weight"]),
+                       "bias": t[lp + "intermediate.dense.bias"]},
+            "ffn_out": {"kernel": lin(t[lp + "output.dense.weight"]),
+                        "bias": t[lp + "output.dense.bias"]},
+            "ln_ffn": ln(lp + "output.LayerNorm"),
+        }
+    headless = ("classifier.weight" not in t
+                or pre + "pooler.dense.weight" not in t)
+    if headless and not allow_headless:
+        raise KeyError(
+            "checkpoint has no classification head (classifier.weight / "
+            "pooler.dense.weight) — serving it would return constant "
+            "zero logits; pass allow_headless=True only to fine-tune a "
+            "fresh head")
+    if pre + "pooler.dense.weight" in t:
+        params["pooler"] = {"kernel": lin(t[pre + "pooler.dense.weight"]),
+                            "bias": t[pre + "pooler.dense.bias"]}
+    else:  # fine-tune path: identity pooler, head trained from scratch
+        params["pooler"] = {"kernel": np.eye(h, dtype=pd),
+                            "bias": np.zeros((h,), pd)}
+    if "classifier.weight" in t:
+        params["classifier"] = {"kernel": lin(t["classifier.weight"]),
+                                "bias": t["classifier.bias"]}
+    else:
+        params["classifier"] = {
+            "kernel": np.zeros((h, cfg.num_labels), pd),
+            "bias": np.zeros((cfg.num_labels,), pd)}
+    params = jax.tree.map(lambda x: jnp.asarray(np.asarray(x, pd)), params)
+    return cfg, params
+
+
+# ---------------------------------------------------------------------------
+# Model builders (used by the serving runtime)
+# ---------------------------------------------------------------------------
+
+def build_from_hf(path: str, **overrides: Any):
+    """Architecture-dispatched import: returns (module, cfg, params)."""
+    hf = read_hf_config(path)
+    arch = (hf.get("architectures") or [hf.get("model_type", "")])[0]
+    if "Bert" in arch or hf.get("model_type") == "bert":
+        cfg, params = import_bert(path, **overrides)
+        return Bert(cfg), cfg, params
+    cfg, params = import_llama(path, **overrides)
+    return Llama(cfg), cfg, params

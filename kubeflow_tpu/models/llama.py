@@ -40,11 +40,20 @@ class LlamaConfig:
     head_dim: int = 128
     max_seq_len: int = 8192
     rope_theta: float = 500000.0
+    # Llama-3.1-style rope scaling (the "llama3" rope_type): a one-time
+    # remap of the inverse frequencies. factor == 1.0 disables it. Scalars
+    # (not a dict) so the config stays hashable for jit-static use.
+    rope_scaling_factor: float = 1.0
+    rope_scaling_low_freq_factor: float = 1.0
+    rope_scaling_high_freq_factor: float = 4.0
+    rope_scaling_original_max_len: int = 8192
     rms_eps: float = 1e-5
     tie_embeddings: bool = False
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
-    attention_impl: str = "auto"  # auto | naive | flash | ring | zigzag
+    # auto | naive | flash | ring | ring_flash | zigzag | zigzag_flash
+    # (*_flash = fused Pallas inner block per ring step)
+    attention_impl: str = "auto"
     remat: bool = True
     scan_layers: bool = True
     # flash-kernel block sizes (tuned for v5e/v5p VMEM; ops/flash_attention.py)
@@ -98,8 +107,24 @@ class RMSNorm(nn.Module):
         return (y * scale).astype(self.dtype)
 
 
-def rope_table(head_dim: int, max_len: int, theta: float) -> tuple[jax.Array, jax.Array]:
+def rope_table(head_dim: int, max_len: int, theta: float,
+               cfg: "LlamaConfig | None" = None) -> tuple[jax.Array, jax.Array]:
     inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    if cfg is not None and cfg.rope_scaling_factor != 1.0:
+        # Llama-3.1 "llama3" rope scaling: leave high-frequency components
+        # alone, divide low-frequency ones by `factor`, and interpolate
+        # smoothly in between (matches HF modeling_rope_utils).
+        factor = cfg.rope_scaling_factor
+        low = cfg.rope_scaling_low_freq_factor
+        high = cfg.rope_scaling_high_freq_factor
+        old_len = cfg.rope_scaling_original_max_len
+        wavelen = 2 * jnp.pi / inv
+        low_wl, high_wl = old_len / low, old_len / high
+        smooth = (old_len / wavelen - low) / (high - low)
+        smooth = jnp.clip(smooth, 0.0, 1.0)
+        scaled = (1 - smooth) * inv / factor + smooth * inv
+        inv = jnp.where(wavelen > low_wl, inv / factor,
+                        jnp.where(wavelen < high_wl, inv, scaled))
     t = jnp.arange(max_len, dtype=jnp.float32)
     freqs = jnp.outer(t, inv)
     return jnp.cos(freqs), jnp.sin(freqs)
@@ -212,11 +237,21 @@ class Attention(nn.Module):
             raise ValueError(
                 "attention_impl='flash' does not support custom positions; "
                 "use 'naive' or 'ring'")
-        if impl == "ring":
+        if impl in ("ring", "ring_flash"):
             from kubeflow_tpu.ops.ring_attention import ring_attention
-            out = ring_attention(q, k, v, axis_name=ring_axis or "seq",
-                                 positions=positions)
-        elif impl == "zigzag":
+            if impl == "ring_flash":
+                if not standard_positions:
+                    raise ValueError(
+                        "attention_impl='ring_flash' derives causality from "
+                        "the contiguous layout; custom positions need 'ring'")
+                out = ring_attention(q, k, v, axis_name=ring_axis or "seq",
+                                     inner="flash",
+                                     block_q=cfg.flash_block_q,
+                                     block_kv=cfg.flash_block_kv)
+            else:
+                out = ring_attention(q, k, v, axis_name=ring_axis or "seq",
+                                     positions=positions)
+        elif impl in ("zigzag", "zigzag_flash"):
             # Balanced causal ring schedule: the CALLER must feed tokens in
             # zigzag order (ops.ring_attention.zigzag_indices) and pass the
             # matching absolute `positions` for RoPE — the trainer does both
@@ -230,9 +265,10 @@ class Attention(nn.Module):
                     "and their explicit absolute positions (the trainer's "
                     "ring_attention='zigzag' mode supplies both)")
             from kubeflow_tpu.ops.ring_attention import zigzag_ring_attention
-            out = zigzag_ring_attention(q, k, v,
-                                        axis_name=ring_axis or "seq",
-                                        pre_permuted=True)
+            out = zigzag_ring_attention(
+                q, k, v, axis_name=ring_axis or "seq", pre_permuted=True,
+                inner="flash" if impl == "zigzag_flash" else "einsum",
+                block_q=cfg.flash_block_q, block_kv=cfg.flash_block_kv)
         elif impl == "flash":
             from kubeflow_tpu.ops.flash_attention import flash_attention
             out = flash_attention(q, k, v, causal=True,
@@ -323,7 +359,8 @@ class Llama(nn.Module):
             (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
         x = embed.astype(cfg.dtype)[tokens]
         x = nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
-        cos, sin = rope_table(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+        cos, sin = rope_table(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta,
+                              cfg)
 
         layer_cls = DecoderLayer
         if cfg.remat:

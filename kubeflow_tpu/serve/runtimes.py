@@ -103,6 +103,8 @@ def _jax_registry_runtime(model_dir: str, spec: dict) -> Model:
         example = np.zeros((1, *example_shape), dtype=dtype)
         params = nn.meta.unbox(module.init(rng, example)["params"])
 
+    module, params = _maybe_quantize(module, params, spec)
+
     if spec.get("generative"):
         # LLM bundle: KV-cache decode engine instead of a fixed forward
         # (⟨kserve: python/huggingfaceserver⟩ equivalent; generation.py).
@@ -121,3 +123,73 @@ def _jax_registry_runtime(model_dir: str, spec: dict) -> Model:
         input_spec=[(example_shape, dtype)],
         batch_buckets=spec.get("batch_buckets", (1, 2, 4, 8, 16, 32)),
         warm_buckets=spec.get("warm_buckets", (1, 8)))
+
+
+def _maybe_quantize(module, params, spec: dict):
+    """spec.quantize == "int8" → weight-only int8 storage (serve/quant.py),
+    transparent to the model via QuantizedModule."""
+    mode = spec.get("quantize")
+    if not mode:
+        return module, params
+    if mode != "int8":
+        raise ValueError(f"unsupported quantize mode {mode!r} (have: int8)")
+    from kubeflow_tpu.serve.quant import QuantizedModule, quantize_tree
+
+    return QuantizedModule(module), quantize_tree(params)
+
+
+@register_runtime("huggingface")
+def _huggingface_runtime(model_dir: str, spec: dict) -> Model:
+    """HF safetensors checkpoint → native JAX model (the huggingfaceserver
+    equivalent; models/hf_import.py). The bundle is the HF directory itself
+    plus a model.json {"format": "huggingface"}; `checkpoint` may point at a
+    subdirectory or an absolute path, default the bundle dir.
+
+    Llama-family checkpoints serve generatively when the spec carries a
+    `generative` block (KV-cache engine), else as a full-forward logits
+    model; BERT checkpoints serve as classifiers (pooled logits).
+    """
+    from kubeflow_tpu.models.bert import Bert
+    from kubeflow_tpu.models.hf_import import build_from_hf, read_hf_config
+
+    ckpt = spec.get("checkpoint") or "."
+    if not os.path.isabs(ckpt):
+        ckpt = os.path.join(os.path.abspath(model_dir), ckpt)
+    overrides = dict(spec.get("model_overrides") or {})
+    module, cfg, params = build_from_hf(ckpt, **overrides)
+    is_bert = isinstance(module, Bert)  # before the quantize wrapper
+    module, params = _maybe_quantize(module, params, spec)
+    name = spec.get("name") or os.path.basename(os.path.abspath(model_dir))
+
+    if is_bert:
+        # Pad tokens must not enter attention: the mask is derived from the
+        # checkpoint's pad_token_id (HF tokenizers right-pad with it), so a
+        # single-input v1/v2 request with padded rows scores identically to
+        # the reference server.
+        pad_id = int(read_hf_config(ckpt).get("pad_token_id") or 0)
+
+        def apply_fn(params, input_ids):
+            _, logits = module.apply({"params": params}, input_ids,
+                                     attention_mask=input_ids != pad_id)
+            return logits
+
+        seq = int(spec.get("seq_len", min(cfg.max_seq_len, 128)))
+        return JAXModel(
+            name, apply_fn, params, input_spec=[((seq,), "int32")],
+            batch_buckets=spec.get("batch_buckets", (1, 2, 4, 8, 16, 32)),
+            warm_buckets=spec.get("warm_buckets", (1, 8)))
+
+    if spec.get("generative"):
+        from kubeflow_tpu.serve.generation import GenerativeJAXModel
+
+        return GenerativeJAXModel(name, module, params, cfg,
+                                  generation=dict(spec["generative"]))
+
+    def apply_fn(params, tokens):
+        return module.apply({"params": params}, tokens)
+
+    seq = int(spec.get("seq_len", 128))
+    return JAXModel(
+        name, apply_fn, params, input_spec=[((seq,), "int32")],
+        batch_buckets=spec.get("batch_buckets", (1, 2, 4, 8)),
+        warm_buckets=spec.get("warm_buckets", (1,)))
