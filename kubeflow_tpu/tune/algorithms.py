@@ -491,6 +491,143 @@ def suggest_cmaes(parameters: Sequence[dict], history: Sequence[dict],
         g += 1
 
 
+# ---------------------------------------------------------------------------
+# PBT (Jaderberg et al., "Population Based Training of Neural Networks",
+# 2017) — the reference ships it as a Katib suggestion service ⟨katib:
+# pkg/suggestion/v1beta1/pbt⟩. A fixed population trains in segments; after
+# each generation the bottom `truncation` fraction EXPLOITS (copies the
+# hyperparameters of a random top member) and EXPLORES (perturbs them).
+#
+# Stateless replay like hyperband/cmaes: member j of generation g is history
+# entry [g*N + j], so the whole evolution reconstructs from the ordered
+# trial history. Two resource modes:
+#   * restart mode (default): each generation's trials train from scratch
+#     for a cumulatively larger budget (resource = step·(g+1)) — weight
+#     inheritance is approximated by re-training longer, which is the
+#     honest trial-restart semantic;
+#   * warm-start mode (settings["parent_param"] names a trial parameter):
+#     resource stays `step` per segment and each assignment carries the
+#     parent's history index in that parameter ("" for generation 0 /
+#     self-continuation uses the member's own previous index). The trial
+#     template substitutes it into a checkpoint-restore path, giving true
+#     PBT weight inheritance over the controller's existing substitution
+#     machinery (⟨katib: pbt's checkpoint annotations⟩ equivalent).
+# ---------------------------------------------------------------------------
+
+
+def suggest_pbt(parameters: Sequence[dict], history: Sequence[dict],
+                count: int, seed: int = 0,
+                settings: dict | None = None) -> dict:
+    _check_space(parameters)
+    s = settings or {}
+    by_name = {p["name"]: p for p in parameters}
+    resource = s.get("resource")
+    if not resource or resource not in by_name:
+        raise AlgorithmError(
+            "pbt needs settings.resource naming a search parameter "
+            f"(have {sorted(by_name)})")
+    rp = by_name[resource]
+    if rp.get("type") not in ("int", "double"):
+        raise AlgorithmError("pbt resource must be int or double")
+    n_pop = int(s.get("population", 8))
+    if n_pop < 2:
+        raise AlgorithmError("pbt population must be >= 2")
+    step = float(s.get("resource_step", rp["min"] if rp["min"] > 0 else 1))
+    max_r = float(s.get("max_resource", rp["max"]))
+    trunc = float(s.get("truncation", 0.25))
+    if not 0.0 < trunc <= 0.5:
+        raise AlgorithmError("pbt truncation must be in (0, 0.5]")
+    factors = list(s.get("perturb_factors", (0.8, 1.25)))
+    resample_prob = float(s.get("resample_prob", 0.25))
+    goal = s.get("goal", "minimize")
+    sign = -1.0 if goal == "maximize" else 1.0
+    parent_param = s.get("parent_param")
+    if parent_param and parent_param in by_name:
+        raise AlgorithmError(
+            f"pbt parent_param {parent_param!r} collides with a search "
+            "parameter — it must be a fresh trial-parameter name")
+    search = [p for p in parameters if p["name"] != resource]
+    if not search:
+        raise AlgorithmError("pbt needs at least one non-resource parameter")
+
+    def perturb(a: dict, rng: _random.Random) -> dict:
+        out = dict(a)
+        for p in search:
+            name = p["name"]
+            if p.get("type") == "categorical":
+                if rng.random() < resample_prob:
+                    out[name] = rng.choice(p["values"])
+                continue
+            if rng.random() < resample_prob:
+                out[name] = _sample_param(p, rng)
+                continue
+            # Multiplicative perturbation in the modeling scale: log-space
+            # params multiply the raw value; linear params scale the unit
+            # coordinate (keeps the factor meaningful near 0).
+            f = rng.choice(factors)
+            if p.get("log"):
+                out[name] = _from_unit(p, _to_unit(p, out[name] * f))
+            else:
+                out[name] = _from_unit(p, _to_unit(p, out[name]) * f)
+        return out
+
+    def resource_for(g: int) -> Any:
+        r = step if parent_param else step * (g + 1)
+        return _resource_value(rp, min(r, max_r))
+
+    hist = list(history)
+    pos, g = 0, 0
+    while True:
+        gen = hist[pos:pos + n_pop]
+        if len(gen) < n_pop:
+            k = len(gen)
+            rng = _random.Random(f"{seed}:pbt:{g}:{len(history)}")
+            out = []
+            if g == 0:
+                for j in range(k, min(n_pop, k + count)):
+                    a = {p["name"]: _sample_param(p, rng) for p in search}
+                    a[resource] = resource_for(0)
+                    if parent_param:
+                        a[parent_param] = ""
+                    out.append(a)
+                return {"assignments": out, "pending": not out}
+            prev = hist[pos - n_pop:pos]
+            ranked = sorted(
+                range(n_pop),
+                key=lambda j: (sign * float(prev[j]["value"])
+                               if prev[j].get("value") is not None
+                               else math.inf))
+            n_cut = max(1, int(round(trunc * n_pop)))
+            top, bottom = ranked[:n_cut], set(ranked[-n_cut:])
+            # Members with no metric at all count as bottom too.
+            for j in range(n_pop):
+                if prev[j].get("value") is None:
+                    bottom.add(j)
+            for j in range(k, min(n_pop, k + count)):
+                src = prev[j].get("params", {})
+                base = {p["name"]: src.get(p["name"]) for p in search}
+                if j in bottom or any(v is None for v in base.values()):
+                    donor = top[rng.randrange(len(top))]
+                    dsrc = prev[donor].get("params", {})
+                    base = {p["name"]: dsrc.get(p["name"],
+                                                _sample_param(p, rng))
+                            for p in search}
+                    a = perturb(base, rng)
+                    parent = pos - n_pop + donor
+                else:
+                    a = dict(base)
+                    parent = pos - n_pop + j
+                a[resource] = resource_for(g)
+                if parent_param:
+                    a[parent_param] = str(parent)
+                out.append(a)
+            return {"assignments": out, "pending": not out}
+        if any(e.get("status") not in TERMINAL_TRIAL for e in gen):
+            return {"assignments": [], "pending": True}
+        pos += n_pop
+        g += 1
+
+
 ALGORITHMS = {
     "random": suggest_random,
     "grid": suggest_grid,
@@ -498,6 +635,7 @@ ALGORITHMS = {
     "bayesian": suggest_tpe,  # reference's "Bayesian" configs use TPE
     "hyperband": suggest_hyperband,
     "cmaes": suggest_cmaes,
+    "pbt": suggest_pbt,
 }
 
 

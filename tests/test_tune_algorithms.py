@@ -404,3 +404,128 @@ def test_cmaes_stable_across_processes():
         assert r.returncode == 0, r.stderr
         outs.append(json.loads(r.stdout))
     assert outs[0] == outs[1] == outs[2]
+
+
+# -- PBT (Jaderberg et al. 2017; ⟨katib: pkg/suggestion/v1beta1/pbt⟩) --------
+
+PBT_SPACE = [
+    {"name": "lr", "type": "double", "min": 1e-4, "max": 1.0, "log": True},
+    {"name": "steps", "type": "int", "min": 1, "max": 100},
+]
+PBT_SETTINGS = {"resource": "steps", "resource_step": 10, "population": 8,
+                "goal": "minimize", "truncation": 0.25}
+
+
+def test_pbt_validation():
+    with pytest.raises(alg.AlgorithmError, match="resource"):
+        alg.suggest_pbt(PBT_SPACE, [], 1, settings={})
+    with pytest.raises(alg.AlgorithmError, match="population"):
+        alg.suggest_pbt(PBT_SPACE, [], 1,
+                        settings=dict(PBT_SETTINGS, population=1))
+    with pytest.raises(alg.AlgorithmError, match="non-resource"):
+        alg.suggest_pbt([PBT_SPACE[1]], [], 1, settings=PBT_SETTINGS)
+
+
+def test_pbt_generation_protocol():
+    """Gen 0 fills the population; mid-generation reports pending."""
+    out = alg.suggest_pbt(PBT_SPACE, [], 8, seed=2, settings=PBT_SETTINGS)
+    assert len(out["assignments"]) == 8
+    assert all(a["steps"] == 10 for a in out["assignments"])
+    history = [{"params": a, "status": "Running"}
+               for a in out["assignments"]]
+    out2 = alg.suggest_pbt(PBT_SPACE, history, 8, seed=2,
+                           settings=PBT_SETTINGS)
+    assert out2["assignments"] == [] and out2["pending"] is True
+
+
+def test_pbt_exploit_explore_improves_population():
+    """On a quadratic in log-lr the population mean must improve over
+    generations: survivors keep params, losers clone+perturb winners."""
+    import math as m
+
+    def obj(a):
+        return (m.log10(a["lr"]) + 2.0) ** 2  # optimum lr = 1e-2
+
+    history = []
+    best_quartile = []
+    for g in range(16):
+        out = alg.suggest_pbt(PBT_SPACE, history, 8, seed=4,
+                              settings=PBT_SETTINGS)
+        assert len(out["assignments"]) == 8, f"gen {g}"
+        # Restart mode: budget grows with the generation index.
+        assert all(a["steps"] == min(10 * (g + 1), 100)
+                   for a in out["assignments"])
+        vals = []
+        for a in out["assignments"]:
+            v = obj(a)
+            vals.append(v)
+            history.append({"params": a, "status": "Succeeded", "value": v})
+        vals.sort()
+        best_quartile.append(sum(vals[:2]) / 2)
+    # Exploration keeps the population mean noisy by design; the exploited
+    # top quartile must ratchet toward the optimum.
+    assert best_quartile[-1] < best_quartile[0] / 4, best_quartile
+    assert min(h["value"] for h in history[-16:]) < 0.05
+
+
+def test_pbt_survivors_keep_params():
+    """A top-ranked member's params carry to the next generation verbatim
+    (modulo the resource), at the same population slot."""
+    history = []
+    out = alg.suggest_pbt(PBT_SPACE, history, 8, seed=6,
+                          settings=PBT_SETTINGS)
+    for j, a in enumerate(out["assignments"]):
+        history.append({"params": a, "status": "Succeeded",
+                        "value": float(j)})  # slot 0 is best
+    out2 = alg.suggest_pbt(PBT_SPACE, history, 8, seed=6,
+                           settings=PBT_SETTINGS)
+    assert out2["assignments"][0]["lr"] == history[0]["params"]["lr"]
+    # The worst slots were replaced: some lr differs from their previous.
+    changed = [j for j in range(8)
+               if out2["assignments"][j]["lr"] != history[j]["params"]["lr"]]
+    assert changed, "no member was exploited"
+
+
+def test_pbt_warm_start_parent_indices():
+    """parent_param mode: per-segment budgets plus a parent history index
+    each trial can substitute into a checkpoint-restore path."""
+    settings = dict(PBT_SETTINGS, parent_param="parent")
+    history = []
+    out = alg.suggest_pbt(PBT_SPACE, history, 8, seed=8, settings=settings)
+    assert all(a["parent"] == "" and a["steps"] == 10
+               for a in out["assignments"])
+    for j, a in enumerate(out["assignments"]):
+        history.append({"params": a, "status": "Succeeded",
+                        "value": float(j)})
+    out2 = alg.suggest_pbt(PBT_SPACE, history, 8, seed=8, settings=settings)
+    for j, a in enumerate(out2["assignments"]):
+        assert a["steps"] == 10  # segment budget, not cumulative
+        parent = int(a["parent"])
+        assert 0 <= parent < 8
+        if a["lr"] == history[j]["params"]["lr"]:
+            assert parent == j  # survivor continues itself
+        else:
+            assert history[parent]["value"] <= 1.0  # donor came from the top
+
+
+def test_pbt_deterministic_replay():
+    def obj(a):
+        return a["lr"]
+
+    def drive():
+        history = []
+        for _ in range(4):
+            out = alg.suggest_pbt(PBT_SPACE, history, 8, seed=11,
+                                  settings=PBT_SETTINGS)
+            for a in out["assignments"]:
+                history.append({"params": a, "status": "Succeeded",
+                                "value": obj(a)})
+        return [h["params"] for h in history]
+
+    assert drive() == drive()
+
+
+def test_pbt_parent_param_collision_rejected():
+    with pytest.raises(alg.AlgorithmError, match="parent_param"):
+        alg.suggest_pbt(PBT_SPACE, [], 1,
+                        settings=dict(PBT_SETTINGS, parent_param="lr"))
