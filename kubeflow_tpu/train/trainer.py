@@ -81,17 +81,19 @@ class Trainer:
 
         from kubeflow_tpu.utils import registry
 
+        model_kwargs = dict(spec.model_kwargs)
         if spec.ring_attention == "zigzag":
             # Keep the kernel and the data contract in lockstep: the spec
-            # is the single switch, the model impl follows.
-            spec.model_kwargs = dict(spec.model_kwargs,
-                                     attention_impl="zigzag")
+            # is the single switch, the model impl follows. Derived locally
+            # — the caller's spec must stay as submitted (it gets
+            # re-serialized for resume/retry).
+            model_kwargs["attention_impl"] = "zigzag"
         self.rules = rules_for(spec.strategy)
         mesh_fields = dict(spec.mesh)
         mesh_fields.setdefault("num_slices", self.penv.num_slices)
         self.mesh = build_mesh(MeshConfig(**mesh_fields))
         self.model, self.info = registry.build_model(
-            spec.model, **spec.model_kwargs)
+            spec.model, **model_kwargs)
 
         sched: optax.Schedule | float
         if spec.warmup_steps:
