@@ -297,6 +297,15 @@ def _flash_fwd_rule(q, k, v, causal, block_q, block_kv, interpret):
 
 def _flash_bwd_rule(causal, block_q, block_kv, interpret, res, g):
     q, k, v, o3, lse = res
+    return _flash_bwd_impl(q, k, v, o3, lse, g, None, causal, block_q,
+                           block_kv, interpret)
+
+
+def _flash_bwd_impl(q, k, v, o3, lse, g, g_lse, causal, block_q, block_kv,
+                    interpret):
+    """Shared two-pass backward. `g_lse` [B,S,H,1] (or None) is the LSE
+    cotangent: d lse_i/d s_ij = p_ij, so it folds into the delta term —
+    ds = p·(dp - (delta - g_lse)) — at zero extra kernel cost."""
     b, s, h, d = q.shape
     t, kh = k.shape[1], k.shape[2]
     group = h // kh
@@ -315,6 +324,11 @@ def _flash_bwd_rule(causal, block_q, block_kv, interpret, res, g):
     # delta_i = rowsum(dO_i · O_i) — the softmax-normalization term.
     delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
                     axis=-1, keepdims=True)
+    if g_lse is not None:
+        gl3 = _pad_seq(
+            g_lse.astype(jnp.float32).transpose(0, 2, 1, 3).reshape(
+                b * h, s, 1), block_q)
+        delta = delta - gl3
 
     dq_kernel = functools.partial(
         _flash_bwd_dq_kernel, block_q=block_q, block_kv=block_kv, seq_q=s,
@@ -375,3 +389,42 @@ def _flash_bwd_rule(causal, block_q, block_kv, interpret, res, g):
 
 
 flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+# -- (out, lse) variant: the ring-attention inner block ----------------------
+# Ring attention merges per-step partial results by their row logsumexp, so
+# the inner op must EXPOSE lse and be differentiable in it. The backward is
+# the same two kernels with delta := delta - g_lse (see _flash_bwd_impl).
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention_lse(q, k, v, causal: bool = True, block_q: int = 512,
+                        block_kv: int = 512, interpret: bool | None = None):
+    """Flash attention returning (out [B,S,H,D], lse [B,S,H,1] fp32).
+
+    lse is the per-row logsumexp of the scaled scores — the online-softmax
+    merge statistic. Both outputs are differentiable."""
+    out, (o3, lse) = _attn_impl(q, k, v, causal, block_q, block_kv,
+                                interpret)
+    return out, _lse_bshl(lse, q.shape)
+
+
+def _lse_bshl(lse3, qshape):
+    b, s, h, d = qshape
+    return lse3[:, :s].reshape(b, h, s, 1).transpose(0, 2, 1, 3)
+
+
+def _flash_lse_fwd_rule(q, k, v, causal, block_q, block_kv, interpret):
+    out, (o3, lse) = _attn_impl(q, k, v, causal, block_q, block_kv,
+                                interpret)
+    return (out, _lse_bshl(lse, q.shape)), (q, k, v, o3, lse)
+
+
+def _flash_lse_bwd_rule(causal, block_q, block_kv, interpret, res, g):
+    q, k, v, o3, lse = res
+    g_out, g_lse = g
+    return _flash_bwd_impl(q, k, v, o3, lse, g_out, g_lse, causal, block_q,
+                           block_kv, interpret)
+
+
+flash_attention_lse.defvjp(_flash_lse_fwd_rule, _flash_lse_bwd_rule)

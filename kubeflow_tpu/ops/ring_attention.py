@@ -62,18 +62,82 @@ def _merge(carry, update):
     return acc * a1 + acc_u * a2, m_new, l * a1 + l_u * a2
 
 
+def _rotate_if(more, operand, axis_name, n):
+    """ppermute `operand` one step around the ring when `more` (skipped on
+    the final step, whose rotation would be discarded)."""
+    def rotate(o):
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        return jax.tree.map(
+            lambda x: jax.lax.ppermute(x, axis_name, perm), o)
+
+    return jax.lax.cond(more, rotate, lambda o: o, operand)
+
+
+def _merge_lse(carry, update):
+    """Merge two NORMALIZED partials (o, lse): o fp32 [b,s,h,d], lse fp32
+    [b,s,h,1]. o·exp(lse) recovers the unnormalized accumulator, so the
+    stable combine is a weighted average with weights exp(lse - max)."""
+    o, lse = carry
+    o_u, lse_u = update
+    m = jnp.maximum(jnp.maximum(lse, lse_u), NEG_INF / 2)
+    w1 = jnp.exp(lse - m)
+    w2 = jnp.exp(lse_u - m)
+    denom = jnp.maximum(w1 + w2, 1e-30)
+    return (o * w1 + o_u * w2) / denom, m + jnp.log(denom)
+
+
+def _flash_case_block(q, k, v, case, block_q, block_kv):
+    """Fused inner block for ring schedules. `case` (traced int32): 0 = the
+    causal mask kills the whole block (skip — zero partials), 1 = diagonal
+    block (aligned causal flash), 2 = fully visible (non-causal flash).
+    Returns fp32 (o, lse). Offset-ordered layouts (contiguous ring shards,
+    zigzag chunks) make every block one of these three cases, so the fused
+    kernel needs no position-aware masking."""
+    from kubeflow_tpu.ops.flash_attention import flash_attention_lse
+
+    b, s, h, d = q.shape
+
+    def skip(_):
+        return (jnp.zeros((b, s, h, d), jnp.float32),
+                jnp.full((b, s, h, 1), NEG_INF, jnp.float32))
+
+    def diag(_):
+        o, l = flash_attention_lse(q, k, v, True, block_q, block_kv)
+        return o.astype(jnp.float32), l
+
+    def full(_):
+        o, l = flash_attention_lse(q, k, v, False, block_q, block_kv)
+        return o.astype(jnp.float32), l
+
+    return jax.lax.switch(case, (skip, diag, full), None)
+
+
 def ring_attention(q, k, v, axis_name: str = "seq",
                    positions: jax.Array | None = None,
-                   mesh=None) -> jax.Array:
+                   mesh=None, inner: str = "einsum",
+                   block_q: int = 512, block_kv: int = 512) -> jax.Array:
     """Causal ring attention. q [B,S,H,D], k/v [B,S,KH,D] — S is the GLOBAL
     sequence; arrays may be traced under jit with any sharding, the inner
     shard_map forces P(axis_name) on dim 1. `positions` defaults to
-    arange(S) broadcast over batch (standard packing comes later)."""
+    arange(S) broadcast over batch (standard packing comes later).
+
+    inner="flash" runs the fused Pallas kernel per ring step (ops/ROADMAP
+    item: no O(s_loc·t_loc) score materialization): with the contiguous
+    layout each incoming KV shard is entirely before/at/after the resident
+    Q shard, so the step is a skip / causal / full flash call selected by
+    ring offset. Requires default positions (the layout IS the mask)."""
     mesh = mesh or current_mesh()
     if mesh is None:
         raise ValueError("ring_attention needs a mesh (with mesh: ...)")
     n = mesh.shape[axis_name]
     b, s, h, d = q.shape
+    if inner == "flash":
+        if positions is not None:
+            raise ValueError(
+                "inner='flash' derives causality from the contiguous ring "
+                "layout; custom positions need inner='einsum'")
+        return _ring_attention_flash(q, k, v, axis_name, mesh, n,
+                                     block_q, block_kv)
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None],
                                      (b, s))
@@ -99,15 +163,7 @@ def ring_attention(q, k, v, axis_name: str = "seq",
             update = _block_attn(q, k_i, v_i, pos, kv_pos)
             acc_m_l = _merge(acc_m_l, update)
 
-            # Rotate K/V (and their positions) to the next ring neighbour —
-            # skipped on the final step, whose rotation would be discarded.
-            def rotate(operand):
-                perm = [(j, (j + 1) % n) for j in range(n)]
-                return jax.tree.map(
-                    lambda x: jax.lax.ppermute(x, axis_name, perm), operand)
-
-            kv, kv_pos = jax.lax.cond(
-                i < n - 1, rotate, lambda o: o, (kv, kv_pos))
+            kv, kv_pos = _rotate_if(i < n - 1, (kv, kv_pos), axis_name, n)
             return acc_m_l, kv, kv_pos
 
         b_loc, s_loc = q.shape[0], q.shape[1]
@@ -119,6 +175,44 @@ def ring_attention(q, k, v, axis_name: str = "seq",
         return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
     return _ring(q, k, v, positions)
+
+
+def _ring_attention_flash(q, k, v, axis_name, mesh, n, block_q, block_kv):
+    """Contiguous-layout ring with the fused flash inner block. Shard r of
+    the ring owns positions [r·s_loc, (r+1)·s_loc); after i rotations the
+    resident KV originates from shard (me - i) mod n, so the whole step is
+    before/at/after the Q shard — see _flash_case_block."""
+    if n == 1:
+        from kubeflow_tpu.ops.flash_attention import flash_attention
+        return flash_attention(q, k, v, True, block_q, block_kv)
+
+    spec = P(("data", "fsdp"), axis_name, None, None)
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    def _ring(q, k, v):
+        me = jax.lax.axis_index(axis_name)
+        b_loc, s_loc, h, d = q.shape
+
+        def step(i, carry):
+            (o, lse), kv = carry
+            k_i, v_i = kv
+            src = jnp.mod(me - i, n)  # origin shard of the resident KV
+            case = jnp.where(src == me, 1,
+                             jnp.where(src < me, 2, 0)).astype(jnp.int32)
+            update = _flash_case_block(q, k_i, v_i, case, block_q, block_kv)
+            o, lse = _merge_lse((o, lse), update)
+
+            kv = _rotate_if(i < n - 1, kv, axis_name, n)
+            return (o, lse), kv
+
+        init = (jnp.zeros((b_loc, s_loc, h, d), jnp.float32),
+                jnp.full((b_loc, s_loc, h, 1), NEG_INF, jnp.float32))
+        (o, _), _ = jax.lax.fori_loop(
+            0, n, jax.checkpoint(step), (init, (k, v)))
+        return o.astype(q.dtype)
+
+    return _ring(q, k, v)
 
 
 def zigzag_indices(s: int, n: int) -> jax.Array:
@@ -162,14 +256,21 @@ def _maybe_block_attn(q, k, v, q_pos, kv_pos):
 
 
 def zigzag_ring_attention(q, k, v, axis_name: str = "seq", mesh=None,
-                          pre_permuted: bool = False) -> jax.Array:
+                          pre_permuted: bool = False,
+                          inner: str = "einsum",
+                          block_q: int = 512,
+                          block_kv: int = 512) -> jax.Array:
     """Causal ring attention with the zigzag layout. Inputs/outputs are in
     NORMAL sequence order unless `pre_permuted` (the efficient path: lay
     the batch out with zigzag_indices in the input pipeline and skip the
     runtime gather). Each ring step splits the resident Q and incoming KV
     into their two chunks and computes only the causally-visible
     sub-blocks — ~2× less dense work at the lockstep pace vs the
-    contiguous schedule."""
+    contiguous schedule.
+
+    inner="flash": zigzag chunks are contiguous position ranges, so every
+    (q chunk, kv chunk) sub-block is skip / aligned-causal / full — the
+    fused Pallas kernel serves all of them (_flash_case_block)."""
     mesh = mesh or current_mesh()
     if mesh is None:
         raise ValueError("zigzag_ring_attention needs a mesh")
@@ -182,6 +283,10 @@ def zigzag_ring_attention(q, k, v, axis_name: str = "seq", mesh=None,
     idx = zigzag_indices(s, n)
     if not pre_permuted:
         q, k, v = (x[:, idx] for x in (q, k, v))
+    if inner == "flash":
+        out = _zigzag_ring_flash(q, k, v, axis_name, mesh, n,
+                                 block_q, block_kv)
+        return out if pre_permuted else out[:, jnp.argsort(idx)]
     positions = jnp.broadcast_to(idx[None].astype(jnp.int32), (b, s))
 
     spec = P(("data", "fsdp"), axis_name, None, None)
@@ -214,13 +319,7 @@ def zigzag_ring_attention(q, k, v, axis_name: str = "seq", mesh=None,
                 hi_part = _merge(hi_part,
                                  _maybe_block_attn(q_hi, kk, vv, p_hi, kp))
 
-            def rotate(operand):
-                perm = [(j, (j + 1) % n) for j in range(n)]
-                return jax.tree.map(
-                    lambda x: jax.lax.ppermute(x, axis_name, perm), operand)
-
-            kv, kv_pos = jax.lax.cond(
-                i < n - 1, rotate, lambda o: o, (kv, kv_pos))
+            kv, kv_pos = _rotate_if(i < n - 1, (kv, kv_pos), axis_name, n)
             return (lo_part, hi_part), kv, kv_pos
 
         def zero_part(width):
@@ -242,6 +341,56 @@ def zigzag_ring_attention(q, k, v, axis_name: str = "seq", mesh=None,
     if pre_permuted:
         return out
     return out[:, jnp.argsort(idx)]
+
+
+def _zigzag_ring_flash(q, k, v, axis_name, mesh, n, block_q, block_kv):
+    """Zigzag schedule with the fused flash inner block. Shard i holds
+    chunks (i, 2n-1-i); chunk c covers positions [c·cs, (c+1)·cs), so
+    chunk-id comparison decides each sub-block's case."""
+    spec = P(("data", "fsdp"), axis_name, None, None)
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    def _ring(q, k, v):
+        me = jax.lax.axis_index(axis_name)
+        b_loc, s_loc, h, d = q.shape
+        half = s_loc // 2
+
+        def split(x):
+            return x[:, :half], x[:, half:]
+
+        q_lo, q_hi = split(q)
+        qc_lo, qc_hi = me, 2 * n - 1 - me  # chunk ids of the two q halves
+
+        def case(qc, kc):
+            return jnp.where(qc == kc, 1,
+                             jnp.where(qc > kc, 2, 0)).astype(jnp.int32)
+
+        def step(i, carry):
+            (lo, hi), kv = carry
+            k_i, v_i = kv
+            src = jnp.mod(me - i, n)
+            k_lo, k_hi = split(k_i)
+            v_lo, v_hi = split(v_i)
+            for kk, vv, kc in ((k_lo, v_lo, src), (k_hi, v_hi, 2 * n - 1 - src)):
+                lo = _merge_lse(lo, _flash_case_block(
+                    q_lo, kk, vv, case(qc_lo, kc), block_q, block_kv))
+                hi = _merge_lse(hi, _flash_case_block(
+                    q_hi, kk, vv, case(qc_hi, kc), block_q, block_kv))
+
+            kv = _rotate_if(i < n - 1, kv, axis_name, n)
+            return (lo, hi), kv
+
+        def zero_part(width):
+            return (jnp.zeros((b_loc, width, h, d), jnp.float32),
+                    jnp.full((b_loc, width, h, 1), NEG_INF, jnp.float32))
+
+        init = (zero_part(half), zero_part(s_loc - half))
+        ((o_lo, _), (o_hi, _)), _ = jax.lax.fori_loop(
+            0, n, jax.checkpoint(step), (init, (k, v)))
+        return jnp.concatenate([o_lo, o_hi], axis=1).astype(q.dtype)
+
+    return _ring(q, k, v)
 
 
 def ulysses_attention(q, k, v, axis_name: str = "seq",
@@ -272,10 +421,8 @@ def ulysses_attention(q, k, v, axis_name: str = "seq",
                                       concat_axis=2, tiled=True)
 
         ql, kl, vl = scatter_heads(q), scatter_heads(k), scatter_heads(v)
-        # Forward via the flash kernel: O(S) memory. NOTE the backward still
-        # recomputes through the einsum reference (O(S²) scores) until the
-        # Pallas backward lands — see ops/ROADMAP.md; prefer ring_attention
-        # for training at very long context.
+        # Forward AND backward run the fused Pallas kernels (O(S) memory;
+        # flash_attention's custom VJP is the two-pass dq/dkv recipe).
         from kubeflow_tpu.ops.flash_attention import flash_attention
         out = flash_attention(ql, kl, vl, True)
         return gather_heads(out)

@@ -275,3 +275,123 @@ def test_zigzag_impl_refuses_unpermuted_data(devices8):
     toks = jnp.zeros((1, 32), jnp.int32)
     with pytest.raises(ValueError, match="zigzag"):
         model.init(jax.random.key(0), toks)
+
+
+# -- fused (flash) inner block for ring schedules ----------------------------
+
+from kubeflow_tpu.ops.flash_attention import flash_attention_lse  # noqa: E402
+
+
+def test_flash_lse_matches_naive_stats():
+    """(out, lse) variant: out matches naive; lse is the row logsumexp of
+    the scaled scores (checked directly against the einsum scores)."""
+    q, k, v = _qkv(s=64)
+    out, lse = flash_attention_lse(q, k, v, True, 32, 32)
+    ref = naive_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+    b, s, h, d = q.shape
+    kh = k.shape[2]
+    qg = q.reshape(b, s, kh, h // kh, d).astype(jnp.float32)
+    scores = jnp.einsum("bskgd,btkd->bskgt", qg, k) / np.sqrt(d)
+    mask = jnp.arange(s)[:, None] >= jnp.arange(s)[None]
+    scores = jnp.where(mask[None, :, None, None, :], scores, -1e30)
+    ref_lse = jax.scipy.special.logsumexp(scores, axis=-1)
+    ref_lse = ref_lse.reshape(b, s, h, 1)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_flash_lse_cotangent():
+    """Gradients through BOTH outputs: a loss that mixes out and lse must
+    match AD through the einsum reference."""
+    q, k, v = _qkv(s=32, seed=3)
+
+    def loss_flash(q, k, v):
+        out, lse = flash_attention_lse(q, k, v, True, 16, 16)
+        return jnp.sum(out ** 2) + jnp.sum(jnp.sin(lse))
+
+    def loss_ref(q, k, v):
+        b, s, h, d = q.shape
+        kh = k.shape[2]
+        out = naive_attention(q, k, v, causal=True)
+        qg = q.reshape(b, s, kh, h // kh, d).astype(jnp.float32)
+        scores = jnp.einsum("bskgd,btkd->bskgt", qg, k) / np.sqrt(d)
+        mask = jnp.arange(s)[:, None] >= jnp.arange(s)[None]
+        scores = jnp.where(mask[None, :, None, None, :], scores, -1e30)
+        lse = jax.scipy.special.logsumexp(scores, axis=-1).reshape(b, s, h, 1)
+        return jnp.sum(out ** 2) + jnp.sum(jnp.sin(lse))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=5e-3, atol=5e-3)
+
+
+def test_ring_flash_matches_naive(devices8):
+    q, k, v = _qkv(s=128)
+    mesh = build_mesh(MeshConfig(seq=8), devices8)
+    ref = naive_attention(q, k, v, causal=True)
+    with mesh:
+        out = ring_attention(q, k, v, axis_name="seq", inner="flash",
+                             block_q=16, block_kv=16)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_ring_flash_grads_match_einsum_ring(devices8):
+    q, k, v = _qkv(s=64, seed=5)
+    mesh = build_mesh(MeshConfig(seq=4), devices8[:4])
+
+    with mesh:
+        def loss_flash(q, k, v):
+            return jnp.sum(ring_attention(q, k, v, inner="flash",
+                                          block_q=16, block_kv=16) ** 2)
+
+        def loss_einsum(q, k, v):
+            return jnp.sum(ring_attention(q, k, v) ** 2)
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        ge = jax.grad(loss_einsum, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, ge):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=5e-3, atol=5e-3)
+
+
+def test_ring_flash_rejects_custom_positions(devices8):
+    q, k, v = _qkv(s=64)
+    mesh = build_mesh(MeshConfig(seq=4), devices8[:4])
+    with mesh, pytest.raises(ValueError, match="contiguous"):
+        ring_attention(q, k, v, inner="flash",
+                       positions=jnp.zeros((2, 64), jnp.int32))
+
+
+def test_zigzag_flash_matches_naive(devices8):
+    q, k, v = _qkv(s=128, seed=7)
+    mesh = build_mesh(MeshConfig(seq=8), devices8)
+    ref = naive_attention(q, k, v, causal=True)
+    with mesh:
+        out = zigzag_ring_attention(q, k, v, inner="flash",
+                                    block_q=8, block_kv=8)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_zigzag_flash_grads(devices8):
+    q, k, v = _qkv(s=64, seed=9)
+    mesh = build_mesh(MeshConfig(seq=4), devices8[:4])
+
+    with mesh:
+        def loss_flash(q, k, v):
+            return jnp.sum(zigzag_ring_attention(
+                q, k, v, inner="flash", block_q=8, block_kv=8) ** 2)
+
+        def loss_einsum(q, k, v):
+            return jnp.sum(zigzag_ring_attention(q, k, v) ** 2)
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        ge = jax.grad(loss_einsum, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, ge):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=5e-3, atol=5e-3)
