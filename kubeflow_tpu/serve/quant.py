@@ -1,0 +1,112 @@
+"""Weight-only int8 quantization for serving.
+
+The reference's LLM runtime leans on vLLM's GPU quantization back ends
+(⟨kserve: python/huggingfaceserver — vLLM engine args⟩, SURVEY.md §2.2).
+The TPU-native equivalent for a serving-side win is *weight-only* int8:
+weights sit in HBM at half the bf16 footprint and the dequantize (a
+per-channel multiply) fuses into the consuming matmul's operand read under
+XLA — decode steps are HBM-bandwidth-bound, so halving weight bytes is a
+direct throughput lever (ops/ROADMAP.md item: quantized serving).
+
+Scheme: symmetric per-channel (max-abs over the leading contraction axis)
+int8, fp32 scales of shape `leaf.shape[1:]`. Quantized leaves are a
+registered pytree node (`Int8Leaf`), so the quantized tree flows through
+jit / device_put / AOT lowering like any params tree, and `QuantizedModule`
+makes it transparent to every consumer that calls `model.apply` (the
+generation engine, AOT-bucketed predictors, graph nodes).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.tree_util.register_pytree_node_class
+class Int8Leaf:
+    """int8 values + fp32 per-channel scales; w ≈ q * scale."""
+
+    def __init__(self, q, scale):
+        self.q = q
+        self.scale = scale
+
+    def tree_flatten(self):
+        return (self.q, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, _aux, children):
+        return cls(*children)
+
+    def dequantize(self, dtype=jnp.bfloat16):
+        return (self.q.astype(jnp.float32) * self.scale).astype(dtype)
+
+
+def _is_quant_leaf(x: Any) -> bool:
+    return isinstance(x, Int8Leaf)
+
+
+def quantize_tree(params: Any, *, min_size: int = 4096) -> Any:
+    """Replace large float leaves with Int8Leaf.
+
+    Leaves smaller than `min_size` elements (norm scales, biases) stay in
+    full precision — they are bandwidth-irrelevant and precision-critical.
+    """
+    def quant(leaf):
+        if not hasattr(leaf, "dtype") or not jnp.issubdtype(
+                jnp.asarray(leaf).dtype, jnp.floating):
+            return leaf
+        arr = jnp.asarray(leaf)
+        if arr.ndim < 2 or arr.size < min_size:
+            return leaf
+        a32 = arr.astype(jnp.float32)
+        amax = jnp.max(jnp.abs(a32), axis=0)  # per-channel over contraction
+        scale = jnp.maximum(amax, 1e-12) / 127.0
+        q = jnp.clip(jnp.round(a32 / scale), -127, 127).astype(jnp.int8)
+        return Int8Leaf(q, scale)
+
+    return jax.tree.map(quant, params)
+
+
+def dequantize_tree(params: Any, dtype: Any = jnp.bfloat16) -> Any:
+    """Inverse of quantize_tree; runs inside jit so XLA fuses the multiply
+    into the consuming matmul's operand read."""
+    return jax.tree.map(
+        lambda leaf: leaf.dequantize(dtype) if _is_quant_leaf(leaf) else leaf,
+        params, is_leaf=_is_quant_leaf)
+
+
+def quantized_bytes(params: Any) -> dict:
+    """{"quantized": n, "full": n} parameter byte counts for metadata.
+    `full` is the bf16 baseline (what the server would otherwise hold),
+    so full/quantized is the honest HBM saving — about 2×."""
+    qb = fb = 0
+    for leaf in jax.tree.leaves(params, is_leaf=_is_quant_leaf):
+        if _is_quant_leaf(leaf):
+            qb += leaf.q.size + leaf.scale.size * 4
+            fb += leaf.q.size * 2  # bf16
+        elif hasattr(leaf, "nbytes"):
+            qb += leaf.nbytes
+            fb += leaf.nbytes
+    return {"quantized": int(qb), "full": int(fb)}
+
+
+class QuantizedModule:
+    """Wraps a flax module so `apply` sees dequantized params — quantization
+    becomes a storage detail invisible to the model code and to every
+    serving path that holds a (module, params) pair."""
+
+    def __init__(self, module: Any, dtype: Any = jnp.bfloat16):
+        self.module = module
+        self.dtype = dtype
+
+    def apply(self, variables: dict, *args, **kwargs):
+        variables = dict(variables)
+        variables["params"] = dequantize_tree(variables["params"],
+                                              self.dtype)
+        return self.module.apply(variables, *args, **kwargs)
+
+    def __getattr__(self, name):  # cfg etc. pass through
+        return getattr(self.module, name)

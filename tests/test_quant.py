@@ -1,0 +1,113 @@
+"""Weight-only int8 serving quantization (serve/quant.py): reconstruction
+error, model-level logits agreement, and the runtime spec flag end-to-end."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.serve.quant import (
+    QuantizedModule,
+    dequantize_tree,
+    quantize_tree,
+    quantized_bytes,
+)
+
+
+def test_roundtrip_error_per_channel():
+    w = jax.random.normal(jax.random.key(0), (256, 64)) * jnp.linspace(
+        0.01, 3.0, 64)[None, :]  # very different per-channel ranges
+    q = quantize_tree({"kernel": w}, min_size=1)
+    deq = dequantize_tree(q, jnp.float32)["kernel"]
+    rel = float(jnp.linalg.norm(deq - w) / jnp.linalg.norm(w))
+    assert rel < 0.01, rel  # max-abs int8: ~0.7% RMS on gaussian
+
+
+def test_small_leaves_stay_full_precision():
+    params = {"kernel": jnp.ones((128, 128)), "bias": jnp.ones((128,)),
+              "scale": jnp.ones((4, 4))}
+    q = quantize_tree(params, min_size=4096)
+    from kubeflow_tpu.serve.quant import Int8Leaf
+    assert isinstance(q["kernel"], Int8Leaf)
+    assert q["kernel"].q.dtype == jnp.int8
+    assert isinstance(q["bias"], jnp.ndarray)  # 1-D: never quantized
+    assert isinstance(q["scale"], jnp.ndarray)  # below min_size
+
+    by = quantized_bytes(q)
+    assert by["quantized"] < by["full"]
+
+
+def test_int_leaves_untouched():
+    params = {"table": jnp.arange(10000, dtype=jnp.int32).reshape(100, 100)}
+    q = quantize_tree(params, min_size=1)
+    assert q["table"].dtype == jnp.int32
+
+
+def test_llama_logits_close():
+    from kubeflow_tpu.models.llama import Llama, llama_tiny
+
+    cfg = llama_tiny()
+    model = Llama(cfg)
+    toks = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 32)),
+        jnp.int32)
+    params = model.init(jax.random.key(1), toks)["params"]
+    import flax.linen as nn
+    params = nn.meta.unbox(params)
+
+    full = model.apply({"params": params}, toks)
+    qm = QuantizedModule(model, dtype=jnp.float32)
+    qlogits = qm.apply({"params": quantize_tree(params)}, toks)
+
+    # Weight-only int8 must preserve the argmax almost everywhere and stay
+    # close in value.
+    agree = float(jnp.mean(
+        (jnp.argmax(full, -1) == jnp.argmax(qlogits, -1)).astype(jnp.float32)))
+    assert agree > 0.95, agree
+    err = float(jnp.max(jnp.abs(qlogits - full)))
+    scale = float(jnp.max(jnp.abs(full)))
+    assert err < 0.1 * max(scale, 1.0), (err, scale)
+
+
+def test_runtime_quantize_flag(tmp_path):
+    from kubeflow_tpu.serve.runtimes import export_for_serving, load_model
+
+    export_for_serving(
+        str(tmp_path), model="llama_tiny", batch_buckets=[2],
+        extra={"quantize": "int8", "warm_buckets": [2],
+               "model_kwargs": {"remat": False}})
+    model = load_model(str(tmp_path))
+    assert model.load()
+    toks = np.zeros((2, 16), np.int32)
+    out = model.predict([toks])
+    assert out[-1].shape == (2, 16, 512)
+    assert np.isfinite(out[-1]).all()
+
+
+def test_runtime_quantize_generative(tmp_path):
+    from kubeflow_tpu.serve.runtimes import export_for_serving, load_model
+
+    export_for_serving(
+        str(tmp_path), model="llama_tiny", batch_buckets=[1],
+        extra={"quantize": "int8",
+               "model_kwargs": {"remat": False, "attention_impl": "naive"},
+               "generative": {"slots": 2, "max_len": 64, "chunk": 4,
+                              "prefill_buckets": [16]}})
+    model = load_model(str(tmp_path))
+    assert model.load()
+    try:
+        out = model.generate({"input_ids": [1, 2, 3], "max_tokens": 5})
+        assert len(out["output_ids"]) == 5
+    finally:
+        model.unload()
+
+
+def test_runtime_rejects_unknown_mode(tmp_path):
+    from kubeflow_tpu.serve.runtimes import export_for_serving, load_model
+
+    export_for_serving(str(tmp_path), model="llama_tiny",
+                       extra={"quantize": "fp4"})
+    with pytest.raises(ValueError, match="quantize"):
+        load_model(str(tmp_path))
