@@ -650,7 +650,12 @@ void PipelineRunController::LaunchTask(RunView& run, const std::string& tname,
   if (store_->Get("JAXJob", job)) store_->Delete("JAXJob", job);
   Json job_spec = Json::Object();
   job_spec["replicas"] = comp.get("replicas").as_int(1);
-  job_spec["devices_per_proc"] = 1;
+  // TPU placement from the component (kfp-kubernetes analog): chips per
+  // process and slice count flow straight into the gang request.
+  job_spec["devices_per_proc"] = comp.get("devices_per_proc").as_int(1);
+  if (comp.get("num_slices").as_int(1) > 1) {
+    job_spec["num_slices"] = comp.get("num_slices");
+  }
   if (comp.get("cpu_devices_per_proc").as_int(0) > 0) {
     job_spec["cpu_devices_per_proc"] = comp.get("cpu_devices_per_proc");
   }

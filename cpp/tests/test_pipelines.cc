@@ -384,6 +384,42 @@ int main() {
     CHECK(l2.Lookup("nope").is_null());
   }
 
+  // --- TPU placement fields flow component -> JAXJob spec ----------------
+  {
+    Harness h;
+    h.sched.AddSlice("s2", 8);  // multi-slice placement needs two pools
+    Json ir = Json::Object();
+    ir["schema"] = "tpk-pipeline/v1";
+    ir["name"] = "place";
+    ir["params"] = Json::Object();
+    Json tasks = Json::Object();
+    Json t = Json::Object();
+    Json c = Json::Object();
+    c["name"] = "p";
+    c["kind"] = "python";
+    c["source"] = "def p(**kw): pass\n";
+    c["params"] = Json::Object();
+    c["defaults"] = Json::Object();
+    c["inputs"] = Json::Array();
+    c["outputs"] = Json::Array();
+    c["replicas"] = 2;
+    c["cache"] = false;
+    c["devices_per_proc"] = 4;
+    c["num_slices"] = 2;
+    t["component"] = c;
+    t["arguments"] = Json::Object();
+    t["depends_on"] = Json::Array();
+    tasks["p"] = t;
+    ir["tasks"] = tasks;
+    h.store.Create("PipelineRun", "pr", h.RunSpec(ir));
+    h.Settle();
+    auto j = h.store.Get("JAXJob", "pr.p");
+    CHECK(j.has_value());
+    CHECK(j->spec.get("replicas").as_int(0) == 2);
+    CHECK(j->spec.get("devices_per_proc").as_int(0) == 4);
+    CHECK(j->spec.get("num_slices").as_int(0) == 2);
+  }
+
   printf("test_pipelines OK\n");
   return 0;
 }

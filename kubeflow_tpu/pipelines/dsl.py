@@ -168,10 +168,16 @@ class Component:
 
     def __init__(self, fn: Callable | None, replicas: int = 1,
                  cpu_devices_per_proc: int = 0, cache: bool = True,
-                 retries: int = 0):
+                 retries: int = 0, devices_per_proc: int = 1,
+                 num_slices: int = 1):
         self.fn = fn
         self.replicas = replicas
         self.cpu_devices_per_proc = cpu_devices_per_proc
+        # TPU placement (the kfp-kubernetes nodeSelector/`google.com/tpu`
+        # analog, SURVEY.md §2.4): chips per process and slice count for
+        # the gang the controller materializes for this step.
+        self.devices_per_proc = int(devices_per_proc)
+        self.num_slices = int(num_slices)
         self.cache = cache
         self.retries = int(retries)
         self.kind = "python"
@@ -297,6 +303,8 @@ class Component:
             "outputs": list(self.outputs),
             "replicas": self.replicas,
             "cpu_devices_per_proc": self.cpu_devices_per_proc,
+            "devices_per_proc": self.devices_per_proc,
+            "num_slices": self.num_slices,
             "cache": self.cache,
             "retries": self.retries,
             "returns": self.returns,
@@ -305,15 +313,19 @@ class Component:
 
 def component(fn: Callable | None = None, *, replicas: int = 1,
               cpu_devices_per_proc: int = 0, cache: bool = True,
-              retries: int = 0):
+              retries: int = 0, devices_per_proc: int = 1,
+              num_slices: int = 1):
     """Decorator: python function → Component (KFP @dsl.component).
     `retries` is the per-task retry budget (KFP set_retry): the controller
     relaunches a failed attempt up to that many times before the task — and
-    with it the run — fails."""
+    with it the run — fails. `devices_per_proc`/`num_slices` place the
+    step's gang on TPU topology (the kfp-kubernetes TPU-resource analog)."""
     def wrap(f: Callable) -> Component:
         return Component(f, replicas=replicas,
                          cpu_devices_per_proc=cpu_devices_per_proc,
-                         cache=cache, retries=retries)
+                         cache=cache, retries=retries,
+                         devices_per_proc=devices_per_proc,
+                         num_slices=num_slices)
     return wrap(fn) if fn is not None else wrap
 
 
@@ -322,10 +334,13 @@ def container_component(name: str, argv: list[str], *,
                         defaults: dict[str, Any] | None = None,
                         inputs: list[str] | None = None,
                         outputs: list[str] | None = None,
-                        cache: bool = True, retries: int = 0) -> Component:
+                        cache: bool = True, retries: int = 0,
+                        replicas: int = 1, devices_per_proc: int = 1,
+                        num_slices: int = 1) -> Component:
     """Raw-command step. `argv` may use `{{params.x}}`, `{{inputs.a}}`,
     `{{outputs.b}}` placeholders, resolved by the launcher at run time."""
-    c = Component(None, cache=cache, retries=retries)
+    c = Component(None, cache=cache, retries=retries, replicas=replicas,
+                  devices_per_proc=devices_per_proc, num_slices=num_slices)
     c.kind = "command"
     c.name = name
     c.argv = list(argv)
