@@ -55,6 +55,13 @@ class LlamaConfig:
     # (*_flash = fused Pallas inner block per ring step)
     attention_impl: str = "auto"
     remat: bool = True
+    # Which residuals the remat'd backward may keep: "nothing" (recompute
+    # the whole block — minimum memory, ~2 extra fwd FLOP-shares), "dots"
+    # (save matmul outputs — recompute only elementwise, costs activation
+    # memory), "dots_no_batch" (save only weight-stationary dots),
+    # "save_attn" (keep attention outputs so bwd skips re-running the
+    # attention kernel — wins only on HBM-rich parts; PROFILE.md §4).
+    remat_policy: str = "nothing"
     scan_layers: bool = True
     # flash-kernel block sizes (tuned for v5e/v5p VMEM; ops/flash_attention.py)
     flash_block_q: int = 512
@@ -320,6 +327,11 @@ class DecoderLayer(nn.Module):
         attn_out, new_cache = Attention(cfg, name="attn")(
             h, cos, sin, positions, ring_axis, standard_positions, cache,
             cache_index)
+        # Remat landmark: policy "save_attn" keeps this tensor so the
+        # backward skips re-running the attention kernel (small residual:
+        # [B,S,H·D] bf16 per layer vs the full block internals).
+        from jax.ad_checkpoint import checkpoint_name
+        attn_out = checkpoint_name(attn_out, "attn_out")
         x = x + attn_out
         h = RMSNorm(cfg.rms_eps, cfg.dtype, name="post_attn_norm")(x)
         x = x + (self.mlp_cls or MLPBlock)(cfg, name="mlp")(h)
@@ -364,9 +376,22 @@ class Llama(nn.Module):
 
         layer_cls = DecoderLayer
         if cfg.remat:
-            layer_cls = nn.remat(
-                layer_cls, policy=jax.checkpoint_policies.nothing_saveable,
-                static_argnums=(5, 6))
+            policies = {
+                "nothing": jax.checkpoint_policies.nothing_saveable,
+                "dots": jax.checkpoint_policies.checkpoint_dots,
+                "dots_no_batch":
+                    jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                "save_attn": jax.checkpoint_policies.save_only_these_names(
+                    "attn_out"),
+            }
+            try:
+                policy = policies[cfg.remat_policy]
+            except KeyError:
+                raise ValueError(
+                    f"remat_policy {cfg.remat_policy!r}: "
+                    f"{sorted(policies)}") from None
+            layer_cls = nn.remat(layer_cls, policy=policy,
+                                 static_argnums=(5, 6))
         new_cache = None
         if cfg.scan_layers:
             # `cache` (leading layer dim) rides as the scan's per-layer input

@@ -46,8 +46,9 @@ class TrainJobSpec:
     warmup_steps: int = 0
     weight_decay: float = 0.0
     seed: int = 0
-    # False | True/"ring" (contiguous ring CP) | "zigzag" (balanced causal
-    # schedule: the trainer permutes batches + positions to match).
+    # False | True/"ring" (contiguous ring CP) | "ring_flash" (fused Pallas
+    # inner block) | "zigzag"/"zigzag_flash" (balanced causal schedule: the
+    # trainer permutes batches + positions to match; _flash = fused inner).
     ring_attention: bool | str = False
     # "full" materializes [B,S,V] logits; "chunked" is the fused blockwise
     # CE (no logits buffer — the long-context/large-vocab memory saver).
@@ -81,13 +82,19 @@ class Trainer:
 
         from kubeflow_tpu.utils import registry
 
+        valid_ring = (False, True, "ring", "ring_flash", "zigzag",
+                      "zigzag_flash")
+        if spec.ring_attention not in valid_ring:
+            raise ValueError(
+                f"ring_attention {spec.ring_attention!r}: one of "
+                f"{valid_ring}")
         model_kwargs = dict(spec.model_kwargs)
-        if spec.ring_attention == "zigzag":
+        if spec.ring_attention in ("zigzag", "zigzag_flash", "ring_flash"):
             # Keep the kernel and the data contract in lockstep: the spec
             # is the single switch, the model impl follows. Derived locally
             # — the caller's spec must stay as submitted (it gets
             # re-serialized for resume/retry).
-            model_kwargs["attention_impl"] = "zigzag"
+            model_kwargs["attention_impl"] = spec.ring_attention
         self.rules = rules_for(spec.strategy)
         mesh_fields = dict(spec.mesh)
         mesh_fields.setdefault("num_slices", self.penv.num_slices)
@@ -184,7 +191,7 @@ class Trainer:
         # in __init__ so spec and kernel can't drift.
         zigzag_idx = None
         init_kwargs = None
-        if spec.ring_attention == "zigzag":
+        if spec.ring_attention in ("zigzag", "zigzag_flash"):
             from kubeflow_tpu.ops.ring_attention import zigzag_indices
 
             n_seq = self.mesh.shape["seq"]

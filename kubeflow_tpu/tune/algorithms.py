@@ -628,6 +628,75 @@ def suggest_pbt(parameters: Sequence[dict], history: Sequence[dict],
         g += 1
 
 
+# ---------------------------------------------------------------------------
+# Regularized evolution (Real et al., "Regularized Evolution for Image
+# Classifier Architecture Search", AAAI 2019) — the NAS workhorse. The
+# reference ships NAS as ENAS/DARTS suggestion services ⟨katib:
+# pkg/suggestion/v1beta1/nas⟩, both of which embed a trained controller /
+# supernet in the service; aging evolution reaches comparable architectures
+# with a plain ask/tell loop (the AmoebaNet result), which is the honest
+# fit for this stateless suggestion protocol. Architectures are encoded in
+# the ordinary parameter-space schema (categorical ops / int dims), so any
+# trial template can consume them.
+#
+# Replay: population = the last `population` terminal trials (aging: older
+# trials fall out of the window); each proposal picks the best of a random
+# `sample` subset and mutates ONE parameter.
+# ---------------------------------------------------------------------------
+
+
+def suggest_evolution(parameters: Sequence[dict], history: Sequence[dict],
+                      count: int, seed: int = 0,
+                      settings: dict | None = None) -> list[dict]:
+    _check_space(parameters)
+    s = settings or {}
+    pop_size = int(s.get("population", 20))
+    sample = int(s.get("sample", 5))
+    if pop_size < 2 or sample < 1:
+        raise AlgorithmError("evolution needs population >= 2, sample >= 1")
+    goal = s.get("goal", "minimize")
+    sign = -1.0 if goal == "maximize" else 1.0
+
+    def mutate(a: dict, rng: _random.Random) -> dict:
+        out = dict(a)
+        p = parameters[rng.randrange(len(parameters))]
+        name = p["name"]
+        if p.get("type") == "categorical":
+            choices = [v for v in p["values"] if v != out.get(name)]
+            out[name] = rng.choice(choices or p["values"])
+        else:
+            # Local move in the unit/model scale; fall back to resample
+            # when stuck on a bound.
+            u = _to_unit(p, out[name])
+            nu = min(max(u + rng.gauss(0.0, 0.15), 0.0), 1.0)
+            moved = _from_unit(p, nu)
+            out[name] = (moved if moved != out[name]
+                         else _sample_param(p, rng))
+        return out
+
+    rng = _random.Random(f"{seed}:rea:{len(history)}")
+    terminal = [h for h in history
+                if h.get("status") in TERMINAL_TRIAL and h.get("params")]
+    scored = [h for h in terminal[-pop_size:] if h.get("value") is not None]
+    out: list[dict] = []
+    seen = {_key(h.get("params", {})) for h in history}
+    for _ in range(count):
+        if len(scored) < 2:  # seed the population randomly
+            a = {p["name"]: _sample_param(p, rng) for p in parameters}
+        else:
+            tournament = [scored[rng.randrange(len(scored))]
+                          for _ in range(sample)]
+            parent = min(tournament, key=lambda h: sign * float(h["value"]))
+            a = mutate(dict(parent["params"]), rng)
+        for _retry in range(20):
+            if _key(a) not in seen:
+                break
+            a = mutate(a, rng)
+        seen.add(_key(a))
+        out.append(a)
+    return out
+
+
 ALGORITHMS = {
     "random": suggest_random,
     "grid": suggest_grid,
@@ -636,6 +705,8 @@ ALGORITHMS = {
     "hyperband": suggest_hyperband,
     "cmaes": suggest_cmaes,
     "pbt": suggest_pbt,
+    "evolution": suggest_evolution,
+    "nas-evolution": suggest_evolution,  # NAS entry point (arch-encoded spaces)
 }
 
 

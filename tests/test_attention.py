@@ -243,7 +243,7 @@ def test_zigzag_training_matches_ring(devices8, tmp_path):
     from kubeflow_tpu.train.trainer import Trainer, TrainJobSpec
 
     series = {}
-    for impl in ("ring", "zigzag"):
+    for impl in ("ring", "zigzag", "ring_flash", "zigzag_flash"):
         metrics = tmp_path / f"{impl}.jsonl"
         spec = TrainJobSpec(
             model="llama_tiny",
@@ -258,8 +258,10 @@ def test_zigzag_training_matches_ring(devices8, tmp_path):
                         for l in metrics.read_text().splitlines()
                         if "loss" in json.loads(l)]
     assert len(series["ring"]) >= 4
-    for a, b in zip(series["ring"], series["zigzag"]):
-        assert b == pytest.approx(a, rel=2e-2), series
+    for other in ("zigzag", "ring_flash", "zigzag_flash"):
+        assert len(series[other]) == len(series["ring"]), (other, series)
+        for a, b in zip(series["ring"], series[other]):
+            assert b == pytest.approx(a, rel=2e-2), (other, series)
 
 
 def test_zigzag_impl_refuses_unpermuted_data(devices8):

@@ -529,3 +529,74 @@ def test_pbt_parent_param_collision_rejected():
     with pytest.raises(alg.AlgorithmError, match="parent_param"):
         alg.suggest_pbt(PBT_SPACE, [], 1,
                         settings=dict(PBT_SETTINGS, parent_param="lr"))
+
+
+# -- regularized evolution (Real et al. 2019; NAS entry point) ---------------
+
+NAS_SPACE = [
+    {"name": "op1", "type": "categorical",
+     "values": ["conv3", "conv5", "sep3", "identity", "maxpool"]},
+    {"name": "op2", "type": "categorical",
+     "values": ["conv3", "conv5", "sep3", "identity", "maxpool"]},
+    {"name": "width", "type": "int", "min": 16, "max": 256, "step": 16},
+]
+
+
+def test_evolution_validation():
+    with pytest.raises(alg.AlgorithmError, match="population"):
+        alg.suggest_evolution(NAS_SPACE, [], 1, settings={"population": 1})
+
+
+def test_evolution_improves_on_synthetic_nas():
+    """Synthetic architecture objective: specific ops + width near 128 are
+    best. Aging evolution must beat its own random seeding phase."""
+    def score(a):
+        s = 0.0
+        s += {"conv3": 0.0, "conv5": 0.1, "sep3": 0.3, "identity": 0.8,
+              "maxpool": 0.6}[a["op1"]]
+        s += {"conv3": 0.5, "conv5": 0.2, "sep3": 0.0, "identity": 0.9,
+              "maxpool": 0.7}[a["op2"]]
+        s += abs(a["width"] - 128) / 128.0
+        return s
+
+    history = []
+    for _ in range(30):
+        for a in alg.suggest_evolution(
+                NAS_SPACE, history, 4, seed=13,
+                settings={"population": 12, "sample": 4}):
+            history.append({"params": a, "status": "Succeeded",
+                            "value": score(a)})
+    first_20 = min(h["value"] for h in history[:20])
+    best = min(h["value"] for h in history)
+    assert best < first_20, (best, first_20)
+    assert best < 0.35, best  # near-optimal architecture found
+    # (No population-mean assertion: REA's guarantee is best-found via
+    # tournament+mutation, not mean concentration — single-param
+    # mutations deliberately keep exploring.)
+
+
+def test_evolution_mutates_single_param_from_parent():
+    history = []
+    for a in alg.suggest_evolution(NAS_SPACE, history, 12, seed=2,
+                                   settings={"population": 12}):
+        history.append({"params": a, "status": "Succeeded", "value": 1.0})
+    # Make one parent clearly the best: with sample == population, every
+    # tournament selects it, so every proposal must be a near copy —
+    # exactly one mutated param (dedup may force a second), never a fresh
+    # random sample (which would differ in ~all params) and never an
+    # unmutated duplicate.
+    history[3]["value"] = 0.0
+    # One proposal at a time: batched asks from one parent re-mutate to
+    # dedup against each other, which would blur the single-step bound.
+    for seed in (3, 4, 5, 6):
+        (a,) = alg.suggest_evolution(
+            NAS_SPACE, history, 1, seed=seed,
+            settings={"population": 12, "sample": 12})
+        diffs = sum(1 for p in NAS_SPACE
+                    if a[p["name"]] != history[3]["params"][p["name"]])
+        assert 1 <= diffs <= 2, (diffs, a, history[3]["params"])
+
+
+def test_evolution_via_dispatch():
+    out = alg.suggest_full("nas-evolution", NAS_SPACE, [], 2, seed=1)
+    assert len(out["assignments"]) == 2 and out["pending"] is False
