@@ -185,6 +185,27 @@ inline std::string ValidateSpec(const std::string& kind, const Json& spec) {
     return "";
   }
 
+  if (kind == "TrainedModel") {
+    if (spec.get("inference_service").as_string().empty()) {
+      return "inference_service (the parent InferenceService) is required";
+    }
+    const Json& model = spec.get("model");
+    if (!model.is_object()) return "model is required";
+    const std::string mname = model.get("name").as_string();
+    if (mname.empty()) return "model.name is required";
+    for (char c : mname) {  // the name becomes a URL path segment
+      if (!isalnum(static_cast<unsigned char>(c)) && c != '-' &&
+          c != '_' && c != '.') {
+        return "model.name must be [A-Za-z0-9._-] (it names a repository "
+               "URL path)";
+      }
+    }
+    if (model.get("model_dir").as_string().empty()) {
+      return "model.model_dir is required";
+    }
+    return "";
+  }
+
   // Unknown kinds (Pipeline IR, Trial internals, user resources) pass —
   // the store is schema-free by design, like CRDs without a webhook.
   return "";

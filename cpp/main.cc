@@ -90,6 +90,7 @@ int main(int argc, char** argv) {
   tpk::ServeController serve(&store, &executor, &scheduler, &probe, workdir,
                              python);
   serve.Recover();
+  tpk::TrainedModelController trained(&store, &probe);
   tpk::Server server(&store, &scheduler, &jaxjob, socket_path, workdir,
                      &tune, &pipelines, &serve);
 
@@ -133,6 +134,11 @@ int main(int argc, char** argv) {
       serve.OnDeleted(ev.resource);
     }
   });
+  store.Watch("TrainedModel", [&trained](const tpk::WatchEvent& ev) {
+    if (ev.type == tpk::WatchEvent::Type::kDeleted) {
+      trained.OnDeleted(ev.resource);
+    }
+  });
 
   while (!g_stop) {
     server.PollOnce(50);
@@ -145,6 +151,7 @@ int main(int argc, char** argv) {
     schedule.Tick(now);
     pipelines.Tick(now);
     serve.Tick(now);
+    trained.Tick(now);
     // Tune/pipeline writes (child JAXJob create/delete) need a jaxjob pass
     // before the next poll so child gangs launch/die promptly.
     store.DrainWatches();

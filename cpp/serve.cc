@@ -16,6 +16,7 @@
 #include <cstdio>
 #include <cstring>
 #include <ctime>
+#include <functional>
 
 namespace tpk {
 
@@ -41,8 +42,8 @@ Json AllocToJson(const Allocation& a) {
 // HttpProbe
 // --------------------------------------------------------------------------
 
-bool HttpProbe::Get(int port, const std::string& path, std::string* body,
-                    int* status) {
+bool HttpProbe::Request(int port, const std::string& raw, std::string* body,
+                        int* status) {
   int fd = socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return false;
   fcntl(fd, F_SETFL, fcntl(fd, F_GETFL, 0) | O_NONBLOCK);
@@ -76,7 +77,7 @@ bool HttpProbe::Get(int port, const std::string& path, std::string* body,
       return false;
     }
   }
-  std::string req = "GET " + path + " HTTP/1.0\r\nHost: 127.0.0.1\r\n\r\n";
+  const std::string& req = raw;
   size_t off = 0;
   while (off < req.size()) {
     ssize_t sent = write(fd, req.data() + off, req.size() - off);
@@ -117,10 +118,35 @@ bool HttpProbe::Get(int port, const std::string& path, std::string* body,
   return true;
 }
 
+bool HttpProbe::Get(int port, const std::string& path, std::string* body,
+                    int* status) {
+  return Request(port,
+                 "GET " + path + " HTTP/1.0\r\nHost: 127.0.0.1\r\n\r\n",
+                 body, status);
+}
+
+bool HttpProbe::Post(int port, const std::string& path,
+                     const std::string& payload, int* status) {
+  std::string body;
+  return Request(
+      port,
+      "POST " + path + " HTTP/1.0\r\nHost: 127.0.0.1\r\n"
+      "Content-Type: application/json\r\nContent-Length: " +
+          std::to_string(payload.size()) + "\r\n\r\n" + payload,
+      &body, status);
+}
+
 bool HttpProbe::Ready(int port) {
   std::string body;
   int status = 0;
   return Get(port, "/v2/health/ready", &body, &status) && status == 200;
+}
+
+bool HttpProbe::ModelReady(int port, const std::string& model) {
+  std::string body;
+  int status = 0;
+  return Get(port, "/v2/models/" + model + "/ready", &body, &status) &&
+         status == 200;
 }
 
 bool HttpProbe::Metrics(int port, std::string* body) {
@@ -696,6 +722,191 @@ void ServeController::Recover() {
     status["replicaState"] = Json::Array();
     status["phase"] = "Pending";
     store_->UpdateStatus("InferenceService", res.name, status);
+  }
+}
+
+// -- TrainedModel controller -------------------------------------------------
+
+namespace {
+// Re-post the async load if no readiness after this long (covers a lost
+// POST or a server that failed mid-load and cleared its error on retry).
+constexpr double kLoadRepostSeconds = 60.0;
+}  // namespace
+
+void TrainedModelController::Tick(double now_s) {
+  now_s_ = now_s;
+  for (const auto& res : store_->List("TrainedModel")) Reconcile(res.name);
+}
+
+void TrainedModelController::Reconcile(const std::string& name) {
+  auto r = store_->Get("TrainedModel", name);
+  if (!r) return;
+  const Json& spec = r->spec;
+  Json status = r->status;
+  const std::string parent = spec.get("inference_service").as_string();
+  const Json& model = spec.get("model");
+  const std::string mname = model.get("name").as_string();
+  const std::string mdir = model.get("model_dir").as_string();
+
+  auto update = [&](Json& next) {
+    if (next.dump() != r->status.dump()) {  // WAL writes only on change
+      store_->UpdateStatus("TrainedModel", name, next);
+    }
+  };
+
+  auto isvc = store_->Get("InferenceService", parent);
+  if (!isvc) {
+    status["phase"] = "Pending";
+    status["message"] = "waiting for InferenceService " + parent;
+    status["loaded"] = Json::Object();
+    status["posted"] = Json::Object();
+    update(status);
+    return;
+  }
+
+  // Name collisions silently hijack the parent's (or a sibling's) model in
+  // the shared repository — reject instead (first created wins; creation
+  // order via resource id).
+  if (isvc->spec.get("model").get("name").as_string() == mname) {
+    status["phase"] = "Failed";
+    status["message"] = "model.name " + mname +
+                        " collides with the parent's base model";
+    update(status);
+    return;
+  }
+  for (const auto& other : store_->List("TrainedModel")) {
+    if (other.name == name) continue;
+    if (other.spec.get("inference_service").as_string() == parent &&
+        other.spec.get("model").get("name").as_string() == mname &&
+        other.name < name) {  // deterministic winner (no creation ts kept)
+      status["phase"] = "Failed";
+      status["message"] = "model.name " + mname +
+                          " collides with TrainedModel " + other.name;
+      update(status);
+      return;
+    }
+  }
+
+  // Rename: unload the previous name everywhere before loading the new
+  // one, or the old model lingers in every replica's repository.
+  const std::string prev = status.get("modelName").as_string();
+  const Json& replicas = isvc->status.get("replicaState");
+  if (!prev.empty() && prev != mname && replicas.is_array()) {
+    for (const auto& rs : replicas.elements()) {
+      if (!rs.is_object() || !rs.get("ready").as_bool(false)) continue;
+      int http = 0;
+      probe_->Post(static_cast<int>(rs.get("port").as_int()),
+                   "/v2/repository/models/" + prev + "/unload", "{}",
+                   &http);
+    }
+    status["loaded"] = Json::Object();
+    status["posted"] = Json::Object();
+  }
+  status["modelName"] = mname;
+
+  // Per-replica load state, keyed port:pid:spec-digest: a restarted
+  // replica (new pid) re-loads, and a model_dir/name change (new digest)
+  // re-loads on live replicas. Keys survive readiness blips — they are
+  // pruned only when the replica itself is gone.
+  const std::string digest =
+      std::to_string(std::hash<std::string>{}(mname + "|" + mdir));
+  const Json loaded_old = status.get("loaded").is_object()
+                              ? status.get("loaded")
+                              : Json::Object();
+  const Json posted_old = status.get("posted").is_object()
+                              ? status.get("posted")
+                              : Json::Object();
+  Json loaded = Json::Object();
+  Json posted = Json::Object();
+  int ready_n = 0, loaded_n = 0;
+  if (replicas.is_array()) {
+    Json payload = Json::Object();
+    payload["model_dir"] = mdir;
+    const std::string body = payload.dump();
+    for (const auto& rs : replicas.elements()) {
+      if (!rs.is_object()) continue;
+      const int port = static_cast<int>(rs.get("port").as_int());
+      const std::string key = std::to_string(port) + ":" +
+                              std::to_string(rs.get("pid").as_int(-1)) +
+                              ":" + digest;
+      const bool was_loaded = loaded_old.get(key).as_bool(false);
+      if (!rs.get("ready").as_bool(false)) {
+        // Blip tolerance: a known-loaded replica that is momentarily
+        // unready keeps its state — reloading a server that still has
+        // the model would recompile for nothing.
+        if (was_loaded) loaded[key] = true;
+        continue;
+      }
+      ready_n++;
+      if (was_loaded) {
+        loaded[key] = true;
+        loaded_n++;
+        continue;
+      }
+      const double since = posted_old.get(key).as_number(0);
+      // Readiness only counts AFTER we posted for this key: on a
+      // model_dir change the server's previous version still answers
+      // ready, and trusting it would skip the re-load entirely. (During
+      // a version swap the old model serves until the new load lands —
+      // readiness is optimistic for that window, by design.)
+      if (since > 0 && probe_->ModelReady(port, mname)) {
+        loaded[key] = true;
+        loaded_n++;
+        metrics_.loads++;
+        continue;
+      }
+      if (since > 0 && now_s_ - since < kLoadRepostSeconds) {
+        posted[key] = since;  // in flight; poll again next tick
+        continue;
+      }
+      int http = 0;
+      if (probe_->Post(port, "/v2/repository/models/" + mname + "/load",
+                       body, &http) &&
+          (http == 200 || http == 202)) {
+        posted[key] = now_s_;
+      } else {
+        metrics_.load_failures++;  // retried next Tick
+      }
+    }
+  }
+  status["loaded"] = loaded;
+  status["posted"] = posted;
+  Json counts = Json::Object();
+  counts["ready"] = ready_n;
+  counts["loaded"] = loaded_n;
+  status["replicas"] = counts;
+  if (ready_n == 0) {
+    status["phase"] = "Pending";
+    status["message"] = "no ready replicas on " + parent;
+  } else if (loaded_n == ready_n) {
+    status["phase"] = "Ready";
+    status["message"] = "";
+  } else {
+    status["phase"] = "Pending";
+    status["message"] = "loading (" + std::to_string(loaded_n) + "/" +
+                        std::to_string(ready_n) + " replicas)";
+  }
+  update(status);
+}
+
+void TrainedModelController::OnDeleted(const Resource& res) {
+  // Best-effort unload from every replica that had it (the server marks
+  // the model UNAVAILABLE; a vanished replica is already clean).
+  const std::string parent = res.spec.get("inference_service").as_string();
+  const std::string mname = res.spec.get("model").get("name").as_string();
+  auto isvc = store_->Get("InferenceService", parent);
+  if (!isvc || mname.empty()) return;
+  const Json& replicas = isvc->status.get("replicaState");
+  if (!replicas.is_array()) return;
+  for (const auto& rs : replicas.elements()) {
+    if (!rs.is_object() || !rs.get("ready").as_bool(false)) continue;
+    int http = 0;
+    if (probe_->Post(static_cast<int>(rs.get("port").as_int()),
+                     "/v2/repository/models/" + mname + "/unload", "{}",
+                     &http) &&
+        http / 100 == 2) {
+      metrics_.unloads++;
+    }
   }
 }
 

@@ -25,6 +25,8 @@
 #include <map>
 #include <set>
 #include <string>
+#include <utility>
+#include <vector>
 
 #include "executor.h"
 #include "json.h"
@@ -40,6 +42,14 @@ class ProbeInterface {
   virtual bool Ready(int port) = 0;
   // Fetches /metrics; returns false if unreachable.
   virtual bool Metrics(int port, std::string* body) = 0;
+  // JSON POST to a replica's control surface (repository load/unload —
+  // the TrainedModel data path). Returns false if unreachable; *status
+  // carries the HTTP code when reachable.
+  virtual bool Post(int port, const std::string& path,
+                    const std::string& payload, int* status) = 0;
+  // Per-model readiness (GET /v2/models/{model}/ready == 200) — how the
+  // TrainedModel controller observes an async repository load landing.
+  virtual bool ModelReady(int port, const std::string& model) = 0;
 };
 
 // Blocking-with-deadline HTTP/1.0 GET against 127.0.0.1 (the model servers
@@ -49,10 +59,15 @@ class HttpProbe : public ProbeInterface {
   explicit HttpProbe(int timeout_ms = 1500) : timeout_ms_(timeout_ms) {}
   bool Ready(int port) override;
   bool Metrics(int port, std::string* body) override;
+  bool Post(int port, const std::string& path, const std::string& payload,
+            int* status) override;
+  bool ModelReady(int port, const std::string& model) override;
 
  private:
   bool Get(int port, const std::string& path, std::string* body,
            int* status);
+  bool Request(int port, const std::string& raw, std::string* body,
+               int* status);
   int timeout_ms_;
 };
 
@@ -65,8 +80,27 @@ class FakeProbe : public ProbeInterface {
     *body = it->second;
     return true;
   }
+  bool Post(int port, const std::string& path, const std::string& payload,
+            int* status) override {
+    posts.push_back({port, path, payload});
+    if (post_unreachable.count(port)) return false;
+    *status = post_status;
+    return true;
+  }
+  bool ModelReady(int port, const std::string& model) override {
+    return model_ready.count({port, model}) > 0;
+  }
   std::set<int> ready;
   std::map<int, std::string> metrics;
+  struct PostRecord {
+    int port;
+    std::string path;
+    std::string payload;
+  };
+  std::vector<PostRecord> posts;
+  std::set<int> post_unreachable;
+  int post_status = 202;  // async repository load answers 202 LOADING
+  std::set<std::pair<int, std::string>> model_ready;
 };
 
 struct ServeMetrics {
@@ -126,6 +160,49 @@ class ServeController {
   std::string workdir_;
   std::string python_;
   ServeMetrics metrics_;
+  double now_s_ = 0;
+};
+
+// TrainedModel controller — multi-model serving (⟨kserve: pkg/apis/serving/
+// v1alpha1 — TrainedModel⟩ + the agent model puller, SURVEY.md §2.2): a
+// lightweight model CR attaches to a RUNNING InferenceService instead of
+// deploying its own replicas. The controller pushes repository load calls
+// (POST /v2/repository/models/{name}/load with the model dir) to every
+// ready replica of the parent, tracks per-replica load state keyed by
+// port:pid (a restarted replica re-loads automatically), and unloads on
+// delete.
+//
+// Spec: {"inference_service": "parent", "model": {"name": "m",
+//        "model_dir": "/bundle"}}
+struct TrainedModelMetrics {
+  int64_t loads = 0;
+  int64_t unloads = 0;
+  int64_t load_failures = 0;
+
+  Json ToJson() const {
+    Json j = Json::Object();
+    j["loads"] = loads;
+    j["unloads"] = unloads;
+    j["load_failures"] = load_failures;
+    return j;
+  }
+};
+
+class TrainedModelController {
+ public:
+  TrainedModelController(Store* store, ProbeInterface* probe)
+      : store_(store), probe_(probe) {}
+
+  void Tick(double now_s);
+  void Reconcile(const std::string& name);
+  void OnDeleted(const Resource& res);
+
+  TrainedModelMetrics& metrics() { return metrics_; }
+
+ private:
+  Store* store_;
+  ProbeInterface* probe_;
+  TrainedModelMetrics metrics_;
   double now_s_ = 0;
 };
 

@@ -16,6 +16,7 @@ using tpk::Json;
 using tpk::Scheduler;
 using tpk::ServeController;
 using tpk::Store;
+using tpk::TrainedModelController;
 
 #define CHECK(cond)                                                   \
   do {                                                                \
@@ -308,6 +309,163 @@ int main() {
     CHECK(r->status.get("replicaState").elements()[0].get("pendingReason")
               .as_string().find("capacity") != std::string::npos);
     CHECK(h.exec.launched.empty());
+  }
+
+  // --- TrainedModel: load pushed to ready replicas, re-load on restart --
+  {
+    Harness h;
+    TrainedModelController tm(&h.store, &h.probe);
+
+    Json spec = Json::Object();
+    Json model = Json::Object();
+    model["name"] = "extra";
+    model["model_dir"] = "/bundles/extra";
+    spec["inference_service"] = "parent";
+    spec["model"] = model;
+    h.store.Create("TrainedModel", "tm1", spec);
+
+    // No parent yet: Pending, no posts.
+    tm.Tick(h.now);
+    auto r = h.store.Get("TrainedModel", "tm1");
+    CHECK(r->status.get("phase").as_string() == "Pending");
+    CHECK(h.probe.posts.empty());
+
+    // Parent with two replicas, one ready.
+    Json pspec = Json::Object();
+    Json pmodel = Json::Object();
+    pmodel["name"] = "base";
+    pmodel["model_dir"] = "/bundles/base";
+    pspec["model"] = pmodel;
+    h.store.Create("InferenceService", "parent", pspec);
+    Json pstatus = Json::Object();
+    Json reps = Json::Array();
+    Json r0 = Json::Object();
+    r0["port"] = 9001;
+    r0["pid"] = 111;
+    r0["ready"] = true;
+    Json r1 = Json::Object();
+    r1["port"] = 9002;
+    r1["pid"] = 112;
+    r1["ready"] = false;
+    reps.push_back(r0);
+    reps.push_back(r1);
+    pstatus["replicaState"] = reps;
+    h.store.UpdateStatus("InferenceService", "parent", pstatus);
+
+    h.probe.posts.clear();
+    tm.Tick(h.now);
+    r = h.store.Get("TrainedModel", "tm1");
+    // Async protocol: load POSTed (202), not yet ready → Pending.
+    CHECK(r->status.get("phase").as_string() == "Pending");
+    CHECK(h.probe.posts.size() == 1);
+    CHECK(h.probe.posts[0].port == 9001);
+    CHECK(h.probe.posts[0].path == "/v2/repository/models/extra/load");
+    CHECK(h.probe.posts[0].payload.find("/bundles/extra") !=
+          std::string::npos);
+
+    // In flight: a second tick does NOT re-post (60s repost window).
+    tm.Tick(h.now);
+    CHECK(h.probe.posts.size() == 1);
+
+    // The async load lands (model readiness turns 200) → Ready.
+    h.probe.model_ready.insert({9001, "extra"});
+    tm.Tick(h.now);
+    r = h.store.Get("TrainedModel", "tm1");
+    CHECK(r->status.get("phase").as_string() == "Ready");
+    CHECK(h.probe.posts.size() == 1);
+
+    // Readiness blip: replica goes unready and back — NO reload.
+    r0["ready"] = false;
+    reps = Json::Array();
+    reps.push_back(r0);
+    reps.push_back(r1);
+    pstatus["replicaState"] = reps;
+    h.store.UpdateStatus("InferenceService", "parent", pstatus);
+    tm.Tick(h.now);
+    r0["ready"] = true;
+    reps = Json::Array();
+    reps.push_back(r0);
+    reps.push_back(r1);
+    pstatus["replicaState"] = reps;
+    h.store.UpdateStatus("InferenceService", "parent", pstatus);
+    tm.Tick(h.now);
+    r = h.store.Get("TrainedModel", "tm1");
+    CHECK(r->status.get("phase").as_string() == "Ready");
+    CHECK(h.probe.posts.size() == 1);  // state kept across the blip
+
+    // Replica restart (same port, new pid) → re-load (fresh server lost
+    // the model; its readiness probe is cleared too).
+    h.probe.model_ready.clear();
+    r0["pid"] = 222;
+    reps = Json::Array();
+    reps.push_back(r0);
+    reps.push_back(r1);
+    pstatus["replicaState"] = reps;
+    h.store.UpdateStatus("InferenceService", "parent", pstatus);
+    tm.Tick(h.now);
+    CHECK(h.probe.posts.size() == 2);
+    h.probe.model_ready.insert({9001, "extra"});
+    tm.Tick(h.now);
+    r = h.store.Get("TrainedModel", "tm1");
+    CHECK(r->status.get("phase").as_string() == "Ready");
+
+    // Second replica becomes ready → loads there; unreachable retries.
+    r1["ready"] = true;
+    reps = Json::Array();
+    reps.push_back(r0);
+    reps.push_back(r1);
+    pstatus["replicaState"] = reps;
+    h.store.UpdateStatus("InferenceService", "parent", pstatus);
+    h.probe.post_unreachable.insert(9002);
+    tm.Tick(h.now);
+    r = h.store.Get("TrainedModel", "tm1");
+    CHECK(r->status.get("phase").as_string() == "Pending");  // 1/2 loaded
+    CHECK(tm.metrics().load_failures >= 1);
+    h.probe.post_unreachable.clear();
+    h.probe.model_ready.insert({9002, "extra"});
+    tm.Tick(h.now);  // posts the load
+    tm.Tick(h.now);  // observes readiness
+    r = h.store.Get("TrainedModel", "tm1");
+    CHECK(r->status.get("phase").as_string() == "Ready");
+    CHECK(r->status.get("replicas").get("loaded").as_int(0) == 2);
+
+    // model_dir change (spec update) → digest changes → re-load on live
+    // replicas, not silent staleness.
+    h.probe.posts.clear();
+    h.probe.model_ready.clear();
+    Json spec2 = h.store.Get("TrainedModel", "tm1")->spec;
+    spec2["model"]["model_dir"] = "/bundles/extra-v2";
+    h.store.UpdateSpec("TrainedModel", "tm1", spec2);
+    tm.Tick(h.now);
+    r = h.store.Get("TrainedModel", "tm1");
+    CHECK(r->status.get("phase").as_string() == "Pending");
+    CHECK(h.probe.posts.size() == 2);  // both replicas reload
+    CHECK(h.probe.posts[0].payload.find("extra-v2") != std::string::npos);
+
+    // Collision with the parent's base model name → Failed, no posts.
+    Json cspec = Json::Object();
+    Json cmodel = Json::Object();
+    cmodel["name"] = "base";
+    cmodel["model_dir"] = "/bundles/x";
+    cspec["inference_service"] = "parent";
+    cspec["model"] = cmodel;
+    h.store.Create("TrainedModel", "clash", cspec);
+    h.probe.posts.clear();
+    tm.Tick(h.now);
+    CHECK(h.store.Get("TrainedModel", "clash")->status.get("phase")
+              .as_string() == "Failed");
+    CHECK(h.probe.posts.empty() ||
+          h.probe.posts[0].path.find("/base/") == std::string::npos);
+    h.store.Delete("TrainedModel", "clash");
+
+    // Delete → unload posted to every ready replica.
+    h.probe.posts.clear();
+    auto res = *h.store.Get("TrainedModel", "tm1");
+    h.store.Delete("TrainedModel", "tm1");
+    tm.OnDeleted(res);
+    CHECK(h.probe.posts.size() == 2);
+    CHECK(h.probe.posts[0].path == "/v2/repository/models/extra/unload");
+    CHECK(tm.metrics().unloads == 2);
   }
 
   printf("test_serve_ctl OK\n");

@@ -45,6 +45,7 @@ class ModelRepository:
         self._models: dict[str, Model] = {}
         self._batchers: dict[str, Batcher] = {}
         self._dirs: dict[str, str] = {}
+        self._loading: dict[str, str | None] = {}  # name -> error | None
         self._lock = threading.Lock()
 
     def register(self, model: Model, *, load: bool = True,
@@ -90,6 +91,36 @@ class ModelRepository:
         model = self.get(name)
         model.load()
         return model
+
+    def load_async(self, name: str, model_dir: str) -> None:
+        """Attach a new model from `model_dir` in a background thread (the
+        TrainedModel path): AOT compiles take seconds, and the control
+        plane's POST must return immediately — the controller polls
+        /v2/models/{name}/ready until the load lands. A load already in
+        flight for the name is not duplicated."""
+        with self._lock:
+            if self._loading.get(name, "") is None:
+                return  # in flight
+            self._loading[name] = None
+
+        def work():
+            try:
+                from kubeflow_tpu.serve import runtimes
+
+                model = runtimes.load_model(model_dir, name=name)
+                self.register(model, model_dir=model_dir)
+                with self._lock:
+                    self._loading.pop(name, None)
+            except Exception as e:  # surfaced via loading_error()
+                with self._lock:
+                    self._loading[name] = f"{type(e).__name__}: {e}"
+
+        threading.Thread(target=work, daemon=True,
+                         name=f"tpk-load-{name}").start()
+
+    def loading_error(self, name: str) -> str | None:
+        with self._lock:
+            return self._loading.get(name)
 
     def unload(self, name: str) -> None:
         model = self.get(name)
@@ -221,6 +252,12 @@ class V2HealthHandler(_Base):
 
 class V2ModelHandler(_Base):
     def get(self, name: str, sub: str = ""):
+        # A failed background load (load_async) answers here so the
+        # controller polling readiness sees the error, not a bare 404.
+        err = self.repo.loading_error(name)
+        if err:
+            raise tornado.web.HTTPError(
+                503, reason=f"model {name!r} failed to load: {err}")
         model = self.repo.get(name)
         if sub == "/ready":
             if not model.ready:
@@ -272,11 +309,33 @@ class V2InferHandler(_Base):
 class RepositoryHandler(_Base):
     def post(self, name: str, verb: str):
         if verb == "load":
+            # A body {"model_dir": ...} attaches a NEW model to this
+            # running server (the TrainedModel / agent model-puller path:
+            # ⟨kserve: pkg/apis/serving/v1alpha1 — TrainedModel⟩). The
+            # load runs in the background (AOT compiles take seconds) —
+            # 202 now, poll /v2/models/{name}/ready. Bodyless load
+            # re-loads a known model synchronously.
+            model_dir = self.body_json().get("model_dir")
+            if model_dir:
+                self.repo.load_async(name, model_dir)
+                self.write_json({"name": name, "state": "LOADING"},
+                                status=202)
+                return
             self.repo.load(name)
         else:
             self.repo.unload(name)
         self.write_json({"name": name, "state":
                          "READY" if verb == "load" else "UNAVAILABLE"})
+
+
+class RepositoryIndexHandler(_Base):
+    def post(self):
+        out = []
+        for name in self.repo.names():
+            m = self.repo.get(name)
+            out.append({"name": name,
+                        "state": "READY" if m.ready else "UNAVAILABLE"})
+        self.write_json(out)
 
 
 class MetricsHandler(_Base):
@@ -384,6 +443,7 @@ class ModelServer:
             (r"/v2/models/([^/]+)/infer", V2InferHandler, kw),
             (r"/v2/repository/models/([^/]+)/(load|unload)",
              RepositoryHandler, kw),
+            (r"/v2/repository/index", RepositoryIndexHandler, kw),
             (r"/v2/models/([^/]+)(/ready)?", V2ModelHandler, kw),
             (r"/metrics", MetricsHandler, kw),
         ])

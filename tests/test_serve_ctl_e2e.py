@@ -302,3 +302,96 @@ def test_grpc_data_plane_via_controller(controlplane):
     finally:
         g.close()
     client.delete("InferenceService", "gclf")
+
+
+def test_trained_model_multi_model_serving(controlplane):
+    """TrainedModel e2e (⟨kserve: v1alpha1 TrainedModel⟩ + agent puller
+    analog): a second model attaches to a RUNNING InferenceService via the
+    repository API, survives a replica restart (auto re-load), and
+    detaches on delete."""
+    from kubeflow_tpu.serve import export_for_serving
+
+    client, workdir, tmp = controlplane
+    base = str(tmp / "base_bundle")
+    extra = str(tmp / "extra_bundle")
+    export_for_serving(base, model="mnist_mlp",
+                       model_kwargs={"in_dim": 16, "hidden": [8],
+                                     "num_classes": 4},
+                       batch_buckets=(1, 4), seed=7)
+    export_for_serving(extra, model="mnist_mlp",
+                       model_kwargs={"in_dim": 8, "hidden": [8],
+                                     "num_classes": 3},
+                       batch_buckets=(1, 4), seed=9)
+
+    client.create("InferenceService", "host", {
+        "model": {"name": "base", "model_dir": base},
+        "replicas": 1, "devices_per_replica": 1, "cpu_devices": 1,
+    })
+    _wait_phase(client, "host", "Ready", timeout=120)
+    url = client.get("InferenceService", "host")["status"]["endpoints"][0][
+        "url"]
+
+    client.create("TrainedModel", "extra", {
+        "inference_service": "host",
+        "model": {"name": "extra", "model_dir": extra},
+    })
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if client.phase("extra", kind="TrainedModel") == "Ready":
+            break
+        time.sleep(0.5)
+    assert client.phase("extra", kind="TrainedModel") == "Ready"
+
+    # Both models answer on the same server.
+    xb = np.random.default_rng(0).normal(size=(2, 16)).astype(np.float32)
+    xe = np.random.default_rng(1).normal(size=(2, 8)).astype(np.float32)
+    out = _post(f"{url}/v1/models/base:predict", {"instances": xb.tolist()})
+    assert np.asarray(out["predictions"]).shape == (2, 4)
+    out = _post(f"{url}/v1/models/extra:predict", {"instances": xe.tolist()})
+    assert np.asarray(out["predictions"]).shape == (2, 3)
+
+    # Replica restart: the controller re-loads the trained model on the
+    # fresh server without user action.
+    pid = client.get("InferenceService", "host")["status"]["replicaState"][
+        0]["pid"]
+    os.kill(pid, 9)
+    # Wait for the REPLACEMENT replica (new pid) to be ready — polling
+    # phase alone races the controller noticing the death and reads the
+    # dead server's stale endpoint.
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        rs = client.get("InferenceService", "host")["status"][
+            "replicaState"][0]
+        if rs.get("pid") not in (None, pid) and rs.get("ready"):
+            break
+        time.sleep(0.3)
+    assert rs["pid"] != pid and rs["ready"], rs
+    deadline = time.time() + 60
+    out = None
+    url = client.get("InferenceService", "host")["status"]["endpoints"][0][
+        "url"]
+    while time.time() < deadline:
+        try:
+            out = _post(f"{url}/v1/models/extra:predict",
+                        {"instances": xe.tolist()})
+            break
+        except Exception:
+            time.sleep(0.5)
+    assert out is not None and np.asarray(out["predictions"]).shape == (2, 3)
+
+    # Delete the TrainedModel → unloaded (503/unavailable), base unaffected.
+    client.delete("TrainedModel", "extra")
+    deadline = time.time() + 30
+    unloaded = False
+    while time.time() < deadline:
+        try:
+            _post(f"{url}/v1/models/extra:predict",
+                  {"instances": xe.tolist()})
+        except Exception:
+            unloaded = True
+            break
+        time.sleep(0.3)
+    assert unloaded
+    out = _post(f"{url}/v1/models/base:predict", {"instances": xb.tolist()})
+    assert np.asarray(out["predictions"]).shape == (2, 4)
+    client.delete("InferenceService", "host")
