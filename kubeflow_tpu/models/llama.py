@@ -183,7 +183,8 @@ class Attention(nn.Module):
     @nn.compact
     def __call__(self, x, cos, sin, positions, ring_axis: str | None = None,
                  standard_positions: bool = True, cache: dict | None = None,
-                 cache_index: jax.Array | None = None):
+                 cache_index: jax.Array | None = None,
+                 segment_ids: jax.Array | None = None):
         cfg = self.cfg
         dense = partial(
             nn.DenseGeneral, use_bias=False, dtype=cfg.dtype,
@@ -233,17 +234,23 @@ class Attention(nn.Module):
         if impl == "auto":
             if ring_axis is not None:
                 impl = "ring"
-            elif (standard_positions
+            elif ((standard_positions or segment_ids is not None)
                   and jax.default_backend() in ("tpu", "axon")):
                 impl = "flash"
             else:
                 impl = "naive"
-        if impl == "flash" and not standard_positions:
+        if impl == "flash" and not standard_positions and segment_ids is None:
             # The flash kernel masks causality by array index; custom
-            # positions (packed/offset sequences) need position-aware masks.
+            # positions (packed/offset sequences) need the segment mask
+            # (pass segment_ids) or a position-aware impl.
             raise ValueError(
-                "attention_impl='flash' does not support custom positions; "
-                "use 'naive' or 'ring'")
+                "attention_impl='flash' with custom positions needs "
+                "segment_ids (packed sequences); use 'naive' or 'ring' "
+                "otherwise")
+        if segment_ids is not None and impl not in ("flash", "naive"):
+            raise ValueError(
+                f"segment_ids (packed sequences) need attention_impl "
+                f"'flash' or 'naive', not {impl!r}")
         if impl in ("ring", "ring_flash"):
             from kubeflow_tpu.ops.ring_attention import ring_attention
             if impl == "ring_flash":
@@ -280,10 +287,12 @@ class Attention(nn.Module):
             from kubeflow_tpu.ops.flash_attention import flash_attention
             out = flash_attention(q, k, v, causal=True,
                                   block_q=cfg.flash_block_q,
-                                  block_kv=cfg.flash_block_kv)
+                                  block_kv=cfg.flash_block_kv,
+                                  segment_ids=segment_ids)
         else:
             out = naive_attention(q, k, v, causal=True, positions_q=positions,
-                                  positions_kv=positions)
+                                  positions_kv=positions,
+                                  segment_ids=segment_ids)
         out = dense(features=cfg.hidden_size, axis=(-2, -1),
                     kernel_init=nn.with_logical_partitioning(
                         nn.initializers.lecun_normal(), ("heads", "kv", "embed")),
@@ -321,12 +330,13 @@ class DecoderLayer(nn.Module):
 
     @nn.compact
     def __call__(self, x, cos, sin, positions, ring_axis=None,
-                 standard_positions=True, cache=None, cache_index=None):
+                 standard_positions=True, cache=None, cache_index=None,
+                 segment_ids=None):
         cfg = self.cfg
         h = RMSNorm(cfg.rms_eps, cfg.dtype, name="input_norm")(x)
         attn_out, new_cache = Attention(cfg, name="attn")(
             h, cos, sin, positions, ring_axis, standard_positions, cache,
-            cache_index)
+            cache_index, segment_ids)
         # Remat landmark: policy "save_attn" keeps this tensor so the
         # backward skips re-running the attention kernel (small residual:
         # [B,S,H·D] bf16 per layer vs the full block internals).
@@ -349,12 +359,16 @@ class Llama(nn.Module):
     def __call__(self, tokens: jax.Array, positions: jax.Array | None = None,
                  ring_axis: str | None = None, cache: dict | None = None,
                  cache_index: jax.Array | None = None,
-                 return_hidden: bool = False):
+                 return_hidden: bool = False,
+                 segment_ids: jax.Array | None = None):
         """Returns logits [B,S,V]; with `cache` (see init_cache) returns
         (logits, updated_cache) — prefill when S>1 (cache_index must be 0),
         single-token decode when S==1 (positions default to cache_index).
         `return_hidden` skips the unembedding and returns the post-norm
-        hidden states [B,S,H] (chunked-CE training path)."""
+        hidden states [B,S,H] (chunked-CE training path). `segment_ids`
+        [B,S] enables packed-sequence training: attention is confined
+        within equal-id spans (pass the matching per-segment restarting
+        `positions` for RoPE)."""
         cfg = self.cfg
         if cache is not None:
             if cache_index is None:
@@ -399,7 +413,8 @@ class Llama(nn.Module):
             x, new_cache = nn.scan(
                 lambda mdl, carry, layer_cache: mdl(
                     carry, cos, sin, positions, ring_axis,
-                    standard_positions, layer_cache, cache_index),
+                    standard_positions, layer_cache, cache_index,
+                    segment_ids),
                 variable_axes={"params": 0, "aux_loss": 0},
                 split_rngs={"params": True},
                 length=cfg.num_layers,
@@ -412,7 +427,7 @@ class Llama(nn.Module):
                     lambda c: c[i], cache)
                 x, lc = layer_cls(cfg, self.mlp_cls, name=f"layer_{i}")(
                     x, cos, sin, positions, ring_axis, standard_positions,
-                    layer_cache, cache_index)
+                    layer_cache, cache_index, segment_ids)
                 layer_caches.append(lc)
             if cache is not None:
                 new_cache = jax.tree.map(

@@ -36,9 +36,13 @@ from jax.experimental.pallas import tpu as pltpu  # noqa: F401  (TPU lowering)
 NEG_INF = -1e30
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, *rest, block_q: int,
                       block_kv: int, seq_kv: int, causal: bool,
-                      sm_scale: float):
+                      sm_scale: float, segments: bool = False):
+    if segments:
+        qs_ref, ks_ref, o_ref, lse_ref = rest
+    else:
+        o_ref, lse_ref = rest
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * sm_scale  # [block_q, D]
 
@@ -66,6 +70,12 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
             rows = jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 0) + qi * block_q
             valid = jnp.logical_and(valid, rows >= cols)
+        if segments:
+            # Packed sequences: attention confined within equal-id spans
+            # (padding carries -1 on the kv side, never equal to real ids).
+            qseg = qs_ref[0, :, 0][:, None]
+            kseg = ks_ref[0, pl.ds(j * block_kv, block_kv), 0][None, :]
+            valid = jnp.logical_and(valid, qseg == kseg)
         s = jnp.where(valid, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -86,26 +96,38 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
     lse_ref[0] = m + jnp.log(jnp.maximum(l, 1e-30))
 
 
-def _flash_fwd(q3, k3, v3, *, group: int, causal: bool, block_q: int,
-               block_kv: int, seq_kv: int, sm_scale: float, interpret: bool):
+def _flash_fwd(q3, k3, v3, seg_q3, seg_kv3, *, group: int, heads: int,
+               causal: bool, block_q: int, block_kv: int, seq_kv: int,
+               sm_scale: float, interpret: bool):
     """q3 [B*H, S, D]; k3/v3 [B*KH, T, D], padded to block multiples; GQA is
     served zero-copy by the K/V index_map (q program bh reads kv row
     bh // group, since bh = batch*H + qh and H = KH*group). seq_kv is the
-    pre-padding key length used for masking. Returns (o3, lse [B*H, S])."""
+    pre-padding key length used for masking. seg_q3/seg_kv3 [B, *, 1] (or
+    None) carry packed-sequence segment ids, read zero-copy per batch row
+    via b // heads index_maps. Returns (o3, lse [B*H, S])."""
     bh, s, d = q3.shape
     t = k3.shape[1]
     grid = (bh, pl.cdiv(s, block_q))
+    segments = seg_q3 is not None
     kernel = functools.partial(
         _flash_fwd_kernel, block_q=block_q, block_kv=block_kv, seq_kv=seq_kv,
-        causal=causal, sm_scale=sm_scale)
+        causal=causal, sm_scale=sm_scale, segments=segments)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        pl.BlockSpec((1, t, d), lambda b, i: (b // group, 0, 0)),
+        pl.BlockSpec((1, t, d), lambda b, i: (b // group, 0, 0)),
+    ]
+    args = [q3, k3, v3]
+    if segments:
+        in_specs += [
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b // heads, i, 0)),
+            pl.BlockSpec((1, t, 1), lambda b, i: (b // heads, 0, 0)),
+        ]
+        args += [seg_q3, seg_kv3]
     return pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, t, d), lambda b, i: (b // group, 0, 0)),
-            pl.BlockSpec((1, t, d), lambda b, i: (b // group, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
@@ -115,12 +137,17 @@ def _flash_fwd(q3, k3, v3, *, group: int, causal: bool, block_q: int,
             jax.ShapeDtypeStruct((bh, s, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(q3, k3, v3)
+    )(*args)
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dq_ref, *, block_q: int, block_kv: int, seq_q: int,
-                         seq_kv: int, causal: bool, sm_scale: float):
+                         *rest, block_q: int, block_kv: int, seq_q: int,
+                         seq_kv: int, causal: bool, sm_scale: float,
+                         segments: bool = False):
+    if segments:
+        qs_ref, ks_ref, dq_ref = rest
+    else:
+        (dq_ref,) = rest
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * sm_scale      # [bq, D]
     do = do_ref[0].astype(jnp.float32)               # [bq, D]
@@ -147,6 +174,11 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         valid = jnp.logical_and(cols < seq_kv, rows < seq_q)
         if causal:
             valid = jnp.logical_and(valid, rows >= cols)
+        if segments:
+            valid = jnp.logical_and(
+                valid,
+                qs_ref[0, :, 0][:, None]
+                == ks_ref[0, pl.ds(j * block_kv, block_kv), 0][None, :])
         # p from saved row stats; masked (incl. padded q rows, whose lse is
         # garbage) to exactly zero so no NaN/inf leaks into the matmuls.
         p = jnp.where(valid, jnp.exp(s - lse), 0.0)
@@ -165,9 +197,14 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_dkv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
-                          dk_ref, dv_ref, *, block_q: int, block_kv: int,
+                          *rest, block_q: int, block_kv: int,
                           seq_q: int, seq_kv: int, seq_q_pad: int, group: int,
-                          causal: bool, sm_scale: float):
+                          causal: bool, sm_scale: float,
+                          segments: bool = False):
+    if segments:
+        qs_ref, ks_ref, dk_ref, dv_ref = rest
+    else:
+        dk_ref, dv_ref = rest
     j = pl.program_id(1)
     k = k_ref[0].astype(jnp.float32)                 # [bkv, D]
     v = v_ref[0].astype(jnp.float32)
@@ -204,6 +241,11 @@ def _flash_bwd_dkv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
             valid = jnp.logical_and(kv_valid, rows < seq_q)
             if causal:
                 valid = jnp.logical_and(valid, rows >= cols)
+            if segments:
+                valid = jnp.logical_and(
+                    valid,
+                    qs_ref[0, pl.ds(qi * block_q, block_q), 0][:, None]
+                    == ks_ref[0, :, 0][None, :])
             p = jnp.where(valid, jnp.exp(s - lse), 0.0)
             dv_new = dv + jax.lax.dot_general(
                 p, do, (((0,), (0,)), ((), ())),
@@ -249,12 +291,18 @@ def _pad_seq(x3, block):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q, k, v, causal: bool = True, block_q: int = 512,
-                    block_kv: int = 512, interpret: bool | None = None):
+                    block_kv: int = 512, interpret: bool | None = None,
+                    segment_ids: jax.Array | None = None):
     """Flash attention. q [B,S,H,D]; k,v [B,T,KH,D]; returns [B,S,H,D].
 
     Forward and backward both run fused Pallas kernels (O(S) memory); the
-    backward uses the saved LSE row stats (two-pass dq then dk/dv)."""
-    out, _ = _attn_impl(q, k, v, causal, block_q, block_kv, interpret)
+    backward uses the saved LSE row stats (two-pass dq then dk/dv).
+
+    `segment_ids` [B,S] int (self-attention only) confines attention
+    within equal-id spans — packed-sequence training with the fused
+    kernels (the splash-style mask, ops/ROADMAP.md item 3)."""
+    out, _ = _attn_impl(q, k, v, causal, block_q, block_kv, interpret,
+                        segment_ids)
     return out
 
 
@@ -267,7 +315,26 @@ def _resolve(q, k, block_q, block_kv, interpret):
     return block_q, block_kv, interpret
 
 
-def _attn_impl(q, k, v, causal, block_q, block_kv, interpret):
+def _seg3(segment_ids, block, b, s, t):
+    """[B,S] segment ids → padded [B, S_pad, 1]. NOT replicated per head —
+    the BlockSpec index_maps (b // heads) read the shared batch row
+    zero-copy. Trailing unit dim: Mosaic needs the last two block dims to
+    be (8k, 128k)-divisible or array-equal; (block, 1) satisfies that."""
+    if segment_ids is None:
+        return None
+    if segment_ids.shape != (b, s) or t != s:
+        raise ValueError(
+            f"segment_ids must be [B,S]={b, s} for self-attention "
+            f"(got {segment_ids.shape}, T={t})")
+    seg = jnp.asarray(segment_ids, jnp.int32)
+    pad = -seg.shape[1] % block
+    if pad:
+        seg = jnp.pad(seg, ((0, 0), (0, pad)), constant_values=-1)
+    return seg[:, :, None]
+
+
+def _attn_impl(q, k, v, causal, block_q, block_kv, interpret,
+               segment_ids=None):
     b, s, h, d = q.shape
     t, kh = k.shape[1], k.shape[2]
     if h % kh:
@@ -282,27 +349,39 @@ def _attn_impl(q, k, v, causal, block_q, block_kv, interpret):
     q3 = _pad_seq(q3, block_q)
     k3 = _pad_seq(k3, block_kv)
     v3 = _pad_seq(v3, block_kv)
-    o3, lse = _flash_fwd(q3, k3, v3, group=h // kh, causal=causal,
-                         block_q=block_q, block_kv=block_kv, seq_kv=t,
-                         sm_scale=sm_scale, interpret=interpret)
+    sq3 = _seg3(segment_ids, block_q, b, s, t)
+    skv3 = _seg3(segment_ids, block_kv, b, s, t)
+    o3, lse = _flash_fwd(q3, k3, v3, sq3, skv3, group=h // kh, heads=h,
+                         causal=causal, block_q=block_q, block_kv=block_kv,
+                         seq_kv=t, sm_scale=sm_scale, interpret=interpret)
     out = o3[:, :s].reshape(b, h, s, d).transpose(0, 2, 1, 3)
     return out, (o3, lse)
 
 
-def _flash_fwd_rule(q, k, v, causal, block_q, block_kv, interpret):
+def _float0_like(x):
+    """Cotangent for integer-dtype primals (segment ids)."""
+    if x is None:
+        return None
+    import numpy as np
+    return np.zeros(x.shape, dtype=jax.dtypes.float0)
+
+
+def _flash_fwd_rule(q, k, v, causal, block_q, block_kv, interpret,
+                    segment_ids=None):
     out, (o3, lse) = _attn_impl(q, k, v, causal, block_q, block_kv,
-                                interpret)
-    return out, (q, k, v, o3, lse)
+                                interpret, segment_ids)
+    return out, (q, k, v, o3, lse, segment_ids)
 
 
 def _flash_bwd_rule(causal, block_q, block_kv, interpret, res, g):
-    q, k, v, o3, lse = res
-    return _flash_bwd_impl(q, k, v, o3, lse, g, None, causal, block_q,
-                           block_kv, interpret)
+    q, k, v, o3, lse, segment_ids = res
+    dq, dk, dv = _flash_bwd_impl(q, k, v, o3, lse, g, None, causal, block_q,
+                                 block_kv, interpret, segment_ids)
+    return dq, dk, dv, _float0_like(segment_ids)
 
 
 def _flash_bwd_impl(q, k, v, o3, lse, g, g_lse, causal, block_q, block_kv,
-                    interpret):
+                    interpret, segment_ids=None):
     """Shared two-pass backward. `g_lse` [B,S,H,1] (or None) is the LSE
     cotangent: d lse_i/d s_ij = p_ij, so it folds into the delta term —
     ds = p·(dp - (delta - g_lse)) — at zero extra kernel cost."""
@@ -330,24 +409,36 @@ def _flash_bwd_impl(q, k, v, o3, lse, g, g_lse, causal, block_q, block_kv,
                 b * h, s, 1), block_q)
         delta = delta - gl3
 
+    segments = segment_ids is not None
+    sq3 = _seg3(segment_ids, block_q, b, s, t)
+    skv3 = _seg3(segment_ids, block_kv, b, s, t)
+
     dq_kernel = functools.partial(
         _flash_bwd_dq_kernel, block_q=block_q, block_kv=block_kv, seq_q=s,
-        seq_kv=t, causal=causal, sm_scale=sm_scale)
+        seq_kv=t, causal=causal, sm_scale=sm_scale, segments=segments)
+    dq_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bi, i: (bi, i, 0)),
+        pl.BlockSpec((1, t_pad, d), lambda bi, i: (bi // group, 0, 0)),
+        pl.BlockSpec((1, t_pad, d), lambda bi, i: (bi // group, 0, 0)),
+        pl.BlockSpec((1, block_q, d), lambda bi, i: (bi, i, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda bi, i: (bi, i, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda bi, i: (bi, i, 0)),
+    ]
+    dq_args = [q3, k3, v3, do3, lse, delta]
+    if segments:
+        dq_specs += [
+            pl.BlockSpec((1, block_q, 1), lambda bi, i: (bi // h, i, 0)),
+            pl.BlockSpec((1, t_pad, 1), lambda bi, i: (bi // h, 0, 0)),
+        ]
+        dq_args += [sq3, skv3]
     dq3 = pl.pallas_call(
         dq_kernel,
         grid=(bh, s_pad // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bi, i: (bi, i, 0)),
-            pl.BlockSpec((1, t_pad, d), lambda bi, i: (bi // group, 0, 0)),
-            pl.BlockSpec((1, t_pad, d), lambda bi, i: (bi // group, 0, 0)),
-            pl.BlockSpec((1, block_q, d), lambda bi, i: (bi, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda bi, i: (bi, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda bi, i: (bi, i, 0)),
-        ],
+        in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda bi, i: (bi, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, s_pad, d), q.dtype),
         interpret=interpret,
-    )(q3, k3, v3, do3, lse, delta)
+    )(*dq_args)
 
     # Grouped (per kv head) views of the q-side tensors: pure reshapes of the
     # [B*H, ...] layout since q head h serves kv head h // group.
@@ -359,18 +450,26 @@ def _flash_bwd_impl(q, k, v, o3, lse, g, g_lse, causal, block_q, block_kv,
     dkv_kernel = functools.partial(
         _flash_bwd_dkv_kernel, block_q=block_q, block_kv=block_kv, seq_q=s,
         seq_kv=t, seq_q_pad=s_pad, group=group, causal=causal,
-        sm_scale=sm_scale)
+        sm_scale=sm_scale, segments=segments)
+    dkv_specs = [
+        pl.BlockSpec((1, group * s_pad, d), lambda bi, j: (bi, 0, 0)),
+        pl.BlockSpec((1, group * s_pad, d), lambda bi, j: (bi, 0, 0)),
+        pl.BlockSpec((1, group * s_pad, 1), lambda bi, j: (bi, 0, 0)),
+        pl.BlockSpec((1, group * s_pad, 1), lambda bi, j: (bi, 0, 0)),
+        pl.BlockSpec((1, block_kv, d), lambda bi, j: (bi, j, 0)),
+        pl.BlockSpec((1, block_kv, d), lambda bi, j: (bi, j, 0)),
+    ]
+    dkv_args = [qg, dog, lseg, deltag, k3, v3]
+    if segments:
+        dkv_specs += [
+            pl.BlockSpec((1, sq3.shape[1], 1), lambda bi, j: (bi // kh, 0, 0)),
+            pl.BlockSpec((1, block_kv, 1), lambda bi, j: (bi // kh, j, 0)),
+        ]
+        dkv_args += [sq3, skv3]
     dk3, dv3 = pl.pallas_call(
         dkv_kernel,
         grid=(bkh, t_pad // block_kv),
-        in_specs=[
-            pl.BlockSpec((1, group * s_pad, d), lambda bi, j: (bi, 0, 0)),
-            pl.BlockSpec((1, group * s_pad, d), lambda bi, j: (bi, 0, 0)),
-            pl.BlockSpec((1, group * s_pad, 1), lambda bi, j: (bi, 0, 0)),
-            pl.BlockSpec((1, group * s_pad, 1), lambda bi, j: (bi, 0, 0)),
-            pl.BlockSpec((1, block_kv, d), lambda bi, j: (bi, j, 0)),
-            pl.BlockSpec((1, block_kv, d), lambda bi, j: (bi, j, 0)),
-        ],
+        in_specs=dkv_specs,
         out_specs=[
             pl.BlockSpec((1, block_kv, d), lambda bi, j: (bi, j, 0)),
             pl.BlockSpec((1, block_kv, d), lambda bi, j: (bi, j, 0)),
@@ -380,7 +479,7 @@ def _flash_bwd_impl(q, k, v, o3, lse, g, g_lse, causal, block_q, block_kv,
             jax.ShapeDtypeStruct((bkh, t_pad, d), v.dtype),
         ],
         interpret=interpret,
-    )(qg, dog, lseg, deltag, k3, v3)
+    )(*dkv_args)
 
     dq = dq3[:, :s].reshape(b, h, s, d).transpose(0, 2, 1, 3)
     dk = dk3[:, :t].reshape(b, kh, t, d).transpose(0, 2, 1, 3)

@@ -13,10 +13,13 @@ import jax.numpy as jnp
 
 
 def naive_attention(q, k, v, *, causal: bool = True,
-                    positions_q=None, positions_kv=None) -> jax.Array:
+                    positions_q=None, positions_kv=None,
+                    segment_ids=None, segment_ids_kv=None) -> jax.Array:
     """q: [B,S,H,D]; k,v: [B,T,KH,D] with H % KH == 0; fp32 softmax.
     Causality is masked by absolute positions when given (packed/offset
-    sequences), else by array index."""
+    sequences), else by array index. `segment_ids` [B,S] (and optionally a
+    separate kv set) additionally confine attention within equal-id spans
+    — the packed-sequence mask."""
     b, s, h, d = q.shape
     t, kh = k.shape[1], k.shape[2]
     group = h // kh
@@ -28,6 +31,11 @@ def naive_attention(q, k, v, *, causal: bool = True,
         pk = positions_kv if positions_kv is not None else jnp.arange(t)[None]
         mask = pq[:, None, None, :, None] >= pk[:, None, None, None, :]
         scores = jnp.where(mask, scores, -1e30)
+    if segment_ids is not None:
+        sk = segment_ids_kv if segment_ids_kv is not None else segment_ids
+        seg = (segment_ids[:, None, None, :, None]
+               == sk[:, None, None, None, :])
+        scores = jnp.where(seg, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     out = jnp.einsum("bkgst,btkd->bskgd", probs, v)
     return out.reshape(b, s, h, d)

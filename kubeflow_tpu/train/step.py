@@ -171,6 +171,13 @@ def make_train_step(
         # mutable=["aux_loss"]: MoE routers sow load-balance penalties there
         # (models/moe.py); dense models leave it empty.
         kwargs = dict(model_kwargs)
+        # Packed-sequence batches carry their own segment ids and
+        # per-segment restarting positions (models honor both; the fused
+        # kernel masks across segment boundaries).
+        if "segment_ids" in batch:
+            kwargs["segment_ids"] = batch["segment_ids"]
+        if "positions" in batch:
+            kwargs["positions"] = batch["positions"]
         if loss_impl == "chunked":
             kwargs["return_hidden"] = True
         out, mutated = model.apply(
