@@ -787,22 +787,52 @@ void TrainedModelController::Reconcile(const std::string& name) {
     }
   }
 
-  // Rename: unload the previous name everywhere before loading the new
-  // one, or the old model lingers in every replica's repository.
+  // Rename: RETIRE the previous name — unload it from every replica,
+  // retrying across ticks until each current replica acknowledged (a
+  // momentarily-unready replica must not keep the old model forever;
+  // 404 counts as done — that server never had it, e.g. post-restart).
   const std::string prev = status.get("modelName").as_string();
   const Json& replicas = isvc->status.get("replicaState");
-  if (!prev.empty() && prev != mname && replicas.is_array()) {
-    for (const auto& rs : replicas.elements()) {
-      if (!rs.is_object() || !rs.get("ready").as_bool(false)) continue;
-      int http = 0;
-      probe_->Post(static_cast<int>(rs.get("port").as_int()),
-                   "/v2/repository/models/" + prev + "/unload", "{}",
-                   &http);
-    }
+  Json retired = status.get("retired").is_object() ? status.get("retired")
+                                                   : Json::Object();
+  if (!prev.empty() && prev != mname) {
+    if (!retired.has(prev)) retired[prev] = Json::Object();
     status["loaded"] = Json::Object();
     status["posted"] = Json::Object();
   }
   status["modelName"] = mname;
+  if (replicas.is_array()) {
+    Json retired_next = Json::Object();
+    for (const auto& [rn, done0] : retired.items()) {
+      if (rn == mname) continue;  // renamed back: live again, not retired
+      Json done = done0.is_object() ? done0 : Json::Object();
+      bool complete = true;
+      for (const auto& rs : replicas.elements()) {
+        if (!rs.is_object()) continue;
+        const std::string key =
+            std::to_string(rs.get("port").as_int()) + ":" +
+            std::to_string(rs.get("pid").as_int(-1));
+        if (done.get(key).as_bool(false)) continue;
+        if (!rs.get("ready").as_bool(false)) {
+          complete = false;  // retry when it comes back (or vanishes)
+          continue;
+        }
+        int http = 0;
+        if (probe_->Post(static_cast<int>(rs.get("port").as_int()),
+                         "/v2/repository/models/" + rn + "/unload", "{}",
+                         &http) &&
+            (http / 100 == 2 || http == 404)) {
+          done[key] = true;
+          if (http / 100 == 2) metrics_.unloads++;
+        } else {
+          complete = false;
+        }
+      }
+      if (!complete) retired_next[rn] = done;
+    }
+    retired = retired_next;
+  }
+  status["retired"] = retired;
 
   // Per-replica load state, keyed port:pid:spec-digest: a restarted
   // replica (new pid) re-loads, and a model_dir/name change (new digest)

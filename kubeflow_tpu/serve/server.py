@@ -45,7 +45,11 @@ class ModelRepository:
         self._models: dict[str, Model] = {}
         self._batchers: dict[str, Batcher] = {}
         self._dirs: dict[str, str] = {}
-        self._loading: dict[str, str | None] = {}  # name -> error | None
+        self._load_errors: dict[str, str] = {}
+        # Async-load intents: name -> wanted model_dir ("" = unload was
+        # requested mid-load; the worker discards its result).
+        self._want: dict[str, str] = {}
+        self._inflight: set[str] = set()
         self._lock = threading.Lock()
 
     def register(self, model: Model, *, load: bool = True,
@@ -96,35 +100,68 @@ class ModelRepository:
         """Attach a new model from `model_dir` in a background thread (the
         TrainedModel path): AOT compiles take seconds, and the control
         plane's POST must return immediately — the controller polls
-        /v2/models/{name}/ready until the load lands. A load already in
-        flight for the name is not duplicated."""
+        /v2/models/{name}/ready until the load lands. Latest intent wins:
+        a newer model_dir (or an unload) arriving mid-load supersedes the
+        in-flight result instead of being dropped."""
         with self._lock:
-            if self._loading.get(name, "") is None:
-                return  # in flight
-            self._loading[name] = None
+            self._want[name] = model_dir
+            self._load_errors.pop(name, None)
+            if name in self._inflight:
+                return  # the worker re-checks _want when it finishes
+            self._inflight.add(name)
 
         def work():
-            try:
-                from kubeflow_tpu.serve import runtimes
+            from kubeflow_tpu.serve import runtimes
 
-                model = runtimes.load_model(model_dir, name=name)
-                self.register(model, model_dir=model_dir)
+            while True:
                 with self._lock:
-                    self._loading.pop(name, None)
-            except Exception as e:  # surfaced via loading_error()
+                    target = self._want.get(name, "")
+                if not target:  # unloaded (or intent cleared) mid-load
+                    break
+                try:
+                    model = runtimes.load_model(target, name=name)
+                except Exception as e:
+                    with self._lock:
+                        if self._want.get(name, "") == target:
+                            self._load_errors[name] = (
+                                f"{type(e).__name__}: {e}")
+                            break
+                    continue  # intent changed while failing: retry
                 with self._lock:
-                    self._loading[name] = f"{type(e).__name__}: {e}"
+                    superseded = self._want.get(name, "") != target
+                if superseded:
+                    continue  # newer dir (or unload) requested: redo
+                self.register(model, model_dir=target)
+                with self._lock:
+                    want_now = self._want.get(name, "")
+                if want_now == target:
+                    break
+                if not want_now:  # unload arrived during register
+                    self.get(name).unload()
+                    break
+                # newer dir requested: loop to load it
+            with self._lock:
+                self._inflight.discard(name)
 
         threading.Thread(target=work, daemon=True,
                          name=f"tpk-load-{name}").start()
 
     def loading_error(self, name: str) -> str | None:
         with self._lock:
-            return self._loading.get(name)
+            return self._load_errors.get(name)
 
     def unload(self, name: str) -> None:
-        model = self.get(name)
-        model.unload()
+        with self._lock:
+            in_flight = name in self._inflight
+            self._want[name] = ""  # cancels an in-flight load
+            self._load_errors.pop(name, None)
+            known = name in self._models
+        if not known:
+            if in_flight:
+                return  # cancelled before it ever registered
+            raise tornado.web.HTTPError(
+                404, reason=f"model {name!r} not found")
+        self.get(name).unload()
 
     def close(self) -> None:
         for b in self._batchers.values():
@@ -253,11 +290,15 @@ class V2HealthHandler(_Base):
 class V2ModelHandler(_Base):
     def get(self, name: str, sub: str = ""):
         # A failed background load (load_async) answers here so the
-        # controller polling readiness sees the error, not a bare 404.
-        err = self.repo.loading_error(name)
-        if err:
-            raise tornado.web.HTTPError(
-                503, reason=f"model {name!r} failed to load: {err}")
+        # controller polling readiness sees the error, not a bare 404 —
+        # but never at the expense of a live model: if a previous version
+        # is still registered and serving, report ITS state (the failed
+        # re-load surfaces via the controller's repost cycle instead).
+        if name not in self.repo.names():
+            err = self.repo.loading_error(name)
+            if err:
+                raise tornado.web.HTTPError(
+                    503, reason=f"model {name!r} failed to load: {err}")
         model = self.repo.get(name)
         if sub == "/ready":
             if not model.ready:

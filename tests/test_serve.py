@@ -271,3 +271,61 @@ def test_grpc_unknown_model_and_bad_dtype(server):
                                   grpc.StatusCode.INTERNAL)
     finally:
         client.close()
+
+
+def test_repository_async_load_supersede_and_cancel(tmp_path):
+    """load_async lifecycle: latest intent wins (a newer model_dir
+    supersedes an in-flight load) and unload-during-load cancels instead
+    of orphaning the model."""
+    import time
+
+    from kubeflow_tpu.serve.runtimes import export_for_serving
+    from kubeflow_tpu.serve.server import ModelRepository
+
+    d1 = export_for_serving(str(tmp_path / "v1"), model="mnist_mlp",
+                            model_kwargs={"in_dim": 8, "hidden": [4],
+                                          "num_classes": 2},
+                            batch_buckets=(1,), seed=1)
+    d2 = export_for_serving(str(tmp_path / "v2"), model="mnist_mlp",
+                            model_kwargs={"in_dim": 8, "hidden": [4],
+                                          "num_classes": 3},
+                            batch_buckets=(1,), seed=2)
+
+    repo = ModelRepository()
+    # Two rapid intents: only the LAST may win.
+    repo.load_async("m", d1)
+    repo.load_async("m", d2)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if "m" in repo.names() and repo.get("m").ready:
+            x = np.zeros((1, 8), np.float32)
+            if repo.get("m").predict([x])[-1].shape == (1, 3):
+                break
+        time.sleep(0.1)
+    assert repo.get("m").predict([np.zeros((1, 8), np.float32)])[-1].shape \
+        == (1, 3)  # v2 (3 classes) won
+
+    # Cancel: unload while the load is in flight -> never serves.
+    repo2 = ModelRepository()
+    repo2.load_async("x", d1)
+    repo2.unload("x")  # may land before or after registration
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        names = repo2.names()
+        if "x" not in names or not repo2.get("x").ready:
+            break
+        time.sleep(0.1)
+    assert "x" not in repo2.names() or not repo2.get("x").ready
+
+    # Failed load surfaces an error; a live model is never 503'd by it.
+    repo3 = ModelRepository()
+    repo3.load_async("bad", str(tmp_path / "nope"))
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if repo3.loading_error("bad"):
+            break
+        time.sleep(0.1)
+    assert repo3.loading_error("bad")
+    repo3.close()
+    repo.close()
+    repo2.close()
