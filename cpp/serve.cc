@@ -142,11 +142,20 @@ bool HttpProbe::Ready(int port) {
   return Get(port, "/v2/health/ready", &body, &status) && status == 200;
 }
 
-bool HttpProbe::ModelReady(int port, const std::string& model) {
+bool HttpProbe::ModelReady(int port, const std::string& model,
+                           const std::string& want_dir) {
   std::string body;
   int status = 0;
-  return Get(port, "/v2/models/" + model + "/ready", &body, &status) &&
-         status == 200;
+  if (!Get(port, "/v2/models/" + model + "/ready", &body, &status) ||
+      status != 200) {
+    return false;
+  }
+  if (want_dir.empty()) return true;
+  try {
+    return Json::parse(body).get("model_dir").as_string() == want_dir;
+  } catch (const std::exception&) {
+    return false;
+  }
 }
 
 bool HttpProbe::Metrics(int port, std::string* body) {
@@ -879,7 +888,7 @@ void TrainedModelController::Reconcile(const std::string& name) {
       // ready, and trusting it would skip the re-load entirely. (During
       // a version swap the old model serves until the new load lands —
       // readiness is optimistic for that window, by design.)
-      if (since > 0 && probe_->ModelReady(port, mname)) {
+      if (since > 0 && probe_->ModelReady(port, mname, mdir)) {
         loaded[key] = true;
         loaded_n++;
         metrics_.loads++;

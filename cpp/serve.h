@@ -49,7 +49,11 @@ class ProbeInterface {
                     const std::string& payload, int* status) = 0;
   // Per-model readiness (GET /v2/models/{model}/ready == 200) — how the
   // TrainedModel controller observes an async repository load landing.
-  virtual bool ModelReady(int port, const std::string& model) = 0;
+  // Non-empty `want_dir` additionally requires the served model_dir to
+  // match, so an old version still serving does not mask a pending
+  // re-load (version-aware readiness).
+  virtual bool ModelReady(int port, const std::string& model,
+                          const std::string& want_dir = "") = 0;
 };
 
 // Blocking-with-deadline HTTP/1.0 GET against 127.0.0.1 (the model servers
@@ -61,7 +65,8 @@ class HttpProbe : public ProbeInterface {
   bool Metrics(int port, std::string* body) override;
   bool Post(int port, const std::string& path, const std::string& payload,
             int* status) override;
-  bool ModelReady(int port, const std::string& model) override;
+  bool ModelReady(int port, const std::string& model,
+                  const std::string& want_dir = "") override;
 
  private:
   bool Get(int port, const std::string& path, std::string* body,
@@ -87,8 +92,11 @@ class FakeProbe : public ProbeInterface {
     *status = post_status;
     return true;
   }
-  bool ModelReady(int port, const std::string& model) override {
-    return model_ready.count({port, model}) > 0;
+  bool ModelReady(int port, const std::string& model,
+                  const std::string& want_dir = "") override {
+    auto it = model_ready.find({port, model});
+    if (it == model_ready.end()) return false;
+    return want_dir.empty() || it->second == want_dir;
   }
   std::set<int> ready;
   std::map<int, std::string> metrics;
@@ -100,7 +108,8 @@ class FakeProbe : public ProbeInterface {
   std::vector<PostRecord> posts;
   std::set<int> post_unreachable;
   int post_status = 202;  // async repository load answers 202 LOADING
-  std::set<std::pair<int, std::string>> model_ready;
+  // (port, model) -> served model_dir.
+  std::map<std::pair<int, std::string>, std::string> model_ready;
 };
 
 struct ServeMetrics {

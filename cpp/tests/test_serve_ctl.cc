@@ -368,7 +368,7 @@ int main() {
     CHECK(h.probe.posts.size() == 1);
 
     // The async load lands (model readiness turns 200) → Ready.
-    h.probe.model_ready.insert({9001, "extra"});
+    h.probe.model_ready[{9001, "extra"}] = "/bundles/extra";
     tm.Tick(h.now);
     r = h.store.Get("TrainedModel", "tm1");
     CHECK(r->status.get("phase").as_string() == "Ready");
@@ -404,7 +404,7 @@ int main() {
     h.store.UpdateStatus("InferenceService", "parent", pstatus);
     tm.Tick(h.now);
     CHECK(h.probe.posts.size() == 2);
-    h.probe.model_ready.insert({9001, "extra"});
+    h.probe.model_ready[{9001, "extra"}] = "/bundles/extra";
     tm.Tick(h.now);
     r = h.store.Get("TrainedModel", "tm1");
     CHECK(r->status.get("phase").as_string() == "Ready");
@@ -422,7 +422,7 @@ int main() {
     CHECK(r->status.get("phase").as_string() == "Pending");  // 1/2 loaded
     CHECK(tm.metrics().load_failures >= 1);
     h.probe.post_unreachable.clear();
-    h.probe.model_ready.insert({9002, "extra"});
+    h.probe.model_ready[{9002, "extra"}] = "/bundles/extra";
     tm.Tick(h.now);  // posts the load
     tm.Tick(h.now);  // observes readiness
     r = h.store.Get("TrainedModel", "tm1");

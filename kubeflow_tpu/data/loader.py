@@ -67,6 +67,45 @@ def load_tokens(source: Any) -> np.ndarray:
         return np.frombuffer(fh.read(), dtype=np.uint8).astype(np.int32)
 
 
+def _checked_tokens(source: Any, vocab_size: int | None) -> np.ndarray:
+    tokens = load_tokens(source)
+    if vocab_size is not None:
+        # One O(corpus) scan at startup beats training silently on clamped
+        # out-of-vocab ids (embedding take clamps, loss stays finite).
+        lo, hi = int(np.min(tokens)), int(np.max(tokens))
+        if lo < 0 or hi >= vocab_size:
+            raise ValueError(
+                f"corpus token ids span [{lo}, {hi}] but the model vocab "
+                f"is {vocab_size} — wrong tokenizer for this model?")
+    return tokens
+
+
+def _pipeline_tail(rows, *, what: str, batch_size: int, seed: int,
+                   shuffle: bool, num_epochs: int | None,
+                   process_index: int | None, process_count: int | None):
+    """Shared scaffold: source -> per-process shard -> (shuffle) -> repeat
+    -> batch. `iter()` on the result is checkpointable."""
+    import grain.python as gp
+
+    if process_index is None or process_count is None:
+        import jax
+
+        process_index = jax.process_index()
+        process_count = jax.process_count()
+
+    ds = gp.MapDataset.source(rows)
+    if process_count > 1:
+        ds = ds[process_index::process_count]
+    if len(ds) < batch_size:
+        raise ValueError(
+            f"shard has {len(ds)} {what} < batch_size {batch_size}; "
+            f"corpus too small for {process_count} procs")
+    if shuffle:
+        ds = ds.shuffle(seed=seed)
+    ds = ds.repeat(num_epochs)
+    return ds.batch(batch_size, drop_remainder=True)
+
+
 def lm_dataset(
     source: Any,
     *,
@@ -85,36 +124,127 @@ def lm_dataset(
     Returns a `grain.MapDataset`; `iter()` on it yields a checkpointable
     iterator (get_state/set_state). `batch_size` here is the PER-PROCESS
     batch (the trainer passes its `local_batch_size`)."""
-    import grain.python as gp
-
-    if process_index is None or process_count is None:
-        import jax
-
-        process_index = jax.process_index()
-        process_count = jax.process_count()
-
-    tokens = load_tokens(source)
-    if vocab_size is not None:
-        # One O(corpus) scan at startup beats training silently on clamped
-        # out-of-vocab ids (embedding take clamps, loss stays finite).
-        lo, hi = int(np.min(tokens)), int(np.max(tokens))
-        if lo < 0 or hi >= vocab_size:
-            raise ValueError(
-                f"corpus token ids span [{lo}, {hi}] but the model vocab "
-                f"is {vocab_size} — wrong tokenizer for this model?")
-    ds = gp.MapDataset.source(_Windows(tokens, seq_len))
-    if process_count > 1:
-        ds = ds[process_index::process_count]
-    if len(ds) < batch_size:
-        raise ValueError(
-            f"shard has {len(ds)} windows < batch_size {batch_size}; "
-            f"corpus too small for ({process_count} procs, seq_len "
-            f"{seq_len})")
-    if shuffle:
-        ds = ds.shuffle(seed=seed)
-    ds = ds.repeat(num_epochs)
-    ds = ds.batch(batch_size, drop_remainder=True)
+    tokens = _checked_tokens(source, vocab_size)
+    ds = _pipeline_tail(
+        _Windows(tokens, seq_len), what="windows", batch_size=batch_size,
+        seed=seed, shuffle=shuffle, num_epochs=num_epochs,
+        process_index=process_index, process_count=process_count)
     return ds.map(lambda b: {"inputs": b[:, :-1], "targets": b[:, 1:]})
+
+
+class _PackedRows:
+    """Random-access packed rows: each row is seq_len+1 tokens of WHOLE
+    documents (first-fit in corpus order — a document that does not fit
+    the current row's remaining space closes the row with loss-masked
+    padding rather than being split mid-document with restarted
+    positions). Only documents longer than a whole row are chunked, each
+    chunk its own segment. Stored as per-row span lists into the
+    (memmapped) corpus — O(docs) memory, not O(corpus). Padding spans are
+    (start=-1, len); their tokens are eos, their segment id is -1, and
+    their targets are masked."""
+
+    def __init__(self, tokens: np.ndarray, seq_len: int, eos_id: int):
+        self._tokens = tokens
+        self._seq = int(seq_len)
+        self._eos = int(eos_id)
+        row_cap = self._seq + 1
+        # Document spans (start, length), eos kept as the doc's last token.
+        ends = np.flatnonzero(np.asarray(tokens) == eos_id)
+        starts = np.concatenate([[0], ends + 1])
+        stops = np.concatenate([ends + 1, [len(tokens)]])
+        self._rows: list[list[tuple[int, int]]] = []
+        cur: list[tuple[int, int]] = []
+        used = 0
+
+        def close_row():
+            nonlocal cur, used
+            if used and row_cap - used:
+                cur.append((-1, row_cap - used))  # pad span
+            if used:
+                self._rows.append(cur)
+            cur, used = [], 0
+
+        for st, sp in zip(starts, stops):
+            ln = int(sp - st)
+            if ln == 0:
+                continue
+            if ln <= row_cap:  # whole-document placement
+                if ln > row_cap - used:
+                    close_row()
+                cur.append((int(st), ln))
+                used += ln
+            else:  # over-long doc: chunk across dedicated rows
+                close_row()
+                off = 0
+                while ln > 0:
+                    piece = min(ln, row_cap)
+                    cur.append((int(st + off), piece))
+                    used += piece
+                    off += piece
+                    ln -= piece
+                    if used == row_cap:
+                        close_row()
+            if used == row_cap:
+                close_row()
+        close_row()
+        if not self._rows:
+            raise ValueError(
+                f"corpus has no packed row of {row_cap} tokens")
+
+    def __len__(self) -> int:
+        return len(self._rows)
+
+    def __getitem__(self, i: int) -> dict:
+        row_cap = self._seq + 1
+        toks = np.empty((row_cap,), np.int32)
+        segs = np.empty((row_cap,), np.int32)
+        pos = np.empty((row_cap,), np.int32)
+        o = 0
+        for si, (st, ln) in enumerate(self._rows[int(i)]):
+            if st < 0:  # pad span: eos tokens, segment -1, masked below
+                toks[o:o + ln] = self._eos
+                segs[o:o + ln] = -1
+            else:
+                toks[o:o + ln] = self._tokens[st:st + ln]
+                segs[o:o + ln] = si
+            pos[o:o + ln] = np.arange(ln)
+            o += ln
+        return {
+            "inputs": toks[:-1],
+            "targets": toks[1:],
+            "segment_ids": segs[:-1],
+            "positions": pos[:-1],
+            # A target in the NEXT document — or inside padding — is not
+            # this segment's to predict.
+            "mask": ((segs[:-1] == segs[1:]) & (segs[:-1] >= 0)).astype(
+                np.float32),
+        }
+
+
+def packed_lm_dataset(
+    source: Any,
+    *,
+    batch_size: int,
+    seq_len: int,
+    eos_id: int,
+    seed: int = 0,
+    shuffle: bool = True,
+    num_epochs: int | None = None,
+    process_index: int | None = None,
+    process_count: int | None = None,
+    vocab_size: int | None = None,
+):
+    """Document-packed LM pipeline: eos-delimited documents greedy-packed
+    into fixed rows with per-token segment ids, restarting positions, and
+    a cross-document loss mask — the batches the packed-attention path
+    (models + fused kernels honoring `segment_ids`) trains on. Same
+    checkpointable-iterator contract as `lm_dataset`."""
+    tokens = _checked_tokens(source, vocab_size)
+    return _pipeline_tail(
+        _PackedRows(tokens, seq_len, eos_id), what="packed rows",
+        batch_size=batch_size, seed=seed, shuffle=shuffle,
+        num_epochs=num_epochs, process_index=process_index,
+        process_count=process_count)
 
 
 def iterator_state(it: Any) -> Mapping[str, Any] | None:

@@ -116,32 +116,39 @@ class ModelRepository:
             while True:
                 with self._lock:
                     target = self._want.get(name, "")
-                if not target:  # unloaded (or intent cleared) mid-load
-                    break
+                    if not target:  # unloaded / intent cleared mid-load
+                        self._inflight.discard(name)
+                        return
                 try:
                     model = runtimes.load_model(target, name=name)
                 except Exception as e:
+                    # Exit decisions happen under the SAME lock that
+                    # releases _inflight — a concurrent load_async either
+                    # sees us in flight (and we loop on the new intent)
+                    # or sees us gone (and starts its own worker).
                     with self._lock:
                         if self._want.get(name, "") == target:
                             self._load_errors[name] = (
                                 f"{type(e).__name__}: {e}")
-                            break
+                            self._inflight.discard(name)
+                            return
                     continue  # intent changed while failing: retry
                 with self._lock:
-                    superseded = self._want.get(name, "") != target
-                if superseded:
-                    continue  # newer dir (or unload) requested: redo
+                    if self._want.get(name, "") != target:
+                        continue  # newer dir (or unload) requested: redo
                 self.register(model, model_dir=target)
                 with self._lock:
                     want_now = self._want.get(name, "")
-                if want_now == target:
-                    break
+                    if want_now == target:
+                        self._inflight.discard(name)
+                        return
                 if not want_now:  # unload arrived during register
                     self.get(name).unload()
-                    break
+                    with self._lock:
+                        if not self._want.get(name, ""):
+                            self._inflight.discard(name)
+                            return
                 # newer dir requested: loop to load it
-            with self._lock:
-                self._inflight.discard(name)
 
         threading.Thread(target=work, daemon=True,
                          name=f"tpk-load-{name}").start()
@@ -149,6 +156,10 @@ class ModelRepository:
     def loading_error(self, name: str) -> str | None:
         with self._lock:
             return self._load_errors.get(name)
+
+    def model_dir(self, name: str) -> str | None:
+        with self._lock:
+            return self._dirs.get(name)
 
     def unload(self, name: str) -> None:
         with self._lock:
@@ -304,7 +315,11 @@ class V2ModelHandler(_Base):
             if not model.ready:
                 raise tornado.web.HTTPError(
                     503, reason=f"model {name!r} not ready")
-            self.write_json({"name": name, "ready": True})
+            # model_dir lets version-aware clients (the TrainedModel
+            # controller) distinguish "old version still serving" from
+            # "my re-load landed".
+            self.write_json({"name": name, "ready": True,
+                             "model_dir": self.repo.model_dir(name)})
         else:
             self.write_json(model.metadata())
 

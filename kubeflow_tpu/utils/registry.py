@@ -156,6 +156,18 @@ def _ensure_builtin() -> None:
             path, batch_size=batch_size, seq_len=seq_len, seed=seed,
             shuffle=shuffle, vocab_size=vocab_size)
 
+    @register_dataset("packed_lm")
+    def _packed_lm(path, batch_size=8, seq_len=128, eos_id=0, seed=0,
+                   shuffle=True, vocab_size=None, **kw):
+        """Document-packed corpus: batches carry segment_ids/positions/mask
+        so attention and loss respect document boundaries (the packed-
+        sequence path through the fused kernels)."""
+        from kubeflow_tpu.data import loader
+
+        return loader.packed_lm_dataset(
+            path, batch_size=batch_size, seq_len=seq_len, eos_id=eos_id,
+            seed=seed, shuffle=shuffle, vocab_size=vocab_size)
+
     # Only mark loaded once every builtin registered — a failed import above
     # must re-raise on the next call, not leave the registry silently empty.
     _builtin_loaded = True

@@ -143,3 +143,98 @@ def test_legacy_checkpoint_restores(tmp_path):
     np.testing.assert_array_equal(out["w"], state["w"])
     assert mgr.restore_data_state() is None
     mgr.close()
+
+
+# -- document packing (segment ids / positions / cross-doc mask) -------------
+
+def test_packed_rows_structure(tmp_path):
+    """Docs pack whole into rows; segments/positions/mask respect
+    boundaries; over-long docs chunk."""
+    from kubeflow_tpu.data.loader import _PackedRows
+
+    eos = 99
+    docs = [[1, 2, 3, eos], [4, 5, eos], [6, eos],
+            [7, 8, 9, 10, 11, 12, 13, 14, 15, 16, 17, eos],  # > row
+            [20, 21, eos]]
+    corpus = np.concatenate([np.asarray(d) for d in docs]).astype(np.int32)
+    rows = _PackedRows(corpus, seq_len=8, eos_id=eos)
+    seen_tokens = []
+    for i in range(len(rows)):
+        r = rows[i]
+        assert r["inputs"].shape == (8,)
+        # Positions restart at 0 on every segment change.
+        seg, pos = r["segment_ids"], r["positions"]
+        for t in range(8):
+            if t == 0 or seg[t] != seg[t - 1]:
+                assert pos[t] == 0, (i, t, pos)
+            else:
+                assert pos[t] == pos[t - 1] + 1
+        # Mask is "target stays in my (real) document" for in-row targets.
+        np.testing.assert_array_equal(
+            r["mask"][:-1],
+            ((seg[:-1] == seg[1:]) & (seg[:-1] >= 0)).astype(np.float32))
+        seen_tokens.extend(r["inputs"].tolist())
+    # Whole docs are contiguous in pack order (corpus order preserved).
+    assert seen_tokens[:4] == [1, 2, 3, eos]
+
+
+def test_packed_dataset_trains_and_checkpoints(tmp_path):
+    """Registry 'packed_lm' -> train step with segments; iterator state
+    round-trips (resume without replay)."""
+    import grain.python as gp  # noqa: F401  (skip if grain missing)
+
+    from kubeflow_tpu.data.loader import (iterator_state, packed_lm_dataset,
+                                          restore_iterator)
+
+    eos = 0
+    rng = np.random.default_rng(0)
+    # ~200 docs of random lengths, eos-terminated, ids in [1, 64).
+    docs = [np.append(rng.integers(1, 64, rng.integers(3, 30)), eos)
+            for _ in range(200)]
+    corpus = np.concatenate(docs).astype(np.int32)
+    path = tmp_path / "tokens.npy"
+    np.save(path, corpus)
+
+    ds = packed_lm_dataset(str(path), batch_size=4, seq_len=32, eos_id=eos,
+                           seed=1, process_index=0, process_count=1,
+                           vocab_size=64)
+    it = iter(ds)
+    b1 = next(it)
+    assert set(b1) == {"inputs", "targets", "segment_ids", "positions",
+                       "mask"}
+    assert b1["inputs"].shape == (4, 32)
+    # Cross-document and padding targets are masked: mask[t] is 0 exactly
+    # where the next input token starts a new segment or is padding.
+    np.testing.assert_array_equal(
+        b1["mask"][:, :-1],
+        ((b1["segment_ids"][:, :-1] == b1["segment_ids"][:, 1:])
+         & (b1["segment_ids"][:, :-1] >= 0)).astype(np.float32))
+    state = iterator_state(it)
+    b2 = next(it)
+    it2 = iter(ds)
+    assert restore_iterator(it2, state)
+    b2b = next(it2)
+    np.testing.assert_array_equal(b2["inputs"], b2b["inputs"])
+
+    # And a real sharded train step consumes it (packed attention path).
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from kubeflow_tpu.models.llama import Llama, llama_tiny
+    from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+    from kubeflow_tpu.parallel.sharding import DEFAULT_RULES
+    from kubeflow_tpu.train.step import init_train_state, make_train_step
+
+    cfg = dataclasses.replace(llama_tiny(vocab=64), attention_impl="naive",
+                              remat=False)
+    model = Llama(cfg)
+    mesh = build_mesh(MeshConfig(data=2), jax.devices()[:2])
+    toks = jnp.zeros((4, 32), jnp.int32)
+    st = init_train_state(model, optax.adamw(1e-3), jax.random.key(0),
+                          (toks,), mesh, DEFAULT_RULES)
+    step = make_train_step(model, mesh, DEFAULT_RULES)
+    st, m = step(st, {k: np.asarray(v) for k, v in b1.items()})
+    assert np.isfinite(float(m["loss"]))
