@@ -184,7 +184,8 @@ class Attention(nn.Module):
     def __call__(self, x, cos, sin, positions, ring_axis: str | None = None,
                  standard_positions: bool = True, cache: dict | None = None,
                  cache_index: jax.Array | None = None,
-                 segment_ids: jax.Array | None = None):
+                 segment_ids: jax.Array | None = None,
+                 attend_full_cache: bool = False):
         cfg = self.cfg
         dense = partial(
             nn.DenseGeneral, use_bias=False, dtype=cfg.dtype,
@@ -211,11 +212,13 @@ class Attention(nn.Module):
         if cache is not None:
             ck, cv = _update_cache(cache["k"], cache["v"], k, v, cache_index)
             new_cache = {"k": ck, "v": cv}
-            if x.shape[1] == 1:
-                # Single-token decode: attend over the whole cache; causality
-                # and the not-yet-written tail (incl. stale entries from a
-                # previous slot occupant) are both masked by absolute
-                # positions (positions_kv > positions_q).
+            if x.shape[1] == 1 or attend_full_cache:
+                # Single-token decode — or a continuation chunk
+                # (attend_full_cache: S new tokens at a nonzero offset,
+                # the chunked-prefill path): attend over the whole cache;
+                # causality and the not-yet-written tail (incl. stale
+                # entries from a previous slot occupant) are both masked
+                # by absolute positions (positions_kv > positions_q).
                 t = ck.shape[1]
                 out = naive_attention(
                     q, ck, cv, causal=True, positions_q=positions,
@@ -331,12 +334,12 @@ class DecoderLayer(nn.Module):
     @nn.compact
     def __call__(self, x, cos, sin, positions, ring_axis=None,
                  standard_positions=True, cache=None, cache_index=None,
-                 segment_ids=None):
+                 segment_ids=None, attend_full_cache=False):
         cfg = self.cfg
         h = RMSNorm(cfg.rms_eps, cfg.dtype, name="input_norm")(x)
         attn_out, new_cache = Attention(cfg, name="attn")(
             h, cos, sin, positions, ring_axis, standard_positions, cache,
-            cache_index, segment_ids)
+            cache_index, segment_ids, attend_full_cache)
         # Remat landmark: policy "save_attn" keeps this tensor so the
         # backward skips re-running the attention kernel (small residual:
         # [B,S,H·D] bf16 per layer vs the full block internals).
@@ -360,10 +363,14 @@ class Llama(nn.Module):
                  ring_axis: str | None = None, cache: dict | None = None,
                  cache_index: jax.Array | None = None,
                  return_hidden: bool = False,
-                 segment_ids: jax.Array | None = None):
+                 segment_ids: jax.Array | None = None,
+                 attend_full_cache: bool = False):
         """Returns logits [B,S,V]; with `cache` (see init_cache) returns
-        (logits, updated_cache) — prefill when S>1 (cache_index must be 0),
-        single-token decode when S==1 (positions default to cache_index).
+        (logits, updated_cache) — prefill when S>1 at cache_index 0,
+        single-token decode when S==1 (positions default to cache_index),
+        and CONTINUATION when S>1 with `attend_full_cache=True`: the new
+        tokens write at cache_index>0 and attend over the whole cache
+        (chunked prefill of long prompts; pass absolute `positions`).
         `return_hidden` skips the unembedding and returns the post-norm
         hidden states [B,S,H] (chunked-CE training path). `segment_ids`
         [B,S] enables packed-sequence training: attention is confined
@@ -404,8 +411,10 @@ class Llama(nn.Module):
                 raise ValueError(
                     f"remat_policy {cfg.remat_policy!r}: "
                     f"{sorted(policies)}") from None
+            # Static: standard_positions(5), cache(6, None in training),
+            # attend_full_cache(9) — python values, not traced.
             layer_cls = nn.remat(layer_cls, policy=policy,
-                                 static_argnums=(5, 6))
+                                 static_argnums=(5, 6, 9))
         new_cache = None
         if cfg.scan_layers:
             # `cache` (leading layer dim) rides as the scan's per-layer input
@@ -414,7 +423,7 @@ class Llama(nn.Module):
                 lambda mdl, carry, layer_cache: mdl(
                     carry, cos, sin, positions, ring_axis,
                     standard_positions, layer_cache, cache_index,
-                    segment_ids),
+                    segment_ids, attend_full_cache),
                 variable_axes={"params": 0, "aux_loss": 0},
                 split_rngs={"params": True},
                 length=cfg.num_layers,
@@ -427,7 +436,8 @@ class Llama(nn.Module):
                     lambda c: c[i], cache)
                 x, lc = layer_cls(cfg, self.mlp_cls, name=f"layer_{i}")(
                     x, cos, sin, positions, ring_axis, standard_positions,
-                    layer_cache, cache_index, segment_ids)
+                    layer_cache, cache_index, segment_ids,
+                    attend_full_cache)
                 layer_caches.append(lc)
             if cache is not None:
                 new_cache = jax.tree.map(

@@ -112,11 +112,19 @@ class GenerationEngine:
         model, cfg = self.model, self.cfg
         from kubeflow_tpu.models.llama import init_cache
 
+        # Fragment caches carry headroom of one max bucket past max_len:
+        # the FINAL chunk's bucket padding may extend past max_len, and
+        # dynamic_update_slice would otherwise CLAMP the start index,
+        # shifting the write backwards over real prompt rows (silent
+        # corruption). Pad rows land in the slack and are dropped at
+        # insert; real prompt rows never exceed max_len-1 (submit bound).
+        frag_len = self.max_len + self.prefill_buckets[-1]
+
         def prefill(params, tokens, length, temperature, top_k, top_p,
                     key):
             """tokens [1, S_bucket] right-padded; returns (frag_cache,
             first sampled token [1])."""
-            cache = init_cache(cfg, 1, self.max_len)
+            cache = init_cache(cfg, 1, frag_len)
             logits, cache = model.apply(
                 {"params": params}, tokens, cache=cache,
                 cache_index=jnp.zeros((1,), jnp.int32))
@@ -125,11 +133,40 @@ class GenerationEngine:
             tok = sample_tokens(last, temperature, key, top_k, top_p)
             return cache, tok
 
+        def extend(params, cache, tokens, length, index, temperature,
+                   top_k, top_p, key):
+            """FINAL continuation chunk of a long prompt: tokens
+            [1, S_bucket] right-padded, written at offset `index` [1],
+            attending over the WHOLE fragment cache; samples the first
+            generated token like prefill."""
+            positions = index[:, None] + jnp.arange(tokens.shape[1])[None]
+            logits, cache = model.apply(
+                {"params": params}, tokens, cache=cache, cache_index=index,
+                positions=positions, attend_full_cache=True)
+            last = jnp.take_along_axis(
+                logits, (length - 1)[:, None, None], axis=1)[:, 0]
+            tok = sample_tokens(last, temperature, key, top_k, top_p)
+            return cache, tok
+
+        def extend_mid(params, cache, tokens, index):
+            """Intermediate continuation chunk: cache write + attention
+            only — return_hidden skips the full-vocab unembedding whose
+            sampled token would be discarded anyway."""
+            positions = index[:, None] + jnp.arange(tokens.shape[1])[None]
+            _, cache = model.apply(
+                {"params": params}, tokens, cache=cache, cache_index=index,
+                positions=positions, attend_full_cache=True,
+                return_hidden=True)
+            return cache
+
         def insert(cache, frag, slot):
-            """Write a prefill fragment (slot-batch 1) into slot `slot`."""
+            """Write a prefill fragment (slot-batch 1) into slot `slot`,
+            dropping the fragment's pad-headroom rows past max_len."""
             return jax.tree.map(
                 lambda c, f: jax.lax.dynamic_update_slice(
-                    c, f.astype(c.dtype),
+                    c,
+                    jax.lax.slice_in_dim(f, 0, c.shape[2], axis=2).astype(
+                        c.dtype),
                     (0, slot) + (0,) * (c.ndim - 2)), cache, frag)
 
         def make_decode(truncate: bool):
@@ -161,7 +198,12 @@ class GenerationEngine:
 
         prefill_jit = jax.jit(prefill)
         self._prefill = {b: prefill_jit for b in self.prefill_buckets}
+        self._extend = jax.jit(extend, donate_argnums=(1,))
+        self._extend_mid = jax.jit(extend_mid, donate_argnums=(1,))
         self._insert = jax.jit(insert, donate_argnums=(0,))
+        # Chunked admission only happens when a legal prompt can exceed
+        # the largest bucket.
+        self._may_chunk = self.prefill_buckets[-1] < self.max_len - 1
         self._decode_trunc = jax.jit(make_decode(True), donate_argnums=(1,))
         self._decode_plain = jax.jit(make_decode(False), donate_argnums=(1,))
 
@@ -177,6 +219,17 @@ class GenerationEngine:
             frag, _ = self._prefill[b](
                 self._params, jnp.zeros((1, b), jnp.int32), one_l, zero_t,
                 zero_k, one_p, self._key)
+        if self._may_chunk:  # chunked-prompt continuation path
+            # Intermediate chunks always use the largest bucket; the
+            # final (sampling) chunk can land on any bucket.
+            frag = self._extend_mid(
+                self._params, frag,
+                jnp.zeros((1, self.prefill_buckets[-1]), jnp.int32),
+                zero_k)
+            for b in self.prefill_buckets:
+                frag, _ = self._extend(
+                    self._params, frag, jnp.zeros((1, b), jnp.int32),
+                    one_l, zero_k, zero_t, zero_k, one_p, self._key)
         self._cache = self._insert(self._cache, frag, jnp.int32(0))
         n = self.n_slots
         for fn in (self._decode_plain, self._decode_trunc):
@@ -242,18 +295,39 @@ class GenerationEngine:
 
     def _admit(self, slot: int, req: dict) -> None:
         ids = req["input_ids"]
-        bucket = self._bucket_for(len(ids))
-        if len(ids) > bucket:  # longer than the largest bucket: truncate tail
-            ids = ids[-bucket:]
-        toks = np.zeros((1, bucket), np.int32)
-        toks[0, :len(ids)] = ids
-        self._key, sub = jax.random.split(self._key)
-        frag, tok0 = self._prefill[bucket](
-            self._params, jnp.asarray(toks),
-            jnp.asarray([len(ids)], jnp.int32),
+        sample_args = (
             jnp.asarray([req["temperature"]], jnp.float32),
             jnp.asarray([req.get("top_k", 0)], jnp.int32),
-            jnp.asarray([req.get("top_p", 1.0)], jnp.float32), sub)
+            jnp.asarray([req.get("top_p", 1.0)], jnp.float32),
+        )
+        # Prompts longer than the largest bucket prefill in CHUNKS: the
+        # first chunk is a plain prefill, the rest are continuation
+        # chunks attending over the whole fragment cache — no silent
+        # truncation (submit() already bounds the prompt by max_len).
+        big = self.prefill_buckets[-1]
+        frag, tok0, done = None, None, 0
+        while done < len(ids):
+            piece = ids[done:done + big]
+            final = done + len(piece) >= len(ids)
+            bucket = self._bucket_for(len(piece))
+            toks = np.zeros((1, bucket), np.int32)
+            toks[0, :len(piece)] = piece
+            if done == 0:
+                self._key, sub = jax.random.split(self._key)
+                frag, tok0 = self._prefill[bucket](
+                    self._params, jnp.asarray(toks),
+                    jnp.asarray([len(piece)], jnp.int32), *sample_args, sub)
+            elif final:
+                self._key, sub = jax.random.split(self._key)
+                frag, tok0 = self._extend(
+                    self._params, frag, jnp.asarray(toks),
+                    jnp.asarray([len(piece)], jnp.int32),
+                    jnp.asarray([done], jnp.int32), *sample_args, sub)
+            else:  # intermediate chunk: no sampling, no unembedding
+                frag = self._extend_mid(
+                    self._params, frag, jnp.asarray(toks),
+                    jnp.asarray([done], jnp.int32))
+            done += len(piece)
         self._cache = self._insert(self._cache, frag, jnp.int32(slot))
         first = int(tok0[0])
         self._slots[slot] = {"req": req, "idx": len(ids), "last": first}

@@ -234,3 +234,63 @@ def test_engine_top_p_requests(tiny):
             eng.submit([1], top_k=-1)
     finally:
         eng.close()
+
+
+def test_chunked_prefill_long_prompt_matches_reference(tiny):
+    """A prompt LONGER than the largest prefill bucket admits via chunked
+    continuation prefill (no silent truncation) and greedy-decodes exactly
+    like the uncached reference rollout."""
+    from kubeflow_tpu.serve.generation import GenerationEngine
+
+    model, params = tiny
+    rng = np.random.default_rng(4)
+    prompt = rng.integers(0, CFG.vocab_size, 45).tolist()  # 45 > bucket 16
+    engine = GenerationEngine(model, params, CFG, slots=2, max_len=96,
+                              chunk=4, prefill_buckets=[16])
+    try:
+        out = engine.submit(prompt, max_tokens=8, temperature=0.0)
+        assert out["num_input_tokens"] == 45
+        assert out["output_ids"] == ref_greedy(model, params, prompt, 8)
+    finally:
+        engine.close()
+
+
+def test_chunked_prefill_matches_single_bucket(tiny):
+    """Same prompt through chunked (small-bucket) and single-shot
+    (large-bucket) admission produces identical greedy output."""
+    from kubeflow_tpu.serve.generation import GenerationEngine
+
+    model, params = tiny
+    rng = np.random.default_rng(9)
+    prompt = rng.integers(0, CFG.vocab_size, 30).tolist()
+    outs = {}
+    for label, buckets in (("chunked", [8]), ("single", [32])):
+        eng = GenerationEngine(model, params, CFG, slots=1, max_len=80,
+                               chunk=4, prefill_buckets=buckets)
+        try:
+            outs[label] = eng.submit(prompt, max_tokens=6,
+                                     temperature=0.0)["output_ids"]
+        finally:
+            eng.close()
+    assert outs["chunked"] == outs["single"]
+
+
+def test_chunked_prefill_bucket_overrun_no_corruption(tiny):
+    """Regression: the FINAL chunk's bucket padding may extend past
+    max_len; the fragment-cache headroom must absorb it (a clamped
+    dynamic_update_slice would shift the write over real prompt rows and
+    silently corrupt decode)."""
+    from kubeflow_tpu.serve.generation import GenerationEngine
+
+    model, params = tiny
+    rng = np.random.default_rng(13)
+    # max_len 40, bucket 32: a 39-token prompt chunks (32, 7→bucket 32)
+    # with the final chunk written at index 32 — 32+32 > 40.
+    prompt = rng.integers(0, CFG.vocab_size, 39).tolist()
+    engine = GenerationEngine(model, params, CFG, slots=1, max_len=48,
+                              chunk=4, prefill_buckets=[32])
+    try:
+        out = engine.submit(prompt, max_tokens=4, temperature=0.0)
+        assert out["output_ids"] == ref_greedy(model, params, prompt, 4)
+    finally:
+        engine.close()
