@@ -1,0 +1,207 @@
+"""RDMA ring attention — K/V rotation by remote DMA inside one kernel.
+
+The lax-level rings (ops/ring_attention.py) rotate K/V with
+`jax.lax.ppermute` between per-step compute calls and rely on XLA to
+overlap the collective with compute. This kernel makes the overlap
+EXPLICIT (pallas_guide.md ring-collectives pattern, ops/ROADMAP.md item):
+one Pallas program per device owns a double-buffered K/V scratch, STARTS
+the remote copy of the current buffer to the right neighbour, computes
+attention against it while the DMA flies, then waits the incoming buffer.
+
+Backpressure is DMA-based: after finishing compute on a slot, a device
+sends a tiny "slot free" ack to its LEFT neighbour (the one that writes
+into its buffers); a sender waits that ack before overwriting a slot the
+receiver may still be reading. Two slots + acks give lockstep-free
+pipelining with bounded VMEM — the kernel never materialises more than
+2 K/V shards.
+
+Causality is masked by global positions (shard offset + row index), so
+every ring step is one masked flash-style block — no cross-step state
+besides the online-softmax partials.
+
+Forward-only kernel: the backward runs through the lax-level flash ring
+(`ring_attention(inner="flash")`) via a custom VJP — any correct gradient
+of the same math; the RDMA win is a forward/serving/inference-time and
+steady-state-throughput property.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+from kubeflow_tpu.parallel.mesh import current_mesh
+
+NEG_INF = -1e30
+
+
+def _rdma_kernel(q_ref, k_ref, v_ref, o_ref, kvbuf, ackbuf,
+                 dsend, drecv, asend, arecv, *, n: int, axis: str,
+                 bkh: int, group: int, s: int, d: int, sm_scale: float):
+    """q_ref [bkh*group, s, d]; k/v_ref [bkh, s, d]; o_ref like q.
+    kvbuf [2, 2, bkh, s, d] (slot, k|v, head, row, d); ackbuf [2, 1, 128].
+    All VMEM. n = ring size (static); unrolled python loop."""
+    me = jax.lax.axis_index(axis)
+    right = jax.lax.rem(me + 1, n)
+    left = jax.lax.rem(me + n - 1, n)
+
+    # Slot 0 starts as the local shard.
+    kvbuf[0, 0] = k_ref[...]
+    kvbuf[0, 1] = v_ref[...]
+
+    rows = jax.lax.broadcasted_iota(jnp.int32, (group * s, s), 0)
+    rows = jax.lax.rem(rows, s) + me * s  # global q positions per head row
+    cols_local = jax.lax.broadcasted_iota(jnp.int32, (group * s, s), 1)
+
+    # Per-kv-head online-softmax partials (python lists: bkh is static).
+    accs = [jnp.zeros((group * s, d), jnp.float32) for _ in range(bkh)]
+    ms = [jnp.full((group * s, 1), NEG_INF, jnp.float32) for _ in range(bkh)]
+    ls = [jnp.zeros((group * s, 1), jnp.float32) for _ in range(bkh)]
+
+    for i in range(n):
+        cur, nxt = i % 2, (i + 1) % 2
+        data_copy = None
+        if i < n - 1:
+            if i >= 1:
+                # Right must have freed slot `nxt` (its compute i-1 done).
+                pltpu.make_async_remote_copy(
+                    src_ref=ackbuf.at[nxt], dst_ref=ackbuf.at[nxt],
+                    send_sem=asend.at[nxt], recv_sem=arecv.at[nxt],
+                    device_id=right,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL).wait_recv()
+            data_copy = pltpu.make_async_remote_copy(
+                src_ref=kvbuf.at[cur], dst_ref=kvbuf.at[nxt],
+                send_sem=dsend.at[nxt], recv_sem=drecv.at[nxt],
+                device_id=right,
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+            data_copy.start()
+
+        # The resident buffer originated at shard (me - i) mod n.
+        src = jax.lax.rem(me + n - i, n)
+        cols = cols_local + src * s
+        mask = rows >= cols
+        # q is laid out [bkh, group*s, d] (_rdma_fwd), so each kv head's
+        # queries are one contiguous 2-D block.
+        for h in range(bkh):
+            qh = q_ref[h].astype(jnp.float32) * sm_scale      # [group*s, d]
+            kh = kvbuf[cur, 0, h].astype(jnp.float32)         # [s, d]
+            vh = kvbuf[cur, 1, h].astype(jnp.float32)
+            sc = jax.lax.dot_general(
+                qh, kh, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)           # [group*s, s]
+            sc = jnp.where(mask, sc, NEG_INF)
+            m_new = jnp.maximum(ms[h], jnp.max(sc, axis=1, keepdims=True))
+            p = jnp.exp(sc - m_new)
+            alpha = jnp.exp(ms[h] - m_new)
+            ls[h] = ls[h] * alpha + jnp.sum(p, axis=1, keepdims=True)
+            accs[h] = accs[h] * alpha + jax.lax.dot_general(
+                p, vh, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            ms[h] = m_new
+
+        if i < n - 1:
+            data_copy.wait_send()  # outgoing read of `cur` complete...
+        if i <= n - 3:
+            # ...so LEFT may now overwrite my `cur` slot: ack it.
+            ack = pltpu.make_async_remote_copy(
+                src_ref=ackbuf.at[cur], dst_ref=ackbuf.at[cur],
+                send_sem=asend.at[cur], recv_sem=arecv.at[cur],
+                device_id=left,
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+            ack.start()
+            ack.wait_send()
+        if i < n - 1:
+            data_copy.wait_recv()  # incoming `nxt` from LEFT has landed
+
+    for h in range(bkh):
+        o_ref[h] = (accs[h] / jnp.maximum(ls[h], 1e-30)).astype(o_ref.dtype)
+
+
+def _rdma_fwd(q, k, v, axis_name, mesh, n, interpret):
+    b, s_glob, h, d = q.shape
+    kh = k.shape[2]
+    group = h // kh
+
+    # Specs adapt to the mesh's axes (shared rule with the lax-level
+    # rings): the full framework mesh shards batch over (data, fsdp); a
+    # dedicated single-axis ring mesh (the only shape the INTERPRET
+    # path's DMA discharge supports — compiled Mosaic has no such limit)
+    # leaves batch replicated.
+    from kubeflow_tpu.ops.ring_attention import _batch_spec
+
+    spec = P(_batch_spec(mesh, axis_name), axis_name, None, None)
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    def _run(q, k, v):
+        bl, s, _, _ = q.shape  # local shapes
+        bkh = bl * kh
+        # Layout: one contiguous [group*s, d] q block per kv head.
+        q3 = q.transpose(0, 2, 1, 3).reshape(bl, kh, group, s, d)
+        q3 = q3.reshape(bkh, group * s, d)
+        k3 = k.transpose(0, 2, 1, 3).reshape(bkh, s, d)
+        v3 = v.transpose(0, 2, 1, 3).reshape(bkh, s, d)
+        kernel = functools.partial(
+            _rdma_kernel, n=n, axis=axis_name, bkh=bkh, group=group, s=s,
+            d=d, sm_scale=1.0 / (d ** 0.5))
+        o3 = pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((bkh, group * s, d), q.dtype),
+            scratch_shapes=[
+                pltpu.VMEM((2, 2, bkh, s, d), k.dtype),
+                pltpu.VMEM((2, 1, 128), jnp.float32),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+            ],
+            interpret=interpret,
+            compiler_params=pltpu.CompilerParams(collective_id=7),
+        )(q3, k3, v3)
+        out = o3.reshape(bl, kh, group, s, d).transpose(0, 3, 1, 2, 4)
+        return out.reshape(bl, s, h, d)
+
+    return _run(q, k, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def rdma_ring_attention(q, k, v, axis_name: str = "seq", mesh=None,
+                        interpret: bool | None = None):
+    """Causal ring attention with in-kernel remote-DMA K/V rotation.
+    q [B,S,H,D], k/v [B,S,KH,D] over the `axis_name` ring (contiguous
+    layout). Forward runs the fused RDMA kernel; gradients route through
+    the lax-level flash ring (same math)."""
+    mesh = mesh or current_mesh()
+    if mesh is None:
+        raise ValueError("rdma_ring_attention needs a mesh")
+    n = mesh.shape[axis_name]
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu",)
+    if n == 1:
+        from kubeflow_tpu.ops.flash_attention import flash_attention
+        return flash_attention(q, k, v, True)
+    return _rdma_fwd(q, k, v, axis_name, mesh, n, interpret)
+
+
+def _vjp_fwd(q, k, v, axis_name, mesh, interpret):
+    return rdma_ring_attention(q, k, v, axis_name, mesh, interpret), (q, k, v)
+
+
+def _vjp_bwd(axis_name, mesh, interpret, res, g):
+    from kubeflow_tpu.ops.ring_attention import ring_attention
+
+    q, k, v = res
+    mesh = mesh or current_mesh()
+    _, pullback = jax.vjp(
+        lambda q, k, v: ring_attention(q, k, v, axis_name=axis_name,
+                                       mesh=mesh, inner="flash"), q, k, v)
+    return pullback(g)
+
+
+rdma_ring_attention.defvjp(_vjp_fwd, _vjp_bwd)

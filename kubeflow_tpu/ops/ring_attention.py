@@ -52,6 +52,15 @@ def _block_attn(q, k, v, q_pos, kv_pos):
             l.reshape(b, s, h, 1))
 
 
+def _batch_spec(mesh, axis_name):
+    """Shard batch over whichever dp-like axes the mesh actually has
+    (never the ring axis itself) — a dedicated single-axis ring mesh
+    (kernel tests, standalone CP) leaves batch replicated."""
+    axes = tuple(a for a in ("data", "fsdp")
+                 if a in mesh.axis_names and a != axis_name)
+    return axes or None
+
+
 def _merge(carry, update):
     """Merge two online-softmax partials."""
     acc, m, l = carry
@@ -148,8 +157,8 @@ def ring_attention(q, k, v, axis_name: str = "seq",
 
     # Batch stays sharded over the dp-like axes — replicating it here would
     # all-gather the global batch onto every seq-ring member.
-    spec = P(("data", "fsdp"), axis_name, None, None)
-    pos_spec = P(("data", "fsdp"), axis_name)
+    spec = P(_batch_spec(mesh, axis_name), axis_name, None, None)
+    pos_spec = P(_batch_spec(mesh, axis_name), axis_name)
 
     @functools.partial(
         shard_map, mesh=mesh,
@@ -186,7 +195,7 @@ def _ring_attention_flash(q, k, v, axis_name, mesh, n, block_q, block_kv):
         from kubeflow_tpu.ops.flash_attention import flash_attention
         return flash_attention(q, k, v, True, block_q, block_kv)
 
-    spec = P(("data", "fsdp"), axis_name, None, None)
+    spec = P(_batch_spec(mesh, axis_name), axis_name, None, None)
 
     @functools.partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)
@@ -289,8 +298,8 @@ def zigzag_ring_attention(q, k, v, axis_name: str = "seq", mesh=None,
         return out if pre_permuted else out[:, jnp.argsort(idx)]
     positions = jnp.broadcast_to(idx[None].astype(jnp.int32), (b, s))
 
-    spec = P(("data", "fsdp"), axis_name, None, None)
-    pos_spec = P(("data", "fsdp"), axis_name)
+    spec = P(_batch_spec(mesh, axis_name), axis_name, None, None)
+    pos_spec = P(_batch_spec(mesh, axis_name), axis_name)
 
     @functools.partial(
         shard_map, mesh=mesh,
@@ -347,7 +356,7 @@ def _zigzag_ring_flash(q, k, v, axis_name, mesh, n, block_q, block_kv):
     """Zigzag schedule with the fused flash inner block. Shard i holds
     chunks (i, 2n-1-i); chunk c covers positions [c·cs, (c+1)·cs), so
     chunk-id comparison decides each sub-block's case."""
-    spec = P(("data", "fsdp"), axis_name, None, None)
+    spec = P(_batch_spec(mesh, axis_name), axis_name, None, None)
 
     @functools.partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)
@@ -406,7 +415,7 @@ def ulysses_attention(q, k, v, axis_name: str = "seq",
         from kubeflow_tpu.ops.reference import naive_attention
         return naive_attention(q, k, v, causal=True)
 
-    spec = P(("data", "fsdp"), axis_name, None, None)
+    spec = P(_batch_spec(mesh, axis_name), axis_name, None, None)
 
     @functools.partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)

@@ -112,13 +112,15 @@ class GenerationEngine:
         model, cfg = self.model, self.cfg
         from kubeflow_tpu.models.llama import init_cache
 
-        # Fragment caches carry headroom of one max bucket past max_len:
-        # the FINAL chunk's bucket padding may extend past max_len, and
-        # dynamic_update_slice would otherwise CLAMP the start index,
-        # shifting the write backwards over real prompt rows (silent
-        # corruption). Pad rows land in the slack and are dropped at
-        # insert; real prompt rows never exceed max_len-1 (submit bound).
-        frag_len = self.max_len + self.prefill_buckets[-1]
+        # Fragment caches carry headroom of one max bucket past max_len
+        # WHEN chunked admission is reachable: the FINAL chunk's bucket
+        # padding may extend past max_len, and dynamic_update_slice would
+        # otherwise CLAMP the start index, shifting the write backwards
+        # over real prompt rows (silent corruption). Pad rows land in the
+        # slack and are dropped at insert; real prompt rows never exceed
+        # max_len-1 (submit bound).
+        big = self.prefill_buckets[-1]
+        frag_len = self.max_len + (big if big < self.max_len - 1 else 0)
 
         def prefill(params, tokens, length, temperature, top_k, top_p,
                     key):

@@ -496,3 +496,61 @@ def test_llama_packed_sequences_match_unpacked(impl):
                                np.asarray(out_a), rtol=2e-4, atol=2e-4)
     np.testing.assert_allclose(np.asarray(out_packed[:, 24:]),
                                np.asarray(out_b), rtol=2e-4, atol=2e-4)
+
+
+# -- RDMA ring: in-kernel remote-DMA K/V rotation ----------------------------
+
+from kubeflow_tpu.ops.rdma_ring_attention import rdma_ring_attention  # noqa: E402
+
+
+@pytest.mark.parametrize("nseq", [4, 8])
+def test_rdma_ring_matches_naive(devices8, nseq):
+    """Double-buffered remote-DMA rotation with DMA-ack backpressure:
+    numerics must match the reference exactly (same math, explicit
+    overlap)."""
+    from jax.sharding import Mesh
+
+    q, k, v = _qkv(b=2, s=128, h=4, kh=2, d=16, seed=21)
+    ref = naive_attention(q, k, v, causal=True)
+    mesh = Mesh(np.array(devices8[:nseq]), ("seq",))
+    out = rdma_ring_attention(q, k, v, axis_name="seq", mesh=mesh)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_rdma_ring_grads_via_flash_fallback(devices8):
+    """The custom VJP routes gradients through the lax-level flash ring —
+    they must match the einsum reference."""
+    from jax.sharding import Mesh
+
+    q, k, v = _qkv(b=1, s=64, h=2, kh=2, d=8, seed=23)
+    mesh = Mesh(np.array(devices8[:4]), ("seq",))
+
+    def loss_rdma(q, k, v):
+        return jnp.sum(rdma_ring_attention(q, k, v, "seq", mesh) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(naive_attention(q, k, v, causal=True) ** 2)
+
+    gr = jax.grad(loss_rdma, argnums=(0, 1, 2))(q, k, v)
+    gn = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gr, gn):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-3, atol=5e-3)
+
+
+def test_rdma_ring_on_framework_mesh_single_axis_limitation(devices8):
+    """On the full multi-axis framework mesh the interpret path cannot
+    discharge remote DMAs (compiled Mosaic can); a 1-axis view works and
+    matches the multi-axis lax-level ring."""
+    q, k, v = _qkv(b=2, s=64, h=4, kh=4, d=8, seed=25)
+    fmesh = build_mesh(MeshConfig(seq=4), devices8[:4])
+    with fmesh:
+        ref = ring_attention(q, k, v, axis_name="seq", inner="flash",
+                             block_q=16, block_kv=16)
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(devices8[:4]), ("seq",))
+    out = rdma_ring_attention(q, k, v, "seq", mesh)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)

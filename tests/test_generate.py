@@ -284,8 +284,8 @@ def test_chunked_prefill_bucket_overrun_no_corruption(tiny):
 
     model, params = tiny
     rng = np.random.default_rng(13)
-    # max_len 40, bucket 32: a 39-token prompt chunks (32, 7→bucket 32)
-    # with the final chunk written at index 32 — 32+32 > 40.
+    # max_len 48, bucket 32: a 39-token prompt chunks (32, 7→bucket 32)
+    # with the final chunk written at index 32 — 32+32 > 48.
     prompt = rng.integers(0, CFG.vocab_size, 39).tolist()
     engine = GenerationEngine(model, params, CFG, slots=1, max_len=48,
                               chunk=4, prefill_buckets=[32])
