@@ -75,7 +75,9 @@ def _kind_alias(kind: str) -> str:
                "experiment": "Experiment", "experiments": "Experiment",
                "trial": "Trial", "trials": "Trial",
                "pipeline": "Pipeline", "pipelines": "Pipeline",
-               "run": "PipelineRun", "runs": "PipelineRun"}
+               "run": "PipelineRun", "runs": "PipelineRun",
+               "trainedmodel": "TrainedModel", "tm": "TrainedModel",
+               "profile": "Profile", "profiles": "Profile"}
     return aliases.get(kind.lower(), kind)
 
 
