@@ -121,15 +121,39 @@ class Trainer:
     # -- data ---------------------------------------------------------------
 
     @property
-    def local_batch_size(self) -> int:
-        """spec.batch_size is the GLOBAL batch; each process loads its share
-        (the reference's per-worker DataLoader sharding, done for the user)."""
+    def _dp_shards(self) -> int:
+        """Extent of the batch-sharding axes (data × fsdp)."""
+        return self.mesh.shape["data"] * self.mesh.shape["fsdp"]
+
+    @property
+    def _batch_groups(self) -> int:
+        """How many DISTINCT per-process data streams the mesh admits.
+
+        The batch dim shards over the leading (data, fsdp) mesh axes, so a
+        process's devices cover dp·n_proc-relative shard spans: with
+        dp >= n_proc each process owns exclusive shards (n distinct
+        streams); with dp < n_proc each shard is replicated across
+        n_proc/dp processes, which must feed IDENTICAL data (dp streams);
+        pure CP/TP (dp == 1) replicates the whole batch everywhere."""
         n = jax.process_count()
-        if self.spec.batch_size % n:
+        dp = self._dp_shards
+        if dp % n and n % dp:
+            raise ValueError(
+                f"batch shards ({dp} = data*fsdp) and processes ({n}) "
+                "must divide one another for process-aligned data loading")
+        return min(dp, n)
+
+    @property
+    def local_batch_size(self) -> int:
+        """spec.batch_size is the GLOBAL batch; each process loads the
+        share of its batch replica group (the reference's per-worker
+        DataLoader sharding, done for the user)."""
+        g = self._batch_groups
+        if self.spec.batch_size % g:
             raise ValueError(
                 f"global batch {self.spec.batch_size} not divisible by "
-                f"{n} processes")
-        return self.spec.batch_size // n
+                f"{g} batch replica groups")
+        return self.spec.batch_size // g
 
     def _data(self) -> Iterator[dict]:
         from kubeflow_tpu.utils import registry
@@ -139,8 +163,13 @@ class Trainer:
         if self.info.get("task") == "lm":
             kwargs.setdefault("seq_len", self.spec.seq_len)
             kwargs.setdefault("vocab_size", self.info["vocab_size"])
-        # Distinct stream per process = per-worker dataset sharding.
-        kwargs.setdefault("seed", self.spec.seed + 7919 * jax.process_index())
+        # One distinct stream per batch replica group: processes sharing a
+        # batch shard (or a fully replicated batch) must load IDENTICAL
+        # data, so they share the seed; exclusive-shard processes get
+        # their own stream.
+        n = jax.process_count()
+        group = jax.process_index() * self._batch_groups // n
+        kwargs.setdefault("seed", self.spec.seed + 7919 * group)
         return registry.build_dataset(self.spec.dataset, **kwargs)
 
     def _globalize(self, batch: dict) -> dict:

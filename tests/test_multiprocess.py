@@ -1,7 +1,9 @@
 """Localhost multi-process e2e — the rebuild's `kind` equivalent (SURVEY.md
 §4): real `jax.distributed` over 127.0.0.1, 2 processes × 2 virtual CPU
-devices, global mesh data=4, DP training through the Trainer runtime with
-the TPK_* env contract (comms/bootstrap.py)."""
+devices, training through the Trainer runtime with the TPK_* env contract
+(comms/bootstrap.py). Covers DP, the 2-slice hybrid mesh (eval config 5
+shape), and cross-process context parallelism (the ring's ppermute rides
+the process boundary — the ICI/DCN path on real hardware)."""
 
 import json
 import os
@@ -19,8 +21,62 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_dp_training(tmp_path):
+def _run_workers(tmp_path, spec, prefix, *, extra_env=None, n_procs=2):
+    """Launch n trainer workers over real jax.distributed; returns the
+    per-rank metric streams after asserting clean exits."""
     port = _free_port()
+    procs = []
+    for pid in range(n_procs):
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=2",
+            TPK_COORDINATOR=f"127.0.0.1:{port}",
+            TPK_NUM_PROCS=str(n_procs),
+            TPK_PROC_ID=str(pid),
+        )
+        for k, v in (extra_env or {}).items():
+            env[k] = v(pid) if callable(v) else v
+        # The axon sitecustomize force-selects the TPU platform via
+        # jax.config, overriding JAX_PLATFORMS; drop its trigger so the
+        # worker really runs on virtual CPU devices.
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        metrics = tmp_path / f"{prefix}_metrics_{pid}.jsonl"
+        path_i = tmp_path / f"{prefix}_spec_{pid}.json"
+        path_i.write_text(json.dumps(dict(spec, metrics_path=str(metrics))))
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "kubeflow_tpu.train.trainer",
+             "--spec", str(path_i)],
+            env=env, cwd=REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True))
+
+    results = []
+    for p in procs:
+        out, err = p.communicate(timeout=280)
+        results.append((p.returncode, out, err))
+    for rc, out, err in results:
+        assert rc == 0, (f"worker failed rc={rc}\nstdout:{out[-2000:]}\n"
+                         f"stderr:{err[-3000:]}")
+
+    streams = []
+    for pid in range(n_procs):
+        lines = (tmp_path / f"{prefix}_metrics_{pid}.jsonl").read_text()
+        streams.append([json.loads(l) for l in lines.splitlines()
+                        if "loss" in json.loads(l)])
+    return streams
+
+
+def _assert_converged_and_agreeing(streams, steps):
+    assert all(streams)
+    for m in streams:
+        assert m[-1]["step"] == steps
+    for m in streams[1:]:  # every rank, not just rank 1
+        assert abs(m[-1]["loss"] - streams[0][-1]["loss"]) < 1e-5
+    assert streams[0][-1]["loss"] < streams[0][0]["loss"]
+
+
+def test_two_process_dp_training(tmp_path):
     spec = {
         "model": "llama_tiny",
         "dataset": "learnable_lm",
@@ -31,48 +87,8 @@ def test_two_process_dp_training(tmp_path):
         "learning_rate": 3e-3,
         "log_every": 4,
     }
-    procs = []
-    for pid in range(2):
-        env = dict(
-            os.environ,
-            JAX_PLATFORMS="cpu",
-            XLA_FLAGS="--xla_force_host_platform_device_count=2",
-            TPK_COORDINATOR=f"127.0.0.1:{port}",
-            TPK_NUM_PROCS="2",
-            TPK_PROC_ID=str(pid),
-        )
-        # The axon sitecustomize force-selects the TPU platform via
-        # jax.config, overriding JAX_PLATFORMS; drop its trigger so the
-        # worker really runs on virtual CPU devices.
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        metrics = tmp_path / f"metrics_{pid}.jsonl"
-        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-        path_i = tmp_path / f"spec_{pid}.json"
-        path_i.write_text(json.dumps(dict(spec, metrics_path=str(metrics))))
-        cmd = [sys.executable, "-m", "kubeflow_tpu.train.trainer",
-               "--spec", str(path_i)]
-        procs.append(subprocess.Popen(
-            cmd, env=env, cwd=REPO, stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE, text=True))
-
-    outs = []
-    for p in procs:
-        out, err = p.communicate(timeout=280)
-        outs.append((p.returncode, out, err))
-    for rc, out, err in outs:
-        assert rc == 0, f"worker failed rc={rc}\nstdout:{out[-2000:]}\nstderr:{err[-3000:]}"
-
-    # Both workers computed identical global losses; loss decreased.
-    m0 = [json.loads(l) for l in
-          (tmp_path / "metrics_0.jsonl").read_text().splitlines()
-          if "loss" in json.loads(l)]
-    m1 = [json.loads(l) for l in
-          (tmp_path / "metrics_1.jsonl").read_text().splitlines()
-          if "loss" in json.loads(l)]
-    assert m0 and m1
-    assert m0[-1]["step"] == 12
-    assert abs(m0[-1]["loss"] - m1[-1]["loss"]) < 1e-5
-    assert m0[-1]["loss"] < m0[0]["loss"]
+    streams = _run_workers(tmp_path, spec, "dp")
+    _assert_converged_and_agreeing(streams, 12)
 
 
 def test_two_slice_hybrid_mesh_training(tmp_path):
@@ -82,7 +98,6 @@ def test_two_slice_hybrid_mesh_training(tmp_path):
     gradient all-reduce crosses processes while param all-gathers stay
     slice-local. Real `jax.distributed` rendezvous; loss identical on both
     ranks and decreasing."""
-    port = _free_port()
     spec = {
         "model": "llama_tiny",
         "dataset": "learnable_lm",
@@ -93,43 +108,29 @@ def test_two_slice_hybrid_mesh_training(tmp_path):
         "learning_rate": 3e-3,
         "log_every": 4,
     }
-    procs = []
-    for pid in range(2):
-        env = dict(
-            os.environ,
-            JAX_PLATFORMS="cpu",
-            XLA_FLAGS="--xla_force_host_platform_device_count=2",
-            TPK_COORDINATOR=f"127.0.0.1:{port}",
-            TPK_NUM_PROCS="2",
-            TPK_PROC_ID=str(pid),
-            TPK_NUM_SLICES="2",
-            TPK_SLICE_ID=str(pid),
-        )
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        metrics = tmp_path / f"ms_metrics_{pid}.jsonl"
-        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-        path_i = tmp_path / f"ms_spec_{pid}.json"
-        path_i.write_text(json.dumps(dict(spec, metrics_path=str(metrics))))
-        cmd = [sys.executable, "-m", "kubeflow_tpu.train.trainer",
-               "--spec", str(path_i)]
-        procs.append(subprocess.Popen(
-            cmd, env=env, cwd=REPO, stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE, text=True))
+    streams = _run_workers(
+        tmp_path, spec, "ms",
+        extra_env={"TPK_NUM_SLICES": "2", "TPK_SLICE_ID": lambda pid: str(pid)})
+    _assert_converged_and_agreeing(streams, 12)
 
-    outs = []
-    for p in procs:
-        out, err = p.communicate(timeout=280)
-        outs.append((p.returncode, out, err))
-    for rc, out, err in outs:
-        assert rc == 0, f"worker failed rc={rc}\nstdout:{out[-2000:]}\nstderr:{err[-3000:]}"
 
-    m0 = [json.loads(l) for l in
-          (tmp_path / "ms_metrics_0.jsonl").read_text().splitlines()
-          if "loss" in json.loads(l)]
-    m1 = [json.loads(l) for l in
-          (tmp_path / "ms_metrics_1.jsonl").read_text().splitlines()
-          if "loss" in json.loads(l)]
-    assert m0 and m1
-    assert m0[-1]["step"] == 12
-    assert abs(m0[-1]["loss"] - m1[-1]["loss"]) < 1e-5
-    assert m0[-1]["loss"] < m0[0]["loss"]
+def test_cross_process_context_parallel_training(tmp_path):
+    """Context parallelism ACROSS processes: the seq axis (4) spans both
+    workers, so every ring-attention ppermute step crosses the process
+    boundary over real jax.distributed — the SURVEY §5.7/§5.8 long-context
+    path at its hardest grain (DCN hops on real multi-host). Zigzag
+    schedule: the trainer's permuted batches + positions must agree across
+    ranks."""
+    spec = {
+        "model": "llama_tiny",
+        "dataset": "learnable_lm",
+        "mesh": {"seq": 4},
+        "ring_attention": "zigzag",
+        "steps": 20,
+        "batch_size": 8,
+        "seq_len": 16,
+        "learning_rate": 5e-3,
+        "log_every": 5,
+    }
+    streams = _run_workers(tmp_path, spec, "cp")
+    _assert_converged_and_agreeing(streams, 20)
