@@ -8,10 +8,12 @@ loader is first-class and *checkpointable*: the grain iterator exposes
 orbax alongside the TrainState, and resume restores the exact stream
 position instead of replaying `next(data)` O(steps) times.
 
-Sharding story matches the platform: each process builds the same pipeline
-with its `(process_index, process_count)` shard, so the global batch is
-assembled from disjoint per-process streams — the grain analog of the
-reference's per-worker DataLoader sharding, done for the user.
+Sharding story matches the platform: each BATCH REPLICA GROUP builds the
+same pipeline with its `(process_index, process_count)` shard — the
+trainer passes its group index/count (processes sharing a batch shard
+must feed identical rows; exclusive-shard processes get disjoint
+streams). The grain analog of the reference's per-worker DataLoader
+sharding, done for the user.
 """
 
 from __future__ import annotations

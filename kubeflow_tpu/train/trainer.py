@@ -165,11 +165,13 @@ class Trainer:
             kwargs.setdefault("vocab_size", self.info["vocab_size"])
         # One distinct stream per batch replica group: processes sharing a
         # batch shard (or a fully replicated batch) must load IDENTICAL
-        # data, so they share the seed; exclusive-shard processes get
-        # their own stream.
+        # data — same seed AND the same grain row shard (the loader's
+        # sharding is group-indexed, not process-indexed).
         n = jax.process_count()
         group = jax.process_index() * self._batch_groups // n
         kwargs.setdefault("seed", self.spec.seed + 7919 * group)
+        kwargs.setdefault("process_index", group)
+        kwargs.setdefault("process_count", self._batch_groups)
         return registry.build_dataset(self.spec.dataset, **kwargs)
 
     def _globalize(self, batch: dict) -> dict:

@@ -145,16 +145,20 @@ def _ensure_builtin() -> None:
 
     @register_dataset("token_file")
     def _token_file(path, batch_size=8, seq_len=128, seed=0, shuffle=True,
-                    vocab_size=None, **kw):
+                    vocab_size=None, process_index=None, process_count=None,
+                    **kw):
         """Grain-backed tokenized corpus (.npy/.bin/.txt) with
         checkpointable iterator state — the production input path. The
         trainer passes the model's vocab_size so a wrong-tokenizer corpus
-        fails at startup instead of training on clamped ids."""
+        fails at startup instead of training on clamped ids, and its batch
+        replica group as (process_index, process_count) so ranks sharing a
+        batch shard load identical rows."""
         from kubeflow_tpu.data import loader
 
         return loader.lm_dataset(
             path, batch_size=batch_size, seq_len=seq_len, seed=seed,
-            shuffle=shuffle, vocab_size=vocab_size)
+            shuffle=shuffle, vocab_size=vocab_size,
+            process_index=process_index, process_count=process_count)
 
     @register_dataset("packed_lm")
     def _packed_lm(path, batch_size=8, seq_len=128, eos_id=0, seed=0,
@@ -166,7 +170,9 @@ def _ensure_builtin() -> None:
 
         return loader.packed_lm_dataset(
             path, batch_size=batch_size, seq_len=seq_len, eos_id=eos_id,
-            seed=seed, shuffle=shuffle, vocab_size=vocab_size)
+            seed=seed, shuffle=shuffle, vocab_size=vocab_size,
+            process_index=kw.get("process_index"),
+            process_count=kw.get("process_count"))
 
     # Only mark loaded once every builtin registered — a failed import above
     # must re-raise on the next call, not leave the registry silently empty.

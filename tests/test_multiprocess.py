@@ -121,9 +121,20 @@ def test_cross_process_context_parallel_training(tmp_path):
     path at its hardest grain (DCN hops on real multi-host). Zigzag
     schedule: the trainer's permuted batches + positions must agree across
     ranks."""
+    import numpy as np
+
+    # Grain-backed corpus (NOT a seed-driven generator): with the seq
+    # axis replicated over both processes, the loader must give BOTH
+    # ranks the identical row shard — a per-process shard here would
+    # silently train each host on different data (regression for the
+    # batch-replica-group contract).
+    corpus = np.random.default_rng(3).integers(
+        0, 512, 20000, dtype=np.int32)
+    np.save(tmp_path / "corpus.npy", corpus)
     spec = {
         "model": "llama_tiny",
-        "dataset": "learnable_lm",
+        "dataset": "token_file",
+        "dataset_kwargs": {"path": str(tmp_path / "corpus.npy")},
         "mesh": {"seq": 4},
         "ring_attention": "zigzag",
         "steps": 20,

@@ -111,3 +111,34 @@ def test_runtime_rejects_unknown_mode(tmp_path):
                        extra={"quantize": "fp4"})
     with pytest.raises(ValueError, match="quantize"):
         load_model(str(tmp_path))
+
+
+def test_int8_matmul_matches_dequant_reference():
+    """W8A8 Pallas kernel (ops/quant_matmul.py): int8x int8->int32 dot with
+    fused per-row x per-channel rescale must match the dequantized matmul
+    to the activation-quantization noise floor, including ragged shapes."""
+    from kubeflow_tpu.ops.quant_matmul import int8_matmul
+
+    rng = np.random.default_rng(0)
+    for m, k, n in [(100, 384, 200), (64, 128, 128), (32, 100, 64)]:
+        x = jnp.asarray(rng.normal(size=(m, k)), jnp.float32)
+        w = jnp.asarray(rng.normal(size=(k, n))
+                        * np.linspace(0.1, 2.0, n)[None, :], jnp.float32)
+        sw = jnp.max(jnp.abs(w), axis=0) / 127.0
+        qw = jnp.clip(jnp.round(w / sw[None, :]), -127, 127).astype(jnp.int8)
+        ref = x @ (qw.astype(jnp.float32) * sw[None, :])
+        got = int8_matmul(x, qw, sw, block_m=64, block_n=64)
+        rel = float(jnp.linalg.norm(got - ref) / jnp.linalg.norm(ref))
+        assert rel < 0.01, (m, k, n, rel)
+        # And close to the full-precision product end to end.
+        full = x @ w
+        rel2 = float(jnp.linalg.norm(got - full) / jnp.linalg.norm(full))
+        assert rel2 < 0.02, (m, k, n, rel2)
+
+
+def test_int8_matmul_shape_validation():
+    from kubeflow_tpu.ops.quant_matmul import int8_matmul
+
+    with pytest.raises(ValueError, match="shape"):
+        int8_matmul(jnp.zeros((4, 8)), jnp.zeros((9, 3), jnp.int8),
+                    jnp.zeros((3,)))
