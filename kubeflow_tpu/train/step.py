@@ -109,6 +109,36 @@ def _unembed_head(params: Any) -> tuple[jax.Array, bool]:
         f"(have {sorted(params)})")
 
 
+def abstract_train_state(
+    model: nn.Module,
+    tx: optax.GradientTransformation,
+    example_inputs: tuple,
+    mesh: jax.sharding.Mesh,
+    rules: Rules = DEFAULT_RULES,
+    example_kwargs: dict | None = None,
+):
+    """(init_fn, abstract_state, shardings): the sharding-layout derivation
+    shared by real initialization (init_train_state) and AOT scale proofs
+    (utils/scaleproof.py) — eval_shape the init, map flax logical metadata
+    through the rules to NamedShardings. `abstract_state` is unboxed
+    ShapeDtypeStructs; `shardings` is the matching NamedSharding tree.
+    Callers must be inside `with mesh, nn.logical_axis_rules(rules)` when
+    tracing `init_fn`."""
+    example_kwargs = example_kwargs or {}
+
+    def _init(rng):
+        variables = model.init(rng, *example_inputs, **example_kwargs)
+        params = variables["params"]
+        return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                          opt_state=tx.init(params), tx=tx)
+
+    with mesh, nn.logical_axis_rules(rules):
+        abstract = jax.eval_shape(_init, jax.random.key(0))
+        logical_specs = nn.get_partition_spec(abstract)
+        shardings = nn.logical_to_mesh_sharding(logical_specs, mesh, rules)
+    return _init, nn.meta.unbox(abstract), shardings
+
+
 def init_train_state(
     model: nn.Module,
     tx: optax.GradientTransformation,
@@ -125,18 +155,9 @@ def init_train_state(
 
     `example_kwargs` rides into model.init for impls whose trace needs the
     full call contract (e.g. zigzag attention requires explicit positions)."""
-    example_kwargs = example_kwargs or {}
-
-    def _init(rng):
-        variables = model.init(rng, *example_inputs, **example_kwargs)
-        params = variables["params"]
-        return TrainState(step=jnp.zeros((), jnp.int32), params=params,
-                          opt_state=tx.init(params), tx=tx)
-
+    _init, _, shardings = abstract_train_state(
+        model, tx, example_inputs, mesh, rules, example_kwargs)
     with mesh, nn.logical_axis_rules(rules):
-        abstract = jax.eval_shape(_init, rng)
-        logical_specs = nn.get_partition_spec(abstract)
-        shardings = nn.logical_to_mesh_sharding(logical_specs, mesh, rules)
         state = jax.jit(_init, out_shardings=shardings)(rng)
         # Unbox flax logical-partitioning metadata for downstream use.
         return nn.meta.unbox(state)
