@@ -1,0 +1,156 @@
+"""Pipeline-parallel Llama: the scanned trunk partitioned over `pipe`.
+
+SURVEY.md §2.6 PP row maps the reference's DeepSpeed/Megatron pipeline
+engines (p2p microbatch send/recv inside user containers) to a compiled
+stage-sharded schedule. parallel/pipeline.py provides the schedules (GPipe +
+interleaved circular, AD straight through); this module binds them to the
+REAL flagship model:
+
+  * **Same parameter pytree as the scanned Llama** (models/llama.py with
+    `scan_layers=True`): trunk leaves carry a leading `layers` dim L. PP is
+    a *rules* change — logical axis `layers` maps to mesh axis `pipe`
+    (sharding.py "pipeline" preset) — plus a reshape [L, ...] ->
+    [stages, L/stages, ...] inside the step. Checkpoints, HF import, and
+    the single-path model stay bit-identical; no second weight format.
+  * **Embed / final-norm / unembed ride GSPMD outside the shard_map**: the
+    pipeline region covers exactly the homogeneous trunk (constant
+    activation shape), which is what the schedule requires; the vocab-sized
+    ends keep their usual tensor/fsdp sharding rules and gradients
+    all-reduce over `data` automatically.
+  * **Per-layer forward is pure jnp** (no flax apply): inside the manual
+    shard_map region, flax's logical-constraint machinery would try to
+    issue auto-sharding constraints, which don't compose with manual axes.
+    The math matches DecoderLayer exactly (RMSNorm fp32, RoPE fp32, GQA
+    attention, SwiGLU in cfg.dtype).
+
+Scope (documented): dense Llama trunk, contiguous sequences (no
+packed-segment masks through PP v1), attention naive or flash. MoE-PP and
+CP-inside-PP are future axes composition work (ops/ROADMAP.md).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.models.llama import LlamaConfig, apply_rope, rope_table
+from kubeflow_tpu.ops.reference import naive_attention
+from kubeflow_tpu.parallel.pipeline import (
+    pipeline_apply, pipeline_apply_circular)
+
+
+def _rms(x: jax.Array, scale: jax.Array, eps: float, dtype) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    y = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (y * scale).astype(dtype)
+
+
+def _resolve_attn(cfg: LlamaConfig) -> str:
+    impl = cfg.attention_impl
+    if impl == "auto":
+        return ("flash" if jax.default_backend() in ("tpu", "axon")
+                else "naive")
+    if impl not in ("naive", "flash"):
+        raise ValueError(
+            f"pipeline parallelism supports attention_impl 'naive'/'flash' "
+            f"(contiguous causal sequences), not {impl!r}")
+    return impl
+
+
+def layer_fwd(cfg: LlamaConfig, lp: dict, x: jax.Array, cos: jax.Array,
+              sin: jax.Array, positions: jax.Array,
+              attn_impl: str = "naive") -> jax.Array:
+    """One decoder layer, pure jnp. lp: the layer's param subtree (kernels
+    exactly as flax lays them out: q/k/v [H, heads, D], o [heads, D, H],
+    gate/up [H, M], down [M, H]); x [mb, S, H] in cfg.dtype."""
+    dt = cfg.dtype
+    h = _rms(x, lp["input_norm"]["scale"], cfg.rms_eps, dt)
+    q = jnp.einsum("bsh,hnd->bsnd", h, lp["attn"]["q_proj"]["kernel"].astype(dt))
+    k = jnp.einsum("bsh,hnd->bsnd", h, lp["attn"]["k_proj"]["kernel"].astype(dt))
+    v = jnp.einsum("bsh,hnd->bsnd", h, lp["attn"]["v_proj"]["kernel"].astype(dt))
+    q = apply_rope(q, cos, sin, positions)
+    k = apply_rope(k, cos, sin, positions)
+    if attn_impl == "flash":
+        from kubeflow_tpu.ops.flash_attention import flash_attention
+        attn = flash_attention(q, k, v, causal=True,
+                               block_q=cfg.flash_block_q,
+                               block_kv=cfg.flash_block_kv)
+    else:
+        attn = naive_attention(q, k, v, causal=True)
+    attn = jnp.einsum("bsnd,ndh->bsh", attn,
+                      lp["attn"]["o_proj"]["kernel"].astype(dt))
+    x = x + attn
+    h2 = _rms(x, lp["post_attn_norm"]["scale"], cfg.rms_eps, dt)
+    gate = h2 @ lp["mlp"]["gate_proj"]["kernel"].astype(dt)
+    up = h2 @ lp["mlp"]["up_proj"]["kernel"].astype(dt)
+    return x + (jax.nn.silu(gate) * up) @ lp["mlp"]["down_proj"]["kernel"].astype(dt)
+
+
+def pipeline_forward(
+    cfg: LlamaConfig,
+    params: Any,
+    tokens: jax.Array,
+    *,
+    mesh,
+    num_microbatches: int,
+    num_chunks: int = 1,
+    data_axis: str | tuple[str, ...] | None = ("data", "fsdp"),
+    return_hidden: bool = False,
+) -> jax.Array:
+    """Full causal-LM forward with the trunk pipelined over `pipe`.
+
+    params: the SAME pytree the scanned Llama produces (trunk under
+    params['layers'] with leading dim L). tokens [B, S]. Returns logits
+    [B, S, V] (or post-norm hidden [B, S, H] with return_hidden for the
+    chunked-CE path). Numerics match the non-pipelined model."""
+    if cfg.num_layers % (mesh.shape["pipe"] * num_chunks):
+        raise ValueError(
+            f"num_layers {cfg.num_layers} not divisible by pipe "
+            f"({mesh.shape['pipe']}) * chunks ({num_chunks})")
+    attn_impl = _resolve_attn(cfg)
+    dt = cfg.dtype
+    b, s = tokens.shape
+    embed = params["embed"]
+    x = embed.astype(dt)[tokens]
+    cos, sin = rope_table(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta, cfg)
+
+    n_stages = mesh.shape["pipe"] * num_chunks
+    per_stage = cfg.num_layers // n_stages
+    stages = jax.tree.map(
+        lambda a: a.reshape(n_stages, per_stage, *a.shape[1:]),
+        params["layers"])
+
+    def stage_fn(sp, h):
+        # sp leaves [per_stage, ...]; h [mb, S, H]. Positions are the
+        # plain arange — PP v1 trains contiguous sequences.
+        pos = jnp.broadcast_to(jnp.arange(s), (h.shape[0], s))
+
+        def body(carry, lp):
+            return layer_fwd(cfg, lp, carry, cos, sin, pos, attn_impl), None
+
+        h, _ = jax.lax.scan(body, h, sp)
+        return h
+
+    axes = ((data_axis,) if isinstance(data_axis, str)
+            else tuple(data_axis or ()))
+    dax = tuple(a for a in axes if mesh.shape[a] > 1) or None
+    if dax is not None and len(dax) == 1:
+        dax = dax[0]
+    if num_chunks > 1:
+        x = pipeline_apply_circular(
+            stage_fn, stages, x, mesh=mesh,
+            num_microbatches=num_microbatches, num_chunks=num_chunks,
+            data_axis=dax)
+    else:
+        x = pipeline_apply(
+            stage_fn, stages, x, mesh=mesh,
+            num_microbatches=num_microbatches, data_axis=dax)
+
+    x = _rms(x, params["final_norm"]["scale"], cfg.rms_eps, dt)
+    if return_hidden:
+        return x
+    if cfg.tie_embeddings:
+        return jnp.einsum("bsh,vh->bsv", x, embed.astype(dt))
+    return x @ params["lm_head"]["kernel"].astype(dt)

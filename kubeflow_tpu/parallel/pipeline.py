@@ -43,6 +43,21 @@ from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
 
+def _check_data_axis(mesh: Mesh, data_axis, mb: int) -> None:
+    """Fail early with a readable error instead of an opaque shard_map
+    partition error when microbatch rows don't divide over the data axis."""
+    if data_axis is None:
+        return
+    axes = (data_axis,) if isinstance(data_axis, str) else tuple(data_axis)
+    dp = 1
+    for a in axes:
+        dp *= mesh.shape[a]
+    if mb % dp:
+        raise ValueError(
+            f"microbatch size {mb} not divisible by data axis "
+            f"{data_axis} (size {dp})")
+
+
 def stack_stage_params(per_stage_params: list[Any]) -> Any:
     """Stacks per-stage pytrees into one pytree with a leading stage axis
     (shard it over `pipe` via the `stage` logical axis / PartitionSpec)."""
@@ -57,6 +72,7 @@ def pipeline_apply(
     mesh: Mesh,
     num_microbatches: int,
     axis: str = "pipe",
+    data_axis: str | tuple[str, ...] | None = None,
 ) -> jax.Array:
     """Applies `stage_fn` P times in sequence, pipelined over microbatches.
 
@@ -64,6 +80,10 @@ def pipeline_apply(
       stage), sharded over mesh axis `axis`.
     x: [B, ...] global batch, B divisible by num_microbatches; activations
       must keep a constant shape across stages (transformer trunk shape).
+    data_axis: optional mesh axis (or axes) carrying data parallelism —
+      microbatch ROWS shard over it (PP x DP composition: each data rank
+      pipelines its slice of every microbatch; stage weights replicate over
+      data, grads all-reduce over it outside via GSPMD).
     Returns stage_{P-1}(...stage_0(x)) with identical numerics to the
     sequential loop — the schedule only changes *when* each stage runs.
     """
@@ -77,10 +97,13 @@ def pipeline_apply(
             f"need microbatches ({num_microbatches}) >= stages "
             f"({num_stages}) to fill the pipeline")
     mb = batch // num_microbatches
+    _check_data_axis(mesh, data_axis, mb)
     xm = x.reshape(num_microbatches, mb, *x.shape[1:])
 
     pspec = jax.tree.map(lambda _: P(axis), stage_params)
-    other = P()  # inputs/outputs replicated over the pipe axis
+    # Inputs/outputs: replicated over the pipe axis; microbatch rows
+    # sharded over the data axis when given.
+    other = P(None, data_axis) if data_axis is not None else P()
 
     @partial(shard_map, mesh=mesh, in_specs=(pspec, other),
              out_specs=other, check_vma=False)
@@ -129,6 +152,7 @@ def pipeline_apply_circular(
     num_microbatches: int,
     num_chunks: int,
     axis: str = "pipe",
+    data_axis: str | tuple[str, ...] | None = None,
 ) -> jax.Array:
     """Interleaved (circular) pipeline schedule — Megatron's interleaved-1F1B
     bubble reduction, compiled for TPU.
@@ -166,6 +190,7 @@ def pipeline_apply_circular(
             f"microbatches ({m}) must be a multiple of stages ({p}) for "
             "the interleaved schedule's group injection")
     mb = batch // m
+    _check_data_axis(mesh, data_axis, mb)
     xm = x.reshape(m, mb, *x.shape[1:])
     groups = m // p
     period = c * p  # ticks to push one group through all chunks
@@ -175,7 +200,7 @@ def pipeline_apply_circular(
     cparams = jax.tree.map(
         lambda a: a.reshape(c, p, *a.shape[1:]), stage_params)
     pspec = jax.tree.map(lambda _: P(None, axis), cparams)
-    other = P()
+    other = P(None, data_axis) if data_axis is not None else P()
 
     # Tick t on device s computes the chunk of the activation that left
     # device 0 at tick t-s: chunk(t, s) = ((t - s) mod C·P) // P. Fresh

@@ -72,6 +72,14 @@ def rules_for(strategy: str) -> Rules:
             ("embed", "fsdp"),
         ),
         "hybrid": DEFAULT_RULES,
+        # Pipeline parallelism: identical to hybrid except the scanned
+        # trunk's `layers` dim shards over `pipe` — params are born
+        # stage-partitioned and the PP step reshapes [L,...] ->
+        # [stages, L/stages, ...] (models/llama_pp.py). A rules swap, not
+        # a weight-format change.
+        "pipeline": tuple(
+            (name, "pipe") if name == "layers" else (name, to)
+            for name, to in DEFAULT_RULES),
     }
     try:
         return presets[strategy]

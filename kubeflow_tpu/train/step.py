@@ -171,6 +171,7 @@ def make_train_step(
     model_kwargs: dict | None = None,
     loss_impl: str = "full",
     loss_chunk: int = 1024,
+    pipeline: dict | None = None,
 ) -> Callable[[TrainState, dict], tuple[TrainState, dict]]:
     """Build the jitted train step for a causal-LM-style batch:
       batch = {"inputs": [B,S] int32, "targets": [B,S] int32,
@@ -179,7 +180,13 @@ def make_train_step(
 
     loss_impl="chunked" computes cross entropy blockwise against the
     unembedding (model must support return_hidden) — the [B·S, V] fp32
-    logits buffer never materializes; backward recomputes per chunk."""
+    logits buffer never materializes; backward recomputes per chunk.
+
+    pipeline={"microbatches": M, "chunks": C}: run the trunk through the
+    compiled pipeline schedule over the `pipe` mesh axis
+    (models/llama_pp.py) instead of model.apply — params stay in the
+    scanned-Llama layout (leading `layers` dim, sharded over `pipe` by the
+    "pipeline" rules); GPipe when C == 1, interleaved circular otherwise."""
     model_kwargs = model_kwargs or {}
     if loss_impl not in ("full", "chunked"):
         raise ValueError(f"loss_impl {loss_impl!r}: full | chunked")
@@ -187,6 +194,47 @@ def make_train_step(
         raise ValueError("loss_impl='chunked' implies the built-in LM loss")
     if loss_chunk < 1:
         raise ValueError(f"loss_chunk must be >= 1, got {loss_chunk}")
+    if pipeline is not None:
+        if mesh.shape["pipe"] < 2:
+            raise ValueError(
+                "pipeline train step needs a mesh with pipe >= 2 "
+                f"(got {mesh.shape['pipe']})")
+        if not hasattr(model, "cfg") or not getattr(
+                model.cfg, "scan_layers", False):
+            raise ValueError(
+                "pipeline parallelism needs the scanned Llama-family "
+                "model (params with a leading 'layers' dim)")
+        if loss_fn is not None:
+            raise ValueError("pipeline implies the built-in LM loss")
+        unsupported = {"ring_axis", "segment_ids", "positions"} & set(
+            model_kwargs)
+        if any(model_kwargs.get(k) is not None for k in unsupported):
+            raise ValueError(
+                f"pipeline parallelism doesn't compose with {unsupported} "
+                "(contiguous causal sequences only in PP v1)")
+
+    def pipeline_loss(params, batch):
+        from kubeflow_tpu.models.llama_pp import pipeline_forward
+
+        if "segment_ids" in batch or "positions" in batch:
+            raise ValueError(
+                "packed-sequence batches are not supported through the "
+                "pipeline schedule (PP v1)")
+        hidden = loss_impl == "chunked"
+        out = pipeline_forward(
+            model.cfg, params, batch["inputs"], mesh=mesh,
+            num_microbatches=int(pipeline["microbatches"]),
+            num_chunks=int(pipeline.get("chunks", 1)),
+            return_hidden=hidden)
+        if hidden:
+            head, vocab_major = _unembed_head(params)
+            main = chunked_cross_entropy(
+                out, head, batch["targets"], batch.get("mask"),
+                chunk=loss_chunk, head_is_vocab_major=vocab_major)
+        else:
+            main = cross_entropy_loss(out, batch["targets"],
+                                      batch.get("mask"))
+        return main, jnp.zeros((), jnp.float32)
 
     def compute_loss(params, batch):
         # mutable=["aux_loss"]: MoE routers sow load-balance penalties there
@@ -232,9 +280,11 @@ def make_train_step(
             axes = ("batch", "act_seq")
         return nn.with_logical_constraint(x, axes + (None,) * (x.ndim - len(axes)))
 
+    loss_impl_fn = pipeline_loss if pipeline is not None else compute_loss
+
     def step(state: TrainState, batch: dict):
         batch = jax.tree.map(constrain_batch, batch)
-        (loss, aux), grads = jax.value_and_grad(compute_loss, has_aux=True)(
+        (loss, aux), grads = jax.value_and_grad(loss_impl_fn, has_aux=True)(
             state.params, batch)
         new_state = state.apply_gradients(grads)
         gnorm = optax.global_norm(grads)

@@ -54,6 +54,12 @@ class TrainJobSpec:
     # CE (no logits buffer — the long-context/large-vocab memory saver).
     loss_impl: str = "full"
     loss_chunk: int = 1024
+    # Pipeline parallelism: set mesh.pipe >= 2 and optionally
+    # {"microbatches": M (default: pipe), "chunks": C (default 1; >1 runs
+    # the interleaved circular schedule)}. The trunk runs the compiled
+    # GPipe/circular schedule (models/llama_pp.py); params keep the
+    # scanned layout, sharded over `pipe` via the "pipeline" rules.
+    pipeline: dict = dataclasses.field(default_factory=dict)
     checkpoint: dict = dataclasses.field(default_factory=dict)
     # {"dir": str, "interval": int, "keep": int}
     metrics_path: str | None = None
@@ -95,10 +101,49 @@ class Trainer:
             # — the caller's spec must stay as submitted (it gets
             # re-serialized for resume/retry).
             model_kwargs["attention_impl"] = spec.ring_attention
-        self.rules = rules_for(spec.strategy)
         mesh_fields = dict(spec.mesh)
         mesh_fields.setdefault("num_slices", self.penv.num_slices)
         self.mesh = build_mesh(MeshConfig(**mesh_fields))
+        strategy = spec.strategy
+        if self.mesh.shape["pipe"] > 1:
+            # pipe in the mesh IS the pipeline switch; the rules must put
+            # the scanned `layers` dim on `pipe` or init would replicate
+            # the trunk over the pipeline stages.
+            if strategy == "hybrid":
+                strategy = "pipeline"
+            elif strategy != "pipeline":
+                raise ValueError(
+                    f"mesh.pipe={self.mesh.shape['pipe']} needs strategy "
+                    f"'pipeline' (or the default), not {strategy!r}")
+            if spec.ring_attention:
+                raise ValueError(
+                    "pipeline parallelism doesn't compose with "
+                    "ring_attention (PP v1)")
+            bad_axes = [a for a in ("tensor", "seq", "expert")
+                        if self.mesh.shape[a] > 1]
+            if bad_axes:
+                # The pipeline shard_map would silently REPLICATE the
+                # trunk over these axes (full weights + redundant compute
+                # on every rank) — refuse rather than quietly burn 2x the
+                # provisioned HBM/FLOPs. PP v1 composes with data/fsdp.
+                raise ValueError(
+                    f"pipeline parallelism doesn't compose with mesh axes "
+                    f"{bad_axes} (PP v1 composes with data/fsdp only)")
+            unknown = set(spec.pipeline) - {"microbatches", "chunks"}
+            if unknown:
+                raise ValueError(
+                    f"unknown spec.pipeline keys {sorted(unknown)}; "
+                    "valid: microbatches, chunks")
+        elif spec.pipeline:
+            raise ValueError("spec.pipeline set but mesh.pipe <= 1")
+        self.rules = rules_for(strategy)
+        self._pipeline = None
+        if self.mesh.shape["pipe"] > 1:
+            self._pipeline = {
+                "microbatches": int(spec.pipeline.get(
+                    "microbatches", self.mesh.shape["pipe"])),
+                "chunks": int(spec.pipeline.get("chunks", 1)),
+            }
         self.model, self.info = registry.build_model(
             spec.model, **model_kwargs)
 
@@ -249,7 +294,8 @@ class Trainer:
                                   loss_fn=self._loss_fn(),
                                   model_kwargs=model_kwargs,
                                   loss_impl=spec.loss_impl,
-                                  loss_chunk=spec.loss_chunk)
+                                  loss_chunk=spec.loss_chunk,
+                                  pipeline=self._pipeline)
 
         tokens_per_step = spec.batch_size * (
             spec.seq_len if self.info.get("task") == "lm" else 1)
