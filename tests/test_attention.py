@@ -518,19 +518,44 @@ def test_rdma_ring_matches_naive(devices8, nseq):
                                rtol=2e-3, atol=2e-3)
 
 
-def test_rdma_ring_grads_via_flash_fallback(devices8):
-    """The custom VJP routes gradients through the lax-level flash ring —
-    they must match the einsum reference."""
+@pytest.mark.parametrize("nseq", [4, 8])
+def test_rdma_ring_fused_backward_matches_naive(devices8, nseq):
+    """The fused two-pass backward (K/V rotate for dq; q/dout/lse/delta
+    rotate for resident dk/dv — ops/ROADMAP.md item 1) must match the
+    einsum reference at both ring sizes."""
     from jax.sharding import Mesh
 
     q, k, v = _qkv(b=1, s=64, h=2, kh=2, d=8, seed=23)
-    mesh = Mesh(np.array(devices8[:4]), ("seq",))
+    mesh = Mesh(np.array(devices8[:nseq]), ("seq",))
 
     def loss_rdma(q, k, v):
         return jnp.sum(rdma_ring_attention(q, k, v, "seq", mesh) ** 2)
 
     def loss_ref(q, k, v):
         return jnp.sum(naive_attention(q, k, v, causal=True) ** 2)
+
+    gr = jax.grad(loss_rdma, argnums=(0, 1, 2))(q, k, v)
+    gn = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gr, gn):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-3, atol=5e-3)
+
+
+def test_rdma_ring_fused_backward_gqa_batched(devices8):
+    """GQA (group > 1) + batch > 1 through the fused backward: the
+    [bkh, group*s, d] head-block layout must round-trip gradients."""
+    from jax.sharding import Mesh
+
+    q, k, v = _qkv(b=2, s=64, h=4, kh=2, d=8, seed=29)
+    mesh = Mesh(np.array(devices8[:4]), ("seq",))
+
+    def loss_rdma(q, k, v):
+        out = rdma_ring_attention(q, k, v, "seq", mesh)
+        return jnp.sum(out * jnp.cos(out))
+
+    def loss_ref(q, k, v):
+        out = naive_attention(q, k, v, causal=True)
+        return jnp.sum(out * jnp.cos(out))
 
     gr = jax.grad(loss_rdma, argnums=(0, 1, 2))(q, k, v)
     gn = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
