@@ -66,6 +66,22 @@ class LlamaConfig:
     # flash-kernel block sizes (tuned for v5e/v5p VMEM; ops/flash_attention.py)
     flash_block_q: int = 512
     flash_block_kv: int = 512
+    # Block-sparse attention mask family (ops/flash_attention.MaskSpec):
+    # causal | full | prefix_lm | sliding_window. Scalars (not a MaskSpec)
+    # so the config stays hashable/serializable; see mask_spec below.
+    mask_kind: str = "causal"
+    mask_window: int = 0
+    mask_prefix: int = 0
+
+    @property
+    def mask_spec(self):
+        """MaskSpec for non-default masks, None for plain causal (the
+        fast path keeps its historical call signatures)."""
+        if self.mask_kind == "causal":
+            return None
+        from kubeflow_tpu.ops.flash_attention import MaskSpec
+        return MaskSpec(self.mask_kind, window=self.mask_window,
+                        prefix=self.mask_prefix)
 
     @property
     def num_params(self) -> int:
@@ -208,6 +224,12 @@ class Attention(nn.Module):
         k = nn.with_logical_constraint(k, ("batch", "act_seq", None, "act_kv"))
         v = nn.with_logical_constraint(v, ("batch", "act_seq", None, "act_kv"))
 
+        mask_spec = cfg.mask_spec
+        if mask_spec is not None and cache is not None:
+            raise ValueError(
+                "attention mask specs don't compose with KV-cache decode "
+                "(v1): serve masked models with full-forward predict")
+
         new_cache = None
         if cache is not None:
             ck, cv = _update_cache(cache["k"], cache["v"], k, v, cache_index)
@@ -254,6 +276,11 @@ class Attention(nn.Module):
             raise ValueError(
                 f"segment_ids (packed sequences) need attention_impl "
                 f"'flash' or 'naive', not {impl!r}")
+        if mask_spec is not None and impl not in ("flash", "naive"):
+            raise ValueError(
+                f"mask_kind={cfg.mask_kind!r} needs attention_impl 'flash' "
+                f"or 'naive' (ring/zigzag schedules are causal-only), "
+                f"not {impl!r}")
         if impl in ("ring", "ring_flash"):
             from kubeflow_tpu.ops.ring_attention import ring_attention
             if impl == "ring_flash":
@@ -291,11 +318,11 @@ class Attention(nn.Module):
             out = flash_attention(q, k, v, causal=True,
                                   block_q=cfg.flash_block_q,
                                   block_kv=cfg.flash_block_kv,
-                                  segment_ids=segment_ids)
+                                  segment_ids=segment_ids, mask=mask_spec)
         else:
             out = naive_attention(q, k, v, causal=True, positions_q=positions,
                                   positions_kv=positions,
-                                  segment_ids=segment_ids)
+                                  segment_ids=segment_ids, mask=mask_spec)
         out = dense(features=cfg.hidden_size, axis=(-2, -1),
                     kernel_init=nn.with_logical_partitioning(
                         nn.initializers.lecun_normal(), ("heads", "kv", "embed")),
@@ -411,10 +438,15 @@ class Llama(nn.Module):
                 raise ValueError(
                     f"remat_policy {cfg.remat_policy!r}: "
                     f"{sorted(policies)}") from None
-            # Static: standard_positions(5), cache(6, None in training),
-            # attend_full_cache(9) — python values, not traced.
+            # Static argnums are SELF-BASED in nn.remat (the scope rides at
+            # index 0, user args start at 1): ring_axis(5) and
+            # standard_positions(6) and attend_full_cache(10) are python
+            # values steering control flow and must not be traced;
+            # cache/cache_index/segment_ids are arrays and must stay
+            # dynamic (serving prefill passes a real cache through the
+            # remat'd layers).
             layer_cls = nn.remat(layer_cls, policy=policy,
-                                 static_argnums=(5, 6, 9))
+                                 static_argnums=(5, 6, 10))
         new_cache = None
         if cfg.scan_layers:
             # `cache` (leading layer dim) rides as the scan's per-layer input

@@ -26,6 +26,7 @@ is the path past that.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 
 import jax
@@ -36,8 +37,103 @@ from jax.experimental.pallas import tpu as pltpu  # noqa: F401  (TPU lowering)
 NEG_INF = -1e30
 
 
+@dataclasses.dataclass(frozen=True)
+class MaskSpec:
+    """Block-sparse attention mask families (the splash-attention mask-spec
+    surface, ops/ROADMAP.md item 2). Static per compile; fully-masked
+    blocks are SKIPPED by the kernels' visible-block ranges, not masked —
+    the sparsity is wall-clock, not cosmetic.
+
+    kind:
+      - "causal": rows attend cols <= row (the default).
+      - "full": bidirectional (encoder-style).
+      - "prefix_lm": bidirectional over the first `prefix` positions,
+        causal after (T5/PaLM2-style prefix LM fine-tuning).
+      - "sliding_window": causal, but each row sees only the trailing
+        `window` keys (Mistral-style local attention).
+
+    Document confinement composes orthogonally via `segment_ids` — a
+    window never crosses a segment boundary when both are given (the
+    "document-window" mask). Exception: prefix_lm is refused with
+    segment_ids (its boundary is an absolute position; packed rows
+    restart positions per document).
+    """
+
+    kind: str = "causal"
+    window: int = 0
+    prefix: int = 0
+
+    def __post_init__(self):
+        kinds = ("causal", "full", "prefix_lm", "sliding_window")
+        if self.kind not in kinds:
+            raise ValueError(f"mask kind {self.kind!r}: one of {kinds}")
+        if self.kind == "sliding_window" and self.window < 1:
+            raise ValueError("sliding_window needs window >= 1")
+        if self.kind == "prefix_lm" and self.prefix < 0:
+            raise ValueError("prefix_lm needs prefix >= 0")
+
+
+def _norm_mask(causal: bool, mask) -> MaskSpec:
+    if mask is None:
+        return MaskSpec("causal" if causal else "full")
+    if isinstance(mask, str):
+        return MaskSpec(mask)
+    return mask
+
+
+def _apply_mask(valid, rows, cols, mask: MaskSpec):
+    """Fold the spec's in-block predicate into `valid` (static dispatch)."""
+    if mask.kind == "causal":
+        return jnp.logical_and(valid, rows >= cols)
+    if mask.kind == "prefix_lm":
+        return jnp.logical_and(
+            valid, jnp.logical_or(rows >= cols, cols < mask.prefix))
+    if mask.kind == "sliding_window":
+        return jnp.logical_and(
+            valid, jnp.logical_and(rows >= cols,
+                                   rows - cols < mask.window))
+    return valid  # full
+
+
+def _q_visible(qi, block_q, block_kv, seq_kv, mask: MaskSpec):
+    """(first, bound) kv-block range a q block must visit — blocks outside
+    are fully masked and never touched. qi may be traced."""
+    num_kv = pl.cdiv(seq_kv, block_kv)
+    if mask.kind == "full":
+        return 0, num_kv
+    last = (qi + 1) * block_q - 1
+    causal_bound = jnp.minimum(last // block_kv + 1, num_kv)
+    if mask.kind == "causal":
+        return 0, causal_bound
+    if mask.kind == "prefix_lm":
+        # Rows below the prefix see every prefix block (bidirectional).
+        prefix_bound = jnp.where(
+            qi * block_q < mask.prefix,
+            jnp.minimum(pl.cdiv(mask.prefix, block_kv), num_kv), 0)
+        return 0, jnp.maximum(causal_bound, prefix_bound)
+    # sliding_window: the earliest col any row sees is first_row-window+1.
+    first = jnp.maximum((qi * block_q - mask.window + 1) // block_kv, 0)
+    return first, causal_bound
+
+
+def _kv_visible(j, block_q, block_kv, seq_q_pad, mask: MaskSpec):
+    """(first, bound) q-block range a kv block contributes gradients to."""
+    num_q = seq_q_pad // block_q
+    if mask.kind == "full":
+        return 0, num_q
+    causal_first = jnp.minimum((j * block_kv) // block_q, num_q)
+    if mask.kind == "causal":
+        return causal_first, num_q
+    if mask.kind == "prefix_lm":
+        return jnp.where(j * block_kv < mask.prefix, 0, causal_first), num_q
+    # sliding_window: the last row that sees col c is c + window - 1.
+    bound = jnp.minimum(
+        ((j + 1) * block_kv - 1 + mask.window - 1) // block_q + 1, num_q)
+    return causal_first, bound
+
+
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, *rest, block_q: int,
-                      block_kv: int, seq_kv: int, causal: bool,
+                      block_kv: int, seq_kv: int, mask: MaskSpec,
                       sm_scale: float, segments: bool = False):
     if segments:
         qs_ref, ks_ref, o_ref, lse_ref = rest
@@ -46,13 +142,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, *rest, block_q: int,
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * sm_scale  # [block_q, D]
 
-    num_kv_blocks = pl.cdiv(seq_kv, block_kv)
-    if causal:
-        # Highest kv block index any row of this q block may see.
-        last = (qi + 1) * block_q - 1
-        num_visible = jnp.minimum((last // block_kv) + 1, num_kv_blocks)
-    else:
-        num_visible = num_kv_blocks
+    first_visible, num_visible = _q_visible(qi, block_q, block_kv, seq_kv,
+                                            mask)
 
     def body(j, carry):
         acc, m, l = carry
@@ -66,10 +157,9 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, *rest, block_q: int,
         # Mask padded keys (inputs are padded up to a block multiple by the
         # wrapper; without this the pad keys would attend in non-causal mode).
         valid = cols < seq_kv
-        if causal:
-            rows = jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_kv), 0) + qi * block_q
-            valid = jnp.logical_and(valid, rows >= cols)
+        rows = jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 0) + qi * block_q
+        valid = _apply_mask(valid, rows, cols, mask)
         if segments:
             # Packed sequences: attention confined within equal-id spans
             # (padding carries -1 on the kv side, never equal to real ids).
@@ -90,14 +180,15 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, *rest, block_q: int,
     acc0 = jnp.zeros((block_q, d), jnp.float32)
     m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, num_visible, body, (acc0, m0, l0))
+    acc, m, l = jax.lax.fori_loop(first_visible, num_visible, body,
+                                  (acc0, m0, l0))
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
     # Row logsumexp of the scaled scores — the backward's softmax residual.
     lse_ref[0] = m + jnp.log(jnp.maximum(l, 1e-30))
 
 
 def _flash_fwd(q3, k3, v3, seg_q3, seg_kv3, *, group: int, heads: int,
-               causal: bool, block_q: int, block_kv: int, seq_kv: int,
+               mask: MaskSpec, block_q: int, block_kv: int, seq_kv: int,
                sm_scale: float, interpret: bool):
     """q3 [B*H, S, D]; k3/v3 [B*KH, T, D], padded to block multiples; GQA is
     served zero-copy by the K/V index_map (q program bh reads kv row
@@ -111,7 +202,7 @@ def _flash_fwd(q3, k3, v3, seg_q3, seg_kv3, *, group: int, heads: int,
     segments = seg_q3 is not None
     kernel = functools.partial(
         _flash_fwd_kernel, block_q=block_q, block_kv=block_kv, seq_kv=seq_kv,
-        causal=causal, sm_scale=sm_scale, segments=segments)
+        mask=mask, sm_scale=sm_scale, segments=segments)
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
         pl.BlockSpec((1, t, d), lambda b, i: (b // group, 0, 0)),
@@ -142,7 +233,7 @@ def _flash_fwd(q3, k3, v3, seg_q3, seg_kv3, *, group: int, heads: int,
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          *rest, block_q: int, block_kv: int, seq_q: int,
-                         seq_kv: int, causal: bool, sm_scale: float,
+                         seq_kv: int, mask: MaskSpec, sm_scale: float,
                          segments: bool = False):
     if segments:
         qs_ref, ks_ref, dq_ref = rest
@@ -156,12 +247,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     rows = jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_kv), 0) + qi * block_q
 
-    num_kv_blocks = pl.cdiv(seq_kv, block_kv)
-    if causal:
-        last = (qi + 1) * block_q - 1
-        num_visible = jnp.minimum((last // block_kv) + 1, num_kv_blocks)
-    else:
-        num_visible = num_kv_blocks
+    first_visible, num_visible = _q_visible(qi, block_q, block_kv, seq_kv,
+                                            mask)
 
     def body(j, acc):
         k = k_ref[0, pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
@@ -172,8 +259,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         cols = jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_kv), 1) + j * block_kv
         valid = jnp.logical_and(cols < seq_kv, rows < seq_q)
-        if causal:
-            valid = jnp.logical_and(valid, rows >= cols)
+        valid = _apply_mask(valid, rows, cols, mask)
         if segments:
             valid = jnp.logical_and(
                 valid,
@@ -191,7 +277,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32)
 
     d = q_ref.shape[-1]
-    acc = jax.lax.fori_loop(0, num_visible, body,
+    acc = jax.lax.fori_loop(first_visible, num_visible, body,
                             jnp.zeros((block_q, d), jnp.float32))
     dq_ref[0] = (acc * sm_scale).astype(dq_ref.dtype)
 
@@ -199,7 +285,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _flash_bwd_dkv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
                           *rest, block_q: int, block_kv: int,
                           seq_q: int, seq_kv: int, seq_q_pad: int, group: int,
-                          causal: bool, sm_scale: float,
+                          mask: MaskSpec, sm_scale: float,
                           segments: bool = False):
     if segments:
         qs_ref, ks_ref, dk_ref, dv_ref = rest
@@ -212,11 +298,7 @@ def _flash_bwd_dkv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
         jnp.int32, (block_q, block_kv), 1) + j * block_kv
     kv_valid = cols < seq_kv
 
-    num_q_blocks = seq_q_pad // block_q
-    if causal:
-        first = jnp.minimum((j * block_kv) // block_q, num_q_blocks)
-    else:
-        first = 0
+    first, num_q_blocks = _kv_visible(j, block_q, block_kv, seq_q_pad, mask)
 
     d = q_ref.shape[-1]
     dk0 = jnp.zeros((block_kv, d), jnp.float32)
@@ -239,8 +321,7 @@ def _flash_bwd_dkv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
             rows = jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 0) + qi * block_q
             valid = jnp.logical_and(kv_valid, rows < seq_q)
-            if causal:
-                valid = jnp.logical_and(valid, rows >= cols)
+            valid = _apply_mask(valid, rows, cols, mask)
             if segments:
                 valid = jnp.logical_and(
                     valid,
@@ -289,10 +370,11 @@ def _pad_seq(x3, block):
     return x3
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 8))
 def flash_attention(q, k, v, causal: bool = True, block_q: int = 512,
                     block_kv: int = 512, interpret: bool | None = None,
-                    segment_ids: jax.Array | None = None):
+                    segment_ids: jax.Array | None = None,
+                    mask: MaskSpec | str | None = None):
     """Flash attention. q [B,S,H,D]; k,v [B,T,KH,D]; returns [B,S,H,D].
 
     Forward and backward both run fused Pallas kernels (O(S) memory); the
@@ -300,9 +382,19 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 512,
 
     `segment_ids` [B,S] int (self-attention only) confines attention
     within equal-id spans — packed-sequence training with the fused
-    kernels (the splash-style mask, ops/ROADMAP.md item 3)."""
+    kernels (the splash-style mask, ops/ROADMAP.md item 3).
+
+    `mask` (a MaskSpec or kind string) selects the block-sparse mask
+    family — causal / full / prefix_lm / sliding_window — overriding
+    `causal`; fully-masked blocks are skipped in all three kernels.
+    causal/full/sliding_window compose with `segment_ids` (document-window
+    masks: in-document index distance equals position distance, so the
+    window is per-document automatically). prefix_lm does NOT — its
+    boundary is an absolute position, which packed rows restart per
+    document — and is refused with segment_ids rather than silently
+    masking only the first document's prefix."""
     out, _ = _attn_impl(q, k, v, causal, block_q, block_kv, interpret,
-                        segment_ids)
+                        segment_ids, mask)
     return out
 
 
@@ -334,13 +426,20 @@ def _seg3(segment_ids, block, b, s, t):
 
 
 def _attn_impl(q, k, v, causal, block_q, block_kv, interpret,
-               segment_ids=None):
+               segment_ids=None, mask=None):
     b, s, h, d = q.shape
     t, kh = k.shape[1], k.shape[2]
     if h % kh:
         raise ValueError(f"q heads {h} not a multiple of kv heads {kh}")
     block_q, block_kv, interpret = _resolve(q, k, block_q, block_kv,
                                             interpret)
+    spec = _norm_mask(causal, mask)
+    if spec.kind == "prefix_lm" and segment_ids is not None:
+        raise ValueError(
+            "prefix_lm does not compose with segment_ids: packed rows "
+            "restart positions per document, but the prefix boundary is "
+            "an absolute index — only the first document would get a "
+            "bidirectional prefix. Pack prefix-LM data unsegmented.")
     sm_scale = 1.0 / (d ** 0.5)
     q3, k3, v3 = _flatten_heads(q, k, v)
     # Pad sequences to block multiples: unpadded dynamic slices would clamp
@@ -352,7 +451,7 @@ def _attn_impl(q, k, v, causal, block_q, block_kv, interpret,
     sq3 = _seg3(segment_ids, block_q, b, s, t)
     skv3 = _seg3(segment_ids, block_kv, b, s, t)
     o3, lse = _flash_fwd(q3, k3, v3, sq3, skv3, group=h // kh, heads=h,
-                         causal=causal, block_q=block_q, block_kv=block_kv,
+                         mask=spec, block_q=block_q, block_kv=block_kv,
                          seq_kv=t, sm_scale=sm_scale, interpret=interpret)
     out = o3[:, :s].reshape(b, h, s, d).transpose(0, 2, 1, 3)
     return out, (o3, lse)
@@ -367,21 +466,21 @@ def _float0_like(x):
 
 
 def _flash_fwd_rule(q, k, v, causal, block_q, block_kv, interpret,
-                    segment_ids=None):
+                    segment_ids=None, mask=None):
     out, (o3, lse) = _attn_impl(q, k, v, causal, block_q, block_kv,
-                                interpret, segment_ids)
+                                interpret, segment_ids, mask)
     return out, (q, k, v, o3, lse, segment_ids)
 
 
-def _flash_bwd_rule(causal, block_q, block_kv, interpret, res, g):
+def _flash_bwd_rule(causal, block_q, block_kv, interpret, mask, res, g):
     q, k, v, o3, lse, segment_ids = res
     dq, dk, dv = _flash_bwd_impl(q, k, v, o3, lse, g, None, causal, block_q,
-                                 block_kv, interpret, segment_ids)
+                                 block_kv, interpret, segment_ids, mask)
     return dq, dk, dv, _float0_like(segment_ids)
 
 
 def _flash_bwd_impl(q, k, v, o3, lse, g, g_lse, causal, block_q, block_kv,
-                    interpret, segment_ids=None):
+                    interpret, segment_ids=None, mask=None):
     """Shared two-pass backward. `g_lse` [B,S,H,1] (or None) is the LSE
     cotangent: d lse_i/d s_ij = p_ij, so it folds into the delta term —
     ds = p·(dp - (delta - g_lse)) — at zero extra kernel cost."""
@@ -390,6 +489,7 @@ def _flash_bwd_impl(q, k, v, o3, lse, g, g_lse, causal, block_q, block_kv,
     group = h // kh
     block_q, block_kv, interpret = _resolve(q, k, block_q, block_kv,
                                             interpret)
+    spec = _norm_mask(causal, mask)
     sm_scale = 1.0 / (d ** 0.5)
 
     q3, k3, v3 = _flatten_heads(q, k, v)
@@ -415,7 +515,7 @@ def _flash_bwd_impl(q, k, v, o3, lse, g, g_lse, causal, block_q, block_kv,
 
     dq_kernel = functools.partial(
         _flash_bwd_dq_kernel, block_q=block_q, block_kv=block_kv, seq_q=s,
-        seq_kv=t, causal=causal, sm_scale=sm_scale, segments=segments)
+        seq_kv=t, mask=spec, sm_scale=sm_scale, segments=segments)
     dq_specs = [
         pl.BlockSpec((1, block_q, d), lambda bi, i: (bi, i, 0)),
         pl.BlockSpec((1, t_pad, d), lambda bi, i: (bi // group, 0, 0)),
@@ -449,7 +549,7 @@ def _flash_bwd_impl(q, k, v, o3, lse, g, g_lse, causal, block_q, block_kv,
 
     dkv_kernel = functools.partial(
         _flash_bwd_dkv_kernel, block_q=block_q, block_kv=block_kv, seq_q=s,
-        seq_kv=t, seq_q_pad=s_pad, group=group, causal=causal,
+        seq_kv=t, seq_q_pad=s_pad, group=group, mask=spec,
         sm_scale=sm_scale, segments=segments)
     dkv_specs = [
         pl.BlockSpec((1, group * s_pad, d), lambda bi, j: (bi, 0, 0)),

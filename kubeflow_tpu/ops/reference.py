@@ -14,19 +14,36 @@ import jax.numpy as jnp
 
 def naive_attention(q, k, v, *, causal: bool = True,
                     positions_q=None, positions_kv=None,
-                    segment_ids=None, segment_ids_kv=None) -> jax.Array:
+                    segment_ids=None, segment_ids_kv=None,
+                    mask=None) -> jax.Array:
     """q: [B,S,H,D]; k,v: [B,T,KH,D] with H % KH == 0; fp32 softmax.
     Causality is masked by absolute positions when given (packed/offset
     sequences), else by array index. `segment_ids` [B,S] (and optionally a
     separate kv set) additionally confine attention within equal-id spans
-    — the packed-sequence mask."""
+    — the packed-sequence mask. `mask` (a flash_attention.MaskSpec)
+    selects causal/full/prefix_lm/sliding_window, overriding `causal`."""
     b, s, h, d = q.shape
     t, kh = k.shape[1], k.shape[2]
     group = h // kh
     qg = q.reshape(b, s, kh, group, d)
     scores = jnp.einsum("bskgd,btkd->bkgst", qg, k).astype(jnp.float32)
     scores = scores / jnp.sqrt(d).astype(jnp.float32)
-    if causal:
+    if mask is not None:
+        pq = positions_q if positions_q is not None else jnp.arange(s)[None]
+        pk = positions_kv if positions_kv is not None else jnp.arange(t)[None]
+        rows = pq[:, None, None, :, None]
+        cols = pk[:, None, None, None, :]
+        if mask.kind == "causal":
+            m = rows >= cols
+        elif mask.kind == "prefix_lm":
+            m = (rows >= cols) | (cols < mask.prefix)
+        elif mask.kind == "sliding_window":
+            m = (rows >= cols) & (rows - cols < mask.window)
+        else:  # full
+            m = None
+        if m is not None:
+            scores = jnp.where(m, scores, -1e30)
+    elif causal:
         pq = positions_q if positions_q is not None else jnp.arange(s)[None]
         pk = positions_kv if positions_kv is not None else jnp.arange(t)[None]
         mask = pq[:, None, None, :, None] >= pk[:, None, None, None, :]
