@@ -45,6 +45,52 @@ inline std::string ValidateSpec(const std::string& kind, const Json& spec) {
         spec.get("command").size() == 0) {
       return "command must be a non-empty argv array";
     }
+    const Json& rt = spec.get("runtime");
+    if (!rt.is_null()) {
+      if (!rt.is_object()) return "runtime must be an object";
+      // Type-strict: as_string()/as_int() fall back to defaults on a
+      // mismatched JSON type, which would ADMIT e.g. lr_schedule: 5 or
+      // accum_steps: "2" and crash the worker at startup — the exact
+      // late failure this webhook exists to prevent.
+      const Json& sched_v = rt.get("lr_schedule");
+      if (!sched_v.is_null()) {
+        if (!sched_v.is_string()) {
+          return "runtime.lr_schedule must be a string";
+        }
+        const std::string sched = sched_v.as_string();
+        if (sched != "constant" && sched != "cosine" && sched != "linear") {
+          return "runtime.lr_schedule must be constant | cosine | linear";
+        }
+      }
+      const Json& clip = rt.get("max_grad_norm");
+      if (!clip.is_null() && (!clip.is_number() || clip.as_number() < 0)) {
+        return "runtime.max_grad_norm must be a number >= 0";
+      }
+      auto int_knob = [&](const char* field, int64_t dflt, int64_t min,
+                          int64_t* out) -> std::string {
+        const Json& v = rt.get(field);
+        *out = dflt;
+        if (v.is_null()) return "";
+        if (!v.is_number()) {
+          return std::string("runtime.") + field + " must be a number";
+        }
+        *out = v.as_int();
+        if (*out < min) {
+          return std::string("runtime.") + field + " must be >= " +
+                 std::to_string(min);
+        }
+        return "";
+      };
+      std::string err;
+      int64_t accum, batch, ev, eb;
+      if (!(err = int_knob("accum_steps", 1, 1, &accum)).empty()) return err;
+      if (!(err = int_knob("batch_size", -1, -1, &batch)).empty()) return err;
+      if (batch >= 0 && batch % accum) {
+        return "runtime.batch_size must be divisible by accum_steps";
+      }
+      if (!(err = int_knob("eval_every", 0, 0, &ev)).empty()) return err;
+      if (!(err = int_knob("eval_batches", 1, 1, &eb)).empty()) return err;
+    }
     const Json& fault = spec.get("fault");
     if (!fault.is_null()) {
       if (!fault.is_object()) return "fault must be an object";

@@ -172,6 +172,7 @@ def make_train_step(
     loss_impl: str = "full",
     loss_chunk: int = 1024,
     pipeline: dict | None = None,
+    accum_steps: int = 1,
 ) -> Callable[[TrainState, dict], tuple[TrainState, dict]]:
     """Build the jitted train step for a causal-LM-style batch:
       batch = {"inputs": [B,S] int32, "targets": [B,S] int32,
@@ -186,8 +187,15 @@ def make_train_step(
     compiled pipeline schedule over the `pipe` mesh axis
     (models/llama_pp.py) instead of model.apply — params stay in the
     scanned-Llama layout (leading `layers` dim, sharded over `pipe` by the
-    "pipeline" rules); GPipe when C == 1, interleaved circular otherwise."""
+    "pipeline" rules); GPipe when C == 1, interleaved circular otherwise.
+
+    accum_steps > 1 scans the loss+grad over accum_steps row-slices of the
+    batch, averaging grads before the (single) optimizer update — identical
+    optimizer math to the full batch at 1/accum_steps the activation
+    memory (the reference SDK's gradient_accumulation_steps)."""
     model_kwargs = model_kwargs or {}
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
     if loss_impl not in ("full", "chunked"):
         raise ValueError(f"loss_impl {loss_impl!r}: full | chunked")
     if loss_impl == "chunked" and loss_fn is not None:
@@ -283,9 +291,37 @@ def make_train_step(
     loss_impl_fn = pipeline_loss if pipeline is not None else compute_loss
 
     def step(state: TrainState, batch: dict):
-        batch = jax.tree.map(constrain_batch, batch)
-        (loss, aux), grads = jax.value_and_grad(loss_impl_fn, has_aux=True)(
-            state.params, batch)
+        if accum_steps > 1:
+            # Scan over row-slices; the grad carry costs one extra
+            # params-sized buffer.
+            def split(x):
+                if x.shape[0] % accum_steps:
+                    raise ValueError(
+                        f"batch dim {x.shape[0]} not divisible by "
+                        f"accum_steps {accum_steps}")
+                return x.reshape(
+                    (accum_steps, x.shape[0] // accum_steps) + x.shape[1:])
+
+            micro = jax.tree.map(split, batch)
+
+            def body(carry, mb):
+                mb = jax.tree.map(constrain_batch, mb)
+                (mloss, maux), mgrads = jax.value_and_grad(
+                    loss_impl_fn, has_aux=True)(state.params, mb)
+                gsum, lsum, asum = carry
+                gsum = jax.tree.map(jnp.add, gsum, mgrads)
+                return (gsum, lsum + mloss, asum + maux), None
+
+            zeros = jax.tree.map(jnp.zeros_like, state.params)
+            (gsum, lsum, asum), _ = jax.lax.scan(
+                body, (zeros, jnp.zeros((), jnp.float32),
+                       jnp.zeros((), jnp.float32)), micro)
+            grads = jax.tree.map(lambda g: g / accum_steps, gsum)
+            loss, aux = lsum / accum_steps, asum / accum_steps
+        else:
+            batch = jax.tree.map(constrain_batch, batch)
+            (loss, aux), grads = jax.value_and_grad(
+                loss_impl_fn, has_aux=True)(state.params, batch)
         new_state = state.apply_gradients(grads)
         gnorm = optax.global_norm(grads)
         return new_state, {"loss": loss, "aux_loss": aux,

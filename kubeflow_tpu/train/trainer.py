@@ -45,6 +45,18 @@ class TrainJobSpec:
     learning_rate: float = 1e-3
     warmup_steps: int = 0
     weight_decay: float = 0.0
+    # Peak-LR decay after warmup: "constant" | "cosine" | "linear", decaying
+    # to lr_final over the remaining spec.steps (the reference SDK's HF
+    # trainer exposes the same three families).
+    lr_schedule: str = "constant"
+    lr_final: float = 0.0
+    # 0 disables clipping; > 0 wires optax.clip_by_global_norm ahead of
+    # adamw (the reported grad_norm metric stays pre-clip).
+    max_grad_norm: float = 0.0
+    # > 1 splits each global batch into accum_steps microbatches scanned
+    # inside the jitted step, averaging grads — same optimizer math at
+    # 1/accum_steps the activation memory.
+    accum_steps: int = 1
     seed: int = 0
     # False | True/"ring" (contiguous ring CP) | "ring_flash" (fused Pallas
     # inner block) | "zigzag"/"zigzag_flash" (balanced causal schedule: the
@@ -66,6 +78,13 @@ class TrainJobSpec:
     profile: dict = dataclasses.field(default_factory=dict)
     # {"dir": str, "start_step": int, "num_steps": int}
     log_every: int = 10
+    # In-run validation stream: every eval_every steps (0 = off), run
+    # eval_batches batches of eval_dataset (default: the train dataset with
+    # a disjoint seed) through make_eval_step and log eval_loss/accuracy.
+    eval_dataset: str | None = None
+    eval_dataset_kwargs: dict = dataclasses.field(default_factory=dict)
+    eval_every: int = 0
+    eval_batches: int = 8
 
     @classmethod
     def from_json(cls, text: str) -> "TrainJobSpec":
@@ -147,13 +166,24 @@ class Trainer:
         self.model, self.info = registry.build_model(
             spec.model, **model_kwargs)
 
-        sched: optax.Schedule | float
-        if spec.warmup_steps:
-            sched = optax.linear_schedule(0.0, spec.learning_rate,
-                                          spec.warmup_steps)
-        else:
-            sched = spec.learning_rate
-        self.tx = optax.adamw(sched, weight_decay=spec.weight_decay)
+        if spec.accum_steps < 1:
+            raise ValueError(f"accum_steps must be >= 1, got "
+                             f"{spec.accum_steps}")
+        if spec.batch_size % spec.accum_steps:
+            raise ValueError(
+                f"batch_size {spec.batch_size} not divisible by "
+                f"accum_steps {spec.accum_steps}")
+        if spec.eval_every < 0 or spec.eval_batches < 1:
+            raise ValueError("eval_every must be >= 0 and eval_batches "
+                             ">= 1")
+        self.tx = optax.adamw(self._lr_schedule(),
+                              weight_decay=spec.weight_decay)
+        if spec.max_grad_norm:
+            if spec.max_grad_norm < 0:
+                raise ValueError(f"max_grad_norm must be >= 0, got "
+                                 f"{spec.max_grad_norm}")
+            self.tx = optax.chain(
+                optax.clip_by_global_norm(spec.max_grad_norm), self.tx)
 
         self._ckpt = None
         if spec.checkpoint.get("dir"):
@@ -162,6 +192,30 @@ class Trainer:
                 interval=spec.checkpoint.get("interval", 50),
                 keep=spec.checkpoint.get("keep", 3))
         self.logger = MetricsLogger(spec.metrics_path)
+
+    def _lr_schedule(self) -> optax.Schedule | float:
+        spec = self.spec
+        peak, warm = spec.learning_rate, spec.warmup_steps
+        if spec.lr_schedule == "constant":
+            if warm:
+                return optax.linear_schedule(0.0, peak, warm)
+            return peak
+        # Decay horizon is the full run: warmup then decay to lr_final at
+        # spec.steps (resume keeps the schedule aligned since opt step
+        # count rides in the checkpointed opt_state).
+        decay_steps = max(spec.steps - warm, 1)
+        if spec.lr_schedule == "cosine":
+            return optax.warmup_cosine_decay_schedule(
+                0.0, peak, warm, warm + decay_steps,
+                end_value=spec.lr_final)
+        if spec.lr_schedule == "linear":
+            decay = optax.linear_schedule(peak, spec.lr_final, decay_steps)
+            if not warm:
+                return decay
+            return optax.join_schedules(
+                [optax.linear_schedule(0.0, peak, warm), decay], [warm])
+        raise ValueError(
+            f"lr_schedule {spec.lr_schedule!r}: constant | cosine | linear")
 
     # -- data ---------------------------------------------------------------
 
@@ -200,24 +254,43 @@ class Trainer:
                 f"{g} batch replica groups")
         return self.spec.batch_size // g
 
-    def _data(self) -> Iterator[dict]:
+    def _make_stream(self, name: str, kwargs: dict,
+                     seed_base: int) -> Iterator[dict]:
+        """Shared dataset-builder: model-derived defaults plus the batch
+        replica-group contract — processes sharing a batch shard (or a
+        fully replicated batch) must load IDENTICAL data: same seed AND
+        the same grain row shard (the loader's sharding is group-indexed,
+        not process-indexed)."""
         from kubeflow_tpu.utils import registry
 
-        kwargs = dict(self.spec.dataset_kwargs)
+        kwargs = dict(kwargs)
         kwargs.setdefault("batch_size", self.local_batch_size)
         if self.info.get("task") == "lm":
             kwargs.setdefault("seq_len", self.spec.seq_len)
             kwargs.setdefault("vocab_size", self.info["vocab_size"])
-        # One distinct stream per batch replica group: processes sharing a
-        # batch shard (or a fully replicated batch) must load IDENTICAL
-        # data — same seed AND the same grain row shard (the loader's
-        # sharding is group-indexed, not process-indexed).
         n = jax.process_count()
         group = jax.process_index() * self._batch_groups // n
-        kwargs.setdefault("seed", self.spec.seed + 7919 * group)
+        kwargs.setdefault("seed", seed_base + 7919 * group)
         kwargs.setdefault("process_index", group)
         kwargs.setdefault("process_count", self._batch_groups)
-        return registry.build_dataset(self.spec.dataset, **kwargs)
+        return registry.build_dataset(name, **kwargs)
+
+    def _data(self) -> Iterator[dict]:
+        return self._make_stream(self.spec.dataset,
+                                 self.spec.dataset_kwargs, self.spec.seed)
+
+    def _eval_data(self) -> Iterator[dict]:
+        """Validation stream. Defaults to the train dataset family —
+        INCLUDING its kwargs (a token_file corpus path must carry over) —
+        with a disjoint seed so synthetic/eval-less corpora still get a
+        held-out-like stream."""
+        if self.spec.eval_dataset:
+            name, kwargs = self.spec.eval_dataset, self.spec.eval_dataset_kwargs
+        else:
+            name = self.spec.dataset
+            kwargs = {**self.spec.dataset_kwargs,
+                      **self.spec.eval_dataset_kwargs}
+        return self._make_stream(name, kwargs, self.spec.seed + 104729)
 
     def _globalize(self, batch: dict) -> dict:
         """Assemble process-local numpy batches into global jax.Arrays
@@ -295,7 +368,52 @@ class Trainer:
                                   model_kwargs=model_kwargs,
                                   loss_impl=spec.loss_impl,
                                   loss_chunk=spec.loss_chunk,
-                                  pipeline=self._pipeline)
+                                  pipeline=self._pipeline,
+                                  accum_steps=spec.accum_steps)
+
+        eval_step = None
+        if spec.eval_every:
+            from kubeflow_tpu.train.step import make_eval_step
+
+            eval_step = make_eval_step(self.model, self.mesh, self.rules,
+                                       model_kwargs=model_kwargs)
+
+        # One persistent eval stream for the whole run: file-backed
+        # corpora pay their tokenize/pack cost in the constructor, so
+        # rebuilding per window would stall training every eval_every
+        # steps. Rebuilt only when exhausted.
+        eval_iter_box: list = [None]
+
+        def next_eval_batch():
+            for _ in range(2):
+                if eval_iter_box[0] is None:
+                    eval_iter_box[0] = iter(self._eval_data())
+                try:
+                    return next(eval_iter_box[0])
+                except StopIteration:
+                    eval_iter_box[0] = None  # exhausted: fresh pass
+            return None
+
+        def run_eval(params, at_step):
+            losses, accs, seen = [], [], 0
+            for _ in range(spec.eval_batches):
+                raw = next_eval_batch()
+                if raw is None:
+                    break
+                if zigzag_idx is not None:
+                    raw = {k: np.asarray(v)[:, zigzag_idx]
+                           for k, v in raw.items()}
+                m = eval_step(params, self._globalize(raw))
+                losses.append(float(m["loss"]))
+                accs.append(float(m["accuracy"]))
+                seen += 1
+            if not seen:
+                return {}
+            out = {"eval_loss": sum(losses) / seen,
+                   "eval_accuracy": sum(accs) / seen,
+                   "eval_batches": seen}
+            self.logger.log(at_step, out)
+            return out
 
         tokens_per_step = spec.batch_size * (
             spec.seq_len if self.info.get("task") == "lm" else 1)
@@ -362,6 +480,7 @@ class Trainer:
             fault_signal = int(kv.get("signal", 9))
 
         last_metrics: dict = {}
+        last_eval: dict = {}
         timer.start()
         window = 0
         for step in range(start_step, spec.steps):
@@ -394,13 +513,25 @@ class Trainer:
                     data_state=(pack_data_state()
                                 if self._ckpt.should_save(step + 1)
                                 else None))
+            if eval_step is not None and (step + 1) % spec.eval_every == 0:
+                # Close the timing window first so eval wall time never
+                # pollutes the train tokens/sec / MFU averages.
+                if window:
+                    jax.block_until_ready(metrics["loss"])
+                    timer.stop(n_steps=window)
+                    window = 0
+                last_eval = run_eval(state.params, step + 1)
+                timer.start()
             if (step + 1) % spec.log_every == 0 or step + 1 == spec.steps:
                 # Block only at logging boundaries — keeping the dispatch
                 # queue full between them lets host data prep overlap device
                 # compute (the per-step numbers are window averages).
                 jax.block_until_ready(metrics["loss"])
-                perf = timer.stop(n_steps=window)
-                window = 0
+                if window:
+                    perf = timer.stop(n_steps=window)
+                    window = 0
+                else:  # an eval just flushed this window
+                    perf = timer.snapshot()
                 last_metrics = {
                     "loss": float(metrics["loss"]),
                     "grad_norm": float(metrics["grad_norm"]),
@@ -420,8 +551,9 @@ class Trainer:
                                       data_state=pack_data_state(),
                                       force=True)
             self._ckpt.wait()
-        self.logger.log(spec.steps, {"event": "done", **last_metrics})
-        return {"final_step": spec.steps, **last_metrics}
+        self.logger.log(spec.steps,
+                        {"event": "done", **last_metrics, **last_eval})
+        return {"final_step": spec.steps, **last_metrics, **last_eval}
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -160,6 +160,21 @@ def test_fault_spec_validation(controlplane):
         client.submit_jaxjob("badfault", spec)
 
 
+def test_runtime_spec_admission(controlplane):
+    """Fine-tune runtime knobs are validated at submit time (webhook
+    analog), not discovered as a worker crash later."""
+    client, sock, workdir, tmp = controlplane
+    spec = _mnist_spec(steps=10)
+    spec["runtime"]["lr_schedule"] = "exponential"
+    with pytest.raises(Exception, match="lr_schedule"):
+        client.submit_jaxjob("badlr", spec)
+    spec = _mnist_spec(steps=10)
+    spec["runtime"]["batch_size"] = 8
+    spec["runtime"]["accum_steps"] = 3
+    with pytest.raises(Exception, match="accum_steps"):
+        client.submit_jaxjob("badaccum", spec)
+
+
 def test_elastic_resubmit_at_different_replica_count(controlplane):
     """Elastic resize through the control plane (SURVEY.md §5.3): a 2-worker
     job checkpoints and completes; resubmitting at 1 worker (half the
