@@ -84,18 +84,43 @@ class GenerationEngine:
 
     def __init__(self, model, params, cfg, *, slots: int = 4,
                  max_len: int = 256, chunk: int = 16,
-                 prefill_buckets: Sequence[int] = (32, 128), seed: int = 0):
+                 prefill_buckets: Sequence[int] = (32, 128),
+                 decode_buckets: Sequence[int] | None = None,
+                 prefix_cache: int = 0, seed: int = 0):
         self.model, self.cfg = model, cfg
         self.max_len, self.chunk, self.n_slots = int(max_len), int(chunk), int(slots)
         self.prefill_buckets = sorted(
             {min(int(b), self.max_len) for b in prefill_buckets})
+        # Length-aware decode (VERDICT r2 item 4): decode compiles once PER
+        # CACHE-LENGTH BUCKET over a time-sliced cache, so attention cost
+        # tracks the longest ACTIVE sequence, not max_len. Default buckets:
+        # powers of two from max(64, 2·chunk) up to max_len.
+        if decode_buckets is None:
+            b, decode_buckets = max(64, 2 * self.chunk), []
+            while b < self.max_len:
+                decode_buckets.append(b)
+                b *= 2
+        self.decode_buckets = sorted(
+            {int(b) for b in decode_buckets
+             if self.chunk < int(b) < self.max_len} | {self.max_len})
+        # Prefix cache: LRU of prompt-chunk-boundary KV fragments keyed by
+        # the exact token prefix; admission resumes chunked prefill after
+        # the longest hit instead of recomputing it (the vLLM prefix-reuse
+        # capability, at bucket granularity). Capacity in fragments —
+        # OPT-IN (0 = off): each fragment is a full-length KV copy, so
+        # the cache charges real HBM; enable it for shared-system-prompt
+        # workloads where the recompute saving pays for the residency.
+        self._prefix_cap = int(prefix_cache)
+        from collections import OrderedDict
+        self._prefix_lru: "OrderedDict[tuple, Any]" = OrderedDict()
         self._params = jax.device_put(params)
         self._key = jax.random.key(seed)
         self._queue: queue.Queue = queue.Queue()
         self._wake = threading.Event()
         self._stop = False
         self.stats = {"requests": 0, "prompt_tokens": 0, "decode_tokens": 0,
-                      "decode_seconds": 0.0, "decode_dispatches": 0}
+                      "decode_seconds": 0.0, "decode_dispatches": 0,
+                      "prefix_hits": 0, "prefix_hit_tokens": 0}
         self._compile()
         from kubeflow_tpu.models.llama import init_cache
         self._cache = jax.jit(
@@ -113,14 +138,18 @@ class GenerationEngine:
         from kubeflow_tpu.models.llama import init_cache
 
         # Fragment caches carry headroom of one max bucket past max_len
-        # WHEN chunked admission is reachable: the FINAL chunk's bucket
-        # padding may extend past max_len, and dynamic_update_slice would
-        # otherwise CLAMP the start index, shifting the write backwards
-        # over real prompt rows (silent corruption). Pad rows land in the
-        # slack and are dropped at insert; real prompt rows never exceed
-        # max_len-1 (submit bound).
+        # WHEN offset writes can happen — chunked admission, or a prefix-
+        # cache hit resuming mid-prompt (either makes _extend write a
+        # bucket-wide update at a nonzero offset whose padding may extend
+        # past max_len, and dynamic_update_slice would otherwise CLAMP the
+        # start index, shifting the write backwards over real prompt rows:
+        # silent KV corruption). Pad rows land in the slack and are
+        # dropped at insert; real prompt rows never exceed max_len-1
+        # (submit bound).
         big = self.prefill_buckets[-1]
-        frag_len = self.max_len + (big if big < self.max_len - 1 else 0)
+        self._may_chunk = big < self.max_len - 1
+        offset_writes = self._may_chunk or self._prefix_cap > 0
+        frag_len = self.max_len + (big if offset_writes else 0)
 
         def prefill(params, tokens, length, temperature, top_k, top_p,
                     key):
@@ -171,30 +200,43 @@ class GenerationEngine:
                         c.dtype),
                     (0, slot) + (0,) * (c.ndim - 2)), cache, frag)
 
-        def make_decode(truncate: bool):
+        def make_decode(truncate: bool, bucket: int):
             def decode_chunk(params, cache, last_tok, index, temperature,
                              top_k, top_p, key):
                 """K decode steps under one dispatch; on-device sampling.
                 last_tok/index/temperature [B]; returns (cache,
                 tokens [B, K]). The non-truncating variant skips the
                 full-vocab sort/cumsum — all-greedy/plain-temperature
-                traffic (the defaults) must not pay O(V log V) per token."""
+                traffic (the defaults) must not pay O(V log V) per token.
+                Attention runs over the first `bucket` cache rows only
+                (the loop picks the smallest bucket covering every active
+                sequence), then the slice is written back."""
+                sliced = (cache if bucket == self.max_len else jax.tree.map(
+                    lambda c: jax.lax.slice_in_dim(c, 0, bucket, axis=2),
+                    cache))
+
                 def step(carry, _):
-                    cache, tok, idx, key = carry
+                    sliced, tok, idx, key = carry
                     key, sub = jax.random.split(key)
-                    logits, cache = model.apply(
-                        {"params": params}, tok[:, None], cache=cache,
-                        cache_index=jnp.minimum(idx, self.max_len - 1))
+                    logits, sliced = model.apply(
+                        {"params": params}, tok[:, None], cache=sliced,
+                        cache_index=jnp.minimum(idx, bucket - 1))
                     if truncate:
                         nxt = sample_tokens(logits[:, 0], temperature, sub,
                                             top_k, top_p)
                     else:
                         nxt = sample_tokens(logits[:, 0], temperature, sub)
-                    return (cache, nxt, idx + 1, key), nxt
+                    return (sliced, nxt, idx + 1, key), nxt
 
-                (cache, _, _, _), toks = jax.lax.scan(
-                    step, (cache, last_tok, index, key), None,
+                (sliced, _, _, _), toks = jax.lax.scan(
+                    step, (sliced, last_tok, index, key), None,
                     length=self.chunk)
+                if bucket != self.max_len:
+                    cache = jax.tree.map(
+                        lambda c, s: jax.lax.dynamic_update_slice(
+                            c, s, (0,) * c.ndim), cache, sliced)
+                else:
+                    cache = sliced
                 return cache, toks.T
             return decode_chunk
 
@@ -203,11 +245,9 @@ class GenerationEngine:
         self._extend = jax.jit(extend, donate_argnums=(1,))
         self._extend_mid = jax.jit(extend_mid, donate_argnums=(1,))
         self._insert = jax.jit(insert, donate_argnums=(0,))
-        # Chunked admission only happens when a legal prompt can exceed
-        # the largest bucket.
-        self._may_chunk = self.prefill_buckets[-1] < self.max_len - 1
-        self._decode_trunc = jax.jit(make_decode(True), donate_argnums=(1,))
-        self._decode_plain = jax.jit(make_decode(False), donate_argnums=(1,))
+        self._decode = {
+            (b, trunc): jax.jit(make_decode(trunc, b), donate_argnums=(1,))
+            for b in self.decode_buckets for trunc in (False, True)}
 
     def _warmup(self):
         """Pay every compile before serving: one prefill per bucket, one
@@ -221,7 +261,7 @@ class GenerationEngine:
             frag, _ = self._prefill[b](
                 self._params, jnp.zeros((1, b), jnp.int32), one_l, zero_t,
                 zero_k, one_p, self._key)
-        if self._may_chunk:  # chunked-prompt continuation path
+        if self._may_chunk or self._prefix_cap:  # offset-write paths
             # Intermediate chunks always use the largest bucket; the
             # final (sampling) chunk can land on any bucket.
             frag = self._extend_mid(
@@ -234,7 +274,7 @@ class GenerationEngine:
                     one_l, zero_k, zero_t, zero_k, one_p, self._key)
         self._cache = self._insert(self._cache, frag, jnp.int32(0))
         n = self.n_slots
-        for fn in (self._decode_plain, self._decode_trunc):
+        for fn in self._decode.values():
             self._cache, _ = fn(
                 self._params, self._cache, jnp.zeros((n,), jnp.int32),
                 jnp.zeros((n,), jnp.int32), jnp.zeros((n,), jnp.float32),
@@ -295,6 +335,36 @@ class GenerationEngine:
                 return b
         return self.prefill_buckets[-1]
 
+    # -- prefix cache --------------------------------------------------------
+
+    def _prefix_lookup(self, ids: list[int]) -> tuple[int, Any] | None:
+        """Longest cached chunk-boundary prefix STRICTLY shorter than the
+        prompt (the final token's logits must still be computed). Returns
+        (matched_len, fresh fragment copy) or None."""
+        best = None
+        for key in self._prefix_lru:
+            n = len(key)
+            if (n < len(ids) and (best is None or n > len(best))
+                    and list(key) == ids[:n]):
+                best = key
+        if best is None:
+            return None
+        self._prefix_lru.move_to_end(best)
+        frag = jax.tree.map(jnp.copy, self._prefix_lru[best])
+        return len(best), frag
+
+    def _prefix_store(self, key: tuple, frag) -> None:
+        """Snapshot a fragment at a prompt-chunk boundary. Rows past the
+        keyed prefix may hold pad/stale K/V — safe, because any reader
+        overwrites row i before its query positions can reach it (absolute-
+        position masking hides rows above the current index)."""
+        if key in self._prefix_lru:
+            self._prefix_lru.move_to_end(key)
+            return
+        self._prefix_lru[key] = jax.tree.map(jnp.copy, frag)
+        while len(self._prefix_lru) > self._prefix_cap:
+            self._prefix_lru.popitem(last=False)
+
     def _admit(self, slot: int, req: dict) -> None:
         ids = req["input_ids"]
         sample_args = (
@@ -308,6 +378,12 @@ class GenerationEngine:
         # truncation (submit() already bounds the prompt by max_len).
         big = self.prefill_buckets[-1]
         frag, tok0, done = None, None, 0
+        if self._prefix_cap:
+            hit = self._prefix_lookup(ids)
+            if hit is not None:
+                done, frag = hit
+                self.stats["prefix_hits"] += 1
+                self.stats["prefix_hit_tokens"] += done
         while done < len(ids):
             piece = ids[done:done + big]
             final = done + len(piece) >= len(ids)
@@ -330,6 +406,8 @@ class GenerationEngine:
                     self._params, frag, jnp.asarray(toks),
                     jnp.asarray([done], jnp.int32))
             done += len(piece)
+            if self._prefix_cap:
+                self._prefix_store(tuple(ids[:done]), frag)
         self._cache = self._insert(self._cache, frag, jnp.int32(slot))
         first = int(tok0[0])
         self._slots[slot] = {"req": req, "idx": len(ids), "last": first}
@@ -391,9 +469,14 @@ class GenerationEngine:
             t0 = time.monotonic()
             # Truncation costs a full-vocab sort per step; only pay it
             # when some active request actually asked for top-k/top-p.
-            decode = (self._decode_trunc
-                      if any(ks[i] > 0 or ps[i] < 1.0 for i in active)
-                      else self._decode_plain)
+            # The cache-length bucket is the smallest covering every
+            # active sequence after this chunk — short conversations
+            # never pay max_len-wide attention.
+            trunc = any(ks[i] > 0 or ps[i] < 1.0 for i in active)
+            need = max(int(idx[i]) for i in active) + self.chunk
+            bucket = next((b for b in self.decode_buckets if b >= need),
+                          self.max_len)
+            decode = self._decode[(bucket, trunc)]
             self._cache, toks = decode(
                 self._params, self._cache, jnp.asarray(last),
                 jnp.asarray(idx), jnp.asarray(temps), jnp.asarray(ks),
@@ -485,4 +568,6 @@ class GenerativeJAXModel(Model):
             "vocab_size": getattr(self.cfg, "vocab_size", None),
             "stats": dict(self.engine.stats) if self.engine else {},
         })
+        if self.engine:
+            md["decode_buckets"] = list(self.engine.decode_buckets)
         return md
