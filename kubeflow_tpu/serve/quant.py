@@ -8,8 +8,9 @@ per-channel multiply) fuses into the consuming matmul's operand read under
 XLA — decode steps are HBM-bandwidth-bound, so halving weight bytes is a
 direct throughput lever (ops/ROADMAP.md item: quantized serving).
 
-Scheme: symmetric per-channel (max-abs over the leading contraction axis)
-int8, fp32 scales of shape `leaf.shape[1:]`. Quantized leaves are a
+Scheme: symmetric per-channel (max-abs over the largest axis — the
+contraction/in dim for 2-D kernels and scanned layer stacks alike) int8,
+fp32 scales with that axis kept at 1 for broadcast. Quantized leaves are a
 registered pytree node (`Int8Leaf`), so the quantized tree flows through
 jit / device_put / AOT lowering like any params tree, and `QuantizedModule`
 makes it transparent to every consumer that calls `model.apply` (the
@@ -48,26 +49,51 @@ def _is_quant_leaf(x: Any) -> bool:
     return isinstance(x, Int8Leaf)
 
 
+def _contraction_axes(path_names: list[str], ndim: int) -> tuple[int, ...]:
+    """Axes to max-abs over = the matmul CONTRACTION axes, so scales are
+    per-output-channel (the standard weight-only scheme) and tiny. Known
+    kernel families by name; a leading scan/layers dim (ndim >= 3) is
+    never reduced — scales stay per-layer. Reducing over axis 0
+    unconditionally (the old scheme) maxed over LAYERS on scanned stacks
+    and stored a near-full-size fp32 scale tensor."""
+    if "o_proj" in path_names and ndim >= 3:
+        return (ndim - 3, ndim - 2)  # [..., heads, head_dim, out]
+    if any(n in path_names for n in ("q_proj", "k_proj", "v_proj")) \
+            and ndim >= 3:
+        return (ndim - 3,)           # [..., in, heads, head_dim]
+    if path_names and path_names[-1] == "embed":
+        return (ndim - 1,)           # [vocab, D]: tied unembed contracts D
+    return (ndim - 2,)               # [..., in, out]
+
+
 def quantize_tree(params: Any, *, min_size: int = 4096) -> Any:
     """Replace large float leaves with Int8Leaf.
 
     Leaves smaller than `min_size` elements (norm scales, biases) stay in
     full precision — they are bandwidth-irrelevant and precision-critical.
     """
-    def quant(leaf):
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+
+    def quant(path, leaf):
         if not hasattr(leaf, "dtype") or not jnp.issubdtype(
                 jnp.asarray(leaf).dtype, jnp.floating):
             return leaf
         arr = jnp.asarray(leaf)
         if arr.ndim < 2 or arr.size < min_size:
             return leaf
+        # Dict keys only: boxed params (nn.Partitioned) append attr keys
+        # like `.value` that would shadow the trailing param name.
+        names = [str(k.key) for k in path if hasattr(k, "key")]
         a32 = arr.astype(jnp.float32)
-        amax = jnp.max(jnp.abs(a32), axis=0)  # per-channel over contraction
+        amax = jnp.max(jnp.abs(a32),
+                       axis=_contraction_axes(names, arr.ndim),
+                       keepdims=True)
         scale = jnp.maximum(amax, 1e-12) / 127.0
         q = jnp.clip(jnp.round(a32 / scale), -127, 127).astype(jnp.int8)
         return Int8Leaf(q, scale)
 
-    return jax.tree.map(quant, params)
+    return jax.tree_util.tree_unflatten(
+        treedef, [quant(p, l) for p, l in flat])
 
 
 def dequantize_tree(params: Any, dtype: Any = jnp.bfloat16) -> Any:
