@@ -99,5 +99,26 @@ def main() -> None:
     print(json.dumps(result))
 
 
+def main_serve() -> None:
+    """`python bench.py --serve`: serving benchmark → SERVEBENCH.json +
+    one JSON line on stdout (kubeflow_tpu/serve/bench.py)."""
+    from kubeflow_tpu.serve.bench import run_servebench
+
+    result = run_servebench(size="1b", quick=False)
+    with open("SERVEBENCH.json", "w") as fh:
+        json.dump(result, fh, indent=1)
+    print(json.dumps({
+        "metric": "serve_decode_tok_s",
+        "value": result["decode"][
+            f"slots_{max(int(k.split('_')[1]) for k in result['decode'])}"][
+                "decode_tok_s"],
+        "unit": "tok/s",
+        "detail": "SERVEBENCH.json",
+    }))
+
+
 if __name__ == "__main__":
-    main()
+    if "--serve" in sys.argv:
+        main_serve()
+    else:
+        main()

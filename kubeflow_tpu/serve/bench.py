@@ -1,0 +1,283 @@
+"""Serving benchmark harness (VERDICT r2 item 3): measured numbers for the
+TPU-native serving stack — decode throughput vs slot count, TTFT per
+prefill bucket, chunked-prefill admission cost, length-aware decode-bucket
+speedup, int8-weight-only vs bf16 delta, and batcher latency percentiles.
+
+`python bench.py --serve` runs it on whatever chip is present and writes
+`SERVEBENCH.json`; the regression test pins the harness on a tiny config.
+The reference inherits vLLM's numbers for its huggingfaceserver
+⟨kserve: python/huggingfaceserver⟩ — this is the artifact that lets the
+TPU stack's claims be checked instead of asserted.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _build_model(size: str):
+    from kubeflow_tpu.models.llama import (Llama, llama_1b, llama_tiny)
+
+    import dataclasses
+    if size == "tiny":
+        cfg = dataclasses.replace(llama_tiny(), dtype=jnp.float32,
+                                  num_layers=2)
+    else:
+        cfg = llama_1b()
+    model = Llama(cfg)
+    toks = jnp.zeros((1, 8), jnp.int32)
+    params = jax.jit(lambda r: model.init(r, toks)["params"])(
+        jax.random.key(0))
+    return model, params, cfg
+
+
+def _drain(engine, prompts, max_tokens):
+    """Submit all prompts concurrently; return wall seconds start→last."""
+    done = []
+    errs = []
+
+    def run(p):
+        try:
+            done.append(engine.submit(p, max_tokens=max_tokens))
+        except Exception as e:  # pragma: no cover - surfaced in result
+            errs.append(str(e))
+
+    threads = [threading.Thread(target=run, args=(p,)) for p in prompts]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+    dt = time.monotonic() - t0
+    if errs:
+        raise RuntimeError(f"servebench requests failed: {errs[:3]}")
+    return dt, done
+
+
+def bench_decode_slots(model, params, cfg, *, slots_list: Sequence[int],
+                      max_len: int, chunk: int, buckets, decode_tokens: int,
+                      rng: np.random.Generator) -> dict:
+    """Decode tok/s at each concurrency: N greedy requests on N slots."""
+    from kubeflow_tpu.serve.generation import GenerationEngine
+
+    out = {}
+    for slots in slots_list:
+        eng = GenerationEngine(model, params, cfg, slots=slots,
+                               max_len=max_len, chunk=chunk,
+                               prefill_buckets=buckets, prefix_cache=0)
+        try:
+            prompts = [list(rng.integers(1, cfg.vocab_size, 16))
+                       for _ in range(slots)]
+            _drain(eng, prompts, decode_tokens)
+            s = eng.stats
+            out[f"slots_{slots}"] = {
+                "decode_tok_s": round(s["decode_tokens"]
+                                      / max(s["decode_seconds"], 1e-9), 1),
+                "decode_dispatches": s["decode_dispatches"],
+            }
+        finally:
+            eng.close()
+    return out
+
+
+def bench_decode_buckets(model, params, cfg, *, max_len: int, chunk: int,
+                         buckets, decode_tokens: int,
+                         rng: np.random.Generator) -> dict:
+    """Length-aware decode win: short conversations on bucketed vs flat
+    (max_len-wide) decode — the VERDICT r2 item 4 'measured speedup'."""
+    from kubeflow_tpu.serve.generation import GenerationEngine
+
+    res = {}
+    for label, dbuckets in (("bucketed", None), ("flat", [max_len])):
+        eng = GenerationEngine(model, params, cfg, slots=4, max_len=max_len,
+                               chunk=chunk, prefill_buckets=buckets,
+                               decode_buckets=dbuckets, prefix_cache=0)
+        try:
+            prompts = [list(rng.integers(1, cfg.vocab_size, 8))
+                       for _ in range(4)]
+            _drain(eng, prompts, decode_tokens)
+            s = eng.stats
+            res[label] = s["decode_tokens"] / max(s["decode_seconds"], 1e-9)
+        finally:
+            eng.close()
+    return {
+        "bucketed_tok_s": round(res["bucketed"], 1),
+        "flat_tok_s": round(res["flat"], 1),
+        "speedup": round(res["bucketed"] / max(res["flat"], 1e-9), 3),
+    }
+
+
+def bench_ttft(model, params, cfg, *, max_len: int, chunk: int, buckets,
+               rng: np.random.Generator) -> dict:
+    """Time-to-first-token per prefill bucket (1 generated token), plus
+    the chunked-admission cost of a prompt past the largest bucket."""
+    from kubeflow_tpu.serve.generation import GenerationEngine
+
+    eng = GenerationEngine(model, params, cfg, slots=1, max_len=max_len,
+                           chunk=chunk, prefill_buckets=buckets,
+                           prefix_cache=0)
+    ttft = {}
+    try:
+        for b in eng.prefill_buckets:
+            n = max(b - 1, 1)
+            lat = []
+            for _ in range(3):
+                r = eng.submit(list(rng.integers(1, cfg.vocab_size, n)),
+                               max_tokens=1)
+                lat.append(r["latency_s"])
+            ttft[str(b)] = round(min(lat), 4)
+        chunked = {}
+        big = eng.prefill_buckets[-1]
+        if big < max_len - 1:  # chunked-prefill reachable
+            n = min(2 * big + big // 2, max_len - 1)
+            lat = []
+            for _ in range(3):
+                r = eng.submit(list(rng.integers(1, cfg.vocab_size, n)),
+                               max_tokens=1)
+                lat.append(r["latency_s"])
+            chunked = {"prompt_len": n, "admission_s": round(min(lat), 4)}
+    finally:
+        eng.close()
+    return {"ttft_s": ttft, "chunked_prefill": chunked}
+
+
+def bench_quant(model, params, cfg, *, max_len: int, chunk: int, buckets,
+                decode_tokens: int, rng: np.random.Generator) -> dict:
+    """Weight-only int8 vs bf16 decode throughput + HBM saving."""
+    from kubeflow_tpu.serve.generation import GenerationEngine
+    from kubeflow_tpu.serve.quant import (QuantizedModule, quantize_tree,
+                                          quantized_bytes)
+
+    res = {}
+    qparams = quantize_tree(params)
+    sizes = quantized_bytes(qparams)
+    for label, m, p in (("bf16", model, params),
+                        ("int8", QuantizedModule(model, cfg.dtype), qparams)):
+        eng = GenerationEngine(m, p, cfg, slots=4, max_len=max_len,
+                               chunk=chunk, prefill_buckets=buckets,
+                               prefix_cache=0)
+        try:
+            prompts = [list(rng.integers(1, cfg.vocab_size, 16))
+                       for _ in range(4)]
+            _drain(eng, prompts, decode_tokens)
+            s = eng.stats
+            res[label] = s["decode_tokens"] / max(s["decode_seconds"], 1e-9)
+        finally:
+            eng.close()
+    return {
+        "bf16_tok_s": round(res["bf16"], 1),
+        "int8_tok_s": round(res["int8"], 1),
+        "int8_vs_bf16": round(res["int8"] / max(res["bf16"], 1e-9), 3),
+        "param_bytes": sizes,
+    }
+
+
+def bench_batcher(*, requests: int = 200, threads: int = 8,
+                  max_batch_size: int = 32,
+                  max_latency_ms: float = 2.0) -> dict:
+    """Adaptive-batcher latency distribution under concurrent load, with a
+    jitted matmul predictor (the BERT-predictor shape of config 3)."""
+    from kubeflow_tpu.serve.batcher import Batcher
+
+    w = jax.random.normal(jax.random.key(1), (256, 256), jnp.float32)
+
+    @jax.jit
+    def fwd(x):
+        return jnp.tanh(x @ w) @ w
+
+    def predict(inputs):
+        return [np.asarray(fwd(jnp.asarray(inputs[0])))]
+
+    batcher = Batcher(predict, max_batch_size=max_batch_size,
+                      max_latency_ms=max_latency_ms)
+    lat: list[float] = []
+    lock = threading.Lock()
+    x = np.zeros((256,), np.float32)
+
+    def worker(n):
+        for _ in range(n):
+            t0 = time.monotonic()
+            batcher.submit([x]).result(timeout=60)
+            dt = time.monotonic() - t0
+            with lock:
+                lat.append(dt)
+
+    ths = [threading.Thread(target=worker, args=(requests // threads,))
+           for _ in range(threads)]
+    t0 = time.monotonic()
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join(timeout=120)
+    wall = time.monotonic() - t0
+    batcher.close()
+    arr = np.asarray(lat) * 1e3
+    return {
+        "requests": len(lat),
+        "throughput_rps": round(len(lat) / wall, 1),
+        "p50_ms": round(float(np.percentile(arr, 50)), 3),
+        "p99_ms": round(float(np.percentile(arr, 99)), 3),
+    }
+
+
+def run_servebench(*, size: str = "1b", quick: bool = False) -> dict:
+    """The full serving benchmark. `size="tiny"`/`quick` is the CI/
+    regression shape; the driver's chip run uses the 0.9B bench model.
+
+    The chip config is deliberately lean on AOT surface — every engine
+    pays its full warmup compile set, so buckets/slots variants are the
+    compile-time budget (each engine ≈ prefill+extend+2·decode-buckets
+    executables at 20-40 s/compile on the axon tunnel)."""
+    import sys
+
+    if quick:
+        max_len, chunk, buckets = 96, 4, (8, 16)
+        slots_list: Sequence[int] = (1, 2)
+        decode_tokens = 12
+        batcher_reqs = 64
+    else:
+        max_len, chunk, buckets = 512, 16, (32, 128)
+        slots_list = (1, 4)
+        decode_tokens = 96
+        batcher_reqs = 200
+
+    def log(stage):
+        print(f"servebench: {stage}", file=sys.stderr, flush=True)
+
+    log(f"building model ({size})")
+    model, params, cfg = _build_model(size)
+    rng = np.random.default_rng(0)
+
+    result: dict[str, Any] = {
+        "metric": "serving",
+        "model": size,
+        "model_params": cfg.num_params,
+        "device_kind": jax.devices()[0].device_kind,
+        "max_len": max_len,
+        "chunk": chunk,
+        "prefill_buckets": list(buckets),
+    }
+    log("decode throughput vs slots")
+    result["decode"] = bench_decode_slots(
+        model, params, cfg, slots_list=slots_list, max_len=max_len,
+        chunk=chunk, buckets=buckets, decode_tokens=decode_tokens, rng=rng)
+    log("length-aware decode buckets")
+    result["decode_buckets"] = bench_decode_buckets(
+        model, params, cfg, max_len=max_len, chunk=chunk, buckets=buckets,
+        decode_tokens=decode_tokens, rng=rng)
+    log("ttft per prefill bucket")
+    result.update(bench_ttft(model, params, cfg, max_len=max_len,
+                             chunk=chunk, buckets=buckets, rng=rng))
+    log("int8 vs bf16")
+    result["quant"] = bench_quant(
+        model, params, cfg, max_len=max_len, chunk=chunk, buckets=buckets,
+        decode_tokens=decode_tokens, rng=rng)
+    log("batcher percentiles")
+    result["batcher"] = bench_batcher(requests=batcher_reqs)
+    return result

@@ -1,0 +1,33 @@
+"""Pins the serving-benchmark harness (kubeflow_tpu/serve/bench.py): the
+quick/tiny shape must produce every artifact section with sane values, so
+the chip run (`bench.py --serve` → SERVEBENCH.json) can't silently rot."""
+
+import numpy as np
+
+from kubeflow_tpu.serve.bench import run_servebench
+
+
+def test_servebench_quick_shape():
+    r = run_servebench(size="tiny", quick=True)
+    # Decode concurrency section: throughput positive at each slot count.
+    assert set(r["decode"]) == {"slots_1", "slots_2"}
+    for v in r["decode"].values():
+        assert v["decode_tok_s"] > 0
+    # Length-aware decode section: both variants measured.
+    db = r["decode_buckets"]
+    assert db["bucketed_tok_s"] > 0 and db["flat_tok_s"] > 0
+    assert db["speedup"] > 0
+    # TTFT per bucket + chunked admission (largest bucket 16 < max_len-1).
+    assert set(r["ttft_s"]) == {"8", "16"}
+    assert all(v > 0 for v in r["ttft_s"].values())
+    assert r["chunked_prefill"]["prompt_len"] > 16
+    assert r["chunked_prefill"]["admission_s"] > 0
+    # Quantization delta: both engines decoded; int8 params are smaller.
+    q = r["quant"]
+    assert q["bf16_tok_s"] > 0 and q["int8_tok_s"] > 0
+    assert q["param_bytes"]["quantized"] < q["param_bytes"]["full"]
+    # Batcher percentiles under load.
+    b = r["batcher"]
+    assert b["requests"] == 64
+    assert 0 < b["p50_ms"] <= b["p99_ms"]
+    assert np.isfinite(b["throughput_rps"]) and b["throughput_rps"] > 0
