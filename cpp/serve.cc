@@ -847,8 +847,16 @@ void TrainedModelController::Reconcile(const std::string& name) {
   // replica (new pid) re-loads, and a model_dir/name change (new digest)
   // re-loads on live replicas. Keys survive readiness blips — they are
   // pruned only when the replica itself is gone.
-  const std::string digest =
-      std::to_string(std::hash<std::string>{}(mname + "|" + mdir));
+  // FNV-1a, not std::hash: std::hash is implementation-defined, so a
+  // controller binary/stdlib upgrade would change every digest and
+  // trigger a spurious re-load of every model on every replica.
+  const std::string digest_src = mname + "|" + mdir;
+  uint64_t fnv = 1469598103934665603ull;
+  for (unsigned char c : digest_src) {
+    fnv ^= c;
+    fnv *= 1099511628211ull;
+  }
+  const std::string digest = std::to_string(fnv);
   const Json loaded_old = status.get("loaded").is_object()
                               ? status.get("loaded")
                               : Json::Object();

@@ -33,6 +33,11 @@ class BertConfig:
     # "gelu_new"/"gelu_pytorch_tanh" (tanh approximation) and "relu".
     hidden_act: str = "gelu"
     num_labels: int = 2  # classification head
+    # Pooler-free classification exports exist (the classifier was trained
+    # on the RAW [CLS] hidden state): use_pooler=False skips the
+    # dense+tanh entirely — an identity-kernel pooler would still apply
+    # tanh and silently deviate from the source model's logits.
+    use_pooler: bool = True
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
 
@@ -126,11 +131,15 @@ class Bert(nn.Module):
                          name="ln_embed")(x.astype(cfg.dtype))
         for i in range(cfg.num_layers):
             x = EncoderLayer(cfg, name=f"layer_{i}")(x, attention_mask)
-        pooled = nn.tanh(nn.Dense(
-            cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-            kernel_init=nn.with_logical_partitioning(
-                nn.initializers.lecun_normal(), ("embed", "embed2")),
-            name="pooler")(x[:, 0]))
+        if cfg.use_pooler:
+            pooled = nn.tanh(nn.Dense(
+                cfg.hidden_size, dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype,
+                kernel_init=nn.with_logical_partitioning(
+                    nn.initializers.lecun_normal(), ("embed", "embed2")),
+                name="pooler")(x[:, 0]))
+        else:
+            pooled = x[:, 0]
         logits = nn.Dense(
             cfg.num_labels, dtype=jnp.float32, param_dtype=cfg.param_dtype,
             kernel_init=nn.with_logical_partitioning(

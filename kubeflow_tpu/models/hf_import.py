@@ -17,6 +17,7 @@ apply_rope`'s split-in-halves form.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 from typing import Any
@@ -264,20 +265,24 @@ def import_bert(path: str, *, allow_headless: bool = False,
                         "bias": t[lp + "output.dense.bias"]},
             "ln_ffn": ln(lp + "output.LayerNorm"),
         }
-    headless = ("classifier.weight" not in t
-                or pre + "pooler.dense.weight" not in t)
+    # Headless = no classifier. A missing pooler alone is NOT headless:
+    # pooler-free classification exports exist and serve correctly with
+    # use_pooler=False below (classifier on the raw [CLS] state).
+    headless = "classifier.weight" not in t
     if headless and not allow_headless:
         raise KeyError(
-            "checkpoint has no classification head (classifier.weight / "
-            "pooler.dense.weight) — serving it would return constant "
-            "zero logits; pass allow_headless=True only to fine-tune a "
-            "fresh head")
+            "checkpoint has no classification head (classifier.weight) — "
+            "serving it would return constant zero logits; pass "
+            "allow_headless=True only to fine-tune a fresh head")
     if pre + "pooler.dense.weight" in t:
         params["pooler"] = {"kernel": lin(t[pre + "pooler.dense.weight"]),
                             "bias": t[pre + "pooler.dense.bias"]}
-    else:  # fine-tune path: identity pooler, head trained from scratch
-        params["pooler"] = {"kernel": np.eye(h, dtype=pd),
-                            "bias": np.zeros((h,), pd)}
+    else:
+        # Pooler-free checkpoint: the classifier (existing or fresh)
+        # consumes the RAW [CLS] hidden state — skip the pooler module
+        # entirely (an identity kernel would still tanh and deviate from
+        # the source model's logits).
+        cfg = dataclasses.replace(cfg, use_pooler=False)
     if "classifier.weight" in t:
         params["classifier"] = {"kernel": lin(t["classifier.weight"]),
                                 "bias": t["classifier.bias"]}

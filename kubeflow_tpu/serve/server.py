@@ -58,6 +58,7 @@ class ModelRepository:
         if load and not model.ready:
             model.load()
         with self._lock:
+            old_model = self._models.get(model.name)
             self._models[model.name] = model
             if model_dir:
                 self._dirs[model.name] = model_dir
@@ -67,6 +68,16 @@ class ModelRepository:
                 max_latency_ms=max_latency_ms)
         if old:
             old.close()
+        if old_model is not None and old_model is not model:
+            # Drop the replaced version's device buffers/AOT executables —
+            # without this, a TrainedModel version swap keeps BOTH
+            # versions resident until GC, which can OOM HBM-constrained
+            # serving. Deferred by a grace window so requests that
+            # grabbed the old model just before the swap (e.g. oversized
+            # calls that bypass the drained batcher) finish first; a
+            # request still running after the grace sees the same cut a
+            # rolling pod replacement would give it.
+            threading.Timer(10.0, old_model.unload).start()
         return model
 
     def get(self, name: str) -> Model:

@@ -286,3 +286,37 @@ def test_missing_lm_head_fails_loudly(hf_llama_dir, tmp_path):
 
     with pytest.raises(KeyError, match="lm_head"):
         import_llama(str(dst))
+
+
+def test_bert_pooler_free_checkpoint(hf_bert_dir, tmp_path):
+    """A classification export WITHOUT pooler weights (pooler-free
+    fine-tunes exist) must be admitted — and served on the RAW [CLS]
+    state: an identity-kernel pooler would still tanh and silently
+    deviate from the source model (ADVICE r2 + review finding)."""
+    import os
+    import shutil
+
+    from safetensors.torch import load_file, save_file
+
+    from kubeflow_tpu.models.bert import Bert
+    from kubeflow_tpu.models.hf_import import import_bert
+
+    path, tmodel = hf_bert_dir
+    d = str(tmp_path / "nopool")
+    shutil.copytree(path, d)
+    st = load_file(os.path.join(d, "model.safetensors"))
+    st = {k: v for k, v in st.items() if "pooler" not in k}
+    save_file(st, os.path.join(d, "model.safetensors"),
+              metadata={"format": "pt"})
+
+    cfg, params = import_bert(d, dtype=jnp.float32)
+    assert not cfg.use_pooler and "pooler" not in params
+
+    rng = np.random.default_rng(3)
+    toks = rng.integers(0, cfg.vocab_size, (2, 10), dtype=np.int64)
+    with torch.no_grad():
+        cls = tmodel.bert(torch.from_numpy(toks)).last_hidden_state[:, 0]
+        ref = tmodel.classifier(cls).numpy()
+    _, got = Bert(cfg).apply({"params": params},
+                             jnp.asarray(toks, jnp.int32))
+    np.testing.assert_allclose(np.asarray(got), ref, atol=3e-4, rtol=2e-3)

@@ -122,3 +122,18 @@ def test_hybrid_mesh_collectives_run(devices8):
     out = jax.jit(shard_map(
         f, mesh=mesh, in_specs=P(("data", "fsdp")), out_specs=P(("data", "fsdp"))))(x)
     np.testing.assert_allclose(np.asarray(out), np.full((8, 1), x.sum()))
+
+
+def test_mesh_factors_all_world_sizes():
+    """The driver's mesh-factor split must cover every world size, not
+    just the n=8 the dryrun exercises (VERDICT r2 weak #4): products
+    always match and odd remainders land on fsdp."""
+    import importlib
+
+    graft = importlib.import_module("__graft_entry__")
+    for n in (1, 2, 3, 4, 5, 6, 8, 12, 16, 24):
+        f = graft._mesh_factors(n)
+        assert (f["data"] * f["fsdp"] * f["tensor"] * f["seq"] == n), (n, f)
+        assert all(v >= 1 for v in f.values()), (n, f)
+    assert graft._mesh_factors(6) == {
+        "tensor": 2, "seq": 1, "fsdp": 3, "data": 1}
