@@ -54,16 +54,23 @@ class MeshConfig:
     num_slices: int = 1
 
     def dcn_axis(self, num_devices: int) -> str | None:
-        """Which mesh axis carries the cross-slice (DCN) factor."""
+        """Which mesh axis carries the cross-slice (DCN) factor.
+
+        Preference: data (gradient all-reduce tolerates DCN latency), then
+        pipe (one boundary permute per microbatch), then seq — the ring-
+        attention-across-pods long-context configuration, where each ring
+        step's K/V permute is sized to overlap with the step's attention
+        compute (SURVEY.md §5.7); chatty axes (fsdp/tensor/expert) never
+        cross DCN."""
         if self.num_slices <= 1:
             return None
         sizes = dict(zip(MESH_AXES, self.axis_sizes(num_devices)))
-        for axis in ("data", "pipe"):
+        for axis in ("data", "pipe", "seq"):
             if sizes[axis] % self.num_slices == 0:
                 return axis
         raise ValueError(
-            f"num_slices={self.num_slices} must divide the data or pipe "
-            f"axis; got mesh {sizes}")
+            f"num_slices={self.num_slices} must divide the data, pipe, or "
+            f"seq axis; got mesh {sizes}")
 
     def axis_sizes(self, num_devices: int) -> tuple[int, ...]:
         sizes = [self.data, self.fsdp, self.pipe, self.tensor, self.seq, self.expert]
