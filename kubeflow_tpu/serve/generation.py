@@ -286,7 +286,12 @@ class GenerationEngine:
     def submit(self, input_ids: Sequence[int], *, max_tokens: int = 32,
                temperature: float = 0.0, top_k: int = 0,
                top_p: float = 1.0, eos_id: int | None = None,
-               timeout: float = 300.0) -> dict:
+               timeout: float = 300.0,
+               on_tokens=None) -> dict:
+        """`on_tokens(tokens, done)` (optional) is invoked from the worker
+        thread as tokens are emitted — chunk-granular streaming; the final
+        call has done=True. Exceptions in the callback are swallowed (a
+        slow/broken stream consumer must not stall the decode loop)."""
         if not input_ids:
             raise ValueError("input_ids must be non-empty")
         if len(input_ids) > self.max_len - 1:
@@ -308,6 +313,7 @@ class GenerationEngine:
             "done": threading.Event(),
             "error": None,
             "t0": time.monotonic(),
+            "cb": on_tokens,
         }
         self._queue.put(req)
         self._wake.set()
@@ -417,20 +423,32 @@ class GenerationEngine:
 
     def _emit(self, slot: int, tokens: list[int]) -> None:
         """Append generated tokens to the slot's request; retire on EOS /
-        budget / context exhaustion."""
+        budget / context exhaustion. Streams newly appended tokens to the
+        request's on_tokens callback when one is set."""
         st = self._slots[slot]
         req = st["req"]
+        new: list[int] = []
+        finished = req["done"].is_set()
         for t in tokens:
-            if req["done"].is_set():
+            if finished:
                 break
             req["out"].append(t)
+            new.append(t)
             if ((req["eos_id"] is not None and t == req["eos_id"])
                     or len(req["out"]) >= req["max_tokens"]):
-                req["done"].set()
-                break
+                finished = True
         if st["idx"] >= self.max_len - 1:
+            finished = True
+        # Stream BEFORE signalling completion: done.set() wakes submit()
+        # in the caller's thread, and a final summary racing ahead of the
+        # last token chunk would truncate the stream.
+        if req["cb"] is not None and (new or finished):
+            try:
+                req["cb"](new, finished)
+            except Exception:
+                pass
+        if finished:
             req["done"].set()
-        if req["done"].is_set():
             self._slots[slot] = None
 
     def _loop(self) -> None:
@@ -526,33 +544,124 @@ class GenerativeJAXModel(Model):
             self.engine.close()
             self.engine = None
 
-    def generate(self, payload: dict) -> dict:
-        if not self.ready or self.engine is None:
-            raise RuntimeError(f"model {self.name} is not loaded")
+    def _resolve_ids(self, payload: dict) -> list[int]:
         ids = payload.get("input_ids")
         text = payload.get("text")
         if ids is None and text is not None:
-            if self.tokenizer != "bytes":
+            if self.tokenizer == "bytes":
+                ids = list(text.encode("utf-8"))
+            elif hasattr(self.tokenizer, "encode"):  # HF-style tokenizer
+                ids = list(self.tokenizer.encode(text))
+            else:
                 raise ValueError(
-                    "this model takes token ids ('input_ids'); no tokenizer "
-                    "is bundled")
-            ids = list(text.encode("utf-8"))
+                    "this model takes token ids ('input_ids'); no "
+                    "tokenizer is bundled")
         if ids is None:
             raise ValueError("request needs 'input_ids' (or 'text')")
-        out = self.engine.submit(
-            ids,
+        return ids
+
+    def _decode_text(self, ids: list[int]) -> str:
+        if self.tokenizer == "bytes":
+            return bytes(t for t in ids if 0 <= t < 256).decode(
+                "utf-8", errors="replace")
+        return self.tokenizer.decode(ids, skip_special_tokens=True)
+
+    def _submit_kwargs(self, payload: dict) -> dict:
+        return dict(
             max_tokens=int(payload.get("max_tokens", 32)),
             temperature=float(payload.get("temperature", 0.0)),
             top_k=int(payload.get("top_k", 0)),
             top_p=float(payload.get("top_p", 1.0)),
             eos_id=payload.get("eos_id", self.eos_id),
             timeout=float(payload.get("timeout", 300.0)))
-        if self.tokenizer == "bytes":
-            out["text"] = bytes(
-                t for t in out["output_ids"] if 0 <= t < 256).decode(
-                    "utf-8", errors="replace")
+
+    def generate(self, payload: dict) -> dict:
+        if not self.ready or self.engine is None:
+            raise RuntimeError(f"model {self.name} is not loaded")
+        ids = self._resolve_ids(payload)
+        out = self.engine.submit(ids, **self._submit_kwargs(payload))
+        if self.tokenizer is not None:
+            out["text"] = self._decode_text(out["output_ids"])
         out["decode_tokens_per_sec"] = round(self.engine.throughput(), 2)
         return out
+
+    def generate_stream(self, payload: dict):
+        """Generator of streaming events: {"tokens": [...]} (plus
+        "text_delta" when a tokenizer is bundled) per emitted chunk, then
+        a final {"done": true, ...summary} — the huggingfaceserver
+        streaming surface, chunk-granular (the engine's scheduling
+        quantum)."""
+        if not self.ready or self.engine is None:
+            raise RuntimeError(f"model {self.name} is not loaded")
+        ids = self._resolve_ids(payload)
+        kwargs = self._submit_kwargs(payload)
+        events: queue.Queue = queue.Queue()
+
+        def on_tokens(tokens, done):
+            events.put(("tok", tokens, done))
+
+        def run():
+            try:
+                events.put(("final", self.engine.submit(
+                    ids, on_tokens=on_tokens, **kwargs), None))
+            except Exception as e:  # surfaced to the consumer
+                events.put(("error", e, None))
+
+        threading.Thread(target=run, daemon=True,
+                         name="tpk-generate-stream").start()
+        emitted: list[int] = []
+        # Windowed incremental detokenization (the vLLM recipe): decode
+        # only from a trailing offset, emit the suffix beyond the
+        # previously rendered window, and hold back while the tail is an
+        # incomplete codepoint — O(window) per chunk instead of
+        # re-decoding the whole prefix (quadratic in output length), and
+        # deltas telescope to the exact full decode.
+        prefix_off = read_off = 0
+        sent_text = ""
+        held = False
+        deadline = time.monotonic() + kwargs["timeout"] + 10.0
+        while True:
+            try:
+                kind, val, done = events.get(
+                    timeout=max(deadline - time.monotonic(), 1.0))
+            except queue.Empty:
+                raise RuntimeError(
+                    f"generation stream timed out after "
+                    f"{kwargs['timeout']}s") from None
+            if kind == "error":
+                raise val
+            if kind == "final":
+                out = dict(val)
+                if self.tokenizer is not None:
+                    out["text"] = self._decode_text(out["output_ids"])
+                    # Flush anything still held back by the window.
+                    out["text_delta"] = (
+                        out["text"][len(sent_text):]
+                        if out["text"].startswith(sent_text) else "")
+                out["decode_tokens_per_sec"] = round(
+                    self.engine.throughput(), 2)
+                yield {"done": True, **out}
+                return
+            if not val:
+                continue
+            emitted.extend(val)
+            ev: dict = {"tokens": [int(t) for t in val]}
+            if self.tokenizer is not None:
+                prev = self._decode_text(emitted[prefix_off:read_off])
+                text = self._decode_text(emitted[prefix_off:])
+                # Hold back a tail that looks like an incomplete
+                # codepoint — but at most once: genuinely invalid bytes
+                # also render as U+FFFD and must not starve the stream.
+                if len(text) > len(prev) and (
+                        held or not text.endswith("�")):
+                    ev["text_delta"] = text[len(prev):]
+                    prefix_off, read_off = read_off, len(emitted)
+                    held = False
+                else:
+                    ev["text_delta"] = ""
+                    held = True
+                sent_text += ev["text_delta"]
+            yield ev
 
     def predict(self, inputs):
         """Full-forward logits (no cache) — v1/v2 infer parity."""

@@ -182,8 +182,26 @@ def _huggingface_runtime(model_dir: str, spec: dict) -> Model:
     if spec.get("generative"):
         from kubeflow_tpu.serve.generation import GenerativeJAXModel
 
+        gen = dict(spec["generative"])
+        # Bundle the checkpoint's own tokenizer when present (vLLM-parity
+        # text in/out + streaming text deltas): generation then accepts
+        # "text" and returns decoded "text"; eos defaults to the
+        # tokenizer's unless the spec pins one.
+        if "tokenizer" not in gen and any(
+                os.path.exists(os.path.join(ckpt, f))
+                for f in ("tokenizer.json", "tokenizer.model")):
+            try:
+                from transformers import AutoTokenizer
+
+                tok = AutoTokenizer.from_pretrained(ckpt)
+                gen["tokenizer"] = tok
+                if tok.eos_token_id is not None:
+                    gen.setdefault("eos_id", int(tok.eos_token_id))
+            except Exception as e:
+                print(f"tokenizer load skipped for {name}: {e}",
+                      flush=True)
         return GenerativeJAXModel(name, module, params, cfg,
-                                  generation=dict(spec["generative"]))
+                                  generation=gen)
 
     def apply_fn(params, tokens):
         return module.apply({"params": params}, tokens)

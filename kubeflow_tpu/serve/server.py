@@ -29,6 +29,7 @@ from typing import Any
 
 import numpy as np
 import tornado.httpserver
+import tornado.iostream
 import tornado.ioloop
 import tornado.netutil
 import tornado.web
@@ -291,6 +292,9 @@ class GenerateHandler(_Base):
                 400, reason=f"model {name!r} is not generative")
         body = self.body_json()
         t0 = time.monotonic()
+        if body.get("stream"):
+            await self._stream(name, model, body, t0)
+            return
         try:
             out = await asyncio.get_event_loop().run_in_executor(
                 None, gen, body)
@@ -299,6 +303,52 @@ class GenerateHandler(_Base):
         self.server.observe(name, out.get("num_output_tokens", 0),
                             time.monotonic() - t0)
         self.write_json({"model_name": name, **out})
+
+    async def _stream(self, name: str, model, body: dict, t0: float):
+        """"stream": true → newline-delimited JSON events flushed as the
+        engine emits chunks (tornado chunked transfer; the KServe/vLLM
+        streaming generate surface). The generator is iterated directly
+        via the executor — generate_stream already bridges the engine's
+        worker thread, so no extra thread/queue layer here. A pre-stream
+        error is a clean 400; an error mid-stream becomes a terminal
+        {"error": ...} line (the status line is already on the wire); a
+        client disconnect stops the response (the engine still decodes
+        the request to completion — no cancellation in v1)."""
+        stream_fn = getattr(model, "generate_stream", None)
+        if stream_fn is None:
+            raise tornado.web.HTTPError(
+                400, reason=f"model {name!r} does not stream")
+        it = stream_fn(body)
+        _END = object()
+
+        def step():
+            try:
+                return ("ev", next(it, _END))
+            except Exception as e:
+                return ("err", f"{type(e).__name__}: {e}")
+
+        loop = asyncio.get_event_loop()
+        kind, ev = await loop.run_in_executor(None, step)
+        if kind == "err":
+            raise tornado.web.HTTPError(400, reason=ev)
+        self.set_header("Content-Type", "application/x-ndjson")
+        tokens_out = 0
+        try:
+            while ev is not _END:
+                if kind == "err":
+                    self.write(json.dumps({"model_name": name,
+                                           "error": ev}) + "\n")
+                    await self.flush()
+                    break
+                tokens_out += len(ev.get("tokens", ()))
+                self.write(json.dumps({"model_name": name, **ev}) + "\n")
+                await self.flush()
+                if ev.get("done"):
+                    break
+                kind, ev = await loop.run_in_executor(None, step)
+        except tornado.iostream.StreamClosedError:
+            it.close()  # stop consuming; delivered tokens still observed
+        self.server.observe(name, tokens_out, time.monotonic() - t0)
 
 
 class V2HealthHandler(_Base):

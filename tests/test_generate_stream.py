@@ -1,0 +1,104 @@
+"""Streaming generation (the huggingfaceserver/vLLM streaming surface):
+engine token callbacks, the generate_stream generator with text deltas,
+and ndjson chunked HTTP streaming end to end."""
+
+import dataclasses
+import json
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubeflow_tpu.models.llama import Llama, llama_tiny
+from kubeflow_tpu.serve.generation import (GenerationEngine,
+                                           GenerativeJAXModel)
+from tests.test_generate import ref_greedy
+
+CFG = dataclasses.replace(llama_tiny(), dtype=jnp.float32, num_layers=2)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    model = Llama(CFG)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))[
+        "params"]
+    return model, params
+
+
+def test_engine_on_tokens_callback(tiny):
+    model, params = tiny
+    eng = GenerationEngine(model, params, CFG, slots=1, max_len=64,
+                           chunk=4, prefill_buckets=(8,))
+    try:
+        got, finals = [], []
+
+        def cb(tokens, done):
+            got.extend(tokens)
+            finals.append(done)
+
+        out = eng.submit([5, 9, 2], max_tokens=9, on_tokens=cb)
+        assert got == out["output_ids"]
+        assert finals[-1] is True and not any(finals[:-1])
+        assert got == ref_greedy(model, params, [5, 9, 2], 9)
+    finally:
+        eng.close()
+
+
+def test_generate_stream_text_deltas(tiny):
+    model, params = tiny
+    gm = GenerativeJAXModel(
+        "m", model, params, CFG,
+        generation={"slots": 1, "max_len": 64, "chunk": 4,
+                    "prefill_buckets": (8,), "tokenizer": "bytes"})
+    gm.load()
+    try:
+        events = list(gm.generate_stream({"input_ids": [5, 9, 2],
+                                          "max_tokens": 8}))
+        assert events[-1]["done"] is True
+        streamed = [t for ev in events[:-1] for t in ev["tokens"]]
+        assert streamed == events[-1]["output_ids"]
+        # Windowed incremental detokenization telescopes exactly: deltas
+        # (including the final flush) join to the full decoded text.
+        deltas = "".join(ev.get("text_delta", "") for ev in events)
+        assert deltas == events[-1]["text"]
+    finally:
+        gm.unload()
+
+
+def test_http_stream_ndjson(tiny):
+    from kubeflow_tpu.serve import ModelServer
+
+    model, params = tiny
+    srv = ModelServer()
+    srv.repo.register(GenerativeJAXModel(
+        "llm", model, params, CFG,
+        generation={"slots": 1, "max_len": 64, "chunk": 4,
+                    "prefill_buckets": (8,), "tokenizer": "bytes"}))
+    port = srv.start_background()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/models/llm:generate",
+            method="POST",
+            data=json.dumps({"input_ids": [5, 9, 2], "max_tokens": 8,
+                             "stream": True}).encode())
+        with urllib.request.urlopen(req, timeout=120) as r:
+            assert r.status == 200
+            assert "ndjson" in r.headers["Content-Type"]
+            lines = [json.loads(l) for l in r.read().splitlines()]
+        assert lines[-1]["done"] is True
+        streamed = [t for ev in lines[:-1] for t in ev["tokens"]]
+        assert streamed == lines[-1]["output_ids"]
+        assert streamed == ref_greedy(model, params, [5, 9, 2], 8)
+        # Errors BEFORE the stream opens are clean 400s.
+        bad = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/models/llm:generate",
+            method="POST",
+            data=json.dumps({"stream": True}).encode())
+        try:
+            urllib.request.urlopen(bad, timeout=30)
+            raise AssertionError("expected 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    finally:
+        srv.stop()

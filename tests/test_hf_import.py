@@ -320,3 +320,48 @@ def test_bert_pooler_free_checkpoint(hf_bert_dir, tmp_path):
     _, got = Bert(cfg).apply({"params": params},
                              jnp.asarray(toks, jnp.int32))
     np.testing.assert_allclose(np.asarray(got), ref, atol=3e-4, rtol=2e-3)
+
+
+def test_hf_generative_text_with_bundled_tokenizer(hf_llama_dir, tmp_path):
+    """A checkpoint dir carrying tokenizer.json serves TEXT in/out (and
+    streaming text deltas) — the runtime auto-bundles the checkpoint's
+    own tokenizer (vLLM-parity text surface)."""
+    import os
+    import shutil
+
+    from tokenizers import Tokenizer
+    from tokenizers.models import WordLevel
+    from tokenizers.pre_tokenizers import Whitespace
+
+    from kubeflow_tpu.serve.runtimes import load_model
+
+    path, _ = hf_llama_dir
+    d = str(tmp_path / "with_tok")
+    shutil.copytree(path, d)
+    vocab = {"<unk>": 0, "a": 1, "b": 2, "c": 3, "d": 4}
+    tok = Tokenizer(WordLevel(vocab, unk_token="<unk>"))
+    tok.pre_tokenizer = Whitespace()
+    tok.save(os.path.join(d, "tokenizer.json"))
+    with open(os.path.join(d, "tokenizer_config.json"), "w") as f:
+        json.dump({"tokenizer_class": "PreTrainedTokenizerFast"}, f)
+    with open(os.path.join(d, "model.json"), "w") as f:
+        json.dump({"format": "huggingface", "name": "llm-tok",
+                   "model_overrides": {"dtype": "float32",
+                                       "attention_impl": "naive",
+                                       "remat": False},
+                   "generative": {"slots": 1, "max_len": 64, "chunk": 4,
+                                  "prefill_buckets": [8]}}, f)
+    model = load_model(d)
+    assert model.load()
+    try:
+        out = model.generate({"text": "a b c", "max_tokens": 4})
+        assert out["num_input_tokens"] == 3
+        assert isinstance(out["text"], str)
+        events = list(model.generate_stream({"text": "a b",
+                                             "max_tokens": 4}))
+        assert events[-1]["done"] is True
+        assert "text" in events[-1]
+        streamed = [t for ev in events[:-1] for t in ev["tokens"]]
+        assert streamed == events[-1]["output_ids"]
+    finally:
+        model.unload()
