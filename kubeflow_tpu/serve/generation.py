@@ -618,7 +618,6 @@ class GenerativeJAXModel(Model):
         # deltas telescope to the exact full decode.
         prefix_off = read_off = 0
         sent_text = ""
-        held = False
         deadline = time.monotonic() + kwargs["timeout"] + 10.0
         while True:
             try:
@@ -649,17 +648,20 @@ class GenerativeJAXModel(Model):
             if self.tokenizer is not None:
                 prev = self._decode_text(emitted[prefix_off:read_off])
                 text = self._decode_text(emitted[prefix_off:])
-                # Hold back a tail that looks like an incomplete
-                # codepoint — but at most once: genuinely invalid bytes
-                # also render as U+FFFD and must not starve the stream.
-                if len(text) > len(prev) and (
-                        held or not text.endswith("�")):
+                # Emit ONLY when the new rendering strictly extends the
+                # previous one and its tail is not a possibly-incomplete
+                # codepoint (U+FFFD). Anything else — a held partial, or
+                # a rewrite where a completing codepoint replaces an
+                # earlier U+FFFD — stays buffered: an emitted delta can
+                # never be retracted, and the final event's residue flush
+                # delivers whatever was held, so deltas always join to
+                # the exact full decode.
+                if (len(text) > len(prev) and text.startswith(prev)
+                        and not text.endswith("�")):
                     ev["text_delta"] = text[len(prev):]
                     prefix_off, read_off = read_off, len(emitted)
-                    held = False
                 else:
                     ev["text_delta"] = ""
-                    held = True
                 sent_text += ev["text_delta"]
             yield ev
 

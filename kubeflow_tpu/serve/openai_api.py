@@ -1,0 +1,280 @@
+"""OpenAI-compatible serving surface (completions + chat), under /openai.
+
+The reference's huggingfaceserver exposes the OpenAI REST API in front of
+vLLM ⟨kserve: python/huggingfaceserver — openai endpoints⟩; this is the
+TPU-native equivalent in front of the generation engine:
+
+  POST /openai/v1/completions        {"model", "prompt", ...}
+  POST /openai/v1/chat/completions   {"model", "messages": [...], ...}
+  GET  /openai/v1/models
+
+Both POST surfaces support "stream": true as server-sent events
+(`data: {...}\n\n`, terminated by `data: [DONE]\n\n`) riding the engine's
+chunk-granular streaming, and `stop` sequences (text-level truncation —
+the engine decodes on; vLLM stops the sampler, we stop the surface).
+Chat prompts use the bundled HF tokenizer's chat template when it has
+one, else a plain role-prefixed transcript. Errors use the OpenAI error
+envelope. The namespace is prefixed (/openai) exactly like the
+reference, so the v1 predict protocol keeps /v1/models.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+import uuid
+from typing import Any
+
+import tornado.web
+
+from kubeflow_tpu.serve.server import _Base, pump_stream
+
+
+class _OpenAIBase(_Base):
+    """Shares the server's handler base (repo access, JSON body parsing,
+    request logging); only the error ENVELOPE differs."""
+
+    def write_error(self, status_code: int, **kwargs) -> None:
+        reason = self._reason
+        if "exc_info" in kwargs:
+            exc = kwargs["exc_info"][1]
+            if not isinstance(exc, tornado.web.HTTPError):
+                reason = f"{type(exc).__name__}: {exc}"
+        self.set_header("Content-Type", "application/json")
+        self.finish(json.dumps({"error": {
+            "message": reason, "type": ("invalid_request_error"
+                                        if status_code < 500
+                                        else "internal_error"),
+            "code": status_code}}))
+
+    def _generative(self, name: str):
+        model = self.repo.get(name or "")
+        if getattr(model, "generate", None) is None:
+            raise tornado.web.HTTPError(
+                400, reason=f"model {name!r} is not generative")
+        return model
+
+
+def _payload_from(body: dict) -> dict:
+    if body.get("n", 1) != 1:
+        raise tornado.web.HTTPError(400, reason="n > 1 is not supported")
+    payload: dict[str, Any] = {
+        "max_tokens": int(body.get("max_tokens", 16)),
+        "temperature": float(body.get("temperature", 1.0)),
+        "top_p": float(body.get("top_p", 1.0)),
+    }
+    if body.get("top_k") is not None:  # common extension
+        payload["top_k"] = int(body["top_k"])
+    return payload
+
+
+def _stop_list(body: dict) -> list[str]:
+    stop = body.get("stop")
+    if stop is None:
+        return []
+    if isinstance(stop, str):
+        stop = [stop]
+    if (not isinstance(stop, list) or len(stop) > 4
+            or not all(isinstance(s, str) and s for s in stop)):
+        raise tornado.web.HTTPError(
+            400, reason="stop must be a non-empty string or up to 4 of "
+                        "them")
+    return stop
+
+
+def _truncate_at_stop(text: str, stops: list[str]) -> tuple[str, bool]:
+    """(text up to the earliest stop sequence — excluded, per OpenAI —
+    and whether one matched)."""
+    cut = -1
+    for s in stops:
+        i = text.find(s)
+        if i >= 0 and (cut < 0 or i < cut):
+            cut = i
+    return (text[:cut], True) if cut >= 0 else (text, False)
+
+
+def _chat_ids_or_text(model, messages: list) -> dict:
+    """messages → generate payload. HF tokenizers with a chat template
+    render it; otherwise a plain role-prefixed transcript with a trailing
+    assistant cue."""
+    if not isinstance(messages, list) or not messages:
+        raise tornado.web.HTTPError(
+            400, reason="messages must be a non-empty array")
+    tok = getattr(model, "tokenizer", None)
+    if hasattr(tok, "apply_chat_template") and getattr(
+            tok, "chat_template", None):
+        ids = tok.apply_chat_template(messages, tokenize=True,
+                                      add_generation_prompt=True)
+        return {"input_ids": list(ids)}
+    text = "\n".join(
+        f"{m.get('role', 'user')}: {m.get('content', '')}" for m in messages)
+    return {"text": text + "\nassistant:"}
+
+
+def _finish_reason(out: dict, max_tokens: int, stopped: bool) -> str:
+    if stopped:
+        return "stop"
+    return "length" if out.get("num_output_tokens", 0) >= max_tokens \
+        else "stop"
+
+
+def _usage(out: dict) -> dict:
+    p = out.get("num_input_tokens", 0)
+    c = out.get("num_output_tokens", 0)
+    return {"prompt_tokens": p, "completion_tokens": c,
+            "total_tokens": p + c}
+
+
+class _GenerativeHandler(_OpenAIBase):
+    object_name = ""  # "text_completion" | "chat.completion"
+
+    def make_payload(self, model, body: dict) -> dict:
+        raise NotImplementedError
+
+    def choice(self, out_text: str, finish) -> dict:
+        raise NotImplementedError
+
+    def delta_choice(self, delta: str, first: bool, finish) -> dict:
+        raise NotImplementedError
+
+    async def post(self):
+        body = self.body_json()
+        if not isinstance(body, dict):
+            raise tornado.web.HTTPError(400, reason="body must be an object")
+        name = body.get("model", "")
+        model = self._generative(name)
+        stops = _stop_list(body)
+        if stops and getattr(model, "tokenizer", None) is None:
+            raise tornado.web.HTTPError(
+                400, reason="stop sequences need a tokenizer-bundled model")
+        payload = {**self.make_payload(model, body), **_payload_from(body)}
+        rid = f"{'chatcmpl' if 'chat' in self.object_name else 'cmpl'}-" \
+              f"{uuid.uuid4().hex[:24]}"
+        t0 = time.monotonic()
+        if body.get("stream"):
+            await self._stream(name, model, payload, rid, stops, t0)
+            return
+        try:
+            out = await asyncio.get_event_loop().run_in_executor(
+                None, model.generate, payload)
+        except (ValueError, RuntimeError) as e:
+            raise tornado.web.HTTPError(400, reason=str(e)) from None
+        text, stopped = _truncate_at_stop(out.get("text", ""), stops)
+        finish = _finish_reason(out, payload["max_tokens"], stopped)
+        self.server.observe(name, out.get("num_output_tokens", 0),
+                            time.monotonic() - t0)
+        self.write_json({
+            "id": rid, "object": self.object_name,
+            "created": int(time.time()), "model": name,
+            "choices": [self.choice(text, finish)],
+            "usage": _usage(out),
+        })
+
+    async def _stream(self, name, model, payload, rid, stops, t0):
+        it = model.generate_stream(payload)
+        base = {"id": rid, "object": self.object_name + ".chunk",
+                "created": int(time.time()), "model": name}
+        sent = ""
+        tokens_out = 0
+        stopped = False
+
+        def sse(obj) -> None:
+            self.write("data: " + json.dumps(obj) + "\n\n")
+
+        def render(ev, first):
+            nonlocal sent, tokens_out, stopped
+            if first:
+                self.set_header("Content-Type", "text/event-stream")
+                self.set_header("Cache-Control", "no-cache")
+            done = bool(ev.get("done"))
+            delta = ev.get("text_delta", "")
+            if stops and delta:
+                # Truncate at the earliest stop crossing the cumulative
+                # text; end the stream once it lands.
+                whole, hit = _truncate_at_stop(sent + delta, stops)
+                if hit:
+                    delta, stopped = whole[len(sent):], True
+            tokens_out += len(ev.get("tokens", ()))
+            if delta:
+                sse({**base, "choices": [
+                    self.delta_choice(delta, first, None)]})
+                sent += delta
+            elif first and not done:
+                sse({**base, "choices": [
+                    self.delta_choice("", True, None)]})
+            if done or stopped:
+                finish = _finish_reason(ev if done else {},
+                                        payload["max_tokens"], stopped)
+                sse({**base, "usage": _usage(ev) if done else None,
+                     "choices": [self.delta_choice("", False, finish)]})
+                self.write("data: [DONE]\n\n")
+                return True
+            return False
+
+        def render_error(msg):
+            return "data: " + json.dumps({"error": {
+                "message": msg, "type": "internal_error"}}) + "\n\n"
+
+        await pump_stream(self, it, render, render_error)
+        if stopped:
+            it.close()  # stop consuming; engine finishes in background
+        self.server.observe(name, tokens_out, time.monotonic() - t0)
+
+
+class CompletionsHandler(_GenerativeHandler):
+    object_name = "text_completion"
+
+    def make_payload(self, model, body: dict) -> dict:
+        prompt = body.get("prompt")
+        if isinstance(prompt, list) and prompt and isinstance(
+                prompt[0], int):
+            return {"input_ids": prompt}
+        if isinstance(prompt, list) and len(prompt) == 1 and isinstance(
+                prompt[0], str):
+            prompt = prompt[0]
+        if isinstance(prompt, str):
+            return {"text": prompt}
+        raise tornado.web.HTTPError(
+            400, reason="prompt must be a string or a token-id array")
+
+    def choice(self, out_text, finish):
+        return {"index": 0, "text": out_text, "logprobs": None,
+                "finish_reason": finish}
+
+    def delta_choice(self, delta, first, finish):
+        return {"index": 0, "text": delta, "logprobs": None,
+                "finish_reason": finish}
+
+
+class ChatCompletionsHandler(_GenerativeHandler):
+    object_name = "chat.completion"
+
+    def make_payload(self, model, body: dict) -> dict:
+        return _chat_ids_or_text(model, body.get("messages"))
+
+    def choice(self, out_text, finish):
+        return {"index": 0, "finish_reason": finish,
+                "message": {"role": "assistant", "content": out_text}}
+
+    def delta_choice(self, delta, first, finish):
+        d: dict = {"content": delta} if delta else {}
+        if first:
+            d["role"] = "assistant"
+        return {"index": 0, "delta": d, "finish_reason": finish}
+
+
+class ModelsHandler(_OpenAIBase):
+    def get(self):
+        self.write_json({"object": "list", "data": [
+            {"id": n, "object": "model", "owned_by": "tpukit"}
+            for n in self.repo.names()]})
+
+
+def routes(server) -> list:
+    kw = {"server": server}
+    return [
+        (r"/openai/v1/completions", CompletionsHandler, kw),
+        (r"/openai/v1/chat/completions", ChatCompletionsHandler, kw),
+        (r"/openai/v1/models", ModelsHandler, kw),
+    ]

@@ -194,6 +194,50 @@ class ModelRepository:
 # -- handlers ---------------------------------------------------------------
 
 
+async def pump_stream(handler, it, render, render_error) -> None:
+    """Drive a blocking generator into a chunked HTTP response: one
+    executor hop per event, shared by the ndjson :generate stream and the
+    OpenAI SSE surfaces. Pre-stream failures raise clean HTTP errors
+    (ValueError/RuntimeError → 400 request faults; anything else → 500 so
+    bugs hit server-side monitoring, matching the non-stream path);
+    mid-stream failures become a terminal `render_error` frame (the
+    status line is already on the wire). A client disconnect closes the
+    generator (the engine still decodes the request to completion — no
+    cancellation in v1). `render(ev, first) -> bool` writes frames and
+    returns True to end the stream."""
+    _END = object()
+
+    def step():
+        try:
+            return ("ev", next(it, _END))
+        except (ValueError, RuntimeError) as e:
+            return ("badreq", f"{type(e).__name__}: {e}")
+        except Exception as e:
+            return ("err", f"{type(e).__name__}: {e}")
+
+    loop = asyncio.get_event_loop()
+    kind, ev = await loop.run_in_executor(None, step)
+    if kind == "badreq":
+        raise tornado.web.HTTPError(400, reason=ev)
+    if kind == "err":
+        raise tornado.web.HTTPError(500, reason=ev)
+    first = True
+    try:
+        while ev is not _END:
+            if kind != "ev":
+                handler.write(render_error(ev))
+                await handler.flush()
+                break
+            done = render(ev, first)
+            first = False
+            await handler.flush()
+            if done:
+                break
+            kind, ev = await loop.run_in_executor(None, step)
+    except tornado.iostream.StreamClosedError:
+        it.close()
+
+
 class _Base(tornado.web.RequestHandler):
     def initialize(self, server: "ModelServer"):
         self.server = server
@@ -307,47 +351,26 @@ class GenerateHandler(_Base):
     async def _stream(self, name: str, model, body: dict, t0: float):
         """"stream": true → newline-delimited JSON events flushed as the
         engine emits chunks (tornado chunked transfer; the KServe/vLLM
-        streaming generate surface). The generator is iterated directly
-        via the executor — generate_stream already bridges the engine's
-        worker thread, so no extra thread/queue layer here. A pre-stream
-        error is a clean 400; an error mid-stream becomes a terminal
-        {"error": ...} line (the status line is already on the wire); a
-        client disconnect stops the response (the engine still decodes
-        the request to completion — no cancellation in v1)."""
+        streaming generate surface), via the shared pump_stream helper."""
         stream_fn = getattr(model, "generate_stream", None)
         if stream_fn is None:
             raise tornado.web.HTTPError(
                 400, reason=f"model {name!r} does not stream")
         it = stream_fn(body)
-        _END = object()
-
-        def step():
-            try:
-                return ("ev", next(it, _END))
-            except Exception as e:
-                return ("err", f"{type(e).__name__}: {e}")
-
-        loop = asyncio.get_event_loop()
-        kind, ev = await loop.run_in_executor(None, step)
-        if kind == "err":
-            raise tornado.web.HTTPError(400, reason=ev)
-        self.set_header("Content-Type", "application/x-ndjson")
         tokens_out = 0
-        try:
-            while ev is not _END:
-                if kind == "err":
-                    self.write(json.dumps({"model_name": name,
-                                           "error": ev}) + "\n")
-                    await self.flush()
-                    break
-                tokens_out += len(ev.get("tokens", ()))
-                self.write(json.dumps({"model_name": name, **ev}) + "\n")
-                await self.flush()
-                if ev.get("done"):
-                    break
-                kind, ev = await loop.run_in_executor(None, step)
-        except tornado.iostream.StreamClosedError:
-            it.close()  # stop consuming; delivered tokens still observed
+
+        def render(ev, first):
+            nonlocal tokens_out
+            if first:
+                self.set_header("Content-Type", "application/x-ndjson")
+            tokens_out += len(ev.get("tokens", ()))
+            self.write(json.dumps({"model_name": name, **ev}) + "\n")
+            return bool(ev.get("done"))
+
+        def render_error(msg):
+            return json.dumps({"model_name": name, "error": msg}) + "\n"
+
+        await pump_stream(self, it, render, render_error)
         self.server.observe(name, tokens_out, time.monotonic() - t0)
 
 
@@ -549,8 +572,10 @@ class ModelServer:
         return "\n".join(lines) + "\n"
 
     def app(self) -> tornado.web.Application:
+        from kubeflow_tpu.serve import openai_api
+
         kw = {"server": self}
-        return tornado.web.Application([
+        return tornado.web.Application(openai_api.routes(self) + [
             (r"/v1/models", V1ListHandler, kw),
             (r"/v1/models/([^/:]+)", V1ModelHandler, kw),
             (r"/v1/models/([^/:]+):predict", V1PredictHandler, kw),

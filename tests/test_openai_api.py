@@ -1,0 +1,147 @@
+"""OpenAI-compatible endpoints (/openai/v1/*) — the reference
+huggingfaceserver's OpenAI surface in front of the generation engine:
+completions, chat completions, SSE streaming, models list, error shape."""
+
+import dataclasses
+import json
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubeflow_tpu.models.llama import Llama, llama_tiny
+from kubeflow_tpu.serve.generation import GenerativeJAXModel
+
+CFG = dataclasses.replace(llama_tiny(), dtype=jnp.float32, num_layers=2)
+
+
+def _http(method, url, body=None):
+    req = urllib.request.Request(url, method=method,
+                                 data=json.dumps(body).encode()
+                                 if body is not None else None)
+    try:
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return r.status, json.loads(r.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+@pytest.fixture(scope="module")
+def oai_server():
+    from kubeflow_tpu.serve import ModelServer
+
+    model = Llama(CFG)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))[
+        "params"]
+    srv = ModelServer()
+    srv.repo.register(GenerativeJAXModel(
+        "llm", model, params, CFG,
+        generation={"slots": 2, "max_len": 64, "chunk": 4,
+                    "prefill_buckets": (8, 16), "tokenizer": "bytes"}))
+    port = srv.start_background()
+    yield f"http://127.0.0.1:{port}"
+    srv.stop()
+
+
+def test_completions(oai_server):
+    code, body = _http("POST", f"{oai_server}/openai/v1/completions",
+                       {"model": "llm", "prompt": "hi", "max_tokens": 6,
+                        "temperature": 0})
+    assert code == 200, body
+    assert body["object"] == "text_completion"
+    assert body["choices"][0]["finish_reason"] == "length"
+    assert isinstance(body["choices"][0]["text"], str)
+    u = body["usage"]
+    assert u["prompt_tokens"] == 2 and u["completion_tokens"] == 6
+    assert u["total_tokens"] == 8
+
+
+def test_completions_token_ids_prompt(oai_server):
+    code, body = _http("POST", f"{oai_server}/openai/v1/completions",
+                       {"model": "llm", "prompt": [5, 9, 2],
+                        "max_tokens": 4, "temperature": 0})
+    assert code == 200, body
+    assert body["usage"]["prompt_tokens"] == 3
+
+
+def test_chat_completions(oai_server):
+    code, body = _http(
+        "POST", f"{oai_server}/openai/v1/chat/completions",
+        {"model": "llm", "max_tokens": 5, "temperature": 0,
+         "messages": [{"role": "system", "content": "be brief"},
+                      {"role": "user", "content": "hi"}]})
+    assert code == 200, body
+    assert body["object"] == "chat.completion"
+    msg = body["choices"][0]["message"]
+    assert msg["role"] == "assistant" and isinstance(msg["content"], str)
+
+
+def test_completions_sse_stream(oai_server):
+    req = urllib.request.Request(
+        f"{oai_server}/openai/v1/completions", method="POST",
+        data=json.dumps({"model": "llm", "prompt": "hi", "max_tokens": 6,
+                         "temperature": 0, "stream": True}).encode())
+    with urllib.request.urlopen(req, timeout=120) as r:
+        assert r.status == 200
+        assert "text/event-stream" in r.headers["Content-Type"]
+        raw = r.read().decode()
+    events = [l[len("data: "):] for l in raw.split("\n\n")
+              if l.startswith("data: ")]
+    assert events[-1] == "[DONE]"
+    chunks = [json.loads(e) for e in events[:-1]]
+    assert all(c["object"] == "text_completion.chunk" for c in chunks)
+    final = chunks[-1]
+    assert final["choices"][0]["finish_reason"] == "length"
+    assert final["usage"]["completion_tokens"] == 6
+    # Non-streaming reference: identical text (greedy).
+    _, ref = _http("POST", f"{oai_server}/openai/v1/completions",
+                   {"model": "llm", "prompt": "hi", "max_tokens": 6,
+                    "temperature": 0})
+    streamed = "".join(c["choices"][0]["text"] for c in chunks)
+    assert streamed == ref["choices"][0]["text"]
+
+
+def test_stop_sequences(oai_server):
+    """OpenAI stop semantics: generation output is truncated BEFORE the
+    earliest stop sequence, finish_reason becomes 'stop' — non-streaming
+    and streaming agree."""
+    _, ref = _http("POST", f"{oai_server}/openai/v1/completions",
+                   {"model": "llm", "prompt": "hi", "max_tokens": 8,
+                    "temperature": 0})
+    text = ref["choices"][0]["text"]
+    assert text  # greedy bytes decode of the tiny model is non-empty
+    stop = text[len(text) // 2]
+    expected = text[:text.find(stop)]
+    code, body = _http("POST", f"{oai_server}/openai/v1/completions",
+                       {"model": "llm", "prompt": "hi", "max_tokens": 8,
+                        "temperature": 0, "stop": stop})
+    assert code == 200, body
+    assert body["choices"][0]["text"] == expected
+    assert body["choices"][0]["finish_reason"] == "stop"
+
+    req = urllib.request.Request(
+        f"{oai_server}/openai/v1/completions", method="POST",
+        data=json.dumps({"model": "llm", "prompt": "hi", "max_tokens": 8,
+                         "temperature": 0, "stop": [stop],
+                         "stream": True}).encode())
+    with urllib.request.urlopen(req, timeout=120) as r:
+        raw = r.read().decode()
+    events = [l[len("data: "):] for l in raw.split("\n\n")
+              if l.startswith("data: ")]
+    assert events[-1] == "[DONE]"
+    chunks = [json.loads(e) for e in events[:-1]]
+    assert chunks[-1]["choices"][0]["finish_reason"] == "stop"
+    assert "".join(c["choices"][0]["text"] for c in chunks) == expected
+
+
+def test_models_list_and_errors(oai_server):
+    code, body = _http("GET", f"{oai_server}/openai/v1/models")
+    assert code == 200 and body["data"][0]["id"] == "llm"
+    code, body = _http("POST", f"{oai_server}/openai/v1/completions",
+                       {"model": "nope", "prompt": "x"})
+    assert code == 404 and "message" in body["error"]
+    code, body = _http("POST", f"{oai_server}/openai/v1/completions",
+                       {"model": "llm", "prompt": "x", "n": 3})
+    assert code == 400 and "n > 1" in body["error"]["message"]
