@@ -98,9 +98,10 @@ def _chat_ids_or_text(model, messages: list) -> dict:
     """messages → generate payload. HF tokenizers with a chat template
     render it; otherwise a plain role-prefixed transcript with a trailing
     assistant cue."""
-    if not isinstance(messages, list) or not messages:
+    if (not isinstance(messages, list) or not messages
+            or not all(isinstance(m, dict) for m in messages)):
         raise tornado.web.HTTPError(
-            400, reason="messages must be a non-empty array")
+            400, reason="messages must be a non-empty array of objects")
     tok = getattr(model, "tokenizer", None)
     if hasattr(tok, "apply_chat_template") and getattr(
             tok, "chat_template", None):
@@ -148,7 +149,18 @@ class _GenerativeHandler(_OpenAIBase):
         if stops and getattr(model, "tokenizer", None) is None:
             raise tornado.web.HTTPError(
                 400, reason="stop sequences need a tokenizer-bundled model")
-        payload = {**self.make_payload(model, body), **_payload_from(body)}
+        try:
+            payload = {**self.make_payload(model, body),
+                       **_payload_from(body)}
+        except tornado.web.HTTPError:
+            raise
+        except (TypeError, ValueError) as e:
+            # Malformed fields (max_tokens: "abc", temperature: null, a
+            # non-dict chat message, ...) are the CLIENT's fault — the
+            # OpenAI envelope contract wants 400 invalid_request_error,
+            # not a 500.
+            raise tornado.web.HTTPError(
+                400, reason=f"invalid request field: {e}") from None
         rid = f"{'chatcmpl' if 'chat' in self.object_name else 'cmpl'}-" \
               f"{uuid.uuid4().hex[:24]}"
         t0 = time.monotonic()
@@ -175,31 +187,47 @@ class _GenerativeHandler(_OpenAIBase):
         it = model.generate_stream(payload)
         base = {"id": rid, "object": self.object_name + ".chunk",
                 "created": int(time.time()), "model": name}
-        sent = ""
+        # With stop sequences, text is emitted through a pending buffer
+        # that always withholds the last max(len(stop))-1 chars — a stop
+        # spanning chunk boundaries can then still be excluded (already-
+        # sent text can't be retracted), and the per-chunk search scans
+        # only the bounded buffer, not the whole cumulative output.
+        hold = max((len(s) for s in stops), default=1) - 1
+        pending = ""
         tokens_out = 0
         stopped = False
 
         def sse(obj) -> None:
             self.write("data: " + json.dumps(obj) + "\n\n")
 
+        def emit_text(delta: str, final: bool) -> str:
+            nonlocal pending, stopped
+            if not stops:
+                return delta
+            pending += delta
+            whole, hit = _truncate_at_stop(pending, stops)
+            if hit:
+                pending, stopped = "", True
+                return whole
+            if final:
+                out, pending = pending, ""
+                return out
+            keep = min(hold, len(pending))
+            out = pending[:len(pending) - keep] if keep else pending
+            pending = pending[len(pending) - keep:] if keep else ""
+            return out
+
         def render(ev, first):
-            nonlocal sent, tokens_out, stopped
+            nonlocal tokens_out, stopped
             if first:
                 self.set_header("Content-Type", "text/event-stream")
                 self.set_header("Cache-Control", "no-cache")
             done = bool(ev.get("done"))
-            delta = ev.get("text_delta", "")
-            if stops and delta:
-                # Truncate at the earliest stop crossing the cumulative
-                # text; end the stream once it lands.
-                whole, hit = _truncate_at_stop(sent + delta, stops)
-                if hit:
-                    delta, stopped = whole[len(sent):], True
+            delta = emit_text(ev.get("text_delta", ""), done)
             tokens_out += len(ev.get("tokens", ()))
             if delta:
                 sse({**base, "choices": [
                     self.delta_choice(delta, first, None)]})
-                sent += delta
             elif first and not done:
                 sse({**base, "choices": [
                     self.delta_choice("", True, None)]})
@@ -227,8 +255,9 @@ class CompletionsHandler(_GenerativeHandler):
 
     def make_payload(self, model, body: dict) -> dict:
         prompt = body.get("prompt")
-        if isinstance(prompt, list) and prompt and isinstance(
-                prompt[0], int):
+        if isinstance(prompt, list) and prompt and all(
+                isinstance(t, int) and not isinstance(t, bool)
+                for t in prompt):
             return {"input_ids": prompt}
         if isinstance(prompt, list) and len(prompt) == 1 and isinstance(
                 prompt[0], str):

@@ -135,6 +135,46 @@ def test_stop_sequences(oai_server):
     assert chunks[-1]["choices"][0]["finish_reason"] == "stop"
     assert "".join(c["choices"][0]["text"] for c in chunks) == expected
 
+    # Multi-character stop: the streaming path must withhold a possible
+    # stop PREFIX at every chunk boundary so the match is excluded even
+    # when it spans deltas — streamed text equals the non-stream result.
+    _, ref24 = _http("POST", f"{oai_server}/openai/v1/completions",
+                     {"model": "llm", "prompt": "hi", "max_tokens": 24,
+                      "temperature": 0})
+    text24 = ref24["choices"][0]["text"]
+    assert len(text24) >= 3  # 24 greedy tokens render several chars
+    mid = len(text24) // 2
+    stop2 = text24[mid:mid + 2]
+    expect2 = text24[:text24.find(stop2)]
+    _, b2 = _http("POST", f"{oai_server}/openai/v1/completions",
+                  {"model": "llm", "prompt": "hi", "max_tokens": 24,
+                   "temperature": 0, "stop": stop2})
+    assert b2["choices"][0]["text"] == expect2
+    req = urllib.request.Request(
+        f"{oai_server}/openai/v1/completions", method="POST",
+        data=json.dumps({"model": "llm", "prompt": "hi", "max_tokens": 24,
+                         "temperature": 0, "stop": stop2,
+                         "stream": True}).encode())
+    with urllib.request.urlopen(req, timeout=120) as r:
+        raw2 = r.read().decode()
+    chunks2 = [json.loads(l[len("data: "):]) for l in raw2.split("\n\n")
+               if l.startswith("data: ") and not l.endswith("[DONE]")]
+    assert "".join(c["choices"][0]["text"] for c in chunks2) == expect2
+
+
+def test_bad_request_fields_are_400(oai_server):
+    code, body = _http("POST", f"{oai_server}/openai/v1/completions",
+                       {"model": "llm", "prompt": "x",
+                        "max_tokens": "abc"})
+    assert code == 400, body
+    assert body["error"]["type"] == "invalid_request_error"
+    code, body = _http("POST", f"{oai_server}/openai/v1/chat/completions",
+                       {"model": "llm", "messages": ["hi"]})
+    assert code == 400
+    code, body = _http("POST", f"{oai_server}/openai/v1/completions",
+                       {"model": "llm", "prompt": [5, "x"]})
+    assert code == 400
+
 
 def test_models_list_and_errors(oai_server):
     code, body = _http("GET", f"{oai_server}/openai/v1/models")
