@@ -1,0 +1,88 @@
+"""gRPC transport for the suggestion service — remote/polyglot algorithms.
+
+The reference's Experiment controller calls a per-experiment gRPC
+Suggestion service (⟨katib: pkg/apis/manager/v1beta1 — api.proto
+Suggestion.GetSuggestions⟩), which lets algorithm services live in any
+language and on any machine. The in-tree transport here is the JSON-lines
+subprocess (tune/service.py) because the C++ control plane has no gRPC
+toolchain — this module restores the REMOTE contract on top of it:
+
+  * `serve_suggestions()` exposes GetSuggestions over gRPC (generic
+    handlers, JSON payloads — the same request/response shape as
+    service.py, so one contract, two transports);
+  * `RemoteSuggestion` is the typed client;
+  * `service.py --remote host:port` turns the controller-spawned
+    subprocess into a thin proxy, so external algorithm services plug in
+    with ZERO control-plane changes.
+
+JSON payloads rather than a new proto: the shape is already the
+documented contract (service.py docstring), and a polyglot implementer
+needs only a gRPC generic endpoint echoing that JSON — no codegen.
+"""
+
+from __future__ import annotations
+
+import json
+from concurrent import futures
+
+import grpc
+
+SERVICE = "tpukit.tune.Suggestion"
+_METHOD = "GetSuggestions"
+
+
+def _ser(d: dict) -> bytes:
+    return json.dumps(d).encode()
+
+
+def _deser(b: bytes) -> dict:
+    return json.loads(b or b"{}")
+
+
+def serve_suggestions(port: int = 0, *, handler=None,
+                      max_workers: int = 4):
+    """Start a gRPC server answering GetSuggestions with `handler`
+    (default: the in-tree algorithm suite via service.handle). Returns
+    (server, bound_port)."""
+    from kubeflow_tpu.tune.service import handle as default_handle
+
+    handle = handler or default_handle
+
+    def get_suggestions(request: dict, context) -> dict:
+        try:
+            return handle(request)
+        except Exception as e:  # contract: errors ride the envelope
+            return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+
+    rpc = grpc.method_handlers_generic_handler(SERVICE, {
+        _METHOD: grpc.unary_unary_rpc_method_handler(
+            get_suggestions, request_deserializer=_deser,
+            response_serializer=_ser),
+    })
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server.add_generic_rpc_handlers((rpc,))
+    bound = server.add_insecure_port(f"127.0.0.1:{port}")
+    server.start()
+    return server, bound
+
+
+class RemoteSuggestion:
+    """Client for a remote Suggestion service (any language, same JSON
+    contract)."""
+
+    def __init__(self, address: str, timeout: float = 60.0):
+        self._channel = grpc.insecure_channel(address)
+        self._call = self._channel.unary_unary(
+            f"/{SERVICE}/{_METHOD}", request_serializer=_ser,
+            response_deserializer=_deser)
+        self._timeout = timeout
+
+    def get(self, request: dict) -> dict:
+        try:
+            return self._call(request, timeout=self._timeout)
+        except grpc.RpcError as e:
+            return {"ok": False,
+                    "error": f"remote suggestion service: {e.code().name}"}
+
+    def close(self):
+        self._channel.close()

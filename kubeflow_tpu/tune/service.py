@@ -55,14 +55,29 @@ def handle(req: dict) -> dict:
             "pending": out["pending"]}
 
 
-def main() -> int:
+def main(argv: list[str] | None = None) -> int:
     # Line-buffered loop; EOF on stdin = controller went away, exit cleanly.
+    # With --remote HOST:PORT the subprocess becomes a thin proxy to an
+    # external gRPC Suggestion service (tune/grpc_service.py) — remote /
+    # polyglot algorithm services with zero control-plane changes.
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--remote", default="",
+                        help="forward to a gRPC Suggestion service")
+    args = parser.parse_args(argv)
+    remote = None
+    if args.remote:
+        from kubeflow_tpu.tune.grpc_service import RemoteSuggestion
+
+        remote = RemoteSuggestion(args.remote)
     for line in sys.stdin:
         line = line.strip()
         if not line:
             continue
         try:
-            resp = handle(json.loads(line))
+            req = json.loads(line)
+            resp = remote.get(req) if remote is not None else handle(req)
         except Exception as e:  # never kill the service on one bad request
             resp = {"ok": False, "error": f"bad request: {e}"}
         sys.stdout.write(json.dumps(resp) + "\n")

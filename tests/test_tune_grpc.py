@@ -1,0 +1,87 @@
+"""Remote gRPC suggestion transport (katib's Suggestion-service contract:
+algorithm services in any language/machine): gRPC server + client, and
+the controller-facing subprocess proxying to it via --remote."""
+
+import json
+import subprocess
+import sys
+
+from kubeflow_tpu.tune.grpc_service import RemoteSuggestion, serve_suggestions
+
+EXPERIMENT = {
+    "parameters": [
+        {"name": "lr", "type": "double", "min": 1e-4, "max": 1e-1},
+        {"name": "opt", "type": "categorical", "values": ["adam", "sgd"]},
+    ],
+    "objective": {"metric": "loss", "goal": "minimize"},
+    "algorithm": {"name": "random"},
+}
+
+
+def test_grpc_roundtrip_default_algorithms():
+    server, port = serve_suggestions()
+    client = RemoteSuggestion(f"127.0.0.1:{port}")
+    try:
+        resp = client.get({"op": "get_suggestions",
+                           "experiment": EXPERIMENT, "trials": [],
+                           "count": 3, "seed": 1})
+        assert resp["ok"], resp
+        assert len(resp["assignments"]) == 3
+        for a in resp["assignments"]:
+            assert 1e-4 <= a["lr"] <= 1e-1 and a["opt"] in ("adam", "sgd")
+        # Contract errors ride the envelope, never crash the channel.
+        bad = client.get({"op": "nope"})
+        assert not bad["ok"] and "unknown op" in bad["error"]
+    finally:
+        client.close()
+        server.stop(0)
+
+
+def test_grpc_polyglot_custom_handler():
+    """An external algorithm service = any GetSuggestions handler speaking
+    the JSON contract."""
+    def my_algo(req):
+        return {"ok": True, "pending": False,
+                "assignments": [{"lr": 0.005, "opt": "adam"}]
+                * req.get("count", 1)}
+
+    server, port = serve_suggestions(handler=my_algo)
+    client = RemoteSuggestion(f"127.0.0.1:{port}")
+    try:
+        resp = client.get({"op": "get_suggestions", "count": 2})
+        assert resp["assignments"] == [{"lr": 0.005, "opt": "adam"}] * 2
+    finally:
+        client.close()
+        server.stop(0)
+
+
+def test_subprocess_proxy_remote():
+    """The controller-spawned pipe service forwards to the remote gRPC
+    service with --remote — the control plane needs zero changes."""
+    server, port = serve_suggestions()
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "kubeflow_tpu.tune.service",
+             "--remote", f"127.0.0.1:{port}"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True)
+        req = json.dumps({"op": "get_suggestions",
+                          "experiment": EXPERIMENT, "trials": [],
+                          "count": 2, "seed": 5})
+        out, _ = proc.communicate(req + "\n", timeout=60)
+        resp = json.loads(out.splitlines()[0])
+        assert resp["ok"] and len(resp["assignments"]) == 2
+    finally:
+        server.stop(0)
+
+
+def test_subprocess_remote_down_is_contained():
+    """A dead remote returns an error envelope per request — the
+    controller sees a failed suggestion, not a dead service process."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kubeflow_tpu.tune.service",
+         "--remote", "127.0.0.1:1"],  # nothing listens there
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True)
+    req = json.dumps({"op": "get_suggestions", "count": 1})
+    out, _ = proc.communicate(req + "\n", timeout=60)
+    resp = json.loads(out.splitlines()[0])
+    assert not resp["ok"] and "remote suggestion" in resp["error"]
