@@ -40,6 +40,15 @@ from kubeflow_tpu.serve.model import Model
 NEG_INF = -1e30
 
 
+def _chosen_logprob(logits: jax.Array, tok: jax.Array) -> jax.Array:
+    """log P(tok) under the UNTEMPERED distribution — the logprob surface
+    OpenAI reports. logits [B, V], tok [B] -> [B] f32."""
+    lse = jax.scipy.special.logsumexp(logits.astype(jnp.float32), axis=-1)
+    gold = jnp.take_along_axis(
+        logits.astype(jnp.float32), tok[:, None], axis=-1)[:, 0]
+    return gold - lse
+
+
 def sample_tokens(logits: jax.Array, temperature: jax.Array,
                   key: jax.Array, top_k: jax.Array | None = None,
                   top_p: jax.Array | None = None) -> jax.Array:
@@ -162,7 +171,7 @@ class GenerationEngine:
             last = jnp.take_along_axis(
                 logits, (length - 1)[:, None, None], axis=1)[:, 0]  # [1, V]
             tok = sample_tokens(last, temperature, key, top_k, top_p)
-            return cache, tok
+            return cache, tok, _chosen_logprob(last, tok)
 
         def extend(params, cache, tokens, length, index, temperature,
                    top_k, top_p, key):
@@ -177,7 +186,7 @@ class GenerationEngine:
             last = jnp.take_along_axis(
                 logits, (length - 1)[:, None, None], axis=1)[:, 0]
             tok = sample_tokens(last, temperature, key, top_k, top_p)
-            return cache, tok
+            return cache, tok, _chosen_logprob(last, tok)
 
         def extend_mid(params, cache, tokens, index):
             """Intermediate continuation chunk: cache write + attention
@@ -226,9 +235,10 @@ class GenerationEngine:
                                             top_k, top_p)
                     else:
                         nxt = sample_tokens(logits[:, 0], temperature, sub)
-                    return (sliced, nxt, idx + 1, key), nxt
+                    lp = _chosen_logprob(logits[:, 0], nxt)
+                    return (sliced, nxt, idx + 1, key), (nxt, lp)
 
-                (sliced, _, _, _), toks = jax.lax.scan(
+                (sliced, _, _, _), (toks, lps) = jax.lax.scan(
                     step, (sliced, last_tok, index, key), None,
                     length=self.chunk)
                 if bucket != self.max_len:
@@ -237,7 +247,7 @@ class GenerationEngine:
                             c, s, (0,) * c.ndim), cache, sliced)
                 else:
                     cache = sliced
-                return cache, toks.T
+                return cache, toks.T, lps.T
             return decode_chunk
 
         prefill_jit = jax.jit(prefill)
@@ -258,7 +268,7 @@ class GenerationEngine:
         one_p = jnp.ones((1,), jnp.float32)
         frag = None
         for b in self.prefill_buckets:
-            frag, _ = self._prefill[b](
+            frag, _, _ = self._prefill[b](
                 self._params, jnp.zeros((1, b), jnp.int32), one_l, zero_t,
                 zero_k, one_p, self._key)
         if self._may_chunk or self._prefix_cap:  # offset-write paths
@@ -269,13 +279,13 @@ class GenerationEngine:
                 jnp.zeros((1, self.prefill_buckets[-1]), jnp.int32),
                 zero_k)
             for b in self.prefill_buckets:
-                frag, _ = self._extend(
+                frag, _, _ = self._extend(
                     self._params, frag, jnp.zeros((1, b), jnp.int32),
                     one_l, zero_k, zero_t, zero_k, one_p, self._key)
         self._cache = self._insert(self._cache, frag, jnp.int32(0))
         n = self.n_slots
         for fn in self._decode.values():
-            self._cache, _ = fn(
+            self._cache, _, _ = fn(
                 self._params, self._cache, jnp.zeros((n,), jnp.int32),
                 jnp.zeros((n,), jnp.int32), jnp.zeros((n,), jnp.float32),
                 jnp.zeros((n,), jnp.int32), jnp.ones((n,), jnp.float32),
@@ -310,6 +320,7 @@ class GenerationEngine:
             "top_p": float(top_p),
             "eos_id": eos_id,
             "out": [],
+            "out_logprobs": [],
             "done": threading.Event(),
             "error": None,
             "t0": time.monotonic(),
@@ -323,6 +334,7 @@ class GenerationEngine:
             raise RuntimeError(req["error"])
         return {
             "output_ids": req["out"],
+            "output_logprobs": req["out_logprobs"],
             "num_input_tokens": len(req["input_ids"]),
             "num_output_tokens": len(req["out"]),
             "latency_s": time.monotonic() - req["t0"],
@@ -398,12 +410,12 @@ class GenerationEngine:
             toks[0, :len(piece)] = piece
             if done == 0:
                 self._key, sub = jax.random.split(self._key)
-                frag, tok0 = self._prefill[bucket](
+                frag, tok0, lp0 = self._prefill[bucket](
                     self._params, jnp.asarray(toks),
                     jnp.asarray([len(piece)], jnp.int32), *sample_args, sub)
             elif final:
                 self._key, sub = jax.random.split(self._key)
-                frag, tok0 = self._extend(
+                frag, tok0, lp0 = self._extend(
                     self._params, frag, jnp.asarray(toks),
                     jnp.asarray([len(piece)], jnp.int32),
                     jnp.asarray([done], jnp.int32), *sample_args, sub)
@@ -419,9 +431,10 @@ class GenerationEngine:
         self._slots[slot] = {"req": req, "idx": len(ids), "last": first}
         self.stats["requests"] += 1
         self.stats["prompt_tokens"] += len(ids)
-        self._emit(slot, [first])
+        self._emit(slot, [first], [float(lp0[0])])
 
-    def _emit(self, slot: int, tokens: list[int]) -> None:
+    def _emit(self, slot: int, tokens: list[int],
+              logprobs: list[float] | None = None) -> None:
         """Append generated tokens to the slot's request; retire on EOS /
         budget / context exhaustion. Streams newly appended tokens to the
         request's on_tokens callback when one is set."""
@@ -429,10 +442,12 @@ class GenerationEngine:
         req = st["req"]
         new: list[int] = []
         finished = req["done"].is_set()
-        for t in tokens:
+        for j, t in enumerate(tokens):
             if finished:
                 break
             req["out"].append(t)
+            if logprobs is not None:
+                req["out_logprobs"].append(logprobs[j])
             new.append(t)
             if ((req["eos_id"] is not None and t == req["eos_id"])
                     or len(req["out"]) >= req["max_tokens"]):
@@ -495,11 +510,12 @@ class GenerationEngine:
             bucket = next((b for b in self.decode_buckets if b >= need),
                           self.max_len)
             decode = self._decode[(bucket, trunc)]
-            self._cache, toks = decode(
+            self._cache, toks, lps = decode(
                 self._params, self._cache, jnp.asarray(last),
                 jnp.asarray(idx), jnp.asarray(temps), jnp.asarray(ks),
                 jnp.asarray(ps), sub)
             toks = np.asarray(toks)  # sync point: [B, chunk]
+            lps = np.asarray(lps)
             dt = time.monotonic() - t0
             self.stats["decode_seconds"] += dt
             self.stats["decode_dispatches"] += 1
@@ -508,7 +524,8 @@ class GenerationEngine:
                 st = self._slots[i]
                 st["idx"] += self.chunk
                 st["last"] = int(toks[i, -1])
-                self._emit(i, [int(t) for t in toks[i]])
+                self._emit(i, [int(t) for t in toks[i]],
+                           [float(v) for v in lps[i]])
 
     def throughput(self) -> float:
         s = self.stats

@@ -120,6 +120,23 @@ def _finish_reason(out: dict, max_tokens: int, stopped: bool) -> str:
         else "stop"
 
 
+def _token_strings(model, ids: list) -> list[str]:
+    """Byte-faithful per-token strings: multi-byte UTF-8 split across
+    byte tokens must stay identifiable (OpenAI renders such tokens as
+    bytes:0x..), and special tokens must not vanish — so NOT the
+    skip-special full decode."""
+    tok = getattr(model, "tokenizer", None)
+    if tok == "bytes":
+        return [chr(t) if 32 <= t < 127 else
+                (f"bytes:{t:#04x}" if 0 <= t < 256 else str(t))
+                for t in ids]
+    if hasattr(tok, "convert_ids_to_tokens"):
+        return [str(s) for s in tok.convert_ids_to_tokens(ids)]
+    if hasattr(tok, "decode"):
+        return [tok.decode([t]) for t in ids]
+    return [str(t) for t in ids]
+
+
 def _usage(out: dict) -> dict:
     p = out.get("num_input_tokens", 0)
     c = out.get("num_output_tokens", 0)
@@ -133,7 +150,13 @@ class _GenerativeHandler(_OpenAIBase):
     def make_payload(self, model, body: dict) -> dict:
         raise NotImplementedError
 
-    def choice(self, out_text: str, finish) -> dict:
+    def choice(self, out_text: str, finish, lp=None) -> dict:
+        raise NotImplementedError
+
+    def logprobs_obj(self, model, out) -> dict:
+        raise NotImplementedError
+
+    def wants_logprobs(self, body: dict) -> bool:
         raise NotImplementedError
 
     def delta_choice(self, delta: str, first: bool, finish) -> dict:
@@ -165,6 +188,9 @@ class _GenerativeHandler(_OpenAIBase):
               f"{uuid.uuid4().hex[:24]}"
         t0 = time.monotonic()
         if body.get("stream"):
+            if self.wants_logprobs(body):
+                raise tornado.web.HTTPError(
+                    400, reason="logprobs with stream is not supported")
             await self._stream(name, model, payload, rid, stops, t0)
             return
         try:
@@ -174,12 +200,17 @@ class _GenerativeHandler(_OpenAIBase):
             raise tornado.web.HTTPError(400, reason=str(e)) from None
         text, stopped = _truncate_at_stop(out.get("text", ""), stops)
         finish = _finish_reason(out, payload["max_tokens"], stopped)
+        # Chosen-token logprobs on request (top-N alternatives are not
+        # computed; with stop truncation the list covers all SAMPLED
+        # tokens, which may extend past the text cut).
+        lp = (self.logprobs_obj(model, out)
+              if self.wants_logprobs(body) else None)
         self.server.observe(name, out.get("num_output_tokens", 0),
                             time.monotonic() - t0)
         self.write_json({
             "id": rid, "object": self.object_name,
             "created": int(time.time()), "model": name,
-            "choices": [self.choice(text, finish)],
+            "choices": [self.choice(text, finish, lp)],
             "usage": _usage(out),
         })
 
@@ -267,9 +298,19 @@ class CompletionsHandler(_GenerativeHandler):
         raise tornado.web.HTTPError(
             400, reason="prompt must be a string or a token-id array")
 
-    def choice(self, out_text, finish):
-        return {"index": 0, "text": out_text, "logprobs": None,
+    def choice(self, out_text, finish, lp=None):
+        return {"index": 0, "text": out_text, "logprobs": lp,
                 "finish_reason": finish}
+
+    def logprobs_obj(self, model, out):
+        return {"tokens": _token_strings(model, out.get("output_ids", [])),
+                "token_logprobs": out.get("output_logprobs", []),
+                "top_logprobs": None, "text_offset": None}
+
+    def wants_logprobs(self, body):
+        # Legacy completions semantics: logprobs is an int, and 0 is a
+        # VALID request (chosen-token logprobs, zero alternatives).
+        return body.get("logprobs") is not None
 
     def delta_choice(self, delta, first, finish):
         return {"index": 0, "text": delta, "logprobs": None,
@@ -282,9 +323,23 @@ class ChatCompletionsHandler(_GenerativeHandler):
     def make_payload(self, model, body: dict) -> dict:
         return _chat_ids_or_text(model, body.get("messages"))
 
-    def choice(self, out_text, finish):
-        return {"index": 0, "finish_reason": finish,
-                "message": {"role": "assistant", "content": out_text}}
+    def choice(self, out_text, finish, lp=None):
+        c = {"index": 0, "finish_reason": finish,
+             "message": {"role": "assistant", "content": out_text}}
+        if lp is not None:
+            c["logprobs"] = lp
+        return c
+
+    def logprobs_obj(self, model, out):
+        toks = _token_strings(model, out.get("output_ids", []))
+        # bytes/top_logprobs are part of the chat schema — strict SDK
+        # consumers construct models from these keys.
+        return {"content": [
+            {"token": s, "logprob": l, "bytes": None, "top_logprobs": []}
+            for s, l in zip(toks, out.get("output_logprobs", []))]}
+
+    def wants_logprobs(self, body):
+        return bool(body.get("logprobs"))
 
     def delta_choice(self, delta, first, finish):
         d: dict = {"content": delta} if delta else {}

@@ -39,11 +39,14 @@ def _deser(b: bytes) -> dict:
     return json.loads(b or b"{}")
 
 
-def serve_suggestions(port: int = 0, *, handler=None,
-                      max_workers: int = 4):
+def serve_suggestions(port: int = 0, *, host: str = "127.0.0.1",
+                      handler=None, max_workers: int = 4):
     """Start a gRPC server answering GetSuggestions with `handler`
     (default: the in-tree algorithm suite via service.handle). Returns
-    (server, bound_port)."""
+    (server, bound_port). `host` defaults to loopback for safety — pass
+    "0.0.0.0" (or a NIC address) to serve REMOTE controllers; the
+    channel is insecure, so front it with your mesh/mTLS like any katib
+    suggestion deployment."""
     from kubeflow_tpu.tune.service import handle as default_handle
 
     handle = handler or default_handle
@@ -61,7 +64,7 @@ def serve_suggestions(port: int = 0, *, handler=None,
     })
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
     server.add_generic_rpc_handlers((rpc,))
-    bound = server.add_insecure_port(f"127.0.0.1:{port}")
+    bound = server.add_insecure_port(f"{host}:{port}")
     server.start()
     return server, bound
 

@@ -185,3 +185,49 @@ def test_models_list_and_errors(oai_server):
     code, body = _http("POST", f"{oai_server}/openai/v1/completions",
                        {"model": "llm", "prompt": "x", "n": 3})
     assert code == 400 and "n > 1" in body["error"]["message"]
+
+
+def test_logprobs(oai_server):
+    code, body = _http("POST", f"{oai_server}/openai/v1/completions",
+                       {"model": "llm", "prompt": "hi", "max_tokens": 5,
+                        "temperature": 0, "logprobs": 1})
+    assert code == 200, body
+    lp = body["choices"][0]["logprobs"]
+    assert len(lp["token_logprobs"]) == 5 and len(lp["tokens"]) == 5
+    assert all(v <= 0.0 for v in lp["token_logprobs"])
+    # Greedy sampling: the chosen token is the argmax, so its logprob is
+    # the max over the vocab -> finite and ordinarily > -20.
+    assert all(v > -30 for v in lp["token_logprobs"])
+    code, body = _http(
+        "POST", f"{oai_server}/openai/v1/chat/completions",
+        {"model": "llm", "max_tokens": 3, "temperature": 0,
+         "logprobs": True,
+         "messages": [{"role": "user", "content": "hi"}]})
+    assert code == 200, body
+    content = body["choices"][0]["logprobs"]["content"]
+    assert len(content) == 3
+    assert all("token" in c and c["logprob"] <= 0.0 for c in content)
+
+
+def test_logprobs_zero_and_stream_rules(oai_server):
+    # logprobs: 0 is a VALID legacy-completions request.
+    code, body = _http("POST", f"{oai_server}/openai/v1/completions",
+                       {"model": "llm", "prompt": "hi", "max_tokens": 3,
+                        "temperature": 0, "logprobs": 0})
+    assert code == 200 and body["choices"][0]["logprobs"] is not None
+    # bytes-faithful token strings: never a bare U+FFFD.
+    assert all("�" not in t
+               for t in body["choices"][0]["logprobs"]["tokens"])
+    # Streaming + logprobs is an explicit 400, not a silent drop.
+    code, body = _http("POST", f"{oai_server}/openai/v1/completions",
+                       {"model": "llm", "prompt": "hi", "stream": True,
+                        "logprobs": 1})
+    assert code == 400 and "logprobs" in body["error"]["message"]
+    # Chat schema carries bytes/top_logprobs keys for strict SDKs.
+    _, body = _http(
+        "POST", f"{oai_server}/openai/v1/chat/completions",
+        {"model": "llm", "max_tokens": 2, "temperature": 0,
+         "logprobs": True,
+         "messages": [{"role": "user", "content": "hi"}]})
+    entry = body["choices"][0]["logprobs"]["content"][0]
+    assert "bytes" in entry and entry["top_logprobs"] == []
