@@ -19,11 +19,96 @@ every synchronous host fetch, which is dispatch latency, not step time.
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
+#: Last chip-measured result (BENCH_r02), kept so a skip record still tells
+#: the reader what the framework does when the backend is healthy.
+LAST_GOOD = {"round": "r02", "tokens_per_sec_per_chip": 20842.4,
+             "mfu": 0.5645, "device_kind": "TPU v6 lite"}
+
+
+def _probe_backend(timeout_s: float = 120.0) -> tuple[bool, str]:
+    """Probe TPU backend init in a subprocess.
+
+    A broken axon tunnel can either raise UNAVAILABLE quickly or hang the
+    PJRT client handshake indefinitely (both observed, rounds 3-4), so the
+    probe must be a separate process with a hard timeout — an in-process
+    try/except cannot bound a hang.
+    """
+    code = ("import jax; d = jax.devices(); "
+            "print(d[0].platform, '|', d[0].device_kind, '|', len(d))")
+    try:
+        p = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                           text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return False, f"backend init hung past {timeout_s:.0f}s"
+    out = p.stdout.strip()
+    if p.returncode == 0 and out:
+        # JAX silently falls back to CPU when libtpu is absent or
+        # JAX_PLATFORMS leaks in from the environment — a CPU device is
+        # a FAILED probe, not a healthy backend, or the headline
+        # tok/s/chip number would be measured on the wrong hardware.
+        # Accept native 'tpu' AND the axon tunnel plugin, whose platform
+        # string is 'axon' (device_kind still reads 'TPU v...').
+        platform = out.split(" |", 1)[0]
+        if platform in ("tpu", "axon"):
+            return True, out
+        return False, f"non-TPU backend came up: {out}"
+    lines = [ln for ln in (p.stderr or p.stdout).strip().splitlines() if ln]
+    return False, lines[-1] if lines else f"probe rc={p.returncode}"
+
+
+def acquire_backend(attempts: int = 4,
+                    probe_timeout_s: float = 120.0) -> tuple[bool, str]:
+    """Bounded-backoff probe loop: ~10.6 min worst case (4 probes x 120 s
+    timeout + 155 s backoff), never hangs.
+
+    The round-3 outage was transient on the scale of hours — a short retry
+    window catches a flake mid-clear, and on persistent failure the caller
+    emits a structured skip record instead of a raw traceback
+    (VERDICT r3 items 1 + weak 1)."""
+    delays = [0.0, 20.0, 45.0, 90.0]
+    detail = ""
+    for i in range(attempts):
+        if i < len(delays) and delays[i]:
+            time.sleep(delays[i])
+        ok, detail = _probe_backend(probe_timeout_s)
+        print(f"backend probe {i + 1}/{attempts}: "
+              f"{'ok ' if ok else ''}{detail}", file=sys.stderr, flush=True)
+        if ok:
+            return True, detail
+    return False, detail
+
+
+def _emit_skip(metric: str, unit: str, detail: str, attempts: int) -> None:
+    print(json.dumps({
+        "metric": metric,
+        "value": None,
+        "unit": unit,
+        "vs_baseline": None,
+        "skipped": "tpu_unavailable",
+        "detail": detail,
+        "probe_attempts": attempts,
+        "last_good": LAST_GOOD,
+    }))
+
+
+def _probe_attempts() -> int:
+    """Probe budget; env-overridable so tests / manual runs can shorten
+    the ~10-minute worst-case retry window."""
+    return max(1, int(os.environ.get("KFT_BENCH_PROBE_ATTEMPTS", "4")))
+
 
 def main() -> None:
+    attempts = _probe_attempts()
+    ok, detail = acquire_backend(attempts=attempts)
+    if not ok:
+        _emit_skip("tokens_per_sec_per_chip", "tok/s/chip", detail, attempts)
+        return
+
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -101,10 +186,37 @@ def main() -> None:
 
 def main_serve() -> None:
     """`python bench.py --serve`: serving benchmark → SERVEBENCH.json +
-    one JSON line on stdout (kubeflow_tpu/serve/bench.py)."""
+    one JSON line on stdout (kubeflow_tpu/serve/bench.py).
+
+    If the TPU backend is unavailable the bench still runs — on CPU, with
+    the result explicitly labeled `platform: cpu-fallback` and a smaller
+    config (CPU decode at 0.9B is ~100x slower than chip; the fallback
+    numbers exercise the harness and relative claims like bucketed-vs-flat,
+    not absolute throughput). VERDICT r3 item 3."""
+    attempts = _probe_attempts()
+    ok, detail = acquire_backend(attempts=attempts)
+    fallback = not ok
+    if fallback:
+        print(f"serve bench: TPU unavailable ({detail}); "
+              "falling back to CPU with explicit labeling",
+              file=sys.stderr, flush=True)
+        # The axon sitecustomize pins JAX_PLATFORMS=axon at interpreter
+        # start, so the env var is already consumed — jax.config is the
+        # only override that works post-import (same trick as conftest).
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
     from kubeflow_tpu.serve.bench import run_servebench
 
-    result = run_servebench(size="1b", quick=False)
+    result = run_servebench(size="tiny" if fallback else "1b",
+                            quick=fallback)
+    result["platform"] = "cpu-fallback" if fallback else "tpu"
+    if fallback:
+        result["fallback_reason"] = detail
+        result["note"] = ("CPU fallback: absolute throughput is not "
+                          "representative of chip performance; relative "
+                          "metrics (bucket speedup, int8 delta, batcher "
+                          "percentiles) remain meaningful.")
     with open("SERVEBENCH.json", "w") as fh:
         json.dump(result, fh, indent=1)
     print(json.dumps({
@@ -113,6 +225,7 @@ def main_serve() -> None:
             f"slots_{max(int(k.split('_')[1]) for k in result['decode'])}"][
                 "decode_tok_s"],
         "unit": "tok/s",
+        "platform": result["platform"],
         "detail": "SERVEBENCH.json",
     }))
 

@@ -6,6 +6,7 @@
 
 #pragma once
 
+#include <cmath>
 #include <string>
 
 #include "json.h"
@@ -73,6 +74,14 @@ inline std::string ValidateSpec(const std::string& kind, const Json& spec) {
         if (v.is_null()) return "";
         if (!v.is_number()) {
           return std::string("runtime.") + field + " must be a number";
+        }
+        // as_int() truncates: accum_steps: 2.5 would pass admission as 2
+        // while the worker receives 2.5 and fails later — the late failure
+        // this webhook exists to prevent. Bounds first: casting a double
+        // beyond int64 range is UB, so reject before as_int() ever runs.
+        const double num = v.as_number();
+        if (num < -9.2e18 || num > 9.2e18 || num != std::floor(num)) {
+          return std::string("runtime.") + field + " must be an integer";
         }
         *out = v.as_int();
         if (*out < min) {

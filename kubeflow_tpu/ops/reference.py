@@ -22,6 +22,16 @@ def naive_attention(q, k, v, *, causal: bool = True,
     separate kv set) additionally confine attention within equal-id spans
     — the packed-sequence mask. `mask` (a flash_attention.MaskSpec)
     selects causal/full/prefix_lm/sliding_window, overriding `causal`."""
+    if (mask is not None and mask.kind == "prefix_lm"
+            and segment_ids is not None):
+        # Same refusal as flash_attention: a global prefix boundary is
+        # ill-defined over packed documents whose positions restart per
+        # segment — accepting it here would let attention_impl='naive'
+        # run semantics the fused path deliberately rejects.
+        raise ValueError(
+            "prefix_lm mask is incompatible with packed segment_ids: "
+            "the prefix boundary is global but packed positions restart "
+            "per document")
     b, s, h, d = q.shape
     t, kh = k.shape[1], k.shape[2]
     group = h // kh

@@ -56,9 +56,10 @@ def _contraction_axes(path_names: list[str], ndim: int) -> tuple[int, ...]:
     never reduced — scales stay per-layer. Reducing over axis 0
     unconditionally (the old scheme) maxed over LAYERS on scanned stacks
     and stored a near-full-size fp32 scale tensor."""
-    if "o_proj" in path_names and ndim >= 3:
+    if any(n in path_names for n in ("o_proj", "o")) and ndim >= 3:
         return (ndim - 3, ndim - 2)  # [..., heads, head_dim, out]
-    if any(n in path_names for n in ("q_proj", "k_proj", "v_proj")) \
+    if any(n in path_names
+           for n in ("q_proj", "k_proj", "v_proj", "q", "k", "v")) \
             and ndim >= 3:
         return (ndim - 3,)           # [..., in, heads, head_dim]
     if path_names and path_names[-1] == "embed":

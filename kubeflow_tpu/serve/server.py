@@ -42,6 +42,10 @@ class ModelRepository:
     """Name → Model with load/unload — the multi-model surface the reference
     exposes via its repository API + agent model puller."""
 
+    #: Seconds a replaced model version stays loaded after a swap so
+    #: in-flight requests against it finish (class attr: tests shrink it).
+    UNLOAD_GRACE_S = 10.0
+
     def __init__(self):
         self._models: dict[str, Model] = {}
         self._batchers: dict[str, Batcher] = {}
@@ -77,8 +81,28 @@ class ModelRepository:
             # grabbed the old model just before the swap (e.g. oversized
             # calls that bypass the drained batcher) finish first; a
             # request still running after the grace sees the same cut a
-            # rolling pod replacement would give it.
-            threading.Timer(10.0, old_model.unload).start()
+            # rolling pod replacement would give it. The callback
+            # re-checks the live registration: a rollback can re-register
+            # the same object inside the grace window, and unloading it
+            # then would kill the now-live model.
+            def _deferred_unload(name=model.name, old=old_model):
+                # Check-and-unload under the lock so it serializes with a
+                # concurrent rollback's install; the post-install re-load
+                # below covers the remaining interleaving.
+                with self._lock:
+                    if self._models.get(name) is old:
+                        return  # rolled back — old is live again
+                    old.unload()
+
+            t = threading.Timer(self.UNLOAD_GRACE_S, _deferred_unload)
+            t.daemon = True  # never delays interpreter exit
+            t.start()
+        if load and not model.ready:
+            # A stale grace-window timer from an earlier swap can unload
+            # this object between our readiness check above and the
+            # install; now that we ARE the live registration any later
+            # timer spares us, so one re-load makes this race-free.
+            model.load()
         return model
 
     def get(self, name: str) -> Model:

@@ -173,6 +173,12 @@ def test_runtime_spec_admission(controlplane):
     spec["runtime"]["accum_steps"] = 3
     with pytest.raises(Exception, match="accum_steps"):
         client.submit_jaxjob("badaccum", spec)
+    # Non-integral numbers must be rejected, not truncated: 2.5 would pass
+    # as 2 while the worker receives 2.5 and fails later.
+    spec = _mnist_spec(steps=10)
+    spec["runtime"]["accum_steps"] = 2.5
+    with pytest.raises(Exception, match="accum_steps must be an integer"):
+        client.submit_jaxjob("badaccumfloat", spec)
 
 
 def test_elastic_resubmit_at_different_replica_count(controlplane):

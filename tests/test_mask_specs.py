@@ -83,6 +83,11 @@ def test_prefix_lm_refuses_segments():
     with pytest.raises(ValueError, match="prefix_lm"):
         flash_attention(q, k, v, mask=MaskSpec("prefix_lm", prefix=8),
                         segment_ids=seg)
+    # The portable fallback must refuse identically — otherwise
+    # attention_impl='naive' runs semantics the fused path rejects.
+    with pytest.raises(ValueError, match="prefix_lm"):
+        naive_attention(q, k, v, mask=MaskSpec("prefix_lm", prefix=8),
+                        segment_ids=seg)
 
 
 def test_mask_spec_validation():

@@ -39,6 +39,29 @@ def test_small_leaves_stay_full_precision():
     assert by["quantized"] < by["full"]
 
 
+def test_bert_attention_scale_shapes():
+    """BERT names its attention kernels q/k/v/o (not *_proj): the scales
+    must still reduce over the true contraction axes — q/k/v [hidden,
+    heads, head_dim] over hidden, o [heads, head_dim, hidden] over
+    (heads, head_dim) — giving per-output-channel scale tensors, not the
+    hidden*head_dim bloat the default (ndim-2,) branch would store."""
+    hidden, heads, hd = 64, 4, 16
+    key = jax.random.key(3)
+    params = {
+        "q": {"kernel": jax.random.normal(key, (hidden, heads, hd))},
+        "o": {"kernel": jax.random.normal(key, (heads, hd, hidden))},
+    }
+    q = quantize_tree(params, min_size=1)
+    assert q["q"]["kernel"].scale.shape == (1, heads, hd)
+    assert q["o"]["kernel"].scale.shape == (1, 1, hidden)
+    # Dequantize stays numerically faithful regardless of axis choice.
+    deq = dequantize_tree(q, jnp.float32)
+    for name in ("q", "o"):
+        w, d = params[name]["kernel"], deq[name]["kernel"]
+        rel = float(jnp.linalg.norm(d - w) / jnp.linalg.norm(w))
+        assert rel < 0.01, (name, rel)
+
+
 def test_int_leaves_untouched():
     params = {"table": jnp.arange(10000, dtype=jnp.int32).reshape(100, 100)}
     q = quantize_tree(params, min_size=1)

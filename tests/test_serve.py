@@ -329,3 +329,36 @@ def test_repository_async_load_supersede_and_cancel(tmp_path):
     repo3.close()
     repo.close()
     repo2.close()
+
+
+def test_deferred_unload_spares_rolled_back_model():
+    """A version swap schedules the old model's unload after a grace
+    window; a rollback that re-registers the SAME object inside the
+    window must cancel the effect — the pending timer may not unload the
+    now-live model. A genuinely replaced version still unloads."""
+    import time
+
+    from kubeflow_tpu.serve.server import ModelRepository
+
+    class Tracked(Model):
+        def predict(self, inputs):
+            return inputs
+
+    old_grace = ModelRepository.UNLOAD_GRACE_S
+    ModelRepository.UNLOAD_GRACE_S = 0.1
+    try:
+        repo = ModelRepository()
+        v1, v2 = Tracked("m"), Tracked("m")
+        repo.register(v1)
+        repo.register(v2)   # swap: v1's unload scheduled
+        repo.register(v1)   # rollback inside the grace window
+        time.sleep(0.5)
+        assert v1.ready, "rollback victim was unloaded by stale timer"
+
+        repo.register(v2)   # swap away again, no rollback this time
+        time.sleep(0.5)
+        assert not v1.ready, "replaced version never unloaded"
+        assert v2.ready
+        repo.close()
+    finally:
+        ModelRepository.UNLOAD_GRACE_S = old_grace
