@@ -7,6 +7,7 @@
 #pragma once
 
 #include <cmath>
+#include <set>
 #include <string>
 
 #include "json.h"
@@ -235,6 +236,39 @@ inline std::string ValidateSpec(const std::string& kind, const Json& spec) {
       int64_t pct = canary.get("traffic_percent").as_int(10);
       if (pct < 0 || pct > 100) {
         return "canary.traffic_percent must be in [0, 100]";
+      }
+    }
+    // Tensor-parallel serving mesh: {"tensor": 8} etc. The axis product
+    // is the device count one replica's SPMD program spans — it must be
+    // covered by devices_per_replica or the scheduler would launch a
+    // mesh bigger than its allocation.
+    const Json& mesh = model.get("mesh");
+    if (!mesh.is_null()) {
+      if (!mesh.is_object()) return "model.mesh must be an object";
+      static const std::set<std::string> kAxes = {"data", "fsdp", "pipe",
+                                                  "tensor", "seq", "expert"};
+      int64_t prod = 1;
+      for (const auto& [axis, n] : mesh.items()) {
+        if (!kAxes.count(axis)) {
+          return "model.mesh: unknown axis " + axis;
+        }
+        if (!n.is_number() ||
+            n.as_number() != static_cast<double>(n.as_int(0)) ||
+            n.as_int(0) < 1) {
+          return "model.mesh." + axis + " must be an integer >= 1";
+        }
+        // Overflow-safe product: divide-first so prod can never exceed
+        // 2^40 (far past any real device count) — a wrapped-negative
+        // product would sail under the budget check below.
+        if (n.as_int() > (int64_t{1} << 40) / prod) {
+          return "model.mesh device product is implausibly large";
+        }
+        prod *= n.as_int();
+      }
+      if (prod > spec.get("devices_per_replica").as_int(1)) {
+        return "model.mesh needs " + std::to_string(prod) +
+               " devices but devices_per_replica is " +
+               std::to_string(spec.get("devices_per_replica").as_int(1));
       }
     }
     return "";

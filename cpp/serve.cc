@@ -249,6 +249,17 @@ void ServeController::EnsureReplica(View& v, int index) {
       s.argv.push_back("--name");
       s.argv.push_back(model.get("name").as_string());
     }
+    // Tensor-parallel serving: model.mesh {"tensor": 8} → --mesh tensor=8
+    // (admission already validated axes and the device budget).
+    if (model.get("mesh").is_object()) {
+      std::string mesh_arg;
+      for (const auto& [axis, n] : model.get("mesh").items()) {
+        if (!mesh_arg.empty()) mesh_arg += ",";
+        mesh_arg += axis + "=" + std::to_string(n.as_int(1));
+      }
+      s.argv.push_back("--mesh");
+      s.argv.push_back(mesh_arg);
+    }
     if (v.spec.get("max_batch_size").is_number()) {
       s.argv.push_back("--max-batch-size");
       s.argv.push_back(

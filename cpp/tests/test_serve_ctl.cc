@@ -107,6 +107,28 @@ int main() {
     CHECK(h.ctl.metrics().replica_starts == 2);
   }
 
+  // --- Tensor-parallel mesh flag reaches the server ---------------------
+  {
+    Harness h;
+    Json spec = BaseSpec(1);
+    spec["devices_per_replica"] = 4;
+    Json mesh = Json::Object();
+    mesh["tensor"] = 4;
+    Json model = spec.get("model");
+    model["mesh"] = mesh;
+    spec["model"] = model;
+    h.store.Create("InferenceService", "svc-tp", spec);
+    h.Tick();
+    CHECK(h.exec.launched.size() == 1);
+    const auto& argv = h.exec.launched[0].argv;
+    bool found = false;
+    for (size_t i = 0; i + 1 < argv.size(); ++i) {
+      if (argv[i] == "--mesh" && argv[i + 1] == "tensor=4") found = true;
+    }
+    CHECK(found);
+    CHECK(h.sched.Slices()[0].used == 4);  // the mesh's devices are held
+  }
+
   // --- Crash loop: backoff, relaunch on new port, streak reset ----------
   {
     Harness h;

@@ -84,18 +84,148 @@ def sample_tokens(logits: jax.Array, temperature: jax.Array,
     return jnp.where(temperature > 0, sampled, greedy)
 
 
+def build_engine_fns(model, cfg, *, max_len: int, chunk: int,
+                     prefill_buckets: Sequence[int],
+                     offset_writes: bool,
+                     cache_sharding=None) -> dict:
+    """The engine's pure device functions, as unjitted closures.
+
+    Single source of truth shared by the live `GenerationEngine` (which
+    jits them) and the 8B serving scale proof (which AOT-lowers THESE
+    functions with tensor-parallel shardings — proving the memory envelope
+    of the actual product, not a hand-written stand-in;
+    `utils/scaleproof.py` serve_8b_tp8). `cache_sharding` (a NamedSharding
+    or None) pins fragment caches created inside prefill so GSPMD shards
+    KV heads over `tensor` instead of guessing from use.
+    """
+    from kubeflow_tpu.models.llama import init_cache
+
+    prefill_buckets = sorted(prefill_buckets)
+    big = prefill_buckets[-1]
+    frag_len = max_len + (big if offset_writes else 0)
+
+    def _constrain_cache(cache):
+        if cache_sharding is None:
+            return cache
+        return jax.tree.map(
+            lambda c: jax.lax.with_sharding_constraint(c, cache_sharding),
+            cache)
+
+    def prefill(params, tokens, length, temperature, top_k, top_p, key):
+        """tokens [1, S_bucket] right-padded; returns (frag_cache,
+        first sampled token [1], its logprob [1])."""
+        cache = _constrain_cache(init_cache(cfg, 1, frag_len))
+        logits, cache = model.apply(
+            {"params": params}, tokens, cache=cache,
+            cache_index=jnp.zeros((1,), jnp.int32))
+        last = jnp.take_along_axis(
+            logits, (length - 1)[:, None, None], axis=1)[:, 0]  # [1, V]
+        tok = sample_tokens(last, temperature, key, top_k, top_p)
+        return cache, tok, _chosen_logprob(last, tok)
+
+    def extend(params, cache, tokens, length, index, temperature,
+               top_k, top_p, key):
+        """FINAL continuation chunk of a long prompt: tokens
+        [1, S_bucket] right-padded, written at offset `index` [1],
+        attending over the WHOLE fragment cache; samples the first
+        generated token like prefill."""
+        positions = index[:, None] + jnp.arange(tokens.shape[1])[None]
+        logits, cache = model.apply(
+            {"params": params}, tokens, cache=cache, cache_index=index,
+            positions=positions, attend_full_cache=True)
+        last = jnp.take_along_axis(
+            logits, (length - 1)[:, None, None], axis=1)[:, 0]
+        tok = sample_tokens(last, temperature, key, top_k, top_p)
+        return cache, tok, _chosen_logprob(last, tok)
+
+    def extend_mid(params, cache, tokens, index):
+        """Intermediate continuation chunk: cache write + attention
+        only — return_hidden skips the full-vocab unembedding whose
+        sampled token would be discarded anyway."""
+        positions = index[:, None] + jnp.arange(tokens.shape[1])[None]
+        _, cache = model.apply(
+            {"params": params}, tokens, cache=cache, cache_index=index,
+            positions=positions, attend_full_cache=True,
+            return_hidden=True)
+        return cache
+
+    def insert(cache, frag, slot):
+        """Write a prefill fragment (slot-batch 1) into slot `slot`,
+        dropping the fragment's pad-headroom rows past max_len."""
+        return jax.tree.map(
+            lambda c, f: jax.lax.dynamic_update_slice(
+                c,
+                jax.lax.slice_in_dim(f, 0, c.shape[2], axis=2).astype(
+                    c.dtype),
+                (0, slot) + (0,) * (c.ndim - 2)), cache, frag)
+
+    def make_decode(truncate: bool, bucket: int):
+        def decode_chunk(params, cache, last_tok, index, temperature,
+                         top_k, top_p, key):
+            """K decode steps under one dispatch; on-device sampling.
+            last_tok/index/temperature [B]; returns (cache,
+            tokens [B, K], logprobs [B, K]). The non-truncating variant
+            skips the full-vocab sort/cumsum — all-greedy/
+            plain-temperature traffic (the defaults) must not pay
+            O(V log V) per token. Attention runs over the first `bucket`
+            cache rows only (the loop picks the smallest bucket covering
+            every active sequence), then the slice is written back."""
+            sliced = (cache if bucket == max_len else jax.tree.map(
+                lambda c: jax.lax.slice_in_dim(c, 0, bucket, axis=2),
+                cache))
+
+            def step(carry, _):
+                sliced, tok, idx, key = carry
+                key, sub = jax.random.split(key)
+                logits, sliced = model.apply(
+                    {"params": params}, tok[:, None], cache=sliced,
+                    cache_index=jnp.minimum(idx, bucket - 1))
+                if truncate:
+                    nxt = sample_tokens(logits[:, 0], temperature, sub,
+                                        top_k, top_p)
+                else:
+                    nxt = sample_tokens(logits[:, 0], temperature, sub)
+                lp = _chosen_logprob(logits[:, 0], nxt)
+                return (sliced, nxt, idx + 1, key), (nxt, lp)
+
+            (sliced, _, _, _), (toks, lps) = jax.lax.scan(
+                step, (sliced, last_tok, index, key), None,
+                length=chunk)
+            if bucket != max_len:
+                cache = jax.tree.map(
+                    lambda c, s: jax.lax.dynamic_update_slice(
+                        c, s, (0,) * c.ndim), cache, sliced)
+            else:
+                cache = sliced
+            return cache, toks.T, lps.T
+        return decode_chunk
+
+    return {"prefill": prefill, "extend": extend, "extend_mid": extend_mid,
+            "insert": insert, "make_decode": make_decode,
+            "frag_len": frag_len}
+
+
 class GenerationEngine:
     """Slot-based continuous-batching decode loop over one global cache.
 
     `submit()` is thread-safe and blocks until the request completes; the
     worker thread multiplexes all in-flight requests onto the slot batch.
+
+    **Tensor parallelism** (SURVEY.md §2.2 "tensor-parallel serving"):
+    pass `mesh` (a jax.sharding.Mesh with a `tensor` axis) and the engine
+    shards weights and KV caches over it — KV heads over `tensor` (each
+    device holds its head group), mlp/vocab per the logical rules — and
+    every prefill/decode dispatch runs SPMD with XLA-inserted collectives.
+    An 8B bf16 model does not fit one chip; TP-8 is how the flagship
+    serves. The public API is unchanged: submit() still takes one request.
     """
 
     def __init__(self, model, params, cfg, *, slots: int = 4,
                  max_len: int = 256, chunk: int = 16,
                  prefill_buckets: Sequence[int] = (32, 128),
                  decode_buckets: Sequence[int] | None = None,
-                 prefix_cache: int = 0, seed: int = 0):
+                 prefix_cache: int = 0, seed: int = 0,
+                 mesh=None, rules=None):
         self.model, self.cfg = model, cfg
         self.max_len, self.chunk, self.n_slots = int(max_len), int(chunk), int(slots)
         self.prefill_buckets = sorted(
@@ -122,7 +252,16 @@ class GenerationEngine:
         self._prefix_cap = int(prefix_cache)
         from collections import OrderedDict
         self._prefix_lru: "OrderedDict[tuple, Any]" = OrderedDict()
-        self._params = jax.device_put(params)
+        self._mesh = mesh
+        if rules is None:
+            from kubeflow_tpu.parallel.sharding import DEFAULT_RULES
+            rules = DEFAULT_RULES
+        self._rules = tuple(rules)
+        self._cache_sharding = None
+        if mesh is not None:
+            self._params = self._shard_params(params)
+        else:
+            self._params = jax.device_put(params)
         self._key = jax.random.key(seed)
         self._queue: queue.Queue = queue.Queue()
         self._wake = threading.Event()
@@ -132,20 +271,77 @@ class GenerationEngine:
                       "prefix_hits": 0, "prefix_hit_tokens": 0}
         self._compile()
         from kubeflow_tpu.models.llama import init_cache
-        self._cache = jax.jit(
-            lambda: init_cache(cfg, self.n_slots, self.max_len))()
-        self._warmup()
+        with self._scope():
+            self._cache = jax.jit(
+                lambda: init_cache(cfg, self.n_slots, self.max_len),
+                out_shardings=(None if self._cache_sharding is None else
+                               jax.tree.map(lambda _: self._cache_sharding,
+                                            {"k": 0, "v": 0})))()
+            self._warmup()
         self._slots = [None] * self.n_slots  # per-slot host state
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="tpk-generate")
         self._thread.start()
 
+    # -- tensor parallelism --------------------------------------------------
+
+    def _shard_params(self, params):
+        """Lay the weight tree out over the mesh by the models' logical
+        axis annotations (the same rules engine training uses) and pin the
+        KV-cache sharding: heads over `tensor`, everything else
+        replicated. Each device ends up holding its head group / mlp
+        shard; XLA inserts the collectives."""
+        import flax.linen as nn
+
+        from kubeflow_tpu.parallel.sharding import logical_to_spec
+        from jax.sharding import NamedSharding
+
+        cfg, mesh = self.cfg, self._mesh
+        tp = mesh.shape.get("tensor", 1)
+        if cfg.num_kv_heads % tp:
+            raise ValueError(
+                f"tensor parallelism {tp} must divide num_kv_heads "
+                f"{cfg.num_kv_heads} (KV heads shard over the tensor axis)")
+        from kubeflow_tpu.serve.quant import Int8Leaf
+        if any(isinstance(leaf, Int8Leaf) for leaf in jax.tree.leaves(
+                params, is_leaf=lambda x: isinstance(x, Int8Leaf))):
+            raise NotImplementedError(
+                "int8 weight-only quantization does not compose with "
+                "tensor-parallel serving yet — serve int8 single-device "
+                "or bf16 tensor-parallel")
+        with mesh, nn.logical_axis_rules(self._rules):
+            abstract = jax.eval_shape(
+                lambda r: self.model.init(
+                    r, jnp.zeros((1, 8), jnp.int32))["params"],
+                jax.random.key(0))
+        specs = nn.get_partition_spec(abstract)
+        shardings = nn.logical_to_mesh_sharding(specs, mesh, self._rules)
+        # Cache layout [L, B, T, KH, D]: KH rides the `heads` rule.
+        self._cache_sharding = NamedSharding(
+            mesh, logical_to_spec(("layers", None, None, "heads", "kv"),
+                                  self._rules))
+        # Callers hand over boxed (fresh init) or plain (orbax-restored)
+        # trees; shardings are derived unboxed, so normalize first.
+        return jax.device_put(nn.meta.unbox(params), shardings)
+
+    def _scope(self):
+        """Mesh + logical-rules context for tracing/compiling — a no-op
+        single-device. Every jit trace happens under this scope so
+        in-model `nn.with_logical_constraint`s resolve to mesh axes."""
+        import contextlib
+
+        if self._mesh is None:
+            return contextlib.nullcontext()
+        import flax.linen as nn
+
+        stack = contextlib.ExitStack()
+        stack.enter_context(self._mesh)
+        stack.enter_context(nn.logical_axis_rules(self._rules))
+        return stack
+
     # -- compiled device functions ------------------------------------------
 
     def _compile(self):
-        model, cfg = self.model, self.cfg
-        from kubeflow_tpu.models.llama import init_cache
-
         # Fragment caches carry headroom of one max bucket past max_len
         # WHEN offset writes can happen — chunked admission, or a prefix-
         # cache hit resuming mid-prompt (either makes _extend write a
@@ -158,105 +354,19 @@ class GenerationEngine:
         big = self.prefill_buckets[-1]
         self._may_chunk = big < self.max_len - 1
         offset_writes = self._may_chunk or self._prefix_cap > 0
-        frag_len = self.max_len + (big if offset_writes else 0)
-
-        def prefill(params, tokens, length, temperature, top_k, top_p,
-                    key):
-            """tokens [1, S_bucket] right-padded; returns (frag_cache,
-            first sampled token [1])."""
-            cache = init_cache(cfg, 1, frag_len)
-            logits, cache = model.apply(
-                {"params": params}, tokens, cache=cache,
-                cache_index=jnp.zeros((1,), jnp.int32))
-            last = jnp.take_along_axis(
-                logits, (length - 1)[:, None, None], axis=1)[:, 0]  # [1, V]
-            tok = sample_tokens(last, temperature, key, top_k, top_p)
-            return cache, tok, _chosen_logprob(last, tok)
-
-        def extend(params, cache, tokens, length, index, temperature,
-                   top_k, top_p, key):
-            """FINAL continuation chunk of a long prompt: tokens
-            [1, S_bucket] right-padded, written at offset `index` [1],
-            attending over the WHOLE fragment cache; samples the first
-            generated token like prefill."""
-            positions = index[:, None] + jnp.arange(tokens.shape[1])[None]
-            logits, cache = model.apply(
-                {"params": params}, tokens, cache=cache, cache_index=index,
-                positions=positions, attend_full_cache=True)
-            last = jnp.take_along_axis(
-                logits, (length - 1)[:, None, None], axis=1)[:, 0]
-            tok = sample_tokens(last, temperature, key, top_k, top_p)
-            return cache, tok, _chosen_logprob(last, tok)
-
-        def extend_mid(params, cache, tokens, index):
-            """Intermediate continuation chunk: cache write + attention
-            only — return_hidden skips the full-vocab unembedding whose
-            sampled token would be discarded anyway."""
-            positions = index[:, None] + jnp.arange(tokens.shape[1])[None]
-            _, cache = model.apply(
-                {"params": params}, tokens, cache=cache, cache_index=index,
-                positions=positions, attend_full_cache=True,
-                return_hidden=True)
-            return cache
-
-        def insert(cache, frag, slot):
-            """Write a prefill fragment (slot-batch 1) into slot `slot`,
-            dropping the fragment's pad-headroom rows past max_len."""
-            return jax.tree.map(
-                lambda c, f: jax.lax.dynamic_update_slice(
-                    c,
-                    jax.lax.slice_in_dim(f, 0, c.shape[2], axis=2).astype(
-                        c.dtype),
-                    (0, slot) + (0,) * (c.ndim - 2)), cache, frag)
-
-        def make_decode(truncate: bool, bucket: int):
-            def decode_chunk(params, cache, last_tok, index, temperature,
-                             top_k, top_p, key):
-                """K decode steps under one dispatch; on-device sampling.
-                last_tok/index/temperature [B]; returns (cache,
-                tokens [B, K]). The non-truncating variant skips the
-                full-vocab sort/cumsum — all-greedy/plain-temperature
-                traffic (the defaults) must not pay O(V log V) per token.
-                Attention runs over the first `bucket` cache rows only
-                (the loop picks the smallest bucket covering every active
-                sequence), then the slice is written back."""
-                sliced = (cache if bucket == self.max_len else jax.tree.map(
-                    lambda c: jax.lax.slice_in_dim(c, 0, bucket, axis=2),
-                    cache))
-
-                def step(carry, _):
-                    sliced, tok, idx, key = carry
-                    key, sub = jax.random.split(key)
-                    logits, sliced = model.apply(
-                        {"params": params}, tok[:, None], cache=sliced,
-                        cache_index=jnp.minimum(idx, bucket - 1))
-                    if truncate:
-                        nxt = sample_tokens(logits[:, 0], temperature, sub,
-                                            top_k, top_p)
-                    else:
-                        nxt = sample_tokens(logits[:, 0], temperature, sub)
-                    lp = _chosen_logprob(logits[:, 0], nxt)
-                    return (sliced, nxt, idx + 1, key), (nxt, lp)
-
-                (sliced, _, _, _), (toks, lps) = jax.lax.scan(
-                    step, (sliced, last_tok, index, key), None,
-                    length=self.chunk)
-                if bucket != self.max_len:
-                    cache = jax.tree.map(
-                        lambda c, s: jax.lax.dynamic_update_slice(
-                            c, s, (0,) * c.ndim), cache, sliced)
-                else:
-                    cache = sliced
-                return cache, toks.T, lps.T
-            return decode_chunk
-
-        prefill_jit = jax.jit(prefill)
+        fns = build_engine_fns(
+            self.model, self.cfg, max_len=self.max_len, chunk=self.chunk,
+            prefill_buckets=self.prefill_buckets,
+            offset_writes=offset_writes,
+            cache_sharding=self._cache_sharding)
+        prefill_jit = jax.jit(fns["prefill"])
         self._prefill = {b: prefill_jit for b in self.prefill_buckets}
-        self._extend = jax.jit(extend, donate_argnums=(1,))
-        self._extend_mid = jax.jit(extend_mid, donate_argnums=(1,))
-        self._insert = jax.jit(insert, donate_argnums=(0,))
+        self._extend = jax.jit(fns["extend"], donate_argnums=(1,))
+        self._extend_mid = jax.jit(fns["extend_mid"], donate_argnums=(1,))
+        self._insert = jax.jit(fns["insert"], donate_argnums=(0,))
         self._decode = {
-            (b, trunc): jax.jit(make_decode(trunc, b), donate_argnums=(1,))
+            (b, trunc): jax.jit(fns["make_decode"](trunc, b),
+                                donate_argnums=(1,))
             for b in self.decode_buckets for trunc in (False, True)}
 
     def _warmup(self):
@@ -384,6 +494,10 @@ class GenerationEngine:
             self._prefix_lru.popitem(last=False)
 
     def _admit(self, slot: int, req: dict) -> None:
+        with self._scope():
+            self._admit_inner(slot, req)
+
+    def _admit_inner(self, slot: int, req: dict) -> None:
         ids = req["input_ids"]
         sample_args = (
             jnp.asarray([req["temperature"]], jnp.float32),
@@ -510,10 +624,11 @@ class GenerationEngine:
             bucket = next((b for b in self.decode_buckets if b >= need),
                           self.max_len)
             decode = self._decode[(bucket, trunc)]
-            self._cache, toks, lps = decode(
-                self._params, self._cache, jnp.asarray(last),
-                jnp.asarray(idx), jnp.asarray(temps), jnp.asarray(ks),
-                jnp.asarray(ps), sub)
+            with self._scope():
+                self._cache, toks, lps = decode(
+                    self._params, self._cache, jnp.asarray(last),
+                    jnp.asarray(idx), jnp.asarray(temps), jnp.asarray(ks),
+                    jnp.asarray(ps), sub)
             toks = np.asarray(toks)  # sync point: [B, chunk]
             lps = np.asarray(lps)
             dt = time.monotonic() - t0
@@ -546,11 +661,39 @@ class GenerativeJAXModel(Model):
         self.engine: GenerationEngine | None = None
         self.eos_id = self._gen_cfg.pop("eos_id", None)
         self.tokenizer = self._gen_cfg.pop("tokenizer", None)
+        # {"tensor": N, ...} from the bundle / ISVC spec — resolved to a
+        # device mesh at load() time, when the devices exist.
+        self._mesh_spec = dict(self._gen_cfg.pop("mesh", None) or {})
+
+    def _build_mesh(self):
+        import math
+
+        from kubeflow_tpu.parallel.mesh import (MESH_AXES, MeshConfig,
+                                                build_mesh)
+
+        unknown = set(self._mesh_spec) - set(MESH_AXES)
+        if unknown:
+            raise ValueError(
+                f"mesh spec has unknown axes {sorted(unknown)}; "
+                f"valid: {list(MESH_AXES)}")
+        sizes = {k: int(v) for k, v in self._mesh_spec.items()}
+        if any(v < 1 for v in sizes.values()):
+            raise ValueError(f"mesh axis sizes must be >= 1: {sizes}")
+        need = math.prod(sizes.values())
+        devs = jax.devices()
+        if len(devs) < need:
+            raise ValueError(
+                f"mesh {sizes} needs {need} devices, have {len(devs)}")
+        sizes.setdefault("data", 1)
+        return build_mesh(MeshConfig(**sizes), devs[:need])
 
     def load(self) -> bool:
         t0 = time.monotonic()
+        kwargs = dict(self._gen_cfg)
+        if self._mesh_spec:
+            kwargs["mesh"] = self._build_mesh()
         self.engine = GenerationEngine(
-            self._model, self._params, self.cfg, **self._gen_cfg)
+            self._model, self._params, self.cfg, **kwargs)
         self.load_time_s = time.monotonic() - t0
         self.ready = True
         return True
@@ -695,6 +838,7 @@ class GenerativeJAXModel(Model):
             "max_len": self._gen_cfg.get("max_len", 256),
             "vocab_size": getattr(self.cfg, "vocab_size", None),
             "stats": dict(self.engine.stats) if self.engine else {},
+            "mesh": self._mesh_spec or None,
         })
         if self.engine:
             md["decode_buckets"] = list(self.engine.decode_buckets)

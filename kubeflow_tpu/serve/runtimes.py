@@ -37,11 +37,24 @@ def list_runtimes() -> list[str]:
     return sorted(_RUNTIMES)
 
 
-def load_model(model_dir: str, name: str | None = None) -> Model:
-    """Resolve model.json's format to a runtime and build the Model."""
+def load_model(model_dir: str, name: str | None = None,
+               mesh: dict | None = None) -> Model:
+    """Resolve model.json's format to a runtime and build the Model.
+
+    `mesh` ({"tensor": N, ...}) overrides the bundle's device-mesh spec —
+    the ISVC `model.mesh` field lands here via the server's --mesh flag,
+    turning a single-device generative bundle into tensor-parallel
+    serving without touching the bundle."""
     spec_path = os.path.join(model_dir, "model.json")
     with open(spec_path) as f:
         spec = json.load(f)
+    if mesh:
+        gen = spec.get("generative")
+        if not gen:
+            raise ValueError(
+                "a mesh override requires a generative bundle (fixed-"
+                "forward models replicate per replica instead)")
+        spec = {**spec, "generative": {**gen, "mesh": dict(mesh)}}
     fmt = spec.get("format", "jax-registry")
     try:
         builder = _RUNTIMES[fmt]

@@ -50,6 +50,7 @@ class ModelRepository:
         self._models: dict[str, Model] = {}
         self._batchers: dict[str, Batcher] = {}
         self._dirs: dict[str, str] = {}
+        self._meshes: dict[str, dict] = {}
         self._load_errors: dict[str, str] = {}
         # Async-load intents: name -> wanted model_dir ("" = unload was
         # requested mid-load; the worker discards its result).
@@ -59,7 +60,8 @@ class ModelRepository:
 
     def register(self, model: Model, *, load: bool = True,
                  max_batch_size: int = 32, max_latency_ms: float = 5.0,
-                 model_dir: str | None = None) -> Model:
+                 model_dir: str | None = None,
+                 mesh: dict | None = None) -> Model:
         if load and not model.ready:
             model.load()
         with self._lock:
@@ -67,6 +69,12 @@ class ModelRepository:
             self._models[model.name] = model
             if model_dir:
                 self._dirs[model.name] = model_dir
+            if mesh:
+                # Remembered per name so every RELOAD path (load(),
+                # load_async() on a model_dir update) re-applies the
+                # tensor-parallel layout — a TP model silently reloaded
+                # single-device would OOM on real hardware.
+                self._meshes[model.name] = dict(mesh)
             old = self._batchers.pop(model.name, None)
             self._batchers[model.name] = Batcher(
                 model.predict, max_batch_size=max_batch_size,
@@ -124,9 +132,10 @@ class ModelRepository:
         way, else by flipping the in-process model's lifecycle."""
         with self._lock:
             model_dir = self._dirs.get(name)
+            mesh = self._meshes.get(name)
         if model_dir:
             from kubeflow_tpu.serve import runtimes
-            model = runtimes.load_model(model_dir, name=name)
+            model = runtimes.load_model(model_dir, name=name, mesh=mesh)
             return self.register(model, model_dir=model_dir)
         model = self.get(name)
         model.load()
@@ -155,8 +164,11 @@ class ModelRepository:
                     if not target:  # unloaded / intent cleared mid-load
                         self._inflight.discard(name)
                         return
+                with self._lock:
+                    mesh = self._meshes.get(name)
                 try:
-                    model = runtimes.load_model(target, name=name)
+                    model = runtimes.load_model(target, name=name,
+                                                mesh=mesh)
                 except Exception as e:
                     # Exit decisions happen under the SAME lock that
                     # releases _inflight — a concurrent load_async either
@@ -669,7 +681,21 @@ def main(argv: list[str] | None = None) -> int:
                    choices=["metadata", "all"])
     p.add_argument("--grpc-port", type=int, default=None,
                    help="also serve the v2 open-inference gRPC protocol")
+    p.add_argument("--mesh", default=None,
+                   help="device mesh for tensor-parallel generative "
+                        "serving, e.g. 'tensor=8' or 'tensor=4,data=2' "
+                        "(the ISVC model.mesh field)")
     args = p.parse_args(argv)
+
+    mesh_spec = None
+    if args.mesh:
+        mesh_spec = {}
+        for part in args.mesh.split(","):
+            axis, _, n = part.partition("=")
+            try:
+                mesh_spec[axis.strip()] = int(n)
+            except ValueError:
+                p.error(f"--mesh parts must be axis=N, got {part!r}")
 
     if args.cpu_devices:
         import jax
@@ -688,8 +714,8 @@ def main(argv: list[str] | None = None) -> int:
     server = ModelServer(request_logger=logger)
     for i, d in enumerate(dirs):
         name = args.name[i] if i < len(args.name) else None
-        model = runtimes.load_model(d, name=name)
-        server.repo.register(model, model_dir=d,
+        model = runtimes.load_model(d, name=name, mesh=mesh_spec)
+        server.repo.register(model, model_dir=d, mesh=mesh_spec,
                              max_batch_size=args.max_batch_size,
                              max_latency_ms=args.max_latency_ms)
         print(json.dumps({"event": "model_loaded", "name": model.name,

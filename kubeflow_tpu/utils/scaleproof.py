@@ -163,9 +163,14 @@ def _case_train_8b_v5p32_2slice() -> dict:
 
 
 def _case_serve_8b_tp8() -> dict:
-    """Serving envelope: bf16 8B weights tensor-sharded 8-way; compile the
-    prefill bucket and the batched decode step against an 8k cache and
-    assert the whole working set fits one v5p chip's HBM share."""
+    """Serving envelope: bf16 8B weights tensor-sharded 8-way. Compiles
+    the GENERATION ENGINE'S OWN functions (serve/generation.py
+    build_engine_fns — the exact prefill/chunked-decode programs the
+    product dispatches, not hand-written stand-ins) with the same
+    shardings `GenerationEngine(mesh=...)` installs, and asserts the
+    working set fits one v5p chip's HBM share. This is the proof that TP
+    serving of the flagship — which an 8B bf16 model *requires*, not
+    fitting one chip — compiles and fits as the product would run it."""
     import dataclasses
 
     import jax
@@ -174,7 +179,8 @@ def _case_serve_8b_tp8() -> dict:
 
     from kubeflow_tpu.models.llama import Llama, init_cache, llama3_8b
     from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
-    from kubeflow_tpu.parallel.sharding import DEFAULT_RULES
+    from kubeflow_tpu.parallel.sharding import DEFAULT_RULES, logical_to_spec
+    from kubeflow_tpu.serve.generation import build_engine_fns
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     # remat off: inference has no backward, and the remat wrapper's static
@@ -185,7 +191,7 @@ def _case_serve_8b_tp8() -> dict:
     mesh = build_mesh(MeshConfig(data=1, tensor=8))
     rules = DEFAULT_RULES
 
-    slots, max_len, prefill_bucket = 8, 8192, 2048
+    slots, max_len, prefill_bucket, chunk = 8, 8192, 2048, 16
 
     with mesh, nn.logical_axis_rules(rules):
         abstract = jax.eval_shape(
@@ -197,8 +203,11 @@ def _case_serve_8b_tp8() -> dict:
             lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
             nn.meta.unbox(abstract), shardings)
 
-        # KV heads shard over tensor (8 kv heads / 8 devices).
-        cache_sh = NamedSharding(mesh, P(None, None, None, "tensor", None))
+        # KV heads shard over tensor — the same spec GenerationEngine
+        # derives (generation.py _shard_params).
+        cache_sh = NamedSharding(
+            mesh, logical_to_spec(("layers", None, None, "heads", "kv"),
+                                  rules))
         cache_shape = jax.eval_shape(
             lambda: init_cache(cfg, slots, max_len))
         cache_args = jax.tree.map(
@@ -206,27 +215,27 @@ def _case_serve_8b_tp8() -> dict:
                                            sharding=cache_sh), cache_shape)
         repl = NamedSharding(mesh, P())
 
-        def prefill(params, tokens, cache):
-            logits, cache = model.apply(
-                {"params": params}, tokens, cache=cache,
-                cache_index=jnp.zeros((slots,), jnp.int32))
-            return logits[:, -1], cache
+        fns = build_engine_fns(
+            model, cfg, max_len=max_len, chunk=chunk,
+            prefill_buckets=(prefill_bucket,),
+            offset_writes=True, cache_sharding=cache_sh)
 
-        def decode(params, tok, cache, index):
-            logits, cache = model.apply(
-                {"params": params}, tok, cache=cache, cache_index=index)
-            return jnp.argmax(logits[:, 0], -1), cache
+        def sds(shape, dtype):
+            return jax.ShapeDtypeStruct(shape, dtype, sharding=repl)
 
-        pre_lowered = jax.jit(prefill, donate_argnums=(2,)).lower(
-            params_args,
-            jax.ShapeDtypeStruct((slots, prefill_bucket), jnp.int32,
-                                 sharding=repl),
-            cache_args)
-        dec_lowered = jax.jit(decode, donate_argnums=(2,)).lower(
-            params_args,
-            jax.ShapeDtypeStruct((slots, 1), jnp.int32, sharding=repl),
-            cache_args,
-            jax.ShapeDtypeStruct((slots,), jnp.int32, sharding=repl))
+        key_arg = jax.eval_shape(lambda: jax.random.key(0))
+        # Engine prefill: slot-batch-1 fragment, on-device sampling.
+        pre_lowered = jax.jit(fns["prefill"]).lower(
+            params_args, sds((1, prefill_bucket), jnp.int32),
+            sds((1,), jnp.int32), sds((1,), jnp.float32),
+            sds((1,), jnp.int32), sds((1,), jnp.float32), key_arg)
+        # Engine chunked decode: `chunk` steps over the full slot batch
+        # under one dispatch (the steady-state hot program).
+        dec_lowered = jax.jit(fns["make_decode"](False, max_len),
+                              donate_argnums=(1,)).lower(
+            params_args, cache_args, sds((slots,), jnp.int32),
+            sds((slots,), jnp.int32), sds((slots,), jnp.float32),
+            sds((slots,), jnp.int32), sds((slots,), jnp.float32), key_arg)
     pre = _mem_report(pre_lowered.compile())
     dec = _mem_report(dec_lowered.compile())
     return {
@@ -237,6 +246,8 @@ def _case_serve_8b_tp8() -> dict:
         "slots": slots,
         "max_len": max_len,
         "prefill_bucket": prefill_bucket,
+        "decode_chunk": chunk,
+        "engine_fns": "serve/generation.py build_engine_fns",
         "prefill": pre,
         "decode": dec,
         "fits_v5p_hbm": pre["fits_v5p_hbm"] and dec["fits_v5p_hbm"],

@@ -1,0 +1,174 @@
+"""Tensor-parallel generative serving (SURVEY.md §2.2 "huggingfaceserver:
+tensor-parallel serving"): the engine shards weights + KV caches over a
+mesh's `tensor` axis and decodes SPMD. The contract test: TP decode is
+token-identical to single-device decode on the same weights and seed."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models.llama import Llama, LlamaConfig, llama_tiny
+from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+from kubeflow_tpu.serve.generation import GenerationEngine
+
+# fp32 everywhere so cross-device reduction order cannot flip an argmax;
+# 8 KV heads so the cache shards cleanly over tensor=8.
+CFG = LlamaConfig(
+    vocab_size=256, hidden_size=64, intermediate_size=128, num_layers=2,
+    num_heads=8, num_kv_heads=8, head_dim=8, max_seq_len=128, remat=False,
+    dtype=jnp.float32, param_dtype=jnp.float32, attention_impl="naive",
+    flash_block_q=64, flash_block_kv=64)
+
+ENGINE_KW = dict(slots=2, max_len=64, chunk=4, prefill_buckets=(8, 16))
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = Llama(CFG)
+    params = jax.jit(
+        lambda r: model.init(r, jnp.zeros((1, 8), jnp.int32))["params"])(
+            jax.random.key(7))
+    return model, params
+
+
+def _generate_all(engine, prompts, **kw):
+    return [engine.submit(p, **kw) for p in prompts]
+
+
+def test_tp_decode_token_identical(model_and_params, devices8):
+    model, params = model_and_params
+    rng = np.random.default_rng(0)
+    prompts = [
+        list(rng.integers(1, CFG.vocab_size, 5)),
+        list(rng.integers(1, CFG.vocab_size, 12)),
+        # Past the largest prefill bucket: exercises chunked admission
+        # (extend_mid + extend) under TP too.
+        list(rng.integers(1, CFG.vocab_size, 23)),
+    ]
+
+    ref = GenerationEngine(model, params, CFG, **ENGINE_KW, seed=0)
+    try:
+        want = _generate_all(ref, prompts, max_tokens=8)
+    finally:
+        ref.close()
+
+    mesh = build_mesh(MeshConfig(data=1, tensor=8), devices8)
+    tp = GenerationEngine(model, params, CFG, **ENGINE_KW, seed=0,
+                          mesh=mesh)
+    try:
+        got = _generate_all(tp, prompts, max_tokens=8)
+    finally:
+        tp.close()
+
+    for w, g in zip(want, got):
+        assert g["output_ids"] == w["output_ids"]
+        np.testing.assert_allclose(g["output_logprobs"],
+                                   w["output_logprobs"], atol=1e-4)
+
+
+def test_tp_sampling_runs(model_and_params, devices8):
+    """Temperature/top-k/top-p sampling under TP: valid tokens, correct
+    counts (cross-device numerics may legitimately flip a sample, so this
+    asserts mechanics, not identity)."""
+    model, params = model_and_params
+    mesh = build_mesh(MeshConfig(data=1, tensor=4), devices8[:4])
+    eng = GenerationEngine(model, params, CFG, **ENGINE_KW, seed=3,
+                           mesh=mesh)
+    try:
+        out = eng.submit([5, 9, 2], max_tokens=6, temperature=0.8,
+                         top_k=40, top_p=0.9)
+        assert len(out["output_ids"]) == 6
+        assert all(0 <= t < CFG.vocab_size for t in out["output_ids"])
+    finally:
+        eng.close()
+
+
+def test_tp_requires_divisible_kv_heads(devices8):
+    cfg = llama_tiny()  # 2 kv heads
+    model = Llama(cfg)
+    params = jax.jit(
+        lambda r: model.init(r, jnp.zeros((1, 8), jnp.int32))["params"])(
+            jax.random.key(0))
+    mesh = build_mesh(MeshConfig(data=1, tensor=8), devices8)
+    with pytest.raises(ValueError, match="num_kv_heads"):
+        GenerationEngine(model, params, cfg, **ENGINE_KW, mesh=mesh)
+
+
+def test_tp_refuses_int8(model_and_params, devices8):
+    from kubeflow_tpu.serve.quant import quantize_tree
+
+    model, params = model_and_params
+    mesh = build_mesh(MeshConfig(data=1, tensor=2), devices8[:2])
+    with pytest.raises(NotImplementedError, match="int8"):
+        GenerationEngine(model, quantize_tree(params), CFG, **ENGINE_KW,
+                         mesh=mesh)
+
+
+def test_load_model_mesh_override(tmp_path, devices8):
+    """ISVC model.mesh → server --mesh → load_model(mesh=...): the bundle
+    stays single-device; the override makes it tensor-parallel at load."""
+    from kubeflow_tpu.serve.runtimes import export_for_serving, load_model
+
+    d = export_for_serving(
+        str(tmp_path / "g"), model="llama_tiny",
+        model_kwargs={"num_layers": 2},
+        extra={"generative": {"slots": 2, "max_len": 48, "chunk": 4,
+                              "prefill_buckets": [8]}})
+    m = load_model(d, name="g", mesh={"tensor": 2})
+    m.load()
+    try:
+        out = m.generate({"input_ids": [3, 1, 4], "max_tokens": 4})
+        assert len(out["output_ids"]) == 4
+        assert m.metadata()["mesh"] == {"tensor": 2}
+    finally:
+        m.unload()
+
+    # Non-generative bundles can't take a mesh override.
+    d2 = export_for_serving(
+        str(tmp_path / "f"), model="mnist_mlp",
+        model_kwargs={"in_dim": 8, "hidden": [4], "num_classes": 2},
+        batch_buckets=(1,))
+    with pytest.raises(ValueError, match="generative"):
+        load_model(d2, mesh={"tensor": 2})
+
+
+def test_mesh_spec_validation(model_and_params):
+    from kubeflow_tpu.serve.generation import GenerativeJAXModel
+
+    model, params = model_and_params
+    m = GenerativeJAXModel("m", model, params, CFG,
+                           generation={"mesh": {"bogus": 2}})
+    with pytest.raises(ValueError, match="unknown axes"):
+        m.load()
+    m2 = GenerativeJAXModel("m", model, params, CFG,
+                            generation={"mesh": {"tensor": 4096}})
+    with pytest.raises(ValueError, match="devices"):
+        m2.load()
+
+
+def test_repository_reload_keeps_mesh(tmp_path, devices8):
+    """A repository reload (the controller's model_dir-update path) must
+    re-apply the remembered mesh — a TP model silently reloaded
+    single-device would OOM on real hardware."""
+    from kubeflow_tpu.serve.runtimes import export_for_serving, load_model
+    from kubeflow_tpu.serve.server import ModelRepository
+
+    d = export_for_serving(
+        str(tmp_path / "g"), model="llama_tiny",
+        model_kwargs={"num_layers": 2},
+        extra={"generative": {"slots": 2, "max_len": 48, "chunk": 4,
+                              "prefill_buckets": [8]}})
+    repo = ModelRepository()
+    mesh = {"tensor": 2}
+    repo.register(load_model(d, name="g", mesh=mesh), model_dir=d,
+                  mesh=mesh)
+    try:
+        reloaded = repo.load("g")  # fresh build from the recorded dir
+        assert reloaded.metadata()["mesh"] == mesh
+        out = reloaded.generate({"input_ids": [7, 3], "max_tokens": 3})
+        assert len(out["output_ids"]) == 3
+    finally:
+        repo.close()

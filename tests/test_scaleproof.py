@@ -27,9 +27,13 @@ def test_train_8b_fits_v5p(devices8, case):
 def test_serve_8b_tp8_fits(devices8):
     r = scaleproof.run_case("serve_8b_tp8")
     assert r["fits_v5p_hbm"], r
-    # bf16 weights over tensor=8: ~2 GiB/device — prefill args must carry
-    # the weight shard plus the KV cache shard.
-    assert r["prefill"]["argument_bytes"] > 2 * 1024**3
+    assert r["engine_fns"]  # compiled from serve/generation.build_engine_fns
+    # bf16 weights over tensor=8: ~1.9 GiB/device. Engine prefill takes
+    # just the weight shard (its fragment cache is created inside — temp);
+    # chunked decode also carries the full slot-batch KV cache shard
+    # (~1 GiB/device at slots=8, 8k, 8 KV heads over 8 devices).
+    assert r["prefill"]["argument_bytes"] > 1.8 * 1024**3
+    assert r["decode"]["argument_bytes"] > 2.8 * 1024**3
 
 
 def test_v5p32_case_via_subprocess():

@@ -395,3 +395,47 @@ def test_trained_model_multi_model_serving(controlplane):
     out = _post(f"{url}/v1/models/base:predict", {"instances": xb.tolist()})
     assert np.asarray(out["predictions"]).shape == (2, 4)
     client.delete("InferenceService", "host")
+
+
+def test_tensor_parallel_generative_isvc(controlplane):
+    """TP serving end to end through the control plane (SURVEY.md §2.2
+    'tensor-parallel serving'): model.mesh {"tensor": 2} on the ISVC spec
+    flows admission → controller --mesh flag → server → GenerationEngine,
+    and the live endpoint decodes on a 2-device mesh."""
+    from kubeflow_tpu.serve.runtimes import export_for_serving
+
+    client, workdir, tmp = controlplane
+    bundle = export_for_serving(
+        str(tmp / "gen"), model="llama_tiny",
+        model_kwargs={"num_layers": 2},
+        extra={"generative": {"slots": 2, "max_len": 48, "chunk": 4,
+                              "prefill_buckets": [8]}})
+
+    # Admission: unknown axis and over-budget meshes are rejected at
+    # submit, not discovered as a worker crash.
+    with pytest.raises(Exception, match="unknown axis"):
+        client.create("InferenceService", "bad1", {
+            "model": {"model_dir": bundle, "mesh": {"bogus": 2}},
+            "devices_per_replica": 2, "cpu_devices": 2})
+    with pytest.raises(Exception, match="devices_per_replica"):
+        client.create("InferenceService", "bad2", {
+            "model": {"model_dir": bundle, "mesh": {"tensor": 4}},
+            "devices_per_replica": 2, "cpu_devices": 2})
+
+    client.create("InferenceService", "gtp", {
+        "model": {"name": "g", "model_dir": bundle,
+                  "mesh": {"tensor": 2}},
+        "replicas": 1,
+        "devices_per_replica": 2,
+        "cpu_devices": 2,
+    })
+    _wait_phase(client, "gtp", "Ready", timeout=180)
+    url = client.get("InferenceService", "gtp")["status"]["endpoints"][0][
+        "url"]
+    out = _post(f"{url}/v1/models/g:generate",
+                {"input_ids": [5, 9, 2], "max_tokens": 6})
+    assert len(out["output_ids"]) == 6
+    md = json.loads(urllib.request.urlopen(
+        f"{url}/v2/models/g", timeout=30).read())
+    assert md["mesh"] == {"tensor": 2}
+    client.delete("InferenceService", "gtp")
