@@ -23,9 +23,14 @@ REAL flagship model:
     The math matches DecoderLayer exactly (RMSNorm fp32, RoPE fp32, GQA
     attention, SwiGLU in cfg.dtype).
 
-Scope (documented): dense Llama trunk, contiguous sequences (no
-packed-segment masks through PP v1), attention naive or flash. MoE-PP and
-CP-inside-PP are future axes composition work (ops/ROADMAP.md).
+Packed pre-training composes with PP: pass `positions` + `segment_ids`
+and they ride the pipeline ring alongside the activations (a pytree
+microbatch — parallel/pipeline.py), so each stage masks attention within
+documents exactly like the scanned model. Block-sparse MaskSpecs
+(cfg.mask_kind) flow into the stage attention the same way.
+
+Scope (documented): dense Llama trunk, attention naive or flash. MoE-PP
+and CP-inside-PP are future axes composition work (ops/ROADMAP.md).
 """
 
 from __future__ import annotations
@@ -55,16 +60,20 @@ def _resolve_attn(cfg: LlamaConfig) -> str:
     if impl not in ("naive", "flash"):
         raise ValueError(
             f"pipeline parallelism supports attention_impl 'naive'/'flash' "
-            f"(contiguous causal sequences), not {impl!r}")
+            f"(contiguous or packed causal sequences), not {impl!r}")
     return impl
 
 
 def layer_fwd(cfg: LlamaConfig, lp: dict, x: jax.Array, cos: jax.Array,
               sin: jax.Array, positions: jax.Array,
-              attn_impl: str = "naive") -> jax.Array:
+              attn_impl: str = "naive",
+              segment_ids: jax.Array | None = None) -> jax.Array:
     """One decoder layer, pure jnp. lp: the layer's param subtree (kernels
     exactly as flax lays them out: q/k/v [H, heads, D], o [heads, D, H],
-    gate/up [H, M], down [M, H]); x [mb, S, H] in cfg.dtype."""
+    gate/up [H, M], down [M, H]); x [mb, S, H] in cfg.dtype.
+    `segment_ids` [mb, S] confines attention within packed documents;
+    cfg.mask_spec selects the block-sparse mask family — both match the
+    scanned Attention module's semantics (models/llama.py)."""
     dt = cfg.dtype
     h = _rms(x, lp["input_norm"]["scale"], cfg.rms_eps, dt)
     q = jnp.einsum("bsh,hnd->bsnd", h, lp["attn"]["q_proj"]["kernel"].astype(dt))
@@ -72,13 +81,17 @@ def layer_fwd(cfg: LlamaConfig, lp: dict, x: jax.Array, cos: jax.Array,
     v = jnp.einsum("bsh,hnd->bsnd", h, lp["attn"]["v_proj"]["kernel"].astype(dt))
     q = apply_rope(q, cos, sin, positions)
     k = apply_rope(k, cos, sin, positions)
+    mask = cfg.mask_spec
     if attn_impl == "flash":
         from kubeflow_tpu.ops.flash_attention import flash_attention
         attn = flash_attention(q, k, v, causal=True,
                                block_q=cfg.flash_block_q,
-                               block_kv=cfg.flash_block_kv)
+                               block_kv=cfg.flash_block_kv,
+                               segment_ids=segment_ids, mask=mask)
     else:
-        attn = naive_attention(q, k, v, causal=True)
+        attn = naive_attention(q, k, v, causal=True, positions_q=positions,
+                               positions_kv=positions,
+                               segment_ids=segment_ids, mask=mask)
     attn = jnp.einsum("bsnd,ndh->bsh", attn,
                       lp["attn"]["o_proj"]["kernel"].astype(dt))
     x = x + attn
@@ -98,18 +111,34 @@ def pipeline_forward(
     num_chunks: int = 1,
     data_axis: str | tuple[str, ...] | None = ("data", "fsdp"),
     return_hidden: bool = False,
+    positions: jax.Array | None = None,
+    segment_ids: jax.Array | None = None,
 ) -> jax.Array:
     """Full causal-LM forward with the trunk pipelined over `pipe`.
 
     params: the SAME pytree the scanned Llama produces (trunk under
     params['layers'] with leading dim L). tokens [B, S]. Returns logits
     [B, S, V] (or post-norm hidden [B, S, H] with return_hidden for the
-    chunked-CE path). Numerics match the non-pipelined model."""
+    chunked-CE path). Numerics match the non-pipelined model.
+
+    Packed pre-training: pass per-document restarting `positions` and
+    `segment_ids` [B, S] (data/loader.py packing) — they microbatch and
+    travel the pipeline ring with the activations, so every stage applies
+    the same RoPE offsets and within-document attention mask the scanned
+    model would."""
     if cfg.num_layers % (mesh.shape["pipe"] * num_chunks):
         raise ValueError(
             f"num_layers {cfg.num_layers} not divisible by pipe "
             f"({mesh.shape['pipe']}) * chunks ({num_chunks})")
     attn_impl = _resolve_attn(cfg)
+    if (attn_impl == "flash" and positions is not None
+            and segment_ids is None):
+        # Mirror the scanned Attention's refusal: the flash kernel masks
+        # causality by array index, so custom positions need the segment
+        # mask to carry document structure.
+        raise ValueError(
+            "pipeline flash attention with custom positions needs "
+            "segment_ids (packed sequences)")
     dt = cfg.dtype
     b, s = tokens.shape
     embed = params["embed"]
@@ -122,16 +151,27 @@ def pipeline_forward(
         lambda a: a.reshape(n_stages, per_stage, *a.shape[1:]),
         params["layers"])
 
-    def stage_fn(sp, h):
-        # sp leaves [per_stage, ...]; h [mb, S, H]. Positions are the
-        # plain arange — PP v1 trains contiguous sequences.
-        pos = jnp.broadcast_to(jnp.arange(s), (h.shape[0], s))
+    # The traveling microbatch: activations plus any packed metadata the
+    # stages need (pipeline_apply treats the pytree opaquely).
+    travel = {"h": x}
+    if positions is not None:
+        travel["pos"] = jnp.broadcast_to(positions, (b, s))
+    if segment_ids is not None:
+        travel["seg"] = jnp.broadcast_to(segment_ids, (b, s))
+
+    def stage_fn(sp, tr):
+        h = tr["h"]
+        pos = tr.get("pos")
+        if pos is None:
+            pos = jnp.broadcast_to(jnp.arange(s), (h.shape[0], s))
+        seg = tr.get("seg")
 
         def body(carry, lp):
-            return layer_fwd(cfg, lp, carry, cos, sin, pos, attn_impl), None
+            return layer_fwd(cfg, lp, carry, cos, sin, pos, attn_impl,
+                             segment_ids=seg), None
 
         h, _ = jax.lax.scan(body, h, sp)
-        return h
+        return {**tr, "h": h}
 
     axes = ((data_axis,) if isinstance(data_axis, str)
             else tuple(data_axis or ()))
@@ -139,14 +179,15 @@ def pipeline_forward(
     if dax is not None and len(dax) == 1:
         dax = dax[0]
     if num_chunks > 1:
-        x = pipeline_apply_circular(
-            stage_fn, stages, x, mesh=mesh,
+        out = pipeline_apply_circular(
+            stage_fn, stages, travel, mesh=mesh,
             num_microbatches=num_microbatches, num_chunks=num_chunks,
             data_axis=dax)
     else:
-        x = pipeline_apply(
-            stage_fn, stages, x, mesh=mesh,
+        out = pipeline_apply(
+            stage_fn, stages, travel, mesh=mesh,
             num_microbatches=num_microbatches, data_axis=dax)
+    x = out["h"]
 
     x = _rms(x, params["final_norm"]["scale"], cfg.rms_eps, dt)
     if return_hidden:

@@ -88,7 +88,7 @@ def pipeline_apply(
     sequential loop — the schedule only changes *when* each stage runs.
     """
     num_stages = mesh.shape[axis]
-    batch = x.shape[0]
+    batch = jax.tree.leaves(x)[0].shape[0]
     if batch % num_microbatches:
         raise ValueError(
             f"batch {batch} not divisible by microbatches {num_microbatches}")
@@ -98,12 +98,19 @@ def pipeline_apply(
             f"({num_stages}) to fill the pipeline")
     mb = batch // num_microbatches
     _check_data_axis(mesh, data_axis, mb)
-    xm = x.reshape(num_microbatches, mb, *x.shape[1:])
+    # x may be a pytree: the activation plus whatever per-microbatch
+    # metadata must travel the ring with it (packed-sequence positions /
+    # segment ids — [mb, S] int32, negligible next to [mb, S, H] acts).
+    # The schedule is structure-agnostic: every leaf microbatches,
+    # rotates, and emits identically.
+    xm = jax.tree.map(
+        lambda a: a.reshape(num_microbatches, mb, *a.shape[1:]), x)
 
     pspec = jax.tree.map(lambda _: P(axis), stage_params)
     # Inputs/outputs: replicated over the pipe axis; microbatch rows
     # sharded over the data axis when given.
-    other = P(None, data_axis) if data_axis is not None else P()
+    o = P(None, data_axis) if data_axis is not None else P()
+    other = jax.tree.map(lambda _: o, x)
 
     @partial(shard_map, mesh=mesh, in_specs=(pspec, other),
              out_specs=other, check_vma=False)
@@ -112,18 +119,22 @@ def pipeline_apply(
         # Each shard holds its stage's slice with a leading dim of 1.
         params = jax.tree.map(lambda p: p[0], params)
         ticks = num_microbatches + num_stages - 1
-        buf = jnp.zeros_like(xm[0])  # activation arriving at this stage
+        # Activation (+ metadata) arriving at this stage.
+        buf = jax.tree.map(lambda a: jnp.zeros_like(a[0]), xm)
 
         def tick(buf, t):
             in_idx = jnp.clip(t, 0, num_microbatches - 1)
-            h_in = jnp.where(stage == 0, xm[in_idx], buf)
+            h_in = jax.tree.map(
+                lambda a, b: jnp.where(stage == 0, a[in_idx], b), xm, buf)
             h_out = stage_fn(params, h_in)
             # Rotate stage -> stage+1 (last -> 0 carries drain garbage,
             # overwritten before stage 0 reads it... stage 0 always reads
             # xm, so the wraparound value is simply unused).
-            buf = jax.lax.ppermute(
-                h_out, axis,
-                [(i, (i + 1) % num_stages) for i in range(num_stages)])
+            buf = jax.tree.map(
+                lambda a: jax.lax.ppermute(
+                    a, axis,
+                    [(i, (i + 1) % num_stages) for i in range(num_stages)]),
+                h_out)
             # h_out rides out as scan ys: emitted once per tick instead of
             # scattering into a carried [M, ...] buffer, so the remat'd
             # backward only stores per-tick boundary activations.
@@ -132,15 +143,19 @@ def pipeline_apply(
         buf, emitted = jax.lax.scan(
             jax.checkpoint(tick), buf, jnp.arange(ticks))
         # The last stage's emissions for ticks P-1.. are microbatches 0..M.
-        outputs = emitted[num_stages - 1:]
         # Only the last stage holds real outputs; give every shard the
         # same result (out_specs replicate over `axis`).
-        outputs = jnp.where(stage == num_stages - 1, outputs, 0.0)
-        outputs = jax.lax.psum(outputs, axis)
-        return outputs
+        def finalize(e):
+            out = e[num_stages - 1:]
+            out = jnp.where(stage == num_stages - 1, out,
+                            jnp.zeros_like(out))
+            return jax.lax.psum(out, axis)
+
+        return jax.tree.map(finalize, emitted)
 
     out = run(stage_params, xm)
-    return out.reshape(batch, *out.shape[2:])
+    return jax.tree.map(
+        lambda a: a.reshape(batch, *a.shape[2:]), out)
 
 
 def pipeline_apply_circular(
@@ -177,7 +192,7 @@ def pipeline_apply_circular(
     """
     num_stages = mesh.shape[axis]
     p, c, m = num_stages, num_chunks, num_microbatches
-    batch = x.shape[0]
+    batch = jax.tree.leaves(x)[0].shape[0]
     total = jax.tree.leaves(stage_params)[0].shape[0]
     if total != p * c:
         raise ValueError(
@@ -191,7 +206,9 @@ def pipeline_apply_circular(
             "the interleaved schedule's group injection")
     mb = batch // m
     _check_data_axis(mesh, data_axis, mb)
-    xm = x.reshape(m, mb, *x.shape[1:])
+    # Pytree x: see pipeline_apply — metadata rides the ring with the
+    # activation.
+    xm = jax.tree.map(lambda a: a.reshape(m, mb, *a.shape[1:]), x)
     groups = m // p
     period = c * p  # ticks to push one group through all chunks
     ticks = groups * period + p - 1
@@ -200,7 +217,8 @@ def pipeline_apply_circular(
     cparams = jax.tree.map(
         lambda a: a.reshape(c, p, *a.shape[1:]), stage_params)
     pspec = jax.tree.map(lambda _: P(None, axis), cparams)
-    other = P(None, data_axis) if data_axis is not None else P()
+    o = P(None, data_axis) if data_axis is not None else P()
+    other = jax.tree.map(lambda _: o, x)
 
     # Tick t on device s computes the chunk of the activation that left
     # device 0 at tick t-s: chunk(t, s) = ((t - s) mod C·P) // P. Fresh
@@ -225,24 +243,35 @@ def pipeline_apply_circular(
             fresh_idx = jnp.clip((t // period) * p + jnp.mod(t, period),
                                  0, m - 1)
             is_fresh = (stage == 0) & (jnp.mod(t, period) < p) & (t < m * c)
-            h_in = jnp.where(is_fresh, xm[fresh_idx], buf)
+            h_in = jax.tree.map(
+                lambda a, b: jnp.where(is_fresh, a[fresh_idx], b), xm, buf)
             cp = jax.tree.map(
                 lambda a: jax.lax.dynamic_index_in_dim(
                     a, chunk, keepdims=False), params)
             h_out = stage_fn(cp, h_in)
-            buf = jax.lax.ppermute(
-                h_out, axis,
-                [(i, (i + 1) % num_stages) for i in range(num_stages)])
+            buf = jax.tree.map(
+                lambda a: jax.lax.ppermute(
+                    a, axis,
+                    [(i, (i + 1) % num_stages) for i in range(num_stages)]),
+                h_out)
             return buf, h_out
 
         _, emitted = jax.lax.scan(
-            jax.checkpoint(tick), jnp.zeros_like(xm[0]), jnp.arange(ticks))
-        outputs = jnp.take(emitted, jnp.asarray(out_ticks), axis=0)
-        outputs = jnp.where(stage == num_stages - 1, outputs, 0.0)
-        return jax.lax.psum(outputs, axis)
+            jax.checkpoint(tick),
+            jax.tree.map(lambda a: jnp.zeros_like(a[0]), xm),
+            jnp.arange(ticks))
+
+        def finalize(e):
+            out = jnp.take(e, jnp.asarray(out_ticks), axis=0)
+            out = jnp.where(stage == num_stages - 1, out,
+                            jnp.zeros_like(out))
+            return jax.lax.psum(out, axis)
+
+        return jax.tree.map(finalize, emitted)
 
     out = run(cparams, xm)
-    return out.reshape(batch, *out.shape[2:])
+    return jax.tree.map(
+        lambda a: a.reshape(batch, *a.shape[2:]), out)
 
 
 def sequential_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],

@@ -75,6 +75,12 @@ class ModelRepository:
                 # tensor-parallel layout — a TP model silently reloaded
                 # single-device would OOM on real hardware.
                 self._meshes[model.name] = dict(mesh)
+            else:
+                # A meshless registration is an intent change (e.g. the
+                # name now points at a single-device or non-generative
+                # bundle): drop the remembered mesh or it would be
+                # re-applied to a bundle it no longer fits.
+                self._meshes.pop(model.name, None)
             old = self._batchers.pop(model.name, None)
             self._batchers[model.name] = Batcher(
                 model.predict, max_batch_size=max_batch_size,
@@ -136,7 +142,7 @@ class ModelRepository:
         if model_dir:
             from kubeflow_tpu.serve import runtimes
             model = runtimes.load_model(model_dir, name=name, mesh=mesh)
-            return self.register(model, model_dir=model_dir)
+            return self.register(model, model_dir=model_dir, mesh=mesh)
         model = self.get(name)
         model.load()
         return model
@@ -184,7 +190,7 @@ class ModelRepository:
                 with self._lock:
                     if self._want.get(name, "") != target:
                         continue  # newer dir (or unload) requested: redo
-                self.register(model, model_dir=target)
+                self.register(model, model_dir=target, mesh=mesh)
                 with self._lock:
                     want_now = self._want.get(name, "")
                     if want_now == target:

@@ -214,26 +214,33 @@ def make_train_step(
                 "model (params with a leading 'layers' dim)")
         if loss_fn is not None:
             raise ValueError("pipeline implies the built-in LM loss")
-        unsupported = {"ring_axis", "segment_ids", "positions"} & set(
-            model_kwargs)
-        if any(model_kwargs.get(k) is not None for k in unsupported):
+        if model_kwargs.get("ring_axis") is not None:
             raise ValueError(
-                f"pipeline parallelism doesn't compose with {unsupported} "
-                "(contiguous causal sequences only in PP v1)")
+                "pipeline parallelism doesn't compose with ring_axis "
+                "(ring/context parallelism inside PP is future work)")
+        static_packed = {"segment_ids", "positions"} & set(model_kwargs)
+        if any(model_kwargs.get(k) is not None for k in static_packed):
+            # The pipeline path reads packed metadata from the BATCH
+            # (pipeline_loss); silently ignoring static model_kwargs here
+            # would train with arange positions and no document masking.
+            raise ValueError(
+                f"pipeline parallelism takes {static_packed} from the "
+                "batch (packed_lm loader), not from model_kwargs")
 
     def pipeline_loss(params, batch):
         from kubeflow_tpu.models.llama_pp import pipeline_forward
 
-        if "segment_ids" in batch or "positions" in batch:
-            raise ValueError(
-                "packed-sequence batches are not supported through the "
-                "pipeline schedule (PP v1)")
         hidden = loss_impl == "chunked"
+        # Packed batches (data/loader.py) carry per-document restarting
+        # positions + segment ids; they travel the pipeline ring with the
+        # activations so stage attention masks within documents.
         out = pipeline_forward(
             model.cfg, params, batch["inputs"], mesh=mesh,
             num_microbatches=int(pipeline["microbatches"]),
             num_chunks=int(pipeline.get("chunks", 1)),
-            return_hidden=hidden)
+            return_hidden=hidden,
+            positions=batch.get("positions"),
+            segment_ids=batch.get("segment_ids"))
         if hidden:
             head, vocab_major = _unembed_head(params)
             main = chunked_cross_entropy(

@@ -85,6 +85,106 @@ def test_pipeline_grads_match_scanned(devices8):
                                    rtol=5e-4, atol=5e-5)
 
 
+def _packed_batch(cfg, batch=8, seq=16, seed=3):
+    """Two documents per row with restarting positions — the loader's
+    packed-row shape (data/loader.py) in miniature."""
+    rng = np.random.default_rng(seed)
+    tokens = rng.integers(1, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    segs = np.zeros((batch, seq), np.int32)
+    pos = np.zeros((batch, seq), np.int32)
+    for i in range(batch):
+        cut = int(rng.integers(4, seq - 4))
+        segs[i, cut:] = 1
+        pos[i, :cut] = np.arange(cut)
+        pos[i, cut:] = np.arange(seq - cut)
+    return jnp.asarray(tokens), jnp.asarray(segs), jnp.asarray(pos)
+
+
+@pytest.mark.parametrize("chunks,mesh_kw,batch", [
+    (1, dict(pipe=4, data=2), 8),
+    (2, dict(pipe=2), 16),  # circular schedule with packed metadata
+])
+def test_pipeline_packed_matches_scanned(devices8, chunks, mesh_kw, batch):
+    """VERDICT r3 item 5: packed-batch PP logits must match the no-PP
+    packed model — segment_ids/positions ride the ring with activations."""
+    cfg = _cfg()
+    model, params, _ = _params_and_tokens(cfg)
+    tokens, segs, pos = _packed_batch(cfg, batch=batch)
+
+    ref = model.apply({"params": params}, tokens, positions=pos,
+                      segment_ids=segs)
+    mesh = build_mesh(MeshConfig(**mesh_kw), devices8)
+    with mesh:
+        out = jax.jit(lambda p, t, sg, ps: pipeline_forward(
+            cfg, p, t, mesh=mesh, num_microbatches=4, num_chunks=chunks,
+            positions=ps, segment_ids=sg))(params, tokens, segs, pos)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_pipeline_packed_grads_match_scanned(devices8):
+    cfg = _cfg()
+    model, params, _ = _params_and_tokens(cfg)
+    tokens, segs, pos = _packed_batch(cfg, batch=8)
+    targets = jnp.roll(tokens, -1, axis=1)
+    # Cross-document targets masked, like the packed loader's mask.
+    mask = (np.asarray(segs)[:, :-1] == np.asarray(segs)[:, 1:])
+    mask = jnp.asarray(
+        np.concatenate([mask, np.zeros((8, 1), bool)], 1), jnp.float32)
+    mesh = build_mesh(MeshConfig(pipe=4, data=2), devices8)
+
+    def ref_loss(p):
+        return cross_entropy_loss(
+            model.apply({"params": p}, tokens, positions=pos,
+                        segment_ids=segs), targets, mask)
+
+    def pp_loss(p):
+        return cross_entropy_loss(
+            pipeline_forward(cfg, p, tokens, mesh=mesh, num_microbatches=4,
+                             positions=pos, segment_ids=segs),
+            targets, mask)
+
+    ref_l, ref_g = jax.value_and_grad(ref_loss)(params)
+    with mesh:
+        pp_l, pp_g = jax.jit(jax.value_and_grad(pp_loss))(params)
+    np.testing.assert_allclose(float(pp_l), float(ref_l), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(ref_g), jax.tree.leaves(pp_g)):
+        np.testing.assert_allclose(np.asarray(b, np.float32),
+                                   np.asarray(a, np.float32),
+                                   rtol=5e-4, atol=5e-5)
+
+
+def test_trainer_packed_pipeline_end_to_end(tmp_path, devices8):
+    """The flagship packed pre-training data path through the pipeline
+    schedule: packed_lm dataset -> PP trainer, loss falls, finite."""
+    import json
+
+    eos = 0
+    rng = np.random.default_rng(0)
+    docs = [np.append(rng.integers(1, 64, rng.integers(3, 30)), eos)
+            for _ in range(300)]
+    np.save(tmp_path / "docs.npy", np.concatenate(docs).astype(np.int32))
+
+    from kubeflow_tpu.train.trainer import TrainJobSpec, Trainer
+
+    result = Trainer(TrainJobSpec(
+        model="llama_tiny",
+        model_kwargs={"num_layers": 4, "attention_impl": "naive",
+                      "vocab_size": 64},
+        dataset="packed_lm",
+        dataset_kwargs={"path": str(tmp_path / "docs.npy"), "eos_id": eos},
+        mesh={"pipe": 4, "data": 2}, pipeline={"microbatches": 4},
+        steps=30, batch_size=8, seq_len=32, learning_rate=3e-3,
+        metrics_path=str(tmp_path / "m.jsonl"), log_every=10)).run()
+    assert result["final_step"] == 30
+    assert np.isfinite(result["loss"])
+    lines = [json.loads(l) for l in
+             open(tmp_path / "m.jsonl").read().splitlines()]
+    first = next(l for l in lines if l.get("step") == 10 and "loss" in l)
+    assert result["loss"] < first["loss"]
+
+
 def test_pipeline_rejects_bad_layer_split(devices8):
     cfg = _cfg(layers=3)  # 3 layers don't split over 4 stages
     model, params, tokens = _params_and_tokens(cfg)
