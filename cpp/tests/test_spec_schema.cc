@@ -1,0 +1,110 @@
+// Spec drift guard, C++ side (SURVEY.md §5.6): admission must enforce the
+// GENERATED runtime field table (spec_schema.gen.h) mechanically — every
+// entry's type/min/enum, and rejection of fields not in the table. If a
+// field is deleted from the schema, the presence assertions below fail;
+// if one is added without regenerating, the Python suite's cross-check
+// fails (tests/test_spec_schema.py). No e2e required to notice drift.
+#include <cstdio>
+#include <string>
+
+#include "admission.h"
+#include "json.h"
+
+using tpk::Json;
+
+#define CHECK(cond)                                                   \
+  do {                                                                \
+    if (!(cond)) {                                                    \
+      fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+      return 1;                                                       \
+    }                                                                 \
+  } while (0)
+
+namespace {
+
+std::string ValidateRuntime(Json runtime) {
+  Json spec = Json::Object();
+  spec["replicas"] = 1;
+  spec["runtime"] = runtime;
+  return tpk::ValidateSpec("JAXJob", spec);
+}
+
+Json One(const std::string& field, Json value) {
+  Json rt = Json::Object();
+  rt[field] = std::move(value);
+  return rt;
+}
+
+}  // namespace
+
+int main() {
+  const Json& table = tpk::SpecSchemaRuntime();
+  CHECK(table.is_object());
+  // A hollowed-out schema must not pass silently: the core contract
+  // fields are pinned by name.
+  for (const char* core : {"steps", "batch_size", "accum_steps",
+                           "learning_rate", "lr_schedule", "model",
+                           "dataset", "mesh"}) {
+    CHECK(table.has(core));
+  }
+
+  int checked = 0;
+  for (const auto& [field, entry] : table.items()) {
+    const std::string type = entry.get("type").as_string();
+    if (type == "int") {
+      int64_t min = entry.get("min").as_int(0);
+      CHECK(ValidateRuntime(One(field, min)).empty());
+      CHECK(!ValidateRuntime(One(field, min - 1)).empty());
+      CHECK(!ValidateRuntime(One(field, min + 0.5)).empty());  // integral
+      CHECK(!ValidateRuntime(One(field, "2")).empty());        // type
+    } else if (type == "number") {
+      double min = entry.get("min").as_number();
+      CHECK(ValidateRuntime(One(field, min)).empty());
+      CHECK(!ValidateRuntime(One(field, min - 1)).empty());
+      CHECK(!ValidateRuntime(One(field, "fast")).empty());
+    } else if (type == "string") {
+      if (entry.has("enum")) {
+        for (const auto& e : entry.get("enum").elements()) {
+          CHECK(ValidateRuntime(One(field, e.as_string())).empty());
+        }
+        CHECK(!ValidateRuntime(One(field, "no-such-enum-value")).empty());
+      } else {
+        CHECK(ValidateRuntime(One(field, "x")).empty());
+      }
+      CHECK(!ValidateRuntime(One(field, 5)).empty());
+    } else if (type == "string_or_null") {
+      CHECK(ValidateRuntime(One(field, "x")).empty());
+      CHECK(ValidateRuntime(One(field, nullptr)).empty());
+      CHECK(!ValidateRuntime(One(field, 5)).empty());
+    } else if (type == "bool_or_string") {
+      CHECK(ValidateRuntime(One(field, true)).empty());
+      CHECK(ValidateRuntime(One(field, "ring")).empty());
+      CHECK(!ValidateRuntime(One(field, 5)).empty());
+    } else if (type == "object") {
+      CHECK(ValidateRuntime(One(field, Json::Object())).empty());
+      CHECK(!ValidateRuntime(One(field, 5)).empty());
+    } else {
+      fprintf(stderr, "FAIL: schema type %s unhandled by this test\n",
+              type.c_str());
+      return 1;
+    }
+    ++checked;
+  }
+  CHECK(checked >= 25);  // the real table, not a stub
+
+  // Unknown runtime fields (typo'd knobs) are rejected at submit.
+  CHECK(!ValidateRuntime(One("stesp", 100)).empty());
+  std::string err = ValidateRuntime(One("no_such_knob", 1));
+  CHECK(err.find("not a JAXJob runtime field") != std::string::npos);
+
+  // Cross-field semantics still enforced on top of the schema.
+  Json rt = Json::Object();
+  rt["batch_size"] = 8;
+  rt["accum_steps"] = 3;
+  CHECK(!ValidateRuntime(rt).empty());
+  rt["accum_steps"] = 2;
+  CHECK(ValidateRuntime(rt).empty());
+
+  printf("spec schema drift guard: %d fields enforced\n", checked);
+  return 0;
+}

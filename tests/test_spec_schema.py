@@ -1,0 +1,86 @@
+"""Spec drift guard, Python side (SURVEY.md §5.6): ONE generated schema,
+consumed by C++ admission (embedded table) and cross-checked against
+TrainJobSpec here — drift on any side breaks a unit suite, not an e2e."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from kubeflow_tpu.utils import spec_schema
+
+REPO = spec_schema.repo_root()
+
+
+def test_schema_matches_dataclass():
+    """Every KNOBS entry is a TrainJobSpec field and vice versa."""
+    spec_schema.check_against_dataclass()
+
+
+def test_checked_in_artifacts_are_current():
+    """The on-disk schema JSON and the embedded C++ header must be byte-
+    identical to what the generator produces — editing either by hand, or
+    editing KNOBS/TrainJobSpec without regenerating, fails here."""
+    with open(os.path.join(REPO, "spec_schema.json")) as fh:
+        assert fh.read() == spec_schema.render_json(), (
+            "spec_schema.json is stale — run "
+            "`python -m kubeflow_tpu.utils.spec_schema`")
+    with open(os.path.join(REPO, "cpp", "spec_schema.gen.h")) as fh:
+        assert fh.read() == spec_schema.render_cpp_header(), (
+            "cpp/spec_schema.gen.h is stale — run "
+            "`python -m kubeflow_tpu.utils.spec_schema`")
+
+
+def test_schema_defaults_satisfy_own_constraints():
+    """TrainJobSpec's dataclass defaults must be admissible under the
+    schema — else every default-valued submit would be rejected."""
+    import dataclasses
+
+    from kubeflow_tpu.train.trainer import TrainJobSpec
+
+    spec = TrainJobSpec()
+    for f in dataclasses.fields(TrainJobSpec):
+        entry = spec_schema.KNOBS[f.name]
+        value = getattr(spec, f.name)
+        t = entry["type"]
+        if t == "int":
+            assert isinstance(value, int) and value >= entry.get(
+                "min", -10**18), f.name
+        elif t == "number":
+            assert isinstance(value, (int, float)) and value >= entry.get(
+                "min", -1e18), f.name
+        elif t == "string":
+            assert isinstance(value, str), f.name
+            if "enum" in entry:
+                assert value in entry["enum"], f.name
+        elif t == "string_or_null":
+            assert value is None or isinstance(value, str), f.name
+        elif t == "bool_or_string":
+            assert isinstance(value, (bool, str)), f.name
+        elif t == "object":
+            assert isinstance(value, dict), f.name
+        else:
+            pytest.fail(f"unknown schema type {t} for {f.name}")
+
+
+def test_from_json_rejects_unknown_fields():
+    """The Python loader enforces the same closed field set the C++
+    admission table does."""
+    from kubeflow_tpu.train.trainer import TrainJobSpec
+
+    with pytest.raises(ValueError, match="unknown TrainJobSpec fields"):
+        TrainJobSpec.from_json(json.dumps({"stesp": 100}))
+    spec = TrainJobSpec.from_json(json.dumps({"steps": 5}))
+    assert spec.steps == 5
+
+
+def test_generator_is_deterministic():
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "from kubeflow_tpu.utils import spec_schema; "
+         "import sys; sys.stdout.write(spec_schema.render_json())"],
+        capture_output=True, text=True, cwd=REPO)
+    assert out.returncode == 0, out.stderr
+    assert out.stdout == spec_schema.render_json()
