@@ -11,8 +11,71 @@
 #include <string>
 
 #include "json.h"
+#include "spec_schema.gen.h"
 
 namespace tpk {
+
+// The generated runtime-field table (kubeflow_tpu/utils/spec_schema.py —
+// ONE schema, consumed here and by TrainJobSpec; SURVEY.md §5.6 drift
+// guard). Parsed once.
+inline const Json& SpecSchemaRuntime() {
+  static const Json schema = Json::parse(kSpecSchemaJson);
+  return schema.get("JAXJob.runtime");
+}
+
+// Validates one runtime field value against its schema entry; "" = ok.
+inline std::string ValidateRuntimeField(const std::string& field,
+                                        const Json& v, const Json& entry) {
+  const std::string type = entry.get("type").as_string();
+  const std::string where = "runtime." + field;
+  if (type == "int") {
+    if (!v.is_number()) return where + " must be a number";
+    // Truncation guard: 2.5 would pass as 2 while the worker receives
+    // 2.5 and fails later. Bounds first — casting a double beyond int64
+    // range is UB.
+    const double num = v.as_number();
+    if (num < -9.2e18 || num > 9.2e18 || num != std::floor(num)) {
+      return where + " must be an integer";
+    }
+    if (entry.has("min") && v.as_int() < entry.get("min").as_int()) {
+      return where + " must be >= " +
+             std::to_string(entry.get("min").as_int());
+    }
+    return "";
+  }
+  if (type == "number") {
+    if (!v.is_number()) return where + " must be a number";
+    if (entry.has("min") && v.as_number() < entry.get("min").as_number()) {
+      return where + " must be >= " + entry.get("min").dump();
+    }
+    return "";
+  }
+  if (type == "string" || type == "string_or_null") {
+    if (type == "string_or_null" && v.is_null()) return "";
+    if (!v.is_string()) return where + " must be a string";
+    if (entry.has("enum")) {
+      std::string allowed;
+      for (const auto& e : entry.get("enum").elements()) {
+        if (e.as_string() == v.as_string()) return "";
+        if (!allowed.empty()) allowed += " | ";
+        allowed += e.as_string();
+      }
+      return where + " must be " + allowed;
+    }
+    return "";
+  }
+  if (type == "bool_or_string") {
+    if (!v.is_bool() && !v.is_string()) {
+      return where + " must be a bool or a string";
+    }
+    return "";
+  }
+  if (type == "object") {
+    if (!v.is_object()) return where + " must be an object";
+    return "";
+  }
+  return where + ": unknown schema type " + type;  // schema bug — loud
+}
 
 // Returns "" when valid, else a human-readable rejection reason.
 inline std::string ValidateSpec(const std::string& kind, const Json& spec) {
@@ -50,56 +113,62 @@ inline std::string ValidateSpec(const std::string& kind, const Json& spec) {
     const Json& rt = spec.get("runtime");
     if (!rt.is_null()) {
       if (!rt.is_object()) return "runtime must be an object";
-      // Type-strict: as_string()/as_int() fall back to defaults on a
-      // mismatched JSON type, which would ADMIT e.g. lr_schedule: 5 or
-      // accum_steps: "2" and crash the worker at startup — the exact
-      // late failure this webhook exists to prevent.
-      const Json& sched_v = rt.get("lr_schedule");
-      if (!sched_v.is_null()) {
-        if (!sched_v.is_string()) {
-          return "runtime.lr_schedule must be a string";
+      // Schema-driven validation (generated table, spec_schema.gen.h):
+      // every present field must exist in the schema and satisfy its
+      // type/min/enum — unknown fields (typo'd knobs) and mismatched
+      // JSON types are rejected at submit, not discovered as a worker
+      // crash. Type-strict by construction: as_string()/as_int() default
+      // fallbacks never decide admission.
+      const Json& table = SpecSchemaRuntime();
+      for (const auto& [field, value] : rt.items()) {
+        if (!table.has(field)) {
+          return "runtime." + field + " is not a JAXJob runtime field "
+                 "(see spec_schema.json)";
         }
-        const std::string sched = sched_v.as_string();
-        if (sched != "constant" && sched != "cosine" && sched != "linear") {
-          return "runtime.lr_schedule must be constant | cosine | linear";
-        }
+        std::string ferr = ValidateRuntimeField(field, value,
+                                                table.get(field));
+        if (!ferr.empty()) return ferr;
       }
-      const Json& clip = rt.get("max_grad_norm");
-      if (!clip.is_null() && (!clip.is_number() || clip.as_number() < 0)) {
-        return "runtime.max_grad_norm must be a number >= 0";
-      }
-      auto int_knob = [&](const char* field, int64_t dflt, int64_t min,
-                          int64_t* out) -> std::string {
-        const Json& v = rt.get(field);
-        *out = dflt;
-        if (v.is_null()) return "";
-        if (!v.is_number()) {
-          return std::string("runtime.") + field + " must be a number";
-        }
-        // as_int() truncates: accum_steps: 2.5 would pass admission as 2
-        // while the worker receives 2.5 and fails later — the late failure
-        // this webhook exists to prevent. Bounds first: casting a double
-        // beyond int64 range is UB, so reject before as_int() ever runs.
-        const double num = v.as_number();
-        if (num < -9.2e18 || num > 9.2e18 || num != std::floor(num)) {
-          return std::string("runtime.") + field + " must be an integer";
-        }
-        *out = v.as_int();
-        if (*out < min) {
-          return std::string("runtime.") + field + " must be >= " +
-                 std::to_string(min);
-        }
-        return "";
-      };
-      std::string err;
-      int64_t accum, batch, ev, eb;
-      if (!(err = int_knob("accum_steps", 1, 1, &accum)).empty()) return err;
-      if (!(err = int_knob("batch_size", -1, -1, &batch)).empty()) return err;
-      if (batch >= 0 && batch % accum) {
+      // Cross-field semantics stay hand-coded (the schema is per-field).
+      int64_t accum = rt.get("accum_steps").as_int(1);
+      int64_t batch = rt.get("batch_size").as_int(-1);
+      if (batch >= 0 && accum >= 1 && batch % accum) {
         return "runtime.batch_size must be divisible by accum_steps";
       }
-      if (!(err = int_knob("eval_every", 0, 0, &ev)).empty()) return err;
-      if (!(err = int_knob("eval_batches", 1, 1, &eb)).empty()) return err;
+    }
+    const Json& elastic = spec.get("elastic");
+    if (!elastic.is_null()) {
+      if (!elastic.is_object()) return "elastic must be an object";
+      // Integral + bounded before any as_int: the cast-beyond-int64 UB
+      // guard, same as ValidateRuntimeField.
+      auto small_int = [](const Json& v, int64_t lo, int64_t hi) {
+        if (!v.is_number()) return false;
+        const double num = v.as_number();
+        if (num != std::floor(num) || num < static_cast<double>(lo) ||
+            num > static_cast<double>(hi)) {
+          return false;
+        }
+        return true;
+      };
+      int64_t replicas = spec.get("replicas").as_int(1);
+      if (!small_int(elastic.get("min"), 1, replicas)) {
+        return "elastic.min must be an integer in [1, replicas]";
+      }
+      int64_t emin = elastic.get("min").as_int();
+      if (elastic.has("max") &&
+          !small_int(elastic.get("max"), emin, replicas)) {
+        return "elastic.max must be an integer in [min, replicas]";
+      }
+      if (elastic.has("heartbeat_timeout_s") &&
+          (!elastic.get("heartbeat_timeout_s").is_number() ||
+           elastic.get("heartbeat_timeout_s").as_number() <= 0)) {
+        return "elastic.heartbeat_timeout_s must be a number > 0";
+      }
+      if (elastic.has("upsize_cooldown_s") &&
+          (!elastic.get("upsize_cooldown_s").is_number() ||
+           elastic.get("upsize_cooldown_s").as_number() < 0)) {
+        return "elastic.upsize_cooldown_s must be a number >= 0";
+      }
     }
     const Json& fault = spec.get("fault");
     if (!fault.is_null()) {

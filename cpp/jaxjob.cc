@@ -104,9 +104,49 @@ void JaxJobController::ReleaseAlloc(JobView& job) {
   }
 }
 
+void JaxJobController::ElasticResize(JobView& job, int target,
+                                     const std::string& phase,
+                                     const std::string& reason,
+                                     const std::string& message,
+                                     bool count_restart) {
+  job.status["effectiveReplicas"] = target;
+  job.status["lastResizeUnix"] = now_s_ ? now_s_ : NowWall();
+  if (count_restart) {
+    job.status["restarts"] = job.status.get("restarts").as_int(0) + 1;
+  }
+  metrics_.elastic_resizes++;
+  SetPhase(job, phase, reason, message, now_s_);
+}
+
+int64_t JaxJobController::UsedInNamespace(const std::string& ns,
+                                          const std::string& exclude) const {
+  int64_t used = 0;
+  for (const auto& other : store_->List("JAXJob")) {
+    if (other.name == exclude) continue;
+    if (NamespaceOf(other.spec) != ns) continue;
+    const Json& oalloc = other.status.get("allocation");
+    if (oalloc.is_object() && oalloc.size() > 0) {
+      for (const auto& [slice, n] : oalloc.items()) {
+        (void)slice;
+        used += n.as_int();
+      }
+    }
+  }
+  return used;
+}
+
+int JaxJobController::EffectiveReplicas(const JobView& job) const {
+  int spec_r = static_cast<int>(job.spec.get("replicas").as_int(1));
+  int eff = static_cast<int>(
+      job.status.get("effectiveReplicas").as_int(spec_r));
+  if (eff < 1) eff = 1;
+  if (eff > spec_r) eff = spec_r;
+  return eff;
+}
+
 void JaxJobController::LaunchGang(JobView& job) {
   const std::string& name = job.res.name;
-  int replicas = static_cast<int>(job.spec.get("replicas").as_int(1));
+  int replicas = EffectiveReplicas(job);
   int devices = static_cast<int>(job.spec.get("devices_per_proc").as_int(1));
   int num_slices = static_cast<int>(job.spec.get("num_slices").as_int(1));
 
@@ -119,16 +159,7 @@ void JaxJobController::LaunchGang(JobView& job) {
   if (profile) {
     int64_t quota = profile->spec.get("max_devices").as_int(-1);
     if (quota >= 0) {
-      int64_t used = 0;
-      for (const auto& other : store_->List("JAXJob")) {
-        if (other.name == name) continue;
-        if (NamespaceOf(other.spec) != ns) continue;
-        const Json& oalloc = other.status.get("allocation");
-        if (oalloc.is_object() && oalloc.size() > 0) {
-          used += other.spec.get("replicas").as_int(1) *
-                  other.spec.get("devices_per_proc").as_int(1);
-        }
-      }
+      int64_t used = UsedInNamespace(ns, name);
       if (used + static_cast<int64_t>(replicas) * devices > quota) {
         SetPhase(job, "Pending", "QuotaExceeded",
                  "namespace " + ns + " quota " + std::to_string(quota) +
@@ -141,6 +172,21 @@ void JaxJobController::LaunchGang(JobView& job) {
 
   auto alloc = scheduler_->Allocate(replicas * devices, num_slices);
   if (!alloc) {
+    // Elastic downsize on scarce capacity: rather than pending forever at
+    // the full size, walk the gang down toward elastic.min one step per
+    // reconcile — the checkpoint-resume path reshards to whatever size
+    // finally fits (SURVEY.md §2.6 Elastic DP).
+    const Json& el = job.spec.get("elastic");
+    int min_r = static_cast<int>(el.get("min").as_int(0));
+    if (el.is_object() && min_r >= 1 && replicas > min_r) {
+      // No gang attempt was consumed — the workers never launched.
+      ElasticResize(job, replicas - 1, "Pending", "ElasticDownsize",
+                    "insufficient capacity for " + std::to_string(replicas) +
+                        " workers; retrying at " +
+                        std::to_string(replicas - 1),
+                    /*count_restart=*/false);
+      return;
+    }
     SetPhase(job, "Pending", "Unschedulable",
              "insufficient slice capacity for gang", now_s_);
     return;
@@ -244,7 +290,7 @@ void JaxJobController::LaunchGang(JobView& job) {
 
 void JaxJobController::HandleExits(JobView& job) {
   const std::string& name = job.res.name;
-  int replicas = static_cast<int>(job.spec.get("replicas").as_int(1));
+  int replicas = EffectiveReplicas(job);
   int succeeded = 0, failed = 0, running = 0;
   int first_fail_code = 0;
   for (int i = 0; i < replicas; ++i) {
@@ -305,11 +351,144 @@ void JaxJobController::HandleExits(JobView& job) {
     // triggers a watch event → reconcile).
     return;
   }
+  // Worker death past the backoff budget: instead of failing the job, an
+  // elastic policy resumes at a smaller topology from the latest
+  // checkpoint — params reshard to the new mesh (the e2e-proven
+  // checkpoint-restart elasticity, now with an automatic trigger;
+  // SURVEY.md §2.6 Elastic DP / §5.3 ElasticPolicy analog).
+  if (retryable) {
+    const Json& el = job.spec.get("elastic");
+    int min_r = static_cast<int>(el.get("min").as_int(0));
+    if (el.is_object() && min_r >= 1 && replicas > min_r) {
+      int target = replicas - failed;
+      if (target < min_r) target = min_r;
+      if (target < 1) target = 1;
+      // count_restart: this consumed a gang attempt — per-attempt gates
+      // (spec.fault's first-attempt default) must see a nonzero count or
+      // the fault would re-arm on every elastic relaunch.
+      ElasticResize(job, target, "Restarting", "ElasticDownsize",
+                    std::to_string(failed) + " worker(s) lost past "
+                        "backoff; resuming at " + std::to_string(target) +
+                        "/" +
+                        std::to_string(job.spec.get("replicas").as_int(1)) +
+                        " from latest checkpoint",
+                    /*count_restart=*/true);
+      return;
+    }
+  }
   job.status["completionUnix"] = now_s_ ? now_s_ : NowWall();
   SetPhase(job, "Failed",
            retryable ? "BackoffLimitExceeded" : "PermanentFailure",
            "worker exited " + std::to_string(first_fail_code), now_s_);
   metrics_.jobs_failed++;
+}
+
+void JaxJobController::CheckHeartbeats(JobView& job) {
+  // Hang detection: a worker that stops writing its log for longer than
+  // elastic.heartbeat_timeout_s is treated as dead (the failure detector
+  // for workers that wedge without exiting — e.g. a hung collective).
+  // Killing it routes through the normal gang-failure path: restart
+  // within backoff, elastic downsize past it. Wall-clock on purpose —
+  // log mtimes are wall time. The timeout must exceed the job's slowest
+  // logging interval (log_every steps).
+  const Json& el = job.spec.get("elastic");
+  double timeout = el.get("heartbeat_timeout_s").as_number(0);
+  if (!(timeout > 0)) return;
+  int replicas = EffectiveReplicas(job);
+  double now_wall = NowWall();
+  for (int i = 0; i < replicas; ++i) {
+    std::string log_path = workdir_ + "/" + job.res.name + "/worker-" +
+                           std::to_string(i) + ".log";
+    struct stat st;
+    if (stat(log_path.c_str(), &st) != 0) continue;  // not spawned by us
+    double age = now_wall - static_cast<double>(st.st_mtime);
+    if (age > timeout) {
+      SetPhase(job, "Running", "HeartbeatTimeout",
+               "worker " + std::to_string(i) + " silent for " +
+                   std::to_string(static_cast<int>(age)) + "s (timeout " +
+                   std::to_string(static_cast<int>(timeout)) +
+                   "s); killing for gang restart",
+               now_s_);
+      executor_->Kill(ProcId(job.res.name, i));
+    }
+  }
+}
+
+void JaxJobController::MaybeUpsize(JobView& job) {
+  // Capacity-driven upsize: a gang running below its desired size (after
+  // an elastic downsize) grows back when freed devices can host it —
+  // kill, release, relaunch larger; the runtime resumes from the latest
+  // checkpoint and reshards up. Cooldown prevents thrash with the
+  // downsize path.
+  const Json& el = job.spec.get("elastic");
+  if (!el.is_object()) return;
+  int spec_r = static_cast<int>(job.spec.get("replicas").as_int(1));
+  int cap = static_cast<int>(el.get("max").as_int(spec_r));
+  if (cap > spec_r) cap = spec_r;
+  int eff = EffectiveReplicas(job);
+  if (eff >= cap) return;
+  double cooldown = el.get("upsize_cooldown_s").as_number(30.0);
+  double last = job.status.get("lastResizeUnix").as_number(0);
+  double now = now_s_ ? now_s_ : NowWall();
+  if (last > 0 && now - last < cooldown) return;
+  int devices = static_cast<int>(job.spec.get("devices_per_proc").as_int(1));
+  int num_slices = static_cast<int>(job.spec.get("num_slices").as_int(1));
+
+  // Find the largest target the scheduler would ACTUALLY grant by
+  // probing real allocations (release current, try bigger, put a
+  // same-size allocation back on failure). A free-device sum would
+  // ignore per-slice fragmentation and num_slices divisibility and kill
+  // a healthy gang for an upsize that can never launch. Single-threaded
+  // controller: nothing races the probe.
+  Allocation current = AllocFromStatus(job.status);
+  scheduler_->Release(current);
+  int target = 0;
+  std::optional<Allocation> probe;
+  for (int t = cap; t > eff; --t) {
+    probe = scheduler_->Allocate(t * devices, num_slices);
+    if (probe) {
+      target = t;
+      break;
+    }
+  }
+  if (target == 0) {
+    // Nothing bigger fits — restore the books for the running gang.
+    auto back = scheduler_->Allocate(eff * devices, num_slices);
+    if (back) {
+      Json alloc_json = Json::Object();
+      for (const auto& [slice, n] : back->slices) alloc_json[slice] = n;
+      job.status["allocation"] = alloc_json;
+    }
+    return;
+  }
+  scheduler_->Release(*probe);  // LaunchGang re-allocates for real
+
+  // Namespace quota headroom must admit the bigger gang too, or the
+  // killed job would land in Pending/QuotaExceeded with zero workers.
+  const std::string ns = NamespaceOf(job.spec);
+  auto profile = store_->Get("Profile", ns);
+  int64_t quota =
+      profile ? profile->spec.get("max_devices").as_int(-1) : -1;
+  if (quota >= 0 && UsedInNamespace(ns, job.res.name) +
+                            static_cast<int64_t>(target) * devices >
+                        quota) {
+    auto back = scheduler_->Allocate(eff * devices, num_slices);
+    if (back) {
+      Json alloc_json = Json::Object();
+      for (const auto& [slice, n] : back->slices) alloc_json[slice] = n;
+      job.status["allocation"] = alloc_json;
+    }
+    return;
+  }
+
+  KillAll(job);
+  job.status["active"] = false;
+  job.status["allocation"] = Json::Object();  // already released above
+  ElasticResize(job, target, "Restarting", "ElasticUpsize",
+                "capacity freed; growing " + std::to_string(eff) + " -> " +
+                    std::to_string(target) +
+                    " workers, resuming from latest checkpoint",
+                /*count_restart=*/false);
 }
 
 void JaxJobController::Recover() {
@@ -414,6 +593,13 @@ void JaxJobController::Tick(double now_s) {
     }
     if (phase == "Pending" || phase == "Restarting" || phase.empty()) {
       Reconcile(res.name);
+    }
+    if (phase == "Running" && job.status.get("active").as_bool(false)) {
+      CheckHeartbeats(job);  // hung-worker kills reaped on a later Poll
+      MaybeUpsize(job);
+      if (job.status.dump() != res.status.dump()) {
+        store_->UpdateStatus("JAXJob", res.name, job.status);
+      }
     }
   }
 }

@@ -36,6 +36,7 @@ struct ControllerMetrics {
   int64_t jobs_failed = 0;
   int64_t gang_restarts = 0;
   int64_t reconciles = 0;
+  int64_t elastic_resizes = 0;
 
   Json ToJson() const {
     Json j = Json::Object();
@@ -44,6 +45,7 @@ struct ControllerMetrics {
     j["jobs_failed"] = jobs_failed;
     j["gang_restarts"] = gang_restarts;
     j["reconciles"] = reconciles;
+    j["elastic_resizes"] = elastic_resizes;
     return j;
   }
 };
@@ -84,6 +86,26 @@ class JaxJobController {
 
   void LaunchGang(JobView& job);
   void HandleExits(JobView& job);
+  // Elastic policy (spec.elastic {min, max?, heartbeat_timeout_s?,
+  // upsize_cooldown_s?}): current gang size (status.effectiveReplicas,
+  // defaulting to spec.replicas), hang detection via worker-log
+  // heartbeats, and capacity-driven upsizing. SURVEY.md §2.6 "Elastic
+  // DP" / §5.3 ElasticPolicy+HPA analog.
+  int EffectiveReplicas(const JobView& job) const;
+  void CheckHeartbeats(JobView& job);
+  void MaybeUpsize(JobView& job);
+  // The one resize transition: record the new gang size + resize time,
+  // bump metrics, set the phase/condition. `count_restart` marks resizes
+  // that consumed a gang attempt (worker-death downsizes) so per-attempt
+  // gating (spec.fault first-attempt semantics) sees them.
+  void ElasticResize(JobView& job, int target, const std::string& phase,
+                     const std::string& reason, const std::string& message,
+                     bool count_restart);
+  // Devices running jobs in `ns` (excluding `exclude`) actually hold —
+  // recorded allocations, so elastically resized gangs charge what they
+  // use, not their spec maximum.
+  int64_t UsedInNamespace(const std::string& ns,
+                          const std::string& exclude) const;
   void SetPhase(JobView& job, const std::string& phase,
                 const std::string& reason, const std::string& message,
                 double now_s);

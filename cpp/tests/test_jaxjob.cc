@@ -4,6 +4,7 @@
 // policies, backoff, deadlines, and TTL GC.
 #include <cstdio>
 
+#include "admission.h"
 #include "executor.h"
 #include "jaxjob.h"
 #include "scheduler.h"
@@ -254,5 +255,162 @@ int main() {
   }
 
   printf("test_jaxjob OK\n");
+  // --- Elastic: downsize past backoff, upsize on freed capacity --------
+  {
+    Harness h;
+    Json spec = BaseSpec(2);
+    spec["backoff_limit"] = 0;
+    Json el = Json::Object();
+    el["min"] = 1;
+    spec["elastic"] = el;
+    h.store.Create("JAXJob", "je", spec);
+    h.Settle();
+    CHECK(Phase(h.store, "je") == "Running");
+    CHECK(h.exec.launched.size() == 2);
+
+    // Worker death past the (zero) backoff budget: the job must NOT
+    // fail — it downsizes to 1 and resumes (VERDICT r3 item 7 e2e shape).
+    h.exec.Finish("je/1", 137);
+    h.Settle();
+    CHECK(Phase(h.store, "je") == "Running");
+    auto r = h.store.Get("JAXJob", "je");
+    CHECK(r->status.get("effectiveReplicas").as_int() == 1);
+    CHECK(h.exec.launched.size() == 3);  // 2 original + 1 downsized
+    CHECK(h.exec.launched[2].env.at("TPK_NUM_PROCS") == "1");
+    CHECK(h.sched.Slices()[0].used == 1);
+    CHECK(h.ctl.metrics().elastic_resizes == 1);
+    CHECK(h.ctl.metrics().jobs_failed == 0);
+
+    // Capacity is free again: after the upsize cooldown the gang grows
+    // back to the desired size and resumes from checkpoint.
+    h.now += 31;
+    h.Settle();
+    r = h.store.Get("JAXJob", "je");
+    CHECK(r->status.get("effectiveReplicas").as_int() == 2);
+    CHECK(Phase(h.store, "je") == "Running");
+    CHECK(h.exec.launched.size() == 5);  // + 2 upsized workers
+    CHECK(h.exec.launched.back().env.at("TPK_NUM_PROCS") == "2");
+    CHECK(h.ctl.metrics().elastic_resizes == 2);
+
+    h.exec.Finish("je/0", 0);
+    h.exec.Finish("je/1", 0);
+    h.Settle();
+    CHECK(Phase(h.store, "je") == "Succeeded");
+  }
+
+  // --- Elastic: downsize when the full gang never fits -----------------
+  {
+    Harness h(1);  // capacity 1 device
+    Json spec = BaseSpec(2);
+    Json el = Json::Object();
+    el["min"] = 1;
+    spec["elastic"] = el;
+    h.store.Create("JAXJob", "js", spec);
+    h.Settle();
+    CHECK(Phase(h.store, "js") == "Running");
+    auto r = h.store.Get("JAXJob", "js");
+    CHECK(r->status.get("effectiveReplicas").as_int() == 1);
+    CHECK(h.exec.launched.size() == 1);
+  }
+
+  // --- Elastic: downsize counts as an attempt (fault gating) ------------
+  {
+    Harness h;
+    Json spec = BaseSpec(2);
+    spec["backoff_limit"] = 0;
+    Json el = Json::Object();
+    el["min"] = 1;
+    spec["elastic"] = el;
+    Json fault = Json::Object();
+    fault["proc"] = 0;
+    fault["step"] = 5;
+    spec["fault"] = fault;
+    h.store.Create("JAXJob", "jfault", spec);
+    h.Settle();
+    CHECK(h.exec.launched[0].env.count("TPK_FAULT") == 1);  // first attempt
+    h.exec.Finish("jfault/0", 137);
+    h.Settle();
+    CHECK(Phase(h.store, "jfault") == "Running");
+    auto r = h.store.Get("JAXJob", "jfault");
+    CHECK(r->status.get("effectiveReplicas").as_int() == 1);
+    CHECK(r->status.get("restarts").as_int() == 1);  // attempt consumed
+    // The relaunched worker 0 must NOT get the fault re-armed — the
+    // default is first-attempt-only, and the downsize WAS an attempt.
+    CHECK(h.exec.launched.size() == 3);
+    CHECK(h.exec.launched[2].env.count("TPK_FAULT") == 0);
+  }
+
+  // --- Elastic: upsize probes a REAL allocation (fragmentation-safe) ---
+  {
+    Harness h(1);           // slice "local" capacity 1
+    h.sched.AddSlice("b", 1);  // + slice "b" capacity 1: 2 free total,
+                               // but no single slice can host 2
+    Json spec = BaseSpec(2);
+    Json el = Json::Object();
+    el["min"] = 1;
+    spec["elastic"] = el;
+    h.store.Create("JAXJob", "jfrag", spec);
+    h.Settle();
+    auto r = h.store.Get("JAXJob", "jfrag");
+    CHECK(r->status.get("effectiveReplicas").as_int() == 1);  // downsized
+    CHECK(Phase(h.store, "jfrag") == "Running");
+    size_t launches = h.exec.launched.size();
+    // Past the cooldown, the free-device SUM (1 free + 1 held = 2) would
+    // suggest an upsize — but no allocation of 2-on-one-slice exists, so
+    // the healthy gang must NOT be killed.
+    h.now += 31;
+    h.Settle();
+    CHECK(Phase(h.store, "jfrag") == "Running");
+    CHECK(h.exec.launched.size() == launches);  // no kill/relaunch churn
+    CHECK(h.store.Get("JAXJob", "jfrag")
+              ->status.get("effectiveReplicas").as_int() == 1);
+    // Books restored: exactly one device still held.
+    int used = 0;
+    for (const auto& s : h.sched.Slices()) used += s.used;
+    CHECK(used == 1);
+  }
+
+  // --- Elastic: without the policy, past-backoff death still fails -----
+  {
+    Harness h;
+    Json spec = BaseSpec(2);
+    spec["backoff_limit"] = 0;
+    h.store.Create("JAXJob", "jf", spec);
+    h.Settle();
+    h.exec.Finish("jf/1", 137);
+    h.Settle();
+    CHECK(Phase(h.store, "jf") == "Failed");
+  }
+
+  // --- Elastic admission ------------------------------------------------
+  {
+    Json spec = BaseSpec(2);
+    Json el = Json::Object();
+    el["min"] = 0;
+    spec["elastic"] = el;
+    CHECK(!tpk::ValidateSpec("JAXJob", spec).empty());
+    el["min"] = 3;  // > replicas
+    spec["elastic"] = el;
+    CHECK(!tpk::ValidateSpec("JAXJob", spec).empty());
+    el["min"] = 1;
+    el["max"] = 5;  // > replicas
+    spec["elastic"] = el;
+    CHECK(!tpk::ValidateSpec("JAXJob", spec).empty());
+    el["max"] = 1.5;  // non-integral
+    spec["elastic"] = el;
+    CHECK(!tpk::ValidateSpec("JAXJob", spec).empty());
+    Json huge = Json::Object();
+    huge["min"] = 1e300;  // beyond int64: UB-guarded rejection
+    spec["elastic"] = huge;
+    CHECK(!tpk::ValidateSpec("JAXJob", spec).empty());
+    el["max"] = 2;
+    el["heartbeat_timeout_s"] = -1;
+    spec["elastic"] = el;
+    CHECK(!tpk::ValidateSpec("JAXJob", spec).empty());
+    el["heartbeat_timeout_s"] = 5;
+    spec["elastic"] = el;
+    CHECK(tpk::ValidateSpec("JAXJob", spec).empty());
+  }
+
   return 0;
 }

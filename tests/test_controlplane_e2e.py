@@ -226,3 +226,72 @@ def test_elastic_resubmit_at_different_replica_count(controlplane):
     logs = client.logs("small", 0, max_bytes=1 << 20)
     assert '"restored"' in logs                  # resumed from step 20
     assert '"data_stream_restarted"' in logs     # world resized 2 -> 1
+
+
+def test_elastic_auto_downsize_on_worker_death(controlplane):
+    """The automatic elastic trigger (SURVEY.md §2.6 Elastic DP / §5.3
+    ElasticPolicy): kill 1 of 2 workers past the backoff budget and the
+    controller — with NO operator action — resumes the job at 1 worker
+    from the latest checkpoint (params reshard to the smaller mesh)."""
+    import numpy as np
+
+    client, sock, workdir, tmp = controlplane
+    corpus = tmp / "ecorpus.npy"
+    np.save(corpus, np.random.default_rng(3).integers(
+        0, 64, 40000, dtype=np.int32))
+    ck = tmp / "eck"
+
+    client.submit_jaxjob("autoelastic", {
+        "replicas": 2,
+        "devices_per_proc": 2,
+        "cpu_devices_per_proc": 2,
+        "restart_policy": "OnFailure",
+        "backoff_limit": 0,
+        "elastic": {"min": 1},
+        # Deterministic chaos: worker 1 kills itself at step 12 (first
+        # attempt only) — past backoff_limit 0, so without the elastic
+        # policy this job would be Failed.
+        "fault": {"proc": 1, "step": 12, "signal": 9},
+        "runtime": {
+            "model": "llama_tiny",
+            "dataset": "token_file",
+            "dataset_kwargs": {"path": str(corpus)},
+            # No explicit mesh: data=-1 absorbs whatever world size the
+            # controller relaunches at — the elastic-ready layout.
+            "steps": 30,
+            "batch_size": 8,
+            "seq_len": 16,
+            "learning_rate": 1e-3,
+            "log_every": 5,
+            "checkpoint": {"dir": str(ck), "interval": 10},
+        },
+    })
+    assert client.wait_for_phase("autoelastic", timeout=300) == \
+        "Succeeded", client.get("JAXJob", "autoelastic")["status"]
+
+    status = client.get("JAXJob", "autoelastic")["status"]
+    assert status["effectiveReplicas"] == 1
+    reasons = [c["reason"] for c in status["conditions"]]
+    assert "ElasticDownsize" in reasons
+    logs = client.logs("autoelastic", 0, max_bytes=1 << 20)
+    assert '"restored"' in logs  # resumed from the step-10 checkpoint
+    assert client.metrics()["elastic_resizes"] >= 1
+
+
+def test_elastic_heartbeat_detects_hung_worker(controlplane):
+    """Failure detection for workers that wedge without exiting: a worker
+    silent past elastic.heartbeat_timeout_s is killed by the controller
+    and the normal gang-failure path takes over."""
+    client, sock, workdir, tmp = controlplane
+    client.submit_jaxjob("hung", {
+        "replicas": 1,
+        "devices_per_proc": 1,
+        "restart_policy": "Never",
+        "elastic": {"min": 1, "heartbeat_timeout_s": 2},
+        "command": ["/bin/sh", "-c", "sleep 600"],
+    })
+    assert client.wait_for_phase("hung", timeout=90) == "Failed", \
+        client.get("JAXJob", "hung")["status"]
+    reasons = [c["reason"]
+               for c in client.get("JAXJob", "hung")["status"]["conditions"]]
+    assert "HeartbeatTimeout" in reasons
