@@ -302,13 +302,6 @@ class GenerationEngine:
             raise ValueError(
                 f"tensor parallelism {tp} must divide num_kv_heads "
                 f"{cfg.num_kv_heads} (KV heads shard over the tensor axis)")
-        from kubeflow_tpu.serve.quant import Int8Leaf
-        if any(isinstance(leaf, Int8Leaf) for leaf in jax.tree.leaves(
-                params, is_leaf=lambda x: isinstance(x, Int8Leaf))):
-            raise NotImplementedError(
-                "int8 weight-only quantization does not compose with "
-                "tensor-parallel serving yet — serve int8 single-device "
-                "or bf16 tensor-parallel")
         with mesh, nn.logical_axis_rules(self._rules):
             abstract = jax.eval_shape(
                 lambda r: self.model.init(
@@ -322,7 +315,29 @@ class GenerationEngine:
                                   self._rules))
         # Callers hand over boxed (fresh init) or plain (orbax-restored)
         # trees; shardings are derived unboxed, so normalize first.
-        return jax.device_put(nn.meta.unbox(params), shardings)
+        from jax.sharding import PartitionSpec
+
+        from kubeflow_tpu.serve.quant import Int8Leaf
+
+        def put(leaf, sh):
+            if isinstance(leaf, Int8Leaf):
+                # int8 x TP: the int8 payload shards exactly like the
+                # weight it replaces; the fp32 per-output-channel scales
+                # keep the weight's spec on their >1 dims (the size-1
+                # contraction dims cannot shard, and the dequantize
+                # broadcast needs the scale co-resident with its shard).
+                spec = list(sh.spec) + [None] * (leaf.q.ndim - len(sh.spec))
+                sspec = [ax if d > 1 else None
+                         for ax, d in zip(spec, leaf.scale.shape)]
+                return Int8Leaf(
+                    jax.device_put(leaf.q, sh),
+                    jax.device_put(
+                        leaf.scale,
+                        NamedSharding(mesh, PartitionSpec(*sspec))))
+            return jax.device_put(leaf, sh)
+
+        return jax.tree.map(put, nn.meta.unbox(params), shardings,
+                            is_leaf=lambda x: isinstance(x, Int8Leaf))
 
     def _scope(self):
         """Mesh + logical-rules context for tracing/compiling — a no-op

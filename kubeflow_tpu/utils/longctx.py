@@ -1,0 +1,172 @@
+"""Long-context evidence for the single-chip bench model (SURVEY.md §5.7).
+
+VERDICT r3 item 4: the long-context stack (chunked fused CE, flash
+attention, packed masks) claims to ADMIT sequences the naive path cannot,
+but the chip had only ever run s1024 (PROFILE.md §3 tried b8 s2048 and
+OOM'd — the wrong batch for the claim). This module produces the evidence
+both ways:
+
+  * `analyze_fit(batch, seq)` — AOT-compile the REAL bench train step
+    (llama_1b, chunked CE, full-block remat, adamw bf16-mu) on one
+    virtual device and read `memory_analysis()`: the per-device working
+    set vs the v5e 16 GiB HBM budget. Runs anywhere, chip or not — the
+    same pre-flight arithmetic the 8B scale proof uses
+    (utils/scaleproof.py).
+  * `measure(batch, seq)` — the measured row (tok/s + MFU) on the live
+    backend; `bench.py --longctx` runs it on the chip and falls back to
+    the fit analysis (explicitly labeled) when the backend is down.
+
+Chunked CE is what makes s>=2048 admissible at all here: the full-CE
+fp32 logits buffer is B*S*V*4 bytes (b2 s2048 * 32768 vocab = 0.5 GiB
+for ONE residency, and XLA keeps fwd+bwd copies), while the chunked path
+peaks at B*chunk*V.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+V5E_HBM_BYTES = 16 * 1024**3
+GIB = 1024**3
+
+#: (batch, seq) points for the fit sweep; smallest-batch long-sequence
+#: first — these back the "long-context-capable" claim, not throughput.
+FIT_CASES = ((1, 2048), (2, 2048), (4, 2048), (1, 4096), (2, 4096),
+             (1, 8192))
+
+
+def _build(batch: int, seq: int, loss_impl: str = "chunked",
+           size: str = "1b"):
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from kubeflow_tpu.models.llama import Llama, llama_1b, llama_tiny
+    from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+    from kubeflow_tpu.parallel.sharding import DEFAULT_RULES
+    from kubeflow_tpu.train.step import abstract_train_state, make_train_step
+
+    # Force the flash kernel: `auto` falls back to naive off-TPU, whose
+    # materialized [B,H,S,S] scores would inflate the measured temp memory
+    # with buffers the TPU deployment never allocates (same rationale as
+    # scaleproof's 8B cases). `size="tiny"` is the harness-pinning test
+    # shape (tests/test_longctx.py).
+    base = llama_1b() if size == "1b" else llama_tiny()
+    cfg = dataclasses.replace(base, attention_impl="flash")
+    model = Llama(cfg)
+    mesh = build_mesh(MeshConfig(data=1), jax.devices()[:1])
+    tx = optax.adamw(3e-4, mu_dtype=jnp.bfloat16)
+    _, abstract, shardings = abstract_train_state(
+        model, tx, (jnp.zeros((1, 8), jnp.int32),), mesh, DEFAULT_RULES)
+    state_args = jax.tree.map(
+        lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+        abstract, shardings)
+    batch_args = {
+        "inputs": jax.ShapeDtypeStruct((batch, seq), jnp.int32),
+        "targets": jax.ShapeDtypeStruct((batch, seq), jnp.int32),
+    }
+    step = make_train_step(model, mesh, DEFAULT_RULES, loss_impl=loss_impl,
+                           loss_chunk=1024)
+    return cfg, model, mesh, tx, step, state_args, batch_args
+
+
+def analyze_fit(batch: int, seq: int, loss_impl: str = "chunked",
+                size: str = "1b") -> dict:
+    """AOT compile + memory_analysis for one (batch, seq) point, against
+    the v5e HBM budget (scaleproof's shared fit arithmetic)."""
+    from kubeflow_tpu.utils.scaleproof import _mem_report
+
+    cfg, _, mesh, _, step, state_args, batch_args = _build(
+        batch, seq, loss_impl, size)
+    with mesh:
+        compiled = step.jitted.lower(state_args, batch_args).compile()
+    report = _mem_report(compiled, hbm_bytes=V5E_HBM_BYTES, chip="v5e")
+    report.update({
+        "batch": batch,
+        "seq_len": seq,
+        "loss_impl": loss_impl,
+        "model_params": cfg.num_params,
+    })
+    return report
+
+
+def analyze_fit_subprocess(batch: int, seq: int,
+                           loss_impl: str = "chunked",
+                           timeout_s: float = 1800.0) -> dict:
+    """Run the fit analysis in a fresh single-device CPU interpreter
+    (backends can't be reconfigured after init — scaleproof pattern)."""
+    from kubeflow_tpu.utils.reexec import cpu_reexec_env
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env = cpu_reexec_env(1, repo=repo)
+    code = (
+        "import json\n"
+        "from kubeflow_tpu.utils import longctx\n"
+        f"r = longctx.analyze_fit({batch}, {seq}, {loss_impl!r})\n"
+        "print('LONGCTX_JSON:' + json.dumps(r))\n")
+    proc = subprocess.run([sys.executable, "-c", code], env=env, cwd=repo,
+                          capture_output=True, text=True, timeout=timeout_s)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"longctx fit b{batch} s{seq} failed rc={proc.returncode}:\n"
+            f"{proc.stderr[-3000:]}")
+    for line in proc.stdout.splitlines():
+        if line.startswith("LONGCTX_JSON:"):
+            return json.loads(line[len("LONGCTX_JSON:"):])
+    raise RuntimeError("longctx: no result line")
+
+
+def measure(batch: int, seq: int, timed_steps: int = 6,
+            loss_impl: str = "chunked", size: str = "1b") -> dict:
+    """Measured tok/s + MFU at (batch, seq) on the live backend — the
+    PROFILE.md §6 row. Pipelined timing, single fetch at the end (the
+    axon tunnel adds ~66 ms to every synchronous host fetch)."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubeflow_tpu.parallel.sharding import DEFAULT_RULES
+    from kubeflow_tpu.train.metrics import peak_flops_per_chip
+    from kubeflow_tpu.train.step import init_train_state
+
+    cfg, model, mesh, tx, step, _, _ = _build(batch, seq, loss_impl, size)
+    tokens = jnp.zeros((batch, seq), jnp.int32)
+    state = init_train_state(model, tx, jax.random.key(0), (tokens,), mesh,
+                             DEFAULT_RULES)
+    rng = np.random.default_rng(0)
+
+    def make_batch():
+        return {
+            "inputs": rng.integers(0, cfg.vocab_size, (batch, seq),
+                                   dtype=np.int32),
+            "targets": rng.integers(0, cfg.vocab_size, (batch, seq),
+                                    dtype=np.int32),
+        }
+
+    for _ in range(3):  # compile + steady-state warmup
+        state, metrics = step(state, make_batch())
+        float(metrics["loss"])
+    batches = [make_batch() for _ in range(timed_steps)]
+    t0 = time.perf_counter()
+    for b in batches:
+        state, metrics = step(state, b)
+    float(metrics["loss"])  # force completion of the chain
+    dt = (time.perf_counter() - t0) / timed_steps
+    mfu = 6 * cfg.num_params * batch * seq / dt / peak_flops_per_chip()
+    return {
+        "batch": batch,
+        "seq_len": seq,
+        "loss_impl": loss_impl,
+        "tok_s": round(batch * seq / dt, 1),
+        "mfu": round(mfu, 4),
+        "avg_step_time_s": round(dt, 4),
+        "device_kind": jax.devices()[0].device_kind,
+    }

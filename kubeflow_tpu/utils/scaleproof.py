@@ -58,7 +58,11 @@ _CASE_DEVICES = {
 }
 
 
-def _mem_report(compiled) -> dict:
+def _mem_report(compiled, *, hbm_bytes: int = V5P_HBM_BYTES,
+                chip: str = "v5p") -> dict:
+    """memory_analysis() → conservative per-device fit report. The ONE
+    copy of this arithmetic — the long-context analysis
+    (utils/longctx.py) consumes it with the v5e budget."""
     ma = compiled.memory_analysis()
     args = int(ma.argument_size_in_bytes)
     temp = int(ma.temp_size_in_bytes)
@@ -77,8 +81,8 @@ def _mem_report(compiled) -> dict:
         "peak_memory_bytes": int(ma.peak_memory_in_bytes),
         "total_conservative_bytes": total,
         "total_conservative_gib": round(total / GIB, 2),
-        "fits_v5p_hbm": total <= V5P_HBM_BYTES,
-        "hbm_budget_gib": round(V5P_HBM_BYTES / GIB, 2),
+        f"fits_{chip}_hbm": total <= hbm_bytes,
+        "hbm_budget_gib": round(hbm_bytes / GIB, 2),
     }
 
 

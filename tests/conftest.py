@@ -22,6 +22,14 @@ jax.config.update("jax_debug_nans", False)
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-process / e2e / AOT-compile tests. The default "
+        "iteration tier is `pytest -m 'not slow'`; CI and round-end runs "
+        "use the full suite (see README Testing).")
+
+
 @pytest.fixture(scope="session")
 def devices8():
     devs = jax.devices()

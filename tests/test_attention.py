@@ -234,6 +234,7 @@ def test_zigzag_step_time_vs_contiguous(devices8):
     assert t_zz < t_ring * 1.5  # loose: zigzag must not regress badly
 
 
+@pytest.mark.slow  # heaviest representative; full tier covers it
 def test_zigzag_training_matches_ring(devices8, tmp_path):
     """End-to-end training parity: the trainer's zigzag contract (permuted
     batches + matching RoPE positions) trains like the standard ring
@@ -380,6 +381,7 @@ def test_zigzag_flash_matches_naive(devices8):
                                rtol=2e-3, atol=2e-3)
 
 
+@pytest.mark.slow  # heaviest representative; full tier covers it
 def test_zigzag_flash_grads(devices8):
     q, k, v = _qkv(s=64, seed=9)
     mesh = build_mesh(MeshConfig(seq=4), devices8[:4])

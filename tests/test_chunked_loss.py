@@ -65,6 +65,7 @@ def test_gradients_match_full():
                                    rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow  # heaviest representative; full tier covers it
 def test_train_step_chunked_matches_full(devices8):
     """Whole-step equivalence on the sharded mesh: starting from the same
     state, one chunked-loss step lands on the same loss/grad-norm as the

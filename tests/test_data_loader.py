@@ -84,6 +84,7 @@ def test_too_small_corpus_raises():
                           seq_len=16, process_index=0, process_count=1)
 
 
+@pytest.mark.slow  # heaviest representative; full tier covers it
 def test_trainer_resume_continues_exact_stream(tmp_path):
     """Kill-resume through the Trainer: a run checkpointed at step 3 and
     resumed to 6 ends bit-identical to an uninterrupted 6-step run — the

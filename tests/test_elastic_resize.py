@@ -9,6 +9,8 @@ import pytest
 
 from kubeflow_tpu.train.trainer import Trainer, TrainJobSpec
 
+pytestmark = pytest.mark.slow  # multi-process/e2e/AOT tier
+
 
 def _spec(steps, ckdir, mesh, path):
     return TrainJobSpec(

@@ -54,6 +54,7 @@ def test_cache_decode_matches_full_forward(tiny):
                                    rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow  # heaviest representative; full tier covers it
 def test_engine_continuous_batching_matches_reference(tiny):
     """3 concurrent requests on 2 slots (third waits for a free slot);
     greedy outputs must equal the uncached rollout per request —
@@ -236,6 +237,7 @@ def test_engine_top_p_requests(tiny):
         eng.close()
 
 
+@pytest.mark.slow  # heaviest representative; full tier covers it
 def test_chunked_prefill_long_prompt_matches_reference(tiny):
     """A prompt LONGER than the largest prefill bucket admits via chunked
     continuation prefill (no silent truncation) and greedy-decodes exactly
@@ -255,6 +257,7 @@ def test_chunked_prefill_long_prompt_matches_reference(tiny):
         engine.close()
 
 
+@pytest.mark.slow  # heaviest representative; full tier covers it
 def test_chunked_prefill_matches_single_bucket(tiny):
     """Same prompt through chunked (small-bucket) and single-shot
     (large-bucket) admission produces identical greedy output."""
@@ -275,6 +278,7 @@ def test_chunked_prefill_matches_single_bucket(tiny):
     assert outs["chunked"] == outs["single"]
 
 
+@pytest.mark.slow  # heaviest representative; full tier covers it
 def test_chunked_prefill_bucket_overrun_no_corruption(tiny):
     """Regression: the FINAL chunk's bucket padding may extend past
     max_len; the fragment-cache headroom must absorb it (a clamped

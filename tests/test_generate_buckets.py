@@ -12,6 +12,8 @@ from kubeflow_tpu.models.llama import Llama, llama_tiny
 from kubeflow_tpu.serve.generation import GenerationEngine
 from tests.test_generate import ref_greedy
 
+pytestmark = pytest.mark.slow  # multi-process/e2e/AOT tier
+
 CFG = dataclasses.replace(llama_tiny(), dtype=jnp.float32, num_layers=2)
 
 

@@ -14,6 +14,8 @@ from kubeflow_tpu.models.llama import Llama, LlamaConfig, llama_tiny
 from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
 from kubeflow_tpu.serve.generation import GenerationEngine
 
+pytestmark = pytest.mark.slow  # multi-process/e2e/AOT tier
+
 # fp32 everywhere so cross-device reduction order cannot flip an argmax;
 # 8 KV heads so the cache shards cleanly over tensor=8.
 CFG = LlamaConfig(
@@ -97,14 +99,33 @@ def test_tp_requires_divisible_kv_heads(devices8):
         GenerationEngine(model, params, cfg, **ENGINE_KW, mesh=mesh)
 
 
-def test_tp_refuses_int8(model_and_params, devices8):
-    from kubeflow_tpu.serve.quant import quantize_tree
+def test_tp_int8_decode_matches_single_device(model_and_params, devices8):
+    """int8 weight-only quantization composes with TP: the int8 payload
+    shards like the weight, scales ride their >1 dims, and dequantize
+    stays a local elementwise op — TP int8 decode is token-identical to
+    single-device int8 decode."""
+    from kubeflow_tpu.serve.quant import QuantizedModule, quantize_tree
 
     model, params = model_and_params
-    mesh = build_mesh(MeshConfig(data=1, tensor=2), devices8[:2])
-    with pytest.raises(NotImplementedError, match="int8"):
-        GenerationEngine(model, quantize_tree(params), CFG, **ENGINE_KW,
-                         mesh=mesh)
+    qmodel = QuantizedModule(model, CFG.dtype)
+    qparams = quantize_tree(params)
+    prompts = [[5, 9, 2], [17, 3, 8, 1, 30]]
+
+    ref = GenerationEngine(qmodel, qparams, CFG, **ENGINE_KW, seed=0)
+    try:
+        want = _generate_all(ref, prompts, max_tokens=6)
+    finally:
+        ref.close()
+
+    mesh = build_mesh(MeshConfig(data=1, tensor=4), devices8[:4])
+    tp = GenerationEngine(qmodel, qparams, CFG, **ENGINE_KW, seed=0,
+                          mesh=mesh)
+    try:
+        got = _generate_all(tp, prompts, max_tokens=6)
+    finally:
+        tp.close()
+    for w, g in zip(want, got):
+        assert g["output_ids"] == w["output_ids"]
 
 
 def test_load_model_mesh_override(tmp_path, devices8):

@@ -20,6 +20,8 @@ transformers = pytest.importorskip("transformers")
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 
+pytestmark = pytest.mark.slow  # multi-process/e2e/AOT tier
+
 
 @pytest.fixture(scope="module")
 def hf_llama_dir(tmp_path_factory):

@@ -17,6 +17,8 @@ from kubeflow_tpu.models.llama_pp import pipeline_forward
 from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
 from kubeflow_tpu.train.step import cross_entropy_loss
 
+pytestmark = pytest.mark.slow  # multi-process/e2e/AOT tier
+
 
 def _cfg(fp32=True, layers=4):
     cfg = dataclasses.replace(

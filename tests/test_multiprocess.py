@@ -6,10 +6,13 @@ shape), and cross-process context parallelism (the ring's ppermute rides
 the process boundary — the ICI/DCN path on real hardware)."""
 
 import json
+import pytest
 import os
 import socket
 import subprocess
 import sys
+
+pytestmark = pytest.mark.slow  # multi-process/e2e/AOT tier
 
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

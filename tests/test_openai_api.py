@@ -14,6 +14,8 @@ import pytest
 from kubeflow_tpu.models.llama import Llama, llama_tiny
 from kubeflow_tpu.serve.generation import GenerativeJAXModel
 
+pytestmark = pytest.mark.slow  # multi-process/e2e/AOT tier
+
 CFG = dataclasses.replace(llama_tiny(), dtype=jnp.float32, num_layers=2)
 
 

@@ -14,8 +14,11 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BIN = os.path.join(REPO, "build", "tpk-controlplane")
 
-pytestmark = pytest.mark.skipif(
-    not os.path.exists(BIN), reason="tpk-controlplane not built")
+pytestmark = [
+    pytest.mark.slow,  # multi-process/e2e tier
+    pytest.mark.skipif(not os.path.exists(BIN),
+                       reason="tpk-controlplane not built"),
+]
 
 
 @pytest.fixture()

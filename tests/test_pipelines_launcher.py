@@ -14,6 +14,8 @@ from kubeflow_tpu.pipelines import (
 )
 from kubeflow_tpu.pipelines.launcher import LauncherError, run_task
 
+pytestmark = pytest.mark.slow  # multi-process/e2e/AOT tier
+
 
 @component
 def writer(out: OutputArtifact, text: str = "hello", n: int = 2):

@@ -13,6 +13,8 @@ import pytest
 
 from kubeflow_tpu.utils import scaleproof
 
+pytestmark = pytest.mark.slow  # multi-process/e2e/AOT tier
+
 
 @pytest.mark.parametrize("case", ["train_8b_v5p8", "train_8b_v5p8_long"])
 def test_train_8b_fits_v5p(devices8, case):

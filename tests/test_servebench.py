@@ -3,8 +3,11 @@ quick/tiny shape must produce every artifact section with sane values, so
 the chip run (`bench.py --serve` → SERVEBENCH.json) can't silently rot."""
 
 import numpy as np
+import pytest
 
 from kubeflow_tpu.serve.bench import run_servebench
+
+pytestmark = pytest.mark.slow  # multi-process/e2e/AOT tier
 
 
 def test_servebench_quick_shape():

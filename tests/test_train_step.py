@@ -36,6 +36,7 @@ def test_llama_init_shards_params(devices8):
     assert emb.sharding.spec == P("tensor", "fsdp")
 
 
+@pytest.mark.slow  # heaviest representative; full tier covers it
 def test_llama_train_step_runs_and_improves(devices8):
     mesh = build_mesh(MeshConfig(data=2, fsdp=2, tensor=2), devices8)
     cfg = llama_tiny(vocab=64)
@@ -95,6 +96,7 @@ def test_fsdp_only_sharding(devices8):
     assert tuple(gate.sharding.spec) == (None, "fsdp", None)
 
 
+@pytest.mark.slow  # heaviest representative; full tier covers it
 def test_packed_sequence_batch(devices8):
     """A batch carrying segment_ids + per-segment positions trains through
     the standard step — packed-sequence training end to end."""

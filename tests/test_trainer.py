@@ -7,6 +7,8 @@ import pytest
 
 from kubeflow_tpu.train.trainer import TrainJobSpec, Trainer
 
+pytestmark = pytest.mark.slow  # multi-process/e2e/AOT tier
+
 
 def test_spec_roundtrip():
     spec = TrainJobSpec(model="llama_tiny", steps=5, mesh={"data": 2})

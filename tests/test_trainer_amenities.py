@@ -9,6 +9,8 @@ import pytest
 
 from kubeflow_tpu.train.trainer import TrainJobSpec, Trainer
 
+pytestmark = pytest.mark.slow  # multi-process/e2e/AOT tier
+
 
 def _base(tmp_path, **over):
     base = dict(model="llama_tiny", dataset="learnable_lm",
