@@ -57,7 +57,12 @@ def _build(batch: int, seq: int, loss_impl: str = "chunked",
     # scaleproof's 8B cases). `size="tiny"` is the harness-pinning test
     # shape (tests/test_longctx.py).
     base = llama_1b() if size == "1b" else llama_tiny()
-    cfg = dataclasses.replace(base, attention_impl="flash")
+    # max_seq_len sizes the RoPE table; llama_1b pins 2048, and positions
+    # past the table would silently CLAMP under jit (same rotary phase for
+    # every tail token) — the long-context evidence must model the config
+    # a real s-length deployment would run.
+    cfg = dataclasses.replace(base, attention_impl="flash",
+                              max_seq_len=max(seq, base.max_seq_len))
     model = Llama(cfg)
     mesh = build_mesh(MeshConfig(data=1), jax.devices()[:1])
     tx = optax.adamw(3e-4, mu_dtype=jnp.bfloat16)
