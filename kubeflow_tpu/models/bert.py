@@ -38,8 +38,29 @@ class BertConfig:
     # dense+tanh entirely — an identity-kernel pooler would still apply
     # tanh and silently deviate from the source model's logits.
     use_pooler: bool = True
+    # Serving task — the reference's huggingfaceserver task surface
+    # (SURVEY.md §2.2 ⟨kserve: python/huggingfaceserver⟩ supports
+    # sequence_classification / token_classification / fill_mask /
+    # embedding for encoder checkpoints). Selects the head:
+    #   sequence_classification → pooled logits [B, num_labels]
+    #   token_classification    → per-token logits [B, S, num_labels]
+    #   fill_mask               → MLM logits [B, S, vocab] (tied decoder)
+    #   embedding               → masked-mean L2-normalized [B, H]
+    task: str = "sequence_classification"
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
+
+
+def _activation(cfg: BertConfig, h):
+    """The checkpoint's hidden activation — shared by the encoder FFN and
+    the MLM transform so the two can never drift."""
+    if cfg.hidden_act == "gelu":  # exact erf GELU (BERT canonical)
+        return nn.gelu(h, approximate=False)
+    if cfg.hidden_act in ("gelu_new", "gelu_pytorch_tanh"):
+        return nn.gelu(h, approximate=True)
+    if cfg.hidden_act == "relu":
+        return nn.relu(h)
+    raise ValueError(f"unsupported hidden_act {cfg.hidden_act!r}")
 
 
 def bert_base(num_labels: int = 2) -> BertConfig:
@@ -86,14 +107,7 @@ class EncoderLayer(nn.Module):
                   kernel_init=nn.with_logical_partitioning(
                       nn.initializers.lecun_normal(), ("embed", "mlp")),
                   name="ffn_in")(x)
-        if cfg.hidden_act == "gelu":  # exact erf GELU (BERT canonical)
-            h = nn.gelu(h, approximate=False)
-        elif cfg.hidden_act in ("gelu_new", "gelu_pytorch_tanh"):
-            h = nn.gelu(h, approximate=True)
-        elif cfg.hidden_act == "relu":
-            h = nn.relu(h)
-        else:
-            raise ValueError(f"unsupported hidden_act {cfg.hidden_act!r}")
+        h = _activation(cfg, h)
         h = dense(features=cfg.hidden_size,
                   kernel_init=nn.with_logical_partitioning(
                       nn.initializers.lecun_normal(), ("mlp", "embed")),
@@ -103,7 +117,9 @@ class EncoderLayer(nn.Module):
 
 
 class Bert(nn.Module):
-    """Returns (sequence_output [B,S,H], pooled_logits [B, num_labels])."""
+    """Returns (sequence_output [B,S,H], head_output) — the head depends on
+    cfg.task (see BertConfig.task); the default sequence_classification
+    head yields pooled logits [B, num_labels]."""
 
     cfg: BertConfig
 
@@ -131,6 +147,53 @@ class Bert(nn.Module):
                          name="ln_embed")(x.astype(cfg.dtype))
         for i in range(cfg.num_layers):
             x = EncoderLayer(cfg, name=f"layer_{i}")(x, attention_mask)
+
+        if cfg.task == "token_classification":
+            # Per-token head: same classifier params as HF's
+            # BertForTokenClassification (Dense over every position).
+            logits = nn.Dense(
+                cfg.num_labels, dtype=jnp.float32,
+                param_dtype=cfg.param_dtype,
+                kernel_init=nn.with_logical_partitioning(
+                    nn.initializers.lecun_normal(), ("embed", "vocab")),
+                name="classifier")(x)
+            return x, logits
+
+        if cfg.task == "fill_mask":
+            # BertOnlyMLMHead: transform (dense+act+LN), then a decoder
+            # TIED to word_embeddings plus a free output bias — the tie is
+            # structural (same param), so a quantized or updated embedding
+            # stays consistent with the decoder.
+            h = nn.Dense(
+                cfg.hidden_size, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                kernel_init=nn.with_logical_partitioning(
+                    nn.initializers.lecun_normal(), ("embed", "embed2")),
+                name="mlm_transform")(x)
+            h = _activation(cfg, h)
+            h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                             name="mlm_ln")(h)
+            bias = self.param("mlm_bias", nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), ("vocab",)),
+                (cfg.vocab_size,), cfg.param_dtype)
+            logits = (jnp.einsum("bsh,vh->bsv", h,
+                                 emb.astype(cfg.dtype)).astype(jnp.float32)
+                      + bias)
+            return x, logits
+
+        if cfg.task == "embedding":
+            # Sentence-embedding head: attention-masked mean pooling over
+            # the sequence output, L2-normalized (the sentence-transformers
+            # convention the reference's embedding task follows). Computed
+            # in fp32 — the norm of a bf16 sum drifts visibly at S=512.
+            m = attention_mask[..., None].astype(jnp.float32)
+            xf = x.astype(jnp.float32)
+            pooled = (xf * m).sum(axis=1) / jnp.maximum(m.sum(axis=1), 1e-9)
+            normed = pooled / jnp.maximum(
+                jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-12)
+            return x, normed
+
+        if cfg.task != "sequence_classification":
+            raise ValueError(f"unknown task {cfg.task!r}")
         if cfg.use_pooler:
             pooled = nn.tanh(nn.Dense(
                 cfg.hidden_size, dtype=cfg.dtype,

@@ -102,9 +102,14 @@ def llama_config_from_hf(hf: dict, **overrides: Any) -> LlamaConfig:
                 rope_scaling_original_max_len=int(
                     scaling.get("original_max_position_embeddings", 8192)))
     if hf.get("sliding_window"):
-        raise ValueError(
-            "sliding-window attention (Mistral-style) is not implemented; "
-            "refusing to import — full attention would change the logits")
+        # Mistral-style windowed attention maps onto the flash kernel's
+        # banded MaskSpec (ops/flash_attention.py kind="sliding_window" —
+        # blocks beyond the band are skipped, not masked). The serving
+        # engine separately enforces max_len <= window, where windowed and
+        # causal decode are identical (serve/generation.py).
+        fields.update(mask_kind="sliding_window",
+                      mask_window=int(hf["sliding_window"]),
+                      attention_impl="flash")
     fields.update(overrides)
     return LlamaConfig(**fields)
 
@@ -191,6 +196,31 @@ def import_llama(path: str, *, scan_layers: bool = True,
 # BERT
 # ---------------------------------------------------------------------------
 
+def _bert_task_from_arch(hf: dict) -> str:
+    """HF `architectures` → serving task (the huggingfaceserver task
+    surface): ForSequenceClassification / ForTokenClassification /
+    ForMaskedLM / bare BertModel → embedding. Head architectures with no
+    implemented head (QuestionAnswering, MultipleChoice, ...) refuse —
+    their classifier params would be silently misapplied as a
+    sequence-classification head."""
+    arch = (hf.get("architectures") or [""])[0]
+    if "TokenClassification" in arch:
+        return "token_classification"
+    if "MaskedLM" in arch or "PreTraining" in arch:
+        return "fill_mask"
+    if "SequenceClassification" in arch:
+        return "sequence_classification"
+    if arch in ("BertModel", ""):
+        # Bare encoder export — serve sentence embeddings. (HF configs
+        # carry a default id2label even here, so arch is the only
+        # trustworthy signal.)
+        return "embedding"
+    raise ValueError(
+        f"unsupported BERT head architecture {arch!r}; implemented tasks: "
+        "sequence_classification, token_classification, fill_mask, "
+        "embedding (pass model_overrides={'task': ...} to force one)")
+
+
 def bert_config_from_hf(hf: dict, **overrides: Any) -> BertConfig:
     pet = hf.get("position_embedding_type", "absolute")
     if pet != "absolute":
@@ -201,6 +231,7 @@ def bert_config_from_hf(hf: dict, **overrides: Any) -> BertConfig:
     if act not in ("gelu", "gelu_new", "gelu_pytorch_tanh", "relu"):
         raise ValueError(f"unsupported hidden_act {act!r}")
     fields = dict(
+        task=_bert_task_from_arch(hf),
         hidden_act=act,
         vocab_size=hf["vocab_size"],
         hidden_size=hf["hidden_size"],
@@ -218,12 +249,19 @@ def bert_config_from_hf(hf: dict, **overrides: Any) -> BertConfig:
 
 def import_bert(path: str, *, allow_headless: bool = False,
                 **config_overrides: Any) -> tuple[BertConfig, dict]:
-    """HF BertForSequenceClassification checkpoint dir → (BertConfig,
-    flax params) matching `Bert(cfg).init(...)`.
+    """HF BERT checkpoint dir → (BertConfig, flax params) matching
+    `Bert(cfg).init(...)`, with the serving task dispatched from the
+    checkpoint's `architectures` (see _bert_task_from_arch): sequence /
+    token classification heads, the tied MLM head, or the parameter-free
+    embedding pooling for bare encoders.
 
-    A headless encoder export (no classifier.weight) raises unless
-    `allow_headless=True` — zero-init heads are only meaningful when the
-    caller is about to fine-tune them, never for serving."""
+    `allow_headless` applies to the sequence_classification task only: a
+    classification import with no classifier.weight raises unless
+    `allow_headless=True` (zero-init heads are only meaningful when the
+    caller is about to fine-tune them, never for serving). To fine-tune a
+    fresh head on a bare BertModel export — which now imports as
+    task='embedding' — pass task='sequence_classification' (plus
+    num_labels) together with allow_headless=True."""
     hf = read_hf_config(path)
     cfg = bert_config_from_hf(hf, **config_overrides)
     t = load_safetensors_dir(path)
@@ -265,31 +303,56 @@ def import_bert(path: str, *, allow_headless: bool = False,
                         "bias": t[lp + "output.dense.bias"]},
             "ln_ffn": ln(lp + "output.LayerNorm"),
         }
-    # Headless = no classifier. A missing pooler alone is NOT headless:
-    # pooler-free classification exports exist and serve correctly with
-    # use_pooler=False below (classifier on the raw [CLS] state).
-    headless = "classifier.weight" not in t
-    if headless and not allow_headless:
-        raise KeyError(
-            "checkpoint has no classification head (classifier.weight) — "
-            "serving it would return constant zero logits; pass "
-            "allow_headless=True only to fine-tune a fresh head")
-    if pre + "pooler.dense.weight" in t:
-        params["pooler"] = {"kernel": lin(t[pre + "pooler.dense.weight"]),
-                            "bias": t[pre + "pooler.dense.bias"]}
-    else:
-        # Pooler-free checkpoint: the classifier (existing or fresh)
-        # consumes the RAW [CLS] hidden state — skip the pooler module
-        # entirely (an identity kernel would still tanh and deviate from
-        # the source model's logits).
-        cfg = dataclasses.replace(cfg, use_pooler=False)
-    if "classifier.weight" in t:
+    if cfg.task == "fill_mask":
+        # BertOnlyMLMHead: cls.predictions.{transform.dense, transform.
+        # LayerNorm, bias}; the decoder weight is TIED to word_embeddings
+        # in the flax module (structural tie), so only the free bias and
+        # transform are imported. An untied decoder (rare) would silently
+        # deviate — refuse it.
+        dec = "cls.predictions.decoder.weight"
+        if dec in t and not np.array_equal(
+                t[dec], t[pre + "embeddings.word_embeddings.weight"]):
+            raise ValueError(
+                "MaskedLM checkpoint has an UNTIED decoder weight; the "
+                "flax MLM head ties the decoder to word_embeddings")
+        params["mlm_transform"] = {
+            "kernel": lin(t["cls.predictions.transform.dense.weight"]),
+            "bias": t["cls.predictions.transform.dense.bias"]}
+        params["mlm_ln"] = ln("cls.predictions.transform.LayerNorm")
+        params["mlm_bias"] = t["cls.predictions.bias"]
+    elif cfg.task == "token_classification":
+        # Dense over every position; HF stores [num_labels, H].
         params["classifier"] = {"kernel": lin(t["classifier.weight"]),
                                 "bias": t["classifier.bias"]}
+    elif cfg.task == "embedding":
+        pass  # pooling head has no parameters
     else:
-        params["classifier"] = {
-            "kernel": np.zeros((h, cfg.num_labels), pd),
-            "bias": np.zeros((cfg.num_labels,), pd)}
+        # Headless = no classifier. A missing pooler alone is NOT headless:
+        # pooler-free classification exports exist and serve correctly with
+        # use_pooler=False below (classifier on the raw [CLS] state).
+        headless = "classifier.weight" not in t
+        if headless and not allow_headless:
+            raise KeyError(
+                "checkpoint has no classification head (classifier.weight)"
+                " — serving it would return constant zero logits; pass "
+                "allow_headless=True only to fine-tune a fresh head")
+        if pre + "pooler.dense.weight" in t:
+            params["pooler"] = {
+                "kernel": lin(t[pre + "pooler.dense.weight"]),
+                "bias": t[pre + "pooler.dense.bias"]}
+        else:
+            # Pooler-free checkpoint: the classifier (existing or fresh)
+            # consumes the RAW [CLS] hidden state — skip the pooler module
+            # entirely (an identity kernel would still tanh and deviate
+            # from the source model's logits).
+            cfg = dataclasses.replace(cfg, use_pooler=False)
+        if "classifier.weight" in t:
+            params["classifier"] = {"kernel": lin(t["classifier.weight"]),
+                                    "bias": t["classifier.bias"]}
+        else:
+            params["classifier"] = {
+                "kernel": np.zeros((h, cfg.num_labels), pd),
+                "bias": np.zeros((cfg.num_labels,), pd)}
     params = jax.tree.map(lambda x: jnp.asarray(np.asarray(x, pd)), params)
     return cfg, params
 

@@ -1,0 +1,155 @@
+"""BERT serving tasks beyond sequence classification: numerics vs torch.
+
+The reference's huggingfaceserver task surface (SURVEY.md §2.2
+⟨kserve: python/huggingfaceserver⟩) covers token_classification,
+fill_mask, and embedding for encoder checkpoints; each head here is
+checked against the real `transformers` modeling code on the same tokens.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+pytestmark = pytest.mark.slow  # torch-reference tier
+
+
+def _save(model, d):
+    model.eval()
+    model.save_pretrained(d, safe_serialization=True)
+    return str(d)
+
+
+def _bert_cfg(**kw):
+    base = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                num_hidden_layers=2, num_attention_heads=4,
+                max_position_embeddings=64, type_vocab_size=2,
+                hidden_act="gelu", attn_implementation="eager")
+    base.update(kw)
+    return transformers.BertConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def toks():
+    rng = np.random.default_rng(7)
+    t = rng.integers(1, 256, (2, 12), dtype=np.int64)
+    mask = np.ones_like(t)
+    mask[1, 9:] = 0
+    return t, mask
+
+
+def test_token_classification_matches_torch(tmp_path, toks):
+    torch.manual_seed(3)
+    tmodel = transformers.BertForTokenClassification(_bert_cfg(num_labels=5))
+    path = _save(tmodel, tmp_path)
+
+    from kubeflow_tpu.models.bert import Bert
+    from kubeflow_tpu.models.hf_import import import_bert
+
+    cfg, params = import_bert(path, dtype=jnp.float32)
+    assert cfg.task == "token_classification" and cfg.num_labels == 5
+    t, mask = toks
+    with torch.no_grad():
+        ref = tmodel(torch.from_numpy(t),
+                     attention_mask=torch.from_numpy(mask)).logits.numpy()
+    _, got = Bert(cfg).apply({"params": params}, jnp.asarray(t, jnp.int32),
+                             attention_mask=jnp.asarray(mask))
+    assert got.shape == (2, 12, 5)
+    np.testing.assert_allclose(np.asarray(got), ref, atol=3e-4, rtol=2e-3)
+
+
+def test_fill_mask_matches_torch(tmp_path, toks):
+    torch.manual_seed(4)
+    tmodel = transformers.BertForMaskedLM(_bert_cfg())
+    path = _save(tmodel, tmp_path)
+
+    from kubeflow_tpu.models.bert import Bert
+    from kubeflow_tpu.models.hf_import import import_bert
+
+    cfg, params = import_bert(path, dtype=jnp.float32)
+    assert cfg.task == "fill_mask"
+    t, mask = toks
+    with torch.no_grad():
+        ref = tmodel(torch.from_numpy(t),
+                     attention_mask=torch.from_numpy(mask)).logits.numpy()
+    _, got = Bert(cfg).apply({"params": params}, jnp.asarray(t, jnp.int32),
+                             attention_mask=jnp.asarray(mask))
+    assert got.shape == (2, 12, 256)
+    np.testing.assert_allclose(np.asarray(got), ref, atol=5e-4, rtol=2e-3)
+    # The decoder is structurally tied: argmax at an unmasked position
+    # recovers a real vocab distribution, not zeros.
+    assert np.abs(np.asarray(got)).max() > 0.1
+
+
+def test_embedding_matches_torch_mean_pool(tmp_path, toks):
+    torch.manual_seed(5)
+    tmodel = transformers.BertModel(_bert_cfg())
+    path = _save(tmodel, tmp_path)
+
+    from kubeflow_tpu.models.bert import Bert
+    from kubeflow_tpu.models.hf_import import import_bert
+
+    cfg, params = import_bert(path, dtype=jnp.float32)
+    assert cfg.task == "embedding"
+    t, mask = toks
+    with torch.no_grad():
+        hidden = tmodel(torch.from_numpy(t),
+                        attention_mask=torch.from_numpy(mask)
+                        ).last_hidden_state.numpy()
+    m = mask[..., None].astype(np.float32)
+    ref = (hidden * m).sum(1) / np.maximum(m.sum(1), 1e-9)
+    ref = ref / np.maximum(np.linalg.norm(ref, axis=-1, keepdims=True),
+                           1e-12)
+    _, got = Bert(cfg).apply({"params": params}, jnp.asarray(t, jnp.int32),
+                             attention_mask=jnp.asarray(mask))
+    assert got.shape == (2, 64)
+    np.testing.assert_allclose(np.asarray(got), ref, atol=3e-4, rtol=2e-3)
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(got), axis=-1),
+                               1.0, atol=1e-5)
+
+
+def test_untied_mlm_decoder_refused(tmp_path, toks):
+    torch.manual_seed(6)
+    tmodel = transformers.BertForMaskedLM(_bert_cfg(tie_word_embeddings=False))
+    with torch.no_grad():
+        tmodel.cls.predictions.decoder.weight.add_(1.0)  # force divergence
+    path = _save(tmodel, tmp_path)
+
+    from kubeflow_tpu.models.hf_import import import_bert
+
+    with pytest.raises(ValueError, match="UNTIED"):
+        import_bert(path, dtype=jnp.float32)
+
+
+def test_serving_runtime_task_heads(tmp_path, toks):
+    """The huggingface runtime serves the task head's output end to end —
+    a fill-mask bundle returns [B, S, vocab] through load_model/predict."""
+    torch.manual_seed(8)
+    tmodel = transformers.BertForMaskedLM(_bert_cfg())
+    path = _save(tmodel, tmp_path)
+    with open(f"{path}/model.json", "w") as f:
+        json.dump({"format": "huggingface", "name": "bert-mlm",
+                   "seq_len": 12, "batch_buckets": [2],
+                   "model_overrides": {"dtype": "float32"}}, f)
+
+    from kubeflow_tpu.serve.runtimes import load_model
+
+    model = load_model(path)
+    assert model.load()
+    t, mask = toks
+    arr = t.astype(np.int32)
+    arr[mask == 0] = 0  # right-pad with pad_token_id
+    out = model.predict([arr])
+    with torch.no_grad():
+        ref = tmodel(torch.from_numpy(arr.astype(np.int64)),
+                     attention_mask=torch.from_numpy(
+                         (arr != 0).astype(np.int64))).logits.numpy()
+    np.testing.assert_allclose(out[-1], ref, atol=5e-4, rtol=2e-3)

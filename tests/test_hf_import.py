@@ -239,8 +239,10 @@ def test_unsupported_configs_fail_loudly(tmp_path):
     with pytest.raises(ValueError, match="rope_scaling"):
         llama_config_from_hf(dict(base, rope_scaling={
             "rope_type": "yarn", "factor": 4.0}))
-    with pytest.raises(ValueError, match="sliding"):
-        llama_config_from_hf(dict(base, sliding_window=4096))
+    # sliding_window is SUPPORTED since round 4 (banded MaskSpec; see
+    # tests/test_mistral_import.py) — it must map, not refuse.
+    wcfg = llama_config_from_hf(dict(base, sliding_window=4096))
+    assert (wcfg.mask_kind, wcfg.mask_window) == ("sliding_window", 4096)
     with pytest.raises(ValueError, match="position_embedding_type"):
         bert_config_from_hf(dict(base, position_embedding_type="relative_key"))
     with pytest.raises(ValueError, match="hidden_act"):
