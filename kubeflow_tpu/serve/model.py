@@ -48,6 +48,11 @@ class Model:
     def postprocess(self, outputs: Any) -> Any:
         return outputs
 
+    def explain(self, instances: Any) -> Any:
+        """v1 `:explain` hook (serve/explain.py attaches implementations)."""
+        raise NotImplementedError(
+            f"model {self.name!r} has no explainer configured")
+
     def __call__(self, payload: Any) -> Any:
         return self.postprocess(self.predict(self.preprocess(payload)))
 
@@ -85,8 +90,25 @@ class JAXModel(Model):
                              if b in self.batch_buckets]
         self._compiled: dict[int, Any] = {}
         self._lock = threading.Lock()
+        self.explainer = None  # serve/explain.py; set via attach_explainer
         self.stats = {"requests": 0, "examples": 0, "padded_examples": 0,
                       "compiles": 0, "predict_s": 0.0}
+
+    def attach_explainer(self, explainer) -> None:
+        self.explainer = explainer
+
+    def apply_and_params(self):
+        """(apply_fn, params) for explainers that differentiate through
+        the model (integrated gradients) rather than calling predict."""
+        return self._apply, self._params
+
+    def explain(self, instances) -> Any:
+        if self.explainer is None:
+            raise NotImplementedError(
+                f"model {self.name!r} has no explainer configured")
+        if not self.ready:
+            raise RuntimeError(f"model {self.name} is not loaded")
+        return self.explainer.explain(self, instances)
 
     # -- compilation --------------------------------------------------------
 

@@ -65,6 +65,14 @@ def load_model(model_dir: str, name: str | None = None,
     model = builder(model_dir, spec)
     if name:
         model.name = name
+    if spec.get("explainer"):
+        from kubeflow_tpu.serve.explain import build_explainer
+
+        attach = getattr(model, "attach_explainer", None)
+        if attach is None:
+            raise ValueError(
+                f"runtime {fmt!r} model does not support explainers")
+        attach(build_explainer(spec["explainer"]))
     return model
 
 

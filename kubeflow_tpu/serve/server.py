@@ -363,6 +363,36 @@ class V1PredictHandler(_Base):
         self.write_json({"predictions": np.asarray(preds).tolist()})
 
 
+class V1ExplainHandler(_Base):
+    """POST /v1/models/{name}:explain — the reference's v1 explain verb
+    (explainer component), served by the model's attached native explainer
+    (serve/explain.py). 501 when the model has none configured."""
+
+    async def post(self, name: str):
+        model = self.repo.get(name)
+        # Same preprocess as :predict — explanations must be computed on
+        # the input the model actually serves.
+        body = model.preprocess(self.body_json())
+        instances = body.get("instances")
+        if instances is None:
+            raise tornado.web.HTTPError(
+                400, reason='v1 request needs "instances"')
+        spec = getattr(model, "input_spec", None)
+        t0 = time.monotonic()
+        try:
+            arr = np.asarray(instances, dtype=spec[0][1] if spec else None)
+            out = await asyncio.get_event_loop().run_in_executor(
+                None, model.explain, arr)
+        except NotImplementedError as e:
+            raise tornado.web.HTTPError(501, reason=str(e))
+        except (ValueError, TypeError) as e:
+            # TypeError is the AOT executable refusing a wrong-shaped
+            # instance (per-example shape is static) — a client error.
+            raise tornado.web.HTTPError(400, reason=str(e))
+        self.server.observe(name, len(out), time.monotonic() - t0)
+        self.write_json({"explanations": out})
+
+
 class GenerateHandler(_Base):
     """POST /v1/models/{name}:generate and /v2/models/{name}/generate —
     the generative data plane (KServe huggingfaceserver's generate surface).
@@ -621,6 +651,7 @@ class ModelServer:
             (r"/v1/models", V1ListHandler, kw),
             (r"/v1/models/([^/:]+)", V1ModelHandler, kw),
             (r"/v1/models/([^/:]+):predict", V1PredictHandler, kw),
+            (r"/v1/models/([^/:]+):explain", V1ExplainHandler, kw),
             (r"/v1/models/([^/:]+):generate", GenerateHandler, kw),
             (r"/v2/models/([^/]+)/generate", GenerateHandler, kw),
             (r"/v2/health/(live|ready)", V2HealthHandler, kw),
