@@ -358,6 +358,101 @@ def import_bert(path: str, *, allow_headless: bool = False,
 
 
 # ---------------------------------------------------------------------------
+# T5
+# ---------------------------------------------------------------------------
+
+def t5_config_from_hf(hf: dict, **overrides: Any):
+    from kubeflow_tpu.models.t5 import T5Config
+
+    proj = hf.get("feed_forward_proj", "relu")
+    if proj not in ("relu", "gated-gelu"):
+        raise ValueError(f"unsupported feed_forward_proj {proj!r}")
+    fields = dict(
+        vocab_size=hf["vocab_size"],
+        d_model=hf["d_model"],
+        d_kv=hf["d_kv"],
+        d_ff=hf["d_ff"],
+        num_layers=hf["num_layers"],
+        num_decoder_layers=hf.get("num_decoder_layers", hf["num_layers"]),
+        num_heads=hf["num_heads"],
+        rel_buckets=hf.get("relative_attention_num_buckets", 32),
+        rel_max_distance=hf.get("relative_attention_max_distance", 128),
+        layer_norm_eps=float(hf.get("layer_norm_epsilon", 1e-6)),
+        feed_forward_proj=proj,
+        tie_embeddings=bool(hf.get("tie_word_embeddings", True)),
+        decoder_start_id=int(hf.get("decoder_start_token_id") or 0),
+        eos_id=int(hf.get("eos_token_id") or 1),
+    )
+    fields.update(overrides)
+    return T5Config(**fields)
+
+
+def import_t5(path: str, **config_overrides: Any):
+    """HF T5ForConditionalGeneration checkpoint dir → (T5Config, flax
+    params) matching `T5(cfg).init(...)` (tree equality asserted in
+    tests/test_t5.py)."""
+    hf = read_hf_config(path)
+    cfg = t5_config_from_hf(hf, **config_overrides)
+    t = load_safetensors_dir(path)
+    h, nh, dk = cfg.d_model, cfg.num_heads, cfg.d_kv
+    pd = np.dtype(jnp.dtype(cfg.param_dtype).name)
+
+    def lin(w):  # torch [out, in] -> flax [in, out]
+        return np.ascontiguousarray(w.T)
+
+    def qkv(name):  # [nh*dk, d_model] -> [d_model, nh, dk]
+        return {"kernel": lin(t[name + ".weight"]).reshape(h, nh, dk)}
+
+    def out_proj(name):  # [d_model, nh*dk] -> [nh, dk, d_model]
+        return {"kernel": lin(t[name + ".weight"]).reshape(nh, dk, h)}
+
+    def attn(stem):
+        return {"q": qkv(stem + ".q"), "k": qkv(stem + ".k"),
+                "v": qkv(stem + ".v"), "o": out_proj(stem + ".o")}
+
+    def ffn(stem):
+        if cfg.gated:
+            return {"wi_0": {"kernel": lin(t[stem + ".wi_0.weight"])},
+                    "wi_1": {"kernel": lin(t[stem + ".wi_1.weight"])},
+                    "wo": {"kernel": lin(t[stem + ".wo.weight"])}}
+        return {"wi": {"kernel": lin(t[stem + ".wi.weight"])},
+                "wo": {"kernel": lin(t[stem + ".wo.weight"])}}
+
+    def ln(name):
+        return {"scale": t[name + ".weight"]}
+
+    params: dict[str, Any] = {
+        "shared_embedding": t["shared.weight"],
+        "enc_rel": {"rel_embedding": t[
+            "encoder.block.0.layer.0.SelfAttention"
+            ".relative_attention_bias.weight"]},
+        "dec_rel": {"rel_embedding": t[
+            "decoder.block.0.layer.0.SelfAttention"
+            ".relative_attention_bias.weight"]},
+        "enc_final_ln": ln("encoder.final_layer_norm"),
+        "dec_final_ln": ln("decoder.final_layer_norm"),
+    }
+    for i in range(cfg.num_layers):
+        b = f"encoder.block.{i}.layer"
+        params[f"enc_{i}_attn"] = attn(f"{b}.0.SelfAttention")
+        params[f"enc_{i}_attn_ln"] = ln(f"{b}.0.layer_norm")
+        params[f"enc_{i}_ffn"] = ffn(f"{b}.1.DenseReluDense")
+        params[f"enc_{i}_ffn_ln"] = ln(f"{b}.1.layer_norm")
+    for i in range(cfg.num_decoder_layers):
+        b = f"decoder.block.{i}.layer"
+        params[f"dec_{i}_self"] = attn(f"{b}.0.SelfAttention")
+        params[f"dec_{i}_self_ln"] = ln(f"{b}.0.layer_norm")
+        params[f"dec_{i}_cross"] = attn(f"{b}.1.EncDecAttention")
+        params[f"dec_{i}_cross_ln"] = ln(f"{b}.1.layer_norm")
+        params[f"dec_{i}_ffn"] = ffn(f"{b}.2.DenseReluDense")
+        params[f"dec_{i}_ffn_ln"] = ln(f"{b}.2.layer_norm")
+    if not cfg.tie_embeddings:
+        params["lm_head"] = lin(t["lm_head.weight"])
+    params = jax.tree.map(lambda x: jnp.asarray(np.asarray(x, pd)), params)
+    return cfg, params
+
+
+# ---------------------------------------------------------------------------
 # Model builders (used by the serving runtime)
 # ---------------------------------------------------------------------------
 
@@ -368,5 +463,19 @@ def build_from_hf(path: str, **overrides: Any):
     if "Bert" in arch or hf.get("model_type") == "bert":
         cfg, params = import_bert(path, **overrides)
         return Bert(cfg), cfg, params
+    # Exact-match T5 dispatch: UMT5 shares these key names but uses
+    # PER-LAYER relative position biases — importing it as classic T5
+    # (block-0 bias shared) would serve silently wrong generations.
+    if (arch in ("T5ForConditionalGeneration", "MT5ForConditionalGeneration")
+            or hf.get("model_type") in ("t5", "mt5")):
+        from kubeflow_tpu.models.t5 import T5
+
+        cfg, params = import_t5(path, **overrides)
+        return T5(cfg), cfg, params
+    if "T5" in arch:
+        raise ValueError(
+            f"unsupported T5-family architecture {arch!r} (classic "
+            "T5/MT5 only; UMT5's per-layer position biases are not "
+            "implemented)")
     cfg, params = import_llama(path, **overrides)
     return Llama(cfg), cfg, params

@@ -756,26 +756,14 @@ class GenerativeJAXModel(Model):
             self.engine = None
 
     def _resolve_ids(self, payload: dict) -> list[int]:
-        ids = payload.get("input_ids")
-        text = payload.get("text")
-        if ids is None and text is not None:
-            if self.tokenizer == "bytes":
-                ids = list(text.encode("utf-8"))
-            elif hasattr(self.tokenizer, "encode"):  # HF-style tokenizer
-                ids = list(self.tokenizer.encode(text))
-            else:
-                raise ValueError(
-                    "this model takes token ids ('input_ids'); no "
-                    "tokenizer is bundled")
-        if ids is None:
-            raise ValueError("request needs 'input_ids' (or 'text')")
-        return ids
+        from kubeflow_tpu.serve.tokenizer_util import resolve_ids
+
+        return resolve_ids(self.tokenizer, payload)
 
     def _decode_text(self, ids: list[int]) -> str:
-        if self.tokenizer == "bytes":
-            return bytes(t for t in ids if 0 <= t < 256).decode(
-                "utf-8", errors="replace")
-        return self.tokenizer.decode(ids, skip_special_tokens=True)
+        from kubeflow_tpu.serve.tokenizer_util import decode_ids
+
+        return decode_ids(self.tokenizer, ids)
 
     def _submit_kwargs(self, payload: dict) -> dict:
         return dict(

@@ -172,6 +172,7 @@ def _huggingface_runtime(model_dir: str, spec: dict) -> Model:
     """
     from kubeflow_tpu.models.bert import Bert
     from kubeflow_tpu.models.hf_import import build_from_hf, read_hf_config
+    from kubeflow_tpu.models.t5 import T5
 
     ckpt = spec.get("checkpoint") or "."
     if not os.path.isabs(ckpt):
@@ -179,8 +180,25 @@ def _huggingface_runtime(model_dir: str, spec: dict) -> Model:
     overrides = dict(spec.get("model_overrides") or {})
     module, cfg, params = build_from_hf(ckpt, **overrides)
     is_bert = isinstance(module, Bert)  # before the quantize wrapper
+    is_t5 = isinstance(module, T5)
     module, params = _maybe_quantize(module, params, spec)
     name = spec.get("name") or os.path.basename(os.path.abspath(model_dir))
+
+    if is_t5:
+        # Encoder-decoder → the text2text task (whole-decode-as-one-
+        # program greedy generation; serve/text2text.py).
+        from kubeflow_tpu.serve.text2text import Text2TextJAXModel
+
+        gen = dict(spec.get("generative") or {})
+        if "tokenizer" not in gen:
+            from kubeflow_tpu.serve.tokenizer_util import \
+                load_bundled_tokenizer
+
+            tok = load_bundled_tokenizer(ckpt, name)
+            if tok is not None:
+                gen["tokenizer"] = tok
+        return Text2TextJAXModel(name, module, params, cfg,
+                                 generation=gen)
 
     if is_bert:
         # Pad tokens must not enter attention: the mask is derived from the
@@ -208,19 +226,15 @@ def _huggingface_runtime(model_dir: str, spec: dict) -> Model:
         # text in/out + streaming text deltas): generation then accepts
         # "text" and returns decoded "text"; eos defaults to the
         # tokenizer's unless the spec pins one.
-        if "tokenizer" not in gen and any(
-                os.path.exists(os.path.join(ckpt, f))
-                for f in ("tokenizer.json", "tokenizer.model")):
-            try:
-                from transformers import AutoTokenizer
+        if "tokenizer" not in gen:
+            from kubeflow_tpu.serve.tokenizer_util import \
+                load_bundled_tokenizer
 
-                tok = AutoTokenizer.from_pretrained(ckpt)
+            tok = load_bundled_tokenizer(ckpt, name)
+            if tok is not None:
                 gen["tokenizer"] = tok
                 if tok.eos_token_id is not None:
                     gen.setdefault("eos_id", int(tok.eos_token_id))
-            except Exception as e:
-                print(f"tokenizer load skipped for {name}: {e}",
-                      flush=True)
         return GenerativeJAXModel(name, module, params, cfg,
                                   generation=gen)
 
