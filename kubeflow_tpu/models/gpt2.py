@@ -1,0 +1,188 @@
+"""GPT-2 decoder family — learned positions, biased MHA, pre-LN.
+
+Another huggingfaceserver-servable causal-LM family (SURVEY.md §2.2
+⟨kserve: python/huggingfaceserver⟩). The module implements the SAME
+functional cache contract as Llama (models/llama.py: `tokens, cache,
+cache_index, positions, attend_full_cache, return_hidden` → (logits,
+cache) with the layer-stacked [L, B, T, H, D] cache from `init_cache`),
+so the entire serving stack — GenerationEngine slots/buckets/prefix
+cache, OpenAI surface, streaming — serves GPT-2 checkpoints unchanged.
+
+Differences from Llama handled here: learned absolute position
+embeddings (no RoPE), LayerNorm with bias (not RMS), fused-projection
+attention WITH bias and 1/sqrt(d) scaling, tanh-approx GELU MLP with
+bias, tied lm head. Attention runs through ops.reference.naive_attention
+in all paths: GPT-2 is a serving family (max_seq_len 1024), not the
+training flagship, and the position-aware naive path is exact for
+prefill, decode, and chunked extension alike.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.ops.reference import naive_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_seq_len: int = 1024
+    layer_norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    # Engine-compat attributes (models/llama.py init_cache is duck-typed
+    # on these): GPT-2 is MHA, so kv heads == heads.
+    @property
+    def num_kv_heads(self) -> int:
+        return self.num_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def num_params(self) -> int:
+        h, L = self.hidden_size, self.num_layers
+        return (self.vocab_size * h + self.max_seq_len * h
+                + L * (4 * h * h + 2 * h * self.intermediate_size))
+
+
+def gpt2_small() -> GPT2Config:
+    return GPT2Config()
+
+
+def gpt2_tiny() -> GPT2Config:
+    return GPT2Config(vocab_size=96, hidden_size=32, num_layers=2,
+                      num_heads=4, intermediate_size=64, max_seq_len=64)
+
+
+def init_cache(cfg: GPT2Config, batch: int, max_len: int | None = None,
+               dtype: Any = None) -> dict:
+    from kubeflow_tpu.models import llama
+
+    return llama.init_cache(cfg, batch, max_len, dtype)
+
+
+class Block(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, positions, cache_k=None, cache_v=None,
+                 cache_index=None, attend_full_cache=False):
+        cfg = self.cfg
+        nh, hd = cfg.num_heads, cfg.head_dim
+        ln = partial(nn.LayerNorm, epsilon=cfg.layer_norm_eps,
+                     dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+        dense = partial(nn.DenseGeneral, use_bias=True, dtype=cfg.dtype,
+                        param_dtype=cfg.param_dtype)
+
+        h = ln(name="ln_1")(x)
+        proj = dict(features=(nh, hd), kernel_init=nn.with_logical_partitioning(
+            nn.initializers.lecun_normal(), ("qkv_embed", "heads", "kv")))
+        q = dense(**proj, name="q_proj")(h)
+        k = dense(**proj, name="k_proj")(h)
+        v = dense(**proj, name="v_proj")(h)
+
+        new_k, new_v = None, None
+        if cache_k is not None:
+            from kubeflow_tpu.models.llama import _update_cache
+
+            new_k, new_v = _update_cache(cache_k, cache_v, k, v,
+                                         cache_index)
+            if x.shape[1] == 1 or attend_full_cache:
+                t = new_k.shape[1]
+                kv_pos = jnp.broadcast_to(jnp.arange(t), (new_k.shape[0], t))
+                attn = naive_attention(
+                    q, new_k.astype(cfg.dtype), new_v.astype(cfg.dtype),
+                    causal=True, positions_q=positions,
+                    positions_kv=kv_pos)
+            else:
+                attn = naive_attention(q, k, v, causal=True,
+                                       positions_q=positions,
+                                       positions_kv=positions)
+        else:
+            attn = naive_attention(q, k, v, causal=True,
+                                   positions_q=positions,
+                                   positions_kv=positions)
+        attn = dense(features=cfg.hidden_size, axis=(-2, -1),
+                     kernel_init=nn.with_logical_partitioning(
+                         nn.initializers.lecun_normal(),
+                         ("heads", "kv", "embed")),
+                     name="o_proj")(attn)
+        x = x + attn
+        h = ln(name="ln_2")(x)
+        h = dense(features=cfg.intermediate_size,
+                  kernel_init=nn.with_logical_partitioning(
+                      nn.initializers.lecun_normal(), ("embed", "mlp")),
+                  name="fc")(h)
+        h = nn.gelu(h, approximate=True)  # GPT-2 canonical gelu_new
+        h = dense(features=cfg.hidden_size,
+                  kernel_init=nn.with_logical_partitioning(
+                      nn.initializers.lecun_normal(), ("mlp", "embed")),
+                  name="proj")(h)
+        return x + h, new_k, new_v
+
+
+class GPT2(nn.Module):
+    """Functional-cache causal LM (the Llama serving contract)."""
+
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, tokens, positions=None, cache=None,
+                 cache_index=None, attend_full_cache=False,
+                 return_hidden=False):
+        cfg = self.cfg
+        b, s = tokens.shape
+        if cache is not None:
+            if cache_index is None:
+                cache_index = jnp.zeros((b,), jnp.int32)
+            if positions is None and s == 1:
+                # Single-token decode: the absolute position IS the cache
+                # write offset (same derivation as llama.py __call__) —
+                # arange would decode every step at position 0.
+                positions = cache_index[:, None]
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+        wte = self.param("wte", nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), ("vocab", "embed")),
+            (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
+        wpe = self.param("wpe", nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), (None, "embed")),
+            (cfg.max_seq_len, cfg.hidden_size), cfg.param_dtype)
+        x = (wte[tokens] + wpe[positions]).astype(cfg.dtype)
+
+        new_cache = None
+        if cache is not None:
+            ks, vs = [], []
+            for i in range(cfg.num_layers):
+                x, nk, nv = Block(cfg, name=f"block_{i}")(
+                    x, positions, cache["k"][i], cache["v"][i],
+                    cache_index, attend_full_cache)
+                ks.append(nk)
+                vs.append(nv)
+            new_cache = {"k": jnp.stack(ks), "v": jnp.stack(vs)}
+        else:
+            for i in range(cfg.num_layers):
+                x, _, _ = Block(cfg, name=f"block_{i}")(x, positions)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="ln_f")(x)
+        if return_hidden:
+            return x, new_cache
+        logits = jnp.einsum("bsh,vh->bsv", x,
+                            wte.astype(cfg.dtype)).astype(jnp.float32)
+        if cache is not None:
+            return logits, new_cache
+        return logits

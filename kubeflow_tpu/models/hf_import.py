@@ -358,6 +358,86 @@ def import_bert(path: str, *, allow_headless: bool = False,
 
 
 # ---------------------------------------------------------------------------
+# GPT-2
+# ---------------------------------------------------------------------------
+
+def gpt2_config_from_hf(hf: dict, **overrides: Any):
+    from kubeflow_tpu.models.gpt2 import GPT2Config
+
+    act = hf.get("activation_function", "gelu_new")
+    if act not in ("gelu_new", "gelu_pytorch_tanh"):
+        raise ValueError(f"unsupported activation_function {act!r}")
+    # Attention-math variants this module does not implement must refuse,
+    # not import with plain 1/sqrt(d) scaling (silently wrong logits).
+    if not hf.get("scale_attn_weights", True):
+        raise ValueError("scale_attn_weights=False is not implemented")
+    for flag in ("scale_attn_by_inverse_layer_idx",
+                 "reorder_and_upcast_attn"):
+        if hf.get(flag):
+            raise ValueError(f"{flag}=true is not implemented")
+    fields = dict(
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["n_embd"],
+        num_layers=hf["n_layer"],
+        num_heads=hf["n_head"],
+        intermediate_size=hf.get("n_inner") or 4 * hf["n_embd"],
+        max_seq_len=hf.get("n_positions", 1024),
+        layer_norm_eps=float(hf.get("layer_norm_epsilon", 1e-5)),
+    )
+    fields.update(overrides)
+    return GPT2Config(**fields)
+
+
+def import_gpt2(path: str, **config_overrides: Any):
+    """HF GPT2LMHeadModel checkpoint dir → (GPT2Config, flax params).
+
+    HF GPT-2 uses Conv1D modules storing weights [in, out] — the flax
+    kernel layout already — so unlike the Linear-based families nothing
+    transposes; c_attn's fused [H, 3H] splits into q/k/v thirds."""
+    hf = read_hf_config(path)
+    cfg = gpt2_config_from_hf(hf, **config_overrides)
+    t = load_safetensors_dir(path)
+    pre = ("transformer."
+           if any(k.startswith("transformer.") for k in t) else "")
+    h, nh, hd = cfg.hidden_size, cfg.num_heads, cfg.head_dim
+    pd = np.dtype(jnp.dtype(cfg.param_dtype).name)
+
+    def ln(name):
+        return {"scale": t[name + ".weight"], "bias": t[name + ".bias"]}
+
+    params: dict[str, Any] = {
+        "wte": t[pre + "wte.weight"],
+        "wpe": t[pre + "wpe.weight"],
+        "ln_f": ln(pre + "ln_f"),
+    }
+    for i in range(cfg.num_layers):
+        b = f"{pre}h.{i}."
+        ca_w = t[b + "attn.c_attn.weight"]   # [H, 3H], Conv1D layout
+        ca_b = t[b + "attn.c_attn.bias"]     # [3H]
+        qw, kw, vw = np.split(ca_w, 3, axis=1)
+        qb, kb, vb = np.split(ca_b, 3)
+        params[f"block_{i}"] = {
+            "q_proj": {"kernel": qw.reshape(h, nh, hd),
+                       "bias": qb.reshape(nh, hd)},
+            "k_proj": {"kernel": kw.reshape(h, nh, hd),
+                       "bias": kb.reshape(nh, hd)},
+            "v_proj": {"kernel": vw.reshape(h, nh, hd),
+                       "bias": vb.reshape(nh, hd)},
+            "o_proj": {"kernel": t[b + "attn.c_proj.weight"]
+                       .reshape(nh, hd, h),
+                       "bias": t[b + "attn.c_proj.bias"]},
+            "ln_1": ln(b + "ln_1"),
+            "ln_2": ln(b + "ln_2"),
+            "fc": {"kernel": t[b + "mlp.c_fc.weight"],
+                   "bias": t[b + "mlp.c_fc.bias"]},
+            "proj": {"kernel": t[b + "mlp.c_proj.weight"],
+                     "bias": t[b + "mlp.c_proj.bias"]},
+        }
+    params = jax.tree.map(lambda x: jnp.asarray(np.asarray(x, pd)), params)
+    return cfg, params
+
+
+# ---------------------------------------------------------------------------
 # T5
 # ---------------------------------------------------------------------------
 
@@ -463,6 +543,11 @@ def build_from_hf(path: str, **overrides: Any):
     if "Bert" in arch or hf.get("model_type") == "bert":
         cfg, params = import_bert(path, **overrides)
         return Bert(cfg), cfg, params
+    if arch == "GPT2LMHeadModel" or hf.get("model_type") == "gpt2":
+        from kubeflow_tpu.models.gpt2 import GPT2
+
+        cfg, params = import_gpt2(path, **overrides)
+        return GPT2(cfg), cfg, params
     # Exact-match T5 dispatch: UMT5 shares these key names but uses
     # PER-LAYER relative position biases — importing it as classic T5
     # (block-0 bias shared) would serve silently wrong generations.
@@ -472,7 +557,10 @@ def build_from_hf(path: str, **overrides: Any):
 
         cfg, params = import_t5(path, **overrides)
         return T5(cfg), cfg, params
-    if "T5" in arch:
+    if "T5" in arch or hf.get("model_type", "").endswith("t5"):
+        # Catches UMT5 (and future T5 variants) whether declared via
+        # architectures OR only via model_type — falling through to
+        # import_llama would crash with an opaque missing-tensor error.
         raise ValueError(
             f"unsupported T5-family architecture {arch!r} (classic "
             "T5/MT5 only; UMT5's per-layer position biases are not "

@@ -228,6 +228,15 @@ class GenerationEngine:
                  mesh=None, rules=None):
         self.model, self.cfg = model, cfg
         self.max_len, self.chunk, self.n_slots = int(max_len), int(chunk), int(slots)
+        msl = int(getattr(cfg, "max_seq_len", 0) or 0)
+        if msl and self.max_len > msl:
+            # Past the model's position range the wpe/RoPE-table gather
+            # CLAMPS under jit — every later token reuses the last
+            # position, silently diverging from the source model.
+            raise ValueError(
+                f"max_len {self.max_len} exceeds the model's position "
+                f"range (max_seq_len={msl}); positions would silently "
+                "clamp")
         mask_kind = getattr(cfg, "mask_kind", "causal")
         if mask_kind == "sliding_window":
             # The decode path attends over the full cache (causal). For a

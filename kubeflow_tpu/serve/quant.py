@@ -62,8 +62,11 @@ def _contraction_axes(path_names: list[str], ndim: int) -> tuple[int, ...]:
            for n in ("q_proj", "k_proj", "v_proj", "q", "k", "v")) \
             and ndim >= 3:
         return (ndim - 3,)           # [..., in, heads, head_dim]
-    if path_names and path_names[-1] == "embed":
-        return (ndim - 1,)           # [vocab, D]: tied unembed contracts D
+    if path_names and path_names[-1] in ("embed", "wte",
+                                         "shared_embedding"):
+        # Tied embeddings across families (Llama "embed", GPT-2 "wte",
+        # T5 "shared_embedding"): [vocab, D], the unembed contracts D.
+        return (ndim - 1,)
     return (ndim - 2,)               # [..., in, out]
 
 

@@ -173,3 +173,20 @@ def test_int8_matmul_shape_validation():
     with pytest.raises(ValueError, match="shape"):
         int8_matmul(jnp.zeros((4, 8)), jnp.zeros((9, 3), jnp.int8),
                     jnp.zeros((3,)))
+
+
+def test_tied_embedding_scale_axes_all_families():
+    """Tied embeddings are named differently per family — Llama "embed",
+    GPT-2 "wte", T5 "shared_embedding". All are [vocab, D] whose unembed
+    matmul contracts D: scales must be per-vocab-row [V, 1], not the
+    per-input-channel [1, D] the default branch would store."""
+    v, dim = 32, 16
+    key = jax.random.key(4)
+    params = {
+        "embed": jax.random.normal(key, (v, dim)),
+        "wte": jax.random.normal(key, (v, dim)),
+        "shared_embedding": jax.random.normal(key, (v, dim)),
+    }
+    q = quantize_tree(params, min_size=1)
+    for name in params:
+        assert q[name].scale.shape == (v, 1), name
