@@ -128,6 +128,18 @@ def _ensure_builtin() -> None:
             "task": "classify", "example_shape": (1, 128),
             "example_dtype": "int32", "num_params": None, "config": cfg}
 
+    @register_model("gpt2_tiny")
+    def _gpt2_tiny(**kw):
+        import dataclasses
+
+        from kubeflow_tpu.models import gpt2
+
+        cfg = dataclasses.replace(gpt2.gpt2_tiny(), **kw)
+        return gpt2.GPT2(cfg), {
+            "task": "lm", "example_shape": (1, 16),
+            "example_dtype": "int32", "num_params": cfg.num_params,
+            "vocab_size": cfg.vocab_size, "config": cfg}
+
     from kubeflow_tpu.data import synthetic
 
     @register_dataset("synthetic_lm")
