@@ -77,6 +77,53 @@ def moe_tiny(vocab: int = 512) -> MoEConfig:
         flash_block_q=64, flash_block_kv=64)
 
 
+def expert_capacity(cfg: MoEConfig, seq_len: int) -> int:
+    """Per-(batch-row) expert buffer: perfect balance needs K*S/E slots;
+    capacity_factor adds slack before tokens drop."""
+    return max(1, int(math.ceil(
+        seq_len * cfg.experts_per_token / cfg.num_experts
+        * cfg.capacity_factor)))
+
+
+def gshard_route(x: jax.Array, w_router: jax.Array, K: int, C: int):
+    """GShard/Switch capacity routing, pure jnp — shared by the flax
+    MoEBlock and the pipeline stage body (models/llama_pp.py MoE-PP), so
+    the two paths cannot drift.
+
+    x [B, S, H] (any dtype; router runs fp32), w_router [H, E] fp32.
+    Returns (dispatch [B,S,E,C], combine [B,S,E,C], aux scalar) where aux
+    is the UNWEIGHTED Switch load-balance term E * Σ_e frac_e · mean_prob_e
+    (caller applies router_aux_coef)."""
+    E = w_router.shape[-1]
+    logits = jnp.einsum("bsh,he->bse", x.astype(jnp.float32),
+                        w_router.astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)            # [B,S,E]
+    gate_vals, expert_idx = jax.lax.top_k(probs, K)    # [B,S,K]
+    gate_vals = gate_vals / jnp.maximum(
+        jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
+
+    B, S = x.shape[0], x.shape[1]
+    # Capacity assignment, slot-major (GShard): slot-0 choices claim
+    # buffer positions first, then slot-1, each in sequence order.
+    dispatch = jnp.zeros((B, S, E, C), jnp.float32)
+    combine = jnp.zeros((B, S, E, C), jnp.float32)
+    count = jnp.zeros((B, 1, E), jnp.float32)  # claimed so far
+    for k in range(K):
+        mask_e = jax.nn.one_hot(expert_idx[:, :, k], E)       # [B,S,E]
+        pos = jnp.cumsum(mask_e, axis=1) - mask_e + count     # [B,S,E]
+        count = count + jnp.sum(mask_e, axis=1, keepdims=True)
+        keep = mask_e * (pos < C)
+        slot = jax.nn.one_hot(pos.astype(jnp.int32), C) * keep[..., None]
+        dispatch = dispatch + slot                            # [B,S,E,C]
+        combine = combine + gate_vals[:, :, k, None, None] * slot
+
+    # Switch aux loss: E * Σ_e (token fraction to e) · (mean prob of e).
+    frac = jnp.mean(jax.nn.one_hot(expert_idx[:, :, 0], E), axis=(0, 1))
+    mean_prob = jnp.mean(probs, axis=(0, 1))
+    aux = E * jnp.sum(frac * mean_prob)
+    return dispatch, combine, aux
+
+
 class MoEBlock(nn.Module):
     """Top-k routed SwiGLU experts with capacity-based dispatch."""
 
@@ -87,41 +134,15 @@ class MoEBlock(nn.Module):
         cfg = self.cfg
         B, S, H = x.shape
         E, K = cfg.num_experts, cfg.experts_per_token
-        # Per-(batch-row) expert buffer: perfect balance needs K*S/E slots;
-        # capacity_factor adds slack before tokens drop.
-        C = max(1, int(math.ceil(S * K / E * cfg.capacity_factor)))
+        C = expert_capacity(cfg, S)
 
         # Router in fp32 (small matmul; numerics matter more than MXU).
         w_router = self.param(
             "router", nn.with_logical_partitioning(
                 nn.initializers.lecun_normal(), ("embed", None)),
             (H, E), jnp.float32)
-        logits = jnp.einsum("bsh,he->bse", x.astype(jnp.float32), w_router)
-        probs = jax.nn.softmax(logits, axis=-1)            # [B,S,E]
-        gate_vals, expert_idx = jax.lax.top_k(probs, K)    # [B,S,K]
-        gate_vals = gate_vals / jnp.maximum(
-            jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
-
-        # Capacity assignment, slot-major (GShard): slot-0 choices claim
-        # buffer positions first, then slot-1, each in sequence order.
-        dispatch = jnp.zeros((B, S, E, C), jnp.float32)
-        combine = jnp.zeros((B, S, E, C), jnp.float32)
-        count = jnp.zeros((B, 1, E), jnp.float32)  # claimed so far
-        for k in range(K):
-            mask_e = jax.nn.one_hot(expert_idx[:, :, k], E)       # [B,S,E]
-            pos = jnp.cumsum(mask_e, axis=1) - mask_e + count     # [B,S,E]
-            count = count + jnp.sum(mask_e, axis=1, keepdims=True)
-            keep = mask_e * (pos < C)
-            slot = jax.nn.one_hot(pos.astype(jnp.int32), C) * keep[..., None]
-            dispatch = dispatch + slot                            # [B,S,E,C]
-            combine = combine + gate_vals[:, :, k, None, None] * slot
-
-        # Switch aux loss: E * Σ_e (token fraction to e) · (mean prob of e).
-        frac = jnp.mean(
-            jax.nn.one_hot(expert_idx[:, :, 0], E), axis=(0, 1))
-        mean_prob = jnp.mean(probs, axis=(0, 1))
-        aux = cfg.router_aux_coef * E * jnp.sum(frac * mean_prob)
-        self.sow("aux_loss", "router", aux)
+        dispatch, combine, aux = gshard_route(x, w_router, K, C)
+        self.sow("aux_loss", "router", cfg.router_aux_coef * aux)
 
         # Dispatch → per-expert batches [E,B,C,H]; with `expert` sharded
         # this contraction IS the all-to-all (GSPMD inserts it).

@@ -121,6 +121,64 @@ def _flash_case_block(q, k, v, case, block_q, block_kv):
     return jax.lax.switch(case, (skip, diag, full), None)
 
 
+def ring_attention_manual(q, k, v, pos, axis_name: str, n: int) -> jax.Array:
+    """Einsum-inner causal ring body for callers ALREADY inside a manual
+    (`shard_map`) region whose mesh includes `axis_name` — context
+    parallelism composed inside another manually-partitioned schedule, e.g.
+    the pipeline stage region (models/llama_pp.py, CP-inside-PP).
+
+    All shapes are per-shard: q [b_loc, s_loc, H, D], k/v [b_loc, s_loc,
+    KH, D], pos [b_loc, s_loc] GLOBAL positions of the resident shard
+    (causality is masked by absolute position, so any contiguous or
+    permuted layout works). Differentiable (each ring step rematerializes).
+    """
+    h, d = q.shape[2], q.shape[3]
+
+    def step(i, carry):
+        acc_m_l, kv, kv_pos = carry
+        k_i, v_i = kv
+        update = _block_attn(q, k_i, v_i, pos, kv_pos)
+        acc_m_l = _merge(acc_m_l, update)
+        kv, kv_pos = _rotate_if(i < n - 1, (kv, kv_pos), axis_name, n)
+        return acc_m_l, kv, kv_pos
+
+    b_loc, s_loc = q.shape[0], q.shape[1]
+    init = (jnp.zeros((b_loc, s_loc, h, d), jnp.float32),
+            jnp.full((b_loc, s_loc, h, 1), NEG_INF, jnp.float32),
+            jnp.zeros((b_loc, s_loc, h, 1), jnp.float32))
+    (acc, _, l), _, _ = jax.lax.fori_loop(
+        0, n, jax.checkpoint(step), (init, (k, v), pos))
+    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+def ring_attention_flash_manual(q, k, v, axis_name: str, n: int,
+                                block_q: int = 512,
+                                block_kv: int = 512) -> jax.Array:
+    """Fused-inner contiguous-layout ring body for manual-region callers
+    (see ring_attention_manual). Requires the CONTIGUOUS layout — shard r
+    of the ring owns global positions [r*s_loc, (r+1)*s_loc) — because
+    causality is derived from ring offsets, not positions."""
+    me = jax.lax.axis_index(axis_name)
+    b_loc, s_loc, h, d = q.shape
+
+    def step(i, carry):
+        (o, lse), kv = carry
+        k_i, v_i = kv
+        src = jnp.mod(me - i, n)  # origin shard of the resident KV
+        case = jnp.where(src == me, 1,
+                         jnp.where(src < me, 2, 0)).astype(jnp.int32)
+        update = _flash_case_block(q, k_i, v_i, case, block_q, block_kv)
+        o, lse = _merge_lse((o, lse), update)
+        kv = _rotate_if(i < n - 1, kv, axis_name, n)
+        return (o, lse), kv
+
+    init = (jnp.zeros((b_loc, s_loc, h, d), jnp.float32),
+            jnp.full((b_loc, s_loc, h, 1), NEG_INF, jnp.float32))
+    (o, _), _ = jax.lax.fori_loop(
+        0, n, jax.checkpoint(step), (init, (k, v)))
+    return o.astype(q.dtype)
+
+
 def ring_attention(q, k, v, axis_name: str = "seq",
                    positions: jax.Array | None = None,
                    mesh=None, inner: str = "einsum",
@@ -166,22 +224,7 @@ def ring_attention(q, k, v, axis_name: str = "seq",
         out_specs=spec, check_vma=False)
     def _ring(q, k, v, pos):
         # All shapes here are per-shard: s_loc = S / n, b_loc = B / dp.
-        def step(i, carry):
-            acc_m_l, kv, kv_pos = carry
-            k_i, v_i = kv
-            update = _block_attn(q, k_i, v_i, pos, kv_pos)
-            acc_m_l = _merge(acc_m_l, update)
-
-            kv, kv_pos = _rotate_if(i < n - 1, (kv, kv_pos), axis_name, n)
-            return acc_m_l, kv, kv_pos
-
-        b_loc, s_loc = q.shape[0], q.shape[1]
-        init = (jnp.zeros((b_loc, s_loc, h, d), jnp.float32),
-                jnp.full((b_loc, s_loc, h, 1), NEG_INF, jnp.float32),
-                jnp.zeros((b_loc, s_loc, h, 1), jnp.float32))
-        (acc, m, l), _, _ = jax.lax.fori_loop(
-            0, n, jax.checkpoint(step), (init, (k, v), pos))
-        return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+        return ring_attention_manual(q, k, v, pos, axis_name, n)
 
     return _ring(q, k, v, positions)
 
@@ -200,26 +243,8 @@ def _ring_attention_flash(q, k, v, axis_name, mesh, n, block_q, block_kv):
     @functools.partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)
     def _ring(q, k, v):
-        me = jax.lax.axis_index(axis_name)
-        b_loc, s_loc, h, d = q.shape
-
-        def step(i, carry):
-            (o, lse), kv = carry
-            k_i, v_i = kv
-            src = jnp.mod(me - i, n)  # origin shard of the resident KV
-            case = jnp.where(src == me, 1,
-                             jnp.where(src < me, 2, 0)).astype(jnp.int32)
-            update = _flash_case_block(q, k_i, v_i, case, block_q, block_kv)
-            o, lse = _merge_lse((o, lse), update)
-
-            kv = _rotate_if(i < n - 1, kv, axis_name, n)
-            return (o, lse), kv
-
-        init = (jnp.zeros((b_loc, s_loc, h, d), jnp.float32),
-                jnp.full((b_loc, s_loc, h, 1), NEG_INF, jnp.float32))
-        (o, _), _ = jax.lax.fori_loop(
-            0, n, jax.checkpoint(step), (init, (k, v)))
-        return o.astype(q.dtype)
+        return ring_attention_flash_manual(q, k, v, axis_name, n,
+                                           block_q, block_kv)
 
     return _ring(q, k, v)
 

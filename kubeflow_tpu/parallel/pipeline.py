@@ -64,6 +64,43 @@ def stack_stage_params(per_stage_params: list[Any]) -> Any:
     return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)
 
 
+def _travel_specs(x: Any, data_axis, travel_specs: Any | None) -> Any:
+    """Per-leaf shard_map specs for the traveling microbatch pytree.
+
+    Leaves are [M, mb, ...] after microbatching: dim 0 (microbatch index)
+    replicates, dim 1 (rows) shards over the data axis, and `travel_specs`
+    — a pytree matching x whose entries are tuples of mesh-axis names (or
+    None) for the dims AFTER rows — shards trailing dims (CP-inside-PP
+    shards the sequence dim over `seq` this way). None = all-replicated
+    trailing dims (the default GPipe travel layout)."""
+    o = P(None, data_axis) if data_axis is not None else P()
+    if travel_specs is None:
+        return jax.tree.map(lambda _: o, x)
+    _, treedef = jax.tree.flatten(x)
+    flat_extra = treedef.flatten_up_to(travel_specs)
+    base = (None, data_axis if data_axis is not None else None)
+    flat = [o if extra is None else P(*base, *extra)
+            for extra in flat_extra]
+    return jax.tree.unflatten(treedef, flat)
+
+
+def _param_specs(stage_params: Any, lead: tuple, param_specs: Any | None
+                 ) -> Any:
+    """Per-leaf shard_map specs for the stage parameters. `lead` is the
+    spec prefix for the leading stage dim(s) — (axis,) for pipeline_apply,
+    (None, axis) for the chunk-major circular layout. `param_specs` — a
+    pytree matching stage_params whose entries are tuples of mesh-axis
+    names (or None) for the dims AFTER the leading stage dim — shards
+    non-stage param dims (MoE-PP shards the expert dim over `expert`)."""
+    if param_specs is None:
+        return jax.tree.map(lambda _: P(*lead), stage_params)
+    _, treedef = jax.tree.flatten(stage_params)
+    flat_extra = treedef.flatten_up_to(param_specs)
+    flat = [P(*lead) if extra is None else P(*lead, *extra)
+            for extra in flat_extra]
+    return jax.tree.unflatten(treedef, flat)
+
+
 def pipeline_apply(
     stage_fn: Callable[[Any, jax.Array], jax.Array],
     stage_params: Any,
@@ -73,6 +110,8 @@ def pipeline_apply(
     num_microbatches: int,
     axis: str = "pipe",
     data_axis: str | tuple[str, ...] | None = None,
+    travel_specs: Any | None = None,
+    param_specs: Any | None = None,
 ) -> jax.Array:
     """Applies `stage_fn` P times in sequence, pipelined over microbatches.
 
@@ -106,11 +145,11 @@ def pipeline_apply(
     xm = jax.tree.map(
         lambda a: a.reshape(num_microbatches, mb, *a.shape[1:]), x)
 
-    pspec = jax.tree.map(lambda _: P(axis), stage_params)
+    pspec = _param_specs(stage_params, (axis,), param_specs)
     # Inputs/outputs: replicated over the pipe axis; microbatch rows
-    # sharded over the data axis when given.
-    o = P(None, data_axis) if data_axis is not None else P()
-    other = jax.tree.map(lambda _: o, x)
+    # sharded over the data axis; trailing dims per travel_specs
+    # (CP-inside-PP shards the sequence dim).
+    other = _travel_specs(x, data_axis, travel_specs)
 
     @partial(shard_map, mesh=mesh, in_specs=(pspec, other),
              out_specs=other, check_vma=False)
@@ -168,6 +207,8 @@ def pipeline_apply_circular(
     num_chunks: int,
     axis: str = "pipe",
     data_axis: str | tuple[str, ...] | None = None,
+    travel_specs: Any | None = None,
+    param_specs: Any | None = None,
 ) -> jax.Array:
     """Interleaved (circular) pipeline schedule — Megatron's interleaved-1F1B
     bubble reduction, compiled for TPU.
@@ -216,9 +257,8 @@ def pipeline_apply_circular(
     # Reshape chunk-major [C*P, ...] -> [C, P, ...]; shard dim 1 over pipe.
     cparams = jax.tree.map(
         lambda a: a.reshape(c, p, *a.shape[1:]), stage_params)
-    pspec = jax.tree.map(lambda _: P(None, axis), cparams)
-    o = P(None, data_axis) if data_axis is not None else P()
-    other = jax.tree.map(lambda _: o, x)
+    pspec = _param_specs(cparams, (None, axis), param_specs)
+    other = _travel_specs(x, data_axis, travel_specs)
 
     # Tick t on device s computes the chunk of the activation that left
     # device 0 at tick t-s: chunk(t, s) = ((t - s) mod C·P) // P. Fresh

@@ -216,8 +216,9 @@ def make_train_step(
             raise ValueError("pipeline implies the built-in LM loss")
         if model_kwargs.get("ring_axis") is not None:
             raise ValueError(
-                "pipeline parallelism doesn't compose with ring_axis "
-                "(ring/context parallelism inside PP is future work)")
+                "pipeline parallelism doesn't take ring_axis — pass "
+                "pipeline={'seq_axis': ...} for context parallelism "
+                "inside the pipeline")
         static_packed = {"segment_ids", "positions"} & set(model_kwargs)
         if any(model_kwargs.get(k) is not None for k in static_packed):
             # The pipeline path reads packed metadata from the BATCH
@@ -240,7 +241,14 @@ def make_train_step(
             num_chunks=int(pipeline.get("chunks", 1)),
             return_hidden=hidden,
             positions=batch.get("positions"),
-            segment_ids=batch.get("segment_ids"))
+            segment_ids=batch.get("segment_ids"),
+            seq_axis=pipeline.get("seq_axis"))
+        aux = jnp.zeros((), jnp.float32)
+        if isinstance(out, tuple):
+            # MoE-PP: the Switch load-balance aux rides out of the
+            # pipeline (per-microbatch statistic, see pipeline_forward).
+            out, raw_aux = out
+            aux = model.cfg.router_aux_coef * raw_aux
         if hidden:
             head, vocab_major = _unembed_head(params)
             main = chunked_cross_entropy(
@@ -249,7 +257,7 @@ def make_train_step(
         else:
             main = cross_entropy_loss(out, batch["targets"],
                                       batch.get("mask"))
-        return main, jnp.zeros((), jnp.float32)
+        return main + aux, aux
 
     def compute_loss(params, batch):
         # mutable=["aux_loss"]: MoE routers sow load-balance penalties there

@@ -135,19 +135,23 @@ class Trainer:
                     f"mesh.pipe={self.mesh.shape['pipe']} needs strategy "
                     f"'pipeline' (or the default), not {strategy!r}")
             if spec.ring_attention:
+                # With PP, mesh.seq IS the CP switch (CP-inside-PP rides
+                # the pipeline shard_map region); the scanned-model
+                # ring_attention spec knob is the wrong mechanism.
                 raise ValueError(
-                    "pipeline parallelism doesn't compose with "
-                    "ring_attention (PP v1)")
-            bad_axes = [a for a in ("tensor", "seq", "expert")
-                        if self.mesh.shape[a] > 1]
-            if bad_axes:
+                    "pipeline parallelism doesn't take ring_attention — "
+                    "set mesh.seq > 1 for context parallelism inside the "
+                    "pipeline")
+            if self.mesh.shape["tensor"] > 1:
                 # The pipeline shard_map would silently REPLICATE the
-                # trunk over these axes (full weights + redundant compute
+                # trunk over this axis (full weights + redundant compute
                 # on every rank) — refuse rather than quietly burn 2x the
-                # provisioned HBM/FLOPs. PP v1 composes with data/fsdp.
+                # provisioned HBM/FLOPs. PP composes with data/fsdp (DP
+                # rows), seq (CP inside the stage region), and expert
+                # (MoE-PP; checked against the model below).
                 raise ValueError(
-                    f"pipeline parallelism doesn't compose with mesh axes "
-                    f"{bad_axes} (PP v1 composes with data/fsdp only)")
+                    "pipeline parallelism doesn't compose with mesh axes "
+                    "['tensor'] (PP composes with data/fsdp/seq/expert)")
             unknown = set(spec.pipeline) - {"microbatches", "chunks"}
             if unknown:
                 raise ValueError(
@@ -163,8 +167,19 @@ class Trainer:
                     "microbatches", self.mesh.shape["pipe"])),
                 "chunks": int(spec.pipeline.get("chunks", 1)),
             }
+            if self.mesh.shape["seq"] > 1:
+                self._pipeline["seq_axis"] = "seq"
         self.model, self.info = registry.build_model(
             spec.model, **model_kwargs)
+        if (self._pipeline is not None
+                and self.mesh.shape["expert"] > 1):
+            from kubeflow_tpu.models.moe import MoEConfig
+
+            if not isinstance(getattr(self.model, "cfg", None), MoEConfig):
+                # A dense trunk would silently replicate over `expert`.
+                raise ValueError(
+                    "mesh.expert with pipeline parallelism needs a "
+                    "MoE model (routed-expert FFNs)")
 
         if spec.accum_steps < 1:
             raise ValueError(f"accum_steps must be >= 1, got "
