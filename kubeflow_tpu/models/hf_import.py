@@ -119,21 +119,21 @@ def _stack(tensors: dict[str, np.ndarray], fmt: str, n: int,
     return np.stack([transform(tensors[fmt.format(i=i)]) for i in range(n)])
 
 
-def import_llama(path: str, *, scan_layers: bool = True,
-                 **config_overrides: Any) -> tuple[LlamaConfig, dict]:
-    """HF Llama checkpoint dir → (LlamaConfig, flax params).
+def _lin(w: np.ndarray) -> np.ndarray:
+    """torch Linear [out, in] -> flax kernel [in, out]."""
+    return np.ascontiguousarray(w.T)
 
-    The returned tree matches `Llama(cfg).init(...)` exactly (asserted by
-    tests/test_hf_import.py), with the scanned trunk's leading layer axis
-    when scan_layers=True.
-    """
-    hf = read_hf_config(path)
-    arch = (hf.get("architectures") or ["LlamaForCausalLM"])[0]
-    if "Llama" not in arch and "Mistral" not in arch:
-        raise ValueError(f"import_llama cannot load architecture {arch!r}")
-    cfg = llama_config_from_hf(hf, scan_layers=scan_layers,
-                               **config_overrides)
-    t = load_safetensors_dir(path)
+
+def _llama_family_params(t: dict, cfg, scan_layers: bool,
+                         mlp: dict) -> dict:
+    """Shared Llama-family mapping — attention/norm/embed/lm_head tensors
+    are identical across Llama, Mistral, and Mixtral checkpoints; `mlp` is
+    the per-family FFN subtree (leaves stacked over layers). One copy so a
+    layout fix can never reach one family and miss another.
+
+    Leaves on a path containing 'router' keep fp32 (routing numerics
+    decide expert assignment — MoEBlock declares the param fp32);
+    everything else casts to cfg.param_dtype."""
     h, nh, nkh, hd = (cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads,
                       cfg.head_dim)
     L = cfg.num_layers
@@ -144,9 +144,6 @@ def import_llama(path: str, *, scan_layers: bool = True,
 
     def ov(w):  # torch [H, nh*hd] -> flax [nh, hd, H]
         return np.ascontiguousarray(w.T).reshape(nh, hd, h)
-
-    def lin(w):  # torch [out, in] -> flax [in, out]
-        return np.ascontiguousarray(w.T)
 
     p = "model.layers.{i}."
     layers = {
@@ -164,14 +161,7 @@ def import_llama(path: str, *, scan_layers: bool = True,
             "o_proj": {"kernel": _stack(
                 t, p + "self_attn.o_proj.weight", L, ov)},
         },
-        "mlp": {
-            "gate_proj": {"kernel": _stack(
-                t, p + "mlp.gate_proj.weight", L, lin)},
-            "up_proj": {"kernel": _stack(
-                t, p + "mlp.up_proj.weight", L, lin)},
-            "down_proj": {"kernel": _stack(
-                t, p + "mlp.down_proj.weight", L, lin)},
-        },
+        "mlp": mlp,
     }
     params: dict[str, Any] = {
         "embed": t["model.embed_tokens.weight"],
@@ -182,14 +172,104 @@ def import_llama(path: str, *, scan_layers: bool = True,
             raise KeyError(
                 "checkpoint says tie_word_embeddings=false but has no "
                 "lm_head.weight — refusing to guess (corrupt export?)")
-        params["lm_head"] = {"kernel": lin(t["lm_head.weight"])}
+        params["lm_head"] = {"kernel": _lin(t["lm_head.weight"])}
     if scan_layers:
         params["layers"] = layers
     else:
         for i in range(L):
             params[f"layer_{i}"] = jax.tree.map(lambda x: x[i], layers)
-    params = jax.tree.map(lambda x: jnp.asarray(np.asarray(x, pd)), params)
-    return cfg, params
+
+    def cast(path, x):
+        fp32 = any(getattr(k, "key", None) == "router" for k in path)
+        return jnp.asarray(np.asarray(x, np.float32 if fp32 else pd))
+
+    return jax.tree_util.tree_map_with_path(cast, params)
+
+
+def import_llama(path: str, *, scan_layers: bool = True,
+                 **config_overrides: Any) -> tuple[LlamaConfig, dict]:
+    """HF Llama checkpoint dir → (LlamaConfig, flax params).
+
+    The returned tree matches `Llama(cfg).init(...)` exactly (asserted by
+    tests/test_hf_import.py), with the scanned trunk's leading layer axis
+    when scan_layers=True.
+    """
+    hf = read_hf_config(path)
+    arch = (hf.get("architectures") or ["LlamaForCausalLM"])[0]
+    if "Llama" not in arch and "Mistral" not in arch:
+        raise ValueError(f"import_llama cannot load architecture {arch!r}")
+    cfg = llama_config_from_hf(hf, scan_layers=scan_layers,
+                               **config_overrides)
+    t = load_safetensors_dir(path)
+    p = "model.layers.{i}."
+    mlp = {
+        "gate_proj": {"kernel": _stack(
+            t, p + "mlp.gate_proj.weight", cfg.num_layers, _lin)},
+        "up_proj": {"kernel": _stack(
+            t, p + "mlp.up_proj.weight", cfg.num_layers, _lin)},
+        "down_proj": {"kernel": _stack(
+            t, p + "mlp.down_proj.weight", cfg.num_layers, _lin)},
+    }
+    return cfg, _llama_family_params(t, cfg, scan_layers, mlp)
+
+
+# ---------------------------------------------------------------------------
+# Mixtral (sparse MoE)
+# ---------------------------------------------------------------------------
+
+def import_mixtral(path: str, *, scan_layers: bool = True,
+                   **config_overrides: Any):
+    """HF Mixtral checkpoint dir → (MoEConfig, flax params) for MoELlama.
+
+    The reference serves Mixtral through the same huggingfaceserver entry
+    point as Llama (SURVEY.md §2.2); here the block-sparse MoE FFN maps
+    onto models/moe.py's capacity-based GShard dispatch. HF Mixtral
+    routing is softmax-then-top-k-then-renormalize over all experts —
+    exactly gshard_route's recipe — and inference must be DROPLESS, so
+    the imported config pins capacity_factor = E/K (capacity == S per
+    expert: no token can drop, logits match torch exactly). Serving cost
+    of dropless dispatch scales with S^2·E per row at prefill — fine for
+    the decode path (S=1) and bucketed prefill at serving lengths.
+
+    Weight mapping per layer: block_sparse_moe.gate [E, H] → router
+    [H, E] (fp32); experts.{e}.w1/w3/w2 [M, H]/[M, H]/[H, M] →
+    w_gate/w_up [E, H, M], w_down [E, M, H]."""
+    from kubeflow_tpu.models.moe import MoEConfig
+
+    hf = read_hf_config(path)
+    arch = (hf.get("architectures") or ["MixtralForCausalLM"])[0]
+    if "Mixtral" not in arch:
+        raise ValueError(f"import_mixtral cannot load architecture {arch!r}")
+    E = int(hf["num_local_experts"])
+    K = int(hf["num_experts_per_tok"])
+    base = llama_config_from_hf(hf, scan_layers=scan_layers)
+    cfg = MoEConfig(
+        **{f.name: getattr(base, f.name)
+           for f in dataclasses.fields(base) if f.init},
+        num_experts=E, experts_per_token=K,
+        capacity_factor=E / K,
+        router_aux_coef=float(hf.get("router_aux_loss_coef", 0.01)))
+    if config_overrides:
+        cfg = dataclasses.replace(cfg, **config_overrides)
+    t = load_safetensors_dir(path)
+    L = cfg.num_layers
+    p = "model.layers.{i}."
+    moe = "block_sparse_moe."
+
+    def experts(i, name):
+        return np.stack([
+            _lin(t[p.format(i=i) + moe + f"experts.{e}.{name}.weight"])
+            for e in range(E)])
+
+    mlp = {
+        # fp32 enforced by path name in _llama_family_params.
+        "router": np.stack([
+            _lin(t[p.format(i=i) + moe + "gate.weight"]) for i in range(L)]),
+        "w_gate": np.stack([experts(i, "w1") for i in range(L)]),
+        "w_up": np.stack([experts(i, "w3") for i in range(L)]),
+        "w_down": np.stack([experts(i, "w2") for i in range(L)]),
+    }
+    return cfg, _llama_family_params(t, cfg, scan_layers, mlp)
 
 
 # ---------------------------------------------------------------------------
@@ -557,6 +637,11 @@ def build_from_hf(path: str, **overrides: Any):
 
         cfg, params = import_t5(path, **overrides)
         return T5(cfg), cfg, params
+    if "Mixtral" in arch or hf.get("model_type") == "mixtral":
+        from kubeflow_tpu.models.moe import MoELlama
+
+        cfg, params = import_mixtral(path, **overrides)
+        return MoELlama(cfg), cfg, params
     if "T5" in arch or hf.get("model_type", "").endswith("t5"):
         # Catches UMT5 (and future T5 variants) whether declared via
         # architectures OR only via model_type — falling through to

@@ -88,6 +88,11 @@ def quantize_tree(params: Any, *, min_size: int = 4096) -> Any:
         # Dict keys only: boxed params (nn.Partitioned) append attr keys
         # like `.value` that would shadow the trailing param name.
         names = [str(k.key) for k in path if hasattr(k, "key")]
+        if "router" in names:
+            # MoE router: int8 noise can FLIP top-k expert assignment —
+            # a discrete routing change, not a smooth dequant error. The
+            # tensor is bandwidth-trivial next to the experts it gates.
+            return leaf
         a32 = arr.astype(jnp.float32)
         amax = jnp.max(jnp.abs(a32),
                        axis=_contraction_axes(names, arr.ndim),
