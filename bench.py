@@ -256,7 +256,7 @@ def main_longctx() -> None:
     result: dict = {"metric": "longctx", "cases": []}
     if ok:
         result["mode"] = "measured_tpu"
-        for b, s in ((1, 2048), (2, 2048), (1, 4096)):
+        for b, s in ((1, 2048), (2, 2048), (1, 3072), (1, 4096)):
             try:
                 result["cases"].append(longctx.measure(b, s))
             except Exception as e:

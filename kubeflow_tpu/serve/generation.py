@@ -22,6 +22,12 @@ continuous batching — does not map to XLA. The TPU-native shape:
 
 Sampling: greedy (temperature 0), temperature, top-k, and nucleus
 (top-p) sampling — all per-slot and on device.
+
+Speculative decoding (`draft=`): a small draft model proposes gamma
+tokens per step and the target verifies them in ONE forward — greedy
+output stays token-identical to vanilla decode (the first mismatch emits
+the target's own argmax), so the speedup is free of quality loss; see
+`build_spec_decode`. Sampled requests fall back to plain chunked decode.
 """
 
 from __future__ import annotations
@@ -42,10 +48,10 @@ NEG_INF = -1e30
 
 def _chosen_logprob(logits: jax.Array, tok: jax.Array) -> jax.Array:
     """log P(tok) under the UNTEMPERED distribution — the logprob surface
-    OpenAI reports. logits [B, V], tok [B] -> [B] f32."""
-    lse = jax.scipy.special.logsumexp(logits.astype(jnp.float32), axis=-1)
-    gold = jnp.take_along_axis(
-        logits.astype(jnp.float32), tok[:, None], axis=-1)[:, 0]
+    OpenAI reports. logits [..., V], tok [...] -> [...] f32."""
+    l32 = logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(l32, axis=-1)
+    gold = jnp.take_along_axis(l32, tok[..., None], axis=-1)[..., 0]
     return gold - lse
 
 
@@ -205,6 +211,93 @@ def build_engine_fns(model, cfg, *, max_len: int, chunk: int,
             "frag_len": frag_len}
 
 
+def build_spec_decode(model, draft_model, *, gamma: int, n_spec: int,
+                      max_len: int):
+    """Speculative decoding step functions (vLLM's draft-model speedup,
+    XLA-shaped): per spec step the DRAFT autoregressively proposes `gamma`
+    tokens (gamma cheap dispans inside the scan), then the TARGET scores
+    all gamma+1 positions in ONE forward — the chunked-prefill path
+    (explicit positions + attend_full_cache), which writes the candidate
+    K/V rows before attending, so rejected rows are simply overwritten by
+    the next step's write at the rewound index. Greedy acceptance:
+    draft tokens match while equal to the target argmax; the first
+    mismatch position emits the target's own token (a correction), so the
+    emitted stream is TOKEN-IDENTICAL to vanilla greedy decode — per
+    step, k accepted + 1 correction/bonus, k in [0, gamma].
+
+    `n_spec` steps ride one dispatch (the tunnel sync amortization that
+    motivates chunked decode; worst case n_spec*(gamma+1) tokens, the
+    caller sizes the cache bucket for it). Returns
+    make(bucket) -> spec_chunk(params, dparams, cache, dcache, last_tok,
+    index) -> (cache, dcache, tokens [B, n_spec, gamma+1],
+    logprobs [B, n_spec, gamma+1], accepted [B, n_spec])."""
+
+    def make(bucket: int):
+        def spec_chunk(params, dparams, cache, dcache, last_tok, index):
+            def sl(c):
+                return (c if bucket == max_len else jax.tree.map(
+                    lambda x: jax.lax.slice_in_dim(x, 0, bucket, axis=2), c))
+
+            sliced, dsliced = sl(cache), sl(dcache)
+
+            def spec_step(carry, _):
+                c, dc, tok, idx = carry
+
+                def dstep(dcarry, _):
+                    dc, t, i = dcarry
+                    dlogits, dc = draft_model.apply(
+                        {"params": dparams}, t[:, None], cache=dc,
+                        cache_index=jnp.minimum(i, bucket - 1))
+                    nxt = jnp.argmax(dlogits[:, 0], -1).astype(jnp.int32)
+                    return (dc, nxt, i + 1), nxt
+
+                # gamma+1 iterations, gamma proposals: the extra step
+                # writes the LAST proposal's K/V into the draft cache
+                # (each iteration caches its INPUT, so d_{gamma-1} —
+                # output-only in a gamma-length scan — would otherwise
+                # leave a stale row after a fully-accepted step, and
+                # every later draft forward would attend garbage there,
+                # collapsing the acceptance rate).
+                (dc, _, _), drafts = jax.lax.scan(
+                    dstep, (dc, tok, idx), None, length=gamma + 1)
+                drafts = drafts.T[:, :gamma]  # [B, gamma]
+
+                tokens_in = jnp.concatenate([tok[:, None], drafts], axis=1)
+                positions = idx[:, None] + jnp.arange(gamma + 1)[None]
+                tlogits, c = model.apply(
+                    {"params": params}, tokens_in, cache=c,
+                    cache_index=jnp.minimum(idx, bucket - 1),
+                    positions=positions, attend_full_cache=True)
+                tgreedy = jnp.argmax(tlogits, -1).astype(jnp.int32)
+                match = drafts == tgreedy[:, :gamma]
+                k = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
+                            axis=1)  # accepted per row
+                j = jnp.arange(gamma + 1)[None]
+                padded = jnp.concatenate(
+                    [drafts, jnp.zeros((drafts.shape[0], 1), jnp.int32)], 1)
+                corr = jnp.take_along_axis(tgreedy, k[:, None], axis=1)
+                out = jnp.where(j < k[:, None], padded,
+                                jnp.where(j == k[:, None], corr, 0))
+                lps = _chosen_logprob(tlogits, out)
+                return (c, dc, corr[:, 0], idx + k + 1), (out, lps, k)
+
+            (sliced, dsliced, _, _), (outs, lps, ks) = jax.lax.scan(
+                spec_step, (sliced, dsliced, last_tok, index), None,
+                length=n_spec)
+
+            def wb(full, s):
+                if bucket == max_len:
+                    return s
+                return jax.tree.map(
+                    lambda c, x: jax.lax.dynamic_update_slice(
+                        c, x, (0,) * c.ndim), full, s)
+
+            return (wb(cache, sliced), wb(dcache, dsliced),
+                    outs.transpose(1, 0, 2), lps.transpose(1, 0, 2), ks.T)
+        return spec_chunk
+    return make
+
+
 class GenerationEngine:
     """Slot-based continuous-batching decode loop over one global cache.
 
@@ -225,7 +318,7 @@ class GenerationEngine:
                  prefill_buckets: Sequence[int] = (32, 128),
                  decode_buckets: Sequence[int] | None = None,
                  prefix_cache: int = 0, seed: int = 0,
-                 mesh=None, rules=None):
+                 mesh=None, rules=None, draft: dict | None = None):
         self.model, self.cfg = model, cfg
         self.max_len, self.chunk, self.n_slots = int(max_len), int(chunk), int(slots)
         msl = int(getattr(cfg, "max_seq_len", 0) or 0)
@@ -297,6 +390,62 @@ class GenerationEngine:
         self._prefix_cap = int(prefix_cache)
         from collections import OrderedDict
         self._prefix_lru: "OrderedDict[tuple, Any]" = OrderedDict()
+        # Speculative decoding (vLLM draft-model speedup): draft =
+        # {"model", "params", "cfg", "gamma"?} — greedy requests decode
+        # speculatively (token-identical to vanilla greedy), sampled
+        # requests fall back to the plain chunked decode.
+        self._spec = None
+        if draft is not None:
+            dcfg = draft["cfg"]
+            if mesh is not None:
+                raise ValueError(
+                    "speculative decoding doesn't compose with a serving "
+                    "mesh yet (draft sharding is future work)")
+            # Same windowed-checkpoint treatment the target gets above: a
+            # Mistral-family draft is exact within its window (rebuild
+            # causal), past it refuse with an actionable message instead
+            # of crashing in the jit trace.
+            dmask = getattr(dcfg, "mask_kind", "causal")
+            if dmask == "sliding_window":
+                dwindow = int(getattr(dcfg, "mask_window", 0))
+                if self.max_len > dwindow:
+                    raise ValueError(
+                        f"sliding-window draft (window={dwindow}): serving "
+                        f"max_len={self.max_len} exceeds the window; set "
+                        "max_len <= window or use a causal draft")
+                import dataclasses
+
+                dcfg = dataclasses.replace(dcfg, mask_kind="causal",
+                                           mask_window=0,
+                                           attention_impl="auto")
+                draft = dict(draft, cfg=dcfg,
+                             model=type(draft["model"])(dcfg))
+            elif dmask != "causal":
+                raise ValueError(
+                    f"speculative decoding needs a causal-class draft; "
+                    f"got mask_kind={dmask!r}")
+            if getattr(dcfg, "vocab_size", None) != cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab {getattr(dcfg, 'vocab_size', None)} != "
+                    f"target vocab {cfg.vocab_size} — speculative "
+                    "acceptance compares token ids, so the vocabularies "
+                    "must be identical")
+            dmsl = int(getattr(dcfg, "max_seq_len", 0) or 0)
+            if dmsl and self.max_len > dmsl:
+                raise ValueError(
+                    f"max_len {self.max_len} exceeds the draft model's "
+                    f"position range (max_seq_len={dmsl})")
+            gamma = int(draft.get("gamma", 4))
+            if gamma < 1:
+                raise ValueError(f"gamma must be >= 1, got {gamma}")
+            self._spec = {
+                "model": draft["model"], "cfg": dcfg, "gamma": gamma,
+                # Spec steps per dispatch: match the vanilla chunk's
+                # best-case token budget so the tunnel-sync amortization
+                # carries over.
+                "n_spec": max(1, self.chunk // (gamma + 1)),
+            }
+            self._dparams = jax.device_put(draft["params"])
         self._mesh = mesh
         if rules is None:
             from kubeflow_tpu.parallel.sharding import DEFAULT_RULES
@@ -313,7 +462,9 @@ class GenerationEngine:
         self._stop = False
         self.stats = {"requests": 0, "prompt_tokens": 0, "decode_tokens": 0,
                       "decode_seconds": 0.0, "decode_dispatches": 0,
-                      "prefix_hits": 0, "prefix_hit_tokens": 0}
+                      "prefix_hits": 0, "prefix_hit_tokens": 0,
+                      "spec_dispatches": 0, "spec_proposed": 0,
+                      "spec_accepted": 0}
         self._compile()
         from kubeflow_tpu.models.llama import init_cache
         with self._scope():
@@ -322,6 +473,9 @@ class GenerationEngine:
                 out_shardings=(None if self._cache_sharding is None else
                                jax.tree.map(lambda _: self._cache_sharding,
                                             {"k": 0, "v": 0})))()
+            if self._spec is not None:
+                self._dcache = jax.jit(lambda: init_cache(
+                    self._spec["cfg"], self.n_slots, self.max_len))()
             self._warmup()
         self._slots = [None] * self.n_slots  # per-slot host state
         self._thread = threading.Thread(
@@ -428,6 +582,29 @@ class GenerationEngine:
             (b, trunc): jax.jit(fns["make_decode"](trunc, b),
                                 donate_argnums=(1,))
             for b in self.decode_buckets for trunc in (False, True)}
+        if self._spec is not None:
+            # The draft runs the SAME admission recipe (chunked cache
+            # writes, no sampling — extend_mid) over its own cache tree.
+            dfns = build_engine_fns(
+                self._spec["model"], self._spec["cfg"],
+                max_len=self.max_len, chunk=self.chunk,
+                prefill_buckets=self.prefill_buckets,
+                offset_writes=True)
+            self._dextend_mid = jax.jit(dfns["extend_mid"],
+                                        donate_argnums=(1,))
+            self._dinsert = jax.jit(dfns["insert"], donate_argnums=(0,))
+            self._dfrag_len = dfns["frag_len"]
+            from kubeflow_tpu.models.llama import init_cache
+
+            self._dfrag_init = jax.jit(
+                lambda: init_cache(self._spec["cfg"], 1, self._dfrag_len))
+            spec_make = build_spec_decode(
+                self.model, self._spec["model"],
+                gamma=self._spec["gamma"], n_spec=self._spec["n_spec"],
+                max_len=self.max_len)
+            self._spec_decode = {
+                b: jax.jit(spec_make(b), donate_argnums=(2, 3))
+                for b in self.decode_buckets}
 
     def _warmup(self):
         """Pay every compile before serving: one prefill per bucket, one
@@ -460,6 +637,17 @@ class GenerationEngine:
                 jnp.zeros((n,), jnp.int32), jnp.zeros((n,), jnp.float32),
                 jnp.zeros((n,), jnp.int32), jnp.ones((n,), jnp.float32),
                 self._key)
+        if self._spec is not None:
+            dfrag = self._dfrag_init()
+            for b in self.prefill_buckets:
+                dfrag = self._dextend_mid(
+                    self._dparams, dfrag, jnp.zeros((1, b), jnp.int32),
+                    zero_k)
+            self._dcache = self._dinsert(self._dcache, dfrag, jnp.int32(0))
+            for fn in self._spec_decode.values():
+                self._cache, self._dcache, _, _, _ = fn(
+                    self._params, self._dparams, self._cache, self._dcache,
+                    jnp.zeros((n,), jnp.int32), jnp.zeros((n,), jnp.int32))
 
     # -- public API ----------------------------------------------------------
 
@@ -601,8 +789,32 @@ class GenerationEngine:
             if self._prefix_cap:
                 self._prefix_store(tuple(ids[:done]), frag)
         self._cache = self._insert(self._cache, frag, jnp.int32(slot))
+        greedy = (req["temperature"] <= 0 and req.get("top_k", 0) == 0
+                  and req.get("top_p", 1.0) >= 1.0)
+        draft_ok = False
+        if self._spec is not None and greedy:
+            # The draft must hold the same prompt history: run the chunked
+            # admission over its own cache (no sampling — the first
+            # generated token reaches the draft as next decode input).
+            # Sampled requests skip this pass: they never decode
+            # speculatively, so their draft rows would be dead weight.
+            dfrag = self._dfrag_init()
+            done = 0
+            while done < len(ids):
+                piece = ids[done:done + big]
+                bucket = self._bucket_for(len(piece))
+                toks = np.zeros((1, bucket), np.int32)
+                toks[0, :len(piece)] = piece
+                dfrag = self._dextend_mid(
+                    self._dparams, dfrag, jnp.asarray(toks),
+                    jnp.asarray([done], jnp.int32))
+                done += len(piece)
+            self._dcache = self._dinsert(self._dcache, dfrag,
+                                         jnp.int32(slot))
+            draft_ok = True
         first = int(tok0[0])
-        self._slots[slot] = {"req": req, "idx": len(ids), "last": first}
+        self._slots[slot] = {"req": req, "idx": len(ids), "last": first,
+                             "draft_ok": draft_ok}
         self.stats["requests"] += 1
         self.stats["prompt_tokens"] += len(ids)
         self._emit(slot, [first], [float(lp0[0])])
@@ -674,6 +886,55 @@ class GenerationEngine:
                 ps[i] = st["req"].get("top_p", 1.0)
             self._key, sub = jax.random.split(self._key)
             t0 = time.monotonic()
+            # Speculative path: all-greedy traffic with a draft model
+            # decodes draft-then-verify (token-identical to vanilla
+            # greedy); any sampled request falls back to plain decode.
+            # Worst-case advance is n_spec*(gamma+1) tokens, so the spec
+            # dispatch needs that much cache headroom — near max_len the
+            # tail decodes vanilla.
+            # draft_ok: a slot's draft cache mirrors its target history
+            # only while every advance went through the spec path — a
+            # vanilla chunk (mixed batch) leaves draft rows unwritten, and
+            # the draft would attend garbage there (acceptance collapses,
+            # spec becomes pure overhead). Such slots decode vanilla for
+            # the rest of their request.
+            all_greedy = all(temps[i] <= 0 and ks[i] == 0 and ps[i] >= 1.0
+                             and self._slots[i].get("draft_ok")
+                             for i in active)
+            if self._spec is not None and all_greedy:
+                worst = self._spec["n_spec"] * (self._spec["gamma"] + 1)
+                need = max(int(idx[i]) for i in active) + worst
+                if need <= self.max_len:
+                    bucket = next(
+                        (b for b in self.decode_buckets if b >= need),
+                        self.max_len)
+                    self._cache, self._dcache, toks, lps, acc = \
+                        self._spec_decode[bucket](
+                            self._params, self._dparams, self._cache,
+                            self._dcache, jnp.asarray(last),
+                            jnp.asarray(idx))
+                    toks = np.asarray(toks)  # [B, n_spec, gamma+1]
+                    lps = np.asarray(lps)
+                    acc = np.asarray(acc)    # [B, n_spec] accepted counts
+                    dt = time.monotonic() - t0
+                    self.stats["decode_seconds"] += dt
+                    self.stats["decode_dispatches"] += 1
+                    self.stats["spec_dispatches"] += 1
+                    for i in active:
+                        emit_t: list[int] = []
+                        emit_l: list[float] = []
+                        for s in range(self._spec["n_spec"]):
+                            kk = int(acc[i, s])
+                            emit_t += [int(t) for t in toks[i, s, :kk + 1]]
+                            emit_l += [float(v) for v in lps[i, s, :kk + 1]]
+                            self.stats["spec_proposed"] += self._spec["gamma"]
+                            self.stats["spec_accepted"] += kk
+                        st = self._slots[i]
+                        st["idx"] += len(emit_t)
+                        st["last"] = emit_t[-1]
+                        self.stats["decode_tokens"] += len(emit_t)
+                        self._emit(i, emit_t, emit_l)
+                    continue
             # Truncation costs a full-vocab sort per step; only pay it
             # when some active request actually asked for top-k/top-p.
             # The cache-length bucket is the smallest covering every
@@ -699,6 +960,9 @@ class GenerationEngine:
                 st = self._slots[i]
                 st["idx"] += self.chunk
                 st["last"] = int(toks[i, -1])
+                # This vanilla chunk left the slot's DRAFT cache rows
+                # unwritten — spec decoding must not trust them again.
+                st["draft_ok"] = False
                 self._emit(i, [int(t) for t in toks[i]],
                            [float(v) for v in lps[i]])
 
@@ -724,6 +988,10 @@ class GenerativeJAXModel(Model):
         # {"tensor": N, ...} from the bundle / ISVC spec — resolved to a
         # device mesh at load() time, when the devices exist.
         self._mesh_spec = dict(self._gen_cfg.pop("mesh", None) or {})
+        # Speculative decoding spec: {"checkpoint": <HF dir>, "gamma": N,
+        # "model_overrides": {...}} — the draft checkpoint is resolved at
+        # load() time (same import path as the target).
+        self._draft_spec = dict(self._gen_cfg.pop("draft", None) or {})
 
     def _build_mesh(self):
         import math
@@ -752,6 +1020,24 @@ class GenerativeJAXModel(Model):
         kwargs = dict(self._gen_cfg)
         if self._mesh_spec:
             kwargs["mesh"] = self._build_mesh()
+        if self._draft_spec:
+            spec = dict(self._draft_spec)
+            ckpt = spec.pop("checkpoint", None)
+            if not ckpt:
+                raise ValueError(
+                    "generative.draft needs a 'checkpoint' (HF dir of "
+                    "the draft model)")
+            from kubeflow_tpu.models.hf_import import build_from_hf
+
+            dmodule, dcfg, dparams = build_from_hf(
+                ckpt, **(spec.pop("model_overrides", None) or {}))
+            draft = {"model": dmodule, "params": dparams, "cfg": dcfg}
+            if "gamma" in spec:
+                draft["gamma"] = int(spec.pop("gamma"))
+            if spec:
+                raise ValueError(
+                    f"unknown generative.draft keys {sorted(spec)}")
+            kwargs["draft"] = draft
         self.engine = GenerationEngine(
             self._model, self._params, self.cfg, **kwargs)
         self.load_time_s = time.monotonic() - t0
@@ -890,4 +1176,5 @@ class GenerativeJAXModel(Model):
         })
         if self.engine:
             md["decode_buckets"] = list(self.engine.decode_buckets)
+            md["speculative"] = self.engine._spec is not None
         return md

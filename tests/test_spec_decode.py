@@ -1,0 +1,197 @@
+"""Speculative decoding: greedy output must be TOKEN-IDENTICAL to vanilla
+decode (draft-verify with argmax acceptance is exact — the first mismatch
+emits the target's own token), acceptance stats must flow, and sampled
+requests must fall back to the plain decode path.
+
+The reference's vLLM runtime ships draft-model speculative decoding as a
+serving speedup (SURVEY.md §2.2); here it is an XLA-shaped scan — gamma
+draft steps + ONE target forward over gamma+1 positions per spec step
+(serve/generation.py build_spec_decode).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models.llama import Llama, llama_tiny
+from kubeflow_tpu.serve.generation import GenerationEngine
+
+pytestmark = pytest.mark.slow  # AOT warmup tier
+
+
+def _cfg(**kw):
+    fields = dict(num_layers=2, attention_impl="naive",
+                  dtype=jnp.float32, param_dtype=jnp.float32)
+    fields.update(kw)
+    return dataclasses.replace(llama_tiny(), **fields)
+
+
+def _params(cfg, seed=0):
+    import flax.linen as nn
+
+    model = Llama(cfg)
+    toks = jnp.zeros((1, 8), jnp.int32)
+    return model, nn.meta.unbox(
+        model.init(jax.random.key(seed), toks)["params"])
+
+
+@pytest.fixture(scope="module")
+def target():
+    cfg = _cfg()
+    model, params = _params(cfg, seed=0)
+    return cfg, model, params
+
+
+def _engine(target, draft=None, **kw):
+    cfg, model, params = target
+    kw.setdefault("slots", 2)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("chunk", 8)
+    kw.setdefault("prefill_buckets", (8,))
+    return GenerationEngine(model, params, cfg, draft=draft, **kw)
+
+
+def test_spec_greedy_identical_self_draft(target):
+    """Draft == target: every proposal is accepted and the output equals
+    vanilla greedy exactly (the strongest identity check — any cache or
+    position bug in the verify path would diverge)."""
+    cfg, model, params = target
+    vanilla = _engine(target)
+    try:
+        ref = vanilla.submit([5, 9, 2], max_tokens=24, temperature=0.0)
+    finally:
+        vanilla.close()
+    spec = _engine(target, draft={"model": model, "params": params,
+                                  "cfg": cfg, "gamma": 3})
+    try:
+        out = spec.submit([5, 9, 2], max_tokens=24, temperature=0.0)
+        assert out["output_ids"] == ref["output_ids"]
+        np.testing.assert_allclose(out["output_logprobs"],
+                                   ref["output_logprobs"], rtol=1e-4,
+                                   atol=1e-5)
+        s = spec.stats
+        assert s["spec_dispatches"] > 0
+        assert s["spec_proposed"] > 0
+        # Identical draft: every proposed token is accepted.
+        assert s["spec_accepted"] == s["spec_proposed"]
+    finally:
+        spec.close()
+
+
+def test_spec_greedy_identical_weak_draft(target):
+    """A DIFFERENT draft (other random init — disagrees with the target
+    almost everywhere): output must STILL be token-identical to vanilla
+    greedy; a weak draft only costs acceptance rate, never correctness."""
+    cfg, model, params = target
+    dcfg = _cfg(num_layers=1)
+    dmodel, dparams = _params(dcfg, seed=7)
+    vanilla = _engine(target)
+    try:
+        ref = vanilla.submit([11, 4], max_tokens=20, temperature=0.0)
+    finally:
+        vanilla.close()
+    spec = _engine(target, draft={"model": dmodel, "params": dparams,
+                                  "cfg": dcfg, "gamma": 4})
+    try:
+        out = spec.submit([11, 4], max_tokens=20, temperature=0.0)
+        assert out["output_ids"] == ref["output_ids"]
+        s = spec.stats
+        assert s["spec_accepted"] <= s["spec_proposed"]
+    finally:
+        spec.close()
+
+
+def test_spec_sampled_requests_fall_back(target):
+    """temperature > 0 must take the vanilla decode path (spec v1 is
+    greedy-exact only) — and still produce tokens."""
+    cfg, model, params = target
+    spec = _engine(target, draft={"model": model, "params": params,
+                                  "cfg": cfg, "gamma": 3})
+    try:
+        out = spec.submit([5, 9, 2], max_tokens=8, temperature=0.8)
+        assert len(out["output_ids"]) == 8
+        assert spec.stats["spec_dispatches"] == 0
+    finally:
+        spec.close()
+
+
+def test_spec_long_prompt_chunked_admission(target):
+    """Prompts longer than the largest prefill bucket reach the draft
+    cache through the same chunked admission — output identical to
+    vanilla greedy."""
+    cfg, model, params = target
+    prompt = list(np.random.default_rng(0).integers(1, 60, 20))
+    vanilla = _engine(target)
+    try:
+        ref = vanilla.submit(prompt, max_tokens=12, temperature=0.0)
+    finally:
+        vanilla.close()
+    spec = _engine(target, draft={"model": model, "params": params,
+                                  "cfg": cfg, "gamma": 3})
+    try:
+        out = spec.submit(prompt, max_tokens=12, temperature=0.0)
+        assert out["output_ids"] == ref["output_ids"]
+    finally:
+        spec.close()
+
+
+def test_spec_mixed_batch_stays_correct(target):
+    """A sampled request sharing the slot batch forces vanilla chunks;
+    the greedy request's draft cache goes stale (draft_ok gate) and it
+    finishes on the vanilla path — output still identical to reference
+    greedy."""
+    import threading
+
+    cfg, model, params = target
+    vanilla = _engine(target)
+    try:
+        ref = vanilla.submit([5, 9, 2], max_tokens=24, temperature=0.0)
+    finally:
+        vanilla.close()
+    spec = _engine(target, draft={"model": model, "params": params,
+                                  "cfg": cfg, "gamma": 3})
+    try:
+        results = {}
+
+        def greedy():
+            results["g"] = spec.submit([5, 9, 2], max_tokens=24,
+                                       temperature=0.0)
+
+        def sampled():
+            results["s"] = spec.submit([8, 1], max_tokens=16,
+                                       temperature=0.9)
+
+        ts = [threading.Thread(target=greedy),
+              threading.Thread(target=sampled)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+        assert results["g"]["output_ids"] == ref["output_ids"]
+        assert len(results["s"]["output_ids"]) == 16
+    finally:
+        spec.close()
+
+
+def test_spec_rejects_vocab_mismatch(target):
+    cfg, model, params = target
+    dcfg = _cfg(vocab_size=cfg.vocab_size * 2)
+    dmodel, dparams = _params(dcfg, seed=1)
+    with pytest.raises(ValueError, match="vocab"):
+        _engine(target, draft={"model": dmodel, "params": dparams,
+                               "cfg": dcfg})
+
+
+def test_spec_rejects_mesh(target):
+    cfg, model, params = target
+    from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    mesh = build_mesh(MeshConfig(tensor=2), jax.devices()[:2])
+    with pytest.raises(ValueError, match="mesh"):
+        _engine(target, mesh=mesh,
+                draft={"model": model, "params": params, "cfg": cfg})
