@@ -85,6 +85,10 @@ def llama_config_from_hf(hf: dict, **overrides: Any) -> LlamaConfig:
         rms_eps=float(hf.get("rms_norm_eps", 1e-5)),
         tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
     )
+    # Qwen2 ships QKV biases (HF LlamaConfig exposes attention_bias
+    # explicitly; Qwen2Config implies it).
+    fields["attention_bias"] = bool(
+        hf.get("attention_bias", hf.get("model_type") == "qwen2"))
     scaling = hf.get("rope_scaling")
     if scaling:
         rtype = scaling.get("rope_type") or scaling.get("type")
@@ -101,7 +105,10 @@ def llama_config_from_hf(hf: dict, **overrides: Any) -> LlamaConfig:
                     scaling.get("high_freq_factor", 4.0)),
                 rope_scaling_original_max_len=int(
                     scaling.get("original_max_position_embeddings", 8192)))
-    if hf.get("sliding_window"):
+    if hf.get("sliding_window") and hf.get("use_sliding_window", True):
+        # (Qwen2 configs carry a sliding_window value with
+        # use_sliding_window=false — windowing disabled — so the gate
+        # must read both fields.)
         # Mistral-style windowed attention maps onto the flash kernel's
         # banded MaskSpec (ops/flash_attention.py kind="sliding_window" —
         # blocks beyond the band are skipped, not masked). The serving
@@ -146,21 +153,29 @@ def _llama_family_params(t: dict, cfg, scan_layers: bool,
         return np.ascontiguousarray(w.T).reshape(nh, hd, h)
 
     p = "model.layers.{i}."
+    attn = {
+        "q_proj": {"kernel": _stack(
+            t, p + "self_attn.q_proj.weight", L, lambda w: qk(w, nh))},
+        "k_proj": {"kernel": _stack(
+            t, p + "self_attn.k_proj.weight", L, lambda w: qk(w, nkh))},
+        "v_proj": {"kernel": _stack(
+            t, p + "self_attn.v_proj.weight", L, lambda w: qk(w, nkh))},
+        "o_proj": {"kernel": _stack(
+            t, p + "self_attn.o_proj.weight", L, ov)},
+    }
+    if getattr(cfg, "attention_bias", False):
+        # Qwen2-family QKV biases: torch [heads*hd] -> flax [heads, hd].
+        for name, heads in (("q_proj", nh), ("k_proj", nkh),
+                            ("v_proj", nkh)):
+            attn[name]["bias"] = _stack(
+                t, p + f"self_attn.{name}.bias", L,
+                lambda b, heads=heads: b.reshape(heads, hd))
     layers = {
         "input_norm": {"scale": _stack(
             t, p + "input_layernorm.weight", L, lambda w: w)},
         "post_attn_norm": {"scale": _stack(
             t, p + "post_attention_layernorm.weight", L, lambda w: w)},
-        "attn": {
-            "q_proj": {"kernel": _stack(
-                t, p + "self_attn.q_proj.weight", L, lambda w: qk(w, nh))},
-            "k_proj": {"kernel": _stack(
-                t, p + "self_attn.k_proj.weight", L, lambda w: qk(w, nkh))},
-            "v_proj": {"kernel": _stack(
-                t, p + "self_attn.v_proj.weight", L, lambda w: qk(w, nkh))},
-            "o_proj": {"kernel": _stack(
-                t, p + "self_attn.o_proj.weight", L, ov)},
-        },
+        "attn": attn,
         "mlp": mlp,
     }
     params: dict[str, Any] = {
@@ -196,7 +211,10 @@ def import_llama(path: str, *, scan_layers: bool = True,
     """
     hf = read_hf_config(path)
     arch = (hf.get("architectures") or ["LlamaForCausalLM"])[0]
-    if "Llama" not in arch and "Mistral" not in arch:
+    if ("Qwen2Moe" in arch
+            or not any(fam in arch for fam in ("Llama", "Mistral", "Qwen2"))):
+        # "Qwen2" must not admit Qwen2MoeForCausalLM — its expert tensors
+        # would die below with an opaque missing-key error.
         raise ValueError(f"import_llama cannot load architecture {arch!r}")
     cfg = llama_config_from_hf(hf, scan_layers=scan_layers,
                                **config_overrides)
@@ -642,6 +660,13 @@ def build_from_hf(path: str, **overrides: Any):
 
         cfg, params = import_mixtral(path, **overrides)
         return MoELlama(cfg), cfg, params
+    if "Qwen2Moe" in arch or hf.get("model_type") == "qwen2_moe":
+        # Qwen2-MoE adds shared experts + a different gate recipe than
+        # Mixtral; importing it as dense Qwen2 would crash on missing
+        # tensors (or worse, as Mixtral with wrong routing).
+        raise ValueError(
+            f"unsupported architecture {arch!r} (dense Qwen2 and Mixtral "
+            "MoE are implemented; Qwen2-MoE's shared-expert block is not)")
     if "T5" in arch or hf.get("model_type", "").endswith("t5"):
         # Catches UMT5 (and future T5 variants) whether declared via
         # architectures OR only via model_type — falling through to

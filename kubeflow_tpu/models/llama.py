@@ -51,6 +51,9 @@ class LlamaConfig:
     tie_embeddings: bool = False
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
+    # QKV projection biases (Qwen2-family checkpoints; o_proj stays
+    # bias-free, matching HF).
+    attention_bias: bool = False
     # auto | naive | flash | ring | ring_flash | zigzag | zigzag_flash
     # (*_flash = fused Pallas inner block per ring step)
     attention_impl: str = "auto"
@@ -89,6 +92,8 @@ class LlamaConfig:
         h, m, v = self.hidden_size, self.intermediate_size, self.vocab_size
         qkv = h * self.num_heads * self.head_dim + 2 * h * self.num_kv_heads * self.head_dim
         attn = qkv + self.num_heads * self.head_dim * h
+        if self.attention_bias:
+            attn += (self.num_heads + 2 * self.num_kv_heads) * self.head_dim
         mlp = 3 * h * m
         norms = 2 * h
         per_layer = attn + mlp + norms
@@ -206,18 +211,26 @@ class Attention(nn.Module):
         dense = partial(
             nn.DenseGeneral, use_bias=False, dtype=cfg.dtype,
             param_dtype=cfg.param_dtype)
+        qkv_bias = dict()
+        if cfg.attention_bias:
+            # Qwen2-style QKV biases; [heads, head_dim] shards like the
+            # kernel's output dims.
+            qkv_bias = dict(
+                use_bias=True,
+                bias_init=nn.with_logical_partitioning(
+                    nn.initializers.zeros_init(), ("heads", "kv")))
         q = dense(features=(cfg.num_heads, cfg.head_dim),
                   kernel_init=nn.with_logical_partitioning(
                       nn.initializers.lecun_normal(), ("qkv_embed", "heads", "kv")),
-                  name="q_proj")(x)
+                  name="q_proj", **qkv_bias)(x)
         k = dense(features=(cfg.num_kv_heads, cfg.head_dim),
                   kernel_init=nn.with_logical_partitioning(
                       nn.initializers.lecun_normal(), ("qkv_embed", "heads", "kv")),
-                  name="k_proj")(x)
+                  name="k_proj", **qkv_bias)(x)
         v = dense(features=(cfg.num_kv_heads, cfg.head_dim),
                   kernel_init=nn.with_logical_partitioning(
                       nn.initializers.lecun_normal(), ("qkv_embed", "heads", "kv")),
-                  name="v_proj")(x)
+                  name="v_proj", **qkv_bias)(x)
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
         q = nn.with_logical_constraint(q, ("batch", "act_seq", "act_heads", "act_kv"))

@@ -100,6 +100,10 @@ def layer_fwd(cfg: LlamaConfig, lp: dict, x: jax.Array, cos: jax.Array,
     q = jnp.einsum("bsh,hnd->bsnd", h, lp["attn"]["q_proj"]["kernel"].astype(dt))
     k = jnp.einsum("bsh,hnd->bsnd", h, lp["attn"]["k_proj"]["kernel"].astype(dt))
     v = jnp.einsum("bsh,hnd->bsnd", h, lp["attn"]["v_proj"]["kernel"].astype(dt))
+    if "bias" in lp["attn"]["q_proj"]:  # Qwen2-family QKV biases
+        q = q + lp["attn"]["q_proj"]["bias"].astype(dt)
+        k = k + lp["attn"]["k_proj"]["bias"].astype(dt)
+        v = v + lp["attn"]["v_proj"]["bias"].astype(dt)
     q = apply_rope(q, cos, sin, positions)
     k = apply_rope(k, cos, sin, positions)
     mask = cfg.mask_spec

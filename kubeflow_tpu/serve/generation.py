@@ -415,11 +415,20 @@ class GenerationEngine:
                         "max_len <= window or use a causal draft")
                 import dataclasses
 
+                from kubeflow_tpu.serve.quant import QuantizedModule
+
                 dcfg = dataclasses.replace(dcfg, mask_kind="causal",
                                            mask_window=0,
                                            attention_impl="auto")
-                draft = dict(draft, cfg=dcfg,
-                             model=type(draft["model"])(dcfg))
+                dmodel = draft["model"]
+                if isinstance(dmodel, QuantizedModule):
+                    # Rebuild the INNER module; the wrapper takes
+                    # (module, dtype), not a config.
+                    dmodel = QuantizedModule(type(dmodel.module)(dcfg),
+                                             dmodel.dtype)
+                else:
+                    dmodel = type(dmodel)(dcfg)
+                draft = dict(draft, cfg=dcfg, model=dmodel)
             elif dmask != "causal":
                 raise ValueError(
                     f"speculative decoding needs a causal-class draft; "
@@ -1023,20 +1032,23 @@ class GenerativeJAXModel(Model):
         if self._draft_spec:
             spec = dict(self._draft_spec)
             ckpt = spec.pop("checkpoint", None)
+            overrides = spec.pop("model_overrides", None) or {}
+            gamma = spec.pop("gamma", None)
+            if spec:
+                # Validate BEFORE the (potentially GB-scale) checkpoint
+                # import — a typo'd key must fail in milliseconds.
+                raise ValueError(
+                    f"unknown generative.draft keys {sorted(spec)}")
             if not ckpt:
                 raise ValueError(
                     "generative.draft needs a 'checkpoint' (HF dir of "
                     "the draft model)")
             from kubeflow_tpu.models.hf_import import build_from_hf
 
-            dmodule, dcfg, dparams = build_from_hf(
-                ckpt, **(spec.pop("model_overrides", None) or {}))
+            dmodule, dcfg, dparams = build_from_hf(ckpt, **overrides)
             draft = {"model": dmodule, "params": dparams, "cfg": dcfg}
-            if "gamma" in spec:
-                draft["gamma"] = int(spec.pop("gamma"))
-            if spec:
-                raise ValueError(
-                    f"unknown generative.draft keys {sorted(spec)}")
+            if gamma is not None:
+                draft["gamma"] = int(gamma)
             kwargs["draft"] = draft
         self.engine = GenerationEngine(
             self._model, self._params, self.cfg, **kwargs)

@@ -93,6 +93,11 @@ def quantize_tree(params: Any, *, min_size: int = 4096) -> Any:
             # a discrete routing change, not a smooth dequant error. The
             # tensor is bandwidth-trivial next to the experts it gates.
             return leaf
+        if names and names[-1] == "bias":
+            # Additive biases (Qwen2 QKV): bandwidth-trivial, and the
+            # name-based contraction-axis table below is kernel-shaped —
+            # it would pick a nonsense scale axis for a bias tensor.
+            return leaf
         a32 = arr.astype(jnp.float32)
         amax = jnp.max(jnp.abs(a32),
                        axis=_contraction_axes(names, arr.ndim),
