@@ -26,8 +26,11 @@ Sampling: greedy (temperature 0), temperature, top-k, and nucleus
 Speculative decoding (`draft=`): a small draft model proposes gamma
 tokens per step and the target verifies them in ONE forward — greedy
 output stays token-identical to vanilla decode (the first mismatch emits
-the target's own argmax), so the speedup is free of quality loss; see
-`build_spec_decode`. Sampled requests fall back to plain chunked decode.
+the target's own argmax), and plain-temperature requests use the
+standard rejection scheme whose emitted marginal IS the tempered target
+distribution (`spec_acceptance`) — the speedup is free of quality loss
+either way; see `build_spec_decode`. Top-k/top-p requests fall back to
+plain chunked decode.
 """
 
 from __future__ import annotations
@@ -211,45 +214,115 @@ def build_engine_fns(model, cfg, *, max_len: int, chunk: int,
             "frag_len": frag_len}
 
 
+def spec_acceptance(drafts, dlogits, tlogits, temperature, key):
+    """Per-row speculative acceptance — greedy rows exact-match, sampled
+    rows the standard rejection scheme (Leviathan/Chen): the draft
+    proposed d_j ~ p_d (its temperature-scaled softmax), the target
+    accepts with prob min(1, p_t(d_j)/p_d(d_j)) and on first rejection
+    emits a sample from the residual normalize(max(p_t - p_d, 0)); full
+    acceptance emits a bonus sample from p_t[gamma]. The emitted marginal
+    at every position is EXACTLY the target's tempered distribution — a
+    weak draft costs acceptance rate, never the sampling law.
+
+    drafts [B, gamma] (greedy rows: argmax proposals; sampled rows: draws
+    from p_d), dlogits [B, gamma, V] draft logits per proposal position,
+    tlogits [B, gamma+1, V] target logits, temperature [B] (<=0 greedy).
+    Returns (out [B, gamma+1] emitted tokens incl. correction/bonus,
+    k [B] accepted counts, next_tok [B])."""
+    b, gamma = drafts.shape
+    sampled = temperature > 0
+    safe_t = jnp.maximum(temperature, 1e-4)[:, None, None]
+    tprobs = jax.nn.softmax(tlogits.astype(jnp.float32) / safe_t, axis=-1)
+    dprobs = jax.nn.softmax(dlogits.astype(jnp.float32) / safe_t, axis=-1)
+    tgreedy = jnp.argmax(tlogits, -1).astype(jnp.int32)  # [B, gamma+1]
+
+    pt_d = jnp.take_along_axis(tprobs[:, :gamma], drafts[..., None],
+                               axis=-1)[..., 0]          # [B, gamma]
+    pd_d = jnp.take_along_axis(dprobs, drafts[..., None],
+                               axis=-1)[..., 0]
+    ukey, rkey = jax.random.split(key)
+    u = jax.random.uniform(ukey, (b, gamma))
+    accept_sampled = u < pt_d / jnp.maximum(pd_d, 1e-30)
+    accept_greedy = drafts == tgreedy[:, :gamma]
+    accept = jnp.where(sampled[:, None], accept_sampled, accept_greedy)
+    k = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1)
+
+    # Correction at the rejection position (sampled rows): residual
+    # distribution max(p_t - p_d, 0) renormalized; on full acceptance the
+    # "residual" at position gamma is p_t itself (p_d defined 0 there).
+    dprobs_pad = jnp.concatenate(
+        [dprobs, jnp.zeros_like(dprobs[:, :1])], axis=1)  # [B, gamma+1, V]
+    pt_k = jnp.take_along_axis(tprobs, k[:, None, None], axis=1)[:, 0]
+    pd_k = jnp.take_along_axis(dprobs_pad, k[:, None, None], axis=1)[:, 0]
+    resid = jnp.maximum(pt_k - pd_k, 0.0)
+    resid_mass = jnp.sum(resid, axis=-1, keepdims=True)
+    # Degenerate residual (identical distributions): fall back to p_t.
+    resid = jnp.where(resid_mass > 1e-30, resid, pt_k)
+    corr_sampled = jax.random.categorical(
+        rkey, jnp.log(jnp.maximum(resid, 1e-30)), axis=-1).astype(jnp.int32)
+    corr_greedy = jnp.take_along_axis(tgreedy, k[:, None], axis=1)[:, 0]
+    corr = jnp.where(sampled, corr_sampled, corr_greedy)
+
+    j = jnp.arange(gamma + 1)[None]
+    padded = jnp.concatenate(
+        [drafts, jnp.zeros((b, 1), jnp.int32)], axis=1)
+    out = jnp.where(j < k[:, None], padded,
+                    jnp.where(j == k[:, None], corr[:, None], 0))
+    return out, k, corr
+
+
 def build_spec_decode(model, draft_model, *, gamma: int, n_spec: int,
                       max_len: int):
     """Speculative decoding step functions (vLLM's draft-model speedup,
     XLA-shaped): per spec step the DRAFT autoregressively proposes `gamma`
-    tokens (gamma cheap dispans inside the scan), then the TARGET scores
+    tokens (gamma cheap forwards inside the scan), then the TARGET scores
     all gamma+1 positions in ONE forward — the chunked-prefill path
     (explicit positions + attend_full_cache), which writes the candidate
     K/V rows before attending, so rejected rows are simply overwritten by
-    the next step's write at the rewound index. Greedy acceptance:
-    draft tokens match while equal to the target argmax; the first
-    mismatch position emits the target's own token (a correction), so the
-    emitted stream is TOKEN-IDENTICAL to vanilla greedy decode — per
-    step, k accepted + 1 correction/bonus, k in [0, gamma].
+    the next step's write at the rewound index. Acceptance per row
+    (spec_acceptance): greedy rows exact-match against the target argmax
+    (emitted stream TOKEN-IDENTICAL to vanilla greedy); tempered rows the
+    rejection scheme (draft samples from p_d, accept w.p. min(1,
+    p_t/p_d), residual sample on rejection) whose emitted marginal is
+    exactly the tempered target distribution — per step, k accepted + 1
+    correction/bonus, k in [0, gamma].
 
     `n_spec` steps ride one dispatch (the tunnel sync amortization that
     motivates chunked decode; worst case n_spec*(gamma+1) tokens, the
     caller sizes the cache bucket for it). Returns
     make(bucket) -> spec_chunk(params, dparams, cache, dcache, last_tok,
-    index) -> (cache, dcache, tokens [B, n_spec, gamma+1],
-    logprobs [B, n_spec, gamma+1], accepted [B, n_spec])."""
+    index, temperature, key) -> (cache, dcache,
+    tokens [B, n_spec, gamma+1], logprobs [B, n_spec, gamma+1],
+    accepted [B, n_spec])."""
 
     def make(bucket: int):
-        def spec_chunk(params, dparams, cache, dcache, last_tok, index):
+        def spec_chunk(params, dparams, cache, dcache, last_tok, index,
+                       temperature, key):
             def sl(c):
                 return (c if bucket == max_len else jax.tree.map(
                     lambda x: jax.lax.slice_in_dim(x, 0, bucket, axis=2), c))
 
             sliced, dsliced = sl(cache), sl(dcache)
+            sampled = temperature > 0
+            safe_t = jnp.maximum(temperature, 1e-4)[:, None]
 
             def spec_step(carry, _):
-                c, dc, tok, idx = carry
+                c, dc, tok, idx, key = carry
+                key, dkey, akey = jax.random.split(key, 3)
 
-                def dstep(dcarry, _):
+                def dstep(dcarry, skey):
                     dc, t, i = dcarry
                     dlogits, dc = draft_model.apply(
                         {"params": dparams}, t[:, None], cache=dc,
                         cache_index=jnp.minimum(i, bucket - 1))
-                    nxt = jnp.argmax(dlogits[:, 0], -1).astype(jnp.int32)
-                    return (dc, nxt, i + 1), nxt
+                    row = dlogits[:, 0]
+                    # Sampled rows draw from the draft's tempered softmax
+                    # (the rejection scheme needs d ~ p_d); greedy rows
+                    # take argmax — exactly sample_tokens' untruncated
+                    # path, reused so proposal sampling can never drift
+                    # from the engine's sampling semantics.
+                    nxt = sample_tokens(row, temperature, skey)
+                    return (dc, nxt, i + 1), (nxt, row)
 
                 # gamma+1 iterations, gamma proposals: the extra step
                 # writes the LAST proposal's K/V into the draft cache
@@ -258,9 +331,11 @@ def build_spec_decode(model, draft_model, *, gamma: int, n_spec: int,
                 # leave a stale row after a fully-accepted step, and
                 # every later draft forward would attend garbage there,
                 # collapsing the acceptance rate).
-                (dc, _, _), drafts = jax.lax.scan(
-                    dstep, (dc, tok, idx), None, length=gamma + 1)
-                drafts = drafts.T[:, :gamma]  # [B, gamma]
+                (dc, _, _), (drafts, dlogits) = jax.lax.scan(
+                    dstep, (dc, tok, idx),
+                    jax.random.split(dkey, gamma + 1))
+                drafts = drafts.T[:, :gamma]           # [B, gamma]
+                dlogits = dlogits.transpose(1, 0, 2)[:, :gamma]
 
                 tokens_in = jnp.concatenate([tok[:, None], drafts], axis=1)
                 positions = idx[:, None] + jnp.arange(gamma + 1)[None]
@@ -268,21 +343,13 @@ def build_spec_decode(model, draft_model, *, gamma: int, n_spec: int,
                     {"params": params}, tokens_in, cache=c,
                     cache_index=jnp.minimum(idx, bucket - 1),
                     positions=positions, attend_full_cache=True)
-                tgreedy = jnp.argmax(tlogits, -1).astype(jnp.int32)
-                match = drafts == tgreedy[:, :gamma]
-                k = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
-                            axis=1)  # accepted per row
-                j = jnp.arange(gamma + 1)[None]
-                padded = jnp.concatenate(
-                    [drafts, jnp.zeros((drafts.shape[0], 1), jnp.int32)], 1)
-                corr = jnp.take_along_axis(tgreedy, k[:, None], axis=1)
-                out = jnp.where(j < k[:, None], padded,
-                                jnp.where(j == k[:, None], corr, 0))
+                out, k, nxt = spec_acceptance(
+                    drafts, dlogits, tlogits, temperature, akey)
                 lps = _chosen_logprob(tlogits, out)
-                return (c, dc, corr[:, 0], idx + k + 1), (out, lps, k)
+                return (c, dc, nxt, idx + k + 1, key), (out, lps, k)
 
-            (sliced, dsliced, _, _), (outs, lps, ks) = jax.lax.scan(
-                spec_step, (sliced, dsliced, last_tok, index), None,
+            (sliced, dsliced, _, _, _), (outs, lps, ks) = jax.lax.scan(
+                spec_step, (sliced, dsliced, last_tok, index, key), None,
                 length=n_spec)
 
             def wb(full, s):
@@ -392,8 +459,10 @@ class GenerationEngine:
         self._prefix_lru: "OrderedDict[tuple, Any]" = OrderedDict()
         # Speculative decoding (vLLM draft-model speedup): draft =
         # {"model", "params", "cfg", "gamma"?} — greedy requests decode
-        # speculatively (token-identical to vanilla greedy), sampled
-        # requests fall back to the plain chunked decode.
+        # speculatively (token-identical to vanilla greedy) and
+        # plain-temperature requests via rejection sampling (exact
+        # tempered-target marginal); top-k/top-p requests fall back to
+        # the plain chunked decode.
         self._spec = None
         if draft is not None:
             dcfg = draft["cfg"]
@@ -656,7 +725,8 @@ class GenerationEngine:
             for fn in self._spec_decode.values():
                 self._cache, self._dcache, _, _, _ = fn(
                     self._params, self._dparams, self._cache, self._dcache,
-                    jnp.zeros((n,), jnp.int32), jnp.zeros((n,), jnp.int32))
+                    jnp.zeros((n,), jnp.int32), jnp.zeros((n,), jnp.int32),
+                    jnp.zeros((n,), jnp.float32), self._key)
 
     # -- public API ----------------------------------------------------------
 
@@ -798,15 +868,17 @@ class GenerationEngine:
             if self._prefix_cap:
                 self._prefix_store(tuple(ids[:done]), frag)
         self._cache = self._insert(self._cache, frag, jnp.int32(slot))
-        greedy = (req["temperature"] <= 0 and req.get("top_k", 0) == 0
-                  and req.get("top_p", 1.0) >= 1.0)
+        spec_able = (req.get("top_k", 0) == 0
+                     and req.get("top_p", 1.0) >= 1.0)
         draft_ok = False
-        if self._spec is not None and greedy:
+        if self._spec is not None and spec_able:
             # The draft must hold the same prompt history: run the chunked
             # admission over its own cache (no sampling — the first
             # generated token reaches the draft as next decode input).
-            # Sampled requests skip this pass: they never decode
-            # speculatively, so their draft rows would be dead weight.
+            # Greedy AND plain-temperature requests decode speculatively
+            # (exact match / rejection sampling); top-k/top-p requests
+            # skip this pass — they never take the spec path, so their
+            # draft rows would be dead weight.
             dfrag = self._dfrag_init()
             done = 0
             while done < len(ids):
@@ -895,22 +967,24 @@ class GenerationEngine:
                 ps[i] = st["req"].get("top_p", 1.0)
             self._key, sub = jax.random.split(self._key)
             t0 = time.monotonic()
-            # Speculative path: all-greedy traffic with a draft model
-            # decodes draft-then-verify (token-identical to vanilla
-            # greedy); any sampled request falls back to plain decode.
-            # Worst-case advance is n_spec*(gamma+1) tokens, so the spec
-            # dispatch needs that much cache headroom — near max_len the
-            # tail decodes vanilla.
+            # Speculative path: greedy traffic decodes draft-then-verify
+            # (token-identical to vanilla greedy) and plain-temperature
+            # traffic via rejection sampling (the emitted marginal IS the
+            # tempered target distribution — spec_acceptance); top-k/
+            # top-p requests fall back to plain decode. Worst-case
+            # advance is n_spec*(gamma+1) tokens, so the spec dispatch
+            # needs that much cache headroom — near max_len the tail
+            # decodes vanilla.
             # draft_ok: a slot's draft cache mirrors its target history
             # only while every advance went through the spec path — a
             # vanilla chunk (mixed batch) leaves draft rows unwritten, and
             # the draft would attend garbage there (acceptance collapses,
             # spec becomes pure overhead). Such slots decode vanilla for
             # the rest of their request.
-            all_greedy = all(temps[i] <= 0 and ks[i] == 0 and ps[i] >= 1.0
-                             and self._slots[i].get("draft_ok")
-                             for i in active)
-            if self._spec is not None and all_greedy:
+            spec_ok = all(ks[i] == 0 and ps[i] >= 1.0
+                          and self._slots[i].get("draft_ok")
+                          for i in active)
+            if self._spec is not None and spec_ok:
                 worst = self._spec["n_spec"] * (self._spec["gamma"] + 1)
                 need = max(int(idx[i]) for i in active) + worst
                 if need <= self.max_len:
@@ -921,7 +995,7 @@ class GenerationEngine:
                         self._spec_decode[bucket](
                             self._params, self._dparams, self._cache,
                             self._dcache, jnp.asarray(last),
-                            jnp.asarray(idx))
+                            jnp.asarray(idx), jnp.asarray(temps), sub)
                     toks = np.asarray(toks)  # [B, n_spec, gamma+1]
                     lps = np.asarray(lps)
                     acc = np.asarray(acc)    # [B, n_spec] accepted counts
