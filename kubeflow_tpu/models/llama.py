@@ -54,6 +54,14 @@ class LlamaConfig:
     # QKV projection biases (Qwen2-family checkpoints; o_proj stays
     # bias-free, matching HF).
     attention_bias: bool = False
+    # LoRA fine-tuning (the reference SDK's PEFT LoraConfig): rank 0 = off.
+    # Adapters add (x @ A) @ B * alpha/rank to the target projections —
+    # q/v (PEFT's Llama default) for "attn", plus gate/up/down for
+    # "attn_mlp". B starts at zero, so step 0 equals the base model; the
+    # train step freezes everything but *_lora_* leaves (train/lora.py).
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
+    lora_targets: str = "attn"  # attn | attn_mlp
     # auto | naive | flash | ring | ring_flash | zigzag | zigzag_flash
     # (*_flash = fused Pallas inner block per ring step)
     attention_impl: str = "auto"
@@ -231,6 +239,15 @@ class Attention(nn.Module):
                   kernel_init=nn.with_logical_partitioning(
                       nn.initializers.lecun_normal(), ("qkv_embed", "heads", "kv")),
                   name="v_proj", **qkv_bias)(x)
+        if cfg.lora_rank > 0:
+            # PEFT's Llama default targets: q_proj + v_proj.
+            h_in = (cfg.hidden_size,)
+            q = q + _lora_delta(self, cfg, "q_proj", x, h_in,
+                                (cfg.num_heads, cfg.head_dim),
+                                ("heads", "kv"))
+            v = v + _lora_delta(self, cfg, "v_proj", x, h_in,
+                                (cfg.num_kv_heads, cfg.head_dim),
+                                ("heads", "kv"))
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
         q = nn.with_logical_constraint(q, ("batch", "act_seq", "act_heads", "act_kv"))
@@ -343,6 +360,37 @@ class Attention(nn.Module):
         return out, new_cache
 
 
+def _lora_delta(mod: nn.Module, cfg: LlamaConfig, name: str, x: jax.Array,
+                in_shape: tuple, out_shape: tuple,
+                out_axes: tuple) -> jax.Array:
+    """(x @ A) @ B * alpha/rank for one target projection. A
+    [*in_shape, r] (small init), B [r, *out_shape] (ZERO init — the
+    adapted model equals the base at step 0, the standard LoRA start).
+    The rank dim is tiny and never sharded; B's output dims follow the
+    base kernel's logical axes so TP shards the delta like the weight."""
+    r = cfg.lora_rank
+    a = mod.param(
+        f"{name}_lora_a",
+        nn.with_logical_partitioning(
+            nn.initializers.normal(0.02),
+            tuple([None] * len(in_shape)) + (None,)),
+        tuple(in_shape) + (r,), cfg.param_dtype)
+    b = mod.param(
+        f"{name}_lora_b",
+        nn.with_logical_partitioning(
+            nn.initializers.zeros_init(), (None,) + tuple(out_axes)),
+        (r,) + tuple(out_shape), cfg.param_dtype)
+    dt = cfg.dtype
+    n_in = len(in_shape)
+    low = jax.lax.dot_general(
+        x.astype(dt), a.astype(dt),
+        (((tuple(range(x.ndim - n_in, x.ndim))), tuple(range(n_in))),
+         ((), ())))
+    delta = jax.lax.dot_general(
+        low, b.astype(dt), (((low.ndim - 1,), (0,)), ((), ())))
+    return delta * (cfg.lora_alpha / r)
+
+
 class MLPBlock(nn.Module):
     cfg: LlamaConfig
 
@@ -351,6 +399,7 @@ class MLPBlock(nn.Module):
         cfg = self.cfg
         dense = partial(nn.DenseGeneral, use_bias=False, dtype=cfg.dtype,
                         param_dtype=cfg.param_dtype)
+        lora_mlp = cfg.lora_rank > 0 and cfg.lora_targets == "attn_mlp"
         gate = dense(features=cfg.intermediate_size,
                      kernel_init=nn.with_logical_partitioning(
                          nn.initializers.lecun_normal(), ("embed", "mlp")),
@@ -359,12 +408,23 @@ class MLPBlock(nn.Module):
                    kernel_init=nn.with_logical_partitioning(
                        nn.initializers.lecun_normal(), ("embed", "mlp")),
                    name="up_proj")(x)
+        if lora_mlp:
+            h = cfg.hidden_size
+            gate = gate + _lora_delta(self, cfg, "gate_proj", x, (h,),
+                                      (cfg.intermediate_size,), ("mlp",))
+            up = up + _lora_delta(self, cfg, "up_proj", x, (h,),
+                                  (cfg.intermediate_size,), ("mlp",))
         h = nn.silu(gate) * up
         h = nn.with_logical_constraint(h, ("batch", "act_seq", "mlp"))
-        return dense(features=cfg.hidden_size,
+        down = dense(features=cfg.hidden_size,
                      kernel_init=nn.with_logical_partitioning(
                          nn.initializers.lecun_normal(), ("mlp", "embed")),
                      name="down_proj")(h)
+        if lora_mlp:
+            down = down + _lora_delta(
+                self, cfg, "down_proj", h, (cfg.intermediate_size,),
+                (cfg.hidden_size,), ("embed",))
+        return down
 
 
 class DecoderLayer(nn.Module):

@@ -303,8 +303,6 @@ def build_spec_decode(model, draft_model, *, gamma: int, n_spec: int,
                     lambda x: jax.lax.slice_in_dim(x, 0, bucket, axis=2), c))
 
             sliced, dsliced = sl(cache), sl(dcache)
-            sampled = temperature > 0
-            safe_t = jnp.maximum(temperature, 1e-4)[:, None]
 
             def spec_step(carry, _):
                 c, dc, tok, idx, key = carry

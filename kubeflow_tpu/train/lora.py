@@ -1,0 +1,85 @@
+"""LoRA fine-tuning utilities — the reference SDK's PEFT LoraConfig
+(⟨kubeflow training SDK: train(..., LoraConfig)⟩; SURVEY.md §2.1 train
+API), TPU-shaped.
+
+The model side lives in models/llama.py (`cfg.lora_rank` adds
+`*_lora_a`/`*_lora_b` leaves to the target projections; B zero-init so
+step 0 equals the base). This module owns the tree plumbing:
+
+  * `partition(params)` — split the tree into (trainable adapters, frozen
+    base) flat dicts. The train step differentiates ONLY the adapter
+    subtree and the optimizer state covers ONLY adapters — that's the
+    LoRA memory win (no fp32 grads / Adam moments for the base, which
+    dominate the full-fine-tune HBM budget).
+  * `merge(params, cfg)` — fold every adapter pair into its base kernel
+    (W += alpha/r * A @ B, cast back to the kernel dtype) and STRIP the
+    lora leaves: the result is a standard base-model tree any serving
+    path loads with zero engine changes.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+from flax import traverse_util
+
+
+def is_lora_path(path: tuple) -> bool:
+    return any("_lora_" in str(p) for p in path)
+
+
+def partition(params: Any) -> tuple[dict, dict]:
+    """params (nested dict) -> (trainable, frozen) NESTED sub-trees.
+
+    Nested (string-keyed) rather than flat tuple-keyed dicts on purpose:
+    the trainable tree becomes the optimizer-state target and rides
+    through orbax checkpointing, whose name-based tree serialization
+    expects ordinary nested containers."""
+    flat = traverse_util.flatten_dict(params)
+    train = {k: v for k, v in flat.items() if is_lora_path(k)}
+    frozen = {k: v for k, v in flat.items() if not is_lora_path(k)}
+    if not train:
+        raise ValueError(
+            "no *_lora_* parameters found — build the model with "
+            "lora_rank > 0")
+    return (traverse_util.unflatten_dict(train),
+            traverse_util.unflatten_dict(frozen))
+
+
+def combine(train: Any, frozen: Any) -> Any:
+    return traverse_util.unflatten_dict(
+        {**traverse_util.flatten_dict(frozen),
+         **traverse_util.flatten_dict(train)})
+
+
+def merge(params: Any, cfg: Any) -> Any:
+    """Fold adapters into base kernels and strip lora leaves. Exact math:
+    the adapted forward computes x@W + (x@A)@B * s, and the merged kernel
+    W + s * reshape(A@B) reproduces it (contraction over the rank dim is
+    associative); verified against the adapted model in
+    tests/test_lora.py."""
+    scale = cfg.lora_alpha / cfg.lora_rank
+    scanned = bool(getattr(cfg, "scan_layers", True))
+    flat = traverse_util.flatten_dict(params)
+    out = {k: v for k, v in flat.items() if not is_lora_path(k)}
+    r = cfg.lora_rank
+    for k in flat:
+        if not str(k[-1]).endswith("_lora_a"):
+            continue
+        base_name = str(k[-1])[: -len("_lora_a")]
+        bk = k[:-1] + (f"{base_name}_lora_b",)
+        kernel_key = k[:-1] + (base_name, "kernel")
+        a = np.asarray(flat[k], np.float32)     # [(L,) *in, r]
+        b = np.asarray(flat[bk], np.float32)    # [(L,) r, *out]
+        w = np.asarray(out[kernel_key])         # [(L,) *in, *out]
+        if scanned:
+            L = a.shape[0]
+            delta = np.matmul(a.reshape(L, -1, r), b.reshape(L, r, -1))
+        else:
+            delta = np.matmul(a.reshape(-1, r), b.reshape(r, -1))
+        merged = (w.astype(np.float32)
+                  + scale * delta.reshape(w.shape))
+        out[kernel_key] = jnp.asarray(merged.astype(w.dtype))
+    return traverse_util.unflatten_dict(out)

@@ -116,6 +116,7 @@ def abstract_train_state(
     mesh: jax.sharding.Mesh,
     rules: Rules = DEFAULT_RULES,
     example_kwargs: dict | None = None,
+    trainable: str | None = None,
 ):
     """(init_fn, abstract_state, shardings): the sharding-layout derivation
     shared by real initialization (init_train_state) and AOT scale proofs
@@ -129,8 +130,16 @@ def abstract_train_state(
     def _init(rng):
         variables = model.init(rng, *example_inputs, **example_kwargs)
         params = variables["params"]
+        opt_target = params
+        if trainable == "lora":
+            # LoRA memory win: optimizer state covers ONLY the adapter
+            # leaves (fp32 Adam moments for the frozen base would
+            # dominate the budget, defeating the point).
+            from kubeflow_tpu.train.lora import partition
+
+            opt_target, _ = partition(dict(params))
         return TrainState(step=jnp.zeros((), jnp.int32), params=params,
-                          opt_state=tx.init(params), tx=tx)
+                          opt_state=tx.init(opt_target), tx=tx)
 
     with mesh, nn.logical_axis_rules(rules):
         abstract = jax.eval_shape(_init, jax.random.key(0))
@@ -147,6 +156,7 @@ def init_train_state(
     mesh: jax.sharding.Mesh,
     rules: Rules = DEFAULT_RULES,
     example_kwargs: dict | None = None,
+    trainable: str | None = None,
 ) -> TrainState:
     """Initialize params already laid out per the sharding rules: we eval_shape
     the init, derive NamedShardings from logical metadata, then run the real
@@ -154,9 +164,10 @@ def init_train_state(
     materialized replicated (essential at 8B scale).
 
     `example_kwargs` rides into model.init for impls whose trace needs the
-    full call contract (e.g. zigzag attention requires explicit positions)."""
+    full call contract (e.g. zigzag attention requires explicit positions).
+    `trainable="lora"` restricts the optimizer state to adapter leaves."""
     _init, _, shardings = abstract_train_state(
-        model, tx, example_inputs, mesh, rules, example_kwargs)
+        model, tx, example_inputs, mesh, rules, example_kwargs, trainable)
     with mesh, nn.logical_axis_rules(rules):
         state = jax.jit(_init, out_shardings=shardings)(rng)
         # Unbox flax logical-partitioning metadata for downstream use.
@@ -173,6 +184,7 @@ def make_train_step(
     loss_chunk: int = 1024,
     pipeline: dict | None = None,
     accum_steps: int = 1,
+    trainable: str | None = None,
 ) -> Callable[[TrainState, dict], tuple[TrainState, dict]]:
     """Build the jitted train step for a causal-LM-style batch:
       batch = {"inputs": [B,S] int32, "targets": [B,S] int32,
@@ -304,11 +316,20 @@ def make_train_step(
         return nn.with_logical_constraint(x, axes + (None,) * (x.ndim - len(axes)))
 
     loss_impl_fn = pipeline_loss if pipeline is not None else compute_loss
+    if trainable not in (None, "lora"):
+        raise ValueError(f"trainable {trainable!r}: None | 'lora'")
+    if trainable == "lora" and pipeline is not None:
+        raise ValueError(
+            "LoRA doesn't compose with pipeline parallelism (the stage "
+            "forward has no adapter path)")
 
-    def step(state: TrainState, batch: dict):
+    def loss_and_grads(loss_fn, target, batch):
+        """(loss, aux, grads) w.r.t. `target`, with the gradient-
+        accumulation scan when accum_steps > 1 — ONE copy of the
+        microbatching machinery shared by full fine-tune and LoRA."""
         if accum_steps > 1:
             # Scan over row-slices; the grad carry costs one extra
-            # params-sized buffer.
+            # target-sized buffer.
             def split(x):
                 if x.shape[0] % accum_steps:
                     raise ValueError(
@@ -322,27 +343,53 @@ def make_train_step(
             def body(carry, mb):
                 mb = jax.tree.map(constrain_batch, mb)
                 (mloss, maux), mgrads = jax.value_and_grad(
-                    loss_impl_fn, has_aux=True)(state.params, mb)
+                    loss_fn, has_aux=True)(target, mb)
                 gsum, lsum, asum = carry
-                gsum = jax.tree.map(jnp.add, gsum, mgrads)
-                return (gsum, lsum + mloss, asum + maux), None
+                return (jax.tree.map(jnp.add, gsum, mgrads), lsum + mloss,
+                        asum + maux), None
 
-            zeros = jax.tree.map(jnp.zeros_like, state.params)
+            zeros = jax.tree.map(jnp.zeros_like, target)
             (gsum, lsum, asum), _ = jax.lax.scan(
                 body, (zeros, jnp.zeros((), jnp.float32),
                        jnp.zeros((), jnp.float32)), micro)
             grads = jax.tree.map(lambda g: g / accum_steps, gsum)
-            loss, aux = lsum / accum_steps, asum / accum_steps
-        else:
-            batch = jax.tree.map(constrain_batch, batch)
-            (loss, aux), grads = jax.value_and_grad(
-                loss_impl_fn, has_aux=True)(state.params, batch)
+            return lsum / accum_steps, asum / accum_steps, grads
+        batch = jax.tree.map(constrain_batch, batch)
+        (loss, aux), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(target, batch)
+        return loss, aux, grads
+
+    def lora_step(state: TrainState, batch: dict):
+        """Differentiate and update ONLY the adapter leaves: grads and
+        optimizer state are adapter-sized (the frozen base never gets a
+        grad buffer or Adam moments — the LoRA memory win)."""
+        from kubeflow_tpu.train.lora import combine, partition
+
+        train_sub, frozen = partition(dict(state.params))
+
+        def sub_loss(tr, b):
+            return loss_impl_fn(combine(tr, frozen), b)
+
+        loss, aux, grads = loss_and_grads(sub_loss, train_sub, batch)
+        updates, new_opt = state.tx.update(grads, state.opt_state,
+                                           train_sub)
+        new_train = optax.apply_updates(train_sub, updates)
+        new_state = state.replace(
+            step=state.step + 1, params=combine(new_train, frozen),
+            opt_state=new_opt)
+        return new_state, {"loss": loss, "aux_loss": aux,
+                           "grad_norm": optax.global_norm(grads),
+                           "step": new_state.step}
+
+    def step(state: TrainState, batch: dict):
+        loss, aux, grads = loss_and_grads(loss_impl_fn, state.params, batch)
         new_state = state.apply_gradients(grads)
         gnorm = optax.global_norm(grads)
         return new_state, {"loss": loss, "aux_loss": aux,
                            "grad_norm": gnorm, "step": new_state.step}
 
-    jitted = jax.jit(step, donate_argnums=(0,))
+    jitted = jax.jit(lora_step if trainable == "lora" else step,
+                     donate_argnums=(0,))
 
     def wrapped(state, batch):
         # Tracing happens on first call, under the mesh + logical-rules

@@ -72,6 +72,11 @@ class TrainJobSpec:
     # GPipe/circular schedule (models/llama_pp.py); params keep the
     # scanned layout, sharded over `pipe` via the "pipeline" rules.
     pipeline: dict = dataclasses.field(default_factory=dict)
+    # LoRA fine-tuning (the reference SDK's PEFT LoraConfig):
+    # {"rank": r, "alpha": a (default 16), "targets": "attn"|"attn_mlp"}.
+    # Adapters are trained, the base is frozen (no base grads or optimizer
+    # state); merge for serving via train/lora.py merge().
+    lora: dict = dataclasses.field(default_factory=dict)
     checkpoint: dict = dataclasses.field(default_factory=dict)
     # {"dir": str, "interval": int, "keep": int}
     metrics_path: str | None = None
@@ -169,8 +174,48 @@ class Trainer:
             }
             if self.mesh.shape["seq"] > 1:
                 self._pipeline["seq_axis"] = "seq"
+        self._trainable = None
+        if spec.lora:
+            unknown = set(spec.lora) - {"rank", "alpha", "targets"}
+            if unknown:
+                raise ValueError(
+                    f"unknown spec.lora keys {sorted(unknown)}; valid: "
+                    "rank, alpha, targets")
+            rank = int(spec.lora.get("rank", 0))
+            if rank < 1:
+                raise ValueError(f"lora.rank must be >= 1, got {rank}")
+            targets = spec.lora.get("targets", "attn")
+            if targets not in ("attn", "attn_mlp"):
+                raise ValueError(
+                    f"lora.targets {targets!r}: attn | attn_mlp")
+            if self._pipeline is not None:
+                raise ValueError(
+                    "LoRA doesn't compose with pipeline parallelism "
+                    "(the stage forward has no adapter path)")
+            model_kwargs["lora_rank"] = rank
+            model_kwargs["lora_alpha"] = float(spec.lora.get("alpha", 16.0))
+            model_kwargs["lora_targets"] = targets
+            self._trainable = "lora"
         self.model, self.info = registry.build_model(
             spec.model, **model_kwargs)
+        if self._trainable == "lora":
+            from kubeflow_tpu.models.llama import LlamaConfig
+            from kubeflow_tpu.models.moe import MoEConfig
+
+            mcfg = getattr(self.model, "cfg", None)
+            if not isinstance(mcfg, LlamaConfig):
+                raise ValueError(
+                    f"spec.lora needs a Llama-family model; "
+                    f"{spec.model!r} has no adapter path")
+            if (isinstance(mcfg, MoEConfig)
+                    and mcfg.lora_targets == "attn_mlp"):
+                # MoEBlock's routed experts have no adapter path — the
+                # user asked for FFN adapters and would silently get
+                # attention-only ones.
+                raise ValueError(
+                    "lora.targets='attn_mlp' is not supported on MoE "
+                    "models (expert FFNs have no adapter path); use "
+                    "targets='attn'")
         if (self._pipeline is not None
                 and self.mesh.shape["expert"] > 1):
             from kubeflow_tpu.models.moe import MoEConfig
@@ -368,7 +413,7 @@ class Trainer:
         state = init_train_state(
             self.model, self.tx, jax.random.key(spec.seed),
             self._example_inputs(), self.mesh, self.rules,
-            example_kwargs=init_kwargs)
+            example_kwargs=init_kwargs, trainable=self._trainable)
 
         start_step = 0
         if self._ckpt is not None:
@@ -384,7 +429,8 @@ class Trainer:
                                   loss_impl=spec.loss_impl,
                                   loss_chunk=spec.loss_chunk,
                                   pipeline=self._pipeline,
-                                  accum_steps=spec.accum_steps)
+                                  accum_steps=spec.accum_steps,
+                                  trainable=self._trainable)
 
         eval_step = None
         if spec.eval_every:
