@@ -1,0 +1,147 @@
+"""PEFT LoRA adapter import: HF `adapter_model.safetensors` → this
+framework's adapter leaves.
+
+The reference ecosystem fine-tunes with HF PEFT (the training SDK's
+LoraConfig produces a PEFT adapter dir: `adapter_config.json` +
+`adapter_model.safetensors`) and serves the result; this module closes
+the loop for checkpoints tuned ELSEWHERE: overlay the adapter onto an
+imported base model (models/hf_import.py) as native `*_lora_*` leaves
+(models/llama.py), then either run the adapted model directly or fold it
+flat with train/lora.py `merge()` and serve a plain base tree.
+
+Layouts: PEFT stores lora_A [r, in] and lora_B [out, r] (torch Linear
+convention); ours are A [in, r] and B [r, *out] — transposes, plus the
+head reshape for attention projections and the leading stacked-layer dim
+for the scanned trunk. Scaling: PEFT applies alpha/r exactly like
+models/llama.py `_lora_delta` (rsLoRA's alpha/sqrt(r) is refused loudly).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_tpu.models.hf_import import load_safetensors_dir
+
+#: target_modules set -> our lora_targets mode.
+_TARGET_MODES = {
+    frozenset({"q_proj", "v_proj"}): "attn",
+    frozenset({"q_proj", "v_proj", "gate_proj", "up_proj",
+               "down_proj"}): "attn_mlp",
+}
+
+
+def read_adapter_config(path: str) -> dict:
+    with open(os.path.join(path, "adapter_config.json")) as f:
+        return json.load(f)
+
+
+def load_peft_adapter(path: str, cfg):
+    """(adapter dir, base LlamaConfig) -> (cfg with lora fields, flat
+    {path tuple: jnp array} adapter leaves matching the scanned model).
+
+    Unsupported adapter shapes fail loudly: silently dropping a target
+    module would serve a model that quietly differs from what was tuned.
+    """
+    ac = read_adapter_config(path)
+    if ac.get("peft_type", "LORA").upper() != "LORA":
+        raise ValueError(
+            f"unsupported peft_type {ac.get('peft_type')!r} (LoRA only)")
+    if ac.get("use_rslora"):
+        raise ValueError(
+            "use_rslora=true scales by alpha/sqrt(r); this build "
+            "implements classic alpha/r scaling only")
+    if ac.get("use_dora"):
+        raise ValueError("DoRA adapters are not supported")
+    if (ac.get("bias") or "none") != "none":
+        raise ValueError(
+            f"adapter bias={ac.get('bias')!r}: bias deltas are not "
+            "implemented (bias='none' only)")
+    if ac.get("modules_to_save"):
+        raise ValueError(
+            f"modules_to_save={ac['modules_to_save']} holds fully-tuned "
+            "modules this importer would silently drop — not supported")
+    if ac.get("alpha_pattern") or ac.get("rank_pattern"):
+        raise ValueError(
+            "per-module alpha_pattern/rank_pattern are not supported "
+            "(one global r/alpha only)")
+    targets = frozenset(ac.get("target_modules") or ())
+    mode = _TARGET_MODES.get(targets)
+    if mode is None:
+        raise ValueError(
+            f"unsupported target_modules {sorted(targets)}; supported: "
+            f"{[sorted(k) for k in _TARGET_MODES]}")
+    r = int(ac["r"])
+    alpha = float(ac.get("lora_alpha", r))
+    from kubeflow_tpu.models.llama import LlamaConfig
+
+    if not isinstance(cfg, LlamaConfig):
+        raise ValueError(
+            f"peft_adapter needs a Llama-family base model; "
+            f"{type(cfg).__name__} has no adapter path")
+    cfg = dataclasses.replace(cfg, lora_rank=r, lora_alpha=alpha,
+                              lora_targets=mode)
+    if not cfg.scan_layers:
+        raise ValueError("adapter import expects the scanned trunk "
+                         "(scan_layers=True)")
+
+    t = load_safetensors_dir(path)
+
+    def find(i: int, module: str, which: str) -> np.ndarray:
+        suffix = f"layers.{i}.{_module_path(module)}.{which}.weight"
+        hits = [k for k in t if k.endswith(suffix)]
+        if len(hits) != 1:
+            raise KeyError(
+                f"expected exactly one tensor ending in {suffix!r}, "
+                f"found {hits}")
+        return t[hits[0]]
+
+    L = cfg.num_layers
+    out_shapes = {
+        "q_proj": (cfg.num_heads, cfg.head_dim),
+        "v_proj": (cfg.num_kv_heads, cfg.head_dim),
+        "gate_proj": (cfg.intermediate_size,),
+        "up_proj": (cfg.intermediate_size,),
+        "down_proj": (cfg.hidden_size,),
+    }
+    modules = (("q_proj", "v_proj") if mode == "attn" else
+               ("q_proj", "v_proj", "gate_proj", "up_proj", "down_proj"))
+    leaves: dict[tuple, Any] = {}
+    for m in modules:
+        group = "attn" if m in ("q_proj", "v_proj") else "mlp"
+        a = np.stack([find(i, m, "lora_A") for i in range(L)])  # [L, r, in]
+        b = np.stack([find(i, m, "lora_B") for i in range(L)])  # [L, out, r]
+        if a.shape[1] != r:
+            raise ValueError(
+                f"{m} lora_A rank dim {a.shape[1]} != config r {r}")
+        a = np.ascontiguousarray(a.transpose(0, 2, 1))  # [L, in, r]
+        b = np.ascontiguousarray(b.transpose(0, 2, 1))  # [L, r, out]
+        b = b.reshape(L, r, *out_shapes[m])
+        pd = np.dtype(jnp.dtype(cfg.param_dtype).name)
+        leaves[("layers", group, f"{m}_lora_a")] = jnp.asarray(
+            a.astype(pd))
+        leaves[("layers", group, f"{m}_lora_b")] = jnp.asarray(
+            b.astype(pd))
+    return cfg, leaves
+
+
+def _module_path(module: str) -> str:
+    return (f"self_attn.{module}" if module.endswith(("q_proj", "v_proj"))
+            else f"mlp.{module}")
+
+
+def attach_peft_adapter(path: str, cfg, params):
+    """Overlay a PEFT adapter onto imported base params: returns
+    (adapted cfg, params carrying *_lora_* leaves) — apply with
+    Llama(adapted_cfg), or fold flat with train/lora.py merge()."""
+    from flax import traverse_util
+
+    cfg, leaves = load_peft_adapter(path, cfg)
+    flat = dict(traverse_util.flatten_dict(params))
+    flat.update(leaves)
+    return cfg, traverse_util.unflatten_dict(flat)

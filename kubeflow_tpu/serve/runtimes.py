@@ -179,6 +179,20 @@ def _huggingface_runtime(model_dir: str, spec: dict) -> Model:
         ckpt = os.path.join(os.path.abspath(model_dir), ckpt)
     overrides = dict(spec.get("model_overrides") or {})
     module, cfg, params = build_from_hf(ckpt, **overrides)
+    adapter = spec.get("peft_adapter")
+    if adapter:
+        # PEFT LoRA adapter dir (tuned here via spec.lora or elsewhere
+        # via HF peft): overlay onto the base and FOLD FLAT — the engine
+        # serves a plain base tree, zero changes downstream
+        # (models/peft_import.py; exactness tested vs the peft-wrapped
+        # torch model).
+        if not os.path.isabs(adapter):
+            adapter = os.path.join(os.path.abspath(model_dir), adapter)
+        from kubeflow_tpu.models.peft_import import attach_peft_adapter
+        from kubeflow_tpu.train.lora import merge
+
+        acfg, aparams = attach_peft_adapter(adapter, cfg, params)
+        params = merge(aparams, acfg)
     is_bert = isinstance(module, Bert)  # before the quantize wrapper
     is_t5 = isinstance(module, T5)
     module, params = _maybe_quantize(module, params, spec)
