@@ -219,16 +219,63 @@ def import_llama(path: str, *, scan_layers: bool = True,
     cfg = llama_config_from_hf(hf, scan_layers=scan_layers,
                                **config_overrides)
     t = load_safetensors_dir(path)
+    return cfg, _llama_family_params(t, cfg, scan_layers,
+                                     _swiglu_mlp(t, cfg.num_layers))
+
+
+def _swiglu_mlp(t: dict, L: int) -> dict:
     p = "model.layers.{i}."
-    mlp = {
+    return {
         "gate_proj": {"kernel": _stack(
-            t, p + "mlp.gate_proj.weight", cfg.num_layers, _lin)},
+            t, p + "mlp.gate_proj.weight", L, _lin)},
         "up_proj": {"kernel": _stack(
-            t, p + "mlp.up_proj.weight", cfg.num_layers, _lin)},
+            t, p + "mlp.up_proj.weight", L, _lin)},
         "down_proj": {"kernel": _stack(
-            t, p + "mlp.down_proj.weight", cfg.num_layers, _lin)},
+            t, p + "mlp.down_proj.weight", L, _lin)},
     }
-    return cfg, _llama_family_params(t, cfg, scan_layers, mlp)
+
+
+# ---------------------------------------------------------------------------
+# Gemma
+# ---------------------------------------------------------------------------
+
+def import_gemma(path: str, *, scan_layers: bool = True,
+                 **config_overrides: Any):
+    """HF Gemma (v1) checkpoint dir → (LlamaConfig, flax params).
+
+    Gemma is Llama-shaped with three convention changes, all config flags
+    on the shared trunk (models/llama.py): zero-centered RMSNorm applied
+    as (1 + w), sqrt(hidden) input-embedding scaling, and a
+    tanh-approximate-GeLU GLU gate. Tensor names match Llama exactly
+    (tied embeddings — no lm_head). Gemma-2/3 add post-norms, logit
+    softcapping, and alternating local attention — refused loudly by the
+    exact-match dispatch, never imported as v1."""
+    hf = read_hf_config(path)
+    arch = (hf.get("architectures") or ["GemmaForCausalLM"])[0]
+    if arch != "GemmaForCausalLM" and hf.get("model_type") != "gemma":
+        raise ValueError(f"import_gemma cannot load architecture {arch!r}")
+    act = (hf.get("hidden_activation") or hf.get("hidden_act")
+           or "gelu_pytorch_tanh")
+    if act not in ("gelu_pytorch_tanh", "gelu"):
+        # HF treats legacy "gelu" configs as the tanh approximation too
+        # (the Gemma release-time config bug); anything else is a model
+        # this trunk does not implement.
+        raise ValueError(f"unsupported Gemma activation {act!r}")
+    fields = dict(
+        scan_layers=scan_layers, norm_plus_one=True, embed_scale=True,
+        mlp_act="gelu_tanh",
+        # GemmaConfig's class default is tied embeddings; saved configs
+        # omit the field (llama's absent-key default is False).
+        tie_embeddings=bool(hf.get("tie_word_embeddings", True)))
+    fields.update(config_overrides)  # caller overrides win (then validate)
+    cfg = llama_config_from_hf(hf, **fields)
+    if not cfg.tie_embeddings:
+        raise ValueError(
+            "Gemma checkpoints tie embeddings; tie_word_embeddings=false "
+            "is not a Gemma-v1 layout")
+    t = load_safetensors_dir(path)
+    return cfg, _llama_family_params(t, cfg, scan_layers,
+                                     _swiglu_mlp(t, cfg.num_layers))
 
 
 # ---------------------------------------------------------------------------
@@ -660,6 +707,15 @@ def build_from_hf(path: str, **overrides: Any):
 
         cfg, params = import_mixtral(path, **overrides)
         return MoELlama(cfg), cfg, params
+    if arch == "GemmaForCausalLM" or hf.get("model_type") == "gemma":
+        cfg, params = import_gemma(path, **overrides)
+        return Llama(cfg), cfg, params
+    if "Gemma" in arch or hf.get("model_type", "").startswith("gemma"):
+        # Gemma-2/3: post-norms, logit softcapping, alternating local
+        # attention — importing as v1 would serve silently-wrong logits.
+        raise ValueError(
+            f"unsupported architecture {arch!r} (Gemma v1 only; "
+            "Gemma-2/3's post-norms and softcapping are not implemented)")
     if "Qwen2Moe" in arch or hf.get("model_type") == "qwen2_moe":
         # Qwen2-MoE adds shared experts + a different gate recipe than
         # Mixtral; importing it as dense Qwen2 would crash on missing

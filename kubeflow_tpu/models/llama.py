@@ -54,6 +54,12 @@ class LlamaConfig:
     # QKV projection biases (Qwen2-family checkpoints; o_proj stays
     # bias-free, matching HF).
     attention_bias: bool = False
+    # Gemma-family conventions (models/hf_import.py import_gemma): RMSNorm
+    # applies (1 + w); token embeddings scale by sqrt(hidden) at input;
+    # the MLP gate activation is tanh-approximate GeLU instead of SiLU.
+    norm_plus_one: bool = False
+    embed_scale: bool = False
+    mlp_act: str = "silu"  # silu | gelu_tanh
     # LoRA fine-tuning (the reference SDK's PEFT LoraConfig): rank 0 = off.
     # Adapters add (x @ A) @ B * alpha/rank to the target projections —
     # q/v (PEFT's Llama default) for "attn", plus gate/up/down for
@@ -132,12 +138,19 @@ def llama_1b() -> LlamaConfig:
 class RMSNorm(nn.Module):
     eps: float
     dtype: Any
+    # Gemma convention: the learned scale is zero-centered and applied as
+    # (1 + w) — checkpoints store w, init stays ones-equivalent via zeros.
+    plus_one: bool = False
 
     @nn.compact
     def __call__(self, x):
         scale = self.param(
-            "scale", nn.with_logical_partitioning(nn.initializers.ones, ("norm",)),
+            "scale", nn.with_logical_partitioning(
+                (nn.initializers.zeros_init() if self.plus_one
+                 else nn.initializers.ones), ("norm",)),
             (x.shape[-1],), jnp.float32)
+        if self.plus_one:
+            scale = 1.0 + scale
         x32 = x.astype(jnp.float32)
         y = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps)
         return (y * scale).astype(self.dtype)
@@ -414,7 +427,13 @@ class MLPBlock(nn.Module):
                                       (cfg.intermediate_size,), ("mlp",))
             up = up + _lora_delta(self, cfg, "up_proj", x, (h,),
                                   (cfg.intermediate_size,), ("mlp",))
-        h = nn.silu(gate) * up
+        if cfg.mlp_act == "silu":
+            act = nn.silu(gate)
+        elif cfg.mlp_act == "gelu_tanh":  # Gemma's GeGLU gate
+            act = nn.gelu(gate, approximate=True)
+        else:
+            raise ValueError(f"mlp_act {cfg.mlp_act!r}: silu | gelu_tanh")
+        h = act * up
         h = nn.with_logical_constraint(h, ("batch", "act_seq", "mlp"))
         down = dense(features=cfg.hidden_size,
                      kernel_init=nn.with_logical_partitioning(
@@ -436,7 +455,8 @@ class DecoderLayer(nn.Module):
                  standard_positions=True, cache=None, cache_index=None,
                  segment_ids=None, attend_full_cache=False):
         cfg = self.cfg
-        h = RMSNorm(cfg.rms_eps, cfg.dtype, name="input_norm")(x)
+        h = RMSNorm(cfg.rms_eps, cfg.dtype, cfg.norm_plus_one,
+                    name="input_norm")(x)
         attn_out, new_cache = Attention(cfg, name="attn")(
             h, cos, sin, positions, ring_axis, standard_positions, cache,
             cache_index, segment_ids, attend_full_cache)
@@ -446,7 +466,8 @@ class DecoderLayer(nn.Module):
         from jax.ad_checkpoint import checkpoint_name
         attn_out = checkpoint_name(attn_out, "attn_out")
         x = x + attn_out
-        h = RMSNorm(cfg.rms_eps, cfg.dtype, name="post_attn_norm")(x)
+        h = RMSNorm(cfg.rms_eps, cfg.dtype, cfg.norm_plus_one,
+                    name="post_attn_norm")(x)
         x = x + (self.mlp_cls or MLPBlock)(cfg, name="mlp")(h)
         x = nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
         return x, new_cache
@@ -491,6 +512,11 @@ class Llama(nn.Module):
                 nn.initializers.normal(0.02), ("vocab", "embed")),
             (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
         x = embed.astype(cfg.dtype)[tokens]
+        if cfg.embed_scale:
+            # Gemma scales token embeddings by sqrt(hidden) at input; the
+            # multiplier is cast to the activation dtype first (HF rounds
+            # the normalizer to the model dtype before multiplying).
+            x = x * jnp.asarray(cfg.hidden_size ** 0.5, cfg.dtype)
         x = nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
         cos, sin = rope_table(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta,
                               cfg)
@@ -548,7 +574,8 @@ class Llama(nn.Module):
                 new_cache = jax.tree.map(
                     lambda *ls: jnp.stack(ls), *layer_caches)
 
-        x = RMSNorm(cfg.rms_eps, cfg.dtype, name="final_norm")(x)
+        x = RMSNorm(cfg.rms_eps, cfg.dtype, cfg.norm_plus_one,
+                    name="final_norm")(x)
         if return_hidden:
             # Chunked-CE training path (train/step.py): the caller computes
             # logits blockwise against the unembedding so the [B·S, V] fp32

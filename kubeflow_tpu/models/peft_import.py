@@ -70,7 +70,17 @@ def load_peft_adapter(path: str, cfg):
         raise ValueError(
             "per-module alpha_pattern/rank_pattern are not supported "
             "(one global r/alpha only)")
-    targets = frozenset(ac.get("target_modules") or ())
+    raw_targets = ac.get("target_modules") or ()
+    if isinstance(raw_targets, str):
+        # PEFT also accepts a regex matched against module names —
+        # resolve it over the module set this family has.
+        import re
+
+        raw_targets = [m for m in ("q_proj", "k_proj", "v_proj", "o_proj",
+                                   "gate_proj", "up_proj", "down_proj")
+                       if re.fullmatch(raw_targets, m)
+                       or re.search(raw_targets, m)]
+    targets = frozenset(raw_targets)
     mode = _TARGET_MODES.get(targets)
     if mode is None:
         raise ValueError(

@@ -1,0 +1,95 @@
+"""Gemma (v1) import: the Llama trunk with Gemma's three convention
+changes — (1+w) RMSNorm, sqrt(hidden) embedding scale, GeGLU — each a
+config flag, checked against the torch reference. Gemma-2/3 are refused
+(post-norms/softcapping would serve silently-wrong logits as v1).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax.numpy as jnp  # noqa: E402
+
+pytestmark = pytest.mark.slow  # torch-reference tier
+
+
+def _gemma_cfg():
+    return transformers.GemmaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=64, rope_theta=10000.0,
+        rms_norm_eps=1e-6, hidden_activation="gelu_pytorch_tanh",
+        attn_implementation="eager")
+
+
+@pytest.fixture(scope="module")
+def hf_gemma_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("hf_gemma")
+    torch.manual_seed(17)
+    model = transformers.GemmaForCausalLM(_gemma_cfg())
+    model.eval()
+    model.save_pretrained(d, safe_serialization=True)
+    return str(d), model
+
+
+def test_gemma_logits_match_torch(hf_gemma_dir):
+    path, tmodel = hf_gemma_dir
+    from kubeflow_tpu.models.hf_import import import_gemma
+    from kubeflow_tpu.models.llama import Llama
+
+    cfg, params = import_gemma(path, dtype=jnp.float32,
+                               param_dtype=jnp.float32)
+    assert cfg.norm_plus_one and cfg.embed_scale
+    assert cfg.mlp_act == "gelu_tanh" and cfg.tie_embeddings
+    model = Llama(cfg)
+    rng = np.random.default_rng(6)
+    toks = rng.integers(0, cfg.vocab_size, (2, 12), dtype=np.int64)
+    with torch.no_grad():
+        ref = tmodel(torch.from_numpy(toks)).logits.numpy()
+    got = model.apply({"params": params}, jnp.asarray(toks, jnp.int32))
+    np.testing.assert_allclose(np.asarray(got), ref, atol=3e-3, rtol=2e-2)
+
+
+def test_gemma_engine_decode_matches_torch(hf_gemma_dir):
+    path, tmodel = hf_gemma_dir
+    from kubeflow_tpu.models.hf_import import build_from_hf
+    from kubeflow_tpu.serve.generation import GenerationEngine
+
+    module, cfg, params = build_from_hf(path, dtype=jnp.float32,
+                                        param_dtype=jnp.float32)
+    eng = GenerationEngine(module, params, cfg, slots=1, max_len=16,
+                           chunk=4, prefill_buckets=(4,))
+    try:
+        prompt = [5, 2, 9]
+        out = eng.submit(prompt, max_tokens=6, temperature=0.0)
+        with torch.no_grad():
+            ref = tmodel.generate(
+                torch.tensor([prompt]), max_new_tokens=6, do_sample=False,
+                pad_token_id=0).numpy()[0, len(prompt):]
+        assert list(out["output_ids"]) == list(ref)
+    finally:
+        eng.close()
+
+
+def test_gemma2_refused(hf_gemma_dir, tmp_path):
+    import json
+    import os
+    import shutil
+
+    path, _ = hf_gemma_dir
+    d = tmp_path / "gemma2"
+    shutil.copytree(path, d)
+    with open(os.path.join(d, "config.json")) as f:
+        cfgj = json.load(f)
+    cfgj["architectures"] = ["Gemma2ForCausalLM"]
+    cfgj["model_type"] = "gemma2"
+    with open(os.path.join(d, "config.json"), "w") as f:
+        json.dump(cfgj, f)
+    from kubeflow_tpu.models.hf_import import build_from_hf
+
+    with pytest.raises(ValueError, match="Gemma v1 only"):
+        build_from_hf(str(d))
