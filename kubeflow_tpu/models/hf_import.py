@@ -252,6 +252,9 @@ def import_gemma(path: str, *, scan_layers: bool = True,
     exact-match dispatch, never imported as v1."""
     hf = read_hf_config(path)
     arch = (hf.get("architectures") or ["GemmaForCausalLM"])[0]
+    if "Gemma" in arch and arch != "GemmaForCausalLM":
+        # Gemma-2/3 must never import as v1, whatever model_type says.
+        raise ValueError(f"import_gemma cannot load architecture {arch!r}")
     if arch != "GemmaForCausalLM" and hf.get("model_type") != "gemma":
         raise ValueError(f"import_gemma cannot load architecture {arch!r}")
     act = (hf.get("hidden_activation") or hf.get("hidden_act")
@@ -707,15 +710,18 @@ def build_from_hf(path: str, **overrides: Any):
 
         cfg, params = import_mixtral(path, **overrides)
         return MoELlama(cfg), cfg, params
-    if arch == "GemmaForCausalLM" or hf.get("model_type") == "gemma":
-        cfg, params = import_gemma(path, **overrides)
-        return Llama(cfg), cfg, params
-    if "Gemma" in arch or hf.get("model_type", "").startswith("gemma"):
+    if ("Gemma" in arch and arch != "GemmaForCausalLM") or hf.get(
+            "model_type", "") in ("gemma2", "gemma3", "gemma3_text"):
         # Gemma-2/3: post-norms, logit softcapping, alternating local
         # attention — importing as v1 would serve silently-wrong logits.
+        # Checked BEFORE the v1 branch so a v2/3 architecture with a
+        # hand-edited model_type can't slip through.
         raise ValueError(
             f"unsupported architecture {arch!r} (Gemma v1 only; "
             "Gemma-2/3's post-norms and softcapping are not implemented)")
+    if arch == "GemmaForCausalLM" or hf.get("model_type") == "gemma":
+        cfg, params = import_gemma(path, **overrides)
+        return Llama(cfg), cfg, params
     if "Qwen2Moe" in arch or hf.get("model_type") == "qwen2_moe":
         # Qwen2-MoE adds shared experts + a different gate recipe than
         # Mixtral; importing it as dense Qwen2 would crash on missing

@@ -227,7 +227,9 @@ class Attention(nn.Module):
                  standard_positions: bool = True, cache: dict | None = None,
                  cache_index: jax.Array | None = None,
                  segment_ids: jax.Array | None = None,
-                 attend_full_cache: bool = False):
+                 attend_full_cache: bool = False,
+                 adapter: dict | None = None,
+                 adapter_ids: jax.Array | None = None):
         cfg = self.cfg
         dense = partial(
             nn.DenseGeneral, use_bias=False, dtype=cfg.dtype,
@@ -261,6 +263,12 @@ class Attention(nn.Module):
             v = v + _lora_delta(self, cfg, "v_proj", x, h_in,
                                 (cfg.num_kv_heads, cfg.head_dim),
                                 ("heads", "kv"))
+        if adapter is not None:
+            # Multi-LoRA serving: per-row adapter selection.
+            q = q + _multi_lora_delta(x, adapter_ids, adapter["q_proj"],
+                                      (cfg.num_heads, cfg.head_dim))
+            v = v + _multi_lora_delta(x, adapter_ids, adapter["v_proj"],
+                                      (cfg.num_kv_heads, cfg.head_dim))
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
         q = nn.with_logical_constraint(q, ("batch", "act_seq", "act_heads", "act_kv"))
@@ -373,6 +381,21 @@ class Attention(nn.Module):
         return out, new_cache
 
 
+def _multi_lora_delta(x: jax.Array, ids: jax.Array, ab: dict,
+                      out_shape: tuple) -> jax.Array:
+    """Per-ROW adapter delta for multi-LoRA serving: each batch row
+    selects its own adapter from stacked weights. ab = {"a": [N, in, r],
+    "b": [N, r, *out]} where entry 0 is all-zeros ("no adapter") and B is
+    PRE-SCALED by alpha/r at load time (serve/multilora.py), so the
+    delta is just (x @ a[id]) @ b[id]. x [B, S, in]."""
+    a = ab["a"][ids].astype(x.dtype)              # [B, in, r]
+    b = ab["b"][ids].astype(x.dtype)              # [B, r, *out]
+    low = jnp.einsum("bsh,bhr->bsr", x, a)
+    bflat = b.reshape(b.shape[0], b.shape[1], -1)
+    d = jnp.einsum("bsr,brf->bsf", low, bflat)
+    return d.reshape(d.shape[0], d.shape[1], *out_shape)
+
+
 def _lora_delta(mod: nn.Module, cfg: LlamaConfig, name: str, x: jax.Array,
                 in_shape: tuple, out_shape: tuple,
                 out_axes: tuple) -> jax.Array:
@@ -408,11 +431,13 @@ class MLPBlock(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, adapter: dict | None = None,
+                 adapter_ids: jax.Array | None = None):
         cfg = self.cfg
         dense = partial(nn.DenseGeneral, use_bias=False, dtype=cfg.dtype,
                         param_dtype=cfg.param_dtype)
         lora_mlp = cfg.lora_rank > 0 and cfg.lora_targets == "attn_mlp"
+        multi_mlp = adapter is not None and "gate_proj" in adapter
         gate = dense(features=cfg.intermediate_size,
                      kernel_init=nn.with_logical_partitioning(
                          nn.initializers.lecun_normal(), ("embed", "mlp")),
@@ -427,6 +452,13 @@ class MLPBlock(nn.Module):
                                       (cfg.intermediate_size,), ("mlp",))
             up = up + _lora_delta(self, cfg, "up_proj", x, (h,),
                                   (cfg.intermediate_size,), ("mlp",))
+        if multi_mlp:
+            gate = gate + _multi_lora_delta(
+                x, adapter_ids, adapter["gate_proj"],
+                (cfg.intermediate_size,))
+            up = up + _multi_lora_delta(
+                x, adapter_ids, adapter["up_proj"],
+                (cfg.intermediate_size,))
         if cfg.mlp_act == "silu":
             act = nn.silu(gate)
         elif cfg.mlp_act == "gelu_tanh":  # Gemma's GeGLU gate
@@ -443,6 +475,9 @@ class MLPBlock(nn.Module):
             down = down + _lora_delta(
                 self, cfg, "down_proj", h, (cfg.intermediate_size,),
                 (cfg.hidden_size,), ("embed",))
+        if multi_mlp:
+            down = down + _multi_lora_delta(
+                h, adapter_ids, adapter["down_proj"], (cfg.hidden_size,))
         return down
 
 
@@ -453,13 +488,23 @@ class DecoderLayer(nn.Module):
     @nn.compact
     def __call__(self, x, cos, sin, positions, ring_axis=None,
                  standard_positions=True, cache=None, cache_index=None,
-                 segment_ids=None, attend_full_cache=False):
+                 segment_ids=None, attend_full_cache=False,
+                 adapter=None, adapter_ids=None):
         cfg = self.cfg
+        attn_ad = None
+        mlp_ad = None
+        if adapter is not None:
+            attn_ad = {k: adapter[k] for k in ("q_proj", "v_proj")
+                       if k in adapter} or None
+            mlp_ad = {k: adapter[k]
+                      for k in ("gate_proj", "up_proj", "down_proj")
+                      if k in adapter} or None
         h = RMSNorm(cfg.rms_eps, cfg.dtype, cfg.norm_plus_one,
                     name="input_norm")(x)
         attn_out, new_cache = Attention(cfg, name="attn")(
             h, cos, sin, positions, ring_axis, standard_positions, cache,
-            cache_index, segment_ids, attend_full_cache)
+            cache_index, segment_ids, attend_full_cache,
+            adapter=attn_ad, adapter_ids=adapter_ids)
         # Remat landmark: policy "save_attn" keeps this tensor so the
         # backward skips re-running the attention kernel (small residual:
         # [B,S,H·D] bf16 per layer vs the full block internals).
@@ -468,7 +513,8 @@ class DecoderLayer(nn.Module):
         x = x + attn_out
         h = RMSNorm(cfg.rms_eps, cfg.dtype, cfg.norm_plus_one,
                     name="post_attn_norm")(x)
-        x = x + (self.mlp_cls or MLPBlock)(cfg, name="mlp")(h)
+        x = x + (self.mlp_cls or MLPBlock)(cfg, name="mlp")(
+            h, adapter=mlp_ad, adapter_ids=adapter_ids)
         x = nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
         return x, new_cache
 
@@ -485,7 +531,9 @@ class Llama(nn.Module):
                  cache_index: jax.Array | None = None,
                  return_hidden: bool = False,
                  segment_ids: jax.Array | None = None,
-                 attend_full_cache: bool = False):
+                 attend_full_cache: bool = False,
+                 adapter: dict | None = None,
+                 adapter_ids: jax.Array | None = None):
         """Returns logits [B,S,V]; with `cache` (see init_cache) returns
         (logits, updated_cache) — prefill when S>1 at cache_index 0,
         single-token decode when S==1 (positions default to cache_index),
@@ -496,8 +544,16 @@ class Llama(nn.Module):
         hidden states [B,S,H] (chunked-CE training path). `segment_ids`
         [B,S] enables packed-sequence training: attention is confined
         within equal-id spans (pass the matching per-segment restarting
-        `positions` for RoPE)."""
+        `positions` for RoPE).
+
+        Multi-LoRA serving (`adapter` + `adapter_ids`): `adapter` maps
+        target module names to stacked adapter pairs {"a": [L, N, in, r],
+        "b": [L, N, r, *out]} (entry 0 zeros = base, B pre-scaled by
+        alpha/r — serve/multilora.py), and `adapter_ids` [B] selects one
+        per batch row; the stacks ride the layer scan like the cache."""
         cfg = self.cfg
+        if adapter is not None and adapter_ids is None:
+            adapter_ids = jnp.zeros((tokens.shape[0],), jnp.int32)
         if cache is not None:
             if cache_index is None:
                 cache_index = jnp.zeros((tokens.shape[0],), jnp.int32)
@@ -551,24 +607,27 @@ class Llama(nn.Module):
             # `cache` (leading layer dim) rides as the scan's per-layer input
             # and the updated cache comes back as its per-layer output.
             x, new_cache = nn.scan(
-                lambda mdl, carry, layer_cache: mdl(
+                lambda mdl, carry, layer_cache, ad: mdl(
                     carry, cos, sin, positions, ring_axis,
                     standard_positions, layer_cache, cache_index,
-                    segment_ids, attend_full_cache),
+                    segment_ids, attend_full_cache, ad, adapter_ids),
                 variable_axes={"params": 0, "aux_loss": 0},
                 split_rngs={"params": True},
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
-            )(layer_cls(cfg, self.mlp_cls, name="layers"), x, cache)
+            )(layer_cls(cfg, self.mlp_cls, name="layers"), x, cache,
+              adapter)
         else:
             layer_caches = []
             for i in range(cfg.num_layers):
                 layer_cache = None if cache is None else jax.tree.map(
                     lambda c: c[i], cache)
+                layer_ad = None if adapter is None else jax.tree.map(
+                    lambda a: a[i], adapter)
                 x, lc = layer_cls(cfg, self.mlp_cls, name=f"layer_{i}")(
                     x, cos, sin, positions, ring_axis, standard_positions,
                     layer_cache, cache_index, segment_ids,
-                    attend_full_cache)
+                    attend_full_cache, layer_ad, adapter_ids)
                 layer_caches.append(lc)
             if cache is not None:
                 new_cache = jax.tree.map(

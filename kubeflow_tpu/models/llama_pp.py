@@ -56,9 +56,12 @@ from kubeflow_tpu.parallel.pipeline import (
     pipeline_apply, pipeline_apply_circular)
 
 
-def _rms(x: jax.Array, scale: jax.Array, eps: float, dtype) -> jax.Array:
+def _rms(x: jax.Array, scale: jax.Array, eps: float, dtype,
+         plus_one: bool = False) -> jax.Array:
     x32 = x.astype(jnp.float32)
     y = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    if plus_one:  # Gemma stores zero-centered scales, applied as (1 + w)
+        scale = 1.0 + scale
     return (y * scale).astype(dtype)
 
 
@@ -96,7 +99,8 @@ def layer_fwd(cfg: LlamaConfig, lp: dict, x: jax.Array, cos: jax.Array,
     Returns (x, aux): aux is the layer's Switch load-balance statistic for
     routed-expert FFNs (`expert=(axis, n)` shards them), 0 for dense."""
     dt = cfg.dtype
-    h = _rms(x, lp["input_norm"]["scale"], cfg.rms_eps, dt)
+    h = _rms(x, lp["input_norm"]["scale"], cfg.rms_eps, dt,
+             cfg.norm_plus_one)
     q = jnp.einsum("bsh,hnd->bsnd", h, lp["attn"]["q_proj"]["kernel"].astype(dt))
     k = jnp.einsum("bsh,hnd->bsnd", h, lp["attn"]["k_proj"]["kernel"].astype(dt))
     v = jnp.einsum("bsh,hnd->bsnd", h, lp["attn"]["v_proj"]["kernel"].astype(dt))
@@ -135,13 +139,20 @@ def layer_fwd(cfg: LlamaConfig, lp: dict, x: jax.Array, cos: jax.Array,
     attn = jnp.einsum("bsnd,ndh->bsh", attn,
                       lp["attn"]["o_proj"]["kernel"].astype(dt))
     x = x + attn
-    h2 = _rms(x, lp["post_attn_norm"]["scale"], cfg.rms_eps, dt)
+    h2 = _rms(x, lp["post_attn_norm"]["scale"], cfg.rms_eps, dt,
+              cfg.norm_plus_one)
     if "router" in lp["mlp"]:
         y, aux = _moe_ffn(cfg, lp["mlp"], h2, expert)
         return x + y, aux
     gate = h2 @ lp["mlp"]["gate_proj"]["kernel"].astype(dt)
     up = h2 @ lp["mlp"]["up_proj"]["kernel"].astype(dt)
-    y = (jax.nn.silu(gate) * up) @ lp["mlp"]["down_proj"]["kernel"].astype(dt)
+    if cfg.mlp_act == "silu":
+        act = jax.nn.silu(gate)
+    elif cfg.mlp_act == "gelu_tanh":  # Gemma's GeGLU gate
+        act = jax.nn.gelu(gate, approximate=True)
+    else:
+        raise ValueError(f"mlp_act {cfg.mlp_act!r}: silu | gelu_tanh")
+    y = (act * up) @ lp["mlp"]["down_proj"]["kernel"].astype(dt)
     return x + y, jnp.zeros((), jnp.float32)
 
 
@@ -262,6 +273,8 @@ def pipeline_forward(
     b, s = tokens.shape
     embed = params["embed"]
     x = embed.astype(dt)[tokens]
+    if cfg.embed_scale:  # Gemma: sqrt(hidden) input scaling
+        x = x * jnp.asarray(cfg.hidden_size ** 0.5, dt)
     cos, sin = rope_table(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta, cfg)
 
     is_moe = "router" in params["layers"]["mlp"]
@@ -356,7 +369,8 @@ def pipeline_forward(
             travel_specs=travel_specs, param_specs=param_specs)
     x = out["h"]
 
-    x = _rms(x, params["final_norm"]["scale"], cfg.rms_eps, dt)
+    x = _rms(x, params["final_norm"]["scale"], cfg.rms_eps, dt,
+             cfg.norm_plus_one)
     if return_hidden:
         result = x
     elif cfg.tie_embeddings:

@@ -130,8 +130,13 @@ class MoEBlock(nn.Module):
     cfg: MoEConfig
 
     @nn.compact
-    def __call__(self, x: jax.Array) -> jax.Array:  # [B, S, H]
+    def __call__(self, x: jax.Array, adapter=None,
+                 adapter_ids=None) -> jax.Array:  # [B, S, H]
         cfg = self.cfg
+        if adapter is not None:
+            raise ValueError(
+                "multi-LoRA adapters don't apply to routed-expert FFNs "
+                "(use attention-only adapters with MoE models)")
         B, S, H = x.shape
         E, K = cfg.num_experts, cfg.experts_per_token
         C = expert_capacity(cfg, S)

@@ -72,14 +72,23 @@ def load_peft_adapter(path: str, cfg):
             "(one global r/alpha only)")
     raw_targets = ac.get("target_modules") or ()
     if isinstance(raw_targets, str):
-        # PEFT also accepts a regex matched against module names —
-        # resolve it over the module set this family has.
+        # PEFT's string form is a regex FULLMATCHED against full dotted
+        # module paths (peft.tuners.tuners_utils) — resolve it the same
+        # way over this family's layout, plus the bare name (PEFT's
+        # exact-name shortcut).
         import re
+
+        def hits(m):
+            group = ("self_attn" if m.endswith(("q_proj", "k_proj",
+                                                "v_proj", "o_proj"))
+                     else "mlp")
+            full = f"model.layers.0.{group}.{m}"
+            return (re.fullmatch(raw_targets, full)
+                    or re.fullmatch(raw_targets, m))
 
         raw_targets = [m for m in ("q_proj", "k_proj", "v_proj", "o_proj",
                                    "gate_proj", "up_proj", "down_proj")
-                       if re.fullmatch(raw_targets, m)
-                       or re.search(raw_targets, m)]
+                       if hits(m)]
     targets = frozenset(raw_targets)
     mode = _TARGET_MODES.get(targets)
     if mode is None:

@@ -96,7 +96,7 @@ def sample_tokens(logits: jax.Array, temperature: jax.Array,
 def build_engine_fns(model, cfg, *, max_len: int, chunk: int,
                      prefill_buckets: Sequence[int],
                      offset_writes: bool,
-                     cache_sharding=None) -> dict:
+                     cache_sharding=None, adapters=None) -> dict:
     """The engine's pure device functions, as unjitted closures.
 
     Single source of truth shared by the live `GenerationEngine` (which
@@ -106,12 +106,22 @@ def build_engine_fns(model, cfg, *, max_len: int, chunk: int,
     `utils/scaleproof.py` serve_8b_tp8). `cache_sharding` (a NamedSharding
     or None) pins fragment caches created inside prefill so GSPMD shards
     KV heads over `tensor` instead of guessing from use.
+
+    `adapters` (serve/multilora.py stacks): every fn gains an optional
+    trailing `aid` (adapter index per row, 0 = base) and the model call
+    gathers per-row adapter deltas — multi-LoRA inside one compiled
+    program. Callers that never pass `aid` keep base behavior exactly.
     """
     from kubeflow_tpu.models.llama import init_cache
 
     prefill_buckets = sorted(prefill_buckets)
     big = prefill_buckets[-1]
     frag_len = max_len + (big if offset_writes else 0)
+
+    def apply_kw(aid) -> dict:
+        if aid is None or adapters is None:
+            return {}
+        return {"adapter": adapters, "adapter_ids": aid}
 
     def _constrain_cache(cache):
         if cache_sharding is None:
@@ -120,20 +130,21 @@ def build_engine_fns(model, cfg, *, max_len: int, chunk: int,
             lambda c: jax.lax.with_sharding_constraint(c, cache_sharding),
             cache)
 
-    def prefill(params, tokens, length, temperature, top_k, top_p, key):
+    def prefill(params, tokens, length, temperature, top_k, top_p, key,
+                aid=None):
         """tokens [1, S_bucket] right-padded; returns (frag_cache,
         first sampled token [1], its logprob [1])."""
         cache = _constrain_cache(init_cache(cfg, 1, frag_len))
         logits, cache = model.apply(
             {"params": params}, tokens, cache=cache,
-            cache_index=jnp.zeros((1,), jnp.int32))
+            cache_index=jnp.zeros((1,), jnp.int32), **apply_kw(aid))
         last = jnp.take_along_axis(
             logits, (length - 1)[:, None, None], axis=1)[:, 0]  # [1, V]
         tok = sample_tokens(last, temperature, key, top_k, top_p)
         return cache, tok, _chosen_logprob(last, tok)
 
     def extend(params, cache, tokens, length, index, temperature,
-               top_k, top_p, key):
+               top_k, top_p, key, aid=None):
         """FINAL continuation chunk of a long prompt: tokens
         [1, S_bucket] right-padded, written at offset `index` [1],
         attending over the WHOLE fragment cache; samples the first
@@ -141,13 +152,13 @@ def build_engine_fns(model, cfg, *, max_len: int, chunk: int,
         positions = index[:, None] + jnp.arange(tokens.shape[1])[None]
         logits, cache = model.apply(
             {"params": params}, tokens, cache=cache, cache_index=index,
-            positions=positions, attend_full_cache=True)
+            positions=positions, attend_full_cache=True, **apply_kw(aid))
         last = jnp.take_along_axis(
             logits, (length - 1)[:, None, None], axis=1)[:, 0]
         tok = sample_tokens(last, temperature, key, top_k, top_p)
         return cache, tok, _chosen_logprob(last, tok)
 
-    def extend_mid(params, cache, tokens, index):
+    def extend_mid(params, cache, tokens, index, aid=None):
         """Intermediate continuation chunk: cache write + attention
         only — return_hidden skips the full-vocab unembedding whose
         sampled token would be discarded anyway."""
@@ -155,7 +166,7 @@ def build_engine_fns(model, cfg, *, max_len: int, chunk: int,
         _, cache = model.apply(
             {"params": params}, tokens, cache=cache, cache_index=index,
             positions=positions, attend_full_cache=True,
-            return_hidden=True)
+            return_hidden=True, **apply_kw(aid))
         return cache
 
     def insert(cache, frag, slot):
@@ -170,7 +181,7 @@ def build_engine_fns(model, cfg, *, max_len: int, chunk: int,
 
     def make_decode(truncate: bool, bucket: int):
         def decode_chunk(params, cache, last_tok, index, temperature,
-                         top_k, top_p, key):
+                         top_k, top_p, key, aid=None):
             """K decode steps under one dispatch; on-device sampling.
             last_tok/index/temperature [B]; returns (cache,
             tokens [B, K], logprobs [B, K]). The non-truncating variant
@@ -188,7 +199,8 @@ def build_engine_fns(model, cfg, *, max_len: int, chunk: int,
                 key, sub = jax.random.split(key)
                 logits, sliced = model.apply(
                     {"params": params}, tok[:, None], cache=sliced,
-                    cache_index=jnp.minimum(idx, bucket - 1))
+                    cache_index=jnp.minimum(idx, bucket - 1),
+                    **apply_kw(aid))
                 if truncate:
                     nxt = sample_tokens(logits[:, 0], temperature, sub,
                                         top_k, top_p)
@@ -383,7 +395,8 @@ class GenerationEngine:
                  prefill_buckets: Sequence[int] = (32, 128),
                  decode_buckets: Sequence[int] | None = None,
                  prefix_cache: int = 0, seed: int = 0,
-                 mesh=None, rules=None, draft: dict | None = None):
+                 mesh=None, rules=None, draft: dict | None = None,
+                 adapters: dict | None = None):
         self.model, self.cfg = model, cfg
         self.max_len, self.chunk, self.n_slots = int(max_len), int(chunk), int(slots)
         msl = int(getattr(cfg, "max_seq_len", 0) or 0)
@@ -522,6 +535,24 @@ class GenerationEngine:
                 "n_spec": max(1, self.chunk // (gamma + 1)),
             }
             self._dparams = jax.device_put(draft["params"])
+        # Multi-LoRA serving (serve/multilora.py): {name: PEFT adapter
+        # dir} — all adapters stacked on device, selected per request by
+        # index inside the compiled program.
+        self._ml_stacks = None
+        self._ml_ids: dict[str, int] = {}
+        if adapters:
+            if mesh is not None:
+                raise ValueError(
+                    "multi-LoRA doesn't compose with a serving mesh yet")
+            if draft is not None:
+                raise ValueError(
+                    "multi-LoRA doesn't compose with speculative "
+                    "decoding yet (the draft has no adapter stacks)")
+            from kubeflow_tpu.serve.multilora import build_adapter_stacks
+
+            self._ml_stacks, self._ml_ids = build_adapter_stacks(
+                dict(adapters), self.cfg)
+            self._ml_stacks = jax.device_put(self._ml_stacks)
         self._mesh = mesh
         if rules is None:
             from kubeflow_tpu.parallel.sharding import DEFAULT_RULES
@@ -648,7 +679,8 @@ class GenerationEngine:
             self.model, self.cfg, max_len=self.max_len, chunk=self.chunk,
             prefill_buckets=self.prefill_buckets,
             offset_writes=offset_writes,
-            cache_sharding=self._cache_sharding)
+            cache_sharding=self._cache_sharding,
+            adapters=self._ml_stacks)
         prefill_jit = jax.jit(fns["prefill"])
         self._prefill = {b: prefill_jit for b in self.prefill_buckets}
         self._extend = jax.jit(fns["extend"], donate_argnums=(1,))
@@ -689,22 +721,24 @@ class GenerationEngine:
         one_l = jnp.ones((1,), jnp.int32)
         zero_k = jnp.zeros((1,), jnp.int32)
         one_p = jnp.ones((1,), jnp.float32)
+        aid1 = self._aid1(0)
         frag = None
         for b in self.prefill_buckets:
             frag, _, _ = self._prefill[b](
                 self._params, jnp.zeros((1, b), jnp.int32), one_l, zero_t,
-                zero_k, one_p, self._key)
+                zero_k, one_p, self._key, aid=aid1)
         if self._may_chunk or self._prefix_cap:  # offset-write paths
             # Intermediate chunks always use the largest bucket; the
             # final (sampling) chunk can land on any bucket.
             frag = self._extend_mid(
                 self._params, frag,
                 jnp.zeros((1, self.prefill_buckets[-1]), jnp.int32),
-                zero_k)
+                zero_k, aid=aid1)
             for b in self.prefill_buckets:
                 frag, _, _ = self._extend(
                     self._params, frag, jnp.zeros((1, b), jnp.int32),
-                    one_l, zero_k, zero_t, zero_k, one_p, self._key)
+                    one_l, zero_k, zero_t, zero_k, one_p, self._key,
+                    aid=aid1)
         self._cache = self._insert(self._cache, frag, jnp.int32(0))
         n = self.n_slots
         for fn in self._decode.values():
@@ -712,7 +746,7 @@ class GenerationEngine:
                 self._params, self._cache, jnp.zeros((n,), jnp.int32),
                 jnp.zeros((n,), jnp.int32), jnp.zeros((n,), jnp.float32),
                 jnp.zeros((n,), jnp.int32), jnp.ones((n,), jnp.float32),
-                self._key)
+                self._key, aid=self._aid_batch([0] * n))
         if self._spec is not None:
             dfrag = self._dfrag_init()
             for b in self.prefill_buckets:
@@ -726,12 +760,40 @@ class GenerationEngine:
                     jnp.zeros((n,), jnp.int32), jnp.zeros((n,), jnp.int32),
                     jnp.zeros((n,), jnp.float32), self._key)
 
+    # -- multi-LoRA ----------------------------------------------------------
+
+    def _aid1(self, aid: int):
+        """[1]-shaped adapter index for admission fns — None when the
+        engine has no adapter stacks (base-only trace)."""
+        if self._ml_stacks is None:
+            return None
+        return jnp.asarray([aid], jnp.int32)
+
+    def _aid_batch(self, aids):
+        if self._ml_stacks is None:
+            return None
+        return jnp.asarray(aids, jnp.int32)
+
+    def _resolve_adapter(self, name) -> int:
+        if name is None:
+            return 0
+        if self._ml_stacks is None:
+            raise ValueError(
+                f"adapter {name!r} requested but the engine has no "
+                "adapters configured")
+        try:
+            return self._ml_ids[name]
+        except KeyError:
+            raise ValueError(
+                f"unknown adapter {name!r}; loaded: "
+                f"{sorted(self._ml_ids)}") from None
+
     # -- public API ----------------------------------------------------------
 
     def submit(self, input_ids: Sequence[int], *, max_tokens: int = 32,
                temperature: float = 0.0, top_k: int = 0,
                top_p: float = 1.0, eos_id: int | None = None,
-               timeout: float = 300.0,
+               timeout: float = 300.0, adapter: str | None = None,
                on_tokens=None) -> dict:
         """`on_tokens(tokens, done)` (optional) is invoked from the worker
         thread as tokens are emitted — chunk-granular streaming; the final
@@ -753,6 +815,7 @@ class GenerationEngine:
             "temperature": float(temperature),
             "top_k": int(top_k),
             "top_p": float(top_p),
+            "aid": self._resolve_adapter(adapter),
             "eos_id": eos_id,
             "out": [],
             "out_logprobs": [],
@@ -790,21 +853,26 @@ class GenerationEngine:
 
     # -- prefix cache --------------------------------------------------------
 
-    def _prefix_lookup(self, ids: list[int]) -> tuple[int, Any] | None:
+    def _prefix_lookup(self, ids: list[int],
+                       aid: int = 0) -> tuple[int, Any] | None:
         """Longest cached chunk-boundary prefix STRICTLY shorter than the
-        prompt (the final token's logits must still be computed). Returns
-        (matched_len, fresh fragment copy) or None."""
+        prompt (the final token's logits must still be computed). Keys
+        carry the ADAPTER index: a prefix computed under adapter X holds
+        X's K/V deltas and must never serve a request under adapter Y.
+        Returns (matched_len, fresh fragment copy) or None."""
         best = None
         for key in self._prefix_lru:
-            n = len(key)
-            if (n < len(ids) and (best is None or n > len(best))
-                    and list(key) == ids[:n]):
+            ka, kt = key
+            n = len(kt)
+            if (ka == aid and n < len(ids)
+                    and (best is None or n > len(best[1]))
+                    and list(kt) == ids[:n]):
                 best = key
         if best is None:
             return None
         self._prefix_lru.move_to_end(best)
         frag = jax.tree.map(jnp.copy, self._prefix_lru[best])
-        return len(best), frag
+        return len(best[1]), frag
 
     def _prefix_store(self, key: tuple, frag) -> None:
         """Snapshot a fragment at a prompt-chunk boundary. Rows past the
@@ -824,6 +892,8 @@ class GenerationEngine:
 
     def _admit_inner(self, slot: int, req: dict) -> None:
         ids = req["input_ids"]
+        aid = req.get("aid", 0)
+        aid1 = self._aid1(aid)
         sample_args = (
             jnp.asarray([req["temperature"]], jnp.float32),
             jnp.asarray([req.get("top_k", 0)], jnp.int32),
@@ -836,7 +906,7 @@ class GenerationEngine:
         big = self.prefill_buckets[-1]
         frag, tok0, done = None, None, 0
         if self._prefix_cap:
-            hit = self._prefix_lookup(ids)
+            hit = self._prefix_lookup(ids, aid)
             if hit is not None:
                 done, frag = hit
                 self.stats["prefix_hits"] += 1
@@ -851,20 +921,22 @@ class GenerationEngine:
                 self._key, sub = jax.random.split(self._key)
                 frag, tok0, lp0 = self._prefill[bucket](
                     self._params, jnp.asarray(toks),
-                    jnp.asarray([len(piece)], jnp.int32), *sample_args, sub)
+                    jnp.asarray([len(piece)], jnp.int32), *sample_args, sub,
+                    aid=aid1)
             elif final:
                 self._key, sub = jax.random.split(self._key)
                 frag, tok0, lp0 = self._extend(
                     self._params, frag, jnp.asarray(toks),
                     jnp.asarray([len(piece)], jnp.int32),
-                    jnp.asarray([done], jnp.int32), *sample_args, sub)
+                    jnp.asarray([done], jnp.int32), *sample_args, sub,
+                    aid=aid1)
             else:  # intermediate chunk: no sampling, no unembedding
                 frag = self._extend_mid(
                     self._params, frag, jnp.asarray(toks),
-                    jnp.asarray([done], jnp.int32))
+                    jnp.asarray([done], jnp.int32), aid=aid1)
             done += len(piece)
             if self._prefix_cap:
-                self._prefix_store(tuple(ids[:done]), frag)
+                self._prefix_store((aid, tuple(ids[:done])), frag)
         self._cache = self._insert(self._cache, frag, jnp.int32(slot))
         spec_able = (req.get("top_k", 0) == 0
                      and req.get("top_p", 1.0) >= 1.0)
@@ -893,7 +965,7 @@ class GenerationEngine:
             draft_ok = True
         first = int(tok0[0])
         self._slots[slot] = {"req": req, "idx": len(ids), "last": first,
-                             "draft_ok": draft_ok}
+                             "draft_ok": draft_ok, "aid": aid}
         self.stats["requests"] += 1
         self.stats["prompt_tokens"] += len(ids)
         self._emit(slot, [first], [float(lp0[0])])
@@ -957,12 +1029,14 @@ class GenerationEngine:
             temps = np.zeros((self.n_slots,), np.float32)
             ks = np.zeros((self.n_slots,), np.int32)
             ps = np.ones((self.n_slots,), np.float32)
+            aids = np.zeros((self.n_slots,), np.int32)
             for i in active:
                 st = self._slots[i]
                 last[i], idx[i] = st["last"], st["idx"]
                 temps[i] = st["req"]["temperature"]
                 ks[i] = st["req"].get("top_k", 0)
                 ps[i] = st["req"].get("top_p", 1.0)
+                aids[i] = st.get("aid", 0)
             self._key, sub = jax.random.split(self._key)
             t0 = time.monotonic()
             # Speculative path: greedy traffic decodes draft-then-verify
@@ -1030,7 +1104,7 @@ class GenerationEngine:
                 self._cache, toks, lps = decode(
                     self._params, self._cache, jnp.asarray(last),
                     jnp.asarray(idx), jnp.asarray(temps), jnp.asarray(ks),
-                    jnp.asarray(ps), sub)
+                    jnp.asarray(ps), sub, aid=self._aid_batch(aids))
             toks = np.asarray(toks)  # sync point: [B, chunk]
             lps = np.asarray(lps)
             dt = time.monotonic() - t0
@@ -1151,6 +1225,7 @@ class GenerativeJAXModel(Model):
             top_k=int(payload.get("top_k", 0)),
             top_p=float(payload.get("top_p", 1.0)),
             eos_id=payload.get("eos_id", self.eos_id),
+            adapter=payload.get("adapter"),
             timeout=float(payload.get("timeout", 300.0)))
 
     def generate(self, payload: dict) -> dict:
@@ -1261,4 +1336,6 @@ class GenerativeJAXModel(Model):
         if self.engine:
             md["decode_buckets"] = list(self.engine.decode_buckets)
             md["speculative"] = self.engine._spec is not None
+            if self.engine._ml_ids:
+                md["adapters"] = sorted(self.engine._ml_ids)
         return md

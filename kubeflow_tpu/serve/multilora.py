@@ -1,0 +1,90 @@
+"""Multi-LoRA serving: N PEFT adapters stacked over one base model,
+selected per request — vLLM's multi-LoRA capability, XLA-shaped.
+
+Instead of swapping adapter weights per request (a host round-trip and a
+recompile hazard), all adapters live on device as STACKED tensors
+[N+1, ...] with entry 0 all-zeros ("base", no adapter); every batch row
+gathers its own adapter by index inside the same compiled program
+(models/llama.py `_multi_lora_delta`), so one decode dispatch serves a
+mixed batch of adapters. Ranks may differ per adapter — narrower ones
+zero-pad to the widest rank (zero rows contribute nothing); alpha/r is
+folded into the stacked B so the model applies no further scaling. An
+adapter that doesn't target some module contributes zeros there.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+_GROUPS = {"q_proj": "attn", "v_proj": "attn", "gate_proj": "mlp",
+           "up_proj": "mlp", "down_proj": "mlp"}
+
+
+def build_adapter_stacks(adapter_dirs: dict[str, str], cfg
+                         ) -> tuple[dict, dict]:
+    """{name: PEFT adapter dir} + base LlamaConfig -> (stacks, ids).
+
+    stacks: {module: {"a": [L, N+1, in, rmax], "b": [L, N+1, rmax, *out]}}
+    ready for `Llama(..., adapter=stacks, adapter_ids=...)`;
+    ids: {name: index >= 1} (0 is the implicit no-adapter base)."""
+    from kubeflow_tpu.models.peft_import import load_peft_adapter
+
+    if not adapter_dirs:
+        raise ValueError("adapter_dirs must name at least one adapter")
+    names = sorted(adapter_dirs)
+    loaded = []
+    for n in names:
+        acfg, leaves = load_peft_adapter(adapter_dirs[n], cfg)
+        loaded.append((n, acfg, leaves))
+    rmax = max(acfg.lora_rank for _, acfg, _ in loaded)
+    L = cfg.num_layers
+    pd = np.dtype(jnp.dtype(cfg.param_dtype).name)
+
+    out_shapes = {
+        "q_proj": (cfg.num_heads, cfg.head_dim),
+        "v_proj": (cfg.num_kv_heads, cfg.head_dim),
+        "gate_proj": (cfg.intermediate_size,),
+        "up_proj": (cfg.intermediate_size,),
+        "down_proj": (cfg.hidden_size,),
+    }
+    in_dims = {
+        "q_proj": cfg.hidden_size, "v_proj": cfg.hidden_size,
+        "gate_proj": cfg.hidden_size, "up_proj": cfg.hidden_size,
+        "down_proj": cfg.intermediate_size,
+    }
+    modules = sorted({
+        m for _, _, leaves in loaded
+        for (_, _, leaf) in leaves
+        for m in [leaf[: -len("_lora_a")]]
+        if leaf.endswith("_lora_a")})
+
+    stacks: dict[str, Any] = {}
+    for m in modules:
+        group = _GROUPS[m]
+        akey = ("layers", group, f"{m}_lora_a")
+        bkey = ("layers", group, f"{m}_lora_b")
+        out = out_shapes[m]
+        a_entries = [np.zeros((L, in_dims[m], rmax), pd)]
+        b_entries = [np.zeros((L, rmax, *out), pd)]
+        for _, acfg, leaves in loaded:
+            r = acfg.lora_rank
+            a = np.zeros((L, in_dims[m], rmax), pd)
+            b = np.zeros((L, rmax, *out), pd)
+            if akey in leaves:
+                a[:, :, :r] = np.asarray(leaves[akey], pd)
+                # Fold alpha/r into B: the per-row delta is then just
+                # (x @ a) @ b, uniform across mixed-alpha adapters.
+                b[:, :r] = (np.asarray(leaves[bkey], pd)
+                            * (acfg.lora_alpha / r))
+            a_entries.append(a)
+            b_entries.append(b)
+        stacks[m] = {
+            # Stack on axis 1: the layer scan consumes axis 0.
+            "a": jnp.asarray(np.stack(a_entries, axis=1)),
+            "b": jnp.asarray(np.stack(b_entries, axis=1)),
+        }
+    ids = {n: i + 1 for i, n in enumerate(names)}
+    return stacks, ids

@@ -236,6 +236,13 @@ def _huggingface_runtime(model_dir: str, spec: dict) -> Model:
         from kubeflow_tpu.serve.generation import GenerativeJAXModel
 
         gen = dict(spec["generative"])
+        if gen.get("adapters"):
+            # Multi-LoRA: {name: PEFT adapter dir}, relative to the
+            # bundle like `checkpoint`.
+            gen["adapters"] = {
+                k: (v if os.path.isabs(v)
+                    else os.path.join(os.path.abspath(model_dir), v))
+                for k, v in dict(gen["adapters"]).items()}
         # Bundle the checkpoint's own tokenizer when present (vLLM-parity
         # text in/out + streaming text deltas): generation then accepts
         # "text" and returns decoded "text"; eos defaults to the

@@ -46,6 +46,23 @@ def _params_and_tokens(cfg, batch=8, seq=16, seed=0):
 def test_pipeline_forward_matches_scanned(devices8, mesh_kw, chunks, batch):
     cfg = _cfg()
     model, params, tokens = _params_and_tokens(cfg, batch=batch)
+    _run_forward_parity(devices8, cfg, model, params, tokens, mesh_kw,
+                        chunks)
+
+
+def test_pipeline_forward_gemma_flags(devices8):
+    """The Gemma conventions ((1+w) norms, embed scale, GeGLU) must hold
+    through the pipeline stage forward too — silently-wrong math here
+    would train a Gemma config wrong with no error."""
+    cfg = dataclasses.replace(_cfg(), norm_plus_one=True, embed_scale=True,
+                              mlp_act="gelu_tanh", tie_embeddings=True)
+    model, params, tokens = _params_and_tokens(cfg, batch=8)
+    _run_forward_parity(devices8, cfg, model, params, tokens,
+                        dict(pipe=4, data=2), 1)
+
+
+def _run_forward_parity(devices8, cfg, model, params, tokens, mesh_kw,
+                        chunks):
     mesh = build_mesh(MeshConfig(**mesh_kw), devices8)
 
     ref = model.apply({"params": params}, tokens)
