@@ -774,6 +774,11 @@ class GenerationEngine:
             return None
         return jnp.asarray(aids, jnp.int32)
 
+    def adapter_names(self) -> list:
+        """Loaded multi-LoRA adapter names (the public surface — the
+        OpenAI model-id routing and metadata() both read this)."""
+        return sorted(self._ml_ids)
+
     def _resolve_adapter(self, name) -> int:
         if name is None:
             return 0
@@ -1336,6 +1341,6 @@ class GenerativeJAXModel(Model):
         if self.engine:
             md["decode_buckets"] = list(self.engine.decode_buckets)
             md["speculative"] = self.engine._spec is not None
-            if self.engine._ml_ids:
-                md["adapters"] = sorted(self.engine._ml_ids)
+            if self.engine.adapter_names():
+                md["adapters"] = self.engine.adapter_names()
         return md

@@ -49,11 +49,51 @@ class _OpenAIBase(_Base):
             "code": status_code}}))
 
     def _generative(self, name: str):
-        model = self.repo.get(name or "")
+        """Resolve an OpenAI model id to (model, adapter | None). The
+        vLLM multi-LoRA convention: a loaded LoRA adapter's name IS a
+        servable model id — "<base>:<adapter>" or the bare adapter name
+        (when unambiguous) route to the base engine with that adapter
+        selected per request."""
+        def lookup(n):
+            try:
+                return self.repo.get(n)
+            except tornado.web.HTTPError:
+                return None  # repo.get 404s on unknown names
+
+        model = lookup(name or "")
+        adapter = None
+        if model is None and name:
+            base_name, _, ad = name.partition(":")
+            if ad:
+                cand = lookup(base_name)
+                if cand is not None and ad in self._adapters_of(cand):
+                    model, adapter = cand, ad
+            else:
+                hits = [(m, name) for m in
+                        (lookup(n) for n in self.repo.names())
+                        if m is not None and name in self._adapters_of(m)]
+                if len(hits) == 1:
+                    model, adapter = hits[0]
+                elif len(hits) > 1:
+                    raise tornado.web.HTTPError(
+                        400, reason=(
+                            f"adapter name {name!r} is ambiguous (loaded "
+                            "on multiple models); use "
+                            "'<base>:<adapter>'"))
+        if model is None:
+            raise tornado.web.HTTPError(
+                404, reason=f"model {name!r} not found")
         if getattr(model, "generate", None) is None:
             raise tornado.web.HTTPError(
                 400, reason=f"model {name!r} is not generative")
-        return model
+        return model, adapter
+
+    @staticmethod
+    def _adapters_of(model) -> list:
+        eng = getattr(model, "engine", None)
+        if eng is None or not hasattr(eng, "adapter_names"):
+            return []
+        return eng.adapter_names()
 
 
 def _payload_from(body: dict) -> dict:
@@ -167,7 +207,7 @@ class _GenerativeHandler(_OpenAIBase):
         if not isinstance(body, dict):
             raise tornado.web.HTTPError(400, reason="body must be an object")
         name = body.get("model", "")
-        model = self._generative(name)
+        model, adapter = self._generative(name)
         stops = _stop_list(body)
         if stops and getattr(model, "tokenizer", None) is None:
             raise tornado.web.HTTPError(
@@ -175,6 +215,8 @@ class _GenerativeHandler(_OpenAIBase):
         try:
             payload = {**self.make_payload(model, body),
                        **_payload_from(body)}
+            if adapter is not None:
+                payload["adapter"] = adapter
         except tornado.web.HTTPError:
             raise
         except (TypeError, ValueError) as e:
@@ -350,9 +392,14 @@ class ChatCompletionsHandler(_GenerativeHandler):
 
 class ModelsHandler(_OpenAIBase):
     def get(self):
-        self.write_json({"object": "list", "data": [
-            {"id": n, "object": "model", "owned_by": "tpukit"}
-            for n in self.repo.names()]})
+        data = []
+        for n in self.repo.names():
+            data.append({"id": n, "object": "model", "owned_by": "tpukit"})
+            # LoRA adapters list as servable models (vLLM convention).
+            for ad in self._adapters_of(self.repo.get(n)):
+                data.append({"id": f"{n}:{ad}", "object": "model",
+                             "owned_by": "tpukit", "parent": n})
+        self.write_json({"object": "list", "data": data})
 
 
 def routes(server) -> list:
