@@ -135,6 +135,46 @@ inline std::string ValidateSpec(const std::string& kind, const Json& spec) {
       if (batch >= 0 && accum >= 1 && batch % accum) {
         return "runtime.batch_size must be divisible by accum_steps";
       }
+      // runtime.lora contents (the schema types it as an object; the
+      // knob semantics live here so a typo'd rank fails at submit,
+      // mirroring the Python Trainer's validation).
+      const Json& lora = rt.get("lora");
+      if (lora.is_object() && lora.size() > 0) {
+        // ({} = LoRA disabled, matching the Python Trainer's falsy
+        // check; contents are validated only when the knob is in use.)
+        for (const auto& [k, v] : lora.items()) {
+          (void)v;
+          if (k != "rank" && k != "alpha" && k != "targets") {
+            return "runtime.lora." + k +
+                   " is not a lora field (rank, alpha, targets)";
+          }
+        }
+        const Json& rank = lora.get("rank");
+        if (!rank.is_number() ||
+            rank.as_number() != std::floor(rank.as_number()) ||
+            rank.as_number() < 1) {
+          return "runtime.lora.rank must be an integer >= 1";
+        }
+        if (lora.has("alpha") && (!lora.get("alpha").is_number() ||
+                                  lora.get("alpha").as_number() <= 0)) {
+          return "runtime.lora.alpha must be a number > 0";
+        }
+        if (lora.has("targets")) {
+          const std::string t = lora.get("targets").as_string();
+          if (t != "attn" && t != "attn_mlp") {
+            return "runtime.lora.targets must be attn | attn_mlp";
+          }
+        }
+        // Pipeline parallelism is switched by mesh.pipe > 1 (the
+        // `pipeline` object only tunes it) — check both surfaces.
+        if ((rt.get("pipeline").is_object() &&
+             rt.get("pipeline").size() > 0) ||
+            rt.get("mesh").get("pipe").as_int(1) > 1) {
+          return "runtime.lora doesn't compose with pipeline "
+                 "parallelism (pipeline stages have no adapter path)";
+        }
+      }
+      // (non-object lora is rejected by the schema-driven loop above)
     }
     const Json& elastic = spec.get("elastic");
     if (!elastic.is_null()) {
