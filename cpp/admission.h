@@ -167,9 +167,13 @@ inline std::string ValidateSpec(const std::string& kind, const Json& spec) {
         }
         // Pipeline parallelism is switched by mesh.pipe > 1 (the
         // `pipeline` object only tunes it) — check both surfaces.
+        // Bounds before any as_int (cast beyond int64 is UB), and no
+        // default fallback decides admission: a non-number pipe simply
+        // isn't "> 1" here (the mesh itself fails later validation).
+        const Json& pipe = rt.get("mesh").get("pipe");
+        const bool pipe_gt1 = pipe.is_number() && pipe.as_number() > 1;
         if ((rt.get("pipeline").is_object() &&
-             rt.get("pipeline").size() > 0) ||
-            rt.get("mesh").get("pipe").as_int(1) > 1) {
+             rt.get("pipeline").size() > 0) || pipe_gt1) {
           return "runtime.lora doesn't compose with pipeline "
                  "parallelism (pipeline stages have no adapter path)";
         }

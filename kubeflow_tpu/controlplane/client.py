@@ -154,12 +154,17 @@ class Client:
               learning_rate: float = 1e-3, strategy: str = "dp",
               mesh: dict | None = None, num_slices: int = 1,
               checkpoint: dict | None = None,
+              lora: dict | None = None,
               restart_policy: str = "OnFailure", backoff_limit: int = 3,
               log_every: int = 10, **runtime_extra) -> dict:
         """High-level fine-tune entry point — `TrainingClient.train()`
         parity (⟨training-operator: sdk/python — train()⟩, SURVEY.md §3.2):
         fabricates the JAXJob from model/dataset names in the runtime
-        registry instead of requiring a hand-written spec."""
+        registry instead of requiring a hand-written spec.
+
+        `lora={"rank": r, "alpha": a, "targets": "attn"|"attn_mlp"}` is
+        the reference SDK's LoraConfig: adapters train, the base stays
+        frozen (train/lora.py)."""
         runtime = {
             "model": model, "dataset": dataset,
             "strategy": strategy, "steps": steps,
@@ -174,6 +179,8 @@ class Client:
             runtime["mesh"] = mesh
         if checkpoint:
             runtime["checkpoint"] = checkpoint
+        if lora:
+            runtime["lora"] = lora
         runtime.update(runtime_extra)
         spec = {
             "replicas": num_workers,
