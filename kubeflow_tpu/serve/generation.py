@@ -553,6 +553,7 @@ class GenerationEngine:
             self._ml_stacks, self._ml_ids = build_adapter_stacks(
                 dict(adapters), self.cfg)
             self._ml_stacks = jax.device_put(self._ml_stacks)
+            self._ml_names = {i: n for n, i in self._ml_ids.items()}
         self._mesh = mesh
         if rules is None:
             from kubeflow_tpu.parallel.sharding import DEFAULT_RULES
@@ -973,6 +974,14 @@ class GenerationEngine:
                              "draft_ok": draft_ok, "aid": aid}
         self.stats["requests"] += 1
         self.stats["prompt_tokens"] += len(ids)
+        if aid:
+            # Copy-on-write: metadata() snapshots stats with a SHALLOW
+            # dict() from another thread — swapping in a fresh dict keeps
+            # any in-flight snapshot's inner reference immutable.
+            per = dict(self.stats.get("adapter_requests", {}))
+            name = self._ml_names[aid]
+            per[name] = per.get(name, 0) + 1
+            self.stats["adapter_requests"] = per
         self._emit(slot, [first], [float(lp0[0])])
 
     def _emit(self, slot: int, tokens: list[int],

@@ -71,6 +71,40 @@ def test_tp_decode_token_identical(model_and_params, devices8):
                                    w["output_logprobs"], atol=1e-4)
 
 
+@pytest.mark.parametrize("variant", ["qwen2", "gemma"])
+def test_tp_decode_new_family_flags(devices8, variant):
+    """The new family conventions compose with tensor parallelism: QKV
+    biases (Qwen2) and (1+w) norms + embed scale + GeGLU (Gemma) must
+    decode token-identically under a tensor=8 mesh."""
+    flags = (dict(attention_bias=True) if variant == "qwen2" else
+             dict(norm_plus_one=True, embed_scale=True,
+                  mlp_act="gelu_tanh", tie_embeddings=True))
+    cfg = dataclasses.replace(CFG, **flags)
+    model = Llama(cfg)
+    params = jax.jit(
+        lambda r: model.init(r, jnp.zeros((1, 8), jnp.int32))["params"])(
+            jax.random.key(11))
+    rng = np.random.default_rng(2)
+    prompts = [list(rng.integers(1, cfg.vocab_size, 5)),
+               list(rng.integers(1, cfg.vocab_size, 12))]
+
+    ref = GenerationEngine(model, params, cfg, **ENGINE_KW, seed=0)
+    try:
+        want = _generate_all(ref, prompts, max_tokens=8)
+    finally:
+        ref.close()
+
+    mesh = build_mesh(MeshConfig(data=1, tensor=8), devices8)
+    tp = GenerationEngine(model, params, cfg, **ENGINE_KW, seed=0,
+                          mesh=mesh)
+    try:
+        got = _generate_all(tp, prompts, max_tokens=8)
+    finally:
+        tp.close()
+    for w, g in zip(want, got):
+        assert g["output_ids"] == w["output_ids"]
+
+
 def test_tp_sampling_runs(model_and_params, devices8):
     """Temperature/top-k/top-p sampling under TP: valid tokens, correct
     counts (cross-device numerics may legitimately flip a sample, so this

@@ -107,6 +107,7 @@ def test_multilora_per_request_matches_references(setup):
         assert out_base["output_ids"] == _torch_greedy(base_model, prompt, 6)
         assert out_a["output_ids"] == _torch_greedy(a_model, prompt, 6)
         assert out_b["output_ids"] == _torch_greedy(b_model, prompt, 6)
+        assert eng.stats["adapter_requests"] == {"ada": 1, "adb": 1}
         # The adapters actually bite (references differ from base).
         assert out_a["output_ids"] != out_base["output_ids"] or \
             out_b["output_ids"] != out_base["output_ids"]
