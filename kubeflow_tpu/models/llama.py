@@ -201,11 +201,26 @@ def init_cache(cfg: LlamaConfig, batch: int, max_len: int | None = None,
     cache is passed into and returned from `Llama.__call__`, never stored as
     a flax variable, so serving can AOT-compile prefill/decode as pure fns
     (the TPU answer to vLLM's mutable paged cache; SURVEY.md §2.2
-    huggingfaceserver row)."""
+    huggingfaceserver row).
+
+    Sliding-window checkpoints (Mistral-class) whose window is shorter
+    than the requested length get a ROLLING cache instead: T = window
+    rows, writes wrap modularly, and a "pos" plane [L, B, T] records each
+    row's absolute position (sentinel -(window+1) = never written) so
+    attention can mask reads exactly — the vLLM/HF rolling-buffer
+    capability, XLA-shaped (static shapes, pure fns)."""
     t = max_len or cfg.max_seq_len
-    shape = (cfg.num_layers, batch, t, cfg.num_kv_heads, cfg.head_dim)
     dt = dtype or cfg.dtype
-    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+    window = int(getattr(cfg, "mask_window", 0) or 0)
+    cache = {}
+    if getattr(cfg, "mask_kind", "causal") == "sliding_window" \
+            and 0 < window < t:
+        t = window
+        cache["pos"] = jnp.full((cfg.num_layers, batch, t),
+                                -(window + 1), jnp.int32)
+    shape = (cfg.num_layers, batch, t, cfg.num_kv_heads, cfg.head_dim)
+    cache.update({"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)})
+    return cache
 
 
 def _update_cache(cache_k, cache_v, k, v, index):
@@ -217,6 +232,29 @@ def _update_cache(cache_k, cache_v, k, v, index):
                 jax.lax.dynamic_update_slice(cv, vv, (i, 0, 0)))
     return jax.vmap(row)(cache_k, cache_v, k.astype(cache_k.dtype),
                          v.astype(cache_v.dtype), index)
+
+
+def _update_cache_rolling(cache, k, v, positions, index, window):
+    """Modular writes into a per-layer rolling cache {"k","v","pos"}:
+    chunk token j lands in row (index + j) % window with its absolute
+    position recorded. Rows whose `positions` entry is negative (the
+    engine marks prompt-bucket padding with a sentinel) keep their OLD
+    contents — a padded write must never evict a real in-window row.
+    Callers guarantee S <= window (the engine clamps prefill buckets), so
+    the target rows are distinct and gather-then-set is well-defined."""
+    s = k.shape[1]
+
+    def row(ck, cv, cp, kk, vv, pos, i):
+        rows = (i + jnp.arange(s)) % window
+        valid = pos >= 0
+        kk = jnp.where(valid[:, None, None], kk.astype(ck.dtype), ck[rows])
+        vv = jnp.where(valid[:, None, None], vv.astype(cv.dtype), cv[rows])
+        pp = jnp.where(valid, pos, cp[rows])
+        return ck.at[rows].set(kk), cv.at[rows].set(vv), cp.at[rows].set(pp)
+
+    ck, cv, cp = jax.vmap(row)(cache["k"], cache["v"], cache["pos"],
+                               k, v, positions, index)
+    return {"k": ck, "v": cv, "pos": cp}
 
 
 class Attention(nn.Module):
@@ -276,10 +314,38 @@ class Attention(nn.Module):
         v = nn.with_logical_constraint(v, ("batch", "act_seq", None, "act_kv"))
 
         mask_spec = cfg.mask_spec
+        if cache is not None and "pos" in cache:
+            # Rolling sliding-window decode (vLLM/HF rolling-buffer
+            # parity for Mistral-class serving past the window). Attend
+            # BEFORE writing: a chunk's own modular writes may evict rows
+            # its earliest queries are still entitled to see. Stale rows
+            # (a spec-decode rewind leaves rows holding positions >= the
+            # current write index) are masked to the sentinel first; the
+            # fresh chunk's own K/V ride alongside the cache in the read.
+            window = int(cfg.mask_window)
+            sentinel = jnp.int32(-(window + 1))
+            cpos = jnp.where(cache["pos"] >= cache_index[:, None],
+                             sentinel, cache["pos"])
+            keys = jnp.concatenate([cache["k"].astype(k.dtype), k], axis=1)
+            vals = jnp.concatenate([cache["v"].astype(v.dtype), v], axis=1)
+            pos_kv = jnp.concatenate([cpos, positions], axis=1)
+            out = naive_attention(q, keys, vals, causal=True,
+                                  positions_q=positions, positions_kv=pos_kv,
+                                  mask=mask_spec)
+            new_cache = _update_cache_rolling(cache, k, v, positions,
+                                              cache_index, window)
+            out = dense(features=cfg.hidden_size, axis=(-2, -1),
+                        kernel_init=nn.with_logical_partitioning(
+                            nn.initializers.lecun_normal(),
+                            ("heads", "kv", "embed")),
+                        name="o_proj")(out)
+            return out, new_cache
         if mask_spec is not None and cache is not None:
             raise ValueError(
                 "attention mask specs don't compose with KV-cache decode "
-                "(v1): serve masked models with full-forward predict")
+                "(v1): serve masked models with full-forward predict "
+                "(sliding_window checkpoints roll automatically when the "
+                "cache is built with max_len > window)")
 
         new_cache = None
         if cache is not None:

@@ -96,7 +96,8 @@ def sample_tokens(logits: jax.Array, temperature: jax.Array,
 def build_engine_fns(model, cfg, *, max_len: int, chunk: int,
                      prefill_buckets: Sequence[int],
                      offset_writes: bool,
-                     cache_sharding=None, adapters=None) -> dict:
+                     cache_sharding=None, adapters=None,
+                     rolling_window: int = 0) -> dict:
     """The engine's pure device functions, as unjitted closures.
 
     Single source of truth shared by the live `GenerationEngine` (which
@@ -111,12 +112,29 @@ def build_engine_fns(model, cfg, *, max_len: int, chunk: int,
     trailing `aid` (adapter index per row, 0 = base) and the model call
     gathers per-row adapter deltas — multi-LoRA inside one compiled
     program. Callers that never pass `aid` keep base behavior exactly.
+
+    `rolling_window` > 0 switches the cache to the rolling sliding-window
+    layout (models/llama.py init_cache): caches hold `window` rows, every
+    admission fn passes EXPLICIT positions whose padded tail is the
+    sentinel (so modular writes skip pad rows), and decode passes the raw
+    absolute index (the model wraps it; clamping would corrupt positions).
     """
     from kubeflow_tpu.models.llama import init_cache
 
     prefill_buckets = sorted(prefill_buckets)
     big = prefill_buckets[-1]
     frag_len = max_len + (big if offset_writes else 0)
+    rolling = int(rolling_window) > 0
+    cache_len = rolling_window if rolling else max_len
+    sentinel = -(int(rolling_window) + 1)
+
+    def _chunk_positions(index, length, width):
+        """Absolute positions for a right-padded chunk, pad tail at the
+        sentinel — rolling mode only (the sentinel both masks pad keys
+        out of attention and stops their modular cache writes)."""
+        ar = jnp.arange(width)[None]
+        return jnp.where(ar < length[:, None], index[:, None] + ar,
+                         sentinel)
 
     def apply_kw(aid) -> dict:
         if aid is None or adapters is None:
@@ -126,18 +144,22 @@ def build_engine_fns(model, cfg, *, max_len: int, chunk: int,
     def _constrain_cache(cache):
         if cache_sharding is None:
             return cache
-        return jax.tree.map(
-            lambda c: jax.lax.with_sharding_constraint(c, cache_sharding),
-            cache)
+        return {k: (jax.lax.with_sharding_constraint(v, cache_sharding)
+                    if k in ("k", "v") else v)
+                for k, v in cache.items()}
 
     def prefill(params, tokens, length, temperature, top_k, top_p, key,
                 aid=None):
         """tokens [1, S_bucket] right-padded; returns (frag_cache,
         first sampled token [1], its logprob [1])."""
         cache = _constrain_cache(init_cache(cfg, 1, frag_len))
+        kw = apply_kw(aid)
+        if rolling:
+            kw["positions"] = _chunk_positions(
+                jnp.zeros((1,), jnp.int32), length, tokens.shape[1])
         logits, cache = model.apply(
             {"params": params}, tokens, cache=cache,
-            cache_index=jnp.zeros((1,), jnp.int32), **apply_kw(aid))
+            cache_index=jnp.zeros((1,), jnp.int32), **kw)
         last = jnp.take_along_axis(
             logits, (length - 1)[:, None, None], axis=1)[:, 0]  # [1, V]
         tok = sample_tokens(last, temperature, key, top_k, top_p)
@@ -149,7 +171,10 @@ def build_engine_fns(model, cfg, *, max_len: int, chunk: int,
         [1, S_bucket] right-padded, written at offset `index` [1],
         attending over the WHOLE fragment cache; samples the first
         generated token like prefill."""
-        positions = index[:, None] + jnp.arange(tokens.shape[1])[None]
+        if rolling:
+            positions = _chunk_positions(index, length, tokens.shape[1])
+        else:
+            positions = index[:, None] + jnp.arange(tokens.shape[1])[None]
         logits, cache = model.apply(
             {"params": params}, tokens, cache=cache, cache_index=index,
             positions=positions, attend_full_cache=True, **apply_kw(aid))
@@ -161,7 +186,9 @@ def build_engine_fns(model, cfg, *, max_len: int, chunk: int,
     def extend_mid(params, cache, tokens, index, aid=None):
         """Intermediate continuation chunk: cache write + attention
         only — return_hidden skips the full-vocab unembedding whose
-        sampled token would be discarded anyway."""
+        sampled token would be discarded anyway. Intermediate chunks are
+        always FULL (only the final piece of a prompt can be partial —
+        _admit_inner), so rolling mode needs no pad sentinel here."""
         positions = index[:, None] + jnp.arange(tokens.shape[1])[None]
         _, cache = model.apply(
             {"params": params}, tokens, cache=cache, cache_index=index,
@@ -189,8 +216,11 @@ def build_engine_fns(model, cfg, *, max_len: int, chunk: int,
             plain-temperature traffic (the defaults) must not pay
             O(V log V) per token. Attention runs over the first `bucket`
             cache rows only (the loop picks the smallest bucket covering
-            every active sequence), then the slice is written back."""
-            sliced = (cache if bucket == max_len else jax.tree.map(
+            every active sequence), then the slice is written back.
+            Rolling mode: the cache is `window` rows (never sliced) and
+            the index passes through RAW — the model wraps it modularly
+            and needs the absolute value for positions."""
+            sliced = (cache if bucket == cache_len else jax.tree.map(
                 lambda c: jax.lax.slice_in_dim(c, 0, bucket, axis=2),
                 cache))
 
@@ -199,7 +229,8 @@ def build_engine_fns(model, cfg, *, max_len: int, chunk: int,
                 key, sub = jax.random.split(key)
                 logits, sliced = model.apply(
                     {"params": params}, tok[:, None], cache=sliced,
-                    cache_index=jnp.minimum(idx, bucket - 1),
+                    cache_index=(idx if rolling
+                                 else jnp.minimum(idx, bucket - 1)),
                     **apply_kw(aid))
                 if truncate:
                     nxt = sample_tokens(logits[:, 0], temperature, sub,
@@ -212,7 +243,7 @@ def build_engine_fns(model, cfg, *, max_len: int, chunk: int,
             (sliced, _, _, _), (toks, lps) = jax.lax.scan(
                 step, (sliced, last_tok, index, key), None,
                 length=chunk)
-            if bucket != max_len:
+            if bucket != cache_len:
                 cache = jax.tree.map(
                     lambda c, s: jax.lax.dynamic_update_slice(
                         c, s, (0,) * c.ndim), cache, sliced)
@@ -284,7 +315,7 @@ def spec_acceptance(drafts, dlogits, tlogits, temperature, key):
 
 
 def build_spec_decode(model, draft_model, *, gamma: int, n_spec: int,
-                      max_len: int):
+                      max_len: int, rolling_window: int = 0):
     """Speculative decoding step functions (vLLM's draft-model speedup,
     XLA-shaped): per spec step the DRAFT autoregressively proposes `gamma`
     tokens (gamma cheap forwards inside the scan), then the TARGET scores
@@ -305,16 +336,55 @@ def build_spec_decode(model, draft_model, *, gamma: int, n_spec: int,
     make(bucket) -> spec_chunk(params, dparams, cache, dcache, last_tok,
     index, temperature, key) -> (cache, dcache,
     tokens [B, n_spec, gamma+1], logprobs [B, n_spec, gamma+1],
-    accepted [B, n_spec])."""
+    accepted [B, n_spec]).
+
+    `rolling_window` > 0: the TARGET runs a rolling sliding-window cache
+    (window rows, modular writes). The verify forward writes all gamma+1
+    candidate rows, but a rejection rewinds — and in a rolling cache
+    those rejected writes have EVICTED live in-window rows (in a causal
+    cache they merely occupy not-yet-committed rows ahead of the index).
+    After acceptance the step reverts rows past the accepted count to
+    their pre-verify contents, so the cache always holds exactly the
+    committed stream."""
+    rolling = int(rolling_window) > 0
 
     def make(bucket: int):
         def spec_chunk(params, dparams, cache, dcache, last_tok, index,
                        temperature, key):
             def sl(c):
-                return (c if bucket == max_len else jax.tree.map(
-                    lambda x: jax.lax.slice_in_dim(x, 0, bucket, axis=2), c))
+                # Rolling target (window rows) and its causal draft
+                # (max_len rows) are never sliced — the window already
+                # bounds the target's attention cost, and bucket is
+                # sized for the causal layout only.
+                if rolling or bucket == max_len:
+                    return c
+                return jax.tree.map(
+                    lambda x: jax.lax.slice_in_dim(x, 0, bucket, axis=2), c)
 
             sliced, dsliced = sl(cache), sl(dcache)
+            dcap = max_len if rolling else bucket
+
+            def revert_rejected(cw, c0, idx, k):
+                """Restore rolling-cache rows the rejected candidates
+                clobbered: row (idx+j) % window keeps the verify's write
+                for j <= k (committed tokens) and returns to its
+                pre-verify contents otherwise."""
+                j = jnp.arange(gamma + 1)
+                rows = (idx[:, None] + j[None]) % rolling_window
+                keep = j[None] <= k[:, None]
+
+                def leaf(cw, c0):
+                    def per_batch(cwb, c0b, r, kp):
+                        old = jnp.take(c0b, r, axis=1)  # [L, gamma+1, ...]
+                        new = jnp.take(cwb, r, axis=1)
+                        sel = kp.reshape((1, -1) + (1,) * (cwb.ndim - 2))
+                        vals = jnp.where(sel, new, old)
+                        return jax.vmap(
+                            lambda cl, vl: cl.at[r].set(vl))(cwb, vals)
+                    return jax.vmap(per_batch, in_axes=(1, 1, 0, 0),
+                                    out_axes=1)(cw, c0, rows, keep)
+
+                return jax.tree.map(leaf, cw, c0)
 
             def spec_step(carry, _):
                 c, dc, tok, idx, key = carry
@@ -324,7 +394,7 @@ def build_spec_decode(model, draft_model, *, gamma: int, n_spec: int,
                     dc, t, i = dcarry
                     dlogits, dc = draft_model.apply(
                         {"params": dparams}, t[:, None], cache=dc,
-                        cache_index=jnp.minimum(i, bucket - 1))
+                        cache_index=jnp.minimum(i, dcap - 1))
                     row = dlogits[:, 0]
                     # Sampled rows draw from the draft's tempered softmax
                     # (the rejection scheme needs d ~ p_d); greedy rows
@@ -349,12 +419,16 @@ def build_spec_decode(model, draft_model, *, gamma: int, n_spec: int,
 
                 tokens_in = jnp.concatenate([tok[:, None], drafts], axis=1)
                 positions = idx[:, None] + jnp.arange(gamma + 1)[None]
+                c0 = c
                 tlogits, c = model.apply(
                     {"params": params}, tokens_in, cache=c,
-                    cache_index=jnp.minimum(idx, bucket - 1),
+                    cache_index=(idx if rolling
+                                 else jnp.minimum(idx, bucket - 1)),
                     positions=positions, attend_full_cache=True)
                 out, k, nxt = spec_acceptance(
                     drafts, dlogits, tlogits, temperature, akey)
+                if rolling:
+                    c = revert_rejected(c, c0, idx, k)
                 lps = _chosen_logprob(tlogits, out)
                 return (c, dc, nxt, idx + k + 1, key), (out, lps, k)
 
@@ -363,7 +437,7 @@ def build_spec_decode(model, draft_model, *, gamma: int, n_spec: int,
                 length=n_spec)
 
             def wb(full, s):
-                if bucket == max_len:
+                if rolling or bucket == max_len:
                     return s
                 return jax.tree.map(
                     lambda c, x: jax.lax.dynamic_update_slice(
@@ -409,55 +483,69 @@ class GenerationEngine:
                 f"range (max_seq_len={msl}); positions would silently "
                 "clamp")
         mask_kind = getattr(cfg, "mask_kind", "causal")
+        self._rolling = 0
         if mask_kind == "sliding_window":
-            # The decode path attends over the full cache (causal). For a
-            # windowed checkpoint (Mistral-style) that is EXACT iff no
-            # sequence can outgrow the window; past it the logits would
-            # silently diverge from the source model — refuse instead.
             window = int(getattr(cfg, "mask_window", 0))
             if self.max_len > window:
-                raise ValueError(
-                    f"sliding-window checkpoint (window={window}): serving "
-                    f"max_len={self.max_len} exceeds the window, where "
-                    "full-cache decode no longer matches the source "
-                    "model; set max_len <= window")
-            # Within the window the band never clips, so causal decode is
-            # exact — rebuild the module causal (params are identical; the
-            # mask kind is config-only) to use the KV-cache paths, which
-            # refuse mask specs outright.
-            import dataclasses
-
-            from kubeflow_tpu.serve.quant import QuantizedModule
-
-            cfg = dataclasses.replace(cfg, mask_kind="causal",
-                                      mask_window=0,
-                                      attention_impl="auto")
-            if isinstance(model, QuantizedModule):
-                # Rebuild the INNER module; the wrapper takes (module,
-                # dtype), not a config.
-                model = QuantizedModule(type(model.module)(cfg),
-                                        model.dtype)
+                # Serving PAST the window: rolling-buffer KV cache
+                # (models/llama.py init_cache grows a "pos" plane; rows =
+                # window, modular writes, position-masked reads) — the
+                # vLLM/huggingfaceserver capability of serving
+                # Mistral-class models at full context, exactly.
+                if window < 1:
+                    raise ValueError(
+                        "sliding-window checkpoint with window=0 cannot "
+                        "be served")
+                self._rolling = window
             else:
-                model = type(model)(cfg)
-            self.model, self.cfg = model, cfg
+                # Within the window the band never clips, so causal decode
+                # is exact — rebuild the module causal (params are
+                # identical; the mask kind is config-only) to use the
+                # faster causal KV-cache paths (bucketed decode, flash
+                # prefill) instead of the rolling read.
+                import dataclasses
+
+                from kubeflow_tpu.serve.quant import QuantizedModule
+
+                cfg = dataclasses.replace(cfg, mask_kind="causal",
+                                          mask_window=0,
+                                          attention_impl="auto")
+                if isinstance(model, QuantizedModule):
+                    # Rebuild the INNER module; the wrapper takes (module,
+                    # dtype), not a config.
+                    model = QuantizedModule(type(model.module)(cfg),
+                                            model.dtype)
+                else:
+                    model = type(model)(cfg)
+                self.model, self.cfg = model, cfg
         elif mask_kind != "causal":
             raise ValueError(
                 f"generative serving needs a causal-class model; got "
                 f"mask_kind={mask_kind!r}")
+        # Rolling mode clamps prompt buckets to the window: a chunk wider
+        # than the window would wrap onto itself (duplicate modular write
+        # rows — undefined scatter order).
+        bucket_cap = min(self.max_len, self._rolling or self.max_len)
         self.prefill_buckets = sorted(
-            {min(int(b), self.max_len) for b in prefill_buckets})
+            {min(int(b), bucket_cap) for b in prefill_buckets})
         # Length-aware decode (VERDICT r2 item 4): decode compiles once PER
         # CACHE-LENGTH BUCKET over a time-sliced cache, so attention cost
         # tracks the longest ACTIVE sequence, not max_len. Default buckets:
         # powers of two from max(64, 2·chunk) up to max_len.
-        if decode_buckets is None:
-            b, decode_buckets = max(64, 2 * self.chunk), []
-            while b < self.max_len:
-                decode_buckets.append(b)
-                b *= 2
-        self.decode_buckets = sorted(
-            {int(b) for b in decode_buckets
-             if self.chunk < int(b) < self.max_len} | {self.max_len})
+        # Rolling mode has ONE bucket — the window itself already bounds
+        # attention cost, and rolling rows aren't prefix-ordered, so
+        # time-slicing the cache would drop live in-window rows.
+        if self._rolling:
+            self.decode_buckets = [self._rolling]
+        else:
+            if decode_buckets is None:
+                b, decode_buckets = max(64, 2 * self.chunk), []
+                while b < self.max_len:
+                    decode_buckets.append(b)
+                    b *= 2
+            self.decode_buckets = sorted(
+                {int(b) for b in decode_buckets
+                 if self.chunk < int(b) < self.max_len} | {self.max_len})
         # Prefix cache: LRU of prompt-chunk-boundary KV fragments keyed by
         # the exact token prefix; admission resumes chunked prefill after
         # the longest hit instead of recomputing it (the vLLM prefix-reuse
@@ -527,6 +615,11 @@ class GenerationEngine:
             gamma = int(draft.get("gamma", 4))
             if gamma < 1:
                 raise ValueError(f"gamma must be >= 1, got {gamma}")
+            if self._rolling and gamma + 1 > self._rolling:
+                raise ValueError(
+                    f"gamma={gamma} writes {gamma + 1} candidate rows per "
+                    f"spec step, more than the rolling window "
+                    f"({self._rolling}) holds")
             self._spec = {
                 "model": draft["model"], "cfg": dcfg, "gamma": gamma,
                 # Spec steps per dispatch: match the vanilla chunk's
@@ -576,11 +669,19 @@ class GenerationEngine:
         self._compile()
         from kubeflow_tpu.models.llama import init_cache
         with self._scope():
+            cache_sh = None
+            if self._cache_sharding is not None:
+                cache_sh = {"k": self._cache_sharding,
+                            "v": self._cache_sharding}
+                if self._rolling:
+                    # The pos plane [L, B, W] is tiny i32 bookkeeping —
+                    # replicate it.
+                    from jax.sharding import NamedSharding, PartitionSpec
+                    cache_sh["pos"] = NamedSharding(self._mesh,
+                                                    PartitionSpec())
             self._cache = jax.jit(
                 lambda: init_cache(cfg, self.n_slots, self.max_len),
-                out_shardings=(None if self._cache_sharding is None else
-                               jax.tree.map(lambda _: self._cache_sharding,
-                                            {"k": 0, "v": 0})))()
+                out_shardings=cache_sh)()
             if self._spec is not None:
                 self._dcache = jax.jit(lambda: init_cache(
                     self._spec["cfg"], self.n_slots, self.max_len))()
@@ -681,7 +782,8 @@ class GenerationEngine:
             prefill_buckets=self.prefill_buckets,
             offset_writes=offset_writes,
             cache_sharding=self._cache_sharding,
-            adapters=self._ml_stacks)
+            adapters=self._ml_stacks,
+            rolling_window=self._rolling)
         prefill_jit = jax.jit(fns["prefill"])
         self._prefill = {b: prefill_jit for b in self.prefill_buckets}
         self._extend = jax.jit(fns["extend"], donate_argnums=(1,))
@@ -710,7 +812,7 @@ class GenerationEngine:
             spec_make = build_spec_decode(
                 self.model, self._spec["model"],
                 gamma=self._spec["gamma"], n_spec=self._spec["n_spec"],
-                max_len=self.max_len)
+                max_len=self.max_len, rolling_window=self._rolling)
             self._spec_decode = {
                 b: jax.jit(spec_make(b), donate_argnums=(2, 3))
                 for b in self.decode_buckets}
@@ -1076,7 +1178,7 @@ class GenerationEngine:
                 if need <= self.max_len:
                     bucket = next(
                         (b for b in self.decode_buckets if b >= need),
-                        self.max_len)
+                        self.decode_buckets[-1])
                     self._cache, self._dcache, toks, lps, acc = \
                         self._spec_decode[bucket](
                             self._params, self._dparams, self._cache,
@@ -1112,7 +1214,7 @@ class GenerationEngine:
             trunc = any(ks[i] > 0 or ps[i] < 1.0 for i in active)
             need = max(int(idx[i]) for i in active) + self.chunk
             bucket = next((b for b in self.decode_buckets if b >= need),
-                          self.max_len)
+                          self.decode_buckets[-1])
             decode = self._decode[(bucket, trunc)]
             with self._scope():
                 self._cache, toks, lps = decode(

@@ -116,14 +116,31 @@ def test_windowed_serving_composes_with_int8(hf_mistral_dir):
         eng.close()
 
 
-def test_windowed_serving_refused_past_window(hf_mistral_dir):
-    path, _ = hf_mistral_dir
+def test_windowed_serving_rolls_past_window(hf_mistral_dir):
+    """max_len > window switches to the ROLLING cache (window rows,
+    modular writes) and greedy decode stays token-identical to torch even
+    when prompt + generation outgrow the window — the vLLM capability the
+    engine used to refuse (VERDICT r4 item 2)."""
+    path, tmodel = hf_mistral_dir
     from kubeflow_tpu.models.hf_import import import_llama
     from kubeflow_tpu.models.llama import Llama
     from kubeflow_tpu.serve.generation import GenerationEngine
 
     cfg, params = import_llama(path, dtype=jnp.float32,
                                param_dtype=jnp.float32)
-    with pytest.raises(ValueError, match="sliding-window"):
-        GenerationEngine(Llama(cfg), params, cfg, slots=1, max_len=32,
-                         chunk=4, prefill_buckets=(4,))
+    eng = GenerationEngine(Llama(cfg), params, cfg, slots=1, max_len=32,
+                           chunk=4, prefill_buckets=(4,))
+    try:
+        assert eng._rolling == 8 and eng.cfg.mask_kind == "sliding_window"
+        rng = np.random.default_rng(4)
+        # Prompt 12 > window 8 (chunked admission through the rolling
+        # cache), decode 10 more — the band clips throughout.
+        prompt = [int(t) for t in rng.integers(0, 256, 12)]
+        out = eng.submit(prompt, max_tokens=10, temperature=0.0)
+        with torch.no_grad():
+            ref = tmodel.generate(
+                torch.tensor([prompt]), max_new_tokens=10, do_sample=False,
+                pad_token_id=0).numpy()[0, len(prompt):]
+        assert list(out["output_ids"]) == list(ref)
+    finally:
+        eng.close()
