@@ -313,6 +313,13 @@ class Attention(nn.Module):
         k = nn.with_logical_constraint(k, ("batch", "act_seq", None, "act_kv"))
         v = nn.with_logical_constraint(v, ("batch", "act_seq", None, "act_kv"))
 
+        def o_proj(out):
+            return dense(features=cfg.hidden_size, axis=(-2, -1),
+                         kernel_init=nn.with_logical_partitioning(
+                             nn.initializers.lecun_normal(),
+                             ("heads", "kv", "embed")),
+                         name="o_proj")(out)
+
         mask_spec = cfg.mask_spec
         if cache is not None and "pos" in cache:
             # Rolling sliding-window decode (vLLM/HF rolling-buffer
@@ -334,12 +341,7 @@ class Attention(nn.Module):
                                   mask=mask_spec)
             new_cache = _update_cache_rolling(cache, k, v, positions,
                                               cache_index, window)
-            out = dense(features=cfg.hidden_size, axis=(-2, -1),
-                        kernel_init=nn.with_logical_partitioning(
-                            nn.initializers.lecun_normal(),
-                            ("heads", "kv", "embed")),
-                        name="o_proj")(out)
-            return out, new_cache
+            return o_proj(out), new_cache
         if mask_spec is not None and cache is not None:
             raise ValueError(
                 "attention mask specs don't compose with KV-cache decode "
@@ -362,12 +364,7 @@ class Attention(nn.Module):
                 out = naive_attention(
                     q, ck, cv, causal=True, positions_q=positions,
                     positions_kv=jnp.broadcast_to(jnp.arange(t), (ck.shape[0], t)))
-                out = dense(features=cfg.hidden_size, axis=(-2, -1),
-                            kernel_init=nn.with_logical_partitioning(
-                                nn.initializers.lecun_normal(),
-                                ("heads", "kv", "embed")),
-                            name="o_proj")(out)
-                return out, new_cache
+                return o_proj(out), new_cache
             # Prefill (cache_index must be 0): nothing precedes the new
             # tokens, so attention over just k/v is exact — the fast flash
             # path below serves it; the cache write above is the only extra.
@@ -440,11 +437,7 @@ class Attention(nn.Module):
             out = naive_attention(q, k, v, causal=True, positions_q=positions,
                                   positions_kv=positions,
                                   segment_ids=segment_ids, mask=mask_spec)
-        out = dense(features=cfg.hidden_size, axis=(-2, -1),
-                    kernel_init=nn.with_logical_partitioning(
-                        nn.initializers.lecun_normal(), ("heads", "kv", "embed")),
-                    name="o_proj")(out)
-        return out, new_cache
+        return o_proj(out), new_cache
 
 
 def _multi_lora_delta(x: jax.Array, ids: jax.Array, ab: dict,

@@ -315,7 +315,8 @@ def spec_acceptance(drafts, dlogits, tlogits, temperature, key):
 
 
 def build_spec_decode(model, draft_model, *, gamma: int, n_spec: int,
-                      max_len: int, rolling_window: int = 0):
+                      max_len: int, rolling_window: int = 0,
+                      adapters=None):
     """Speculative decoding step functions (vLLM's draft-model speedup,
     XLA-shaped): per spec step the DRAFT autoregressively proposes `gamma`
     tokens (gamma cheap forwards inside the scan), then the TARGET scores
@@ -345,12 +346,20 @@ def build_spec_decode(model, draft_model, *, gamma: int, n_spec: int,
     cache they merely occupy not-yet-committed rows ahead of the index).
     After acceptance the step reverts rows past the accepted count to
     their pre-verify contents, so the cache always holds exactly the
-    committed stream."""
+    committed stream.
+
+    `adapters` (multi-LoRA x spec-decode): the TARGET verifies under each
+    row's adapter while the draft proposes from its own base weights — a
+    base-model draft can only cost acceptance rate, never correctness,
+    because every emitted token comes from the target's (adapted) logits
+    via exact-match/rejection acceptance."""
     rolling = int(rolling_window) > 0
 
     def make(bucket: int):
         def spec_chunk(params, dparams, cache, dcache, last_tok, index,
-                       temperature, key):
+                       temperature, key, aid=None):
+            t_kw = ({} if aid is None or adapters is None
+                    else {"adapter": adapters, "adapter_ids": aid})
             def sl(c):
                 # Rolling target (window rows) and its causal draft
                 # (max_len rows) are never sliced — the window already
@@ -424,7 +433,7 @@ def build_spec_decode(model, draft_model, *, gamma: int, n_spec: int,
                     {"params": params}, tokens_in, cache=c,
                     cache_index=(idx if rolling
                                  else jnp.minimum(idx, bucket - 1)),
-                    positions=positions, attend_full_cache=True)
+                    positions=positions, attend_full_cache=True, **t_kw)
                 out, k, nxt = spec_acceptance(
                     drafts, dlogits, tlogits, temperature, akey)
                 if rolling:
@@ -565,10 +574,6 @@ class GenerationEngine:
         self._spec = None
         if draft is not None:
             dcfg = draft["cfg"]
-            if mesh is not None:
-                raise ValueError(
-                    "speculative decoding doesn't compose with a serving "
-                    "mesh yet (draft sharding is future work)")
             # Same windowed-checkpoint treatment the target gets above: a
             # Mistral-family draft is exact within its window (rebuild
             # causal), past it refuse with an actionable message instead
@@ -627,25 +632,33 @@ class GenerationEngine:
                 # carries over.
                 "n_spec": max(1, self.chunk // (gamma + 1)),
             }
-            self._dparams = jax.device_put(draft["params"])
+            # Device placement happens after mesh setup below — under TP
+            # the draft shards over the same mesh as the target.
+            self._dparams_src = draft["params"]
         # Multi-LoRA serving (serve/multilora.py): {name: PEFT adapter
         # dir} — all adapters stacked on device, selected per request by
         # index inside the compiled program.
         self._ml_stacks = None
         self._ml_ids: dict[str, int] = {}
         if adapters:
-            if mesh is not None:
-                raise ValueError(
-                    "multi-LoRA doesn't compose with a serving mesh yet")
-            if draft is not None:
-                raise ValueError(
-                    "multi-LoRA doesn't compose with speculative "
-                    "decoding yet (the draft has no adapter stacks)")
             from kubeflow_tpu.serve.multilora import build_adapter_stacks
 
             self._ml_stacks, self._ml_ids = build_adapter_stacks(
                 dict(adapters), self.cfg)
-            self._ml_stacks = jax.device_put(self._ml_stacks)
+            if mesh is None:
+                self._ml_stacks = jax.device_put(self._ml_stacks)
+            else:
+                # multi-LoRA x TP: adapter stacks REPLICATE over the mesh
+                # (rank-r factors are tiny next to the base weights); the
+                # per-row delta lands on sharded activations and XLA
+                # slices it at the logical constraint right after. Spec
+                # compose note: the draft proposes from the BASE model —
+                # acceptance may drop for heavily-adapted targets, but
+                # emitted tokens always come from the target's (adapted)
+                # logits, so the sampling law is untouched.
+                from jax.sharding import NamedSharding, PartitionSpec
+                self._ml_stacks = jax.device_put(
+                    self._ml_stacks, NamedSharding(mesh, PartitionSpec()))
             self._ml_names = {i: n for n, i in self._ml_ids.items()}
         self._mesh = mesh
         if rules is None:
@@ -653,10 +666,23 @@ class GenerationEngine:
             rules = DEFAULT_RULES
         self._rules = tuple(rules)
         self._cache_sharding = None
+        self._dcache_sharding = None
         if mesh is not None:
-            self._params = self._shard_params(params)
+            self._params, self._cache_sharding = self._shard_params(params)
         else:
             self._params = jax.device_put(params)
+        if self._spec is not None:
+            # Spec-decode x TP: the draft shards over the SAME mesh by
+            # the same logical rules (its KV heads must divide tensor
+            # like the target's) — one SPMD program runs draft proposals
+            # and target verify together.
+            if mesh is not None:
+                self._dparams, self._dcache_sharding = self._shard_params(
+                    self._dparams_src, model=self._spec["model"],
+                    cfg=self._spec["cfg"], role="draft")
+            else:
+                self._dparams = jax.device_put(self._dparams_src)
+            del self._dparams_src
         self._key = jax.random.key(seed)
         self._queue: queue.Queue = queue.Queue()
         self._wake = threading.Event()
@@ -665,7 +691,7 @@ class GenerationEngine:
                       "decode_seconds": 0.0, "decode_dispatches": 0,
                       "prefix_hits": 0, "prefix_hit_tokens": 0,
                       "spec_dispatches": 0, "spec_proposed": 0,
-                      "spec_accepted": 0}
+                      "spec_accepted": 0, "spec_demotions": 0}
         self._compile()
         from kubeflow_tpu.models.llama import init_cache
         with self._scope():
@@ -683,8 +709,13 @@ class GenerationEngine:
                 lambda: init_cache(cfg, self.n_slots, self.max_len),
                 out_shardings=cache_sh)()
             if self._spec is not None:
-                self._dcache = jax.jit(lambda: init_cache(
-                    self._spec["cfg"], self.n_slots, self.max_len))()
+                dcache_sh = (None if self._dcache_sharding is None else
+                             {"k": self._dcache_sharding,
+                              "v": self._dcache_sharding})
+                self._dcache = jax.jit(
+                    lambda: init_cache(self._spec["cfg"], self.n_slots,
+                                       self.max_len),
+                    out_shardings=dcache_sh)()
             self._warmup()
         self._slots = [None] * self.n_slots  # per-slot host state
         self._thread = threading.Thread(
@@ -693,32 +724,38 @@ class GenerationEngine:
 
     # -- tensor parallelism --------------------------------------------------
 
-    def _shard_params(self, params):
-        """Lay the weight tree out over the mesh by the models' logical
-        axis annotations (the same rules engine training uses) and pin the
-        KV-cache sharding: heads over `tensor`, everything else
-        replicated. Each device ends up holding its head group / mlp
-        shard; XLA inserts the collectives."""
+    def _shard_params(self, params, model=None, cfg=None,
+                      role: str = "target"):
+        """Lay a weight tree out over the mesh by the model's logical
+        axis annotations (the same rules engine training uses) and derive
+        the matching KV-cache sharding: heads over `tensor`, everything
+        else replicated. Each device ends up holding its head group / mlp
+        shard; XLA inserts the collectives. Returns (sharded_params,
+        cache_sharding) — also used for the DRAFT model under
+        spec-decode x TP (role only flavors the error message)."""
         import flax.linen as nn
 
         from kubeflow_tpu.parallel.sharding import logical_to_spec
         from jax.sharding import NamedSharding
 
-        cfg, mesh = self.cfg, self._mesh
+        model = model if model is not None else self.model
+        cfg = cfg if cfg is not None else self.cfg
+        mesh = self._mesh
         tp = mesh.shape.get("tensor", 1)
         if cfg.num_kv_heads % tp:
             raise ValueError(
-                f"tensor parallelism {tp} must divide num_kv_heads "
-                f"{cfg.num_kv_heads} (KV heads shard over the tensor axis)")
+                f"tensor parallelism {tp} must divide the {role} model's "
+                f"num_kv_heads {cfg.num_kv_heads} (KV heads shard over "
+                f"the tensor axis)")
         with mesh, nn.logical_axis_rules(self._rules):
             abstract = jax.eval_shape(
-                lambda r: self.model.init(
+                lambda r: model.init(
                     r, jnp.zeros((1, 8), jnp.int32))["params"],
                 jax.random.key(0))
         specs = nn.get_partition_spec(abstract)
         shardings = nn.logical_to_mesh_sharding(specs, mesh, self._rules)
         # Cache layout [L, B, T, KH, D]: KH rides the `heads` rule.
-        self._cache_sharding = NamedSharding(
+        cache_sharding = NamedSharding(
             mesh, logical_to_spec(("layers", None, None, "heads", "kv"),
                                   self._rules))
         # Callers hand over boxed (fresh init) or plain (orbax-restored)
@@ -744,8 +781,9 @@ class GenerationEngine:
                         NamedSharding(mesh, PartitionSpec(*sspec))))
             return jax.device_put(leaf, sh)
 
-        return jax.tree.map(put, nn.meta.unbox(params), shardings,
-                            is_leaf=lambda x: isinstance(x, Int8Leaf))
+        return (jax.tree.map(put, nn.meta.unbox(params), shardings,
+                             is_leaf=lambda x: isinstance(x, Int8Leaf)),
+                cache_sharding)
 
     def _scope(self):
         """Mesh + logical-rules context for tracing/compiling — a no-op
@@ -800,7 +838,8 @@ class GenerationEngine:
                 self._spec["model"], self._spec["cfg"],
                 max_len=self.max_len, chunk=self.chunk,
                 prefill_buckets=self.prefill_buckets,
-                offset_writes=True)
+                offset_writes=True,
+                cache_sharding=self._dcache_sharding)
             self._dextend_mid = jax.jit(dfns["extend_mid"],
                                         donate_argnums=(1,))
             self._dinsert = jax.jit(dfns["insert"], donate_argnums=(0,))
@@ -812,7 +851,8 @@ class GenerationEngine:
             spec_make = build_spec_decode(
                 self.model, self._spec["model"],
                 gamma=self._spec["gamma"], n_spec=self._spec["n_spec"],
-                max_len=self.max_len, rolling_window=self._rolling)
+                max_len=self.max_len, rolling_window=self._rolling,
+                adapters=self._ml_stacks)
             self._spec_decode = {
                 b: jax.jit(spec_make(b), donate_argnums=(2, 3))
                 for b in self.decode_buckets}
@@ -861,7 +901,8 @@ class GenerationEngine:
                 self._cache, self._dcache, _, _, _ = fn(
                     self._params, self._dparams, self._cache, self._dcache,
                     jnp.zeros((n,), jnp.int32), jnp.zeros((n,), jnp.int32),
-                    jnp.zeros((n,), jnp.float32), self._key)
+                    jnp.zeros((n,), jnp.float32), self._key,
+                    aid=self._aid_batch([0] * n))
 
     # -- multi-LoRA ----------------------------------------------------------
 
@@ -1179,11 +1220,13 @@ class GenerationEngine:
                     bucket = next(
                         (b for b in self.decode_buckets if b >= need),
                         self.decode_buckets[-1])
-                    self._cache, self._dcache, toks, lps, acc = \
-                        self._spec_decode[bucket](
-                            self._params, self._dparams, self._cache,
-                            self._dcache, jnp.asarray(last),
-                            jnp.asarray(idx), jnp.asarray(temps), sub)
+                    with self._scope():
+                        self._cache, self._dcache, toks, lps, acc = \
+                            self._spec_decode[bucket](
+                                self._params, self._dparams, self._cache,
+                                self._dcache, jnp.asarray(last),
+                                jnp.asarray(idx), jnp.asarray(temps), sub,
+                                aid=self._aid_batch(aids))
                     toks = np.asarray(toks)  # [B, n_spec, gamma+1]
                     lps = np.asarray(lps)
                     acc = np.asarray(acc)    # [B, n_spec] accepted counts
@@ -1233,6 +1276,12 @@ class GenerationEngine:
                 st["last"] = int(toks[i, -1])
                 # This vanilla chunk left the slot's DRAFT cache rows
                 # unwritten — spec decoding must not trust them again.
+                # Surfaced as spec_demotions: under mixed traffic one
+                # truncated-sampling request demotes every concurrent
+                # spec-able slot for the rest of its request (a perf
+                # effect, never correctness — ops/ROADMAP.md).
+                if st.get("draft_ok"):
+                    self.stats["spec_demotions"] += 1
                 st["draft_ok"] = False
                 self._emit(i, [int(t) for t in toks[i]],
                            [float(v) for v in lps[i]])

@@ -53,7 +53,12 @@ class _OpenAIBase(_Base):
         vLLM multi-LoRA convention: a loaded LoRA adapter's name IS a
         servable model id — "<base>:<adapter>" or the bare adapter name
         (when unambiguous) route to the base engine with that adapter
-        selected per request."""
+        selected per request.
+
+        Precedence: a bare name resolves to a registered MODEL first; an
+        adapter that shares a model's name stays reachable through the
+        explicit "<base>:<adapter>" form (the model has no equivalent
+        explicit form, so the model must win the bare lookup)."""
         def lookup(n):
             try:
                 return self.repo.get(n)
@@ -69,11 +74,9 @@ class _OpenAIBase(_Base):
                 if cand is not None and ad in self._adapters_of(cand):
                     model, adapter = cand, ad
             else:
-                hits = [(m, name) for m in
-                        (lookup(n) for n in self.repo.names())
-                        if m is not None and name in self._adapters_of(m)]
+                hits = self._adapter_owners(name)
                 if len(hits) == 1:
-                    model, adapter = hits[0]
+                    model, adapter = hits[0], name
                 elif len(hits) > 1:
                     raise tornado.web.HTTPError(
                         400, reason=(
@@ -94,6 +97,18 @@ class _OpenAIBase(_Base):
         if eng is None or not hasattr(eng, "adapter_names"):
             return []
         return eng.adapter_names()
+
+    def _adapter_owners(self, adapter_name: str) -> list:
+        """Loaded models that carry an adapter of this name."""
+        out = []
+        for n in self.repo.names():
+            try:
+                m = self.repo.get(n)
+            except tornado.web.HTTPError:
+                continue
+            if adapter_name in self._adapters_of(m):
+                out.append(m)
+        return out
 
 
 def _payload_from(body: dict) -> dict:

@@ -196,8 +196,20 @@ class Trainer:
             model_kwargs["lora_alpha"] = float(spec.lora.get("alpha", 16.0))
             model_kwargs["lora_targets"] = targets
             self._trainable = "lora"
-        self.model, self.info = registry.build_model(
-            spec.model, **model_kwargs)
+        try:
+            self.model, self.info = registry.build_model(
+                spec.model, **model_kwargs)
+        except TypeError as e:
+            # A non-Llama registry entry chokes on the injected lora_*
+            # kwargs with an opaque TypeError from its config dataclass
+            # (every builder takes **kw, so a signature pre-check can't
+            # see it). Translate ONLY that case — an unrelated TypeError
+            # from a genuinely Llama-family build keeps its traceback.
+            if self._trainable == "lora" and "lora_" in str(e):
+                raise ValueError(
+                    f"spec.lora needs a Llama-family model; "
+                    f"{spec.model!r} has no adapter path") from None
+            raise
         if self._trainable == "lora":
             from kubeflow_tpu.models.llama import LlamaConfig
             from kubeflow_tpu.models.moe import MoEConfig
