@@ -132,7 +132,7 @@ def _lin(w: np.ndarray) -> np.ndarray:
 
 
 def _llama_family_params(t: dict, cfg, scan_layers: bool,
-                         mlp: dict) -> dict:
+                         mlp: dict, extra_layers: dict | None = None) -> dict:
     """Shared Llama-family mapping — attention/norm/embed/lm_head tensors
     are identical across Llama, Mistral, and Mixtral checkpoints; `mlp` is
     the per-family FFN subtree (leaves stacked over layers). One copy so a
@@ -178,6 +178,10 @@ def _llama_family_params(t: dict, cfg, scan_layers: bool,
         "attn": attn,
         "mlp": mlp,
     }
+    if extra_layers:
+        # Family-specific per-layer subtrees (Gemma-2 sandwich norms) —
+        # leaves already stacked over L like everything above.
+        layers.update(extra_layers)
     params: dict[str, Any] = {
         "embed": t["model.embed_tokens.weight"],
         "final_norm": {"scale": t["model.norm.weight"]},
@@ -255,6 +259,13 @@ def import_gemma(path: str, *, scan_layers: bool = True,
     if "Gemma" in arch and arch != "GemmaForCausalLM":
         # Gemma-2/3 must never import as v1, whatever model_type says.
         raise ValueError(f"import_gemma cannot load architecture {arch!r}")
+    if hf.get("model_type") in ("gemma2", "gemma3", "gemma3_text"):
+        # A v2/3 config with a missing/defaulted `architectures` key must
+        # not slip through the arch check above and import as v1 with
+        # silently-wrong math (r4 advisor finding).
+        raise ValueError(
+            f"import_gemma cannot load model_type "
+            f"{hf['model_type']!r} (use import_gemma2 / build_from_hf)")
     if arch != "GemmaForCausalLM" and hf.get("model_type") != "gemma":
         raise ValueError(f"import_gemma cannot load architecture {arch!r}")
     act = (hf.get("hidden_activation") or hf.get("hidden_act")
@@ -279,6 +290,90 @@ def import_gemma(path: str, *, scan_layers: bool = True,
     t = load_safetensors_dir(path)
     return cfg, _llama_family_params(t, cfg, scan_layers,
                                      _swiglu_mlp(t, cfg.num_layers))
+
+
+def import_gemma2(path: str, *, scan_layers: bool = True,
+                  **config_overrides: Any):
+    """HF Gemma-2 checkpoint dir → (LlamaConfig, flax params).
+
+    On top of the Gemma-v1 conventions ((1+w) norms, sqrt(hidden) embed
+    scale, GeGLU, tied embeddings), Gemma-2 adds — all config flags on
+    the shared trunk (models/llama.py):
+
+      * sandwich norms: attention/MLP OUTPUTS are normed before their
+        residual adds (HF post_attention_layernorm →
+        `attn_out_norm`, post_feedforward_layernorm → `mlp_out_norm`;
+        HF pre_feedforward_layernorm lands in our existing
+        `post_attn_norm` slot — same position, normed MLP input);
+      * tanh soft-caps on attention scores (`attn_softcap`) and final
+        logits (`final_softcap`);
+      * score scale query_pre_attn_scalar^-0.5 (folded into q);
+      * alternating attention (HF layer_types): even layers sliding
+        window, odd layers full causal — `sliding_pattern="even"`, a
+        traced per-layer flag through the scanned trunk (einsum
+        attention path; the fused kernels don't implement the
+        softcapped/alternating score transform).
+
+    Serving: within the window the engine rebuilds causal (exact);
+    max_len > window is refused — the full-attention layers need the
+    whole history, so the Mistral rolling cache doesn't apply."""
+    hf = read_hf_config(path)
+    arch = (hf.get("architectures") or [""])[0]
+    if hf.get("model_type") in ("gemma3", "gemma3_text") or "Gemma3" in arch:
+        raise ValueError(
+            f"import_gemma2 cannot load {arch or hf.get('model_type')!r} "
+            "(Gemma-3 is not implemented)")
+    if not (arch in ("", "Gemma2ForCausalLM")
+            or hf.get("model_type") == "gemma2"):
+        raise ValueError(f"import_gemma2 cannot load architecture {arch!r}")
+    act = (hf.get("hidden_activation") or hf.get("hidden_act")
+           or "gelu_pytorch_tanh")
+    if act not in ("gelu_pytorch_tanh", "gelu"):
+        raise ValueError(f"unsupported Gemma-2 activation {act!r}")
+    lt = hf.get("layer_types")
+    if lt is not None:
+        want = ["sliding_attention" if i % 2 == 0 else "full_attention"
+                for i in range(hf["num_hidden_layers"])]
+        if list(lt) != want:
+            raise ValueError(
+                "unsupported Gemma-2 layer_types pattern (expected "
+                "alternating sliding/full starting sliding at layer 0)")
+    fields = dict(
+        scan_layers=scan_layers, norm_plus_one=True, embed_scale=True,
+        mlp_act="gelu_tanh", sandwich_norms=True,
+        attn_softcap=float(hf.get("attn_logit_softcapping") or 0.0),
+        final_softcap=float(hf.get("final_logit_softcapping") or 0.0),
+        query_pre_attn_scalar=float(hf.get("query_pre_attn_scalar") or 0.0),
+        tie_embeddings=bool(hf.get("tie_word_embeddings", True)),
+        attention_impl="naive")
+    fields.update(config_overrides)
+    cfg = llama_config_from_hf(hf, **fields)
+    if cfg.mask_kind == "sliding_window":
+        # llama_config_from_hf set the window; mark the alternation (it
+        # must not override a caller's explicit pattern choice, so apply
+        # after overrides only when still defaulted).
+        if "sliding_pattern" not in config_overrides:
+            import dataclasses
+            cfg = dataclasses.replace(cfg, sliding_pattern="even",
+                                      attention_impl="naive")
+    if not cfg.tie_embeddings:
+        raise ValueError(
+            "Gemma-2 checkpoints tie embeddings; tie_word_embeddings="
+            "false is not a Gemma-2 layout")
+    t = load_safetensors_dir(path)
+    L = cfg.num_layers
+    p = "model.layers.{i}."
+    extra = {
+        "attn_out_norm": {"scale": _stack(
+            t, p + "post_attention_layernorm.weight", L, lambda w: w)},
+        "post_attn_norm": {"scale": _stack(
+            t, p + "pre_feedforward_layernorm.weight", L, lambda w: w)},
+        "mlp_out_norm": {"scale": _stack(
+            t, p + "post_feedforward_layernorm.weight", L, lambda w: w)},
+    }
+    return cfg, _llama_family_params(t, cfg, scan_layers,
+                                     _swiglu_mlp(t, cfg.num_layers),
+                                     extra_layers=extra)
 
 
 # ---------------------------------------------------------------------------
@@ -710,15 +805,19 @@ def build_from_hf(path: str, **overrides: Any):
 
         cfg, params = import_mixtral(path, **overrides)
         return MoELlama(cfg), cfg, params
-    if ("Gemma" in arch and arch != "GemmaForCausalLM") or hf.get(
-            "model_type", "") in ("gemma2", "gemma3", "gemma3_text"):
-        # Gemma-2/3: post-norms, logit softcapping, alternating local
-        # attention — importing as v1 would serve silently-wrong logits.
-        # Checked BEFORE the v1 branch so a v2/3 architecture with a
-        # hand-edited model_type can't slip through.
+    if "Gemma3" in arch or hf.get("model_type") in ("gemma3", "gemma3_text"):
+        # Gemma-3 (interleaved 5:1 local/global, QK-norm) is not
+        # implemented — refuse before any Gemma branch can accept it.
         raise ValueError(
-            f"unsupported architecture {arch!r} (Gemma v1 only; "
-            "Gemma-2/3's post-norms and softcapping are not implemented)")
+            f"unsupported architecture {arch!r} (Gemma v1/v2 are "
+            "implemented; Gemma-3's QK-norm and 5:1 local/global "
+            "interleave are not)")
+    if arch == "Gemma2ForCausalLM" or hf.get("model_type") == "gemma2":
+        cfg, params = import_gemma2(path, **overrides)
+        return Llama(cfg), cfg, params
+    if "Gemma" in arch and arch != "GemmaForCausalLM":
+        # Any other non-v1 Gemma variant: refuse rather than guess.
+        raise ValueError(f"unsupported architecture {arch!r}")
     if arch == "GemmaForCausalLM" or hf.get("model_type") == "gemma":
         cfg, params = import_gemma(path, **overrides)
         return Llama(cfg), cfg, params

@@ -60,6 +60,21 @@ class LlamaConfig:
     norm_plus_one: bool = False
     embed_scale: bool = False
     mlp_act: str = "silu"  # silu | gelu_tanh
+    # Gemma-2 conventions (import_gemma2): sandwich norms add a norm on
+    # the attention/MLP OUTPUTS before the residual add (HF
+    # post_attention_layernorm / post_feedforward_layernorm; our
+    # post_attn_norm then plays HF's pre_feedforward_layernorm role);
+    # attention scores and final logits pass through tanh soft-caps; the
+    # score scale is query_pre_attn_scalar^-0.5 instead of head_dim^-0.5.
+    sandwich_norms: bool = False
+    attn_softcap: float = 0.0    # 0 = off
+    final_softcap: float = 0.0   # 0 = off
+    query_pre_attn_scalar: float = 0.0  # 0 = use head_dim
+    # Which layers the sliding_window mask applies to: "all" (Mistral) or
+    # "even" (Gemma-2: layers 0,2,4,... sliding, odd layers full causal —
+    # HF layer_types). "even" threads a per-layer traced flag through the
+    # scanned trunk, so it runs on the einsum attention path only.
+    sliding_pattern: str = "all"
     # LoRA fine-tuning (the reference SDK's PEFT LoraConfig): rank 0 = off.
     # Adapters add (x @ A) @ B * alpha/rank to the target projections —
     # q/v (PEFT's Llama default) for "attn", plus gate/up/down for
@@ -213,8 +228,13 @@ def init_cache(cfg: LlamaConfig, batch: int, max_len: int | None = None,
     dt = dtype or cfg.dtype
     window = int(getattr(cfg, "mask_window", 0) or 0)
     cache = {}
-    if getattr(cfg, "mask_kind", "causal") == "sliding_window" \
-            and 0 < window < t:
+    if (getattr(cfg, "mask_kind", "causal") == "sliding_window"
+            and 0 < window < t
+            and getattr(cfg, "sliding_pattern", "all") == "all"):
+        # Pattern "even" (Gemma-2) has FULL-attention layers that need
+        # the whole history — a window-rows rolling cache would drop
+        # rows those layers must read, so it stays on the plain layout
+        # (the serving engine refuses max_len > window for it).
         t = window
         cache["pos"] = jnp.full((cfg.num_layers, batch, t),
                                 -(window + 1), jnp.int32)
@@ -267,7 +287,8 @@ class Attention(nn.Module):
                  segment_ids: jax.Array | None = None,
                  attend_full_cache: bool = False,
                  adapter: dict | None = None,
-                 adapter_ids: jax.Array | None = None):
+                 adapter_ids: jax.Array | None = None,
+                 sliding: jax.Array | None = None):
         cfg = self.cfg
         dense = partial(
             nn.DenseGeneral, use_bias=False, dtype=cfg.dtype,
@@ -307,6 +328,14 @@ class Attention(nn.Module):
                                       (cfg.num_heads, cfg.head_dim))
             v = v + _multi_lora_delta(x, adapter_ids, adapter["v_proj"],
                                       (cfg.num_kv_heads, cfg.head_dim))
+        if cfg.query_pre_attn_scalar:
+            # Gemma-2 scales scores by query_pre_attn_scalar^-0.5; every
+            # attention impl here divides by sqrt(head_dim), so fold the
+            # ratio into q (AFTER adapter deltas — HF scales the full
+            # projected query at score time).
+            q = q * jnp.asarray(
+                (cfg.head_dim ** 0.5) / (cfg.query_pre_attn_scalar ** 0.5),
+                q.dtype)
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
         q = nn.with_logical_constraint(q, ("batch", "act_seq", "act_heads", "act_kv"))
@@ -338,7 +367,7 @@ class Attention(nn.Module):
             pos_kv = jnp.concatenate([cpos, positions], axis=1)
             out = naive_attention(q, keys, vals, causal=True,
                                   positions_q=positions, positions_kv=pos_kv,
-                                  mask=mask_spec)
+                                  mask=mask_spec, softcap=cfg.attn_softcap)
             new_cache = _update_cache_rolling(cache, k, v, positions,
                                               cache_index, window)
             return o_proj(out), new_cache
@@ -363,11 +392,30 @@ class Attention(nn.Module):
                 t = ck.shape[1]
                 out = naive_attention(
                     q, ck, cv, causal=True, positions_q=positions,
-                    positions_kv=jnp.broadcast_to(jnp.arange(t), (ck.shape[0], t)))
+                    positions_kv=jnp.broadcast_to(jnp.arange(t), (ck.shape[0], t)),
+                    softcap=cfg.attn_softcap)
                 return o_proj(out), new_cache
             # Prefill (cache_index must be 0): nothing precedes the new
             # tokens, so attention over just k/v is exact — the fast flash
             # path below serves it; the cache write above is the only extra.
+
+        if cfg.attn_softcap or sliding is not None:
+            # Gemma-2's tanh score cap / per-layer traced window flag are
+            # not implemented in the fused kernels — the einsum path is
+            # the only correct impl; silently running flash would serve
+            # wrong logits.
+            if cfg.attention_impl not in ("auto", "naive"):
+                raise ValueError(
+                    f"attn_softcap / alternating sliding layers need "
+                    f"attention_impl 'naive', not "
+                    f"{cfg.attention_impl!r}")
+            out = naive_attention(q, k, v, causal=True,
+                                  positions_q=positions,
+                                  positions_kv=positions,
+                                  segment_ids=segment_ids, mask=mask_spec,
+                                  softcap=cfg.attn_softcap,
+                                  windowed=sliding)
+            return o_proj(out), new_cache
 
         impl = cfg.attention_impl
         if impl == "auto":
@@ -548,7 +596,7 @@ class DecoderLayer(nn.Module):
     def __call__(self, x, cos, sin, positions, ring_axis=None,
                  standard_positions=True, cache=None, cache_index=None,
                  segment_ids=None, attend_full_cache=False,
-                 adapter=None, adapter_ids=None):
+                 adapter=None, adapter_ids=None, sliding=None):
         cfg = self.cfg
         attn_ad = None
         mlp_ad = None
@@ -563,17 +611,28 @@ class DecoderLayer(nn.Module):
         attn_out, new_cache = Attention(cfg, name="attn")(
             h, cos, sin, positions, ring_axis, standard_positions, cache,
             cache_index, segment_ids, attend_full_cache,
-            adapter=attn_ad, adapter_ids=adapter_ids)
+            adapter=attn_ad, adapter_ids=adapter_ids, sliding=sliding)
+        if cfg.sandwich_norms:
+            # Gemma-2: norm the attention OUTPUT before the residual add
+            # (HF post_attention_layernorm).
+            attn_out = RMSNorm(cfg.rms_eps, cfg.dtype, cfg.norm_plus_one,
+                               name="attn_out_norm")(attn_out)
         # Remat landmark: policy "save_attn" keeps this tensor so the
         # backward skips re-running the attention kernel (small residual:
         # [B,S,H·D] bf16 per layer vs the full block internals).
         from jax.ad_checkpoint import checkpoint_name
         attn_out = checkpoint_name(attn_out, "attn_out")
         x = x + attn_out
+        # In sandwich mode this plays HF's pre_feedforward_layernorm role
+        # (same position: normed input to the MLP).
         h = RMSNorm(cfg.rms_eps, cfg.dtype, cfg.norm_plus_one,
                     name="post_attn_norm")(x)
-        x = x + (self.mlp_cls or MLPBlock)(cfg, name="mlp")(
+        mlp_out = (self.mlp_cls or MLPBlock)(cfg, name="mlp")(
             h, adapter=mlp_ad, adapter_ids=adapter_ids)
+        if cfg.sandwich_norms:
+            mlp_out = RMSNorm(cfg.rms_eps, cfg.dtype, cfg.norm_plus_one,
+                              name="mlp_out_norm")(mlp_out)
+        x = x + mlp_out
         x = nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
         return x, new_cache
 
@@ -635,6 +694,13 @@ class Llama(nn.Module):
         x = nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
         cos, sin = rope_table(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta,
                               cfg)
+        sliding = None
+        if (cfg.mask_kind == "sliding_window"
+                and cfg.sliding_pattern == "even"):
+            # Gemma-2 alternation (HF layer_types): even layers sliding,
+            # odd layers full causal — a traced per-layer flag riding the
+            # scan, so one compiled trunk serves both layer kinds.
+            sliding = (jnp.arange(cfg.num_layers) % 2) == 0
 
         layer_cls = DecoderLayer
         if cfg.remat:
@@ -666,16 +732,16 @@ class Llama(nn.Module):
             # `cache` (leading layer dim) rides as the scan's per-layer input
             # and the updated cache comes back as its per-layer output.
             x, new_cache = nn.scan(
-                lambda mdl, carry, layer_cache, ad: mdl(
+                lambda mdl, carry, layer_cache, ad, sl: mdl(
                     carry, cos, sin, positions, ring_axis,
                     standard_positions, layer_cache, cache_index,
-                    segment_ids, attend_full_cache, ad, adapter_ids),
+                    segment_ids, attend_full_cache, ad, adapter_ids, sl),
                 variable_axes={"params": 0, "aux_loss": 0},
                 split_rngs={"params": True},
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )(layer_cls(cfg, self.mlp_cls, name="layers"), x, cache,
-              adapter)
+              adapter, sliding)
         else:
             layer_caches = []
             for i in range(cfg.num_layers):
@@ -686,7 +752,8 @@ class Llama(nn.Module):
                 x, lc = layer_cls(cfg, self.mlp_cls, name=f"layer_{i}")(
                     x, cos, sin, positions, ring_axis, standard_positions,
                     layer_cache, cache_index, segment_ids,
-                    attend_full_cache, layer_ad, adapter_ids)
+                    attend_full_cache, layer_ad, adapter_ids,
+                    None if sliding is None else sliding[i])
                 layer_caches.append(lc)
             if cache is not None:
                 new_cache = jax.tree.map(
@@ -708,6 +775,11 @@ class Llama(nn.Module):
                 kernel_init=nn.with_logical_partitioning(
                     nn.initializers.lecun_normal(), ("embed", "vocab")),
                 name="lm_head")(x)
+        if cfg.final_softcap:
+            # Gemma-2 final-logit soft-cap. NB the chunked-CE training
+            # path exits above via return_hidden — train/step.py applies
+            # the same cap inside each logits chunk.
+            logits = jnp.tanh(logits / cfg.final_softcap) * cfg.final_softcap
         if cache is not None:
             return logits, new_cache
         return logits

@@ -15,13 +15,20 @@ import jax.numpy as jnp
 def naive_attention(q, k, v, *, causal: bool = True,
                     positions_q=None, positions_kv=None,
                     segment_ids=None, segment_ids_kv=None,
-                    mask=None) -> jax.Array:
+                    mask=None, softcap: float = 0.0,
+                    windowed=None) -> jax.Array:
     """q: [B,S,H,D]; k,v: [B,T,KH,D] with H % KH == 0; fp32 softmax.
     Causality is masked by absolute positions when given (packed/offset
     sequences), else by array index. `segment_ids` [B,S] (and optionally a
     separate kv set) additionally confine attention within equal-id spans
     — the packed-sequence mask. `mask` (a flash_attention.MaskSpec)
-    selects causal/full/prefix_lm/sliding_window, overriding `causal`."""
+    selects causal/full/prefix_lm/sliding_window, overriding `causal`.
+
+    `softcap` > 0 applies Gemma-2's attention-logit soft-cap
+    tanh(s/cap)*cap after scaling, before masking. `windowed` (traced
+    scalar bool, Gemma-2's alternating layers) gates a sliding_window
+    mask's band per call: where False the mask degrades to plain causal
+    — dynamic, so one scanned trunk serves both layer types."""
     if (mask is not None and mask.kind == "prefix_lm"
             and segment_ids is not None):
         # Same refusal as flash_attention: a global prefix boundary is
@@ -38,6 +45,8 @@ def naive_attention(q, k, v, *, causal: bool = True,
     qg = q.reshape(b, s, kh, group, d)
     scores = jnp.einsum("bskgd,btkd->bkgst", qg, k).astype(jnp.float32)
     scores = scores / jnp.sqrt(d).astype(jnp.float32)
+    if softcap:
+        scores = jnp.tanh(scores / softcap) * softcap
     if mask is not None:
         pq = positions_q if positions_q is not None else jnp.arange(s)[None]
         pk = positions_kv if positions_kv is not None else jnp.arange(t)[None]
@@ -48,7 +57,10 @@ def naive_attention(q, k, v, *, causal: bool = True,
         elif mask.kind == "prefix_lm":
             m = (rows >= cols) | (cols < mask.prefix)
         elif mask.kind == "sliding_window":
-            m = (rows >= cols) & (rows - cols < mask.window)
+            band = rows - cols < mask.window
+            if windowed is not None:
+                band = band | jnp.logical_not(windowed)
+            m = (rows >= cols) & band
         else:  # full
             m = None
         if m is not None:

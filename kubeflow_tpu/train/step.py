@@ -52,7 +52,8 @@ def chunked_cross_entropy(hidden: jax.Array, head: jax.Array,
                           targets: jax.Array,
                           mask: jax.Array | None = None, *,
                           chunk: int = 1024,
-                          head_is_vocab_major: bool = False) -> jax.Array:
+                          head_is_vocab_major: bool = False,
+                          final_softcap: float = 0.0) -> jax.Array:
     """Fused blockwise cross entropy (ops/ROADMAP.md item 1): logits are
     computed per token-chunk against the unembedding and never
     materialized as the [B·S, V] fp32 buffer that dominates peak memory at
@@ -62,6 +63,9 @@ def chunked_cross_entropy(hidden: jax.Array, head: jax.Array,
 
     hidden [B,S,D]; head [D,V] (lm_head kernel) or [V,D] with
     `head_is_vocab_major` (tied embedding); targets [B,S].
+    `final_softcap` applies Gemma-2's logit cap tanh(l/cap)*cap inside
+    each chunk — the return_hidden path skips the model's own cap, so
+    omitting it here would train against uncapped logits.
     """
     b, s, d = hidden.shape
     n = b * s
@@ -86,6 +90,8 @@ def chunked_cross_entropy(hidden: jax.Array, head: jax.Array,
         hx, tx, mx = xs
         logits = jnp.einsum(spec, hx, head.astype(hx.dtype)).astype(
             jnp.float32)
+        if final_softcap:
+            logits = jnp.tanh(logits / final_softcap) * final_softcap
         logz = jax.scipy.special.logsumexp(logits, axis=-1)
         gold = jnp.take_along_axis(logits, tx[:, None], axis=-1)[:, 0]
         tot, cnt = carry
@@ -265,7 +271,8 @@ def make_train_step(
             head, vocab_major = _unembed_head(params)
             main = chunked_cross_entropy(
                 out, head, batch["targets"], batch.get("mask"),
-                chunk=loss_chunk, head_is_vocab_major=vocab_major)
+                chunk=loss_chunk, head_is_vocab_major=vocab_major,
+                final_softcap=getattr(model.cfg, "final_softcap", 0.0))
         else:
             main = cross_entropy_loss(out, batch["targets"],
                                       batch.get("mask"))
@@ -291,7 +298,8 @@ def make_train_step(
             head, vocab_major = _unembed_head(params)
             main = chunked_cross_entropy(
                 out, head, batch["targets"], batch.get("mask"),
-                chunk=loss_chunk, head_is_vocab_major=vocab_major)
+                chunk=loss_chunk, head_is_vocab_major=vocab_major,
+                final_softcap=getattr(model.cfg, "final_softcap", 0.0))
         else:
             logits = out
             if isinstance(logits, tuple):  # models returning (hidden, logits)

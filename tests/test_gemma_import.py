@@ -75,21 +75,141 @@ def test_gemma_engine_decode_matches_torch(hf_gemma_dir):
         eng.close()
 
 
-def test_gemma2_refused(hf_gemma_dir, tmp_path):
+def test_gemma2_without_architectures_key_refused_by_v1_importer(
+        hf_gemma_dir, tmp_path):
+    """r4 advisor finding: a gemma2 config whose `architectures` key is
+    missing must not default into the v1 importer with silently-wrong
+    math when import_gemma is called DIRECTLY."""
     import json
     import os
     import shutil
 
     path, _ = hf_gemma_dir
-    d = tmp_path / "gemma2"
+    d = tmp_path / "gemma2_bare"
     shutil.copytree(path, d)
     with open(os.path.join(d, "config.json")) as f:
         cfgj = json.load(f)
-    cfgj["architectures"] = ["Gemma2ForCausalLM"]
+    cfgj.pop("architectures", None)
     cfgj["model_type"] = "gemma2"
+    with open(os.path.join(d, "config.json"), "w") as f:
+        json.dump(cfgj, f)
+    from kubeflow_tpu.models.hf_import import import_gemma
+
+    with pytest.raises(ValueError, match="gemma2"):
+        import_gemma(str(d))
+
+
+# ---------------------------------------------------------------------------
+# Gemma-2
+# ---------------------------------------------------------------------------
+
+def _gemma2_cfg():
+    return transformers.Gemma2Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=64, rope_theta=10000.0,
+        rms_norm_eps=1e-5, sliding_window=8, query_pre_attn_scalar=24.0,
+        attn_logit_softcapping=50.0, final_logit_softcapping=30.0,
+        attn_implementation="eager")
+
+
+@pytest.fixture(scope="module")
+def hf_gemma2_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("hf_gemma2")
+    torch.manual_seed(11)
+    model = transformers.Gemma2ForCausalLM(_gemma2_cfg())
+    model.eval()
+    model.save_pretrained(d, safe_serialization=True)
+    return str(d), model
+
+
+def test_gemma2_logits_match_torch(hf_gemma2_dir):
+    """seq 16 > window 8 with 4 alternating layers: sandwich norms, both
+    soft-caps, the query_pre_attn_scalar scale AND the even-layers-only
+    band must all be right for agreement."""
+    path, tmodel = hf_gemma2_dir
+    from kubeflow_tpu.models.hf_import import build_from_hf
+
+    module, cfg, params = build_from_hf(path, dtype=jnp.float32,
+                                        param_dtype=jnp.float32)
+    assert cfg.sandwich_norms and cfg.sliding_pattern == "even"
+    assert cfg.attn_softcap == 50.0 and cfg.final_softcap == 30.0
+    assert cfg.query_pre_attn_scalar == 24.0
+    rng = np.random.default_rng(2)
+    toks = rng.integers(0, cfg.vocab_size, (2, 16), dtype=np.int64)
+    with torch.no_grad():
+        ref = tmodel(torch.from_numpy(toks)).logits.numpy()
+    got = module.apply({"params": params}, jnp.asarray(toks, jnp.int32))
+    np.testing.assert_allclose(np.asarray(got), ref, atol=3e-3, rtol=2e-2)
+    # The alternation must be load-bearing: all-causal layers disagree
+    # past the window, or this proves nothing.
+    import dataclasses
+
+    from kubeflow_tpu.models.llama import Llama
+
+    causal = Llama(dataclasses.replace(cfg, mask_kind="causal",
+                                       mask_window=0,
+                                       sliding_pattern="all"))
+    gc = causal.apply({"params": params}, jnp.asarray(toks, jnp.int32))
+    assert not np.allclose(np.asarray(gc)[:, 12:], ref[:, 12:],
+                           atol=3e-3, rtol=2e-2)
+
+
+def test_gemma2_engine_decode_matches_torch(hf_gemma2_dir):
+    """Within the window the engine rebuilds causal (keeping the
+    soft-caps and score scale) — greedy decode token-identical to torch
+    generate."""
+    path, tmodel = hf_gemma2_dir
+    from kubeflow_tpu.models.hf_import import build_from_hf
+    from kubeflow_tpu.serve.generation import GenerationEngine
+
+    module, cfg, params = build_from_hf(path, dtype=jnp.float32,
+                                        param_dtype=jnp.float32)
+    eng = GenerationEngine(module, params, cfg, slots=1, max_len=8,
+                           chunk=4, prefill_buckets=(4,))
+    try:
+        assert eng.cfg.mask_kind == "causal"
+        assert eng.cfg.attn_softcap == 50.0  # survives the rebuild
+        prompt = [5, 9, 2]
+        out = eng.submit(prompt, max_tokens=5, temperature=0.0)
+        with torch.no_grad():
+            ref = tmodel.generate(
+                torch.tensor([prompt]), max_new_tokens=5, do_sample=False,
+                pad_token_id=0).numpy()[0, len(prompt):]
+        assert list(out["output_ids"]) == list(ref)
+    finally:
+        eng.close()
+
+
+def test_gemma2_serving_past_window_refused(hf_gemma2_dir):
+    """The full-attention layers need the whole history — the Mistral
+    rolling cache must NOT engage for the alternating pattern."""
+    path, _ = hf_gemma2_dir
+    from kubeflow_tpu.models.hf_import import build_from_hf
+    from kubeflow_tpu.serve.generation import GenerationEngine
+
+    module, cfg, params = build_from_hf(path, dtype=jnp.float32,
+                                        param_dtype=jnp.float32)
+    with pytest.raises(ValueError, match="full-attention layers"):
+        GenerationEngine(module, params, cfg, slots=1, max_len=32,
+                         chunk=4, prefill_buckets=(4,))
+
+
+def test_gemma3_still_refused(hf_gemma2_dir, tmp_path):
+    import json
+    import os
+    import shutil
+
+    path, _ = hf_gemma2_dir
+    d = tmp_path / "gemma3"
+    shutil.copytree(path, d)
+    with open(os.path.join(d, "config.json")) as f:
+        cfgj = json.load(f)
+    cfgj["architectures"] = ["Gemma3ForCausalLM"]
+    cfgj["model_type"] = "gemma3"
     with open(os.path.join(d, "config.json"), "w") as f:
         json.dump(cfgj, f)
     from kubeflow_tpu.models.hf_import import build_from_hf
 
-    with pytest.raises(ValueError, match="Gemma v1 only"):
+    with pytest.raises(ValueError, match="Gemma-3"):
         build_from_hf(str(d))

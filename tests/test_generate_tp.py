@@ -71,14 +71,23 @@ def test_tp_decode_token_identical(model_and_params, devices8):
                                    w["output_logprobs"], atol=1e-4)
 
 
-@pytest.mark.parametrize("variant", ["qwen2", "gemma"])
+@pytest.mark.parametrize("variant", ["qwen2", "gemma", "gemma2"])
 def test_tp_decode_new_family_flags(devices8, variant):
     """The new family conventions compose with tensor parallelism: QKV
     biases (Qwen2) and (1+w) norms + embed scale + GeGLU (Gemma) must
     decode token-identically under a tensor=8 mesh."""
-    flags = (dict(attention_bias=True) if variant == "qwen2" else
-             dict(norm_plus_one=True, embed_scale=True,
-                  mlp_act="gelu_tanh", tie_embeddings=True))
+    flags = {
+        "qwen2": dict(attention_bias=True),
+        "gemma": dict(norm_plus_one=True, embed_scale=True,
+                      mlp_act="gelu_tanh", tie_embeddings=True),
+        # Gemma-2 decode math (post-rebuild: causal + caps + sandwich
+        # norms + query_pre_attn scale) under TP.
+        "gemma2": dict(norm_plus_one=True, embed_scale=True,
+                       mlp_act="gelu_tanh", tie_embeddings=True,
+                       sandwich_norms=True, attn_softcap=50.0,
+                       final_softcap=30.0, query_pre_attn_scalar=24.0,
+                       attention_impl="naive"),
+    }[variant]
     cfg = dataclasses.replace(CFG, **flags)
     model = Llama(cfg)
     params = jax.jit(
