@@ -15,6 +15,38 @@
 
 namespace tpk {
 
+// --- Namespace defaults (the PodDefaults-webhook analog) -------------------
+//
+// Upstream, the PodDefaults mutating webhook injects env/volumes/
+// tolerations into pods by label selector (SURVEY.md §2.5). Here the
+// namespace object itself (Profile — its name IS the namespace) may carry
+// `defaults: {<Kind>: {<partial spec>}}`; at CREATE admission the API
+// server deep-merges the kind's defaults into the submitted spec, filling
+// ONLY missing fields (the user's spec always wins, recursively for
+// objects). The merged spec is what gets stored — validation then runs on
+// the final object, so a bad default fails loudly at submit.
+
+inline std::string SpecNamespace(const Json& spec) {
+  // Mirror of jaxjob.cc NamespaceOf / controlplane.client namespace_of.
+  const std::string ns = spec.get("namespace").as_string();
+  return ns.empty() ? "default" : ns;
+}
+
+inline Json MergeNamespaceDefaults(const Json& spec, const Json& defaults) {
+  if (!defaults.is_object()) return spec;
+  if (spec.is_null()) return defaults;
+  if (!spec.is_object()) return spec;  // scalar user value always wins
+  Json out = spec;
+  for (const auto& [k, dv] : defaults.items()) {
+    if (!out.has(k) || out.get(k).is_null()) {
+      out[k] = dv;
+    } else if (out.get(k).is_object() && dv.is_object()) {
+      out[k] = MergeNamespaceDefaults(out.get(k), dv);
+    }
+  }
+  return out;
+}
+
 // The generated runtime-field table (kubeflow_tpu/utils/spec_schema.py —
 // ONE schema, consumed here and by TrainJobSpec; SURVEY.md §5.6 drift
 // guard). Parsed once.
@@ -251,6 +283,22 @@ inline std::string ValidateSpec(const std::string& kind, const Json& spec) {
         spec.get("max_devices").as_int(-1) < 0) {
       return "max_devices must be >= 0";
     }
+    const Json& defs = spec.get("defaults");
+    if (!defs.is_null()) {
+      if (!defs.is_object()) {
+        return "defaults must be an object of {Kind: partial spec}";
+      }
+      for (const auto& [k, v] : defs.items()) {
+        if (!v.is_object()) {
+          return "defaults." + k + " must be an object (a partial " + k +
+                 " spec)";
+        }
+        if (k == "Profile") {
+          return "defaults.Profile is not allowed (namespaces don't "
+                 "default namespaces)";
+        }
+      }
+    }
     return "";
   }
 
@@ -332,6 +380,10 @@ inline std::string ValidateSpec(const std::string& kind, const Json& spec) {
     if (spec.get("replicas").is_number() &&
         spec.get("replicas").as_int() < 0) {
       return "replicas must be >= 0";
+    }
+    if (spec.get("scale_to_zero_after_s").is_number() &&
+        spec.get("scale_to_zero_after_s").as_number() < 0) {
+      return "scale_to_zero_after_s must be >= 0";
     }
     const Json& logger = spec.get("logger");
     if (logger.is_object()) {

@@ -1,5 +1,7 @@
 #include "jaxjob.h"
 
+#include "admission.h"
+
 #include "util.h"
 
 #include <netinet/in.h>
@@ -46,13 +48,9 @@ Allocation JaxJobController::AllocFromStatus(const Json& status) const {
 
 namespace {
 
-// The one normalization rule for tenancy: resources without a namespace
-// live in "default". Python mirrors this in controlplane/client.py
-// (namespace_of) — keep the two in sync.
-std::string NamespaceOf(const Json& spec) {
-  const std::string ns = spec.get("namespace").as_string();
-  return ns.empty() ? "default" : ns;
-}
+// The one normalization rule for tenancy lives in admission.h
+// (SpecNamespace; Python mirror: controlplane/client.py namespace_of).
+std::string NamespaceOf(const Json& spec) { return SpecNamespace(spec); }
 
 }  // namespace
 

@@ -448,15 +448,28 @@ int ServeController::DesiredReplicas(View& v) {
       v.spec.get("replicas").as_int(1));
   int64_t max_r = v.spec.get("max_replicas").as_int(min_r);
   double target = v.spec.get("target_rps").as_number(0);
-  if (target <= 0 || max_r <= min_r) {
+  // Scale-to-zero (the Knative KPA capability, SURVEY.md §5.3): after
+  // `scale_to_zero_after_s` with no served requests the replica count
+  // drops to 0 (processes stopped, devices released). Cold start is an
+  // explicit control-plane activation — clients that find the service
+  // Idle bump `spec.wake` (TrainingClient.wake_service) and wait Ready;
+  // a data-plane activator proxy that buffers the first request is the
+  // production shape this stands in for.
+  double idle_after = v.spec.get("scale_to_zero_after_s").as_number(0);
+  bool rps_autoscale = target > 0 && max_r > min_r;
+  if (!rps_autoscale && idle_after <= 0) {
     return static_cast<int>(v.spec.get("replicas").as_int(min_r));
   }
   // Throughput autoscaler: rps over the scrape interval / target per
-  // replica (KPA stand-in; no scale-to-zero).
+  // replica (KPA stand-in).
   Json as = v.status.get("autoscale").is_object()
                 ? v.status.get("autoscale")
                 : Json::Object();
-  int desired = static_cast<int>(as.get("desired").as_int(min_r));
+  // Fixed-replica services must keep following spec.replicas updates —
+  // only the rps autoscaler owns the persisted `desired`.
+  int desired = static_cast<int>(
+      rps_autoscale ? as.get("desired").as_int(min_r)
+                    : v.spec.get("replicas").as_int(min_r));
   double interval = v.spec.get("scale_interval_s").as_number(10);
   double last_t = as.get("lastTime").as_number(0);
   if (now_s_ - last_t >= interval) {
@@ -497,7 +510,11 @@ int ServeController::DesiredReplicas(View& v) {
       as["lastTime"] = now_s_;
     }
     if (scraped) {
-      if (last_t > 0) {
+      as["lastScrapeOk"] = now_s_;
+      if (delta > 0) {
+        as["lastActive"] = now_s_;  // served traffic this window
+      }
+      if (rps_autoscale && last_t > 0) {
         double rps = delta / (now_s_ - last_t);
         desired = static_cast<int>(std::ceil(rps / target));
         desired = std::max(desired, static_cast<int>(min_r));
@@ -512,6 +529,62 @@ int ServeController::DesiredReplicas(View& v) {
     }
     v.status["autoscale"] = as;
   }
+  // Idle reaping applies only when something would otherwise run — a
+  // service scaled to zero BY HAND stays phase Ready, never Idle.
+  if (idle_after > 0 && desired > 0) {
+    bool reaped = v.status.get("idle").as_bool(false);
+    double last_active = as.get("lastActive").as_number(0);
+    // Activation: a wake timestamp newer than the last activity counts
+    // as activity (and survives restarts — both live in the store).
+    double wake = v.spec.get("wake").as_number(0);
+    if (wake > last_active) {
+      last_active = wake;
+      as["lastActive"] = wake;
+      v.status["autoscale"] = as;
+    }
+    // The idle clock only runs while the service can actually serve: a
+    // replica still loading its model (cold start can exceed a short
+    // idle window) or crash-looping must not be reaped as "idle" —
+    // unless it is ALREADY reaped, where zero ready replicas is the
+    // steady state and refreshing would immediately resurrect it.
+    bool any_ready = false;
+    const Json& reps = v.status.get("replicaState");
+    if (reps.is_array()) {
+      for (const auto& rs : reps.elements()) {
+        if (rs.is_object() && rs.get("ready").as_bool(false)) {
+          any_ready = true;
+          break;
+        }
+      }
+    }
+    if (!reaped && !any_ready) {
+      as["lastActive"] = now_s_;
+      v.status["autoscale"] = as;
+      return desired;
+    }
+    if (last_active == 0) {
+      // Defensive: ready with no recorded activity — start the clock.
+      as["lastActive"] = now_s_;
+      v.status["autoscale"] = as;
+    } else if (as.get("lastScrapeOk").as_number(0) - last_active >=
+               idle_after) {
+      // Reap only on scrape EVIDENCE: a successful /metrics read at
+      // least idle_after past the last activity. Comparing against
+      // wall-clock `now` instead would reap a busy service whenever
+      // idle_after < scale_interval_s (traffic lands between scrapes)
+      // or whenever its metrics endpoint is wedged.
+      if (!v.status.get("idle").as_bool(false)) {
+        // Transition only: an idle service must not re-fire the metric
+        // or rewrite its status (WAL churn) on every 50ms tick.
+        metrics_.scale_events++;
+        as["lastScaleTime"] = now_s_;
+        v.status["autoscale"] = as;
+        v.status["idle"] = true;
+      }
+      return 0;
+    }
+  }
+  if (v.status.get("idle").as_bool(false)) v.status["idle"] = false;
   return desired;
 }
 
@@ -657,7 +730,9 @@ void ServeController::Reconcile(const std::string& name) {
 
   std::string phase;
   if (desired == 0) {
-    phase = "Ready";  // scaled to zero by hand
+    // Idle = reaped by scale-to-zero (wake brings it back); Ready =
+    // scaled to zero by hand.
+    phase = v.status.get("idle").as_bool(false) ? "Idle" : "Ready";
   } else if (ready == desired) {
     phase = "Ready";
   } else if (running > 0) {
@@ -672,7 +747,9 @@ void ServeController::Reconcile(const std::string& name) {
     Json cond = Json::Object();
     cond["type"] = phase;
     cond["status"] = "True";
-    cond["reason"] = phase == "Ready" ? "AllReplicasReady" : "Reconciling";
+    cond["reason"] = phase == "Ready"  ? "AllReplicasReady"
+                     : phase == "Idle" ? "ScaledToZero"
+                                       : "Reconciling";
     cond["message"] = std::to_string(ready) + "/" +
                       std::to_string(desired) + " replicas ready";
     cond["lastTransitionTime"] = Timestamp(now_s_);

@@ -77,12 +77,23 @@ Json Server::Dispatch(const Json& req) {
     resp["ok"] = true;
     resp["pong"] = true;
   } else if (op == "create") {
-    std::string veto = ValidateSpec(kind, req.get("spec"));
+    Json spec = req.get("spec");
+    if (kind != "Profile") {
+      // PodDefaults-equivalent (admission.h): the namespace's Profile
+      // may carry per-kind partial specs that fill missing fields
+      // before validation — so a bad default fails loudly here.
+      auto prof = store_->Get("Profile", SpecNamespace(spec));
+      if (prof && prof->spec.get("defaults").is_object()) {
+        spec = MergeNamespaceDefaults(
+            spec, prof->spec.get("defaults").get(kind));
+      }
+    }
+    std::string veto = ValidateSpec(kind, spec);
     if (!veto.empty()) {
       resp["ok"] = false;
       resp["error"] = "invalid " + kind + " spec: " + veto;
     } else {
-      fill(store_->Create(kind, name, req.get("spec")));
+      fill(store_->Create(kind, name, spec));
     }
   } else if (op == "get") {
     auto r = store_->Get(kind, name);

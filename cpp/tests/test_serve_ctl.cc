@@ -237,6 +237,84 @@ int main() {
     CHECK(h.sched.Slices()[0].used == 1);
   }
 
+  // --- Scale-to-zero: idle reap, wake cold-start, hand-zero stays Ready --
+  {
+    Harness h;
+    Json spec = BaseSpec(1);
+    spec["scale_to_zero_after_s"] = 30;
+    spec["scale_interval_s"] = 5;
+    h.store.Create("InferenceService", "svc", spec);
+    h.Tick();
+    int p0 = Port(h.store, "svc", 0);
+    h.probe.ready = {p0};
+    h.probe.metrics[p0] = "tpk_serve_requests_total{model=\"m\"} 0\n";
+    h.now += 6;
+    h.Tick();  // readiness recorded (scrape sees last tick's not-ready)
+    CHECK(Phase(h.store, "svc") == "Ready");
+    h.now += 6;
+    h.Tick();  // first scrape: counter baseline; birth = activity
+
+    // Traffic within the window keeps it alive past idle_after.
+    h.probe.metrics[p0] = "tpk_serve_requests_total{model=\"m\"} 10\n";
+    h.now += 6;
+    h.Tick();  // delta>0 -> lastActive refreshed
+    h.now += 25;
+    h.Tick();
+    CHECK(Phase(h.store, "svc") == "Ready");
+
+    // No traffic for idle_after -> reaped to 0, phase Idle.
+    h.now += 31;
+    h.Tick();
+    CHECK(Phase(h.store, "svc") == "Idle");
+    auto r = h.store.Get("InferenceService", "svc");
+    CHECK(r->status.get("replicas").get("desired").as_int() == 0);
+    CHECK(r->status.get("replicaState").size() == size_t{0});
+    CHECK(h.sched.Slices()[0].used == 0);  // devices released
+    auto events = h.ctl.metrics().scale_events;
+    std::string dump = r->status.dump();
+    h.now += 1;  // further idle ticks must not re-fire metric or status
+    h.Tick();
+    h.now += 1;
+    h.Tick();
+    CHECK(h.ctl.metrics().scale_events == events);
+    CHECK(h.store.Get("InferenceService", "svc")->status.dump() == dump);
+
+    // Wake: spec.wake bump brings it back (cold start).
+    auto cur = h.store.Get("InferenceService", "svc");
+    Json wspec = cur->spec;
+    wspec["wake"] = h.now;
+    h.store.UpdateSpec("InferenceService", "svc", wspec);
+    h.Tick();
+    r = h.store.Get("InferenceService", "svc");
+    CHECK(r->status.get("replicas").get("desired").as_int() == 1);
+    CHECK(r->status.get("replicaState").size() == 1);
+    int p1 = Port(h.store, "svc", 0);
+    h.probe.ready = {p1};
+    h.Tick();
+    CHECK(Phase(h.store, "svc") == "Ready");
+
+    // spec.replicas updates still honored with scale_to_zero set.
+    Json up = r->spec;
+    up["replicas"] = 2;
+    up["wake"] = h.now;  // fresh activity alongside the resize
+    h.store.UpdateSpec("InferenceService", "svc", up);
+    h.Tick();
+    r = h.store.Get("InferenceService", "svc");
+    CHECK(r->status.get("replicas").get("desired").as_int() == 2);
+  }
+  {
+    // Hand-zeroed service with scale_to_zero configured: stays Ready
+    // (nothing was reaped), never flips Idle.
+    Harness h;
+    Json spec = BaseSpec(0);
+    spec["scale_to_zero_after_s"] = 5;
+    h.store.Create("InferenceService", "svc0", spec);
+    h.Tick();
+    h.now += 60;
+    h.Tick();
+    CHECK(Phase(h.store, "svc0") == "Ready");
+  }
+
   // --- Liveness: wedged-but-alive server drops out of endpoints ---------
   {
     Harness h;

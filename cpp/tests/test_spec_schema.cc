@@ -106,5 +106,43 @@ int main() {
   CHECK(ValidateRuntime(rt).empty());
 
   printf("spec schema drift guard: %d fields enforced\n", checked);
+
+  // --- Namespace defaults (PodDefaults analog) -------------------------
+  {
+    using tpk::MergeNamespaceDefaults;
+    using tpk::SpecNamespace;
+    Json spec = Json::parse(R"({
+      "namespace": "team-a",
+      "runtime": {"steps": 50, "checkpoint": {"interval": 5}}
+    })");
+    Json defs = Json::parse(R"({
+      "backoff_limit": 2,
+      "runtime": {"steps": 999, "log_every": 10,
+                  "checkpoint": {"interval": 99, "keep": 3}}
+    })");
+    CHECK(SpecNamespace(spec) == "team-a");
+    CHECK(SpecNamespace(Json::Object()) == "default");
+    Json merged = MergeNamespaceDefaults(spec, defs);
+    // Missing fields filled at every depth...
+    CHECK(merged.get("backoff_limit").as_int() == 2);
+    CHECK(merged.get("runtime").get("log_every").as_int() == 10);
+    CHECK(merged.get("runtime").get("checkpoint").get("keep").as_int() == 3);
+    // ...but the user's values always win.
+    CHECK(merged.get("runtime").get("steps").as_int() == 50);
+    CHECK(merged.get("runtime").get("checkpoint").get("interval")
+              .as_int() == 5);
+    // No defaults -> spec unchanged.
+    CHECK(MergeNamespaceDefaults(spec, Json()).dump() == spec.dump());
+
+    // Profile.defaults validation: object-of-objects, no Profile key.
+    Json prof = Json::Object();
+    prof["defaults"] = Json::parse(R"({"JAXJob": {"backoff_limit": 1}})");
+    CHECK(tpk::ValidateSpec("Profile", prof).empty());
+    prof["defaults"] = Json::parse(R"({"JAXJob": 5})");
+    CHECK(!tpk::ValidateSpec("Profile", prof).empty());
+    prof["defaults"] = Json::parse(R"({"Profile": {}})");
+    CHECK(!tpk::ValidateSpec("Profile", prof).empty());
+    printf("namespace defaults: merge + validation OK\n");
+  }
   return 0;
 }

@@ -127,6 +127,18 @@ class Client:
     def submit_jaxjob(self, name: str, spec: dict) -> dict:
         return self.create("JAXJob", name, spec)
 
+    def wake_service(self, name: str) -> dict:
+        """Cold-start a scale-to-zero'd InferenceService: bump spec.wake
+        so the controller scales it back up (the control-plane analog of
+        Knative's activator receiving the first request — callers then
+        wait_for_phase(name, ("Ready",), kind="InferenceService") and
+        send the request)."""
+        res = self.get("InferenceService", name)
+        spec = dict(res.get("spec", {}))
+        spec["wake"] = time.time()
+        return self.update_spec("InferenceService", name, spec,
+                                expected_version=res.get("resourceVersion"))
+
     def phase(self, name: str, kind: str = "JAXJob") -> str:
         return self.get(kind, name).get("status", {}).get("phase", "")
 

@@ -495,6 +495,14 @@ class GenerationEngine:
         self._rolling = 0
         if mask_kind == "sliding_window":
             window = int(getattr(cfg, "mask_window", 0))
+            if (self.max_len > window
+                    and getattr(cfg, "sliding_pattern", "all") != "all"):
+                raise ValueError(
+                    f"alternating sliding/full layers (Gemma-2, pattern "
+                    f"{cfg.sliding_pattern!r}): the full-attention layers "
+                    f"need the whole history, so a rolling window cache "
+                    f"cannot serve max_len={self.max_len} > window="
+                    f"{window}; set max_len <= window")
             if self.max_len > window:
                 # Serving PAST the window: rolling-buffer KV cache
                 # (models/llama.py init_cache grows a "pos" plane; rows =

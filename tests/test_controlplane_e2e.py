@@ -298,3 +298,50 @@ def test_elastic_heartbeat_detects_hung_worker(controlplane):
     reasons = [c["reason"]
                for c in client.get("JAXJob", "hung")["status"]["conditions"]]
     assert "HeartbeatTimeout" in reasons
+
+
+def test_namespace_defaults_injected_at_admission(controlplane):
+    """PodDefaults-equivalent (SURVEY.md §2.5): the namespace's Profile
+    carries per-kind partial specs; a JAXJob submitted into that
+    namespace materializes the missing fields at CREATE admission (the
+    user's own values win), and the defaulted job runs to Succeeded."""
+    client, sock, workdir, tmp = controlplane
+    ckpt_dir = str(tmp / "team_ckpts")
+    client.create("Profile", "team-a", {
+        "max_devices": 8,
+        "defaults": {
+            "JAXJob": {
+                "backoff_limit": 5,
+                "runtime": {
+                    "log_every": 5,
+                    "checkpoint": {"dir": ckpt_dir, "interval": 10},
+                },
+            },
+        },
+    })
+
+    spec = _mnist_spec(steps=20)
+    spec["namespace"] = "team-a"
+    del spec["backoff_limit"]          # -> defaulted to 5
+    spec["runtime"].pop("log_every")   # -> defaulted to 5
+    client.submit_jaxjob("nsjob", spec)
+
+    stored = client.get("JAXJob", "nsjob")["spec"]
+    assert stored["backoff_limit"] == 5
+    assert stored["runtime"]["log_every"] == 5
+    assert stored["runtime"]["checkpoint"]["dir"] == ckpt_dir
+    # User values won over defaults at every depth.
+    assert stored["runtime"]["steps"] == 20
+
+    assert client.wait_for_phase("nsjob", timeout=240) == "Succeeded"
+    # The defaulted checkpoint dir actually materialized on disk.
+    assert os.path.isdir(ckpt_dir) and os.listdir(ckpt_dir)
+
+    # A job in another namespace is untouched by team-a's defaults.
+    other = _mnist_spec(steps=5)
+    other_bl = other["backoff_limit"]
+    client.submit_jaxjob("otherjob", other)
+    assert client.get("JAXJob", "otherjob")["spec"]["backoff_limit"] == \
+        other_bl
+    assert "checkpoint" not in client.get("JAXJob", "otherjob")["spec"][
+        "runtime"]

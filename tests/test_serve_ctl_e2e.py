@@ -442,3 +442,49 @@ def test_tensor_parallel_generative_isvc(controlplane):
         f"{url}/v2/models/g", timeout=30).read())
     assert md["mesh"] == {"tensor": 2}
     client.delete("InferenceService", "gtp")
+
+
+def test_scale_to_zero_and_wake(controlplane):
+    """Knative KPA parity (SURVEY.md §5.3): an idle ISVC is reaped to 0
+    replicas (processes stopped, devices released, phase Idle); a wake —
+    the control-plane stand-in for the activator receiving the first
+    request — brings it back, and the request then succeeds."""
+    from kubeflow_tpu.serve import export_for_serving
+
+    client, workdir, tmp = controlplane
+    bundle = str(tmp / "bundle_s0")
+    export_for_serving(bundle, model="mnist_mlp",
+                       model_kwargs={"in_dim": 16, "hidden": [8],
+                                     "num_classes": 4},
+                       batch_buckets=(1, 4), seed=7)
+
+    client.create("InferenceService", "s0", {
+        "model": {"name": "s0", "model_dir": bundle},
+        "replicas": 1,
+        "devices_per_replica": 1,
+        "cpu_devices": 1,
+        "scale_to_zero_after_s": 4,
+        "scale_interval_s": 1,
+    })
+    _wait_phase(client, "s0", "Ready", timeout=120)
+    url = client.get("InferenceService", "s0")["status"]["endpoints"][0][
+        "url"]
+    x = np.random.default_rng(0).normal(size=(1, 16)).astype(np.float32)
+    out = _post(f"{url}/v1/models/s0:predict", {"instances": x.tolist()})
+    assert len(out["predictions"]) == 1
+
+    # Idle out: replicas -> 0, endpoints gone, devices released.
+    _wait_phase(client, "s0", "Idle", timeout=60)
+    status = client.get("InferenceService", "s0")["status"]
+    assert status["replicas"]["desired"] == 0
+    assert status["replicas"]["running"] == 0
+    assert status.get("endpoints", []) == []
+
+    # Cold start: wake + wait Ready + the request succeeds again.
+    client.wake_service("s0")
+    _wait_phase(client, "s0", "Ready", timeout=120)
+    url = client.get("InferenceService", "s0")["status"]["endpoints"][0][
+        "url"]
+    out = _post(f"{url}/v1/models/s0:predict", {"instances": x.tolist()})
+    assert len(out["predictions"]) == 1
+    client.delete("InferenceService", "s0")
