@@ -32,16 +32,22 @@ inline std::string SpecNamespace(const Json& spec) {
   return ns.empty() ? "default" : ns;
 }
 
-inline Json MergeNamespaceDefaults(const Json& spec, const Json& defaults) {
+inline Json MergeNamespaceDefaults(const Json& spec, const Json& defaults,
+                                   bool top = true) {
   if (!defaults.is_object()) return spec;
   if (spec.is_null()) return defaults;
   if (!spec.is_object()) return spec;  // scalar user value always wins
   Json out = spec;
   for (const auto& [k, dv] : defaults.items()) {
+    if (top && k == "namespace") {
+      // A default must never MOVE the resource into another tenancy —
+      // the Profile consulted was chosen by the pre-merge namespace.
+      continue;
+    }
     if (!out.has(k) || out.get(k).is_null()) {
       out[k] = dv;
     } else if (out.get(k).is_object() && dv.is_object()) {
-      out[k] = MergeNamespaceDefaults(out.get(k), dv);
+      out[k] = MergeNamespaceDefaults(out.get(k), dv, /*top=*/false);
     }
   }
   return out;
@@ -296,6 +302,10 @@ inline std::string ValidateSpec(const std::string& kind, const Json& spec) {
         if (k == "Profile") {
           return "defaults.Profile is not allowed (namespaces don't "
                  "default namespaces)";
+        }
+        if (v.has("namespace")) {
+          return "defaults." + k + ".namespace is not allowed (a "
+                 "default cannot move resources between namespaces)";
         }
       }
     }

@@ -458,6 +458,9 @@ int ServeController::DesiredReplicas(View& v) {
   double idle_after = v.spec.get("scale_to_zero_after_s").as_number(0);
   bool rps_autoscale = target > 0 && max_r > min_r;
   if (!rps_autoscale && idle_after <= 0) {
+    // Disabling scale-to-zero must clear a stale reaped marker, or
+    // re-enabling it later would instantly reap the live service.
+    if (v.status.get("idle").as_bool(false)) v.status["idle"] = false;
     return static_cast<int>(v.spec.get("replicas").as_int(min_r));
   }
   // Throughput autoscaler: rps over the scrape interval / target per
@@ -558,8 +561,14 @@ int ServeController::DesiredReplicas(View& v) {
       }
     }
     if (!reaped && !any_ready) {
-      as["lastActive"] = now_s_;
-      v.status["autoscale"] = as;
+      // Refresh at scrape-interval granularity, not per tick — a long
+      // cold start or crash loop must not append a WAL record per
+      // second (the idle clock tolerates interval-sized slack; reaping
+      // needs scrape evidence anyway).
+      if (now_s_ - last_active >= interval) {
+        as["lastActive"] = now_s_;
+        v.status["autoscale"] = as;
+      }
       return desired;
     }
     if (last_active == 0) {

@@ -33,9 +33,10 @@ CP composes INSIDE the pipeline (`seq_axis`): traveling activations shard
 their sequence dim over `seq` and stage attention runs the ring schedule
 (position-masked einsum ring for 'naive', fused offset-case ring for
 'flash') — ops/ring_attention.py manual bodies, callable because the
-`seq` axis is part of the pipeline's own shard_map region. v1 scope:
-causal + unpacked (packed segment masks and MaskSpec families need the
-non-CP pipeline).
+`seq` axis is part of the pipeline's own shard_map region. Packed
+segment masks compose too (round 5): segs travel the pipeline AND rotate
+the stage ring with K/V, on the einsum ring. MaskSpec families still
+need the non-CP pipeline.
 
 MoE composes too: a scanned MoELlama tree pipelines with expert weights
 sharded over `expert` (_moe_ffn — EP's combine-psum inside the stage
@@ -114,18 +115,27 @@ def layer_fwd(cfg: LlamaConfig, lp: dict, x: jax.Array, cos: jax.Array,
     if ring is not None:
         from kubeflow_tpu.ops.ring_attention import (
             ring_attention_flash_manual, ring_attention_manual)
-        if segment_ids is not None or mask is not None:
+        if mask is not None:
             raise ValueError(
                 "ring attention inside the pipeline stage is causal-only "
-                "and unpacked-only (no segment_ids / MaskSpec)")
+                "(no MaskSpec families)")
         if attn_impl == "flash":
             # Contiguous layout: shard r owns positions [r*s_loc, ...), so
             # causality comes from ring offsets (fused Pallas inner).
+            # Packed batches take the einsum ring (pipeline_forward
+            # downgrades the impl) — the fused ring has no segment mask.
+            if segment_ids is not None:
+                raise ValueError(
+                    "the fused ring has no segment mask; packed "
+                    "CP-inside-PP uses the einsum ring (attn 'naive')")
             attn = ring_attention_flash_manual(
                 q, k, v, ring[0], ring[1],
                 block_q=cfg.flash_block_q, block_kv=cfg.flash_block_kv)
         else:
-            attn = ring_attention_manual(q, k, v, positions, *ring)
+            # Position+segment-masked einsum ring: exact for packed
+            # documents — segs rotate with K/V.
+            attn = ring_attention_manual(q, k, v, positions, *ring,
+                                         segment_ids=segment_ids)
     elif attn_impl == "flash":
         from kubeflow_tpu.ops.flash_attention import flash_attention
         attn = flash_attention(q, k, v, causal=True,
@@ -233,8 +243,9 @@ def pipeline_forward(
     ring schedule over that axis — PP x CP composition for long sequences
     (SURVEY §5.7 x §2.6). Contiguous layout; attn 'naive' uses the
     position-masked einsum ring (exact), 'flash' the fused offset-case
-    ring. v1 scope: causal only (no MaskSpec families), unpacked only —
-    packed segment masks don't compose with CP-inside-PP yet."""
+    ring. Packed batches compose: segment_ids shard with the sequence and
+    rotate the stage ring alongside K/V (einsum ring — the impl
+    auto-downgrades from 'flash'). MaskSpec families still refuse."""
     if cfg.num_layers % (mesh.shape["pipe"] * num_chunks):
         raise ValueError(
             f"num_layers {cfg.num_layers} not divisible by pipe "
@@ -244,10 +255,11 @@ def pipeline_forward(
     if seq_axis is not None and mesh.shape[seq_axis] > 1:
         n_seq = mesh.shape[seq_axis]
         if segment_ids is not None:
-            raise ValueError(
-                "CP-inside-PP (seq_axis) does not compose with packed "
-                "segment_ids yet — use packed PP without seq_axis, or CP "
-                "without PP")
+            # Packed documents x CP-inside-PP: segment ids shard with the
+            # sequence and rotate around the stage ring with K/V — exact
+            # on the position+segment-masked einsum ring only (the fused
+            # ring derives causality from layout, not positions).
+            attn_impl = "naive"
         if cfg.mask_spec is not None:
             raise ValueError(
                 f"CP-inside-PP is causal-only; mask_kind={cfg.mask_kind!r} "

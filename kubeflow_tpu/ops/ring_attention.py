@@ -29,10 +29,12 @@ from kubeflow_tpu.parallel.mesh import current_mesh
 NEG_INF = -1e30
 
 
-def _block_attn(q, k, v, q_pos, kv_pos):
+def _block_attn(q, k, v, q_pos, kv_pos, q_seg=None, kv_seg=None):
     """One blockwise attention contribution with causal masking by absolute
     positions. q [b,s,h,d] (local shard), k/v [b,t,kh,d]. Returns fp32
-    (acc [b,s,h,d], m [b,s,h,1], l [b,s,h,1]) partials."""
+    (acc [b,s,h,d], m [b,s,h,1], l [b,s,h,1]) partials. `q_seg`/`kv_seg`
+    [b,s]/[b,t] additionally confine attention within equal-id spans (the
+    packed-sequence mask, matching ops/reference.py semantics)."""
     b, s, h, d = q.shape
     kh = k.shape[2]
     group = h // kh
@@ -40,6 +42,9 @@ def _block_attn(q, k, v, q_pos, kv_pos):
     scores = jnp.einsum("bskgd,btkd->bskgt", qg, k.astype(jnp.float32))
     scores = scores / (d ** 0.5)
     mask = q_pos[:, :, None, None, None] >= kv_pos[:, None, None, None, :]
+    if q_seg is not None:
+        mask &= (q_seg[:, :, None, None, None]
+                 == kv_seg[:, None, None, None, :])
     scores = jnp.where(mask, scores, NEG_INF)
     m = jnp.max(scores, axis=-1, keepdims=True)  # [b,s,kh,g,1]
     # Rows with no visible keys: exp(NEG_INF - NEG_INF) would be 1; zero them
@@ -121,7 +126,8 @@ def _flash_case_block(q, k, v, case, block_q, block_kv):
     return jax.lax.switch(case, (skip, diag, full), None)
 
 
-def ring_attention_manual(q, k, v, pos, axis_name: str, n: int) -> jax.Array:
+def ring_attention_manual(q, k, v, pos, axis_name: str, n: int,
+                          segment_ids=None) -> jax.Array:
     """Einsum-inner causal ring body for callers ALREADY inside a manual
     (`shard_map`) region whose mesh includes `axis_name` — context
     parallelism composed inside another manually-partitioned schedule, e.g.
@@ -130,24 +136,32 @@ def ring_attention_manual(q, k, v, pos, axis_name: str, n: int) -> jax.Array:
     All shapes are per-shard: q [b_loc, s_loc, H, D], k/v [b_loc, s_loc,
     KH, D], pos [b_loc, s_loc] GLOBAL positions of the resident shard
     (causality is masked by absolute position, so any contiguous or
-    permuted layout works). Differentiable (each ring step rematerializes).
-    """
+    permuted layout works). `segment_ids` [b_loc, s_loc] (packed
+    documents) rotate around the ring with K/V so every step masks
+    within-document exactly. Differentiable (each ring step
+    rematerializes)."""
     h, d = q.shape[2], q.shape[3]
+    packed = segment_ids is not None
 
     def step(i, carry):
-        acc_m_l, kv, kv_pos = carry
+        acc_m_l, kv, kv_pos, kv_seg = carry
         k_i, v_i = kv
-        update = _block_attn(q, k_i, v_i, pos, kv_pos)
+        update = _block_attn(q, k_i, v_i, pos, kv_pos,
+                             segment_ids if packed else None, kv_seg)
         acc_m_l = _merge(acc_m_l, update)
-        kv, kv_pos = _rotate_if(i < n - 1, (kv, kv_pos), axis_name, n)
-        return acc_m_l, kv, kv_pos
+        kv, kv_pos, kv_seg = _rotate_if(
+            i < n - 1, (kv, kv_pos, kv_seg), axis_name, n)
+        return acc_m_l, kv, kv_pos, kv_seg
 
     b_loc, s_loc = q.shape[0], q.shape[1]
     init = (jnp.zeros((b_loc, s_loc, h, d), jnp.float32),
             jnp.full((b_loc, s_loc, h, 1), NEG_INF, jnp.float32),
             jnp.zeros((b_loc, s_loc, h, 1), jnp.float32))
-    (acc, _, l), _, _ = jax.lax.fori_loop(
-        0, n, jax.checkpoint(step), (init, (k, v), pos))
+    # A zeros placeholder keeps the carry structure static when unpacked
+    # (fori_loop needs one pytree either way; _block_attn ignores it).
+    seg0 = segment_ids if packed else jnp.zeros((b_loc, s_loc), jnp.int32)
+    (acc, _, l), _, _, _ = jax.lax.fori_loop(
+        0, n, jax.checkpoint(step), (init, (k, v), pos, seg0))
     return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
 

@@ -227,6 +227,62 @@ def test_pipeline_cp_forward_matches_scanned(devices8, attn, chunks):
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.parametrize("chunks", [1, 2])
+def test_pipeline_cp_packed_matches_scanned(devices8, chunks):
+    """VERDICT r4 item 8: packed segments x CP-inside-PP — segment ids
+    shard with the sequence, travel the pipeline, and rotate the stage
+    ring with K/V; logits must match the scanned packed model. Also
+    checks the auto-downgrade from 'flash' (the fused ring has no
+    segment mask)."""
+    cfg = dataclasses.replace(_cfg(), attention_impl="flash")
+    model, params, _ = _params_and_tokens(cfg)
+    tokens, segs, pos = _packed_batch(cfg, batch=8, seq=32)
+    mesh = build_mesh(MeshConfig(pipe=2, seq=2, data=2), devices8)
+
+    ref = model.apply({"params": params}, tokens, positions=pos,
+                      segment_ids=segs)
+    with mesh:
+        out = jax.jit(lambda p, t, sg, ps: pipeline_forward(
+            cfg, p, t, mesh=mesh, num_microbatches=2, num_chunks=chunks,
+            positions=ps, segment_ids=sg, seq_axis="seq"))(
+                params, tokens, segs, pos)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_pipeline_cp_packed_grads_match_scanned(devices8):
+    cfg = _cfg()
+    model, params, _ = _params_and_tokens(cfg)
+    tokens, segs, pos = _packed_batch(cfg, batch=8, seq=32)
+    targets = jnp.roll(tokens, -1, axis=1)
+    mask = (np.asarray(segs)[:, :-1] == np.asarray(segs)[:, 1:])
+    mask = jnp.asarray(
+        np.concatenate([mask, np.zeros((8, 1), bool)], 1), jnp.float32)
+    mesh = build_mesh(MeshConfig(pipe=2, seq=2, data=2), devices8)
+
+    def ref_loss(p):
+        return cross_entropy_loss(
+            model.apply({"params": p}, tokens, positions=pos,
+                        segment_ids=segs), targets, mask)
+
+    def pp_loss(p):
+        return cross_entropy_loss(
+            pipeline_forward(cfg, p, tokens, mesh=mesh, num_microbatches=2,
+                             positions=pos, segment_ids=segs,
+                             seq_axis="seq"),
+            targets, mask)
+
+    ref_l, ref_g = jax.value_and_grad(ref_loss)(params)
+    with mesh:
+        pp_l, pp_g = jax.jit(jax.value_and_grad(pp_loss))(params)
+    np.testing.assert_allclose(float(pp_l), float(ref_l), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(ref_g), jax.tree.leaves(pp_g)):
+        np.testing.assert_allclose(np.asarray(b, np.float32),
+                                   np.asarray(a, np.float32),
+                                   rtol=5e-4, atol=5e-5)
+
+
 def test_pipeline_cp_grads_match_scanned(devices8):
     cfg = _cfg()
     model, params, tokens = _params_and_tokens(cfg)
