@@ -561,11 +561,14 @@ int ServeController::DesiredReplicas(View& v) {
       }
     }
     if (!reaped && !any_ready) {
-      // Refresh at scrape-interval granularity, not per tick — a long
-      // cold start or crash loop must not append a WAL record per
-      // second (the idle clock tolerates interval-sized slack; reaping
-      // needs scrape evidence anyway).
-      if (now_s_ - last_active >= interval) {
+      // Refresh at bounded granularity, not per tick — a long cold
+      // start or crash loop must not append a WAL record per second.
+      // The grain must not exceed idle_after: with idle_after <
+      // interval, an interval-stale lastActive at the ready transition
+      // would let the first post-cold-start scrape reap the service
+      // before it served anything.
+      double grain = std::min(interval, idle_after) / 2.0;
+      if (now_s_ - last_active >= grain) {
         as["lastActive"] = now_s_;
         v.status["autoscale"] = as;
       }

@@ -157,11 +157,10 @@ def ring_attention_manual(q, k, v, pos, axis_name: str, n: int,
     init = (jnp.zeros((b_loc, s_loc, h, d), jnp.float32),
             jnp.full((b_loc, s_loc, h, 1), NEG_INF, jnp.float32),
             jnp.zeros((b_loc, s_loc, h, 1), jnp.float32))
-    # A zeros placeholder keeps the carry structure static when unpacked
-    # (fori_loop needs one pytree either way; _block_attn ignores it).
-    seg0 = segment_ids if packed else jnp.zeros((b_loc, s_loc), jnp.int32)
+    # None is a leaf-less pytree node: unpacked callers carry (and
+    # ppermute) nothing extra.
     (acc, _, l), _, _, _ = jax.lax.fori_loop(
-        0, n, jax.checkpoint(step), (init, (k, v), pos, seg0))
+        0, n, jax.checkpoint(step), (init, (k, v), pos, segment_ids))
     return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
 

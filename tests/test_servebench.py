@@ -29,6 +29,20 @@ def test_servebench_quick_shape():
     q = r["quant"]
     assert q["bf16_tok_s"] > 0 and q["int8_tok_s"] > 0
     assert q["param_bytes"]["quantized"] < q["param_bytes"]["full"]
+    # Long-max_len bucketed-decode row (where the win can appear).
+    dbl = r["decode_buckets_long"]
+    assert dbl["max_len"] > r["max_len"]
+    assert dbl["bucketed_tok_s"] > 0 and dbl["flat_tok_s"] > 0
+    # Speculative decoding rows: self-draft must accept nearly all
+    # proposals; the random small draft nearly none.
+    sp = r["spec_decode"]
+    assert sp["vanilla"]["tok_s"] > 0
+    assert sp["self_draft"]["acceptance"] > 0.9
+    assert sp["small_draft"]["acceptance"] < 0.5
+    assert sp["self_draft"]["spec_dispatches"] > 0
+    # Multi-LoRA mixed-adapter batch measured against base.
+    ml = r["multilora"]
+    assert ml["base_tok_s"] > 0 and ml["mixed_adapter_tok_s"] > 0
     # Batcher percentiles under load.
     b = r["batcher"]
     assert b["requests"] == 64
