@@ -435,6 +435,90 @@ def import_mixtral(path: str, *, scan_layers: bool = True,
     return cfg, _llama_family_params(t, cfg, scan_layers, mlp)
 
 
+def import_qwen2_moe(path: str, *, scan_layers: bool = True,
+                     **config_overrides: Any):
+    """HF Qwen2-MoE checkpoint dir → (MoEConfig, flax params) for MoELlama.
+
+    On top of the Mixtral recipe (GShard capacity dispatch pinned
+    dropless at E/K), Qwen2-MoE adds — all on the shared MoE trunk
+    (models/moe.py):
+
+      * a SHARED expert: an always-on dense SwiGLU
+        (`shared_expert_intermediate_size`) scaled by a learned
+        per-token sigmoid gate (`shared_expert_gate` [1, H] → [H, 1]);
+      * `norm_topk_prob=false` by default — top-k gate values keep their
+        raw softmax mass instead of renormalizing to 1;
+      * Qwen2's QKV biases (`attention_bias`);
+      * expert width `moe_intermediate_size` (the dense
+        `intermediate_size` belongs to the shared expert).
+
+    Heterogeneous layouts are refused: `mlp_only_layers` non-empty or
+    `decoder_sparse_step != 1` would interleave dense layers into the
+    scanned MoE trunk."""
+    from kubeflow_tpu.models.moe import MoEConfig
+
+    hf = read_hf_config(path)
+    arch = (hf.get("architectures") or [""])[0]
+    if not ("Qwen2Moe" in arch or hf.get("model_type") == "qwen2_moe"):
+        raise ValueError(
+            f"import_qwen2_moe cannot load architecture {arch!r}")
+    if hf.get("mlp_only_layers"):
+        raise ValueError(
+            f"mlp_only_layers={hf['mlp_only_layers']}: dense layers "
+            "interleaved into the MoE trunk are not supported (the "
+            "scanned trunk is homogeneous)")
+    if int(hf.get("decoder_sparse_step", 1)) != 1:
+        raise ValueError(
+            f"decoder_sparse_step={hf['decoder_sparse_step']}: only "
+            "every-layer-sparse checkpoints are supported")
+    E = int(hf["num_experts"])
+    K = int(hf["num_experts_per_tok"])
+    base = llama_config_from_hf(hf, scan_layers=scan_layers,
+                                attention_bias=True)
+    fields = {f.name: getattr(base, f.name)
+              for f in dataclasses.fields(base) if f.init}
+    # The dense intermediate_size is the SHARED expert's width; routed
+    # experts use moe_intermediate_size.
+    fields["intermediate_size"] = int(hf["moe_intermediate_size"])
+    cfg = MoEConfig(
+        **fields,
+        num_experts=E, experts_per_token=K,
+        capacity_factor=E / K,  # dropless (see import_mixtral)
+        norm_topk_prob=bool(hf.get("norm_topk_prob", False)),
+        shared_expert_size=int(hf["shared_expert_intermediate_size"]),
+        router_aux_coef=float(hf.get("router_aux_loss_coef", 0.001)))
+    if config_overrides:
+        cfg = dataclasses.replace(cfg, **config_overrides)
+    t = load_safetensors_dir(path)
+    L = cfg.num_layers
+    p = "model.layers.{i}.mlp."
+
+    def experts(i, name):
+        return np.stack([
+            _lin(t[p.format(i=i) + f"experts.{e}.{name}.weight"])
+            for e in range(E)])
+
+    def shared(name):
+        return np.stack([
+            _lin(t[p.format(i=i) + f"shared_expert.{name}.weight"])
+            for i in range(L)])
+
+    mlp = {
+        "router": np.stack([
+            _lin(t[p.format(i=i) + "gate.weight"]) for i in range(L)]),
+        "w_gate": np.stack([experts(i, "gate_proj") for i in range(L)]),
+        "w_up": np.stack([experts(i, "up_proj") for i in range(L)]),
+        "w_down": np.stack([experts(i, "down_proj") for i in range(L)]),
+        "w_shared_gate": shared("gate_proj"),
+        "w_shared_up": shared("up_proj"),
+        "w_shared_down": shared("down_proj"),
+        "shared_gate": np.stack([
+            _lin(t[p.format(i=i) + "shared_expert_gate.weight"])
+            for i in range(L)]),
+    }
+    return cfg, _llama_family_params(t, cfg, scan_layers, mlp)
+
+
 # ---------------------------------------------------------------------------
 # BERT
 # ---------------------------------------------------------------------------
@@ -822,12 +906,10 @@ def build_from_hf(path: str, **overrides: Any):
         cfg, params = import_gemma(path, **overrides)
         return Llama(cfg), cfg, params
     if "Qwen2Moe" in arch or hf.get("model_type") == "qwen2_moe":
-        # Qwen2-MoE adds shared experts + a different gate recipe than
-        # Mixtral; importing it as dense Qwen2 would crash on missing
-        # tensors (or worse, as Mixtral with wrong routing).
-        raise ValueError(
-            f"unsupported architecture {arch!r} (dense Qwen2 and Mixtral "
-            "MoE are implemented; Qwen2-MoE's shared-expert block is not)")
+        from kubeflow_tpu.models.moe import MoELlama
+
+        cfg, params = import_qwen2_moe(path, **overrides)
+        return MoELlama(cfg), cfg, params
     if "T5" in arch or hf.get("model_type", "").endswith("t5"):
         # Catches UMT5 (and future T5 variants) whether declared via
         # architectures OR only via model_type — falling through to

@@ -183,7 +183,8 @@ def _moe_ffn(cfg, mp: dict, h2: jax.Array,
     s = h2.shape[1]
     C = expert_capacity(cfg, s)
     dispatch, combine, aux = gshard_route(
-        h2, mp["router"], cfg.experts_per_token, C)
+        h2, mp["router"], cfg.experts_per_token, C,
+        renormalize=getattr(cfg, "norm_topk_prob", True))
     e_loc = mp["w_gate"].shape[0]
     if expert is not None and expert[1] > 1:
         start = jax.lax.axis_index(expert[0]) * e_loc
@@ -197,6 +198,14 @@ def _moe_ffn(cfg, mp: dict, h2: jax.Array,
     y = jnp.einsum("bsec,ebch->bsh", combine.astype(dt), out)
     if expert is not None and expert[1] > 1:
         y = jax.lax.psum(y, expert[0])
+    if "w_shared_gate" in mp:
+        # Qwen2-MoE shared expert (replicated over `expert`) — the ONE
+        # definition in models/moe.py, same as MoEBlock.
+        from kubeflow_tpu.models.moe import shared_expert_ffn
+
+        y = y + shared_expert_ffn(h2, mp["w_shared_gate"],
+                                  mp["w_shared_up"], mp["w_shared_down"],
+                                  mp["shared_gate"], dt)
     return y.astype(dt), aux
 
 

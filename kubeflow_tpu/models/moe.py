@@ -34,6 +34,18 @@ class MoEConfig(LlamaConfig):
     experts_per_token: int = 2     # top-k routing (Mixtral: 2)
     capacity_factor: float = 1.25  # buffer slack over perfect balance
     router_aux_coef: float = 0.01  # Switch load-balance loss weight
+    # Mixtral renormalizes the top-k gate values to sum 1; Qwen2-MoE's
+    # default (norm_topk_prob=false) keeps the raw softmax mass.
+    norm_topk_prob: bool = True
+    # Qwen2-MoE shared expert: an always-on SwiGLU FFN of this width
+    # whose output is scaled by a learned sigmoid gate (0 = none).
+    shared_expert_size: int = 0
+
+    def _shared_params(self) -> int:
+        if not self.shared_expert_size:
+            return 0
+        return 3 * self.hidden_size * self.shared_expert_size \
+            + self.hidden_size  # + the [H, 1] sigmoid gate
 
     @property
     def num_params(self) -> int:
@@ -41,9 +53,11 @@ class MoEConfig(LlamaConfig):
         qkv = (h * self.num_heads * self.head_dim
                + 2 * h * self.num_kv_heads * self.head_dim)
         attn = qkv + self.num_heads * self.head_dim * h
+        if self.attention_bias:
+            attn += (self.num_heads + 2 * self.num_kv_heads) * self.head_dim
         experts = self.num_experts * 3 * h * m
         router = h * self.num_experts
-        per_layer = attn + experts + router + 2 * h
+        per_layer = attn + experts + router + 2 * h + self._shared_params()
         emb = v * h * (1 if self.tie_embeddings else 2)
         return self.num_layers * per_layer + emb + h
 
@@ -54,8 +68,11 @@ class MoEConfig(LlamaConfig):
         qkv = (h * self.num_heads * self.head_dim
                + 2 * h * self.num_kv_heads * self.head_dim)
         attn = qkv + self.num_heads * self.head_dim * h
+        if self.attention_bias:
+            attn += (self.num_heads + 2 * self.num_kv_heads) * self.head_dim
         experts = self.experts_per_token * 3 * h * m
-        per_layer = attn + experts + h * self.num_experts + 2 * h
+        per_layer = (attn + experts + h * self.num_experts + 2 * h
+                     + self._shared_params())
         emb = v * h * (1 if self.tie_embeddings else 2)
         return self.num_layers * per_layer + emb + h
 
@@ -85,7 +102,8 @@ def expert_capacity(cfg: MoEConfig, seq_len: int) -> int:
         * cfg.capacity_factor)))
 
 
-def gshard_route(x: jax.Array, w_router: jax.Array, K: int, C: int):
+def gshard_route(x: jax.Array, w_router: jax.Array, K: int, C: int,
+                 renormalize: bool = True):
     """GShard/Switch capacity routing, pure jnp — shared by the flax
     MoEBlock and the pipeline stage body (models/llama_pp.py MoE-PP), so
     the two paths cannot drift.
@@ -93,14 +111,17 @@ def gshard_route(x: jax.Array, w_router: jax.Array, K: int, C: int):
     x [B, S, H] (any dtype; router runs fp32), w_router [H, E] fp32.
     Returns (dispatch [B,S,E,C], combine [B,S,E,C], aux scalar) where aux
     is the UNWEIGHTED Switch load-balance term E * Σ_e frac_e · mean_prob_e
-    (caller applies router_aux_coef)."""
+    (caller applies router_aux_coef). `renormalize` scales the top-k gate
+    values to sum 1 (Mixtral); Qwen2-MoE's norm_topk_prob=false keeps the
+    raw softmax mass."""
     E = w_router.shape[-1]
     logits = jnp.einsum("bsh,he->bse", x.astype(jnp.float32),
                         w_router.astype(jnp.float32))
     probs = jax.nn.softmax(logits, axis=-1)            # [B,S,E]
     gate_vals, expert_idx = jax.lax.top_k(probs, K)    # [B,S,K]
-    gate_vals = gate_vals / jnp.maximum(
-        jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
+    if renormalize:
+        gate_vals = gate_vals / jnp.maximum(
+            jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
 
     B, S = x.shape[0], x.shape[1]
     # Capacity assignment, slot-major (GShard): slot-0 choices claim
@@ -122,6 +143,20 @@ def gshard_route(x: jax.Array, w_router: jax.Array, K: int, C: int):
     mean_prob = jnp.mean(probs, axis=(0, 1))
     aux = E * jnp.sum(frac * mean_prob)
     return dispatch, combine, aux
+
+
+def shared_expert_ffn(x, w_gate, w_up, w_down, gate_w, dtype):
+    """Qwen2-MoE's always-on shared expert, pure jnp — one definition
+    shared by the flax MoEBlock and the pipeline stage body
+    (models/llama_pp.py _moe_ffn) so the two paths cannot drift (same
+    contract as gshard_route): dense SwiGLU scaled by a learned
+    per-token sigmoid gate (fp32 sigmoid). x [.., H]; w_gate/w_up
+    [H, Ms]; w_down [Ms, H]; gate_w [H, 1]."""
+    xd = x.astype(dtype)
+    sh = (jax.nn.silu(xd @ w_gate.astype(dtype))
+          * (xd @ w_up.astype(dtype))) @ w_down.astype(dtype)
+    gate = jax.nn.sigmoid((xd @ gate_w.astype(dtype)).astype(jnp.float32))
+    return sh * gate.astype(dtype)
 
 
 class MoEBlock(nn.Module):
@@ -146,7 +181,8 @@ class MoEBlock(nn.Module):
             "router", nn.with_logical_partitioning(
                 nn.initializers.lecun_normal(), ("embed", None)),
             (H, E), jnp.float32)
-        dispatch, combine, aux = gshard_route(x, w_router, K, C)
+        dispatch, combine, aux = gshard_route(
+            x, w_router, K, C, renormalize=cfg.norm_topk_prob)
         self.sow("aux_loss", "router", cfg.router_aux_coef * aux)
 
         # Dispatch → per-expert batches [E,B,C,H]; with `expert` sharded
@@ -179,6 +215,27 @@ class MoEBlock(nn.Module):
 
         # Combine back to token order (the return all-to-all).
         y = jnp.einsum("bsec,ebch->bsh", combine.astype(cfg.dtype), out)
+
+        if cfg.shared_expert_size:
+            # Qwen2-MoE shared expert: an always-on dense SwiGLU whose
+            # output is scaled by a learned per-token sigmoid gate —
+            # replicated over `expert` (every rank computes it; it's the
+            # dense fraction of the FLOPs), sharded like a dense MLP.
+            ms = cfg.shared_expert_size
+            ws_gate = self.param(
+                "w_shared_gate", nn.with_logical_partitioning(
+                    dense_init, ("embed", "mlp")), (H, ms), cfg.param_dtype)
+            ws_up = self.param(
+                "w_shared_up", nn.with_logical_partitioning(
+                    dense_init, ("embed", "mlp")), (H, ms), cfg.param_dtype)
+            ws_down = self.param(
+                "w_shared_down", nn.with_logical_partitioning(
+                    dense_init, ("mlp", "embed")), (ms, H), cfg.param_dtype)
+            w_sgate = self.param(
+                "shared_gate", nn.with_logical_partitioning(
+                    dense_init, ("embed", None)), (H, 1), cfg.param_dtype)
+            y = y + shared_expert_ffn(x, ws_gate, ws_up, ws_down, w_sgate,
+                                      cfg.dtype)
         return y.astype(cfg.dtype)
 
 

@@ -406,6 +406,25 @@ def test_pipeline_moe_matches_scanned(devices8, mesh_kw, chunks):
         np.testing.assert_allclose(float(aux), aux_ref, rtol=1e-5)
 
 
+def test_pipeline_moe_shared_expert_matches_scanned(devices8):
+    """Qwen2-MoE conventions through MoE-PP: shared expert (sigmoid-gated
+    dense SwiGLU) + raw-softmax top-k mass (norm_topk_prob=False) must
+    match the scanned model — the two paths call ONE shared_expert_ffn /
+    gshard_route, and this pins that they stay wired."""
+    cfg = dataclasses.replace(_moe_cfg(), shared_expert_size=96,
+                              norm_topk_prob=False)
+    model, params, tokens = _moe_params_and_tokens(cfg)
+    mesh = build_mesh(MeshConfig(pipe=2, expert=2, data=2), devices8)
+
+    ref = model.apply({"params": params}, tokens)
+    with mesh:
+        out, _ = jax.jit(lambda p, t: pipeline_forward(
+            cfg, p, t, mesh=mesh, num_microbatches=4))(params, tokens)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_pipeline_moe_grads_match_scanned(devices8):
     """Grads of CE + coef*aux through MoE-PP vs a reference with the same
     per-microbatch aux semantics (scanned model applied per microbatch)."""

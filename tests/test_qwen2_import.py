@@ -80,8 +80,10 @@ def test_qwen2_engine_decode_matches_torch(hf_qwen2_dir):
         eng.close()
 
 
-def test_qwen2_moe_refused(hf_qwen2_dir, tmp_path):
-    """Qwen2-MoE must be refused loudly, not imported as dense Qwen2."""
+def test_qwen2_moe_as_dense_qwen2_refused(hf_qwen2_dir, tmp_path):
+    """A config CLAIMING qwen2_moe over dense-Qwen2 tensors must fail
+    loudly in the MoE importer (missing expert tensors), never import as
+    dense Qwen2 silently."""
     import json
     import os
     import shutil
@@ -93,12 +95,112 @@ def test_qwen2_moe_refused(hf_qwen2_dir, tmp_path):
         cfgj = json.load(f)
     cfgj["architectures"] = ["Qwen2MoeForCausalLM"]
     cfgj["model_type"] = "qwen2_moe"
+    cfgj.update(num_experts=4, num_experts_per_tok=2,
+                moe_intermediate_size=48,
+                shared_expert_intermediate_size=128)
     with open(os.path.join(d, "config.json"), "w") as f:
         json.dump(cfgj, f)
     from kubeflow_tpu.models.hf_import import build_from_hf
 
-    with pytest.raises(ValueError, match="Qwen2-MoE"):
+    with pytest.raises(KeyError):
         build_from_hf(str(d))
+
+
+# ---------------------------------------------------------------------------
+# Qwen2-MoE (round 5: imported, no longer refused)
+# ---------------------------------------------------------------------------
+
+def _qwen2_moe_cfg(**kw):
+    base = dict(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        moe_intermediate_size=48, shared_expert_intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rope_theta=10000.0, rms_norm_eps=1e-5,
+        num_experts=4, num_experts_per_tok=2, norm_topk_prob=False,
+        decoder_sparse_step=1, mlp_only_layers=[],
+        attn_implementation="eager")
+    base.update(kw)
+    return transformers.Qwen2MoeConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def hf_qwen2_moe_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("hf_qwen2_moe")
+    torch.manual_seed(13)
+    model = transformers.Qwen2MoeForCausalLM(_qwen2_moe_cfg())
+    model.eval()
+    model.save_pretrained(d, safe_serialization=True)
+    return str(d), model
+
+
+@pytest.mark.parametrize("norm_topk", [False, True])
+def test_qwen2_moe_logits_match_torch(tmp_path_factory, norm_topk):
+    """Shared-expert sigmoid gate, QKV biases, raw-vs-renormalized top-k
+    mass, and the dropless GShard dispatch must all line up with torch —
+    for BOTH norm_topk_prob settings (the flag flips the combine
+    weights)."""
+    d = tmp_path_factory.mktemp(f"qmoe_{norm_topk}")
+    torch.manual_seed(13 + int(norm_topk))
+    tmodel = transformers.Qwen2MoeForCausalLM(
+        _qwen2_moe_cfg(norm_topk_prob=norm_topk))
+    tmodel.eval()
+    tmodel.save_pretrained(d, safe_serialization=True)
+    from kubeflow_tpu.models.hf_import import build_from_hf
+
+    module, cfg, params = build_from_hf(str(d), dtype=jnp.float32,
+                                        param_dtype=jnp.float32)
+    assert cfg.norm_topk_prob is norm_topk
+    assert cfg.shared_expert_size == 128 and cfg.intermediate_size == 48
+    assert cfg.attention_bias
+    rng = np.random.default_rng(2)
+    toks = rng.integers(0, cfg.vocab_size, (2, 12), dtype=np.int64)
+    with torch.no_grad():
+        ref = tmodel(torch.from_numpy(toks)).logits.numpy()
+    got = module.apply({"params": params}, jnp.asarray(toks, jnp.int32))
+    np.testing.assert_allclose(np.asarray(got), ref, atol=3e-3, rtol=2e-2)
+
+
+def test_qwen2_moe_engine_decode_matches_torch(hf_qwen2_moe_dir):
+    path, tmodel = hf_qwen2_moe_dir
+    from kubeflow_tpu.models.hf_import import build_from_hf
+    from kubeflow_tpu.serve.generation import GenerationEngine
+
+    module, cfg, params = build_from_hf(path, dtype=jnp.float32,
+                                        param_dtype=jnp.float32)
+    eng = GenerationEngine(module, params, cfg, slots=1, max_len=24,
+                           chunk=4, prefill_buckets=(4,))
+    try:
+        prompt = [5, 9, 2]
+        out = eng.submit(prompt, max_tokens=6, temperature=0.0)
+        with torch.no_grad():
+            ref = tmodel.generate(
+                torch.tensor([prompt]), max_new_tokens=6, do_sample=False,
+                pad_token_id=0).numpy()[0, len(prompt):]
+        assert list(out["output_ids"]) == list(ref)
+    finally:
+        eng.close()
+
+
+def test_qwen2_moe_heterogeneous_layouts_refused(hf_qwen2_moe_dir,
+                                                 tmp_path):
+    import json
+    import os
+    import shutil
+
+    path, _ = hf_qwen2_moe_dir
+    from kubeflow_tpu.models.hf_import import import_qwen2_moe
+
+    for field, value, match in ((("mlp_only_layers"), [1], "mlp_only"),
+                                (("decoder_sparse_step"), 2, "sparse")):
+        d = tmp_path / f"het_{field}"
+        shutil.copytree(path, d)
+        with open(os.path.join(d, "config.json")) as f:
+            cfgj = json.load(f)
+        cfgj[field] = value
+        with open(os.path.join(d, "config.json"), "w") as f:
+            json.dump(cfgj, f)
+        with pytest.raises(ValueError, match=match):
+            import_qwen2_moe(str(d))
 
 
 def test_qwen2_bias_pipeline_parity(devices8):
