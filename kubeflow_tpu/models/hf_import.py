@@ -490,6 +490,11 @@ def import_qwen2_moe(path: str, *, scan_layers: bool = True,
     if config_overrides:
         cfg = dataclasses.replace(cfg, **config_overrides)
     t = load_safetensors_dir(path)
+    if "model.layers.0.mlp.gate.weight" not in t:
+        raise ValueError(
+            f"config at {path!r} says qwen2_moe but the checkpoint has "
+            "no expert router tensors (model.layers.*.mlp.gate.weight) — "
+            "a dense-Qwen2 or truncated export mislabeled as MoE")
     L = cfg.num_layers
     p = "model.layers.{i}.mlp."
 

@@ -4,11 +4,23 @@ The reference runs âŸ¨kserve: python/kserve/kserve/storage â€” Storage.downloadâ
 as an initContainer pulling s3/gcs/pvc/http URIs to /mnt/models before the
 server starts (SURVEY.md Â§3.3). This environment has zero egress, so local
 schemes are real and remote schemes fail with a clear error instead of a
-silent stub.
+silent stub. DESCOPE NOTE (documented, not silent): the remote half of
+KServe's storage matrix â€” s3/gcs/http credentials, range requests, retry
+policy â€” is the piece this build cannot exercise at all; the `download()`
+signature and the archive/dir handling match the reference's contract so a
+networked executor can slot a real fetcher behind the same call.
+
+Integrity: `uri` may carry a digest fragment `#sha256=<hex>` (the OCI/
+KServe-style pinning). For file/pvc sources the materialized file is
+hashed and a mismatch fails loudly BEFORE anything is extracted â€” a
+corrupt or swapped model must never reach the server. Directories cannot
+be digest-pinned (no canonical serialization); passing a digest for a
+directory is an error rather than a silent skip.
 """
 
 from __future__ import annotations
 
+import hashlib
 import os
 import shutil
 import tarfile
@@ -18,9 +30,29 @@ LOCAL_SCHEMES = ("file://", "pvc://", "")
 REMOTE_SCHEMES = ("s3://", "gs://", "gcs://", "http://", "https://", "hdfs://")
 
 
+def _split_digest(uri: str) -> tuple[str, str | None]:
+    base, _, frag = uri.partition("#")
+    if not frag:
+        return uri, None
+    algo, _, hexd = frag.partition("=")
+    if algo != "sha256" or not hexd:
+        raise ValueError(
+            f"unsupported digest fragment {frag!r} (use #sha256=<hex>)")
+    return base, hexd.lower()
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
 def download(uri: str, dest: str) -> str:
     """Materializes `uri` under `dest`; returns the model directory path."""
     os.makedirs(dest, exist_ok=True)
+    uri, digest = _split_digest(uri)
     for scheme in REMOTE_SCHEMES:
         if uri.startswith(scheme):
             raise NotImplementedError(
@@ -35,7 +67,18 @@ def download(uri: str, dest: str) -> str:
     if not os.path.exists(path):
         raise FileNotFoundError(f"model uri {uri!r} -> {path!r} not found")
     if os.path.isdir(path):
+        if digest:
+            raise ValueError(
+                f"digest pinning needs a FILE source; {path!r} is a "
+                "directory (no canonical bytes to hash)")
         return path  # local dirs are served in place, no copy
+    if digest:
+        got = _sha256_file(path)
+        if got != digest:
+            raise ValueError(
+                f"model digest mismatch for {uri!r}: expected sha256 "
+                f"{digest}, file hashes {got} â€” refusing to serve a "
+                "corrupt/swapped model")
     if tarfile.is_tarfile(path):
         with tarfile.open(path) as tf:
             tf.extractall(dest, filter="data")

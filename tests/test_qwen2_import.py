@@ -102,7 +102,7 @@ def test_qwen2_moe_as_dense_qwen2_refused(hf_qwen2_dir, tmp_path):
         json.dump(cfgj, f)
     from kubeflow_tpu.models.hf_import import build_from_hf
 
-    with pytest.raises(KeyError):
+    with pytest.raises(ValueError, match="mislabeled"):
         build_from_hf(str(d))
 
 

@@ -1,0 +1,69 @@
+"""Storage initializer (serve/storage.py): local schemes, archive
+extraction, loud remote refusal, and sha256 digest pinning — the
+KServe storage-initializer contract minus network egress."""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import tarfile
+
+import pytest
+
+from kubeflow_tpu.serve import storage
+
+
+def test_local_dir_served_in_place(tmp_path):
+    src = tmp_path / "model"
+    src.mkdir()
+    (src / "w.bin").write_bytes(b"weights")
+    out = storage.download(str(src), str(tmp_path / "dest"))
+    assert out == str(src)  # no copy for local dirs
+
+
+def test_file_scheme_and_tar_extraction(tmp_path):
+    src = tmp_path / "model"
+    src.mkdir()
+    (src / "w.bin").write_bytes(b"weights")
+    tar = tmp_path / "model.tar"
+    with tarfile.open(tar, "w") as tf:
+        tf.add(src / "w.bin", arcname="w.bin")
+    dest = tmp_path / "dest"
+    out = storage.download(f"file://{tar}", str(dest))
+    assert out == str(dest)
+    assert (dest / "w.bin").read_bytes() == b"weights"
+
+
+def test_pvc_scheme_resolves_under_root(tmp_path, monkeypatch):
+    claim = tmp_path / "claims" / "models" / "m"
+    claim.mkdir(parents=True)
+    monkeypatch.setenv("TPK_PVC_ROOT", str(tmp_path / "claims"))
+    out = storage.download("pvc://models/m", str(tmp_path / "dest"))
+    assert out == str(claim)
+
+
+def test_remote_schemes_refused_loudly(tmp_path):
+    with pytest.raises(NotImplementedError, match="egress"):
+        storage.download("s3://bucket/model", str(tmp_path / "dest"))
+
+
+def test_digest_pinning(tmp_path):
+    blob = tmp_path / "m.bin"
+    blob.write_bytes(b"model bytes")
+    good = hashlib.sha256(b"model bytes").hexdigest()
+    dest = tmp_path / "dest"
+    out = storage.download(f"file://{blob}#sha256={good}", str(dest))
+    assert os.path.exists(os.path.join(out, "m.bin"))
+    # Mismatch fails BEFORE anything is materialized from the archive.
+    with pytest.raises(ValueError, match="digest mismatch"):
+        storage.download(f"file://{blob}#sha256={'0' * 64}",
+                         str(tmp_path / "dest2"))
+    # Directories have no canonical bytes — pinning one is an error,
+    # never a silent skip.
+    d = tmp_path / "dir"
+    d.mkdir()
+    with pytest.raises(ValueError, match="FILE source"):
+        storage.download(f"{d}#sha256={good}", str(tmp_path / "dest3"))
+    # Unknown digest algorithms refuse.
+    with pytest.raises(ValueError, match="sha256"):
+        storage.download(f"file://{blob}#md5=abc", str(tmp_path / "dest4"))
