@@ -39,7 +39,9 @@ FIT_CASES = ((1, 2048), (2, 2048), (4, 2048), (1, 4096), (2, 4096),
 
 
 def _build(batch: int, seq: int, loss_impl: str = "chunked",
-           size: str = "1b"):
+           size: str = "1b", loss_chunk: int = 1024,
+           remat_policy: str | None = None,
+           flash_block: tuple[int, int] | None = None):
     import dataclasses
 
     import jax
@@ -63,6 +65,11 @@ def _build(batch: int, seq: int, loss_impl: str = "chunked",
     # a real s-length deployment would run.
     cfg = dataclasses.replace(base, attention_impl="flash",
                               max_seq_len=max(seq, base.max_seq_len))
+    if remat_policy:
+        cfg = dataclasses.replace(cfg, remat_policy=remat_policy)
+    if flash_block:
+        cfg = dataclasses.replace(cfg, flash_block_q=flash_block[0],
+                                  flash_block_kv=flash_block[1])
     model = Llama(cfg)
     mesh = build_mesh(MeshConfig(data=1), jax.devices()[:1])
     tx = optax.adamw(3e-4, mu_dtype=jnp.bfloat16)
@@ -76,7 +83,7 @@ def _build(batch: int, seq: int, loss_impl: str = "chunked",
         "targets": jax.ShapeDtypeStruct((batch, seq), jnp.int32),
     }
     step = make_train_step(model, mesh, DEFAULT_RULES, loss_impl=loss_impl,
-                           loss_chunk=1024)
+                           loss_chunk=loss_chunk)
     return cfg, model, mesh, tx, step, state_args, batch_args
 
 
@@ -128,10 +135,14 @@ def analyze_fit_subprocess(batch: int, seq: int,
 
 
 def measure(batch: int, seq: int, timed_steps: int = 6,
-            loss_impl: str = "chunked", size: str = "1b") -> dict:
+            loss_impl: str = "chunked", size: str = "1b",
+            loss_chunk: int = 1024, remat_policy: str | None = None,
+            flash_block: tuple[int, int] | None = None) -> dict:
     """Measured tok/s + MFU at (batch, seq) on the live backend — the
     PROFILE.md §6 row. Pipelined timing, single fetch at the end (the
-    axon tunnel adds ~66 ms to every synchronous host fetch)."""
+    axon tunnel adds ~66 ms to every synchronous host fetch). The knob
+    kwargs (loss_chunk / remat_policy / flash_block) back the tuning
+    sweep (`tune_point`)."""
     import time
 
     import jax
@@ -142,7 +153,9 @@ def measure(batch: int, seq: int, timed_steps: int = 6,
     from kubeflow_tpu.train.metrics import peak_flops_per_chip
     from kubeflow_tpu.train.step import init_train_state
 
-    cfg, model, mesh, tx, step, _, _ = _build(batch, seq, loss_impl, size)
+    cfg, model, mesh, tx, step, _, _ = _build(
+        batch, seq, loss_impl, size, loss_chunk=loss_chunk,
+        remat_policy=remat_policy, flash_block=flash_block)
     tokens = jnp.zeros((batch, seq), jnp.int32)
     state = init_train_state(model, tx, jax.random.key(0), (tokens,), mesh,
                              DEFAULT_RULES)
@@ -170,8 +183,47 @@ def measure(batch: int, seq: int, timed_steps: int = 6,
         "batch": batch,
         "seq_len": seq,
         "loss_impl": loss_impl,
+        "loss_chunk": loss_chunk,
+        "remat_policy": remat_policy or cfg.remat_policy,
+        "flash_block": list(flash_block) if flash_block else
+        [cfg.flash_block_q, cfg.flash_block_kv],
         "tok_s": round(batch * seq / dt, 1),
         "mfu": round(mfu, 4),
         "avg_step_time_s": round(dt, 4),
         "device_kind": jax.devices()[0].device_kind,
     }
+
+
+#: The s3072 knob grid (PROFILE.md §4's levers): remat policy, CE chunk,
+#: flash block shape. Small by design — each variant pays a 20-40 s
+#: tunnel compile, and chip windows have been scarce.
+TUNE_VARIANTS = (
+    {},  # committed defaults: remat nothing, chunk 1024, blocks 512x512
+    {"remat_policy": "save_attn"},
+    {"loss_chunk": 512},
+    {"loss_chunk": 2048},
+    {"flash_block": (1024, 512)},
+    {"flash_block": (512, 1024)},
+)
+
+
+def tune_point(batch: int, seq: int, timed_steps: int = 4,
+               variants=TUNE_VARIANTS, size: str = "1b") -> list[dict]:
+    """Sweep the long-context knobs at one (batch, seq) on the live
+    chip; returns rows sorted best-MFU-first, failures recorded inline
+    (an OOM or compile crash is a data point, not an abort — the r4
+    s4096 helper crash must not kill the sweep)."""
+    rows = []
+    for kv in variants:
+        try:
+            rows.append(measure(batch, seq, timed_steps=timed_steps,
+                                size=size, **kv))
+        except Exception as e:  # noqa: BLE001 - recorded per variant
+            import re
+
+            msg = re.sub(r"\x1b\[[0-9;]*m", "", f"{type(e).__name__}: {e}")
+            rows.append({"batch": batch, "seq_len": seq, **kv,
+                         "error": " ".join(msg.split())[:200]})
+        print(f"longctx tune {kv}: {rows[-1].get('mfu', 'ERR')}",
+              file=sys.stderr, flush=True)
+    return sorted(rows, key=lambda r: -r.get("mfu", -1.0))

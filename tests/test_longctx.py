@@ -30,3 +30,19 @@ def test_measure_tiny_shape():
     assert 0 <= r["mfu"] < 10  # CPU nominal peak makes this loose
     assert r["avg_step_time_s"] > 0
     assert r["device_kind"] == jax.devices()[0].device_kind
+
+
+def test_tune_point_tiny_shape():
+    """The knob sweep (bench.py --longctx-tune) runs off-chip on the
+    tiny shape: every variant measured or its failure recorded inline,
+    best-MFU-first ordering, knob fields present."""
+    variants = ({}, {"remat_policy": "save_attn"}, {"loss_chunk": 32},
+                {"flash_block": (64, 32)})
+    rows = longctx.tune_point(2, 64, timed_steps=1, variants=variants,
+                              size="tiny")
+    assert len(rows) == len(variants)
+    ok = [r for r in rows if "mfu" in r]
+    assert ok, rows  # at least the default variant must measure
+    assert ok == sorted(ok, key=lambda r: -r["mfu"])
+    for r in ok:
+        assert {"remat_policy", "loss_chunk", "flash_block"} <= set(r)
