@@ -794,6 +794,14 @@ def t5_config_from_hf(hf: dict, **overrides: Any):
         tie_embeddings=bool(hf.get("tie_word_embeddings", True)),
         decoder_start_id=int(hf.get("decoder_start_token_id") or 0),
         eos_id=int(hf.get("eos_token_id") or 1),
+        # UMT5: every layer owns its relative-position bias table. The
+        # detection MUST mirror build_from_hf's dispatch (model_type OR
+        # architectures): an UMT5 arch with a stale model_type would
+        # otherwise import with block-0 bias sharing — no missing-tensor
+        # error to save us, just silently wrong generations.
+        per_layer_rel_bias=(
+            hf.get("model_type") == "umt5"
+            or "UMT5" in (hf.get("architectures") or [""])[0]),
     )
     fields.update(overrides)
     return T5Config(**fields)
@@ -833,25 +841,30 @@ def import_t5(path: str, **config_overrides: Any):
     def ln(name):
         return {"scale": t[name + ".weight"]}
 
+    def rel(stem):
+        return {"rel_embedding": t[
+            stem + ".SelfAttention.relative_attention_bias.weight"]}
+
     params: dict[str, Any] = {
         "shared_embedding": t["shared.weight"],
-        "enc_rel": {"rel_embedding": t[
-            "encoder.block.0.layer.0.SelfAttention"
-            ".relative_attention_bias.weight"]},
-        "dec_rel": {"rel_embedding": t[
-            "decoder.block.0.layer.0.SelfAttention"
-            ".relative_attention_bias.weight"]},
         "enc_final_ln": ln("encoder.final_layer_norm"),
         "dec_final_ln": ln("decoder.final_layer_norm"),
     }
+    if not cfg.per_layer_rel_bias:
+        params["enc_rel"] = rel("encoder.block.0.layer.0")
+        params["dec_rel"] = rel("decoder.block.0.layer.0")
     for i in range(cfg.num_layers):
         b = f"encoder.block.{i}.layer"
+        if cfg.per_layer_rel_bias:  # UMT5: each layer owns a table
+            params[f"enc_{i}_rel"] = rel(f"{b}.0")
         params[f"enc_{i}_attn"] = attn(f"{b}.0.SelfAttention")
         params[f"enc_{i}_attn_ln"] = ln(f"{b}.0.layer_norm")
         params[f"enc_{i}_ffn"] = ffn(f"{b}.1.DenseReluDense")
         params[f"enc_{i}_ffn_ln"] = ln(f"{b}.1.layer_norm")
     for i in range(cfg.num_decoder_layers):
         b = f"decoder.block.{i}.layer"
+        if cfg.per_layer_rel_bias:
+            params[f"dec_{i}_rel"] = rel(f"{b}.0")
         params[f"dec_{i}_self"] = attn(f"{b}.0.SelfAttention")
         params[f"dec_{i}_self_ln"] = ln(f"{b}.0.layer_norm")
         params[f"dec_{i}_cross"] = attn(f"{b}.1.EncDecAttention")
@@ -880,11 +893,13 @@ def build_from_hf(path: str, **overrides: Any):
 
         cfg, params = import_gpt2(path, **overrides)
         return GPT2(cfg), cfg, params
-    # Exact-match T5 dispatch: UMT5 shares these key names but uses
-    # PER-LAYER relative position biases — importing it as classic T5
-    # (block-0 bias shared) would serve silently wrong generations.
-    if (arch in ("T5ForConditionalGeneration", "MT5ForConditionalGeneration")
-            or hf.get("model_type") in ("t5", "mt5")):
+    # Exact-match T5 dispatch. UMT5 (round 5) rides the same importer:
+    # t5_config_from_hf flips per_layer_rel_bias so every layer owns its
+    # relative-position table instead of sharing block 0's.
+    if (arch in ("T5ForConditionalGeneration",
+                 "MT5ForConditionalGeneration",
+                 "UMT5ForConditionalGeneration")
+            or hf.get("model_type") in ("t5", "mt5", "umt5")):
         from kubeflow_tpu.models.t5 import T5
 
         cfg, params = import_t5(path, **overrides)
@@ -916,12 +931,11 @@ def build_from_hf(path: str, **overrides: Any):
         cfg, params = import_qwen2_moe(path, **overrides)
         return MoELlama(cfg), cfg, params
     if "T5" in arch or hf.get("model_type", "").endswith("t5"):
-        # Catches UMT5 (and future T5 variants) whether declared via
-        # architectures OR only via model_type — falling through to
-        # import_llama would crash with an opaque missing-tensor error.
+        # Catches future T5 variants whether declared via architectures
+        # OR only via model_type — falling through to import_llama would
+        # crash with an opaque missing-tensor error.
         raise ValueError(
-            f"unsupported T5-family architecture {arch!r} (classic "
-            "T5/MT5 only; UMT5's per-layer position biases are not "
-            "implemented)")
+            f"unsupported T5-family architecture {arch!r} "
+            "(T5/MT5/UMT5 are implemented)")
     cfg, params = import_llama(path, **overrides)
     return Llama(cfg), cfg, params

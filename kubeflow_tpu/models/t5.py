@@ -41,6 +41,9 @@ class T5Config:
     rel_max_distance: int = 128
     layer_norm_eps: float = 1e-6
     feed_forward_proj: str = "relu"   # "relu" (v1.0) | "gated-gelu" (v1.1)
+    # UMT5: EVERY layer owns its relative-position bias table (classic
+    # T5/MT5 share block 0's across the stack).
+    per_layer_rel_bias: bool = False
     tie_embeddings: bool = True
     decoder_start_id: int = 0
     eos_id: int = 1
@@ -209,8 +212,18 @@ class T5(nn.Module):
             (cfg.vocab_size, cfg.d_model), cfg.param_dtype)
         ln = partial(T5LayerNorm, eps=cfg.layer_norm_eps,
                      param_dtype=cfg.param_dtype)
-        self.enc_rel = RelPosBias(cfg, bidirectional=True, name="enc_rel")
-        self.dec_rel = RelPosBias(cfg, bidirectional=False, name="dec_rel")
+        if cfg.per_layer_rel_bias:  # UMT5: one table per layer
+            self.enc_rels = [RelPosBias(cfg, bidirectional=True,
+                                        name=f"enc_{i}_rel")
+                             for i in range(cfg.num_layers)]
+            self.dec_rels = [RelPosBias(cfg, bidirectional=False,
+                                        name=f"dec_{i}_rel")
+                             for i in range(cfg.num_decoder_layers)]
+        else:
+            self.enc_rel = RelPosBias(cfg, bidirectional=True,
+                                      name="enc_rel")
+            self.dec_rel = RelPosBias(cfg, bidirectional=False,
+                                      name="dec_rel")
         self.enc_attn = [T5Attention(cfg, name=f"enc_{i}_attn")
                          for i in range(cfg.num_layers)]
         self.enc_attn_ln = [ln(name=f"enc_{i}_attn_ln")
@@ -245,11 +258,14 @@ class T5(nn.Module):
             enc_mask = jnp.ones((b, s), jnp.bool_)
         x = self.shared[input_ids].astype(cfg.dtype)
         pos = jnp.arange(s)
-        bias = self.enc_rel(pos, pos)[None]          # [1, H, S, S]
+        bias = (None if cfg.per_layer_rel_bias
+                else self.enc_rel(pos, pos)[None])   # [1, H, S, S]
         mask = enc_mask[:, None, None, :]            # [B, 1, 1, S]
         for i in range(cfg.num_layers):
+            b_i = (self.enc_rels[i](pos, pos)[None]
+                   if cfg.per_layer_rel_bias else bias)
             h = self.enc_attn_ln[i](x)
-            x = x + self.enc_attn[i](h, h, mask, bias)
+            x = x + self.enc_attn[i](h, h, mask, b_i)
             x = x + self.enc_ffn[i](self.enc_ffn_ln[i](x))
         return self.enc_final_ln(x)
 
@@ -273,12 +289,15 @@ class T5(nn.Module):
         b, t = decoder_input_ids.shape
         x = self.shared[decoder_input_ids].astype(cfg.dtype)
         pos = jnp.arange(t)
-        bias = self.dec_rel(pos, pos)[None]
+        bias = (None if cfg.per_layer_rel_bias
+                else self.dec_rel(pos, pos)[None])
         causal = (pos[:, None] >= pos[None, :])[None, None]
         cross_mask = enc_mask[:, None, None, :]
         for i in range(cfg.num_decoder_layers):
+            b_i = (self.dec_rels[i](pos, pos)[None]
+                   if cfg.per_layer_rel_bias else bias)
             h = self.dec_self_ln[i](x)
-            x = x + self.dec_self[i](h, h, causal, bias)
+            x = x + self.dec_self[i](h, h, causal, b_i)
             x = x + self.dec_cross[i](self.dec_cross_ln[i](x), enc_out,
                                       cross_mask)
             x = x + self.dec_ffn[i](self.dec_ffn_ln[i](x))
@@ -305,10 +324,13 @@ class T5(nn.Module):
         x = self.shared[tok].astype(cfg.dtype)     # [B, 1, D]
         t_max = cache_k.shape[2]
         kv_pos = jnp.arange(t_max)
-        bias = self.dec_rel(pos[None], kv_pos)[None]   # [1, H, 1, T]
+        bias = (None if cfg.per_layer_rel_bias
+                else self.dec_rel(pos[None], kv_pos)[None])  # [1,H,1,T]
         self_mask = (kv_pos <= pos)[None, None, None, :]
         cross_mask = enc_mask[:, None, None, :]
         for i in range(cfg.num_decoder_layers):
+            b_i = (self.dec_rels[i](pos[None], kv_pos)[None]
+                   if cfg.per_layer_rel_bias else bias)
             attn = self.dec_self[i]
             h = self.dec_self_ln[i](x)
             q, k1, v1 = attn.q(h), attn.k(h), attn.v(h)
@@ -318,7 +340,7 @@ class T5(nn.Module):
                 cache_v, v1[None].astype(cache_v.dtype), (i, 0, pos, 0, 0))
             x = x + attn.finish(q, cache_k[i].astype(cfg.dtype),
                                 cache_v[i].astype(cfg.dtype),
-                                self_mask, bias)
+                                self_mask, b_i)
             cattn = self.dec_cross[i]
             cq = cattn.q(self.dec_cross_ln[i](x))
             ckk, cvv = cross[i]

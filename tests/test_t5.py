@@ -169,10 +169,10 @@ def test_text2text_serving_runtime(tmp_path):
         model.generate({"input_ids": []})
 
 
-def test_umt5_refused(tmp_path):
-    """UMT5 shares T5's key names but uses per-layer position biases —
-    it must refuse, not import as classic T5 with silently wrong bias
-    sharing."""
+def test_classic_t5_mislabeled_umt5_fails_loudly(tmp_path):
+    """A classic-T5 checkpoint whose config CLAIMS umt5 must fail on the
+    missing per-layer bias tensors, never import with silently wrong
+    bias sharing."""
     torch.manual_seed(13)
     path = _save(transformers.T5ForConditionalGeneration(_t5_cfg()),
                  tmp_path)
@@ -183,5 +183,63 @@ def test_umt5_refused(tmp_path):
 
     from kubeflow_tpu.models.hf_import import build_from_hf
 
-    with pytest.raises(ValueError, match="UMT5"):
+    with pytest.raises(KeyError, match="relative_attention_bias"):
         build_from_hf(path)
+
+
+# ---------------------------------------------------------------------------
+# UMT5 (round 5: imported, no longer refused)
+# ---------------------------------------------------------------------------
+
+def _umt5_cfg(**kw):
+    base = dict(vocab_size=64, d_model=32, d_kv=8, d_ff=64,
+                num_layers=2, num_decoder_layers=2, num_heads=4,
+                relative_attention_num_buckets=8,
+                relative_attention_max_distance=16,
+                feed_forward_proj="gated-gelu", tie_word_embeddings=False,
+                decoder_start_token_id=0, eos_token_id=1)
+    base.update(kw)
+    return transformers.UMT5Config(**base)
+
+
+def test_umt5_logits_and_greedy_match_torch(tmp_path):
+    """UMT5 = T5 v1.1 with a relative-position table PER LAYER
+    (per_layer_rel_bias): teacher-forced logits AND the one-program
+    greedy decode must match torch — and the per-layer tables must be
+    load-bearing (averaging them into one shared table must diverge)."""
+    torch.manual_seed(23)
+    tmodel = transformers.UMT5ForConditionalGeneration(_umt5_cfg())
+    path = _save(tmodel, tmp_path)
+    from kubeflow_tpu.models.hf_import import build_from_hf
+    from kubeflow_tpu.models.t5 import greedy_generate
+
+    module, cfg, params = build_from_hf(path, dtype=jnp.float32,
+                                        param_dtype=jnp.float32)
+    assert cfg.per_layer_rel_bias
+    assert "enc_1_rel" in params and "dec_1_rel" in params
+    enc, dec, mask = _inputs(3)
+    with torch.no_grad():
+        ref = tmodel(input_ids=torch.from_numpy(enc),
+                     decoder_input_ids=torch.from_numpy(dec)).logits.numpy()
+    got = module.apply({"params": params}, jnp.asarray(enc, jnp.int32),
+                       jnp.asarray(dec, jnp.int32))
+    np.testing.assert_allclose(np.asarray(got), ref, atol=3e-3, rtol=2e-2)
+
+    # Per-layer tables must matter: swap layer-1's tables for layer-0's
+    # and the logits must change, or this proves nothing over shared-T5.
+    swapped = dict(params)
+    swapped["enc_1_rel"] = params["enc_0_rel"]
+    swapped["dec_1_rel"] = params["dec_0_rel"]
+    got_sw = module.apply({"params": swapped}, jnp.asarray(enc, jnp.int32),
+                          jnp.asarray(dec, jnp.int32))
+    assert not np.allclose(np.asarray(got_sw), ref, atol=3e-3, rtol=2e-2)
+
+    toks, nvalid = greedy_generate(module, params,
+                                   jnp.asarray(enc, jnp.int32),
+                                   max_tokens=8)
+    with torch.no_grad():
+        r = tmodel.generate(torch.from_numpy(enc), max_new_tokens=8,
+                            do_sample=False).numpy()
+    for i in range(enc.shape[0]):
+        ours = [int(t) for t in np.asarray(toks)[i][:int(nvalid[i])]]
+        assert ours == [int(t) for t in r[i][1:1 + len(ours)]]
