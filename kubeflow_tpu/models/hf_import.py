@@ -179,9 +179,18 @@ def _llama_family_params(t: dict, cfg, scan_layers: bool,
         "mlp": mlp,
     }
     if extra_layers:
-        # Family-specific per-layer subtrees (Gemma-2 sandwich norms) —
-        # leaves already stacked over L like everything above.
-        layers.update(extra_layers)
+        # Family-specific per-layer subtrees (Gemma-2 sandwich norms,
+        # Gemma-3 qk-norms) — leaves already stacked over L like
+        # everything above. One-level-nested keys merge INTO the
+        # existing subtree (e.g. {"attn": {"q_norm": ...}}), so extras
+        # can extend the attention block without a bespoke copy of this
+        # function's layout/cast handling.
+        for k, v in extra_layers.items():
+            if k in layers and isinstance(v, dict) \
+                    and isinstance(layers[k], dict):
+                layers[k].update(v)
+            else:
+                layers[k] = v
     params: dict[str, Any] = {
         "embed": t["model.embed_tokens.weight"],
         "final_norm": {"scale": t["model.norm.weight"]},
@@ -370,6 +379,111 @@ def import_gemma2(path: str, *, scan_layers: bool = True,
             t, p + "pre_feedforward_layernorm.weight", L, lambda w: w)},
         "mlp_out_norm": {"scale": _stack(
             t, p + "post_feedforward_layernorm.weight", L, lambda w: w)},
+    }
+    return cfg, _llama_family_params(t, cfg, scan_layers,
+                                     _swiglu_mlp(t, cfg.num_layers),
+                                     extra_layers=extra)
+
+
+def import_gemma3(path: str, *, scan_layers: bool = True,
+                  **config_overrides: Any):
+    """HF Gemma-3 TEXT checkpoint dir → (LlamaConfig, flax params).
+
+    On top of Gemma-2's sandwich norms / (1+w) norms / embed scale /
+    GeGLU / query_pre_attn scale (soft-caps are GONE in v3), Gemma-3
+    adds — all config flags on the shared trunk:
+
+      * QK-norm: per-head (1+w) RMSNorm on projected q/k before RoPE
+        (`qk_norm`; HF self_attn.q_norm/k_norm);
+      * 5:1 local/global interleave (HF layer_types: every 6th layer
+        full attention) — `sliding_pattern="5to1"`;
+      * DUAL rope bases: sliding layers use `rope_local_base_freq`,
+        full layers `rope_theta` with optional LINEAR scaling
+        (`rope_global_scaling_factor`), selected per layer by the same
+        traced flag as the mask.
+
+    Multimodal Gemma-3 (`Gemma3ForConditionalGeneration`, a vision tower
+    + text model) is refused — this imports the text stack only.
+    Serving follows Gemma-2's gate: exact within the window (causal
+    rebuild keeps qk-norm/rope flags), refused past it."""
+    hf = read_hf_config(path)
+    arch = (hf.get("architectures") or [""])[0]
+    if "ConditionalGeneration" in arch or hf.get("vision_config"):
+        raise ValueError(
+            f"{arch or hf.get('model_type')!r} is multimodal Gemma-3 "
+            "(vision tower + text); only text checkpoints "
+            "(Gemma3ForCausalLM / gemma3_text) are supported")
+    if not (arch in ("", "Gemma3ForCausalLM", "Gemma3TextModel")
+            or hf.get("model_type") in ("gemma3", "gemma3_text")):
+        raise ValueError(f"import_gemma3 cannot load architecture {arch!r}")
+    act = (hf.get("hidden_activation") or hf.get("hidden_act")
+           or "gelu_pytorch_tanh")
+    if act not in ("gelu_pytorch_tanh", "gelu"):
+        raise ValueError(f"unsupported Gemma-3 activation {act!r}")
+    lt = hf.get("layer_types")
+    if lt is not None:
+        want = ["full_attention" if (i + 1) % 6 == 0 else "sliding_attention"
+                for i in range(hf["num_hidden_layers"])]
+        if list(lt) != want:
+            raise ValueError(
+                "unsupported Gemma-3 layer_types pattern (expected 5 "
+                "sliding : 1 full, full at every 6th layer)")
+    elif int(hf.get("sliding_window_pattern", 6)) != 6:
+        # Release-era configs carry sliding_window_pattern instead of
+        # layer_types; anything but the canonical 6 (= 5 sliding : 1
+        # full) would place the full layers at wrong indices — silently
+        # wrong logits, so refuse.
+        raise ValueError(
+            f"unsupported sliding_window_pattern "
+            f"{hf['sliding_window_pattern']} (only the 5:1 interleave "
+            "is implemented)")
+    scaling = hf.get("rope_scaling")
+    linear_factor = 1.0
+    if scaling:
+        rtype = scaling.get("rope_type") or scaling.get("type")
+        if rtype != "linear":
+            raise ValueError(
+                f"unsupported Gemma-3 rope_scaling type {rtype!r} "
+                "(global layers use 'linear')")
+        linear_factor = float(scaling.get("factor", 1.0))
+    fields = dict(
+        scan_layers=scan_layers, norm_plus_one=True, embed_scale=True,
+        mlp_act="gelu_tanh", sandwich_norms=True, qk_norm=True,
+        query_pre_attn_scalar=float(hf.get("query_pre_attn_scalar") or 0.0),
+        rope_theta_local=float(hf.get("rope_local_base_freq", 10000.0)),
+        rope_global_scaling_factor=linear_factor,
+        tie_embeddings=bool(hf.get("tie_word_embeddings", True)),
+        attention_impl="naive")
+    fields.update(config_overrides)
+    # llama_config_from_hf reads hf["rope_scaling"] with llama3-type
+    # semantics — Gemma-3's linear scaling is handled above, so shadow it.
+    hf = dict(hf, rope_scaling=None)
+    cfg = llama_config_from_hf(hf, **fields)
+    if cfg.mask_kind == "sliding_window" \
+            and "sliding_pattern" not in config_overrides:
+        cfg = dataclasses.replace(cfg, sliding_pattern="5to1",
+                                  attention_impl="naive")
+    if not cfg.tie_embeddings:
+        raise ValueError(
+            "Gemma-3 checkpoints tie embeddings; tie_word_embeddings="
+            "false is not a Gemma-3 layout")
+    t = load_safetensors_dir(path)
+    L = cfg.num_layers
+    p = "model.layers.{i}."
+    extra = {
+        "attn_out_norm": {"scale": _stack(
+            t, p + "post_attention_layernorm.weight", L, lambda w: w)},
+        "post_attn_norm": {"scale": _stack(
+            t, p + "pre_feedforward_layernorm.weight", L, lambda w: w)},
+        "mlp_out_norm": {"scale": _stack(
+            t, p + "post_feedforward_layernorm.weight", L, lambda w: w)},
+        # QK-norm scales live inside the attention subtree ([L, D_head]).
+        "attn": {
+            "q_norm": {"scale": _stack(
+                t, p + "self_attn.q_norm.weight", L, lambda w: w)},
+            "k_norm": {"scale": _stack(
+                t, p + "self_attn.k_norm.weight", L, lambda w: w)},
+        },
     }
     return cfg, _llama_family_params(t, cfg, scan_layers,
                                      _swiglu_mlp(t, cfg.num_layers),
@@ -910,12 +1024,8 @@ def build_from_hf(path: str, **overrides: Any):
         cfg, params = import_mixtral(path, **overrides)
         return MoELlama(cfg), cfg, params
     if "Gemma3" in arch or hf.get("model_type") in ("gemma3", "gemma3_text"):
-        # Gemma-3 (interleaved 5:1 local/global, QK-norm) is not
-        # implemented — refuse before any Gemma branch can accept it.
-        raise ValueError(
-            f"unsupported architecture {arch!r} (Gemma v1/v2 are "
-            "implemented; Gemma-3's QK-norm and 5:1 local/global "
-            "interleave are not)")
+        cfg, params = import_gemma3(path, **overrides)
+        return Llama(cfg), cfg, params
     if arch == "Gemma2ForCausalLM" or hf.get("model_type") == "gemma2":
         cfg, params = import_gemma2(path, **overrides)
         return Llama(cfg), cfg, params

@@ -70,11 +70,19 @@ class LlamaConfig:
     attn_softcap: float = 0.0    # 0 = off
     final_softcap: float = 0.0   # 0 = off
     query_pre_attn_scalar: float = 0.0  # 0 = use head_dim
-    # Which layers the sliding_window mask applies to: "all" (Mistral) or
-    # "even" (Gemma-2: layers 0,2,4,... sliding, odd layers full causal —
-    # HF layer_types). "even" threads a per-layer traced flag through the
-    # scanned trunk, so it runs on the einsum attention path only.
+    # Which layers the sliding_window mask applies to: "all" (Mistral),
+    # "even" (Gemma-2: layers 0,2,4,... sliding), or "5to1" (Gemma-3:
+    # every 6th layer full, the rest sliding — HF layer_types). Non-"all"
+    # patterns thread a per-layer traced flag through the scanned trunk,
+    # so they run on the einsum attention path only.
     sliding_pattern: str = "all"
+    # Gemma-3 conventions (import_gemma3): RMSNorm ((1+w), fp32) on the
+    # projected q/k heads before RoPE; TWO rope bases — sliding layers
+    # use rope_theta_local (0 = single-table models), full layers use
+    # rope_theta with an optional LINEAR position scaling.
+    qk_norm: bool = False
+    rope_theta_local: float = 0.0
+    rope_global_scaling_factor: float = 1.0
     # LoRA fine-tuning (the reference SDK's PEFT LoraConfig): rank 0 = off.
     # Adapters add (x @ A) @ B * alpha/rank to the target projections —
     # q/v (PEFT's Llama default) for "attn", plus gate/up/down for
@@ -174,6 +182,14 @@ class RMSNorm(nn.Module):
 def rope_table(head_dim: int, max_len: int, theta: float,
                cfg: "LlamaConfig | None" = None) -> tuple[jax.Array, jax.Array]:
     inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    if cfg is not None and getattr(cfg, "rope_global_scaling_factor",
+                                   1.0) != 1.0:
+        # HF "linear" rope scaling: positions divided by the factor —
+        # identically, frequencies divided. Read from cfg so EVERY
+        # cfg-passing call site (scanned trunk, pipeline stage) scales
+        # identically; Gemma-3's LOCAL table passes cfg=None and stays
+        # unscaled (HF scales the global rope only).
+        inv = inv / cfg.rope_global_scaling_factor
     if cfg is not None and cfg.rope_scaling_factor != 1.0:
         # Llama-3.1 "llama3" rope scaling: leave high-frequency components
         # alone, divide low-frequency ones by `factor`, and interpolate
@@ -288,7 +304,8 @@ class Attention(nn.Module):
                  attend_full_cache: bool = False,
                  adapter: dict | None = None,
                  adapter_ids: jax.Array | None = None,
-                 sliding: jax.Array | None = None):
+                 sliding: jax.Array | None = None,
+                 rope_local: tuple | None = None):
         cfg = self.cfg
         dense = partial(
             nn.DenseGeneral, use_bias=False, dtype=cfg.dtype,
@@ -328,6 +345,13 @@ class Attention(nn.Module):
                                       (cfg.num_heads, cfg.head_dim))
             v = v + _multi_lora_delta(x, adapter_ids, adapter["v_proj"],
                                       (cfg.num_kv_heads, cfg.head_dim))
+        if cfg.qk_norm:
+            # Gemma-3: per-head RMSNorm on q/k BEFORE the score scale and
+            # RoPE (the norm would erase a pre-applied scalar).
+            q = RMSNorm(cfg.rms_eps, cfg.dtype, cfg.norm_plus_one,
+                        name="q_norm")(q)
+            k = RMSNorm(cfg.rms_eps, cfg.dtype, cfg.norm_plus_one,
+                        name="k_norm")(k)
         if cfg.query_pre_attn_scalar:
             # Gemma-2 scales scores by query_pre_attn_scalar^-0.5; every
             # attention impl here divides by sqrt(head_dim), so fold the
@@ -336,6 +360,12 @@ class Attention(nn.Module):
             q = q * jnp.asarray(
                 (cfg.head_dim ** 0.5) / (cfg.query_pre_attn_scalar ** 0.5),
                 q.dtype)
+        if rope_local is not None and sliding is not None:
+            # Gemma-3 dual rope bases: this layer's table picked by the
+            # traced sliding flag (local base on sliding layers, global —
+            # possibly linear-scaled — on full layers).
+            cos = jnp.where(sliding, rope_local[0], cos)
+            sin = jnp.where(sliding, rope_local[1], sin)
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
         q = nn.with_logical_constraint(q, ("batch", "act_seq", "act_heads", "act_kv"))
@@ -399,11 +429,15 @@ class Attention(nn.Module):
             # tokens, so attention over just k/v is exact — the fast flash
             # path below serves it; the cache write above is the only extra.
 
-        if cfg.attn_softcap or sliding is not None:
+        if cfg.attn_softcap or (sliding is not None
+                                and mask_spec is not None):
             # Gemma-2's tanh score cap / per-layer traced window flag are
             # not implemented in the fused kernels — the einsum path is
             # the only correct impl; silently running flash would serve
-            # wrong logits.
+            # wrong logits. NB `sliding` alone doesn't force this path:
+            # after the serving engine's within-window causal rebuild the
+            # flags stay alive for Gemma-3's dual rope selection, and
+            # with the mask gone flash prefill is exact again.
             if cfg.attention_impl not in ("auto", "naive"):
                 raise ValueError(
                     f"attn_softcap / alternating sliding layers need "
@@ -596,7 +630,8 @@ class DecoderLayer(nn.Module):
     def __call__(self, x, cos, sin, positions, ring_axis=None,
                  standard_positions=True, cache=None, cache_index=None,
                  segment_ids=None, attend_full_cache=False,
-                 adapter=None, adapter_ids=None, sliding=None):
+                 adapter=None, adapter_ids=None, sliding=None,
+                 rope_local=None):
         cfg = self.cfg
         attn_ad = None
         mlp_ad = None
@@ -611,7 +646,8 @@ class DecoderLayer(nn.Module):
         attn_out, new_cache = Attention(cfg, name="attn")(
             h, cos, sin, positions, ring_axis, standard_positions, cache,
             cache_index, segment_ids, attend_full_cache,
-            adapter=attn_ad, adapter_ids=adapter_ids, sliding=sliding)
+            adapter=attn_ad, adapter_ids=adapter_ids, sliding=sliding,
+            rope_local=rope_local)
         if cfg.sandwich_norms:
             # Gemma-2: norm the attention OUTPUT before the residual add
             # (HF post_attention_layernorm).
@@ -694,13 +730,27 @@ class Llama(nn.Module):
         x = nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
         cos, sin = rope_table(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta,
                               cfg)
+        # Per-layer kind flags (HF layer_types): needed by the alternating
+        # MASK (while the config still carries it — the serving engine's
+        # within-window rebuild drops the mask) AND by Gemma-3's dual
+        # rope bases (which survive the rebuild, so the flags must not
+        # depend on the mask being present).
         sliding = None
-        if (cfg.mask_kind == "sliding_window"
-                and cfg.sliding_pattern == "even"):
-            # Gemma-2 alternation (HF layer_types): even layers sliding,
-            # odd layers full causal — a traced per-layer flag riding the
-            # scan, so one compiled trunk serves both layer kinds.
-            sliding = (jnp.arange(cfg.num_layers) % 2) == 0
+        if cfg.sliding_pattern != "all" and (
+                cfg.mask_kind == "sliding_window" or cfg.rope_theta_local):
+            idx = jnp.arange(cfg.num_layers)
+            if cfg.sliding_pattern == "even":
+                sliding = idx % 2 == 0       # Gemma-2
+            elif cfg.sliding_pattern == "5to1":
+                sliding = (idx + 1) % 6 != 0  # Gemma-3: every 6th full
+            else:
+                raise ValueError(
+                    f"sliding_pattern {cfg.sliding_pattern!r}: "
+                    "all | even | 5to1")
+        rope_local = None
+        if cfg.rope_theta_local:
+            rope_local = rope_table(cfg.head_dim, cfg.max_seq_len,
+                                    cfg.rope_theta_local)
 
         layer_cls = DecoderLayer
         if cfg.remat:
@@ -735,7 +785,8 @@ class Llama(nn.Module):
                 lambda mdl, carry, layer_cache, ad, sl: mdl(
                     carry, cos, sin, positions, ring_axis,
                     standard_positions, layer_cache, cache_index,
-                    segment_ids, attend_full_cache, ad, adapter_ids, sl),
+                    segment_ids, attend_full_cache, ad, adapter_ids, sl,
+                    rope_local),
                 variable_axes={"params": 0, "aux_loss": 0},
                 split_rngs={"params": True},
                 length=cfg.num_layers,
@@ -753,7 +804,7 @@ class Llama(nn.Module):
                     x, cos, sin, positions, ring_axis, standard_positions,
                     layer_cache, cache_index, segment_ids,
                     attend_full_cache, layer_ad, adapter_ids,
-                    None if sliding is None else sliding[i])
+                    None if sliding is None else sliding[i], rope_local)
                 layer_caches.append(lc)
             if cache is not None:
                 new_cache = jax.tree.map(

@@ -259,6 +259,20 @@ def pipeline_forward(
         raise ValueError(
             f"num_layers {cfg.num_layers} not divisible by pipe "
             f"({mesh.shape['pipe']}) * chunks ({num_chunks})")
+    if (getattr(cfg, "sliding_pattern", "all") != "all"
+            or getattr(cfg, "qk_norm", False)
+            or getattr(cfg, "rope_theta_local", 0)
+            or getattr(cfg, "attn_softcap", 0)):
+        # The stage body applies ONE attention recipe to every layer it
+        # scans — per-layer kinds (Gemma-2/3 alternating windows, dual
+        # rope bases) and the softcap/qk-norm score transforms would be
+        # silently wrong, not slow. Train those families on the scanned
+        # model.
+        raise ValueError(
+            "pipeline parallelism doesn't implement per-layer attention "
+            "kinds or Gemma-2/3 score transforms (alternating windows / "
+            "dual rope bases / qk_norm / softcap) — use the scanned "
+            "model")
     attn_impl = _resolve_attn(cfg)
     ring = None
     if seq_axis is not None and mesh.shape[seq_axis] > 1:

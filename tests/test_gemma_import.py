@@ -195,21 +195,124 @@ def test_gemma2_serving_past_window_refused(hf_gemma2_dir):
                          chunk=4, prefill_buckets=(4,))
 
 
-def test_gemma3_still_refused(hf_gemma2_dir, tmp_path):
+# ---------------------------------------------------------------------------
+# Gemma-3 (round 5: imported, no longer refused)
+# ---------------------------------------------------------------------------
+
+def _gemma3_cfg(**kw):
+    base = dict(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=12, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=96, rope_theta=1000000.0,
+        rope_local_base_freq=10000.0, rms_norm_eps=1e-5, sliding_window=8,
+        query_pre_attn_scalar=24.0,
+        rope_scaling={"rope_type": "linear", "factor": 2.0},
+        attn_implementation="eager")
+    base.update(kw)
+    return transformers.Gemma3TextConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def hf_gemma3_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("hf_gemma3")
+    torch.manual_seed(31)
+    model = transformers.Gemma3ForCausalLM(_gemma3_cfg())
+    model.eval()
+    model.save_pretrained(d, safe_serialization=True)
+    return str(d), model
+
+
+def test_gemma3_logits_match_torch(hf_gemma3_dir):
+    """12 layers (2 full at indices 5/11), seq 16 > window 8: QK-norm,
+    the 5:1 interleave, AND the dual rope bases (local 1e4 on sliding
+    layers, linear-scaled 1e6 on full layers) must all be right for
+    agreement — and single-base rope must DISAGREE, or the dual-base
+    path proves nothing."""
+    path, tmodel = hf_gemma3_dir
+    from kubeflow_tpu.models.hf_import import build_from_hf
+
+    module, cfg, params = build_from_hf(path, dtype=jnp.float32,
+                                        param_dtype=jnp.float32)
+    assert cfg.sliding_pattern == "5to1" and cfg.qk_norm
+    assert cfg.rope_theta_local == 10000.0
+    assert cfg.rope_global_scaling_factor == 2.0
+    assert cfg.attn_softcap == 0.0  # v3 dropped the caps
+    rng = np.random.default_rng(2)
+    toks = rng.integers(0, cfg.vocab_size, (2, 16), dtype=np.int64)
+    with torch.no_grad():
+        ref = tmodel(torch.from_numpy(toks)).logits.numpy()
+    got = module.apply({"params": params}, jnp.asarray(toks, jnp.int32))
+    np.testing.assert_allclose(np.asarray(got), ref, atol=3e-3, rtol=2e-2)
+    import dataclasses
+
+    from kubeflow_tpu.models.llama import Llama
+
+    single = Llama(dataclasses.replace(cfg, rope_theta_local=0.0))
+    gs = single.apply({"params": params}, jnp.asarray(toks, jnp.int32))
+    assert not np.allclose(np.asarray(gs), ref, atol=3e-3, rtol=2e-2)
+
+
+def test_gemma3_engine_decode_matches_torch(hf_gemma3_dir):
+    """Within the window the causal rebuild keeps qk-norm and the dual
+    rope flags — greedy decode token-identical to torch; past the window
+    the alternating pattern refuses (full layers can't roll)."""
+    path, tmodel = hf_gemma3_dir
+    from kubeflow_tpu.models.hf_import import build_from_hf
+    from kubeflow_tpu.serve.generation import GenerationEngine
+
+    module, cfg, params = build_from_hf(path, dtype=jnp.float32,
+                                        param_dtype=jnp.float32)
+    eng = GenerationEngine(module, params, cfg, slots=1, max_len=8,
+                           chunk=4, prefill_buckets=(4,))
+    try:
+        prompt = [5, 9, 2]
+        out = eng.submit(prompt, max_tokens=5, temperature=0.0)
+        with torch.no_grad():
+            ref = tmodel.generate(
+                torch.tensor([prompt]), max_new_tokens=5, do_sample=False,
+                pad_token_id=0).numpy()[0, len(prompt):]
+        assert list(out["output_ids"]) == list(ref)
+    finally:
+        eng.close()
+    with pytest.raises(ValueError, match="full-attention layers"):
+        GenerationEngine(module, params, cfg, slots=1, max_len=32,
+                         chunk=4, prefill_buckets=(4,))
+
+
+def test_gemma3_multimodal_refused(hf_gemma3_dir, tmp_path):
     import json
     import os
     import shutil
 
-    path, _ = hf_gemma2_dir
-    d = tmp_path / "gemma3"
+    path, _ = hf_gemma3_dir
+    d = tmp_path / "gemma3mm"
     shutil.copytree(path, d)
     with open(os.path.join(d, "config.json")) as f:
         cfgj = json.load(f)
-    cfgj["architectures"] = ["Gemma3ForCausalLM"]
-    cfgj["model_type"] = "gemma3"
+    cfgj["architectures"] = ["Gemma3ForConditionalGeneration"]
     with open(os.path.join(d, "config.json"), "w") as f:
         json.dump(cfgj, f)
     from kubeflow_tpu.models.hf_import import build_from_hf
 
-    with pytest.raises(ValueError, match="Gemma-3"):
+    with pytest.raises(ValueError, match="multimodal"):
         build_from_hf(str(d))
+
+
+def test_gemma3_pipeline_refused(hf_gemma3_dir, devices8):
+    """The PP stage applies one attention recipe per scan — per-layer
+    kinds must refuse loudly, never run the window on every layer."""
+    path, _ = hf_gemma3_dir
+    import jax
+    import jax.numpy as jnp_  # noqa: F401
+
+    from kubeflow_tpu.models.hf_import import build_from_hf
+    from kubeflow_tpu.models.llama_pp import pipeline_forward
+    from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    module, cfg, params = build_from_hf(path, dtype=jnp.float32,
+                                        param_dtype=jnp.float32)
+    mesh = build_mesh(MeshConfig(pipe=2, data=4), devices8)
+    with pytest.raises(ValueError, match="per-layer attention"):
+        with mesh:
+            pipeline_forward(cfg, params, jnp.zeros((4, 16), jnp.int32),
+                             mesh=mesh, num_microbatches=2)
