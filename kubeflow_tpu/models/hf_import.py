@@ -324,8 +324,9 @@ def import_gemma2(path: str, *, scan_layers: bool = True,
         softcapped/alternating score transform).
 
     Serving: within the window the engine rebuilds causal (exact);
-    max_len > window is refused — the full-attention layers need the
-    whole history, so the Mistral rolling cache doesn't apply."""
+    PAST the window the cache stays full-length (the full-attention
+    layers need all history — nothing rolls) and sliding layers band
+    their decode reads per the traced flag (round 5)."""
     hf = read_hf_config(path)
     arch = (hf.get("architectures") or [""])[0]
     if hf.get("model_type") in ("gemma3", "gemma3_text") or "Gemma3" in arch:
@@ -404,8 +405,8 @@ def import_gemma3(path: str, *, scan_layers: bool = True,
 
     Multimodal Gemma-3 (`Gemma3ForConditionalGeneration`, a vision tower
     + text model) is refused — this imports the text stack only.
-    Serving follows Gemma-2's gate: exact within the window (causal
-    rebuild keeps qk-norm/rope flags), refused past it."""
+    Serving follows Gemma-2's shape: causal rebuild within the window,
+    full-length cache with per-layer banded reads past it (round 5)."""
     hf = read_hf_config(path)
     arch = (hf.get("architectures") or [""])[0]
     if "ConditionalGeneration" in arch or hf.get("vision_config"):

@@ -247,10 +247,10 @@ def init_cache(cfg: LlamaConfig, batch: int, max_len: int | None = None,
     if (getattr(cfg, "mask_kind", "causal") == "sliding_window"
             and 0 < window < t
             and getattr(cfg, "sliding_pattern", "all") == "all"):
-        # Pattern "even" (Gemma-2) has FULL-attention layers that need
-        # the whole history — a window-rows rolling cache would drop
-        # rows those layers must read, so it stays on the plain layout
-        # (the serving engine refuses max_len > window for it).
+        # Alternating patterns (Gemma-2/3) have FULL-attention layers
+        # that need the whole history — nothing rolls; they serve past
+        # the window on the plain full-length layout with per-layer
+        # banded decode reads (Attention's decode branch).
         t = window
         cache["pos"] = jnp.full((cfg.num_layers, batch, t),
                                 -(window + 1), jnp.int32)
@@ -401,7 +401,9 @@ class Attention(nn.Module):
             new_cache = _update_cache_rolling(cache, k, v, positions,
                                               cache_index, window)
             return o_proj(out), new_cache
-        if mask_spec is not None and cache is not None:
+        if (mask_spec is not None and cache is not None
+                and not (mask_spec.kind == "sliding_window"
+                         and sliding is not None)):
             raise ValueError(
                 "attention mask specs don't compose with KV-cache decode "
                 "(v1): serve masked models with full-forward predict "
@@ -419,11 +421,18 @@ class Attention(nn.Module):
                 # causality and the not-yet-written tail (incl. stale
                 # entries from a previous slot occupant) are both masked
                 # by absolute positions (positions_kv > positions_q).
+                # Alternating-window models (Gemma-2/3 past the window)
+                # keep the FULL-length cache — the full-attention layers
+                # need all history, so there is nothing to roll — and
+                # the sliding layers band their reads per the traced
+                # flag, exactly as in the full forward.
                 t = ck.shape[1]
                 out = naive_attention(
                     q, ck, cv, causal=True, positions_q=positions,
                     positions_kv=jnp.broadcast_to(jnp.arange(t), (ck.shape[0], t)),
-                    softcap=cfg.attn_softcap)
+                    softcap=cfg.attn_softcap,
+                    mask=(mask_spec if sliding is not None else None),
+                    windowed=sliding)
                 return o_proj(out), new_cache
             # Prefill (cache_index must be 0): nothing precedes the new
             # tokens, so attention over just k/v is exact — the fast flash

@@ -495,15 +495,20 @@ class GenerationEngine:
         self._rolling = 0
         if mask_kind == "sliding_window":
             window = int(getattr(cfg, "mask_window", 0))
+            if window < 1 and self.max_len > window:
+                raise ValueError(
+                    "sliding-window checkpoint with window=0 cannot be "
+                    "served")
             if (self.max_len > window
                     and getattr(cfg, "sliding_pattern", "all") != "all"):
-                raise ValueError(
-                    f"alternating sliding/full layers (Gemma-2, pattern "
-                    f"{cfg.sliding_pattern!r}): the full-attention layers "
-                    f"need the whole history, so a rolling window cache "
-                    f"cannot serve max_len={self.max_len} > window="
-                    f"{window}; set max_len <= window")
-            if self.max_len > window:
+                # Alternating sliding/full layers (Gemma-2/3) past the
+                # window: the full-attention layers need ALL history, so
+                # nothing rolls — the cache stays full-length and the
+                # sliding layers band their reads per the traced
+                # per-layer flag (models/llama.py decode branch). The
+                # config keeps its mask; decode runs the einsum path.
+                pass
+            elif self.max_len > window:
                 # Serving PAST the window: rolling-buffer KV cache
                 # (models/llama.py init_cache grows a "pos" plane; rows =
                 # window, modular writes, position-masked reads) — the

@@ -181,18 +181,33 @@ def test_gemma2_engine_decode_matches_torch(hf_gemma2_dir):
         eng.close()
 
 
-def test_gemma2_serving_past_window_refused(hf_gemma2_dir):
-    """The full-attention layers need the whole history — the Mistral
-    rolling cache must NOT engage for the alternating pattern."""
-    path, _ = hf_gemma2_dir
+def test_gemma2_serving_past_window(hf_gemma2_dir):
+    """Past the window the cache stays FULL-LENGTH (the full-attention
+    layers need all history — nothing rolls) and the sliding layers
+    band their decode reads per the traced flag: greedy decode stays
+    token-identical to torch with prompt + generation outgrowing the
+    window."""
+    path, tmodel = hf_gemma2_dir
     from kubeflow_tpu.models.hf_import import build_from_hf
     from kubeflow_tpu.serve.generation import GenerationEngine
 
     module, cfg, params = build_from_hf(path, dtype=jnp.float32,
                                         param_dtype=jnp.float32)
-    with pytest.raises(ValueError, match="full-attention layers"):
-        GenerationEngine(module, params, cfg, slots=1, max_len=32,
-                         chunk=4, prefill_buckets=(4,))
+    eng = GenerationEngine(module, params, cfg, slots=2, max_len=24,
+                           chunk=4, prefill_buckets=(4, 8))
+    try:
+        assert eng._rolling == 0  # no rolling for alternating layers
+        assert eng.cfg.mask_kind == "sliding_window"
+        rng = np.random.default_rng(4)
+        prompt = [int(t) for t in rng.integers(0, 256, 12)]  # > window 8
+        out = eng.submit(prompt, max_tokens=10, temperature=0.0)
+        with torch.no_grad():
+            ref = tmodel.generate(
+                torch.tensor([prompt]), max_new_tokens=10, do_sample=False,
+                pad_token_id=0).numpy()[0, len(prompt):]
+        assert list(out["output_ids"]) == list(ref)
+    finally:
+        eng.close()
 
 
 # ---------------------------------------------------------------------------
@@ -254,8 +269,8 @@ def test_gemma3_logits_match_torch(hf_gemma3_dir):
 
 def test_gemma3_engine_decode_matches_torch(hf_gemma3_dir):
     """Within the window the causal rebuild keeps qk-norm and the dual
-    rope flags — greedy decode token-identical to torch; past the window
-    the alternating pattern refuses (full layers can't roll)."""
+    rope flags; PAST the window the full-length cache with per-layer
+    banded reads takes over — both token-identical to torch."""
     path, tmodel = hf_gemma3_dir
     from kubeflow_tpu.models.hf_import import build_from_hf
     from kubeflow_tpu.serve.generation import GenerationEngine
@@ -274,9 +289,19 @@ def test_gemma3_engine_decode_matches_torch(hf_gemma3_dir):
         assert list(out["output_ids"]) == list(ref)
     finally:
         eng.close()
-    with pytest.raises(ValueError, match="full-attention layers"):
-        GenerationEngine(module, params, cfg, slots=1, max_len=32,
-                         chunk=4, prefill_buckets=(4,))
+    past = GenerationEngine(module, params, cfg, slots=1, max_len=24,
+                            chunk=4, prefill_buckets=(4, 8))
+    try:
+        rng = np.random.default_rng(4)
+        prompt = [int(t) for t in rng.integers(0, 256, 12)]
+        out = past.submit(prompt, max_tokens=10, temperature=0.0)
+        with torch.no_grad():
+            ref = tmodel.generate(
+                torch.tensor([prompt]), max_new_tokens=10, do_sample=False,
+                pad_token_id=0).numpy()[0, len(prompt):]
+        assert list(out["output_ids"]) == list(ref)
+    finally:
+        past.close()
 
 
 def test_gemma3_multimodal_refused(hf_gemma3_dir, tmp_path):
