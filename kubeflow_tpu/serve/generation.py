@@ -513,11 +513,9 @@ class GenerationEngine:
                 # (models/llama.py init_cache grows a "pos" plane; rows =
                 # window, modular writes, position-masked reads) — the
                 # vLLM/huggingfaceserver capability of serving
-                # Mistral-class models at full context, exactly.
-                if window < 1:
-                    raise ValueError(
-                        "sliding-window checkpoint with window=0 cannot "
-                        "be served")
+                # Mistral-class models at full context, exactly. (The
+                # window >= 1 guard above already rejected degenerate
+                # configs.)
                 self._rolling = window
             else:
                 # Within the window the band never clips, so causal decode

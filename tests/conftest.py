@@ -36,3 +36,15 @@ def devices8():
     if len(devs) < 8:
         pytest.skip("needs 8 virtual devices")
     return devs[:8]
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """Cap cumulative compiled-executable growth across the full tier:
+    438 tests build hundreds of engines/train steps in ONE process, and
+    the global jit cache holds every executable forever — by ~80% of the
+    suite the process dies (SIGSEGV under allocation pressure, seen
+    twice at the same index in round 5). Modules don't share traces, so
+    per-module cache drops only cost intra-module recompiles: none."""
+    yield
+    jax.clear_caches()

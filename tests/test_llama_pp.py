@@ -309,15 +309,12 @@ def test_pipeline_cp_grads_match_scanned(devices8):
 
 
 def test_pipeline_cp_rejections(devices8):
-    """CP-inside-PP v1 scope: causal-only, unpacked-only — loud refusals."""
+    """CP-inside-PP remaining scope edges: MaskSpec families still
+    refuse loudly (packed segment_ids COMPOSE since round 5 — covered by
+    test_pipeline_cp_packed_matches_scanned)."""
     cfg = _cfg()
     model, params, tokens = _params_and_tokens(cfg)
     mesh = build_mesh(MeshConfig(pipe=2, seq=2, data=2), devices8)
-    segs = jnp.zeros_like(tokens)
-    with pytest.raises(ValueError, match="segment_ids"):
-        pipeline_forward(cfg, params, tokens, mesh=mesh, num_microbatches=4,
-                         seq_axis="seq", segment_ids=segs,
-                         positions=jnp.zeros_like(tokens))
     swcfg = dataclasses.replace(cfg, mask_kind="sliding_window",
                                 mask_window=8)
     with pytest.raises(ValueError, match="causal-only"):
