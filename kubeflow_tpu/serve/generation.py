@@ -702,7 +702,8 @@ class GenerationEngine:
                       "decode_seconds": 0.0, "decode_dispatches": 0,
                       "prefix_hits": 0, "prefix_hit_tokens": 0,
                       "spec_dispatches": 0, "spec_proposed": 0,
-                      "spec_accepted": 0, "spec_demotions": 0}
+                      "spec_accepted": 0, "spec_demotions": 0,
+                      "spec_readmissions": 0}
         self._compile()
         from kubeflow_tpu.models.llama import init_cache
         with self._scope():
@@ -1109,18 +1110,8 @@ class GenerationEngine:
             # (exact match / rejection sampling); top-k/top-p requests
             # skip this pass — they never take the spec path, so their
             # draft rows would be dead weight.
-            dfrag = self._dfrag_init()
-            done = 0
-            while done < len(ids):
-                piece = ids[done:done + big]
-                bucket = self._bucket_for(len(piece))
-                toks = np.zeros((1, bucket), np.int32)
-                toks[0, :len(piece)] = piece
-                dfrag = self._dextend_mid(
-                    self._dparams, dfrag, jnp.asarray(toks),
-                    jnp.asarray([done], jnp.int32))
-                done += len(piece)
-            self._dcache = self._dinsert(self._dcache, dfrag,
+            self._dcache = self._dinsert(self._dcache,
+                                         self._draft_replay(ids),
                                          jnp.int32(slot))
             draft_ok = True
         first = int(tok0[0])
@@ -1137,6 +1128,50 @@ class GenerationEngine:
             per[name] = per.get(name, 0) + 1
             self.stats["adapter_requests"] = per
         self._emit(slot, [first], [float(lp0[0])])
+
+    def _draft_replay(self, ids: list[int]) -> Any:
+        """Chunked draft-cache build over a token sequence — the ONE
+        admission recipe shared by initial admission and re-admission
+        (no sampling: _dextend_mid only)."""
+        big = self.prefill_buckets[-1]
+        dfrag = self._dfrag_init()
+        done = 0
+        while done < len(ids):
+            piece = ids[done:done + big]
+            bucket = self._bucket_for(len(piece))
+            toks = np.zeros((1, bucket), np.int32)
+            toks[0, :len(piece)] = piece
+            dfrag = self._dextend_mid(self._dparams, dfrag,
+                                      jnp.asarray(toks),
+                                      jnp.asarray([done], jnp.int32))
+            done += len(piece)
+        return dfrag
+
+    def _readmit_worthwhile(self, st: dict) -> bool:
+        """Cost gate for draft re-admission: replaying the whole history
+        to speculate a handful of remaining tokens (or a history vastly
+        longer than the remainder) costs more than it saves. Checked for
+        the WHOLE batch before any replay runs — spec is batch-wide, so
+        one unworthy slot keeps everyone vanilla, and replaying the
+        others first would be pure waste repeated every loop."""
+        req = st["req"]
+        remaining = req["max_tokens"] - len(req["out"])
+        history = len(req["input_ids"]) + len(req["out"]) - 1
+        return remaining >= self.chunk and history <= 32 * remaining
+
+    def _readmit_draft(self, slot: int, st: dict) -> None:
+        """Rebuild a demoted slot's draft cache from its token history
+        (prompt + all emitted but the pending last = positions
+        0..idx-1), restoring speculative decoding after a vanilla chunk
+        invalidated the draft rows — mixed traffic costs spec throughput
+        only WHILE the truncated-sampling request is in flight, not for
+        the rest of every concurrent request (r4 advisor finding)."""
+        req = st["req"]
+        ids = req["input_ids"] + req["out"][:-1]
+        self._dcache = self._dinsert(self._dcache, self._draft_replay(ids),
+                                     jnp.int32(slot))
+        st["draft_ok"] = True
+        self.stats["spec_readmissions"] += 1
 
     def _emit(self, slot: int, tokens: list[int],
               logprobs: list[float] | None = None) -> None:
@@ -1219,47 +1254,64 @@ class GenerationEngine:
             # only while every advance went through the spec path — a
             # vanilla chunk (mixed batch) leaves draft rows unwritten, and
             # the draft would attend garbage there (acceptance collapses,
-            # spec becomes pure overhead). Such slots decode vanilla for
-            # the rest of their request.
-            spec_ok = all(ks[i] == 0 and ps[i] >= 1.0
-                          and self._slots[i].get("draft_ok")
-                          for i in active)
-            if self._spec is not None and spec_ok:
+            # spec becomes pure overhead). Once the batch is all
+            # spec-able again, demoted slots RE-ADMIT their draft cache
+            # from token history instead of decoding vanilla forever.
+            spec_able_batch = (self._spec is not None
+                               and all(ks[i] == 0 and ps[i] >= 1.0
+                                       for i in active))
+            spec_ok = False
+            if spec_able_batch:
                 worst = self._spec["n_spec"] * (self._spec["gamma"] + 1)
                 need = max(int(idx[i]) for i in active) + worst
                 if need <= self.max_len:
-                    bucket = next(
-                        (b for b in self.decode_buckets if b >= need),
-                        self.decode_buckets[-1])
-                    with self._scope():
-                        self._cache, self._dcache, toks, lps, acc = \
-                            self._spec_decode[bucket](
-                                self._params, self._dparams, self._cache,
-                                self._dcache, jnp.asarray(last),
-                                jnp.asarray(idx), jnp.asarray(temps), sub,
-                                aid=self._aid_batch(aids))
-                    toks = np.asarray(toks)  # [B, n_spec, gamma+1]
-                    lps = np.asarray(lps)
-                    acc = np.asarray(acc)    # [B, n_spec] accepted counts
-                    dt = time.monotonic() - t0
-                    self.stats["decode_seconds"] += dt
-                    self.stats["decode_dispatches"] += 1
-                    self.stats["spec_dispatches"] += 1
-                    for i in active:
-                        emit_t: list[int] = []
-                        emit_l: list[float] = []
-                        for s in range(self._spec["n_spec"]):
-                            kk = int(acc[i, s])
-                            emit_t += [int(t) for t in toks[i, s, :kk + 1]]
-                            emit_l += [float(v) for v in lps[i, s, :kk + 1]]
-                            self.stats["spec_proposed"] += self._spec["gamma"]
-                            self.stats["spec_accepted"] += kk
-                        st = self._slots[i]
-                        st["idx"] += len(emit_t)
-                        st["last"] = emit_t[-1]
-                        self.stats["decode_tokens"] += len(emit_t)
-                        self._emit(i, emit_t, emit_l)
-                    continue
+                    # Only re-admit when the spec dispatch can actually
+                    # run — near the context end the tail decodes
+                    # vanilla, and replaying the draft there would be a
+                    # demote/replay ping-pong every chunk. Gates are
+                    # checked for EVERY demoted slot before any replay
+                    # runs (see _readmit_worthwhile).
+                    demoted = [i for i in active
+                               if not self._slots[i].get("draft_ok")]
+                    if all(self._readmit_worthwhile(self._slots[i])
+                           for i in demoted):
+                        with self._scope():
+                            for i in demoted:
+                                self._readmit_draft(i, self._slots[i])
+                        spec_ok = True
+            if spec_ok:
+                bucket = next(
+                    (b for b in self.decode_buckets if b >= need),
+                    self.decode_buckets[-1])
+                with self._scope():
+                    self._cache, self._dcache, toks, lps, acc = \
+                        self._spec_decode[bucket](
+                            self._params, self._dparams, self._cache,
+                            self._dcache, jnp.asarray(last),
+                            jnp.asarray(idx), jnp.asarray(temps), sub,
+                            aid=self._aid_batch(aids))
+                toks = np.asarray(toks)  # [B, n_spec, gamma+1]
+                lps = np.asarray(lps)
+                acc = np.asarray(acc)    # [B, n_spec] accepted counts
+                dt = time.monotonic() - t0
+                self.stats["decode_seconds"] += dt
+                self.stats["decode_dispatches"] += 1
+                self.stats["spec_dispatches"] += 1
+                for i in active:
+                    emit_t: list[int] = []
+                    emit_l: list[float] = []
+                    for s in range(self._spec["n_spec"]):
+                        kk = int(acc[i, s])
+                        emit_t += [int(t) for t in toks[i, s, :kk + 1]]
+                        emit_l += [float(v) for v in lps[i, s, :kk + 1]]
+                        self.stats["spec_proposed"] += self._spec["gamma"]
+                        self.stats["spec_accepted"] += kk
+                    st = self._slots[i]
+                    st["idx"] += len(emit_t)
+                    st["last"] = emit_t[-1]
+                    self.stats["decode_tokens"] += len(emit_t)
+                    self._emit(i, emit_t, emit_l)
+                continue
             # Truncation costs a full-vocab sort per step; only pay it
             # when some active request actually asked for top-k/top-p.
             # The cache-length bucket is the smallest covering every
@@ -1286,11 +1338,11 @@ class GenerationEngine:
                 st["idx"] += self.chunk
                 st["last"] = int(toks[i, -1])
                 # This vanilla chunk left the slot's DRAFT cache rows
-                # unwritten — spec decoding must not trust them again.
-                # Surfaced as spec_demotions: under mixed traffic one
-                # truncated-sampling request demotes every concurrent
-                # spec-able slot for the rest of its request (a perf
-                # effect, never correctness — ops/ROADMAP.md).
+                # unwritten — spec decoding must not trust them until
+                # re-admission replays the slot's history
+                # (_readmit_draft, once the batch is all-spec-able
+                # again). spec_demotions / spec_readmissions count both
+                # sides (perf effects, never correctness).
                 if st.get("draft_ok"):
                     self.stats["spec_demotions"] += 1
                 st["draft_ok"] = False

@@ -239,6 +239,57 @@ def test_spec_mixed_batch_stays_correct(target):
         spec.close()
 
 
+def test_spec_readmission_after_mixed_traffic(target):
+    """r4 advisor finding (round-5 fix): a demoted slot re-admits its
+    draft cache from token history once the batch is all-spec-able
+    again, instead of decoding vanilla for the rest of its request.
+    The long greedy request must (a) stay token-identical to reference
+    greedy and (b) actually resume speculating after the short sampled
+    request retires."""
+    import threading
+
+    cfg, model, params = target
+    vanilla = _engine(target)
+    try:
+        ref = vanilla.submit([5, 9, 2], max_tokens=48, temperature=0.0)
+    finally:
+        vanilla.close()
+    # chunk=4: the sampled request spans many dispatches, so the greedy
+    # request reliably shares chunks with it (deterministic demotion).
+    spec = _engine(target, chunk=4,
+                   draft={"model": model, "params": params,
+                          "cfg": cfg, "gamma": 3})
+    try:
+        results = {}
+
+        # Back-to-back submits (CPU dispatches are ~3 ms — sleeps can't
+        # sequence this): both live in the slot batch from the first
+        # chunks, the sampled request forces vanilla (demotion), and its
+        # smaller budget retires it with the greedy request still owing
+        # >= 32 tokens — the re-admission window.
+        def greedy():
+            results["g"] = spec.submit([5, 9, 2], max_tokens=48,
+                                       temperature=0.0)
+
+        def sampled():
+            results["s"] = spec.submit([8, 1], max_tokens=16,
+                                       temperature=0.9, top_p=0.9)
+
+        ts = [threading.Thread(target=greedy),
+              threading.Thread(target=sampled)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=180)
+        assert results["g"]["output_ids"] == ref["output_ids"]
+        s = spec.stats
+        assert s["spec_demotions"] >= 1, s
+        assert s["spec_readmissions"] >= 1, s
+        assert s["spec_dispatches"] > 0, s
+    finally:
+        spec.close()
+
+
 def test_spec_rejects_vocab_mismatch(target):
     cfg, model, params = target
     dcfg = _cfg(vocab_size=cfg.vocab_size * 2)
