@@ -15,9 +15,51 @@ import subprocess
 import time
 from typing import Any, Iterator
 
+from kubeflow_tpu.utils import faults
+from kubeflow_tpu.utils.resilience import (BackoffPolicy, Deadline,
+                                           DeadlineExceeded, retry_call)
+
+_FP_REQUEST = faults.register_point(
+    "controlplane.request",
+    "per transport attempt, before connect/send; ctx: op, attempt")
+
 
 class ControlPlaneError(RuntimeError):
     pass
+
+
+class ControlPlaneDisconnected(ControlPlaneError, ConnectionError):
+    """The socket died mid-exchange (truncated read / closed connection)
+    — the transient, retryable subset of ControlPlaneError."""
+
+
+class ControlPlaneUnavailable(ControlPlaneError):
+    """Typed terminal error: the retry/deadline budget for one call is
+    exhausted and the control plane never answered. Callers distinguish
+    'the server rejected this' (ControlPlaneError) from 'the server is
+    gone' (this) — the same split client-go makes with IsServerTimeout."""
+
+
+#: Transient transport errors worth a reconnect+retry: refused / missing
+#: socket (server starting or restarting), reset / broken pipe /
+#: truncated read (server died mid-exchange). Plain timeouts are NOT
+#: retried — the server may be wedged mid-request, and replaying a
+#: non-idempotent op against a wedged server is worse than failing.
+TRANSIENT_ERRORS = (ConnectionRefusedError, ConnectionResetError,
+                    BrokenPipeError, FileNotFoundError,
+                    ControlPlaneDisconnected)
+
+#: Errors that can only occur BEFORE the request reached the server
+#: (connect-time): safe to retry for any op. The rest of
+#: TRANSIENT_ERRORS can strike after sendall — the server may have
+#: already applied the op — so those only replay for read-only verbs.
+_PRE_SEND_ERRORS = (ConnectionRefusedError, FileNotFoundError)
+
+#: Verbs with no server-side effects: replaying them after a mid-exchange
+#: disconnect is always safe (client-go's IsServerTimeout/idempotency
+#: split for GET-class requests).
+_READ_ONLY_OPS = frozenset(
+    {"get", "list", "metrics", "slices", "logs", "ping"})
 
 
 def namespace_of(resource: dict) -> str:
@@ -27,19 +69,35 @@ def namespace_of(resource: dict) -> str:
 
 
 class Client:
+    """`retry` / `max_attempts` / `deadline_s` govern the transport's
+    resilience (utils/resilience.py): transient socket errors reconnect
+    and retry under jittered exponential backoff, bounded by BOTH an
+    attempt cap and a per-call wall-clock budget (`deadline_s`, default =
+    `timeout`). Connect-time errors retry for any op; mid-exchange
+    disconnects only replay read-only verbs (a mutating op may already
+    have been applied server-side). Exhaustion raises
+    `ControlPlaneUnavailable` with the last transport error chained.
+    `max_attempts=1` restores the old single-shot behavior."""
+
     def __init__(self, socket_path: str = "/tmp/tpk.sock",
-                 timeout: float = 30.0):
+                 timeout: float = 30.0,
+                 retry: BackoffPolicy | None = None,
+                 max_attempts: int = 5,
+                 deadline_s: float | None = None):
         self.socket_path = socket_path
         self.timeout = timeout
+        self.retry = retry or BackoffPolicy(initial_s=0.05, max_s=2.0)
+        self.max_attempts = int(max_attempts)
+        self.deadline_s = timeout if deadline_s is None else deadline_s
         self._sock: socket.socket | None = None
         self._buf = b""
 
     # -- transport ----------------------------------------------------------
 
-    def _connect(self) -> socket.socket:
+    def _connect(self, deadline: Deadline) -> socket.socket:
         if self._sock is None:
             s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-            s.settimeout(self.timeout)
+            s.settimeout(max(deadline.bound(self.timeout), 0.001))
             s.connect(self.socket_path)
             self._sock = s
         return self._sock
@@ -49,14 +107,17 @@ class Client:
             self._sock.close()
             self._sock = None
 
-    def request(self, **req: Any) -> dict:
+    def _request_once(self, req: dict, deadline: Deadline,
+                      attempt: int = 0) -> dict:
+        faults.fire(_FP_REQUEST, op=req.get("op"), attempt=attempt)
         try:
-            s = self._connect()
+            s = self._connect(deadline)
+            s.settimeout(max(deadline.bound(self.timeout), 0.001))
             s.sendall(json.dumps(req).encode() + b"\n")
             while b"\n" not in self._buf:
                 chunk = s.recv(65536)
                 if not chunk:
-                    raise ControlPlaneError(
+                    raise ControlPlaneDisconnected(
                         "connection closed by control plane")
                 self._buf += chunk
         except (OSError, ControlPlaneError):
@@ -71,6 +132,50 @@ class Client:
         if not resp.get("ok"):
             raise ControlPlaneError(resp.get("error", "unknown error"))
         return resp
+
+    def request(self, **req: Any) -> dict:
+        deadline = Deadline(self.deadline_s)
+        attempts = [0]
+
+        def once():
+            attempt = attempts[0]
+            attempts[0] += 1
+            try:
+                return self._request_once(req, deadline, attempt)
+            except TRANSIENT_ERRORS as e:
+                if (not isinstance(e, _PRE_SEND_ERRORS)
+                        and req.get("op") not in _READ_ONLY_OPS):
+                    # Mid-exchange death on a mutating op: the server may
+                    # have applied it before dying — replaying could
+                    # double-apply (create -> already-exists, delete ->
+                    # not-found). Surface the ambiguity instead (not a
+                    # TRANSIENT_ERROR, so retry_call propagates it).
+                    raise ControlPlaneUnavailable(
+                        f"connection lost mid-exchange during "
+                        f"non-idempotent op {req.get('op')!r} (outcome "
+                        f"unknown, not retried): "
+                        f"{type(e).__name__}: {e}") from e
+                raise
+
+        try:
+            return retry_call(once, retry_on=TRANSIENT_ERRORS,
+                              policy=self.retry,
+                              max_attempts=self.max_attempts,
+                              deadline=deadline,
+                              component="controlplane")
+        except TRANSIENT_ERRORS + (DeadlineExceeded, TimeoutError) as e:
+            # DeadlineExceeded: the budget expired before an attempt
+            # could even start (retry_call's pre-attempt check).
+            # TimeoutError/socket.timeout: the budget (or flat timeout)
+            # expired MID-attempt on a slow-but-alive server — not
+            # retried (it may be wedged mid-request), but still "the
+            # control plane never answered", so both wear the typed
+            # error the docstring promises.
+            raise ControlPlaneUnavailable(
+                f"control plane at {self.socket_path} unavailable "
+                f"after {attempts[0]} attempt(s) over "
+                f"{self.deadline_s:.1f}s budget: "
+                f"{type(e).__name__}: {e}") from e
 
     # -- resource verbs -------------------------------------------------------
 
@@ -117,8 +222,13 @@ class Client:
                             stderr=stderr, max_bytes=max_bytes)
 
     def ping(self) -> bool:
+        # Single-shot on purpose: ping IS the health probe the startup
+        # poll spins on — retry/backoff here would just slow the poll's
+        # own loop (the caller is the retry policy).
         try:
-            return bool(self.request(op="ping").get("pong"))
+            return bool(self._request_once({"op": "ping"},
+                                           Deadline(self.timeout))
+                        .get("pong"))
         except (OSError, ControlPlaneError):
             return False
 

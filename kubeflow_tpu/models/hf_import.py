@@ -360,12 +360,14 @@ def import_gemma2(path: str, *, scan_layers: bool = True,
     cfg = llama_config_from_hf(hf, **fields)
     if cfg.mask_kind == "sliding_window":
         # llama_config_from_hf set the window; mark the alternation (it
-        # must not override a caller's explicit pattern choice, so apply
-        # after overrides only when still defaulted).
+        # must not override a caller's explicit pattern OR impl choice,
+        # so apply after overrides only the fields still defaulted).
         if "sliding_pattern" not in config_overrides:
             import dataclasses
-            cfg = dataclasses.replace(cfg, sliding_pattern="even",
-                                      attention_impl="naive")
+            forced = {"sliding_pattern": "even"}
+            if "attention_impl" not in config_overrides:
+                forced["attention_impl"] = "naive"
+            cfg = dataclasses.replace(cfg, **forced)
     if not cfg.tie_embeddings:
         raise ValueError(
             "Gemma-2 checkpoints tie embeddings; tie_word_embeddings="
@@ -462,8 +464,10 @@ def import_gemma3(path: str, *, scan_layers: bool = True,
     cfg = llama_config_from_hf(hf, **fields)
     if cfg.mask_kind == "sliding_window" \
             and "sliding_pattern" not in config_overrides:
-        cfg = dataclasses.replace(cfg, sliding_pattern="5to1",
-                                  attention_impl="naive")
+        forced = {"sliding_pattern": "5to1"}
+        if "attention_impl" not in config_overrides:
+            forced["attention_impl"] = "naive"
+        cfg = dataclasses.replace(cfg, **forced)
     if not cfg.tie_embeddings:
         raise ValueError(
             "Gemma-3 checkpoints tie embeddings; tie_word_embeddings="

@@ -9,11 +9,13 @@ from kubeflow_tpu.serve.batcher import Batcher
 from kubeflow_tpu.serve.model import JAXModel, Model
 from kubeflow_tpu.serve.runtimes import (export_for_serving, list_runtimes,
                                          load_model, register_runtime)
-from kubeflow_tpu.serve.server import ModelRepository, ModelServer
+from kubeflow_tpu.serve.server import (DEADLINE_HEADER, AdmissionController,
+                                       ModelRepository, ModelServer)
 from kubeflow_tpu.serve.storage import download
 
 __all__ = [
-    "Batcher", "JAXModel", "Model", "ModelRepository", "ModelServer",
-    "download", "export_for_serving", "list_runtimes", "load_model",
+    "AdmissionController", "Batcher", "DEADLINE_HEADER", "JAXModel",
+    "Model", "ModelRepository", "ModelServer", "download",
+    "export_for_serving", "list_runtimes", "load_model",
     "register_runtime",
 ]

@@ -13,19 +13,57 @@ from __future__ import annotations
 import queue
 import threading
 import time
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from typing import Any, Callable, Sequence
 
 import numpy as np
 
+from kubeflow_tpu.utils import faults
+from kubeflow_tpu.utils.resilience import Deadline, DeadlineExceeded
+
+_FP_PREDICT = faults.register_point(
+    "serve.predict", "batcher worker, before the coalesced model call; "
+                     "ctx: batch (total examples)")
+
 
 class _Item:
-    __slots__ = ("inputs", "future", "n")
+    __slots__ = ("inputs", "future", "n", "deadline")
 
-    def __init__(self, inputs: Sequence[np.ndarray]):
+    def __init__(self, inputs: Sequence[np.ndarray],
+                 deadline: Deadline | None = None):
         self.inputs = [np.asarray(x) for x in inputs]
         self.n = self.inputs[0].shape[0]
+        self.deadline = deadline
         self.future: Future = Future()
+
+    def deliver(self, result=None, exc: BaseException | None = None) -> None:
+        """Complete the future, tolerating a caller that already gave up:
+        an expired server-side await (asyncio.wait_for) CANCELS the
+        wrapped future, and a plain set_result after that would raise
+        InvalidStateError out of the worker thread — killing the batcher
+        for every other request."""
+        try:
+            if exc is not None:
+                self.future.set_exception(exc)
+            else:
+                self.future.set_result(result)
+        except InvalidStateError:
+            pass  # caller abandoned (deadline/cancel): result is moot
+
+    def expire_if_due(self) -> bool:
+        """Resolve the future with DeadlineExceeded when the request's
+        budget is gone — an expired item must not occupy device batch
+        rows its caller will never read (the 504 already went out).
+        A caller-side cancel counts as expiry too. No metrics here: the
+        serving surface that returns the error (HTTP/gRPC) counts each
+        expired request exactly once."""
+        if self.future.cancelled():
+            return True
+        if self.deadline is not None and self.deadline.expired():
+            self.deliver(exc=DeadlineExceeded(
+                "request deadline expired in the admission queue"))
+            return True
+        return False
 
     def signature(self) -> tuple:
         """Items only coalesce when per-example shapes and dtypes agree —
@@ -53,16 +91,21 @@ class Batcher:
         self.stats = {"batches": 0, "items": 0, "examples": 0}
         self._thread.start()
 
-    def submit(self, inputs: Sequence[np.ndarray]) -> Future:
+    def submit(self, inputs: Sequence[np.ndarray],
+               deadline: Deadline | None = None) -> Future:
         if self._closed:
             raise RuntimeError("batcher is closed")
-        item = _Item(inputs)
+        item = _Item(inputs, deadline)
+        if item.expire_if_due():
+            return item.future
         if item.n > self.max_batch_size:
             # Oversized requests bypass coalescing; JAXModel chunks them.
-            try:
-                item.future.set_result(self._predict(item.inputs))
-            except BaseException as e:  # noqa: BLE001 - deliver to caller
-                item.future.set_exception(e)
+            if item.future.set_running_or_notify_cancel():
+                try:
+                    faults.fire(_FP_PREDICT, batch=item.n)
+                    item.deliver(self._predict(item.inputs))
+                except BaseException as e:  # noqa: BLE001 - to caller
+                    item.deliver(exc=e)
             return item.future
         self._q.put(item)
         return item.future
@@ -85,6 +128,10 @@ class Batcher:
         per-item idle timeout — trickling arrivals must not extend it)."""
         first = self._pending or self._q.get()
         self._pending = None
+        while first is not None and first.expire_if_due():
+            # Expired while queued: its caller already got the 504 —
+            # don't spend device batch rows on it.
+            first = self._q.get()
         if first is None:
             return None
         batch, total = [first], first.n
@@ -101,6 +148,8 @@ class Batcher:
             if nxt is None:
                 self._q.put(None)  # re-post sentinel for the outer loop
                 break
+            if nxt.expire_if_due():
+                continue
             if nxt.signature() != sig or total + nxt.n > self.max_batch_size:
                 self._pending = nxt  # incompatible/overflow: next batch's head
                 break
@@ -113,18 +162,27 @@ class Batcher:
             batch = self._gather()
             if batch is None:
                 return
+            # Claim each item (PENDING -> RUNNING, the concurrent.futures
+            # protocol): a caller-side cancel can no longer race the
+            # dispatch, so "cancelled" reliably means "never computed" and
+            # a cancelled-after-claim slot rides the batch to completion.
+            batch = [i for i in batch
+                     if i.future.set_running_or_notify_cancel()]
+            if not batch:
+                continue
             try:
+                faults.fire(_FP_PREDICT, batch=sum(i.n for i in batch))
                 stacked = [np.concatenate(parts)
                            for parts in zip(*(i.inputs for i in batch))]
                 outs = self._predict(stacked)
             except BaseException as e:  # noqa: BLE001 - deliver to callers
                 for item in batch:
-                    item.future.set_exception(e)
+                    item.deliver(exc=e)
                 continue
             self.stats["batches"] += 1
             self.stats["items"] += len(batch)
             self.stats["examples"] += sum(i.n for i in batch)
             off = 0
             for item in batch:
-                item.future.set_result([o[off:off + item.n] for o in outs])
+                item.deliver([o[off:off + item.n] for o in outs])
                 off += item.n

@@ -45,6 +45,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from kubeflow_tpu.serve.model import Model
+from kubeflow_tpu.utils.resilience import Deadline, DeadlineExceeded
 
 NEG_INF = -1e30
 
@@ -955,11 +956,17 @@ class GenerationEngine:
                temperature: float = 0.0, top_k: int = 0,
                top_p: float = 1.0, eos_id: int | None = None,
                timeout: float = 300.0, adapter: str | None = None,
-               on_tokens=None) -> dict:
+               deadline: Deadline | None = None, on_tokens=None) -> dict:
         """`on_tokens(tokens, done)` (optional) is invoked from the worker
         thread as tokens are emitted — chunk-granular streaming; the final
         call has done=True. Exceptions in the callback are swallowed (a
-        slow/broken stream consumer must not stall the decode loop)."""
+        slow/broken stream consumer must not stall the decode loop).
+
+        `deadline` is the request's end-to-end budget (resilience.Deadline,
+        propagated from the server's timeout header): the scheduler checks
+        it at admission and every chunk boundary, and an expired request
+        raises DeadlineExceeded AND frees its decode slot — it stops
+        burning batch capacity the moment its 504 is decided."""
         if not input_ids:
             raise ValueError("input_ids must be non-empty")
         if len(input_ids) > self.max_len - 1:
@@ -982,13 +989,26 @@ class GenerationEngine:
             "out_logprobs": [],
             "done": threading.Event(),
             "error": None,
+            "deadline": deadline,
             "t0": time.monotonic(),
             "cb": on_tokens,
         }
         self._queue.put(req)
         self._wake.set()
-        if not req["done"].wait(timeout):
-            req["error"] = f"generation timed out after {timeout}s"
+        wait_s = timeout
+        if deadline is not None:
+            # Wake as soon as the budget expires — the worker notices at
+            # the next chunk boundary, but the caller's 504 must not wait
+            # for it.
+            wait_s = deadline.bound(timeout)
+        if not req["done"].wait(wait_s):
+            if deadline is not None and deadline.expired():
+                req["error"] = DeadlineExceeded(
+                    "request deadline expired during generation")
+            else:
+                req["error"] = f"generation timed out after {timeout}s"
+        if isinstance(req["error"], BaseException):
+            raise req["error"]
         if req["error"]:
             raise RuntimeError(req["error"])
         return {
@@ -1206,21 +1226,56 @@ class GenerationEngine:
             req["done"].set()
             self._slots[slot] = None
 
+    def _expire(self, req: dict) -> bool:
+        """Finish `req` with DeadlineExceeded when its budget is gone.
+        True means the request is done and must not (or no longer) hold a
+        decode slot. No metrics here: the serving surface that returns
+        the error counts each expired request exactly once."""
+        if req["done"].is_set():
+            return True  # already finished (e.g. EOS raced the sweep)
+        dl = req.get("deadline")
+        if dl is None or not dl.expired():
+            return False
+        req["error"] = DeadlineExceeded(
+            "request deadline expired during generation")
+        req["done"].set()
+        return True
+
     def _loop(self) -> None:
         while not self._stop:
             # Admit waiting requests into free slots (chunk boundary).
+            # Each free slot keeps popping past already-expired entries
+            # (their callers were 504'd) and failed admissions, so a
+            # backlog of dead requests can't make live ones wait a chunk
+            # per corpse; one empty probe ends the whole scan (no
+            # per-slot queue.Empty churn on the idle hot loop).
+            queue_empty = False
             for slot in range(self.n_slots):
-                if self._slots[slot] is None:
+                if queue_empty:
+                    break
+                while self._slots[slot] is None:
                     try:
                         req = self._queue.get_nowait()
                     except queue.Empty:
+                        queue_empty = True
                         break
+                    if self._expire(req):
+                        continue  # never admitted; try the next waiter
                     try:
                         self._admit(slot, req)
                     except Exception as e:  # surface to the caller
                         req["error"] = f"{type(e).__name__}: {e}"
                         req["done"].set()
                         self._slots[slot] = None
+                        continue  # slot still free; try the next waiter
+                    break
+            # Chunk-boundary deadline sweep: an expired request frees its
+            # slot NOW instead of decoding tokens its caller (already
+            # 504'd) will never read — expiry costs the batch at most one
+            # chunk of waste.
+            for i, st in enumerate(self._slots):
+                if st is not None and self._expire(st["req"]):
+                    self._slots[i] = None
             active = [i for i, s in enumerate(self._slots) if s is not None]
             if not active:
                 self._wake.wait(0.05)
@@ -1454,7 +1509,10 @@ class GenerativeJAXModel(Model):
             top_p=float(payload.get("top_p", 1.0)),
             eos_id=payload.get("eos_id", self.eos_id),
             adapter=payload.get("adapter"),
-            timeout=float(payload.get("timeout", 300.0)))
+            timeout=float(payload.get("timeout", 300.0)),
+            # In-process deadline propagation: the server stashes the
+            # request's Deadline under "_deadline" (never a wire field).
+            deadline=payload.get("_deadline"))
 
     def generate(self, payload: dict) -> dict:
         if not self.ready or self.engine is None:

@@ -15,6 +15,7 @@ Tensor encoding: typed `contents` fields or packed little-endian
 from __future__ import annotations
 
 import threading
+import time
 from concurrent import futures
 from typing import TYPE_CHECKING
 
@@ -23,7 +24,9 @@ import ml_dtypes  # noqa: F401 — registers bfloat16 with numpy
 import numpy as np
 
 from kubeflow_tpu.serve import open_inference_pb2 as pb
-from kubeflow_tpu.serve.model import _v2_dtype, v2_to_numpy_dtype
+from kubeflow_tpu.serve.model import Model, _v2_dtype, v2_to_numpy_dtype
+from kubeflow_tpu.utils.resilience import (Deadline, DeadlineExceeded,
+                                           metrics as res_metrics)
 
 if TYPE_CHECKING:  # avoid a cycle; server.py imports us lazily
     from kubeflow_tpu.serve.server import ModelServer
@@ -86,7 +89,9 @@ class InferenceServicer:
         return pb.ServerLiveResponse(live=True)
 
     def ServerReady(self, request, context):
-        return pb.ServerReadyResponse(ready=True)
+        # Shares ModelServer.readiness() with the HTTP probe — ONE
+        # readiness rule, so the two surfaces cannot drift.
+        return pb.ServerReadyResponse(ready=self.server.readiness()[0])
 
     def _model(self, name, context):
         try:
@@ -114,10 +119,30 @@ class InferenceServicer:
         return resp
 
     def ModelInfer(self, request, context):
-        import time
+        # The gRPC data plane sits behind the SAME admission gate as the
+        # HTTP handlers — it must not be an unbounded side door around
+        # --max-inflight. RESOURCE_EXHAUSTED is the canonical overload
+        # status (the HTTP 503 + Retry-After equivalent).
+        adm = self.server.admission
+        if adm is not None and not adm.try_acquire(component="serve_grpc"):
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
+                          "server overloaded: admission queue full")
+        # An expired request's work may still be computing when the
+        # abort unwinds: _infer parks the claimed future here so the
+        # admission slot rides it to true completion (same rule as the
+        # HTTP path's _slot_rides_with) — max_inflight bounds concurrent
+        # WORK, not just concurrent waiting callers.
+        ride = []
+        try:
+            return self._infer(request, context, ride)
+        finally:
+            if adm is not None:
+                if ride:
+                    ride[0].add_done_callback(lambda _f: adm.release())
+                else:
+                    adm.release()
 
-        from kubeflow_tpu.serve.model import Model
-
+    def _infer(self, request, context, ride):
         name = request.model_name
         model = self._model(name, context)
         if not model.ready:
@@ -153,20 +178,53 @@ class InferenceServicer:
             if not inputs:
                 context.abort(grpc.StatusCode.INVALID_ARGUMENT,
                               "preprocess returned no inputs")
+        # gRPC's native deadline (client-set, in context) maps onto the
+        # shared Deadline clock, same as the HTTP timeout header: an
+        # expired request frees its batch row instead of computing a
+        # result nobody will read.
+        rem = context.time_remaining()
+        deadline = Deadline(rem) if rem is not None else None
+        fut = None
         t0 = time.monotonic()
         try:
             if getattr(model, "wants_raw_payload", False):
-                # Graph/raw-payload models take the whole payload dict and
-                # bypass the batcher (same as the HTTP handlers).
+                # Graph/raw-payload models take the whole payload dict
+                # and bypass the batcher, but still run bounded on the
+                # server's worker pool (same as the HTTP handlers).
                 payload = dict(params)
                 payload["instances"] = inputs[0]
-                out = model.predict(payload)
+                fut = self.server.executor.submit(model.predict, payload)
+                out = fut.result(
+                    timeout=deadline.bound(120.0) if deadline else 120)
                 outs = [out.get("instances")
                         if isinstance(out, dict) else out]
             else:
-                fut = self.server.repo.batcher(name).submit(inputs)
-                outs = fut.result(timeout=120)
+                fut = self.server.repo.batcher(name).submit(
+                    inputs, deadline=deadline)
+                outs = fut.result(
+                    timeout=deadline.bound(120.0) if deadline else 120)
             outs = model.postprocess(outs)
+        except (DeadlineExceeded, futures.TimeoutError) as e:
+            # The caller is getting an error either way: try to abandon
+            # the queued work (cancel only lands pre-claim); if it is
+            # already computing, park it so the admission slot rides it
+            # to completion.
+            if fut is not None and not fut.cancel():
+                ride.append(fut)
+            if (isinstance(e, DeadlineExceeded)
+                    or (deadline is not None and deadline.expired())):
+                # This surface aborts at most once per request and the
+                # inner layers never count — exactly one increment.
+                res_metrics.inc("tpk_deadline_expired_total",
+                                component="serve_grpc")
+                context.abort(grpc.StatusCode.DEADLINE_EXCEEDED,
+                              f"request deadline exceeded "
+                              f"({type(e).__name__})")
+            # A work-raised timeout with budget left (or no deadline at
+            # all — on py3.11+ futures.TimeoutError IS builtin
+            # TimeoutError) is a server fault, not an expired deadline.
+            context.abort(grpc.StatusCode.INTERNAL,
+                          f"{type(e).__name__}: {e}")
         except Exception as e:  # surfaced as a proper gRPC status
             context.abort(grpc.StatusCode.INTERNAL,
                           f"{type(e).__name__}: {e}")

@@ -28,7 +28,7 @@ from typing import Any
 
 import tornado.web
 
-from kubeflow_tpu.serve.server import _Base, pump_stream
+from kubeflow_tpu.serve.server import _Base, admission_gated, pump_stream
 
 
 class _OpenAIBase(_Base):
@@ -47,6 +47,13 @@ class _OpenAIBase(_Base):
                                         if status_code < 500
                                         else "internal_error"),
             "code": status_code}}))
+
+    def shed_body(self) -> dict:
+        # Admission sheds must wear the OpenAI envelope too: SDK clients
+        # parse resp["error"]["message"]/["type"], not a bare string.
+        return {"error": {
+            "message": "server overloaded: admission queue full",
+            "type": "overloaded_error", "code": 503}}
 
     def _generative(self, name: str):
         """Resolve an OpenAI model id to (model, adapter | None). The
@@ -217,6 +224,9 @@ class _GenerativeHandler(_OpenAIBase):
     def delta_choice(self, delta: str, first: bool, finish) -> dict:
         raise NotImplementedError
 
+    # Same admission gate as the native data plane: the OpenAI facade
+    # must not become an unbounded side door around --max-inflight.
+    @admission_gated
     async def post(self):
         body = self.body_json()
         if not isinstance(body, dict):
@@ -241,6 +251,11 @@ class _GenerativeHandler(_OpenAIBase):
             # not a 500.
             raise tornado.web.HTTPError(
                 400, reason=f"invalid request field: {e}") from None
+        deadline = self.request_deadline()
+        if deadline is not None:
+            # In-process deadline propagation, exactly as the native
+            # :generate path: the engine frees the decode slot on expiry.
+            payload["_deadline"] = deadline
         rid = f"{'chatcmpl' if 'chat' in self.object_name else 'cmpl'}-" \
               f"{uuid.uuid4().hex[:24]}"
         t0 = time.monotonic()
@@ -251,8 +266,8 @@ class _GenerativeHandler(_OpenAIBase):
             await self._stream(name, model, payload, rid, stops, t0)
             return
         try:
-            out = await asyncio.get_event_loop().run_in_executor(
-                None, model.generate, payload)
+            out = await self.await_bounded(
+                self.submit_blocking(model.generate, payload), deadline)
         except (ValueError, RuntimeError) as e:
             raise tornado.web.HTTPError(400, reason=str(e)) from None
         text, stopped = _truncate_at_stop(out.get("text", ""), stops)

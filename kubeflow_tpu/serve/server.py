@@ -22,9 +22,13 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import functools
 import json
+import math
+import os
 import threading
 import time
+from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any
 
 import numpy as np
@@ -36,6 +40,72 @@ import tornado.web
 
 from kubeflow_tpu.serve.batcher import Batcher
 from kubeflow_tpu.serve.model import Model, _v2_dtype, v2_to_numpy_dtype
+from kubeflow_tpu.utils.resilience import (Deadline, DeadlineExceeded,
+                                           metrics as res_metrics)
+
+#: Relative per-request budget in milliseconds (the KServe/Istio-style
+#: timeout header, deadline-propagated in-process): expiry anywhere on
+#: the request path — admission queue, batcher, generation — returns 504.
+DEADLINE_HEADER = "X-Request-Timeout-Ms"
+
+
+class AdmissionController:
+    """Bounded admission for the inference data plane — the KServe/
+    Knative containerConcurrency + activator-queue behavior, in-process.
+
+    At most `max_inflight` inference requests are admitted concurrently
+    (admitted = queued in a batcher/engine OR executing). Beyond that the
+    server SHEDS: 503 + `Retry-After` instead of unbounded queueing, and
+    the readiness probe degrades (`/v2/health/ready` → 503) while the
+    replica is actively rejecting work so the platform's LB/controller
+    routes around it — fail fast and visibly, never silently queue into
+    timeout. Merely being full does NOT degrade readiness (Knative's
+    queue-proxy stays ready at containerConcurrency): a single long
+    request on a small-capacity replica must not pull it from endpoints
+    when nothing was rejected."""
+
+    def __init__(self, max_inflight: int = 256,
+                 retry_after_s: float = 1.0):
+        if max_inflight < 1:
+            raise ValueError(f"max_inflight must be >= 1, got "
+                             f"{max_inflight}")
+        self.max_inflight = int(max_inflight)
+        self.retry_after_s = float(retry_after_s)
+        self._inflight = 0
+        self._last_shed = -float("inf")
+        self._lock = threading.Lock()
+
+    def try_acquire(self, component: str = "serve") -> bool:
+        """`component` labels the shed counter with the data plane that
+        hit the gate (serve vs serve_grpc), mirroring the deadline
+        counter's surface labels."""
+        with self._lock:
+            if self._inflight >= self.max_inflight:
+                self._last_shed = time.monotonic()
+                res_metrics.inc("tpk_shed_total", component=component)
+                return False
+            self._inflight += 1
+            return True
+
+    def release(self) -> None:
+        with self._lock:
+            self._inflight -= 1
+
+    @property
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    @property
+    def shedding(self) -> bool:
+        """True while the replica is at capacity AND rejected a request
+        within the last `retry_after_s` (readiness degrades): degradation
+        tracks actual rejections, so a full-but-quiet replica stays in
+        the endpoint set and recovers the moment load drains."""
+        with self._lock:
+            return (self._inflight >= self.max_inflight
+                    and time.monotonic() - self._last_shed
+                    <= self.retry_after_s)
 
 
 class ModelRepository:
@@ -252,6 +322,8 @@ async def pump_stream(handler, it, render, render_error) -> None:
     def step():
         try:
             return ("ev", next(it, _END))
+        except DeadlineExceeded as e:
+            return ("expired", f"{type(e).__name__}: {e}")
         except (ValueError, RuntimeError) as e:
             return ("badreq", f"{type(e).__name__}: {e}")
         except Exception as e:
@@ -259,6 +331,11 @@ async def pump_stream(handler, it, render, render_error) -> None:
 
     loop = asyncio.get_event_loop()
     kind, ev = await loop.run_in_executor(None, step)
+    if kind == "expired":
+        # Streams surface the expiry here (once per request — the inner
+        # layers only free resources, they never count).
+        res_metrics.inc("tpk_deadline_expired_total", component="serve")
+        raise tornado.web.HTTPError(504, reason=ev)
     if kind == "badreq":
         raise tornado.web.HTTPError(400, reason=ev)
     if kind == "err":
@@ -267,6 +344,12 @@ async def pump_stream(handler, it, render, render_error) -> None:
     try:
         while ev is not _END:
             if kind != "ev":
+                if kind == "expired":
+                    # Mid-stream expiry: status line already went out, so
+                    # the 504 becomes a terminal error frame — but it is
+                    # still one expired request for the counter.
+                    res_metrics.inc("tpk_deadline_expired_total",
+                                    component="serve")
                 handler.write(render_error(ev))
                 await handler.flush()
                 break
@@ -296,6 +379,104 @@ class _Base(tornado.web.RequestHandler):
         except json.JSONDecodeError as e:
             raise tornado.web.HTTPError(400, reason=f"bad JSON: {e}") from None
 
+    # -- resilience (deadline + admission) ----------------------------------
+
+    def request_deadline(self) -> Deadline | None:
+        """The request's end-to-end budget from DEADLINE_HEADER (None
+        when the client set none)."""
+        raw = self.request.headers.get(DEADLINE_HEADER)
+        if raw is None:
+            return None
+        try:
+            ms = float(raw)
+            # NaN/inf would defeat every expiry comparison downstream.
+            if not math.isfinite(ms) or ms <= 0:
+                raise ValueError
+        except ValueError:
+            raise tornado.web.HTTPError(
+                400, reason=f"{DEADLINE_HEADER} must be a positive "
+                            f"number of milliseconds, got {raw!r}") \
+                from None
+        return Deadline(ms / 1e3)
+
+    def admit(self) -> bool:
+        """Admission-gate an inference request. False = the 503 +
+        Retry-After shed response has been written; the caller must
+        return without releasing. True = admitted; the caller owns one
+        release()."""
+        adm = self.server.admission
+        if adm is None or adm.try_acquire():
+            return True
+        self.set_header("Retry-After",
+                        str(max(int(adm.retry_after_s), 1)))
+        self.write_json(self.shed_body(), status=503)
+        return False
+
+    def shed_body(self) -> dict:
+        """The 503 shed response body — facades with their own error
+        envelope (OpenAI) override this so SDK clients can parse it."""
+        return {"error": "server overloaded: admission queue full"}
+
+    def _release(self) -> None:
+        adm = self.server.admission
+        if adm is None:
+            return
+        held_by = getattr(self, "_slot_rides_with", None)
+        if held_by is not None:
+            # The request 504'd but its blocking work may still be
+            # running: the admission slot stays held until the work
+            # really finishes (immediately, if it was cancelled in the
+            # queue) — so max_inflight bounds CONCURRENT WORK, not just
+            # concurrent waiting callers.
+            held_by.add_done_callback(lambda _f: adm.release())
+        else:
+            adm.release()
+
+    def submit_blocking(self, fn, *args) -> Future:
+        """Run `fn(*args)` on the server's worker pool, returning the
+        concurrent future. Gated handlers use this instead of
+        run_in_executor so await_bounded can tie the admission slot to
+        the work's true completion on expiry."""
+        return self.server.executor.submit(fn, *args)
+
+    async def await_bounded(self, fut, deadline: Deadline | None):
+        """Await a (concurrent or asyncio) future under the request
+        deadline; expiry — whether raised by the work itself (batcher
+        queue pruning) or by the clock here — maps to 504. The work is
+        not preempted mid-dispatch; instead an expired request's
+        admission slot rides the concurrent future to completion, so the
+        gate still bounds total concurrent work."""
+        cfut = fut if isinstance(fut, Future) else None
+        if cfut is not None:
+            fut = asyncio.wrap_future(cfut)
+        if deadline is None:
+            # No budget: the work's own errors (including a model-raised
+            # TimeoutError) must keep their 500 path, not map to 504.
+            return await fut
+        rem = deadline.remaining()
+        if rem is None:  # Deadline.never(): unbounded, same as None
+            return await fut
+        try:
+            return await asyncio.wait_for(fut, max(rem, 0.0))
+        except (asyncio.TimeoutError, DeadlineExceeded) as e:
+            if (not isinstance(e, DeadlineExceeded)
+                    and not deadline.expired()):
+                # On py3.11+ asyncio.TimeoutError IS builtin
+                # TimeoutError, so a timeout raised by the work's own
+                # internals lands here too — with budget left it is a
+                # server fault (500 path), not an expired deadline.
+                raise
+            if cfut is not None:
+                self._slot_rides_with = cfut
+            # This surface raises at most once per request, and the
+            # inner layers (batcher prune, engine sweep) never count —
+            # so the counter is exactly one increment per expiry.
+            res_metrics.inc("tpk_deadline_expired_total",
+                            component="serve")
+            raise tornado.web.HTTPError(
+                504, reason=f"request deadline exceeded "
+                            f"({type(e).__name__})") from e
+
     def write_error(self, status_code: int, **kwargs) -> None:
         reason = self._reason
         if "exc_info" in kwargs:
@@ -318,6 +499,22 @@ class _Base(tornado.web.RequestHandler):
             rl.log(self, args[0])
 
 
+def admission_gated(method):
+    """Wrap an async inference handler method behind the admission gate:
+    shed (503 already written) or run with a guaranteed release. Every
+    inference entry point uses this ONE wrapper, so a new handler can't
+    silently become an unbounded side door around --max-inflight."""
+    @functools.wraps(method)
+    async def gated(self, *args, **kwargs):
+        if not self.admit():
+            return
+        try:
+            return await method(self, *args, **kwargs)
+        finally:
+            self._release()
+    return gated
+
+
 class V1ListHandler(_Base):
     def get(self):
         self.write_json({"models": self.repo.names()})
@@ -333,8 +530,10 @@ class V1ModelHandler(_Base):
 
 
 class V1PredictHandler(_Base):
+    @admission_gated
     async def post(self, name: str):
         model = self.repo.get(name)
+        deadline = self.request_deadline()
         body = model.preprocess(self.body_json())
         instances = body.get("instances")
         if instances is None:
@@ -345,8 +544,8 @@ class V1PredictHandler(_Base):
             # InferenceGraphs take the whole JSON body (routing fields
             # included) and bypass the batcher — per-request routing can't
             # survive cross-request coalescing.
-            out = await asyncio.get_event_loop().run_in_executor(
-                None, model.predict, body)
+            out = await self.await_bounded(
+                self.submit_blocking(model.predict, body), deadline)
             preds = out.get("instances") if isinstance(out, dict) else out
             self.server.observe(name, len(instances),
                                 time.monotonic() - t0)
@@ -355,8 +554,8 @@ class V1PredictHandler(_Base):
         # v1 protocol is single-tensor: "instances" stack along batch dim 0.
         spec = getattr(model, "input_spec", None)
         inputs = [np.asarray(instances, dtype=spec[0][1] if spec else None)]
-        fut = self.repo.batcher(name).submit(inputs)
-        outs = await asyncio.wrap_future(fut)
+        fut = self.repo.batcher(name).submit(inputs, deadline=deadline)
+        outs = await self.await_bounded(fut, deadline)
         outs = model.postprocess(outs)
         self.server.observe(name, len(instances), time.monotonic() - t0)
         preds = outs[0] if isinstance(outs, (list, tuple)) else outs
@@ -368,8 +567,10 @@ class V1ExplainHandler(_Base):
     (explainer component), served by the model's attached native explainer
     (serve/explain.py). 501 when the model has none configured."""
 
+    @admission_gated
     async def post(self, name: str):
         model = self.repo.get(name)
+        deadline = self.request_deadline()
         # Same preprocess as :predict — explanations must be computed on
         # the input the model actually serves.
         body = model.preprocess(self.body_json())
@@ -381,8 +582,8 @@ class V1ExplainHandler(_Base):
         t0 = time.monotonic()
         try:
             arr = np.asarray(instances, dtype=spec[0][1] if spec else None)
-            out = await asyncio.get_event_loop().run_in_executor(
-                None, model.explain, arr)
+            out = await self.await_bounded(
+                self.submit_blocking(model.explain, arr), deadline)
         except NotImplementedError as e:
             raise tornado.web.HTTPError(501, reason=str(e))
         except (ValueError, TypeError) as e:
@@ -400,6 +601,7 @@ class GenerateHandler(_Base):
     "eos_id"}. Bypasses the coalescing batcher: the generation engine does
     its own continuous batching across concurrent requests."""
 
+    @admission_gated
     async def post(self, name: str):
         model = self.repo.get(name)
         gen = getattr(model, "generate", None)
@@ -407,13 +609,22 @@ class GenerateHandler(_Base):
             raise tornado.web.HTTPError(
                 400, reason=f"model {name!r} is not generative")
         body = self.body_json()
+        # "_deadline" is an in-process field only: a wire-supplied value
+        # would reach the engine as a non-Deadline and crash it.
+        body.pop("_deadline", None)
+        deadline = self.request_deadline()
+        if deadline is not None:
+            # In-process deadline propagation: the engine checks the SAME
+            # object at admission and every chunk boundary, so an expired
+            # request frees its decode slot instead of burning the batch.
+            body["_deadline"] = deadline
         t0 = time.monotonic()
         if body.get("stream"):
             await self._stream(name, model, body, t0)
             return
         try:
-            out = await asyncio.get_event_loop().run_in_executor(
-                None, gen, body)
+            out = await self.await_bounded(
+                self.submit_blocking(gen, body), deadline)
         except (ValueError, RuntimeError) as e:
             raise tornado.web.HTTPError(400, reason=str(e)) from None
         self.server.observe(name, out.get("num_output_tokens", 0),
@@ -448,9 +659,10 @@ class GenerateHandler(_Base):
 
 class V2HealthHandler(_Base):
     def get(self, kind: str):
-        if kind == "ready" and not all(
-                m.ready for m in map(self.repo.get, self.repo.names())):
-            raise tornado.web.HTTPError(503, reason="models loading")
+        if kind == "ready":
+            ready, why = self.server.readiness()
+            if not ready:
+                raise tornado.web.HTTPError(503, reason=why)
         self.write_json({"live" if kind == "live" else "ready": True})
 
 
@@ -481,8 +693,10 @@ class V2ModelHandler(_Base):
 
 
 class V2InferHandler(_Base):
+    @admission_gated
     async def post(self, name: str):
         model = self.repo.get(name)
+        deadline = self.request_deadline()
         body = model.preprocess(self.body_json())
         tensors = body.get("inputs")
         if not tensors:
@@ -498,12 +712,12 @@ class V2InferHandler(_Base):
             # parameters ride along as routing fields.
             payload = dict(body.get("parameters") or {})
             payload["instances"] = inputs[0]
-            out = await asyncio.get_event_loop().run_in_executor(
-                None, model.predict, payload)
+            out = await self.await_bounded(
+                self.submit_blocking(model.predict, payload), deadline)
             outs = [out.get("instances") if isinstance(out, dict) else out]
         else:
-            fut = self.repo.batcher(name).submit(inputs)
-            outs = await asyncio.wrap_future(fut)
+            fut = self.repo.batcher(name).submit(inputs, deadline=deadline)
+            outs = await self.await_bounded(fut, deadline)
         outs = model.postprocess(outs)
         if not isinstance(outs, (list, tuple)):
             outs = [outs]
@@ -599,9 +813,26 @@ class ModelServer:
     """Hosts a ModelRepository over HTTP; runs inline or on a daemon thread."""
 
     def __init__(self, repo: ModelRepository | None = None,
-                 request_logger: RequestLogger | None = None):
+                 request_logger: RequestLogger | None = None,
+                 admission: AdmissionController | None = None,
+                 max_inflight: int = 256):
         self.repo = repo or ModelRepository()
         self.request_logger = request_logger
+        # max_inflight=0 disables admission control entirely (None);
+        # an explicit controller wins over the convenience knob.
+        if max_inflight < 0:
+            raise ValueError(f"max_inflight must be >= 0, got "
+                             f"{max_inflight}")
+        self.admission = admission
+        if admission is None and max_inflight > 0:
+            self.admission = AdmissionController(max_inflight)
+        # Handler-submitted blocking work runs here (not the asyncio
+        # default executor) so expired requests hand back a CONCURRENT
+        # future: the admission slot can ride it to true completion
+        # instead of freeing while the abandoned call still runs.
+        self.executor = ThreadPoolExecutor(
+            max_workers=min(32, (os.cpu_count() or 1) + 4),
+            thread_name_prefix="tpk-serve-work")
         self._counters: dict[str, dict] = {}
         self._lock = threading.Lock()
         self._loop: tornado.ioloop.IOLoop | None = None
@@ -618,6 +849,25 @@ class ModelServer:
         self._grpc, self.grpc_port = build_grpc_server(self, port)
         self._grpc.start()
         return self.grpc_port
+
+    def readiness(self) -> tuple[bool, str]:
+        """THE readiness rule, shared by the HTTP probe and gRPC
+        ServerReady so the two surfaces cannot drift: not ready while any
+        model is still loading, or while the replica is actively
+        shedding (admission rejections within the last retry_after_s —
+        KServe probe semantics: route around a saturated replica instead
+        of feeding more traffic into 503s; a full-but-quiet replica
+        stays ready)."""
+        for name in self.repo.names():
+            try:
+                model = self.repo.get(name)
+            except Exception:
+                continue  # unloaded between names() and get(): not loading
+            if not model.ready:
+                return False, "models loading"
+        if self.admission is not None and self.admission.shedding:
+            return False, "shedding: admission queue full"
+        return True, ""
 
     def observe(self, model: str, examples: int, seconds: float) -> None:
         with self._lock:
@@ -641,7 +891,16 @@ class ModelServer:
                     f"tpk_serve_examples_total{tag} {c['examples']}",
                     f"tpk_serve_request_seconds_total{tag} {c['seconds']:.6f}",
                 ]
-        return "\n".join(lines) + "\n"
+        if self.admission is not None:
+            lines += [
+                "# TYPE tpk_serve_inflight gauge",
+                f"tpk_serve_inflight {self.admission.inflight}",
+            ]
+        out = "\n".join(lines) + "\n"
+        # The shared resilience counters (retries, deadline expiries,
+        # sheds) render on the same scrape — one metrics surface for the
+        # whole failure story.
+        return out + res_metrics.prometheus_text()
 
     def app(self) -> tornado.web.Application:
         from kubeflow_tpu.serve import openai_api
@@ -686,12 +945,19 @@ class ModelServer:
         return self.port
 
     def stop(self) -> None:
+        grpc_drained = None
         if self._grpc is not None:
-            self._grpc.stop(grace=1.0)
+            grpc_drained = self._grpc.stop(grace=1.0)
         if self._loop is not None:
             self._loop.add_callback(self._loop.stop)
         if self._thread is not None:
             self._thread.join(timeout=5.0)
+        # Executor last, after the gRPC grace window actually drains:
+        # in-flight handlers may still submit_blocking(), and shutting
+        # down first would 500 them with 'cannot schedule new futures'.
+        if grpc_drained is not None:
+            grpc_drained.wait(1.5)
+        self.executor.shutdown(wait=False)
         self.repo.close()
 
     def run(self, port: int) -> None:
@@ -718,6 +984,9 @@ def main(argv: list[str] | None = None) -> int:
                    choices=["metadata", "all"])
     p.add_argument("--grpc-port", type=int, default=None,
                    help="also serve the v2 open-inference gRPC protocol")
+    p.add_argument("--max-inflight", type=int, default=256,
+                   help="admitted-request cap before 503 shedding "
+                        "(0 disables admission control)")
     p.add_argument("--mesh", default=None,
                    help="device mesh for tensor-parallel generative "
                         "serving, e.g. 'tensor=8' or 'tensor=4,data=2' "
@@ -748,7 +1017,8 @@ def main(argv: list[str] | None = None) -> int:
 
     logger = (RequestLogger(args.request_log, args.request_log_mode)
               if args.request_log else None)
-    server = ModelServer(request_logger=logger)
+    server = ModelServer(request_logger=logger,
+                         max_inflight=args.max_inflight)
     for i, d in enumerate(dirs):
         name = args.name[i] if i < len(args.name) else None
         model = runtimes.load_model(d, name=name, mesh=mesh_spec)

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import hashlib
 import os
+import re
 import shutil
 import tarfile
 import zipfile
@@ -31,14 +32,22 @@ REMOTE_SCHEMES = ("s3://", "gs://", "gcs://", "http://", "https://", "hdfs://")
 
 
 def _split_digest(uri: str) -> tuple[str, str | None]:
-    base, _, frag = uri.partition("#")
-    if not frag:
-        return uri, None
-    algo, _, hexd = frag.partition("=")
-    if algo != "sha256" or not hexd:
+    """Split a trailing `#sha256=<64-hex>` digest pin off `uri`.
+
+    Only a fragment that is EXACTLY a sha256 digest counts as a pin.
+    On remote URIs ANY other fragment (`#md5=...`, truncated/typo'd hex,
+    empty) is a loud ValueError — the user clearly intended an integrity
+    pin and silently shipping it to the store as part of the key would
+    drop it. On local paths anything else is part of the filename ('#'
+    is legal there, e.g. `data#v2/model.tar`) and a bad path already
+    fails loudly as FileNotFoundError."""
+    base, sep, frag = uri.rpartition("#")
+    if sep and re.fullmatch(r"sha256=[0-9a-fA-F]{64}", frag):
+        return base, frag[len("sha256="):].lower()
+    if sep and uri.startswith(REMOTE_SCHEMES):
         raise ValueError(
-            f"unsupported digest fragment {frag!r} (use #sha256=<hex>)")
-    return base, hexd.lower()
+            f"unsupported digest fragment {frag!r} (use #sha256=<64-hex>)")
+    return uri, None
 
 
 def _sha256_file(path: str) -> str:

@@ -14,6 +14,13 @@ from typing import Any
 
 import orbax.checkpoint as ocp
 
+from kubeflow_tpu.utils import faults
+
+_FP_SAVE = faults.register_point(
+    "checkpoint.save", "before a checkpoint save lands; ctx: step")
+_FP_RESTORE = faults.register_point(
+    "checkpoint.restore", "before a checkpoint restore; ctx: step")
+
 
 class CheckpointManager:
     """Thin wrapper over ocp.CheckpointManager for TrainState pytrees."""
@@ -35,6 +42,7 @@ class CheckpointManager:
         `data_state` is the input iterator's resume state (a small JSON
         dict from grain get_state()) saved alongside the TrainState so
         resume continues the exact data stream (SURVEY.md §5.4)."""
+        faults.fire(_FP_SAVE, step=step)
         items = {"state": ocp.args.StandardSave(state)}
         if data_state is not None:
             items["data"] = ocp.args.JsonSave(data_state)
@@ -66,6 +74,7 @@ class CheckpointManager:
         step = step if step is not None else self.latest_step()
         if step is None:
             return state_template
+        faults.fire(_FP_RESTORE, step=step)
         if "state" not in self._items(step):
             return self._mgr.restore(
                 step, args=ocp.args.StandardRestore(state_template))

@@ -26,6 +26,13 @@ from kubeflow_tpu.parallel.sharding import rules_for
 from kubeflow_tpu.train.checkpoint import CheckpointManager
 from kubeflow_tpu.train.metrics import MetricsLogger, StepTimer
 from kubeflow_tpu.train.step import init_train_state, make_train_step
+from kubeflow_tpu.utils import faults, resilience
+
+#: Fires at the top of every training step (ctx: step) — arming FailN
+#: with match={"step": K} is the in-process analog of the controller's
+#: TPK_FAULT step-precise process kill.
+_FP_STEP = faults.register_point(
+    "train.step", "top of each training step; ctx: step")
 
 
 @dataclasses.dataclass
@@ -79,6 +86,16 @@ class TrainJobSpec:
     lora: dict = dataclasses.field(default_factory=dict)
     checkpoint: dict = dataclasses.field(default_factory=dict)
     # {"dir": str, "interval": int, "keep": int}
+    # In-process supervision (training-operator restartPolicy/backoffLimit
+    # semantics, SURVEY.md §3.2): "Never" propagates the first failure;
+    # "OnFailure" restarts immediately; "ExponentialBackoff" restarts
+    # with jittered exponential delays. Each restart re-enters the run
+    # loop through the checkpoint auto-resume path (latest step + saved
+    # data-iterator state), so a mid-run failure costs at most one
+    # checkpoint interval of recompute. backoff_limit counts RESTARTS:
+    # the (backoff_limit+1)-th failure raises BackoffLimitExceeded.
+    restart_policy: str = "Never"
+    backoff_limit: int = 3
     metrics_path: str | None = None
     profile: dict = dataclasses.field(default_factory=dict)
     # {"dir": str, "start_step": int, "num_steps": int}
@@ -203,12 +220,19 @@ class Trainer:
             # A non-Llama registry entry chokes on the injected lora_*
             # kwargs with an opaque TypeError from its config dataclass
             # (every builder takes **kw, so a signature pre-check can't
-            # see it). Translate ONLY that case — an unrelated TypeError
-            # from a genuinely Llama-family build keeps its traceback.
-            if self._trainable == "lora" and "lora_" in str(e):
+            # see it). Translate ONLY the unexpected-keyword error for
+            # the exact kwargs WE injected — a TypeError that merely
+            # mentions a lora_* name (e.g. the user's own lora_rnk typo
+            # in model_kwargs) keeps its type, and the original traceback
+            # rides along as __cause__ either way.
+            msg = str(e)
+            injected = ("lora_rank", "lora_alpha", "lora_targets")
+            if (self._trainable == "lora"
+                    and "unexpected keyword argument" in msg
+                    and any(f"'{k}'" in msg for k in injected)):
                 raise ValueError(
                     f"spec.lora needs a Llama-family model; "
-                    f"{spec.model!r} has no adapter path") from None
+                    f"{spec.model!r} has no adapter path") from e
             raise
         if self._trainable == "lora":
             from kubeflow_tpu.models.llama import LlamaConfig
@@ -248,6 +272,14 @@ class Trainer:
         if spec.eval_every < 0 or spec.eval_batches < 1:
             raise ValueError("eval_every must be >= 0 and eval_batches "
                              ">= 1")
+        if spec.restart_policy not in ("Never", "OnFailure",
+                                       "ExponentialBackoff"):
+            raise ValueError(
+                f"restart_policy {spec.restart_policy!r}: Never | "
+                "OnFailure | ExponentialBackoff")
+        if spec.backoff_limit < 0:
+            raise ValueError(f"backoff_limit must be >= 0, got "
+                             f"{spec.backoff_limit}")
         self.tx = optax.adamw(self._lr_schedule(),
                               weight_decay=spec.weight_decay)
         if spec.max_grad_norm:
@@ -399,6 +431,56 @@ class Trainer:
     # -- run ----------------------------------------------------------------
 
     def run(self) -> dict:
+        """Supervised entry point: runs the training loop under the
+        spec's restart policy (training-operator restartPolicy/
+        backoffLimit, in-process). Every restart flows through
+        `_run_once`'s checkpoint auto-resume — latest TrainState AND the
+        saved data-iterator position — so the run converges to the same
+        final step a fault-free run reaches."""
+        spec = self.spec
+        if spec.restart_policy == "Never":
+            return self._run_once()
+        backoff = resilience.BackoffPolicy(initial_s=0.05, max_s=10.0)
+        restarts = 0
+        while True:
+            try:
+                return self._run_once()
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                if self._ckpt is not None:
+                    # An async save may be mid-flight; restarting before
+                    # it lands could resume from the previous (older)
+                    # step. Failures inside wait() itself mean the ckpt
+                    # dir is suspect — surface the original error.
+                    try:
+                        self._ckpt.wait()
+                    except Exception:
+                        pass
+                restarts += 1
+                if restarts > spec.backoff_limit:
+                    resilience.metrics.inc("tpk_retry_exhausted_total",
+                                           component="train")
+                    raise resilience.BackoffLimitExceeded(
+                        f"training failed {restarts} times "
+                        f"(backoff_limit={spec.backoff_limit}, "
+                        f"restart_policy={spec.restart_policy}): "
+                        f"{type(e).__name__}: {e}") from e
+                # Counted only when a restart actually happens — the
+                # terminal failure above is an exhaustion, not a restart.
+                resilience.metrics.inc("tpk_restarts_total",
+                                       component="train")
+                delay = (backoff.delay(restarts - 1)
+                         if spec.restart_policy == "ExponentialBackoff"
+                         else 0.0)
+                self.logger.log(0, {
+                    "event": "restarting", "attempt": restarts,
+                    "backoff_s": round(delay, 3),
+                    "error": f"{type(e).__name__}: {e}"})
+                if delay:
+                    time.sleep(delay)
+
+    def _run_once(self) -> dict:
         spec = self.spec
 
         model_kwargs = {}
@@ -557,6 +639,7 @@ class Trainer:
         timer.start()
         window = 0
         for step in range(start_step, spec.steps):
+            faults.fire(_FP_STEP, step=step)
             if fault_step is not None and step == fault_step:
                 if self._ckpt is not None:
                     self._ckpt.wait()  # die with a consistent checkpoint
@@ -642,8 +725,8 @@ def main(argv: list[str] | None = None) -> int:
     args = parser.parse_args(argv)
 
     if args.cpu_devices:
-        jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+        from kubeflow_tpu.utils.devices import force_cpu_device_count
+        force_cpu_device_count(args.cpu_devices)
 
     with open(args.spec) as fh:
         spec = TrainJobSpec.from_json(fh.read())

@@ -1,0 +1,25 @@
+"""Virtual-device plumbing shared by the test harness and CLI test modes."""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_device_count(n: int) -> None:
+    """Pin jax to the CPU backend with `n` virtual devices — the
+    device-plane analog of envtest/kind: real XLA collectives over `n`
+    host devices. Works on jax >= 0.5 (`jax_num_cpu_devices` config) and
+    older jax (XLA_FLAGS, read at first backend init). Must run before
+    any backend use; importing jax beforehand is fine."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:
+        # jax < 0.5 has no jax_num_cpu_devices; XLA_FLAGS is still read
+        # at first backend init, which has not happened yet.
+        flag = f"--xla_force_host_platform_device_count={n}"
+        if flag not in os.environ.get("XLA_FLAGS", ""):
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "") + " " + flag).strip()

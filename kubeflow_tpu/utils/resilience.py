@@ -1,0 +1,235 @@
+"""Shared resilience primitives: backoff, deadlines, retry budgets.
+
+The reference platform spreads failure semantics across subsystems —
+training-operator restartPolicy/backoffLimit on the job spec, client-go
+rate limiters + wait.Backoff in every controller, Knative/KServe request
+timeouts and probe-driven readiness (SURVEY.md §2.1/§2.2/§3.2). Here ONE
+module owns the primitives so train, controlplane, and serve agree on
+semantics and metric names:
+
+  * `BackoffPolicy` — exponential backoff with decorrelated jitter (the
+    client-go / AWS-architecture-blog recipe); deterministic when handed
+    a seeded rng, which is how the fault-injection tests pin schedules.
+  * `Deadline` — an absolute budget on the monotonic clock, threaded
+    through call stacks instead of per-hop flat timeouts (gRPC-style
+    deadline propagation). `DeadlineExceeded` is the one typed expiry
+    error every layer raises (serve maps it to HTTP 504).
+  * `RetryBudget` — SRE-style token bucket capping the retry *ratio*, so
+    a hard-down dependency sees a bounded trickle, not attempts×clients.
+  * `retry_call` — the one retry loop (attempt cap AND deadline cap,
+    backoff between attempts, metrics per attempt/exhaustion).
+  * `metrics` — process-global counters with uniform names
+    (`tpk_retry_attempts_total`, `tpk_deadline_expired_total`, ...);
+    the model server's /metrics endpoint renders them alongside its own.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import threading
+import time
+from typing import Any, Callable
+
+
+class DeadlineExceeded(TimeoutError):
+    """A per-request/per-call budget expired (serve maps this to 504)."""
+
+
+class BackoffLimitExceeded(RuntimeError):
+    """A supervised retry loop exhausted its restart/attempt budget (the
+    training-operator's `backoffLimit` failure, typed)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class BackoffPolicy:
+    """Exponential backoff schedule with full jitter.
+
+    `delay(attempt)` is the sleep before retry number `attempt` (0-based):
+    base·multiplier^attempt, capped at `max_s`, then jittered down by up
+    to `jitter` fraction (uniform). Pass a seeded `random.Random` for a
+    deterministic schedule (tests); the default draws from the module rng.
+    """
+
+    initial_s: float = 0.05
+    max_s: float = 5.0
+    multiplier: float = 2.0
+    jitter: float = 0.5
+
+    def delay(self, attempt: int, rng: random.Random | None = None) -> float:
+        d = min(self.initial_s * self.multiplier ** max(attempt, 0),
+                self.max_s)
+        if self.jitter > 0:
+            u = (rng or _RNG).random()
+            d *= 1.0 - self.jitter * u
+        return d
+
+
+_RNG = random.Random()
+
+
+class Deadline:
+    """Absolute time budget on the monotonic clock.
+
+    `Deadline(None)` never expires — callers thread one object through
+    unconditionally instead of branching on "has a deadline". The clock is
+    injectable so tests advance time without sleeping.
+    """
+
+    __slots__ = ("_clock", "_at")
+
+    def __init__(self, budget_s: float | None,
+                 clock: Callable[[], float] = time.monotonic):
+        self._clock = clock
+        self._at = None if budget_s is None else clock() + float(budget_s)
+
+    @classmethod
+    def never(cls) -> "Deadline":
+        return cls(None)
+
+    def remaining(self) -> float | None:
+        """Seconds left (may be <= 0), or None for a never-expiring one."""
+        if self._at is None:
+            return None
+        return self._at - self._clock()
+
+    def expired(self) -> bool:
+        return self._at is not None and self._clock() >= self._at
+
+    def bound(self, timeout: float) -> float:
+        """`timeout` clipped to the remaining budget (for per-hop socket/
+        wait timeouts under an end-to-end deadline)."""
+        rem = self.remaining()
+        return timeout if rem is None else min(timeout, max(rem, 0.0))
+
+    def require(self, what: str = "operation",
+                component: str = "") -> None:
+        """Raise `DeadlineExceeded` (and count it) if the budget is gone."""
+        if self.expired():
+            if component:
+                metrics.inc("tpk_deadline_expired_total",
+                            component=component)
+            raise DeadlineExceeded(f"deadline expired before {what}")
+
+
+class RetryBudget:
+    """Token-bucket retry budget (the SRE retry-ratio cap).
+
+    Every first attempt deposits `deposit_per_call` tokens (clipped at
+    `capacity`); every retry withdraws one. When the bucket is empty,
+    `allow()` refuses — so a dependency that is hard-down sees retries in
+    proportion to fresh traffic, never an amplified storm.
+    """
+
+    def __init__(self, capacity: float = 10.0,
+                 deposit_per_call: float = 0.1):
+        self.capacity = float(capacity)
+        self.deposit_per_call = float(deposit_per_call)
+        self._tokens = float(capacity)
+        self._lock = threading.Lock()
+
+    def deposit(self) -> None:
+        with self._lock:
+            self._tokens = min(self._tokens + self.deposit_per_call,
+                               self.capacity)
+
+    def allow(self) -> bool:
+        with self._lock:
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            return False
+
+    @property
+    def tokens(self) -> float:
+        with self._lock:
+            return self._tokens
+
+
+def retry_call(fn: Callable[[], Any], *,
+               retry_on: tuple[type[BaseException], ...],
+               policy: BackoffPolicy | None = None,
+               max_attempts: int = 5,
+               deadline: Deadline | None = None,
+               budget: RetryBudget | None = None,
+               component: str = "",
+               sleep: Callable[[float], None] = time.sleep,
+               rng: random.Random | None = None) -> Any:
+    """Run `fn` under the unified retry semantics.
+
+    Retries only `retry_on` exceptions, waiting `policy.delay(i)` between
+    attempts, until `max_attempts` calls have failed OR the deadline
+    cannot cover the next backoff sleep OR the retry budget refuses. On
+    exhaustion the LAST error re-raises (callers wrap it in their typed
+    error: `ControlPlaneUnavailable`, `BackoffLimitExceeded`, ...).
+    """
+    policy = policy or BackoffPolicy()
+    deadline = deadline or Deadline.never()
+    if budget is not None:
+        budget.deposit()
+    attempt = 0
+    while True:
+        deadline.require("attempt", component=component)
+        try:
+            return fn()
+        except retry_on as e:
+            attempt += 1
+            metrics.inc("tpk_retry_attempts_total", component=component)
+            delay = policy.delay(attempt - 1, rng=rng)
+            rem = deadline.remaining()
+            if (attempt >= max_attempts
+                    or (rem is not None and rem <= delay)
+                    or (budget is not None and not budget.allow())):
+                metrics.inc("tpk_retry_exhausted_total",
+                            component=component)
+                raise
+            sleep(delay)
+
+
+class Counters:
+    """Process-global labeled counters with prometheus rendering — the
+    uniform metrics surface every resilience consumer increments."""
+
+    def __init__(self):
+        self._counts: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, name: str, value: float = 1.0, **labels: str) -> None:
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            self._counts[key] = self._counts.get(key, 0.0) + value
+
+    def get(self, name: str, **labels: str) -> float:
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            return self._counts.get(key, 0.0)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                name + ("{%s}" % ",".join(f'{k}="{v}"' for k, v in lbl)
+                        if lbl else ""): v
+                for (name, lbl), v in sorted(self._counts.items())}
+
+    def prometheus_text(self) -> str:
+        lines = []
+        seen: set[str] = set()
+        with self._lock:
+            items = sorted(self._counts.items())
+        for (name, lbl), v in items:
+            if name not in seen:
+                seen.add(name)
+                lines.append(f"# TYPE {name} counter")
+            tag = ("{%s}" % ",".join(f'{k}="{v2}"' for k, v2 in lbl)
+                   if lbl else "")
+            val = int(v) if float(v).is_integer() else v
+            lines.append(f"{name}{tag} {val}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def reset(self) -> None:
+        """Test hook — counters are process-global."""
+        with self._lock:
+            self._counts.clear()
+
+
+metrics = Counters()

@@ -11,12 +11,16 @@ import os
 # The axon sitecustomize imports jax at interpreter start with
 # JAX_PLATFORMS=axon, so env vars are too late here — use jax.config,
 # which works post-import as long as no backend has been touched yet.
+# force_cpu_device_count covers jax < 0.5 (no jax_num_cpu_devices
+# option) via XLA_FLAGS, which IS read at first backend init.
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+from kubeflow_tpu.utils.devices import force_cpu_device_count  # noqa: E402
+
+force_cpu_device_count(8)
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
 jax.config.update("jax_debug_nans", False)
 
 import pytest  # noqa: E402
@@ -28,6 +32,11 @@ def pytest_configure(config):
         "slow: multi-process / e2e / AOT-compile tests. The default "
         "iteration tier is `pytest -m 'not slow'`; CI and round-end runs "
         "use the full suite (see README Testing).")
+    config.addinivalue_line(
+        "markers",
+        "faults: fault-injection / resilience tests (utils/faults.py "
+        "harness). Unmarked slow-wise, so `-m 'not slow'` still "
+        "collects them; `-m faults` runs the failure story alone.")
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,713 @@
+"""Fault-injection harness + unified resilience layer (ISSUE 1).
+
+Covers the three wired layers the way the reference stack's own suites
+do — training-operator e2e kills workers to exercise restartPolicy,
+client-go retries against fake clients that error N times, KServe sheds
+and times out under probe control:
+
+  * harness determinism / policy exhaustion / scoping (utils/faults.py)
+  * resilience primitives: backoff, deadline clock, retry budget,
+    retry_call (utils/resilience.py)
+  * controlplane client retry/backoff against a refusing socket
+  * trainer supervised restart + checkpoint auto-resume + backoff_limit
+  * serve request deadlines (504) and admission shedding (503 +
+    Retry-After, readiness degradation)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import socketserver
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.utils import faults, resilience
+from kubeflow_tpu.utils.resilience import (BackoffPolicy, Deadline,
+                                           DeadlineExceeded, RetryBudget,
+                                           retry_call)
+
+pytestmark = pytest.mark.faults
+
+#: Module-local injection point: the harness unit tests must not
+#: depend on which instrumented subsystems happen to be imported.
+_TP = faults.register_point("tests.unit", "test-local point")
+
+
+# -- harness ----------------------------------------------------------------
+
+
+def test_fire_is_noop_when_disarmed():
+    assert faults.active() is None
+    faults.fire("tests.unit", step=3)  # must not raise, count, or sleep
+
+
+def test_arm_unknown_point_rejected():
+    with faults.harness() as h:
+        with pytest.raises(ValueError, match="unknown injection point"):
+            h.arm("no.such.point", faults.FailN(1))
+
+
+def test_failn_exhaustion_and_counts():
+    with faults.harness() as h:
+        h.arm("tests.unit", faults.FailN(2, RuntimeError))
+        for _ in range(2):
+            with pytest.raises(RuntimeError, match="injected fault"):
+                faults.fire("tests.unit", step=0)
+        faults.fire("tests.unit", step=0)  # exhausted: passes through
+        assert h.counts["tests.unit"] == {
+            "fired": 3, "injected": 2, "delayed": 0}
+
+
+def test_failn_match_restricts_to_context():
+    with faults.harness() as h:
+        h.arm("tests.unit", faults.FailN(1, match={"step": 4}))
+        for step in (2, 3):
+            faults.fire("tests.unit", step=step)
+        with pytest.raises(faults.FaultError):
+            faults.fire("tests.unit", step=4)
+        faults.fire("tests.unit", step=4)  # n exhausted
+        # Non-matching firings count as fired but never inject.
+        assert h.counts["tests.unit"]["injected"] == 1
+
+
+def test_failprob_deterministic_per_seed():
+    def run(seed):
+        hits = []
+        with faults.harness(seed=seed) as h:
+            h.arm("tests.unit", faults.FailProb(0.5))
+            for i in range(32):
+                try:
+                    faults.fire("tests.unit", step=i)
+                    hits.append(0)
+                except faults.FaultError:
+                    hits.append(1)
+        return hits
+
+    assert run(7) == run(7)  # same seed + firing order => same faults
+    assert run(7) != run(8)  # and the seed actually matters
+    assert 0 < sum(run(7)) < 32
+
+
+def test_latency_policy_delays():
+    with faults.harness() as h:
+        h.arm("tests.unit", faults.Latency(0.05))
+        t0 = time.monotonic()
+        faults.fire("tests.unit", batch=1)
+        assert time.monotonic() - t0 >= 0.04
+        assert h.counts["tests.unit"]["delayed"] == 1
+
+
+def test_harness_scoping_and_no_nesting():
+    with pytest.raises(RuntimeError):
+        with faults.harness() as h:
+            h.arm("tests.unit", faults.FailN(100))
+            with pytest.raises(RuntimeError, match="already installed"):
+                with faults.harness():
+                    pass
+            raise RuntimeError("workload crash")
+    # Uninstalled even though the workload raised: nothing leaks.
+    assert faults.active() is None
+    faults.fire("tests.unit", step=0)
+
+
+def test_disarmed_fire_is_cheap():
+    # The whole production cost of the harness is one global read — a
+    # generous bound that still catches an accidental lock or dict walk
+    # on the disarmed path.
+    t0 = time.monotonic()
+    for i in range(10_000):
+        faults.fire("tests.unit", step=i)
+    assert time.monotonic() - t0 < 0.5
+
+
+# -- resilience primitives --------------------------------------------------
+
+
+def test_backoff_policy_schedule():
+    import random
+
+    pol = BackoffPolicy(initial_s=0.1, max_s=1.0, multiplier=2.0,
+                        jitter=0.5)
+    a = [pol.delay(i, rng=random.Random(3)) for i in range(6)]
+    b = [pol.delay(i, rng=random.Random(3)) for i in range(6)]
+    assert a == b  # deterministic under a seeded rng
+    for i, d in enumerate(a):
+        ceil = min(0.1 * 2 ** i, 1.0)
+        assert 0.5 * ceil <= d <= ceil  # jittered down by at most 50%
+    nojit = BackoffPolicy(initial_s=0.1, max_s=1.0, jitter=0.0)
+    assert [nojit.delay(i) for i in range(5)] == [
+        pytest.approx(v) for v in (0.1, 0.2, 0.4, 0.8, 1.0)]
+
+
+def test_deadline_fake_clock():
+    now = [100.0]
+    d = Deadline(5.0, clock=lambda: now[0])
+    assert d.remaining() == pytest.approx(5.0)
+    assert d.bound(30.0) == pytest.approx(5.0)
+    assert d.bound(2.0) == pytest.approx(2.0)
+    assert not d.expired()
+    now[0] += 6.0
+    assert d.expired()
+    assert d.bound(30.0) == 0.0
+    with pytest.raises(DeadlineExceeded):
+        d.require("the test op")
+    never = Deadline.never()
+    assert never.remaining() is None
+    assert not never.expired()
+    never.require("anything")
+
+
+def test_retry_budget_caps_ratio():
+    b = RetryBudget(capacity=2.0, deposit_per_call=0.5)
+    assert b.allow() and b.allow()
+    assert not b.allow()  # bucket empty: the retry storm stops here
+    for _ in range(2):
+        b.deposit()
+    assert b.allow()
+    assert not b.allow()
+
+
+def test_retry_call_retries_then_succeeds():
+    resilience.metrics.reset()
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionRefusedError("down")
+        return "up"
+
+    out = retry_call(flaky, retry_on=(ConnectionRefusedError,),
+                     policy=BackoffPolicy(initial_s=0.001, max_s=0.002),
+                     max_attempts=5, component="test", sleep=lambda s: None)
+    assert out == "up" and len(calls) == 3
+    assert resilience.metrics.get("tpk_retry_attempts_total",
+                                  component="test") == 2
+
+
+def test_retry_call_exhaustion_reraises_last_error():
+    def always():
+        raise ConnectionResetError("still down")
+
+    with pytest.raises(ConnectionResetError):
+        retry_call(always, retry_on=(ConnectionResetError,),
+                   policy=BackoffPolicy(initial_s=0.001),
+                   max_attempts=3, sleep=lambda s: None)
+
+
+def test_retry_call_respects_deadline():
+    now = [0.0]
+    sleeps = []
+
+    def always():
+        raise ConnectionRefusedError
+
+    with pytest.raises(ConnectionRefusedError):
+        retry_call(always, retry_on=(ConnectionRefusedError,),
+                   policy=BackoffPolicy(initial_s=10.0, jitter=0.0),
+                   max_attempts=100,
+                   deadline=Deadline(5.0, clock=lambda: now[0]),
+                   sleep=sleeps.append)
+    # The 10s backoff cannot fit the 5s budget: no sleep ever happens.
+    assert sleeps == []
+
+
+def test_retry_call_unlisted_error_propagates():
+    def boom():
+        raise ValueError("not transient")
+
+    with pytest.raises(ValueError):
+        retry_call(boom, retry_on=(ConnectionRefusedError,),
+                   max_attempts=5, sleep=lambda s: None)
+
+
+def test_counters_prometheus_text():
+    c = resilience.Counters()
+    c.inc("tpk_retry_attempts_total", component="x")
+    c.inc("tpk_retry_attempts_total", component="x")
+    c.inc("tpk_shed_total")
+    text = c.prometheus_text()
+    assert "# TYPE tpk_retry_attempts_total counter" in text
+    assert 'tpk_retry_attempts_total{component="x"} 2' in text
+    assert "tpk_shed_total 1" in text
+
+
+# -- controlplane client retry ----------------------------------------------
+
+
+class _FakeControlPlane(socketserver.ThreadingUnixStreamServer):
+    """Line-JSON UDS server that answers every request {"ok": true}."""
+
+    class Handler(socketserver.StreamRequestHandler):
+        def handle(self):
+            while True:
+                line = self.rfile.readline()
+                if not line:
+                    return
+                req = json.loads(line)
+                self.wfile.write(json.dumps(
+                    {"ok": True, "pong": True,
+                     "op": req.get("op")}).encode() + b"\n")
+
+    def __init__(self, path):
+        super().__init__(path, self.Handler)
+        self.daemon_threads = True
+
+
+@pytest.fixture()
+def fake_cp(tmp_path):
+    path = str(tmp_path / "cp.sock")
+    srv = _FakeControlPlane(path)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield path
+    srv.shutdown()
+    srv.server_close()
+
+
+def test_client_retries_transient_refusals(fake_cp):
+    from kubeflow_tpu.controlplane.client import Client
+
+    client = Client(fake_cp, timeout=5.0,
+                    retry=BackoffPolicy(initial_s=0.001, max_s=0.01))
+    with faults.harness() as h:
+        h.arm("controlplane.request",
+              faults.FailN(2, ConnectionRefusedError))
+        resp = client.request(op="ping")
+        assert resp["pong"] is True
+        assert h.counts["controlplane.request"]["injected"] == 2
+        assert h.counts["controlplane.request"]["fired"] == 3
+    client.close()
+
+
+def test_client_reconnects_after_truncated_read(fake_cp):
+    from kubeflow_tpu.controlplane.client import (Client,
+                                                  ControlPlaneDisconnected)
+
+    client = Client(fake_cp, timeout=5.0,
+                    retry=BackoffPolicy(initial_s=0.001, max_s=0.01))
+    with faults.harness() as h:
+        h.arm("controlplane.request",
+              faults.FailN(1, ControlPlaneDisconnected("truncated")))
+        assert client.request(op="ping")["pong"] is True
+    client.close()
+
+
+def test_client_unavailable_after_exhaustion(tmp_path):
+    from kubeflow_tpu.controlplane.client import (Client,
+                                                  ControlPlaneError,
+                                                  ControlPlaneUnavailable)
+
+    resilience.metrics.reset()
+    client = Client(str(tmp_path / "nobody-home.sock"), timeout=5.0,
+                    retry=BackoffPolicy(initial_s=0.001, max_s=0.01),
+                    max_attempts=3)
+    with pytest.raises(ControlPlaneUnavailable) as ei:
+        client.request(op="ping")
+    assert "3 attempt" in str(ei.value)
+    assert isinstance(ei.value.__cause__, OSError)  # original chained
+    assert isinstance(ei.value, ControlPlaneError)  # typed subset
+    assert resilience.metrics.get("tpk_retry_exhausted_total",
+                                  component="controlplane") == 1
+
+
+def test_client_deadline_budget_caps_wall_clock(tmp_path):
+    from kubeflow_tpu.controlplane.client import ControlPlaneUnavailable
+    from kubeflow_tpu.controlplane.client import Client
+
+    client = Client(str(tmp_path / "nobody-home.sock"), timeout=5.0,
+                    retry=BackoffPolicy(initial_s=0.2, max_s=0.2,
+                                        jitter=0.0),
+                    max_attempts=100, deadline_s=0.15)
+    t0 = time.monotonic()
+    with pytest.raises(ControlPlaneUnavailable):
+        client.request(op="ping")
+    # The 0.2s backoff never fits the 0.15s budget: one attempt, no sleep.
+    assert time.monotonic() - t0 < 1.0
+
+
+def test_client_mid_exchange_disconnect_not_replayed_for_mutations(fake_cp):
+    from kubeflow_tpu.controlplane.client import (Client,
+                                                  ControlPlaneDisconnected,
+                                                  ControlPlaneUnavailable)
+
+    client = Client(fake_cp, timeout=5.0,
+                    retry=BackoffPolicy(initial_s=0.001, max_s=0.01))
+    with faults.harness() as h:
+        h.arm("controlplane.request",
+              faults.FailN(99, ControlPlaneDisconnected("truncated")))
+        # A read-only verb replays through the disconnect...
+        with pytest.raises(ControlPlaneUnavailable):
+            client.request(op="get", kind="JAXJob", name="x")
+        assert h.counts["controlplane.request"]["fired"] > 1
+        fired = h.counts["controlplane.request"]["fired"]
+        # ...but a mutating verb fails fast: the server may already have
+        # applied it, so the ambiguity surfaces instead of a double-apply.
+        with pytest.raises(ControlPlaneUnavailable,
+                           match="non-idempotent"):
+            client.request(op="create", kind="JAXJob", name="x", spec={})
+        assert h.counts["controlplane.request"]["fired"] == fired + 1
+    client.close()
+
+
+def test_client_single_attempt_restores_old_behavior(tmp_path):
+    from kubeflow_tpu.controlplane.client import (Client,
+                                                  ControlPlaneUnavailable)
+
+    client = Client(str(tmp_path / "nobody-home.sock"), max_attempts=1)
+    t0 = time.monotonic()
+    with pytest.raises(ControlPlaneUnavailable):
+        client.request(op="ping")
+    assert time.monotonic() - t0 < 0.5  # no backoff sleeps at all
+
+
+# -- trainer supervised restart ---------------------------------------------
+
+
+def _mnist_spec(tmp_path, name, **kw):
+    from kubeflow_tpu.train.trainer import TrainJobSpec
+
+    base = dict(model="mnist_mlp", dataset="mnist_like", strategy="dp",
+                mesh={"data": 8}, steps=8, batch_size=16,
+                learning_rate=1e-2, log_every=4,
+                checkpoint={"dir": str(tmp_path / name), "interval": 2,
+                            "keep": 3})
+    base.update(kw)
+    return TrainJobSpec(**base)
+
+
+def test_trainer_resumes_after_injected_step_failure(tmp_path, devices8):
+    from kubeflow_tpu.train.trainer import Trainer
+
+    # Reference run, no faults.
+    clean = Trainer(_mnist_spec(tmp_path, "clean")).run()
+
+    spec = _mnist_spec(tmp_path, "faulted", restart_policy="OnFailure",
+                       backoff_limit=2)
+    with faults.harness() as h:
+        h.arm("train.step", faults.FailN(1, match={"step": 5}))
+        result = Trainer(spec).run()
+        assert h.counts["train.step"]["injected"] == 1
+    # Killed at step 5, resumed from the step-4 checkpoint, and still
+    # reached the same final step as a fault-free run...
+    assert result["final_step"] == 8 == clean["final_step"]
+    # ...with the same data order (replayed through the resume path) and
+    # optimizer state, hence the same final loss.
+    np.testing.assert_allclose(result["loss"], clean["loss"], rtol=1e-4)
+    assert resilience.metrics.get("tpk_restarts_total",
+                                  component="train") >= 1
+    from kubeflow_tpu.train.checkpoint import CheckpointManager
+
+    assert CheckpointManager(spec.checkpoint["dir"]).latest_step() == 8
+
+
+def test_trainer_backoff_limit_exhaustion_is_typed(tmp_path, devices8):
+    from kubeflow_tpu.train.trainer import Trainer
+
+    spec = _mnist_spec(tmp_path, "doomed", restart_policy="OnFailure",
+                       backoff_limit=1, steps=4)
+    with faults.harness() as h:
+        h.arm("train.step", faults.FailN(99, match={"step": 1}))
+        with pytest.raises(resilience.BackoffLimitExceeded,
+                           match="backoff_limit=1"):
+            Trainer(spec).run()
+        # initial run + 1 restart, each killed at step 1.
+        assert h.counts["train.step"]["injected"] == 2
+
+
+def test_trainer_restart_policy_validation(devices8):
+    from kubeflow_tpu.train.trainer import TrainJobSpec, Trainer
+
+    with pytest.raises(ValueError, match="restart_policy"):
+        Trainer(TrainJobSpec(model="mnist_mlp", dataset="mnist_like",
+                             strategy="dp", mesh={"data": 8},
+                             restart_policy="Always"))
+    with pytest.raises(ValueError, match="backoff_limit"):
+        Trainer(TrainJobSpec(model="mnist_mlp", dataset="mnist_like",
+                             strategy="dp", mesh={"data": 8},
+                             backoff_limit=-1))
+
+
+# -- serve deadlines + shedding ---------------------------------------------
+
+
+def _http(method, url, body=None, headers=None):
+    req = urllib.request.Request(
+        url, method=method,
+        data=json.dumps(body).encode() if body is not None else None,
+        headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.loads(r.read() or b"{}"), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}"), dict(e.headers)
+
+
+@pytest.fixture()
+def shed_server():
+    from kubeflow_tpu.serve import AdmissionController, Model, ModelServer
+
+    class Echo(Model):
+        def predict(self, inputs):
+            return [np.asarray(inputs[0]) * 2]
+
+        def generate(self, payload):
+            from kubeflow_tpu.utils.resilience import Deadline
+            dl = payload.get("_deadline")
+            assert dl is None or isinstance(dl, Deadline)
+            return {"text": "ok", "num_output_tokens": 1,
+                    "saw_deadline": dl is not None}
+
+    srv = ModelServer(admission=AdmissionController(max_inflight=1,
+                                                    retry_after_s=2.0))
+    srv.repo.register(Echo("echo"))
+    port = srv.start_background()
+    yield f"http://127.0.0.1:{port}", srv
+    srv.stop()
+
+
+def test_serve_504_on_expired_deadline(shed_server):
+    base, _ = shed_server
+    resilience.metrics.reset()
+    with faults.harness() as h:
+        h.arm("serve.predict", faults.Latency(0.5))
+        code, body, _ = _http("POST", f"{base}/v1/models/echo:predict",
+                              {"instances": [[1, 2]]},
+                              {"X-Request-Timeout-Ms": "60"})
+    assert code == 504
+    assert "deadline" in body["error"].lower()
+    # The HTTP surface counts each expired request exactly once (inner
+    # layers free resources without counting), so this is deterministic.
+    assert resilience.metrics.get("tpk_deadline_expired_total",
+                                  component="serve") == 1
+
+
+def test_serve_bad_deadline_header_400(shed_server):
+    base, _ = shed_server
+    # Non-numeric, non-finite, and non-positive are all client errors —
+    # NaN in particular would defeat every expiry comparison downstream.
+    for bad in ("soon", "nan", "inf", "-5", "0"):
+        code, body, _ = _http("POST", f"{base}/v1/models/echo:predict",
+                              {"instances": [[1, 2]]},
+                              {"X-Request-Timeout-Ms": bad})
+        assert code == 400 and "X-Request-Timeout-Ms" in body["error"], bad
+
+
+def test_serve_wire_deadline_field_is_stripped(shed_server):
+    # "_deadline" is in-process only; a client smuggling it into the
+    # :generate body must never reach the model as a non-Deadline value
+    # (it would crash the engine with a 500).
+    base, _ = shed_server
+    code, body, _ = _http("POST", f"{base}/v1/models/echo:generate",
+                          {"input_ids": [1, 2], "_deadline": 123})
+    assert code == 200 and body["saw_deadline"] is False
+    # The header-derived Deadline still rides in under the same key.
+    code, body, _ = _http("POST", f"{base}/v1/models/echo:generate",
+                          {"input_ids": [1, 2], "_deadline": 123},
+                          {"X-Request-Timeout-Ms": "30000"})
+    assert code == 200 and body["saw_deadline"] is True
+
+
+def test_expired_request_slot_rides_work_to_completion(shed_server):
+    base, srv = shed_server
+    with faults.harness() as h:
+        h.arm("serve.predict", faults.Latency(1.0))
+        code, body, _ = _http("POST", f"{base}/v1/models/echo:predict",
+                              {"instances": [[1, 2]]},
+                              {"X-Request-Timeout-Ms": "60"})
+        assert code == 504
+        # The 504 went out but the abandoned batch is still executing:
+        # the admission slot stays held (max_inflight bounds concurrent
+        # WORK, not just concurrent waiting callers)...
+        assert srv.admission.inflight == 1
+        # ...and frees when the work actually finishes.
+        t0 = time.monotonic()
+        while srv.admission.inflight > 0 and time.monotonic() - t0 < 5.0:
+            time.sleep(0.02)
+        assert srv.admission.inflight == 0
+
+
+def test_negative_max_inflight_rejected():
+    from kubeflow_tpu.serve import ModelServer
+
+    with pytest.raises(ValueError, match="max_inflight"):
+        ModelServer(max_inflight=-1)
+
+
+def test_serve_sheds_and_degrades_readiness_under_overload(shed_server):
+    base, srv = shed_server
+    resilience.metrics.reset()
+    results = []
+    with faults.harness() as h:
+        h.arm("serve.predict", faults.Latency(1.0))
+        t = threading.Thread(
+            target=lambda: results.append(
+                _http("POST", f"{base}/v1/models/echo:predict",
+                      {"instances": [[1, 2]]})))
+        t.start()
+        # Wait until the slow request is actually admitted (inflight=1)
+        # rather than racing it with a fixed sleep.
+        deadline = time.monotonic() + 5.0
+        while (srv.admission.inflight < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert srv.admission.inflight == 1
+
+        # Full but not rejecting: readiness HOLDS — one long request on
+        # a small-capacity replica must not pull it from the endpoint
+        # set (Knative queue-proxy stays ready at containerConcurrency).
+        code, _, _ = _http("GET", f"{base}/v2/health/ready")
+        assert code == 200
+
+        # Overload: the second request is shed, not queued.
+        code, body, headers = _http(
+            "POST", f"{base}/v1/models/echo:predict",
+            {"instances": [[3, 4]]})
+        assert code == 503 and "overloaded" in body["error"]
+        assert headers.get("Retry-After") == "2"
+
+        # The OpenAI facade sits behind the SAME admission gate — it
+        # must not be an unbounded side door around max_inflight — and
+        # its shed wears the OpenAI error envelope (SDKs parse
+        # error.message/error.type, not a bare string).
+        code, body, _ = _http("POST", f"{base}/openai/v1/chat/completions",
+                              {"model": "echo", "messages": []})
+        assert code == 503 and "overloaded" in body["error"]["message"]
+        assert body["error"]["type"] == "overloaded_error"
+
+        # Readiness degrades while at capacity...
+        code, body, _ = _http("GET", f"{base}/v2/health/ready")
+        assert code == 503 and "shedding" in body["error"]
+        # ...but liveness does not (the replica is healthy, just full).
+        code, _, _ = _http("GET", f"{base}/v2/health/live")
+        assert code == 200
+        t.join(timeout=10)
+
+    # The admitted request completed fine, and readiness recovered.
+    assert results and results[0][0] == 200
+    assert results[0][1]["predictions"] == [[2, 4]]
+    code, _, _ = _http("GET", f"{base}/v2/health/ready")
+    assert code == 200
+    assert resilience.metrics.get("tpk_shed_total", component="serve") == 2
+    # The shared counters surface on the same /metrics scrape.
+    req = urllib.request.Request(f"{base}/metrics")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        text = r.read().decode()
+    assert 'tpk_shed_total{component="serve"} 2' in text
+    assert "tpk_serve_inflight 0" in text
+
+
+def test_grpc_plane_shares_admission_and_deadlines(shed_server):
+    # The gRPC data plane must not be an unbounded side door around
+    # max_inflight, and its native (client-set) deadline rides the same
+    # shared Deadline clock as the HTTP timeout header.
+    grpc = pytest.importorskip("grpc")
+    from kubeflow_tpu.serve import open_inference_pb2 as pb
+    from kubeflow_tpu.serve.grpc_server import InferenceClient
+
+    base, srv = shed_server
+    port = srv.start_grpc()
+    client = InferenceClient(f"127.0.0.1:{port}")
+    x = np.asarray([[1.0, 2.0]], np.float32)
+    try:
+        np.testing.assert_allclose(client.infer("echo", [x])[0], x * 2)
+
+        resilience.metrics.reset()
+        with faults.harness() as h:
+            h.arm("serve.predict", faults.Latency(1.0))
+            t = threading.Thread(
+                target=lambda: _http(
+                    "POST", f"{base}/v1/models/echo:predict",
+                    {"instances": [[1, 2]]}))
+            t.start()
+            deadline = time.monotonic() + 5.0
+            while (srv.admission.inflight < 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            assert srv.admission.inflight == 1
+
+            # At capacity: gRPC infer is shed with RESOURCE_EXHAUSTED
+            # (the 503 analog), and ServerReady degrades like the probe.
+            with pytest.raises(grpc.RpcError) as e:
+                client.infer("echo", [x])
+            assert e.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+            ready = client._call("ServerReady", pb.ServerReadyRequest(),
+                                 pb.ServerReadyResponse)
+            assert ready.ready is False
+            t.join(timeout=10)
+
+        # Client-set gRPC deadline shorter than the injected latency:
+        # DEADLINE_EXCEEDED, and the server-side expiry is counted.
+        with faults.harness() as h:
+            h.arm("serve.predict", faults.Latency(0.5))
+            req = pb.ModelInferRequest(model_name="echo")
+            ti = req.inputs.add(name="input_0", datatype="FP32",
+                                shape=[1, 2])
+            ti.contents.fp32_contents.extend([1.0, 2.0])
+            rpc = client._channel.unary_unary(
+                "/inference.GRPCInferenceService/ModelInfer",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=pb.ModelInferResponse.FromString)
+            with pytest.raises(grpc.RpcError) as e:
+                rpc(req, timeout=0.05)
+            assert e.value.code() == grpc.StatusCode.DEADLINE_EXCEEDED
+
+            # The gRPC surface counts each expired request exactly once
+            # (inner layers never count); poll — the server-side handler
+            # outlives the client-side abort by up to the latency fault.
+            def grpc_expiries():
+                return resilience.metrics.get(
+                    "tpk_deadline_expired_total", component="serve_grpc")
+            t0 = time.monotonic()
+            while grpc_expiries() < 1 and time.monotonic() - t0 < 5.0:
+                time.sleep(0.05)
+            assert grpc_expiries() == 1
+        # Recovered once the abandoned work drains — its admission slot
+        # rides the in-flight batch to completion, so max_inflight
+        # bounds concurrent WORK on the gRPC path too.
+        t0 = time.monotonic()
+        while srv.admission.inflight > 0 and time.monotonic() - t0 < 5.0:
+            time.sleep(0.02)
+        assert srv.admission.inflight == 0
+        np.testing.assert_allclose(client.infer("echo", [x])[0], x * 2)
+    finally:
+        client.close()
+
+
+def test_batcher_expires_queued_items():
+    from kubeflow_tpu.serve.batcher import Batcher
+
+    b = Batcher(lambda xs: [x * 2 for x in xs], max_batch_size=4)
+    try:
+        # Already-expired budget: resolved without touching the model.
+        fut = b.submit([np.ones((1, 2))], deadline=Deadline(-1.0))
+        with pytest.raises(DeadlineExceeded):
+            fut.result(timeout=1.0)
+        # A live deadline passes through untouched.
+        fut = b.submit([np.ones((1, 2))], deadline=Deadline(30.0))
+        np.testing.assert_array_equal(fut.result(timeout=5.0)[0],
+                                      np.full((1, 2), 2.0))
+    finally:
+        b.close()
+
+
+def test_injected_predict_fault_delivered_to_caller():
+    from kubeflow_tpu.serve.batcher import Batcher
+
+    b = Batcher(lambda xs: [x * 2 for x in xs], max_batch_size=4)
+    try:
+        with faults.harness() as h:
+            h.arm("serve.predict", faults.FailN(1, RuntimeError))
+            with pytest.raises(RuntimeError, match="injected fault"):
+                b.submit([np.ones((1, 2))]).result(timeout=5.0)
+        # Healed: the same batcher serves the next request.
+        assert b.submit([np.ones((1, 2))]).result(timeout=5.0)
+    finally:
+        b.close()

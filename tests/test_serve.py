@@ -362,3 +362,30 @@ def test_deferred_unload_spares_rolled_back_model():
         repo.close()
     finally:
         ModelRepository.UNLOAD_GRACE_S = old_grace
+
+
+def test_happy_path_unchanged_with_no_faults_armed(server):
+    """Zero-overhead check (ISSUE 1): with no fault harness installed and
+    no deadline header, the resilience layer must be invisible — same
+    responses as the seed, no admission friction, and fire() short-
+    circuiting to a single global read."""
+    import time as _time
+
+    from kubeflow_tpu.utils import faults
+
+    base, srv = server
+    assert faults.active() is None
+    for _ in range(3):
+        code, body = _http("POST", f"{base}/v1/models/echo:predict",
+                           {"instances": [[1, 2], [3, 4]]})
+        assert code == 200
+        assert body["predictions"] == [[2, 4], [6, 8]]
+    # Admission fully drains between requests; readiness stays green.
+    assert srv.admission is not None and srv.admission.inflight == 0
+    code, _ = _http("GET", f"{base}/v2/health/ready")
+    assert code == 200
+    # The disarmed hot-path hook costs one global None-check.
+    t0 = _time.monotonic()
+    for i in range(10_000):
+        faults.fire("serve.predict", batch=i)
+    assert _time.monotonic() - t0 < 0.5

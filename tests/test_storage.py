@@ -64,6 +64,29 @@ def test_digest_pinning(tmp_path):
     d.mkdir()
     with pytest.raises(ValueError, match="FILE source"):
         storage.download(f"{d}#sha256={good}", str(tmp_path / "dest3"))
-    # Unknown digest algorithms refuse.
-    with pytest.raises(ValueError, match="sha256"):
+    # A fragment that is not exactly sha256=<hex> is NOT a digest — it's
+    # part of the path, so a nonexistent one misses as a path, loudly.
+    with pytest.raises(FileNotFoundError):
         storage.download(f"file://{blob}#md5=abc", str(tmp_path / "dest4"))
+    # On REMOTE uris a near-miss fragment is clearly an intended pin:
+    # reject loudly instead of silently shipping it to the store as key.
+    with pytest.raises(ValueError, match="sha256"):
+        storage.download("s3://bucket/model.tar#md5=abc",
+                         str(tmp_path / "dest5"))
+    with pytest.raises(ValueError, match="sha256"):
+        storage.download(f"s3://bucket/model.tar#sha256={good[:10]}",
+                         str(tmp_path / "dest6"))
+
+
+def test_hash_in_filename_still_loads(tmp_path):
+    # '#' is legal in local filenames; only a trailing #sha256=<hex>
+    # fragment is digest syntax. Both the bare name and a digest pinned
+    # BEHIND such a name must resolve.
+    blob = tmp_path / "ckpt#v2.bin"
+    blob.write_bytes(b"model bytes")
+    dest = tmp_path / "dest"
+    out = storage.download(str(blob), str(dest))
+    assert os.path.exists(os.path.join(out, "ckpt#v2.bin"))
+    good = hashlib.sha256(b"model bytes").hexdigest()
+    out = storage.download(f"{blob}#sha256={good}", str(tmp_path / "dest2"))
+    assert os.path.exists(os.path.join(out, "ckpt#v2.bin"))
