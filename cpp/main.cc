@@ -2,6 +2,7 @@
 //
 //   tpk-controlplane --socket /tmp/tpk.sock --workdir /tmp/tpk
 //       --slices local=8 [--python python3] [--wal /tmp/tpk/wal.jsonl]
+//       [--fsync never|interval|always] [--fsync-interval N] [--compact N]
 //
 // One process = store + scheduler + JAXJob controller + API server, the
 // single-binary equivalent of {kube-apiserver, etcd, scheduler, kubelet,
@@ -33,6 +34,9 @@ int main(int argc, char** argv) {
   std::string workdir = "/tmp/tpk";
   std::string wal;
   std::string python = "python3";
+  std::string fsync_mode = "never";
+  int fsync_interval = 64;
+  int compact_threshold = 4096;
   std::vector<std::pair<std::string, int>> slices = {{"local", 8}};
 
   for (int i = 1; i < argc; ++i) {
@@ -44,6 +48,9 @@ int main(int argc, char** argv) {
     else if (arg == "--workdir") workdir = next();
     else if (arg == "--wal") wal = next();
     else if (arg == "--python") python = next();
+    else if (arg == "--fsync") fsync_mode = next();
+    else if (arg == "--fsync-interval") fsync_interval = atoi(next().c_str());
+    else if (arg == "--compact") compact_threshold = atoi(next().c_str());
     else if (arg == "--slices") {
       slices.clear();
       std::string val = next();  // "name=cap,name=cap"
@@ -61,9 +68,24 @@ int main(int argc, char** argv) {
       }
     } else if (arg == "--help") {
       printf("usage: tpk-controlplane --socket PATH --workdir DIR "
-             "[--wal FILE] [--python BIN] [--slices name=cap,...]\n");
+             "[--wal FILE] [--python BIN] [--slices name=cap,...] "
+             "[--fsync never|interval|always] [--fsync-interval N] "
+             "[--compact N]\n");
       return 0;
     }
+  }
+
+  tpk::Store::FsyncPolicy fsync_policy;
+  if (fsync_mode == "never") {
+    fsync_policy = tpk::Store::FsyncPolicy::kNever;
+  } else if (fsync_mode == "interval") {
+    fsync_policy = tpk::Store::FsyncPolicy::kInterval;
+  } else if (fsync_mode == "always") {
+    fsync_policy = tpk::Store::FsyncPolicy::kAlways;
+  } else {
+    fprintf(stderr, "tpk-controlplane: --fsync must be never | interval | "
+            "always, got '%s'\n", fsync_mode.c_str());
+    return 1;
   }
 
   signal(SIGINT, OnSignal);
@@ -71,7 +93,22 @@ int main(int argc, char** argv) {
   signal(SIGPIPE, SIG_IGN);
 
   tpk::Store store(wal);
-  int replayed = store.Load();
+  store.SetFsync(fsync_policy, fsync_interval);
+  store.SetCompactionThreshold(compact_threshold);
+  store.Load();
+  const tpk::Store::LoadStats& replay = store.load_stats();
+  if (!replay.clean) {
+    fprintf(stderr,
+            "tpk-controlplane: WAL REPLAY STOPPED EARLY AT CORRUPTION: %s "
+            "(%lld bytes truncated; state is the last good record)\n",
+            replay.error.c_str(),
+            static_cast<long long>(replay.truncated_bytes));
+  } else if (replay.truncated_bytes > 0) {
+    fprintf(stderr,
+            "tpk-controlplane: torn WAL tail truncated (%lld bytes) — "
+            "expected after a crash mid-append\n",
+            static_cast<long long>(replay.truncated_bytes));
+  }
   tpk::Scheduler scheduler;
   for (const auto& [name, cap] : slices) scheduler.AddSlice(name, cap);
   tpk::LocalExecutor executor;
@@ -100,11 +137,18 @@ int main(int argc, char** argv) {
             socket_path.c_str(), error.c_str());
     return 1;
   }
+  // Replay health, not just a count: operators must see snapshot vs tail
+  // split and whether anything was truncated (the `stateinfo` verb serves
+  // the same record over the API).
   fprintf(stderr,
-          "tpk-controlplane: listening on %s (workdir=%s, %d WAL records, "
-          "%d lineage records, %zu slices)\n",
-          socket_path.c_str(), workdir.c_str(), replayed, lineage_records,
-          slices.size());
+          "tpk-controlplane: listening on %s (workdir=%s, WAL replay: "
+          "%d applied = %d snapshot + %d tail, %lld bytes truncated, %s, "
+          "fsync=%s; %d lineage records, %zu slices)\n",
+          socket_path.c_str(), workdir.c_str(), replay.applied,
+          replay.snapshot_records, replay.tail_records,
+          static_cast<long long>(replay.truncated_bytes),
+          replay.clean ? "clean" : "STOPPED AT CORRUPTION",
+          fsync_mode.c_str(), lineage_records, slices.size());
 
   // Watch: any JAXJob change → reconcile (informer-style edge trigger).
   // Deletes are handled inline: the resource is already gone from the

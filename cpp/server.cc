@@ -135,6 +135,11 @@ Json Server::Dispatch(const Json& req) {
     if (pipelines_) m["pipelines"] = pipelines_->metrics().ToJson();
     if (serve_) m["serve"] = serve_->metrics().ToJson();
     resp["metrics"] = m;
+  } else if (op == "stateinfo") {
+    // Durability health: WAL replay stats, compaction counters, fsync
+    // mode — the operator's view of whether state survives a crash.
+    resp["ok"] = true;
+    resp["stateinfo"] = store_->StateInfo();
   } else if (op == "slices") {
     resp["ok"] = true;
     Json arr = Json::Array();

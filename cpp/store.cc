@@ -1,64 +1,471 @@
 #include "store.h"
 
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <array>
 #include <cctype>
+#include <cerrno>
+#include <cinttypes>
 #include <cstdio>
 #include <cstdlib>
+#include <cstring>
 
 namespace tpk {
 
-Store::Store(std::string wal_path) : wal_path_(std::move(wal_path)) {
-  if (!wal_path_.empty()) {
-    wal_ = fopen(wal_path_.c_str(), "a");
+namespace {
+
+// CRC32 (IEEE/zlib polynomial) over the exact payload bytes as written —
+// the integrity check that lets Load() tell a torn/bit-flipped record from
+// a good one instead of trusting whatever the JSON parser accepts.
+uint32_t Crc32(const char* p, size_t n) {
+  static const std::array<uint32_t, 256> table = [] {
+    std::array<uint32_t, 256> t{};
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k) {
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      }
+      t[i] = c;
+    }
+    return t;
+  }();
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; ++i) {
+    c = table[(c ^ static_cast<unsigned char>(p[i])) & 0xFF] ^ (c >> 8);
+  }
+  return c ^ 0xFFFFFFFFu;
+}
+
+// One framed WAL line: `v1 <seq> <crc32hex> <payload>\n`.
+std::string FrameRecord(uint64_t seq, const std::string& payload) {
+  char head[64];
+  snprintf(head, sizeof(head), "v1 %" PRIu64 " %08x ", seq,
+           Crc32(payload.data(), payload.size()));
+  std::string line = head;
+  line += payload;
+  line += '\n';
+  return line;
+}
+
+// Splits a framed line (newline already stripped) into seq + payload,
+// verifying the CRC. Returns false with *error on any mismatch.
+bool ParseFrame(const std::string& line, uint64_t* seq, std::string* payload,
+                std::string* error) {
+  size_t sp1 = line.find(' ', 3);
+  size_t sp2 = sp1 == std::string::npos ? std::string::npos
+                                        : line.find(' ', sp1 + 1);
+  if (sp2 == std::string::npos) {
+    *error = "malformed frame header";
+    return false;
+  }
+  char* end = nullptr;
+  errno = 0;
+  unsigned long long s = strtoull(line.c_str() + 3, &end, 10);
+  if (errno != 0 || end != line.c_str() + sp1) {
+    *error = "bad sequence number in frame header";
+    return false;
+  }
+  unsigned long crc = strtoul(line.c_str() + sp1 + 1, &end, 16);
+  if (end != line.c_str() + sp2) {
+    *error = "bad crc in frame header";
+    return false;
+  }
+  *payload = line.substr(sp2 + 1);
+  uint32_t got = Crc32(payload->data(), payload->size());
+  if (got != static_cast<uint32_t>(crc)) {
+    char buf[96];
+    snprintf(buf, sizeof(buf),
+             "crc mismatch at seq %llu (stored %08lx, computed %08x)", s,
+             crc, got);
+    *error = buf;
+    return false;
+  }
+  *seq = s;
+  return true;
+}
+
+void FsyncDirOf(const std::string& path) {
+  // Durability of the rename itself (best effort — not all filesystems
+  // support directory fsync, and failure here never loses applied state).
+  size_t slash = path.find_last_of('/');
+  std::string dir = slash == std::string::npos ? "." : path.substr(0, slash);
+  int fd = open(dir.c_str(), O_RDONLY);
+  if (fd >= 0) {
+    fsync(fd);
+    close(fd);
   }
 }
+
+}  // namespace
+
+Store::Store(std::string wal_path) : wal_path_(std::move(wal_path)) {}
 
 Store::~Store() {
   if (wal_) fclose(wal_);
 }
 
+void Store::SetFsync(FsyncPolicy policy, int interval_records) {
+  std::lock_guard<std::mutex> lock(mu_);
+  fsync_policy_ = policy;
+  fsync_interval_ = interval_records > 0 ? interval_records : 1;
+}
+
+void Store::SetCompactionThreshold(int records) {
+  std::lock_guard<std::mutex> lock(mu_);
+  compact_threshold_ = records > 0 ? records : 0;
+}
+
+bool Store::EnsureWalLocked(std::string* error) {
+  if (wal_broken_) {
+    if (error) *error = "WAL broken: " + wal_error_;
+    return false;
+  }
+  if (wal_) return true;
+  wal_ = fopen(wal_path_.c_str(), "a");
+  if (!wal_) {
+    wal_broken_ = true;
+    wal_error_ = std::string("cannot open ") + wal_path_ + ": " +
+                 strerror(errno);
+    if (error) *error = "WAL broken: " + wal_error_;
+    return false;
+  }
+  // Unbuffered: fwrite maps 1:1 onto write(2), so a failed append reports
+  // a short count immediately and rollback is a plain ftruncate — no
+  // stdio buffer left holding half a record to leak into the next append.
+  setvbuf(wal_, nullptr, _IONBF, 0);
+  return true;
+}
+
+bool Store::WalAppendLocked(const Resource& r, std::string* error) {
+  if (wal_path_.empty()) return true;  // in-memory store
+  if (!EnsureWalLocked(error)) return false;
+
+  uint64_t seq = wal_seq_ + 1;
+  std::string line = FrameRecord(seq, ToJson(r).dump());
+  long off = ftell(wal_);
+  size_t wrote = fwrite(line.data(), 1, line.size(), wal_);
+  int saved_errno = errno;
+  bool ok = wrote == line.size() && fflush(wal_) == 0;
+  if (ok && fsync_policy_ != FsyncPolicy::kNever) {
+    ++unsynced_records_;
+    if (fsync_policy_ == FsyncPolicy::kAlways ||
+        unsynced_records_ >= fsync_interval_) {
+      if (fsync(fileno(wal_)) != 0) {
+        // A failed fsync may drop the very pages it was asked to persist
+        // (the fsync-gate problem) — the record cannot be trusted.
+        saved_errno = errno;
+        ok = false;
+      } else {
+        unsynced_records_ = 0;
+      }
+    }
+  }
+  if (!ok) {
+    // Roll the file back to the pre-record offset so a partial append
+    // can't become a torn line that replay would stop at.
+    std::string reason = std::string("wal append failed: ") +
+                         strerror(saved_errno);
+    clearerr(wal_);
+    if (off < 0 || ftruncate(fileno(wal_), off) != 0) {
+      // Can't even restore the file — disk state is unknown. Refuse all
+      // further mutations instead of silently diverging memory from disk.
+      wal_broken_ = true;
+      wal_error_ = reason + "; rollback truncate failed: " +
+                   strerror(errno);
+      fclose(wal_);
+      wal_ = nullptr;
+      if (error) *error = "WAL broken: " + wal_error_;
+      return false;
+    }
+    if (error) *error = reason;
+    return false;
+  }
+  wal_seq_ = seq;
+  ++wal_records_;
+  return true;
+}
+
+bool Store::ApplyLineLocked(const std::string& raw, bool require_framed,
+                            bool* is_meta, std::string* error) {
+  std::string line = raw;
+  while (!line.empty() && (line.back() == '\n' || line.back() == '\r')) {
+    line.pop_back();
+  }
+  *is_meta = false;
+  std::string payload;
+  bool framed = line.compare(0, 3, "v1 ") == 0;
+  if (framed) {
+    uint64_t seq = 0;
+    if (!ParseFrame(line, &seq, &payload, error)) return false;
+    if (seq <= wal_seq_) {
+      char buf[96];
+      snprintf(buf, sizeof(buf),
+               "sequence regression: %" PRIu64 " after %" PRIu64, seq,
+               wal_seq_);
+      *error = buf;
+      return false;
+    }
+    wal_seq_ = seq;
+  } else if (require_framed) {
+    *error = "unframed record in snapshot";
+    return false;
+  } else {
+    payload = line;  // legacy plain-JSONL record (pre-framing WAL)
+  }
+  Json rec;
+  try {
+    rec = Json::parse(payload);
+  } catch (const std::exception& e) {
+    *error = std::string("bad record JSON: ") + e.what();
+    return false;
+  }
+  if (rec.has("snapshotMeta")) {
+    const Json& meta = rec.get("snapshotMeta");
+    int64_t nv = meta.get("nextVersion").as_int(0);
+    if (nv > next_version_) next_version_ = nv;
+    *is_meta = true;
+    return true;
+  }
+  Resource r;
+  r.kind = rec.get("kind").as_string();
+  r.name = rec.get("name").as_string();
+  r.spec = rec.get("spec");
+  r.status = rec.get("status");
+  r.resource_version = rec.get("resourceVersion").as_int();
+  r.generation = rec.get("generation").as_int();
+  r.deleted = rec.get("deleted").as_bool();
+  auto key = std::make_pair(r.kind, r.name);
+  if (r.deleted) {
+    data_.erase(key);
+  } else {
+    data_[key] = r;
+  }
+  if (r.resource_version >= next_version_) {
+    next_version_ = r.resource_version + 1;
+  }
+  return true;
+}
+
 int Store::Load() {
   if (wal_path_.empty()) return 0;
-  FILE* f = fopen(wal_path_.c_str(), "r");
-  if (!f) return 0;
-  int applied = 0;
-  std::string line;
-  // getline(3): records (full JAXJob specs) can exceed any fixed buffer; a
-  // truncated read would mis-parse and silently drop every later record.
+  std::lock_guard<std::mutex> lock(mu_);
+  load_stats_ = LoadStats{};
+  wal_seq_ = 0;
+  wal_records_ = 0;
+
+  // A leftover temp snapshot means a crash mid-compaction before the
+  // atomic rename — the WAL still has everything; just discard it.
+  remove((snapshot_path() + ".tmp").c_str());
+
   char* lbuf = nullptr;
   size_t lcap = 0;
   ssize_t llen;
-  std::lock_guard<std::mutex> lock(mu_);
+
+  // Phase 1: snapshot (full state at the last compaction), if present.
+  if (FILE* snap = fopen(snapshot_path().c_str(), "r")) {
+    load_stats_.snapshot_loaded = true;
+    while ((llen = getline(&lbuf, &lcap, snap)) != -1) {
+      std::string line(lbuf, static_cast<size_t>(llen));
+      if (line == "\n") continue;
+      bool is_meta = false;
+      std::string err;
+      if (!ApplyLineLocked(line, /*require_framed=*/true, &is_meta, &err)) {
+        // Should be impossible (snapshots land via atomic rename): real
+        // disk corruption. Keep what replayed, stay loud, continue to
+        // the tail — partial state beats no state for an operator
+        // deciding what to salvage.
+        load_stats_.clean = false;
+        load_stats_.error = "snapshot: " + err;
+        break;
+      }
+      if (!is_meta) {
+        ++load_stats_.snapshot_records;
+        ++load_stats_.applied;
+      }
+    }
+    fclose(snap);
+  }
+
+  // Phase 2: the WAL tail, tracking the byte offset after the last good
+  // record so a torn/corrupt tail is truncated IN THE FILE before the
+  // writer reopens — otherwise the next append glues onto the torn line
+  // and every later record is lost on all future replays.
+  FILE* f = fopen(wal_path_.c_str(), "r");
+  if (!f) {
+    free(lbuf);
+    return load_stats_.applied;
+  }
+  long good_end = 0;
   while ((llen = getline(&lbuf, &lcap, f)) != -1) {
-    line.assign(lbuf, static_cast<size_t>(llen));
-    if (line.empty() || line == "\n") continue;
-    try {
-      Json rec = Json::parse(line);
-      Resource r;
-      r.kind = rec.get("kind").as_string();
-      r.name = rec.get("name").as_string();
-      r.spec = rec.get("spec");
-      r.status = rec.get("status");
-      r.resource_version = rec.get("resourceVersion").as_int();
-      r.generation = rec.get("generation").as_int();
-      r.deleted = rec.get("deleted").as_bool();
-      auto key = std::make_pair(r.kind, r.name);
-      if (r.deleted) {
-        data_.erase(key);
-      } else {
-        data_[key] = r;
-      }
-      if (r.resource_version >= next_version_) {
-        next_version_ = r.resource_version + 1;
-      }
-      ++applied;
-    } catch (const std::exception&) {
-      // Torn tail write (crash mid-append): stop replay at the corruption.
+    std::string line(lbuf, static_cast<size_t>(llen));
+    if (line.back() != '\n') {
+      // Partial final record: the expected crash-mid-append shape (power
+      // loss / partial writeback). Truncated below; still a clean load.
       break;
     }
+    if (line == "\n") {
+      good_end = ftell(f);
+      continue;
+    }
+    bool is_meta = false;
+    std::string err;
+    if (!ApplyLineLocked(line, /*require_framed=*/false, &is_meta, &err)) {
+      // Corruption on a COMPLETE line — not a torn tail. Stop early and
+      // report loudly; everything after it is cut (a lost earlier
+      // mutation makes later state unreliable, the etcd rule).
+      load_stats_.clean = false;
+      if (load_stats_.error.empty()) load_stats_.error = err;
+      break;
+    }
+    if (!is_meta) {
+      ++load_stats_.tail_records;
+      ++load_stats_.applied;
+    }
+    good_end = ftell(f);
   }
-  free(lbuf);
+  fseek(f, 0, SEEK_END);
+  long file_size = ftell(f);
   fclose(f);
-  return applied;
+  free(lbuf);
+  if (file_size > good_end) {
+    load_stats_.truncated_bytes = file_size - good_end;
+    if (truncate(wal_path_.c_str(), good_end) != 0) {
+      // Can't repair the file: appending would glue onto the torn tail.
+      wal_broken_ = true;
+      wal_error_ = std::string("cannot truncate torn tail of ") +
+                   wal_path_ + ": " + strerror(errno);
+      load_stats_.clean = false;
+      if (load_stats_.error.empty()) load_stats_.error = wal_error_;
+    }
+  }
+  wal_records_ = load_stats_.tail_records;
+
+  // A tail already past the threshold (e.g. compaction was disabled last
+  // run) compacts at startup so the NEXT replay is bounded.
+  std::string cerr_;
+  if (compact_threshold_ > 0 && wal_records_ > compact_threshold_ &&
+      !wal_broken_) {
+    CompactLocked(&cerr_);
+  }
+  return load_stats_.applied;
+}
+
+bool Store::CompactLocked(std::string* error) {
+  if (wal_path_.empty()) return true;
+  std::string tmp = snapshot_path() + ".tmp";
+  FILE* f = fopen(tmp.c_str(), "w");
+  if (!f) {
+    compact_error_ = std::string("cannot open ") + tmp + ": " +
+                     strerror(errno);
+    if (error) *error = compact_error_;
+    return false;
+  }
+  bool ok = true;
+  {
+    Json meta = Json::Object();
+    Json m = Json::Object();
+    m["nextVersion"] = next_version_;
+    m["resources"] = static_cast<int64_t>(data_.size());
+    meta["snapshotMeta"] = m;
+    std::string line = FrameRecord(++wal_seq_, meta.dump());
+    ok = fwrite(line.data(), 1, line.size(), f) == line.size();
+  }
+  for (auto it = data_.begin(); ok && it != data_.end(); ++it) {
+    std::string line = FrameRecord(++wal_seq_, ToJson(it->second).dump());
+    ok = fwrite(line.data(), 1, line.size(), f) == line.size();
+  }
+  ok = ok && fflush(f) == 0 && fsync(fileno(f)) == 0;
+  int saved_errno = errno;
+  if (fclose(f) != 0) ok = false;
+  if (!ok) {
+    remove(tmp.c_str());
+    compact_error_ = std::string("snapshot write failed: ") +
+                     strerror(saved_errno);
+    if (error) *error = compact_error_;
+    return false;
+  }
+  if (rename(tmp.c_str(), snapshot_path().c_str()) != 0) {
+    compact_error_ = std::string("snapshot rename failed: ") +
+                     strerror(errno);
+    remove(tmp.c_str());
+    if (error) *error = compact_error_;
+    return false;
+  }
+  FsyncDirOf(wal_path_);
+  // Snapshot is durable; the WAL tail it covers can go. If a crash lands
+  // between the rename and this truncate, replay stops at the stale
+  // tail's sequence regression with exactly the snapshot state.
+  if (wal_) {
+    fclose(wal_);
+    wal_ = nullptr;
+  }
+  FILE* w = fopen(wal_path_.c_str(), "w");
+  if (!w) {
+    wal_broken_ = true;
+    wal_error_ = std::string("cannot reopen WAL after compaction: ") +
+                 strerror(errno);
+    compact_error_ = wal_error_;
+    if (error) *error = compact_error_;
+    return false;
+  }
+  setvbuf(w, nullptr, _IONBF, 0);
+  wal_ = w;
+  wal_records_ = 0;
+  unsynced_records_ = 0;
+  ++compactions_;
+  compact_error_.clear();
+  return true;
+}
+
+void Store::MaybeCompactLocked() {
+  // Runs inline in the mutating request once the tail passes the
+  // threshold. Synchronous-by-design: the control plane is effectively a
+  // single-writer event loop, the cost is amortized O(1)/record, and a
+  // background compactor would need a second WAL handle + copy of data_.
+  // If snapshots ever get big enough to matter, this is the seam to move
+  // off-thread. Failure is recorded in compact_error_ (stateinfo), never
+  // fails the mutation — the WAL append already landed.
+  if (compact_threshold_ > 0 && wal_records_ > compact_threshold_) {
+    std::string ignored;
+    CompactLocked(&ignored);
+  }
+}
+
+bool Store::Compact(std::string* error) {
+  std::lock_guard<std::mutex> lock(mu_);
+  return CompactLocked(error);
+}
+
+Json Store::StateInfo() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  Json out = Json::Object();
+  out["walPath"] = wal_path_;
+  out["resources"] = static_cast<int64_t>(data_.size());
+  out["nextVersion"] = next_version_;
+  out["walRecords"] = wal_records_;
+  out["walSeq"] = static_cast<int64_t>(wal_seq_);
+  out["walBroken"] = wal_broken_;
+  if (!wal_error_.empty()) out["walError"] = wal_error_;
+  out["fsync"] = fsync_policy_ == FsyncPolicy::kAlways
+                     ? "always"
+                     : fsync_policy_ == FsyncPolicy::kInterval ? "interval"
+                                                               : "never";
+  out["fsyncInterval"] = fsync_interval_;
+  out["compactThreshold"] = compact_threshold_;
+  out["compactions"] = compactions_;
+  if (!compact_error_.empty()) out["compactError"] = compact_error_;
+  Json replay = Json::Object();
+  replay["applied"] = load_stats_.applied;
+  replay["snapshotRecords"] = load_stats_.snapshot_records;
+  replay["tailRecords"] = load_stats_.tail_records;
+  replay["truncatedBytes"] = load_stats_.truncated_bytes;
+  replay["snapshotLoaded"] = load_stats_.snapshot_loaded;
+  replay["clean"] = load_stats_.clean;
+  if (!load_stats_.error.empty()) replay["error"] = load_stats_.error;
+  out["replay"] = replay;
+  return out;
 }
 
 bool Store::ValidName(const std::string& name) {
@@ -87,14 +494,6 @@ Json Store::ToJson(const Resource& r) {
   return out;
 }
 
-void Store::WalWrite(const Resource& r) {
-  if (!wal_) return;
-  std::string line = ToJson(r).dump();
-  fwrite(line.data(), 1, line.size(), wal_);
-  fputc('\n', wal_);
-  fflush(wal_);
-}
-
 void Store::Append(const WatchEvent& ev) { pending_.push_back(ev); }
 
 Store::Result Store::Create(const std::string& kind, const std::string& name,
@@ -113,11 +512,16 @@ Store::Result Store::Create(const std::string& kind, const std::string& name,
   r.name = name;
   r.spec = std::move(spec);
   r.status = Json::Object();
-  r.resource_version = next_version_++;
+  r.resource_version = next_version_;
   r.generation = 1;
+  // WAL first, memory second: a failed append (disk full, broken WAL)
+  // rejects the mutation instead of letting memory diverge from disk.
+  std::string werr;
+  if (!WalAppendLocked(r, &werr)) return {false, werr, {}};
+  ++next_version_;
   data_[key] = r;
-  WalWrite(r);
   Append({WatchEvent::Type::kAdded, r});
+  MaybeCompactLocked();
   return {true, "", r};
 }
 
@@ -131,11 +535,16 @@ Store::Result Store::UpdateSpec(const std::string& kind,
       it->second.resource_version != expected_version) {
     return {false, "conflict: version mismatch", {}};
   }
-  it->second.spec = std::move(spec);
-  it->second.resource_version = next_version_++;
-  it->second.generation++;
-  WalWrite(it->second);
+  Resource updated = it->second;
+  updated.spec = std::move(spec);
+  updated.resource_version = next_version_;
+  updated.generation++;
+  std::string werr;
+  if (!WalAppendLocked(updated, &werr)) return {false, werr, {}};
+  ++next_version_;
+  it->second = std::move(updated);
   Append({WatchEvent::Type::kModified, it->second});
+  MaybeCompactLocked();
   return {true, "", it->second};
 }
 
@@ -149,10 +558,15 @@ Store::Result Store::UpdateStatus(const std::string& kind,
       it->second.resource_version != expected_version) {
     return {false, "conflict: version mismatch", {}};
   }
-  it->second.status = std::move(status);
-  it->second.resource_version = next_version_++;
-  WalWrite(it->second);
+  Resource updated = it->second;
+  updated.status = std::move(status);
+  updated.resource_version = next_version_;
+  std::string werr;
+  if (!WalAppendLocked(updated, &werr)) return {false, werr, {}};
+  ++next_version_;
+  it->second = std::move(updated);
   Append({WatchEvent::Type::kModified, it->second});
+  MaybeCompactLocked();
   return {true, "", it->second};
 }
 
@@ -162,10 +576,13 @@ Store::Result Store::Delete(const std::string& kind, const std::string& name) {
   if (it == data_.end()) return {false, "not found: " + kind + "/" + name, {}};
   Resource r = it->second;
   r.deleted = true;
-  r.resource_version = next_version_++;
+  r.resource_version = next_version_;
+  std::string werr;
+  if (!WalAppendLocked(r, &werr)) return {false, werr, {}};
+  ++next_version_;
   data_.erase(it);
-  WalWrite(r);
   Append({WatchEvent::Type::kDeleted, r});
+  MaybeCompactLocked();
   return {true, "", r};
 }
 

@@ -5,12 +5,33 @@
 // the reference does NOT implement). The rebuild must supply it: resources
 // are (kind, name) → {spec, status, resourceVersion, generation}; writers get
 // optimistic concurrency via resourceVersion compare-and-swap; watchers get
-// ordered ADDED/MODIFIED/DELETED events; a JSONL WAL makes state survive
-// restarts (controller restart ≈ apiserver restart + informer resync).
+// ordered ADDED/MODIFIED/DELETED events; a WAL makes state survive restarts
+// (controller restart ≈ apiserver restart + informer resync).
+//
+// Durability model (the etcd analog, scaled down):
+//   * Every mutation appends one framed record: `v1 <seq> <crc32> <json>\n`.
+//     The CRC covers the exact payload bytes; seq is strictly increasing.
+//     Legacy plain-JSONL lines (pre-framing WALs) still replay.
+//   * Append errors (fwrite/fflush/fsync) FAIL the mutation — memory never
+//     diverges from disk. A torn partial append is rolled back by
+//     truncating the file to the pre-record offset; if even that fails the
+//     WAL is marked broken and every later mutation errors loudly.
+//   * Load() stops at the first torn/corrupt record and truncates the file
+//     there BEFORE the writer reopens in append mode — without this, the
+//     next append glues onto the torn line and every later record is
+//     silently lost on all future replays.
+//   * When the WAL tail exceeds a record threshold, the store writes a
+//     full-state snapshot (temp file + fsync + atomic rename, like etcd's
+//     snap/) and truncates the WAL; Load() replays snapshot-then-tail.
+//   * `--fsync never|interval|always` bounds the post-SIGKILL loss window
+//     (never: page cache only — safe against process death, not power
+//     loss; interval: fsync every N records; always: fsync per record).
 
 #pragma once
 
+#include <cstdint>
 #include <functional>
+#include <map>
 #include <mutex>
 #include <optional>
 #include <string>
@@ -48,8 +69,44 @@ class Store {
   explicit Store(std::string wal_path = "");
   ~Store();
 
-  // Replays the WAL if present. Returns number of records applied.
+  // When (and whether) appends reach the platter, not just the page cache.
+  enum class FsyncPolicy { kNever, kInterval, kAlways };
+
+  // What Load() found — the replay-health record surfaced by the startup
+  // log and the `stateinfo` server verb.
+  struct LoadStats {
+    int applied = 0;            // records applied (snapshot + tail)
+    int snapshot_records = 0;   // replayed from <wal>.snap
+    int tail_records = 0;       // replayed from the WAL file itself
+    int64_t truncated_bytes = 0;  // torn/corrupt bytes cut off the WAL
+    bool snapshot_loaded = false;
+    // true = replay ended at a clean EOF (a torn FINAL record — the
+    // expected crash-mid-append shape — still counts as clean; it is
+    // truncated and reported in truncated_bytes). false = replay stopped
+    // EARLY at mid-file corruption (CRC mismatch, seq regression, bad
+    // JSON on a complete line): loud, not silent.
+    bool clean = true;
+    std::string error;          // first corruption, human-readable
+  };
+
+  // Durability knobs — set BEFORE Load()/first mutation.
+  void SetFsync(FsyncPolicy policy, int interval_records = 64);
+  // Snapshot+truncate once the WAL tail exceeds `records` (0 = never).
+  void SetCompactionThreshold(int records);
+
+  // Replays snapshot + WAL if present, truncating any torn/corrupt tail
+  // in the file before the writer reopens. Returns records applied.
   int Load();
+  const LoadStats& load_stats() const { return load_stats_; }
+
+  // Force a snapshot+WAL-truncate now (also runs automatically past the
+  // compaction threshold). Returns false (with *error) on I/O failure —
+  // the WAL keeps working; compaction failure never loses state.
+  bool Compact(std::string* error = nullptr);
+
+  // Durability health for operators: replay stats, compaction counters,
+  // fsync mode, live WAL length — the `stateinfo` verb's payload.
+  Json StateInfo() const;
 
   // CRUD. All return the stored resource (with bumped versions) or an error
   // string. expected_version: -1 = unconditional, else CAS.
@@ -84,11 +141,33 @@ class Store {
 
  private:
   void Append(const WatchEvent& ev);
-  void WalWrite(const Resource& r);
+  // Appends one framed record; on I/O failure rolls the file back to the
+  // pre-record offset and returns false with *error (the mutation must
+  // not commit). Caller holds mu_.
+  bool WalAppendLocked(const Resource& r, std::string* error);
+  bool EnsureWalLocked(std::string* error);
+  bool CompactLocked(std::string* error);
+  void MaybeCompactLocked();
+  // Parses one WAL/snapshot line (framed or legacy). Returns false with
+  // *error on corruption; *is_meta set for snapshot header records.
+  bool ApplyLineLocked(const std::string& line, bool require_framed,
+                       bool* is_meta, std::string* error);
+  std::string snapshot_path() const { return wal_path_ + ".snap"; }
 
   mutable std::mutex mu_;
   std::string wal_path_;
   FILE* wal_ = nullptr;
+  bool wal_broken_ = false;
+  std::string wal_error_;
+  FsyncPolicy fsync_policy_ = FsyncPolicy::kNever;
+  int fsync_interval_ = 64;
+  int unsynced_records_ = 0;
+  int compact_threshold_ = 0;
+  int wal_records_ = 0;     // records in the current WAL tail (post-snapshot)
+  uint64_t wal_seq_ = 0;    // last framed sequence number written/replayed
+  int64_t compactions_ = 0;
+  std::string compact_error_;  // last compaction failure (loud via stateinfo)
+  LoadStats load_stats_;
   std::map<std::pair<std::string, std::string>, Resource> data_;
   int64_t next_version_ = 1;
   struct Watcher {

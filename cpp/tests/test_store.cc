@@ -1,4 +1,6 @@
 // Store tests: CRUD, optimistic concurrency, watches, WAL replay.
+#include <sys/stat.h>
+
 #include <cassert>
 #include <cstdio>
 #include <unistd.h>
@@ -105,6 +107,44 @@ int main() {
     CHECK(!s.Create("JAXJob", ".hidden", Json::Object()).ok);
     CHECK(s.Create("JAXJob", "ok-name_1.2", Json::Object()).ok);
     CHECK(!Store::ValidName(std::string(300, 'a')));
+  }
+
+  // Crash mid-append (torn tail): Load() must truncate the torn line IN
+  // THE FILE before the writer reopens — without that, the next append
+  // glues onto the torn line and every later record is silently lost on
+  // the NEXT replay (regression: the seed's append-mode reopen bug).
+  {
+    std::string wal = "/tmp/tpk_test_store_tornwal.jsonl";
+    std::remove(wal.c_str());
+    {
+      Store w(wal);
+      Json spec = Json::Object();
+      spec["v"] = 1;
+      CHECK(w.Create("JAXJob", "a", spec).ok);
+      CHECK(w.Create("JAXJob", "b", spec).ok);
+    }
+    struct stat st;
+    CHECK(stat(wal.c_str(), &st) == 0);
+    CHECK(truncate(wal.c_str(), st.st_size - 7) == 0);  // tear record "b"
+    {
+      Store r(wal);
+      CHECK(r.Load() == 1);  // stopped at the torn record
+      CHECK(r.load_stats().clean);  // torn tail = expected crash shape
+      CHECK(r.load_stats().truncated_bytes > 0);
+      CHECK(r.Get("JAXJob", "a").has_value());
+      CHECK(!r.Get("JAXJob", "b").has_value());
+      // Appending after the repair must start on a fresh line.
+      CHECK(r.Create("JAXJob", "c", Json::Object()).ok);
+    }
+    {
+      Store r2(wal);
+      CHECK(r2.Load() == 2);  // a AND c survive a SECOND replay
+      CHECK(r2.Get("JAXJob", "a").has_value());
+      CHECK(r2.Get("JAXJob", "c").has_value());
+      CHECK(r2.load_stats().clean);
+      CHECK(r2.load_stats().truncated_bytes == 0);
+    }
+    std::remove(wal.c_str());
   }
 
   // WAL records larger than 64KB must replay intact (regression: fixed-size

@@ -195,6 +195,12 @@ def cmd_metrics(args) -> int:
     return 0
 
 
+def cmd_stateinfo(args) -> int:
+    """Durability health: WAL replay stats, compaction, fsync mode."""
+    print(json.dumps(_client(args).stateinfo(), indent=2))
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="tpukit")
     parser.add_argument("--socket", default="/tmp/tpk.sock")
@@ -247,6 +253,10 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("metrics")
     p.set_defaults(fn=cmd_metrics)
+
+    p = sub.add_parser("stateinfo",
+                       help="WAL/snapshot durability health")
+    p.set_defaults(fn=cmd_stateinfo)
 
     args = parser.parse_args(argv)
     try:

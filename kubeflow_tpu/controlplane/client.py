@@ -59,7 +59,7 @@ _PRE_SEND_ERRORS = (ConnectionRefusedError, FileNotFoundError)
 #: disconnect is always safe (client-go's IsServerTimeout/idempotency
 #: split for GET-class requests).
 _READ_ONLY_OPS = frozenset(
-    {"get", "list", "metrics", "slices", "logs", "ping"})
+    {"get", "list", "metrics", "slices", "logs", "ping", "stateinfo"})
 
 
 def namespace_of(resource: dict) -> str:
@@ -210,6 +210,13 @@ class Client:
     def slices(self) -> list[dict]:
         return self.request(op="slices")["slices"]
 
+    def stateinfo(self) -> dict:
+        """Durability health of the control plane's store: WAL replay
+        stats (records applied, snapshot vs tail, truncated bytes, clean
+        vs stopped-at-corruption), compaction counters, and the fsync
+        policy — the operator's `etcdctl endpoint status` analog."""
+        return self.request(op="stateinfo")["stateinfo"]
+
     def logs(self, name: str, replica: int = 0, stderr: bool = False,
              max_bytes: int = 65536) -> str:
         return self.logs_ex(name, replica, stderr, max_bytes)["content"]
@@ -348,14 +355,20 @@ def find_binary() -> str:
 def start_controlplane(socket_path: str, workdir: str,
                        slices: str = "local=8", wal: str | None = None,
                        python: str | None = None,
-                       wait_s: float = 10.0) -> subprocess.Popen:
-    """Starts the control-plane binary and waits for its socket."""
+                       wait_s: float = 10.0,
+                       extra_args: list[str] | None = None
+                       ) -> subprocess.Popen:
+    """Starts the control-plane binary and waits for its socket.
+    `extra_args` passes durability knobs straight through
+    (`--fsync`, `--fsync-interval`, `--compact`)."""
     import sys
 
     cmd = [find_binary(), "--socket", socket_path, "--workdir", workdir,
            "--slices", slices, "--python", python or sys.executable]
     if wal:
         cmd += ["--wal", wal]
+    if extra_args:
+        cmd += list(extra_args)
     proc = subprocess.Popen(cmd)
     client = Client(socket_path)
     deadline = time.time() + wait_s

@@ -10,16 +10,25 @@ with `restore=latest` — checkpoint-restart IS the elasticity mechanism
 
 from __future__ import annotations
 
+import logging
+import os
 from typing import Any
 
 import orbax.checkpoint as ocp
 
-from kubeflow_tpu.utils import faults
+from kubeflow_tpu.utils import faults, resilience
 
 _FP_SAVE = faults.register_point(
     "checkpoint.save", "before a checkpoint save lands; ctx: step")
 _FP_RESTORE = faults.register_point(
     "checkpoint.restore", "before a checkpoint restore; ctx: step")
+
+_LOG = logging.getLogger(__name__)
+
+#: Subdirectory (inside the checkpoint root) where corrupt step dirs are
+#: moved. Non-numeric, so orbax's step scan ignores it; kept on disk (not
+#: deleted) so an operator can post-mortem the torn write.
+QUARANTINE_DIR = "quarantine"
 
 
 class CheckpointManager:
@@ -29,6 +38,8 @@ class CheckpointManager:
                  keep: int = 3, async_save: bool = True):
         self.directory = str(directory)
         self.interval = interval
+        self._keep = keep
+        self._async_save = async_save
         options = ocp.CheckpointManagerOptions(
             save_interval_steps=interval,
             max_to_keep=keep,
@@ -51,6 +62,9 @@ class CheckpointManager:
 
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
+
+    def all_steps(self) -> list[int]:
+        return list(self._mgr.all_steps())
 
     def should_save(self, step: int) -> bool:
         """Whether `step` is on the save schedule — lets the trainer skip
@@ -82,9 +96,79 @@ class CheckpointManager:
             state=ocp.args.StandardRestore(state_template)))
         return out["state"]
 
+    def quarantine_step(self, step: int) -> str | None:
+        """Move `step`'s directory into `<root>/quarantine/` so the next
+        latest_step() skips it — a partial orbax write (SIGKILL mid-save,
+        torn disk) must cost one checkpoint interval, not wedge every
+        restart on the same poisoned restore. Returns the new path."""
+        src = os.path.join(self.directory, str(step))
+        if not os.path.isdir(src):
+            return None
+        qdir = os.path.join(self.directory, QUARANTINE_DIR)
+        os.makedirs(qdir, exist_ok=True)
+        dst = os.path.join(qdir, str(step))
+        n = 1
+        while os.path.exists(dst):
+            dst = os.path.join(qdir, f"{step}.{n}")
+            n += 1
+        os.rename(src, dst)
+        resilience.metrics.inc("tpk_checkpoint_quarantined_total",
+                               component="train")
+        # Refresh the manager's cached step list; older orbax without
+        # reload() gets a rebuilt manager (same options).
+        try:
+            self._mgr.reload()
+        except AttributeError:
+            self._mgr.close()
+            self._mgr = ocp.CheckpointManager(
+                self.directory,
+                options=ocp.CheckpointManagerOptions(
+                    save_interval_steps=self.interval,
+                    max_to_keep=self._keep,
+                    enable_async_checkpointing=self._async_save,
+                ))
+        return dst
+
+    def restore_latest_good(self, state_template: Any
+                            ) -> tuple[Any, int | None, list[int]]:
+        """Restore the newest step that actually restores, quarantining
+        any that raise (partial write, bad metadata) and falling back to
+        the next-newest — so a torn checkpoint costs one interval of
+        recompute instead of burning the whole backoff budget on a
+        permanently poisoned restore. Returns (state, step, quarantined);
+        (template, None, [...]) when nothing restorable remains."""
+        quarantined: list[int] = []
+        while True:
+            step = self.latest_step()
+            if step is None:
+                return state_template, None, quarantined
+            try:
+                return self.restore(state_template, step), step, quarantined
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                resilience.metrics.inc("tpk_checkpoint_fallback_total",
+                                       component="train")
+                dst = self.quarantine_step(step)
+                if self.latest_step() == step:
+                    # Quarantine didn't remove the step from the scan
+                    # (non-local storage, unexpected step-dir layout):
+                    # surfacing the restore error beats looping on the
+                    # same poisoned step forever.
+                    raise RuntimeError(
+                        f"checkpoint step {step} failed to restore and "
+                        f"could not be quarantined under "
+                        f"{self.directory}") from e
+                quarantined.append(int(step))
+                _LOG.warning(
+                    "checkpoint step %s failed to restore (%s: %s); "
+                    "quarantined to %s, falling back to the next-newest "
+                    "step", step, type(e).__name__, e, dst)
+
     def restore_data_state(self, step: int | None = None) -> Any | None:
         """The saved input-iterator state, or None when the checkpoint
-        predates it (plain-generator jobs)."""
+        predates it (plain-generator jobs) or the item is unreadable
+        (the trainer then falls back to replaying the stream)."""
         step = step if step is not None else self.latest_step()
         if step is None:
             return None
@@ -94,8 +178,13 @@ class CheckpointManager:
             return None  # worst case: the trainer falls back to replay
         if not has_data:
             return None
-        out = self._mgr.restore(
-            step, args=ocp.args.Composite(data=ocp.args.JsonRestore()))
+        try:
+            out = self._mgr.restore(
+                step, args=ocp.args.Composite(data=ocp.args.JsonRestore()))
+        except Exception:
+            # A torn `data` item must not kill a resume whose TrainState
+            # already restored — replaying the stream is the safe floor.
+            return None
         return out["data"]
 
     def wait(self) -> None:

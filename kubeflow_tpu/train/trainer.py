@@ -511,9 +511,16 @@ class Trainer:
 
         start_step = 0
         if self._ckpt is not None:
-            latest = self._ckpt.latest_step()
+            # Corrupt-latest fallback: a torn orbax write (SIGKILL
+            # mid-save) quarantines that step and resumes from the
+            # next-newest good one instead of wedging every restart of
+            # the backoff loop on the same poisoned restore.
+            state, latest, quarantined = \
+                self._ckpt.restore_latest_good(state)
+            for bad in quarantined:
+                self.logger.log(int(bad), {
+                    "event": "checkpoint_quarantined", "step": int(bad)})
             if latest is not None:
-                state = self._ckpt.restore(state)
                 start_step = int(latest)
                 self.logger.log(start_step, {"event": "restored"})
 
