@@ -221,6 +221,16 @@ def main_serve() -> None:
                           "representative of chip performance; relative "
                           "metrics (bucket speedup, int8 delta, batcher "
                           "percentiles) remain meaningful.")
+        if "pipelined_vs_sync" in result:
+            # The tunnel-RTT-hiding claim needs the chip (the ~66 ms
+            # fetch stall IS what pipelining removes); record the chip
+            # measurement as skipped-with-reason per BENCH_r05 precedent
+            # while keeping the CPU harness numbers (mechanism proof:
+            # overlapped fetches + host-stall split still populate).
+            result["pipelined_vs_sync"]["tpu_measurement"] = {
+                "skipped": "tpu_unavailable",
+                "detail": detail,
+            }
     with open("SERVEBENCH.json", "w") as fh:
         json.dump(result, fh, indent=1)
     print(json.dumps({

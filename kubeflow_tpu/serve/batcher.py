@@ -27,13 +27,14 @@ _FP_PREDICT = faults.register_point(
 
 
 class _Item:
-    __slots__ = ("inputs", "future", "n", "deadline")
+    __slots__ = ("inputs", "future", "n", "deadline", "t_enq")
 
     def __init__(self, inputs: Sequence[np.ndarray],
                  deadline: Deadline | None = None):
         self.inputs = [np.asarray(x) for x in inputs]
         self.n = self.inputs[0].shape[0]
         self.deadline = deadline
+        self.t_enq = time.monotonic()
         self.future: Future = Future()
 
     def deliver(self, result=None, exc: BaseException | None = None) -> None:
@@ -124,8 +125,18 @@ class Batcher:
 
     def _gather(self) -> list[_Item] | None:
         """Blocks for the first item, then drains until size limit or until
-        max_latency has elapsed since the FIRST item (a fixed deadline, not a
-        per-item idle timeout — trickling arrivals must not extend it)."""
+        max_latency has elapsed since the FIRST item was ENQUEUED (not
+        since this gather started — a fixed deadline, not a per-item idle
+        timeout: trickling arrivals must not extend it, and time the head
+        already spent queued behind an in-flight batch counts).
+
+        The enqueue-anchored deadline is the p99 fix (ISSUE 3 satellite,
+        PROFILE.md §5): waiters that arrived while the previous batch was
+        executing have typically burned their whole window already — the
+        old gather made them wait a FRESH window (a full extra batch
+        generation) before flushing. Now an expired window flushes
+        immediately, after sweeping every already-queued compatible
+        waiter into the same device call."""
         first = self._pending or self._q.get()
         self._pending = None
         while first is not None and first.expire_if_due():
@@ -136,13 +147,14 @@ class Batcher:
             return None
         batch, total = [first], first.n
         sig = first.signature()
-        deadline = time.monotonic() + self.max_latency_s
+        deadline = first.t_enq + self.max_latency_s
         while total < self.max_batch_size:
             remaining = deadline - time.monotonic()
-            if remaining <= 0:
-                break
             try:
-                nxt = self._q.get(timeout=remaining)
+                # Window expired: no fresh wait, but DO sweep compatible
+                # waiters already in the queue into this flush.
+                nxt = (self._q.get_nowait() if remaining <= 0
+                       else self._q.get(timeout=remaining))
             except queue.Empty:
                 break
             if nxt is None:

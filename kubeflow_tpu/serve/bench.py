@@ -334,6 +334,61 @@ def bench_multilora(model, params, cfg, *, max_len: int, chunk: int,
     }
 
 
+def bench_pipelined_vs_sync(model, params, cfg, *, slots: int,
+                            max_len: int, chunk: int, buckets,
+                            decode_tokens: int,
+                            rng: np.random.Generator) -> dict:
+    """ISSUE 3 tentpole A/B: the overlapped engine (in-flight decode
+    pipelining + off-critical-path admission, `pipeline_depth=2`) against
+    the synchronous loop (`pipeline_depth=1`, the escape hatch that IS
+    the old engine) on identical traffic — 2 waves of requests so
+    admission overlaps in-flight decode. On the axon tunnel every
+    synchronous chunk fetch pays the ~66 ms RTT (PROFILE.md §1/§5);
+    depth 2 hides it behind the next in-flight chunk. `host_stall_s` and
+    the blocking/overlapped fetch split prove the MECHANISM (the stall
+    left the loop), `wall_s`/`tok_s_e2e` the outcome. Measurement is
+    fetch-synced per the §1 hygiene rule: the wall clock closes when the
+    last request's final tokens have been fetched to the host."""
+    from kubeflow_tpu.serve.generation import GenerationEngine
+
+    res: dict[str, Any] = {}
+    for label, depth in (("sync_depth1", 1), ("pipelined_depth2", 2)):
+        eng = GenerationEngine(model, params, cfg, slots=slots,
+                               max_len=max_len, chunk=chunk,
+                               prefill_buckets=buckets, prefix_cache=0,
+                               pipeline_depth=depth)
+        try:
+            prompts = [list(rng.integers(1, cfg.vocab_size, 16))
+                       for _ in range(2 * slots)]
+            dt, done = _drain(eng, prompts, decode_tokens)
+            s = eng.stats
+            emitted = sum(r["num_output_tokens"] for r in done)
+            res[label] = {
+                "pipeline_depth": depth,
+                "wall_s": round(dt, 4),
+                # Wall-anchored: under overlap the engine-busy clock
+                # (decode_seconds) absorbs admission time the sync loop
+                # spends elsewhere, so emitted/wall is the only tok/s
+                # comparable across the two modes.
+                "tok_s_e2e": round(emitted / max(dt, 1e-9), 1),
+                "host_stall_s": round(s["host_stall_seconds"], 4),
+                "decode_dispatches": s["decode_dispatches"],
+                "blocking_fetches": s["decode_fetch_blocking"],
+                "overlapped_fetches": s["decode_fetch_overlapped"],
+                "admit_overlap": s["admit_overlap"],
+                "wasted_tokens": s["decode_wasted_tokens"],
+            }
+        finally:
+            eng.close()
+    res["speedup_wall"] = round(
+        res["sync_depth1"]["wall_s"]
+        / max(res["pipelined_depth2"]["wall_s"], 1e-9), 3)
+    res["host_stall_removed_s"] = round(
+        res["sync_depth1"]["host_stall_s"]
+        - res["pipelined_depth2"]["host_stall_s"], 4)
+    return res
+
+
 def bench_batcher(*, requests: int = 200, threads: int = 8,
                   max_batch_size: int = 32,
                   max_latency_ms: float = 2.0) -> dict:
@@ -447,6 +502,10 @@ def run_servebench(*, size: str = "1b", quick: bool = False,
         "chunk": chunk,
         "prefill_buckets": list(buckets),
     }
+    log("pipelined vs sync engine (overlapped scheduling A/B)")
+    result["pipelined_vs_sync"] = bench_pipelined_vs_sync(
+        model, params, cfg, slots=2 if quick else 4, max_len=max_len,
+        chunk=chunk, buckets=buckets, decode_tokens=decode_tokens, rng=rng)
     log("decode throughput vs slots")
     result["decode"] = bench_decode_slots(
         model, params, cfg, slots_list=slots_list, max_len=max_len,

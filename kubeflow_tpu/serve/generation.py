@@ -38,6 +38,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
+from collections import OrderedDict, deque
 from typing import Any, Sequence
 
 import jax
@@ -465,6 +466,28 @@ class GenerationEngine:
     `submit()` is thread-safe and blocks until the request completes; the
     worker thread multiplexes all in-flight requests onto the slot batch.
 
+    **Overlapped scheduling** (`pipeline_depth`, default 2): the run loop
+    keeps up to `pipeline_depth` decode chunks in flight — chunk k+1 is
+    dispatched *chained through the on-device cache and last-token carry*
+    before chunk k's tokens are fetched, so the device never idles a
+    tunnel RTT (~66 ms on the axon backend, PROFILE.md §1) between
+    chunks. Admission (prefill/extend/insert) is likewise dispatched
+    *between* in-flight chunks without a host sync — the newly admitted
+    request's first sampled token stays on device as the decode carry and
+    its host value is collected lazily — so admitting request B no longer
+    stalls every active slot for a whole prefill round-trip. When a
+    fetched chunk reveals EOS/budget/deadline for a slot, the chunks
+    already speculatively dispatched contain dead rows for it; the fetch
+    path reconciles by dropping them (`decode_wasted_tokens` /
+    `decode_dead_slot_chunks` account the waste, bounded by
+    `pipeline_depth - 1` chunks per retirement) and the slot is freed at
+    that boundary. `pipeline_depth=1` is the escape hatch: it reproduces
+    the fully synchronous dispatch→fetch loop bit-for-bit (same RNG
+    stream, same host-sync points). Engines with a speculative `draft`
+    always run depth 1 — the spec path's advance is data-dependent
+    (accepted counts), so its carry cannot be chained on device; the spec
+    chunk already amortizes the RTT by n_spec·(gamma+1) tokens.
+
     **Tensor parallelism** (SURVEY.md §2.2 "tensor-parallel serving"):
     pass `mesh` (a jax.sharding.Mesh with a `tensor` axis) and the engine
     shards weights and KV caches over it — KV heads over `tensor` (each
@@ -480,7 +503,7 @@ class GenerationEngine:
                  decode_buckets: Sequence[int] | None = None,
                  prefix_cache: int = 0, seed: int = 0,
                  mesh=None, rules=None, draft: dict | None = None,
-                 adapters: dict | None = None):
+                 adapters: dict | None = None, pipeline_depth: int = 2):
         self.model, self.cfg = model, cfg
         self.max_len, self.chunk, self.n_slots = int(max_len), int(chunk), int(slots)
         msl = int(getattr(cfg, "max_seq_len", 0) or 0)
@@ -575,8 +598,12 @@ class GenerationEngine:
         # the cache charges real HBM; enable it for shared-system-prompt
         # workloads where the recompute saving pays for the residency.
         self._prefix_cap = int(prefix_cache)
-        from collections import OrderedDict
-        self._prefix_lru: "OrderedDict[tuple, Any]" = OrderedDict()
+        # LRU keyed by (aid, prefix_len, hash(token_tuple)); each value is
+        # (token_tuple, fragment) — the tuple verifies the hash, and the
+        # (aid -> {len: count}) side index lets lookup probe by length
+        # instead of scanning every entry (see _prefix_lookup).
+        self._prefix_lru: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._prefix_lens: dict[int, dict[int, int]] = {}
         # Speculative decoding (vLLM draft-model speedup): draft =
         # {"model", "params", "cfg", "gamma"?} — greedy requests decode
         # speculatively (token-identical to vanilla greedy) and
@@ -695,6 +722,20 @@ class GenerationEngine:
             else:
                 self._dparams = jax.device_put(self._dparams_src)
             del self._dparams_src
+        if int(pipeline_depth) < 1:
+            raise ValueError(
+                f"pipeline_depth must be >= 1, got {pipeline_depth}")
+        # Spec engines always run synchronously: the spec chunk's advance
+        # is data-dependent (accepted counts pick the next index), so its
+        # carry cannot chain on device — and the spec dispatch already
+        # amortizes the tunnel RTT across n_spec*(gamma+1) tokens.
+        self.pipeline_depth = (1 if self._spec is not None
+                               else int(pipeline_depth))
+        #: Live in-flight dispatch count (worker-thread writes, metrics
+        #: reads — a plain int store, GIL-atomic). 0 when idle/drained;
+        #: a pipeline that silently re-serializes never reads above 1.
+        self.inflight_depth = 0
+        self._busy_mark: float | None = None
         self._key = jax.random.key(seed)
         self._queue: queue.Queue = queue.Queue()
         self._wake = threading.Event()
@@ -702,6 +743,12 @@ class GenerationEngine:
         self.stats = {"requests": 0, "prompt_tokens": 0, "decode_tokens": 0,
                       "decode_seconds": 0.0, "decode_dispatches": 0,
                       "prefix_hits": 0, "prefix_hit_tokens": 0,
+                      "prefix_misses": 0, "prefix_stores": 0,
+                      "host_stall_seconds": 0.0,
+                      "decode_fetch_blocking": 0,
+                      "decode_fetch_overlapped": 0,
+                      "admit_overlap": 0, "decode_dead_slot_chunks": 0,
+                      "decode_wasted_tokens": 0,
                       "spec_dispatches": 0, "spec_proposed": 0,
                       "spec_accepted": 0, "spec_demotions": 0,
                       "spec_readmissions": 0}
@@ -1040,32 +1087,63 @@ class GenerationEngine:
         prompt (the final token's logits must still be computed). Keys
         carry the ADAPTER index: a prefix computed under adapter X holds
         X's K/V deltas and must never serve a request under adapter Y.
-        Returns (matched_len, fresh fragment copy) or None."""
-        best = None
-        for key in self._prefix_lru:
-            ka, kt = key
-            n = len(kt)
-            if (ka == aid and n < len(ids)
-                    and (best is None or n > len(best[1]))
-                    and list(kt) == ids[:n]):
-                best = key
-        if best is None:
-            return None
-        self._prefix_lru.move_to_end(best)
-        frag = jax.tree.map(jnp.copy, self._prefix_lru[best])
-        return len(best[1]), frag
 
-    def _prefix_store(self, key: tuple, frag) -> None:
+        Fast path (ISSUE 3): entries are keyed `(aid, n, hash(tokens))`
+        and a per-adapter length index drives the probe — one O(n) hash
+        per DISTINCT cached length (longest first) instead of the seed's
+        O(cap × len) scan with a full token-list compare per entry. The
+        stored token tuple still verifies each hash hit, so a collision
+        can only cost a miss, never a wrong fragment.
+        Returns (matched_len, fresh fragment copy) or None."""
+        lens = self._prefix_lens.get(aid)
+        if not lens:
+            return None
+        for n in sorted(lens, reverse=True):
+            if n >= len(ids):
+                continue
+            kt = tuple(ids[:n])
+            key = (aid, n, hash(kt))
+            entry = self._prefix_lru.get(key)
+            if entry is None or entry[0] != kt:
+                continue  # absent, or a same-hash different prefix
+            self._prefix_lru.move_to_end(key)
+            return n, jax.tree.map(jnp.copy, entry[1])
+        return None
+
+    def _prefix_store(self, aid: int, kt: tuple, frag, *,
+                      copy: bool = True) -> None:
         """Snapshot a fragment at a prompt-chunk boundary. Rows past the
         keyed prefix may hold pad/stale K/V — safe, because any reader
         overwrites row i before its query positions can reach it (absolute-
-        position masking hides rows above the current index)."""
-        if key in self._prefix_lru:
+        position masking hides rows above the current index).
+
+        `copy=False` takes `frag` by reference — used for an admission's
+        FINAL fragment, which nothing donates afterwards (`_insert`
+        donates the slot cache, not the fragment) and which every lookup
+        hit copies out of, so the stored tree is never mutated. A store
+        whose key is already resident is a pure LRU touch (no device
+        work)."""
+        key = (aid, len(kt), hash(kt))
+        existing = self._prefix_lru.get(key)
+        if existing is not None and existing[0] == kt:
             self._prefix_lru.move_to_end(key)
             return
-        self._prefix_lru[key] = jax.tree.map(jnp.copy, frag)
+        if existing is None:
+            per = self._prefix_lens.setdefault(aid, {})
+            per[len(kt)] = per.get(len(kt), 0) + 1
+        self._prefix_lru[key] = (kt, frag if not copy
+                                 else jax.tree.map(jnp.copy, frag))
+        self._prefix_lru.move_to_end(key)
+        self.stats["prefix_stores"] += 1
         while len(self._prefix_lru) > self._prefix_cap:
-            self._prefix_lru.popitem(last=False)
+            (eaid, en, _), _ = self._prefix_lru.popitem(last=False)
+            per = self._prefix_lens.get(eaid, {})
+            if per.get(en, 0) <= 1:
+                per.pop(en, None)
+                if not per:
+                    self._prefix_lens.pop(eaid, None)
+            else:
+                per[en] -= 1
 
     def _admit(self, slot: int, req: dict) -> None:
         with self._scope():
@@ -1092,6 +1170,8 @@ class GenerationEngine:
                 done, frag = hit
                 self.stats["prefix_hits"] += 1
                 self.stats["prefix_hit_tokens"] += done
+            else:
+                self.stats["prefix_misses"] += 1
         while done < len(ids):
             piece = ids[done:done + big]
             final = done + len(piece) >= len(ids)
@@ -1117,7 +1197,16 @@ class GenerationEngine:
                     jnp.asarray([done], jnp.int32), aid=aid1)
             done += len(piece)
             if self._prefix_cap:
-                self._prefix_store((aid, tuple(ids[:done])), frag)
+                # Skip fragments a LATER boundary of this same admission
+                # would immediately LRU-evict (cap < boundaries left: the
+                # seed copied them only to pop them milliseconds later),
+                # and hand the final fragment over by reference — nothing
+                # donates it after the loop, so the full-fragment HBM
+                # copy the seed paid on every admission is gone.
+                chunks_left = -(-(len(ids) - done) // big)
+                if chunks_left < self._prefix_cap:
+                    self._prefix_store(aid, tuple(ids[:done]), frag,
+                                       copy=done < len(ids))
         self._cache = self._insert(self._cache, frag, jnp.int32(slot))
         spec_able = (req.get("top_k", 0) == 0
                      and req.get("top_p", 1.0) >= 1.0)
@@ -1134,9 +1223,22 @@ class GenerationEngine:
                                          self._draft_replay(ids),
                                          jnp.int32(slot))
             draft_ok = True
-        first = int(tok0[0])
-        self._slots[slot] = {"req": req, "idx": len(ids), "last": first,
-                             "draft_ok": draft_ok, "aid": aid}
+        st = {"req": req, "idx": len(ids), "disp": len(ids), "last": None,
+              "pending": None, "draft_ok": draft_ok, "aid": aid}
+        if self.pipeline_depth > 1:
+            # Off-critical-path admission: do NOT fetch the first sampled
+            # token here — that host sync would serialize the prefill
+            # behind every in-flight decode chunk and stall the loop for
+            # all slots. The token stays on device as the slot's decode
+            # carry; its host value lands via the async copy and is
+            # emitted at the next poll/fetch boundary.
+            for arr in (tok0, lp0):
+                getattr(arr, "copy_to_host_async", lambda: None)()
+            st["pending"] = (tok0, lp0)
+            self._slots[slot] = st
+        else:
+            st["last"] = int(tok0[0])
+            self._slots[slot] = st
         self.stats["requests"] += 1
         self.stats["prompt_tokens"] += len(ids)
         if aid:
@@ -1147,7 +1249,8 @@ class GenerationEngine:
             name = self._ml_names[aid]
             per[name] = per.get(name, 0) + 1
             self.stats["adapter_requests"] = per
-        self._emit(slot, [first], [float(lp0[0])])
+        if st["pending"] is None:
+            self._emit(slot, st, [st["last"]], [float(lp0[0])])
 
     def _draft_replay(self, ids: list[int]) -> Any:
         """Chunked draft-cache build over a token sequence — the ONE
@@ -1193,12 +1296,14 @@ class GenerationEngine:
         st["draft_ok"] = True
         self.stats["spec_readmissions"] += 1
 
-    def _emit(self, slot: int, tokens: list[int],
+    def _emit(self, slot: int, st: dict, tokens: list[int],
               logprobs: list[float] | None = None) -> None:
-        """Append generated tokens to the slot's request; retire on EOS /
+        """Append generated tokens to `st`'s request; retire on EOS /
         budget / context exhaustion. Streams newly appended tokens to the
-        request's on_tokens callback when one is set."""
-        st = self._slots[slot]
+        request's on_tokens callback when one is set. `st` is passed
+        explicitly (not read from the slot) because in pipelined mode a
+        fetched chunk may belong to a request that already retired and
+        whose slot was re-admitted — the caller reconciles by identity."""
         req = st["req"]
         new: list[int] = []
         finished = req["done"].is_set()
@@ -1224,7 +1329,8 @@ class GenerationEngine:
                 pass
         if finished:
             req["done"].set()
-            self._slots[slot] = None
+            if self._slots[slot] is st:
+                self._slots[slot] = None
 
     def _expire(self, req: dict) -> bool:
         """Finish `req` with DeadlineExceeded when its budget is gone.
@@ -1241,168 +1347,332 @@ class GenerationEngine:
         req["done"].set()
         return True
 
+    def _admit_waiting(self, overlap: bool) -> None:
+        """Admit waiting requests into free slots (chunk boundary).
+        Each free slot keeps popping past already-expired entries
+        (their callers were 504'd) and failed admissions, so a
+        backlog of dead requests can't make live ones wait a chunk
+        per corpse; one empty probe ends the whole scan (no
+        per-slot queue.Empty churn on the idle hot loop). Queued
+        admissions coalesce: every free slot fills in ONE pass, so a
+        burst of arrivals costs one trip through the admission
+        dispatches before the next decode chunk goes out.
+
+        With `overlap` (decode chunks in flight), the prefill/extend/
+        insert dispatches enqueue BEHIND them on the device stream and
+        no host sync happens (`_admit_inner` defers the first-token
+        fetch) — admission is off the critical path, counted by
+        `admit_overlap`."""
+        queue_empty = False
+        for slot in range(self.n_slots):
+            if queue_empty:
+                break
+            while self._slots[slot] is None:
+                try:
+                    req = self._queue.get_nowait()
+                except queue.Empty:
+                    queue_empty = True
+                    break
+                if self._expire(req):
+                    continue  # never admitted; try the next waiter
+                try:
+                    self._admit(slot, req)
+                except Exception as e:  # surface to the caller
+                    req["error"] = f"{type(e).__name__}: {e}"
+                    req["done"].set()
+                    self._slots[slot] = None
+                    continue  # slot still free; try the next waiter
+                if overlap:
+                    self.stats["admit_overlap"] += 1
+                break
+
+    def _emit_pending(self, slot: int, st: dict) -> None:
+        """Deliver a deferred first token (deep-pipeline admission). By
+        the time this runs the prefill has long completed (it precedes
+        any decode chunk containing the slot in stream order) and the
+        async host copy has usually landed — the fetch is a no-wait."""
+        tok0, lp0 = st["pending"]
+        st["pending"] = None
+        first = int(np.asarray(tok0)[0])
+        st["last"] = first
+        self._emit(slot, st, [first], [float(np.asarray(lp0)[0])])
+
+    def _poll_pending_first(self) -> None:
+        """Emit deferred first tokens whose async host copy already
+        landed — chunk-granular TTFT without waiting for the next fetch
+        boundary, and an EOS / max_tokens=1 finish frees the slot before
+        the next dispatch wastes a chunk on it (like the sync path)."""
+        for slot, st in enumerate(self._slots):
+            if st is None or st.get("pending") is None:
+                continue
+            try:
+                if not st["pending"][0].is_ready():
+                    continue
+            except AttributeError:  # older jaxlib: fetch at the boundary
+                continue
+            self._emit_pending(slot, st)
+
+    def _worth_speculating(self, active: list[int]) -> bool:
+        """Gate for dispatching chunk k+1 before chunk k is fetched:
+        never speculate past the context end (the write would clamp — or
+        wrap, in rolling mode), and never when every active request's
+        remaining budget is already covered by in-flight tokens (the
+        chunk would be pure waste). EOS is unknowable on the host; that
+        waste is the price of overlap, bounded by pipeline_depth-1
+        chunks per retirement and accounted in decode_wasted_tokens."""
+        if (max(self._slots[i]["disp"] for i in active) + self.chunk
+                > self.max_len):
+            return False
+        for i in active:
+            st = self._slots[i]
+            inflight = st["disp"] - st["idx"] + (1 if st["pending"] else 0)
+            if len(st["req"]["out"]) + inflight < st["req"]["max_tokens"]:
+                return True
+        return False
+
+    def _try_spec_chunk(self, active: list[int]) -> bool:
+        """Speculative path: greedy traffic decodes draft-then-verify
+        (token-identical to vanilla greedy) and plain-temperature
+        traffic via rejection sampling (the emitted marginal IS the
+        tempered target distribution — spec_acceptance); top-k/
+        top-p requests fall back to plain decode. Worst-case
+        advance is n_spec*(gamma+1) tokens, so the spec dispatch
+        needs that much cache headroom — near max_len the tail
+        decodes vanilla.
+        draft_ok: a slot's draft cache mirrors its target history
+        only while every advance went through the spec path — a
+        vanilla chunk (mixed batch) leaves draft rows unwritten, and
+        the draft would attend garbage there (acceptance collapses,
+        spec becomes pure overhead). Once the batch is all
+        spec-able again, demoted slots RE-ADMIT their draft cache
+        from token history instead of decoding vanilla forever.
+        Runs only with the pipe empty (spec engines are depth-1): the
+        accepted counts decide each slot's next index, so the advance
+        must round-trip to the host every dispatch. Returns True when a
+        spec chunk ran (dispatch + fetch + emit)."""
+        if self._spec is None:
+            return False
+        sts = [self._slots[i] for i in active]
+        if not all(st["req"].get("top_k", 0) == 0
+                   and st["req"].get("top_p", 1.0) >= 1.0 for st in sts):
+            return False
+        worst = self._spec["n_spec"] * (self._spec["gamma"] + 1)
+        need = max(st["idx"] for st in sts) + worst
+        if need > self.max_len:
+            return False
+        # Only re-admit when the spec dispatch can actually run — near
+        # the context end the tail decodes vanilla, and replaying the
+        # draft there would be a demote/replay ping-pong every chunk.
+        # Gates are checked for EVERY demoted slot before any replay
+        # runs (see _readmit_worthwhile).
+        demoted = [i for i in active if not self._slots[i].get("draft_ok")]
+        if not all(self._readmit_worthwhile(self._slots[i])
+                   for i in demoted):
+            return False
+        last = np.zeros((self.n_slots,), np.int32)
+        idx = np.zeros((self.n_slots,), np.int32)
+        temps = np.zeros((self.n_slots,), np.float32)
+        aids = np.zeros((self.n_slots,), np.int32)
+        for i in active:
+            st = self._slots[i]
+            last[i], idx[i] = st["last"], st["idx"]
+            temps[i] = st["req"]["temperature"]
+            aids[i] = st.get("aid", 0)
+        self._key, sub = jax.random.split(self._key)
+        t0 = time.monotonic()
+        with self._scope():
+            for i in demoted:
+                self._readmit_draft(i, self._slots[i])
+        bucket = next((b for b in self.decode_buckets if b >= need),
+                      self.decode_buckets[-1])
+        with self._scope():
+            self._cache, self._dcache, toks, lps, acc = \
+                self._spec_decode[bucket](
+                    self._params, self._dparams, self._cache,
+                    self._dcache, jnp.asarray(last),
+                    jnp.asarray(idx), jnp.asarray(temps), sub,
+                    aid=self._aid_batch(aids))
+        toks = np.asarray(toks)  # [B, n_spec, gamma+1]
+        lps = np.asarray(lps)
+        acc = np.asarray(acc)    # [B, n_spec] accepted counts
+        now = time.monotonic()
+        self.stats["decode_seconds"] += now - t0
+        self.stats["host_stall_seconds"] += now - t0
+        self.stats["decode_fetch_blocking"] += 1
+        self._busy_mark = now
+        self.stats["decode_dispatches"] += 1
+        self.stats["spec_dispatches"] += 1
+        for i in active:
+            emit_t: list[int] = []
+            emit_l: list[float] = []
+            for s in range(self._spec["n_spec"]):
+                kk = int(acc[i, s])
+                emit_t += [int(t) for t in toks[i, s, :kk + 1]]
+                emit_l += [float(v) for v in lps[i, s, :kk + 1]]
+                self.stats["spec_proposed"] += self._spec["gamma"]
+                self.stats["spec_accepted"] += kk
+            st = self._slots[i]
+            st["idx"] += len(emit_t)
+            st["disp"] = st["idx"]
+            st["last"] = emit_t[-1]
+            self.stats["decode_tokens"] += len(emit_t)
+            self._emit(i, st, emit_t, emit_l)
+        return True
+
+    def _dispatch_chunk(self, active: list[int],
+                        carry: dict | None = None) -> dict:
+        """Issue one chunked decode dispatch over the slot batch WITHOUT
+        fetching its result. `carry` is the previous (still in-flight)
+        dispatch record: its on-device last-token column chains straight
+        into this dispatch, so back-to-back chunks execute with no host
+        round-trip between them. Rows that didn't ride the carry — a
+        slot admitted mid-pipe (its prefill's sampled token is spliced in
+        as an on-device scalar) or one re-synced after a drain — are
+        overridden individually. Truncation costs a full-vocab sort per
+        step; only pay it when some active request actually asked for
+        top-k/top-p. The cache-length bucket is the smallest covering
+        every active sequence after this chunk — short conversations
+        never pay max_len-wide attention."""
+        last = np.zeros((self.n_slots,), np.int32)
+        idx = np.zeros((self.n_slots,), np.int32)
+        temps = np.zeros((self.n_slots,), np.float32)
+        ks = np.zeros((self.n_slots,), np.int32)
+        ps = np.ones((self.n_slots,), np.float32)
+        aids = np.zeros((self.n_slots,), np.int32)
+        for i in active:
+            st = self._slots[i]
+            idx[i] = st["disp"]
+            temps[i] = st["req"]["temperature"]
+            ks[i] = st["req"].get("top_k", 0)
+            ps[i] = st["req"].get("top_p", 1.0)
+            aids[i] = st.get("aid", 0)
+            if st["pending"] is None and st["last"] is not None:
+                last[i] = st["last"]
+        trunc = any(ks[i] > 0 or ps[i] < 1.0 for i in active)
+        need = int(max(idx[i] for i in active)) + self.chunk
+        bucket = next((b for b in self.decode_buckets if b >= need),
+                      self.decode_buckets[-1])
+        self._key, sub = jax.random.split(self._key)
+        t0 = time.monotonic()
+        with self._scope():
+            last_dev = (jnp.asarray(last) if carry is None
+                        else carry["toks"][:, -1])
+            for i in active:
+                st = self._slots[i]
+                if carry is not None and carry["parts"].get(i) is st:
+                    continue  # row rides the on-device carry
+                if st["pending"] is not None:
+                    # Mid-pipe admission: splice the prefill's on-device
+                    # first token into the carried vector (a scalar
+                    # update dispatch, no host round-trip).
+                    last_dev = last_dev.at[i].set(st["pending"][0][0])
+                elif carry is not None:
+                    last_dev = last_dev.at[i].set(np.int32(st["last"]))
+            self._cache, toks, lps = self._decode[(bucket, trunc)](
+                self._params, self._cache, last_dev, jnp.asarray(idx),
+                jnp.asarray(temps), jnp.asarray(ks), jnp.asarray(ps),
+                sub, aid=self._aid_batch(aids))
+        # Start the D2H transfer now; the fetch a pipeline-depth later
+        # should find the bytes already on host.
+        for arr in (toks, lps):
+            getattr(arr, "copy_to_host_async", lambda: None)()
+        self.stats["decode_dispatches"] += 1
+        parts: dict[int, dict] = {}
+        for i in active:
+            st = self._slots[i]
+            st["disp"] += self.chunk
+            parts[i] = st
+        return {"toks": toks, "lps": lps, "parts": parts, "t0": t0,
+                "chunk": self.chunk}
+
+    def _fetch_chunk(self, rec: dict, overlapped: bool) -> None:
+        """Fetch one dispatch record's tokens (the host sync point) and
+        reconcile: a slot whose dispatch-time occupant already retired
+        (EOS / budget / deadline at an earlier boundary) gets its rows
+        dropped — the chunk was speculatively dead for it. `overlapped`
+        records whether another chunk was still in flight during this
+        fetch (the steady-state pipelining invariant the CPU dispatch-
+        count guard test pins)."""
+        t0 = time.monotonic()
+        toks = np.asarray(rec["toks"])  # host sync point: [B, chunk]
+        lps = np.asarray(rec["lps"])
+        now = time.monotonic()
+        self.stats["host_stall_seconds"] += now - t0
+        self.stats["decode_fetch_overlapped" if overlapped
+                    else "decode_fetch_blocking"] += 1
+        # decode_seconds sums ENGINE-BUSY wall time (non-overlapping
+        # intervals), so throughput() stays honest when chunks overlap.
+        start = (rec["t0"] if self._busy_mark is None
+                 else max(self._busy_mark, rec["t0"]))
+        self.stats["decode_seconds"] += now - start
+        self._busy_mark = now
+        for i, st in rec["parts"].items():
+            if self._slots[i] is not st:
+                self.stats["decode_dead_slot_chunks"] += 1
+                self.stats["decode_wasted_tokens"] += rec["chunk"]
+                continue
+            if st["pending"] is not None:
+                # First token of a mid-pipe admission: emit it before
+                # the chunk tokens (the chunk was decoded FROM it).
+                self._emit_pending(i, st)
+                if self._slots[i] is not st:  # EOS/budget at token 1
+                    self.stats["decode_dead_slot_chunks"] += 1
+                    self.stats["decode_wasted_tokens"] += rec["chunk"]
+                    continue
+            st["idx"] += rec["chunk"]
+            st["last"] = int(toks[i, -1])
+            # This vanilla chunk left the slot's DRAFT cache rows
+            # unwritten — spec decoding must not trust them until
+            # re-admission replays the slot's history
+            # (_readmit_draft, once the batch is all-spec-able
+            # again). spec_demotions / spec_readmissions count both
+            # sides (perf effects, never correctness).
+            if st.get("draft_ok"):
+                self.stats["spec_demotions"] += 1
+            st["draft_ok"] = False
+            self.stats["decode_tokens"] += rec["chunk"]
+            self._emit(i, st, [int(t) for t in toks[i]],
+                       [float(v) for v in lps[i]])
+
     def _loop(self) -> None:
+        """The scheduler: admit → sweep deadlines → keep up to
+        `pipeline_depth` decode chunks in flight → fetch the oldest.
+        At depth 1 each iteration dispatches then immediately fetches —
+        the synchronous engine, bit-for-bit (same RNG splits, same sync
+        points). At depth >= 2 the fetch of chunk k overlaps the device
+        executing chunk k+1 (and any admission dispatches), hiding the
+        host/tunnel round-trip that capped 1-slot decode at ~200 tok/s
+        regardless of chip speed (PROFILE.md §5)."""
+        inflight: deque = deque()
         while not self._stop:
-            # Admit waiting requests into free slots (chunk boundary).
-            # Each free slot keeps popping past already-expired entries
-            # (their callers were 504'd) and failed admissions, so a
-            # backlog of dead requests can't make live ones wait a chunk
-            # per corpse; one empty probe ends the whole scan (no
-            # per-slot queue.Empty churn on the idle hot loop).
-            queue_empty = False
-            for slot in range(self.n_slots):
-                if queue_empty:
-                    break
-                while self._slots[slot] is None:
-                    try:
-                        req = self._queue.get_nowait()
-                    except queue.Empty:
-                        queue_empty = True
-                        break
-                    if self._expire(req):
-                        continue  # never admitted; try the next waiter
-                    try:
-                        self._admit(slot, req)
-                    except Exception as e:  # surface to the caller
-                        req["error"] = f"{type(e).__name__}: {e}"
-                        req["done"].set()
-                        self._slots[slot] = None
-                        continue  # slot still free; try the next waiter
-                    break
+            self._admit_waiting(overlap=bool(inflight))
             # Chunk-boundary deadline sweep: an expired request frees its
             # slot NOW instead of decoding tokens its caller (already
-            # 504'd) will never read — expiry costs the batch at most one
-            # chunk of waste.
+            # 504'd) will never read — expiry costs the batch at most
+            # pipeline_depth chunks of waste.
             for i, st in enumerate(self._slots):
                 if st is not None and self._expire(st["req"]):
                     self._slots[i] = None
-            active = [i for i, s in enumerate(self._slots) if s is not None]
-            if not active:
+            self._poll_pending_first()
+            active = [i for i, s in enumerate(self._slots)
+                      if s is not None]
+            if not active and not inflight:
+                self._busy_mark = None
                 self._wake.wait(0.05)
                 self._wake.clear()
                 continue
-            # One chunked decode dispatch over the whole slot batch.
-            last = np.zeros((self.n_slots,), np.int32)
-            idx = np.zeros((self.n_slots,), np.int32)
-            temps = np.zeros((self.n_slots,), np.float32)
-            ks = np.zeros((self.n_slots,), np.int32)
-            ps = np.ones((self.n_slots,), np.float32)
-            aids = np.zeros((self.n_slots,), np.int32)
-            for i in active:
-                st = self._slots[i]
-                last[i], idx[i] = st["last"], st["idx"]
-                temps[i] = st["req"]["temperature"]
-                ks[i] = st["req"].get("top_k", 0)
-                ps[i] = st["req"].get("top_p", 1.0)
-                aids[i] = st.get("aid", 0)
-            self._key, sub = jax.random.split(self._key)
-            t0 = time.monotonic()
-            # Speculative path: greedy traffic decodes draft-then-verify
-            # (token-identical to vanilla greedy) and plain-temperature
-            # traffic via rejection sampling (the emitted marginal IS the
-            # tempered target distribution — spec_acceptance); top-k/
-            # top-p requests fall back to plain decode. Worst-case
-            # advance is n_spec*(gamma+1) tokens, so the spec dispatch
-            # needs that much cache headroom — near max_len the tail
-            # decodes vanilla.
-            # draft_ok: a slot's draft cache mirrors its target history
-            # only while every advance went through the spec path — a
-            # vanilla chunk (mixed batch) leaves draft rows unwritten, and
-            # the draft would attend garbage there (acceptance collapses,
-            # spec becomes pure overhead). Once the batch is all
-            # spec-able again, demoted slots RE-ADMIT their draft cache
-            # from token history instead of decoding vanilla forever.
-            spec_able_batch = (self._spec is not None
-                               and all(ks[i] == 0 and ps[i] >= 1.0
-                                       for i in active))
-            spec_ok = False
-            if spec_able_batch:
-                worst = self._spec["n_spec"] * (self._spec["gamma"] + 1)
-                need = max(int(idx[i]) for i in active) + worst
-                if need <= self.max_len:
-                    # Only re-admit when the spec dispatch can actually
-                    # run — near the context end the tail decodes
-                    # vanilla, and replaying the draft there would be a
-                    # demote/replay ping-pong every chunk. Gates are
-                    # checked for EVERY demoted slot before any replay
-                    # runs (see _readmit_worthwhile).
-                    demoted = [i for i in active
-                               if not self._slots[i].get("draft_ok")]
-                    if all(self._readmit_worthwhile(self._slots[i])
-                           for i in demoted):
-                        with self._scope():
-                            for i in demoted:
-                                self._readmit_draft(i, self._slots[i])
-                        spec_ok = True
-            if spec_ok:
-                bucket = next(
-                    (b for b in self.decode_buckets if b >= need),
-                    self.decode_buckets[-1])
-                with self._scope():
-                    self._cache, self._dcache, toks, lps, acc = \
-                        self._spec_decode[bucket](
-                            self._params, self._dparams, self._cache,
-                            self._dcache, jnp.asarray(last),
-                            jnp.asarray(idx), jnp.asarray(temps), sub,
-                            aid=self._aid_batch(aids))
-                toks = np.asarray(toks)  # [B, n_spec, gamma+1]
-                lps = np.asarray(lps)
-                acc = np.asarray(acc)    # [B, n_spec] accepted counts
-                dt = time.monotonic() - t0
-                self.stats["decode_seconds"] += dt
-                self.stats["decode_dispatches"] += 1
-                self.stats["spec_dispatches"] += 1
-                for i in active:
-                    emit_t: list[int] = []
-                    emit_l: list[float] = []
-                    for s in range(self._spec["n_spec"]):
-                        kk = int(acc[i, s])
-                        emit_t += [int(t) for t in toks[i, s, :kk + 1]]
-                        emit_l += [float(v) for v in lps[i, s, :kk + 1]]
-                        self.stats["spec_proposed"] += self._spec["gamma"]
-                        self.stats["spec_accepted"] += kk
-                    st = self._slots[i]
-                    st["idx"] += len(emit_t)
-                    st["last"] = emit_t[-1]
-                    self.stats["decode_tokens"] += len(emit_t)
-                    self._emit(i, emit_t, emit_l)
+            if active and not inflight and self._try_spec_chunk(active):
                 continue
-            # Truncation costs a full-vocab sort per step; only pay it
-            # when some active request actually asked for top-k/top-p.
-            # The cache-length bucket is the smallest covering every
-            # active sequence after this chunk — short conversations
-            # never pay max_len-wide attention.
-            trunc = any(ks[i] > 0 or ps[i] < 1.0 for i in active)
-            need = max(int(idx[i]) for i in active) + self.chunk
-            bucket = next((b for b in self.decode_buckets if b >= need),
-                          self.decode_buckets[-1])
-            decode = self._decode[(bucket, trunc)]
-            with self._scope():
-                self._cache, toks, lps = decode(
-                    self._params, self._cache, jnp.asarray(last),
-                    jnp.asarray(idx), jnp.asarray(temps), jnp.asarray(ks),
-                    jnp.asarray(ps), sub, aid=self._aid_batch(aids))
-            toks = np.asarray(toks)  # sync point: [B, chunk]
-            lps = np.asarray(lps)
-            dt = time.monotonic() - t0
-            self.stats["decode_seconds"] += dt
-            self.stats["decode_dispatches"] += 1
-            self.stats["decode_tokens"] += len(active) * self.chunk
-            for i in active:
-                st = self._slots[i]
-                st["idx"] += self.chunk
-                st["last"] = int(toks[i, -1])
-                # This vanilla chunk left the slot's DRAFT cache rows
-                # unwritten — spec decoding must not trust them until
-                # re-admission replays the slot's history
-                # (_readmit_draft, once the batch is all-spec-able
-                # again). spec_demotions / spec_readmissions count both
-                # sides (perf effects, never correctness).
-                if st.get("draft_ok"):
-                    self.stats["spec_demotions"] += 1
-                st["draft_ok"] = False
-                self._emit(i, [int(t) for t in toks[i]],
-                           [float(v) for v in lps[i]])
+            while active and len(inflight) < self.pipeline_depth:
+                if inflight and not self._worth_speculating(active):
+                    break
+                inflight.append(self._dispatch_chunk(
+                    active, carry=inflight[-1] if inflight else None))
+                self.inflight_depth = len(inflight)
+            if inflight:
+                rec = inflight.popleft()
+                self.inflight_depth = len(inflight)
+                self._fetch_chunk(rec, overlapped=bool(inflight))
 
     def throughput(self) -> float:
         s = self.stats
@@ -1621,6 +1891,7 @@ class GenerativeJAXModel(Model):
         })
         if self.engine:
             md["decode_buckets"] = list(self.engine.decode_buckets)
+            md["pipeline_depth"] = self.engine.pipeline_depth
             md["speculative"] = self.engine._spec is not None
             if self.engine.adapter_names():
                 md["adapters"] = self.engine.adapter_names()

@@ -118,6 +118,16 @@ class InferenceServicer:
                              shape=[int(s) for s in t["shape"]])
         return resp
 
+    def Metrics(self, request: bytes, context) -> bytes:
+        """Prometheus text over gRPC (`/tpk.Metrics/Prometheus`): the
+        SAME rendering the HTTP /metrics endpoint serves — engine
+        counters (tpk_decode_dispatch_total, host-stall, admit-overlap,
+        prefix hits), batcher/admission gauges, resilience counters —
+        so a gRPC-only deployment still gets the full scrape. Raw-bytes
+        payload via identity (de)serializers: the message needs no
+        schema and the checked-in protoc gencode stays untouched."""
+        return self.server.prometheus_text().encode()
+
     def ModelInfer(self, request, context):
         # The gRPC data plane sits behind the SAME admission gate as the
         # HTTP handlers — it must not be an unbounded side door around
@@ -272,9 +282,16 @@ def build_grpc_server(server: "ModelServer", port: int = 0,
         "ModelInfer": _unary(servicer.ModelInfer, pb.ModelInferRequest,
                              pb.ModelInferResponse),
     })
+    metrics_handlers = grpc.method_handlers_generic_handler(
+        "tpk.Metrics", {
+            "Prometheus": grpc.unary_unary_rpc_method_handler(
+                servicer.Metrics,
+                request_deserializer=lambda b: b,
+                response_serializer=lambda b: b),
+        })
     gserver = grpc.server(futures.ThreadPoolExecutor(
         max_workers=max_workers, thread_name_prefix="tpk-grpc"))
-    gserver.add_generic_rpc_handlers((handlers,))
+    gserver.add_generic_rpc_handlers((handlers, metrics_handlers))
     bound = gserver.add_insecure_port(f"127.0.0.1:{port}")
     if bound == 0:
         # Fail loudly: advertising a dead port would leave the replica
@@ -310,6 +327,16 @@ class InferenceClient:
         return self._call("ModelMetadata",
                           pb.ModelMetadataRequest(name=name),
                           pb.ModelMetadataResponse)
+
+    def metrics(self) -> str:
+        """The server's Prometheus text over the gRPC plane (same
+        rendering as HTTP /metrics — engine pipelining counters
+        included)."""
+        rpc = self._channel.unary_unary(
+            "/tpk.Metrics/Prometheus",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b)
+        return rpc(b"").decode()
 
     def infer(self, name: str, arrays: list[np.ndarray], *,
               raw: bool = False) -> list[np.ndarray]:

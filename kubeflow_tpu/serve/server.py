@@ -48,6 +48,36 @@ from kubeflow_tpu.utils.resilience import (Deadline, DeadlineExceeded,
 #: the request path — admission queue, batcher, generation — returns 504.
 DEADLINE_HEADER = "X-Request-Timeout-Ms"
 
+#: GenerationEngine stats → /metrics series (ISSUE 3 observability): the
+#: engine's own counters rendered per model on every scrape, so the
+#: overlapped-scheduling claim (host-stall removal, overlapped fetches,
+#: off-critical-path admissions) and the prefix-cache economy are
+#: observable in Prometheus, not just in SERVEBENCH.json. The sentinel
+#: "__depth__" row reads the engine attribute instead of a stats key.
+_ENGINE_METRICS = (
+    ("requests", "tpk_engine_requests_total", "counter"),
+    ("prompt_tokens", "tpk_engine_prompt_tokens_total", "counter"),
+    ("decode_tokens", "tpk_engine_decode_tokens_total", "counter"),
+    ("decode_dispatches", "tpk_decode_dispatch_total", "counter"),
+    ("prefix_hits", "tpk_engine_prefix_hits_total", "counter"),
+    ("prefix_hit_tokens", "tpk_engine_prefix_hit_tokens_total", "counter"),
+    ("prefix_misses", "tpk_engine_prefix_misses_total", "counter"),
+    ("host_stall_seconds", "tpk_engine_host_stall_seconds_total",
+     "counter"),
+    ("admit_overlap", "tpk_admit_overlap_total", "counter"),
+    ("decode_fetch_blocking", "tpk_engine_decode_fetch_blocking_total",
+     "counter"),
+    ("decode_fetch_overlapped",
+     "tpk_engine_decode_fetch_overlapped_total", "counter"),
+    ("decode_wasted_tokens", "tpk_engine_decode_wasted_tokens_total",
+     "counter"),
+    ("spec_dispatches", "tpk_engine_spec_dispatch_total", "counter"),
+    # Live in-flight dispatch count (0 when drained; stuck at ≤1 means
+    # the pipeline re-serialized) vs the configured ceiling.
+    ("__inflight__", "tpk_decode_inflight_depth", "gauge"),
+    ("__depth__", "tpk_engine_pipeline_depth", "gauge"),
+)
+
 
 class AdmissionController:
     """Bounded admission for the inference data plane — the KServe/
@@ -896,11 +926,46 @@ class ModelServer:
                 "# TYPE tpk_serve_inflight gauge",
                 f"tpk_serve_inflight {self.admission.inflight}",
             ]
+        lines += self._engine_metric_lines()
         out = "\n".join(lines) + "\n"
         # The shared resilience counters (retries, deadline expiries,
         # sheds) render on the same scrape — one metrics surface for the
         # whole failure story.
         return out + res_metrics.prometheus_text()
+
+    def _engine_metric_lines(self) -> list[str]:
+        """Per-model generation-engine counters (see _ENGINE_METRICS)."""
+        rows = []
+        for name in self.repo.names():
+            try:
+                model = self.repo.get(name)
+            except Exception:
+                continue  # unloaded between names() and get()
+            engine = getattr(model, "engine", None)
+            stats = getattr(engine, "stats", None)
+            if not stats:
+                continue
+            # Shallow snapshot: the engine worker mutates its dict.
+            rows.append((name, engine, dict(stats)))
+        lines: list[str] = []
+        for stat_key, metric, kind in _ENGINE_METRICS:
+            typed = False
+            for name, engine, stats in rows:
+                if stat_key == "__depth__":
+                    val = getattr(engine, "pipeline_depth", 1)
+                elif stat_key == "__inflight__":
+                    val = getattr(engine, "inflight_depth", 0)
+                else:
+                    val = stats.get(stat_key)
+                    if val is None:
+                        continue
+                if not typed:
+                    lines.append(f"# TYPE {metric} {kind}")
+                    typed = True
+                v = (int(val) if float(val).is_integer()
+                     else round(float(val), 6))
+                lines.append(f'{metric}{{model="{name}"}} {v}')
+        return lines
 
     def app(self) -> tornado.web.Application:
         from kubeflow_tpu.serve import openai_api

@@ -183,6 +183,40 @@ def test_generative_metadata_and_v2_infer(gen_server):
     assert body["outputs"][0]["shape"] == [1, 4, CFG.vocab_size]
 
 
+def test_engine_counters_on_metrics_and_grpc(gen_server):
+    """ISSUE 3 observability: the generation engine's stats render as
+    tpk_* series on /metrics (per model) AND over the gRPC plane's
+    Prometheus method — one scrape, two transports, so the pipelining
+    counters (dispatches, inflight depth, host stall, admit overlap) are
+    monitorable however the replica is fronted."""
+    base, srv = gen_server
+    _http("POST", f"{base}/v1/models/llm:generate",
+          {"input_ids": [5, 9, 2], "max_tokens": 6})
+    import urllib.request
+    with urllib.request.urlopen(f"{base}/metrics", timeout=30) as r:
+        text = r.read().decode()
+    for metric in ('tpk_engine_requests_total{model="llm"}',
+                   'tpk_decode_dispatch_total{model="llm"}',
+                   'tpk_decode_inflight_depth{model="llm"}',
+                   'tpk_engine_pipeline_depth{model="llm"} 2',
+                   'tpk_engine_host_stall_seconds_total{model="llm"}',
+                   'tpk_admit_overlap_total{model="llm"}',
+                   'tpk_engine_prefix_hits_total{model="llm"}',
+                   'tpk_engine_prompt_tokens_total{model="llm"}'):
+        assert metric in text, metric
+    # Same rendering over gRPC.
+    from kubeflow_tpu.serve.grpc_server import InferenceClient
+
+    port = srv.start_grpc(0)
+    client = InferenceClient(f"127.0.0.1:{port}")
+    try:
+        gtext = client.metrics()
+        assert 'tpk_decode_dispatch_total{model="llm"}' in gtext
+        assert 'tpk_decode_inflight_depth{model="llm"}' in gtext
+    finally:
+        client.close()
+
+
 def test_sampling_top_k_top_p():
     import jax
     import jax.numpy as jnp

@@ -1,0 +1,166 @@
+"""Prefix-cache correctness under the overlapped engine (ISSUE 3
+satellites): the (aid, len, hash)-indexed fast path must keep the seed's
+semantics — adapter-keyed isolation, strict-shorter longest-prefix hits,
+LRU eviction at `_prefix_cap` — while skipping the wasted fragment copies
+(no-op stores, immediately-evicted boundary stores). All CPU-runnable.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models.llama import Llama, llama_tiny
+from kubeflow_tpu.serve.generation import GenerationEngine
+from tests.test_generate import ref_greedy
+
+pytestmark = pytest.mark.slow  # engine-compile-heavy; full tier covers it
+
+CFG = dataclasses.replace(llama_tiny(), dtype=jnp.float32, num_layers=2)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    model = Llama(CFG)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))[
+        "params"]
+    return model, params
+
+
+def _engine(tiny, **kw):
+    model, params = tiny
+    kw.setdefault("slots", 1)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("chunk", 4)
+    kw.setdefault("prefill_buckets", (8,))
+    return GenerationEngine(model, params, CFG, **kw)
+
+
+def test_hit_miss_counters_and_exact_output(tiny):
+    """Cold admission counts a miss; a shared-head resubmit counts a hit
+    covering the longest chunk-boundary prefix STRICTLY shorter than the
+    prompt — and the pipelined continuation still greedy-decodes exactly
+    like the uncached reference."""
+    model, params = tiny
+    head = [7, 3, 11, 2, 9, 1, 4, 4, 30, 8, 2, 5, 19, 6, 1, 3]  # 2 chunks
+    eng = _engine(tiny, prefix_cache=8)
+    try:
+        eng.submit(head + [40, 2], max_tokens=4)
+        assert eng.stats["prefix_misses"] == 1
+        assert eng.stats["prefix_hits"] == 0
+        out = eng.submit(head + [12, 33, 5], max_tokens=8)
+        assert eng.stats["prefix_hits"] == 1
+        assert eng.stats["prefix_hit_tokens"] >= 16
+        assert out["output_ids"] == ref_greedy(
+            model, params, head + [12, 33, 5], 8)
+    finally:
+        eng.close()
+
+
+def test_lru_eviction_at_cap(tiny):
+    """The cache never exceeds `_prefix_cap`; the oldest entry is the
+    one evicted (an evicted head no longer hits, a fresh one does), and
+    the length index shrinks with it (no stale probe lengths)."""
+    eng = _engine(tiny, prefix_cache=2)
+    heads = [[i + 1] * 6 for i in range(4)]  # one boundary per admission
+    try:
+        for h in heads:
+            eng.submit(h, max_tokens=2)
+        assert len(eng._prefix_lru) <= 2
+        assert sum(len(v) for v in eng._prefix_lens.values()) <= 2
+        hits0 = eng.stats["prefix_hits"]
+        # Evicted long ago — a miss (and this probe's own store evicts
+        # heads[2], the then-oldest resident).
+        eng.submit(heads[0] + [50], max_tokens=2)
+        assert eng.stats["prefix_hits"] == hits0
+        eng.submit(heads[3] + [50], max_tokens=2)  # still resident
+        assert eng.stats["prefix_hits"] == hits0 + 1
+    finally:
+        eng.close()
+
+
+def test_immediately_evicted_boundary_stores_skipped(tiny):
+    """A 3-chunk admission at cap=1 must store ONE fragment (the final
+    boundary — the only one that can survive), not copy three and pop
+    two: `prefix_stores` counts actual inserts."""
+    eng = _engine(tiny, prefix_cache=1)
+    prompt = list(np.random.default_rng(3).integers(1, 60, 22))  # 3 chunks
+    try:
+        eng.submit(prompt, max_tokens=2)
+        assert eng.stats["prefix_stores"] == 1
+        assert len(eng._prefix_lru) == 1
+        (aid, n, _h) = next(iter(eng._prefix_lru))
+        assert (aid, n) == (0, len(prompt))
+    finally:
+        eng.close()
+
+
+def test_noop_restore_does_not_copy(tiny):
+    """Re-admitting an identical prompt touches the LRU (move_to_end)
+    without a fresh device copy: `prefix_stores` stays flat."""
+    eng = _engine(tiny, prefix_cache=4)
+    prompt = [9, 9, 2, 4, 1, 7, 7, 3, 6, 6]
+    try:
+        eng.submit(prompt, max_tokens=2)
+        stores = eng.stats["prefix_stores"]
+        eng.submit(prompt, max_tokens=2)
+        assert eng.stats["prefix_stores"] == stores
+    finally:
+        eng.close()
+
+
+def test_adapter_keyed_isolation(tiny):
+    """A prefix computed under adapter X holds X's K/V deltas and must
+    never serve adapter Y (or base): cross-adapter lookups miss, and the
+    base stream stays identical to the no-adapter reference even after
+    the adapter seeded the same token prefix."""
+    from kubeflow_tpu.serve.bench import _synth_adapter_dir
+
+    model, params = tiny
+    a_dir = _synth_adapter_dir(CFG, "/tmp/tpk_prefix_ada", seed=21)
+    eng = GenerationEngine(model, params, CFG, slots=1, max_len=64,
+                           chunk=4, prefill_buckets=(8,), prefix_cache=8,
+                           adapters={"ada": a_dir})
+    prompt = list(range(2, 18))  # 2 chunks
+    try:
+        out_a = eng.submit(prompt + [40], max_tokens=5, adapter="ada")
+        hits_after_a = eng.stats["prefix_hits"]
+        # Same token prefix under BASE: must not reuse ada's fragments.
+        out_base = eng.submit(prompt + [40], max_tokens=5)
+        assert eng.stats["prefix_hits"] == hits_after_a
+        assert out_base["output_ids"] == ref_greedy(
+            model, params, prompt + [40], 5)
+        # Same-adapter extension DOES hit.
+        eng.submit(prompt + [40, 12], max_tokens=5, adapter="ada")
+        assert eng.stats["prefix_hits"] == hits_after_a + 1
+        # The adapter stream itself must be self-consistent: a cached
+        # resubmit equals the cold submit.
+        rerun = eng.submit(prompt + [40], max_tokens=5, adapter="ada")
+        assert rerun["output_ids"] == out_a["output_ids"]
+    finally:
+        eng.close()
+
+
+def test_hash_collision_entry_never_serves_wrong_tokens(tiny):
+    """Force a fabricated same-(aid,len,hash) entry into the LRU: lookup
+    must reject it on the token-tuple verify (a collision can cost a
+    miss, never a wrong fragment)."""
+    eng = _engine(tiny, prefix_cache=4)
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6]  # one full chunk boundary
+    try:
+        eng.submit(prompt, max_tokens=2)
+        # Rekey the real entry under a DIFFERENT token tuple's identity.
+        ((aid, n, h), (kt, frag)), = list(eng._prefix_lru.items())
+        fake = tuple([99] * n)
+        eng._prefix_lru.clear()
+        eng._prefix_lru[(aid, n, hash(fake))] = (kt, frag)
+        eng._prefix_lens = {aid: {n: 1}}
+        hits0 = eng.stats["prefix_hits"]
+        out = eng.submit(list(fake) + [7], max_tokens=4)
+        assert eng.stats["prefix_hits"] == hits0  # verify rejected it
+        assert out["output_ids"] == ref_greedy(
+            eng.model, eng._params, list(fake) + [7], 4)
+    finally:
+        eng.close()

@@ -12,6 +12,16 @@ pytestmark = pytest.mark.slow  # multi-process/e2e/AOT tier
 
 def test_servebench_quick_shape():
     r = run_servebench(size="tiny", quick=True)
+    # Pipelined-vs-sync A/B (ISSUE 3 tentpole): both engines measured,
+    # and the overlap mechanism visibly engaged — the sync engine blocks
+    # on every fetch, the pipelined one overlaps its steady state.
+    ab = r["pipelined_vs_sync"]
+    for row in ("sync_depth1", "pipelined_depth2"):
+        assert ab[row]["tok_s_e2e"] > 0
+        assert ab[row]["wall_s"] > 0
+    assert ab["sync_depth1"]["overlapped_fetches"] == 0
+    assert ab["pipelined_depth2"]["overlapped_fetches"] > 0
+    assert ab["speedup_wall"] > 0
     # Decode concurrency section: throughput positive at each slot count.
     assert set(r["decode"]) == {"slots_1", "slots_2"}
     for v in r["decode"].values():
