@@ -106,6 +106,90 @@ def _probe_attempts() -> int:
     return max(1, int(os.environ.get("KFT_BENCH_PROBE_ATTEMPTS", "4")))
 
 
+def train_input_ab(step, state, mesh, vocab_size: int, batch: int,
+                   seq: int, steps: int = 8, warmup: int = 2,
+                   depth: int = 2, corpus_tokens: int | None = None):
+    """Sync-vs-prefetch input-pipeline A/B for the training hot path
+    (ISSUE 4). One seeded packed-corpus grain stream feeds both arms:
+    arm "sync" is `Prefetcher` depth 0 (pull + packed-row assembly + H2D
+    inline between dispatches — the pre-prefetch trainer loop), arm
+    "prefetch" is depth `depth` (the same host work + device placement
+    on the worker thread, overlapping device compute). Fetch-synced per
+    PROFILE.md §1 hygiene: each arm's clock closes on a single final
+    `float(loss)`, so no unfetched tunnel queue can flatter either arm.
+    Returns (state, section) — state rides through both arms' steps.
+    """
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kubeflow_tpu.data.loader import packed_lm_dataset
+    from kubeflow_tpu.data.prefetch import Prefetcher
+
+    eos = 1
+    rng = np.random.default_rng(0)
+    need = corpus_tokens or (warmup + steps + 2) * batch * (seq + 1) * 2
+    docs = []
+    total = 0
+    while total < need:
+        d = np.append(rng.integers(2, vocab_size, rng.integers(
+            16, max(seq // 2, 17)), dtype=np.int32), eos)
+        docs.append(d)
+        total += len(d)
+    corpus = np.concatenate(docs).astype(np.int32)
+
+    dp = mesh.shape["data"] * mesh.shape["fsdp"]
+
+    def place(b):
+        def conv(x):
+            x = np.asarray(x)
+            # dp sharding when the batch divides; replicated otherwise
+            # (the step reshards, same as the numpy path).
+            spec = (P(("data", "fsdp"), *([None] * (x.ndim - 1)))
+                    if x.ndim and x.shape[0] % dp == 0 else P())
+            return jax.device_put(x, NamedSharding(mesh, spec))
+        return jax.tree.map(conv, b)
+
+    section = {
+        "method": ("identical seeded packed-corpus stream; fetch-synced "
+                   "(single final float(loss)) per PROFILE.md §1; sync = "
+                   "prefetch depth 0 (inline pull+pack+H2D), prefetch = "
+                   f"depth {depth} (worker thread stages device-resident "
+                   "batches)"),
+        "batch": batch, "seq_len": seq, "timed_steps": steps,
+    }
+    for label, d in (("sync", 0), (f"prefetch_depth{depth}", depth)):
+        ds = packed_lm_dataset(corpus, batch_size=batch, seq_len=seq,
+                               eos_id=eos, seed=0, process_index=0,
+                               process_count=1)
+        pf = Prefetcher(iter(ds), depth=d, place=place)
+        try:
+            if warmup:
+                for _ in range(warmup):
+                    state, metrics = step(state, next(pf))
+                float(metrics["loss"])  # drain before opening the clock
+            wait0, h2d0 = pf.data_wait_s, pf.h2d_s
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                state, metrics = step(state, next(pf))
+            final = float(metrics["loss"])  # closes the clock honestly
+            wall = time.perf_counter() - t0
+        finally:
+            pf.close()
+        section[label] = {
+            "ms_per_step": round(wall / steps * 1e3, 2),
+            "tok_s": round(batch * seq * steps / wall, 1),
+            "data_wait_s": round(pf.data_wait_s - wait0, 4),
+            "h2d_s": round(pf.h2d_s - h2d0, 4),
+            "final_loss": round(final, 4),
+        }
+    sync_ms = section["sync"]["ms_per_step"]
+    pre_ms = section[f"prefetch_depth{depth}"]["ms_per_step"]
+    if pre_ms > 0:
+        section["speedup"] = round(sync_ms / pre_ms, 4)
+    return state, section
+
+
 def main() -> None:
     attempts = _probe_attempts()
     ok, detail = acquire_backend(attempts=attempts)
@@ -185,6 +269,14 @@ def main() -> None:
         "seq_len": seq,
         "avg_step_time_s": round(dt, 4),
     }
+    # Input-pipeline A/B (ISSUE 4): same chip, packed-corpus stream fed
+    # synchronously vs through the depth-2 device prefetcher. Kept
+    # non-fatal: a data-path failure must not cost the headline number.
+    try:
+        _, result["sync_vs_prefetch"] = train_input_ab(
+            step, state, mesh, cfg.vocab_size, batch, seq)
+    except Exception as e:
+        result["sync_vs_prefetch"] = {"error": _clean_err(e)}
     print(json.dumps(result))
 
 

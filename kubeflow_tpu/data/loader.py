@@ -62,7 +62,28 @@ def load_tokens(source: Any) -> np.ndarray:
     if not os.path.exists(path):
         raise FileNotFoundError(f"token source {path!r} does not exist")
     if path.endswith(".npy"):
-        return np.load(path, mmap_mode="r")
+        arr = np.load(path, mmap_mode="r")
+        if arr.dtype == np.int32:
+            return arr
+        if arr.dtype.kind in "iu":
+            # Wrong-width integer export (int64/uint16/...): converting
+            # here materializes the corpus in RAM, so check the ids
+            # actually fit rather than silently wrapping.
+            if arr.size:
+                lo, hi = int(arr.min()), int(arr.max())
+                info = np.iinfo(np.int32)
+                if lo < info.min or hi > info.max:
+                    raise ValueError(
+                        f"token file {path!r} holds {arr.dtype} ids "
+                        f"spanning [{lo}, {hi}], which overflow int32 — "
+                        "re-export the corpus as int32")
+            return np.ascontiguousarray(arr, dtype=np.int32)
+        # A float (or other non-integer) corpus would flow through to an
+        # opaque downstream error (embedding take on float indices);
+        # fail at load with the actual problem.
+        raise ValueError(
+            f"token file {path!r} holds dtype {arr.dtype}; token ids "
+            "must be integers (re-export the corpus as int32)")
     if path.endswith((".bin", ".tokens")):
         return np.memmap(path, dtype=np.int32, mode="r")
     with open(path, "rb") as fh:
@@ -140,7 +161,7 @@ class _PackedRows:
     the current row's remaining space closes the row with loss-masked
     padding rather than being split mid-document with restarted
     positions). Only documents longer than a whole row are chunked, each
-    chunk its own segment. Stored as per-row span lists into the
+    chunk its own segment. Stored as a CSR span table into the
     (memmapped) corpus — O(docs) memory, not O(corpus). Padding spans are
     (start=-1, len); their tokens are eos, their segment id is -1, and
     their targets are masked."""
@@ -163,7 +184,7 @@ class _PackedRows:
         # doc), not per document — startup stays sub-second at tens of
         # millions of docs where the per-doc loop took minutes.
         csum = np.concatenate([[0], np.cumsum(lens)])
-        self._rows: list[list[tuple[int, int]]] = []
+        rows: list[list[tuple[int, int]]] = []
         cur: list[tuple[int, int]] = []
         used = 0
 
@@ -172,7 +193,7 @@ class _PackedRows:
             if used and row_cap - used:
                 cur.append((-1, row_cap - used))  # pad span
             if used:
-                self._rows.append(cur)
+                rows.append(cur)
             cur, used = [], 0
 
         i = 0
@@ -204,28 +225,46 @@ class _PackedRows:
             if used == row_cap:
                 close_row()
         close_row()
-        if not self._rows:
+        if not rows:
             raise ValueError(
                 f"corpus has no packed row of {row_cap} tokens")
+        # CSR span table (still O(docs) memory): __getitem__ assembles a
+        # row with precomputed gather indices + numpy fancy-indexing into
+        # the (memmapped) corpus instead of a per-span python loop —
+        # packed-row assembly must not be a per-step host cost the
+        # prefetcher has to hide.
+        self._row_ptr = np.concatenate(
+            [[0], np.cumsum([len(r) for r in rows])]).astype(np.int64)
+        flat = [sp for r in rows for sp in r]
+        self._span_start = np.asarray([s for s, _ in flat], np.int64)
+        self._span_len = np.asarray([ln for _, ln in flat], np.int64)
 
     def __len__(self) -> int:
-        return len(self._rows)
+        return len(self._row_ptr) - 1
 
     def __getitem__(self, i: int) -> dict:
         row_cap = self._seq + 1
-        toks = np.empty((row_cap,), np.int32)
-        segs = np.empty((row_cap,), np.int32)
-        pos = np.empty((row_cap,), np.int32)
-        o = 0
-        for si, (st, ln) in enumerate(self._rows[int(i)]):
-            if st < 0:  # pad span: eos tokens, segment -1, masked below
-                toks[o:o + ln] = self._eos
-                segs[o:o + ln] = -1
-            else:
-                toks[o:o + ln] = self._tokens[st:st + ln]
-                segs[o:o + ln] = si
-            pos[o:o + ln] = np.arange(ln)
-            o += ln
+        n = len(self._row_ptr) - 1
+        i = int(i)
+        if i < 0:
+            i += n
+        if not 0 <= i < n:
+            raise IndexError(f"row {i} out of range for {n} packed rows")
+        a, b = self._row_ptr[i], self._row_ptr[i + 1]
+        starts = self._span_start[a:b]
+        lens = self._span_len[a:b]
+        # Per-token span id and within-span position, then one gather.
+        offs = np.repeat(np.concatenate([[0], np.cumsum(lens[:-1])]), lens)
+        sid = np.repeat(np.arange(b - a), lens)
+        pos = (np.arange(row_cap, dtype=np.int64) - offs)
+        src = starts[sid] + pos
+        pad = starts[sid] < 0
+        toks = np.where(
+            pad, self._eos,
+            np.asarray(self._tokens[np.where(pad, 0, src)])).astype(
+                np.int32)
+        segs = np.where(pad, -1, sid).astype(np.int32)
+        pos = pos.astype(np.int32)
         return {
             "inputs": toks[:-1],
             "targets": toks[1:],

@@ -21,6 +21,7 @@ import numpy as np
 import optax
 
 from kubeflow_tpu.comms.bootstrap import ProcessEnv, initialize, read_env
+from kubeflow_tpu.data.prefetch import Prefetcher
 from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
 from kubeflow_tpu.parallel.sharding import rules_for
 from kubeflow_tpu.train.checkpoint import CheckpointManager
@@ -96,6 +97,14 @@ class TrainJobSpec:
     # the (backoff_limit+1)-th failure raises BackoffLimitExceeded.
     restart_policy: str = "Never"
     backoff_limit: int = 3
+    # Async input pipeline depth: the trainer stages up to `prefetch`
+    # device-resident batches ahead of compute on a background thread
+    # (pull + zigzag permute + H2D placement all off the critical path —
+    # data/prefetch.py). 0 = fully synchronous; every depth trains the
+    # identical batch sequence with identical numerics, and checkpoints
+    # under prefetch save the state of the batch actually trained, not
+    # the read-ahead position.
+    prefetch: int = 2
     metrics_path: str | None = None
     profile: dict = dataclasses.field(default_factory=dict)
     # {"dir": str, "start_step": int, "num_steps": int}
@@ -280,6 +289,8 @@ class Trainer:
         if spec.backoff_limit < 0:
             raise ValueError(f"backoff_limit must be >= 0, got "
                              f"{spec.backoff_limit}")
+        if spec.prefetch < 0:
+            raise ValueError(f"prefetch must be >= 0, got {spec.prefetch}")
         self.tx = optax.adamw(self._lr_schedule(),
                               weight_decay=spec.weight_decay)
         if spec.max_grad_norm:
@@ -407,6 +418,30 @@ class Trainer:
             spec = P(("data", "fsdp"), *([None] * (x.ndim - 1)))
             return jax.make_array_from_process_local_data(
                 NamedSharding(self.mesh, spec), np.asarray(x))
+
+        return jax.tree.map(conv, batch)
+
+    def _place_on_device(self, batch: dict) -> dict:
+        """Explicit H2D staging for the prefetch path: each leaf lands on
+        device BEFORE the trainer thread sees it, so the transfer
+        overlaps device compute instead of riding implicitly inside the
+        next step's dispatch. Multi-host goes through `_globalize`
+        (make_array_from_process_local_data with the dp sharding — the
+        per-process shards ARE the placement). Single-process places
+        with the replicated layout the jitted step resolves for
+        uncommitted batch inputs anyway: the same bytes land on the same
+        devices as the numpy path, just off the critical path — which
+        keeps every prefetch depth bit-identical to the synchronous
+        loop (a dp-sharded committed input would compile a different —
+        cheaper to transfer but numerically reordered — program)."""
+        if jax.process_count() > 1:
+            return self._globalize(batch)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sharding = NamedSharding(self.mesh, P())
+
+        def conv(x):
+            return jax.device_put(np.asarray(x), sharding)
 
         return jax.tree.map(conv, batch)
 
@@ -557,7 +592,12 @@ class Trainer:
             return None
 
         def run_eval(params, at_step):
-            losses, accs, seen = [], [], 0
+            # Accumulate DEVICE scalars and fetch once per eval window:
+            # a float() per batch would pay one full host sync each
+            # (~66 ms on the tunnel backend, PROFILE.md §1) — an
+            # eval_batches-deep stall inside the training timeline.
+            loss_sum = acc_sum = None
+            seen = 0
             for _ in range(spec.eval_batches):
                 raw = next_eval_batch()
                 if raw is None:
@@ -566,13 +606,16 @@ class Trainer:
                     raw = {k: np.asarray(v)[:, zigzag_idx]
                            for k, v in raw.items()}
                 m = eval_step(params, self._globalize(raw))
-                losses.append(float(m["loss"]))
-                accs.append(float(m["accuracy"]))
+                loss_sum = (m["loss"] if loss_sum is None
+                            else loss_sum + m["loss"])
+                acc_sum = (m["accuracy"] if acc_sum is None
+                           else acc_sum + m["accuracy"])
                 seen += 1
             if not seen:
                 return {}
-            out = {"eval_loss": sum(losses) / seen,
-                   "eval_accuracy": sum(accs) / seen,
+            totals = np.asarray(jnp.stack([loss_sum, acc_sum]))  # 1 fetch
+            out = {"eval_loss": float(totals[0]) / seen,
+                   "eval_accuracy": float(totals[1]) / seen,
                    "eval_batches": seen}
             self.logger.log(at_step, out)
             return out
@@ -596,11 +639,14 @@ class Trainer:
                 prof_start = prof_stop = None
         prof_active = False
 
-        from kubeflow_tpu.data.loader import (
-            iterator_state, restore_iterator)
+        from kubeflow_tpu.data.loader import restore_iterator
 
         def pack_data_state():
-            st = iterator_state(data)
+            # Under prefetch the iterator runs ahead of training;
+            # consumed_state() is the snapshot paired with the batch the
+            # checkpoint step actually trained on, so resume replays
+            # exactly the right rows.
+            st = prefetch.consumed_state()
             if st is None:
                 return None
             # The iterator state is only valid for the same per-process
@@ -631,6 +677,17 @@ class Trainer:
                 # assumption (the tag didn't exist to say otherwise).
                 restore_iterator(data, saved)
 
+        # The async input pipeline: pull + zigzag + H2D staged up to
+        # `spec.prefetch` batches ahead on a worker thread (depth 0 runs
+        # the same ops inline — the synchronous escape hatch). Created
+        # AFTER the iterator seek above so read-ahead starts at the
+        # resume position.
+        transform = None
+        if zigzag_idx is not None:
+            def transform(raw):
+                return {k: np.asarray(v)[:, zigzag_idx]
+                        for k, v in raw.items()}
+
         # Fault injection (SURVEY.md §5.3): the controller sets
         # TPK_FAULT="step=K;signal=S" on one worker; it kills itself at the
         # top of step K — the deterministic, step-precise chaos fixture.
@@ -641,82 +698,122 @@ class Trainer:
             fault_step = int(kv.get("step", -1))
             fault_signal = int(kv.get("signal", 9))
 
+        prefetch = Prefetcher(
+            data, depth=spec.prefetch, transform=transform,
+            place=(self._globalize if spec.prefetch == 0
+                   else self._place_on_device))
+
         last_metrics: dict = {}
         last_eval: dict = {}
-        timer.start()
-        window = 0
-        for step in range(start_step, spec.steps):
-            faults.fire(_FP_STEP, step=step)
-            if fault_step is not None and step == fault_step:
-                if self._ckpt is not None:
-                    self._ckpt.wait()  # die with a consistent checkpoint
-                self.logger.log(step, {"event": "fault_injected",
-                                       "signal": fault_signal})
-                os.kill(os.getpid(), fault_signal)
-            if prof_start is not None and step == prof_start:
-                jax.profiler.start_trace(prof["dir"])
-                prof_active = True
-            raw = next(data)
-            if zigzag_idx is not None:
-                raw = {k: np.asarray(v)[:, zigzag_idx]
-                       for k, v in raw.items()}
-            batch = self._globalize(raw)
-            state, metrics = step_fn(state, batch)
-            window += 1
-            if prof_active and step + 1 == prof_stop:
-                jax.block_until_ready(metrics["loss"])
-                jax.profiler.stop_trace()
-                prof_active = False
-            if self._ckpt is not None:
-                # Only collect iterator state on steps that will save —
-                # get_state() walks the grain pipeline and doesn't belong
-                # in the non-blocking hot loop.
-                self._ckpt.maybe_save(
-                    step + 1, state,
-                    data_state=(pack_data_state()
-                                if self._ckpt.should_save(step + 1)
-                                else None))
-            if eval_step is not None and (step + 1) % spec.eval_every == 0:
-                # Close the timing window first so eval wall time never
-                # pollutes the train tokens/sec / MFU averages.
-                if window:
-                    jax.block_until_ready(metrics["loss"])
-                    timer.stop(n_steps=window)
-                    window = 0
-                last_eval = run_eval(state.params, step + 1)
-                timer.start()
-            if (step + 1) % spec.log_every == 0 or step + 1 == spec.steps:
-                # Block only at logging boundaries — keeping the dispatch
-                # queue full between them lets host data prep overlap device
-                # compute (the per-step numbers are window averages).
-                jax.block_until_ready(metrics["loss"])
-                if window:
-                    perf = timer.stop(n_steps=window)
-                    window = 0
-                else:  # an eval just flushed this window
-                    perf = timer.snapshot()
-                last_metrics = {
-                    "loss": float(metrics["loss"]),
-                    "grad_norm": float(metrics["grad_norm"]),
-                    "tokens_per_sec": perf["tokens_per_sec"],
-                    "mfu": perf["mfu"],
-                    "step_time_s": perf["step_time_s"],
-                }
-                # MoE models report the router load-balance penalty too.
-                if float(metrics.get("aux_loss", 0.0)) > 0:
-                    last_metrics["aux_loss"] = float(metrics["aux_loss"])
-                self.logger.log(step + 1, last_metrics)
-                timer.start()
+        # Per-window data-starvation accounting: how much of the window's
+        # wall the training thread spent waiting on input (data_wait_frac
+        # ≈ 0 when the prefetcher keeps up; → 1 when the pipeline is the
+        # bottleneck and depth/host work needs attention).
+        win = {"t0": 0.0, "wait": 0.0, "h2d": 0.0}
 
-        if self._ckpt is not None:
-            if self._ckpt.latest_step() != spec.steps:
-                self._ckpt.maybe_save(spec.steps, state,
-                                      data_state=pack_data_state(),
-                                      force=True)
-            self._ckpt.wait()
-        self.logger.log(spec.steps,
-                        {"event": "done", **last_metrics, **last_eval})
-        return {"final_step": spec.steps, **last_metrics, **last_eval}
+        def win_reset():
+            win["t0"] = time.perf_counter()
+            win["wait"] = prefetch.data_wait_s
+            win["h2d"] = prefetch.h2d_s
+
+        def win_metrics() -> dict:
+            wall = time.perf_counter() - win["t0"]
+            dw = prefetch.data_wait_s - win["wait"]
+            return {
+                "data_wait_s": round(dw, 6),
+                "data_wait_frac": round(dw / wall, 4) if wall > 0 else 0.0,
+                "data_h2d_s": round(prefetch.h2d_s - win["h2d"], 6),
+                "tpk_data_wait_seconds_total": round(
+                    resilience.metrics.get("tpk_data_wait_seconds_total",
+                                           component="train"), 6),
+            }
+
+        try:
+            timer.start()
+            win_reset()
+            window = 0
+            for step in range(start_step, spec.steps):
+                faults.fire(_FP_STEP, step=step)
+                if fault_step is not None and step == fault_step:
+                    if self._ckpt is not None:
+                        self._ckpt.wait()  # die w/ a consistent checkpoint
+                    self.logger.log(step, {"event": "fault_injected",
+                                           "signal": fault_signal})
+                    os.kill(os.getpid(), fault_signal)
+                if prof_start is not None and step == prof_start:
+                    jax.profiler.start_trace(prof["dir"])
+                    prof_active = True
+                batch = next(prefetch)
+                state, metrics = step_fn(state, batch)
+                window += 1
+                if prof_active and step + 1 == prof_stop:
+                    jax.block_until_ready(metrics["loss"])
+                    jax.profiler.stop_trace()
+                    prof_active = False
+                if self._ckpt is not None:
+                    # Only collect iterator state on steps that will save
+                    # — consumed_state() may walk the grain pipeline
+                    # (depth 0) and doesn't belong in the non-blocking
+                    # hot loop.
+                    self._ckpt.maybe_save(
+                        step + 1, state,
+                        data_state=(pack_data_state()
+                                    if self._ckpt.should_save(step + 1)
+                                    else None))
+                if (eval_step is not None
+                        and (step + 1) % spec.eval_every == 0):
+                    # Close the timing window first so eval wall time
+                    # never pollutes the train tokens/sec / MFU averages.
+                    if window:
+                        jax.block_until_ready(metrics["loss"])
+                        timer.stop(n_steps=window)
+                        window = 0
+                    last_eval = run_eval(state.params, step + 1)
+                    timer.start()
+                    win_reset()
+                if ((step + 1) % spec.log_every == 0
+                        or step + 1 == spec.steps):
+                    # Block only at logging boundaries — keeping the
+                    # dispatch queue full between them lets host data prep
+                    # overlap device compute (per-step numbers are window
+                    # averages).
+                    jax.block_until_ready(metrics["loss"])
+                    if window:
+                        perf = timer.stop(n_steps=window)
+                        window = 0
+                    else:  # an eval just flushed this window
+                        perf = timer.snapshot()
+                    last_metrics = {
+                        "loss": float(metrics["loss"]),
+                        "grad_norm": float(metrics["grad_norm"]),
+                        "tokens_per_sec": perf["tokens_per_sec"],
+                        "mfu": perf["mfu"],
+                        "step_time_s": perf["step_time_s"],
+                        **win_metrics(),
+                    }
+                    # MoE models report the router balance penalty too.
+                    if float(metrics.get("aux_loss", 0.0)) > 0:
+                        last_metrics["aux_loss"] = float(
+                            metrics["aux_loss"])
+                    self.logger.log(step + 1, last_metrics)
+                    timer.start()
+                    win_reset()
+
+            if self._ckpt is not None:
+                if self._ckpt.latest_step() != spec.steps:
+                    self._ckpt.maybe_save(spec.steps, state,
+                                          data_state=pack_data_state(),
+                                          force=True)
+                self._ckpt.wait()
+            self.logger.log(spec.steps,
+                            {"event": "done", **last_metrics, **last_eval})
+            return {"final_step": spec.steps, **last_metrics, **last_eval}
+        finally:
+            # Every exit path of the supervised restart loop lands here:
+            # normal completion, a raising step (restart policies rebuild
+            # the stream), KeyboardInterrupt — the worker thread must
+            # never outlive its run.
+            prefetch.close()
 
 
 def main(argv: list[str] | None = None) -> int:

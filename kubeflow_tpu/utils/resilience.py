@@ -192,6 +192,7 @@ class Counters:
 
     def __init__(self):
         self._counts: dict[tuple, float] = {}
+        self._gauges: dict[tuple, float] = {}
         self._lock = threading.Lock()
 
     def inc(self, name: str, value: float = 1.0, **labels: str) -> None:
@@ -204,22 +205,39 @@ class Counters:
         with self._lock:
             return self._counts.get(key, 0.0)
 
+    def set_gauge(self, name: str, value: float, **labels: str) -> None:
+        """Last-write-wins gauge (config knobs, live depths) rendered
+        next to the counters with the proper prometheus TYPE."""
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            self._gauges[key] = float(value)
+
+    def get_gauge(self, name: str, **labels: str) -> float:
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            return self._gauges.get(key, 0.0)
+
     def snapshot(self) -> dict:
         with self._lock:
+            items = sorted(self._counts.items()) + sorted(
+                self._gauges.items())
             return {
                 name + ("{%s}" % ",".join(f'{k}="{v}"' for k, v in lbl)
                         if lbl else ""): v
-                for (name, lbl), v in sorted(self._counts.items())}
+                for (name, lbl), v in items}
 
     def prometheus_text(self) -> str:
         lines = []
         seen: set[str] = set()
         with self._lock:
-            items = sorted(self._counts.items())
-        for (name, lbl), v in items:
+            items = ([(n, lbl, v, "counter")
+                      for (n, lbl), v in sorted(self._counts.items())]
+                     + [(n, lbl, v, "gauge")
+                        for (n, lbl), v in sorted(self._gauges.items())])
+        for name, lbl, v, kind in items:
             if name not in seen:
                 seen.add(name)
-                lines.append(f"# TYPE {name} counter")
+                lines.append(f"# TYPE {name} {kind}")
             tag = ("{%s}" % ",".join(f'{k}="{v2}"' for k, v2 in lbl)
                    if lbl else "")
             val = int(v) if float(v).is_integer() else v
@@ -230,6 +248,7 @@ class Counters:
         """Test hook — counters are process-global."""
         with self._lock:
             self._counts.clear()
+            self._gauges.clear()
 
 
 metrics = Counters()

@@ -47,6 +47,28 @@ def devices8():
     return devs[:8]
 
 
+@pytest.fixture(autouse=True)
+def _no_leaked_prefetch_threads():
+    """Every trainer exit path (normal, raising step, restart/backoff
+    loop, injected fault) must close its input prefetcher — a worker
+    thread that outlives its test is a shutdown-path regression
+    (kubeflow_tpu/data/prefetch.py). Checked after EVERY test."""
+    yield
+    import threading
+    import time
+
+    def leaked():
+        return [t.name for t in threading.enumerate()
+                if t.name.startswith("tpk-prefetch")]
+
+    deadline = time.monotonic() + 2.0  # grace for a close() in flight
+    while leaked() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert not leaked(), (
+        f"prefetch worker threads leaked: {leaked()} — a trainer exit "
+        "path failed to close() its Prefetcher")
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_between_modules():
     """Cap cumulative compiled-executable growth across the full tier:

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import json
 import os
+import signal
 import socket
 import socketserver
 import threading
@@ -512,6 +513,131 @@ def test_checkpoint_fallback_via_injected_restore_fault(tmp_path, devices8):
         assert step == 6
         assert state is not None
     assert mgr.latest_step() == 6
+
+
+def test_prefetch_worker_fault_surfaces_as_step_error(tmp_path, devices8):
+    """An injected `data.next` fault fires on the PREFETCH WORKER thread
+    but must surface as the consuming step's error: restart_policy=Never
+    propagates it out of run(), and the worker thread is gone (no leak
+    across the failure path)."""
+    import threading
+
+    from kubeflow_tpu.train.trainer import Trainer
+
+    spec = _mnist_spec(tmp_path, "pfnever", prefetch=2)
+    with faults.harness() as h:
+        h.arm("data.next", faults.FailN(1, match={"n": 5}))
+        with pytest.raises(faults.FaultError):
+            Trainer(spec).run()
+        assert h.counts["data.next"]["injected"] == 1
+    assert not [t for t in threading.enumerate()
+                if t.name.startswith("tpk-prefetch")]
+
+
+def test_prefetch_worker_fault_heals_under_restart_policy(tmp_path,
+                                                          devices8):
+    """The same injected data fault under OnFailure: the restart rebuilds
+    the stream (fresh prefetcher), auto-resumes from the checkpoint, and
+    converges to the fault-free final loss — data faults ride the exact
+    restart semantics step faults do."""
+    from kubeflow_tpu.train.trainer import Trainer
+
+    clean = Trainer(_mnist_spec(tmp_path, "pfclean")).run()
+    spec = _mnist_spec(tmp_path, "pfheal", restart_policy="OnFailure",
+                       backoff_limit=2, prefetch=2)
+    with faults.harness() as h:
+        h.arm("data.next", faults.FailN(1, match={"n": 5}))
+        result = Trainer(spec).run()
+        assert h.counts["data.next"]["injected"] == 1
+    assert result["final_step"] == clean["final_step"]
+    np.testing.assert_allclose(result["loss"], clean["loss"], rtol=1e-4)
+
+
+def test_resume_under_prefetch_replays_exact_grain_stream(tmp_path,
+                                                          devices8):
+    """Crash-resume with read-ahead in flight (the ISSUE 4 subtlety): a
+    checkpointable grain stream, prefetch depth 3, an injected kill at
+    step 4 — the resumed run must train the same rows a fault-free run
+    trains (same final loss), proving the checkpoint saved the state of
+    the batch actually trained, not the iterator's read-ahead position."""
+    from kubeflow_tpu.train.trainer import TrainJobSpec, Trainer
+
+    path = tmp_path / "corpus.npy"
+    np.save(path, np.random.default_rng(7).integers(0, 64, 20000,
+                                                    dtype=np.int32))
+
+    def spec(name, **kw):
+        base = dict(model="llama_tiny", dataset="token_file",
+                    dataset_kwargs={"path": str(path)}, mesh={"data": -1},
+                    steps=6, batch_size=8, seq_len=16, learning_rate=1e-3,
+                    log_every=3, prefetch=3,
+                    checkpoint={"dir": str(tmp_path / name), "interval": 2})
+        base.update(kw)
+        return TrainJobSpec(**base)
+
+    clean = Trainer(spec("gclean")).run()
+    with faults.harness() as h:
+        h.arm("train.step", faults.FailN(1, match={"step": 4}))
+        result = Trainer(spec("gfault", restart_policy="OnFailure",
+                              backoff_limit=2)).run()
+        assert h.counts["train.step"]["injected"] == 1
+    assert result["final_step"] == 6 == clean["final_step"]
+    # Same depth + same rows on both sides: bit-identical, not just close.
+    assert result["loss"] == clean["loss"]
+
+
+@pytest.mark.slow  # real-process kill-9 e2e
+def test_kill9_resume_under_prefetch_subprocess(tmp_path):
+    """The ISSUE 2 crash harness extended to the input pipeline: the real
+    trainer process is SIGKILLed mid-run via TPK_FAULT with prefetch
+    read-ahead in flight, restarted on the same checkpoint dir, and must
+    converge to the same final step/loss as a crash-free control run."""
+    import subprocess
+    import sys
+
+    path = tmp_path / "corpus.npy"
+    np.save(path, np.random.default_rng(11).integers(0, 64, 20000,
+                                                     dtype=np.int32))
+
+    def spec_file(name):
+        from kubeflow_tpu.train.trainer import TrainJobSpec
+
+        sp = TrainJobSpec(
+            model="llama_tiny", dataset="token_file",
+            dataset_kwargs={"path": str(path)}, mesh={},
+            steps=8, batch_size=4, seq_len=16, learning_rate=1e-3,
+            log_every=4, prefetch=2,
+            checkpoint={"dir": str(tmp_path / name), "interval": 2})
+        f = tmp_path / f"{name}.json"
+        f.write_text(sp.to_json())
+        return str(f)
+
+    def run(spec_path, fault=None, expect_kill=False):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("TPK_FAULT", None)
+        if fault:
+            env["TPK_FAULT"] = fault
+        p = subprocess.run(
+            [sys.executable, "-m", "kubeflow_tpu.train.trainer",
+             "--spec", spec_path],
+            capture_output=True, text=True, env=env, timeout=600)
+        if expect_kill:
+            assert p.returncode == -signal.SIGKILL, (p.returncode,
+                                                     p.stderr[-2000:])
+            return None
+        assert p.returncode == 0, p.stderr[-2000:]
+        line = [l for l in p.stdout.splitlines() if '"result"' in l][-1]
+        return json.loads(line)["result"]
+
+    control = run(spec_file("k9control"))
+
+    crashed = spec_file("k9crash")
+    run(crashed, fault="step=5;signal=9", expect_kill=True)
+    resumed = run(crashed)
+
+    assert resumed["final_step"] == 8 == control["final_step"]
+    np.testing.assert_allclose(resumed["loss"], control["loss"],
+                               rtol=1e-6)
 
 
 def test_trainer_restart_policy_validation(devices8):

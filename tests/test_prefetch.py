@@ -1,0 +1,310 @@
+"""Async input pipeline (ISSUE 4): device prefetch must never change WHAT
+the trainer consumes — only where the host work happens.
+
+Covers: depth-0 vs depth-K batch-sequence identity, StopIteration and
+worker-exception propagation into the consuming thread, the
+consumed-state/read-ahead pairing that makes checkpoints under prefetch
+resume at the right batch, the `data.next` fault point (inline and
+threaded), trainer-level loss-trajectory equivalence plus the
+data-wait metrics in the JSONL stream, the hot-loop host-sync guard
+(the training analog of test_decode_pipeline.py's dispatch-count
+guard), and the bench sync-vs-prefetch A/B harness shape.
+"""
+
+import json
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from kubeflow_tpu.data import loader
+from kubeflow_tpu.data.prefetch import THREAD_NAME, Prefetcher
+from kubeflow_tpu.utils import faults, resilience
+
+
+def _corpus(n=20000, vocab=64, seed=3):
+    return np.random.default_rng(seed).integers(0, vocab, n, dtype=np.int32)
+
+
+def _ds(tokens, **kw):
+    kw.setdefault("batch_size", 4)
+    kw.setdefault("seq_len", 16)
+    kw.setdefault("seed", 11)
+    kw.setdefault("process_index", 0)
+    kw.setdefault("process_count", 1)
+    return loader.lm_dataset(tokens, **kw)
+
+
+# -- unit: the prefetcher itself ---------------------------------------------
+
+
+def test_depth0_and_depthk_yield_identical_sequences():
+    ds = _ds(_corpus())
+    seqs = {}
+    for depth in (0, 3):
+        with Prefetcher(iter(ds), depth=depth) as pf:
+            seqs[depth] = [next(pf) for _ in range(10)]
+    for a, b in zip(seqs[0], seqs[3]):
+        np.testing.assert_array_equal(a["inputs"], b["inputs"])
+        np.testing.assert_array_equal(a["targets"], b["targets"])
+
+
+def test_stop_iteration_surfaces_at_the_right_batch():
+    def gen():
+        for i in range(3):
+            yield {"i": np.full((2,), i)}
+
+    for depth in (0, 2):
+        with Prefetcher(gen(), depth=depth) as pf:
+            got = [next(pf)["i"][0] for _ in range(3)]
+            assert got == [0, 1, 2]
+            with pytest.raises(StopIteration):
+                next(pf)
+            with pytest.raises(StopIteration):  # stays exhausted
+                next(pf)
+
+
+def test_worker_exception_propagates_in_stream_order():
+    def bad_transform(raw):
+        if int(raw["inputs"][0, 0]) >= 0:  # every batch
+            raise ValueError("boom in prep")
+        return raw
+
+    ds = _ds(_corpus())
+    with Prefetcher(iter(ds), depth=2, transform=bad_transform) as pf:
+        with pytest.raises(ValueError, match="boom in prep"):
+            next(pf)
+        with pytest.raises(ValueError, match="boom in prep"):
+            next(pf)  # sticky: the stream is dead, not silently resumed
+
+
+def test_consumed_state_pairs_with_handed_out_batch_not_read_ahead():
+    """THE resume-correctness property: after consuming K batches the
+    snapshot must continue at batch K+1 even though the worker has read
+    several batches further ahead."""
+    ds = _ds(_corpus())
+    pf = Prefetcher(iter(ds), depth=3)
+    try:
+        for _ in range(4):
+            next(pf)
+        # Wait until the worker has demonstrably read ahead.
+        deadline = time.monotonic() + 5.0
+        while pf.stats["pulled"] <= 5 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert pf.stats["pulled"] > 5, pf.stats
+        state = pf.consumed_state()
+        expect = [next(pf)["inputs"] for _ in range(3)]
+    finally:
+        pf.close()
+    it2 = iter(ds)
+    assert loader.restore_iterator(it2, state)
+    for e in expect:
+        np.testing.assert_array_equal(e, next(it2)["inputs"])
+
+
+def test_close_is_idempotent_and_joins_the_worker():
+    ds = _ds(_corpus())
+    pf = Prefetcher(iter(ds), depth=2)
+    next(pf)
+    pf.close()
+    pf.close()
+    assert not [t for t in threading.enumerate()
+                if t.name.startswith(THREAD_NAME)]
+
+
+def test_negative_depth_rejected():
+    with pytest.raises(ValueError, match="depth"):
+        Prefetcher(iter([]), depth=-1)
+
+
+def test_next_after_close_raises_instead_of_hanging():
+    for depth in (0, 2):  # both depths fence identically after close()
+        pf = Prefetcher(iter(_ds(_corpus())), depth=depth)
+        next(pf)
+        pf.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            next(pf)
+
+
+def test_data_next_fault_point_inline_and_threaded():
+    ds = _ds(_corpus())
+    # Inline (depth 0): fires on the consuming thread.
+    with faults.harness() as h:
+        h.arm("data.next", faults.FailN(1, match={"n": 2}))
+        with Prefetcher(iter(ds), depth=0) as pf:
+            next(pf)
+            next(pf)
+            with pytest.raises(faults.FaultError):
+                next(pf)
+        assert h.counts["data.next"]["injected"] == 1
+    # Threaded: injected on the worker, delivered at the matching next().
+    with faults.harness() as h:
+        h.arm("data.next", faults.FailN(1, match={"n": 2}))
+        pf = Prefetcher(iter(ds), depth=2)
+        try:
+            np1 = next(pf)["inputs"]
+            np2 = next(pf)["inputs"]
+            assert np1.shape == np2.shape
+            with pytest.raises(faults.FaultError):
+                next(pf)
+        finally:
+            pf.close()
+        assert h.counts["data.next"]["injected"] == 1
+
+
+def test_prefetch_depth_gauge_renders():
+    resilience.metrics.reset()
+    with Prefetcher(iter(_ds(_corpus())), depth=2):
+        pass
+    assert resilience.metrics.get_gauge("tpk_data_prefetch_depth",
+                                        component="train") == 2
+    assert ("# TYPE tpk_data_prefetch_depth gauge"
+            in resilience.metrics.prometheus_text())
+
+
+# -- trainer wiring ----------------------------------------------------------
+
+
+def _lm_spec(tmp_path, corpus_path, **kw):
+    from kubeflow_tpu.train.trainer import TrainJobSpec
+
+    base = dict(model="llama_tiny", dataset="token_file",
+                dataset_kwargs={"path": str(corpus_path)},
+                mesh={"data": -1}, steps=5, batch_size=8, seq_len=16,
+                learning_rate=1e-3, log_every=1)
+    base.update(kw)
+    return TrainJobSpec(**base)
+
+
+@pytest.fixture(scope="module")
+def corpus_path(tmp_path_factory):
+    path = tmp_path_factory.mktemp("corpus") / "tokens.npy"
+    np.save(path, _corpus())
+    return path
+
+
+def test_trainer_depth0_vs_depthk_loss_trajectory(tmp_path, corpus_path,
+                                                  devices8):
+    """Same seeded stream at prefetch=0 and prefetch=2: identical batch
+    order AND identical numerics — the device-placed batch carries the
+    same replicated layout the jitted step resolves for host arrays, so
+    the logged loss trajectory must match bit-for-bit."""
+    from kubeflow_tpu.train.trainer import Trainer
+
+    trajs = {}
+    for depth in (0, 2):
+        mp = tmp_path / f"m{depth}.jsonl"
+        spec = _lm_spec(tmp_path, corpus_path, prefetch=depth,
+                        metrics_path=str(mp))
+        result = Trainer(spec).run()
+        lines = [json.loads(l) for l in open(mp).read().splitlines()]
+        trajs[depth] = [l["loss"] for l in lines
+                        if "loss" in l and "event" not in l]
+        assert len(trajs[depth]) == spec.steps
+        # The data-wait mechanism is visible in the stream (acceptance).
+        stepline = next(l for l in lines if "data_wait_frac" in l)
+        assert "tpk_data_wait_seconds_total" in stepline
+        assert "data_h2d_s" in stepline
+        assert result["final_step"] == spec.steps
+    assert trajs[0] == trajs[2]
+
+
+def test_trainer_prefetch_resume_is_bit_identical(tmp_path, corpus_path,
+                                                  devices8):
+    """Kill-resume under read-ahead: a run checkpointed at step 3 and
+    resumed to 6 must equal an uninterrupted 6-step run EXACTLY — the
+    checkpoint carried the trained batch's state, not the read-ahead
+    position (both runs use the same depth, so this is bit-for-bit)."""
+    from kubeflow_tpu.train.trainer import Trainer
+
+    def spec(steps, ck):
+        return _lm_spec(tmp_path, corpus_path, steps=steps, prefetch=3,
+                        checkpoint={"dir": str(ck), "interval": 3})
+
+    full = Trainer(spec(6, tmp_path / "full")).run()
+    Trainer(spec(3, tmp_path / "resumed")).run()
+    resumed = Trainer(spec(6, tmp_path / "resumed")).run()
+    assert resumed["final_step"] == 6
+    assert resumed["loss"] == full["loss"]
+
+
+def test_trainer_prefetch_spec_validation(devices8):
+    from kubeflow_tpu.train.trainer import TrainJobSpec, Trainer
+
+    with pytest.raises(ValueError, match="prefetch"):
+        Trainer(TrainJobSpec(model="mnist_mlp", dataset="mnist_like",
+                             strategy="dp", mesh={"data": 8}, prefetch=-1))
+
+
+def test_hot_loop_host_sync_guard(monkeypatch, devices8):
+    """The training analog of test_decode_pipeline.py's dispatch-count
+    guard: between logging boundaries the hot loop must issue ZERO host
+    fetches (no float() on device arrays, no block_until_ready) — that
+    is the whole point of overlapping host data prep with device
+    compute. 6 steps at log_every=3 = exactly 2 boundaries; each
+    boundary is 1 block_until_ready + 3 scalar fetches (loss, grad_norm,
+    the aux_loss probe). Any mid-window fetch breaks the budget."""
+    from jax._src.array import ArrayImpl
+
+    from kubeflow_tpu.train.trainer import TrainJobSpec, Trainer
+
+    events = []
+    orig_float = ArrayImpl.__float__
+    orig_sync = jax.block_until_ready
+    monkeypatch.setattr(
+        ArrayImpl, "__float__",
+        lambda self: (events.append("float"), orig_float(self))[1])
+    monkeypatch.setattr(
+        jax, "block_until_ready",
+        lambda x: (events.append("sync"), orig_sync(x))[1])
+
+    spec = TrainJobSpec(model="mnist_mlp", dataset="mnist_like",
+                        strategy="dp", mesh={"data": 8}, steps=6,
+                        batch_size=16, learning_rate=1e-2, log_every=3,
+                        prefetch=2)
+    result = Trainer(spec).run()
+    assert result["final_step"] == 6
+    boundaries = 2
+    assert events.count("sync") == boundaries, events
+    assert events.count("float") == 3 * boundaries, events
+
+
+# -- bench A/B harness -------------------------------------------------------
+
+
+def test_bench_sync_vs_prefetch_ab_shape(devices8):
+    """The CPU-runnable proof of the bench section's shape: both arms
+    run, report the mechanism split, and train the same stream (equal
+    final loss within input-layout tolerance)."""
+    import dataclasses
+
+    import jax.numpy as jnp
+    import optax
+
+    import bench
+    from kubeflow_tpu.models.llama import Llama, llama_tiny
+    from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+    from kubeflow_tpu.parallel.sharding import DEFAULT_RULES
+    from kubeflow_tpu.train.step import init_train_state, make_train_step
+
+    cfg = dataclasses.replace(llama_tiny(), num_layers=2)
+    mesh = build_mesh(MeshConfig(), jax.devices()[:8])
+    model = Llama(cfg)
+    batch, seq = 4, 16
+    tokens = jnp.zeros((batch, seq), jnp.int32)
+    state = init_train_state(model, optax.adamw(1e-3), jax.random.key(0),
+                             (tokens,), mesh, DEFAULT_RULES)
+    step = make_train_step(model, mesh, DEFAULT_RULES)
+    _, section = bench.train_input_ab(step, state, mesh, cfg.vocab_size,
+                                      batch, seq, steps=3, warmup=1)
+    assert set(section) >= {"method", "sync", "prefetch_depth2", "speedup"}
+    for arm in ("sync", "prefetch_depth2"):
+        assert section[arm]["ms_per_step"] > 0
+        assert np.isfinite(section[arm]["final_loss"])
+        assert section[arm]["data_wait_s"] >= 0
+    # The sync arm pays its host work on the clock; the prefetch arm's
+    # residual wait must not exceed it (the overlap mechanism).
+    assert (section["prefetch_depth2"]["data_wait_s"]
+            <= section["sync"]["data_wait_s"] + 0.5)
