@@ -1,0 +1,92 @@
+// Per-job structured event log — the rebuild's EventRecorder.
+//
+// Upstream, controllers emit Kubernetes Events (kubectl describe shows
+// them) and Katib scrapes worker stdout with a regex sidecar for
+// metrics; both are replaced here by ONE structured history: an ordered
+// `events` array on the resource STATUS (SURVEY.md §5.5 "structured
+// JSONL event log per job — events + conditions in our store"). Because
+// events live in status, every append rides the normal UpdateStatus →
+// WAL path: the history is crash-durable for free and replays with the
+// rest of the state (`tpukit events <job>` after a restart shows the
+// same Submitted → … → Succeeded story).
+//
+// Shape of one event:
+//   {type: "Normal"|"Warning", reason: "Scheduled", message, timestamp,
+//    unix, count, [lastTimestamp, lastUnix]}
+// Dedup (the EventRecorder aggregation, tuned for a WAL-backed store):
+//   * same (type, reason, message) as the last event → PURE NO-OP. The
+//     level-triggered reconcile re-derives "Unschedulable" every 50 ms
+//     tick; recording each repeat would write one WAL record per tick
+//     for as long as the job pends. The returned status is unchanged,
+//     so the caller's only-write-when-changed guard skips the write.
+//   * same (type, reason) as the last event, new message → merged into
+//     it (count+1, message/lastTimestamp updated): "CheckpointSaved
+//     step 100" aggregates onto "step 50", a QuotaExceeded whose
+//     used-count moved updates in place. Only the LAST entry is
+//     compared — reasons separated by other events (a restart cycle's
+//     Restarted → Scheduled → Launched) append normally; that history
+//     is real and bounded by backoff_limit.
+//   * different reason → appended.
+// Bounded at kMaxStatusEvents, trimmed oldest-first (like upstream
+// Events, old entries expire; the conditions array keeps the phase
+// transitions).
+
+#pragma once
+
+#include <string>
+
+#include "json.h"
+#include "util.h"
+
+namespace tpk {
+
+inline constexpr size_t kMaxStatusEvents = 48;
+
+inline Json AppendStatusEvent(Json status, const std::string& type,
+                              const std::string& reason,
+                              const std::string& message, double now_s) {
+  if (!(now_s > 0)) now_s = NowWall();
+  Json events = Json::Array();
+  if (status.get("events").is_array()) events = status.get("events");
+  if (events.size() > 0) {
+    const Json& last = events.elements()[events.size() - 1];
+    if (last.get("type").as_string() == type &&
+        last.get("reason").as_string() == reason) {
+      if (last.get("message").as_string() == message) {
+        return status;  // exact repeat: no-op, no status churn
+      }
+      Json rebuilt = Json::Array();
+      for (size_t i = 0; i + 1 < events.size(); ++i) {
+        rebuilt.push_back(events.elements()[i]);
+      }
+      Json merged = last;
+      merged["count"] = last.get("count").as_int(1) + 1;
+      merged["message"] = message;
+      merged["lastTimestamp"] = Timestamp(now_s);
+      merged["lastUnix"] = now_s;
+      rebuilt.push_back(merged);
+      status["events"] = rebuilt;
+      return status;
+    }
+  }
+  Json ev = Json::Object();
+  ev["type"] = type;
+  ev["reason"] = reason;
+  ev["message"] = message;
+  ev["timestamp"] = Timestamp(now_s);
+  ev["unix"] = now_s;
+  ev["count"] = 1;
+  events.push_back(ev);
+  if (events.size() > kMaxStatusEvents) {
+    Json trimmed = Json::Array();
+    for (size_t i = events.size() - kMaxStatusEvents; i < events.size();
+         ++i) {
+      trimmed.push_back(events.elements()[i]);
+    }
+    events = trimmed;
+  }
+  status["events"] = events;
+  return status;
+}
+
+}  // namespace tpk

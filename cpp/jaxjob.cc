@@ -2,6 +2,8 @@
 
 #include "admission.h"
 
+#include "events.h"
+
 #include "util.h"
 
 #include <netinet/in.h>
@@ -87,6 +89,13 @@ void JaxJobController::SetPhase(JobView& job, const std::string& phase,
   }
 }
 
+void JaxJobController::AppendEvent(JobView& job, const std::string& type,
+                                   const std::string& reason,
+                                   const std::string& message) {
+  job.status = AppendStatusEvent(job.status, type, reason, message,
+                                 now_s_ ? now_s_ : NowWall());
+}
+
 void JaxJobController::KillAll(const JobView& job) {
   int replicas = static_cast<int>(job.spec.get("replicas").as_int(1));
   for (int i = 0; i < replicas; ++i) {
@@ -107,6 +116,7 @@ void JaxJobController::ElasticResize(JobView& job, int target,
                                      const std::string& reason,
                                      const std::string& message,
                                      bool count_restart) {
+  AppendEvent(job, "Normal", reason, message);
   job.status["effectiveReplicas"] = target;
   job.status["lastResizeUnix"] = now_s_ ? now_s_ : NowWall();
   if (count_restart) {
@@ -159,6 +169,9 @@ void JaxJobController::LaunchGang(JobView& job) {
     if (quota >= 0) {
       int64_t used = UsedInNamespace(ns, name);
       if (used + static_cast<int64_t>(replicas) * devices > quota) {
+        AppendEvent(job, "Warning", "QuotaExceeded",
+                    "namespace " + ns + " quota " + std::to_string(quota) +
+                        " devices; " + std::to_string(used) + " in use");
         SetPhase(job, "Pending", "QuotaExceeded",
                  "namespace " + ns + " quota " + std::to_string(quota) +
                      " devices; " + std::to_string(used) + " in use",
@@ -185,9 +198,22 @@ void JaxJobController::LaunchGang(JobView& job) {
                     /*count_restart=*/false);
       return;
     }
+    AppendEvent(job, "Warning", "Unschedulable",
+                "insufficient slice capacity for gang");
     SetPhase(job, "Pending", "Unschedulable",
              "insufficient slice capacity for gang", now_s_);
     return;
+  }
+  // Allocation granted — the "Scheduled" moment (kube-scheduler's Bind
+  // event analog): record which slices host the gang.
+  {
+    std::string placed;
+    for (const auto& [slice, n] : alloc->slices) {
+      if (!placed.empty()) placed += ",";
+      placed += slice + "=" + std::to_string(n);
+    }
+    AppendEvent(job, "Normal", "Scheduled",
+                std::to_string(replicas) + " worker(s) on " + placed);
   }
 
   // Job workdir: spec file + per-replica logs.
@@ -240,6 +266,14 @@ void JaxJobController::LaunchGang(JobView& job) {
     s.env["TPK_NUM_SLICES"] = std::to_string(num_slices);
     s.env["TPK_SLICE_ID"] = std::to_string(i * num_slices / replicas);
     s.env["TPK_JOB_NAME"] = name;
+    // The job's workdir (profiler traces land here: the runtime's
+    // profile_start_step/profile_stop_step knobs default their trace
+    // dir to $TPK_WORKDIR/profile) and the API socket (the runtime
+    // posts CheckpointSaved events back into the job's event log).
+    s.env["TPK_WORKDIR"] = dir;
+    if (!socket_path_.empty()) {
+      s.env["TPK_SOCKET"] = socket_path_;
+    }
     // First-class fault injection (SURVEY.md §5.3): spec.fault =
     // {proc, step, signal?, every_attempt?} makes worker `proc` kill
     // itself at training step `step` — deterministic, step-precise chaos
@@ -262,6 +296,7 @@ void JaxJobController::LaunchGang(JobView& job) {
   std::string error;
   if (!executor_->LaunchGang(specs, &error)) {
     scheduler_->Release(*alloc);
+    AppendEvent(job, "Warning", "LaunchFailed", error);
     SetPhase(job, "Pending", "LaunchFailed", error, now_s_);
     return;
   }
@@ -282,6 +317,8 @@ void JaxJobController::LaunchGang(JobView& job) {
     job.status["startTime"] = Timestamp(now_s_ ? now_s_ : NowWall());
     job.status["startUnix"] = now_s_ ? now_s_ : NowWall();
   }
+  AppendEvent(job, "Normal", "Launched",
+              "all " + std::to_string(replicas) + " workers launched");
   SetPhase(job, "Running", "GangLaunched",
            "all " + std::to_string(replicas) + " workers launched", now_s_);
 }
@@ -313,6 +350,7 @@ void JaxJobController::HandleExits(JobView& job) {
     job.status["active"] = false;
     ReleaseAlloc(job);
     job.status["completionUnix"] = now_s_ ? now_s_ : NowWall();
+    AppendEvent(job, "Normal", "Succeeded", "all workers exited 0");
     SetPhase(job, "Succeeded", "AllWorkersSucceeded",
              "all workers exited 0", now_s_);
     metrics_.jobs_succeeded++;
@@ -340,6 +378,16 @@ void JaxJobController::HandleExits(JobView& job) {
   if (retryable && restarts < backoff) {
     job.status["restarts"] = restarts + 1;
     metrics_.gang_restarts++;
+    // ONE event per restart cycle (failure + restart together). Each
+    // relaunch still appends Scheduled/Launched between cycles, so
+    // cycles don't merge — but total restart history is bounded by
+    // backoff_limit (3 events per cycle), and past the 48-entry cap the
+    // oldest entries expire like upstream Events; conditions keep the
+    // phase transitions.
+    AppendEvent(job, "Warning", "Restarted",
+                "worker exited " + std::to_string(first_fail_code) +
+                    "; gang restart " + std::to_string(restarts + 1) +
+                    "/" + std::to_string(backoff));
     SetPhase(job, "Restarting", "WorkerFailed",
              "worker exited " + std::to_string(first_fail_code) +
                  "; gang restart " + std::to_string(restarts + 1) + "/" +
@@ -375,6 +423,10 @@ void JaxJobController::HandleExits(JobView& job) {
     }
   }
   job.status["completionUnix"] = now_s_ ? now_s_ : NowWall();
+  AppendEvent(job, "Warning", "Failed",
+              std::string(retryable ? "BackoffLimitExceeded"
+                                    : "PermanentFailure") +
+                  ": worker exited " + std::to_string(first_fail_code));
   SetPhase(job, "Failed",
            retryable ? "BackoffLimitExceeded" : "PermanentFailure",
            "worker exited " + std::to_string(first_fail_code), now_s_);
@@ -401,6 +453,10 @@ void JaxJobController::CheckHeartbeats(JobView& job) {
     if (stat(log_path.c_str(), &st) != 0) continue;  // not spawned by us
     double age = now_wall - static_cast<double>(st.st_mtime);
     if (age > timeout) {
+      AppendEvent(job, "Warning", "HeartbeatTimeout",
+                  "worker " + std::to_string(i) + " silent for " +
+                      std::to_string(static_cast<int>(age)) +
+                      "s; killing for gang restart");
       SetPhase(job, "Running", "HeartbeatTimeout",
                "worker " + std::to_string(i) + " silent for " +
                    std::to_string(static_cast<int>(age)) + "s (timeout " +
@@ -508,6 +564,8 @@ void JaxJobController::Recover() {
     job.status["restarts"] = restarts + 1;  // counts toward backoff: a
     // crash-looping control plane must not restart gangs forever
     metrics_.gang_restarts++;
+    AppendEvent(job, "Warning", "ControlPlaneRestarted",
+                "orphaned gang reaped after control-plane restart");
     SetPhase(job, "Restarting", "ControlPlaneRestarted",
              "orphaned gang reaped after control-plane restart", NowWall());
     store_->UpdateStatus("JAXJob", res.name, job.status);
@@ -536,6 +594,7 @@ void JaxJobController::Reconcile(const std::string& name) {
 
   if (phase.empty()) {
     metrics_.jobs_created++;
+    AppendEvent(job, "Normal", "Submitted", "job accepted");
     SetPhase(job, "Created", "JobCreated", "accepted", now_s_);
   }
 
@@ -583,6 +642,8 @@ void JaxJobController::Tick(double now_s) {
       job.status["active"] = false;
       ReleaseAlloc(job);
       job.status["completionUnix"] = now_s;
+      AppendEvent(job, "Warning", "Failed",
+                  "DeadlineExceeded: activeDeadlineSeconds exceeded");
       SetPhase(job, "Failed", "DeadlineExceeded",
                "activeDeadlineSeconds exceeded", now_s);
       metrics_.jobs_failed++;

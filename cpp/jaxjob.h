@@ -74,6 +74,11 @@ class JaxJobController {
 
   ControllerMetrics& metrics() { return metrics_; }
 
+  // Where the API server listens — injected into workers as TPK_SOCKET
+  // so the runtime can post events (CheckpointSaved) back into the
+  // job's event log. Empty = workers get no event channel.
+  void SetSocketPath(const std::string& path) { socket_path_ = path; }
+
   // Process id helper: "<job>/<replica-index>".
   static std::string ProcId(const std::string& job, int replica);
 
@@ -109,6 +114,11 @@ class JaxJobController {
   void SetPhase(JobView& job, const std::string& phase,
                 const std::string& reason, const std::string& message,
                 double now_s);
+  // Append one entry to the job's structured event log (events.h):
+  // ordered, deduped, bounded, WAL-persisted with the status write the
+  // caller's reconcile already makes. type: "Normal" | "Warning".
+  void AppendEvent(JobView& job, const std::string& type,
+                   const std::string& reason, const std::string& message);
   void KillAll(const JobView& job);
   void ReleaseAlloc(JobView& job);
   Allocation AllocFromStatus(const Json& status) const;
@@ -118,6 +128,7 @@ class JaxJobController {
   Scheduler* scheduler_;
   std::string workdir_;
   std::string python_;
+  std::string socket_path_;
   ControllerMetrics metrics_;
   double now_s_ = 0;
 };

@@ -113,6 +113,7 @@ int main(int argc, char** argv) {
   for (const auto& [name, cap] : slices) scheduler.AddSlice(name, cap);
   tpk::LocalExecutor executor;
   tpk::JaxJobController jaxjob(&store, &executor, &scheduler, workdir, python);
+  jaxjob.SetSocketPath(socket_path);
   jaxjob.Recover();
   tpk::SubprocessSuggestion suggestion(python);
   tpk::ExperimentController tune(&store, &suggestion, workdir);

@@ -2,6 +2,8 @@
 
 #include "admission.h"
 
+#include "events.h"
+
 #include <fcntl.h>
 #include <poll.h>
 #include <sys/socket.h>
@@ -9,9 +11,24 @@
 #include <unistd.h>
 
 #include <cerrno>
+#include <chrono>
 #include <cstring>
 
+#include "util.h"
+
 namespace tpk {
+
+namespace {
+
+// µs on the steady clock — the trace ring's timeline (Chrome trace
+// wants monotonic µs, not wall time).
+double SteadyMicros() {
+  return std::chrono::duration<double, std::micro>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+}  // namespace
 
 Server::Server(Store* store, Scheduler* scheduler, JaxJobController* jaxjob,
                std::string socket_path, std::string workdir,
@@ -140,6 +157,55 @@ Json Server::Dispatch(const Json& req) {
     // mode — the operator's view of whether state survives a crash.
     resp["ok"] = true;
     resp["stateinfo"] = store_->StateInfo();
+  } else if (op == "events") {
+    // Per-job structured event history (events.h): ordered events +
+    // conditions from the resource status — `tpukit events <job>`.
+    // Status rides the WAL, so the history survives restarts.
+    const std::string k = kind.empty() ? "JAXJob" : kind;
+    auto r = store_->Get(k, name);
+    if (!r) {
+      resp["ok"] = false;
+      resp["error"] = "not found: " + k + "/" + name;
+    } else {
+      resp["ok"] = true;
+      resp["events"] = r->status.get("events").is_array()
+                           ? r->status.get("events")
+                           : Json::Array();
+      resp["conditions"] = r->status.get("conditions").is_array()
+                               ? r->status.get("conditions")
+                               : Json::Array();
+      resp["phase"] = r->status.get("phase").as_string();
+    }
+  } else if (op == "event") {
+    // Worker-posted event (the trainer's CheckpointSaved path): append
+    // one event to the job's history through the normal status write —
+    // WAL-persisted like every controller-recorded event.
+    const std::string k = kind.empty() ? "JAXJob" : kind;
+    auto r = store_->Get(k, name);
+    if (!r) {
+      resp["ok"] = false;
+      resp["error"] = "not found: " + k + "/" + name;
+    } else {
+      std::string type = req.get("type").as_string();
+      if (type != "Warning") type = "Normal";
+      Json status = AppendStatusEvent(
+          r->status, type, req.get("reason").as_string(),
+          req.get("message").as_string(), NowWall());
+      if (status.dump() == r->status.dump()) {
+        // Exact-duplicate event (AppendStatusEvent's dedup no-op): a
+        // worker retry loop must not bump resourceVersion / append WAL
+        // records / fire watches for history that didn't change.
+        resp["ok"] = true;
+        resp["resource"] = Store::ToJson(*r);
+      } else {
+        fill(store_->UpdateStatus(k, name, status));
+      }
+    }
+  } else if (op == "trace") {
+    // The control plane's span ring as Chrome trace-event JSON —
+    // `tpukit trace` (the /debug/trace analog for this process).
+    resp["ok"] = true;
+    resp["trace"] = TraceJson();
   } else if (op == "slices") {
     resp["ok"] = true;
     Json arr = Json::Array();
@@ -192,16 +258,57 @@ Json Server::Dispatch(const Json& req) {
   return resp;
 }
 
+void Server::RecordSpan(const std::string& name, const std::string& trace,
+                        double ts_us, double dur_us) {
+  // Both strings are wire-controlled; the ring RETAINS them past the
+  // request (unlike the line buffer), so bound them or a hostile client
+  // could park gigabytes here (the Python side bounds ids to 128 too).
+  constexpr size_t kMaxStr = 128;
+  trace_ring_.push_back({name.substr(0, kMaxStr), trace.substr(0, kMaxStr),
+                         ts_us, dur_us});
+  while (trace_ring_.size() > kTraceRingCap) trace_ring_.pop_front();
+}
+
+Json Server::TraceJson() const {
+  Json events = Json::Array();
+  for (const auto& sp : trace_ring_) {
+    Json ev = Json::Object();
+    ev["name"] = sp.name;
+    ev["cat"] = "tpk";
+    ev["ph"] = "X";
+    ev["ts"] = sp.ts_us;
+    ev["dur"] = sp.dur_us;
+    ev["pid"] = static_cast<int64_t>(getpid());
+    ev["tid"] = "controlplane";
+    Json args = Json::Object();
+    args["trace_id"] = sp.trace;
+    ev["args"] = args;
+    events.push_back(ev);
+  }
+  Json doc = Json::Object();
+  doc["traceEvents"] = events;
+  doc["displayTimeUnit"] = "ms";
+  return doc;
+}
+
 void Server::HandleLine(Client& c, const std::string& line) {
   Json resp;
+  std::string span_name = "controlplane.bad_request";
+  std::string trace;
+  const double t0 = SteadyMicros();
   try {
     Json req = Json::parse(line);
+    span_name = "controlplane." + req.get("op").as_string();
+    trace = req.get("trace").as_string();
     resp = Dispatch(req);
   } catch (const std::exception& e) {
     resp = Json::Object();
     resp["ok"] = false;
     resp["error"] = std::string("bad request: ") + e.what();
   }
+  // Every dispatched request leaves one span in the ring (the `trace`
+  // verb included — its own handling is part of the timeline too).
+  RecordSpan(span_name, trace, t0, SteadyMicros() - t0);
   c.out_buf += resp.dump();
   c.out_buf += '\n';
 }

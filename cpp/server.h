@@ -9,6 +9,7 @@
 
 #pragma once
 
+#include <deque>
 #include <functional>
 #include <map>
 #include <string>
@@ -43,6 +44,21 @@ class Server {
 
   Json Dispatch(const Json& req);  // public for unit tests
 
+  // One span per dispatched request in a bounded process-local ring
+  // (the control plane's half of the end-to-end trace: clients attach
+  // their trace id to each request; the `trace` verb exports the ring
+  // as Chrome trace-event JSON for chrome://tracing / Perfetto —
+  // `tpukit trace`).
+  struct TraceSpan {
+    std::string name;   // "controlplane.<op>"
+    std::string trace;  // caller-attached trace id ("" when absent)
+    double ts_us;       // µs since process start (steady clock)
+    double dur_us;
+  };
+  void RecordSpan(const std::string& name, const std::string& trace,
+                  double ts_us, double dur_us);
+  Json TraceJson() const;  // {"traceEvents": [...]} — the `trace` verb
+
  private:
   struct Client {
     int fd;
@@ -62,6 +78,8 @@ class Server {
   std::string workdir_;
   int listen_fd_ = -1;
   std::vector<Client> clients_;
+  std::deque<TraceSpan> trace_ring_;
+  static constexpr size_t kTraceRingCap = 2048;
 };
 
 }  // namespace tpk

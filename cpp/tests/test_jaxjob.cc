@@ -5,6 +5,7 @@
 #include <cstdio>
 
 #include "admission.h"
+#include "events.h"
 #include "executor.h"
 #include "jaxjob.h"
 #include "scheduler.h"
@@ -463,6 +464,74 @@ int main() {
     rt["mesh"] = mesh;
     spec["runtime"] = rt;
     CHECK(!tpk::ValidateSpec("JAXJob", spec).empty());
+  }
+
+  // --- Structured event log (events.h): ordered lifecycle history -------
+  {
+    Harness h;
+    h.store.Create("JAXJob", "ev", BaseSpec(1));
+    h.Settle();
+    h.exec.Finish("ev/0", 0);
+    h.Settle();
+    CHECK(Phase(h.store, "ev") == "Succeeded");
+    auto r = h.store.Get("JAXJob", "ev");
+    const Json& evs = r->status.get("events");
+    CHECK(evs.is_array() && evs.size() >= 4);
+    std::vector<std::string> reasons;
+    double last_unix = 0;
+    for (const auto& e : evs.elements()) {
+      reasons.push_back(e.get("reason").as_string());
+      CHECK(e.get("unix").as_number() >= last_unix);  // ordered
+      last_unix = e.get("unix").as_number();
+      CHECK(!e.get("timestamp").as_string().empty());
+    }
+    auto idx = [&](const std::string& what) {
+      for (size_t i = 0; i < reasons.size(); ++i) {
+        if (reasons[i] == what) return static_cast<int>(i);
+      }
+      return -1;
+    };
+    CHECK(idx("Submitted") == 0);
+    CHECK(idx("Scheduled") > idx("Submitted"));
+    CHECK(idx("Launched") > idx("Scheduled"));
+    CHECK(idx("Succeeded") > idx("Launched"));
+  }
+
+  // --- Event dedup: exact repeat = no-op; new message merges ------------
+  {
+    Json st = Json::Object();
+    st = tpk::AppendStatusEvent(st, "Warning", "Unschedulable", "no cap",
+                                100.0);
+    std::string before = st.dump();
+    st = tpk::AppendStatusEvent(st, "Warning", "Unschedulable", "no cap",
+                                101.0);
+    CHECK(st.dump() == before);  // exact repeat: byte-identical status
+    st = tpk::AppendStatusEvent(st, "Warning", "Unschedulable",
+                                "still no cap", 102.0);
+    CHECK(st.get("events").size() == 1);  // merged, not appended
+    const Json& merged = st.get("events").elements()[0];
+    CHECK(merged.get("count").as_int() == 2);
+    CHECK(merged.get("message").as_string() == "still no cap");
+    st = tpk::AppendStatusEvent(st, "Normal", "Scheduled", "ok", 103.0);
+    CHECK(st.get("events").size() == 2);  // different reason appends
+    // Bounded: the log trims oldest-first past the cap.
+    for (int i = 0; i < 2 * static_cast<int>(tpk::kMaxStatusEvents); ++i) {
+      st = tpk::AppendStatusEvent(st, "Normal", "R" + std::to_string(i),
+                                  "m", 104.0 + i);
+    }
+    CHECK(st.get("events").size() == tpk::kMaxStatusEvents);
+  }
+
+  // --- Unschedulable pend: repeated reconciles must not churn status ----
+  {
+    Harness h(/*capacity=*/1);
+    h.store.Create("JAXJob", "toobig", BaseSpec(4));
+    h.Settle();
+    CHECK(Phase(h.store, "toobig") == "Pending");
+    auto v1 = h.store.Get("JAXJob", "toobig")->resource_version;
+    for (int i = 0; i < 5; ++i) h.Settle();  // level-triggered retries
+    auto v2 = h.store.Get("JAXJob", "toobig")->resource_version;
+    CHECK(v1 == v2);  // event dedup kept the status write-free
   }
 
   return 0;

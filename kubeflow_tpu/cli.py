@@ -11,6 +11,8 @@ slice and metrics introspection.
   tpukit logs mnist -r 0 [-f]
   tpukit delete job mnist
   tpukit slices | tpukit metrics
+  tpukit events mnist          # per-job event history (WAL-persisted)
+  tpukit trace -o trace.json   # control-plane spans for chrome://tracing
 """
 
 from __future__ import annotations
@@ -201,6 +203,38 @@ def cmd_stateinfo(args) -> int:
     return 0
 
 
+def cmd_events(args) -> int:
+    """Ordered per-job event history (the `kubectl describe` events
+    table analog) — WAL-persisted, so it survives control-plane
+    restarts."""
+    out = _client(args).events(args.name, kind=_kind_alias(args.kind))
+    if args.json:
+        print(json.dumps(out, indent=2))
+        return 0
+    fmt = "{:<20} {:<8} {:<22} {:<6} {}"
+    print(fmt.format("TIME", "TYPE", "REASON", "COUNT", "MESSAGE"))
+    for ev in out["events"]:
+        print(fmt.format(ev.get("timestamp", ""), ev.get("type", ""),
+                         ev.get("reason", ""), str(ev.get("count", 1)),
+                         ev.get("message", "")))
+    return 0
+
+
+def cmd_trace(args) -> int:
+    """The control plane's span ring as Chrome trace-event JSON — load
+    the output in chrome://tracing or https://ui.perfetto.dev."""
+    doc = _client(args).trace()
+    text = json.dumps(doc, indent=1)
+    if args.output:
+        with open(args.output, "w") as fh:
+            fh.write(text + "\n")
+        print(f"wrote {args.output} "
+              f"({len(doc.get('traceEvents', []))} spans)")
+    else:
+        print(text)
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="tpukit")
     parser.add_argument("--socket", default="/tmp/tpk.sock")
@@ -257,6 +291,19 @@ def main(argv=None) -> int:
     p = sub.add_parser("stateinfo",
                        help="WAL/snapshot durability health")
     p.set_defaults(fn=cmd_stateinfo)
+
+    p = sub.add_parser("events",
+                       help="per-job event history (WAL-persisted)")
+    p.add_argument("name")
+    p.add_argument("--kind", default="JAXJob")
+    p.add_argument("--json", action="store_true",
+                   help="raw JSON (events + conditions)")
+    p.set_defaults(fn=cmd_events)
+
+    p = sub.add_parser("trace",
+                       help="control-plane spans as Chrome trace JSON")
+    p.add_argument("-o", "--output")
+    p.set_defaults(fn=cmd_trace)
 
     args = parser.parse_args(argv)
     try:

@@ -15,9 +15,11 @@ import subprocess
 import time
 from typing import Any, Iterator
 
-from kubeflow_tpu.utils import faults
+from kubeflow_tpu.utils import faults, obs
 from kubeflow_tpu.utils.resilience import (BackoffPolicy, Deadline,
-                                           DeadlineExceeded, retry_call)
+                                           DeadlineExceeded,
+                                           metrics as res_metrics,
+                                           retry_call)
 
 _FP_REQUEST = faults.register_point(
     "controlplane.request",
@@ -59,7 +61,8 @@ _PRE_SEND_ERRORS = (ConnectionRefusedError, FileNotFoundError)
 #: disconnect is always safe (client-go's IsServerTimeout/idempotency
 #: split for GET-class requests).
 _READ_ONLY_OPS = frozenset(
-    {"get", "list", "metrics", "slices", "logs", "ping", "stateinfo"})
+    {"get", "list", "metrics", "slices", "logs", "ping", "stateinfo",
+     "events", "trace"})
 
 
 def namespace_of(resource: dict) -> str:
@@ -83,12 +86,18 @@ class Client:
                  timeout: float = 30.0,
                  retry: BackoffPolicy | None = None,
                  max_attempts: int = 5,
-                 deadline_s: float | None = None):
+                 deadline_s: float | None = None,
+                 trace_id: str | None = None):
         self.socket_path = socket_path
         self.timeout = timeout
         self.retry = retry or BackoffPolicy(initial_s=0.05, max_s=2.0)
         self.max_attempts = int(max_attempts)
         self.deadline_s = timeout if deadline_s is None else deadline_s
+        # One trace identity per client (callers can pass the request id
+        # they are working under): attached to every RPC, recorded on the
+        # client's spans AND in the server's dispatch trace ring — the
+        # cross-process link `tpukit trace` surfaces.
+        self.trace_id = obs.sanitize_trace_id(trace_id)
         self._sock: socket.socket | None = None
         self._buf = b""
 
@@ -136,6 +145,9 @@ class Client:
     def request(self, **req: Any) -> dict:
         deadline = Deadline(self.deadline_s)
         attempts = [0]
+        op = str(req.get("op", ""))
+        req.setdefault("trace", self.trace_id)
+        t0 = time.perf_counter()
 
         def once():
             attempt = attempts[0]
@@ -176,6 +188,15 @@ class Client:
                 f"after {attempts[0]} attempt(s) over "
                 f"{self.deadline_s:.1f}s budget: "
                 f"{type(e).__name__}: {e}") from e
+        finally:
+            # Per-verb RPC latency distribution + a client-side span,
+            # every outcome, retries/backoff included — this is the
+            # latency the CALLER experienced, the SRE-relevant number.
+            t1 = time.perf_counter()
+            res_metrics.observe("tpk_controlplane_rpc_latency_seconds",
+                                t1 - t0, verb=op)
+            obs.record("controlplane.rpc", t0, t1, self.trace_id,
+                       op=op, attempts=max(attempts[0], 1))
 
     # -- resource verbs -------------------------------------------------------
 
@@ -216,6 +237,30 @@ class Client:
         vs stopped-at-corruption), compaction counters, and the fsync
         policy — the operator's `etcdctl endpoint status` analog."""
         return self.request(op="stateinfo")["stateinfo"]
+
+    def events(self, name: str, kind: str = "JAXJob") -> dict:
+        """The per-job structured event log + conditions (the rebuild's
+        EventRecorder, SURVEY.md §5.5): {"events": [ordered {type,
+        reason, message, timestamp, unix, count}], "conditions": [...]}.
+        Events live in the resource status, so they ride the WAL and
+        survive a control-plane restart."""
+        r = self.request(op="events", kind=kind, name=name)
+        return {"events": r.get("events", []),
+                "conditions": r.get("conditions", [])}
+
+    def post_event(self, name: str, reason: str, message: str = "",
+                   type_: str = "Normal", kind: str = "JAXJob") -> None:
+        """Append one event to a job's event log (the worker-side path:
+        the trainer posts CheckpointSaved and friends through this)."""
+        self.request(op="event", kind=kind, name=name, type=type_,
+                     reason=reason, message=message)
+
+    def trace(self) -> dict:
+        """The control plane's span ring as a Chrome trace-event
+        document (load in chrome://tracing / Perfetto): one `ph: "X"`
+        event per dispatched request, with the caller's trace id under
+        args — `tpukit trace` prints this."""
+        return self.request(op="trace")["trace"]
 
     def logs(self, name: str, replica: int = 0, stderr: bool = False,
              max_bytes: int = 65536) -> str:

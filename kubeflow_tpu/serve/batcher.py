@@ -18,7 +18,7 @@ from typing import Any, Callable, Sequence
 
 import numpy as np
 
-from kubeflow_tpu.utils import faults
+from kubeflow_tpu.utils import faults, obs
 from kubeflow_tpu.utils.resilience import Deadline, DeadlineExceeded
 
 _FP_PREDICT = faults.register_point(
@@ -27,14 +27,17 @@ _FP_PREDICT = faults.register_point(
 
 
 class _Item:
-    __slots__ = ("inputs", "future", "n", "deadline", "t_enq")
+    __slots__ = ("inputs", "future", "n", "deadline", "t_enq", "t_perf",
+                 "trace")
 
     def __init__(self, inputs: Sequence[np.ndarray],
-                 deadline: Deadline | None = None):
+                 deadline: Deadline | None = None, trace_id: str = ""):
         self.inputs = [np.asarray(x) for x in inputs]
         self.n = self.inputs[0].shape[0]
         self.deadline = deadline
         self.t_enq = time.monotonic()
+        self.t_perf = time.perf_counter()  # span clock (obs epoch)
+        self.trace = trace_id
         self.future: Future = Future()
 
     def deliver(self, result=None, exc: BaseException | None = None) -> None:
@@ -93,10 +96,11 @@ class Batcher:
         self._thread.start()
 
     def submit(self, inputs: Sequence[np.ndarray],
-               deadline: Deadline | None = None) -> Future:
+               deadline: Deadline | None = None,
+               trace_id: str = "") -> Future:
         if self._closed:
             raise RuntimeError("batcher is closed")
-        item = _Item(inputs, deadline)
+        item = _Item(inputs, deadline, trace_id)
         if item.expire_if_due():
             return item.future
         if item.n > self.max_batch_size:
@@ -182,6 +186,17 @@ class Batcher:
                      if i.future.set_running_or_notify_cancel()]
             if not batch:
                 continue
+            # One batch-gather span per item (enqueue → dispatch: the
+            # time this request spent waiting to coalesce), each
+            # carrying ITS request id, so a slow request's queue share
+            # is separable from its compute share in /debug/trace.
+            t_flush = time.perf_counter()
+            tracer = obs.get_tracer()
+            if tracer.enabled:
+                for item in batch:
+                    tracer.record("serve.batch_gather", item.t_perf,
+                                  t_flush, item.trace,
+                                  batch=len(batch), examples=item.n)
             try:
                 faults.fire(_FP_PREDICT, batch=sum(i.n for i in batch))
                 stacked = [np.concatenate(parts)
@@ -191,6 +206,15 @@ class Batcher:
                 for item in batch:
                     item.deliver(exc=e)
                 continue
+            if tracer.enabled:
+                # The shared model call, one span PER rider (same
+                # interval, each request's own trace id): a request's
+                # timeline stays complete even when it shared the batch.
+                t1 = time.perf_counter()
+                for item in batch:
+                    tracer.record("serve.predict", t_flush, t1, item.trace,
+                                  items=len(batch),
+                                  examples=sum(i.n for i in batch))
             self.stats["batches"] += 1
             self.stats["items"] += len(batch)
             self.stats["examples"] += sum(i.n for i in batch)

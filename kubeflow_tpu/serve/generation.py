@@ -46,6 +46,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from kubeflow_tpu.serve.model import Model
+from kubeflow_tpu.utils import obs
 from kubeflow_tpu.utils.resilience import Deadline, DeadlineExceeded
 
 NEG_INF = -1e30
@@ -1003,7 +1004,8 @@ class GenerationEngine:
                temperature: float = 0.0, top_k: int = 0,
                top_p: float = 1.0, eos_id: int | None = None,
                timeout: float = 300.0, adapter: str | None = None,
-               deadline: Deadline | None = None, on_tokens=None) -> dict:
+               deadline: Deadline | None = None, on_tokens=None,
+               trace_id: str = "") -> dict:
         """`on_tokens(tokens, done)` (optional) is invoked from the worker
         thread as tokens are emitted — chunk-granular streaming; the final
         call has done=True. Exceptions in the callback are swallowed (a
@@ -1038,6 +1040,11 @@ class GenerationEngine:
             "error": None,
             "deadline": deadline,
             "t0": time.monotonic(),
+            # Trace identity + enqueue mark: the worker records this
+            # request's batch-gather span (queue wait → slot admission)
+            # and annotates its prefill/decode/fetch spans with the id.
+            "trace": trace_id,
+            "t_enq": time.perf_counter(),
             "cb": on_tokens,
         }
         self._queue.put(req)
@@ -1146,8 +1153,19 @@ class GenerationEngine:
                 per[en] -= 1
 
     def _admit(self, slot: int, req: dict) -> None:
+        tracer = obs.get_tracer()
+        if tracer.enabled:
+            # Queue wait (submit enqueue → slot admission): the engine's
+            # continuous batcher is this request's "batch gather".
+            tracer.record("serve.batch_gather",
+                          req.get("t_enq") or time.perf_counter(),
+                          time.perf_counter(), req.get("trace", ""),
+                          slot=slot)
         with self._scope():
-            self._admit_inner(slot, req)
+            with obs.span("serve.prefill", trace_id=req.get("trace", ""),
+                          slot=slot,
+                          prompt_tokens=len(req["input_ids"])):
+                self._admit_inner(slot, req)
 
     def _admit_inner(self, slot: int, req: dict) -> None:
         ids = req["input_ids"]
@@ -1480,6 +1498,7 @@ class GenerationEngine:
             aids[i] = st.get("aid", 0)
         self._key, sub = jax.random.split(self._key)
         t0 = time.monotonic()
+        p0 = time.perf_counter()
         with self._scope():
             for i in demoted:
                 self._readmit_draft(i, self._slots[i])
@@ -1496,6 +1515,13 @@ class GenerationEngine:
         lps = np.asarray(lps)
         acc = np.asarray(acc)    # [B, n_spec] accepted counts
         now = time.monotonic()
+        tracer = obs.get_tracer()
+        if tracer.enabled:
+            p1 = time.perf_counter()
+            for i in active:
+                tracer.record("serve.decode_chunk", p0, p1,
+                              self._slots[i]["req"].get("trace", ""),
+                              slot=i, spec=True)
         self.stats["decode_seconds"] += now - t0
         self.stats["host_stall_seconds"] += now - t0
         self.stats["decode_fetch_blocking"] += 1
@@ -1554,6 +1580,7 @@ class GenerationEngine:
                       self.decode_buckets[-1])
         self._key, sub = jax.random.split(self._key)
         t0 = time.monotonic()
+        p0 = time.perf_counter()  # span clock for the decode-chunk span
         with self._scope():
             last_dev = (jnp.asarray(last) if carry is None
                         else carry["toks"][:, -1])
@@ -1583,7 +1610,7 @@ class GenerationEngine:
             st["disp"] += self.chunk
             parts[i] = st
         return {"toks": toks, "lps": lps, "parts": parts, "t0": t0,
-                "chunk": self.chunk}
+                "p0": p0, "chunk": self.chunk}
 
     def _fetch_chunk(self, rec: dict, overlapped: bool) -> None:
         """Fetch one dispatch record's tokens (the host sync point) and
@@ -1594,9 +1621,23 @@ class GenerationEngine:
         fetch (the steady-state pipelining invariant the CPU dispatch-
         count guard test pins)."""
         t0 = time.monotonic()
+        pf0 = time.perf_counter()
         toks = np.asarray(rec["toks"])  # host sync point: [B, chunk]
         lps = np.asarray(rec["lps"])
         now = time.monotonic()
+        pf1 = time.perf_counter()
+        tracer = obs.get_tracer()
+        if tracer.enabled:
+            # Chunk-granular spans (never per-token — the hot loop adds
+            # no syncs, and the ring stays bounded): one decode-chunk
+            # span per rider covering dispatch→fetch-start, one fetch
+            # span per rider covering the host sync itself.
+            for i, st in rec["parts"].items():
+                trace = st["req"].get("trace", "")
+                tracer.record("serve.decode_chunk", rec["p0"], pf0, trace,
+                              slot=i, chunk=rec["chunk"],
+                              overlapped=overlapped)
+                tracer.record("serve.fetch", pf0, pf1, trace, slot=i)
         self.stats["host_stall_seconds"] += now - t0
         self.stats["decode_fetch_overlapped" if overlapped
                     else "decode_fetch_blocking"] += 1
@@ -1780,9 +1821,11 @@ class GenerativeJAXModel(Model):
             eos_id=payload.get("eos_id", self.eos_id),
             adapter=payload.get("adapter"),
             timeout=float(payload.get("timeout", 300.0)),
-            # In-process deadline propagation: the server stashes the
-            # request's Deadline under "_deadline" (never a wire field).
-            deadline=payload.get("_deadline"))
+            # In-process deadline/trace propagation: the server stashes
+            # the request's Deadline under "_deadline" and its
+            # X-Request-Id under "_trace" (never wire fields).
+            deadline=payload.get("_deadline"),
+            trace_id=payload.get("_trace", ""))
 
     def generate(self, payload: dict) -> dict:
         if not self.ready or self.engine is None:

@@ -25,6 +25,7 @@ import numpy as np
 
 from kubeflow_tpu.serve import open_inference_pb2 as pb
 from kubeflow_tpu.serve.model import Model, _v2_dtype, v2_to_numpy_dtype
+from kubeflow_tpu.utils import obs
 from kubeflow_tpu.utils.resilience import (Deadline, DeadlineExceeded,
                                            metrics as res_metrics)
 
@@ -129,12 +130,25 @@ class InferenceServicer:
         return self.server.prometheus_text().encode()
 
     def ModelInfer(self, request, context):
+        # Trace identity, shared with the HTTP plane: honor the caller's
+        # x-request-id metadata, assign one otherwise, echo it back in
+        # the trailing metadata — gRPC and HTTP requests land in the
+        # SAME span ring with the same span names.
+        rid = next((v for k, v in (context.invocation_metadata() or ())
+                    if k.lower() == "x-request-id"), None)
+        trace_id = obs.sanitize_trace_id(rid)
+        context.set_trailing_metadata((("x-request-id", trace_id),))
         # The gRPC data plane sits behind the SAME admission gate as the
         # HTTP handlers — it must not be an unbounded side door around
         # --max-inflight. RESOURCE_EXHAUSTED is the canonical overload
         # status (the HTTP 503 + Retry-After equivalent).
         adm = self.server.admission
-        if adm is not None and not adm.try_acquire(component="serve_grpc"):
+        with obs.span("serve.admit", trace_id=trace_id,
+                      path="grpc.ModelInfer") as sp:
+            shed = adm is not None and not adm.try_acquire(
+                component="serve_grpc")
+            sp.set(admitted=not shed)
+        if shed:
             context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
                           "server overloaded: admission queue full")
         # An expired request's work may still be computing when the
@@ -144,7 +158,7 @@ class InferenceServicer:
         # WORK, not just concurrent waiting callers.
         ride = []
         try:
-            return self._infer(request, context, ride)
+            return self._infer(request, context, ride, trace_id)
         finally:
             if adm is not None:
                 if ride:
@@ -152,7 +166,7 @@ class InferenceServicer:
                 else:
                     adm.release()
 
-    def _infer(self, request, context, ride):
+    def _infer(self, request, context, ride, trace_id=""):
         name = request.model_name
         model = self._model(name, context)
         if not model.ready:
@@ -210,7 +224,7 @@ class InferenceServicer:
                         if isinstance(out, dict) else out]
             else:
                 fut = self.server.repo.batcher(name).submit(
-                    inputs, deadline=deadline)
+                    inputs, deadline=deadline, trace_id=trace_id)
                 outs = fut.result(
                     timeout=deadline.bound(120.0) if deadline else 120)
             outs = model.postprocess(outs)
@@ -308,12 +322,12 @@ class InferenceClient:
     def __init__(self, target: str):
         self._channel = grpc.insecure_channel(target)
 
-    def _call(self, method, req, resp_cls):
+    def _call(self, method, req, resp_cls, metadata=None):
         rpc = self._channel.unary_unary(
             f"/{SERVICE}/{method}",
             request_serializer=lambda m: m.SerializeToString(),
             response_deserializer=resp_cls.FromString)
-        return rpc(req)
+        return rpc(req, metadata=metadata)
 
     def server_live(self) -> bool:
         return self._call("ServerLive", pb.ServerLiveRequest(),
@@ -339,7 +353,11 @@ class InferenceClient:
         return rpc(b"").decode()
 
     def infer(self, name: str, arrays: list[np.ndarray], *,
-              raw: bool = False) -> list[np.ndarray]:
+              raw: bool = False,
+              request_id: str | None = None) -> list[np.ndarray]:
+        """`request_id` rides as x-request-id metadata — the gRPC half
+        of the trace-id contract (the server echoes it in the trailing
+        metadata and stamps it on the request's spans)."""
         arrays = [np.asarray(a) for a in arrays]
         # raw_input_contents is all-or-nothing; FP16/BF16 force raw.
         use_raw = raw or any(
@@ -356,7 +374,9 @@ class InferenceClient:
             else:
                 getattr(t.contents, _CONTENTS_FIELD[dt]).extend(
                     arr.reshape(-1).tolist())
-        resp = self._call("ModelInfer", req, pb.ModelInferResponse)
+        resp = self._call("ModelInfer", req, pb.ModelInferResponse,
+                          metadata=(("x-request-id", request_id),)
+                          if request_id else None)
         outs = []
         for j, t in enumerate(resp.outputs):
             raw_out = (resp.raw_output_contents[j]

@@ -256,6 +256,10 @@ class _GenerativeHandler(_OpenAIBase):
             # In-process deadline propagation, exactly as the native
             # :generate path: the engine frees the decode slot on expiry.
             payload["_deadline"] = deadline
+        # Trace propagation, exactly as the native path (the payload is
+        # rebuilt from whitelisted fields, so a wire "_trace" can't ride
+        # in): the facade's engine spans carry X-Request-Id too.
+        payload["_trace"] = self.trace_id
         rid = f"{'chatcmpl' if 'chat' in self.object_name else 'cmpl'}-" \
               f"{uuid.uuid4().hex[:24]}"
         t0 = time.monotonic()

@@ -40,6 +40,7 @@ import tornado.web
 
 from kubeflow_tpu.serve.batcher import Batcher
 from kubeflow_tpu.serve.model import Model, _v2_dtype, v2_to_numpy_dtype
+from kubeflow_tpu.utils import obs
 from kubeflow_tpu.utils.resilience import (Deadline, DeadlineExceeded,
                                            metrics as res_metrics)
 
@@ -47,6 +48,13 @@ from kubeflow_tpu.utils.resilience import (Deadline, DeadlineExceeded,
 #: timeout header, deadline-propagated in-process): expiry anywhere on
 #: the request path — admission queue, batcher, generation — returns 504.
 DEADLINE_HEADER = "X-Request-Timeout-Ms"
+
+#: The one trace identity of a request (SURVEY.md §5.1 "no unified
+#: tracing" rebuild): honored when the caller sets it, assigned
+#: otherwise, always echoed on the response — and threaded through
+#: admission, the batcher, and the generation engine, whose spans all
+#: carry it (see /debug/trace and utils/obs.py).
+REQUEST_ID_HEADER = "X-Request-Id"
 
 #: GenerationEngine stats → /metrics series (ISSUE 3 observability): the
 #: engine's own counters rendered per model on every scrape, so the
@@ -398,6 +406,13 @@ class _Base(tornado.web.RequestHandler):
         self.server = server
         self.repo = server.repo
 
+    def prepare(self) -> None:
+        # One trace id per request, caller-set or assigned, echoed back —
+        # every span this request opens downstream carries it.
+        self.trace_id = obs.sanitize_trace_id(
+            self.request.headers.get(REQUEST_ID_HEADER))
+        self.set_header(REQUEST_ID_HEADER, self.trace_id)
+
     def write_json(self, obj: Any, status: int = 200) -> None:
         self.set_status(status)
         self.set_header("Content-Type", "application/json")
@@ -435,8 +450,12 @@ class _Base(tornado.web.RequestHandler):
         return without releasing. True = admitted; the caller owns one
         release()."""
         adm = self.server.admission
-        if adm is None or adm.try_acquire():
-            return True
+        with obs.span("serve.admit", trace_id=self.trace_id,
+                      path=self.request.path) as sp:
+            if adm is None or adm.try_acquire():
+                sp.set(admitted=True)
+                return True
+            sp.set(admitted=False)
         self.set_header("Retry-After",
                         str(max(int(adm.retry_after_s), 1)))
         self.write_json(self.shed_body(), status=503)
@@ -584,7 +603,8 @@ class V1PredictHandler(_Base):
         # v1 protocol is single-tensor: "instances" stack along batch dim 0.
         spec = getattr(model, "input_spec", None)
         inputs = [np.asarray(instances, dtype=spec[0][1] if spec else None)]
-        fut = self.repo.batcher(name).submit(inputs, deadline=deadline)
+        fut = self.repo.batcher(name).submit(inputs, deadline=deadline,
+                                             trace_id=self.trace_id)
         outs = await self.await_bounded(fut, deadline)
         outs = model.postprocess(outs)
         self.server.observe(name, len(instances), time.monotonic() - t0)
@@ -639,9 +659,11 @@ class GenerateHandler(_Base):
             raise tornado.web.HTTPError(
                 400, reason=f"model {name!r} is not generative")
         body = self.body_json()
-        # "_deadline" is an in-process field only: a wire-supplied value
-        # would reach the engine as a non-Deadline and crash it.
+        # "_deadline"/"_trace" are in-process fields only: a wire-supplied
+        # value would reach the engine as a non-Deadline / spoofed trace.
         body.pop("_deadline", None)
+        body.pop("_trace", None)
+        body["_trace"] = self.trace_id
         deadline = self.request_deadline()
         if deadline is not None:
             # In-process deadline propagation: the engine checks the SAME
@@ -746,7 +768,8 @@ class V2InferHandler(_Base):
                 self.submit_blocking(model.predict, payload), deadline)
             outs = [out.get("instances") if isinstance(out, dict) else out]
         else:
-            fut = self.repo.batcher(name).submit(inputs, deadline=deadline)
+            fut = self.repo.batcher(name).submit(inputs, deadline=deadline,
+                                                 trace_id=self.trace_id)
             outs = await self.await_bounded(fut, deadline)
         outs = model.postprocess(outs)
         if not isinstance(outs, (list, tuple)):
@@ -798,6 +821,18 @@ class MetricsHandler(_Base):
     def get(self):
         self.set_header("Content-Type", "text/plain; version=0.0.4")
         self.finish(self.server.prometheus_text())
+
+
+class DebugTraceHandler(_Base):
+    """GET /debug/trace[?trace_id=...] — the process's span ring as
+    Chrome trace-event JSON (load in chrome://tracing / Perfetto). One
+    slow request is diagnosable by filtering its X-Request-Id: admit →
+    batch-gather → prefill → per-chunk decode → fetch spans all carry
+    it. Bounded ring, so this is always a small read."""
+
+    def get(self):
+        tid = self.get_query_argument("trace_id", default=None)
+        self.write_json(obs.get_tracer().chrome_trace(tid))
 
 
 class RequestLogger:
@@ -906,6 +941,11 @@ class ModelServer:
             c["requests"] += 1
             c["examples"] += examples
             c["seconds"] += seconds
+        # Latency distribution, not just the running sum: the counter
+        # pair gives average latency only — p50/p99 need buckets
+        # (SURVEY.md §5.1 rebuild item).
+        res_metrics.observe("tpk_serve_request_latency_seconds", seconds,
+                            model=model)
 
     def prometheus_text(self) -> str:
         lines = [
@@ -985,6 +1025,7 @@ class ModelServer:
             (r"/v2/repository/index", RepositoryIndexHandler, kw),
             (r"/v2/models/([^/]+)(/ready)?", V2ModelHandler, kw),
             (r"/metrics", MetricsHandler, kw),
+            (r"/debug/trace", DebugTraceHandler, kw),
         ])
 
     def _serve(self, port: int, ready: threading.Event) -> None:
@@ -1069,10 +1110,12 @@ def main(argv: list[str] | None = None) -> int:
                 p.error(f"--mesh parts must be axis=N, got {part!r}")
 
     if args.cpu_devices:
-        import jax
+        # Shared helper: covers jax >= 0.5 (jax_num_cpu_devices) AND
+        # older jax (XLA_FLAGS) — a raw config update crash-loops every
+        # controller-launched replica on old-jax environments.
+        from kubeflow_tpu.utils.devices import force_cpu_device_count
 
-        jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+        force_cpu_device_count(args.cpu_devices)
 
     from kubeflow_tpu.serve import runtimes, storage
 

@@ -27,7 +27,7 @@ from kubeflow_tpu.parallel.sharding import rules_for
 from kubeflow_tpu.train.checkpoint import CheckpointManager
 from kubeflow_tpu.train.metrics import MetricsLogger, StepTimer
 from kubeflow_tpu.train.step import init_train_state, make_train_step
-from kubeflow_tpu.utils import faults, resilience
+from kubeflow_tpu.utils import faults, obs, resilience
 
 #: Fires at the top of every training step (ctx: step) — arming FailN
 #: with match={"step": K} is the in-process analog of the controller's
@@ -108,6 +108,15 @@ class TrainJobSpec:
     metrics_path: str | None = None
     profile: dict = dataclasses.field(default_factory=dict)
     # {"dir": str, "start_step": int, "num_steps": int}
+    # Flat jax.profiler window keyed off the job spec (SURVEY.md §5.1
+    # rebuild item): steps [profile_start_step, profile_stop_step) run
+    # under jax.profiler.start_trace/stop_trace, writing to
+    # $TPK_WORKDIR/profile (the job's workdir under the control plane)
+    # — or next to metrics_path, or ./tpk-profile — unless profile.dir
+    # overrides. stop <= start disables (the default). The dict-style
+    # `profile` knob wins when both are set.
+    profile_start_step: int = 0
+    profile_stop_step: int = 0
     log_every: int = 10
     # In-run validation stream: every eval_every steps (0 = off), run
     # eval_batches batches of eval_dataset (default: the train dataset with
@@ -291,6 +300,14 @@ class Trainer:
                              f"{spec.backoff_limit}")
         if spec.prefetch < 0:
             raise ValueError(f"prefetch must be >= 0, got {spec.prefetch}")
+        if spec.profile_start_step < 0 or spec.profile_stop_step < 0:
+            raise ValueError(
+                "profile_start_step/profile_stop_step must be >= 0, got "
+                f"{spec.profile_start_step}/{spec.profile_stop_step}")
+        # Trace identity for this worker's spans: the job name under a
+        # control plane, a fixed label standalone.
+        self._trace = os.environ.get("TPK_JOB_NAME", "") or "train"
+        self._event_client = None
         self.tx = optax.adamw(self._lr_schedule(),
                               weight_decay=spec.weight_decay)
         if spec.max_grad_norm:
@@ -307,6 +324,27 @@ class Trainer:
                 interval=spec.checkpoint.get("interval", 50),
                 keep=spec.checkpoint.get("keep", 3))
         self.logger = MetricsLogger(spec.metrics_path)
+
+    def _post_event(self, reason: str, message: str = "") -> None:
+        """Best-effort event into the job's control-plane event log
+        (CheckpointSaved & co.): only when launched by the control plane
+        (TPK_SOCKET + TPK_JOB_NAME injected), only from process 0, and
+        never fatal — a missing/slow control plane must not fail
+        training."""
+        sock = os.environ.get("TPK_SOCKET")
+        job = os.environ.get("TPK_JOB_NAME")
+        if not sock or not job or jax.process_index() != 0:
+            return
+        try:
+            if self._event_client is None:
+                from kubeflow_tpu.controlplane.client import Client
+
+                self._event_client = Client(sock, timeout=2.0,
+                                            max_attempts=1, deadline_s=2.0,
+                                            trace_id=job)
+            self._event_client.post_event(job, reason, message)
+        except Exception:
+            self._event_client = None  # reconnect on the next event
 
     def _lr_schedule(self) -> optax.Schedule | float:
         spec = self.spec
@@ -550,8 +588,9 @@ class Trainer:
             # mid-save) quarantines that step and resumes from the
             # next-newest good one instead of wedging every restart of
             # the backoff loop on the same poisoned restore.
-            state, latest, quarantined = \
-                self._ckpt.restore_latest_good(state)
+            with obs.span("train.restore", trace_id=self._trace):
+                state, latest, quarantined = \
+                    self._ckpt.restore_latest_good(state)
             for bad in quarantined:
                 self.logger.log(int(bad), {
                     "event": "checkpoint_quarantined", "step": int(bad)})
@@ -626,16 +665,29 @@ class Trainer:
             num_params=self.info.get("num_params") or 0,
             tokens_per_step=tokens_per_step)
 
-        # Profile window [prof_start, prof_stop): only valid when a dir and a
-        # start inside the run are both given; clamped so the trace always
-        # closes before the loop ends.
+        # Profile window [prof_start, prof_stop): the dict-style knob
+        # (dir + start_step + num_steps) or the flat spec knobs
+        # (profile_start_step/profile_stop_step, trace dir defaulting to
+        # the job workdir); clamped so the trace always closes before
+        # the loop ends.
         prof = spec.profile
         prof_start = prof_stop = None
-        if prof.get("dir") and prof.get("start_step") is not None:
+        prof_dir = prof.get("dir")
+        if prof_dir and prof.get("start_step") is not None:
             prof_start = max(int(prof["start_step"]), start_step)
             prof_stop = min(prof_start + int(prof.get("num_steps", 3)),
                             spec.steps)
             if prof_start >= spec.steps:
+                prof_start = prof_stop = None
+        elif spec.profile_stop_step > spec.profile_start_step:
+            base = (os.environ.get("TPK_WORKDIR")
+                    or (os.path.dirname(spec.metrics_path)
+                        if spec.metrics_path else "")
+                    or ".")
+            prof_dir = prof_dir or os.path.join(base, "profile")
+            prof_start = max(spec.profile_start_step, start_step)
+            prof_stop = min(spec.profile_stop_step, spec.steps)
+            if prof_start >= prof_stop:
                 prof_start = prof_stop = None
         prof_active = False
 
@@ -705,21 +757,44 @@ class Trainer:
 
         last_metrics: dict = {}
         last_eval: dict = {}
+        # CheckpointSaved events are deferred one save boundary: orbax
+        # saves asynchronously, and a WAL-persisted event must never
+        # claim a checkpoint that a kill-9 then tore. Starting save k+1
+        # blocks on save k's commit, so at the next boundary (and after
+        # the final wait()) the previous save is known durable.
+        ckpt_event_pending: int | None = None
         # Per-window data-starvation accounting: how much of the window's
         # wall the training thread spent waiting on input (data_wait_frac
         # ≈ 0 when the prefetcher keeps up; → 1 when the pipeline is the
         # bottleneck and depth/host work needs attention).
         win = {"t0": 0.0, "wait": 0.0, "h2d": 0.0}
+        # Per-window span rollup (tentpole: "span summaries in the JSONL
+        # stream"): host-side wall spent in step dispatch / boundary
+        # fetches / checkpoint saves / eval, summed between log
+        # boundaries — the coarse where-did-the-window-go view; the full
+        # per-span timeline lives in the obs tracer ring.
+        span_win: dict[str, list] = {}
+
+        def acc_span(key: str, sp) -> None:
+            if sp is obs.NOP_SPAN:
+                # Tracing disabled (TPK_TRACE=0): omit the span_* keys
+                # entirely rather than emitting constant 0.0 — "not
+                # measured" must not read as "zero host time".
+                return
+            w = span_win.setdefault(key, [0, 0.0])
+            w[0] += 1
+            w[1] += sp.dur_s
 
         def win_reset():
             win["t0"] = time.perf_counter()
             win["wait"] = prefetch.data_wait_s
             win["h2d"] = prefetch.h2d_s
+            span_win.clear()
 
         def win_metrics() -> dict:
             wall = time.perf_counter() - win["t0"]
             dw = prefetch.data_wait_s - win["wait"]
-            return {
+            out = {
                 "data_wait_s": round(dw, 6),
                 "data_wait_frac": round(dw / wall, 4) if wall > 0 else 0.0,
                 "data_h2d_s": round(prefetch.h2d_s - win["h2d"], 6),
@@ -727,6 +802,9 @@ class Trainer:
                     resilience.metrics.get("tpk_data_wait_seconds_total",
                                            component="train"), 6),
             }
+            for key, (_, total) in sorted(span_win.items()):
+                out[f"span_{key}_ms"] = round(total * 1e3, 3)
+            return out
 
         try:
             timer.start()
@@ -741,10 +819,18 @@ class Trainer:
                                            "signal": fault_signal})
                     os.kill(os.getpid(), fault_signal)
                 if prof_start is not None and step == prof_start:
-                    jax.profiler.start_trace(prof["dir"])
+                    jax.profiler.start_trace(prof_dir)
                     prof_active = True
-                batch = next(prefetch)
-                state, metrics = step_fn(state, batch)
+                # The step span measures HOST dispatch wall (data wait +
+                # enqueue) — the device executes asynchronously, and the
+                # span never touches a device value, so tracing adds
+                # zero host syncs to the hot loop (the span-overhead
+                # guard test pins this).
+                with obs.span("train.step", trace_id=self._trace,
+                              step=step) as sp:
+                    batch = next(prefetch)
+                    state, metrics = step_fn(state, batch)
+                acc_span("step", sp)
                 window += 1
                 if prof_active and step + 1 == prof_stop:
                     jax.block_until_ready(metrics["loss"])
@@ -755,29 +841,53 @@ class Trainer:
                     # — consumed_state() may walk the grain pipeline
                     # (depth 0) and doesn't belong in the non-blocking
                     # hot loop.
-                    self._ckpt.maybe_save(
-                        step + 1, state,
-                        data_state=(pack_data_state()
-                                    if self._ckpt.should_save(step + 1)
-                                    else None))
+                    if self._ckpt.should_save(step + 1):
+                        with obs.span("train.checkpoint_save",
+                                      trace_id=self._trace,
+                                      step=step + 1) as sp:
+                            self._ckpt.maybe_save(
+                                step + 1, state,
+                                data_state=pack_data_state())
+                        acc_span("ckpt", sp)
+                        if ckpt_event_pending is not None:
+                            self._post_event(
+                                "CheckpointSaved",
+                                f"step {ckpt_event_pending}")
+                        ckpt_event_pending = step + 1
+                    else:
+                        self._ckpt.maybe_save(step + 1, state)
                 if (eval_step is not None
                         and (step + 1) % spec.eval_every == 0):
                     # Close the timing window first so eval wall time
                     # never pollutes the train tokens/sec / MFU averages.
+                    sp_fetch = None
                     if window:
-                        jax.block_until_ready(metrics["loss"])
+                        with obs.span("train.fetch",
+                                      trace_id=self._trace) as sp_fetch:
+                            jax.block_until_ready(metrics["loss"])
                         timer.stop(n_steps=window)
                         window = 0
-                    last_eval = run_eval(state.params, step + 1)
+                    with obs.span("train.eval", trace_id=self._trace,
+                                  step=step + 1) as sp:
+                        last_eval = run_eval(state.params, step + 1)
                     timer.start()
                     win_reset()
+                    # Recorded AFTER the reset so the boundary costs
+                    # show on the next window's line instead of
+                    # vanishing with the window they closed.
+                    if sp_fetch is not None:
+                        acc_span("fetch", sp_fetch)
+                    acc_span("eval", sp)
                 if ((step + 1) % spec.log_every == 0
                         or step + 1 == spec.steps):
                     # Block only at logging boundaries — keeping the
                     # dispatch queue full between them lets host data prep
                     # overlap device compute (per-step numbers are window
                     # averages).
-                    jax.block_until_ready(metrics["loss"])
+                    with obs.span("train.fetch",
+                                  trace_id=self._trace) as sp:
+                        jax.block_until_ready(metrics["loss"])
+                    acc_span("fetch", sp)
                     if window:
                         perf = timer.stop(n_steps=window)
                         window = 0
@@ -801,10 +911,21 @@ class Trainer:
 
             if self._ckpt is not None:
                 if self._ckpt.latest_step() != spec.steps:
-                    self._ckpt.maybe_save(spec.steps, state,
-                                          data_state=pack_data_state(),
-                                          force=True)
+                    with obs.span("train.checkpoint_save",
+                                  trace_id=self._trace, step=spec.steps):
+                        self._ckpt.maybe_save(spec.steps, state,
+                                              data_state=pack_data_state(),
+                                              force=True)
                 self._ckpt.wait()
+                # Everything is durable now: flush the deferred interior
+                # event (it never met its "next boundary"), then the
+                # final step's (the two merge into one aggregated row).
+                if (ckpt_event_pending is not None
+                        and ckpt_event_pending != self._ckpt.latest_step()):
+                    self._post_event("CheckpointSaved",
+                                     f"step {ckpt_event_pending}")
+                self._post_event("CheckpointSaved",
+                                 f"step {self._ckpt.latest_step()}")
             self.logger.log(spec.steps,
                             {"event": "done", **last_metrics, **last_eval})
             return {"final_step": spec.steps, **last_metrics, **last_eval}

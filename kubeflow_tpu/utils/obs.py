@@ -1,0 +1,236 @@
+"""Request-scoped tracing: spans, trace ids, a bounded ring, Chrome export.
+
+The reference platform has NO unified tracing (SURVEY.md §5.1: per-
+controller Prometheus counters only) — a slow request tells you *that*
+it was slow, never *where the time went*. This module is the one tracing
+surface every layer shares:
+
+  * **Trace identity.** One request id threads through the whole stack:
+    the model server assigns/honors `X-Request-Id`, the control-plane
+    client attaches its id to every RPC, the trainer uses its job name.
+    Spans carry the id, so a single request's admit → batch-gather →
+    prefill → decode → fetch timeline can be filtered out of process
+    noise.
+  * **Spans.** Host-side wall intervals with a name, a trace id, and
+    small attrs. Two recording styles: `span(...)` as a context manager
+    around synchronous work, and `Tracer.record(...)` for intervals
+    measured externally (the serving engine times dispatch→fetch itself
+    — the device executes asynchronously, so a `with` block around the
+    dispatch would lie).
+  * **Bounded ring, zero hot-path cost.** Finished spans land in a
+    process-local ring (`deque(maxlen=capacity)`) — old spans fall off,
+    memory never grows with run length. Spans never touch device
+    arrays: recording is perf_counter arithmetic + one append, so the
+    train/decode hot loops keep their zero-host-sync guarantees with
+    tracing at default settings (the span-overhead guard test pins
+    this). `TPK_TRACE=0` (or `tracer.enabled = False`) turns recording
+    into a shared no-op object — nothing is allocated at all.
+  * **Chrome trace export.** `chrome_trace()` renders the ring as
+    Chrome trace-event JSON (`ph: "X"` complete events), loadable in
+    chrome://tracing / Perfetto: `GET /debug/trace` on the model
+    server, `tpukit trace` for the control plane — no mesh, no sidecar,
+    no collector.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+import time
+import uuid
+from collections import deque
+
+#: ts values are microseconds since this process-local epoch (Chrome
+#: trace wants a monotonic µs timeline, not wall time).
+_EPOCH = time.perf_counter()
+
+_TRACE_ID_RE = re.compile(r"[^A-Za-z0-9._:-]")
+_MAX_TRACE_ID = 128
+
+
+def new_trace_id() -> str:
+    """A fresh request/trace id (uuid4 hex — no coordination needed)."""
+    return uuid.uuid4().hex
+
+
+def sanitize_trace_id(raw: str | None) -> str:
+    """A caller-supplied id, made safe for logs/exposition: restricted
+    charset, bounded length; empty/None gets a fresh id."""
+    if not raw:
+        return new_trace_id()
+    return _TRACE_ID_RE.sub("_", str(raw))[:_MAX_TRACE_ID] or new_trace_id()
+
+
+class Span:
+    """A finished (or in-flight, inside `with`) host-side interval."""
+
+    __slots__ = ("name", "trace_id", "attrs", "ts_us", "dur_us", "tid",
+                 "_tracer", "_t0")
+
+    def __init__(self, tracer: "Tracer", name: str, trace_id: str,
+                 attrs: dict | None):
+        self._tracer = tracer
+        self.name = name
+        self.trace_id = trace_id
+        self.attrs = attrs
+        self.ts_us = 0.0
+        self.dur_us = 0.0
+        self.tid = ""
+        self._t0 = 0.0
+
+    @property
+    def dur_s(self) -> float:
+        return self.dur_us / 1e6
+
+    def set(self, **attrs) -> "Span":
+        """Attach/overwrite attrs (mid-span annotations)."""
+        if self.attrs is None:
+            self.attrs = attrs
+        else:
+            self.attrs.update(attrs)
+        return self
+
+    def __enter__(self) -> "Span":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        t1 = time.perf_counter()
+        self.ts_us = (self._t0 - _EPOCH) * 1e6
+        self.dur_us = (t1 - self._t0) * 1e6
+        self.tid = threading.current_thread().name
+        self._tracer._append(self)
+
+
+class _NopSpan:
+    """Shared do-nothing span — what `span()` hands out when tracing is
+    disabled. One instance for the whole process: zero allocation on the
+    disabled path."""
+
+    __slots__ = ()
+    name = ""
+    trace_id = ""
+    attrs: dict | None = None
+    ts_us = dur_us = 0.0
+    dur_s = 0.0
+    tid = ""
+
+    def set(self, **attrs) -> "_NopSpan":
+        return self
+
+    def __enter__(self) -> "_NopSpan":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+
+NOP_SPAN = _NopSpan()
+
+
+class Tracer:
+    """Process-local span recorder with a bounded ring buffer."""
+
+    def __init__(self, capacity: int = 4096, enabled: bool | None = None):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        if enabled is None:
+            enabled = os.environ.get("TPK_TRACE", "1") != "0"
+        self.enabled = bool(enabled)
+        self._ring: deque[Span] = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+
+    # -- recording ----------------------------------------------------------
+
+    def span(self, name: str, trace_id: str = "", **attrs):
+        """Context manager measuring the enclosed block. Returns the
+        Span (its `dur_s` is valid after exit) — or the shared no-op
+        when tracing is disabled."""
+        if not self.enabled:
+            return NOP_SPAN
+        return Span(self, name, trace_id, attrs or None)
+
+    def record(self, name: str, t0: float, t1: float, trace_id: str = "",
+               **attrs) -> None:
+        """Record an externally measured interval (`t0`/`t1` are
+        time.perf_counter() readings)."""
+        if not self.enabled:
+            return
+        sp = Span(self, name, trace_id, attrs or None)
+        sp.ts_us = (t0 - _EPOCH) * 1e6
+        sp.dur_us = max(t1 - t0, 0.0) * 1e6
+        sp.tid = threading.current_thread().name
+        self._append(sp)
+
+    def _append(self, sp: Span) -> None:
+        with self._lock:
+            self._ring.append(sp)
+
+    # -- export -------------------------------------------------------------
+
+    def events(self, trace_id: str | None = None) -> list[dict]:
+        """Spans as plain dicts, oldest first; optionally filtered to one
+        trace id."""
+        with self._lock:
+            spans = list(self._ring)
+        out = []
+        for sp in spans:
+            if trace_id is not None and sp.trace_id != trace_id:
+                continue
+            out.append({
+                "name": sp.name, "trace_id": sp.trace_id,
+                "ts_us": sp.ts_us, "dur_us": sp.dur_us, "tid": sp.tid,
+                "attrs": dict(sp.attrs) if sp.attrs else {},
+            })
+        return out
+
+    def chrome_trace(self, trace_id: str | None = None) -> dict:
+        """The ring as a Chrome trace-event document (chrome://tracing /
+        Perfetto's legacy JSON format): `ph: "X"` complete events, ts/dur
+        in microseconds, the trace id and attrs under `args`."""
+        pid = os.getpid()
+        events = []
+        for ev in self.events(trace_id):
+            events.append({
+                "name": ev["name"], "cat": "tpk", "ph": "X",
+                "ts": round(ev["ts_us"], 3), "dur": round(ev["dur_us"], 3),
+                "pid": pid, "tid": ev["tid"] or "main",
+                "args": {"trace_id": ev["trace_id"], **ev["attrs"]},
+            })
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+
+#: The process-global tracer. Read through `get_tracer()` / the module
+#: helpers so tests can swap in a bounded/disabled instance.
+_TRACER = Tracer()
+
+
+def get_tracer() -> Tracer:
+    return _TRACER
+
+
+def set_tracer(tracer: Tracer) -> Tracer:
+    """Swap the process-global tracer (tests); returns the previous one."""
+    global _TRACER
+    prev, _TRACER = _TRACER, tracer
+    return prev
+
+
+def span(name: str, trace_id: str = "", **attrs):
+    """Module-level convenience: a span on the process-global tracer."""
+    return _TRACER.span(name, trace_id, **attrs)
+
+
+def record(name: str, t0: float, t1: float, trace_id: str = "",
+           **attrs) -> None:
+    _TRACER.record(name, t0, t1, trace_id, **attrs)

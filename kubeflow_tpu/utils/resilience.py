@@ -186,13 +186,47 @@ def retry_call(fn: Callable[[], Any], *,
             sleep(delay)
 
 
+def _escape_label(value: str) -> str:
+    """Prometheus exposition-format label-value escaping: backslash,
+    double-quote, and newline must be escaped or the line is invalid
+    (and a crafted value could inject whole fake series). Backslash
+    first — escaping it last would re-mangle the other escapes."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _label_str(lbl: tuple) -> str:
+    """Render a sorted (key, value) label tuple as `k1="v1",k2="v2"`
+    with spec-compliant value escaping (shared by snapshot and the
+    exposition renderer so the two can't drift)."""
+    return ",".join(f'{k}="{_escape_label(v)}"' for k, v in lbl)
+
+
+def _fmt_value(v: float):
+    return int(v) if float(v).is_integer() else v
+
+
+#: Default histogram buckets, in seconds — latency-shaped (sub-ms to
+#: 10 s), cumulative `le` rendering adds +Inf. Callers measuring
+#: something else pass explicit buckets on first observe().
+DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                   0.5, 1.0, 2.5, 5.0, 10.0)
+
+
 class Counters:
-    """Process-global labeled counters with prometheus rendering — the
-    uniform metrics surface every resilience consumer increments."""
+    """Process-global labeled counters/gauges/histograms with prometheus
+    rendering — the uniform metrics surface every resilience consumer
+    increments."""
 
     def __init__(self):
         self._counts: dict[tuple, float] = {}
         self._gauges: dict[tuple, float] = {}
+        # Histograms: family name -> bucket upper bounds (fixed at first
+        # observe — every label set of a family shares one bucket
+        # layout, as prometheus requires); (name, labels) -> [per-bucket
+        # counts (NON-cumulative; +Inf implicit), sum, count].
+        self._hist_buckets: dict[str, tuple] = {}
+        self._hists: dict[tuple, list] = {}
         self._lock = threading.Lock()
 
     def inc(self, name: str, value: float = 1.0, **labels: str) -> None:
@@ -217,14 +251,65 @@ class Counters:
         with self._lock:
             return self._gauges.get(key, 0.0)
 
+    def observe(self, name: str, value: float,
+                buckets: tuple | None = None, **labels: str) -> None:
+        """Record one histogram observation. The family's bucket layout
+        is fixed by the FIRST observe (explicit `buckets` or
+        DEFAULT_BUCKETS); later calls reuse it — prometheus histograms
+        cannot change buckets mid-flight."""
+        value = float(value)
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            bkts = self._hist_buckets.get(name)
+            if bkts is None:
+                bkts = tuple(sorted(float(b) for b in
+                                    (buckets or DEFAULT_BUCKETS)))
+                self._hist_buckets[name] = bkts
+            h = self._hists.get(key)
+            if h is None:
+                h = self._hists[key] = [[0] * len(bkts), 0.0, 0]
+            counts, _, _ = h
+            for i, le in enumerate(bkts):
+                if value <= le:
+                    counts[i] += 1
+                    break
+            # else: only the implicit +Inf bucket (== count) holds it.
+            h[1] += value
+            h[2] += 1
+
+    def get_histogram(self, name: str, **labels: str) -> dict:
+        """{"buckets": {le: CUMULATIVE count}, "sum", "count"} — the
+        test/introspection view of one labeled series ("+Inf" included)."""
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            bkts = self._hist_buckets.get(name, ())
+            h = self._hists.get(key)
+            if h is None:
+                return {"buckets": {}, "sum": 0.0, "count": 0}
+            counts, total, n = list(h[0]), h[1], h[2]
+        cum, out = 0, {}
+        for le, c in zip(bkts, counts):
+            cum += c
+            out[le] = cum
+        out["+Inf"] = n
+        return {"buckets": out, "sum": total, "count": n}
+
     def snapshot(self) -> dict:
         with self._lock:
             items = sorted(self._counts.items()) + sorted(
                 self._gauges.items())
-            return {
-                name + ("{%s}" % ",".join(f'{k}="{v}"' for k, v in lbl)
-                        if lbl else ""): v
-                for (name, lbl), v in items}
+            # Copy sum/count under the lock: the stored lists are live
+            # and a concurrent observe() could tear the pair.
+            hists = [(key, (h[1], h[2]))
+                     for key, h in sorted(self._hists.items())]
+        out = {
+            name + ("{%s}" % _label_str(lbl) if lbl else ""): v
+            for (name, lbl), v in items}
+        for (name, lbl), (total, n) in hists:
+            tag = "{%s}" % _label_str(lbl) if lbl else ""
+            out[f"{name}_sum{tag}"] = total
+            out[f"{name}_count{tag}"] = n
+        return out
 
     def prometheus_text(self) -> str:
         lines = []
@@ -234,14 +319,30 @@ class Counters:
                       for (n, lbl), v in sorted(self._counts.items())]
                      + [(n, lbl, v, "gauge")
                         for (n, lbl), v in sorted(self._gauges.items())])
+            hists = [(n, lbl, list(h[0]), h[1], h[2])
+                     for (n, lbl), h in sorted(self._hists.items())]
+            hist_buckets = dict(self._hist_buckets)
         for name, lbl, v, kind in items:
             if name not in seen:
                 seen.add(name)
                 lines.append(f"# TYPE {name} {kind}")
-            tag = ("{%s}" % ",".join(f'{k}="{v2}"' for k, v2 in lbl)
-                   if lbl else "")
-            val = int(v) if float(v).is_integer() else v
-            lines.append(f"{name}{tag} {val}")
+            tag = "{%s}" % _label_str(lbl) if lbl else ""
+            lines.append(f"{name}{tag} {_fmt_value(v)}")
+        for name, lbl, counts, total, n in hists:
+            if name not in seen:
+                seen.add(name)
+                lines.append(f"# TYPE {name} histogram")
+            base = _label_str(lbl)
+            cum = 0
+            for le, c in zip(hist_buckets.get(name, ()), counts):
+                cum += c
+                tag = (base + "," if base else "") + f'le="{le:g}"'
+                lines.append(f"{name}_bucket{{{tag}}} {cum}")
+            tag = (base + "," if base else "") + 'le="+Inf"'
+            lines.append(f"{name}_bucket{{{tag}}} {n}")
+            suffix = "{%s}" % base if base else ""
+            lines.append(f"{name}_sum{suffix} {_fmt_value(total)}")
+            lines.append(f"{name}_count{suffix} {n}")
         return "\n".join(lines) + ("\n" if lines else "")
 
     def reset(self) -> None:
@@ -249,6 +350,8 @@ class Counters:
         with self._lock:
             self._counts.clear()
             self._gauges.clear()
+            self._hists.clear()
+            self._hist_buckets.clear()
 
 
 metrics = Counters()

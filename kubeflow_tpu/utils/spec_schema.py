@@ -73,6 +73,8 @@ KNOBS: dict[str, dict] = {
     "prefetch": {"type": "int", "min": 0},
     "metrics_path": {"type": "string_or_null"},
     "profile": {"type": "object"},
+    "profile_start_step": {"type": "int", "min": 0},
+    "profile_stop_step": {"type": "int", "min": 0},
     "log_every": {"type": "int", "min": 1},
     "eval_dataset": {"type": "string_or_null"},
     "eval_dataset_kwargs": {"type": "object"},

@@ -48,6 +48,21 @@ def devices8():
 
 
 @pytest.fixture(autouse=True)
+def _fresh_metrics_registry():
+    """The resilience `metrics` registry and the obs tracer ring are
+    process-global: without a reset, counts/spans bleed across tests and
+    any assertion on exact values becomes order-dependent (passes alone,
+    fails in the suite — or worse, the reverse). Every test starts from
+    a clean registry; accumulation within one test is untouched."""
+    from kubeflow_tpu.utils import obs
+    from kubeflow_tpu.utils.resilience import metrics
+
+    metrics.reset()
+    obs.get_tracer().clear()
+    yield
+
+
+@pytest.fixture(autouse=True)
 def _no_leaked_prefetch_threads():
     """Every trainer exit path (normal, raising step, restart/backoff
     loop, injected fault) must close its input prefetcher — a worker

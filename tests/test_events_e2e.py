@@ -1,0 +1,186 @@
+"""Controlplane e2e for the per-job event log + trace verb (ISSUE 5).
+
+Runs the REAL tpk-controlplane binary with command-based jobs (seconds-
+fast, no jax workers): `tpukit events <job>` must show an ordered
+Submitted → … → Succeeded history; the history must survive a server
+restart on the same WAL (events live in status, which replays); failure
+paths append WorkerFailed/Restarted(n)/Failed(reason); workers post
+CheckpointSaved through the `event` verb; `tpukit trace` exports the
+dispatch spans as Chrome trace JSON carrying the client's trace id.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BIN = os.path.join(REPO, "build", "tpk-controlplane")
+
+pytestmark = [
+    pytest.mark.slow,  # real-binary e2e tier
+    pytest.mark.skipif(not os.path.exists(BIN),
+                       reason="tpk-controlplane not built"),
+]
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    from kubeflow_tpu.controlplane.client import Client, start_controlplane
+
+    os.environ.setdefault("TPK_CONTROLPLANE_BIN", BIN)
+    state = {
+        "sock": str(tmp_path / "cp.sock"),
+        "work": str(tmp_path / "work"),
+        "wal": str(tmp_path / "wal.jsonl"),
+        "proc": None,
+    }
+
+    def start() -> Client:
+        state["proc"] = start_controlplane(state["sock"], state["work"],
+                                           wal=state["wal"])
+        return Client(state["sock"], timeout=15)
+
+    def restart() -> Client:
+        stop()
+        return start()
+
+    def stop():
+        p = state["proc"]
+        if p is not None and p.poll() is None:
+            p.terminate()
+            p.wait(timeout=10)
+
+    state["start"], state["restart"], state["stop"] = start, restart, stop
+    yield state
+    stop()
+
+
+def _cmd_spec(cmd: str, policy: str = "Never", backoff: int = 3) -> dict:
+    return {"replicas": 1, "devices_per_proc": 1,
+            "restart_policy": policy, "backoff_limit": backoff,
+            "command": ["/bin/sh", "-c", cmd]}
+
+
+def _reasons(events: list[dict]) -> list[str]:
+    return [e["reason"] for e in events]
+
+
+def test_events_ordered_history_survives_restart(cluster, capsys):
+    """THE controlplane acceptance: ordered Submitted→…→Succeeded via
+    `tpukit events`, intact after a server restart (WAL replay)."""
+    from kubeflow_tpu import cli
+
+    client = cluster["start"]()
+    client.submit_jaxjob("ev-ok", _cmd_spec("sleep 0.3"))
+    assert client.wait_for_phase("ev-ok", timeout=60) == "Succeeded"
+
+    ev = client.events("ev-ok")
+    reasons = _reasons(ev["events"])
+    # Ordered lifecycle: submission before scheduling before launch
+    # before completion — and timestamps nondecreasing.
+    for a, b in (("Submitted", "Scheduled"), ("Scheduled", "Launched"),
+                 ("Launched", "Succeeded")):
+        assert reasons.index(a) < reasons.index(b), reasons
+    unix = [e["unix"] for e in ev["events"]]
+    assert unix == sorted(unix)
+    assert ev["conditions"], "conditions ride along with events"
+
+    # Worker-posted event lands in the same history.
+    client.post_event("ev-ok", "CheckpointSaved", "step 42")
+
+    # Restart on the same WAL: the history replays byte-for-byte.
+    client.close()
+    client = cluster["restart"]()
+    ev2 = client.events("ev-ok")
+    assert _reasons(ev2["events"])[:len(reasons)] == reasons
+    assert "CheckpointSaved" in _reasons(ev2["events"])
+
+    # The CLI table renders the same story.
+    rc = cli.main(["--socket", cluster["sock"], "events", "ev-ok"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    for reason in ("Submitted", "Scheduled", "Launched", "Succeeded",
+                   "CheckpointSaved"):
+        assert reason in out, out
+    client.close()
+
+
+def test_events_failure_and_restart_path(cluster):
+    client = cluster["start"]()
+    client.submit_jaxjob("ev-fail",
+                         _cmd_spec("exit 7", policy="OnFailure",
+                                   backoff=1))
+    assert client.wait_for_phase("ev-fail", timeout=60) == "Failed"
+    ev = client.events("ev-fail")
+    reasons = _reasons(ev["events"])
+    # One combined event per restart cycle: exit code + restart count.
+    (restarted,) = [e for e in ev["events"] if e["reason"] == "Restarted"]
+    assert "worker exited 7" in restarted["message"]
+    assert "restart 1/1" in restarted["message"]
+    assert reasons[-1] == "Failed"
+    failed = ev["events"][-1]
+    assert failed["type"] == "Warning"
+    assert "BackoffLimitExceeded" in failed["message"]
+    # Dedup semantics through the event verb: an exact repeat of the
+    # last (type, reason, message) is a no-op; a new message under the
+    # same reason MERGES (count bump) instead of scrolling history.
+    client.post_event("ev-fail", "CheckpointSaved", "step 10")
+    client.post_event("ev-fail", "CheckpointSaved", "step 10")  # no-op
+    client.post_event("ev-fail", "CheckpointSaved", "step 20")  # merge
+    saves = [e for e in client.events("ev-fail")["events"]
+             if e["reason"] == "CheckpointSaved"]
+    assert len(saves) == 1, saves
+    assert saves[0]["count"] == 2 and saves[0]["message"] == "step 20"
+    client.close()
+
+
+def test_trainer_posts_checkpoint_events(cluster):
+    """A command job emulating the trainer's event channel: TPK_SOCKET +
+    TPK_JOB_NAME are injected by the controller, and posting through
+    them lands CheckpointSaved in the job's own history."""
+    client = cluster["start"]()
+    post = ("import os; "
+            "from kubeflow_tpu.controlplane.client import Client; "
+            "c = Client(os.environ['TPK_SOCKET'], timeout=5); "
+            "c.post_event(os.environ['TPK_JOB_NAME'], "
+            "'CheckpointSaved', 'step 7')")
+    import sys
+
+    spec = {"replicas": 1, "devices_per_proc": 1,
+            "restart_policy": "Never",
+            "command": [sys.executable, "-c", post]}
+    client.submit_jaxjob("ev-post", spec)
+    assert client.wait_for_phase("ev-post", timeout=60) == "Succeeded"
+    reasons = _reasons(client.events("ev-post")["events"])
+    assert "CheckpointSaved" in reasons, reasons
+    assert reasons.index("Launched") < reasons.index("CheckpointSaved")
+    client.close()
+
+
+def test_trace_verb_exports_chrome_json(cluster, capsys):
+    from kubeflow_tpu import cli
+    from kubeflow_tpu.controlplane.client import Client
+
+    cluster["start"]()
+    client = Client(cluster["sock"], timeout=15, trace_id="e2e-trace-42")
+    client.submit_jaxjob("tr-ok", _cmd_spec("true"))
+    client.wait_for_phase("tr-ok", timeout=60)
+    doc = client.trace()
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert "controlplane.create" in names
+    assert "controlplane.get" in names
+    mine = [e for e in doc["traceEvents"]
+            if e["args"]["trace_id"] == "e2e-trace-42"]
+    assert mine, "client trace id must reach the server's span ring"
+    for ev in doc["traceEvents"]:
+        assert ev["ph"] == "X" and ev["dur"] >= 0
+    client.close()
+
+    rc = cli.main(["--socket", cluster["sock"], "trace"])
+    assert rc == 0
+    printed = json.loads(capsys.readouterr().out)
+    assert {e["name"] for e in printed["traceEvents"]} >= {
+        "controlplane.create"}

@@ -1,0 +1,243 @@
+"""Observability layer unit tests: exposition-format conformance for the
+Counters registry (escaping, TYPE lines, histogram buckets), the span
+tracer (ring bound, Chrome export, disabled path), and the
+metric-naming/README drift guard (tools/check_metrics.py) as a tier-1
+gate."""
+
+from __future__ import annotations
+
+import json
+import re
+
+import pytest
+
+from kubeflow_tpu.utils import obs
+from kubeflow_tpu.utils.resilience import Counters
+
+
+# -- exposition-format conformance ------------------------------------------
+
+
+def parse_exposition(text: str) -> tuple[dict, dict]:
+    """Tiny conforming parser: returns (types {family: kind},
+    samples {(name, frozen labels): value}). Label values are unescaped
+    per the spec, so escaping round-trips are provable."""
+    types: dict[str, str] = {}
+    samples: dict[tuple, float] = {}
+    sample_re = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
+                           r"(?:\{(.*)\})? (\S+)$")
+    label_re = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+    def unescape(v: str) -> str:
+        out, i = [], 0
+        while i < len(v):
+            if v[i] == "\\" and i + 1 < len(v):
+                nxt = v[i + 1]
+                out.append({"n": "\n", "\\": "\\", '"': '"'}.get(nxt))
+                assert out[-1] is not None, f"bad escape \\{nxt}"
+                i += 2
+            else:
+                out.append(v[i])
+                i += 1
+        return "".join(out)
+
+    for line in text.splitlines():
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ")
+            assert name not in types, f"TYPE for {name} emitted twice"
+            types[name] = kind
+            continue
+        if not line or line.startswith("#"):
+            continue
+        m = sample_re.match(line)
+        assert m, f"unparseable exposition line: {line!r}"
+        labels = tuple(sorted(
+            (k, unescape(v)) for k, v in label_re.findall(m.group(2) or "")))
+        key = (m.group(1), labels)
+        assert key not in samples, f"duplicate sample {key}"
+        samples[key] = float(m.group(3))
+    return types, samples
+
+
+def test_label_escaping_round_trips():
+    c = Counters()
+    nasty = 'quo"te\\back\nline'
+    c.inc("tpk_esc_total", 2, model=nasty)
+    c.set_gauge("tpk_esc_depth", 3, model=nasty)
+    text = c.prometheus_text()
+    # The raw control characters must not appear unescaped: a newline in
+    # a label value would split the line into a fake second sample.
+    for line in text.splitlines():
+        assert "\n" not in line  # tautological post-split; format check:
+    assert '\\"' in text and "\\\\" in text and "\\n" in text
+    types, samples = parse_exposition(text)
+    assert samples[("tpk_esc_total", (("model", nasty),))] == 2
+    assert samples[("tpk_esc_depth", (("model", nasty),))] == 3
+
+
+def test_snapshot_uses_same_escaping():
+    c = Counters()
+    c.inc("tpk_snap_total", model='a"b')
+    (key,) = c.snapshot().keys()
+    assert key == 'tpk_snap_total{model="a\\"b"}'
+
+
+def test_type_line_once_per_family_across_label_sets():
+    c = Counters()
+    c.inc("tpk_multi_total", component="a")
+    c.inc("tpk_multi_total", component="b")
+    c.observe("tpk_lat_seconds", 0.1, verb="get")
+    c.observe("tpk_lat_seconds", 0.2, verb="list")
+    text = c.prometheus_text()
+    assert text.count("# TYPE tpk_multi_total counter") == 1
+    assert text.count("# TYPE tpk_lat_seconds histogram") == 1
+    # parse_exposition also asserts no duplicate TYPE lines anywhere.
+    parse_exposition(text)
+
+
+def test_histogram_buckets_cumulative_le_ordered_inf():
+    c = Counters()
+    obs_values = [0.0005, 0.003, 0.003, 0.07, 99.0]
+    for v in obs_values:
+        c.observe("tpk_h_seconds", v, verb="get")
+    text = c.prometheus_text()
+    types, samples = parse_exposition(text)
+    assert types["tpk_h_seconds"] == "histogram"
+    buckets = []
+    for (name, labels), val in samples.items():
+        if name == "tpk_h_seconds_bucket":
+            lbl = dict(labels)
+            assert lbl["verb"] == "get"
+            buckets.append((lbl["le"], val))
+    # le-ordered as rendered, +Inf last.
+    les = [le for le, _ in buckets]
+    assert les[-1] == "+Inf"
+    numeric = [float(le) for le in les[:-1]]
+    assert numeric == sorted(numeric)
+    # Cumulative and consistent: counts never decrease, +Inf == count.
+    counts = [v for _, v in buckets]
+    assert counts == sorted(counts)
+    n = samples[("tpk_h_seconds_count", (("verb", "get"),))]
+    s = samples[("tpk_h_seconds_sum", (("verb", "get"),))]
+    assert counts[-1] == n == len(obs_values)
+    assert s == pytest.approx(sum(obs_values))
+    # Spot-check cumulative math against the observations.
+    by_le = dict(buckets)
+    assert by_le["0.001"] == 1          # 0.0005
+    assert by_le["0.005"] == 3          # + two 0.003s
+    assert by_le["0.1"] == 4            # + 0.07
+    assert by_le["10"] == 4             # 99.0 only in +Inf
+
+
+def test_histogram_sum_count_and_accessor():
+    c = Counters()
+    c.observe("tpk_x_seconds", 0.5, buckets=(0.1, 1.0))
+    c.observe("tpk_x_seconds", 5.0)
+    h = c.get_histogram("tpk_x_seconds")
+    assert h["count"] == 2 and h["sum"] == pytest.approx(5.5)
+    assert h["buckets"][0.1] == 0
+    assert h["buckets"][1.0] == 1
+    assert h["buckets"]["+Inf"] == 2
+    # snapshot carries the _sum/_count view.
+    snap = c.snapshot()
+    assert snap["tpk_x_seconds_count"] == 2
+    assert snap["tpk_x_seconds_sum"] == pytest.approx(5.5)
+
+
+def test_reset_clears_histograms():
+    c = Counters()
+    c.observe("tpk_r_seconds", 1.0)
+    c.reset()
+    assert c.get_histogram("tpk_r_seconds")["count"] == 0
+    assert c.prometheus_text() == ""
+
+
+# -- tracer ------------------------------------------------------------------
+
+
+def test_tracer_ring_is_bounded():
+    t = obs.Tracer(capacity=16, enabled=True)
+    for i in range(200):
+        with t.span("x", trace_id="t", i=i):
+            pass
+    assert len(t) == 16
+    # Oldest fell off: the survivors are the last 16.
+    assert [e["attrs"]["i"] for e in t.events()] == list(range(184, 200))
+
+
+def test_tracer_chrome_trace_valid_and_filterable():
+    t = obs.Tracer(capacity=32, enabled=True)
+    with t.span("serve.admit", trace_id="req-1", admitted=True):
+        pass
+    t.record("serve.fetch", 1.0, 1.5, "req-2", slot=0)
+    doc = json.loads(json.dumps(t.chrome_trace()))
+    assert set(doc) == {"traceEvents", "displayTimeUnit"}
+    for ev in doc["traceEvents"]:
+        assert ev["ph"] == "X"
+        assert isinstance(ev["ts"], (int, float))
+        assert ev["dur"] >= 0
+        assert "trace_id" in ev["args"]
+    only = t.chrome_trace("req-2")["traceEvents"]
+    assert len(only) == 1 and only[0]["name"] == "serve.fetch"
+    assert only[0]["args"] == {"trace_id": "req-2", "slot": 0}
+    assert only[0]["dur"] == pytest.approx(0.5e6)
+
+
+def test_disabled_tracer_allocates_nothing():
+    t = obs.Tracer(capacity=8, enabled=False)
+    spans = {id(t.span("a", trace_id="x")) for _ in range(50)}
+    assert spans == {id(obs.NOP_SPAN)}  # one shared no-op object
+    with t.span("a") as sp:
+        sp.set(k=1)
+    assert sp.dur_s == 0.0
+    t.record("b", 0.0, 1.0, "x")
+    assert len(t) == 0
+
+
+def test_trace_id_sanitization():
+    # Well-formed ids pass through untouched.
+    assert obs.sanitize_trace_id("ok-id_1.2:3") == "ok-id_1.2:3"
+    # Exposition/log-hostile characters are replaced, length is bounded.
+    s = obs.sanitize_trace_id('a"b\nc{d}')
+    assert re.fullmatch(r"[A-Za-z0-9._:-]+", s), s
+    assert len(obs.sanitize_trace_id("x" * 1000)) == 128
+    # Absent ids get fresh, distinct ones.
+    fresh = obs.sanitize_trace_id(None)
+    assert fresh and fresh != obs.sanitize_trace_id(None)
+
+
+def test_module_helpers_respect_swapped_tracer():
+    prev = obs.set_tracer(obs.Tracer(capacity=4, enabled=True))
+    try:
+        with obs.span("swapped", trace_id="z"):
+            pass
+        assert obs.get_tracer().events()[0]["name"] == "swapped"
+    finally:
+        obs.set_tracer(prev)
+
+
+# -- naming conventions + README drift (tools/check_metrics.py) -------------
+
+
+def test_metric_conventions_and_readme_in_sync():
+    """Tier-1 gate: every emitted tpk_* series obeys the naming rules
+    (counters _total, time histograms _seconds, tpk_ prefix) and the
+    README Observability table matches the code exactly, both ways."""
+    import importlib.util
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "check_metrics", os.path.join(root, "tools", "check_metrics.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    problems = mod.check()
+    assert not problems, "\n".join(problems)
+    series, _ = mod.scan_code()
+    # The guard must actually see the core series, or a regex rot would
+    # silently pass an empty scan.
+    for expect in ("tpk_retry_attempts_total",
+                   "tpk_serve_request_latency_seconds",
+                   "tpk_controlplane_rpc_latency_seconds",
+                   "tpk_engine_pipeline_depth"):
+        assert expect in series, expect

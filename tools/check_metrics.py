@@ -1,0 +1,151 @@
+#!/usr/bin/env python3
+"""Metric-series lint: naming conventions + README table drift guard.
+
+Run as a tier-1 test (tests/test_obs.py) and standalone:
+
+    python tools/check_metrics.py
+
+What it enforces, mechanically (SURVEY.md §5.1 — ONE metrics surface
+with uniform names, instead of per-controller ad-hoc series):
+
+  * Every `metrics.inc/observe/set_gauge` call site (resilience Counters
+    consumers) uses a literal `tpk_`-prefixed name — dynamic names would
+    be invisible to this guard and to the README.
+  * Counters end in `_total`; time histograms end in `_seconds`; gauges
+    end in neither suffix (prometheus naming conventions).
+  * The README "Observability" series table and the code agree EXACTLY:
+    every series emitted in code is documented, every documented series
+    exists in code — a new metric without a doc row (or a doc row whose
+    metric was renamed away) fails the suite, not a code review.
+
+Series are discovered from three shapes:
+  1. call sites:      metrics.inc("tpk_x_total", ...) / observe /
+                      set_gauge (incl. res_metrics.* / resilience.metrics.*)
+  2. TYPE literals:   "# TYPE tpk_x kind" inside hand-rendered exposition
+                      (serve/server.py prometheus_text)
+  3. table constants: ("stat_key", "tpk_x", "kind") rows (_ENGINE_METRICS)
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCAN_DIR = os.path.join(REPO, "kubeflow_tpu")
+README = os.path.join(REPO, "README.md")
+
+#: Histograms that measure something other than time (exempt from the
+#: `_seconds` suffix rule). None today — add deliberately.
+NON_TIME_HISTOGRAMS: set[str] = set()
+
+_CALL = re.compile(
+    r"metrics\.(inc|observe|set_gauge)\(\s*\n?\s*\"(tpk_\w+)\"")
+_BAD_CALL = re.compile(
+    r"metrics\.(inc|observe|set_gauge)\(\s*\n?\s*\"(?!tpk_)(\w+)\"")
+_TYPE_LINE = re.compile(r"# TYPE (tpk_\w+) (counter|gauge|histogram)")
+_TABLE_ROW = re.compile(r"\"(tpk_\w+)\",\s*\n?\s*\"(counter|gauge)\"")
+_README_ROW = re.compile(r"^\|\s*`(tpk_\w+)`\s*\|\s*(\w+)", re.M)
+
+_KIND_OF_CALL = {"inc": "counter", "observe": "histogram",
+                 "set_gauge": "gauge"}
+
+
+def scan_code() -> tuple[dict[str, str], list[str]]:
+    """All emitted series: name -> kind, plus rule violations."""
+    series: dict[str, str] = {}
+    problems: list[str] = []
+
+    def add(name: str, kind: str, where: str) -> None:
+        prev = series.get(name)
+        if prev and prev != kind:
+            problems.append(
+                f"{where}: series {name} declared as {kind} but "
+                f"elsewhere as {prev}")
+        series[name] = kind
+
+    for root, _, files in os.walk(SCAN_DIR):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(root, fn)
+            rel = os.path.relpath(path, REPO)
+            with open(path) as fh:
+                text = fh.read()
+            for m in _BAD_CALL.finditer(text):
+                problems.append(
+                    f"{rel}: metrics.{m.group(1)}({m.group(2)!r}) — "
+                    "series must carry the tpk_ prefix")
+            for m in _CALL.finditer(text):
+                add(m.group(2), _KIND_OF_CALL[m.group(1)], rel)
+            for m in _TYPE_LINE.finditer(text):
+                add(m.group(1), m.group(2), rel)
+            for m in _TABLE_ROW.finditer(text):
+                add(m.group(1), m.group(2), rel)
+
+    for name, kind in sorted(series.items()):
+        if kind == "counter" and not name.endswith("_total"):
+            problems.append(
+                f"counter {name} must end in _total (prometheus "
+                "counter convention)")
+        if kind == "gauge" and name.endswith("_total"):
+            problems.append(
+                f"gauge {name} must not end in _total (that suffix "
+                "marks counters)")
+        if (kind == "histogram" and name not in NON_TIME_HISTOGRAMS
+                and not name.endswith("_seconds")):
+            problems.append(
+                f"histogram {name} must end in _seconds (time unit "
+                "suffix) or be whitelisted in NON_TIME_HISTOGRAMS")
+    return series, problems
+
+
+def scan_readme() -> dict[str, str]:
+    """Documented series: name -> kind, from the README table rows
+    `| \\`tpk_x\\` | kind | ... |`."""
+    with open(README) as fh:
+        text = fh.read()
+    return {m.group(1): m.group(2).lower()
+            for m in _README_ROW.finditer(text)}
+
+
+def check() -> list[str]:
+    code, problems = scan_code()
+    documented = scan_readme()
+    if not documented:
+        problems.append(
+            "README.md has no series table (| `tpk_...` | kind | ...) — "
+            "the Observability section must document every series")
+        return problems
+    for name in sorted(set(code) - set(documented)):
+        problems.append(
+            f"series {name} ({code[name]}) is emitted in code but "
+            "missing from the README Observability table")
+    for name in sorted(set(documented) - set(code)):
+        problems.append(
+            f"series {name} is documented in README but no code emits "
+            "it — stale row or renamed metric")
+    for name in sorted(set(code) & set(documented)):
+        if code[name] != documented[name]:
+            problems.append(
+                f"series {name}: code says {code[name]}, README says "
+                f"{documented[name]}")
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    if problems:
+        for p in problems:
+            print(f"check_metrics: {p}", file=sys.stderr)
+        print(f"check_metrics: {len(problems)} problem(s)",
+              file=sys.stderr)
+        return 1
+    code, _ = scan_code()
+    print(f"check_metrics: OK — {len(code)} series, README in sync")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
