@@ -25,6 +25,17 @@ namespace tpk {
 // ONLY missing fields (the user's spec always wins, recursively for
 // objects). The merged spec is what gets stored — validation then runs on
 // the final object, so a bad default fails loudly at submit.
+//
+// Null semantics (ADVICE r5): an EXPLICITLY-present JSON `null` in the
+// user's spec is a user-wins OPT-OUT of that key's namespace default —
+// the key is STRIPPED before validation (the stored spec simply omits
+// it), never silently refilled with the default. `{"lora": null}` under
+// a namespace that defaults `lora` therefore means "no LoRA", exactly as
+// it would in a namespace without defaults. Nulls on keys the namespace
+// does not default are left untouched: top-level validators already
+// treat null as absent, and schema-typed runtime fields keep rejecting
+// null unless their type admits it — so opting out is scoped to the
+// defaulting machinery, not a general null-erasure pass.
 
 inline std::string SpecNamespace(const Json& spec) {
   // Mirror of jaxjob.cc NamespaceOf / controlplane.client namespace_of.
@@ -44,7 +55,11 @@ inline Json MergeNamespaceDefaults(const Json& spec, const Json& defaults,
       // the Profile consulted was chosen by the pre-merge namespace.
       continue;
     }
-    if (!out.has(k) || out.get(k).is_null()) {
+    if (out.has(k) && out.get(k).is_null()) {
+      // Explicit null opts OUT of this key's default (see the design
+      // note above): strip it so validation sees the key as absent.
+      out.erase(k);
+    } else if (!out.has(k)) {
       out[k] = dv;
     } else if (out.get(k).is_object() && dv.is_object()) {
       out[k] = MergeNamespaceDefaults(out.get(k), dv, /*top=*/false);
@@ -61,18 +76,32 @@ inline const Json& SpecSchemaRuntime() {
   return schema.get("JAXJob.runtime");
 }
 
-// Validates one runtime field value against its schema entry; "" = ok.
-inline std::string ValidateRuntimeField(const std::string& field,
-                                        const Json& v, const Json& entry) {
+// The serving twin: InferenceService `model.generative` knob table.
+inline const Json& SpecSchemaGenerative() {
+  static const Json schema = Json::parse(kSpecSchemaJson);
+  return schema.get("InferenceService.model.generative");
+}
+
+// A JSON number that is a representable integer: bounds first (casting
+// a double beyond int64 range is UB), then the truncation guard (2.5
+// must not pass as 2 while the worker receives 2.5 and fails later).
+inline bool IsIntegralNumber(const Json& v) {
+  if (!v.is_number()) return false;
+  const double num = v.as_number();
+  return num >= -9.2e18 && num <= 9.2e18 && num == std::floor(num);
+}
+
+// Validates one schema-typed field value against its table entry;
+// "" = ok. `scope` prefixes the field in error messages ("runtime." /
+// "model.generative.").
+inline std::string ValidateRuntimeField(
+    const std::string& field, const Json& v, const Json& entry,
+    const std::string& scope = "runtime.") {
   const std::string type = entry.get("type").as_string();
-  const std::string where = "runtime." + field;
+  const std::string where = scope + field;
   if (type == "int") {
     if (!v.is_number()) return where + " must be a number";
-    // Truncation guard: 2.5 would pass as 2 while the worker receives
-    // 2.5 and fails later. Bounds first — casting a double beyond int64
-    // range is UB.
-    const double num = v.as_number();
-    if (num < -9.2e18 || num > 9.2e18 || num != std::floor(num)) {
+    if (!IsIntegralNumber(v)) {
       return where + " must be an integer";
     }
     if (entry.has("min") && v.as_int() < entry.get("min").as_int()) {
@@ -110,6 +139,31 @@ inline std::string ValidateRuntimeField(const std::string& field,
   }
   if (type == "object") {
     if (!v.is_object()) return where + " must be an object";
+    return "";
+  }
+  if (type == "int_or_null") {
+    if (v.is_null()) return "";
+    if (!IsIntegralNumber(v)) {
+      return where + " must be an integer or null";
+    }
+    return "";
+  }
+  if (type == "int_array") {
+    // Non-empty by rule: an empty bucket list passes the type check but
+    // crashes the engine at model load (buckets[-1]) — the crash-loop
+    // this table exists to catch at submit.
+    if (!v.is_array() || v.size() == 0) {
+      return where + " must be a non-empty array of integers";
+    }
+    for (const auto& e : v.elements()) {
+      if (!IsIntegralNumber(e)) {
+        return where + " must contain only integers";
+      }
+      if (entry.has("min") && e.as_int() < entry.get("min").as_int()) {
+        return where + " elements must be >= " +
+               std::to_string(entry.get("min").as_int());
+      }
+    }
     return "";
   }
   return where + ": unknown schema type " + type;  // schema bug — loud
@@ -411,6 +465,32 @@ inline std::string ValidateSpec(const std::string& kind, const Json& spec) {
       int64_t pct = canary.get("traffic_percent").as_int(10);
       if (pct < 0 || pct > 100) {
         return "canary.traffic_percent must be in [0, 100]";
+      }
+    }
+    // Generative serving knobs (model.generative — GenerationEngine /
+    // text2text config): schema-driven like runtime, from the SAME
+    // generated table (spec_schema.gen.h "InferenceService.model.
+    // generative"), so a typo'd serving knob — or kv_block_size/
+    // kv_blocks against a binary that predates the paged KV cache —
+    // fails at submit, not as a replica crash-loop. Known limit: the
+    // table is the UNION of the causal-LM and text2text runtimes
+    // (which runtime applies is decided by the checkpoint's
+    // architectures at load time — admission cannot see it), so a
+    // cross-runtime knob (e.g. in_buckets on a Llama service) passes
+    // here and still fails at model load. Typos and type errors are
+    // what this catches.
+    const Json& gen = model.get("generative");
+    if (!gen.is_null()) {
+      if (!gen.is_object()) return "model.generative must be an object";
+      const Json& gtable = SpecSchemaGenerative();
+      for (const auto& [field, value] : gen.items()) {
+        if (!gtable.has(field)) {
+          return "model.generative." + field + " is not a generative "
+                 "serving knob (see spec_schema.json)";
+        }
+        std::string gerr = ValidateRuntimeField(
+            field, value, gtable.get(field), "model.generative.");
+        if (!gerr.empty()) return gerr;
       }
     }
     // Tensor-parallel serving mesh: {"tensor": 8} etc. The axis product

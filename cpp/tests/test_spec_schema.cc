@@ -35,6 +35,12 @@ Json One(const std::string& field, Json value) {
   return rt;
 }
 
+std::string ValidateGenerative(Json gen) {
+  Json spec = Json::parse(R"({"model": {"model_dir": "/m"}})");
+  spec["model"]["generative"] = std::move(gen);
+  return tpk::ValidateSpec("InferenceService", spec);
+}
+
 }  // namespace
 
 int main() {
@@ -107,6 +113,78 @@ int main() {
 
   printf("spec schema drift guard: %d fields enforced\n", checked);
 
+  // --- Generative serving knobs (InferenceService.model.generative) ----
+  {
+    const Json& gtable = tpk::SpecSchemaGenerative();
+    CHECK(gtable.is_object());
+    // The paged-KV knobs this table exists to carry, plus the engine
+    // core, pinned by name.
+    for (const char* core : {"kv_block_size", "kv_blocks", "slots",
+                             "max_len", "chunk", "prefill_buckets",
+                             "pipeline_depth", "prefix_cache"}) {
+      CHECK(gtable.has(core));
+    }
+    int gchecked = 0;
+    for (const auto& [field, entry] : gtable.items()) {
+      const std::string type = entry.get("type").as_string();
+      if (type == "int") {
+        int64_t min = entry.get("min").as_int(0);
+        CHECK(ValidateGenerative(One(field, min)).empty());
+        CHECK(!ValidateGenerative(One(field, min - 1)).empty());
+        CHECK(!ValidateGenerative(One(field, min + 0.5)).empty());
+        CHECK(!ValidateGenerative(One(field, "2")).empty());
+      } else if (type == "int_or_null") {
+        CHECK(ValidateGenerative(One(field, 7)).empty());
+        CHECK(ValidateGenerative(One(field, nullptr)).empty());
+        CHECK(!ValidateGenerative(One(field, "7")).empty());
+        CHECK(!ValidateGenerative(One(field, 1.5)).empty());
+      } else if (type == "int_array") {
+        Json arr = Json::Array();
+        arr.push_back(Json(int64_t{32}));
+        arr.push_back(Json(int64_t{128}));
+        CHECK(ValidateGenerative(One(field, arr)).empty());
+        CHECK(!ValidateGenerative(One(field, 32)).empty());
+        // Empty bucket lists crash the engine at load — rejected here.
+        CHECK(!ValidateGenerative(One(field, Json::Array())).empty());
+        Json bad = Json::Array();
+        bad.push_back(Json("x"));
+        CHECK(!ValidateGenerative(One(field, bad)).empty());
+        Json frac = Json::Array();
+        frac.push_back(Json(1.5));
+        CHECK(!ValidateGenerative(One(field, frac)).empty());
+        if (entry.has("min")) {
+          Json low = Json::Array();
+          low.push_back(Json(entry.get("min").as_int() - 1));
+          CHECK(!ValidateGenerative(One(field, low)).empty());
+        }
+      } else if (type == "object") {
+        CHECK(ValidateGenerative(One(field, Json::Object())).empty());
+        CHECK(!ValidateGenerative(One(field, 5)).empty());
+      } else if (type == "string_or_null") {
+        CHECK(ValidateGenerative(One(field, "x")).empty());
+        CHECK(ValidateGenerative(One(field, nullptr)).empty());
+        CHECK(!ValidateGenerative(One(field, 5)).empty());
+      } else {
+        fprintf(stderr, "FAIL: generative schema type %s unhandled\n",
+                type.c_str());
+        return 1;
+      }
+      ++gchecked;
+    }
+    CHECK(gchecked >= 15);
+    // Unknown knobs (typos, or knobs newer than this binary) rejected.
+    std::string gerr = ValidateGenerative(One("kv_blocksize", 16));
+    CHECK(gerr.find("not a generative serving knob") != std::string::npos);
+    // Non-object generative rejected; absent generative still fine.
+    Json spec = Json::parse(R"({"model": {"model_dir": "/m",
+                                          "generative": 5}})");
+    CHECK(!tpk::ValidateSpec("InferenceService", spec).empty());
+    CHECK(tpk::ValidateSpec("InferenceService",
+                            Json::parse(R"({"model": {"model_dir": "/m"}})"))
+              .empty());
+    printf("generative knob table: %d fields enforced\n", gchecked);
+  }
+
   // --- Namespace defaults (PodDefaults analog) -------------------------
   {
     using tpk::MergeNamespaceDefaults;
@@ -133,6 +211,32 @@ int main() {
               .as_int() == 5);
     // No defaults -> spec unchanged.
     CHECK(MergeNamespaceDefaults(spec, Json()).dump() == spec.dump());
+
+    // Explicit null = user-wins OPT-OUT of that key's default (ADVICE
+    // r5): the key is STRIPPED before validation, not silently
+    // refilled — at the top level and recursively inside objects.
+    Json optout = Json::parse(R"({
+      "namespace": "team-a",
+      "backoff_limit": null,
+      "runtime": {"steps": 50, "log_every": null}
+    })");
+    Json m2 = MergeNamespaceDefaults(optout, defs);
+    CHECK(!m2.has("backoff_limit"));
+    CHECK(!m2.get("runtime").has("log_every"));
+    CHECK(m2.get("runtime").get("steps").as_int() == 50);
+    // Untouched defaults still fill around the opt-out.
+    CHECK(m2.get("runtime").get("checkpoint").get("keep").as_int() == 3);
+    // The stripped spec validates as if the key were never sent — this
+    // is why stripping must happen BEFORE validation: a surviving
+    // runtime.log_every=null would be rejected by its schema type.
+    CHECK(tpk::ValidateSpec("JAXJob", m2).empty());
+    // Null on a key the namespace does NOT default is left untouched
+    // (opt-out is scoped to the defaulting machinery).
+    Json nodef = Json::parse(R"({"runtime": {"steps": 5},
+                                 "elastic": null})");
+    Json m3 = MergeNamespaceDefaults(nodef, defs);
+    CHECK(m3.has("elastic") && m3.get("elastic").is_null());
+    printf("null opt-out of namespace defaults OK\n");
 
     // Profile.defaults validation: object-of-objects, no Profile key.
     Json prof = Json::Object();

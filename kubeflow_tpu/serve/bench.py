@@ -389,6 +389,69 @@ def bench_pipelined_vs_sync(model, params, cfg, *, slots: int,
     return res
 
 
+def bench_paged_vs_flat(model, params, cfg, *, slots: int, max_len: int,
+                        chunk: int, buckets, decode_tokens: int,
+                        rng: np.random.Generator) -> dict:
+    """ISSUE 6 tentpole A/B: block-paged KV cache against the flat
+    slot-contiguous cache on a mixed-length request set, at EQUAL pool
+    memory (the paged pool holds exactly `slots x max_len` tokens, the
+    flat engine's footprint) but double the decode width — the paged
+    engine admits by free-block accounting, so short requests coexist
+    where flat mode pins worst-case rows. `peak_inflight_requests` is
+    the mechanism proof (more concurrent rows than flat slots in the
+    same memory); wall/tok_s the outcome. Fetch-synced per PROFILE §1:
+    _drain returns when every request's tokens are host-side."""
+    from kubeflow_tpu.serve.generation import GenerationEngine
+
+    bs = 16  # divides max_len and every power-of-two decode bucket
+    pool_blocks = slots * max_len // bs
+    n_req = 4 * slots
+    prompts = [list(rng.integers(
+        1, cfg.vocab_size, int(rng.integers(8, max(10, max_len // 8)))))
+        for _ in range(n_req)]
+    res: dict[str, Any] = {}
+    for label, kw, width in (
+            ("flat", {}, slots),
+            ("paged", {"kv_block_size": bs, "kv_blocks": pool_blocks},
+             2 * slots)):
+        eng = GenerationEngine(model, params, cfg, slots=width,
+                               max_len=max_len, chunk=chunk,
+                               prefill_buckets=buckets, prefix_cache=0,
+                               pipeline_depth=2, **kw)
+        peak = [0]
+        orig = eng._dispatch_chunk
+
+        def spy(active, carry=None, _orig=orig, _peak=peak):
+            _peak[0] = max(_peak[0], len(active))
+            return _orig(active, carry)
+
+        eng._dispatch_chunk = spy
+        try:
+            dt, done = _drain(eng, prompts, decode_tokens)
+            s = eng.stats
+            emitted = sum(r["num_output_tokens"] for r in done)
+            res[label] = {
+                "slots": width,
+                "pool_tokens": slots * max_len,
+                "requests": n_req,
+                "wall_s": round(dt, 4),
+                "tok_s_e2e": round(emitted / max(dt, 1e-9), 1),
+                "decode_dispatches": s["decode_dispatches"],
+                "peak_inflight_requests": peak[0],
+            }
+            if label == "paged":
+                res[label]["kv_block_size"] = bs
+                res[label]["kv_blocks"] = pool_blocks
+        finally:
+            eng.close()
+    res["speedup_wall"] = round(
+        res["flat"]["wall_s"] / max(res["paged"]["wall_s"], 1e-9), 3)
+    res["concurrency_gain"] = round(
+        res["paged"]["peak_inflight_requests"]
+        / max(res["flat"]["peak_inflight_requests"], 1), 3)
+    return res
+
+
 def bench_batcher(*, requests: int = 200, threads: int = 8,
                   max_batch_size: int = 32,
                   max_latency_ms: float = 2.0) -> dict:
@@ -504,6 +567,10 @@ def run_servebench(*, size: str = "1b", quick: bool = False,
     }
     log("pipelined vs sync engine (overlapped scheduling A/B)")
     result["pipelined_vs_sync"] = bench_pipelined_vs_sync(
+        model, params, cfg, slots=2 if quick else 4, max_len=max_len,
+        chunk=chunk, buckets=buckets, decode_tokens=decode_tokens, rng=rng)
+    log("paged vs flat KV cache (block-table memory A/B)")
+    result["paged_vs_flat"] = bench_paged_vs_flat(
         model, params, cfg, slots=2 if quick else 4, max_len=max_len,
         chunk=chunk, buckets=buckets, decode_tokens=decode_tokens, rng=rng)
     log("decode throughput vs slots")

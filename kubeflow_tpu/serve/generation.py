@@ -46,10 +46,28 @@ import jax.numpy as jnp
 import numpy as np
 
 from kubeflow_tpu.serve.model import Model
+from kubeflow_tpu.serve.paging import BlockAllocator, blocks_for
 from kubeflow_tpu.utils import obs
 from kubeflow_tpu.utils.resilience import Deadline, DeadlineExceeded
 
 NEG_INF = -1e30
+
+
+class KVCapacityExceeded(RuntimeError):
+    """The request's worst-case KV footprint exceeds the whole paged
+    pool — it can NEVER be admitted, no matter how long it waits. The
+    HTTP serving surfaces (native :generate, OpenAI facade, both
+    streaming and not) map this to a shed — 503 + Retry-After, counted
+    in tpk_shed_total — instead of a 400: the spec is valid, this
+    replica's pool is just too small."""
+
+
+class _NeedKVBlocks(Exception):
+    """Internal admission signal: the pool cannot cover the request's
+    worst-case block need RIGHT NOW (it fits the pool in principle).
+    The scheduler keeps the request queued — head-of-line, so a large
+    request cannot be starved by a stream of small ones — and retries
+    as retirements free blocks."""
 
 
 def _chosen_logprob(logits: jax.Array, tok: jax.Array) -> jax.Array:
@@ -100,7 +118,8 @@ def build_engine_fns(model, cfg, *, max_len: int, chunk: int,
                      prefill_buckets: Sequence[int],
                      offset_writes: bool,
                      cache_sharding=None, adapters=None,
-                     rolling_window: int = 0) -> dict:
+                     rolling_window: int = 0,
+                     kv_block_size: int = 0) -> dict:
     """The engine's pure device functions, as unjitted closures.
 
     Single source of truth shared by the live `GenerationEngine` (which
@@ -121,6 +140,20 @@ def build_engine_fns(model, cfg, *, max_len: int, chunk: int,
     admission fn passes EXPLICIT positions whose padded tail is the
     sentinel (so modular writes skip pad rows), and decode passes the raw
     absolute index (the model wraps it; clamping would corrupt positions).
+
+    `kv_block_size` > 0 additionally builds the PAGED variants (serve/
+    paging.py design note): the persistent cache is a pool of fixed-size
+    blocks `[L, n_blocks, block_size, KH, D]` and each decode row's
+    history lives wherever its block table points. The jitted step
+    gathers the table into a contiguous `[L, B, bucket, ...]` view, runs
+    the EXACT flat decode computation on it (view row t IS logical
+    position t, so masking/positions/sampling are untouched — paged
+    greedy/seeded decode is token-identical to flat), then scatters the
+    view back block-by-block. Scatter-back rewrites shared (immutable)
+    blocks with their own values and pads through the reserved NULL
+    block 0, so duplicate scatter indices can only ever disagree on
+    garbage nobody reads (absolute-position masking hides every row past
+    a request's write index, exactly as it hides stale flat slots).
     """
     from kubeflow_tpu.models.llama import init_cache
 
@@ -255,9 +288,109 @@ def build_engine_fns(model, cfg, *, max_len: int, chunk: int,
             return cache, toks.T, lps.T
         return decode_chunk
 
-    return {"prefill": prefill, "extend": extend, "extend_mid": extend_mid,
-            "insert": insert, "make_decode": make_decode,
-            "frag_len": frag_len}
+    fns = {"prefill": prefill, "extend": extend, "extend_mid": extend_mid,
+           "insert": insert, "make_decode": make_decode,
+           "frag_len": frag_len}
+    if kv_block_size > 0:
+        if rolling:
+            raise ValueError(
+                "paged KV does not compose with the rolling cache")
+        bs = int(kv_block_size)
+        mb = max_len // bs  # blocks covering one full-length request
+
+        def _gather_view(pool_leaf, tables):
+            """[L, NB, bs, ...] × [B, nb] -> [L, B, nb*bs, ...]: view row
+            j*bs + r is block tables[b, j] row r — logical position
+            j*bs + r, because tables are position-ordered."""
+            g = jnp.take(pool_leaf, tables, axis=1)  # [L, B, nb, bs, ...]
+            return g.reshape(g.shape[0], g.shape[1],
+                             g.shape[2] * g.shape[3], *g.shape[4:])
+
+        def _scatter_view(pool_leaf, view_leaf, tables):
+            """Write the view back to its blocks. Duplicate ids (shared
+            prefix blocks across rows, NULL-block pads) are benign: a
+            shared block is immutable, so every row writes its original
+            values; the NULL block receives garbage nobody reads."""
+            b, nb = tables.shape
+            v = view_leaf.reshape(view_leaf.shape[0], b, nb, bs,
+                                  *view_leaf.shape[3:])
+            v = v.reshape(v.shape[0], b * nb, bs, *v.shape[4:])
+            return pool_leaf.at[:, tables.reshape(-1)].set(v)
+
+        def make_decode_paged(truncate: bool, bucket: int):
+            nb = bucket // bs
+
+            def decode_chunk(params, pool, tables, last_tok, index,
+                             temperature, top_k, top_p, key, aid=None):
+                """Flat `decode_chunk` semantics over a gathered block
+                view: tables [B, nb] (pad entries 0 = NULL block). The
+                scan body is the flat step verbatim — paged decode is
+                token-identical to flat decode by construction."""
+                view = jax.tree.map(lambda p: _gather_view(p, tables),
+                                    pool)
+
+                def step(carry, _):
+                    view, tok, idx, key = carry
+                    key, sub = jax.random.split(key)
+                    logits, view = model.apply(
+                        {"params": params}, tok[:, None], cache=view,
+                        cache_index=jnp.minimum(idx, bucket - 1),
+                        **apply_kw(aid))
+                    if truncate:
+                        nxt = sample_tokens(logits[:, 0], temperature,
+                                            sub, top_k, top_p)
+                    else:
+                        nxt = sample_tokens(logits[:, 0], temperature,
+                                            sub)
+                    lp = _chosen_logprob(logits[:, 0], nxt)
+                    return (view, nxt, idx + 1, key), (nxt, lp)
+
+                (view, _, _, _), (toks, lps) = jax.lax.scan(
+                    step, (view, last_tok, index, key), None,
+                    length=chunk)
+                pool = jax.tree.map(
+                    lambda p, v: _scatter_view(p, v, tables), pool, view)
+                return pool, toks.T, lps.T
+            return decode_chunk
+
+        def insert_paged(pool, frag, table):
+            """Scatter an admission fragment's first max_len rows into
+            the request's blocks. `table` [mb] is the SCATTER table:
+            zero-copy shared prefix blocks are masked to the NULL block
+            (they already hold these exact rows and must stay untouched
+            by construction, not by luck), a freshly forked tail block
+            receives its committed rows from the fragment — that write
+            IS the copy-on-write copy — and entries past the allocation
+            pad to NULL."""
+            def leaf(p, f):
+                rows = jax.lax.slice_in_dim(
+                    f, 0, mb * bs, axis=2).astype(p.dtype)
+                rows = rows.reshape(rows.shape[0], mb, bs,
+                                    *rows.shape[3:])
+                return p.at[:, table].set(rows)
+            return jax.tree.map(leaf, pool, frag)
+
+        def frag_from_pool(pool, table):
+            """Rebuild a fragment cache [L, 1, frag_len, ...] from a
+            block table — the admission-side gather that lets chunked
+            prefill RESUME after a prefix-cache hit without the flat
+            engine's stored full-length fragment copy. Rows past the
+            stored prefix come back as garbage; safe for the same reason
+            stale fragment rows always were (each is overwritten before
+            any query position can attend it)."""
+            empty = init_cache(cfg, 1, frag_len)
+
+            def leaf(f, p):
+                g = jnp.take(p, table, axis=1)  # [L, mb, bs, ...]
+                g = g.reshape(g.shape[0], 1, mb * bs, *g.shape[3:])
+                return jax.lax.dynamic_update_slice(
+                    f, g.astype(f.dtype), (0,) * f.ndim)
+            return jax.tree.map(leaf, empty, pool)
+
+        fns.update(make_decode_paged=make_decode_paged,
+                   insert_paged=insert_paged,
+                   frag_from_pool=frag_from_pool)
+    return fns
 
 
 def spec_acceptance(drafts, dlogits, tlogits, temperature, key):
@@ -504,7 +637,8 @@ class GenerationEngine:
                  decode_buckets: Sequence[int] | None = None,
                  prefix_cache: int = 0, seed: int = 0,
                  mesh=None, rules=None, draft: dict | None = None,
-                 adapters: dict | None = None, pipeline_depth: int = 2):
+                 adapters: dict | None = None, pipeline_depth: int = 2,
+                 kv_block_size: int = 0, kv_blocks: int = 0):
         self.model, self.cfg = model, cfg
         self.max_len, self.chunk, self.n_slots = int(max_len), int(chunk), int(slots)
         msl = int(getattr(cfg, "max_seq_len", 0) or 0)
@@ -591,6 +725,45 @@ class GenerationEngine:
             self.decode_buckets = sorted(
                 {int(b) for b in decode_buckets
                  if self.chunk < int(b) < self.max_len} | {self.max_len})
+        # Paged KV cache (ROADMAP item 1, the vLLM PagedAttention design
+        # TPU-shaped — serve/paging.py): `kv_block_size` > 0 swaps the
+        # slot-contiguous cache [L, slots, max_len, ...] for a pool of
+        # `kv_blocks` fixed-size blocks (+ the reserved NULL block).
+        # `slots` becomes pure CONCURRENCY (the compiled decode width);
+        # memory is the pool, so many short requests coexist where flat
+        # mode would hold `slots` worst-case rows. kv_blocks=0 sizes the
+        # pool to flat parity (slots*max_len tokens) — raise slots and
+        # shrink kv_blocks to trade worst-case headroom for concurrency.
+        # kv_block_size=0 (default) is the escape hatch: the flat engine,
+        # bit-for-bit (same RNG splits, same sync points).
+        self._paged = int(kv_block_size) > 0
+        self._kv_bs = int(kv_block_size)
+        self._kv_stash: deque = deque()  # admissions waiting for blocks
+        if self._paged:
+            if self._rolling:
+                raise ValueError(
+                    "kv_block_size > 0 does not compose with rolling "
+                    "sliding-window serving (rolling rows are not "
+                    "prefix-ordered, so block tables cannot address "
+                    "them); set kv_block_size=0")
+            if draft is not None:
+                raise ValueError(
+                    "kv_block_size > 0 does not yet compose with "
+                    "speculative decoding (the draft cache is unpaged); "
+                    "set kv_block_size=0 to use a draft")
+            if self.max_len % self._kv_bs:
+                raise ValueError(
+                    f"kv_block_size {self._kv_bs} must divide max_len "
+                    f"{self.max_len} (block tables address whole blocks)")
+            bad = [b for b in self.decode_buckets if b % self._kv_bs]
+            if bad:
+                raise ValueError(
+                    f"kv_block_size {self._kv_bs} must divide every "
+                    f"decode bucket; offending: {bad} (pass explicit "
+                    "decode_buckets or a power-of-two block size)")
+            n_blocks = int(kv_blocks) or -(-self.n_slots * self.max_len
+                                           // self._kv_bs)
+            self._kv_alloc = BlockAllocator(n_blocks, self._kv_bs)
         # Prefix cache: LRU of prompt-chunk-boundary KV fragments keyed by
         # the exact token prefix; admission resumes chunked prefill after
         # the longest hit instead of recomputing it (the vLLM prefix-reuse
@@ -752,7 +925,8 @@ class GenerationEngine:
                       "decode_wasted_tokens": 0,
                       "spec_dispatches": 0, "spec_proposed": 0,
                       "spec_accepted": 0, "spec_demotions": 0,
-                      "spec_readmissions": 0}
+                      "spec_readmissions": 0, "spec_stale_rides": 0,
+                      "kv_cow_copies": 0, "prefix_zero_copy_hits": 0}
         self._compile()
         from kubeflow_tpu.models.llama import init_cache
         with self._scope():
@@ -766,9 +940,18 @@ class GenerationEngine:
                     from jax.sharding import NamedSharding, PartitionSpec
                     cache_sh["pos"] = NamedSharding(self._mesh,
                                                     PartitionSpec())
-            self._cache = jax.jit(
-                lambda: init_cache(cfg, self.n_slots, self.max_len),
-                out_shardings=cache_sh)()
+            if self._paged:
+                # The pool: kv_blocks usable blocks + NULL block 0. Block
+                # axis rides the slot axis's (replicated) spec; heads
+                # still shard over `tensor` under TP.
+                self._cache = jax.jit(
+                    lambda: init_cache(cfg, self._kv_alloc.n_blocks + 1,
+                                       self._kv_bs),
+                    out_shardings=cache_sh)()
+            else:
+                self._cache = jax.jit(
+                    lambda: init_cache(cfg, self.n_slots, self.max_len),
+                    out_shardings=cache_sh)()
             if self._spec is not None:
                 dcache_sh = (None if self._dcache_sharding is None else
                              {"k": self._dcache_sharding,
@@ -882,16 +1065,30 @@ class GenerationEngine:
             offset_writes=offset_writes,
             cache_sharding=self._cache_sharding,
             adapters=self._ml_stacks,
-            rolling_window=self._rolling)
+            rolling_window=self._rolling,
+            kv_block_size=self._kv_bs if self._paged else 0)
         prefill_jit = jax.jit(fns["prefill"])
         self._prefill = {b: prefill_jit for b in self.prefill_buckets}
         self._extend = jax.jit(fns["extend"], donate_argnums=(1,))
         self._extend_mid = jax.jit(fns["extend_mid"], donate_argnums=(1,))
-        self._insert = jax.jit(fns["insert"], donate_argnums=(0,))
-        self._decode = {
-            (b, trunc): jax.jit(fns["make_decode"](trunc, b),
-                                donate_argnums=(1,))
-            for b in self.decode_buckets for trunc in (False, True)}
+        if self._paged:
+            # Same attribute names, paged signatures: _insert takes the
+            # request's scatter table, _decode the per-row block tables
+            # (call sites branch on self._paged). Admission fragments
+            # (prefill/extend) are identical in both modes.
+            self._insert = jax.jit(fns["insert_paged"],
+                                   donate_argnums=(0,))
+            self._frag_from_pool = jax.jit(fns["frag_from_pool"])
+            self._decode = {
+                (b, trunc): jax.jit(fns["make_decode_paged"](trunc, b),
+                                    donate_argnums=(1,))
+                for b in self.decode_buckets for trunc in (False, True)}
+        else:
+            self._insert = jax.jit(fns["insert"], donate_argnums=(0,))
+            self._decode = {
+                (b, trunc): jax.jit(fns["make_decode"](trunc, b),
+                                    donate_argnums=(1,))
+                for b in self.decode_buckets for trunc in (False, True)}
         if self._spec is not None:
             # The draft runs the SAME admission recipe (chunked cache
             # writes, no sampling — extend_mid) over its own cache tree.
@@ -943,14 +1140,36 @@ class GenerationEngine:
                     self._params, frag, jnp.zeros((1, b), jnp.int32),
                     one_l, zero_k, zero_t, zero_k, one_p, self._key,
                     aid=aid1)
-        self._cache = self._insert(self._cache, frag, jnp.int32(0))
         n = self.n_slots
-        for fn in self._decode.values():
-            self._cache, _, _ = fn(
-                self._params, self._cache, jnp.zeros((n,), jnp.int32),
-                jnp.zeros((n,), jnp.int32), jnp.zeros((n,), jnp.float32),
-                jnp.zeros((n,), jnp.int32), jnp.ones((n,), jnp.float32),
-                self._key, aid=self._aid_batch([0] * n))
+        if self._paged:
+            # All-NULL tables: the warmup writes land in the reserved
+            # garbage block, never in allocatable pool blocks.
+            mb = self.max_len // self._kv_bs
+            self._cache = self._insert(self._cache, frag,
+                                       jnp.zeros((mb,), jnp.int32))
+            if self._prefix_cap:
+                frag = self._frag_from_pool(self._cache,
+                                            jnp.zeros((mb,), jnp.int32))
+            for (b, _), fn in self._decode.items():
+                self._cache, _, _ = fn(
+                    self._params, self._cache,
+                    jnp.zeros((n, b // self._kv_bs), jnp.int32),
+                    jnp.zeros((n,), jnp.int32),
+                    jnp.zeros((n,), jnp.int32),
+                    jnp.zeros((n,), jnp.float32),
+                    jnp.zeros((n,), jnp.int32),
+                    jnp.ones((n,), jnp.float32),
+                    self._key, aid=self._aid_batch([0] * n))
+        else:
+            self._cache = self._insert(self._cache, frag, jnp.int32(0))
+            for fn in self._decode.values():
+                self._cache, _, _ = fn(
+                    self._params, self._cache, jnp.zeros((n,), jnp.int32),
+                    jnp.zeros((n,), jnp.int32),
+                    jnp.zeros((n,), jnp.float32),
+                    jnp.zeros((n,), jnp.int32),
+                    jnp.ones((n,), jnp.float32),
+                    self._key, aid=self._aid_batch([0] * n))
         if self._spec is not None:
             dfrag = self._dfrag_init()
             for b in self.prefill_buckets:
@@ -1026,6 +1245,18 @@ class GenerationEngine:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         if top_k < 0:
             raise ValueError(f"top_k must be >= 0, got {top_k}")
+        if self._paged:
+            need = blocks_for(
+                self._paged_need_tokens(len(input_ids), int(max_tokens)),
+                self._kv_bs)
+            if need > self._kv_alloc.n_blocks:
+                # Permanent: even an empty pool can't cover it — shed
+                # now (503), don't let it camp in the queue to 504.
+                raise KVCapacityExceeded(
+                    f"request needs {need} KV blocks worst-case "
+                    f"(prompt {len(input_ids)} + max_tokens "
+                    f"{int(max_tokens)}) but the pool has "
+                    f"{self._kv_alloc.n_blocks}")
         req = {
             "input_ids": [int(t) for t in input_ids],
             "max_tokens": int(max_tokens),
@@ -1143,14 +1374,322 @@ class GenerationEngine:
         self._prefix_lru.move_to_end(key)
         self.stats["prefix_stores"] += 1
         while len(self._prefix_lru) > self._prefix_cap:
-            (eaid, en, _), _ = self._prefix_lru.popitem(last=False)
-            per = self._prefix_lens.get(eaid, {})
-            if per.get(en, 0) <= 1:
-                per.pop(en, None)
-                if not per:
-                    self._prefix_lens.pop(eaid, None)
+            self._prefix_evict_oldest()
+
+    # -- paged KV (block-table) admission ------------------------------------
+
+    def _paged_need_tokens(self, prompt: int, max_tokens: int) -> int:
+        """Worst-case cache rows a request can ever WRITE: the prompt
+        plus its decode budget rounded up to whole dispatch chunks (the
+        retirement chunk still writes its full width), capped at max_len
+        (decode indices clamp at bucket-1, so no write ever lands past
+        row max_len-1). Blocks covering this are reserved whole at
+        admission — allocation never happens on the decode critical
+        path, which is what lets paging compose with pipeline_depth>1
+        without new host syncs. Dead in-flight chunks past a retirement
+        may write beyond this bound; those rows map to NULL-block table
+        pads, never to another request's blocks."""
+        chunks = -(-max(int(max_tokens), 1) // self.chunk)
+        return min(self.max_len, prompt + chunks * self.chunk)
+
+    def _prefix_probe_paged(self, ids: list[int], aid: int, *,
+                            touch: bool) -> tuple[int, tuple] | None:
+        """Paged twin of `_prefix_lookup`: longest strictly-shorter
+        cached prefix, returning its resident BLOCK IDS instead of a
+        fragment copy. `touch=False` is the read-only peek the
+        admission-fit check uses (no LRU reorder, no stats)."""
+        lens = self._prefix_lens.get(aid)
+        if not lens:
+            return None
+        for n in sorted(lens, reverse=True):
+            if n >= len(ids):
+                continue
+            kt = tuple(ids[:n])
+            key = (aid, n, hash(kt))
+            entry = self._prefix_lru.get(key)
+            if entry is None or entry[0] != kt:
+                continue
+            if touch:
+                self._prefix_lru.move_to_end(key)
+            return n, entry[1]
+        return None
+
+    def _prefix_store_paged(self, aid: int, kt: tuple,
+                            blocks: list[int]) -> None:
+        """Publish a prompt-boundary prefix as block REFERENCES
+        (refcount bump — no fragment copy, no device work). The stored
+        tail block may be partially filled; its owner keeps appending at
+        rows >= len(kt), which never disturbs the committed rows a later
+        hit reads, and the hit forks that block before writing (CoW)."""
+        key = (aid, len(kt), hash(kt))
+        existing = self._prefix_lru.get(key)
+        if existing is not None and existing[0] == kt:
+            self._prefix_lru.move_to_end(key)
+            return
+        if existing is None:
+            per = self._prefix_lens.setdefault(aid, {})
+            per[len(kt)] = per.get(len(kt), 0) + 1
+        else:
+            # Hash-collision overwrite: the displaced entry's block refs
+            # must be dropped or they leak out of the pool forever (the
+            # flat cache's displaced fragment was simply GC'd; the
+            # refcounted twin needs the explicit release).
+            self._kv_alloc.decref(existing[1])
+        self._kv_alloc.incref(blocks)
+        self._prefix_lru[key] = (kt, tuple(blocks))
+        self._prefix_lru.move_to_end(key)
+        self.stats["prefix_stores"] += 1
+        while len(self._prefix_lru) > self._prefix_cap:
+            self._prefix_evict_oldest()
+
+    def _prefix_evict_oldest(self) -> None:
+        self._prefix_evict(next(iter(self._prefix_lru)))
+
+    def _prefix_evict(self, key: tuple) -> None:
+        """Drop one prefix entry + its length-index bookkeeping — shared
+        by both cache flavors. The payload is a fragment tree (flat:
+        Python GC reclaims it) or a block-id tuple (paged: the refs must
+        be returned to the allocator explicitly)."""
+        _, payload = self._prefix_lru.pop(key)
+        eaid, en, _ = key
+        per = self._prefix_lens.get(eaid, {})
+        if per.get(en, 0) <= 1:
+            per.pop(en, None)
+            if not per:
+                self._prefix_lens.pop(eaid, None)
+        else:
+            per[en] -= 1
+        if self._paged:
+            self._kv_alloc.decref(payload)
+
+    def _kv_fits(self, req: dict) -> bool:
+        """Admission-by-free-blocks (the paged replacement for "is a
+        static slot free"): can the pool cover this request's worst-case
+        need right now, counting zero-copy shared prefix blocks? Under
+        pressure, LRU prefix-cache entries are reclaimed first — cached
+        prefixes must yield to live traffic, or a pool fully pinned by
+        cache references would deadlock an idle engine against a stashed
+        admission.
+
+        Reclaim discipline: the feasibility bound is computed ONCE (a
+        block counts as reclaimable only when every ref on it is a
+        cache ref — live tables pin the rest), for BOTH outcomes:
+        keeping the peeked zero-copy hit (discounted need, hit's blocks
+        unreclaimable) and sacrificing it (full need, everything
+        reclaimable). If neither can ever fit, nothing is evicted at
+        all. Otherwise non-hit entries go first, oldest-first, and the
+        hit itself is evicted only when sacrificing its discount is the
+        only way to fit — an admission can never wipe the cache while
+        freeing nothing, and never destroys its own hit needlessly."""
+        ids = req["input_ids"]
+        total = blocks_for(
+            self._paged_need_tokens(len(ids), req["max_tokens"]),
+            self._kv_bs)
+        aid = req.get("aid", 0)
+        hit = (self._prefix_probe_paged(ids, aid, touch=False)
+               if self._prefix_cap else None)
+        shared = hit[0] // self._kv_bs if hit is not None else 0
+        hit_key = ((aid, hit[0], hash(tuple(ids[:hit[0]])))
+                   if hit is not None else None)
+        if self._kv_alloc.can_alloc(total - shared):
+            return True
+        if not self._prefix_lru:
+            return False
+        cache_refs: dict[int, int] = {}
+        for _, eblocks in self._prefix_lru.values():
+            for b in eblocks:
+                cache_refs[b] = cache_refs.get(b, 0) + 1
+        hit_blocks = set(hit[1]) if hit is not None else set()
+        free = self._kv_alloc.free_blocks
+        reclaim_all = sum(1 for b, c in cache_refs.items()
+                          if self._kv_alloc.refcount(b) == c)
+        reclaim_keep_hit = sum(1 for b, c in cache_refs.items()
+                               if self._kv_alloc.refcount(b) == c
+                               and b not in hit_blocks)
+        keep_hit = (hit_key is not None
+                    and free + reclaim_keep_hit >= total - shared)
+        if not keep_hit and free + reclaim_all < total:
+            return False
+        protect = hit_key if keep_hit else None
+        while True:
+            resident = (hit_key is not None
+                        and hit_key in self._prefix_lru)
+            disc = shared if resident else 0
+            if self._kv_alloc.can_alloc(total - disc):
+                return True
+            victim = next((k for k in self._prefix_lru if k != protect),
+                          None)
+            if victim is None:
+                return False  # unreachable under the exact bounds above
+            self._prefix_evict(victim)
+
+    def _free_slot_blocks(self, st: dict) -> None:
+        """Return a retired request's block references to the pool
+        (idempotent — the pop guards double-retirement paths). Blocks
+        still referenced by the prefix cache or by zero-copy sharers
+        survive; in-flight dead chunks may still write to truly-freed
+        blocks, which is safe because any re-admission's insert is
+        dispatched AFTER them and rewrites every block it was handed
+        (device stream order is dispatch order)."""
+        if not self._paged:
+            return
+        blocks = st.pop("blocks", None)
+        if blocks:
+            self._kv_alloc.decref(blocks)
+
+    @property
+    def kv_blocks_free(self):
+        return self._kv_alloc.free_blocks if self._paged else None
+
+    @property
+    def kv_blocks_used(self):
+        return self._kv_alloc.used_blocks if self._paged else None
+
+    def kv_info(self) -> dict | None:
+        """Paged-pool snapshot for metadata()/debugging (None = flat)."""
+        if not self._paged:
+            return None
+        return {"block_size": self._kv_bs,
+                "blocks": self._kv_alloc.n_blocks,
+                "blocks_free": self._kv_alloc.free_blocks,
+                "blocks_used": self._kv_alloc.used_blocks}
+
+    def _admit_inner_paged(self, slot: int, req: dict) -> None:
+        """Paged admission: the fragment pipeline (prefill/extend over a
+        contiguous fragment cache) is IDENTICAL to flat — only where the
+        fragment lands differs (scatter into this request's blocks
+        instead of a slot row), plus the block-table bookkeeping:
+
+          * zero-copy prefix hit: the stored prefix's fully-committed
+            blocks map into this table by reference (refcount bump);
+            only the partially-filled tail block is forked — its
+            committed rows ride the fragment into a fresh block, which
+            IS the copy-on-write copy (`kv_cow_copies`).
+          * the whole worst-case block need is allocated here, off the
+            decode critical path (see `_paged_need_tokens`).
+
+        KEEP IN SYNC with `_admit_inner`: the chunked-prefill loop is a
+        deliberate textual copy (flat must stay byte-untouched); any fix
+        to the recipe there must land here too, or the seeded
+        flat-vs-paged identity test breaks.
+        """
+        ids = req["input_ids"]
+        aid = req.get("aid", 0)
+        aid1 = self._aid1(aid)
+        bs = self._kv_bs
+        mb = self.max_len // bs
+        sample_args = (
+            jnp.asarray([req["temperature"]], jnp.float32),
+            jnp.asarray([req.get("top_k", 0)], jnp.int32),
+            jnp.asarray([req.get("top_p", 1.0)], jnp.float32),
+        )
+        big = self.prefill_buckets[-1]
+        frag, tok0, done = None, None, 0
+        shared: list[int] = []
+        gather_tbl: tuple | None = None
+        cow_fork = False
+        hit = None
+        if self._prefix_cap:
+            hit = self._prefix_probe_paged(ids, aid, touch=True)
+            if hit is not None:
+                done, hit_blocks = hit
+                shared = list(hit_blocks[:done // bs])
+                cow_fork = done % bs > 0
+                gather_tbl = hit_blocks
+        need = blocks_for(self._paged_need_tokens(len(ids),
+                                                  req["max_tokens"]), bs)
+        fresh = self._kv_alloc.alloc(max(0, need - len(shared)))
+        if fresh is None:
+            # _admit_waiting's _kv_fits precheck makes this unreachable
+            # in the normal flow; defense against future reordering.
+            raise _NeedKVBlocks()
+        if self._prefix_cap:
+            if hit is not None:
+                self.stats["prefix_hits"] += 1
+                self.stats["prefix_hit_tokens"] += done
+                if shared:
+                    self.stats["prefix_zero_copy_hits"] += 1
+                if cow_fork:
+                    self.stats["kv_cow_copies"] += 1
             else:
-                per[en] -= 1
+                self.stats["prefix_misses"] += 1
+        self._kv_alloc.incref(shared)
+        table = shared + fresh
+        boundaries: list[int] = []
+        try:
+            if gather_tbl is not None:
+                # Resume chunked prefill mid-prompt: seed the fragment
+                # from the hit's blocks (includes the partial tail —
+                # read-only; its committed rows become the fork copy).
+                gt = np.zeros((mb,), np.int32)
+                gt[:len(gather_tbl)] = gather_tbl
+                frag = self._frag_from_pool(self._cache, jnp.asarray(gt))
+            while done < len(ids):
+                piece = ids[done:done + big]
+                final = done + len(piece) >= len(ids)
+                bucket = self._bucket_for(len(piece))
+                toks = np.zeros((1, bucket), np.int32)
+                toks[0, :len(piece)] = piece
+                if done == 0:
+                    self._key, sub = jax.random.split(self._key)
+                    frag, tok0, lp0 = self._prefill[bucket](
+                        self._params, jnp.asarray(toks),
+                        jnp.asarray([len(piece)], jnp.int32),
+                        *sample_args, sub, aid=aid1)
+                elif final:
+                    self._key, sub = jax.random.split(self._key)
+                    frag, tok0, lp0 = self._extend(
+                        self._params, frag, jnp.asarray(toks),
+                        jnp.asarray([len(piece)], jnp.int32),
+                        jnp.asarray([done], jnp.int32), *sample_args,
+                        sub, aid=aid1)
+                else:  # intermediate chunk: no sampling, no unembedding
+                    frag = self._extend_mid(
+                        self._params, frag, jnp.asarray(toks),
+                        jnp.asarray([done], jnp.int32), aid=aid1)
+                done += len(piece)
+                if self._prefix_cap:
+                    # Same boundary gate as flat (skip entries a later
+                    # boundary of this admission would immediately
+                    # evict); the store itself is deferred until the
+                    # blocks are written by the insert below.
+                    chunks_left = -(-(len(ids) - done) // big)
+                    if chunks_left < self._prefix_cap:
+                        boundaries.append(done)
+            # Scatter table: shared prefix blocks masked to NULL (their
+            # rows are already resident and immutable), owned blocks
+            # receive their fragment rows — including the CoW fork and
+            # the pad/garbage tail that decode will overwrite in place.
+            st_tbl = np.zeros((mb,), np.int32)
+            st_tbl[len(shared):len(table)] = fresh
+            self._cache = self._insert(self._cache, frag,
+                                       jnp.asarray(st_tbl))
+        except BaseException:
+            self._kv_alloc.decref(table)
+            raise
+        for m in boundaries:
+            self._prefix_store_paged(aid, tuple(ids[:m]),
+                                     table[:blocks_for(m, bs)])
+        st = {"req": req, "idx": len(ids), "disp": len(ids), "last": None,
+              "pending": None, "draft_ok": False, "aid": aid,
+              "blocks": table}
+        if self.pipeline_depth > 1:
+            for arr in (tok0, lp0):
+                getattr(arr, "copy_to_host_async", lambda: None)()
+            st["pending"] = (tok0, lp0)
+            self._slots[slot] = st
+        else:
+            st["last"] = int(tok0[0])
+            self._slots[slot] = st
+        self.stats["requests"] += 1
+        self.stats["prompt_tokens"] += len(ids)
+        if aid:
+            per = dict(self.stats.get("adapter_requests", {}))
+            name = self._ml_names[aid]
+            per[name] = per.get(name, 0) + 1
+            self.stats["adapter_requests"] = per
+        if st["pending"] is None:
+            self._emit(slot, st, [st["last"]], [float(lp0[0])])
 
     def _admit(self, slot: int, req: dict) -> None:
         tracer = obs.get_tracer()
@@ -1168,6 +1707,8 @@ class GenerationEngine:
                 self._admit_inner(slot, req)
 
     def _admit_inner(self, slot: int, req: dict) -> None:
+        if self._paged:
+            return self._admit_inner_paged(slot, req)
         ids = req["input_ids"]
         aid = req.get("aid", 0)
         aid1 = self._aid1(aid)
@@ -1180,6 +1721,12 @@ class GenerationEngine:
         # first chunk is a plain prefill, the rest are continuation
         # chunks attending over the whole fragment cache — no silent
         # truncation (submit() already bounds the prompt by max_len).
+        # KEEP IN SYNC with _admit_inner_paged's loop: the recipe
+        # (piece slicing, bucket choice, RNG split order, boundary
+        # gating) is duplicated there so the flat path stays textually
+        # untouched — a change landing in only one breaks the
+        # paged-is-token-identical-to-flat invariant the seeded test
+        # pins.
         big = self.prefill_buckets[-1]
         frag, tok0, done = None, None, 0
         if self._prefix_cap:
@@ -1291,10 +1838,10 @@ class GenerationEngine:
     def _readmit_worthwhile(self, st: dict) -> bool:
         """Cost gate for draft re-admission: replaying the whole history
         to speculate a handful of remaining tokens (or a history vastly
-        longer than the remainder) costs more than it saves. Checked for
-        the WHOLE batch before any replay runs — spec is batch-wide, so
-        one unworthy slot keeps everyone vanilla, and replaying the
-        others first would be pure waste repeated every loop."""
+        longer than the remainder) costs more than it saves. Checked
+        PER SLOT (ADVICE r5 partial fix): an unworthy slot is simply not
+        replayed — it rides the spec chunk with its stale draft rows —
+        instead of keeping the whole batch vanilla for its lifetime."""
         req = st["req"]
         remaining = req["max_tokens"] - len(req["out"])
         history = len(req["input_ids"]) + len(req["out"]) - 1
@@ -1349,6 +1896,7 @@ class GenerationEngine:
             req["done"].set()
             if self._slots[slot] is st:
                 self._slots[slot] = None
+            self._free_slot_blocks(st)
 
     def _expire(self, req: dict) -> bool:
         """Finish `req` with DeadlineExceeded when its budget is gone.
@@ -1380,21 +1928,38 @@ class GenerationEngine:
         insert dispatches enqueue BEHIND them on the device stream and
         no host sync happens (`_admit_inner` defers the first-token
         fetch) — admission is off the critical path, counted by
-        `admit_overlap`."""
+        `admit_overlap`.
+
+        Paged mode adds the free-block gate: a request whose worst-case
+        block need the pool cannot cover yet is STASHED head-of-line
+        (`_kv_fits` — which first reclaims LRU prefix-cache blocks) and
+        the scan stops, so admission stays FIFO and a big request can't
+        be starved by smaller ones slipping past it."""
         queue_empty = False
         for slot in range(self.n_slots):
             if queue_empty:
                 break
             while self._slots[slot] is None:
-                try:
-                    req = self._queue.get_nowait()
-                except queue.Empty:
-                    queue_empty = True
-                    break
+                if self._kv_stash:
+                    req = self._kv_stash.popleft()
+                else:
+                    try:
+                        req = self._queue.get_nowait()
+                    except queue.Empty:
+                        queue_empty = True
+                        break
                 if self._expire(req):
                     continue  # never admitted; try the next waiter
+                if self._paged and not self._kv_fits(req):
+                    self._kv_stash.appendleft(req)
+                    queue_empty = True  # FIFO: nothing jumps the line
+                    break
                 try:
                     self._admit(slot, req)
+                except _NeedKVBlocks:
+                    self._kv_stash.appendleft(req)
+                    queue_empty = True
+                    break
                 except Exception as e:  # surface to the caller
                     req["error"] = f"{type(e).__name__}: {e}"
                     req["done"].set()
@@ -1478,14 +2043,26 @@ class GenerationEngine:
         need = max(st["idx"] for st in sts) + worst
         if need > self.max_len:
             return False
-        # Only re-admit when the spec dispatch can actually run — near
-        # the context end the tail decodes vanilla, and replaying the
-        # draft there would be a demote/replay ping-pong every chunk.
-        # Gates are checked for EVERY demoted slot before any replay
-        # runs (see _readmit_worthwhile).
+        # Re-admission is PER SLOT (ADVICE r5 partial fix): worthy
+        # demoted slots replay their draft cache; permanently-unworthy
+        # ones (near budget / history dwarfs the remainder — the replay
+        # can't pay for itself, and the gap only widens) are excluded
+        # from the re-admission group and ride the chunk with STALE
+        # draft rows. That's a pure acceptance-rate cost, never a
+        # correctness one: every emitted token still comes from the
+        # target's verify forward (exact-match / rejection acceptance),
+        # so one near-budget request no longer disables speculation for
+        # all concurrent greedy traffic. (Truncated-sampling requests
+        # still gate the whole batch above — their sampling law can't
+        # ride a spec dispatch at all; the full spec/vanilla split
+        # dispatch is ROADMAP item 4.)
         demoted = [i for i in active if not self._slots[i].get("draft_ok")]
-        if not all(self._readmit_worthwhile(self._slots[i])
-                   for i in demoted):
+        worthy = [i for i in demoted
+                  if self._readmit_worthwhile(self._slots[i])]
+        stale = len(demoted) - len(worthy)
+        if stale == len(active):
+            # Nobody would propose from a live draft cache — the spec
+            # dispatch would be pure overhead over a vanilla chunk.
             return False
         last = np.zeros((self.n_slots,), np.int32)
         idx = np.zeros((self.n_slots,), np.int32)
@@ -1500,8 +2077,10 @@ class GenerationEngine:
         t0 = time.monotonic()
         p0 = time.perf_counter()
         with self._scope():
-            for i in demoted:
+            for i in worthy:
                 self._readmit_draft(i, self._slots[i])
+        if stale:
+            self.stats["spec_stale_rides"] += stale
         bucket = next((b for b in self.decode_buckets if b >= need),
                       self.decode_buckets[-1])
         with self._scope():
@@ -1595,10 +2174,26 @@ class GenerationEngine:
                     last_dev = last_dev.at[i].set(st["pending"][0][0])
                 elif carry is not None:
                     last_dev = last_dev.at[i].set(np.int32(st["last"]))
-            self._cache, toks, lps = self._decode[(bucket, trunc)](
-                self._params, self._cache, last_dev, jnp.asarray(idx),
-                jnp.asarray(temps), jnp.asarray(ks), jnp.asarray(ps),
-                sub, aid=self._aid_batch(aids))
+            if self._paged:
+                # Per-row block tables, padded with the NULL block. Built
+                # from host lists fixed at admission — no device sync, so
+                # chained pipelined dispatch works exactly as flat.
+                nb = bucket // self._kv_bs
+                tables = np.zeros((self.n_slots, nb), np.int32)
+                for i in active:
+                    blk = self._slots[i]["blocks"]
+                    k = min(len(blk), nb)
+                    tables[i, :k] = blk[:k]
+                self._cache, toks, lps = self._decode[(bucket, trunc)](
+                    self._params, self._cache, jnp.asarray(tables),
+                    last_dev, jnp.asarray(idx), jnp.asarray(temps),
+                    jnp.asarray(ks), jnp.asarray(ps), sub,
+                    aid=self._aid_batch(aids))
+            else:
+                self._cache, toks, lps = self._decode[(bucket, trunc)](
+                    self._params, self._cache, last_dev, jnp.asarray(idx),
+                    jnp.asarray(temps), jnp.asarray(ks), jnp.asarray(ps),
+                    sub, aid=self._aid_batch(aids))
         # Start the D2H transfer now; the fetch a pipeline-depth later
         # should find the bytes already on host.
         for arr in (toks, lps):
@@ -1694,6 +2289,7 @@ class GenerationEngine:
             for i, st in enumerate(self._slots):
                 if st is not None and self._expire(st["req"]):
                     self._slots[i] = None
+                    self._free_slot_blocks(st)
             self._poll_pending_first()
             active = [i for i, s in enumerate(self._slots)
                       if s is not None]
@@ -1936,6 +2532,7 @@ class GenerativeJAXModel(Model):
             md["decode_buckets"] = list(self.engine.decode_buckets)
             md["pipeline_depth"] = self.engine.pipeline_depth
             md["speculative"] = self.engine._spec is not None
+            md["paged_kv"] = self.engine.kv_info()
             if self.engine.adapter_names():
                 md["adapters"] = self.engine.adapter_names()
         return md

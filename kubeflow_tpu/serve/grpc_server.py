@@ -123,7 +123,9 @@ class InferenceServicer:
         """Prometheus text over gRPC (`/tpk.Metrics/Prometheus`): the
         SAME rendering the HTTP /metrics endpoint serves — engine
         counters (tpk_decode_dispatch_total, host-stall, admit-overlap,
-        prefix hits), batcher/admission gauges, resilience counters —
+        prefix hits, paged-KV zero-copy/CoW counters and the
+        tpk_kv_blocks_free/used pool gauges admission decides by),
+        batcher/admission gauges, resilience counters —
         so a gRPC-only deployment still gets the full scrape. Raw-bytes
         payload via identity (de)serializers: the message needs no
         schema and the checked-in protoc gencode stays untouched."""

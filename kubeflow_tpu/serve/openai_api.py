@@ -28,6 +28,7 @@ from typing import Any
 
 import tornado.web
 
+from kubeflow_tpu.serve.generation import KVCapacityExceeded
 from kubeflow_tpu.serve.server import _Base, admission_gated, pump_stream
 
 
@@ -54,6 +55,10 @@ class _OpenAIBase(_Base):
         return {"error": {
             "message": "server overloaded: admission queue full",
             "type": "overloaded_error", "code": 503}}
+
+    def capacity_body(self, msg: str) -> dict:
+        return {"error": {"message": msg, "type": "overloaded_error",
+                          "code": 503}}
 
     def _generative(self, name: str):
         """Resolve an OpenAI model id to (model, adapter | None). The
@@ -272,6 +277,11 @@ class _GenerativeHandler(_OpenAIBase):
         try:
             out = await self.await_bounded(
                 self.submit_blocking(model.generate, payload), deadline)
+        except KVCapacityExceeded as e:
+            # Same shed semantics as the native :generate path, wearing
+            # the OpenAI envelope via the capacity_body override.
+            self.write_capacity_shed(str(e))
+            return
         except (ValueError, RuntimeError) as e:
             raise tornado.web.HTTPError(400, reason=str(e)) from None
         text, stopped = _truncate_at_stop(out.get("text", ""), stops)

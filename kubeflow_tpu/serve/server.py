@@ -39,6 +39,7 @@ import tornado.netutil
 import tornado.web
 
 from kubeflow_tpu.serve.batcher import Batcher
+from kubeflow_tpu.serve.generation import KVCapacityExceeded
 from kubeflow_tpu.serve.model import Model, _v2_dtype, v2_to_numpy_dtype
 from kubeflow_tpu.utils import obs
 from kubeflow_tpu.utils.resilience import (Deadline, DeadlineExceeded,
@@ -80,6 +81,15 @@ _ENGINE_METRICS = (
     ("decode_wasted_tokens", "tpk_engine_decode_wasted_tokens_total",
      "counter"),
     ("spec_dispatches", "tpk_engine_spec_dispatch_total", "counter"),
+    # Paged KV cache (ISSUE 6): prefix hits served as zero-copy block
+    # references, copy-on-write tail-block forks, and the live pool
+    # occupancy admission decides by. Flat engines (kv_block_size=0)
+    # emit the counters at 0 and skip the pool gauges.
+    ("kv_cow_copies", "tpk_kv_cow_copies_total", "counter"),
+    ("prefix_zero_copy_hits", "tpk_prefix_zero_copy_hits_total",
+     "counter"),
+    ("__kv_free__", "tpk_kv_blocks_free", "gauge"),
+    ("__kv_used__", "tpk_kv_blocks_used", "gauge"),
     # Live in-flight dispatch count (0 when drained; stuck at ≤1 means
     # the pipeline re-serialized) vs the configured ceiling.
     ("__inflight__", "tpk_decode_inflight_depth", "gauge"),
@@ -128,6 +138,15 @@ class AdmissionController:
     def release(self) -> None:
         with self._lock:
             self._inflight -= 1
+
+    def note_shed(self, component: str = "serve") -> None:
+        """Record an out-of-band shed — e.g. the generation engine
+        refusing a request whose worst-case paged-KV footprint can never
+        fit its pool — so the shed counter and the readiness-degradation
+        window see it exactly like a queue-full rejection."""
+        with self._lock:
+            self._last_shed = time.monotonic()
+        res_metrics.inc("tpk_shed_total", component=component)
 
     @property
     def inflight(self) -> int:
@@ -362,6 +381,11 @@ async def pump_stream(handler, it, render, render_error) -> None:
             return ("ev", next(it, _END))
         except DeadlineExceeded as e:
             return ("expired", f"{type(e).__name__}: {e}")
+        except KVCapacityExceeded as e:
+            # Before ValueError/RuntimeError: pool exhaustion is an
+            # overload shed, not a bad request — same 503 contract as
+            # the non-stream paths.
+            return ("shed", str(e))
         except (ValueError, RuntimeError) as e:
             return ("badreq", f"{type(e).__name__}: {e}")
         except Exception as e:
@@ -374,6 +398,10 @@ async def pump_stream(handler, it, render, render_error) -> None:
         # layers only free resources, they never count).
         res_metrics.inc("tpk_deadline_expired_total", component="serve")
         raise tornado.web.HTTPError(504, reason=ev)
+    if kind == "shed":
+        # Pre-stream shed (submit refused before any frame went out).
+        handler.write_capacity_shed(ev)
+        return
     if kind == "badreq":
         raise tornado.web.HTTPError(400, reason=ev)
     if kind == "err":
@@ -465,6 +493,26 @@ class _Base(tornado.web.RequestHandler):
         """The 503 shed response body — facades with their own error
         envelope (OpenAI) override this so SDK clients can parse it."""
         return {"error": "server overloaded: admission queue full"}
+
+    def capacity_body(self, msg: str) -> dict:
+        """503 body for a paged-KV capacity shed (KVCapacityExceeded) —
+        same override contract as shed_body."""
+        return {"error": msg}
+
+    def write_capacity_shed(self, msg: str) -> None:
+        """THE shed path for paged-KV capacity refusals, shared by every
+        HTTP surface (native :generate, streaming, OpenAI): count it
+        like a queue-full rejection (tpk_shed_total + the readiness
+        window), then write 503 + Retry-After with this surface's
+        envelope. Written directly — send_error would clear the
+        Retry-After header."""
+        adm = self.server.admission
+        if adm is not None:
+            adm.note_shed("serve")
+        else:
+            res_metrics.inc("tpk_shed_total", component="serve")
+        self.set_header("Retry-After", "1")
+        self.write_json(self.capacity_body(msg), status=503)
 
     def _release(self) -> None:
         adm = self.server.admission
@@ -677,6 +725,11 @@ class GenerateHandler(_Base):
         try:
             out = await self.await_bounded(
                 self.submit_blocking(gen, body), deadline)
+        except KVCapacityExceeded as e:
+            # Paged-KV exhaustion is an overload shed, not a bad request
+            # (the spec is valid; THIS replica's pool is too small).
+            self.write_capacity_shed(str(e))
+            return
         except (ValueError, RuntimeError) as e:
             raise tornado.web.HTTPError(400, reason=str(e)) from None
         self.server.observe(name, out.get("num_output_tokens", 0),
@@ -995,6 +1048,15 @@ class ModelServer:
                     val = getattr(engine, "pipeline_depth", 1)
                 elif stat_key == "__inflight__":
                     val = getattr(engine, "inflight_depth", 0)
+                elif stat_key in ("__kv_free__", "__kv_used__"):
+                    # None on flat engines — the pool gauges only exist
+                    # where a pool does.
+                    val = getattr(engine,
+                                  "kv_blocks_free" if stat_key ==
+                                  "__kv_free__" else "kv_blocks_used",
+                                  None)
+                    if val is None:
+                        continue
                 else:
                     val = stats.get(stat_key)
                     if val is None:

@@ -41,6 +41,10 @@ import os
 #:   string_or_null string or null
 #:   bool_or_string bool or string (ring_attention's switch/mode union)
 #:   object         JSON object (contents validated downstream)
+#:   int_array      non-empty JSON array of integral numbers (each
+#:                  >= min when given — an empty bucket list would
+#:                  crash the engine at load, after admission)
+#:   int_or_null    integral number or null
 KNOBS: dict[str, dict] = {
     "model": {"type": "string"},
     "model_kwargs": {"type": "object"},
@@ -82,6 +86,41 @@ KNOBS: dict[str, dict] = {
     "eval_batches": {"type": "int", "min": 1},
 }
 
+#: InferenceService `model.generative` knob table — the serving twin of
+#: KNOBS (same generator, same two consumers). C++ admission validates
+#: the generative object field-by-field against it, so a typo'd serving
+#: knob (or a kv_block_size on a binary that predates paging) fails at
+#: submit instead of as a replica crash-loop. Superset of both
+#: generative runtimes: the causal-LM engine (GenerationEngine kwargs +
+#: GenerativeJAXModel's eos_id/tokenizer/mesh/draft) and the T5
+#: text2text engine (in_buckets/max_tokens/pad_id). Deliberate limit:
+#: which runtime applies is decided by the checkpoint's architectures
+#: at LOAD time, which admission cannot see — so a cross-runtime knob
+#: (in_buckets on a causal-LM service) passes admission and fails at
+#: model load; the table exists to catch typos and type errors early,
+#: not to discriminate engines.
+GENERATIVE_KNOBS: dict[str, dict] = {
+    "slots": {"type": "int", "min": 1},
+    "max_len": {"type": "int", "min": 2},
+    "chunk": {"type": "int", "min": 1},
+    "prefill_buckets": {"type": "int_array", "min": 1},
+    "decode_buckets": {"type": "int_array", "min": 1},
+    "prefix_cache": {"type": "int", "min": 0},
+    "seed": {"type": "int", "min": 0},
+    "pipeline_depth": {"type": "int", "min": 1},
+    # Paged KV cache (serve/paging.py): 0 = flat escape hatch.
+    "kv_block_size": {"type": "int", "min": 0},
+    "kv_blocks": {"type": "int", "min": 0},
+    "mesh": {"type": "object"},
+    "draft": {"type": "object"},
+    "adapters": {"type": "object"},
+    "eos_id": {"type": "int_or_null"},
+    "tokenizer": {"type": "string_or_null"},
+    "in_buckets": {"type": "int_array", "min": 1},
+    "max_tokens": {"type": "int", "min": 1},
+    "pad_id": {"type": "int", "min": 0},
+}
+
 
 def check_against_dataclass() -> None:
     """KNOBS must name exactly the TrainJobSpec fields — a field on either
@@ -101,11 +140,33 @@ def check_against_dataclass() -> None:
             f"and regenerate (python -m kubeflow_tpu.utils.spec_schema)")
 
 
+def check_generative_against_engine() -> None:
+    """Every GenerationEngine kwarg must have a GENERATIVE_KNOBS entry
+    (plus the wrapper-level keys GenerativeJAXModel pops) — a new engine
+    knob without a schema row would be REJECTED by C++ admission on
+    every spec that sets it. `rules` is deliberately schema-less: it
+    takes in-process sharding-rule objects, never JSON."""
+    import inspect
+
+    from kubeflow_tpu.serve.generation import GenerationEngine
+
+    sig = inspect.signature(GenerationEngine.__init__)
+    knobs = {n for n in sig.parameters
+             if n not in ("self", "model", "params", "cfg", "rules")}
+    missing = knobs - set(GENERATIVE_KNOBS)
+    if missing:
+        raise AssertionError(
+            f"generative schema drift: GenerationEngine kwargs missing "
+            f"from GENERATIVE_KNOBS: {sorted(missing)} — edit "
+            f"kubeflow_tpu/utils/spec_schema.py and regenerate")
+
+
 def schema_document() -> dict:
     return {
         "version": 1,
         "generated_by": "kubeflow_tpu/utils/spec_schema.py",
         "JAXJob.runtime": KNOBS,
+        "InferenceService.model.generative": GENERATIVE_KNOBS,
     }
 
 

@@ -22,6 +22,14 @@ def test_servebench_quick_shape():
     assert ab["sync_depth1"]["overlapped_fetches"] == 0
     assert ab["pipelined_depth2"]["overlapped_fetches"] > 0
     assert ab["speedup_wall"] > 0
+    # Paged-vs-flat A/B (ISSUE 6 tentpole): equal pool memory, paged
+    # decode width doubled — the paged engine must actually RUN more
+    # concurrent requests than the flat engine has slots.
+    pf = r["paged_vs_flat"]
+    assert pf["flat"]["tok_s_e2e"] > 0 and pf["paged"]["tok_s_e2e"] > 0
+    assert pf["paged"]["pool_tokens"] == pf["flat"]["pool_tokens"]
+    assert pf["paged"]["peak_inflight_requests"] > pf["flat"]["slots"]
+    assert pf["concurrency_gain"] > 1
     # Decode concurrency section: throughput positive at each slot count.
     assert set(r["decode"]) == {"slots_1", "slots_2"}
     for v in r["decode"].values():

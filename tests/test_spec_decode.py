@@ -324,3 +324,57 @@ def test_spec_composes_with_mesh(target):
         assert eng.stats["spec_dispatches"] > 0
     finally:
         eng.close()
+
+
+def test_spec_stale_ride_excludes_unworthy_from_readmission(target):
+    """ADVICE r5 partial fix: a permanently-unworthy demoted slot (the
+    replay can never pay for itself) no longer gates speculation for
+    the whole batch — worthy traffic speculates while the unworthy slot
+    rides the spec chunk with STALE draft rows, and its output stays
+    token-identical (every emitted token comes from the target verify).
+    `_readmit_worthwhile` is forced False to model the permanently-
+    unworthy class deterministically (near-budget / history >> remainder
+    are timing windows on CPU)."""
+    import threading
+
+    cfg, model, params = target
+    vanilla = _engine(target)
+    try:
+        ref_a = vanilla.submit([5, 9, 2], max_tokens=48)
+        ref_c = vanilla.submit([4, 4, 1], max_tokens=16)
+    finally:
+        vanilla.close()
+    spec = _engine(target, chunk=4,
+                   draft={"model": model, "params": params,
+                          "cfg": cfg, "gamma": 3})
+    spec._readmit_worthwhile = lambda st: False
+    try:
+        results = {}
+
+        def greedy_long():
+            results["a"] = spec.submit([5, 9, 2], max_tokens=48)
+
+        def sampled_then_greedy():
+            # The truncated-sampling request forces vanilla chunks
+            # (demoting A's draft cache); once it retires, the fresh
+            # greedy C makes the batch spec-able again — under the old
+            # batch-wide gate, unworthy-A would keep everyone vanilla.
+            results["s"] = spec.submit([8, 1], max_tokens=12,
+                                       temperature=0.9, top_p=0.9)
+            results["c"] = spec.submit([4, 4, 1], max_tokens=16)
+
+        ts = [threading.Thread(target=greedy_long),
+              threading.Thread(target=sampled_then_greedy)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=180)
+        assert results["a"]["output_ids"] == ref_a["output_ids"]
+        assert results["c"]["output_ids"] == ref_c["output_ids"]
+        s = spec.stats
+        assert s["spec_demotions"] >= 1, s
+        assert s["spec_stale_rides"] >= 1, s   # A rode without replay
+        assert s["spec_readmissions"] == 0, s  # nothing replayed
+        assert s["spec_dispatches"] > 0, s
+    finally:
+        spec.close()

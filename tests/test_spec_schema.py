@@ -84,3 +84,17 @@ def test_generator_is_deterministic():
         capture_output=True, text=True, cwd=REPO)
     assert out.returncode == 0, out.stderr
     assert out.stdout == spec_schema.render_json()
+
+
+def test_generative_knobs_cover_engine_kwargs():
+    """Serving twin of the dataclass cross-check: every GenerationEngine
+    kwarg must have a GENERATIVE_KNOBS row (C++ admission rejects
+    unknown generative fields, so a schema-less knob would be
+    unsubmittable), including the paged-KV knobs."""
+    spec_schema.check_generative_against_engine()
+    for knob in ("kv_block_size", "kv_blocks", "slots", "max_len",
+                 "pipeline_depth", "prefix_cache"):
+        assert knob in spec_schema.GENERATIVE_KNOBS, knob
+    doc = spec_schema.schema_document()
+    assert doc["InferenceService.model.generative"] \
+        == spec_schema.GENERATIVE_KNOBS
