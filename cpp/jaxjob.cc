@@ -225,8 +225,12 @@ void JaxJobController::LaunchGang(JobView& job) {
     FILE* f = fopen(spec_path.c_str(), "w");
     if (f) {
       std::string text = runtime.is_null() ? "{}" : runtime.dump();
-      fwrite(text.data(), 1, text.size(), f);
-      fclose(f);
+      bool ok = fwrite(text.data(), 1, text.size(), f) == text.size();
+      ok = fclose(f) == 0 && ok;
+      // A torn spec must not reach the worker: a missing file fails the
+      // replica loudly at startup instead of silently training a
+      // truncated runtime config.
+      if (!ok) remove(spec_path.c_str());
     }
   }
 

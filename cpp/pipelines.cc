@@ -75,9 +75,9 @@ void MkdirP(const std::string& path) {
 bool WriteFile(const std::string& path, const std::string& content) {
   FILE* f = fopen(path.c_str(), "w");
   if (!f) return false;
-  fwrite(content.data(), 1, content.size(), f);
-  fclose(f);
-  return true;
+  bool ok = fwrite(content.data(), 1, content.size(), f) == content.size();
+  ok = fclose(f) == 0 && ok;
+  return ok;
 }
 
 void ListDirSorted(const std::string& dir, const std::string& rel,
@@ -156,8 +156,19 @@ void LineageStore::Record(const std::string& fingerprint,
   if (!path_.empty() && !file_) file_ = fopen(path_.c_str(), "a");
   if (file_) {
     std::string line = rec.dump() + "\n";
-    fwrite(line.data(), 1, line.size(), file_);
-    fflush(file_);
+    if (fwrite(line.data(), 1, line.size(), file_) != line.size() ||
+        fflush(file_) != 0) {
+      // Short write: the file may now end in a torn line. Stop
+      // appending FOR GOOD (clearing path_ disables the lazy reopen
+      // above — a later append would glue onto the torn line and make
+      // the next Load() drop that whole glued record, the ISSUE 2 WAL
+      // bug class). Memory stays authoritative for this run; Load()
+      // already drops an unparseable tail, so the next start simply
+      // re-executes the uncached tasks instead of reading garbage.
+      fclose(file_);
+      file_ = nullptr;
+      path_.clear();
+    }
   }
 }
 

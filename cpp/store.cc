@@ -93,6 +93,7 @@ void FsyncDirOf(const std::string& path) {
   std::string dir = slash == std::string::npos ? "." : path.substr(0, slash);
   int fd = open(dir.c_str(), O_RDONLY);
   if (fd >= 0) {
+    // tpk-lint: allow(cpp-checked-io) reason=deliberate best-effort per the comment above: not every filesystem supports directory fsync, and failure here never loses applied state
     fsync(fd);
     close(fd);
   }

@@ -6,6 +6,7 @@
 #include <sys/stat.h>
 
 #include <cstdio>
+#include <cstdlib>
 #include <string>
 
 #include "executor.h"
@@ -60,7 +61,14 @@ void WriteArtifact(const std::string& run, const std::string& task,
     cur += c;
   }
   FILE* f = fopen((dir + "/data.txt").c_str(), "w");
-  fwrite(content.data(), 1, content.size(), f);
+  // Fixture writes must not fail silently: a short artifact would turn
+  // downstream cache/lineage assertions into confusing false failures.
+  if (!f || fwrite(content.data(), 1, content.size(), f)
+                != content.size()) {
+    fprintf(stderr, "FAIL %s:%d: fixture write %s\n", __FILE__, __LINE__,
+            dir.c_str());
+    abort();
+  }
   fclose(f);
 }
 

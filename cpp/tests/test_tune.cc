@@ -7,6 +7,7 @@
 #include <sys/stat.h>
 
 #include <cstdio>
+#include <cstdlib>
 #include <string>
 
 #include "executor.h"
@@ -50,7 +51,14 @@ void WriteLog(const std::string& job, const std::string& content) {
   std::string dir = std::string(kWorkdir) + "/" + job;
   mkdir(dir.c_str(), 0755);
   FILE* f = fopen((dir + "/worker-0.log").c_str(), "w");
-  fwrite(content.data(), 1, content.size(), f);
+  // Fixture writes must not fail silently: a short log would turn the
+  // metric-parsing assertions into confusing false failures.
+  if (!f || fwrite(content.data(), 1, content.size(), f)
+                != content.size()) {
+    fprintf(stderr, "FAIL %s:%d: fixture write %s\n", __FILE__, __LINE__,
+            dir.c_str());
+    abort();
+  }
   fclose(f);
 }
 

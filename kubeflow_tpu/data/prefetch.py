@@ -102,12 +102,20 @@ class Prefetcher:
         self._place = place
         self._state_fn = state_fn or (lambda: iterator_state(self._it))
         self._component = component
+        # Worker-thread writes race the training thread's stats/window
+        # reads (and depth-0 counters live on the consumer thread): one
+        # lock keeps the counter quartet tear-free.
+        self._lock = threading.Lock()
+        # guarded-by: _lock
         self._pulled = 0     # raw batches pulled from the iterator
+        # guarded-by: _lock
         self._consumed = 0   # batches handed to the caller
         self._exc: BaseException | None = None
         self._exhausted = False
         self._closed = False
+        # guarded-by: _lock
         self.data_wait_s = 0.0  # training-thread time spent inside next()
+        # guarded-by: _lock
         self.h2d_s = 0.0        # wall time spent in place() (H2D staging)
         resilience.metrics.set_gauge("tpk_data_prefetch_depth",
                                      self._depth, component=component)
@@ -124,6 +132,7 @@ class Prefetcher:
 
     # -- worker --------------------------------------------------------------
 
+    # tpk-hot: prefetch-worker
     def _prep(self, raw: Any) -> Any:
         if self._transform is not None:
             raw = self._transform(raw)
@@ -131,7 +140,8 @@ class Prefetcher:
             t0 = time.perf_counter()
             raw = self._place(raw)
             dt = time.perf_counter() - t0
-            self.h2d_s += dt
+            with self._lock:
+                self.h2d_s += dt
             resilience.metrics.inc("tpk_data_h2d_seconds_total", dt,
                                    component=self._component)
         return raw
@@ -147,10 +157,13 @@ class Prefetcher:
                 continue
         return False
 
+    # tpk-hot: prefetch-worker
     def _worker(self) -> None:
         while not self._stop.is_set():
+            with self._lock:
+                n = self._pulled
             try:
-                faults.fire(_FP_NEXT, n=self._pulled)
+                faults.fire(_FP_NEXT, n=n)
                 raw = next(self._it)
             except StopIteration:
                 self._offer(_STOP)
@@ -158,7 +171,8 @@ class Prefetcher:
             except BaseException as e:
                 self._offer(_Failure(e))
                 return
-            self._pulled += 1
+            with self._lock:
+                self._pulled += 1
             try:
                 # Snapshot BEFORE reading ahead any further: this state
                 # resumes at the batch after `raw` — what a checkpoint
@@ -182,11 +196,15 @@ class Prefetcher:
             if self._depth == 0:
                 if self._closed:
                     raise RuntimeError("Prefetcher is closed")
-                faults.fire(_FP_NEXT, n=self._pulled)
+                with self._lock:
+                    n = self._pulled
+                faults.fire(_FP_NEXT, n=n)
                 raw = next(self._it)  # StopIteration propagates as-is
-                self._pulled += 1
+                with self._lock:
+                    self._pulled += 1
                 batch = self._prep(raw)
-                self._consumed += 1
+                with self._lock:
+                    self._consumed += 1
                 return batch
             if self._exc is not None:
                 raise self._exc
@@ -205,11 +223,13 @@ class Prefetcher:
                 raise item.exc
             batch, state = item
             self._consumed_state = state
-            self._consumed += 1
+            with self._lock:
+                self._consumed += 1
             return batch
         finally:
             dt = time.perf_counter() - t0
-            self.data_wait_s += dt
+            with self._lock:
+                self.data_wait_s += dt
             resilience.metrics.inc("tpk_data_wait_seconds_total", dt,
                                    component=self._component)
 
@@ -224,13 +244,14 @@ class Prefetcher:
 
     @property
     def stats(self) -> dict:
-        return {
-            "depth": self._depth,
-            "pulled": self._pulled,
-            "consumed": self._consumed,
-            "data_wait_s": self.data_wait_s,
-            "h2d_s": self.h2d_s,
-        }
+        with self._lock:
+            return {
+                "depth": self._depth,
+                "pulled": self._pulled,
+                "consumed": self._consumed,
+                "data_wait_s": self.data_wait_s,
+                "h2d_s": self.h2d_s,
+            }
 
     def close(self, timeout: float = 5.0) -> None:
         """Stop and join the worker (idempotent; every trainer exit path

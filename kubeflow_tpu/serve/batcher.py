@@ -92,6 +92,8 @@ class Batcher:
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="tpk-batcher")
         self._closed = False
+        self._lock = threading.Lock()
+        # guarded-by: _lock
         self.stats = {"batches": 0, "items": 0, "examples": 0}
         self._thread.start()
 
@@ -127,6 +129,7 @@ class Batcher:
 
     # -- worker -------------------------------------------------------------
 
+    # tpk-hot: batcher-worker
     def _gather(self) -> list[_Item] | None:
         """Blocks for the first item, then drains until size limit or until
         max_latency has elapsed since the FIRST item was ENQUEUED (not
@@ -173,6 +176,7 @@ class Batcher:
             total += nxt.n
         return batch
 
+    # tpk-hot: batcher-worker
     def _loop(self) -> None:
         while True:
             batch = self._gather()
@@ -215,9 +219,10 @@ class Batcher:
                     tracer.record("serve.predict", t_flush, t1, item.trace,
                                   items=len(batch),
                                   examples=sum(i.n for i in batch))
-            self.stats["batches"] += 1
-            self.stats["items"] += len(batch)
-            self.stats["examples"] += sum(i.n for i in batch)
+            with self._lock:
+                self.stats["batches"] += 1
+                self.stats["items"] += len(batch)
+                self.stats["examples"] += sum(i.n for i in batch)
             off = 0
             for item in batch:
                 item.deliver([o[off:off + item.n] for o in outs])

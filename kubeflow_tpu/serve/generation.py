@@ -914,6 +914,12 @@ class GenerationEngine:
         self._queue: queue.Queue = queue.Queue()
         self._wake = threading.Event()
         self._stop = False
+        # Worker-thread writes race metrics/metadata readers; the lock
+        # makes snapshots tear-free AND keeps `dict(stats)` safe against
+        # the first adapter-request key insertion (an unlocked dict copy
+        # concurrent with a key insert can raise RuntimeError).
+        self._stats_lock = threading.Lock()
+        # guarded-by: _stats_lock
         self.stats = {"requests": 0, "prompt_tokens": 0, "decode_tokens": 0,
                       "decode_seconds": 0.0, "decode_dispatches": 0,
                       "prefix_hits": 0, "prefix_hit_tokens": 0,
@@ -1372,7 +1378,8 @@ class GenerationEngine:
         self._prefix_lru[key] = (kt, frag if not copy
                                  else jax.tree.map(jnp.copy, frag))
         self._prefix_lru.move_to_end(key)
-        self.stats["prefix_stores"] += 1
+        with self._stats_lock:
+            self.stats["prefix_stores"] += 1
         while len(self._prefix_lru) > self._prefix_cap:
             self._prefix_evict_oldest()
 
@@ -1438,7 +1445,8 @@ class GenerationEngine:
         self._kv_alloc.incref(blocks)
         self._prefix_lru[key] = (kt, tuple(blocks))
         self._prefix_lru.move_to_end(key)
-        self.stats["prefix_stores"] += 1
+        with self._stats_lock:
+            self.stats["prefix_stores"] += 1
         while len(self._prefix_lru) > self._prefix_cap:
             self._prefix_evict_oldest()
 
@@ -1568,10 +1576,12 @@ class GenerationEngine:
           * the whole worst-case block need is allocated here, off the
             decode critical path (see `_paged_need_tokens`).
 
-        KEEP IN SYNC with `_admit_inner`: the chunked-prefill loop is a
-        deliberate textual copy (flat must stay byte-untouched); any fix
-        to the recipe there must land here too, or the seeded
-        flat-vs-paged identity test breaks.
+        The chunked-prefill loop is a deliberate textual copy of
+        `_admit_inner`'s (flat must stay byte-untouched); the
+        `admit-chunked-prefill` / `admit-slot-state` tpk-sync regions
+        enforce the twinning mechanically — a fix landing in only one
+        loop fails tier-1 (rule sync-regions) instead of breaking the
+        seeded flat-vs-paged identity test at runtime.
         """
         ids = req["input_ids"]
         aid = req.get("aid", 0)
@@ -1604,15 +1614,16 @@ class GenerationEngine:
             # in the normal flow; defense against future reordering.
             raise _NeedKVBlocks()
         if self._prefix_cap:
-            if hit is not None:
-                self.stats["prefix_hits"] += 1
-                self.stats["prefix_hit_tokens"] += done
-                if shared:
-                    self.stats["prefix_zero_copy_hits"] += 1
-                if cow_fork:
-                    self.stats["kv_cow_copies"] += 1
-            else:
-                self.stats["prefix_misses"] += 1
+            with self._stats_lock:
+                if hit is not None:
+                    self.stats["prefix_hits"] += 1
+                    self.stats["prefix_hit_tokens"] += done
+                    if shared:
+                        self.stats["prefix_zero_copy_hits"] += 1
+                    if cow_fork:
+                        self.stats["kv_cow_copies"] += 1
+                else:
+                    self.stats["prefix_misses"] += 1
         self._kv_alloc.incref(shared)
         table = shared + fresh
         boundaries: list[int] = []
@@ -1624,6 +1635,8 @@ class GenerationEngine:
                 gt = np.zeros((mb,), np.int32)
                 gt[:len(gather_tbl)] = gather_tbl
                 frag = self._frag_from_pool(self._cache, jnp.asarray(gt))
+            # tpk-sync: begin admit-chunked-prefill paged
+            # tpk-sync: sub self._prefix_store(aid, tuple(ids[:done]), frag, copy=done < len(ids)) -> boundaries.append(done)
             while done < len(ids):
                 piece = ids[done:done + big]
                 final = done + len(piece) >= len(ids)
@@ -1656,6 +1669,7 @@ class GenerationEngine:
                     chunks_left = -(-(len(ids) - done) // big)
                     if chunks_left < self._prefix_cap:
                         boundaries.append(done)
+            # tpk-sync: end admit-chunked-prefill
             # Scatter table: shared prefix blocks masked to NULL (their
             # rows are already resident and immutable), owned blocks
             # receive their fragment rows — including the CoW fork and
@@ -1670,6 +1684,9 @@ class GenerationEngine:
         for m in boundaries:
             self._prefix_store_paged(aid, tuple(ids[:m]),
                                      table[:blocks_for(m, bs)])
+        # tpk-sync: begin admit-slot-state paged
+        # tpk-sync: sub 'draft_ok': draft_ok -> 'draft_ok': False
+        # tpk-sync: sub 'aid': aid} -> 'aid': aid, 'blocks': table}
         st = {"req": req, "idx": len(ids), "disp": len(ids), "last": None,
               "pending": None, "draft_ok": False, "aid": aid,
               "blocks": table}
@@ -1681,15 +1698,17 @@ class GenerationEngine:
         else:
             st["last"] = int(tok0[0])
             self._slots[slot] = st
-        self.stats["requests"] += 1
-        self.stats["prompt_tokens"] += len(ids)
-        if aid:
-            per = dict(self.stats.get("adapter_requests", {}))
-            name = self._ml_names[aid]
-            per[name] = per.get(name, 0) + 1
-            self.stats["adapter_requests"] = per
+        with self._stats_lock:
+            self.stats["requests"] += 1
+            self.stats["prompt_tokens"] += len(ids)
+            if aid:
+                per = dict(self.stats.get("adapter_requests", {}))
+                name = self._ml_names[aid]
+                per[name] = per.get(name, 0) + 1
+                self.stats["adapter_requests"] = per
         if st["pending"] is None:
             self._emit(slot, st, [st["last"]], [float(lp0[0])])
+        # tpk-sync: end admit-slot-state
 
     def _admit(self, slot: int, req: dict) -> None:
         tracer = obs.get_tracer()
@@ -1721,10 +1740,11 @@ class GenerationEngine:
         # first chunk is a plain prefill, the rest are continuation
         # chunks attending over the whole fragment cache — no silent
         # truncation (submit() already bounds the prompt by max_len).
-        # KEEP IN SYNC with _admit_inner_paged's loop: the recipe
-        # (piece slicing, bucket choice, RNG split order, boundary
-        # gating) is duplicated there so the flat path stays textually
-        # untouched — a change landing in only one breaks the
+        # The recipe (piece slicing, bucket choice, RNG split order,
+        # boundary gating) is duplicated in _admit_inner_paged so the
+        # flat path stays textually untouched; the tpk-sync regions
+        # below enforce the twinning — a change landing in only one
+        # side fails tier-1 (rule sync-regions) instead of breaking the
         # paged-is-token-identical-to-flat invariant the seeded test
         # pins.
         big = self.prefill_buckets[-1]
@@ -1733,10 +1753,13 @@ class GenerationEngine:
             hit = self._prefix_lookup(ids, aid)
             if hit is not None:
                 done, frag = hit
-                self.stats["prefix_hits"] += 1
-                self.stats["prefix_hit_tokens"] += done
+                with self._stats_lock:
+                    self.stats["prefix_hits"] += 1
+                    self.stats["prefix_hit_tokens"] += done
             else:
-                self.stats["prefix_misses"] += 1
+                with self._stats_lock:
+                    self.stats["prefix_misses"] += 1
+        # tpk-sync: begin admit-chunked-prefill flat
         while done < len(ids):
             piece = ids[done:done + big]
             final = done + len(piece) >= len(ids)
@@ -1772,6 +1795,7 @@ class GenerationEngine:
                 if chunks_left < self._prefix_cap:
                     self._prefix_store(aid, tuple(ids[:done]), frag,
                                        copy=done < len(ids))
+        # tpk-sync: end admit-chunked-prefill
         self._cache = self._insert(self._cache, frag, jnp.int32(slot))
         spec_able = (req.get("top_k", 0) == 0
                      and req.get("top_p", 1.0) >= 1.0)
@@ -1788,6 +1812,7 @@ class GenerationEngine:
                                          self._draft_replay(ids),
                                          jnp.int32(slot))
             draft_ok = True
+        # tpk-sync: begin admit-slot-state flat
         st = {"req": req, "idx": len(ids), "disp": len(ids), "last": None,
               "pending": None, "draft_ok": draft_ok, "aid": aid}
         if self.pipeline_depth > 1:
@@ -1804,18 +1829,20 @@ class GenerationEngine:
         else:
             st["last"] = int(tok0[0])
             self._slots[slot] = st
-        self.stats["requests"] += 1
-        self.stats["prompt_tokens"] += len(ids)
-        if aid:
-            # Copy-on-write: metadata() snapshots stats with a SHALLOW
-            # dict() from another thread — swapping in a fresh dict keeps
-            # any in-flight snapshot's inner reference immutable.
-            per = dict(self.stats.get("adapter_requests", {}))
-            name = self._ml_names[aid]
-            per[name] = per.get(name, 0) + 1
-            self.stats["adapter_requests"] = per
+        with self._stats_lock:
+            self.stats["requests"] += 1
+            self.stats["prompt_tokens"] += len(ids)
+            if aid:
+                # Copy-on-write: stats_snapshot() copies stats SHALLOWLY
+                # from another thread — swapping in a fresh dict keeps
+                # any in-flight snapshot's inner reference immutable.
+                per = dict(self.stats.get("adapter_requests", {}))
+                name = self._ml_names[aid]
+                per[name] = per.get(name, 0) + 1
+                self.stats["adapter_requests"] = per
         if st["pending"] is None:
             self._emit(slot, st, [st["last"]], [float(lp0[0])])
+        # tpk-sync: end admit-slot-state
 
     def _draft_replay(self, ids: list[int]) -> Any:
         """Chunked draft-cache build over a token sequence — the ONE
@@ -1859,7 +1886,8 @@ class GenerationEngine:
         self._dcache = self._dinsert(self._dcache, self._draft_replay(ids),
                                      jnp.int32(slot))
         st["draft_ok"] = True
-        self.stats["spec_readmissions"] += 1
+        with self._stats_lock:
+            self.stats["spec_readmissions"] += 1
 
     def _emit(self, slot: int, st: dict, tokens: list[int],
               logprobs: list[float] | None = None) -> None:
@@ -1966,7 +1994,8 @@ class GenerationEngine:
                     self._slots[slot] = None
                     continue  # slot still free; try the next waiter
                 if overlap:
-                    self.stats["admit_overlap"] += 1
+                    with self._stats_lock:
+                        self.stats["admit_overlap"] += 1
                 break
 
     def _emit_pending(self, slot: int, st: dict) -> None:
@@ -2080,7 +2109,8 @@ class GenerationEngine:
             for i in worthy:
                 self._readmit_draft(i, self._slots[i])
         if stale:
-            self.stats["spec_stale_rides"] += stale
+            with self._stats_lock:
+                self.stats["spec_stale_rides"] += stale
         bucket = next((b for b in self.decode_buckets if b >= need),
                       self.decode_buckets[-1])
         with self._scope():
@@ -2101,29 +2131,38 @@ class GenerationEngine:
                 tracer.record("serve.decode_chunk", p0, p1,
                               self._slots[i]["req"].get("trace", ""),
                               slot=i, spec=True)
-        self.stats["decode_seconds"] += now - t0
-        self.stats["host_stall_seconds"] += now - t0
-        self.stats["decode_fetch_blocking"] += 1
+        with self._stats_lock:
+            self.stats["decode_seconds"] += now - t0
+            self.stats["host_stall_seconds"] += now - t0
+            self.stats["decode_fetch_blocking"] += 1
+            self.stats["decode_dispatches"] += 1
+            self.stats["spec_dispatches"] += 1
         self._busy_mark = now
-        self.stats["decode_dispatches"] += 1
-        self.stats["spec_dispatches"] += 1
         for i in active:
             emit_t: list[int] = []
             emit_l: list[float] = []
+            accepted = 0
             for s in range(self._spec["n_spec"]):
                 kk = int(acc[i, s])
                 emit_t += [int(t) for t in toks[i, s, :kk + 1]]
                 emit_l += [float(v) for v in lps[i, s, :kk + 1]]
-                self.stats["spec_proposed"] += self._spec["gamma"]
-                self.stats["spec_accepted"] += kk
+                accepted += kk
             st = self._slots[i]
             st["idx"] += len(emit_t)
             st["disp"] = st["idx"]
             st["last"] = emit_t[-1]
-            self.stats["decode_tokens"] += len(emit_t)
+            # One acquisition per slot (not per speculative step): the
+            # counters are accumulated locally first — same totals,
+            # bounded contention with metrics readers on the hot path.
+            with self._stats_lock:
+                self.stats["spec_proposed"] += (self._spec["gamma"]
+                                                * self._spec["n_spec"])
+                self.stats["spec_accepted"] += accepted
+                self.stats["decode_tokens"] += len(emit_t)
             self._emit(i, st, emit_t, emit_l)
         return True
 
+    # tpk-hot: engine-dispatch
     def _dispatch_chunk(self, active: list[int],
                         carry: dict | None = None) -> dict:
         """Issue one chunked decode dispatch over the slot batch WITHOUT
@@ -2198,7 +2237,8 @@ class GenerationEngine:
         # should find the bytes already on host.
         for arr in (toks, lps):
             getattr(arr, "copy_to_host_async", lambda: None)()
-        self.stats["decode_dispatches"] += 1
+        with self._stats_lock:
+            self.stats["decode_dispatches"] += 1
         parts: dict[int, dict] = {}
         for i in active:
             st = self._slots[i]
@@ -2207,6 +2247,7 @@ class GenerationEngine:
         return {"toks": toks, "lps": lps, "parts": parts, "t0": t0,
                 "p0": p0, "chunk": self.chunk}
 
+    # tpk-hot: engine-fetch
     def _fetch_chunk(self, rec: dict, overlapped: bool) -> None:
         """Fetch one dispatch record's tokens (the host sync point) and
         reconcile: a slot whose dispatch-time occupant already retired
@@ -2217,7 +2258,12 @@ class GenerationEngine:
         count guard test pins)."""
         t0 = time.monotonic()
         pf0 = time.perf_counter()
+        # THE one designed host sync of the decode pipeline: everything
+        # below is host numpy. (The runtime fetch-count guard test pins
+        # exactly one fetch pair per chunk.)
+        # tpk-lint: allow(host-sync) reason=the designed per-chunk fetch boundary; D2H was prestaged by copy_to_host_async at dispatch
         toks = np.asarray(rec["toks"])  # host sync point: [B, chunk]
+        # tpk-lint: allow(host-sync) reason=second half of the designed per-chunk fetch boundary (logprobs ride the same prestaged copy)
         lps = np.asarray(rec["lps"])
         now = time.monotonic()
         pf1 = time.perf_counter()
@@ -2233,27 +2279,30 @@ class GenerationEngine:
                               slot=i, chunk=rec["chunk"],
                               overlapped=overlapped)
                 tracer.record("serve.fetch", pf0, pf1, trace, slot=i)
-        self.stats["host_stall_seconds"] += now - t0
-        self.stats["decode_fetch_overlapped" if overlapped
-                    else "decode_fetch_blocking"] += 1
         # decode_seconds sums ENGINE-BUSY wall time (non-overlapping
         # intervals), so throughput() stays honest when chunks overlap.
         start = (rec["t0"] if self._busy_mark is None
                  else max(self._busy_mark, rec["t0"]))
-        self.stats["decode_seconds"] += now - start
+        with self._stats_lock:
+            self.stats["host_stall_seconds"] += now - t0
+            self.stats["decode_fetch_overlapped" if overlapped
+                        else "decode_fetch_blocking"] += 1
+            self.stats["decode_seconds"] += now - start
         self._busy_mark = now
         for i, st in rec["parts"].items():
             if self._slots[i] is not st:
-                self.stats["decode_dead_slot_chunks"] += 1
-                self.stats["decode_wasted_tokens"] += rec["chunk"]
+                with self._stats_lock:
+                    self.stats["decode_dead_slot_chunks"] += 1
+                    self.stats["decode_wasted_tokens"] += rec["chunk"]
                 continue
             if st["pending"] is not None:
                 # First token of a mid-pipe admission: emit it before
                 # the chunk tokens (the chunk was decoded FROM it).
                 self._emit_pending(i, st)
                 if self._slots[i] is not st:  # EOS/budget at token 1
-                    self.stats["decode_dead_slot_chunks"] += 1
-                    self.stats["decode_wasted_tokens"] += rec["chunk"]
+                    with self._stats_lock:
+                        self.stats["decode_dead_slot_chunks"] += 1
+                        self.stats["decode_wasted_tokens"] += rec["chunk"]
                     continue
             st["idx"] += rec["chunk"]
             st["last"] = int(toks[i, -1])
@@ -2263,13 +2312,15 @@ class GenerationEngine:
             # (_readmit_draft, once the batch is all-spec-able
             # again). spec_demotions / spec_readmissions count both
             # sides (perf effects, never correctness).
-            if st.get("draft_ok"):
-                self.stats["spec_demotions"] += 1
+            with self._stats_lock:
+                if st.get("draft_ok"):
+                    self.stats["spec_demotions"] += 1
+                self.stats["decode_tokens"] += rec["chunk"]
             st["draft_ok"] = False
-            self.stats["decode_tokens"] += rec["chunk"]
             self._emit(i, st, [int(t) for t in toks[i]],
                        [float(v) for v in lps[i]])
 
+    # tpk-hot: engine-loop
     def _loop(self) -> None:
         """The scheduler: admit → sweep deadlines → keep up to
         `pipeline_depth` decode chunks in flight → fetch the oldest.
@@ -2311,8 +2362,15 @@ class GenerationEngine:
                 self.inflight_depth = len(inflight)
                 self._fetch_chunk(rec, overlapped=bool(inflight))
 
+    def stats_snapshot(self) -> dict:
+        """Tear-free copy of the engine counters for metrics/metadata
+        readers on other threads. Shallow by design: inner values are
+        swapped whole (copy-on-write), never mutated in place."""
+        with self._stats_lock:
+            return dict(self.stats)
+
     def throughput(self) -> float:
-        s = self.stats
+        s = self.stats_snapshot()
         return s["decode_tokens"] / s["decode_seconds"] if s["decode_seconds"] else 0.0
 
 
@@ -2525,7 +2583,7 @@ class GenerativeJAXModel(Model):
             "generative": True,
             "max_len": self._gen_cfg.get("max_len", 256),
             "vocab_size": getattr(self.cfg, "vocab_size", None),
-            "stats": dict(self.engine.stats) if self.engine else {},
+            "stats": self.engine.stats_snapshot() if self.engine else {},
             "mesh": self._mesh_spec or None,
         })
         if self.engine:

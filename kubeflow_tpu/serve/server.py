@@ -119,8 +119,8 @@ class AdmissionController:
                              f"{max_inflight}")
         self.max_inflight = int(max_inflight)
         self.retry_after_s = float(retry_after_s)
-        self._inflight = 0
-        self._last_shed = -float("inf")
+        self._inflight = 0  # guarded-by: _lock
+        self._last_shed = -float("inf")  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def try_acquire(self, component: str = "serve") -> bool:
@@ -1035,10 +1035,14 @@ class ModelServer:
             except Exception:
                 continue  # unloaded between names() and get()
             engine = getattr(model, "engine", None)
-            stats = getattr(engine, "stats", None)
+            snap = getattr(engine, "stats_snapshot", None)
+            # Locked shallow snapshot (the engine worker mutates its
+            # dict); plain-dict fallback for engines without the lock
+            # (text2text's single-threaded stats).
+            stats = snap() if callable(snap) else getattr(engine, "stats",
+                                                          None)
             if not stats:
                 continue
-            # Shallow snapshot: the engine worker mutates its dict.
             rows.append((name, engine, dict(stats)))
         lines: list[str] = []
         for stat_key, metric, kind in _ENGINE_METRICS:

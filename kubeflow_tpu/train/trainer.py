@@ -810,6 +810,14 @@ class Trainer:
             timer.start()
             win_reset()
             window = 0
+            # The hot loop: between log/eval boundaries nothing below
+            # may touch a device value — host data prep and device
+            # compute only overlap while the dispatch queue stays full.
+            # The tpk-hot region makes that reviewable-by-machine; the
+            # runtime sync-budget guard test pins the same invariant
+            # dynamically. Every deliberate boundary fetch below carries
+            # its reason inline.
+            # tpk-hot: begin trainer-step-loop
             for step in range(start_step, spec.steps):
                 faults.fire(_FP_STEP, step=step)
                 if fault_step is not None and step == fault_step:
@@ -833,6 +841,7 @@ class Trainer:
                 acc_span("step", sp)
                 window += 1
                 if prof_active and step + 1 == prof_stop:
+                    # tpk-lint: allow(host-sync) reason=profiler window close must drain the device or the trace tail is lost; runs only on the configured profile_stop_step
                     jax.block_until_ready(metrics["loss"])
                     jax.profiler.stop_trace()
                     prof_active = False
@@ -864,6 +873,7 @@ class Trainer:
                     if window:
                         with obs.span("train.fetch",
                                       trace_id=self._trace) as sp_fetch:
+                            # tpk-lint: allow(host-sync) reason=eval boundary closes the timing window so eval wall never pollutes tokens/sec (designed per-eval_every fetch)
                             jax.block_until_ready(metrics["loss"])
                         timer.stop(n_steps=window)
                         window = 0
@@ -886,6 +896,7 @@ class Trainer:
                     # averages).
                     with obs.span("train.fetch",
                                   trace_id=self._trace) as sp:
+                        # tpk-lint: allow(host-sync) reason=the designed per-log_every window boundary; the runtime guard budgets exactly one fetch here
                         jax.block_until_ready(metrics["loss"])
                     acc_span("fetch", sp)
                     if window:
@@ -894,7 +905,9 @@ class Trainer:
                     else:  # an eval just flushed this window
                         perf = timer.snapshot()
                     last_metrics = {
+                        # tpk-lint: allow(host-sync) reason=already on host after the boundary block_until_ready above; free fetch
                         "loss": float(metrics["loss"]),
+                        # tpk-lint: allow(host-sync) reason=already on host after the boundary block_until_ready above; free fetch
                         "grad_norm": float(metrics["grad_norm"]),
                         "tokens_per_sec": perf["tokens_per_sec"],
                         "mfu": perf["mfu"],
@@ -902,12 +915,15 @@ class Trainer:
                         **win_metrics(),
                     }
                     # MoE models report the router balance penalty too.
+                    # tpk-lint: allow(host-sync) reason=log-boundary only, value already on host after the window fetch above
                     if float(metrics.get("aux_loss", 0.0)) > 0:
+                        # tpk-lint: allow(host-sync) reason=log-boundary only, value already on host after the window fetch
                         last_metrics["aux_loss"] = float(
                             metrics["aux_loss"])
                     self.logger.log(step + 1, last_metrics)
                     timer.start()
                     win_reset()
+            # tpk-hot: end trainer-step-loop
 
             if self._ckpt is not None:
                 if self._ckpt.latest_step() != spec.steps:

@@ -125,7 +125,7 @@ class RetryBudget:
                  deposit_per_call: float = 0.1):
         self.capacity = float(capacity)
         self.deposit_per_call = float(deposit_per_call)
-        self._tokens = float(capacity)
+        self._tokens = float(capacity)  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def deposit(self) -> None:
@@ -219,14 +219,14 @@ class Counters:
     increments."""
 
     def __init__(self):
-        self._counts: dict[tuple, float] = {}
-        self._gauges: dict[tuple, float] = {}
+        self._counts: dict[tuple, float] = {}  # guarded-by: _lock
+        self._gauges: dict[tuple, float] = {}  # guarded-by: _lock
         # Histograms: family name -> bucket upper bounds (fixed at first
         # observe — every label set of a family shares one bucket
         # layout, as prometheus requires); (name, labels) -> [per-bucket
         # counts (NON-cumulative; +Inf implicit), sum, count].
-        self._hist_buckets: dict[str, tuple] = {}
-        self._hists: dict[tuple, list] = {}
+        self._hist_buckets: dict[str, tuple] = {}  # guarded-by: _lock
+        self._hists: dict[tuple, list] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def inc(self, name: str, value: float = 1.0, **labels: str) -> None:

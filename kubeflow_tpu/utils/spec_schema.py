@@ -27,6 +27,12 @@ Drift breaks mechanically, without e2e:
 
 Regenerate after editing KNOBS or TrainJobSpec:
     python -m kubeflow_tpu.utils.spec_schema
+
+Tier-1 also enforces this WITHOUT importing jax: tpklint's `spec-schema`
+rule regenerates both artifacts in memory from these tables and diffs
+the committed files, so "edited a table, forgot to regenerate (or to
+rebuild the C++ binary)" fails as a lint finding with a file:line, not
+as a C++ admission e2e surprise.
 """
 
 from __future__ import annotations

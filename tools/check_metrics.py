@@ -1,137 +1,43 @@
 #!/usr/bin/env python3
-"""Metric-series lint: naming conventions + README table drift guard.
+"""Metric-series lint — thin shim over tools/tpklint/rules_metrics.py.
 
-Run as a tier-1 test (tests/test_obs.py) and standalone:
+The logic migrated into the tpklint framework (ISSUE 7) as rule
+`metrics`; this script keeps the historical entrypoints byte-compatible:
 
-    python tools/check_metrics.py
+    python tools/check_metrics.py      # same CLI, same output
+    mod.check() / mod.scan_code()      # tests/test_obs.py interface
 
-What it enforces, mechanically (SURVEY.md §5.1 — ONE metrics surface
-with uniform names, instead of per-controller ad-hoc series):
-
-  * Every `metrics.inc/observe/set_gauge` call site (resilience Counters
-    consumers) uses a literal `tpk_`-prefixed name — dynamic names would
-    be invisible to this guard and to the README.
-  * Counters end in `_total`; time histograms end in `_seconds`; gauges
-    end in neither suffix (prometheus naming conventions).
-  * The README "Observability" series table and the code agree EXACTLY:
-    every series emitted in code is documented, every documented series
-    exists in code — a new metric without a doc row (or a doc row whose
-    metric was renamed away) fails the suite, not a code review.
-
-Series are discovered from three shapes:
-  1. call sites:      metrics.inc("tpk_x_total", ...) / observe /
-                      set_gauge (incl. res_metrics.* / resilience.metrics.*)
-  2. TYPE literals:   "# TYPE tpk_x kind" inside hand-rendered exposition
-                      (serve/server.py prometheus_text)
-  3. table constants: ("stat_key", "tpk_x", "kind") rows (_ENGINE_METRICS)
+Everything it enforced before is enforced unchanged — tpk_ prefixes,
+counter `_total` / time-histogram `_seconds` suffixes, and the exact
+two-way README Observability table sync. See the rule module for the
+full doc.
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-SCAN_DIR = os.path.join(REPO, "kubeflow_tpu")
-README = os.path.join(REPO, "README.md")
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
-#: Histograms that measure something other than time (exempt from the
-#: `_seconds` suffix rule). None today — add deliberately.
-NON_TIME_HISTOGRAMS: set[str] = set()
+from tools.tpklint import rules_metrics as _impl  # noqa: E402
 
-_CALL = re.compile(
-    r"metrics\.(inc|observe|set_gauge)\(\s*\n?\s*\"(tpk_\w+)\"")
-_BAD_CALL = re.compile(
-    r"metrics\.(inc|observe|set_gauge)\(\s*\n?\s*\"(?!tpk_)(\w+)\"")
-_TYPE_LINE = re.compile(r"# TYPE (tpk_\w+) (counter|gauge|histogram)")
-_TABLE_ROW = re.compile(r"\"(tpk_\w+)\",\s*\n?\s*\"(counter|gauge)\"")
-_README_ROW = re.compile(r"^\|\s*`(tpk_\w+)`\s*\|\s*(\w+)", re.M)
-
-_KIND_OF_CALL = {"inc": "counter", "observe": "histogram",
-                 "set_gauge": "gauge"}
+#: Non-time histograms whitelist (re-exported; add deliberately).
+NON_TIME_HISTOGRAMS = _impl.NON_TIME_HISTOGRAMS
 
 
-def scan_code() -> tuple[dict[str, str], list[str]]:
-    """All emitted series: name -> kind, plus rule violations."""
-    series: dict[str, str] = {}
-    problems: list[str] = []
-
-    def add(name: str, kind: str, where: str) -> None:
-        prev = series.get(name)
-        if prev and prev != kind:
-            problems.append(
-                f"{where}: series {name} declared as {kind} but "
-                f"elsewhere as {prev}")
-        series[name] = kind
-
-    for root, _, files in os.walk(SCAN_DIR):
-        for fn in files:
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(root, fn)
-            rel = os.path.relpath(path, REPO)
-            with open(path) as fh:
-                text = fh.read()
-            for m in _BAD_CALL.finditer(text):
-                problems.append(
-                    f"{rel}: metrics.{m.group(1)}({m.group(2)!r}) — "
-                    "series must carry the tpk_ prefix")
-            for m in _CALL.finditer(text):
-                add(m.group(2), _KIND_OF_CALL[m.group(1)], rel)
-            for m in _TYPE_LINE.finditer(text):
-                add(m.group(1), m.group(2), rel)
-            for m in _TABLE_ROW.finditer(text):
-                add(m.group(1), m.group(2), rel)
-
-    for name, kind in sorted(series.items()):
-        if kind == "counter" and not name.endswith("_total"):
-            problems.append(
-                f"counter {name} must end in _total (prometheus "
-                "counter convention)")
-        if kind == "gauge" and name.endswith("_total"):
-            problems.append(
-                f"gauge {name} must not end in _total (that suffix "
-                "marks counters)")
-        if (kind == "histogram" and name not in NON_TIME_HISTOGRAMS
-                and not name.endswith("_seconds")):
-            problems.append(
-                f"histogram {name} must end in _seconds (time unit "
-                "suffix) or be whitelisted in NON_TIME_HISTOGRAMS")
-    return series, problems
+def scan_code(root: str = REPO):
+    return _impl.scan_code(root)
 
 
-def scan_readme() -> dict[str, str]:
-    """Documented series: name -> kind, from the README table rows
-    `| \\`tpk_x\\` | kind | ... |`."""
-    with open(README) as fh:
-        text = fh.read()
-    return {m.group(1): m.group(2).lower()
-            for m in _README_ROW.finditer(text)}
+def scan_readme(root: str = REPO):
+    return _impl.scan_readme(root)
 
 
-def check() -> list[str]:
-    code, problems = scan_code()
-    documented = scan_readme()
-    if not documented:
-        problems.append(
-            "README.md has no series table (| `tpk_...` | kind | ...) — "
-            "the Observability section must document every series")
-        return problems
-    for name in sorted(set(code) - set(documented)):
-        problems.append(
-            f"series {name} ({code[name]}) is emitted in code but "
-            "missing from the README Observability table")
-    for name in sorted(set(documented) - set(code)):
-        problems.append(
-            f"series {name} is documented in README but no code emits "
-            "it — stale row or renamed metric")
-    for name in sorted(set(code) & set(documented)):
-        if code[name] != documented[name]:
-            problems.append(
-                f"series {name}: code says {code[name]}, README says "
-                f"{documented[name]}")
-    return problems
+def check(root: str = REPO):
+    return _impl.check(root)
 
 
 def main() -> int:
@@ -142,8 +48,8 @@ def main() -> int:
         print(f"check_metrics: {len(problems)} problem(s)",
               file=sys.stderr)
         return 1
-    code, _ = scan_code()
-    print(f"check_metrics: OK — {len(code)} series, README in sync")
+    series, _ = scan_code()
+    print(f"check_metrics: OK — {len(series)} series, README in sync")
     return 0
 
 

@@ -1,0 +1,49 @@
+"""CLI: `python -m tools.tpklint` — exits nonzero on findings."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from . import RULES, RULE_DOCS, run
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpklint",
+        description="AST-based invariant checkers (tier-1 gates)")
+    ap.add_argument("--root", default=repo_root(),
+                    help="tree to lint (default: this repo)")
+    ap.add_argument("--rule", action="append", default=None,
+                    metavar="NAME",
+                    help="run only this rule (repeatable)")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+    if args.list_rules:
+        for name in sorted(RULES):
+            print(f"{name:16s} {RULE_DOCS.get(name, '')}")
+        return 0
+    for name in args.rule or []:
+        if name not in RULES:
+            print(f"tpklint: unknown rule {name!r} (known: "
+                  f"{', '.join(sorted(RULES))})", file=sys.stderr)
+            return 2
+    findings = run(args.root, args.rule)
+    for f in findings:
+        print(f.format())
+    if findings:
+        print(f"tpklint: {len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    ran = ", ".join(args.rule) if args.rule else f"{len(RULES)} rules"
+    print(f"tpklint: OK — {ran} clean")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,166 @@
+"""Rule `cpp-checked-io`: durability syscalls must have checked returns.
+
+The WAL durability PR (ISSUE 2) fixed exactly this bug class: an
+unchecked `fwrite`/`fsync`/`rename`/`ftruncate` silently drops the
+mutation it was supposed to make durable, and the process's in-memory
+state diverges from disk until the next replay notices (or doesn't).
+This rule scans `cpp/` line-wise — comments and string literals
+stripped — and flags any of those calls used as a bare statement:
+
+    fwrite(buf, 1, n, f);            <- flagged
+    if (fwrite(...) != n) ...        <- checked
+    size_t wrote = fwrite(...);      <- checked
+    ok = ok && fsync(...) == 0;      <- checked (even wrapped lines)
+    (void)fsync(fd);                 <- explicit discard: passes, the
+                                        cast is the visible waiver
+
+A deliberate best-effort call (e.g. directory fsync after an atomic
+rename, where failure loses nothing that was promised) carries
+`// tpk-lint: allow(cpp-checked-io) reason=...` instead.
+"""
+
+from __future__ import annotations
+
+import re
+
+from .core import Context, Finding, rule
+
+RULE = "cpp-checked-io"
+
+_CALL = re.compile(r"\b(?:std::)?(fwrite|fsync|rename|ftruncate)\s*\(")
+# Strings never span lines here; char literals are single-char — keeps
+# an apostrophe in a comment from ever swallowing code.
+_STRING = re.compile(r'"(?:[^"\\\n]|\\.)*"' + r"|'(?:[^'\\\n]|\\.)'")
+
+
+def _strip(text: str) -> str:
+    """Blank comments then string literals, preserving every newline
+    and byte offset (finding lines stay exact). Comments go first so an
+    apostrophe inside one can't open a phantom char literal."""
+    out = []
+    i, n = 0, len(text)
+    in_block = in_str = False
+    quote = ""
+    while i < n:
+        c = text[i]
+        if in_block:
+            if text.startswith("*/", i):
+                out.append("  ")
+                i += 2
+                in_block = False
+            else:
+                out.append(c if c == "\n" else " ")
+                i += 1
+        elif in_str:
+            if c == "\\" and i + 1 < n:
+                out.append("  ")
+                i += 2
+            else:
+                out.append(c if c == "\n" else " ")
+                if c == quote or c == "\n":
+                    in_str = False
+                i += 1
+        elif text.startswith("/*", i):
+            out.append("  ")
+            i += 2
+            in_block = True
+        elif text.startswith("//", i):
+            j = text.find("\n", i)
+            j = n if j < 0 else j
+            out.append(" " * (j - i))
+            i = j
+        elif c in "\"'":
+            out.append(" ")
+            in_str, quote = True, c
+            i += 1
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def _stmt_start(text: str, pos: int) -> bool:
+    """True when `pos` begins a statement — the call's value has
+    nowhere to go. Covers the plain boundaries (; { } : start-of-file),
+    a preceding `else`/`do` keyword, and the braceless control body
+    `if (...) fwrite(...);` (previous char is the `)` of an
+    if/while/for/switch clause). A preceding cast like `(void)` is NOT
+    a statement start: the discard is explicit and visible."""
+    i = pos - 1
+    while i >= 0 and text[i].isspace():
+        i -= 1
+    if i < 0 or text[i] in ";{}:":
+        return True
+    if text[i] == ")":
+        # Walk to the matching '(' and look at the word before it.
+        depth, j = 0, i
+        while j >= 0:
+            if text[j] == ")":
+                depth += 1
+            elif text[j] == "(":
+                depth -= 1
+                if depth == 0:
+                    break
+            j -= 1
+        if j < 0:
+            return False
+        k = j - 1
+        while k >= 0 and text[k].isspace():
+            k -= 1
+        end = k
+        while k >= 0 and (text[k].isalnum() or text[k] == "_"):
+            k -= 1
+        return text[k + 1:end + 1] in ("if", "while", "for", "switch")
+    # `else fsync(fd);` / `do fsync(fd);` — keyword directly before.
+    end = i
+    while i >= 0 and (text[i].isalnum() or text[i] == "_"):
+        i -= 1
+    return text[i + 1:end + 1] in ("else", "do")
+
+
+def _is_bare(text: str, open_paren: int) -> bool:
+    """True when the call's closing paren is directly followed by `;`
+    (the whole statement is the call — nothing inspects the return)."""
+    depth, i, n = 0, open_paren, len(text)
+    while i < n:
+        c = text[i]
+        if c == "(":
+            depth += 1
+        elif c == ")":
+            depth -= 1
+            if depth == 0:
+                break
+        i += 1
+    if i >= n:
+        return False  # unbalanced (macro soup): don't guess
+    i += 1
+    while i < n and text[i].isspace():
+        i += 1
+    return i < n and text[i] == ";"
+
+
+@rule(RULE, "fwrite/fsync/rename/ftruncate return values in cpp/ must "
+            "be checked (or explicitly (void)-discarded)")
+def check(ctx: Context) -> list[Finding]:
+    findings: list[Finding] = []
+    for rel in ctx.files(".cc", ".h", ".cpp", under="cpp"):
+        if rel.endswith(".gen.h"):
+            continue  # generated data, no code
+        raw = ctx.read(rel)
+        if raw is None:
+            continue
+        text = _strip(raw)
+        for m in _CALL.finditer(text):
+            if not _stmt_start(text, m.start()):
+                continue
+            open_paren = text.index("(", m.end() - 1)
+            if not _is_bare(text, open_paren):
+                continue
+            line = text.count("\n", 0, m.start()) + 1
+            findings.append(Finding(
+                RULE, rel, line,
+                f"unchecked `{m.group(1)}` return — a silent short "
+                "write/sync here diverges memory from disk (the ISSUE 2 "
+                "WAL bug class); check it, or `(void)`-cast / pragma "
+                "a deliberate best-effort call"))
+    return findings

@@ -1,0 +1,172 @@
+"""Rule `lock-discipline`: `# guarded-by:` fields stay under their lock.
+
+The batcher, generation engine, prefetcher, and resilience Counters all
+share mutable state between a worker thread and request/metrics
+threads. The locking convention was enforced by review only; this rule
+makes the declaration executable:
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.stats = {...}  # guarded-by: _lock
+
+Every OTHER access to `self.stats` anywhere in the declaring class must
+then sit lexically inside `with self._lock:` (any `with` statement one
+of whose context managers is `self._lock`). The declaring method —
+normally `__init__`, where the construction happens-before any thread
+starts — is exempt in full.
+
+Scope analysis is lexical (AST), so a helper called *from* a locked
+region still needs its own `with` or an allow-pragma naming why it's
+safe (single-writer field, GIL-atomic read, ...). That is deliberate:
+the pragma inventory IS the list of places the convention bends.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Context, Finding, rule
+
+RULE = "lock-discipline"
+
+_GUARD = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+
+
+def _decl_field(node) -> str | None:
+    """The self.<field> a declaration statement assigns, if any."""
+    targets = []
+    if isinstance(node, ast.Assign):
+        targets = node.targets
+    elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+        targets = [node.target]
+    for t in targets:
+        if (isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name)
+                and t.value.id == "self"):
+            return t.attr
+    return None
+
+
+def _with_locks(node: ast.With) -> set[str]:
+    """Lock attribute names this `with` acquires (`with self._lock:`)."""
+    out = set()
+    for item in node.items:
+        e = item.context_expr
+        if (isinstance(e, ast.Attribute) and isinstance(e.value, ast.Name)
+                and e.value.id == "self"):
+            out.add(e.attr)
+    return out
+
+
+class _ClassInfo:
+    def __init__(self, node: ast.ClassDef):
+        self.node = node
+        #: field -> (lock attr, declaring function node, decl line)
+        self.guards: dict[str, tuple[str, object, int]] = {}
+
+
+def _collect(tree: ast.Module, comments: list[tuple[int, str]],
+             text: str, rel: str,
+             findings: list[Finding]) -> list[_ClassInfo]:
+    lines = text.splitlines()
+    # line -> (lock name, standalone?). A TRAILING comment (code before
+    # it on its line) belongs to the statement on ITS line only; a
+    # standalone comment belongs to the statement directly below. This
+    # distinction matters: `self.x = 0  # guarded-by: _lock` must not
+    # also annotate the `self._lock = threading.Lock()` on the next
+    # line (which would absurdly register the lock as guarded by
+    # itself).
+    guard_lines: dict[int, tuple[str, bool]] = {}
+    for line, comment in comments:
+        m = _GUARD.search(comment)
+        if m:
+            src = lines[line - 1] if line - 1 < len(lines) else ""
+            standalone = src.split("#", 1)[0].strip() == ""
+            guard_lines[line] = (m.group(1), standalone)
+    if not guard_lines:
+        return []
+    infos = []
+    for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+        info = _ClassInfo(cls)
+        for func in [n for n in ast.walk(cls)
+                     if isinstance(n, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))]:
+            for stmt in ast.walk(func):
+                if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                    continue
+                end = getattr(stmt, "end_lineno", stmt.lineno)
+                hit = None
+                for c in range(stmt.lineno, end + 1):
+                    g = guard_lines.get(c)
+                    if g is not None and not g[1]:
+                        hit = (g[0], c)  # trailing, on this statement
+                        break
+                if hit is None:
+                    g = guard_lines.get(stmt.lineno - 1)
+                    if g is not None and g[1]:
+                        hit = (g[0], stmt.lineno - 1)  # standalone above
+                if hit is None:
+                    continue
+                field = _decl_field(stmt)
+                if field is None:
+                    findings.append(Finding(
+                        RULE, rel, stmt.lineno,
+                        "guarded-by comment is not attached to a "
+                        "`self.<field> = ...` statement"))
+                    continue
+                info.guards[field] = (hit[0], func, stmt.lineno)
+        if info.guards:
+            infos.append(info)
+    return infos
+
+
+def _check_class(info: _ClassInfo, rel: str,
+                 findings: list[Finding]) -> None:
+    def visit(node, held: frozenset[str], func):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            if not isinstance(node, ast.Lambda):
+                func = node
+            # A nested function/lambda does NOT inherit the held set:
+            # the closure may run on another thread long after the
+            # enclosing `with self._lock:` released (callback, worker
+            # target) — the exact deferred-execution race this rule
+            # exists to catch. A helper genuinely called under the lock
+            # takes its own `with` or a reasoned pragma.
+            held = frozenset()
+        if isinstance(node, ast.With):
+            held = held | _with_locks(node)
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+                and node.attr in info.guards):
+            lock, decl_func, _ = info.guards[node.attr]
+            if func is not decl_func and lock not in held:
+                findings.append(Finding(
+                    RULE, rel, node.lineno,
+                    f"`self.{node.attr}` is guarded-by `self.{lock}` "
+                    f"but accessed outside `with self.{lock}:`"))
+        for child in ast.iter_child_nodes(node):
+            visit(child, held, func)
+
+    visit(info.node, frozenset(), None)
+
+
+@rule(RULE, "fields declared `# guarded-by: <lock>` are only touched "
+            "inside `with self.<lock>:`")
+def check(ctx: Context) -> list[Finding]:
+    findings: list[Finding] = []
+    for rel in ctx.py_files():
+        comments = ctx.comments(rel)
+        if not any("guarded-by:" in c for _, c in comments):
+            continue
+        text = ctx.read(rel) or ""
+        try:
+            tree = ast.parse(text)
+        except SyntaxError as e:
+            findings.append(Finding(RULE, rel, e.lineno or 1,
+                                    f"file does not parse: {e.msg}"))
+            continue
+        for info in _collect(tree, comments, text, rel, findings):
+            _check_class(info, rel, findings)
+    return findings

@@ -1,0 +1,165 @@
+"""Rule `metrics`: series naming conventions + README table drift.
+
+The former standalone `tools/check_metrics.py`, migrated into the
+framework unchanged in behavior (that script is now a thin shim over
+this module, same CLI, same output): every emitted `tpk_*` series obeys
+prometheus naming (counters `_total`, time histograms `_seconds`,
+gauges neither), call sites use literal `tpk_`-prefixed names, and the
+README "Observability" series table matches the code EXACTLY, both
+ways — the 36-series two-way sync check, not weakened.
+
+Series are discovered from three shapes:
+  1. call sites:      metrics.inc("tpk_x_total", ...) / observe /
+                      set_gauge (incl. res_metrics.* / resilience.metrics.*)
+  2. TYPE literals:   "# TYPE tpk_x kind" inside hand-rendered exposition
+  3. table constants: ("stat_key", "tpk_x", "kind") rows (_ENGINE_METRICS)
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+from .core import Context, Finding, rule
+
+RULE = "metrics"
+
+#: Histograms that measure something other than time (exempt from the
+#: `_seconds` suffix rule). None today — add deliberately.
+NON_TIME_HISTOGRAMS: set[str] = set()
+
+_CALL = re.compile(
+    r"metrics\.(inc|observe|set_gauge)\(\s*\n?\s*\"(tpk_\w+)\"")
+_BAD_CALL = re.compile(
+    r"metrics\.(inc|observe|set_gauge)\(\s*\n?\s*\"(?!tpk_)(\w+)\"")
+_TYPE_LINE = re.compile(r"# TYPE (tpk_\w+) (counter|gauge|histogram)")
+_TABLE_ROW = re.compile(r"\"(tpk_\w+)\",\s*\n?\s*\"(counter|gauge)\"")
+_README_ROW = re.compile(r"^\|\s*`(tpk_\w+)`\s*\|\s*(\w+)", re.M)
+
+_KIND_OF_CALL = {"inc": "counter", "observe": "histogram",
+                 "set_gauge": "gauge"}
+
+SCAN_SUBDIR = "kubeflow_tpu"
+README = "README.md"
+
+
+def _line_of(text: str, pos: int) -> int:
+    return text.count("\n", 0, pos) + 1
+
+
+def scan_code(root: str) -> tuple[dict[str, str], list[str]]:
+    """All emitted series: name -> kind, plus rule violations (message
+    strings — the shim's historical interface)."""
+    series, problems, _ = _scan_code_located(Context(root))
+    return series, [msg for _, _, msg in problems]
+
+
+def _scan_code_located(ctx: Context) -> tuple[
+        dict[str, str], list[tuple[str, int, str]],
+        dict[str, tuple[str, int]]]:
+    series: dict[str, str] = {}
+    where: dict[str, tuple[str, int]] = {}
+    problems: list[tuple[str, int, str]] = []
+
+    def add(name: str, kind: str, rel: str, line: int) -> None:
+        prev = series.get(name)
+        if prev and prev != kind:
+            problems.append((rel, line,
+                             f"{rel}: series {name} declared as {kind} "
+                             f"but elsewhere as {prev}"))
+        series[name] = kind
+        where.setdefault(name, (rel, line))
+
+    for rel in ctx.py_files(under=SCAN_SUBDIR):
+        text = ctx.read(rel) or ""
+        for m in _BAD_CALL.finditer(text):
+            problems.append((rel, _line_of(text, m.start()),
+                             f"{rel}: metrics.{m.group(1)}"
+                             f"({m.group(2)!r}) — series must carry "
+                             "the tpk_ prefix"))
+        for m in _CALL.finditer(text):
+            add(m.group(2), _KIND_OF_CALL[m.group(1)], rel,
+                _line_of(text, m.start()))
+        for m in _TYPE_LINE.finditer(text):
+            add(m.group(1), m.group(2), rel, _line_of(text, m.start()))
+        for m in _TABLE_ROW.finditer(text):
+            add(m.group(1), m.group(2), rel, _line_of(text, m.start()))
+
+    for name, kind in sorted(series.items()):
+        rel, line = where[name]
+        if kind == "counter" and not name.endswith("_total"):
+            problems.append((rel, line,
+                             f"counter {name} must end in _total "
+                             "(prometheus counter convention)"))
+        if kind == "gauge" and name.endswith("_total"):
+            problems.append((rel, line,
+                             f"gauge {name} must not end in _total "
+                             "(that suffix marks counters)"))
+        if (kind == "histogram" and name not in NON_TIME_HISTOGRAMS
+                and not name.endswith("_seconds")):
+            problems.append((rel, line,
+                             f"histogram {name} must end in _seconds "
+                             "(time unit suffix) or be whitelisted in "
+                             "NON_TIME_HISTOGRAMS"))
+    return series, problems, where
+
+
+def scan_readme(root: str) -> dict[str, str]:
+    """Documented series: name -> kind, from the README table rows
+    `| \\`tpk_x\\` | kind | ... |`."""
+    return {name: kind for name, kind, _ in
+            _scan_readme_located(Context(root))}
+
+
+def _scan_readme_located(ctx: Context) -> list[tuple[str, str, int]]:
+    text = ctx.read(README)
+    if text is None:
+        return []
+    return [(m.group(1), m.group(2).lower(), _line_of(text, m.start()))
+            for m in _README_ROW.finditer(text)]
+
+
+def check(root: str) -> list[str]:
+    """Historical string interface (tools/check_metrics.py shim +
+    tests/test_obs.py)."""
+    return [msg for _, _, msg in _check_located(Context(root))]
+
+
+def _check_located(ctx: Context) -> list[tuple[str, int, str]]:
+    code, problems, where = _scan_code_located(ctx)
+    rows = _scan_readme_located(ctx)
+    documented = {name: kind for name, kind, _ in rows}
+    doc_line = {name: line for name, _, line in rows}
+    if not documented:
+        problems.append((README, 1,
+                         "README.md has no series table (| `tpk_...` | "
+                         "kind | ...) — the Observability section must "
+                         "document every series"))
+        return problems
+    for name in sorted(set(code) - set(documented)):
+        rel, line = where[name]
+        problems.append((rel, line,
+                         f"series {name} ({code[name]}) is emitted in "
+                         "code but missing from the README "
+                         "Observability table"))
+    for name in sorted(set(documented) - set(code)):
+        problems.append((README, doc_line[name],
+                         f"series {name} is documented in README but "
+                         "no code emits it — stale row or renamed "
+                         "metric"))
+    for name in sorted(set(code) & set(documented)):
+        if code[name] != documented[name]:
+            rel, line = where[name]
+            problems.append((rel, line,
+                             f"series {name}: code says {code[name]}, "
+                             f"README says {documented[name]}"))
+    return problems
+
+
+@rule(RULE, "tpk_* series naming conventions + README Observability "
+            "table two-way sync")
+def check_rule(ctx: Context) -> list[Finding]:
+    if not os.path.isdir(os.path.join(ctx.root, SCAN_SUBDIR)):
+        return []  # fixture tree without the package: nothing to scan
+    return [Finding(RULE, rel, line, msg)
+            for rel, line, msg in _check_located(ctx)]

@@ -1,0 +1,84 @@
+"""Rule `spec-schema`: committed schema artifacts match the generator.
+
+`kubeflow_tpu/utils/spec_schema.py` is the single source of truth for
+the JAXJob runtime + InferenceService generative knob tables; two
+generated artifacts are checked in and consumed elsewhere:
+
+  * `spec_schema.json`      — the schema document
+  * `cpp/spec_schema.gen.h` — the same table embedded for C++ admission
+
+Editing KNOBS/GENERATIVE_KNOBS without regenerating (and rebuilding the
+control-plane binary) used to fail only at C++ admission e2e — or not
+at all until a spec actually used the new knob. This rule regenerates
+both artifacts IN MEMORY from the tables and diffs against the
+committed files, so the drift fails at tier-1 with a file:line.
+
+The generator module is loaded from the tree under check (stdlib-only
+import: json + os), so fixture trees exercise the rule hermetically.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+
+from .core import Context, Finding, rule
+
+RULE = "spec-schema"
+
+GENERATOR = "kubeflow_tpu/utils/spec_schema.py"
+ARTIFACTS = (
+    ("spec_schema.json", "render_json"),
+    ("cpp/spec_schema.gen.h", "render_cpp_header"),
+)
+
+_REGEN = ("run `python -m kubeflow_tpu.utils.spec_schema` and rebuild "
+          "the control-plane binary (cpp/)")
+
+
+def _load_generator(ctx: Context):
+    path = os.path.join(ctx.root, GENERATOR)
+    spec = importlib.util.spec_from_file_location(
+        f"_tpklint_spec_schema_{abs(hash(ctx.root))}", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@rule(RULE, "spec_schema.json + cpp/spec_schema.gen.h match the "
+            "KNOBS/GENERATIVE_KNOBS tables")
+def check(ctx: Context) -> list[Finding]:
+    if not ctx.exists(GENERATOR):
+        return []  # fixture tree without the generator: nothing to pin
+    try:
+        mod = _load_generator(ctx)
+    except Exception as e:  # noqa: BLE001 — any load error is a finding
+        return [Finding(RULE, GENERATOR, 1,
+                        f"generator failed to load: {e!r}")]
+    findings: list[Finding] = []
+    for rel, renderer in ARTIFACTS:
+        fn = getattr(mod, renderer, None)
+        if fn is None:
+            findings.append(Finding(
+                RULE, GENERATOR, 1,
+                f"generator has no {renderer}() — cannot verify {rel}"))
+            continue
+        expected = fn()
+        actual = ctx.read(rel)
+        if actual is None:
+            findings.append(Finding(
+                RULE, rel, 1,
+                f"missing generated artifact ({renderer}); {_REGEN}"))
+            continue
+        if actual == expected:
+            continue
+        exp_lines = expected.splitlines()
+        act_lines = actual.splitlines()
+        line = next((i + 1 for i, (a, b)
+                     in enumerate(zip(exp_lines, act_lines)) if a != b),
+                    min(len(exp_lines), len(act_lines)) + 1)
+        findings.append(Finding(
+            RULE, rel, line,
+            "stale against the KNOBS/GENERATIVE_KNOBS tables in "
+            f"{GENERATOR}; {_REGEN}"))
+    return findings

@@ -1,0 +1,202 @@
+"""Rule `sync-regions`: paired `# tpk-sync` regions must match.
+
+Replaces free-text "KEEP IN SYNC" notes with enforced twins. A tag has
+exactly two sides:
+
+    # tpk-sync: begin <tag> <variant>
+    ...statements...
+    # tpk-sync: end <tag>
+
+and at most ONE side declares the deliberate differences, each as a
+text substitution from the OTHER (canonical) side to this one:
+
+    # tpk-sync: begin <tag> paged
+    # tpk-sync: sub <canonical-text> -> <this-side-text>
+
+Bodies are compared structurally: each side is dedented, parsed, and
+re-rendered with `ast.unparse`, so comments, blank lines, and line
+wrapping never count as drift — only code does. Substitutions apply to
+the canonical side's rendering and must each hit at least once (a sub
+that no longer applies is itself drift: the twin changed under it).
+Regions must be syntactically complete statement runs.
+
+REQUIRED_TAGS pins the two converted `KEEP IN SYNC` notes in
+serve/generation.py (flat vs paged admission): deleting the markers is
+a finding, not an escape.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Context, Finding, rule
+
+RULE = "sync-regions"
+
+#: tag -> a file that must carry it (enforced only when that file
+#: exists, so fixture trees are exempt).
+REQUIRED_TAGS = {
+    "admit-chunked-prefill": "kubeflow_tpu/serve/generation.py",
+    "admit-slot-state": "kubeflow_tpu/serve/generation.py",
+}
+
+_MARK = re.compile(r"#\s*tpk-sync:\s*(begin|end|sub)\s*(.*?)\s*$")
+
+
+class _Side:
+    def __init__(self, path: str, tag: str, variant: str, begin: int):
+        self.path, self.tag, self.variant = path, tag, variant
+        self.begin = begin       # line of the begin marker
+        self.end: int | None = None
+        self.subs: list[tuple[str, str]] = []
+        self.sub_lines: list[int] = []
+
+
+def _dedent(lines: list[str]) -> str:
+    pad = None
+    for ln in lines:
+        if ln.strip():
+            ind = len(ln) - len(ln.lstrip())
+            pad = ind if pad is None else min(pad, ind)
+    if pad:
+        lines = [ln[pad:] if ln.strip() else ln for ln in lines]
+    return "\n".join(lines)
+
+
+def _normalize(text_lines: list[str]) -> tuple[str | None, str]:
+    """ast-canonical rendering of a statement run ('' msg on success)."""
+    src = _dedent(text_lines)
+    try:
+        return ast.unparse(ast.parse(src)), ""
+    except SyntaxError as e:
+        return None, (f"region is not a syntactically complete "
+                      f"statement run ({e.msg})")
+
+
+def _first_diff(a: str, b: str) -> str:
+    for la, lb in zip(a.splitlines(), b.splitlines()):
+        if la != lb:
+            return f"expected `{la.strip()}` but twin has `{lb.strip()}`"
+    na, nb = len(a.splitlines()), len(b.splitlines())
+    return (f"twin has {nb} statements where {na} were expected "
+            "(trailing statements differ)")
+
+
+@rule(RULE, "paired tpk-sync regions must match modulo their declared "
+            "substitutions")
+def check(ctx: Context) -> list[Finding]:
+    findings: list[Finding] = []
+    sides: dict[str, list[_Side]] = {}
+    for rel in ctx.py_files():
+        stack: list[_Side] = []
+        for line, comment in ctx.comments(rel):
+            m = _MARK.search(comment)
+            if not m:
+                continue
+            kind, rest = m.group(1), m.group(2)
+            words = rest.split(None, 1)
+            if kind == "begin":
+                parts = rest.split()
+                if len(parts) != 2:
+                    findings.append(Finding(
+                        RULE, rel, line, "begin needs `<tag> <variant>`"))
+                    continue
+                side = _Side(rel, parts[0], parts[1], line)
+                stack.append(side)
+                sides.setdefault(parts[0], []).append(side)
+            elif kind == "end":
+                tag = words[0] if words else ""
+                open_idx = next(
+                    (i for i in range(len(stack) - 1, -1, -1)
+                     if stack[i].tag == tag), None)
+                if open_idx is None:
+                    findings.append(Finding(
+                        RULE, rel, line,
+                        f"end '{tag}' without a matching begin"))
+                    continue
+                stack[open_idx].end = line
+                del stack[open_idx]
+            elif kind == "sub":
+                if not stack:
+                    findings.append(Finding(
+                        RULE, rel, line,
+                        "sub outside any open tpk-sync region"))
+                    continue
+                if " -> " not in rest:
+                    findings.append(Finding(
+                        RULE, rel, line,
+                        "sub needs `<canonical-text> -> <this-text>`"))
+                    continue
+                old, new = rest.split(" -> ", 1)
+                stack[-1].subs.append((old.strip(), new.strip()))
+                stack[-1].sub_lines.append(line)
+        for side in stack:
+            findings.append(Finding(
+                RULE, side.path, side.begin,
+                f"begin '{side.tag} {side.variant}' is never closed"))
+
+    for tag, pair in sorted(sides.items()):
+        pair = [s for s in pair if s.end is not None]
+        if len(pair) != 2:
+            for s in pair or []:
+                findings.append(Finding(
+                    RULE, s.path, s.begin,
+                    f"tag '{tag}' has {len(pair)} side(s); exactly 2 "
+                    "variants are required"))
+            continue
+        a, b = pair
+        if a.subs and b.subs:
+            findings.append(Finding(
+                RULE, b.path, b.begin,
+                f"tag '{tag}': both sides declare subs — only the "
+                "non-canonical side may"))
+            continue
+        canon, other = (b, a) if a.subs else (a, b)
+        # (if neither has subs, side order is irrelevant: exact match.)
+        canon_lines = (ctx.read(canon.path) or "").splitlines()
+        other_lines = (ctx.read(other.path) or "").splitlines()
+        canon_norm, err = _normalize(
+            canon_lines[canon.begin:canon.end - 1])
+        if canon_norm is None:
+            findings.append(Finding(RULE, canon.path, canon.begin,
+                                    f"tag '{tag}': {err}"))
+            continue
+        other_norm, err = _normalize(
+            other_lines[other.begin:other.end - 1])
+        if other_norm is None:
+            findings.append(Finding(RULE, other.path, other.begin,
+                                    f"tag '{tag}': {err}"))
+            continue
+        expected = canon_norm
+        ok = True
+        for (old, new), line in zip(other.subs, other.sub_lines):
+            if old not in expected:
+                findings.append(Finding(
+                    RULE, other.path, line,
+                    f"tag '{tag}': substitution LHS `{old}` no longer "
+                    "appears in the canonical side — the twin changed "
+                    "under the declared difference"))
+                ok = False
+                continue
+            expected = expected.replace(old, new)
+        if not ok:
+            continue
+        if expected != other_norm:
+            findings.append(Finding(
+                RULE, other.path, other.begin,
+                f"tag '{tag}' drifted from its twin at "
+                f"{canon.path}:{canon.begin}: "
+                f"{_first_diff(expected, other_norm)}"))
+
+    for tag, home in sorted(REQUIRED_TAGS.items()):
+        # The twin pair must live in its HOME file — a same-named tag
+        # elsewhere must not satisfy the seed requirement.
+        if ctx.exists(home) and not any(s.path == home
+                                        for s in sides.get(tag, [])):
+            findings.append(Finding(
+                RULE, home, 1,
+                f"required tpk-sync tag '{tag}' not found — the "
+                "enforced twin markers were deleted; restore them "
+                "(see README 'Static analysis')"))
+    return findings
