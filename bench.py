@@ -347,6 +347,39 @@ def _clean_err(e: Exception) -> str:
     return " ".join(txt.split())[:300]
 
 
+def main_ctrlbench() -> None:
+    """`python bench.py --ctrlbench`: control-plane group-commit benchmark
+    → CTRLBENCH.json + one JSON line (kubeflow_tpu/controlplane/bench.py).
+
+    Pure host-side (real tpk-controlplane binary over its unix socket) —
+    no TPU probe. The headline is the `--fsync always` submit-rps pair:
+    group commit ON amortizes one covering fsync over every mutation of
+    an event-loop pass; OFF pays one fsync per mutation (ISSUE 8)."""
+    from kubeflow_tpu.controlplane.bench import run_ctrlbench
+
+    result = run_ctrlbench(quick="--quick" in sys.argv)
+    with open("CTRLBENCH.json", "w") as fh:
+        json.dump(result, fh, indent=1)
+    if result.get("skipped"):
+        print(json.dumps({"metric": "ctrlbench_submit_rps_always",
+                          "value": None, "unit": "rps",
+                          "skipped": result["skipped"],
+                          "detail": result.get("detail", ""),
+                          "artifact": "CTRLBENCH.json"}))
+        return
+    always = result["group_commit"]["always"]
+    print(json.dumps({
+        "metric": "ctrlbench_submit_rps_always",
+        "value": always["on"]["submit_rps"],
+        "unit": "rps",
+        "group_commit_off_rps": always["off"]["submit_rps"],
+        "speedup": always["speedup_submit"],
+        "clients": result["clients"],
+        "coalesced_events": result["watch_fanout"]["coalesced_events"],
+        "detail": "CTRLBENCH.json",
+    }))
+
+
 def main_longctx() -> None:
     """`python bench.py --longctx`: the long-context evidence row
     (PROFILE.md §6). On a live chip: measured tok/s + MFU at s>=2048
@@ -517,7 +550,9 @@ def main_longctx_tune() -> None:
 
 
 if __name__ == "__main__":
-    if "--serve" in sys.argv:
+    if "--ctrlbench" in sys.argv:
+        main_ctrlbench()
+    elif "--serve" in sys.argv:
         main_serve()
     elif "--longctx-tune" in sys.argv:
         main_longctx_tune()

@@ -12,6 +12,7 @@
 #include <sys/stat.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <cstdio>
 #include <ctime>
 
@@ -627,6 +628,7 @@ void JaxJobController::Tick(double now_s) {
     }
   }
   // 2) Deadlines, TTL GC, and level-triggered retries for non-terminal jobs.
+  std::vector<std::string> pending;  // queued jobs; launched under a budget
   for (const auto& res : store_->List("JAXJob")) {
     JobView job{res, res.spec, res.status};
     const std::string phase = job.status.get("phase").as_string();
@@ -655,7 +657,7 @@ void JaxJobController::Tick(double now_s) {
       continue;
     }
     if (phase == "Pending" || phase == "Restarting" || phase.empty()) {
-      Reconcile(res.name);
+      pending.push_back(res.name);
     }
     if (phase == "Running" && job.status.get("active").as_bool(false)) {
       CheckHeartbeats(job);  // hung-worker kills reaped on a later Poll
@@ -665,6 +667,15 @@ void JaxJobController::Tick(double now_s) {
       }
     }
   }
+  // Bounded round-robin launch sweep over the queue (see jaxjob.h note):
+  // the rotating cursor keeps it fair, the budget keeps a 1000-job
+  // backlog from monopolizing the event loop every tick.
+  const size_t n = pending.size();
+  const size_t budget = std::min(n, kMaxPendingLaunchPerTick);
+  for (size_t k = 0; k < budget; ++k) {
+    Reconcile(pending[(pending_cursor_ + k) % n]);
+  }
+  pending_cursor_ = n > 0 ? (pending_cursor_ + budget) % n : 0;
 }
 
 }  // namespace tpk

@@ -131,6 +131,15 @@ class JaxJobController {
   std::string socket_path_;
   ControllerMetrics metrics_;
   double now_s_ = 0;
+  // Bounded pending sweep (ISSUE 8): at most this many queued
+  // (Pending/Restarting) jobs attempt a launch per Tick, served
+  // round-robin from a rotating cursor — thousands of unschedulable
+  // jobs must not turn every 50 ms tick into thousands of allocation
+  // attempts + status serializations. Watch-driven reconciles
+  // (submit, spec change) are NOT capped; freed capacity reaches every
+  // queued job within ceil(pending / budget) ticks.
+  static constexpr size_t kMaxPendingLaunchPerTick = 128;
+  size_t pending_cursor_ = 0;
 };
 
 }  // namespace tpk

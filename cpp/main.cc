@@ -3,6 +3,7 @@
 //   tpk-controlplane --socket /tmp/tpk.sock --workdir /tmp/tpk
 //       --slices local=8 [--python python3] [--wal /tmp/tpk/wal.jsonl]
 //       [--fsync never|interval|always] [--fsync-interval N] [--compact N]
+//       [--group-commit N]
 //
 // One process = store + scheduler + JAXJob controller + API server, the
 // single-binary equivalent of {kube-apiserver, etcd, scheduler, kubelet,
@@ -10,6 +11,7 @@
 
 #include <signal.h>
 
+#include <algorithm>
 #include <cstdio>
 #include <cstring>
 #include <ctime>
@@ -37,6 +39,10 @@ int main(int argc, char** argv) {
   std::string fsync_mode = "never";
   int fsync_interval = 64;
   int compact_threshold = 4096;
+  // Group commit (ISSUE 8): max WAL records per covering fsync. Default
+  // on — it only batches what one event-loop pass applies anyway; 0
+  // restores the per-record append path byte-for-byte.
+  int group_commit = 64;
   std::vector<std::pair<std::string, int>> slices = {{"local", 8}};
 
   for (int i = 1; i < argc; ++i) {
@@ -51,6 +57,7 @@ int main(int argc, char** argv) {
     else if (arg == "--fsync") fsync_mode = next();
     else if (arg == "--fsync-interval") fsync_interval = atoi(next().c_str());
     else if (arg == "--compact") compact_threshold = atoi(next().c_str());
+    else if (arg == "--group-commit") group_commit = atoi(next().c_str());
     else if (arg == "--slices") {
       slices.clear();
       std::string val = next();  // "name=cap,name=cap"
@@ -70,7 +77,7 @@ int main(int argc, char** argv) {
       printf("usage: tpk-controlplane --socket PATH --workdir DIR "
              "[--wal FILE] [--python BIN] [--slices name=cap,...] "
              "[--fsync never|interval|always] [--fsync-interval N] "
-             "[--compact N]\n");
+             "[--compact N] [--group-commit N]\n");
       return 0;
     }
   }
@@ -95,6 +102,7 @@ int main(int argc, char** argv) {
   tpk::Store store(wal);
   store.SetFsync(fsync_policy, fsync_interval);
   store.SetCompactionThreshold(compact_threshold);
+  store.SetGroupCommit(group_commit);
   store.Load();
   const tpk::Store::LoadStats& replay = store.load_stats();
   if (!replay.clean) {
@@ -144,12 +152,12 @@ int main(int argc, char** argv) {
   fprintf(stderr,
           "tpk-controlplane: listening on %s (workdir=%s, WAL replay: "
           "%d applied = %d snapshot + %d tail, %lld bytes truncated, %s, "
-          "fsync=%s; %d lineage records, %zu slices)\n",
+          "fsync=%s, group-commit=%d; %d lineage records, %zu slices)\n",
           socket_path.c_str(), workdir.c_str(), replay.applied,
           replay.snapshot_records, replay.tail_records,
           static_cast<long long>(replay.truncated_bytes),
           replay.clean ? "clean" : "STOPPED AT CORRUPTION",
-          fsync_mode.c_str(), lineage_records, slices.size());
+          fsync_mode.c_str(), group_commit, lineage_records, slices.size());
 
   // Watch: any JAXJob change → reconcile (informer-style edge trigger).
   // Deletes are handled inline: the resource is already gone from the
@@ -185,11 +193,39 @@ int main(int argc, char** argv) {
     }
   });
 
+  // Watch coalescing collapses most same-name churn already; the sort+
+  // unique below catches the rest so one job never reconciles twice in
+  // one pass.
+  auto reconcile_dirty = [&dirty, &jaxjob]() {
+    std::sort(dirty.begin(), dirty.end());
+    dirty.erase(std::unique(dirty.begin(), dirty.end()), dirty.end());
+    for (const auto& name : dirty) jaxjob.Reconcile(name);
+    dirty.clear();
+  };
+  // A failed CONTROLLER commit is fatal (etcd's WAL-sync-failure rule):
+  // unlike a client batch — whose rollback is complete because replies
+  // are held and watch events gated — the Ticks/reconciles act on their
+  // mutations in the same call (worker gangs spawned, processes
+  // signalled). The store rollback cannot undo those side effects, so
+  // continuing would run a controller whose in-process state diverges
+  // from durable state (e.g. a Launched gang whose job replays as
+  // Pending → duplicate launch). Exit loudly; restart replays the
+  // durable state and re-reconciles — the exact path the kill-9 crash
+  // tests prove correct.
+  auto controller_commit_ok = [&store]() {
+    std::string gc_err;
+    if (store.CommitGroup(&gc_err)) return true;
+    fprintf(stderr,
+            "tpk-controlplane: FATAL: controller group commit failed "
+            "(%s); controller side effects cannot be rolled back — "
+            "exiting, restart replays durable state\n",
+            gc_err.c_str());
+    return false;
+  };
   while (!g_stop) {
     server.PollOnce(50);
     store.DrainWatches();
-    for (const auto& name : dirty) jaxjob.Reconcile(name);
-    dirty.clear();
+    reconcile_dirty();
     double now = static_cast<double>(time(nullptr));
     jaxjob.Tick(now);
     tune.Tick(now);
@@ -197,11 +233,22 @@ int main(int argc, char** argv) {
     pipelines.Tick(now);
     serve.Tick(now);
     trained.Tick(now);
+    // Controller-driven mutations (the Ticks above) batch like client
+    // ops; land them BEFORE draining their watch events — DrainWatches
+    // only delivers committed events (a failed commit must be able to
+    // drop its batch's events), so the commit has to come first for the
+    // Ticks' child JAXJob create/delete to reach the jaxjob pass below
+    // instead of waiting a poll cycle. Failure is fatal — see
+    // controller_commit_ok above.
+    if (!controller_commit_ok()) return 1;
     // Tune/pipeline writes (child JAXJob create/delete) need a jaxjob pass
     // before the next poll so child gangs launch/die promptly.
     store.DrainWatches();
-    for (const auto& name : dirty) jaxjob.Reconcile(name);
-    dirty.clear();
+    reconcile_dirty();
+    // ...and the reconcile pass buffers its own mutations: land them
+    // before sleeping in poll so the durability window stays one loop
+    // pass, not open-ended. Same fatality rule — reconciles spawn too.
+    if (!controller_commit_ok()) return 1;
   }
   fprintf(stderr, "tpk-controlplane: shutting down\n");
   return 0;

@@ -55,13 +55,20 @@ bool Server::Start(std::string* error) {
   sockaddr_un addr{};
   addr.sun_family = AF_UNIX;
   strncpy(addr.sun_path, socket_path_.c_str(), sizeof(addr.sun_path) - 1);
+  // Backlog sized for a connection burst (the ctrlbench K-client ramp):
+  // the accept loop drains it in one pass, but the kernel queue must
+  // hold the burst until that pass runs.
   if (bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
-      listen(listen_fd_, 16) < 0) {
+      listen(listen_fd_, 128) < 0) {
     if (error) *error = strerror(errno);
     close(listen_fd_);
     listen_fd_ = -1;
     return false;
   }
+  // Non-blocking listener: the accept loop below drains to EAGAIN, and
+  // a connection that vanishes between poll and accept must not wedge
+  // the event loop.
+  fcntl(listen_fd_, F_SETFL, fcntl(listen_fd_, F_GETFL, 0) | O_NONBLOCK);
   return true;
 }
 
@@ -296,6 +303,7 @@ void Server::HandleLine(Client& c, const std::string& line) {
   std::string span_name = "controlplane.bad_request";
   std::string trace;
   const double t0 = SteadyMicros();
+  const bool group = store_->group_commit() > 0;
   try {
     Json req = Json::parse(line);
     span_name = "controlplane." + req.get("op").as_string();
@@ -309,8 +317,49 @@ void Server::HandleLine(Client& c, const std::string& line) {
   // Every dispatched request leaves one span in the ring (the `trace`
   // verb included — its own handling is part of the timeline too).
   RecordSpan(span_name, trace, t0, SteadyMicros() - t0);
-  c.out_buf += resp.dump();
-  c.out_buf += '\n';
+  std::string out = resp.dump();
+  out += '\n';
+  // Ack-after-durable: a reply that acknowledges buffered WAL records
+  // is staged until the pass's covering fsync; anything queued behind
+  // one on the same connection stages too (reply order is the
+  // protocol). And not just the mutations' own replies: ANY reply
+  // computed while batch records are buffered observed applied-but-
+  // uncommitted state — released early, a failed commit would leak a
+  // dirty read (e.g. a get on another connection claiming a rolled-back
+  // create exists) that the per-record path can never produce. Such
+  // replies ride the commit and become the batch error on failure.
+  // Read-only traffic while no batch is open skips the wait.
+  const bool sees_batch = group && store_->PendingGroupRecords() > 0;
+  if (sees_batch || !c.staged.empty()) {
+    c.staged.emplace_back(std::move(out), sees_batch);
+  } else {
+    c.out_buf += out;
+  }
+}
+
+void Server::CommitAndRelease() {
+  std::string err;
+  // ack-after-durable: commit — the single covering fsync for every
+  // mutation this pass applied.
+  const bool ok = store_->CommitGroup(&err);
+  std::string failure;
+  if (!ok) {
+    Json e = Json::Object();
+    e["ok"] = false;
+    e["error"] = "group commit failed, mutation rolled back: " + err;
+    failure = e.dump();
+    failure += '\n';
+  }
+  // ack-after-durable: release — staged replies reach the socket only
+  // after the commit; batch-dependent replies (acks and reads over the
+  // now-rolled-back state) answer with the error (nothing durable was
+  // promised, and a success reply here would be a dirty read).
+  for (auto& c : clients_) {
+    for (auto& [reply, sees_batch] : c.staged) {
+      c.out_buf += (ok || !sees_batch) ? reply : failure;
+    }
+    c.staged.clear();
+  }
 }
 
 int Server::PollOnce(int timeout_ms) {
@@ -325,58 +374,77 @@ int Server::PollOnce(int timeout_ms) {
   int n = poll(fds.data(), fds.size(), timeout_ms);
   if (n <= 0) return 0;
 
+  const int group_max = store_->group_commit();
   int served = 0;
   if (fds[0].revents & POLLIN) {
-    int fd = accept(listen_fd_, nullptr, nullptr);
-    if (fd >= 0) {
+    // Drain the accept queue: a burst of K new clients joins in ONE
+    // pass instead of paying one poll cycle each.
+    int fd;
+    while ((fd = accept(listen_fd_, nullptr, nullptr)) >= 0) {
       // Non-blocking: a stalled client must never block the event loop
       // (this thread also runs reconciles and exit reaping).
       fcntl(fd, F_SETFL, fcntl(fd, F_GETFL, 0) | O_NONBLOCK);
-      clients_.push_back({fd, "", ""});
+      clients_.push_back(Client{fd, "", "", {}, false});
     }
   }
-  std::vector<int> dead;
+  // Phase 1 — read + dispatch: drain every readable connection so all
+  // requests already in flight join this pass's batch. Replies stage
+  // (group mode) or append to out_buf (per-record mode); nothing is
+  // written back yet.
   for (size_t i = 1; i < fds.size(); ++i) {
     Client& c = clients_[i - 1];
-    if (fds[i].revents & (POLLIN | POLLHUP | POLLERR)) {
+    if (!(fds[i].revents & (POLLIN | POLLHUP | POLLERR))) continue;
+    while (true) {
       char buf[4096];
       ssize_t got = read(c.fd, buf, sizeof(buf));
-      if (got <= 0) {
-        dead.push_back(static_cast<int>(i - 1));
+      if (got > 0) {
+        c.in_buf.append(buf, got);
         continue;
       }
-      c.in_buf.append(buf, got);
-      size_t nl;
-      while ((nl = c.in_buf.find('\n')) != std::string::npos) {
-        std::string line = c.in_buf.substr(0, nl);
-        c.in_buf.erase(0, nl + 1);
-        if (!line.empty()) {
-          HandleLine(c, line);
-          ++served;
-        }
-      }
+      if (got < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) break;
+      c.dead = true;  // EOF or hard error; handle what already arrived
+      break;
     }
-    if (!c.out_buf.empty()) {
-      // Opportunistic non-blocking write (fd is O_NONBLOCK): fresh responses
-      // from this pass go out immediately instead of waiting a poll cycle.
-      ssize_t sent = write(c.fd, c.out_buf.data(), c.out_buf.size());
-      if (sent > 0) {
-        c.out_buf.erase(0, sent);
-      } else if (sent < 0 && errno != EAGAIN && errno != EWOULDBLOCK) {
-        dead.push_back(static_cast<int>(i - 1));
-        continue;
+    size_t nl;
+    while ((nl = c.in_buf.find('\n')) != std::string::npos) {
+      std::string line = c.in_buf.substr(0, nl);
+      c.in_buf.erase(0, nl + 1);
+      if (!line.empty()) {
+        HandleLine(c, line);
+        ++served;
       }
-      // Cap pending output: a client that never reads gets disconnected
-      // rather than growing the buffer unboundedly.
-      if (c.out_buf.size() > (8u << 20)) {
-        dead.push_back(static_cast<int>(i - 1));
+      if (group_max > 0 && store_->PendingGroupRecords() >= group_max) {
+        // Batch cap: land what we have mid-pass so one huge burst can't
+        // grow the commit (and every waiter's ack latency) unboundedly.
+        CommitAndRelease();
       }
     }
   }
-  // Remove dead clients (reverse order keeps indices valid).
-  for (auto it = dead.rbegin(); it != dead.rend(); ++it) {
-    close(clients_[*it].fd);
-    clients_.erase(clients_.begin() + *it);
+  // Phase 2 — the pass's covering commit, then the held acks.
+  if (group_max > 0) CommitAndRelease();
+  // Phase 3 — opportunistic non-blocking writes (fds are O_NONBLOCK):
+  // this pass's responses go out now instead of waiting a poll cycle.
+  for (auto& c : clients_) {
+    if (c.dead || c.out_buf.empty()) continue;
+    ssize_t sent = write(c.fd, c.out_buf.data(), c.out_buf.size());
+    if (sent > 0) {
+      c.out_buf.erase(0, sent);
+    } else if (sent < 0 && errno != EAGAIN && errno != EWOULDBLOCK) {
+      c.dead = true;
+      continue;
+    }
+    // Cap pending output: a client that never reads gets disconnected
+    // rather than growing the buffer unboundedly.
+    if (c.out_buf.size() > (8u << 20)) c.dead = true;
+  }
+  // Sweep dead clients.
+  for (auto it = clients_.begin(); it != clients_.end();) {
+    if (it->dead) {
+      close(it->fd);
+      it = clients_.erase(it);
+    } else {
+      ++it;
+    }
   }
   return served;
 }

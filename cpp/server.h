@@ -36,8 +36,23 @@ class Server {
 
   bool Start(std::string* error);
 
-  // One event-loop pass: accept clients, read/dispatch requests, write
-  // responses. timeout_ms bounds the poll wait. Returns requests served.
+  // One event-loop pass: accept clients (drained to EAGAIN), read and
+  // dispatch every complete request already in each socket, land the
+  // pass's mutations through ONE store group commit (covering fsync),
+  // and only then flush the queued replies. timeout_ms bounds the poll
+  // wait. Returns requests served.
+  //
+  // Ack-after-durable: with group commit enabled (store->group_commit()
+  // > 0), a reply whose request buffered WAL records is staged and
+  // released only after CommitGroup() returns true — so under
+  // `--fsync always` an acknowledged mutation is never lost, while all
+  // mutations of one pass share one fsync. Every reply computed while
+  // batch records are buffered rides the commit (reads included — they
+  // observed applied-but-uncommitted state); on commit failure all of
+  // them become error replies, so a rolled-back batch leaks neither
+  // acks nor dirty reads. Read-only replies while no batch is open
+  // skip the wait.
+  // With group commit off the per-record path runs exactly as before.
   int PollOnce(int timeout_ms);
 
   void Stop();
@@ -64,9 +79,20 @@ class Server {
     int fd;
     std::string in_buf;
     std::string out_buf;
+    // Replies staged during a group-commit pass: (reply line, whether
+    // it depends on the open batch — acks AND reads computed over
+    // uncommitted state). Released into out_buf by CommitAndRelease,
+    // in dispatch order.
+    std::vector<std::pair<std::string, bool>> staged;
+    bool dead = false;
   };
 
   void HandleLine(Client& c, const std::string& line);
+  // Lands the pending store batch and releases every staged reply:
+  // verbatim on success; batch-dependent replies (acks and reads over
+  // uncommitted state) become error replies on failure — the mutations
+  // were rolled back, nothing was promised, and nothing dirty leaks.
+  void CommitAndRelease();
 
   Store* store_;
   Scheduler* scheduler_;

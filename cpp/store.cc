@@ -1,9 +1,11 @@
 #include "store.h"
 
 #include <fcntl.h>
+#include <signal.h>
 #include <sys/stat.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <array>
 #include <cctype>
 #include <cerrno>
@@ -15,6 +17,27 @@
 namespace tpk {
 
 namespace {
+
+// Test-only seeded crash points (tests/test_crash_recovery.py's
+// kill-9-inside-the-commit-window harness): TPK_CRASH_AT="<point>:<n>"
+// SIGKILLs the process on the n-th hit of the named point. One getenv at
+// first use; zero cost when unset.
+void MaybeCrashAt(const char* point) {
+  static const char* spec = getenv("TPK_CRASH_AT");
+  if (!spec) return;
+  const char* colon = strchr(spec, ':');
+  if (!colon) return;
+  size_t plen = strlen(point);
+  if (plen != static_cast<size_t>(colon - spec) ||
+      strncmp(spec, point, plen) != 0) {
+    return;
+  }
+  static int hits = 0;  // only the one named point ever increments
+  if (++hits == atoi(colon + 1)) {
+    fprintf(stderr, "tpk-controlplane: TPK_CRASH_AT %s firing\n", spec);
+    kill(getpid(), SIGKILL);
+  }
+}
 
 // CRC32 (IEEE/zlib polynomial) over the exact payload bytes as written —
 // the integrity check that lets Load() tell a torn/bit-flipped record from
@@ -118,6 +141,21 @@ void Store::SetCompactionThreshold(int records) {
   compact_threshold_ = records > 0 ? records : 0;
 }
 
+void Store::SetGroupCommit(int max_batch) {
+  std::lock_guard<std::mutex> lock(mu_);
+  group_commit_max_ = max_batch > 0 ? max_batch : 0;
+}
+
+int Store::group_commit() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return group_commit_max_;
+}
+
+int Store::PendingGroupRecords() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return batch_records_;
+}
+
 bool Store::EnsureWalLocked(std::string* error) {
   if (wal_broken_) {
     if (error) *error = "WAL broken: " + wal_error_;
@@ -143,12 +181,32 @@ bool Store::WalAppendLocked(const Resource& r, std::string* error) {
   if (wal_path_.empty()) return true;  // in-memory store
   if (!EnsureWalLocked(error)) return false;
 
+  if (group_commit_max_ > 0) {
+    // Group-commit mode: the record joins the in-memory batch with its
+    // final framing (the bytes CommitGroup writes are exactly the bytes
+    // the per-record path would have written, in the same order — WAL
+    // parity is byte-for-byte). Durability and failure handling move to
+    // CommitGroup; a mutation is only acknowledged after it.
+    if (batch_records_ == 0) {
+      batch_seq_start_ = wal_seq_;
+      batch_version_start_ = next_version_;
+      batch_watch_start_ = pending_.size();
+    }
+    uint64_t seq = wal_seq_ + 1;
+    batch_buf_ += FrameRecord(seq, ToJson(r).dump());
+    wal_seq_ = seq;
+    ++batch_records_;
+    return true;
+  }
+
   uint64_t seq = wal_seq_ + 1;
   std::string line = FrameRecord(seq, ToJson(r).dump());
   long off = ftell(wal_);
   size_t wrote = fwrite(line.data(), 1, line.size(), wal_);
-  int saved_errno = errno;
   bool ok = wrote == line.size() && fflush(wal_) == 0;
+  // After the chain: a short fwrite short-circuits fflush (errno holds
+  // the write error); otherwise errno holds the flush error.
+  int saved_errno = errno;
   if (ok && fsync_policy_ != FsyncPolicy::kNever) {
     ++unsynced_records_;
     if (fsync_policy_ == FsyncPolicy::kAlways ||
@@ -185,6 +243,121 @@ bool Store::WalAppendLocked(const Resource& r, std::string* error) {
   }
   wal_seq_ = seq;
   ++wal_records_;
+  return true;
+}
+
+void Store::RecordUndoLocked(const std::pair<std::string, std::string>& key) {
+  if (group_commit_max_ <= 0 || wal_path_.empty()) return;
+  auto it = data_.find(key);
+  if (it == data_.end()) {
+    batch_undo_.emplace_back(key, std::nullopt);
+  } else {
+    batch_undo_.emplace_back(key, it->second);
+  }
+}
+
+void Store::ClearBatchLocked() {
+  batch_buf_.clear();
+  batch_records_ = 0;
+  batch_undo_.clear();
+}
+
+bool Store::CommitGroup(std::string* error) {
+  std::lock_guard<std::mutex> lock(mu_);
+  return CommitGroupLocked(error);
+}
+
+bool Store::CommitGroupLocked(std::string* error) {
+  if (batch_records_ == 0) return true;  // nothing pending, no fsync
+  std::string werr;
+  bool ok = EnsureWalLocked(&werr);
+  long off = -1;
+  int saved_errno = 0;
+  if (ok) {
+    off = ftell(wal_);
+    // The loss window the kill-9 test aims at: mutations are applied in
+    // memory and replies staged, but the batch bytes are still only in
+    // this process — a SIGKILL here loses exactly the unacknowledged
+    // tail, never an acknowledged record.
+    MaybeCrashAt("group-commit.pre-write");
+    size_t wrote = fwrite(batch_buf_.data(), 1, batch_buf_.size(), wal_);
+    ok = wrote == batch_buf_.size() && fflush(wal_) == 0;
+    // After the whole chain: a short fwrite short-circuits fflush, so
+    // errno still holds the write error; otherwise it holds the flush
+    // error (fwrite often buffers fine and ENOSPC only surfaces here).
+    saved_errno = errno;
+  }
+  if (ok && fsync_policy_ != FsyncPolicy::kNever) {
+    // Accumulate into unsynced_records_ only on success: a failed commit
+    // truncates this batch off disk, and counting its records would make
+    // later commits fire their covering fsync early (and drift the
+    // stateinfo fsync count from the real unsynced backlog).
+    const int pending_unsynced = unsynced_records_ + batch_records_;
+    if (fsync_policy_ == FsyncPolicy::kAlways ||
+        pending_unsynced >= fsync_interval_) {
+      MaybeCrashAt("group-commit.pre-fsync");
+      if (fsync(fileno(wal_)) != 0) {
+        // Same fsync-gate rule as the per-record path: a failed fsync
+        // may drop the very pages it was asked to persist — nothing in
+        // this batch can be trusted.
+        saved_errno = errno;
+        ok = false;
+      } else {
+        unsynced_records_ = 0;
+        ++group_fsyncs_;
+      }
+    } else {
+      unsynced_records_ = pending_unsynced;
+    }
+  }
+  if (!ok) {
+    std::string reason = std::string("group commit failed: ") +
+                         (werr.empty() ? strerror(saved_errno) : werr.c_str());
+    if (wal_) {
+      clearerr(wal_);
+      if (off < 0 || ftruncate(fileno(wal_), off) != 0) {
+        // Disk state unknown — refuse all further mutations rather than
+        // silently diverging (mirrors the per-record rollback failure).
+        wal_broken_ = true;
+        wal_error_ = reason + "; rollback truncate failed: " +
+                     strerror(errno);
+        fclose(wal_);
+        wal_ = nullptr;
+      }
+    }
+    // Roll the whole batch out of memory, newest first: pre-images
+    // restore data_, the version/seq clocks rewind, and the batch's
+    // queued watch events are dropped — the per-record path's
+    // reject-on-failure contract at batch granularity. Replies for
+    // these mutations were held pending this commit, so nothing was
+    // ever acknowledged.
+    for (auto it = batch_undo_.rbegin(); it != batch_undo_.rend(); ++it) {
+      if (it->second) {
+        data_[it->first] = *it->second;
+      } else {
+        data_.erase(it->first);
+      }
+    }
+    next_version_ = batch_version_start_;
+    wal_seq_ = batch_seq_start_;
+    if (pending_.size() > batch_watch_start_) {
+      pending_.resize(batch_watch_start_);
+    }
+    ClearBatchLocked();
+    if (error) {
+      *error = wal_broken_ ? "WAL broken: " + wal_error_ : reason;
+    }
+    return false;
+  }
+  wal_records_ += batch_records_;
+  ++group_commits_;
+  group_records_ += batch_records_;
+  group_max_batch_ = std::max(group_max_batch_, batch_records_);
+  ClearBatchLocked();
+  // Compaction is deferred while a batch is open (a snapshot must never
+  // make unacknowledged mutations durable ahead of their commit); run it
+  // here, where the tail is fully durable.
+  MaybeCompactLocked();
   return true;
 }
 
@@ -427,8 +600,11 @@ void Store::MaybeCompactLocked() {
   // background compactor would need a second WAL handle + copy of data_.
   // If snapshots ever get big enough to matter, this is the seam to move
   // off-thread. Failure is recorded in compact_error_ (stateinfo), never
-  // fails the mutation — the WAL append already landed.
-  if (compact_threshold_ > 0 && wal_records_ > compact_threshold_) {
+  // fails the mutation — the WAL append already landed. In group-commit
+  // mode this only runs from CommitGroupLocked (batch_records_ == 0
+  // there), never with a batch open.
+  if (batch_records_ == 0 && compact_threshold_ > 0 &&
+      wal_records_ > compact_threshold_) {
     std::string ignored;
     CompactLocked(&ignored);
   }
@@ -436,6 +612,11 @@ void Store::MaybeCompactLocked() {
 
 bool Store::Compact(std::string* error) {
   std::lock_guard<std::mutex> lock(mu_);
+  // A pending batch must land first: CompactLocked snapshots memory and
+  // truncates the WAL, and a batch appended AFTER that truncate would
+  // carry sequence numbers at or below the snapshot's (replay would stop
+  // at the regression).
+  if (!CommitGroupLocked(error)) return false;
   return CompactLocked(error);
 }
 
@@ -457,6 +638,26 @@ Json Store::StateInfo() const {
   out["compactThreshold"] = compact_threshold_;
   out["compactions"] = compactions_;
   if (!compact_error_.empty()) out["compactError"] = compact_error_;
+  // Group-commit health (ISSUE 8): how many mutations shared a covering
+  // fsync, and how much watch fan-out the coalescer absorbed.
+  Json gc = Json::Object();
+  gc["maxBatch"] = group_commit_max_;   // config: 0 = off
+  gc["commits"] = group_commits_;
+  gc["records"] = group_records_;
+  gc["fsyncs"] = group_fsyncs_;
+  gc["maxBatchObserved"] = group_max_batch_;
+  gc["meanBatch"] = group_commits_ > 0
+                        ? static_cast<double>(group_records_) /
+                              static_cast<double>(group_commits_)
+                        : 0.0;
+  gc["pendingRecords"] = batch_records_;
+  out["groupCommit"] = gc;
+  Json watch = Json::Object();
+  watch["coalescedEvents"] = watch_coalesced_;
+  watch["deliveredEvents"] = watch_delivered_;
+  watch["queuedEvents"] = static_cast<int64_t>(pending_.size());
+  watch["watchers"] = static_cast<int64_t>(watchers_.size());
+  out["watch"] = watch;
   Json replay = Json::Object();
   replay["applied"] = load_stats_.applied;
   replay["snapshotRecords"] = load_stats_.snapshot_records;
@@ -517,8 +718,12 @@ Store::Result Store::Create(const std::string& kind, const std::string& name,
   r.generation = 1;
   // WAL first, memory second: a failed append (disk full, broken WAL)
   // rejects the mutation instead of letting memory diverge from disk.
+  // (Group-commit mode: the append only buffers; RecordUndoLocked keeps
+  // the pre-image so a failed covering fsync can reject it just as
+  // completely at commit time.)
   std::string werr;
   if (!WalAppendLocked(r, &werr)) return {false, werr, {}};
+  RecordUndoLocked(key);
   ++next_version_;
   data_[key] = r;
   Append({WatchEvent::Type::kAdded, r});
@@ -542,6 +747,7 @@ Store::Result Store::UpdateSpec(const std::string& kind,
   updated.generation++;
   std::string werr;
   if (!WalAppendLocked(updated, &werr)) return {false, werr, {}};
+  RecordUndoLocked(it->first);
   ++next_version_;
   it->second = std::move(updated);
   Append({WatchEvent::Type::kModified, it->second});
@@ -564,6 +770,7 @@ Store::Result Store::UpdateStatus(const std::string& kind,
   updated.resource_version = next_version_;
   std::string werr;
   if (!WalAppendLocked(updated, &werr)) return {false, werr, {}};
+  RecordUndoLocked(it->first);
   ++next_version_;
   it->second = std::move(updated);
   Append({WatchEvent::Type::kModified, it->second});
@@ -580,6 +787,7 @@ Store::Result Store::Delete(const std::string& kind, const std::string& name) {
   r.resource_version = next_version_;
   std::string werr;
   if (!WalAppendLocked(r, &werr)) return {false, werr, {}};
+  RecordUndoLocked(it->first);
   ++next_version_;
   data_.erase(it);
   Append({WatchEvent::Type::kDeleted, r});
@@ -626,8 +834,60 @@ int Store::DrainWatches() {
   std::vector<Watcher> watchers;
   {
     std::lock_guard<std::mutex> lock(mu_);
-    events.swap(pending_);
+    // Events queued by a still-open batch stay queued: a failed commit
+    // must be able to drop them (a delivered event cannot be recalled —
+    // watchers would act on mutations that were rolled back), and the
+    // rollback's pending_.resize(batch_watch_start_) relies on the
+    // batch's events being the intact suffix. Only the committed prefix
+    // drains; the suffix delivers after its covering commit.
+    const size_t drainable =
+        batch_records_ > 0 ? std::min(batch_watch_start_, pending_.size())
+                           : pending_.size();
+    if (drainable == 0) return 0;
+    std::vector<WatchEvent> raw(
+        std::make_move_iterator(pending_.begin()),
+        std::make_move_iterator(pending_.begin() + drainable));
+    pending_.erase(pending_.begin(), pending_.begin() + drainable);
+    if (batch_records_ > 0) batch_watch_start_ -= drainable;
     watchers = watchers_;
+    // Coalesce per (kind, name): a run of ADDED/MODIFIED with no DELETED
+    // between collapses to one event carrying the latest resource (an
+    // ADDED that was immediately MODIFIED stays an ADDED). DELETED is a
+    // barrier — delivered as-is, and a re-create after it starts fresh.
+    // Level-triggered consumers (the reconcilers) only act on current
+    // state, so intermediate writes are pure fan-out cost.
+    std::map<std::pair<std::string, std::string>, size_t> open_run;
+    for (auto& ev : raw) {
+      auto key = std::make_pair(ev.resource.kind, ev.resource.name);
+      if (ev.type == WatchEvent::Type::kDeleted) {
+        open_run.erase(key);
+        events.push_back(std::move(ev));
+        continue;
+      }
+      auto it = open_run.find(key);
+      if (it != open_run.end()) {
+        events[it->second].resource = std::move(ev.resource);
+        ++watch_coalesced_;
+      } else {
+        open_run.emplace(key, events.size());
+        events.push_back(std::move(ev));
+      }
+    }
+    // Per-pass delivery budget: leftovers go back to the queue's FRONT
+    // (they predate anything a delivery callback appends) and keep
+    // their order for the next pass.
+    if (events.size() > kMaxWatchDeliverPerPass) {
+      const size_t leftover = events.size() - kMaxWatchDeliverPerPass;
+      pending_.insert(pending_.begin(),
+                      std::make_move_iterator(
+                          events.begin() + kMaxWatchDeliverPerPass),
+                      std::make_move_iterator(events.end()));
+      // Reinserted leftovers are committed events sitting ahead of any
+      // open batch's suffix — keep the suffix boundary pointing at it.
+      if (batch_records_ > 0) batch_watch_start_ += leftover;
+      events.resize(kMaxWatchDeliverPerPass);
+    }
+    watch_delivered_ += static_cast<int64_t>(events.size());
   }
   for (const auto& ev : events) {
     for (const auto& w : watchers) {

@@ -26,6 +26,19 @@
 //   * `--fsync never|interval|always` bounds the post-SIGKILL loss window
 //     (never: page cache only — safe against process death, not power
 //     loss; interval: fsync every N records; always: fsync per record).
+//   * Group commit (`--group-commit N`, the etcd/raft batched-commit
+//     analog): mutations buffer framed records in memory and apply to
+//     the in-memory map immediately; CommitGroup() lands the whole
+//     batch with ONE write + ONE covering fsync. The owning event loop
+//     holds client replies until the commit returns, so the
+//     acknowledged-mutation-is-never-lost contract of `--fsync always`
+//     is preserved exactly while N mutations share one fsync
+//     (unacknowledged mutations may be lost, same as today). A failed
+//     commit rolls the batch back — file truncated to the pre-batch
+//     offset, memory restored from per-mutation pre-images, queued
+//     watch events dropped — the per-record path's reject-on-failure
+//     contract at batch granularity. 0 disables: the per-record append
+//     path runs byte-for-byte as before.
 
 #pragma once
 
@@ -93,6 +106,20 @@ class Store {
   void SetFsync(FsyncPolicy policy, int interval_records = 64);
   // Snapshot+truncate once the WAL tail exceeds `records` (0 = never).
   void SetCompactionThreshold(int records);
+  // Group commit: mutations buffer framed records until CommitGroup();
+  // `max_batch` is the advisory per-commit record cap the owning event
+  // loop enforces (exposed via group_commit()). 0 = off (per-record
+  // append path, unchanged).
+  void SetGroupCommit(int max_batch);
+  int group_commit() const;
+  // Records buffered and awaiting a covering fsync (0 when off/idle).
+  int PendingGroupRecords() const;
+  // Land the pending batch: one fwrite + fflush + covering fsync (per
+  // the fsync policy). True when the batch — possibly empty — is
+  // durable. On failure every batched mutation is rolled back from
+  // memory AND disk; callers must only acknowledge mutations after
+  // this returns true (ack-after-durable).
+  bool CommitGroup(std::string* error = nullptr);
 
   // Replays snapshot + WAL if present, truncating any torn/corrupt tail
   // in the file before the writer reopens. Returns records applied.
@@ -131,6 +158,16 @@ class Store {
 
   // Deliver queued events to watchers. Called from the owning event loop.
   // Returns number of events delivered.
+  //
+  // Fan-out is bounded two ways (ISSUE 8): consecutive ADDED/MODIFIED
+  // events for the same (kind, name) with no DELETED between them
+  // coalesce to one event carrying the LATEST resource (level-triggered
+  // watchers — reconcilers — only need current state, not every
+  // intermediate write; an ADDED immediately MODIFIED is still an
+  // ADDED, informer-style). DELETED is a barrier: it is never coalesced
+  // away and a later re-create starts a fresh run. Per pass at most
+  // kMaxWatchDeliverPerPass coalesced events deliver; leftovers keep
+  // their order at the queue's front for the next pass.
   int DrainWatches();
 
   static Json ToJson(const Resource& r);
@@ -143,8 +180,15 @@ class Store {
   void Append(const WatchEvent& ev);
   // Appends one framed record; on I/O failure rolls the file back to the
   // pre-record offset and returns false with *error (the mutation must
-  // not commit). Caller holds mu_.
+  // not commit). Caller holds mu_. In group-commit mode the record only
+  // joins the in-memory batch (durability deferred to CommitGroup).
   bool WalAppendLocked(const Resource& r, std::string* error);
+  // Captures the pre-mutation state of `key` for batch rollback (no-op
+  // outside group-commit mode). Caller holds mu_; call BEFORE mutating
+  // data_.
+  void RecordUndoLocked(const std::pair<std::string, std::string>& key);
+  bool CommitGroupLocked(std::string* error);
+  void ClearBatchLocked();
   bool EnsureWalLocked(std::string* error);
   bool CompactLocked(std::string* error);
   void MaybeCompactLocked();
@@ -165,6 +209,22 @@ class Store {
   int compact_threshold_ = 0;
   int wal_records_ = 0;     // records in the current WAL tail (post-snapshot)
   uint64_t wal_seq_ = 0;    // last framed sequence number written/replayed
+  // Group commit: the pending batch (framed bytes + rollback state) and
+  // its health counters (stateinfo's groupCommit object).
+  int group_commit_max_ = 0;   // 0 = off
+  std::string batch_buf_;      // framed records awaiting the covering fsync
+  int batch_records_ = 0;
+  uint64_t batch_seq_start_ = 0;      // wal_seq_ before the batch opened
+  int64_t batch_version_start_ = 0;   // next_version_ before the batch
+  size_t batch_watch_start_ = 0;      // pending_.size() before the batch
+  std::vector<std::pair<std::pair<std::string, std::string>,
+                        std::optional<Resource>>> batch_undo_;
+  int64_t group_commits_ = 0;      // CommitGroup calls that landed records
+  int64_t group_records_ = 0;      // records landed through group commits
+  int64_t group_fsyncs_ = 0;       // covering fsyncs issued
+  int group_max_batch_ = 0;        // largest batch landed by one commit
+  int64_t watch_coalesced_ = 0;    // events collapsed by DrainWatches
+  int64_t watch_delivered_ = 0;    // events actually delivered
   int64_t compactions_ = 0;
   std::string compact_error_;  // last compaction failure (loud via stateinfo)
   LoadStats load_stats_;
@@ -178,6 +238,9 @@ class Store {
   std::vector<Watcher> watchers_;
   std::vector<WatchEvent> pending_;
   int next_watch_id_ = 1;
+  // Per-pass delivery budget (post-coalescing): bounds how long one
+  // DrainWatches can hold the event loop at high job counts.
+  static constexpr size_t kMaxWatchDeliverPerPass = 4096;
 };
 
 }  // namespace tpk

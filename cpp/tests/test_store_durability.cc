@@ -246,6 +246,271 @@ int main() {
     std::remove((wal + ".snap").c_str());
   }
 
+  // ---- Group commit (ISSUE 8) ---------------------------------------------
+
+  // Mutations buffer until CommitGroup lands them with one covering
+  // fsync; replay sees exactly the committed records, and the WAL bytes
+  // are identical to the per-record path's (byte-for-byte parity).
+  {
+    std::string wal_on = "/tmp/tpk_dur_group_on.jsonl";
+    std::string wal_off = "/tmp/tpk_dur_group_off.jsonl";
+    for (const auto& w : {wal_on, wal_off}) {
+      std::remove(w.c_str());
+      std::remove((w + ".snap").c_str());
+    }
+    auto workload = [](Store& s) {
+      Json spec = Json::Object();
+      spec["x"] = 1;
+      CHECK(s.Create("JAXJob", "a", spec).ok);
+      CHECK(s.Create("JAXJob", "b", spec).ok);
+      Json st = Json::Object();
+      st["phase"] = "Running";
+      CHECK(s.UpdateStatus("JAXJob", "a", st).ok);
+      CHECK(s.UpdateSpec("JAXJob", "b", spec).ok);
+      CHECK(s.Delete("JAXJob", "b").ok);
+      return 0;
+    };
+    {
+      Store on(wal_on);
+      on.SetFsync(Store::FsyncPolicy::kAlways);
+      on.SetGroupCommit(64);
+      workload(on);
+      CHECK(on.PendingGroupRecords() == 5);
+      CHECK(ReadFile(wal_on).empty());  // nothing durable before commit
+      CHECK(on.CommitGroup(nullptr));
+      CHECK(on.PendingGroupRecords() == 0);
+      Json info = on.StateInfo();
+      CHECK(info.get("groupCommit").get("commits").as_int() == 1);
+      CHECK(info.get("groupCommit").get("records").as_int() == 5);
+      CHECK(info.get("groupCommit").get("fsyncs").as_int() == 1);
+      CHECK(info.get("groupCommit").get("maxBatchObserved").as_int() == 5);
+    }
+    {
+      Store off(wal_off);
+      off.SetFsync(Store::FsyncPolicy::kAlways);
+      workload(off);  // per-record path, five fsyncs
+    }
+    CHECK(ReadFile(wal_on) == ReadFile(wal_off));  // byte-for-byte parity
+    Store r(wal_on);
+    CHECK(r.Load() == 5);
+    CHECK(r.load_stats().clean);
+    CHECK(r.Get("JAXJob", "a").has_value());
+    CHECK(!r.Get("JAXJob", "b").has_value());
+    for (const auto& w : {wal_on, wal_off}) std::remove(w.c_str());
+  }
+
+  // A batch torn mid-record (crash during the covering write) truncates
+  // to the last durable record — the standard torn-tail discipline at
+  // batch granularity.
+  {
+    std::string wal = "/tmp/tpk_dur_group_torn.jsonl";
+    std::remove(wal.c_str());
+    std::remove((wal + ".snap").c_str());
+    {
+      Store w(wal);
+      w.SetGroupCommit(64);
+      for (int i = 0; i < 4; ++i) {
+        CHECK(w.Create("JAXJob", "j" + std::to_string(i),
+                       Json::Object()).ok);
+      }
+      CHECK(w.CommitGroup(nullptr));
+    }
+    std::string content = ReadFile(wal);
+    WriteFile(wal, content.substr(0, content.size() - 7));  // tear record 4
+    Store r(wal);
+    CHECK(r.Load() == 3);
+    CHECK(r.load_stats().clean);  // torn FINAL record = expected shape
+    CHECK(r.load_stats().truncated_bytes > 0);
+    CHECK(!r.Get("JAXJob", "j3").has_value());
+    std::remove(wal.c_str());
+  }
+
+  // Commit failure rolls the WHOLE batch back — memory, versions, and
+  // queued watch events — so nothing unacknowledged survives anywhere
+  // (the per-record reject-on-failure contract at batch granularity).
+  {
+    Store s("/dev/full");
+    s.SetGroupCommit(64);
+    int events = 0;
+    s.Watch("JAXJob", [&events](const tpk::WatchEvent&) { ++events; });
+    auto r = s.Create("JAXJob", "doomed", Json::Object());
+    CHECK(r.ok);  // buffered: durability is promised at commit, not here
+    CHECK(s.Get("JAXJob", "doomed").has_value());
+    std::string err;
+    CHECK(!s.CommitGroup(&err));
+    CHECK(err.find("group commit failed") != std::string::npos ||
+          err.find("WAL broken") != std::string::npos);
+    CHECK(!s.Get("JAXJob", "doomed").has_value());  // rolled back
+    s.DrainWatches();
+    CHECK(events == 0);  // the batch's watch events died with it
+    // Later mutations stay loud (broken WAL or repeated commit failure).
+    auto r2 = s.Create("JAXJob", "doomed2", Json::Object());
+    if (r2.ok) CHECK(!s.CommitGroup(nullptr));
+    CHECK(s.List("").empty() || !s.Get("JAXJob", "doomed2").has_value());
+  }
+
+  // The loss window: buffered records that never reach CommitGroup die
+  // with the process — and they were never acknowledged, so replay
+  // correctly shows an empty store.
+  {
+    std::string wal = "/tmp/tpk_dur_group_loss.jsonl";
+    std::remove(wal.c_str());
+    std::remove((wal + ".snap").c_str());
+    {
+      Store w(wal);
+      w.SetGroupCommit(64);
+      CHECK(w.Create("JAXJob", "lost", Json::Object()).ok);
+      // No CommitGroup: destructor drops the user-space batch buffer.
+    }
+    Store r(wal);
+    CHECK(r.Load() == 0);
+    CHECK(!r.Get("JAXJob", "lost").has_value());
+    std::remove(wal.c_str());
+  }
+
+  // Mixed legacy + group-committed appends replay end to end.
+  {
+    std::string wal = "/tmp/tpk_dur_group_legacy.jsonl";
+    std::remove(wal.c_str());
+    std::remove((wal + ".snap").c_str());
+    WriteFile(wal,
+              "{\"kind\":\"JAXJob\",\"name\":\"old1\",\"spec\":{\"v\":1},"
+              "\"status\":{},\"resourceVersion\":1,\"generation\":1}\n");
+    {
+      Store w(wal);
+      w.SetGroupCommit(64);
+      CHECK(w.Load() == 1);
+      CHECK(w.Create("JAXJob", "new1", Json::Object()).ok);
+      CHECK(w.CommitGroup(nullptr));
+    }
+    Store r(wal);
+    CHECK(r.Load() == 2);
+    CHECK(r.load_stats().clean);
+    CHECK(r.Get("JAXJob", "old1").has_value());
+    CHECK(r.Get("JAXJob", "new1").has_value());
+    std::remove(wal.c_str());
+  }
+
+  // fsync=interval composes: covering fsyncs fire once the ACCUMULATED
+  // record count crosses the interval, not per commit.
+  {
+    std::string wal = "/tmp/tpk_dur_group_interval.jsonl";
+    std::remove(wal.c_str());
+    std::remove((wal + ".snap").c_str());
+    Store w(wal);
+    w.SetFsync(Store::FsyncPolicy::kInterval, 8);
+    w.SetGroupCommit(64);
+    for (int commit = 0; commit < 3; ++commit) {
+      for (int i = 0; i < 3; ++i) {
+        CHECK(w.Create("JAXJob",
+                       "j" + std::to_string(commit * 3 + i),
+                       Json::Object()).ok);
+      }
+      CHECK(w.CommitGroup(nullptr));
+    }
+    Json info = w.StateInfo();
+    CHECK(info.get("groupCommit").get("commits").as_int() == 3);
+    CHECK(info.get("groupCommit").get("records").as_int() == 9);
+    CHECK(info.get("groupCommit").get("fsyncs").as_int() == 1);  // at 9 >= 8
+    std::remove(wal.c_str());
+  }
+
+  // Explicit Compact() with a batch open lands the batch first: the
+  // snapshot may never make unacknowledged mutations durable ahead of
+  // their commit, nor strand committed ones behind a stale tail.
+  {
+    std::string wal = "/tmp/tpk_dur_group_compact.jsonl";
+    std::remove(wal.c_str());
+    std::remove((wal + ".snap").c_str());
+    {
+      Store w(wal);
+      w.SetGroupCommit(64);
+      for (int i = 0; i < 3; ++i) {
+        CHECK(w.Create("JAXJob", "j" + std::to_string(i),
+                       Json::Object()).ok);
+      }
+      CHECK(w.PendingGroupRecords() == 3);
+      std::string err;
+      CHECK(w.Compact(&err));
+      CHECK(w.PendingGroupRecords() == 0);
+    }
+    Store r(wal);
+    CHECK(r.Load() == 3);
+    CHECK(r.load_stats().snapshot_loaded);
+    CHECK(r.load_stats().tail_records == 0);
+    std::remove(wal.c_str());
+    std::remove((wal + ".snap").c_str());
+  }
+
+  // ---- Watch coalescing (ISSUE 8) -----------------------------------------
+
+  // A run of ADDED/MODIFIED per (kind, name) collapses to ONE event with
+  // the latest resource; DELETED is a barrier; counters land in
+  // stateinfo.
+  {
+    Store s("");  // coalescing is store-level, WAL not needed
+    std::vector<std::string> seen;
+    s.Watch("JAXJob", [&seen](const tpk::WatchEvent& ev) {
+      seen.push_back(ev.resource.name + ":" +
+                     std::to_string(static_cast<int>(ev.type)) + ":" +
+                     std::to_string(ev.resource.status.get("beat").as_int(-1)));
+    });
+    CHECK(s.Create("JAXJob", "hot", Json::Object()).ok);
+    for (int i = 0; i < 5; ++i) {
+      Json st = Json::Object();
+      st["beat"] = i;
+      CHECK(s.UpdateStatus("JAXJob", "hot", st).ok);
+    }
+    CHECK(s.DrainWatches() == 1);
+    // One ADDED (the create opened the run) carrying the LAST status.
+    CHECK(seen.size() == 1);
+    CHECK(seen[0] == "hot:0:4");
+    Json info = s.StateInfo();
+    CHECK(info.get("watch").get("coalescedEvents").as_int() == 5);
+    CHECK(info.get("watch").get("deliveredEvents").as_int() == 1);
+
+    // DELETED is never coalesced away, and a re-create after it starts
+    // a fresh run: modify → delete → create delivers all three.
+    seen.clear();
+    Json st = Json::Object();
+    st["beat"] = 9;
+    CHECK(s.UpdateStatus("JAXJob", "hot", st).ok);
+    CHECK(s.Delete("JAXJob", "hot").ok);
+    CHECK(s.Create("JAXJob", "hot", Json::Object()).ok);
+    CHECK(s.DrainWatches() == 3);
+    CHECK(seen.size() == 3);
+    CHECK(seen[0] == "hot:1:9");   // MODIFIED, latest pre-delete state
+    CHECK(seen[1] == "hot:2:9");   // DELETED
+    CHECK(seen[2] == "hot:0:-1");  // fresh ADDED
+  }
+
+  // Events queued by an OPEN batch are invisible to DrainWatches until
+  // the covering commit lands: a delivered event cannot be recalled, so
+  // only committed mutations may fan out (and a failed commit can still
+  // drop its batch's events). Committed events ahead of the batch still
+  // drain.
+  {
+    std::string wal = "/tmp/tpk_dur_group_watchgate.jsonl";
+    std::remove(wal.c_str());
+    std::remove((wal + ".snap").c_str());
+    Store s(wal);
+    s.SetGroupCommit(64);
+    int events = 0;
+    s.Watch("JAXJob", [&events](const tpk::WatchEvent&) { ++events; });
+    CHECK(s.Create("JAXJob", "a", Json::Object()).ok);
+    CHECK(s.CommitGroup(nullptr));
+    CHECK(s.Create("JAXJob", "b", Json::Object()).ok);  // opens a batch
+    CHECK(s.DrainWatches() == 1);  // only the committed "a" delivers
+    CHECK(events == 1);
+    CHECK(s.DrainWatches() == 0);  // "b" stays gated behind its commit
+    CHECK(events == 1);
+    CHECK(s.CommitGroup(nullptr));
+    CHECK(s.DrainWatches() == 1);  // now it delivers
+    CHECK(events == 2);
+    std::remove(wal.c_str());
+    std::remove((wal + ".snap").c_str());
+  }
+
   printf("test_store_durability OK\n");
   return 0;
 }

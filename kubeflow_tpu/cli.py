@@ -47,6 +47,10 @@ def cmd_controlplane(args) -> int:
            "--slices", args.slices, "--python", sys.executable]
     if args.wal:
         cmd += ["--wal", args.wal]
+    # Durability knobs pass straight through to the binary.
+    cmd += ["--fsync", args.fsync, "--fsync-interval",
+            str(args.fsync_interval), "--compact", str(args.compact),
+            "--group-commit", str(args.group_commit)]
     print("exec:", " ".join(cmd), file=sys.stderr)
     return subprocess.call(cmd)
 
@@ -244,6 +248,15 @@ def main(argv=None) -> int:
     p.add_argument("--workdir", default="/tmp/tpk")
     p.add_argument("--slices", default="local=8")
     p.add_argument("--wal", default="")
+    p.add_argument("--fsync", default="never",
+                   choices=("never", "interval", "always"),
+                   help="WAL fsync policy (loss window after SIGKILL)")
+    p.add_argument("--fsync-interval", type=int, default=64)
+    p.add_argument("--compact", type=int, default=4096,
+                   help="snapshot+truncate past this many WAL records")
+    p.add_argument("--group-commit", type=int, default=64,
+                   help="max mutations per covering fsync "
+                        "(0 = per-record appends)")
     p.set_defaults(fn=cmd_controlplane)
 
     p = sub.add_parser("submit", help="submit a job spec (yaml/json)")

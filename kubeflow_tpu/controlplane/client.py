@@ -234,8 +234,11 @@ class Client:
     def stateinfo(self) -> dict:
         """Durability health of the control plane's store: WAL replay
         stats (records applied, snapshot vs tail, truncated bytes, clean
-        vs stopped-at-corruption), compaction counters, and the fsync
-        policy — the operator's `etcdctl endpoint status` analog."""
+        vs stopped-at-corruption), compaction counters, the fsync
+        policy, group-commit health (`groupCommit`: commits, records,
+        covering fsyncs, max/mean batch, pending records) and watch
+        fan-out counters (`watch`: coalesced/delivered/queued events) —
+        the operator's `etcdctl endpoint status` analog."""
         return self.request(op="stateinfo")["stateinfo"]
 
     def events(self, name: str, kind: str = "JAXJob") -> dict:
@@ -430,3 +433,40 @@ def start_controlplane(socket_path: str, workdir: str,
         time.sleep(0.1)
     proc.terminate()
     raise TimeoutError(f"control plane socket {socket_path} never came up")
+
+
+class ClusterHandle:
+    """One control plane on a private socket/workdir/WAL that a harness
+    can start, SIGKILL, and restart against the same on-disk state — the
+    shared lifecycle of the kill-9 crash tests
+    (tests/test_crash_recovery.py) and the ctrlbench harness
+    (kubeflow_tpu/controlplane/bench.py); one copy so a startup/teardown
+    semantics change can't silently leave one of them exercising a
+    different lifecycle."""
+
+    def __init__(self, base: str, label: str,
+                 extra_args: list[str] | None = None,
+                 client_timeout: float = 15.0):
+        base = str(base)  # accepts pathlib tmp_path too
+        self.sock = os.path.join(base, f"{label}.sock")
+        self.work = os.path.join(base, f"{label}-work")
+        self.wal = os.path.join(base, f"{label}-wal.jsonl")
+        self.extra_args = list(extra_args or [])
+        self.client_timeout = client_timeout
+        self.proc: subprocess.Popen | None = None
+
+    def start(self) -> Client:
+        self.proc = start_controlplane(self.sock, self.work, wal=self.wal,
+                                       extra_args=self.extra_args)
+        return Client(self.sock, timeout=self.client_timeout)
+
+    def kill9(self) -> None:
+        import signal
+
+        self.proc.send_signal(signal.SIGKILL)
+        self.proc.wait(timeout=10)
+
+    def stop(self) -> None:
+        if self.proc and self.proc.poll() is None:
+            self.proc.terminate()
+            self.proc.wait(timeout=10)

@@ -17,7 +17,6 @@ from __future__ import annotations
 
 import os
 import random
-import signal
 import threading
 import time
 
@@ -54,35 +53,14 @@ def _spec(cmd: str, policy: str) -> dict:
             "command": ["/bin/sh", "-c", cmd]}
 
 
-class _Cluster:
-    """One control plane on a private socket/workdir/WAL that can be
-    SIGKILLed and restarted against the same state."""
+def _Cluster(tmp_path, label: str, extra_args: list[str] | None = None):
+    """The shared control-plane lifecycle wrapper (client.ClusterHandle —
+    one copy with bench.py's harness), with this suite's defaults."""
+    from kubeflow_tpu.controlplane.client import ClusterHandle
 
-    def __init__(self, tmp_path, label: str,
-                 extra_args: list[str] | None = None):
-        self.sock = str(tmp_path / f"{label}.sock")
-        self.work = str(tmp_path / f"{label}-work")
-        self.wal = str(tmp_path / f"{label}-wal.jsonl")
-        self.extra_args = extra_args or ["--fsync", "interval"]
-        self.proc = None
-
-    def start(self):
-        from kubeflow_tpu.controlplane.client import (Client,
-                                                      start_controlplane)
-
-        os.environ.setdefault("TPK_CONTROLPLANE_BIN", BIN)
-        self.proc = start_controlplane(self.sock, self.work, wal=self.wal,
-                                       extra_args=self.extra_args)
-        return Client(self.sock, timeout=15)
-
-    def kill9(self):
-        self.proc.send_signal(signal.SIGKILL)
-        self.proc.wait(timeout=10)
-
-    def stop(self):
-        if self.proc and self.proc.poll() is None:
-            self.proc.terminate()
-            self.proc.wait(timeout=10)
+    os.environ.setdefault("TPK_CONTROLPLANE_BIN", BIN)
+    return ClusterHandle(str(tmp_path), label,
+                         extra_args or ["--fsync", "interval"])
 
 
 def _wait_all(client, names, timeout=120.0) -> dict:
@@ -194,6 +172,76 @@ def test_torn_wal_tail_replays_to_last_good_record(tmp_path):
         assert info["replay"]["applied"] == 2, info
         assert info["replay"]["truncatedBytes"] == 0, info
         assert client.get("Widget", "w3")["spec"]["x"] == 3
+    finally:
+        client.close()
+        cluster.stop()
+
+
+@pytest.mark.parametrize("point,seed", [
+    ("group-commit.pre-write", 5),
+    ("group-commit.pre-write", 11),
+    ("group-commit.pre-fsync", 7),
+])
+def test_kill9_between_apply_and_covering_fsync(tmp_path, point, seed):
+    """The group-commit crash window (ISSUE 8): TPK_CRASH_AT SIGKILLs the
+    REAL binary inside CommitGroup — after the batch's mutations were
+    applied to memory and replies staged, but before the batch is
+    durable ('pre-write': bytes still in user space, genuinely lost with
+    the process; 'pre-fsync': written but unsynced). The ack-after-
+    durable invariant: NO acknowledged mutation may be missing after
+    restart. Unacknowledged outcomes are free — pre-write loses them,
+    pre-fsync may keep them — and both are legal.
+
+    The crash commit is seeded: the n-th covering commit fires the kill,
+    so the schedule replays exactly (`-k <point>-<seed>`)."""
+    rng = random.Random(seed)
+    n_crash_commit = rng.randint(3, 9)
+    cluster = _Cluster(tmp_path, f"gcwin{seed}",
+                       extra_args=["--fsync", "always",
+                                   "--group-commit", "64"])
+    os.environ["TPK_CRASH_AT"] = f"{point}:{n_crash_commit}"
+    try:
+        client = cluster.start()
+    finally:
+        del os.environ["TPK_CRASH_AT"]
+    acked: list[str] = []
+    unacked: list[str] = []
+    try:
+        # Sequential submits: each create is one covering commit, so the
+        # n-th create dies inside the commit window with its reply held
+        # (never acknowledged).
+        for i in range(n_crash_commit + 3):
+            name = f"w{i}"
+            try:
+                client.create("Widget", name, {"i": i})
+                acked.append(name)
+            except Exception:
+                unacked.append(name)
+                break
+        assert unacked, (
+            f"{point}:{n_crash_commit}: server never crashed — the "
+            f"fault point did not fire")
+        cluster.proc.wait(timeout=10)  # SIGKILL'd itself
+
+        client.close()
+        client = cluster.start()  # same workdir + WAL, no crash env
+        info = client.stateinfo()
+        assert info["replay"]["clean"], info
+        present = {r["name"] for r in client.list("Widget")}
+        # THE invariant: every acknowledged mutation survived.
+        missing = [n for n in acked if n not in present]
+        assert not missing, (
+            f"{point}:{n_crash_commit}: acknowledged mutations lost "
+            f"across kill-9: {missing} (present: {sorted(present)})")
+        if point == "group-commit.pre-write":
+            # The batch bytes never left user space: the unacked
+            # mutation is genuinely gone — the documented loss window.
+            assert unacked[0] not in present, (
+                f"unacked {unacked[0]} survived a pre-write SIGKILL — "
+                f"the crash point did not land where it claims")
+        # Either way the store keeps working on the same WAL.
+        client.create("Widget", "after-crash", {"i": -1})
+        assert client.get("Widget", "after-crash")["spec"]["i"] == -1
     finally:
         client.close()
         cluster.stop()

@@ -381,7 +381,14 @@ def test_happy_path_unchanged_with_no_faults_armed(server):
         assert code == 200
         assert body["predictions"] == [[2, 4], [6, 8]]
     # Admission fully drains between requests; readiness stays green.
-    assert srv.admission is not None and srv.admission.inflight == 0
+    # (The handler thread decrements inflight AFTER flushing the body,
+    # so the client can observe the gauge a beat early under load —
+    # poll briefly instead of racing it.)
+    assert srv.admission is not None
+    deadline = _time.monotonic() + 2.0
+    while srv.admission.inflight != 0 and _time.monotonic() < deadline:
+        _time.sleep(0.01)
+    assert srv.admission.inflight == 0
     code, _ = _http("GET", f"{base}/v2/health/ready")
     assert code == 200
     # The disarmed hot-path hook costs one global None-check.

@@ -511,6 +511,79 @@ def test_cpp_checked_io_pragma(tmp_path):
     assert lint(tmp_path, {"cpp/io.cc": fixed}, ["cpp-checked-io"]) == []
 
 
+# -- rule: ack-after-durable ------------------------------------------------
+
+
+def _copy_server(tmp_path):
+    rel = "cpp/server.cc"
+    dst = tmp_path / rel
+    dst.parent.mkdir(parents=True, exist_ok=True)
+    shutil.copy(os.path.join(REPO, rel), dst)
+    return dst
+
+
+def test_ack_after_durable_real_server_is_clean(tmp_path):
+    _copy_server(tmp_path)
+    assert lint(tmp_path, rules=["ack-after-durable"]) == []
+
+
+def test_ack_after_durable_silent_without_server(tmp_path):
+    # Fixture trees without cpp/server.cc must not fire (other rule
+    # tests build such trees constantly).
+    assert lint(tmp_path, {"cpp/other.cc": "int x;\n"},
+                ["ack-after-durable"]) == []
+
+
+def test_release_before_commit_turns_red(tmp_path):
+    """THE red switch: a copy of the real server.cc that flushes staged
+    replies BEFORE the covering fsync (the whole CommitAndRelease body
+    reordered, markers riding along) must be flagged."""
+    dst = _copy_server(tmp_path)
+    src = dst.read_text()
+    commit_mark = "// ack-after-durable: commit"
+    release_mark = "// ack-after-durable: release"
+    assert commit_mark in src and release_mark in src
+    # Swap the two marker labels — textually equivalent to moving the
+    # release block above the commit call.
+    mutated = (src.replace(commit_mark, "@@TMP@@")
+                  .replace(release_mark, commit_mark)
+                  .replace("@@TMP@@", release_mark))
+    dst.write_text(mutated)
+    fs = lint(tmp_path, rules=["ack-after-durable"])
+    assert len(fs) == 1
+    assert "BEFORE the covering fsync" in fs[0].message
+
+
+def test_deleting_ack_marker_turns_red(tmp_path):
+    dst = _copy_server(tmp_path)
+    src = dst.read_text()
+    dst.write_text(src.replace("// ack-after-durable: release", "// gone"))
+    fs = lint(tmp_path, rules=["ack-after-durable"])
+    assert len(fs) == 1
+    assert "ack-after-durable: release" in fs[0].message
+
+
+def test_bare_fwrite_in_group_commit_turns_red(tmp_path):
+    """cpp-checked-io coverage of the new commit path: a copy of the
+    real store.cc whose covering batch fwrite stops checking its return
+    must be flagged (the ISSUE 2 bug class resurfacing inside ISSUE 8's
+    hot path)."""
+    rel = "cpp/store.cc"
+    dst = tmp_path / rel
+    dst.parent.mkdir(parents=True, exist_ok=True)
+    shutil.copy(os.path.join(REPO, rel), dst)
+    src = dst.read_text()
+    checked = ("size_t wrote = fwrite(batch_buf_.data(), 1, "
+               "batch_buf_.size(), wal_);")
+    assert checked in src  # the real commit write, currently checked
+    assert lint(tmp_path, rules=["cpp-checked-io"]) == []
+    dst.write_text(src.replace(
+        checked, "fwrite(batch_buf_.data(), 1, batch_buf_.size(), wal_);"))
+    fs = lint(tmp_path, rules=["cpp-checked-io"])
+    assert len(fs) == 1
+    assert "unchecked `fwrite`" in fs[0].message
+
+
 # -- rule: metrics (the migrated check_metrics) -----------------------------
 
 

@@ -9,6 +9,8 @@ Rules (see each module's docstring for the full contract):
   spec-schema      generated schema artifacts match KNOBS tables
   lock-discipline  `# guarded-by:` fields only touched under their lock
   cpp-checked-io   fwrite/fsync/rename/ftruncate returns checked in cpp/
+  ack-after-durable  server.cc releases staged acks only after the
+                   covering group-commit fsync (markers pinned)
   metrics          tpk_* naming + README table sync (ex check_metrics.py)
 
 Suppression: `# tpk-lint: allow(<rule>) reason=<why>` on the finding's

@@ -17,6 +17,23 @@ stripped — and flags any of those calls used as a bare statement:
 A deliberate best-effort call (e.g. directory fsync after an atomic
 rename, where failure loses nothing that was promised) carries
 `// tpk-lint: allow(cpp-checked-io) reason=...` instead.
+
+Rule `ack-after-durable` (same module — both guard the commit path):
+the group-commit server (ISSUE 8) promises that a client reply which
+acknowledges WAL records reaches the socket only AFTER the covering
+fsync. The ordering lives in cpp/server.cc and is pinned by two marker
+comments (the REQUIRED_TAGS discipline: deleting a marker is itself a
+finding):
+
+    // ack-after-durable: commit    <- the CommitGroup() call
+    // ack-after-durable: release   <- staged replies -> out_buf
+
+The rule fires when either marker is missing or the first `release`
+precedes the first `commit` — the exact mutation (flushing a reply
+before the covering fsync) that would silently void the
+acknowledged-mutation-is-never-lost contract. Like every marker-pinned
+rule, it checks the annotated sites, not arbitrary reorderings of
+unannotated code.
 """
 
 from __future__ import annotations
@@ -163,4 +180,43 @@ def check(ctx: Context) -> list[Finding]:
                 "write/sync here diverges memory from disk (the ISSUE 2 "
                 "WAL bug class); check it, or `(void)`-cast / pragma "
                 "a deliberate best-effort call"))
+    return findings
+
+
+RULE_ACK = "ack-after-durable"
+#: Where the group-commit reply ordering lives; absent in fixture trees
+#: (the rule is then silent), REQUIRED once present.
+ACK_HOME = "cpp/server.cc"
+_ACK_MARK = re.compile(r"//\s*ack-after-durable:\s*(commit|release)\b")
+
+
+@rule(RULE_ACK, "cpp/server.cc must land the covering fsync (commit "
+                "marker) before releasing staged replies (release "
+                "marker); both markers are pinned")
+def check_ack(ctx: Context) -> list[Finding]:
+    text = ctx.read(ACK_HOME)
+    if text is None:
+        return []  # fixture tree without a server: nothing to pin
+    commits: list[int] = []
+    releases: list[int] = []
+    for i, ln in enumerate(text.splitlines(), start=1):
+        m = _ACK_MARK.search(ln)
+        if m:
+            (commits if m.group(1) == "commit" else releases).append(i)
+    findings: list[Finding] = []
+    for name, found in (("commit", commits), ("release", releases)):
+        if not found:
+            findings.append(Finding(
+                RULE_ACK, ACK_HOME, 1,
+                f"required marker `// ack-after-durable: {name}` is "
+                "missing — the ack-after-durable ordering is no longer "
+                "pinned (restore the marker on the "
+                f"{'CommitGroup call' if name == 'commit' else 'staged-reply flush'})"))
+    if commits and releases and min(releases) < min(commits):
+        findings.append(Finding(
+            RULE_ACK, ACK_HOME, min(releases),
+            "staged replies are released BEFORE the covering fsync "
+            "(release marker precedes commit marker) — an acknowledged "
+            "mutation could be lost to a crash after its ack was "
+            "already on the socket"))
     return findings
