@@ -380,6 +380,32 @@ def main_ctrlbench() -> None:
     }))
 
 
+def main_routerbench() -> None:
+    """`python bench.py --routerbench`: multi-replica serving-fabric
+    benchmark → ROUTERBENCH.json + one JSON line
+    (kubeflow_tpu/serve/loadgen.py).
+
+    Pure host-side: an open-loop Poisson load harness over FAKE
+    slot-limited replicas behind real ModelServers and the real router —
+    measures the router (proxy overhead bound, 1→4 horizontal scaling,
+    prefix-affinity hit-rate vs the hash-off control), not model decode.
+    No TPU probe; runs on any box."""
+    from kubeflow_tpu.serve.loadgen import run_routerbench
+
+    result = run_routerbench(quick="--quick" in sys.argv)
+    with open("ROUTERBENCH.json", "w") as fh:
+        json.dump(result, fh, indent=1)
+    print(json.dumps({
+        "metric": "routerbench_scaling_x",
+        "value": result["scaling_x"],
+        "unit": "x_1_replica_goodput",
+        "routed_overhead_p50": result.get("routed_overhead_p50"),
+        "affinity_hit_rate_on": result["affinity"]["hit_rate_on"],
+        "affinity_hit_rate_off": result["affinity"]["hit_rate_off"],
+        "detail": "ROUTERBENCH.json",
+    }))
+
+
 def main_longctx() -> None:
     """`python bench.py --longctx`: the long-context evidence row
     (PROFILE.md §6). On a live chip: measured tok/s + MFU at s>=2048
@@ -552,6 +578,8 @@ def main_longctx_tune() -> None:
 if __name__ == "__main__":
     if "--ctrlbench" in sys.argv:
         main_ctrlbench()
+    elif "--routerbench" in sys.argv:
+        main_routerbench()
     elif "--serve" in sys.argv:
         main_serve()
     elif "--longctx-tune" in sys.argv:

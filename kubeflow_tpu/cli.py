@@ -224,6 +224,39 @@ def cmd_events(args) -> int:
     return 0
 
 
+def cmd_replicas(args) -> int:
+    """The serving fleet as the router sees it (ISSUE 9): address,
+    ready/draining state, live in-flight depth, free KV blocks, and the
+    age of the last scrape — against the router's admin endpoint."""
+    from kubeflow_tpu.serve.fleet import fetch_replicas
+
+    out = fetch_replicas(args.router)
+    if args.json:
+        print(json.dumps(out, indent=2))
+        return 0
+    fmt = "{:<12} {:<28} {:<9} {:>9} {:>8} {:>8} {:>10}"
+    print(fmt.format("NAME", "ADDRESS", "STATE", "OUT", "INFLIGHT",
+                     "KV_FREE", "SCRAPE_AGE"))
+
+    def cell(v, unit=""):
+        return "-" if v is None else f"{v:g}{unit}"
+
+    for r in out.get("replicas", []):
+        print(fmt.format(r["name"], r["url"], r["state"],
+                         str(r["outstanding"]),
+                         cell(r["decode_inflight"]),
+                         cell(r["kv_blocks_free"]),
+                         cell(r["scrape_age_s"], "s")))
+    stats = out.get("router", {})
+    if stats:
+        print(f"router: placed={stats.get('placed', 0)} "
+              f"affinity={stats.get('affinity_hits', 0)} "
+              f"spill={stats.get('spills', 0)} "
+              f"retries={stats.get('retries', 0)} "
+              f"sheds={stats.get('sheds_forwarded', 0)}")
+    return 0
+
+
 def cmd_trace(args) -> int:
     """The control plane's span ring as Chrome trace-event JSON — load
     the output in chrome://tracing or https://ui.perfetto.dev."""
@@ -312,6 +345,14 @@ def main(argv=None) -> int:
     p.add_argument("--json", action="store_true",
                    help="raw JSON (events + conditions)")
     p.set_defaults(fn=cmd_events)
+
+    p = sub.add_parser("replicas",
+                       help="serving-fleet table from the front-door "
+                            "router's admin endpoint")
+    p.add_argument("--router", default="http://127.0.0.1:8090",
+                   help="router base URL (tpk-router --port)")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_replicas)
 
     p = sub.add_parser("trace",
                        help="control-plane spans as Chrome trace JSON")

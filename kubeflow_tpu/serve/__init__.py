@@ -2,20 +2,52 @@
 
 Data plane: Model/JAXModel (AOT bucketed inference), Batcher (request
 coalescing), ModelServer (v1 + v2 open-inference HTTP), storage initializer,
-and ServingRuntime-style format registry.
+and ServingRuntime-style format registry. Fabric layer (ISSUE 9):
+Fleet/Router/RouterServer — the front door over N replicas (affinity
+placement, draining, autoscaling).
+
+Exports resolve LAZILY (PEP 562): server.py pulls in the engine stack
+(jax) at module level, and the front-door router process must be able to
+import its engine-free slice (`serve.router`, `serve.fleet`,
+`serve.headers`) without paying that stall — an eager `__init__` would
+re-defeat exactly that.
 """
 
-from kubeflow_tpu.serve.batcher import Batcher
-from kubeflow_tpu.serve.model import JAXModel, Model
-from kubeflow_tpu.serve.runtimes import (export_for_serving, list_runtimes,
-                                         load_model, register_runtime)
-from kubeflow_tpu.serve.server import (DEADLINE_HEADER, AdmissionController,
-                                       ModelRepository, ModelServer)
-from kubeflow_tpu.serve.storage import download
+import importlib
 
-__all__ = [
-    "AdmissionController", "Batcher", "DEADLINE_HEADER", "JAXModel",
-    "Model", "ModelRepository", "ModelServer", "download",
-    "export_for_serving", "list_runtimes", "load_model",
-    "register_runtime",
-]
+#: export name -> defining submodule (resolved on first attribute access).
+_EXPORTS = {
+    "AdmissionController": "kubeflow_tpu.serve.server",
+    "Batcher": "kubeflow_tpu.serve.batcher",
+    "ControlPlaneScaler": "kubeflow_tpu.serve.fleet",
+    "DEADLINE_HEADER": "kubeflow_tpu.serve.headers",
+    "Fleet": "kubeflow_tpu.serve.fleet",
+    "FleetAutoscaler": "kubeflow_tpu.serve.fleet",
+    "JAXModel": "kubeflow_tpu.serve.model",
+    "Model": "kubeflow_tpu.serve.model",
+    "ModelRepository": "kubeflow_tpu.serve.server",
+    "ModelServer": "kubeflow_tpu.serve.server",
+    "Router": "kubeflow_tpu.serve.router",
+    "RouterServer": "kubeflow_tpu.serve.router",
+    "download": "kubeflow_tpu.serve.storage",
+    "export_for_serving": "kubeflow_tpu.serve.runtimes",
+    "list_runtimes": "kubeflow_tpu.serve.runtimes",
+    "load_model": "kubeflow_tpu.serve.runtimes",
+    "register_runtime": "kubeflow_tpu.serve.runtimes",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    module = _EXPORTS.get(name)
+    if module is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    value = getattr(importlib.import_module(module), name)
+    globals()[name] = value  # cache: next access skips __getattr__
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

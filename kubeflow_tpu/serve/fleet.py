@@ -1,0 +1,619 @@
+"""Replica fleet: the router's model of N engine replicas (ISSUE 9).
+
+The front-door router (serve/router.py) places requests over a set of
+model-server replicas. This module owns everything about those replicas
+EXCEPT placement itself:
+
+  * **Replica table.** One record per replica — address, ready/draining
+    state, the live load signals placement reads, last-scrape age,
+    consecutive probe failures. All mutation is lock-guarded; the router
+    reads immutable snapshots.
+  * **Background scrape poller.** Load signals come from the replicas'
+    EXISTING metrics surface (no new replica API): the gRPC
+    `/tpk.Metrics/Prometheus` method when a replica registers a gRPC
+    address, HTTP `GET /metrics` otherwise — parsed for
+    `tpk_decode_inflight_depth`, `tpk_kv_blocks_free`, and
+    `tpk_serve_inflight` (admission occupancy). Readiness rides the
+    same poll (`/v2/health/ready`, which the ISSUE-1 degradation states
+    already feed). Scraping happens HERE, fanned out on the poller's
+    scrape pool, never on the placement path — placement reads cached
+    numbers.
+  * **Draining.** `drain(name)` removes a replica from placement
+    immediately; the poller watches the replica's router-tracked
+    outstanding count AND its scraped in-flight gauges reach zero, then
+    fires the drain callback exactly once (scale-in retires the process
+    there). In-flight requests are never cut.
+  * **Autoscaling.** `FleetAutoscaler` closes the control loop: router
+    shed rate and fleet occupancy high/low-water drive scale-out
+    callbacks and drain-then-retire scale-in. The controlplane flavor
+    (`ControlPlaneScaler`) reconciles by patching the InferenceService
+    `spec.replicas` through the C++ store — complementing the existing
+    scale-to-zero ISVC (examples/inference_service_scale_to_zero.yaml).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+from kubeflow_tpu.utils.resilience import metrics as res_metrics
+
+#: Replica states. `starting` = registered, not yet probed; optimistic —
+#: placement may try it (a connect failure retries elsewhere and the
+#: poller downgrades it). `down` = N consecutive probe failures.
+#: `draining` = no new placements; `drained` = drain completed (nothing
+#: in flight anywhere), safe to retire.
+STATES = ("starting", "ready", "draining", "drained", "down")
+
+#: Consecutive probe failures before a replica is marked down.
+DOWN_AFTER_FAILURES = 3
+
+#: Drain-completion grace for replicas that expose NO in-flight gauge
+#: (admission disabled / non-generative): their own traffic is
+#: unobservable, so the drain holds this long past drain start instead
+#: of completing on the first poll (see _quiesced_locked).
+DRAIN_UNOBSERVED_GRACE_S = 5.0
+
+
+class Replica:
+    """One replica's record. Instances are internal to the Fleet (mutated
+    under its lock); the router sees `snapshot()` copies."""
+
+    __slots__ = ("name", "url", "grpc", "state", "outstanding",
+                 "decode_inflight", "admission_inflight", "kv_blocks_free",
+                 "last_scrape", "scrape_failures", "on_drained",
+                 "draining_since", "probe_ready")
+
+    def __init__(self, name: str, url: str, grpc: str | None = None):
+        self.name = name
+        self.url = url.rstrip("/")
+        self.grpc = grpc
+        self.state = "starting"
+        #: Router-owned live count of requests this process has in
+        #: flight against the replica — fresher than any scrape.
+        self.outstanding = 0
+        # Scraped load signals (None until the first successful scrape).
+        self.decode_inflight: float | None = None
+        self.admission_inflight: float | None = None
+        self.kv_blocks_free: float | None = None
+        self.last_scrape: float | None = None
+        self.scrape_failures = 0
+        self.on_drained = None
+        self.draining_since: float | None = None
+        #: Last readiness-probe answer (None until first probe). False
+        #: = the replica itself degraded (ISSUE-1 shedding window, an
+        #: out-of-band drain): placement routes around it until the
+        #: probe recovers — the KServe "route around a saturated
+        #: replica" semantics, fleet-side.
+        self.probe_ready: bool | None = None
+
+    def load(self) -> float:
+        """The placement load score: requests this router has riding on
+        the replica plus the replica's own reported concurrency. The
+        admission gauge already counts every request that is decoding,
+        so the two scraped signals combine with max() — summing them
+        double-counted each generative request, which made spill_margin
+        and capacity_per_replica operate in ~3x-inflated units.
+        `outstanding` IS still added on top: it is fresher than any
+        scrape and covers requests the last scrape predates, at the
+        cost of briefly double-counting this router's already-admitted
+        traffic. Unscraped signals count 0 — a brand-new replica looks
+        idle, which is what drains traffic toward it."""
+        return self.outstanding + max(self.decode_inflight or 0,
+                                      self.admission_inflight or 0)
+
+    def placeable(self) -> bool:
+        return (self.state in ("starting", "ready")
+                and self.probe_ready is not False)
+
+    def view(self) -> dict:
+        """JSON-safe copy for admin/CLI surfaces."""
+        return {
+            "name": self.name, "url": self.url, "grpc": self.grpc,
+            "state": self.state, "ready": self.probe_ready,
+            "outstanding": self.outstanding,
+            "decode_inflight": self.decode_inflight,
+            "admission_inflight": self.admission_inflight,
+            "kv_blocks_free": self.kv_blocks_free,
+            "scrape_age_s": (None if self.last_scrape is None
+                             else round(time.monotonic() - self.last_scrape,
+                                        3)),
+            "scrape_failures": self.scrape_failures,
+            "load": self.load(),
+        }
+
+
+def parse_scrape(text: str) -> dict:
+    """Pull the placement signals out of one replica's Prometheus text.
+
+    Sums `tpk_decode_inflight_depth` over the replica's models (a replica
+    may serve several engines), keeps the SCARCEST `tpk_kv_blocks_free`
+    (admission blocks on the tightest pool), and reads the unlabeled
+    `tpk_serve_inflight` admission gauge. Missing series stay None —
+    flat engines have no pool gauges, non-generative replicas no decode
+    depth."""
+    decode = None
+    kv_free = None
+    admission = None
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name, _, rest = line.partition(" ")
+        base = name.partition("{")[0]
+        try:
+            value = float(rest.split()[0])
+        except (ValueError, IndexError):
+            continue
+        if base == "tpk_decode_inflight_depth":
+            decode = (decode or 0.0) + value
+        elif base == "tpk_kv_blocks_free":
+            kv_free = value if kv_free is None else min(kv_free, value)
+        elif base == "tpk_serve_inflight":
+            admission = value
+    return {"decode_inflight": decode, "kv_blocks_free": kv_free,
+            "admission_inflight": admission}
+
+
+class Fleet:
+    """The replica table + its background poller.
+
+    Thread model: request threads call checkout/checkin/snapshot; the
+    poller thread scrapes and writes load signals; admin calls mutate
+    membership. Everything meets under `_lock`; network I/O (scrapes,
+    probes) happens strictly OUTSIDE it.
+    """
+
+    def __init__(self, poll_interval_s: float = 0.25,
+                 scrape_timeout_s: float = 2.0,
+                 start_poller: bool = True):
+        self._replicas: dict[str, Replica] = {}  # guarded-by: _lock
+        #: Membership generation — bumped on add/remove/state change so
+        #: the router knows to rebuild its hash ring.
+        self._version = 0  # guarded-by: _lock
+        self._grpc_clients: dict = {}  # guarded-by: _lock
+        self._lock = threading.Lock()
+        self.poll_interval_s = float(poll_interval_s)
+        self.scrape_timeout_s = float(scrape_timeout_s)
+        self._closed = threading.Event()
+        # Scrapes fan out on this pool (threads are lazy): one stalled
+        # replica must not serialize the pass and stale every OTHER
+        # replica's load signals behind its timeout.
+        self._scrape_pool = ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="tpk-fleet-scrape")
+        self._thread: threading.Thread | None = None
+        if start_poller:
+            self._thread = threading.Thread(
+                target=self._poll_loop, daemon=True, name="tpk-fleet-poll")
+            self._thread.start()
+
+    # -- membership ---------------------------------------------------------
+
+    def add(self, name: str, url: str, grpc: str | None = None) -> None:
+        """Register a replica (idempotent on the same address; a new
+        address replaces the record — the controller relaunched it)."""
+        with self._lock:
+            cur = self._replicas.get(name)
+            if cur is not None and cur.url == url.rstrip("/") \
+                    and cur.grpc == grpc:
+                return
+            self._replicas[name] = Replica(name, url, grpc)
+            client = self._grpc_clients.pop(name, None)
+            self._version += 1
+            n = len(self._replicas)
+        if client is not None:
+            # Only the scrape pool uses these clients; a scrape racing
+            # the close fails once and self-heals on the next pass.
+            try:
+                client.close()
+            except Exception:
+                pass
+        res_metrics.set_gauge("tpk_router_replicas", n)
+
+    def remove(self, name: str) -> None:
+        """Drop a replica immediately — no drain, in-flight requests to
+        it will fail and retry elsewhere. Use `drain()` for graceful
+        scale-in."""
+        with self._lock:
+            self._replicas.pop(name, None)
+            client = self._grpc_clients.pop(name, None)
+            self._version += 1
+            n = len(self._replicas)
+        if client is not None:
+            try:
+                client.close()
+            except Exception:
+                pass
+        res_metrics.set_gauge("tpk_router_replicas", n)
+
+    def drain(self, name: str, on_drained=None) -> bool:
+        """Stop placing new requests on `name`; in-flight requests (both
+        this router's outstanding and the replica's own gauges) finish.
+        `on_drained(name)` fires exactly once when everything lands.
+        Returns False for an unknown replica."""
+        with self._lock:
+            r = self._replicas.get(name)
+            if r is None:
+                return False
+            if r.state not in ("draining", "drained"):
+                r.state = "draining"
+                r.draining_since = time.monotonic()
+                r.on_drained = on_drained
+                self._version += 1
+            return True
+
+    # -- placement-side accessors ------------------------------------------
+
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._replicas)
+
+    def placeable_names(self) -> list[str]:
+        with self._lock:
+            return sorted(n for n, r in self._replicas.items()
+                          if r.placeable())
+
+    def snapshot(self) -> list[dict]:
+        with self._lock:
+            return [r.view() for _, r in sorted(self._replicas.items())]
+
+    def loads(self, names=None) -> dict[str, float]:
+        """name -> load score for the given (default: placeable)
+        replicas. One lock hop, no I/O — safe on the placement path."""
+        with self._lock:
+            if names is None:
+                return {n: r.load() for n, r in self._replicas.items()
+                        if r.placeable()}
+            return {n: self._replicas[n].load() for n in names
+                    if n in self._replicas}
+
+    def get(self, name: str) -> dict | None:
+        with self._lock:
+            r = self._replicas.get(name)
+            return r.view() if r is not None else None
+
+    def url_of(self, name: str) -> str | None:
+        with self._lock:
+            r = self._replicas.get(name)
+            return r.url if r is not None else None
+
+    def checkout(self, name: str) -> bool:
+        """Claim one outstanding slot on the replica (the router calls
+        this around every forward so drain can see true quiescence)."""
+        with self._lock:
+            r = self._replicas.get(name)
+            if r is None:
+                return False
+            r.outstanding += 1
+            return True
+
+    def checkin(self, name: str, *, failed: bool = False) -> None:
+        with self._lock:
+            r = self._replicas.get(name)
+            if r is None:
+                return
+            r.outstanding = max(r.outstanding - 1, 0)
+            if failed:
+                # A connect-level failure is evidence ahead of the next
+                # poll: nudge the failure count so repeated resets take
+                # the replica out of placement quickly.
+                r.scrape_failures += 1
+                if (r.scrape_failures >= DOWN_AFTER_FAILURES
+                        and r.state in ("starting", "ready")):
+                    r.state = "down"
+                    self._version += 1
+
+    # -- polling ------------------------------------------------------------
+
+    def _scrape_one(self, name: str, url: str, grpc: str | None) -> dict:
+        """One replica's load signals + readiness, via the existing
+        surfaces. Runs on the scrape pool only (network I/O)."""
+        if grpc:
+            client = self._grpc_client(name, grpc)
+            text = client.metrics(timeout=self.scrape_timeout_s)
+        else:
+            with urllib.request.urlopen(f"{url}/metrics",
+                                        timeout=self.scrape_timeout_s) as r:
+                text = r.read().decode()
+        out = parse_scrape(text)
+        out["ready"] = self._probe_ready(url)
+        return out
+
+    def _grpc_client(self, name: str, grpc_addr: str):
+        with self._lock:
+            client = self._grpc_clients.get(name)
+        if client is None:
+            from kubeflow_tpu.serve.grpc_server import InferenceClient
+
+            client = InferenceClient(grpc_addr)
+            with self._lock:
+                self._grpc_clients[name] = client
+        return client
+
+    def _probe_ready(self, url: str) -> bool:
+        try:
+            with urllib.request.urlopen(f"{url}/v2/health/ready",
+                                        timeout=self.scrape_timeout_s) as r:
+                return r.status == 200
+        except urllib.error.HTTPError:
+            return False  # 503 = degraded/draining, the probe answered
+
+    def _poll_loop(self) -> None:
+        while not self._closed.wait(self.poll_interval_s):
+            self.poll_once()
+
+    def poll_once(self) -> None:
+        """One scrape pass over the fleet — the poller's body, public so
+        tests (and CLI one-shots) can drive it synchronously. Replicas
+        scrape in parallel on the pool; the pass still blocks until
+        every result (bounded by the per-request scrape timeouts) is
+        applied, so synchronous drivers see a complete table."""
+        with self._lock:
+            targets = [(r.name, r.url, r.grpc)
+                       for r in self._replicas.values()
+                       if r.state != "drained"]
+        if not targets:
+            return
+
+        def scrape_and_apply(target):
+            name, url, grpc = target
+            try:
+                sig = self._scrape_one(name, url, grpc)
+            except Exception:
+                sig = None
+            # Apply HERE, as each scrape lands — an in-order gather
+            # would hold every fast replica's fresh signals hostage to
+            # the slowest scrape's timeout.
+            self.update_load(name, sig)
+
+        for f in [self._scrape_pool.submit(scrape_and_apply, t)
+                  for t in targets]:
+            f.result()
+
+    def update_load(self, name: str, sig: dict | None) -> None:
+        """Apply one scrape result (None = probe failed) to the table.
+        The poller's write path — and the unit-test hook for driving
+        placement scenarios without live replicas."""
+        fire_drained = None
+        with self._lock:
+            r = self._replicas.get(name)
+            if r is None:
+                return
+            if sig is None:
+                r.scrape_failures += 1
+                if (r.scrape_failures >= DOWN_AFTER_FAILURES
+                        and r.state in ("starting", "ready")):
+                    r.state = "down"
+                    self._version += 1
+            else:
+                r.scrape_failures = 0
+                r.last_scrape = time.monotonic()
+                for k in ("decode_inflight", "admission_inflight",
+                          "kv_blocks_free"):
+                    if k in sig:
+                        setattr(r, k, sig[k])
+                if "ready" in sig and sig["ready"] != r.probe_ready:
+                    # A degradation flip changes placeability — bump the
+                    # version so the router rebuilds its ring.
+                    r.probe_ready = sig["ready"]
+                    self._version += 1
+                if r.state in ("starting", "down"):
+                    # Readiness may be degraded (shedding) — the replica
+                    # still answers, so it is back in the table; a
+                    # not-ready-but-alive replica keeps its state until
+                    # ready flips true.
+                    if sig.get("ready", True):
+                        r.state = "ready"
+                        self._version += 1
+            if r.state == "draining" and self._quiesced_locked(r, sig):
+                r.state = "drained"
+                self._version += 1
+                fire_drained, r.on_drained = r.on_drained, None
+        if fire_drained is not None:
+            try:
+                fire_drained(name)
+            except Exception:
+                pass  # a retire hook must never kill the poller
+
+    @staticmethod
+    def _quiesced_locked(r: Replica, sig: dict | None) -> bool:
+        """Drain completion: nothing outstanding from this router AND the
+        replica's own gauges read idle (or the replica is gone — nothing
+        left to preserve)."""
+        if r.outstanding > 0:
+            return False
+        if sig is None:
+            return r.scrape_failures >= DOWN_AFTER_FAILURES
+        decode = sig.get("decode_inflight")
+        admission = sig.get("admission_inflight")
+        if decode is None and admission is None:
+            # The replica exposes NO in-flight gauge: absence is not
+            # evidence of idleness (other routers' / direct clients'
+            # traffic is unobservable), so hold the drain for a grace
+            # window rather than completing on the first poll. Best
+            # effort only — work longer than the grace can still be
+            # cut; replicas with an admission gate are fully observed.
+            since = r.draining_since or 0.0
+            return time.monotonic() - since >= DRAIN_UNOBSERVED_GRACE_S
+        return not decode and not admission
+
+    def close(self) -> None:
+        self._closed.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        self._scrape_pool.shutdown(wait=False)
+        with self._lock:
+            clients = list(self._grpc_clients.values())
+            self._grpc_clients.clear()
+        for c in clients:
+            try:
+                c.close()
+            except Exception:
+                pass
+
+
+class FleetAutoscaler:
+    """Closes the load → replica-count loop (ISSUE 9 tentpole).
+
+    Inputs are ROUTER-observed: the shed rate (503s forwarded to
+    callers since the last evaluation) and fleet occupancy (mean load
+    per replica against `capacity_per_replica`). Policy:
+
+      * sheds observed OR occupancy >= high_water  → scale OUT (+1).
+      * occupancy <= low_water for `low_water_evals` consecutive
+        evaluations and more than `min_replicas` remain → scale IN:
+        pick the least-loaded replica, DRAIN it (placement stops,
+        in-flight finishes), and only when the fleet reports it
+        quiesced does `retire(name)` run.
+
+    The scaler is deliberately callback-shaped: `scale_up()` adds a
+    replica however the deployment does (spawn a process, patch an ISVC
+    through `ControlPlaneScaler`, …) and `retire(name)` tears one down.
+    `evaluate()` is the whole policy — the background thread just calls
+    it on an interval, so tests drive it synchronously."""
+
+    def __init__(self, fleet: Fleet, router, *, scale_up, retire,
+                 capacity_per_replica: float = 8.0,
+                 high_water: float = 0.8, low_water: float = 0.2,
+                 low_water_evals: int = 3,
+                 min_replicas: int = 1, max_replicas: int = 8,
+                 interval_s: float = 1.0):
+        self.fleet = fleet
+        self.router = router
+        self.scale_up = scale_up
+        self.retire = retire
+        self.capacity_per_replica = float(capacity_per_replica)
+        self.high_water = float(high_water)
+        self.low_water = float(low_water)
+        self.low_water_evals = int(low_water_evals)
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.interval_s = float(interval_s)
+        self._last_sheds = 0.0
+        self._low_streak = 0
+        self._closed = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "FleetAutoscaler":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="tpk-autoscaler")
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._closed.wait(self.interval_s):
+            try:
+                self.evaluate()
+            except Exception:
+                pass  # a flaky scale hook must not kill the loop
+
+    def occupancy(self) -> float:
+        loads = self.fleet.loads()
+        if not loads:
+            return 0.0
+        cap = self.capacity_per_replica * len(loads)
+        return sum(loads.values()) / max(cap, 1e-9)
+
+    def evaluate(self) -> str | None:
+        """One policy step; returns the action taken (telemetry/tests)."""
+        stats = self.router.stats_snapshot()
+        # no_replica counts too: a fleet whose every replica degraded
+        # into its shedding window is the loudest possible scale signal.
+        sheds = (float(stats.get("sheds_forwarded", 0))
+                 + float(stats.get("no_replica", 0)))
+        shed_delta, self._last_sheds = sheds - self._last_sheds, sheds
+        occ = self.occupancy()
+        # Draining replicas still count toward the total (their retire
+        # is already committed) so a slow drain can't double-scale —
+        # but drained/down ones are no longer capacity and must not
+        # consume max_replicas headroom: past scale-ins (whose retired
+        # table entries a count-based ControlPlaneScaler never removes)
+        # would otherwise permanently block future scale-outs.
+        total = len([r for r in self.fleet.snapshot()
+                     if r["state"] in ("starting", "ready", "draining")])
+        if (shed_delta > 0 or occ >= self.high_water) \
+                and total < self.max_replicas:
+            self._low_streak = 0
+            self.scale_up()
+            return "scale_up"
+        placeable = self.fleet.placeable_names()
+        if occ <= self.low_water and len(placeable) > self.min_replicas:
+            self._low_streak += 1
+            if self._low_streak >= self.low_water_evals:
+                self._low_streak = 0
+                loads = self.fleet.loads(placeable)
+                victim = min(placeable,
+                             key=lambda n: (loads.get(n, 0.0), n))
+                self.fleet.drain(victim, on_drained=self._retire_and_remove)
+                return f"drain:{victim}"
+        else:
+            self._low_streak = 0
+        return None
+
+    def _retire_and_remove(self, name: str) -> None:
+        """Drain-completion hook: tear the replica down AND drop its
+        table entry — a retired 'drained' record kept forever would
+        inflate tpk_router_replicas and eat max_replicas headroom."""
+        try:
+            self.retire(name)
+        finally:
+            self.fleet.remove(name)
+
+    def close(self) -> None:
+        self._closed.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+
+class ControlPlaneScaler:
+    """Autoscaler callbacks that reconcile through the C++ store: patch
+    the InferenceService's `spec.replicas`, and let the serving
+    controller (cpp/serve.cc) do the actual process launch/teardown —
+    the same path `tpukit submit` and the scale-to-zero example use.
+    The fixed-replica ISVC reconcile already follows `spec.replicas`
+    updates, so the router's autoscaler composes with it without any
+    new control-plane verb.
+
+    LIMITATION — count-based, not victim-targeted: `retire(name)` only
+    decrements `spec.replicas`; the serving controller picks which
+    process to tear down when reconciling the count, and that may NOT
+    be the replica the fleet just drained (k8s has pod-deletion-cost
+    for this; the store schema has no per-replica selector yet). Safe
+    only where the controller's victim choice matches the drain (e.g.
+    it retires the highest index and the autoscaler drains the same),
+    or where a second drain cycle on the survivor is acceptable.
+    Deployments that need exact victim identity should pass a custom
+    `retire` callback that kills the drained process directly."""
+
+    def __init__(self, client, isvc_name: str):
+        self.client = client
+        self.isvc = isvc_name
+
+    def _replicas(self) -> int:
+        res = self.client.get("InferenceService", self.isvc)
+        return int(res.get("spec", {}).get("replicas", 1))
+
+    def scale_up(self) -> None:
+        self.client.update_spec("InferenceService", self.isvc,
+                                {"replicas": self._replicas() + 1})
+
+    def retire(self, name: str) -> None:
+        self.client.update_spec(
+            "InferenceService", self.isvc,
+            {"replicas": max(self._replicas() - 1, 0)})
+
+
+def fetch_replicas(router_url: str, timeout_s: float = 5.0) -> dict:
+    """GET the router's admin replica table (the `tpukit replicas`
+    backend)."""
+    with urllib.request.urlopen(
+            f"{router_url.rstrip('/')}/admin/replicas",
+            timeout=timeout_s) as r:
+        return json.loads(r.read().decode())

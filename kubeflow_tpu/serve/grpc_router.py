@@ -1,0 +1,185 @@
+"""gRPC front door: the router's open-inference plane (ISSUE 9).
+
+The replica's gRPC server (serve/grpc_server.py) hand-rolls its service
+with `method_handlers_generic_handler`; the router fronts the SAME
+method names with identity (de)serializers — requests stay raw bytes
+end to end, so the router needs no protobuf schema knowledge and adds
+no re-encode cost. Placement is least-loaded over the replicas that
+registered a gRPC address (byte-opaque requests carry no usable prefix
+signal — affinity stays an HTTP-plane feature); `UNAVAILABLE` failures
+(connect refused, replica draining) retry on a different replica under
+the caller's gRPC deadline, mirroring the HTTP retry contract.
+`x-request-id` metadata is honored/assigned, forwarded, and echoed in
+the trailing metadata — one trace identity across both planes.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent import futures
+from typing import TYPE_CHECKING
+
+import grpc
+
+from kubeflow_tpu.utils import obs
+from kubeflow_tpu.utils.resilience import metrics as res_metrics
+
+if TYPE_CHECKING:
+    from kubeflow_tpu.serve.router import RouterServer
+
+SERVICE = "inference.GRPCInferenceService"
+_METHODS = ("ServerLive", "ServerReady", "ModelReady", "ModelMetadata",
+            "ModelInfer")
+
+
+class GrpcRouterServicer:
+    """Byte-level forwarders for every replica RPC."""
+
+    def __init__(self, server: "RouterServer"):
+        self.server = server
+        self.fleet = server.fleet
+        self.router = server.router
+        #: name -> (addr, channel); keyed on the ADDRESS too, so a
+        #: replica relaunched elsewhere doesn't keep being dialed at
+        #: its dead old port through a stale cached channel.
+        self._channels: dict[str, tuple[str, grpc.Channel]] = {}  # guarded-by: _lock
+        self._lock = threading.Lock()
+
+    def _channel(self, name: str, addr: str) -> grpc.Channel:
+        with self._lock:
+            cached = self._channels.get(name)
+            if cached is not None and cached[0] == addr:
+                return cached[1]
+            ch = grpc.insecure_channel(addr)
+            self._channels[name] = (addr, ch)
+        if cached is not None:
+            # Deferred close: closing now would CANCEL any RPC still in
+            # flight on the displaced channel; every such RPC carries a
+            # timeout <= forward_timeout_s, so past that they are all
+            # settled and the close only reclaims the channel.
+            timer = threading.Timer(self.server.forward_timeout_s + 1.0,
+                                    cached[1].close)
+            timer.daemon = True
+            timer.start()
+        return ch
+
+    def _grpc_replicas(self) -> dict[str, str]:
+        """placeable replica name -> gRPC address. Mirrors the HTTP
+        plane's Replica.placeable(): a degraded readiness probe routes
+        the replica out of placement on BOTH planes."""
+        out = {}
+        for r in self.server.fleet.snapshot():
+            if r["grpc"] and r["state"] in ("starting", "ready") \
+                    and r["ready"] is not False:
+                out[r["name"]] = r["grpc"]
+        return out
+
+    def forward(self, full_method: str, request: bytes, context) -> bytes:
+        rid = next((v for k, v in (context.invocation_metadata() or ())
+                    if k.lower() == "x-request-id"), None)
+        trace_id = obs.sanitize_trace_id(rid)
+        context.set_trailing_metadata((("x-request-id", trace_id),))
+        addrs = self._grpc_replicas()
+        exclude: set[str] = set()
+        attempts = 0
+        last_err = "no gRPC-capable replica registered"
+        while True:
+            candidates = {n: a for n, a in addrs.items()
+                          if n not in exclude}
+            loads = self.fleet.loads(sorted(candidates))
+            if not candidates:
+                res_metrics.inc("tpk_router_requests_total", replica="-",
+                                outcome="no_replica")
+                self.router._bump("no_replica")
+                context.abort(grpc.StatusCode.UNAVAILABLE,
+                              f"no live replica: {last_err}")
+            with obs.span("router.place", trace_id=trace_id,
+                          path=full_method) as sp:
+                name = min(candidates,
+                           key=lambda n: (loads.get(n, 0.0), n))
+                sp.set(replica=name, reason="least-loaded")
+            res_metrics.inc("tpk_router_placement_total",
+                            reason="least-loaded")
+            self.router._bump("placed")
+            self.router._bump("least_loaded")
+            rem = context.time_remaining()
+            timeout = (min(rem, self.server.forward_timeout_s)
+                       if rem is not None else self.server.forward_timeout_s)
+            if timeout <= 0:
+                res_metrics.inc("tpk_router_requests_total", replica=name,
+                                outcome="deadline")
+                context.abort(grpc.StatusCode.DEADLINE_EXCEEDED,
+                              "request deadline exceeded (router)")
+            rpc = self._channel(name, candidates[name]).unary_unary(
+                full_method,
+                request_serializer=lambda b: b,
+                response_deserializer=lambda b: b)
+            attempts += 1
+            self.fleet.checkout(name)
+            try:
+                resp = rpc(request, timeout=timeout,
+                           metadata=(("x-request-id", trace_id),))
+            except grpc.RpcError as e:
+                code = e.code()
+                retryable = code == grpc.StatusCode.UNAVAILABLE
+                draining = "draining" in (e.details() or "")
+                self.fleet.checkin(name,
+                                   failed=retryable and not draining)
+                last_err = f"{name}: {code.name}: {e.details()}"
+                if retryable and attempts <= max(len(addrs), 1):
+                    exclude.add(name)
+                    res_metrics.inc("tpk_router_retry_total",
+                                    reason=("draining" if draining
+                                            else "connect"))
+                    self.router._bump("retries")
+                    continue
+                outcome = ("shed" if code ==
+                           grpc.StatusCode.RESOURCE_EXHAUSTED
+                           else "retry_exhausted" if retryable
+                           else "upstream_error")
+                res_metrics.inc("tpk_router_requests_total",
+                                replica=name, outcome=outcome)
+                self.router._bump("sheds_forwarded"
+                                  if outcome == "shed" else "errors")
+                # Forward the replica's status verbatim — a shed's
+                # RESOURCE_EXHAUSTED is backpressure, not retry fodder.
+                context.abort(code, e.details() or code.name)
+            else:
+                self.fleet.checkin(name)
+                res_metrics.inc("tpk_router_requests_total",
+                                replica=name, outcome="ok")
+                self.router._bump("ok")
+                return resp
+
+
+def _identity_handler(fn):
+    return grpc.unary_unary_rpc_method_handler(
+        fn, request_deserializer=lambda b: b,
+        response_serializer=lambda b: b)
+
+
+def build_grpc_router(server: "RouterServer", port: int = 0,
+                      max_workers: int = 16):
+    """Returns (grpc.Server, bound_port) on 127.0.0.1."""
+    servicer = GrpcRouterServicer(server)
+
+    def fwd(method):
+        full = f"/{SERVICE}/{method}"
+        return _identity_handler(
+            lambda req, ctx, _f=full: servicer.forward(_f, req, ctx))
+
+    handlers = grpc.method_handlers_generic_handler(
+        SERVICE, {m: fwd(m) for m in _METHODS})
+    metrics_handlers = grpc.method_handlers_generic_handler(
+        "tpk.Metrics", {
+            "Prometheus": _identity_handler(
+                lambda req, ctx: servicer.forward(
+                    "/tpk.Metrics/Prometheus", req, ctx)),
+        })
+    gserver = grpc.server(futures.ThreadPoolExecutor(
+        max_workers=max_workers, thread_name_prefix="tpk-grpc-router"))
+    gserver.add_generic_rpc_handlers((handlers, metrics_handlers))
+    bound = gserver.add_insecure_port(f"127.0.0.1:{port}")
+    if bound == 0:
+        raise RuntimeError(f"cannot bind router gRPC port {port}")
+    return gserver, bound

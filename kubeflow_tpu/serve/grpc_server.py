@@ -140,6 +140,13 @@ class InferenceServicer:
                     if k.lower() == "x-request-id"), None)
         trace_id = obs.sanitize_trace_id(rid)
         context.set_trailing_metadata((("x-request-id", trace_id),))
+        if getattr(self.server, "draining", False):
+            # Scale-in drain: same contract as the HTTP plane's 503 +
+            # DRAINING_HEADER — UNAVAILABLE (not RESOURCE_EXHAUSTED,
+            # which means overload backpressure) with "draining" in the
+            # details so the router retries on a surviving replica.
+            context.abort(grpc.StatusCode.UNAVAILABLE,
+                          "replica draining")
         # The gRPC data plane sits behind the SAME admission gate as the
         # HTTP handlers — it must not be an unbounded side door around
         # --max-inflight. RESOURCE_EXHAUSTED is the canonical overload
@@ -335,6 +342,12 @@ class InferenceClient:
         return self._call("ServerLive", pb.ServerLiveRequest(),
                           pb.ServerLiveResponse).live
 
+    def server_ready(self) -> bool:
+        """The gRPC readiness probe — same shared rule as HTTP
+        /v2/health/ready (degrades while shedding OR draining)."""
+        return self._call("ServerReady", pb.ServerReadyRequest(),
+                          pb.ServerReadyResponse).ready
+
     def model_ready(self, name: str) -> bool:
         return self._call("ModelReady", pb.ModelReadyRequest(name=name),
                           pb.ModelReadyResponse).ready
@@ -344,15 +357,16 @@ class InferenceClient:
                           pb.ModelMetadataRequest(name=name),
                           pb.ModelMetadataResponse)
 
-    def metrics(self) -> str:
+    def metrics(self, timeout: float | None = None) -> str:
         """The server's Prometheus text over the gRPC plane (same
         rendering as HTTP /metrics — engine pipelining counters
-        included)."""
+        included). `timeout` bounds the RPC — the fleet poller's scrape
+        must never hang on an unreachable replica."""
         rpc = self._channel.unary_unary(
             "/tpk.Metrics/Prometheus",
             request_serializer=lambda b: b,
             response_deserializer=lambda b: b)
-        return rpc(b"").decode()
+        return rpc(b"", timeout=timeout).decode()
 
     def infer(self, name: str, arrays: list[np.ndarray], *,
               raw: bool = False,

@@ -1,0 +1,22 @@
+"""Wire-protocol header names shared by the replica server and the
+front-door router (ISSUE 9).
+
+This module must stay DEPENDENCY-FREE: server.py imports the engine
+stack (jax) at module level, so any constant the router needs must live
+where importing it costs nothing — the router process (and its first
+proxied request) must never pay the engine's import stall or RSS.
+"""
+
+#: End-to-end request budget in milliseconds. The router re-issues it
+#: to the replica as the REMAINING budget at forward time — deadline
+#: propagation, not per-hop resets.
+DEADLINE_HEADER = "X-Request-Timeout-Ms"
+
+#: Trace identity: honored when the caller sets it, assigned otherwise,
+#: echoed back, and forwarded replica-ward — one id across the fabric.
+REQUEST_ID_HEADER = "X-Request-Id"
+
+#: Marker the replica sets on a drain shed (server.py admit()) so the
+#: router can tell "draining — retry elsewhere" from "overloaded —
+#: forward the backpressure".
+DRAINING_HEADER = "X-Tpk-Draining"

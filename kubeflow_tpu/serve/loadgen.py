@@ -1,0 +1,493 @@
+"""Open-loop load harness + router benchmark (ISSUE 9).
+
+The r4 batcher-tail episode (PROFILE.md §5) is the standing lesson: a
+harness bug can fabricate a 13x tail. This harness is therefore built
+around the two disciplines that episode taught:
+
+  * **Open loop.** Arrival times are drawn from a seeded Poisson process
+    and requests FIRE AT THEIR SCHEDULED TIME regardless of completions
+    — a closed loop (next request after the last reply) hides queueing
+    collapse, because a saturated server slows the offered load down to
+    exactly what it can serve.
+  * **Mechanism arms, honest labels.** The replicas are FAKE engines
+    (slot-limited timed service, a real prefix-seen cache) behind REAL
+    ModelServers: the numbers measure the ROUTER — placement, proxy
+    overhead, horizontal scaling, affinity — not model decode. The
+    artifact says so.
+
+`run_routerbench()` (→ ROUTERBENCH.json via `python bench.py
+--routerbench`) records:
+
+  * routed-1-replica vs direct-1-replica: the router's p50 overhead
+    bound (acceptance: <= 10%);
+  * routed-4 vs routed-1 at the SAME per-replica offered load: the
+    horizontal-scaling claim (acceptance: >= 3x goodput at equal p99
+    deadline-miss rate);
+  * affinity on vs hash-off control at identical traffic: the
+    prefix-cache hit-rate delta (acceptance: strictly above).
+
+Latency percentiles are reported both from the per-request records and
+from the replicas' EXISTING `tpk_serve_request_latency_seconds`
+histograms (scraped and merged), so the two views cross-check each
+other.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+from kubeflow_tpu.serve.model import Model
+from kubeflow_tpu.serve.server import (DEADLINE_HEADER, ModelServer)
+
+#: Tokens of prompt prefix the fake prefix cache keys on — matches the
+#: router's affinity_key prefix window so an affinity hit IS a cache
+#: hit after first touch.
+PREFIX_TOKENS = 32
+
+
+class FakeEngine:
+    """The engine-shaped stats surface a fake replica exports, so the
+    REAL /metrics rendering (ModelServer._engine_metric_lines) and the
+    fleet scrape see live gauges: tpk_decode_inflight_depth, request
+    and prefix-cache counters."""
+
+    pipeline_depth = 1
+
+    def __init__(self):
+        self.stats = {  # guarded-by: _lock
+            "requests": 0, "decode_tokens": 0,
+            "prefix_hits": 0, "prefix_misses": 0,
+        }
+        self.inflight_depth = 0  # single int store, GIL-atomic reads
+        self._lock = threading.Lock()
+
+    def bump(self, **deltas) -> None:
+        with self._lock:
+            for k, v in deltas.items():
+                self.stats[k] = self.stats.get(k, 0) + v
+
+    def enter(self) -> None:
+        with self._lock:
+            self.inflight_depth += 1
+
+    def exit(self) -> None:
+        with self._lock:
+            self.inflight_depth -= 1
+
+    def stats_snapshot(self) -> dict:
+        with self._lock:
+            return dict(self.stats)
+
+
+class FakeGenerativeModel(Model):
+    """A timed stand-in for the generation engine: `slots` concurrent
+    decodes, service time = prefill + max_tokens x per-token, with a
+    prefix-seen cache (keyed like the engine's (adapter, prefix) family)
+    that discounts the prefill on a hit. Deterministic, CPU-only, and
+    honest about concurrency — queueing happens in a real semaphore, so
+    open-loop overload produces real latency growth."""
+
+    def __init__(self, name: str, *, slots: int = 4,
+                 per_token_s: float = 0.0012, prefill_s: float = 0.012,
+                 hit_prefill_s: float = 0.002):
+        super().__init__(name)
+        self.ready = True
+        self.engine = FakeEngine()
+        self.slots = int(slots)
+        self.per_token_s = float(per_token_s)
+        self.prefill_s = float(prefill_s)
+        self.hit_prefill_s = float(hit_prefill_s)
+        self._slots_sem = threading.Semaphore(self.slots)
+        self._seen: set = set()  # guarded-by: _seen_lock
+        self._seen_lock = threading.Lock()
+
+    def _prefix_probe(self, payload: dict) -> bool:
+        ids = payload.get("input_ids") or []
+        key = (payload.get("adapter") or "",
+               tuple(int(t) for t in ids[:PREFIX_TOKENS]))
+        with self._seen_lock:
+            hit = key in self._seen
+            self._seen.add(key)
+        self.engine.bump(prefix_hits=int(hit), prefix_misses=int(not hit))
+        return hit
+
+    def generate_stream(self, payload: dict):
+        """Genuinely incremental: each chunk event yields AFTER its
+        share of the timed service, while the slot is held — so a drain
+        that begins mid-stream really does race an open stream, and the
+        zero-mid-stream-errors pin means something."""
+        hit = self._prefix_probe(payload)
+        max_tokens = int(payload.get("max_tokens", 16))
+        with self._slots_sem:
+            self.engine.enter()
+            try:
+                time.sleep(self.hit_prefill_s if hit else self.prefill_s)
+                emitted = 0
+                while emitted < max_tokens:
+                    n = min(8, max_tokens - emitted)
+                    time.sleep(n * self.per_token_s)
+                    toks = list(range(emitted, emitted + n))
+                    emitted += n
+                    yield {"tokens": toks}
+            finally:
+                self.engine.exit()
+        self.engine.bump(requests=1, decode_tokens=max_tokens)
+        yield {"done": True, "output_ids": list(range(max_tokens)),
+               "num_output_tokens": max_tokens, "prefix_hit": hit}
+
+    def generate(self, payload: dict) -> dict:
+        out: dict = {}
+        for ev in self.generate_stream(payload):
+            if ev.get("done"):
+                out = {k: v for k, v in ev.items() if k != "done"}
+        return out
+
+    def predict(self, inputs):
+        return [np.asarray(inputs[0])]
+
+
+def make_fake_replica(name: str = "m", *, slots: int = 4,
+                      max_inflight: int = 64, grpc: bool = False,
+                      **model_kw):
+    """One in-process fake replica: (ModelServer, base_url, model).
+    Registered under model name `name` with a REAL admission gate, so
+    overload sheds and readiness degradation behave exactly like a
+    production replica's. The worker pool is sized by admission depth,
+    not CPU count — fake service time is sleeps, and a 2-CPU test host
+    must not serialize the concurrency the bench exists to measure."""
+    model = FakeGenerativeModel(name, slots=slots, **model_kw)
+    server = ModelServer(max_inflight=max_inflight,
+                        executor_workers=max_inflight)
+    server.repo.register(model, load=False)
+    port = server.start_background()
+    if grpc:
+        server.start_grpc()
+    return server, f"http://127.0.0.1:{port}", model
+
+
+# -- open-loop generator ----------------------------------------------------
+
+
+def _post_generate(base_url: str, model: str, payload: dict,
+                   deadline_ms: float | None,
+                   timeout_s: float = 30.0) -> tuple[int, dict]:
+    req = urllib.request.Request(
+        f"{base_url}/v1/models/{model}:generate",
+        data=json.dumps(payload).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    if deadline_ms is not None:
+        req.add_header(DEADLINE_HEADER, str(int(deadline_ms)))
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as r:
+            return r.status, json.loads(r.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        try:
+            body = json.loads(e.read() or b"{}")
+        except json.JSONDecodeError:
+            body = {}
+        return e.code, body
+    except Exception as e:
+        return -1, {"error": f"{type(e).__name__}: {e}"}
+
+
+def open_loop(base_url: str, model: str, prompts: list[list[int]], *,
+              rate_rps: float, duration_s: float, max_tokens: int = 24,
+              deadline_ms: float | None = 2000.0,
+              seed: int = 0) -> list[dict]:
+    """Fire POST :generate requests at seeded Poisson arrival times for
+    `duration_s`, cycling through `prompts`. Every request fires at its
+    schedule (open loop); returns one record per request."""
+    rng = np.random.default_rng(seed)
+    arrivals = []
+    t = 0.0
+    while t < duration_s:
+        t += float(rng.exponential(1.0 / rate_rps))
+        if t < duration_s:
+            arrivals.append(t)
+    records: list[dict] = []
+    rec_lock = threading.Lock()
+    threads: list[threading.Thread] = []
+
+    def fire(i: int, sched: float):
+        payload = {"input_ids": prompts[i % len(prompts)],
+                   "max_tokens": max_tokens}
+        t0 = time.monotonic()
+        status, body = _post_generate(base_url, model, payload,
+                                      deadline_ms)
+        latency = time.monotonic() - t0
+        with rec_lock:
+            records.append({
+                "sched_s": sched, "status": status,
+                "latency_ms": latency * 1e3,
+                "prefix_hit": bool(body.get("prefix_hit")),
+            })
+
+    start = time.monotonic()
+    for i, sched in enumerate(arrivals):
+        delay = start + sched - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        th = threading.Thread(target=fire, args=(i, sched), daemon=True)
+        th.start()
+        threads.append(th)
+    for th in threads:
+        th.join(timeout=60.0)
+    return records
+
+
+def summarize(records: list[dict], duration_s: float,
+              deadline_ms: float | None) -> dict:
+    """Per-arm report: offered/goodput rps, p50/p99 over successful
+    requests, shed rate, deadline-miss rate (504s + replies that landed
+    past the client's budget)."""
+    n = len(records)
+    ok = [r for r in records if r["status"] == 200]
+    sheds = sum(1 for r in records if r["status"] == 503)
+    late = (sum(1 for r in ok if deadline_ms is not None
+                and r["latency_ms"] > deadline_ms))
+    misses = sum(1 for r in records if r["status"] == 504) + late
+    lat = sorted(r["latency_ms"] for r in ok)
+
+    def pct(p):
+        if not lat:
+            return None
+        return round(lat[min(int(len(lat) * p), len(lat) - 1)], 2)
+
+    hits = sum(1 for r in ok if r["prefix_hit"])
+    return {
+        "requests": n,
+        "offered_rps": round(n / duration_s, 1),
+        "completed_ok": len(ok),
+        "goodput_rps": round((len(ok) - late) / duration_s, 1),
+        "p50_ms": pct(0.50), "p99_ms": pct(0.99),
+        "shed_rate": round(sheds / max(n, 1), 4),
+        "deadline_miss_rate": round(misses / max(n, 1), 4),
+        "prefix_hit_rate": round(hits / max(len(ok), 1), 4),
+        "errors": sum(1 for r in records
+                      if r["status"] not in (200, 503, 504)),
+    }
+
+
+def _quantiles_from_cum(buckets: dict[float, float], total: float,
+                        quantiles=(0.5, 0.99)) -> dict:
+    """Interpolate quantiles from cumulative `le` buckets (+Inf folds
+    to the last finite bound — a histogram can't say more)."""
+    if not buckets or total <= 0:
+        return {}
+    out = {}
+    bounds = sorted(buckets)
+    for q in quantiles:
+        target = q * total
+        lo_bound, lo_cum = 0.0, 0.0
+        for ub in bounds:
+            cum = buckets[ub]
+            if cum >= target:
+                if ub == float("inf"):
+                    out[f"p{int(q * 100)}_ms"] = round(lo_bound * 1e3, 2)
+                    break
+                frac = ((target - lo_cum) / max(cum - lo_cum, 1e-12))
+                val = lo_bound + frac * (ub - lo_bound)
+                out[f"p{int(q * 100)}_ms"] = round(val * 1e3, 2)
+                break
+            lo_bound, lo_cum = ub, cum
+    out["count"] = int(total)
+    return out
+
+
+def histogram_quantiles(prom_texts: list[str], name: str,
+                        quantiles=(0.5, 0.99)) -> dict:
+    """Merge one histogram family across replica scrapes (all label
+    sets summed) and interpolate quantiles from the cumulative buckets
+    — the 'p50/p99 from the existing histograms' view."""
+    buckets: dict[float, float] = {}
+    total = 0.0
+    for text in prom_texts:
+        for line in text.splitlines():
+            if not line.startswith(name):
+                continue
+            metric, _, value = line.rpartition(" ")
+            if metric.startswith(f"{name}_bucket"):
+                le = metric.rsplit('le="', 1)[-1].rstrip('"}')
+                ub = float("inf") if le == "+Inf" else float(le)
+                buckets[ub] = buckets.get(ub, 0.0) + float(value)
+            elif metric.startswith(f"{name}_count"):
+                total += float(value)
+    return _quantiles_from_cum(buckets, total, quantiles)
+
+
+def _hist_snapshot(model: str) -> dict:
+    from kubeflow_tpu.utils.resilience import metrics as res_metrics
+
+    return res_metrics.get_histogram("tpk_serve_request_latency_seconds",
+                                     model=model)
+
+
+def _hist_delta(before: dict, after: dict) -> dict:
+    """SECTION DELTA of the serve-latency histogram (the CTRLBENCH.json
+    precedent): the registry is process-global, so an arm's view must
+    subtract everything earlier arms observed."""
+    buckets = {}
+    for le, cum in after.get("buckets", {}).items():
+        ub = float("inf") if le == "+Inf" else float(le)
+        buckets[ub] = cum - before.get("buckets", {}).get(le, 0)
+    total = after.get("count", 0) - before.get("count", 0)
+    return _quantiles_from_cum(buckets, total)
+
+
+# -- the router benchmark ---------------------------------------------------
+
+
+def _prompt_mix(rng: np.random.Generator, *, prefixes: int,
+                repeats: int, vocab: int = 30000) -> list[list[int]]:
+    """`prefixes` distinct PREFIX_TOKENS-token prefixes, each appearing
+    `repeats` times with a different short suffix — the shape that
+    rewards prefix affinity (same prefix -> same replica -> cache hit)
+    and punishes scattering. Shuffled so arrival order interleaves
+    prefixes."""
+    heads = [list(map(int, rng.integers(2, vocab, PREFIX_TOKENS)))
+             for _ in range(prefixes)]
+    prompts = []
+    for head in heads:
+        for _ in range(repeats):
+            tail = list(map(int, rng.integers(2, vocab,
+                                              int(rng.integers(2, 8)))))
+            prompts.append(head + tail)
+    rng.shuffle(prompts)
+    return prompts
+
+
+def run_routerbench(quick: bool = False, seed: int = 0) -> dict:
+    """The ROUTERBENCH.json payload. Pure host-side (fake CPU replicas
+    behind real ModelServers + the real router) — no chip, no binary."""
+    from kubeflow_tpu.serve.router import RouterServer
+
+    # Sized for the HARNESS HOST, not the model: the whole fleet + the
+    # router + the open-loop client run in one Python process on a
+    # small-CPU container, so per-request interpreter cost (two HTTP
+    # hops of tornado/http.client) caps total request rate long before
+    # any router mechanism does. Service times are slow enough that the
+    # offered load at 0.7x capacity stays well inside the interpreter's
+    # envelope — the arms then measure PLACEMENT AND SCALING, not GIL
+    # contention (the §5 lesson, applied in advance).
+    slots = 2
+    per_token_s = 0.01
+    prefill_s, hit_prefill_s = 0.03, 0.005
+    max_tokens = 24
+    duration = 6.0 if quick else 15.0
+    deadline_ms = 2000.0
+    # Per-replica service time ~= prefill + tokens*per_token; offered
+    # load is 70% of nominal capacity per replica, scaled by N for the
+    # routed-N arm — same per-replica pressure in every arm.
+    svc_s = prefill_s + max_tokens * per_token_s
+    cap_rps = slots / svc_s
+    rate_1 = 0.7 * cap_rps
+    rng = np.random.default_rng(seed)
+    result: dict = {
+        "metric": "routerbench",
+        "mode": "fake-cpu-replicas",
+        "note": ("replicas are slot-limited timed FAKE engines behind "
+                 "real ModelServers: these numbers measure the router "
+                 "(placement, proxy overhead, horizontal scaling, "
+                 "affinity), NOT model decode throughput"),
+        "params": {"slots": slots, "per_token_s": per_token_s,
+                   "prefill_s": prefill_s,
+                   "hit_prefill_s": hit_prefill_s,
+                   "max_tokens": max_tokens, "duration_s": duration,
+                   "deadline_ms": deadline_ms,
+                   "offered_frac_of_capacity": 0.7,
+                   "capacity_rps_per_replica": round(cap_rps, 1),
+                   "quick": bool(quick), "seed": seed},
+        "arms": {},
+    }
+
+    def one_arm(n_replicas: int, *, routed: bool, affinity: bool = True,
+                rate: float | None = None, prompts=None,
+                label: str = "") -> dict:
+        servers = []
+        router = None
+        try:
+            replicas = [make_fake_replica("m", slots=slots,
+                                          per_token_s=per_token_s,
+                                          prefill_s=prefill_s,
+                                          hit_prefill_s=hit_prefill_s)
+                        for _ in range(n_replicas)]
+            servers = [s for s, _, _ in replicas]
+            if routed:
+                router = RouterServer(affinity=affinity)
+                router.fleet.poll_interval_s = 0.15
+                for i, (_, url, _) in enumerate(replicas):
+                    router.fleet.add(f"r{i}", url)
+                base = f"http://127.0.0.1:{router.start_background()}"
+                time.sleep(0.4)  # let the poller take a first scrape
+            else:
+                base = replicas[0][1]
+            rate = rate or rate_1 * n_replicas
+            prompts = prompts or _prompt_mix(
+                rng, prefixes=16, repeats=12)
+            hist0 = _hist_snapshot("m")
+            records = open_loop(base, "m", prompts, rate_rps=rate,
+                                duration_s=duration,
+                                max_tokens=max_tokens,
+                                deadline_ms=deadline_ms, seed=seed)
+            arm = summarize(records, duration, deadline_ms)
+            arm["replicas"] = n_replicas
+            # Server-side view from the EXISTING latency histogram
+            # (tpk_serve_request_latency_seconds), as a section delta —
+            # the registry is process-global across arms.
+            arm["histogram"] = _hist_delta(hist0, _hist_snapshot("m"))
+            if router is not None:
+                arm["router_stats"] = router.router.stats_snapshot()
+            return arm
+        finally:
+            if router is not None:
+                router.stop()
+            for s in servers:
+                s.stop()
+
+    # One shared prompt mix for the three scaling arms: every arm's
+    # replicas start cold, so direct-vs-routed p50 compares like with
+    # like (same hit pattern) and the routed-4 arm just cycles the mix
+    # at 4x the arrival rate.
+    base_prompts = _prompt_mix(rng, prefixes=12, repeats=16)
+    result["arms"]["direct_1"] = one_arm(1, routed=False,
+                                         prompts=base_prompts)
+    result["arms"]["routed_1"] = one_arm(1, routed=True,
+                                         prompts=base_prompts)
+    result["arms"]["routed_4"] = one_arm(4, routed=True,
+                                         prompts=base_prompts)
+    d1 = result["arms"]["direct_1"]
+    r1 = result["arms"]["routed_1"]
+    r4 = result["arms"]["routed_4"]
+    if d1["p50_ms"] and r1["p50_ms"]:
+        result["routed_overhead_p50"] = round(
+            r1["p50_ms"] / d1["p50_ms"] - 1.0, 4)
+    result["scaling_x"] = round(
+        r4["goodput_rps"] / max(r1["goodput_rps"], 1e-9), 2)
+    result["scaling_miss_rate_delta"] = round(
+        r4["deadline_miss_rate"] - r1["deadline_miss_rate"], 4)
+
+    # Affinity A/B: IDENTICAL traffic (same seed, same prompt mix) over
+    # 4 replicas, consistent-hash affinity vs the hash-off control.
+    # Many prefixes with few repeats each — the regime where scattering
+    # hurts: without affinity every replica pays its own cold miss per
+    # prefix, and there aren't enough repeats to warm all four anyway.
+    ab_prompts = _prompt_mix(np.random.default_rng(seed + 1),
+                             prefixes=48, repeats=4)
+    on = one_arm(4, routed=True, affinity=True, prompts=ab_prompts,
+                 rate=rate_1 * 4)
+    off = one_arm(4, routed=True, affinity=False, prompts=ab_prompts,
+                  rate=rate_1 * 4)
+    result["affinity"] = {
+        "on": on, "off": off,
+        "hit_rate_on": on["prefix_hit_rate"],
+        "hit_rate_off": off["prefix_hit_rate"],
+        "hit_rate_delta": round(on["prefix_hit_rate"]
+                                - off["prefix_hit_rate"], 4),
+    }
+    return result

@@ -1,0 +1,741 @@
+"""Front-door router: one address over N engine replicas (ISSUE 9).
+
+One engine's ceiling behind one tunnel is a few hundred tok/s
+(SERVEBENCH.json); the ROADMAP's "millions of users" direction is
+horizontal. This module is the front door: it proxies the native
+`:generate`, the OpenAI facade, the v1/v2 predict surfaces, and the gRPC
+open-inference plane over a `Fleet` of model-server replicas
+(serve/fleet.py), placing each request by:
+
+  * **Prefix/adapter affinity.** Requests whose prompts share a prefix
+    land on the same replica — the engine's prefix cache is keyed on the
+    `(adapter, len, hash)` family, so cache warmth is per replica and
+    scattering a hot prefix across the fleet wastes it. Placement
+    consistent-hashes the request's affinity key (adapter + prompt
+    prefix) onto a ring of virtual nodes per replica, so membership
+    changes only remap the keys of the replicas that changed.
+  * **Load-based spill-over.** Affinity yields when the cache-warm
+    replica is more than `spill_margin` requests deeper than the
+    least-loaded one — a hot prefix must not melt one replica while the
+    rest idle. Load is router-outstanding + the replica's scraped
+    `tpk_decode_inflight_depth` + admission occupancy; the scrape runs
+    on the fleet's background poller, NEVER on the placement path.
+  * **Least-loaded fallback.** No affinity signal (tensor inference,
+    metadata GETs) → lowest load, ties broken by name (deterministic).
+
+Composition with the existing layers (not a bypass):
+
+  * `X-Request-Id` is honored/assigned and forwarded; the router's
+    place/forward spans join the same trace the replica's admit →
+    prefill → decode spans carry.
+  * `X-Request-Timeout-Ms` is re-issued to the replica as the REMAINING
+    budget at forward time — deadline propagation, not per-hop resets.
+  * A replica's 503 overload shed is FORWARDED (Retry-After intact),
+    never retried: backpressure must reach the caller or the router
+    converts overload into a retry storm.
+  * Connect-level failures and draining-replica rejections ARE retried,
+    on a different replica, under the caller's remaining deadline —
+    these are placement mistakes, not capacity signals. A POST-CONNECT
+    timeout is neither: the replica accepted the request and may still
+    be decoding it, so the caller gets a 504 and no replay (a replay
+    would duplicate the work on a second replica).
+
+Scale events come from serve/fleet.py: `drain()` stops placement while
+in-flight requests finish; `FleetAutoscaler` turns router-observed shed
+rate/occupancy into scale-out and drain-then-retire scale-in.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import bisect
+import hashlib
+import http.client
+import json
+import math
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from urllib.parse import urlsplit
+
+import tornado.httpserver
+import tornado.ioloop
+import tornado.iostream
+import tornado.netutil
+import tornado.web
+
+from kubeflow_tpu.serve.fleet import Fleet
+from kubeflow_tpu.serve.headers import (DEADLINE_HEADER, DRAINING_HEADER,
+                                        REQUEST_ID_HEADER)
+from kubeflow_tpu.utils import obs
+from kubeflow_tpu.utils.resilience import (Deadline,
+                                           metrics as res_metrics)
+
+#: Headers copied replica → caller. Everything else is router-owned
+#: (the router echoes ITS X-Request-Id; hop-by-hop headers must not
+#: leak through a proxy).
+_FORWARD_RESP_HEADERS = ("Content-Type", "Retry-After")
+
+#: Request paths that are inference traffic (placement + retry + body
+#: parse for affinity); everything else is metadata/control and just
+#: takes the least-loaded forward.
+_GENERATIVE_SUFFIXES = (":generate", "/generate")
+_OPENAI_PATHS = ("/openai/v1/completions", "/openai/v1/chat/completions")
+_INFER_SUFFIXES = (":predict", ":explain", "/infer")
+
+#: Bodies above this size skip the affinity parse (see _proxy).
+_AFFINITY_PARSE_CAP = 512 * 1024
+
+
+def _hash64(s: str) -> int:
+    return int.from_bytes(
+        hashlib.blake2b(s.encode(), digest_size=8).digest(), "big")
+
+
+def affinity_key(path: str, body: dict | None) -> str | None:
+    """The placement-affinity key of one request, or None when the
+    request carries no prefix signal (→ least-loaded).
+
+    Built to follow the engine prefix cache's key family (adapter, len,
+    hash): the ADAPTER (either the payload field or the OpenAI
+    "<base>:<adapter>" model id) plus a bounded PREFIX of the prompt —
+    leading token ids when the caller sends `input_ids`, leading text
+    otherwise. Two requests that would hit the same cached prefix
+    produce the same key; max_tokens/temperature/suffix differences
+    don't perturb it."""
+    if not isinstance(body, dict):
+        return None
+    scope = path.rsplit("/", 1)[-1] if path else ""
+    adapter = body.get("adapter") or ""
+    model = body.get("model") or scope
+    ids = body.get("input_ids")
+    if isinstance(ids, (list, tuple)) and ids:
+        head = ",".join(str(t) for t in ids[:32])
+        return f"{model}|{adapter}|ids:{head}"
+    for field in ("text", "prompt"):
+        v = body.get(field)
+        if isinstance(v, str) and v:
+            return f"{model}|{adapter}|txt:{v[:128]}"
+    msgs = body.get("messages")
+    if isinstance(msgs, list) and msgs:
+        try:
+            head = json.dumps(msgs[0], sort_keys=True)[:128]
+        except (TypeError, ValueError):
+            return None
+        return f"{model}|{adapter}|msg:{head}"
+    return None
+
+
+class Router:
+    """Placement policy over a Fleet: consistent-hash affinity with
+    load-based spill-over, least-loaded otherwise. Pure table math —
+    every signal it reads was cached by the fleet poller."""
+
+    def __init__(self, fleet: Fleet, *, affinity: bool = True,
+                 spill_margin: float = 4.0, vnodes: int = 48):
+        self.fleet = fleet
+        self.affinity = bool(affinity)
+        self.spill_margin = float(spill_margin)
+        self.vnodes = int(vnodes)
+        self._ring: list[tuple[int, str]] = []  # guarded-by: _ring_lock
+        self._ring_version = -1  # guarded-by: _ring_lock
+        self._ring_lock = threading.Lock()
+        self.stats = {  # guarded-by: _stats_lock
+            "placed": 0, "affinity_hits": 0, "spills": 0,
+            "least_loaded": 0, "retries": 0, "ok": 0,
+            "sheds_forwarded": 0, "no_replica": 0, "errors": 0,
+        }
+        self._stats_lock = threading.Lock()
+
+    def stats_snapshot(self) -> dict:
+        with self._stats_lock:
+            return dict(self.stats)
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        with self._stats_lock:
+            self.stats[key] = self.stats.get(key, 0) + n
+
+    def _ring_for(self, names: list[str],
+                  version: int) -> list[tuple[int, str]]:
+        """The consistent-hash ring over `names`, rebuilt only when fleet
+        membership/state changed (cheap version check otherwise).
+        `version` must have been read BEFORE `names` was snapshotted: a
+        membership change between the two then stamps the fresher set
+        with the older version — over-invalidation (one spare rebuild),
+        never a stale ring cached under the newest version."""
+        with self._ring_lock:
+            if version == self._ring_version:
+                return self._ring
+        ring = sorted((_hash64(f"{name}#{i}"), name)
+                      for name in names for i in range(self.vnodes))
+        with self._ring_lock:
+            self._ring, self._ring_version = ring, version
+        return ring
+
+    def _ring_lookup(self, ring, point: int) -> str | None:
+        if not ring:
+            return None
+        # (point,) sorts below every (point, name), so bisect_left gives
+        # the first vnode at-or-after the point; wrap closes the ring.
+        return ring[bisect.bisect_left(ring, (point,)) % len(ring)][1]
+
+    # Placement is on every request's critical path: table reads and
+    # hash math only — the fleet poller already cached every load
+    # signal, so nothing here blocks on a scrape, a device, or I/O.
+    # tpk-hot: router-placement
+    def place(self, key: str | None,
+              exclude: frozenset = frozenset()) -> tuple[str | None, str]:
+        """Choose a replica for a request with affinity key `key`
+        (None = no prefix signal). Returns (replica_name, reason);
+        (None, "no_replica") when nothing is placeable. `exclude` drops
+        replicas that already failed this request (retry path)."""
+        version = self.fleet.version()  # before loads() — see _ring_for
+        loads = self.fleet.loads()
+        candidates = loads if not exclude else \
+            {n: v for n, v in loads.items() if n not in exclude}
+        if not candidates:
+            self._bump("no_replica")
+            return None, "no_replica"
+        floor = min(candidates.values())
+        reason = "least-loaded"
+        chosen = None
+        if self.affinity and key is not None and len(loads) > 1:
+            # The ring covers the FULL placeable set — it is cached
+            # against the fleet version, so a retry's per-request
+            # exclusions must apply at lookup time, never to the ring
+            # itself (a poisoned cache would silently drop a healthy
+            # replica from affinity until the next membership change).
+            ring = self._ring_for(sorted(loads), version)
+            target = self._ring_lookup(ring, _hash64(key))
+            if target in candidates:
+                if candidates[target] - floor < self.spill_margin:
+                    chosen, reason = target, "affinity-hit"
+                else:
+                    reason = "spill"
+        elif self.affinity and key is not None:
+            # Single candidate: the hash could only name it anyway.
+            reason = "affinity-hit"
+        if chosen is None:
+            chosen = min(candidates, key=lambda n: (candidates[n], n))
+        res_metrics.inc("tpk_router_placement_total", reason=reason)
+        self._bump("placed")
+        self._bump({"affinity-hit": "affinity_hits", "spill": "spills",
+                    "least-loaded": "least_loaded"}[reason])
+        return chosen, reason
+
+
+class _ForwardResult:
+    """One upstream attempt's outcome: a live response to stream, or a
+    complete small response (sheds, errors) already read."""
+
+    __slots__ = ("status", "headers", "conn", "resp", "body")
+
+    def __init__(self, status, headers, conn=None, resp=None, body=None):
+        self.status = status
+        self.headers = headers
+        self.conn = conn
+        self.resp = resp
+        self.body = body
+
+
+class RetryableForwardError(Exception):
+    """Connect-level failure or a draining replica — retry elsewhere."""
+
+
+class ForwardTimeoutError(Exception):
+    """The upstream ran past its time budget AFTER the connection was
+    established. The replica accepted the request and may still be
+    executing it, so replaying elsewhere would duplicate decode work —
+    the caller gets a 504 instead."""
+
+
+def _forward_once(url: str, method: str, path: str, body: bytes | None,
+                  headers: dict, timeout_s: float,
+                  read_body: bool = True) -> _ForwardResult:
+    """One blocking proxy attempt against `url`. Raises
+    RetryableForwardError on connect-level failures and drain
+    rejections, ForwardTimeoutError on a post-connect timeout. With
+    `read_body` (every non-streaming request) the WHOLE response is
+    read here — one executor hop per request instead of one per chunk;
+    streams keep the live (conn, resp) to relay chunk-by-chunk."""
+    parts = urlsplit(url)
+    conn = http.client.HTTPConnection(parts.hostname, parts.port,
+                                      timeout=timeout_s)
+    try:
+        conn.connect()
+    except OSError as e:
+        # Pre-request failure (refused, reset, connect timeout):
+        # nothing reached the replica, replaying elsewhere is safe.
+        conn.close()
+        raise RetryableForwardError(f"{type(e).__name__}: {e}") from e
+    try:
+        conn.request(method, path, body=body, headers=headers)
+        resp = conn.getresponse()
+        draining = (resp.status == 503
+                    and resp.getheader(DRAINING_HEADER) is not None)
+        whole = draining or read_body or resp.status == 503
+        data = resp.read() if whole else None
+    except TimeoutError as e:
+        # socket.timeout past the established connection: the request
+        # is in the replica's hands — slow is not retry fodder.
+        conn.close()
+        raise ForwardTimeoutError(
+            f"no response within {timeout_s:.1f}s") from e
+    except (ConnectionError, OSError, http.client.HTTPException) as e:
+        # HTTPException covers a replica dying mid-response
+        # (BadStatusLine / IncompleteRead on a closed socket) — same
+        # retry class as a straight connect reset: nothing reached the
+        # caller yet, so replaying elsewhere is safe.
+        conn.close()
+        raise RetryableForwardError(f"{type(e).__name__}: {e}") from e
+    if draining:
+        conn.close()
+        raise RetryableForwardError(
+            f"replica draining: {data[:120]!r}")
+    if whole:
+        conn.close()
+        return _ForwardResult(resp.status, resp.getheaders(), body=data)
+    return _ForwardResult(resp.status, resp.getheaders(), conn=conn,
+                          resp=resp)
+
+
+class _RouterBase(tornado.web.RequestHandler):
+    def initialize(self, server: "RouterServer"):
+        self.server = server
+        self.router = server.router
+        self.fleet = server.fleet
+
+    def write_json(self, obj, status: int = 200) -> None:
+        self.set_status(status)
+        self.set_header("Content-Type", "application/json")
+        self.finish(json.dumps(obj))
+
+    def write_error(self, status_code: int, **kwargs) -> None:
+        reason = self._reason
+        if "exc_info" in kwargs:
+            exc = kwargs["exc_info"][1]
+            if not isinstance(exc, tornado.web.HTTPError):
+                reason = f"{type(exc).__name__}: {exc}"
+        self.write_json({"error": reason}, status=status_code)
+
+
+class ProxyHandler(_RouterBase):
+    """The catch-all data-plane proxy: place, forward, stream back."""
+
+    async def get(self, path):
+        await self._proxy(path)
+
+    async def post(self, path):
+        await self._proxy(path)
+
+    async def put(self, path):
+        await self._proxy(path)
+
+    async def delete(self, path):
+        await self._proxy(path)
+
+    def _count(self, replica: str | None, outcome: str) -> None:
+        res_metrics.inc("tpk_router_requests_total",
+                        replica=replica or "-", outcome=outcome)
+
+    def _deadline(self) -> Deadline | None:
+        raw = self.request.headers.get(DEADLINE_HEADER)
+        if raw is None:
+            return None
+        try:
+            ms = float(raw)
+            # Mirrors server.py request_deadline: NaN/inf would defeat
+            # every expiry comparison (and overflow the remaining-ms
+            # re-issue) downstream.
+            if not math.isfinite(ms) or ms <= 0:
+                raise ValueError
+        except ValueError:
+            raise tornado.web.HTTPError(
+                400, reason=f"{DEADLINE_HEADER} must be a positive "
+                            f"number of milliseconds, got {raw!r}") \
+                from None
+        return Deadline(ms / 1e3)
+
+    async def _proxy(self, path: str) -> None:
+        trace_id = obs.sanitize_trace_id(
+            self.request.headers.get(REQUEST_ID_HEADER))
+        self.set_header(REQUEST_ID_HEADER, trace_id)
+        deadline = self._deadline()
+        route = "/" + path
+        full_path = route
+        if self.request.query:
+            full_path += "?" + self.request.query
+        # Classify (and key affinity) on the bare ROUTE: a query string
+        # must not reclassify inference traffic as metadata — that would
+        # drop both the affinity key and the drain-retry contract.
+        is_generative = (route.endswith(_GENERATIVE_SUFFIXES)
+                         or route in _OPENAI_PATHS)
+        is_inference = is_generative or route.endswith(_INFER_SUFFIXES)
+        key = None
+        wants_stream = False
+        if is_generative and self.request.body:
+            raw = self.request.body
+            if len(raw) <= _AFFINITY_PARSE_CAP:
+                try:
+                    parsed = json.loads(raw)
+                    key = affinity_key(route, parsed)
+                    wants_stream = bool(isinstance(parsed, dict)
+                                        and parsed.get("stream"))
+                except (ValueError, TypeError):
+                    key = None  # malformed body: the replica renders the 400
+            else:
+                # json.loads holds the GIL for the whole parse — a
+                # multi-MB longctx payload parsed on the ioloop would
+                # stall every other request for placement sugar worth a
+                # 32-token prefix. Forego affinity; a substring test
+                # picks the relay mode (a false positive only costs
+                # chunk-wise relay of a non-streamed reply).
+                wants_stream = b'"stream"' in raw
+        loop = asyncio.get_event_loop()
+        attempts = 0
+        exclude: set[str] = set()
+        max_attempts = max(len(self.fleet.names()), 1)
+        while True:
+            with obs.span("router.place", trace_id=trace_id,
+                          path=full_path) as sp:
+                name, reason = self.router.place(key,
+                                                 exclude=frozenset(exclude))
+                sp.set(replica=name or "-", reason=reason)
+            if name is None:
+                self._count(None, "no_replica")
+                self.router._bump("errors")
+                self.set_header("Retry-After", "1")
+                self.write_json({"error": "no live replica"}, status=503)
+                return
+            url = self.fleet.url_of(name)
+            if url is None:
+                exclude.add(name)
+                continue
+            if deadline is not None and deadline.expired():
+                self._count(name, "deadline")
+                res_metrics.inc("tpk_deadline_expired_total",
+                                component="router")
+                raise tornado.web.HTTPError(
+                    504, reason="request deadline exceeded (router)")
+            headers = {REQUEST_ID_HEADER: trace_id}
+            ct = self.request.headers.get("Content-Type")
+            if ct:
+                headers["Content-Type"] = ct
+            if deadline is not None:
+                rem = deadline.remaining()
+                headers[DEADLINE_HEADER] = str(
+                    max(int((rem or 0.0) * 1e3), 1))
+            timeout_s = (deadline.bound(self.server.forward_timeout_s)
+                         if deadline is not None
+                         else self.server.forward_timeout_s)
+            self.fleet.checkout(name)
+            attempts += 1
+            t0 = time.perf_counter()
+            try:
+                result = await loop.run_in_executor(
+                    self.server.executor, _forward_once, url,
+                    self.request.method, full_path,
+                    self.request.body or None, headers, timeout_s,
+                    not wants_stream)
+            except RetryableForwardError as e:
+                self.fleet.checkin(
+                    name, failed="draining" not in str(e))
+                obs.record("router.forward", t0, time.perf_counter(),
+                           trace_id=trace_id, replica=name,
+                           error=str(e)[:120])
+                retryable = (is_inference or self.request.method == "GET")
+                expired = deadline is not None and deadline.expired()
+                draining = "draining" in str(e)
+                if (retryable and attempts <= max_attempts
+                        and not expired):
+                    exclude.add(name)
+                    res_metrics.inc(
+                        "tpk_router_retry_total",
+                        reason=("draining" if draining else "connect"))
+                    self.router._bump("retries")
+                    continue
+                self._count(name, "deadline" if expired
+                            else "draining" if draining
+                            else "retry_exhausted")
+                if expired:
+                    self.router._bump("errors")
+                    res_metrics.inc("tpk_deadline_expired_total",
+                                    component="router")
+                    raise tornado.web.HTTPError(
+                        504, reason="request deadline exceeded "
+                                    "(router retries)") from e
+                if draining:
+                    # The replica answered cleanly — reflect its drain
+                    # rejection as the 503 it was, not a 502. NOT
+                    # counted as a shed: sheds feed the autoscaler's
+                    # scale-out signal, and a drain rejection is the
+                    # opposite of overload evidence.
+                    self.router._bump("draining_rejects")
+                    self.set_header("Retry-After", "1")
+                    self.set_header(DRAINING_HEADER, "1")
+                    self.write_json(
+                        {"error": f"replica {name} draining"}, status=503)
+                    return
+                self.router._bump("errors")
+                raise tornado.web.HTTPError(
+                    502, reason=f"replica {name} unreachable: {e}") \
+                    from e
+            except ForwardTimeoutError as e:
+                # The replica may still be executing the request: no
+                # replay (that would duplicate decode work) and no
+                # failure mark (slow is not dead) — just a 504.
+                self.fleet.checkin(name)
+                obs.record("router.forward", t0, time.perf_counter(),
+                           trace_id=trace_id, replica=name,
+                           error=str(e)[:120])
+                self._count(name, "upstream_error")
+                self.router._bump("errors")
+                raise tornado.web.HTTPError(
+                    504, reason=f"replica {name} timed out: {e}") from e
+            except Exception:
+                # Anything non-retryable still releases the outstanding
+                # count, or a drain on this replica would wait forever.
+                self.fleet.checkin(name)
+                raise
+            try:
+                await self._relay(result, name, trace_id, t0)
+            finally:
+                self.fleet.checkin(name)
+            return
+
+    async def _relay(self, result: _ForwardResult, name: str,
+                     trace_id: str, t0: float) -> None:
+        """Stream one upstream response back to the caller."""
+        loop = asyncio.get_event_loop()
+        self.set_status(result.status)
+        hdrs = dict(result.headers or ())
+        for h in _FORWARD_RESP_HEADERS:
+            if h in hdrs:
+                self.set_header(h, hdrs[h])
+        if result.body is not None:  # fully-read (non-stream) response
+            if result.status == 503:
+                outcome, stat = "shed", "sheds_forwarded"
+            elif result.status >= 500:
+                outcome, stat = "upstream_error", "errors"
+            else:
+                outcome, stat = "ok", "ok"
+            self._count(name, outcome)
+            self.router._bump(stat)
+            obs.record("router.forward", t0, time.perf_counter(),
+                       trace_id=trace_id, replica=name,
+                       status=result.status)
+            self.finish(result.body)
+            return
+        conn, resp = result.conn, result.resp
+        outcome = "ok" if result.status < 500 else "upstream_error"
+        upstream_err = None
+        try:
+            while True:
+                try:
+                    # read1: at most ONE chunk per hop. read(amt) on a
+                    # chunked response accumulates until `amt` bytes or
+                    # end-of-stream — it would buffer a whole token
+                    # stream and deliver it at EOF.
+                    chunk = await loop.run_in_executor(
+                        self.server.executor, resp.read1, 65536)
+                except (OSError, http.client.HTTPException) as e:
+                    # Replica died mid-stream — exactly the fleet event
+                    # the counters exist to surface.
+                    upstream_err = e
+                    outcome = "upstream_error"
+                    break
+                if not chunk:
+                    break
+                self.write(chunk)
+                try:
+                    await self.flush()
+                except tornado.iostream.StreamClosedError:
+                    break  # caller went away; stop pulling
+            self._count(name, outcome)
+            self.router._bump("ok" if outcome == "ok" else "errors")
+            obs.record("router.forward", t0, time.perf_counter(),
+                       trace_id=trace_id, replica=name,
+                       status=result.status,
+                       **({"error": str(upstream_err)[:120]}
+                          if upstream_err is not None else {}))
+            if upstream_err is not None:
+                # Headers (and chunks) are already on the wire: the only
+                # honest signal left is an abrupt close — a clean chunked
+                # terminator would make the truncation invisible.
+                try:
+                    self.request.connection.stream.close()
+                except Exception:
+                    pass
+            else:
+                try:
+                    self.finish()
+                except tornado.iostream.StreamClosedError:
+                    pass
+        finally:
+            conn.close()
+
+
+class AdminReplicasHandler(_RouterBase):
+    def get(self):
+        self.write_json({
+            "replicas": self.fleet.snapshot(),
+            "router": self.router.stats_snapshot(),
+        })
+
+    def post(self):
+        try:
+            body = json.loads(self.request.body or b"{}")
+        except json.JSONDecodeError as e:
+            raise tornado.web.HTTPError(400, reason=f"bad JSON: {e}") \
+                from None
+        name, url = body.get("name"), body.get("url")
+        if not name or not url:
+            raise tornado.web.HTTPError(
+                400, reason="replica registration needs name and url")
+        self.fleet.add(name, url, grpc=body.get("grpc"))
+        self.write_json({"added": name})
+
+
+class AdminReplicaHandler(_RouterBase):
+    def delete(self, name):
+        self.fleet.remove(name)
+        self.write_json({"removed": name})
+
+
+class AdminDrainHandler(_RouterBase):
+    def post(self, name):
+        if not self.fleet.drain(name):
+            raise tornado.web.HTTPError(
+                404, reason=f"replica {name!r} not found")
+        self.write_json({"draining": name})
+
+
+class RouterMetricsHandler(_RouterBase):
+    def get(self):
+        self.set_header("Content-Type", "text/plain; version=0.0.4")
+        self.finish(res_metrics.prometheus_text())
+
+
+class RouterTraceHandler(_RouterBase):
+    def get(self):
+        tid = self.get_query_argument("trace_id", default=None)
+        self.write_json(obs.get_tracer().chrome_trace(tid))
+
+
+class RouterServer:
+    """Hosts the proxy + admin plane; same lifecycle shape as
+    ModelServer (daemon-thread ioloop, worker executor for blocking
+    upstream I/O)."""
+
+    def __init__(self, fleet: Fleet | None = None, *,
+                 affinity: bool = True, spill_margin: float = 4.0,
+                 forward_timeout_s: float = 300.0,
+                 max_workers: int = 128):
+        self.fleet = fleet or Fleet()
+        self.router = Router(self.fleet, affinity=affinity,
+                             spill_margin=spill_margin)
+        self.forward_timeout_s = float(forward_timeout_s)
+        # One worker is HELD for the whole upstream round trip of one
+        # in-flight request (blocking http.client forward), so the pool
+        # must cover peak CONCURRENT requests, not CPU count — the
+        # workers spend their lives in network waits. Threads are lazy;
+        # an idle router allocates none of them.
+        self.executor = ThreadPoolExecutor(
+            max_workers=int(max_workers),
+            thread_name_prefix="tpk-router-fwd")
+        self._loop: tornado.ioloop.IOLoop | None = None
+        self._thread: threading.Thread | None = None
+        self.port: int | None = None
+        self._grpc = None
+        self.grpc_port: int | None = None
+
+    def app(self) -> tornado.web.Application:
+        kw = {"server": self}
+        return tornado.web.Application([
+            (r"/admin/replicas", AdminReplicasHandler, kw),
+            (r"/admin/replicas/([^/]+)", AdminReplicaHandler, kw),
+            (r"/admin/drain/([^/]+)", AdminDrainHandler, kw),
+            (r"/metrics", RouterMetricsHandler, kw),
+            (r"/debug/trace", RouterTraceHandler, kw),
+            (r"/(.*)", ProxyHandler, kw),
+        ])
+
+    def start_grpc(self, port: int = 0) -> int:
+        from kubeflow_tpu.serve.grpc_router import build_grpc_router
+
+        self._grpc, self.grpc_port = build_grpc_router(self, port)
+        self._grpc.start()
+        return self.grpc_port
+
+    def _serve(self, port: int, ready: threading.Event) -> None:
+        asyncio.set_event_loop(asyncio.new_event_loop())
+        self._loop = tornado.ioloop.IOLoop.current()
+        sockets = tornado.netutil.bind_sockets(port, address="127.0.0.1")
+        server = tornado.httpserver.HTTPServer(self.app())
+        server.add_sockets(sockets)
+        self.port = sockets[0].getsockname()[1]
+        ready.set()
+        self._loop.start()
+
+    def start_background(self, port: int = 0) -> int:
+        ready = threading.Event()
+        self._thread = threading.Thread(
+            target=self._serve, args=(port, ready), daemon=True,
+            name="tpk-router")
+        self._thread.start()
+        if not ready.wait(10.0):
+            raise TimeoutError("router failed to bind")
+        assert self.port is not None
+        return self.port
+
+    def run(self, port: int) -> None:
+        self._serve(port, threading.Event())
+
+    def stop(self) -> None:
+        if self._grpc is not None:
+            self._grpc.stop(grace=1.0).wait(1.5)
+        if self._loop is not None:
+            self._loop.add_callback(self._loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        self.executor.shutdown(wait=False)
+        self.fleet.close()
+
+
+def main(argv: list[str] | None = None) -> int:
+    """`tpk-router` entrypoint: front a static replica list (grow/shrink
+    later through the admin endpoint or the autoscaler)."""
+    import argparse
+
+    p = argparse.ArgumentParser(prog="tpk-router")
+    p.add_argument("--port", type=int, default=8090)
+    p.add_argument("--grpc-port", type=int, default=None)
+    p.add_argument("--replica", action="append", default=[],
+                   metavar="NAME=URL[,GRPC]",
+                   help="replica registration (repeatable)")
+    p.add_argument("--no-affinity", action="store_true",
+                   help="disable prefix/adapter affinity (least-loaded "
+                        "only; the A/B control)")
+    p.add_argument("--spill-margin", type=float, default=4.0)
+    args = p.parse_args(argv)
+
+    server = RouterServer(affinity=not args.no_affinity,
+                          spill_margin=args.spill_margin)
+    for spec in args.replica:
+        name, _, rest = spec.partition("=")
+        if not rest:
+            p.error(f"--replica must be NAME=URL[,GRPC], got {spec!r}")
+        url, _, grpc = rest.partition(",")
+        server.fleet.add(name, url, grpc=grpc or None)
+    if args.grpc_port is not None:
+        bound = server.start_grpc(args.grpc_port)
+        print(json.dumps({"event": "router_grpc", "port": bound}),
+              flush=True)
+    print(json.dumps({"event": "router_serving", "port": args.port,
+                      "replicas": server.fleet.names()}), flush=True)
+    server.run(args.port)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -41,21 +41,19 @@ import tornado.web
 from kubeflow_tpu.serve.batcher import Batcher
 from kubeflow_tpu.serve.generation import KVCapacityExceeded
 from kubeflow_tpu.serve.model import Model, _v2_dtype, v2_to_numpy_dtype
+# The wire header names live in serve/headers.py (dependency-free) so
+# the router process can import them without paying THIS module's
+# engine-stack import; they are re-exported here for compatibility.
+# DEADLINE_HEADER: the KServe/Istio-style relative budget, deadline-
+# propagated in-process — expiry anywhere on the request path
+# (admission queue, batcher, generation) returns 504. REQUEST_ID_HEADER:
+# the one trace identity (SURVEY.md §5.1 rebuild), threaded through
+# admission, the batcher, and the engine spans (see /debug/trace).
+from kubeflow_tpu.serve.headers import (DEADLINE_HEADER, DRAINING_HEADER,
+                                        REQUEST_ID_HEADER)
 from kubeflow_tpu.utils import obs
 from kubeflow_tpu.utils.resilience import (Deadline, DeadlineExceeded,
                                            metrics as res_metrics)
-
-#: Relative per-request budget in milliseconds (the KServe/Istio-style
-#: timeout header, deadline-propagated in-process): expiry anywhere on
-#: the request path — admission queue, batcher, generation — returns 504.
-DEADLINE_HEADER = "X-Request-Timeout-Ms"
-
-#: The one trace identity of a request (SURVEY.md §5.1 "no unified
-#: tracing" rebuild): honored when the caller sets it, assigned
-#: otherwise, always echoed on the response — and threaded through
-#: admission, the batcher, and the generation engine, whose spans all
-#: carry it (see /debug/trace and utils/obs.py).
-REQUEST_ID_HEADER = "X-Request-Id"
 
 #: GenerationEngine stats → /metrics series (ISSUE 3 observability): the
 #: engine's own counters rendered per model on every scrape, so the
@@ -477,6 +475,17 @@ class _Base(tornado.web.RequestHandler):
         Retry-After shed response has been written; the caller must
         return without releasing. True = admitted; the caller owns one
         release()."""
+        if self.server.draining:
+            # Drain rejection, NOT an overload shed: marked with
+            # DRAINING_HEADER so the front-door router retries the
+            # request on a surviving replica instead of forwarding the
+            # 503 as backpressure. In-flight requests (already past this
+            # gate) keep running to completion.
+            self.set_header("Retry-After", "1")
+            self.set_header(DRAINING_HEADER, "1")
+            self.write_json(self.capacity_body("replica draining"),
+                            status=503)
+            return False
         adm = self.server.admission
         with obs.span("serve.admit", trace_id=self.trace_id,
                       path=self.request.path) as sp:
@@ -933,7 +942,8 @@ class ModelServer:
     def __init__(self, repo: ModelRepository | None = None,
                  request_logger: RequestLogger | None = None,
                  admission: AdmissionController | None = None,
-                 max_inflight: int = 256):
+                 max_inflight: int = 256,
+                 executor_workers: int | None = None):
         self.repo = repo or ModelRepository()
         self.request_logger = request_logger
         # max_inflight=0 disables admission control entirely (None);
@@ -948,8 +958,14 @@ class ModelServer:
         # default executor) so expired requests hand back a CONCURRENT
         # future: the admission slot can ride it to true completion
         # instead of freeing while the abandoned call still runs.
+        # `executor_workers` overrides the CPU-derived default: a
+        # worker is held for each admitted blocking call's full
+        # duration (mostly device/engine waits, not CPU), so small-CPU
+        # hosts serving concurrency-heavy traffic size it by admission
+        # depth instead.
         self.executor = ThreadPoolExecutor(
-            max_workers=min(32, (os.cpu_count() or 1) + 4),
+            max_workers=(int(executor_workers) if executor_workers
+                         else min(32, (os.cpu_count() or 1) + 4)),
             thread_name_prefix="tpk-serve-work")
         self._counters: dict[str, dict] = {}
         self._lock = threading.Lock()
@@ -958,6 +974,28 @@ class ModelServer:
         self.port: int | None = None
         self._grpc = None
         self.grpc_port: int | None = None
+        # Connection-draining state (scale-in, ISSUE 9): a plain bool —
+        # single writer (the drain trigger), GIL-atomic reads from
+        # request threads and probes.
+        self._draining = False
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def begin_drain(self) -> None:
+        """Enter draining: BOTH readiness surfaces (HTTP /v2/health/ready
+        and gRPC ServerReady share readiness()) go not-ready, new
+        inference requests are rejected 503 + DRAINING_HEADER (HTTP) /
+        UNAVAILABLE "replica draining" (gRPC), and in-flight requests
+        run to completion — the router/controller retires the process
+        once the in-flight gauges reach zero."""
+        self._draining = True
+
+    def end_drain(self) -> None:
+        """Abort a drain (scale-in cancelled): the replica resumes
+        admitting and both readiness surfaces recover together."""
+        self._draining = False
 
     def start_grpc(self, port: int = 0) -> int:
         """Open Inference Protocol v2 over gRPC (grpc_server.py), sharing
@@ -970,12 +1008,17 @@ class ModelServer:
 
     def readiness(self) -> tuple[bool, str]:
         """THE readiness rule, shared by the HTTP probe and gRPC
-        ServerReady so the two surfaces cannot drift: not ready while any
-        model is still loading, or while the replica is actively
-        shedding (admission rejections within the last retry_after_s —
-        KServe probe semantics: route around a saturated replica instead
-        of feeding more traffic into 503s; a full-but-quiet replica
-        stays ready)."""
+        ServerReady so the two surfaces cannot drift: not ready while
+        draining (scale-in in progress — pollers on EITHER surface must
+        see the same degradation, or a gRPC-only client keeps sending
+        to a replica the HTTP plane already retired), while any model is
+        still loading, or while the replica is actively shedding
+        (admission rejections within the last retry_after_s — KServe
+        probe semantics: route around a saturated replica instead of
+        feeding more traffic into 503s; a full-but-quiet replica stays
+        ready)."""
+        if self._draining:
+            return False, "draining"
         for name in self.repo.names():
             try:
                 model = self.repo.get(name)

@@ -56,6 +56,10 @@ REQUIRED_HOT_PATHS = {
     "trainer-step-loop": "kubeflow_tpu/train/trainer.py",
     "prefetch-worker": "kubeflow_tpu/data/prefetch.py",
     "batcher-worker": "kubeflow_tpu/serve/batcher.py",
+    # Router placement runs on every proxied request: table math over
+    # poller-cached load signals only — a blocking scrape or host sync
+    # here would serialize the whole front door (ISSUE 9).
+    "router-placement": "kubeflow_tpu/serve/router.py",
 }
 
 _MARK = re.compile(r"#\s*tpk-hot:\s*(.+?)\s*$")
