@@ -368,6 +368,7 @@ def main_ctrlbench() -> None:
                           "artifact": "CTRLBENCH.json"}))
         return
     always = result["group_commit"]["always"]
+    repl = result.get("replicated", {})
     print(json.dumps({
         "metric": "ctrlbench_submit_rps_always",
         "value": always["on"]["submit_rps"],
@@ -376,6 +377,15 @@ def main_ctrlbench() -> None:
         "speedup": always["speedup_submit"],
         "clients": result["clients"],
         "coalesced_events": result["watch_fanout"]["coalesced_events"],
+        # The replicated arm (ISSUE 11): quorum-acked rps vs single node
+        # (< 1 by design — the price of ack-after-quorum) plus the
+        # horizontal read surface followers add.
+        "replicated_submit_rps": repl.get("replicated",
+                                          {}).get("submit_rps"),
+        "replicated_vs_single": repl.get(
+            "rps_ratio_replicated_vs_single"),
+        "quorum_commits": repl.get("quorum_commits"),
+        "follower_get_rps": repl.get("follower_get_rps"),
         "detail": "CTRLBENCH.json",
     }))
 

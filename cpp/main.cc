@@ -20,6 +20,7 @@
 #include "executor.h"
 #include "jaxjob.h"
 #include "pipelines.h"
+#include "replica.h"
 #include "scheduler.h"
 #include "serve.h"
 #include "server.h"
@@ -43,6 +44,14 @@ int main(int argc, char** argv) {
   // on — it only batches what one event-loop pass applies anyway; 0
   // restores the per-record append path byte-for-byte.
   int group_commit = 64;
+  // Replication (ISSUE 11): --peers lists the OTHER replicas' sockets
+  // (empty = single-node, the ISSUE 8 path byte-for-byte); --replica-of
+  // names the leader to follow at startup (absent = bootstrap: campaign
+  // for leadership once a quorum of peers answers).
+  std::string peers_csv;
+  std::string replica_of;
+  int lease_ms = 1500;
+  int quorum_timeout_ms = 5000;
   std::vector<std::pair<std::string, int>> slices = {{"local", 8}};
 
   for (int i = 1; i < argc; ++i) {
@@ -58,6 +67,11 @@ int main(int argc, char** argv) {
     else if (arg == "--fsync-interval") fsync_interval = atoi(next().c_str());
     else if (arg == "--compact") compact_threshold = atoi(next().c_str());
     else if (arg == "--group-commit") group_commit = atoi(next().c_str());
+    else if (arg == "--peers") peers_csv = next();
+    else if (arg == "--replica-of") replica_of = next();
+    else if (arg == "--lease-ms") lease_ms = atoi(next().c_str());
+    else if (arg == "--quorum-timeout-ms")
+      quorum_timeout_ms = atoi(next().c_str());
     else if (arg == "--slices") {
       slices.clear();
       std::string val = next();  // "name=cap,name=cap"
@@ -77,7 +91,9 @@ int main(int argc, char** argv) {
       printf("usage: tpk-controlplane --socket PATH --workdir DIR "
              "[--wal FILE] [--python BIN] [--slices name=cap,...] "
              "[--fsync never|interval|always] [--fsync-interval N] "
-             "[--compact N] [--group-commit N]\n");
+             "[--compact N] [--group-commit N] "
+             "[--peers SOCK,SOCK,...] [--replica-of SOCK] "
+             "[--lease-ms N] [--quorum-timeout-ms N]\n");
       return 0;
     }
   }
@@ -92,6 +108,31 @@ int main(int argc, char** argv) {
   } else {
     fprintf(stderr, "tpk-controlplane: --fsync must be never | interval | "
             "always, got '%s'\n", fsync_mode.c_str());
+    return 1;
+  }
+
+  std::vector<std::string> peers;
+  {
+    size_t pos = 0;
+    while (pos < peers_csv.size()) {
+      size_t comma = peers_csv.find(',', pos);
+      if (comma == std::string::npos) comma = peers_csv.size();
+      std::string part = peers_csv.substr(pos, comma - pos);
+      if (!part.empty() && part != socket_path) peers.push_back(part);
+      pos = comma + 1;
+    }
+  }
+  if (!peers.empty() && group_commit <= 0) {
+    // The replication log IS the group-commit batch: without batching
+    // there is nothing to ship before the ack, and the quorum gate
+    // would silently not exist.
+    fprintf(stderr, "tpk-controlplane: --peers requires --group-commit "
+            "> 0 (the batch is the replication unit)\n");
+    return 1;
+  }
+  if (!peers.empty() && wal.empty()) {
+    fprintf(stderr, "tpk-controlplane: --peers requires --wal (the WAL "
+            "is the replication log)\n");
     return 1;
   }
 
@@ -117,12 +158,27 @@ int main(int argc, char** argv) {
             "expected after a crash mid-append\n",
             static_cast<long long>(replay.truncated_bytes));
   }
+  tpk::Replication::Options ropts;
+  ropts.self = socket_path;
+  ropts.peers = peers;
+  ropts.state_path = wal.empty() ? "" : wal + ".replstate";
+  ropts.leader_hint = replica_of;
+  ropts.lease_ms = lease_ms > 0 ? lease_ms : 1500;
+  ropts.quorum_timeout_ms = quorum_timeout_ms > 0 ? quorum_timeout_ms
+                                                  : 5000;
+  tpk::Replication repl(&store, ropts);
+  // Single-node (no peers): every repl path below is inert and the
+  // loop is the ISSUE 8 loop byte-for-byte.
+  const bool replicated = repl.enabled();
+
   tpk::Scheduler scheduler;
   for (const auto& [name, cap] : slices) scheduler.AddSlice(name, cap);
   tpk::LocalExecutor executor;
   tpk::JaxJobController jaxjob(&store, &executor, &scheduler, workdir, python);
   jaxjob.SetSocketPath(socket_path);
-  jaxjob.Recover();
+  // A replicated follower must not adopt/restart gangs it never owns;
+  // Recover() runs on promotion instead (TookLeadership below).
+  if (!replicated) jaxjob.Recover();
   tpk::SubprocessSuggestion suggestion(python);
   tpk::ExperimentController tune(&store, &suggestion, workdir);
   tpk::LineageStore lineage(workdir + "/lineage.jsonl");
@@ -135,10 +191,10 @@ int main(int argc, char** argv) {
   tpk::HttpProbe probe(250);
   tpk::ServeController serve(&store, &executor, &scheduler, &probe, workdir,
                              python);
-  serve.Recover();
+  if (!replicated) serve.Recover();
   tpk::TrainedModelController trained(&store, &probe);
   tpk::Server server(&store, &scheduler, &jaxjob, socket_path, workdir,
-                     &tune, &pipelines, &serve);
+                     &tune, &pipelines, &serve, &repl);
 
   std::string error;
   if (!server.Start(&error)) {
@@ -158,12 +214,29 @@ int main(int argc, char** argv) {
           static_cast<long long>(replay.truncated_bytes),
           replay.clean ? "clean" : "STOPPED AT CORRUPTION",
           fsync_mode.c_str(), group_commit, lineage_records, slices.size());
+  if (replicated) {
+    const std::string role_note =
+        replica_of.empty() ? "bootstrap — campaigning"
+                           : "following " + replica_of;
+    fprintf(stderr,
+            "tpk-controlplane: replicated (%zu peers, quorum %d, "
+            "lease %d ms, term %lld, %s)\n",
+            peers.size(), repl.quorum(), ropts.lease_ms,
+            static_cast<long long>(repl.term()), role_note.c_str());
+  }
 
   // Watch: any JAXJob change → reconcile (informer-style edge trigger).
   // Deletes are handled inline: the resource is already gone from the
   // store, so the controller must kill the gang from the event's snapshot.
+  // Followers drop controller-facing events — they own no gangs and run
+  // no reconciles; promotion runs Recover() against the applied state
+  // instead (the watch.poll ring still serves them to clients).
+  auto lead = [&repl, replicated]() {
+    return !replicated || repl.IsLeader();
+  };
   std::vector<std::string> dirty;
-  store.Watch("JAXJob", [&dirty, &jaxjob](const tpk::WatchEvent& ev) {
+  store.Watch("JAXJob", [&dirty, &jaxjob, lead](const tpk::WatchEvent& ev) {
+    if (!lead()) return;
     if (ev.type == tpk::WatchEvent::Type::kDeleted) {
       jaxjob.OnDeleted(ev.resource);
     } else {
@@ -171,23 +244,28 @@ int main(int argc, char** argv) {
     }
   });
   // Experiment/Trial deletes cascade to their children (apiserver GC).
-  store.Watch("Experiment", [&tune](const tpk::WatchEvent& ev) {
+  store.Watch("Experiment", [&tune, lead](const tpk::WatchEvent& ev) {
+    if (!lead()) return;
     if (ev.type == tpk::WatchEvent::Type::kDeleted) tune.OnDeleted(ev.resource);
   });
-  store.Watch("Trial", [&tune](const tpk::WatchEvent& ev) {
+  store.Watch("Trial", [&tune, lead](const tpk::WatchEvent& ev) {
+    if (!lead()) return;
     if (ev.type == tpk::WatchEvent::Type::kDeleted) tune.OnDeleted(ev.resource);
   });
-  store.Watch("PipelineRun", [&pipelines](const tpk::WatchEvent& ev) {
+  store.Watch("PipelineRun", [&pipelines, lead](const tpk::WatchEvent& ev) {
+    if (!lead()) return;
     if (ev.type == tpk::WatchEvent::Type::kDeleted) {
       pipelines.OnDeleted(ev.resource);
     }
   });
-  store.Watch("InferenceService", [&serve](const tpk::WatchEvent& ev) {
+  store.Watch("InferenceService", [&serve, lead](const tpk::WatchEvent& ev) {
+    if (!lead()) return;
     if (ev.type == tpk::WatchEvent::Type::kDeleted) {
       serve.OnDeleted(ev.resource);
     }
   });
-  store.Watch("TrainedModel", [&trained](const tpk::WatchEvent& ev) {
+  store.Watch("TrainedModel", [&trained, lead](const tpk::WatchEvent& ev) {
+    if (!lead()) return;
     if (ev.type == tpk::WatchEvent::Type::kDeleted) {
       trained.OnDeleted(ev.resource);
     }
@@ -212,9 +290,15 @@ int main(int argc, char** argv) {
   // Pending → duplicate launch). Exit loudly; restart replays the
   // durable state and re-reconciles — the exact path the kill-9 crash
   // tests prove correct.
-  auto controller_commit_ok = [&store]() {
+  auto controller_commit_ok = [&store, &repl, replicated]() {
     std::string gc_err;
-    if (store.CommitGroup(&gc_err)) return true;
+    // Controller mutations replicate exactly like client ops (they are
+    // the same WAL records); a leader that cannot quorum them — or was
+    // deposed while its batch was open — exits rather than run
+    // controllers whose side effects outlive a rolled-back batch.
+    const bool ok = replicated ? repl.CommitQuorum(&gc_err)
+                               : store.CommitGroup(&gc_err);
+    if (ok) return true;
     fprintf(stderr,
             "tpk-controlplane: FATAL: controller group commit failed "
             "(%s); controller side effects cannot be rolled back — "
@@ -224,31 +308,46 @@ int main(int argc, char** argv) {
   };
   while (!g_stop) {
     server.PollOnce(50);
+    repl.Tick();
+    if (replicated && repl.TookLeadership()) {
+      // Promotion: the applied store state is now ours to act on.
+      // Recover() rebuilds gang/process bookkeeping exactly as a
+      // restart would (the old leader's orphaned workers count as one
+      // restart, the kill-9 semantics the crash harness pins).
+      jaxjob.Recover();
+      serve.Recover();
+    }
     store.DrainWatches();
-    reconcile_dirty();
-    double now = static_cast<double>(time(nullptr));
-    jaxjob.Tick(now);
-    tune.Tick(now);
-    schedule.Tick(now);
-    pipelines.Tick(now);
-    serve.Tick(now);
-    trained.Tick(now);
-    // Controller-driven mutations (the Ticks above) batch like client
-    // ops; land them BEFORE draining their watch events — DrainWatches
-    // only delivers committed events (a failed commit must be able to
-    // drop its batch's events), so the commit has to come first for the
-    // Ticks' child JAXJob create/delete to reach the jaxjob pass below
-    // instead of waiting a poll cycle. Failure is fatal — see
-    // controller_commit_ok above.
-    if (!controller_commit_ok()) return 1;
-    // Tune/pipeline writes (child JAXJob create/delete) need a jaxjob pass
-    // before the next poll so child gangs launch/die promptly.
-    store.DrainWatches();
-    reconcile_dirty();
-    // ...and the reconcile pass buffers its own mutations: land them
-    // before sleeping in poll so the durability window stays one loop
-    // pass, not open-ended. Same fatality rule — reconciles spawn too.
-    if (!controller_commit_ok()) return 1;
+    if (lead()) {
+      reconcile_dirty();
+    } else {
+      dirty.clear();  // stale names from a follower window
+    }
+    if (lead()) {
+      double now = static_cast<double>(time(nullptr));
+      jaxjob.Tick(now);
+      tune.Tick(now);
+      schedule.Tick(now);
+      pipelines.Tick(now);
+      serve.Tick(now);
+      trained.Tick(now);
+      // Controller-driven mutations (the Ticks above) batch like client
+      // ops; land them BEFORE draining their watch events — DrainWatches
+      // only delivers committed events (a failed commit must be able to
+      // drop its batch's events), so the commit has to come first for the
+      // Ticks' child JAXJob create/delete to reach the jaxjob pass below
+      // instead of waiting a poll cycle. Failure is fatal — see
+      // controller_commit_ok above.
+      if (!controller_commit_ok()) return 1;
+      // Tune/pipeline writes (child JAXJob create/delete) need a jaxjob
+      // pass before the next poll so child gangs launch/die promptly.
+      store.DrainWatches();
+      reconcile_dirty();
+      // ...and the reconcile pass buffers its own mutations: land them
+      // before sleeping in poll so the durability window stays one loop
+      // pass, not open-ended. Same fatality rule — reconciles spawn too.
+      if (!controller_commit_ok()) return 1;
+    }
   }
   fprintf(stderr, "tpk-controlplane: shutting down\n");
   return 0;

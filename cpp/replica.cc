@@ -1,0 +1,638 @@
+#include "replica.h"
+
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+
+namespace tpk {
+
+namespace {
+
+constexpr int kVoteTimeoutMs = 300;       // per-peer vote RPC
+constexpr int kShipTimeoutMs = 1000;      // per-peer append RPC
+constexpr int kSnapshotTimeoutMs = 4000;  // catch-up transfer
+
+}  // namespace
+
+Replication::Replication(Store* store, Options opts)
+    : store_(store), opts_(std::move(opts)) {
+  for (const auto& sock : opts_.peers) {
+    if (sock.empty() || sock == opts_.self) continue;
+    peers_.push_back(Peer{sock, -1, 0, false});
+  }
+  // Deterministic-enough jitter seed: distinct per replica identity and
+  // process, so simultaneous restarts don't campaign in lockstep.
+  rng_state_ = static_cast<unsigned>(getpid());
+  for (char c : opts_.self) rng_state_ = rng_state_ * 31 + c;
+  LoadState();
+  leader_ = opts_.leader_hint;
+  last_contact_ms_ = NowMs();
+  // Bootstrap (no --replica-of): campaign quickly so a fresh cluster
+  // forms without waiting a full lease. With a leader hint, give that
+  // leader its whole lease first.
+  ResetElectionDeadline(/*short_fuse=*/opts_.leader_hint.empty());
+}
+
+double Replication::NowMs() const {
+  return std::chrono::duration<double, std::milli>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+void Replication::ResetElectionDeadline(bool short_fuse) {
+  const int base = short_fuse ? std::max(opts_.lease_ms / 4, 100)
+                              : opts_.lease_ms;
+  const int jitter_span = std::max(base / 2, 50);
+  const int jitter = static_cast<int>(rand_r(&rng_state_) %
+                                      static_cast<unsigned>(jitter_span));
+  election_deadline_ms_ = NowMs() + base + jitter;
+}
+
+void Replication::LoadState() {
+  if (opts_.state_path.empty()) return;
+  FILE* f = fopen(opts_.state_path.c_str(), "r");
+  if (!f) return;
+  char buf[512];
+  size_t got = fread(buf, 1, sizeof(buf) - 1, f);
+  fclose(f);
+  buf[got] = '\0';
+  try {
+    Json st = Json::parse(buf);
+    term_ = st.get("term").as_int(0);
+    voted_for_ = st.get("votedFor").as_string();
+  } catch (const std::exception& e) {
+    fprintf(stderr, "tpk-controlplane: replication state %s unreadable "
+            "(%s) — starting at term 0\n", opts_.state_path.c_str(),
+            e.what());
+  }
+}
+
+void Replication::PersistState() {
+  // Terms and votes must survive a crash (a replica that forgets its
+  // vote could grant two candidates the same term — split brain), so
+  // this is temp + fsync + atomic rename, all checked.
+  if (opts_.state_path.empty()) return;
+  Json st = Json::Object();
+  st["term"] = term_;
+  st["votedFor"] = voted_for_;
+  std::string data = st.dump();
+  data += '\n';
+  std::string tmp = opts_.state_path + ".tmp";
+  FILE* f = fopen(tmp.c_str(), "w");
+  if (!f) {
+    fprintf(stderr, "tpk-controlplane: cannot persist replication state "
+            "%s: %s\n", tmp.c_str(), strerror(errno));
+    return;
+  }
+  bool ok = fwrite(data.data(), 1, data.size(), f) == data.size();
+  ok = ok && fflush(f) == 0 && fsync(fileno(f)) == 0;
+  if (fclose(f) != 0) ok = false;
+  if (!ok || rename(tmp.c_str(), opts_.state_path.c_str()) != 0) {
+    remove(tmp.c_str());
+    fprintf(stderr, "tpk-controlplane: cannot persist replication state "
+            "%s: %s\n", opts_.state_path.c_str(), strerror(errno));
+  }
+}
+
+bool Replication::TookLeadership() {
+  bool took = leadership_gained_;
+  leadership_gained_ = false;
+  return took;
+}
+
+void Replication::BecomeLeader() {
+  role_ = Role::kLeader;
+  leader_ = opts_.self;
+  // Whatever the log holds is now committed by fiat of the election
+  // restriction (we were at least as long as a majority): apply any
+  // suffix the old leader never confirmed, then serve from it.
+  store_->ApplyReplicatedUpTo(store_->WalSeq());
+  commit_seq_ = store_->WalSeq();
+  for (auto& p : peers_) {
+    p.acked_seq = 0;  // re-learn follower positions via heartbeats
+    p.reachable = false;
+  }
+  last_quorum_ok_ms_ = NowMs();
+  last_heartbeat_ms_ = 0;  // heartbeat on the next Tick
+  leadership_gained_ = true;
+  fprintf(stderr, "tpk-controlplane: LEADER at term %lld (seq %llu, "
+          "%zu peers, quorum %d)\n", static_cast<long long>(term_),
+          static_cast<unsigned long long>(store_->WalSeq()),
+          peers_.size(), quorum());
+}
+
+void Replication::StepDown(const std::string& reason, int64_t new_term) {
+  if (new_term > term_) {
+    term_ = new_term;
+    voted_for_.clear();
+    PersistState();
+  }
+  if (role_ == Role::kLeader) {
+    fprintf(stderr, "tpk-controlplane: stepping down at term %lld: %s\n",
+            static_cast<long long>(term_), reason.c_str());
+  }
+  role_ = Role::kFollower;
+  leader_.clear();
+  last_contact_ms_ = NowMs();
+  ResetElectionDeadline(false);
+}
+
+bool Replication::PeerRequest(Peer& p, const Json& req, Json* resp,
+                              int timeout_ms) {
+  std::string line = req.dump();
+  line += '\n';
+  for (int attempt = 0; attempt < 2; ++attempt) {
+    const bool was_cached = p.fd >= 0;
+    if (p.fd < 0) {
+      p.fd = socket(AF_UNIX, SOCK_STREAM, 0);
+      if (p.fd < 0) return false;
+      sockaddr_un addr{};
+      addr.sun_family = AF_UNIX;
+      strncpy(addr.sun_path, p.sock.c_str(), sizeof(addr.sun_path) - 1);
+      if (connect(p.fd, reinterpret_cast<sockaddr*>(&addr),
+                  sizeof(addr)) != 0) {
+        close(p.fd);
+        p.fd = -1;
+        p.reachable = false;
+        return false;
+      }
+    }
+    timeval tv{timeout_ms / 1000, (timeout_ms % 1000) * 1000};
+    setsockopt(p.fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    setsockopt(p.fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+    bool ok = true;
+    size_t off = 0;
+    while (off < line.size()) {
+      ssize_t n = send(p.fd, line.data() + off, line.size() - off,
+                       MSG_NOSIGNAL);
+      if (n <= 0) {
+        ok = false;
+        break;
+      }
+      off += static_cast<size_t>(n);
+    }
+    std::string buf;
+    while (ok && buf.find('\n') == std::string::npos) {
+      char tmp[65536];
+      ssize_t n = recv(p.fd, tmp, sizeof(tmp), 0);
+      if (n <= 0) {
+        ok = false;
+        break;
+      }
+      buf.append(tmp, static_cast<size_t>(n));
+    }
+    if (!ok) {
+      // A timed-out or half-done exchange leaves request/reply pairing
+      // undefined on this connection — drop it so the next request
+      // starts clean (the Python client's reset-on-error rule).
+      close(p.fd);
+      p.fd = -1;
+      p.reachable = false;
+      if (was_cached) continue;  // stale cached fd: one fresh reconnect
+      return false;
+    }
+    try {
+      *resp = Json::parse(buf.substr(0, buf.find('\n')));
+    } catch (const std::exception&) {
+      close(p.fd);
+      p.fd = -1;
+      p.reachable = false;
+      return false;
+    }
+    p.reachable = true;
+    return true;
+  }
+  return false;
+}
+
+bool Replication::ShipSnapshotTo(Peer& p, int timeout_ms) {
+  std::string snap, wal;
+  if (!store_->ReadReplicaFiles(&snap, &wal)) return false;
+  Json req = Json::Object();
+  req["op"] = "repl.snapshot";
+  req["term"] = term_;
+  req["leader"] = opts_.self;
+  req["commitSeq"] = static_cast<int64_t>(commit_seq_);
+  req["snapshot"] = snap;
+  req["wal"] = wal;
+  Json resp;
+  if (!PeerRequest(p, req, &resp, timeout_ms)) return false;
+  if (resp.get("staleTerm").as_bool()) {
+    StepDown("stale term reported by " + p.sock,
+             resp.get("term").as_int());
+    return false;
+  }
+  if (!resp.get("ok").as_bool()) return false;
+  ++snapshots_shipped_;
+  p.acked_seq = static_cast<uint64_t>(resp.get("seq").as_int());
+  return true;
+}
+
+int Replication::ShipRound(const Store::BatchBytes& batch,
+                           int timeout_ms) {
+  int acks = 0;
+  for (auto& p : peers_) {
+    if (role_ != Role::kLeader) break;  // deposed mid-round
+    if (p.acked_seq >= batch.last_seq) {
+      ++acks;
+      continue;
+    }
+    Json req = Json::Object();
+    req["op"] = "repl.append";
+    req["term"] = term_;
+    req["leader"] = opts_.self;
+    req["prevSeq"] = static_cast<int64_t>(batch.prev_seq);
+    req["prevCrc"] = static_cast<int64_t>(batch.prev_crc);
+    req["commitSeq"] = static_cast<int64_t>(commit_seq_);
+    req["data"] = batch.bytes;
+    Json resp;
+    if (!PeerRequest(p, req, &resp, timeout_ms)) continue;
+    if (resp.get("staleTerm").as_bool()) {
+      StepDown("stale term reported by " + p.sock,
+               resp.get("term").as_int());
+      break;
+    }
+    if (resp.get("ok").as_bool()) {
+      p.acked_seq = static_cast<uint64_t>(resp.get("seq").as_int());
+    } else if (resp.get("needSnapshot").as_bool()) {
+      // The follower's log diverged (behind after a crash, or carrying
+      // records a rolled-back batch left stranded): reseed it from our
+      // snapshot + tail, then re-ship this batch.
+      if (ShipSnapshotTo(p, kSnapshotTimeoutMs) &&
+          PeerRequest(p, req, &resp, timeout_ms) &&
+          resp.get("ok").as_bool()) {
+        p.acked_seq = static_cast<uint64_t>(resp.get("seq").as_int());
+      }
+    }
+    if (p.acked_seq >= batch.last_seq) ++acks;
+  }
+  return acks;
+}
+
+bool Replication::CommitQuorum(std::string* error) {
+  Store::BatchBytes batch;
+  if (!store_->PendingBatchBytes(&batch) || !enabled()) {
+    // Nothing to replicate (or single-node mode): the plain covering
+    // fsync, byte-for-byte the ISSUE 8 path.
+    return store_->CommitGroup(error);
+  }
+  if (role_ != Role::kLeader) {
+    store_->AbortBatch();
+    if (error) *error = "not leader (stepped down with a batch open)";
+    return false;
+  }
+  ++shipped_batches_;
+  // Crash window: nothing shipped, nothing locally durable — the whole
+  // batch is legitimately lost with the process (all replies were held).
+  MaybeCrashAtPoint("repl.pre-ship");
+  const double t0 = NowMs();
+  const int needed = quorum() - 1;  // our own covering fsync is the +1
+  int acks = ShipRound(batch, kShipTimeoutMs);
+  while (acks < needed && role_ == Role::kLeader &&
+         NowMs() - t0 < opts_.quorum_timeout_ms) {
+    // Quorum-degraded stall: clients see their acks held (and time out
+    // under their own deadline budget) while we retry the ship — the
+    // honest behavior, since releasing early would acknowledge a batch
+    // a minority holds.
+    usleep(20 * 1000);
+    acks = ShipRound(batch, kShipTimeoutMs);
+  }
+  // Crash window: followers may hold the batch durably, we do not, and
+  // no reply was released — applied-never-acked on survivors is legal.
+  MaybeCrashAtPoint("repl.post-ship-pre-quorum");
+  if (acks < needed || role_ != Role::kLeader) {
+    ++quorum_failures_;
+    store_->AbortBatch();
+    char buf[160];
+    snprintf(buf, sizeof(buf),
+             "quorum not reached: %d/%d follower acks (+1 self, need %d "
+             "of %zu) within %d ms — batch rolled back",
+             acks, static_cast<int>(peers_.size()), quorum(),
+             peers_.size() + 1, opts_.quorum_timeout_ms);
+    if (error) *error = buf;
+    if (role_ == Role::kLeader) {
+      // A leader that cannot reach a majority must not keep serving:
+      // step down and let the majority side elect.
+      StepDown(buf, term_);
+    }
+    return false;
+  }
+  if (!store_->CommitGroup(error)) {
+    // Local disk failed AFTER a majority of followers landed the batch:
+    // CommitGroup already rolled our memory back; our log is now behind
+    // the followers', and the next leader (or our own next append's
+    // needSnapshot reply) reconciles via resync.
+    ++quorum_failures_;
+    return false;
+  }
+  commit_seq_ = batch.last_seq;
+  last_quorum_ok_ms_ = NowMs();
+  ++quorum_commits_;
+  // Crash window: quorum-durable everywhere but no reply released — the
+  // mutation MUST survive failover (the harness's acked⇒survives proof
+  // targets the release that follows this return).
+  MaybeCrashAtPoint("repl.post-quorum-pre-release");
+  return true;
+}
+
+Json Replication::HandleAppend(const Json& req) {
+  Json resp = Json::Object();
+  const int64_t t = req.get("term").as_int();
+  // ack-after-quorum: term-check — a stale leader's append is rejected
+  // before a single byte can land or apply (the fencing that makes a
+  // deposed leader harmless).
+  if (t < term_ || (t == term_ && role_ == Role::kLeader)) {
+    ++stale_rejections_;
+    resp["ok"] = false;
+    resp["staleTerm"] = true;
+    resp["term"] = term_;
+    return resp;
+  }
+  if (t > term_) {
+    const bool was_leader = role_ == Role::kLeader;
+    term_ = t;
+    voted_for_.clear();
+    PersistState();
+    if (was_leader) StepDown("append from newer term", t);
+  }
+  role_ = Role::kFollower;
+  leader_ = req.get("leader").as_string();
+  last_contact_ms_ = NowMs();
+  ResetElectionDeadline(false);
+  const uint64_t prev =
+      static_cast<uint64_t>(req.get("prevSeq").as_int());
+  const uint32_t prev_crc =
+      static_cast<uint32_t>(req.get("prevCrc").as_int());
+  if (prev != store_->WalSeq() ||
+      (prev > 0 && prev_crc != store_->WalTipCrc())) {
+    // Behind (missed batches), ahead (stranded rolled-back records), or
+    // DIVERGED — same seq, different record: a batch a crashed leader
+    // shipped us that the new leader's history replaced (the Raft
+    // (term,index) check, with the tip record's CRC standing in for
+    // the per-entry term). Either way the leader's log is
+    // authoritative — ask for a reseed.
+    resp["ok"] = false;
+    resp["needSnapshot"] = true;
+    resp["seq"] = static_cast<int64_t>(store_->WalSeq());
+    resp["term"] = term_;
+    return resp;
+  }
+  const std::string& data = req.get("data").as_string();
+  if (!data.empty()) {
+    std::string err;
+    if (!store_->AppendReplicatedLog(data, &err)) {
+      resp["ok"] = false;
+      resp["error"] = err;
+      resp["term"] = term_;
+      return resp;
+    }
+  }
+  // ack-after-quorum: apply — only the prefix the leader reports
+  // committed becomes visible to this follower's reads and watch
+  // fan-out; the durable-but-uncommitted suffix stays buffered.
+  store_->ApplyReplicatedUpTo(
+      static_cast<uint64_t>(req.get("commitSeq").as_int()));
+  resp["ok"] = true;
+  resp["seq"] = static_cast<int64_t>(store_->WalSeq());
+  resp["term"] = term_;
+  return resp;
+}
+
+Json Replication::HandleSnapshot(const Json& req) {
+  Json resp = Json::Object();
+  const int64_t t = req.get("term").as_int();
+  // Same fencing as the append path: a stale leader cannot reseed us.
+  if (t < term_ || (t == term_ && role_ == Role::kLeader)) {
+    ++stale_rejections_;
+    resp["ok"] = false;
+    resp["staleTerm"] = true;
+    resp["term"] = term_;
+    return resp;
+  }
+  if (t > term_) {
+    const bool was_leader = role_ == Role::kLeader;
+    term_ = t;
+    voted_for_.clear();
+    PersistState();
+    if (was_leader) StepDown("snapshot from newer term", t);
+  }
+  role_ = Role::kFollower;
+  leader_ = req.get("leader").as_string();
+  last_contact_ms_ = NowMs();
+  ResetElectionDeadline(false);
+  std::string err;
+  if (!store_->InstallReplica(req.get("snapshot").as_string(),
+                              req.get("wal").as_string(), &err)) {
+    resp["ok"] = false;
+    resp["error"] = err;
+    resp["term"] = term_;
+    return resp;
+  }
+  resp["ok"] = true;
+  resp["seq"] = static_cast<int64_t>(store_->WalSeq());
+  resp["term"] = term_;
+  return resp;
+}
+
+Json Replication::HandleVote(const Json& req) {
+  Json resp = Json::Object();
+  resp["ok"] = true;
+  const int64_t t = req.get("term").as_int();
+  const std::string& cand = req.get("candidate").as_string();
+  const uint64_t cand_seq =
+      static_cast<uint64_t>(req.get("lastSeq").as_int());
+  bool granted = false;
+  if (t >= term_) {
+    // Lease protection: a replica that still hears from its leader (or
+    // IS a leader that recently reached quorum) refuses to depose it —
+    // a partitioned-then-healed replica with a bumped term cannot
+    // disrupt a live majority.
+    const double now = NowMs();
+    const bool lease_fresh =
+        role_ == Role::kLeader
+            ? now - last_quorum_ok_ms_ < opts_.lease_ms
+            : !leader_.empty() &&
+                  now - last_contact_ms_ < opts_.lease_ms;
+    if (!(lease_fresh && cand != leader_)) {
+      if (t > term_) {
+        const bool was_leader = role_ == Role::kLeader;
+        term_ = t;
+        voted_for_.clear();
+        PersistState();
+        if (was_leader) StepDown("vote request from newer term", t);
+      }
+      // The election restriction: never elect a shorter log than our
+      // own — this is what makes acked (quorum-durable) batches survive
+      // failover, since any majority intersects the batch's quorum. An
+      // EQUAL-length log whose tip record differs from ours (divergence
+      // a dead leader left behind) is refused too: without per-entry
+      // terms we cannot tell whose tip is the committed one, and
+      // refusing is the safe direction (a live leader reseeds the
+      // diverged replica on first contact; a wrong grant could elect
+      // the stranded record over the acked one).
+      const uint64_t cand_crc =
+          static_cast<uint64_t>(req.get("lastCrc").as_int());
+      const bool up_to_date =
+          cand_seq > store_->WalSeq() ||
+          (cand_seq == store_->WalSeq() &&
+           (store_->WalSeq() == 0 || cand_crc == store_->WalTipCrc()));
+      if (up_to_date && (voted_for_.empty() || voted_for_ == cand)) {
+        voted_for_ = cand;
+        PersistState();
+        granted = true;
+        // Granting resets our own fuse: give the candidate a chance to
+        // win before we campaign against it.
+        ResetElectionDeadline(false);
+      }
+    }
+  }
+  resp["granted"] = granted;
+  resp["term"] = term_;
+  return resp;
+}
+
+void Replication::RunElection() {
+  ++elections_;
+  term_ += 1;
+  voted_for_ = opts_.self;
+  PersistState();
+  Json req = Json::Object();
+  req["op"] = "repl.vote";
+  req["term"] = term_;
+  req["candidate"] = opts_.self;
+  req["lastSeq"] = static_cast<int64_t>(store_->WalSeq());
+  req["lastCrc"] = static_cast<int64_t>(store_->WalTipCrc());
+  int votes = 1;  // our own
+  for (auto& p : peers_) {
+    Json resp;
+    if (!PeerRequest(p, req, &resp, kVoteTimeoutMs)) continue;
+    const int64_t peer_term = resp.get("term").as_int();
+    if (peer_term > term_) {
+      StepDown("outvoted by newer term", peer_term);
+      return;
+    }
+    if (resp.get("granted").as_bool()) ++votes;
+  }
+  if (votes >= quorum()) {
+    BecomeLeader();
+    SendHeartbeats();  // announce immediately; fences older leaders
+  } else {
+    // Lost. During bootstrap (no leader ever heard — peers likely just
+    // not up yet) retry on the short fuse so the fresh cluster forms as
+    // soon as a quorum answers; once a leader has existed, back off a
+    // full jittered lease so a live majority isn't churned.
+    ResetElectionDeadline(/*short_fuse=*/leader_.empty());
+  }
+}
+
+void Replication::SendHeartbeats() {
+  last_heartbeat_ms_ = NowMs();
+  ++heartbeats_sent_;
+  const int hb_timeout = std::max(50, std::min(opts_.lease_ms / 3, 250));
+  int responses = 0;
+  for (auto& p : peers_) {
+    if (role_ != Role::kLeader) break;
+    Json req = Json::Object();
+    req["op"] = "repl.append";
+    req["term"] = term_;
+    req["leader"] = opts_.self;
+    req["prevSeq"] = static_cast<int64_t>(store_->WalSeq());
+    req["prevCrc"] = static_cast<int64_t>(store_->WalTipCrc());
+    req["commitSeq"] = static_cast<int64_t>(commit_seq_);
+    req["data"] = "";
+    Json resp;
+    if (!PeerRequest(p, req, &resp, hb_timeout)) continue;
+    if (resp.get("staleTerm").as_bool()) {
+      StepDown("stale term reported by " + p.sock,
+               resp.get("term").as_int());
+      break;
+    }
+    ++responses;
+    if (resp.get("ok").as_bool()) {
+      p.acked_seq = static_cast<uint64_t>(resp.get("seq").as_int());
+    } else if (resp.get("needSnapshot").as_bool()) {
+      // Heartbeats double as the catch-up probe: a follower that
+      // rejoined behind (or diverged) reseeds without waiting for the
+      // next mutation.
+      ShipSnapshotTo(p, kSnapshotTimeoutMs);
+    }
+  }
+  if (role_ == Role::kLeader && responses + 1 >= quorum()) {
+    last_quorum_ok_ms_ = NowMs();
+  }
+}
+
+void Replication::Tick() {
+  if (!enabled()) return;
+  const double now = NowMs();
+  if (role_ == Role::kLeader) {
+    if (now - last_heartbeat_ms_ >= opts_.lease_ms / 3.0) {
+      SendHeartbeats();
+    }
+    // The leader's own lease: a partitioned leader that has not heard a
+    // majority for a whole lease steps down rather than keep serving
+    // reads/watches from arbitrarily stale state while the majority
+    // side elects — "cannot reach a majority must not serve" applies to
+    // the read path too, not just mutations.
+    if (role_ == Role::kLeader &&
+        NowMs() - last_quorum_ok_ms_ >= opts_.lease_ms) {
+      StepDown("leader lease expired: no majority contact for a full "
+               "lease", term_);
+    }
+  } else if (now >= election_deadline_ms_) {
+    // The leader lease expired with no append/heartbeat: campaign.
+    RunElection();
+  }
+}
+
+Json Replication::StateJson() const {
+  Json out = Json::Object();
+  out["role"] = role_ == Role::kLeader ? "leader" : "follower";
+  out["term"] = term_;
+  out["leader"] = leader_;
+  out["self"] = opts_.self;
+  out["quorum"] = quorum();
+  out["replicas"] = static_cast<int64_t>(peers_.size() + 1);
+  out["leaseMs"] = opts_.lease_ms;
+  const uint64_t seq = store_->WalSeq();
+  out["seq"] = static_cast<int64_t>(seq);
+  out["appliedSeq"] = static_cast<int64_t>(store_->AppliedSeq());
+  out["commitSeq"] = static_cast<int64_t>(commit_seq_);
+  // Follower-side lag: records durable here but not yet committed by
+  // the leader's word (bounded by one heartbeat interval).
+  out["lagRecords"] =
+      role_ == Role::kLeader
+          ? static_cast<int64_t>(0)
+          : static_cast<int64_t>(store_->UnappliedRecords());
+  Json followers = Json::Array();
+  int64_t max_lag = 0;
+  for (const auto& p : peers_) {
+    Json f = Json::Object();
+    f["sock"] = p.sock;
+    f["ackedSeq"] = static_cast<int64_t>(p.acked_seq);
+    const int64_t lag = role_ == Role::kLeader && seq >= p.acked_seq
+                            ? static_cast<int64_t>(seq - p.acked_seq)
+                            : 0;
+    f["lagRecords"] = lag;
+    f["reachable"] = p.reachable;
+    followers.push_back(f);
+    if (lag > max_lag) max_lag = lag;
+  }
+  out["followers"] = followers;
+  if (role_ == Role::kLeader) out["lagRecords"] = max_lag;
+  out["shippedBatches"] = shipped_batches_;
+  out["quorumCommits"] = quorum_commits_;
+  out["quorumFailures"] = quorum_failures_;
+  out["snapshotsShipped"] = snapshots_shipped_;
+  out["elections"] = elections_;
+  out["staleRejections"] = stale_rejections_;
+  out["heartbeatsSent"] = heartbeats_sent_;
+  return out;
+}
+
+}  // namespace tpk

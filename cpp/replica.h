@@ -1,0 +1,157 @@
+// Replicated control plane (ISSUE 11) — WAL shipping, quorum acks,
+// lease-based failover, follower-served reads/watches.
+//
+// The design is the Raft/etcd lineage scaled to this repo's shapes: the
+// framed, group-committed WAL (store.h) IS the replication log, so the
+// leader ships each open batch's exact framed bytes to its followers
+// over the existing newline-JSON socket protocol (`repl.append` /
+// `repl.snapshot` verbs served by cpp/server.cc) and the group-commit
+// reply staging becomes the quorum gate: staged replies release only
+// once a majority of the replica set — leader included — has the batch
+// durable.
+//
+//   * Ship-then-commit: CommitQuorum ships the open batch to followers
+//     FIRST (each lands it durably per its own --fsync policy and acks),
+//     then runs the local covering fsync. A batch the quorum rejects is
+//     aborted before any local byte lands (Store::AbortBatch — the
+//     whole-batch rollback contract of ISSUE 8, so nothing was promised
+//     and nothing dirty leaks), and the leader steps down: a leader that
+//     cannot reach a majority must not serve.
+//   * Commit index: followers append-and-fsync immediately but APPLY
+//     only up to the leader's shipped commitSeq (piggybacked on every
+//     append/heartbeat), so a follower never serves state the quorum
+//     may abort. Follower lag is therefore bounded by one heartbeat.
+//   * Leases + elections: followers track leader contact; when the
+//     lease (--lease-ms) expires they campaign with term+1, voting
+//     gated by term AND log seq (a candidate must be at least as long
+//     as the voter's log) AND lease freshness (a replica that still
+//     hears its leader refuses to depose it). Majority wins; terms and
+//     votes persist across restarts (<wal>.replstate). A deposed or
+//     stale leader's appends are rejected by term — the fencing the
+//     kill-9 failover harness proves.
+//   * Catch-up: a follower whose log diverges (behind after a crash,
+//     or ahead with records a quorum-failed leader rolled back)
+//     answers needSnapshot; the leader ships its snapshot + WAL tail
+//     verbatim (the compaction machinery's files) and the follower
+//     reloads from them (Store::InstallReplica) — leader-authoritative,
+//     exactly a restart replay.
+//
+// Threading: every member runs on the owning event-loop thread (the
+// same single thread that runs Server::PollOnce and the controllers);
+// the Store keeps its own lock. Peer RPCs are synchronous with bounded
+// timeouts — while the leader waits for quorum the event loop stalls,
+// which is the honest behavior: no progress is safe without a majority.
+
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "json.h"
+#include "store.h"
+
+namespace tpk {
+
+class Replication {
+ public:
+  enum class Role { kLeader, kFollower };
+
+  struct Options {
+    std::string self;                // our server socket path (identity)
+    std::vector<std::string> peers;  // the other replicas' socket paths
+    std::string state_path;          // term/vote persistence ("" = none)
+    std::string leader_hint;         // --replica-of: where the leader is
+    int lease_ms = 1500;             // leader lease / election timeout
+    int quorum_timeout_ms = 5000;    // max stall waiting for quorum
+  };
+
+  Replication(Store* store, Options opts);
+
+  bool enabled() const { return !opts_.peers.empty(); }
+  bool IsLeader() const { return role_ == Role::kLeader; }
+  Role role() const { return role_; }
+  int64_t term() const { return term_; }
+  const std::string& leader() const { return leader_; }
+  // Majority of the replica set (peers + self): ⌈(N+1)/2⌉ of N+1... for
+  // N total replicas, floor(N/2)+1.
+  int quorum() const {
+    return static_cast<int>(opts_.peers.size() + 1) / 2 + 1;
+  }
+
+  // The leader's commit path: ship the store's open batch to followers,
+  // wait (bounded by quorum_timeout_ms) for majority durability, then
+  // land the local covering fsync. True = the batch is quorum-durable
+  // and staged replies may release. False = the batch was rolled back
+  // whole (quorum unreachable → AbortBatch + step-down, or local commit
+  // failure → CommitGroup's own rollback) and staged batch-dependent
+  // replies must become errors. With no batch open this is a plain
+  // (no-op) CommitGroup.
+  bool CommitQuorum(std::string* error);
+
+  // Follower-side verb handlers (dispatched by cpp/server.cc).
+  Json HandleAppend(const Json& req);
+  Json HandleSnapshot(const Json& req);
+  Json HandleVote(const Json& req);
+
+  // Heartbeats (leader), lease expiry + elections (follower). Call once
+  // per event-loop pass.
+  void Tick();
+
+  // True exactly once after each transition INTO leadership — the main
+  // loop's cue to run controller Recover() against the applied state.
+  bool TookLeadership();
+
+  // stateinfo's replication{} object.
+  Json StateJson() const;
+
+ private:
+  struct Peer {
+    std::string sock;
+    int fd = -1;
+    uint64_t acked_seq = 0;
+    bool reachable = false;
+  };
+
+  double NowMs() const;
+  void PersistState();
+  void LoadState();
+  void BecomeLeader();
+  void StepDown(const std::string& reason, int64_t new_term);
+  void ResetElectionDeadline(bool short_fuse);
+  void RunElection();
+  void SendHeartbeats();
+  // One synchronous request/reply line to a peer (connect cached on the
+  // Peer, reconnected on failure). False on transport failure/timeout.
+  bool PeerRequest(Peer& p, const Json& req, Json* resp, int timeout_ms);
+  // Ship `batch` to every follower not yet known to hold it, handling
+  // needSnapshot catch-up inline. Returns follower acks at/above
+  // batch.last_seq observed THIS call.
+  int ShipRound(const Store::BatchBytes& batch, int timeout_ms);
+  bool ShipSnapshotTo(Peer& p, int timeout_ms);
+
+  Store* store_;
+  Options opts_;
+  Role role_ = Role::kFollower;
+  int64_t term_ = 0;
+  std::string voted_for_;
+  std::string leader_;           // last known leader ("" = unknown)
+  std::vector<Peer> peers_;
+  uint64_t commit_seq_ = 0;      // highest quorum-durable seq
+  double last_contact_ms_ = 0;   // follower: last valid leader append
+  double last_quorum_ok_ms_ = 0; // leader: last round that saw majority
+  double last_heartbeat_ms_ = 0;
+  double election_deadline_ms_ = 0;
+  bool leadership_gained_ = false;
+  unsigned rng_state_;           // jitter for election deadlines
+  // Counters for stateinfo.replication.
+  int64_t shipped_batches_ = 0;
+  int64_t quorum_commits_ = 0;
+  int64_t quorum_failures_ = 0;
+  int64_t snapshots_shipped_ = 0;
+  int64_t elections_ = 0;
+  int64_t stale_rejections_ = 0;  // appends we rejected for stale term
+  int64_t heartbeats_sent_ = 0;
+};
+
+}  // namespace tpk

@@ -33,13 +33,14 @@ double SteadyMicros() {
 Server::Server(Store* store, Scheduler* scheduler, JaxJobController* jaxjob,
                std::string socket_path, std::string workdir,
                ExperimentController* tune, PipelineRunController* pipelines,
-               ServeController* serve)
+               ServeController* serve, Replication* repl)
     : store_(store),
       scheduler_(scheduler),
       jaxjob_(jaxjob),
       tune_(tune),
       pipelines_(pipelines),
       serve_(serve),
+      repl_(repl),
       socket_path_(std::move(socket_path)),
       workdir_(std::move(workdir)) {}
 
@@ -87,6 +88,34 @@ Json Server::Dispatch(const Json& req) {
   const std::string op = req.get("op").as_string();
   const std::string kind = req.get("kind").as_string();
   const std::string name = req.get("name").as_string();
+
+  // Replication verbs (ISSUE 11): served on any replica, any role —
+  // the handlers do their own term fencing. A single-node server
+  // (--peers unset) does not expose them at all.
+  if (repl_ && repl_->enabled() && op.compare(0, 5, "repl.") == 0) {
+    if (op == "repl.append") return repl_->HandleAppend(req);
+    if (op == "repl.snapshot") return repl_->HandleSnapshot(req);
+    if (op == "repl.vote") return repl_->HandleVote(req);
+    resp["ok"] = false;
+    resp["error"] = "unknown op: " + op;
+    return resp;
+  }
+  // Followers serve reads and watches at their applied seq; mutations
+  // redirect to the leader (the Python client follows `redirect` and
+  // retries under its deadline budget).
+  if (repl_ && repl_->enabled() && !repl_->IsLeader() &&
+      (op == "create" || op == "update_spec" || op == "update_status" ||
+       op == "delete" || op == "event")) {
+    resp["ok"] = false;
+    resp["notLeader"] = true;
+    resp["redirect"] = repl_->leader();
+    resp["error"] = "not leader (role=follower, term " +
+                    std::to_string(repl_->term()) + "); leader: " +
+                    (repl_->leader().empty() ? "<unknown — election "
+                                               "pending>"
+                                             : repl_->leader());
+    return resp;
+  }
 
   auto fill = [&](const Store::Result& r) {
     resp["ok"] = r.ok;
@@ -162,8 +191,24 @@ Json Server::Dispatch(const Json& req) {
   } else if (op == "stateinfo") {
     // Durability health: WAL replay stats, compaction counters, fsync
     // mode — the operator's view of whether state survives a crash.
+    // Under replication the payload grows replication{role, term, seq,
+    // quorum, followers[], lagRecords, ...}.
     resp["ok"] = true;
-    resp["stateinfo"] = store_->StateInfo();
+    Json info = store_->StateInfo();
+    if (repl_ && repl_->enabled()) info["replication"] = repl_->StateJson();
+    resp["stateinfo"] = info;
+  } else if (op == "watch.poll") {
+    // Poll-based informer (ISSUE 11): committed, coalesced events with
+    // resourceVersion > `since`, served from the store's delivery ring
+    // — on followers too, at their applied seq, which is how watcher
+    // fan-out scales horizontally. resync=true means the cursor
+    // predates the ring: re-list, then resume from the returned
+    // resourceVersion.
+    resp["ok"] = true;
+    Json w = store_->WatchSince(req.get("since").as_int(0), kind);
+    resp["events"] = w.get("events");
+    resp["resourceVersion"] = w.get("resourceVersion");
+    resp["resync"] = w.get("resync");
   } else if (op == "events") {
     // Per-job structured event history (events.h): ordered events +
     // conditions from the resource status — `tpukit events <job>`.
@@ -339,9 +384,24 @@ void Server::HandleLine(Client& c, const std::string& line) {
 
 void Server::CommitAndRelease() {
   std::string err;
-  // ack-after-durable: commit — the single covering fsync for every
-  // mutation this pass applied.
-  const bool ok = store_->CommitGroup(&err);
+  bool ok;
+  if (repl_ && repl_->enabled()) {
+    // ack-after-quorum: quorum-wait — ship the batch's framed bytes to
+    // the followers and hold every staged reply until a majority of
+    // the replica set (our own covering fsync included) has it durable.
+    // Quorum failure rolls the whole batch back (nothing was promised)
+    // and the release below turns the staged replies into errors.
+    // Routed through CommitQuorum for EVERY role: a leader deposed
+    // mid-pass (a newer-term vote/append dispatched after this pass's
+    // mutations) must have its open batch ABORTED with error replies —
+    // the plain CommitGroup would land it on this minority replica
+    // alone and ack writes the new leader's history will erase.
+    ok = repl_->CommitQuorum(&err);
+  } else {
+    // ack-after-durable: commit — the single covering fsync for every
+    // mutation this pass applied (single-node mode).
+    ok = store_->CommitGroup(&err);
+  }
   std::string failure;
   if (!ok) {
     Json e = Json::Object();

@@ -18,6 +18,7 @@
 #include "jaxjob.h"
 #include "json.h"
 #include "pipelines.h"
+#include "replica.h"
 #include "scheduler.h"
 #include "serve.h"
 #include "store.h"
@@ -27,11 +28,18 @@ namespace tpk {
 
 class Server {
  public:
+  // `repl` (ISSUE 11) turns the group-commit release gate into the
+  // quorum gate: non-null + enabled means mutations redirect to the
+  // leader on followers, repl.* verbs are served, and a leader's pass
+  // commit ships the batch and waits for majority durability before any
+  // staged reply releases (ack-after-quorum). Null/disabled is the
+  // single-node ISSUE 8 path, byte-for-byte.
   Server(Store* store, Scheduler* scheduler, JaxJobController* jaxjob,
          std::string socket_path, std::string workdir,
          ExperimentController* tune = nullptr,
          PipelineRunController* pipelines = nullptr,
-         ServeController* serve = nullptr);
+         ServeController* serve = nullptr,
+         Replication* repl = nullptr);
   ~Server();
 
   bool Start(std::string* error);
@@ -100,6 +108,7 @@ class Server {
   ExperimentController* tune_;
   PipelineRunController* pipelines_;
   ServeController* serve_;
+  Replication* repl_;
   std::string socket_path_;
   std::string workdir_;
   int listen_fd_ = -1;

@@ -61,11 +61,16 @@ uint32_t Crc32(const char* p, size_t n) {
   return c ^ 0xFFFFFFFFu;
 }
 
-// One framed WAL line: `v1 <seq> <crc32hex> <payload>\n`.
-std::string FrameRecord(uint64_t seq, const std::string& payload) {
+// One framed WAL line: `v1 <seq> <crc32hex> <payload>\n`. `crc_out`
+// (optional) receives the record's CRC — the replication layer uses the
+// log TIP's crc as its entry-identity check (the per-entry-term stand-in:
+// two logs that agree on (seq, crc) agree on the record).
+std::string FrameRecord(uint64_t seq, const std::string& payload,
+                        uint32_t* crc_out = nullptr) {
+  uint32_t crc = Crc32(payload.data(), payload.size());
+  if (crc_out) *crc_out = crc;
   char head[64];
-  snprintf(head, sizeof(head), "v1 %" PRIu64 " %08x ", seq,
-           Crc32(payload.data(), payload.size()));
+  snprintf(head, sizeof(head), "v1 %" PRIu64 " %08x ", seq, crc);
   std::string line = head;
   line += payload;
   line += '\n';
@@ -75,7 +80,7 @@ std::string FrameRecord(uint64_t seq, const std::string& payload) {
 // Splits a framed line (newline already stripped) into seq + payload,
 // verifying the CRC. Returns false with *error on any mismatch.
 bool ParseFrame(const std::string& line, uint64_t* seq, std::string* payload,
-                std::string* error) {
+                std::string* error, uint32_t* crc_out = nullptr) {
   size_t sp1 = line.find(' ', 3);
   size_t sp2 = sp1 == std::string::npos ? std::string::npos
                                         : line.find(' ', sp1 + 1);
@@ -106,6 +111,7 @@ bool ParseFrame(const std::string& line, uint64_t* seq, std::string* payload,
     return false;
   }
   *seq = s;
+  if (crc_out) *crc_out = got;
   return true;
 }
 
@@ -123,6 +129,8 @@ void FsyncDirOf(const std::string& path) {
 }
 
 }  // namespace
+
+void MaybeCrashAtPoint(const char* point) { MaybeCrashAt(point); }
 
 Store::Store(std::string wal_path) : wal_path_(std::move(wal_path)) {}
 
@@ -189,18 +197,21 @@ bool Store::WalAppendLocked(const Resource& r, std::string* error) {
     // CommitGroup; a mutation is only acknowledged after it.
     if (batch_records_ == 0) {
       batch_seq_start_ = wal_seq_;
+      batch_crc_start_ = last_crc_;
       batch_version_start_ = next_version_;
       batch_watch_start_ = pending_.size();
     }
     uint64_t seq = wal_seq_ + 1;
-    batch_buf_ += FrameRecord(seq, ToJson(r).dump());
+    batch_buf_ += FrameRecord(seq, ToJson(r).dump(), &last_crc_);
     wal_seq_ = seq;
+    applied_seq_ = seq;  // local writes apply immediately
     ++batch_records_;
     return true;
   }
 
   uint64_t seq = wal_seq_ + 1;
-  std::string line = FrameRecord(seq, ToJson(r).dump());
+  uint32_t crc = 0;
+  std::string line = FrameRecord(seq, ToJson(r).dump(), &crc);
   long off = ftell(wal_);
   size_t wrote = fwrite(line.data(), 1, line.size(), wal_);
   bool ok = wrote == line.size() && fflush(wal_) == 0;
@@ -242,6 +253,8 @@ bool Store::WalAppendLocked(const Resource& r, std::string* error) {
     return false;
   }
   wal_seq_ = seq;
+  last_crc_ = crc;
+  applied_seq_ = seq;
   ++wal_records_;
   return true;
 }
@@ -260,6 +273,39 @@ void Store::ClearBatchLocked() {
   batch_buf_.clear();
   batch_records_ = 0;
   batch_undo_.clear();
+}
+
+void Store::RollbackBatchLocked() {
+  // Roll the whole batch out of memory, newest first: pre-images
+  // restore data_, the version/seq clocks rewind, and the batch's
+  // queued watch events are dropped — the per-record path's
+  // reject-on-failure contract at batch granularity. Replies for
+  // these mutations were held pending this commit, so nothing was
+  // ever acknowledged.
+  for (auto it = batch_undo_.rbegin(); it != batch_undo_.rend(); ++it) {
+    if (it->second) {
+      data_[it->first] = *it->second;
+    } else {
+      data_.erase(it->first);
+    }
+  }
+  next_version_ = batch_version_start_;
+  wal_seq_ = batch_seq_start_;
+  last_crc_ = batch_crc_start_;
+  applied_seq_ = batch_seq_start_;
+  if (pending_.size() > batch_watch_start_) {
+    pending_.resize(batch_watch_start_);
+  }
+  ClearBatchLocked();
+}
+
+void Store::AbortBatch() {
+  // The quorum said no before the local covering fsync ran: the batch
+  // bytes were never written here, so the rollback is memory-only —
+  // exactly CommitGroup's failure path minus the file truncate.
+  std::lock_guard<std::mutex> lock(mu_);
+  if (batch_records_ == 0) return;
+  RollbackBatchLocked();
 }
 
 bool Store::CommitGroup(std::string* error) {
@@ -325,25 +371,7 @@ bool Store::CommitGroupLocked(std::string* error) {
         wal_ = nullptr;
       }
     }
-    // Roll the whole batch out of memory, newest first: pre-images
-    // restore data_, the version/seq clocks rewind, and the batch's
-    // queued watch events are dropped — the per-record path's
-    // reject-on-failure contract at batch granularity. Replies for
-    // these mutations were held pending this commit, so nothing was
-    // ever acknowledged.
-    for (auto it = batch_undo_.rbegin(); it != batch_undo_.rend(); ++it) {
-      if (it->second) {
-        data_[it->first] = *it->second;
-      } else {
-        data_.erase(it->first);
-      }
-    }
-    next_version_ = batch_version_start_;
-    wal_seq_ = batch_seq_start_;
-    if (pending_.size() > batch_watch_start_) {
-      pending_.resize(batch_watch_start_);
-    }
-    ClearBatchLocked();
+    RollbackBatchLocked();
     if (error) {
       *error = wal_broken_ ? "WAL broken: " + wal_error_ : reason;
     }
@@ -361,6 +389,272 @@ bool Store::CommitGroupLocked(std::string* error) {
   return true;
 }
 
+bool Store::PendingBatchBytes(BatchBytes* out) const {
+  std::lock_guard<std::mutex> lock(mu_);
+  if (batch_records_ == 0) return false;
+  out->bytes = batch_buf_;
+  out->prev_seq = batch_seq_start_;
+  out->last_seq = wal_seq_;
+  out->prev_crc = batch_crc_start_;
+  out->records = batch_records_;
+  return true;
+}
+
+uint32_t Store::WalTipCrc() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return last_crc_;
+}
+
+uint64_t Store::WalSeq() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return wal_seq_;
+}
+
+uint64_t Store::AppliedSeq() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return applied_seq_;
+}
+
+int Store::UnappliedRecords() const {
+  std::lock_guard<std::mutex> lock(mu_);
+  return static_cast<int>(repl_unapplied_.size());
+}
+
+bool Store::AppendReplicatedLog(const std::string& bytes,
+                                std::string* error) {
+  std::lock_guard<std::mutex> lock(mu_);
+  if (batch_records_ > 0) {
+    // A follower never opens local batches (mutations are redirected to
+    // the leader); refusing here keeps the two write paths from ever
+    // interleaving in one WAL.
+    if (error) *error = "local group-commit batch open";
+    return false;
+  }
+  // Phase 1 — verify every shipped line BEFORE anything touches the
+  // disk: framed, CRC-good, sequence contiguous from our WAL tip. Any
+  // failure rejects the whole batch with nothing written (the shipped
+  // bytes are the leader's exact framed bytes, so a mismatch means
+  // corruption in flight or a diverged log — resync, don't guess).
+  std::vector<std::pair<uint64_t, Resource>> parsed;
+  uint64_t seq = wal_seq_;
+  uint32_t tip_crc = last_crc_;
+  size_t pos = 0;
+  while (pos < bytes.size()) {
+    size_t nl = bytes.find('\n', pos);
+    if (nl == std::string::npos) {
+      if (error) *error = "shipped batch ends mid-record";
+      return false;
+    }
+    std::string line = bytes.substr(pos, nl - pos);
+    pos = nl + 1;
+    if (line.empty()) continue;
+    if (line.compare(0, 3, "v1 ") != 0) {
+      if (error) *error = "unframed record in shipped batch";
+      return false;
+    }
+    uint64_t got_seq = 0;
+    uint32_t got_crc = 0;
+    std::string payload, perr;
+    if (!ParseFrame(line, &got_seq, &payload, &perr, &got_crc)) {
+      if (error) *error = "shipped batch: " + perr;
+      return false;
+    }
+    if (got_seq != seq + 1) {
+      char buf[96];
+      snprintf(buf, sizeof(buf),
+               "shipped batch seq %" PRIu64 " does not follow %" PRIu64,
+               got_seq, seq);
+      if (error) *error = buf;
+      return false;
+    }
+    seq = got_seq;
+    tip_crc = got_crc;
+    Resource r;
+    try {
+      r = FromJson(Json::parse(payload));
+    } catch (const std::exception& e) {
+      if (error) *error = std::string("shipped batch record JSON: ") +
+                          e.what();
+      return false;
+    }
+    parsed.emplace_back(got_seq, std::move(r));
+  }
+  if (parsed.empty()) return true;  // pure heartbeat payload
+  // Phase 2 — land the bytes durably, the per-record append's checked-IO
+  // discipline at batch granularity: a short write or failed covering
+  // fsync rolls the file back to the pre-batch offset and rejects.
+  if (!wal_path_.empty()) {
+    if (!EnsureWalLocked(error)) return false;
+    long off = ftell(wal_);
+    size_t wrote = fwrite(bytes.data(), 1, bytes.size(), wal_);
+    bool ok = wrote == bytes.size() && fflush(wal_) == 0;
+    int saved_errno = errno;
+    if (ok && fsync_policy_ != FsyncPolicy::kNever) {
+      const int pending_unsynced =
+          unsynced_records_ + static_cast<int>(parsed.size());
+      if (fsync_policy_ == FsyncPolicy::kAlways ||
+          pending_unsynced >= fsync_interval_) {
+        if (fsync(fileno(wal_)) != 0) {
+          saved_errno = errno;
+          ok = false;
+        } else {
+          unsynced_records_ = 0;
+        }
+      } else {
+        unsynced_records_ = pending_unsynced;
+      }
+    }
+    if (!ok) {
+      std::string reason = std::string("replicated append failed: ") +
+                           strerror(saved_errno);
+      clearerr(wal_);
+      if (off < 0 || ftruncate(fileno(wal_), off) != 0) {
+        wal_broken_ = true;
+        wal_error_ = reason + "; rollback truncate failed: " +
+                     strerror(errno);
+        fclose(wal_);
+        wal_ = nullptr;
+        if (error) *error = "WAL broken: " + wal_error_;
+        return false;
+      }
+      if (error) *error = reason;
+      return false;
+    }
+    wal_records_ += static_cast<int>(parsed.size());
+  }
+  wal_seq_ = seq;
+  last_crc_ = tip_crc;
+  for (auto& p : parsed) repl_unapplied_.push_back(std::move(p));
+  return true;
+}
+
+int Store::ApplyReplicatedUpTo(uint64_t commit_seq) {
+  std::lock_guard<std::mutex> lock(mu_);
+  int applied = 0;
+  size_t i = 0;
+  for (; i < repl_unapplied_.size() && repl_unapplied_[i].first <= commit_seq;
+       ++i) {
+    const Resource& r = repl_unapplied_[i].second;
+    auto key = std::make_pair(r.kind, r.name);
+    WatchEvent::Type type;
+    if (r.deleted) {
+      type = WatchEvent::Type::kDeleted;
+      data_.erase(key);
+    } else {
+      type = data_.count(key) ? WatchEvent::Type::kModified
+                              : WatchEvent::Type::kAdded;
+      data_[key] = r;
+    }
+    if (r.resource_version >= next_version_) {
+      next_version_ = r.resource_version + 1;
+    }
+    applied_seq_ = repl_unapplied_[i].first;
+    // Events queue only for COMMITTED records — the follower's watch
+    // fan-out can never leak a batch the quorum later aborts.
+    Append({type, r});
+    ++applied;
+  }
+  if (i > 0) {
+    repl_unapplied_.erase(repl_unapplied_.begin(),
+                          repl_unapplied_.begin() + i);
+  }
+  return applied;
+}
+
+bool Store::ReadReplicaFiles(std::string* snapshot_bytes,
+                             std::string* wal_bytes) const {
+  std::lock_guard<std::mutex> lock(mu_);
+  if (wal_path_.empty()) return false;
+  auto slurp = [](const std::string& path, std::string* out) {
+    out->clear();
+    FILE* f = fopen(path.c_str(), "r");
+    if (!f) return;  // absent file ships as empty (e.g. no snapshot yet)
+    char buf[65536];
+    size_t got;
+    while ((got = fread(buf, 1, sizeof(buf), f)) > 0) out->append(buf, got);
+    fclose(f);
+  };
+  slurp(snapshot_path(), snapshot_bytes);
+  slurp(wal_path_, wal_bytes);
+  return true;
+}
+
+bool Store::InstallReplica(const std::string& snapshot_bytes,
+                           const std::string& wal_bytes,
+                           std::string* error) {
+  std::lock_guard<std::mutex> lock(mu_);
+  if (wal_path_.empty()) {
+    if (error) *error = "in-memory store cannot install a replica image";
+    return false;
+  }
+  if (wal_) {
+    fclose(wal_);
+    wal_ = nullptr;
+  }
+  // Leader-authoritative resync: our own WAL (which may have diverged —
+  // e.g. records a rolled-back leader shipped us that never reached
+  // quorum) is REPLACED by the leader's files, then replayed exactly
+  // like a restart. Snapshot first via temp+rename so a crash between
+  // the two writes still loads something coherent.
+  auto write_file = [&](const std::string& path, const std::string& data,
+                        std::string* werr) {
+    std::string tmp = path + ".install";
+    FILE* f = fopen(tmp.c_str(), "w");
+    if (!f) {
+      *werr = "cannot open " + tmp + ": " + strerror(errno);
+      return false;
+    }
+    bool ok = data.empty() ||
+              fwrite(data.data(), 1, data.size(), f) == data.size();
+    ok = ok && fflush(f) == 0 && fsync(fileno(f)) == 0;
+    int saved_errno = errno;
+    if (fclose(f) != 0) ok = false;
+    if (!ok) {
+      remove(tmp.c_str());
+      *werr = "short write installing " + path + ": " +
+              strerror(saved_errno);
+      return false;
+    }
+    if (rename(tmp.c_str(), path.c_str()) != 0) {
+      *werr = "rename installing " + path + ": " + strerror(errno);
+      remove(tmp.c_str());
+      return false;
+    }
+    return true;
+  };
+  std::string werr;
+  if (snapshot_bytes.empty()) {
+    remove(snapshot_path().c_str());
+  } else if (!write_file(snapshot_path(), snapshot_bytes, &werr)) {
+    if (error) *error = werr;
+    return false;
+  }
+  if (!write_file(wal_path_, wal_bytes, &werr)) {
+    if (error) *error = werr;
+    return false;
+  }
+  FsyncDirOf(wal_path_);
+  data_.clear();
+  repl_unapplied_.clear();
+  pending_.clear();
+  recent_events_.clear();
+  ring_floor_rv_ = 0;
+  next_version_ = 1;
+  wal_broken_ = false;
+  wal_error_.clear();
+  unsynced_records_ = 0;
+  LoadLocked();
+  // Watchers resync from current state, not an event replay: poll
+  // watchers see resync=true (ring cleared) and re-list.
+  ring_floor_rv_ = next_version_ - 1;
+  if (!load_stats_.clean) {
+    if (error) *error = "installed replica image replayed dirty: " +
+                        load_stats_.error;
+    return false;
+  }
+  return true;
+}
+
 bool Store::ApplyLineLocked(const std::string& raw, bool require_framed,
                             bool* is_meta, std::string* error) {
   std::string line = raw;
@@ -372,7 +666,8 @@ bool Store::ApplyLineLocked(const std::string& raw, bool require_framed,
   bool framed = line.compare(0, 3, "v1 ") == 0;
   if (framed) {
     uint64_t seq = 0;
-    if (!ParseFrame(line, &seq, &payload, error)) return false;
+    uint32_t crc = 0;
+    if (!ParseFrame(line, &seq, &payload, error, &crc)) return false;
     if (seq <= wal_seq_) {
       char buf[96];
       snprintf(buf, sizeof(buf),
@@ -382,11 +677,13 @@ bool Store::ApplyLineLocked(const std::string& raw, bool require_framed,
       return false;
     }
     wal_seq_ = seq;
+    last_crc_ = crc;
   } else if (require_framed) {
     *error = "unframed record in snapshot";
     return false;
   } else {
     payload = line;  // legacy plain-JSONL record (pre-framing WAL)
+    last_crc_ = Crc32(payload.data(), payload.size());
   }
   Json rec;
   try {
@@ -402,14 +699,7 @@ bool Store::ApplyLineLocked(const std::string& raw, bool require_framed,
     *is_meta = true;
     return true;
   }
-  Resource r;
-  r.kind = rec.get("kind").as_string();
-  r.name = rec.get("name").as_string();
-  r.spec = rec.get("spec");
-  r.status = rec.get("status");
-  r.resource_version = rec.get("resourceVersion").as_int();
-  r.generation = rec.get("generation").as_int();
-  r.deleted = rec.get("deleted").as_bool();
+  Resource r = FromJson(rec);
   auto key = std::make_pair(r.kind, r.name);
   if (r.deleted) {
     data_.erase(key);
@@ -425,8 +715,13 @@ bool Store::ApplyLineLocked(const std::string& raw, bool require_framed,
 int Store::Load() {
   if (wal_path_.empty()) return 0;
   std::lock_guard<std::mutex> lock(mu_);
+  return LoadLocked();
+}
+
+int Store::LoadLocked() {
   load_stats_ = LoadStats{};
   wal_seq_ = 0;
+  last_crc_ = 0;
   wal_records_ = 0;
 
   // A leftover temp snapshot means a crash mid-compaction before the
@@ -515,6 +810,12 @@ int Store::Load() {
     }
   }
   wal_records_ = load_stats_.tail_records;
+  // A restart replays (and applies) the full local log: commit-index
+  // recovery is the new leader's job — any record here that never
+  // reached quorum is either re-committed or truncated by the resync
+  // the next leader's first append triggers.
+  applied_seq_ = wal_seq_;
+  repl_unapplied_.clear();
 
   // A tail already past the threshold (e.g. compaction was disabled last
   // run) compacts at startup so the NEXT replay is bounded.
@@ -543,11 +844,12 @@ bool Store::CompactLocked(std::string* error) {
     m["nextVersion"] = next_version_;
     m["resources"] = static_cast<int64_t>(data_.size());
     meta["snapshotMeta"] = m;
-    std::string line = FrameRecord(++wal_seq_, meta.dump());
+    std::string line = FrameRecord(++wal_seq_, meta.dump(), &last_crc_);
     ok = fwrite(line.data(), 1, line.size(), f) == line.size();
   }
   for (auto it = data_.begin(); ok && it != data_.end(); ++it) {
-    std::string line = FrameRecord(++wal_seq_, ToJson(it->second).dump());
+    std::string line = FrameRecord(++wal_seq_, ToJson(it->second).dump(),
+                                   &last_crc_);
     ok = fwrite(line.data(), 1, line.size(), f) == line.size();
   }
   ok = ok && fflush(f) == 0 && fsync(fileno(f)) == 0;
@@ -588,6 +890,11 @@ bool Store::CompactLocked(std::string* error) {
   wal_ = w;
   wal_records_ = 0;
   unsynced_records_ = 0;
+  // Snapshot records consumed sequence numbers the followers never saw:
+  // the next shipped append's prevSeq mismatch sends them through the
+  // snapshot catch-up path (ReadReplicaFiles/InstallReplica) — the
+  // documented cost of leader-side compaction under replication.
+  applied_seq_ = wal_seq_;
   ++compactions_;
   compact_error_.clear();
   return true;
@@ -628,6 +935,8 @@ Json Store::StateInfo() const {
   out["nextVersion"] = next_version_;
   out["walRecords"] = wal_records_;
   out["walSeq"] = static_cast<int64_t>(wal_seq_);
+  out["appliedSeq"] = static_cast<int64_t>(applied_seq_);
+  out["unappliedRecords"] = static_cast<int64_t>(repl_unapplied_.size());
   out["walBroken"] = wal_broken_;
   if (!wal_error_.empty()) out["walError"] = wal_error_;
   out["fsync"] = fsync_policy_ == FsyncPolicy::kAlways
@@ -694,6 +1003,18 @@ Json Store::ToJson(const Resource& r) {
   out["generation"] = r.generation;
   if (r.deleted) out["deleted"] = true;
   return out;
+}
+
+Resource Store::FromJson(const Json& rec) {
+  Resource r;
+  r.kind = rec.get("kind").as_string();
+  r.name = rec.get("name").as_string();
+  r.spec = rec.get("spec");
+  r.status = rec.get("status");
+  r.resource_version = rec.get("resourceVersion").as_int();
+  r.generation = rec.get("generation").as_int();
+  r.deleted = rec.get("deleted").as_bool();
+  return r;
 }
 
 void Store::Append(const WatchEvent& ev) { pending_.push_back(ev); }
@@ -812,6 +1133,36 @@ std::vector<Resource> Store::List(const std::string& kind) const {
   return out;
 }
 
+Json Store::WatchSince(int64_t since_version,
+                       const std::string& kind) const {
+  std::lock_guard<std::mutex> lock(mu_);
+  Json out = Json::Object();
+  Json events = Json::Array();
+  // A cursor at or below the ring floor may have missed evicted events:
+  // the caller must re-list (resync), the etcd compacted-revision rule.
+  const bool resync = since_version < ring_floor_rv_;
+  if (!resync) {
+    for (const auto& ev : recent_events_) {
+      if (ev.rv <= since_version) continue;
+      if (!kind.empty() &&
+          ev.resource.get("kind").as_string() != kind) {
+        continue;
+      }
+      Json e = Json::Object();
+      e["type"] = ev.type == WatchEvent::Type::kAdded
+                      ? "ADDED"
+                      : ev.type == WatchEvent::Type::kDeleted ? "DELETED"
+                                                              : "MODIFIED";
+      e["resource"] = ev.resource;
+      events.push_back(e);
+    }
+  }
+  out["events"] = events;
+  out["resourceVersion"] = next_version_ - 1;
+  out["resync"] = resync;
+  return out;
+}
+
 int Store::Watch(const std::string& kind, WatchFn fn) {
   std::lock_guard<std::mutex> lock(mu_);
   int id = next_watch_id_++;
@@ -888,6 +1239,18 @@ int Store::DrainWatches() {
       events.resize(kMaxWatchDeliverPerPass);
     }
     watch_delivered_ += static_cast<int64_t>(events.size());
+    // Every delivered (committed, coalesced) event also enters the
+    // watch.poll ring — the client-facing fan-out surface followers
+    // serve at their applied seq. Evictions raise the resync floor.
+    for (const auto& ev : events) {
+      recent_events_.push_back({ev.resource.resource_version, ev.type,
+                                ToJson(ev.resource)});
+      while (recent_events_.size() > kWatchRingCap) {
+        ring_floor_rv_ = std::max(ring_floor_rv_,
+                                  recent_events_.front().rv);
+        recent_events_.pop_front();
+      }
+    }
   }
   for (const auto& ev : events) {
     for (const auto& w : watchers) {

@@ -39,10 +39,24 @@
 //     watch events dropped — the per-record path's reject-on-failure
 //     contract at batch granularity. 0 disables: the per-record append
 //     path runs byte-for-byte as before.
+//   * Replication (ISSUE 11): the framed WAL doubles as the replication
+//     log. A leader exports the open batch's exact framed bytes
+//     (PendingBatchBytes) and ships them to followers BEFORE its own
+//     covering fsync; a follower lands them with AppendReplicatedLog
+//     (verify frames + contiguous seq, one durable write — byte-for-byte
+//     what the leader writes) but applies them to memory only up to the
+//     leader's commit sequence (ApplyReplicatedUpTo), so a follower
+//     never serves a batch that the quorum may still abort. A batch the
+//     quorum rejects is dropped with AbortBatch (the CommitGroup failure
+//     path without the disk rollback — the bytes were never written
+//     locally). A lagging or diverged follower is reseeded from the
+//     leader's snapshot + WAL tail (ReadReplicaFiles / InstallReplica —
+//     the compaction machinery's files, shipped verbatim).
 
 #pragma once
 
 #include <cstdint>
+#include <deque>
 #include <functional>
 #include <map>
 #include <mutex>
@@ -121,6 +135,52 @@ class Store {
   // this returns true (ack-after-durable).
   bool CommitGroup(std::string* error = nullptr);
 
+  // -- replication hooks (ISSUE 11) --------------------------------------
+  // The open batch's exact framed bytes plus the sequence range they
+  // cover: (prev_seq, last_seq]. False when no batch is open. The bytes
+  // are the ones CommitGroup will write — shipped-batch byte parity with
+  // the local WAL is by construction, and test-pinned.
+  struct BatchBytes {
+    std::string bytes;
+    uint64_t prev_seq = 0;   // wal_seq_ before the batch opened
+    uint64_t last_seq = 0;   // wal_seq_ of the batch's final record
+    uint32_t prev_crc = 0;   // tip crc before the batch (see WalTipCrc)
+    int records = 0;
+  };
+  bool PendingBatchBytes(BatchBytes* out) const;
+  // CRC of the record at the log tip (0 on an empty log). The
+  // replication layer's entry-identity check — the per-entry-term
+  // stand-in: two logs agreeing on (WalSeq, WalTipCrc) hold the same
+  // record there, so a follower whose tip crc diverges from the
+  // leader's prevCrc is reseeded instead of silently extending a
+  // stranded (rolled-back) record at the same sequence number.
+  uint32_t WalTipCrc() const;
+  // Drop the open batch without touching disk: the CommitGroup failure
+  // path (pre-images restored, clocks rewound, queued watch events
+  // dropped) for a batch the replication quorum rejected before the
+  // local covering fsync ever ran.
+  void AbortBatch();
+  // Follower ingest: verify `bytes` as framed records contiguous from
+  // WalSeq()+1 (CRC + seq checked per line; any failure rejects the
+  // whole batch with nothing written), land them with one durable
+  // write (fsync per the policy — the follower's ack means durable
+  // exactly as a local ack does), and BUFFER the parsed records
+  // unapplied. ApplyReplicatedUpTo moves the committed prefix into the
+  // in-memory map and queues its watch events.
+  bool AppendReplicatedLog(const std::string& bytes, std::string* error);
+  int ApplyReplicatedUpTo(uint64_t commit_seq);
+  uint64_t WalSeq() const;
+  uint64_t AppliedSeq() const;
+  int UnappliedRecords() const;
+  // Catch-up transfer: the on-disk snapshot + WAL tail verbatim (leader
+  // side), and their installation over the local state + full reload
+  // (follower side). The shipped files contain only committed records —
+  // an open batch lives in memory until its covering commit.
+  bool ReadReplicaFiles(std::string* snapshot_bytes,
+                        std::string* wal_bytes) const;
+  bool InstallReplica(const std::string& snapshot_bytes,
+                      const std::string& wal_bytes, std::string* error);
+
   // Replays snapshot + WAL if present, truncating any torn/corrupt tail
   // in the file before the writer reopens. Returns records applied.
   int Load();
@@ -170,7 +230,21 @@ class Store {
   // their order at the queue's front for the next pass.
   int DrainWatches();
 
+  // Client-facing poll watch (`watch.poll` verb, ISSUE 11): committed,
+  // post-coalescing events with resourceVersion > `since`, served from a
+  // bounded ring DrainWatches fills as it delivers — so followers serve
+  // the same coalesced fan-out leaders do, at their applied seq. When
+  // `since` predates the ring (events were evicted), the reply carries
+  // resync=true and the caller must re-list (etcd's compacted-revision
+  // contract). Reply: {events:[{type,resource}...], resourceVersion,
+  // resync}.
+  Json WatchSince(int64_t since_version, const std::string& kind) const;
+
   static Json ToJson(const Resource& r);
+  // Inverse of ToJson — the ONE place a persisted record becomes a
+  // Resource, shared by WAL replay and replicated-batch ingest so the
+  // two paths cannot drift field-by-field.
+  static Resource FromJson(const Json& rec);
 
   // True when `name` is safe as a resource name / path component
   // ([A-Za-z0-9._-], <=253 chars, no leading '.').
@@ -188,7 +262,11 @@ class Store {
   // data_.
   void RecordUndoLocked(const std::pair<std::string, std::string>& key);
   bool CommitGroupLocked(std::string* error);
+  // Memory half of the failed-commit path: restore pre-images, rewind
+  // the version/seq clocks, drop the batch's queued watch events.
+  void RollbackBatchLocked();
   void ClearBatchLocked();
+  int LoadLocked();
   bool EnsureWalLocked(std::string* error);
   bool CompactLocked(std::string* error);
   void MaybeCompactLocked();
@@ -209,12 +287,14 @@ class Store {
   int compact_threshold_ = 0;
   int wal_records_ = 0;     // records in the current WAL tail (post-snapshot)
   uint64_t wal_seq_ = 0;    // last framed sequence number written/replayed
+  uint32_t last_crc_ = 0;   // crc of the record at wal_seq_ (log tip)
   // Group commit: the pending batch (framed bytes + rollback state) and
   // its health counters (stateinfo's groupCommit object).
   int group_commit_max_ = 0;   // 0 = off
   std::string batch_buf_;      // framed records awaiting the covering fsync
   int batch_records_ = 0;
   uint64_t batch_seq_start_ = 0;      // wal_seq_ before the batch opened
+  uint32_t batch_crc_start_ = 0;      // last_crc_ before the batch opened
   int64_t batch_version_start_ = 0;   // next_version_ before the batch
   size_t batch_watch_start_ = 0;      // pending_.size() before the batch
   std::vector<std::pair<std::pair<std::string, std::string>,
@@ -230,6 +310,12 @@ class Store {
   LoadStats load_stats_;
   std::map<std::pair<std::string, std::string>, Resource> data_;
   int64_t next_version_ = 1;
+  // Replication: records landed in the WAL by AppendReplicatedLog but
+  // not yet applied (their seq exceeds the last ApplyReplicatedUpTo).
+  // applied_seq_ trails wal_seq_ only on followers; every local-write
+  // path keeps them equal.
+  std::vector<std::pair<uint64_t, Resource>> repl_unapplied_;
+  uint64_t applied_seq_ = 0;
   struct Watcher {
     int id;
     std::string kind;
@@ -238,9 +324,26 @@ class Store {
   std::vector<Watcher> watchers_;
   std::vector<WatchEvent> pending_;
   int next_watch_id_ = 1;
+  // watch.poll ring: delivered (committed, coalesced) events, bounded.
+  // ring_floor_rv_ is the highest resourceVersion ever evicted — a
+  // `since` at or below it may have missed events and must resync.
+  struct RingEvent {
+    int64_t rv;
+    WatchEvent::Type type;
+    Json resource;
+  };
+  std::deque<RingEvent> recent_events_;
+  int64_t ring_floor_rv_ = 0;
+  static constexpr size_t kWatchRingCap = 4096;
   // Per-pass delivery budget (post-coalescing): bounds how long one
   // DrainWatches can hold the event loop at high job counts.
   static constexpr size_t kMaxWatchDeliverPerPass = 4096;
 };
+
+// Test-only seeded crash hook (TPK_CRASH_AT="<point>:<n>" SIGKILLs on the
+// n-th hit), exported for the replication ship path (repl.pre-ship /
+// repl.post-ship-pre-quorum / repl.post-quorum-pre-release windows live
+// in server.cc/replica.cc but share store.cc's one env-spec counter).
+void MaybeCrashAtPoint(const char* point);
 
 }  // namespace tpk

@@ -1,0 +1,494 @@
+// Replication tests (ISSUE 11): shipped-batch byte parity with the local
+// WAL, commit-gated follower apply, abort rollback, term fencing of a
+// stale leader, snapshot catch-up after compaction, torn shipped-batch
+// tails truncating exactly like local replay, and the vote rules
+// (term + log-length + lease). Handler-level — the socket transport is
+// exercised by the Python e2e suite against real binaries. Runs under
+// the ASan/TSan matrix like every store test.
+#include <unistd.h>
+
+#include <cstdio>
+#include <fstream>
+#include <sstream>
+#include <string>
+
+#include "replica.h"
+#include "store.h"
+
+using tpk::Json;
+using tpk::Replication;
+using tpk::Store;
+
+#define CHECK(cond)                                                   \
+  do {                                                                \
+    if (!(cond)) {                                                    \
+      fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+      return 1;                                                       \
+    }                                                                 \
+  } while (0)
+
+namespace {
+
+std::string ReadFile(const std::string& path) {
+  std::ifstream in(path, std::ios::binary);
+  std::ostringstream os;
+  os << in.rdbuf();
+  return os.str();
+}
+
+void Cleanup(const std::string& wal) {
+  std::remove(wal.c_str());
+  std::remove((wal + ".snap").c_str());
+  std::remove((wal + ".replstate").c_str());
+}
+
+Replication::Options FollowerOpts(const std::string& wal, int lease_ms) {
+  Replication::Options o;
+  o.self = "/tmp/tpk_repl_self.sock";
+  o.peers = {"/tmp/tpk_repl_peer.sock"};
+  o.state_path = wal + ".replstate";
+  o.leader_hint = "/tmp/tpk_repl_leader.sock";
+  o.lease_ms = lease_ms;
+  o.quorum_timeout_ms = 100;
+  return o;
+}
+
+Json AppendReq(int64_t term, uint64_t prev_seq, uint64_t commit_seq,
+               const std::string& data, uint32_t prev_crc = 0,
+               const std::string& leader = "/tmp/tpk_repl_leader.sock") {
+  Json req = Json::Object();
+  req["op"] = "repl.append";
+  req["term"] = term;
+  req["leader"] = leader;
+  req["prevSeq"] = static_cast<int64_t>(prev_seq);
+  req["prevCrc"] = static_cast<int64_t>(prev_crc);
+  req["commitSeq"] = static_cast<int64_t>(commit_seq);
+  req["data"] = data;
+  return req;
+}
+
+}  // namespace
+
+int main() {
+  // Shipped bytes are the local WAL bytes, byte for byte: the leader's
+  // open batch (PendingBatchBytes) equals exactly what CommitGroup then
+  // appends to the leader's file, and a follower landing those bytes
+  // produces a byte-identical WAL file.
+  {
+    std::string lwal = "/tmp/tpk_repl_leader.jsonl";
+    std::string fwal = "/tmp/tpk_repl_follower.jsonl";
+    Cleanup(lwal);
+    Cleanup(fwal);
+    Store leader(lwal);
+    leader.SetGroupCommit(64);
+    CHECK(leader.Create("Widget", "a", Json::Object()).ok);
+    CHECK(leader.UpdateSpec("Widget", "a", Json::Object()).ok);
+    CHECK(leader.Create("Widget", "b", Json::Object()).ok);
+    Store::BatchBytes batch;
+    CHECK(leader.PendingBatchBytes(&batch));
+    CHECK(batch.records == 3);
+    CHECK(batch.prev_seq == 0 && batch.last_seq == 3);
+    const std::string pre = ReadFile(lwal);
+    CHECK(leader.CommitGroup());
+    CHECK(ReadFile(lwal) == pre + batch.bytes);  // shipped == written
+
+    Store follower(fwal);
+    std::string err;
+    CHECK(follower.AppendReplicatedLog(batch.bytes, &err));
+    CHECK(ReadFile(fwal) == ReadFile(lwal));  // replica WAL byte parity
+    CHECK(follower.WalSeq() == 3);
+    // Commit-gated apply: durable but invisible until the leader's
+    // commitSeq covers it (no dirty follower reads of an abortable
+    // batch)...
+    CHECK(follower.AppliedSeq() == 0);
+    CHECK(follower.UnappliedRecords() == 3);
+    CHECK(!follower.Get("Widget", "a").has_value());
+    // ...and a partial commitSeq applies exactly the prefix.
+    CHECK(follower.ApplyReplicatedUpTo(2) == 2);
+    CHECK(follower.Get("Widget", "a").has_value());
+    CHECK(!follower.Get("Widget", "b").has_value());
+    CHECK(follower.ApplyReplicatedUpTo(3) == 1);
+    CHECK(follower.Get("Widget", "b").has_value());
+    CHECK(follower.AppliedSeq() == 3);
+    // The applied events reach the follower's watch fan-out (coalesced:
+    // a's create+update collapse to one ADDED).
+    int events = 0;
+    follower.Watch("", [&events](const tpk::WatchEvent&) { ++events; });
+    CHECK(follower.DrainWatches() == 2);
+    CHECK(events == 2);
+    Cleanup(lwal);
+    Cleanup(fwal);
+  }
+
+  // AbortBatch is the quorum-failure rollback: memory restored from
+  // pre-images, clocks rewound, queued watch events dropped, and the
+  // WAL file never touched — then the store keeps working.
+  {
+    std::string wal = "/tmp/tpk_repl_abort.jsonl";
+    Cleanup(wal);
+    Store s(wal);
+    s.SetGroupCommit(64);
+    CHECK(s.Create("Widget", "keep", Json::Object()).ok);
+    CHECK(s.CommitGroup());
+    const std::string durable = ReadFile(wal);
+    int events = 0;
+    s.Watch("", [&events](const tpk::WatchEvent&) { ++events; });
+    CHECK(s.DrainWatches() == 1);  // the committed create
+    CHECK(s.Create("Widget", "doomed", Json::Object()).ok);
+    CHECK(s.UpdateSpec("Widget", "keep", Json::Object()).ok);
+    Store::BatchBytes batch;
+    CHECK(s.PendingBatchBytes(&batch));
+    s.AbortBatch();
+    CHECK(ReadFile(wal) == durable);            // disk untouched
+    CHECK(!s.Get("Widget", "doomed").has_value());
+    CHECK(s.Get("Widget", "keep")->generation == 1);  // spec bump undone
+    CHECK(s.DrainWatches() == 0);               // batch events dropped
+    CHECK(s.PendingGroupRecords() == 0);
+    auto r = s.Create("Widget", "after", Json::Object());
+    CHECK(r.ok);
+    CHECK(s.CommitGroup());
+    Store s2(wal);
+    s2.Load();
+    CHECK(s2.WalSeq() == 2);  // keep + after; doomed never durable
+    CHECK(s2.Get("Widget", "after").has_value());
+    CHECK(events == 1);
+    Cleanup(wal);
+  }
+
+  // Term fencing: a stale leader's append (and snapshot) is rejected
+  // before anything lands or applies — the deposed-leader harmlessness
+  // the failover harness relies on.
+  {
+    std::string wal = "/tmp/tpk_repl_fence.jsonl";
+    Cleanup(wal);
+    Store s(wal);
+    Replication repl(&s, FollowerOpts(wal, 50));
+    // A term-5 leader establishes itself.
+    Json ok = repl.HandleAppend(AppendReq(5, 0, 0, ""));
+    CHECK(ok.get("ok").as_bool());
+    CHECK(repl.term() == 5);
+    // Build one framed record by committing through a scratch leader.
+    std::string lwal = "/tmp/tpk_repl_fence_l.jsonl";
+    Cleanup(lwal);
+    Store leader(lwal);
+    leader.SetGroupCommit(64);
+    CHECK(leader.Create("Widget", "w", Json::Object()).ok);
+    Store::BatchBytes batch;
+    CHECK(leader.PendingBatchBytes(&batch));
+    CHECK(leader.CommitGroup());
+    // The stale (term 3 < 5) leader ships that batch: rejected by term,
+    // nothing written, nothing applied.
+    Json stale = repl.HandleAppend(AppendReq(3, 0, 1, batch.bytes));
+    CHECK(!stale.get("ok").as_bool());
+    CHECK(stale.get("staleTerm").as_bool());
+    CHECK(stale.get("term").as_int() == 5);
+    CHECK(s.WalSeq() == 0);
+    CHECK(!s.Get("Widget", "w").has_value());
+    Json stale_snap = Json::Object();
+    stale_snap["op"] = "repl.snapshot";
+    stale_snap["term"] = 3;
+    stale_snap["leader"] = "/tmp/tpk_repl_leader.sock";
+    stale_snap["commitSeq"] = 1;
+    stale_snap["snapshot"] = "";
+    stale_snap["wal"] = ReadFile(lwal);
+    CHECK(!repl.HandleSnapshot(stale_snap).get("ok").as_bool());
+    CHECK(s.WalSeq() == 0);
+    // The CURRENT term's leader ships the same batch: accepted.
+    Json good = repl.HandleAppend(AppendReq(5, 0, 1, batch.bytes));
+    CHECK(good.get("ok").as_bool());
+    CHECK(s.Get("Widget", "w").has_value());
+    // A mismatched prevSeq (leader ahead — we missed a batch) asks for
+    // the snapshot reseed instead of guessing.
+    Json gap = repl.HandleAppend(AppendReq(5, 7, 7, batch.bytes));
+    CHECK(!gap.get("ok").as_bool());
+    CHECK(gap.get("needSnapshot").as_bool());
+    CHECK(gap.get("seq").as_int() == 1);
+    Cleanup(wal);
+    Cleanup(lwal);
+  }
+
+  // Divergence detection (the Raft (term,index) check via the tip
+  // record's CRC): a follower holding a DIFFERENT record at the same
+  // sequence — a batch a crashed leader shipped that the new leader's
+  // history replaced — is told to reseed instead of silently extending
+  // the stranded record.
+  {
+    std::string wal = "/tmp/tpk_repl_diverge.jsonl";
+    std::string l1 = "/tmp/tpk_repl_diverge_l1.jsonl";
+    std::string l2 = "/tmp/tpk_repl_diverge_l2.jsonl";
+    Cleanup(wal);
+    Cleanup(l1);
+    Cleanup(l2);
+    // Two histories for seq 1: the stranded one (shipped by the dead
+    // leader) and the committed one (the new leader's).
+    Store stranded_leader(l1);
+    stranded_leader.SetGroupCommit(64);
+    Json sspec = Json::Object();
+    sspec["stranded"] = true;
+    CHECK(stranded_leader.Create("Widget", "w", sspec).ok);
+    Store::BatchBytes stranded;
+    CHECK(stranded_leader.PendingBatchBytes(&stranded));
+    CHECK(stranded_leader.CommitGroup());
+    Store committed_leader(l2);
+    committed_leader.SetGroupCommit(64);
+    Json cspec = Json::Object();
+    cspec["committed"] = true;
+    CHECK(committed_leader.Create("Widget", "w", cspec).ok);
+    Store::BatchBytes committed;
+    CHECK(committed_leader.PendingBatchBytes(&committed));
+    CHECK(committed_leader.CommitGroup());
+    CHECK(stranded_leader.WalTipCrc() != committed_leader.WalTipCrc());
+
+    Store s(wal);
+    Replication repl(&s, FollowerOpts(wal, 50));
+    // The dead leader's batch lands (term 1).
+    CHECK(repl.HandleAppend(AppendReq(1, 0, 1, stranded.bytes))
+              .get("ok").as_bool());
+    CHECK(s.WalSeq() == 1);
+    // The new leader (term 2) heartbeats with ITS tip identity: same
+    // seq, different record — the follower must ask for a reseed, not
+    // ack a log it does not actually share.
+    Json hb = repl.HandleAppend(
+        AppendReq(2, 1, 1, "", committed_leader.WalTipCrc()));
+    CHECK(!hb.get("ok").as_bool());
+    CHECK(hb.get("needSnapshot").as_bool());
+    CHECK(s.Get("Widget", "w")->spec.get("stranded").as_bool());
+    // The reseed replaces the stranded history with the committed one.
+    std::string snap, lwal, err;
+    CHECK(committed_leader.ReadReplicaFiles(&snap, &lwal));
+    Json snap_req = Json::Object();
+    snap_req["op"] = "repl.snapshot";
+    snap_req["term"] = 2;
+    snap_req["leader"] = "/tmp/tpk_repl_leader.sock";
+    snap_req["commitSeq"] = 1;
+    snap_req["snapshot"] = snap;
+    snap_req["wal"] = lwal;
+    CHECK(repl.HandleSnapshot(snap_req).get("ok").as_bool());
+    CHECK(s.WalTipCrc() == committed_leader.WalTipCrc());
+    CHECK(s.Get("Widget", "w")->spec.get("committed").as_bool());
+    // And a MATCHING tip identity heartbeats clean.
+    CHECK(repl.HandleAppend(
+              AppendReq(2, 1, 1, "", committed_leader.WalTipCrc()))
+              .get("ok").as_bool());
+    Cleanup(wal);
+    Cleanup(l1);
+    Cleanup(l2);
+  }
+
+  // Catch-up from snapshot after compaction: the leader's snapshot +
+  // tail files install over a stale follower and replay to the exact
+  // same state and sequence — the rejoin path when the tail the
+  // follower missed was compacted away.
+  {
+    std::string lwal = "/tmp/tpk_repl_catchup_l.jsonl";
+    std::string fwal = "/tmp/tpk_repl_catchup_f.jsonl";
+    Cleanup(lwal);
+    Cleanup(fwal);
+    Store leader(lwal);
+    leader.SetGroupCommit(64);
+    for (int i = 0; i < 8; ++i) {
+      CHECK(leader.Create("Widget", "w" + std::to_string(i),
+                          Json::Object()).ok);
+      CHECK(leader.CommitGroup());
+    }
+    CHECK(leader.Compact());
+    CHECK(leader.Create("Widget", "post-compact", Json::Object()).ok);
+    CHECK(leader.CommitGroup());
+
+    Store follower(fwal);
+    // The follower has its own (diverged) history: install overwrites.
+    CHECK(follower.Create("Widget", "stale-local", Json::Object()).ok);
+    std::string snap, wal;
+    CHECK(leader.ReadReplicaFiles(&snap, &wal));
+    CHECK(!snap.empty());
+    std::string err;
+    CHECK(follower.InstallReplica(snap, wal, &err));
+    CHECK(follower.WalSeq() == leader.WalSeq());
+    CHECK(follower.load_stats().snapshot_loaded);
+    CHECK(follower.load_stats().clean);
+    CHECK(!follower.Get("Widget", "stale-local").has_value());
+    CHECK(follower.Get("Widget", "w7").has_value());
+    CHECK(follower.Get("Widget", "post-compact").has_value());
+    CHECK(ReadFile(fwal) == ReadFile(lwal));
+    CHECK(ReadFile(fwal + ".snap") == ReadFile(lwal + ".snap"));
+    Cleanup(lwal);
+    Cleanup(fwal);
+  }
+
+  // A torn shipped-batch tail on the follower truncates on replay
+  // exactly like a torn local append: replay stops at the last good
+  // record, the torn bytes leave the file, and the load is clean.
+  {
+    std::string lwal = "/tmp/tpk_repl_torn_l.jsonl";
+    std::string fwal = "/tmp/tpk_repl_torn_f.jsonl";
+    Cleanup(lwal);
+    Cleanup(fwal);
+    Store leader(lwal);
+    leader.SetGroupCommit(64);
+    for (int i = 0; i < 3; ++i) {
+      CHECK(leader.Create("Widget", "w" + std::to_string(i),
+                          Json::Object()).ok);
+    }
+    Store::BatchBytes batch;
+    CHECK(leader.PendingBatchBytes(&batch));
+    CHECK(leader.CommitGroup());
+    {
+      Store follower(fwal);
+      std::string err;
+      CHECK(follower.AppendReplicatedLog(batch.bytes, &err));
+      CHECK(follower.ApplyReplicatedUpTo(batch.last_seq) == 3);
+    }
+    // Tear the follower's file mid-final-record (the crash-mid-append
+    // shape, here crash-mid-replicated-append).
+    std::string bytes = ReadFile(fwal);
+    CHECK(truncate(fwal.c_str(), bytes.size() - 7) == 0);
+    Store reloaded(fwal);
+    CHECK(reloaded.Load() == 2);
+    CHECK(reloaded.load_stats().clean);
+    CHECK(reloaded.load_stats().truncated_bytes > 0);
+    CHECK(reloaded.WalSeq() == 2);
+    CHECK(!reloaded.Get("Widget", "w2").has_value());
+    // And the torn record can be re-shipped: the leader's next append
+    // sees the seq gap (needSnapshot in the handler); at store level a
+    // reseed lands the full log again.
+    std::string snap, wal;
+    CHECK(leader.ReadReplicaFiles(&snap, &wal));
+    std::string err;
+    CHECK(reloaded.InstallReplica(snap, wal, &err));
+    CHECK(reloaded.Get("Widget", "w2").has_value());
+    CHECK(reloaded.WalSeq() == leader.WalSeq());
+    Cleanup(lwal);
+    Cleanup(fwal);
+  }
+
+  // Shipped-batch verification: corrupt shipped bytes (bit flip) or a
+  // sequence gap reject the WHOLE batch with nothing written.
+  {
+    std::string lwal = "/tmp/tpk_repl_verify_l.jsonl";
+    std::string fwal = "/tmp/tpk_repl_verify_f.jsonl";
+    Cleanup(lwal);
+    Cleanup(fwal);
+    Store leader(lwal);
+    leader.SetGroupCommit(64);
+    CHECK(leader.Create("Widget", "a", Json::Object()).ok);
+    CHECK(leader.Create("Widget", "b", Json::Object()).ok);
+    Store::BatchBytes batch;
+    CHECK(leader.PendingBatchBytes(&batch));
+    CHECK(leader.CommitGroup());
+    Store follower(fwal);
+    std::string corrupted = batch.bytes;
+    corrupted[corrupted.size() / 2] ^= 0x20;  // flip inside record 1 or 2
+    std::string err;
+    CHECK(!follower.AppendReplicatedLog(corrupted, &err));
+    CHECK(follower.WalSeq() == 0);
+    CHECK(ReadFile(fwal).empty());
+    // Contiguity: shipping the batch twice is a seq regression, not a
+    // silent double apply.
+    CHECK(follower.AppendReplicatedLog(batch.bytes, &err));
+    CHECK(!follower.AppendReplicatedLog(batch.bytes, &err));
+    CHECK(follower.WalSeq() == 2);
+    Cleanup(lwal);
+    Cleanup(fwal);
+  }
+
+  // Vote rules: term, log length, one vote per term, and the lease gate
+  // (a follower that still hears its leader refuses to depose it).
+  {
+    std::string wal = "/tmp/tpk_repl_vote.jsonl";
+    Cleanup(wal);
+    Store s(wal);
+    Replication repl(&s, FollowerOpts(wal, 40));
+    // Establish a leader at term 2 (fresh lease from this append).
+    CHECK(repl.HandleAppend(AppendReq(2, 0, 0, "")).get("ok").as_bool());
+    Json vote = Json::Object();
+    vote["op"] = "repl.vote";
+    vote["term"] = 3;
+    vote["candidate"] = "/tmp/tpk_repl_other.sock";
+    vote["lastSeq"] = 0;
+    // Lease fresh → denied even at a higher term, and OUR term must not
+    // adopt the candidate's (else the live leader gets fenced anyway).
+    Json denied = repl.HandleVote(vote);
+    CHECK(!denied.get("granted").as_bool());
+    CHECK(repl.term() == 2);
+    usleep(90 * 1000);  // lease (40 ms) expires
+    // Stale term → denied regardless of lease.
+    Json stale_vote = vote;
+    stale_vote["term"] = 1;
+    CHECK(!repl.HandleVote(stale_vote).get("granted").as_bool());
+    // Expired lease + newer term + log at least as long → granted.
+    Json granted = repl.HandleVote(vote);
+    CHECK(granted.get("granted").as_bool());
+    CHECK(repl.term() == 3);
+    // One vote per term: a second candidate at the same term is denied.
+    Json rival = vote;
+    rival["candidate"] = "/tmp/tpk_repl_rival.sock";
+    CHECK(!repl.HandleVote(rival).get("granted").as_bool());
+    // A shorter log is never electable: bump our log, candidate at 0.
+    CHECK(repl.HandleAppend(AppendReq(3, 0, 0, "")).get("ok").as_bool());
+    {
+      std::string lwal = "/tmp/tpk_repl_vote_l.jsonl";
+      Cleanup(lwal);
+      Store leader(lwal);
+      leader.SetGroupCommit(64);
+      CHECK(leader.Create("Widget", "w", Json::Object()).ok);
+      Store::BatchBytes b;
+      CHECK(leader.PendingBatchBytes(&b));
+      CHECK(leader.CommitGroup());
+      CHECK(repl.HandleAppend(AppendReq(3, 0, 1, b.bytes))
+                .get("ok").as_bool());
+      Cleanup(lwal);
+    }
+    usleep(90 * 1000);
+    Json short_cand = vote;
+    short_cand["term"] = 4;
+    short_cand["lastSeq"] = 0;  // our log is at seq 1
+    CHECK(!repl.HandleVote(short_cand).get("granted").as_bool());
+    // Equal length but a DIFFERENT tip record (divergence a dead leader
+    // left behind): refused — electing it could replace the committed
+    // record with the stranded one.
+    Json diverged_cand = vote;
+    diverged_cand["term"] = 4;
+    diverged_cand["lastSeq"] = 1;
+    diverged_cand["lastCrc"] = static_cast<int64_t>(s.WalTipCrc() ^ 0x1);
+    CHECK(!repl.HandleVote(diverged_cand).get("granted").as_bool());
+    Json long_cand = vote;
+    long_cand["term"] = 4;
+    long_cand["lastSeq"] = 1;
+    long_cand["lastCrc"] = static_cast<int64_t>(s.WalTipCrc());
+    CHECK(repl.HandleVote(long_cand).get("granted").as_bool());
+    // Terms and votes persisted: a restart remembers term 4.
+    Replication repl2(&s, FollowerOpts(wal, 40));
+    CHECK(repl2.term() == 4);
+    Cleanup(wal);
+  }
+
+  // Single-node WAL parity: a store whose batches commit WITHOUT any
+  // replication produces byte-for-byte the same WAL as one driven
+  // through PendingBatchBytes+CommitGroup (the export is read-only).
+  {
+    std::string a = "/tmp/tpk_repl_parity_a.jsonl";
+    std::string b = "/tmp/tpk_repl_parity_b.jsonl";
+    Cleanup(a);
+    Cleanup(b);
+    Store sa(a);
+    sa.SetGroupCommit(64);
+    Store sb(b);
+    sb.SetGroupCommit(64);
+    for (int i = 0; i < 4; ++i) {
+      Json spec = Json::Object();
+      spec["i"] = i;
+      CHECK(sa.Create("Widget", "w" + std::to_string(i), spec).ok);
+      CHECK(sb.Create("Widget", "w" + std::to_string(i), spec).ok);
+    }
+    Store::BatchBytes peek;
+    CHECK(sb.PendingBatchBytes(&peek));  // the leader-path read
+    CHECK(sa.CommitGroup());
+    CHECK(sb.CommitGroup());
+    CHECK(ReadFile(a) == ReadFile(b));
+    Cleanup(a);
+    Cleanup(b);
+  }
+
+  printf("test_replication: OK\n");
+  return 0;
+}

@@ -202,8 +202,48 @@ def cmd_metrics(args) -> int:
 
 
 def cmd_stateinfo(args) -> int:
-    """Durability health: WAL replay stats, compaction, fsync mode."""
-    print(json.dumps(_client(args).stateinfo(), indent=2))
+    """Durability + replication health. Default is a human summary
+    (the `tpukit replicas` style); --json emits the full stateinfo
+    document (replay/groupCommit/watch/replication objects verbatim —
+    the scriptable surface, documented in README 'Control plane')."""
+    info = _client(args).stateinfo()
+    if args.json:
+        print(json.dumps(info, indent=2))
+        return 0
+    replay = info.get("replay", {})
+    print(f"wal: {info.get('walPath') or '<in-memory>'} "
+          f"({info.get('walRecords', 0)} records, seq "
+          f"{info.get('walSeq', 0)}, fsync={info.get('fsync')}, "
+          f"{'BROKEN' if info.get('walBroken') else 'healthy'})")
+    print(f"replay: {replay.get('applied', 0)} applied = "
+          f"{replay.get('snapshotRecords', 0)} snapshot + "
+          f"{replay.get('tailRecords', 0)} tail, "
+          f"{replay.get('truncatedBytes', 0)} bytes truncated, "
+          f"{'clean' if replay.get('clean') else 'STOPPED AT CORRUPTION'}")
+    gc = info.get("groupCommit", {})
+    if gc.get("maxBatch"):
+        print(f"group-commit: {gc.get('commits', 0)} commits / "
+              f"{gc.get('records', 0)} records / "
+              f"{gc.get('fsyncs', 0)} fsyncs "
+              f"(mean batch {gc.get('meanBatch', 0):.1f})")
+    repl = info.get("replication")
+    if repl:
+        print(f"replication: {repl['role']} term {repl['term']} "
+              f"(leader: {repl.get('leader') or '<election pending>'}, "
+              f"quorum {repl['quorum']}/{repl['replicas']}, "
+              f"seq {repl['seq']}, applied {repl['appliedSeq']}, "
+              f"commit {repl['commitSeq']}, lag {repl['lagRecords']})")
+        print(f"  quorum commits {repl['quorumCommits']}, failures "
+              f"{repl['quorumFailures']}, elections {repl['elections']}, "
+              f"stale-leader rejections {repl['staleRejections']}, "
+              f"snapshots shipped {repl['snapshotsShipped']}")
+        fmt = "  {:<40} {:>10} {:>6} {}"
+        print(fmt.format("FOLLOWER", "ACKED_SEQ", "LAG", "REACHABLE"))
+        for f in repl.get("followers", []):
+            print(fmt.format(f["sock"], f["ackedSeq"], f["lagRecords"],
+                             "yes" if f["reachable"] else "no"))
+    else:
+        print("replication: off (single node)")
     return 0
 
 
@@ -335,7 +375,10 @@ def main(argv=None) -> int:
     p.set_defaults(fn=cmd_metrics)
 
     p = sub.add_parser("stateinfo",
-                       help="WAL/snapshot durability health")
+                       help="WAL/snapshot durability + replication "
+                            "health")
+    p.add_argument("--json", action="store_true",
+                   help="full stateinfo document (scriptable)")
     p.set_defaults(fn=cmd_stateinfo)
 
     p = sub.add_parser("events",

@@ -21,6 +21,14 @@ Sections (each pinned by tests/test_ctrlbench.py):
   * accept_ramp — K clients connect at once; the drained accept loop
     must serve the whole burst without per-connection poll-cycle
     penalties (ISSUE 8 satellite regression row).
+  * replicated (ISSUE 11) — 1 leader + 2 followers on localhost vs a
+    single node, both at fsync=always with group commit, measurement
+    slices ALTERNATING between the arms (PROFILE.md §10: the 9p fsync
+    regime drifts minute-to-minute, and the replicated arm pays 3x the
+    fsyncs). Records quorum-acked submit rps (the cost of
+    ack-after-quorum), follower-served get and watch.poll throughput
+    (the horizontal read/watch win), and the replication mechanism
+    counters (quorum commits, follower lag) that the shape test pins.
 
 Run `python bench.py --ctrlbench` from the repo root. If the binary is
 not built, the result is one skipped-with-reason record (the
@@ -355,6 +363,100 @@ def _bench_watch_fanout(base: str, jobs: int, clients: int,
         cluster.stop()
 
 
+def _bench_replicated(base: str, clients: int, seconds: float,
+                      warmup_s: float, slices: int = 4) -> dict:
+    """Single-node vs 3-replica set, both live, alternating submit
+    slices; then follower-served read/watch throughput on the live
+    replica set."""
+    from kubeflow_tpu.controlplane.replication import ReplicaSet
+
+    single = _cluster(base, "repl-single", [
+        "--fsync", "always", "--group-commit", "64", "--compact", "0"])
+    rset = ReplicaSet(os.path.join(base, "rset"), n=3, lease_ms=1500,
+                      fsync="always", client_timeout=60,
+                      extra_args=["--compact", "0"])
+    os.makedirs(os.path.join(base, "rset"), exist_ok=True)
+    single_admin = None
+    try:
+        single_admin = single.start()
+        rset.start()
+        lead = rset.wait_leader(timeout=30)
+        leader_sock = rset.socks[lead]
+        follower = next(i for i in range(3) if i != lead)
+        info0 = rset.stateinfo(lead)["replication"]
+
+        slice_s = max(seconds / slices, 0.25)
+        acked = {"single": 0, "replicated": 0}
+        for s in range(slices):
+            for key, sock in (("single", single.sock),
+                              ("replicated", leader_sock)):
+                r = _raw_submit_loop(sock, clients, slice_s, tag=f"r{s}",
+                                     warmup_s=warmup_s if s == 0 else 0.0)
+                acked[key] += r["acked"]
+        wall = slices * slice_s
+
+        # Follower-served reads: the horizontal scaling surface —
+        # closed-loop gets against a FOLLOWER while the leader idles.
+        fol_client_sock = rset.socks[follower]
+        probe = Client(leader_sock, timeout=60)
+        probe.create("Widget", "probe", {"x": 0})
+        probe.close()
+        time.sleep(1.0)  # one heartbeat: follower applies the probe
+        follower_get = _closed_loop(
+            fol_client_sock, clients, max(seconds / 3, 0.5),
+            lambda c, i, n: c.get("Widget", "probe"))
+        # Follower-served watch: take a cursor on the FOLLOWER (since=0
+        # would resync — the submit storm evicted the ring's head), make
+        # fresh leader writes, and count them arriving in the follower's
+        # coalesced stream after the commit heartbeat.
+        fc = Client(fol_client_sock, timeout=60)
+        cursor = fc.watch_poll()["resourceVersion"]
+        wprobe = Client(leader_sock, timeout=60)
+        for i in range(8):
+            wprobe.create("Widget", f"watchprobe-{i}", {"i": i})
+        wprobe.close()
+        time.sleep(1.0)
+        w1 = fc.watch_poll(since=cursor)
+        watch_events = len(w1["events"])
+        fol_info = fc.stateinfo()["replication"]
+        fc.close()
+
+        lead_admin = Client(leader_sock, timeout=60)
+        info1 = lead_admin.stateinfo()["replication"]
+        lead_admin.close()
+        single_rps = round(acked["single"] / wall, 1)
+        repl_rps = round(acked["replicated"] / wall, 1)
+        return {
+            "replicas": 3,
+            "quorum": info1["quorum"],
+            "single": {"submit_rps": single_rps,
+                       "submit_acked": acked["single"]},
+            "replicated": {"submit_rps": repl_rps,
+                           "submit_acked": acked["replicated"]},
+            "submit_wall_s": round(wall, 3),
+            "rps_ratio_replicated_vs_single": round(
+                repl_rps / max(single_rps, 1e-9), 3),
+            "quorum_commits": (info1["quorumCommits"]
+                               - info0["quorumCommits"]),
+            "quorum_failures": (info1["quorumFailures"]
+                                - info0["quorumFailures"]),
+            "snapshots_shipped": info1["snapshotsShipped"],
+            "follower_lag_records": max(
+                f["lagRecords"] for f in info1["followers"]),
+            "follower_acked_seq": [f["ackedSeq"]
+                                   for f in info1["followers"]],
+            "leader_seq": info1["seq"],
+            "follower_get_rps": follower_get["rps"],
+            "follower_watch_events": watch_events,
+            "follower_applied_seq": fol_info["appliedSeq"],
+        }
+    finally:
+        if single_admin is not None:
+            single_admin.close()
+        single.stop()
+        rset.stop()
+
+
 def _bench_accept_ramp(base: str, clients: int) -> dict:
     cluster = _cluster(base, "ramp", [
         "--fsync", "always", "--group-commit", "64"])
@@ -430,6 +532,8 @@ def run_ctrlbench(quick: bool = False, clients: int = 8) -> dict:
         result["watch_fanout"] = _bench_watch_fanout(base, jobs, clients,
                                                      churn_rounds)
         result["accept_ramp"] = _bench_accept_ramp(base, ramp_clients)
+        result["replicated"] = _bench_replicated(base, clients, seconds,
+                                                 warmup_s)
     finally:
         # Each arm leaves a cluster workdir + a WAL holding thousands of
         # framed records; repeated runs must not accumulate dead state.

@@ -35,11 +35,32 @@ class ControlPlaneDisconnected(ControlPlaneError, ConnectionError):
     — the transient, retryable subset of ControlPlaneError."""
 
 
+class ControlPlaneSendFailed(ControlPlaneDisconnected):
+    """The connection died during the SEND phase (e.g. a cached socket
+    to a since-killed leader: broken pipe / reset on sendall). For this
+    newline-delimited protocol that is provably pre-dispatch: sendall
+    only raises when a suffix of the line — which ends with the
+    terminating newline — never reached the kernel, and the server
+    dispatches complete lines only. Safe to retry for ANY op, unlike a
+    recv-phase death (request fully delivered, outcome unknown)."""
+
+
 class ControlPlaneUnavailable(ControlPlaneError):
     """Typed terminal error: the retry/deadline budget for one call is
     exhausted and the control plane never answered. Callers distinguish
     'the server rejected this' (ControlPlaneError) from 'the server is
     gone' (this) — the same split client-go makes with IsServerTimeout."""
+
+
+class NotLeader(ControlPlaneError):
+    """A replicated follower rejected a mutation (ISSUE 11). Carries the
+    follower's `redirect` hint (the leader's socket path, possibly ""
+    mid-election). Always safe to retry — the server applied nothing —
+    and the Client handles it internally by re-targeting the leader."""
+
+    def __init__(self, message: str, redirect: str = ""):
+        super().__init__(message)
+        self.redirect = redirect
 
 
 #: Transient transport errors worth a reconnect+retry: refused / missing
@@ -49,20 +70,23 @@ class ControlPlaneUnavailable(ControlPlaneError):
 #: non-idempotent op against a wedged server is worse than failing.
 TRANSIENT_ERRORS = (ConnectionRefusedError, ConnectionResetError,
                     BrokenPipeError, FileNotFoundError,
-                    ControlPlaneDisconnected)
+                    ControlPlaneDisconnected, NotLeader)
 
-#: Errors that can only occur BEFORE the request reached the server
-#: (connect-time): safe to retry for any op. The rest of
+#: Errors that can only occur BEFORE the request took effect server-side
+#: (connect-time refusals, send-phase deaths — the newline never left
+#: the kernel — and a follower's not-leader rejection, which by contract
+#: applied nothing): safe to retry for any op. The rest of
 #: TRANSIENT_ERRORS can strike after sendall — the server may have
 #: already applied the op — so those only replay for read-only verbs.
-_PRE_SEND_ERRORS = (ConnectionRefusedError, FileNotFoundError)
+_PRE_SEND_ERRORS = (ConnectionRefusedError, FileNotFoundError, NotLeader,
+                    ControlPlaneSendFailed)
 
 #: Verbs with no server-side effects: replaying them after a mid-exchange
 #: disconnect is always safe (client-go's IsServerTimeout/idempotency
 #: split for GET-class requests).
 _READ_ONLY_OPS = frozenset(
     {"get", "list", "metrics", "slices", "logs", "ping", "stateinfo",
-     "events", "trace"})
+     "events", "trace", "watch.poll"})
 
 
 def namespace_of(resource: dict) -> str:
@@ -80,18 +104,31 @@ class Client:
     disconnects only replay read-only verbs (a mutating op may already
     have been applied server-side). Exhaustion raises
     `ControlPlaneUnavailable` with the last transport error chained.
-    `max_attempts=1` restores the old single-shot behavior."""
+    `max_attempts=1` restores the old single-shot behavior.
+
+    `replicas` (ISSUE 11) teaches the client the replica set of a
+    replicated control plane: a follower's not-leader rejection
+    re-targets the hinted leader and retries (the rejection applied
+    nothing, so this is safe for mutations too); a refused/absent
+    socket rotates to the next known replica. Both stay inside the
+    call's deadline budget — a failover (lease expiry + election)
+    resolves mid-call instead of surfacing as a first-refusal error —
+    and the attempt cap scales with the replica count so the budget,
+    not the cap, bounds a failover wait."""
 
     def __init__(self, socket_path: str = "/tmp/tpk.sock",
                  timeout: float = 30.0,
                  retry: BackoffPolicy | None = None,
                  max_attempts: int = 5,
                  deadline_s: float | None = None,
-                 trace_id: str | None = None):
+                 trace_id: str | None = None,
+                 replicas: list[str] | tuple[str, ...] | None = None):
         self.socket_path = socket_path
         self.timeout = timeout
         self.retry = retry or BackoffPolicy(initial_s=0.05, max_s=2.0)
-        self.max_attempts = int(max_attempts)
+        self.replicas = [socket_path] + [r for r in (replicas or ())
+                                         if r != socket_path]
+        self.max_attempts = int(max_attempts) * max(len(self.replicas), 1)
         self.deadline_s = timeout if deadline_s is None else deadline_s
         # One trace identity per client (callers can pass the request id
         # they are working under): attached to every RPC, recorded on the
@@ -122,7 +159,17 @@ class Client:
         try:
             s = self._connect(deadline)
             s.settimeout(max(deadline.bound(self.timeout), 0.001))
-            s.sendall(json.dumps(req).encode() + b"\n")
+            try:
+                s.sendall(json.dumps(req).encode() + b"\n")
+            except (BrokenPipeError, ConnectionResetError) as e:
+                # Send-phase death (see ControlPlaneSendFailed): the
+                # request line never fully reached the kernel, so the
+                # server cannot have dispatched it — retryable even for
+                # mutations (the failover path: cached socket to a
+                # SIGKILLed leader).
+                raise ControlPlaneSendFailed(
+                    f"connection to {self.socket_path} died during "
+                    f"send: {type(e).__name__}: {e}") from e
             while b"\n" not in self._buf:
                 chunk = s.recv(65536)
                 if not chunk:
@@ -139,8 +186,31 @@ class Client:
         line, self._buf = self._buf.split(b"\n", 1)
         resp = json.loads(line)
         if not resp.get("ok"):
+            if resp.get("notLeader"):
+                raise NotLeader(resp.get("error", "not leader"),
+                                redirect=resp.get("redirect", ""))
             raise ControlPlaneError(resp.get("error", "unknown error"))
         return resp
+
+    def _retarget(self, path: str) -> None:
+        """Point the transport at another replica (closing the current
+        connection so the next attempt connects fresh)."""
+        if path == self.socket_path:
+            return
+        self.close()
+        self._buf = b""
+        self.socket_path = path
+        if path not in self.replicas:
+            self.replicas.append(path)
+
+    def _rotate_target(self) -> None:
+        """Current replica is unreachable: try the next one in the set
+        (no-op for a single-target client — the old behavior exactly)."""
+        if len(self.replicas) <= 1:
+            return
+        i = (self.replicas.index(self.socket_path) + 1
+             if self.socket_path in self.replicas else 0)
+        self._retarget(self.replicas[i % len(self.replicas)])
 
     def request(self, **req: Any) -> dict:
         deadline = Deadline(self.deadline_s)
@@ -154,7 +224,26 @@ class Client:
             attempts[0] += 1
             try:
                 return self._request_once(req, deadline, attempt)
+            except NotLeader as e:
+                # A follower refused a mutation (nothing applied): chase
+                # the redirect when it names the leader, otherwise rotate
+                # — mid-election the hint is empty and SOME replica will
+                # know the winner within a lease. retry_call then replays
+                # under the same deadline budget.
+                if e.redirect:
+                    self._retarget(e.redirect)
+                else:
+                    self._rotate_target()
+                raise
             except TRANSIENT_ERRORS as e:
+                if isinstance(e, (ConnectionRefusedError,
+                                  FileNotFoundError,
+                                  ControlPlaneSendFailed)):
+                    # Dead/absent socket — e.g. a SIGKILLed leader during
+                    # failover. Another replica may be (or know) the new
+                    # leader; rotating keeps the retries useful instead
+                    # of hammering a corpse until the budget dies.
+                    self._rotate_target()
                 if (not isinstance(e, _PRE_SEND_ERRORS)
                         and req.get("op") not in _READ_ONLY_OPS):
                     # Mid-exchange death on a mutating op: the server may
@@ -238,8 +327,24 @@ class Client:
         policy, group-commit health (`groupCommit`: commits, records,
         covering fsyncs, max/mean batch, pending records) and watch
         fan-out counters (`watch`: coalesced/delivered/queued events) —
-        the operator's `etcdctl endpoint status` analog."""
+        the operator's `etcdctl endpoint status` analog. A replicated
+        control plane (ISSUE 11) adds `replication{role, term, leader,
+        seq, appliedSeq, commitSeq, quorum, followers[{sock, ackedSeq,
+        lagRecords, reachable}], lagRecords, quorumCommits,
+        quorumFailures, elections, ...}`."""
         return self.request(op="stateinfo")["stateinfo"]
+
+    def watch_poll(self, kind: str = "", since: int = 0) -> dict:
+        """Poll-based informer (ISSUE 11): committed, coalesced events
+        with resourceVersion > `since`, served by ANY replica — point a
+        watcher at a follower and the event stream scales horizontally.
+        Returns {"events": [{type, resource}...], "resourceVersion": rv,
+        "resync": bool}; resume with since=rv, and on resync=True
+        re-`list()` first (the cursor predates the server's ring)."""
+        r = self.request(op="watch.poll", kind=kind, since=int(since))
+        return {"events": r.get("events", []),
+                "resourceVersion": r.get("resourceVersion", 0),
+                "resync": bool(r.get("resync"))}
 
     def events(self, name: str, kind: str = "JAXJob") -> dict:
         """The per-job structured event log + conditions (the rebuild's

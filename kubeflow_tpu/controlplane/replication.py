@@ -1,0 +1,340 @@
+"""Python-side replication harness for the replicated control plane
+(ISSUE 11).
+
+Two tools, both speaking the C++ server's wire protocol:
+
+  * `FollowerSim` — a scriptable in-process follower: a unix-socket
+    server that answers `repl.append` / `repl.vote` / `repl.snapshot`
+    exactly as a real follower would (frame + CRC + seq verification,
+    so shipped-batch byte parity is checked on every append), with the
+    **`controlplane.replicate`** fault point (utils/faults.py) fired on
+    every arriving batch. Tests arm FailN/FailProb/Latency against it to
+    exercise quorum-degraded mode — one follower down must still ack,
+    a lost quorum must stall the leader and surface as
+    `ControlPlaneUnavailable` at the caller's deadline — without real
+    process kills.
+  * `ReplicaSet` — N real `tpk-controlplane` binaries wired into one
+    replica set (the kill-9 failover harness's and ctrlbench's shared
+    lifecycle): per-replica sockets/workdirs/WALs under one base dir,
+    `--peers` cross-wired, followers started with `--replica-of` the
+    first replica, leader discovery by polling `stateinfo.replication`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+import zlib
+
+from kubeflow_tpu.controlplane.client import Client, ClusterHandle
+from kubeflow_tpu.utils import faults
+
+_FP_REPLICATE = faults.register_point(
+    "controlplane.replicate",
+    "per shipped batch arriving at a (simulated) follower, before it "
+    "acks; ctx: op, prev_seq, records — FailN refuses the ack, Latency "
+    "delays it past the leader's ship timeout")
+
+
+def _tip_crc(data: bytes) -> int:
+    """CRC (from the frame header) of the LAST record in `data` — the
+    log-tip identity the leader's prevCrc consistency check compares."""
+    lines = [ln for ln in data.split(b"\n") if ln]
+    if not lines:
+        return 0
+    head, _, rest = lines[-1][3:].partition(b" ")
+    crc_hex = rest.split(b" ", 1)[0]
+    return int(crc_hex, 16)
+
+
+def parse_frames(data: bytes | str) -> list[tuple[int, dict]]:
+    """Split framed WAL bytes (`v1 <seq> <crc32hex> <json>\\n`) into
+    (seq, record) pairs, verifying each CRC — raises ValueError on any
+    mismatch. The Python mirror of cpp/store.cc's ParseFrame, used to
+    assert shipped-batch byte parity from the harness side."""
+    if isinstance(data, str):
+        data = data.encode()
+    out: list[tuple[int, dict]] = []
+    for line in data.split(b"\n"):
+        if not line:
+            continue
+        if not line.startswith(b"v1 "):
+            raise ValueError(f"unframed record: {line[:40]!r}")
+        head, _, payload = line[3:].partition(b" ")
+        crc_hex, _, payload = payload.partition(b" ")
+        if zlib.crc32(payload) & 0xFFFFFFFF != int(crc_hex, 16):
+            raise ValueError(f"crc mismatch at seq {int(head)}")
+        out.append((int(head), json.loads(payload)))
+    return out
+
+
+class FollowerSim:
+    """A fake follower replica: accepts the leader's replication verbs
+    on a real unix socket and acknowledges durably-shaped (in-memory)
+    appends. `grant_votes=False` makes it a non-voting bystander.
+
+    State exposed for assertions: `log` (the exact shipped bytes,
+    concatenated), `records` ((seq, record) pairs), `seq`,
+    `applied_seq`, `term`, `counts` ({appends, heartbeats, acks, nacks,
+    votes, snapshots})."""
+
+    def __init__(self, sock_path: str, grant_votes: bool = True):
+        self.sock_path = sock_path
+        self.grant_votes = grant_votes
+        self.log = b""
+        self.records: list[tuple[int, dict]] = []
+        self.seq = 0
+        self.tip_crc = 0  # crc of the record at seq (the divergence check)
+        self.applied_seq = 0
+        self.term = 0
+        self.snapshot: bytes = b""
+        self.counts = {"appends": 0, "heartbeats": 0, "acks": 0,
+                       "nacks": 0, "votes": 0, "snapshots": 0}
+        self._lock = threading.Lock()
+        self._listener: socket.socket | None = None
+        self._threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "FollowerSim":
+        if os.path.exists(self.sock_path):
+            os.unlink(self.sock_path)
+        self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._listener.bind(self.sock_path)
+        self._listener.listen(16)
+        self._listener.settimeout(0.2)
+        t = threading.Thread(target=self._accept_loop, daemon=True,
+                             name=f"tpk-followersim-{self.sock_path}")
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._listener is not None:
+            self._listener.close()
+        for t in self._threads:
+            t.join(timeout=5)
+        if os.path.exists(self.sock_path):
+            os.unlink(self.sock_path)
+
+    def __enter__(self) -> "FollowerSim":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve(self, conn: socket.socket) -> None:
+        conn.settimeout(0.5)
+        buf = b""
+        with conn:
+            while not self._stop.is_set():
+                try:
+                    chunk = conn.recv(1 << 20)
+                except socket.timeout:
+                    continue
+                except OSError:
+                    return
+                if not chunk:
+                    return
+                buf += chunk
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    if not line:
+                        continue
+                    try:
+                        resp = self.handle(json.loads(line))
+                    except Exception as e:  # noqa: BLE001 — wire boundary
+                        resp = {"ok": False, "error": str(e)}
+                    try:
+                        conn.sendall(json.dumps(resp).encode() + b"\n")
+                    except OSError:
+                        return
+
+    # -- protocol ----------------------------------------------------------
+
+    def handle(self, req: dict) -> dict:
+        op = req.get("op")
+        if op == "repl.append":
+            return self._handle_append(req)
+        if op == "repl.vote":
+            with self._lock:
+                self.counts["votes"] += 1
+                granted = self.grant_votes
+                if granted:
+                    self.term = max(self.term, int(req.get("term", 0)))
+                return {"ok": True, "granted": granted, "term": self.term}
+        if op == "repl.snapshot":
+            return self._handle_snapshot(req)
+        if op == "ping":
+            return {"ok": True, "pong": True}
+        return {"ok": False, "error": f"followersim: unknown op {op!r}"}
+
+    def _handle_append(self, req: dict) -> dict:
+        data = req.get("data", "").encode()
+        prev_seq = int(req.get("prevSeq", 0))
+        with self._lock:
+            t = int(req.get("term", 0))
+            if t < self.term:
+                self.counts["nacks"] += 1
+                return {"ok": False, "staleTerm": True, "term": self.term}
+            self.term = t
+            prev_crc = int(req.get("prevCrc", 0))
+            diverged = (prev_seq != self.seq
+                        or (prev_seq > 0 and prev_crc != self.tip_crc))
+            if not data:
+                self.counts["heartbeats"] += 1
+                if diverged:
+                    return {"ok": False, "needSnapshot": True,
+                            "seq": self.seq, "term": self.term}
+                self.applied_seq = min(int(req.get("commitSeq", 0)),
+                                       self.seq)
+                return {"ok": True, "seq": self.seq, "term": self.term}
+            self.counts["appends"] += 1
+        # Fault point OUTSIDE the lock (a Latency policy must be able to
+        # stall several concurrent appends, not serialize them). `sock`
+        # lets a test target ONE sim of a set (match={"sock": ...}).
+        faults.fire(_FP_REPLICATE, op="append", prev_seq=prev_seq,
+                    records=data.count(b"\n"), sock=self.sock_path)
+        with self._lock:
+            if (prev_seq != self.seq
+                    or (prev_seq > 0
+                        and int(req.get("prevCrc", 0)) != self.tip_crc)):
+                self.counts["nacks"] += 1
+                return {"ok": False, "needSnapshot": True,
+                        "seq": self.seq, "term": self.term}
+            try:
+                parsed = parse_frames(data)
+            except ValueError as e:
+                self.counts["nacks"] += 1
+                return {"ok": False, "error": str(e), "term": self.term}
+            expect = self.seq
+            for seq, _ in parsed:
+                expect += 1
+                if seq != expect:
+                    self.counts["nacks"] += 1
+                    return {"ok": False,
+                            "error": f"seq gap: {seq} != {expect}",
+                            "term": self.term}
+            self.log += data
+            self.records.extend(parsed)
+            self.seq = expect
+            self.tip_crc = _tip_crc(data) or self.tip_crc
+            self.applied_seq = min(int(req.get("commitSeq", 0)), self.seq)
+            self.counts["acks"] += 1
+            return {"ok": True, "seq": self.seq, "term": self.term}
+
+    def _handle_snapshot(self, req: dict) -> dict:
+        with self._lock:
+            t = int(req.get("term", 0))
+            if t < self.term:
+                return {"ok": False, "staleTerm": True, "term": self.term}
+            self.term = t
+            self.counts["snapshots"] += 1
+            self.snapshot = req.get("snapshot", "").encode()
+            wal = req.get("wal", "").encode()
+            frames = parse_frames(wal)
+            self.log = wal
+            self.records = list(frames)
+            self.seq = frames[-1][0] if frames else 0
+            self.tip_crc = _tip_crc(wal)
+            self.applied_seq = min(int(req.get("commitSeq", 0)), self.seq)
+            return {"ok": True, "seq": self.seq, "term": self.term}
+
+
+class ReplicaSet:
+    """N real control-plane binaries as one replica set. Replica 0 is
+    the bootstrap candidate (no --replica-of); the rest follow it at
+    startup. `client()` returns a replica-aware Client that follows
+    redirects and rotates across failover."""
+
+    def __init__(self, base: str, n: int = 3, lease_ms: int = 400,
+                 fsync: str = "interval", quorum_timeout_ms: int = 4000,
+                 extra_args: list[str] | None = None,
+                 client_timeout: float = 15.0):
+        base = str(base)
+        self.base = base
+        self.lease_ms = lease_ms
+        self.handles: list[ClusterHandle] = []
+        self.client_timeout = client_timeout
+        socks = [os.path.join(base, f"r{i}.sock") for i in range(n)]
+        for i in range(n):
+            peers = ",".join(s for j, s in enumerate(socks) if j != i)
+            args = ["--fsync", fsync, "--group-commit", "64",
+                    "--peers", peers, "--lease-ms", str(lease_ms),
+                    "--quorum-timeout-ms", str(quorum_timeout_ms)]
+            if i > 0:
+                args += ["--replica-of", socks[0]]
+            args += list(extra_args or [])
+            self.handles.append(ClusterHandle(base, f"r{i}", args,
+                                              client_timeout=client_timeout))
+            # ClusterHandle derives <base>/<label>.sock — matches socks[i].
+            assert self.handles[-1].sock == socks[i]
+        self.socks = socks
+
+    def start(self) -> None:
+        for h in self.handles:
+            h.start().close()
+
+    def stop(self) -> None:
+        for h in self.handles:
+            h.stop()
+
+    def client(self, **kw) -> Client:
+        kw.setdefault("timeout", self.client_timeout)
+        return Client(self.socks[0], replicas=self.socks[1:], **kw)
+
+    def stateinfo(self, i: int) -> dict | None:
+        """One replica's stateinfo, None when it is down/unreachable."""
+        from kubeflow_tpu.controlplane.client import (ControlPlaneError,
+                                                      ControlPlaneUnavailable)
+
+        c = Client(self.socks[i], timeout=5, max_attempts=1, deadline_s=5)
+        try:
+            return c.stateinfo()
+        except (ControlPlaneUnavailable, ControlPlaneError, OSError):
+            return None
+        finally:
+            c.close()
+
+    def leader_index(self) -> int | None:
+        for i in range(len(self.handles)):
+            info = self.stateinfo(i)
+            if info and info.get("replication", {}).get("role") == "leader":
+                return i
+        return None
+
+    def wait_leader(self, timeout: float = 15.0,
+                    exclude: int | None = None) -> int:
+        """Block until some replica (optionally excluding one index)
+        reports role=leader; returns its index."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            for i in range(len(self.handles)):
+                if i == exclude:
+                    continue
+                info = self.stateinfo(i)
+                if (info and info.get("replication", {})
+                        .get("role") == "leader"):
+                    return i
+            time.sleep(0.1)
+        raise TimeoutError(
+            f"no leader emerged within {timeout}s "
+            f"(exclude={exclude}, lease={self.lease_ms}ms)")

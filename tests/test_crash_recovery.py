@@ -247,6 +247,206 @@ def test_kill9_between_apply_and_covering_fsync(tmp_path, point, seed):
         cluster.stop()
 
 
+# -- leader failover (ISSUE 11) ------------------------------------------
+#
+# The replicated extension of the group-commit windows above: SIGKILL the
+# LEADER of a 3-replica set inside the quorum-commit path and prove the
+# failover invariant — every ACKED mutation is served by the promoted
+# follower, every unacked one is provably lost-or-applied-never-acked —
+# plus job phases converging to the crash-free control run's.
+
+#: Replicated control jobs: a subset of JOBS (time-bounded — each crash
+#: window runs a full 3-binary cluster) with known terminal phases.
+REPL_JOBS = [("ok-a", "sleep 0.3", "OnFailure"),
+             ("fail-b", "exit 7", "Never")]
+REPL_CONTROL = {"ok-a": "Succeeded", "fail-b": "Failed"}
+
+
+def _replica_set(tmp_path, lease_ms=400):
+    from kubeflow_tpu.controlplane.replication import ReplicaSet
+
+    os.environ.setdefault("TPK_CONTROLPLANE_BIN", BIN)
+    return ReplicaSet(str(tmp_path), n=3, lease_ms=lease_ms,
+                      fsync="always", quorum_timeout_ms=4000)
+
+
+@pytest.fixture(scope="module")
+def repl_control_phases(tmp_path_factory):
+    """Crash-free REPLICATED control run: the phases every crashed run
+    must converge to (and proof the pinned expectations hold on this
+    host before any kill muddies the water)."""
+    rs = _replica_set(tmp_path_factory.mktemp("repl-control"))
+    rs.start()
+    try:
+        lead = rs.wait_leader()
+        client = rs.client()
+        try:
+            for name, cmd, policy in REPL_JOBS:
+                client.submit_jaxjob(name, _spec(cmd, policy))
+            phases = _wait_all(client, [n for n, _, _ in REPL_JOBS])
+        finally:
+            client.close()
+        assert phases == REPL_CONTROL, (lead, phases)
+        return phases
+    finally:
+        rs.stop()
+
+
+@pytest.mark.parametrize("point,seed", [
+    ("repl.pre-ship", 5), ("repl.pre-ship", 11), ("repl.pre-ship", 23),
+    ("repl.post-ship-pre-quorum", 5), ("repl.post-ship-pre-quorum", 11),
+    ("repl.post-ship-pre-quorum", 23),
+    ("repl.post-quorum-pre-release", 5),
+    ("repl.post-quorum-pre-release", 11),
+    ("repl.post-quorum-pre-release", 23),
+])
+def test_kill9_leader_failover_windows(tmp_path, point, seed):
+    """TPK_CRASH_AT SIGKILLs the LEADER on the n-th hit of a quorum-
+    commit window (`pre-ship`: nothing shipped, nothing durable;
+    `post-ship-pre-quorum`: followers may hold it, leader does not;
+    `post-quorum-pre-release`: majority-durable, reply never sent).
+    Widget-only on purpose: with no jobs there are no controller
+    batches, so the n-th window hit IS the n-th create's batch and the
+    per-window claims are deterministic — pre-ship's crashed mutation is
+    provably lost, post-quorum's provably applied (the election
+    restriction: no electable majority lacks it), never acked either
+    way. The failover invariant in every case: acked ⇒ the promoted
+    follower serves it. Seed in every assertion: `-k <point>-<seed>`
+    replays the schedule."""
+    rng = random.Random(seed)
+    n_crash = rng.randint(3, 9)
+    rs = _replica_set(tmp_path)
+    os.environ["TPK_CRASH_AT"] = f"{point}:{n_crash}"
+    try:
+        rs.handles[0].start().close()  # only the leader gets the window
+    finally:
+        del os.environ["TPK_CRASH_AT"]
+    for h in rs.handles[1:]:
+        h.start().close()
+    acked: list[str] = []
+    unacked: list[str] = []
+    client = None
+    try:
+        assert rs.wait_leader(timeout=15) == 0, f"seed={seed}"
+        from kubeflow_tpu.controlplane.client import Client
+
+        # Single-shot client at the leader: an exception IS "never
+        # acked" (no retry may mask the outcome), the bookkeeping the
+        # invariant is stated over. Sequential creates: one create per
+        # batch per covering quorum commit, so the n-th create dies
+        # inside the window with its reply held.
+        raw = Client(rs.socks[0], timeout=10, max_attempts=1,
+                     deadline_s=10)
+        for i in range(n_crash + 3):
+            name = f"w{i}"
+            try:
+                raw.create("Widget", name, {"i": i})
+                acked.append(name)
+            except Exception:
+                unacked.append(name)
+                break
+        raw.close()
+        assert unacked, (
+            f"seed={seed} {point}:{n_crash}: leader never crashed — "
+            f"the window did not fire")
+        rs.handles[0].proc.wait(timeout=10)  # SIGKILL'd itself
+
+        promoted = rs.wait_leader(timeout=20, exclude=0)
+        client = rs.client()
+        client._retarget(rs.socks[promoted])
+        present = {r["name"] for r in client.list("Widget")}
+        # THE invariant: acked ⇒ served by the promoted follower.
+        missing = [n for n in acked if n not in present]
+        assert not missing, (
+            f"seed={seed} {point}:{n_crash}: acked mutations missing "
+            f"after failover to r{promoted}: {missing} "
+            f"(present: {sorted(present)})")
+        if point == "repl.pre-ship":
+            # Nothing was shipped and nothing was locally durable: the
+            # crashed mutation is provably lost.
+            assert unacked[0] not in present, (
+                f"seed={seed}: {unacked[0]} survived a pre-ship kill — "
+                f"the window did not land where it claims")
+        if point == "repl.post-quorum-pre-release":
+            # Majority-durable: the election restriction (longest log
+            # wins) means no electable leader lacks it —
+            # applied-never-acked, the legal outcome.
+            assert unacked[0] in present, (
+                f"seed={seed}: quorum-durable {unacked[0]} lost by "
+                f"failover — election picked a short log")
+        # The promoted leader keeps serving writes on the same set.
+        client.create("Widget", "after-failover", {"i": -1})
+        assert client.get("Widget", "after-failover")["spec"]["i"] == -1
+        info = client.stateinfo()
+        assert not info["walBroken"], f"seed={seed}: {info}"
+        assert info["replication"]["role"] == "leader"
+        assert info["replication"]["quorumCommits"] > 0, info["replication"]
+    finally:
+        if client is not None:
+            client.close()
+        rs.stop()
+
+
+def test_kill9_leader_failover_jobs_converge_to_control(
+        tmp_path, repl_control_phases):
+    """The jobs-level failover proof: kill the leader mid-run (first
+    quorum batch after both submits — job status churn keeps hitting
+    the window), let a follower promote and Recover(), re-drive
+    whatever was never acked, and the promoted leader must converge to
+    the crash-free control run's phases."""
+    rs = _replica_set(tmp_path)
+    os.environ["TPK_CRASH_AT"] = "repl.post-ship-pre-quorum:6"
+    try:
+        rs.handles[0].start().close()
+    finally:
+        del os.environ["TPK_CRASH_AT"]
+    for h in rs.handles[1:]:
+        h.start().close()
+    client = None
+    try:
+        assert rs.wait_leader(timeout=15) == 0
+        from kubeflow_tpu.controlplane.client import Client
+
+        raw = Client(rs.socks[0], timeout=10, max_attempts=1,
+                     deadline_s=10)
+        submitted: list[str] = []
+        try:
+            for name, cmd, policy in REPL_JOBS:
+                raw.submit_jaxjob(name, _spec(cmd, policy))
+                submitted.append(name)
+        except Exception:
+            pass  # died mid-submit; re-driven below
+        # Drive the window with status-bearing batches if the submits
+        # alone did not reach it.
+        for i in range(30):
+            try:
+                raw.create("Widget", f"tick{i}", {"i": i})
+            except Exception:
+                break
+            time.sleep(0.05)
+        raw.close()
+        rs.handles[0].proc.wait(timeout=15)
+
+        promoted = rs.wait_leader(timeout=20, exclude=0)
+        client = rs.client()
+        client._retarget(rs.socks[promoted])
+        have = {r["name"] for r in client.list("JAXJob")}
+        # Acked submits must already be there (the invariant again).
+        missing = [n for n in submitted if n not in have]
+        assert not missing, (missing, sorted(have))
+        for name, cmd, policy in REPL_JOBS:
+            if name not in have:
+                client.submit_jaxjob(name, _spec(cmd, policy))
+        phases = _wait_all(client, [n for n, _, _ in REPL_JOBS])
+        assert phases == repl_control_phases, (
+            f"phases after leader failover {phases} != crash-free "
+            f"control {repl_control_phases}")
+    finally:
+        if client is not None:
+            client.close()
+        rs.stop()
+
+
 def test_compaction_bounds_replay_after_restart(tmp_path):
     """After >threshold writes, a restart replays snapshot + short tail
     (verified record count), with resourceVersions continuing

@@ -563,6 +563,89 @@ def test_deleting_ack_marker_turns_red(tmp_path):
     assert "ack-after-durable: release" in fs[0].message
 
 
+# -- rule: ack-after-quorum (ISSUE 11) --------------------------------------
+
+
+def _copy_replica(tmp_path):
+    rel = "cpp/replica.cc"
+    dst = tmp_path / rel
+    dst.parent.mkdir(parents=True, exist_ok=True)
+    shutil.copy(os.path.join(REPO, rel), dst)
+    return dst
+
+
+def test_ack_after_quorum_real_files_are_clean(tmp_path):
+    _copy_server(tmp_path)
+    _copy_replica(tmp_path)
+    assert lint(tmp_path, rules=["ack-after-quorum"]) == []
+
+
+def test_ack_after_quorum_silent_in_fixture_trees(tmp_path):
+    assert lint(tmp_path, {"cpp/other.cc": "int x;\n"},
+                ["ack-after-quorum"]) == []
+
+
+def test_release_before_quorum_wait_turns_red(tmp_path):
+    """THE red switch: a copy of the real server.cc where staged
+    replies flush BEFORE the quorum wait (marker order swapped — the
+    textual equivalent of releasing acks while a minority holds the
+    batch) must be flagged."""
+    dst = _copy_server(tmp_path)
+    _copy_replica(tmp_path)
+    src = dst.read_text()
+    qmark = "// ack-after-quorum: quorum-wait"
+    rmark = "// ack-after-durable: release"
+    assert qmark in src and rmark in src
+    mutated = (src.replace(qmark, "@@TMP@@")
+                  .replace(rmark, qmark)
+                  .replace("@@TMP@@", rmark))
+    dst.write_text(mutated)
+    fs = lint(tmp_path, rules=["ack-after-quorum"])
+    assert len(fs) == 1
+    assert "minority holds the batch" in fs[0].message
+
+
+def test_deleting_quorum_wait_marker_turns_red(tmp_path):
+    dst = _copy_server(tmp_path)
+    _copy_replica(tmp_path)
+    src = dst.read_text()
+    dst.write_text(src.replace("// ack-after-quorum: quorum-wait",
+                               "// gone"))
+    fs = lint(tmp_path, rules=["ack-after-quorum"])
+    assert len(fs) == 1
+    assert "ack-after-quorum: quorum-wait" in fs[0].message
+
+
+def test_apply_before_term_check_turns_red(tmp_path):
+    """Follower-path red switch: a copy of the real replica.cc whose
+    apply marker precedes the term check (fencing bypassed) must be
+    flagged."""
+    _copy_server(tmp_path)
+    dst = _copy_replica(tmp_path)
+    src = dst.read_text()
+    tmark = "// ack-after-quorum: term-check"
+    amark = "// ack-after-quorum: apply"
+    assert tmark in src and amark in src
+    mutated = (src.replace(tmark, "@@TMP@@")
+                  .replace(amark, tmark)
+                  .replace("@@TMP@@", amark))
+    dst.write_text(mutated)
+    fs = lint(tmp_path, rules=["ack-after-quorum"])
+    assert len(fs) == 1
+    assert "fencing bypassed" in fs[0].message
+
+
+def test_deleting_term_check_marker_turns_red(tmp_path):
+    _copy_server(tmp_path)
+    dst = _copy_replica(tmp_path)
+    src = dst.read_text()
+    dst.write_text(src.replace("// ack-after-quorum: term-check",
+                               "// gone"))
+    fs = lint(tmp_path, rules=["ack-after-quorum"])
+    assert len(fs) == 1
+    assert "ack-after-quorum: term-check" in fs[0].message
+
+
 def test_bare_fwrite_in_group_commit_turns_red(tmp_path):
     """cpp-checked-io coverage of the new commit path: a copy of the
     real store.cc whose covering batch fwrite stops checking its return

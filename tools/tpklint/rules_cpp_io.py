@@ -34,6 +34,24 @@ before the covering fsync) that would silently void the
 acknowledged-mutation-is-never-lost contract. Like every marker-pinned
 rule, it checks the annotated sites, not arbitrary reorderings of
 unannotated code.
+
+Rule `ack-after-quorum` (ISSUE 11) extends the same contract to the
+replicated control plane. Two orderings, two homes:
+
+    cpp/server.cc:
+      // ack-after-quorum: quorum-wait  <- CommitQuorum (ship + wait)
+      must precede `// ack-after-durable: release` — a staged reply
+      flushed before the quorum wait acknowledges a batch a minority
+      holds, exactly the loss the failover harness would catch only
+      under a crash.
+    cpp/replica.cc:
+      // ack-after-quorum: term-check   <- stale-term rejection
+      // ack-after-quorum: apply        <- ApplyReplicatedUpTo
+      term-check must precede apply in the follower append path — an
+      apply before the fencing would let a deposed leader mutate a
+      follower that already voted in a newer term.
+
+Deleting any of the four markers is a finding.
 """
 
 from __future__ import annotations
@@ -219,4 +237,69 @@ def check_ack(ctx: Context) -> list[Finding]:
             "(release marker precedes commit marker) — an acknowledged "
             "mutation could be lost to a crash after its ack was "
             "already on the socket"))
+    return findings
+
+
+RULE_QUORUM = "ack-after-quorum"
+#: The follower append path's home; like ACK_HOME, absent in fixture
+#: trees (silent), REQUIRED once present.
+QUORUM_FOLLOWER_HOME = "cpp/replica.cc"
+_QUORUM_MARK = re.compile(
+    r"//\s*ack-after-quorum:\s*(quorum-wait|term-check|apply)\b")
+
+
+def _marker_lines(text: str) -> dict[str, list[int]]:
+    marks: dict[str, list[int]] = {}
+    for i, ln in enumerate(text.splitlines(), start=1):
+        m = _QUORUM_MARK.search(ln)
+        if m:
+            marks.setdefault(m.group(1), []).append(i)
+        m2 = _ACK_MARK.search(ln)
+        if m2:
+            marks.setdefault(m2.group(1), []).append(i)
+    return marks
+
+
+@rule(RULE_QUORUM, "replication ordering markers: quorum-wait before "
+                   "staged-reply release in cpp/server.cc; term-check "
+                   "before apply in cpp/replica.cc's follower path — "
+                   "all four markers pinned")
+def check_quorum(ctx: Context) -> list[Finding]:
+    findings: list[Finding] = []
+    server = ctx.read(ACK_HOME)
+    if server is not None:
+        marks = _marker_lines(server)
+        if not marks.get("quorum-wait"):
+            findings.append(Finding(
+                RULE_QUORUM, ACK_HOME, 1,
+                "required marker `// ack-after-quorum: quorum-wait` is "
+                "missing — the replicated release ordering is no longer "
+                "pinned (restore it on the CommitQuorum call)"))
+        elif marks.get("release") and \
+                min(marks["release"]) < min(marks["quorum-wait"]):
+            findings.append(Finding(
+                RULE_QUORUM, ACK_HOME, min(marks["release"]),
+                "staged replies are released BEFORE the quorum wait "
+                "(release marker precedes quorum-wait marker) — an ack "
+                "could reach the socket while only a minority holds the "
+                "batch, voiding acked-implies-survives-failover"))
+    follower = ctx.read(QUORUM_FOLLOWER_HOME)
+    if follower is not None:
+        marks = _marker_lines(follower)
+        for name, where in (("term-check", "the stale-term rejection"),
+                            ("apply", "the ApplyReplicatedUpTo call")):
+            if not marks.get(name):
+                findings.append(Finding(
+                    RULE_QUORUM, QUORUM_FOLLOWER_HOME, 1,
+                    f"required marker `// ack-after-quorum: {name}` is "
+                    f"missing — the follower append ordering is no "
+                    f"longer pinned (restore it on {where})"))
+        if marks.get("term-check") and marks.get("apply") and \
+                min(marks["apply"]) < min(marks["term-check"]):
+            findings.append(Finding(
+                RULE_QUORUM, QUORUM_FOLLOWER_HOME, min(marks["apply"]),
+                "follower applies shipped records BEFORE the term check "
+                "(apply marker precedes term-check marker) — a deposed "
+                "leader could mutate a follower that already voted in a "
+                "newer term (fencing bypassed)"))
     return findings
