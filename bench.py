@@ -416,6 +416,31 @@ def main_routerbench() -> None:
     }))
 
 
+def main_disaggbench() -> None:
+    """`python bench.py --disaggbench`: disaggregated-prefill/decode
+    vs unified fleet A/B → DISAGGBENCH.json + one JSON line
+    (kubeflow_tpu/serve/disaggbench.py).
+
+    REAL tiny engines on CPU behind real ModelServers and the real
+    router, equal engines per arm, open-loop Poisson mixed
+    long-prompt/short-decode traffic; records goodput, p50/p99 TTFT,
+    decode-tail p99 and the wire-format mechanism counters. Chip row
+    recorded skipped-with-reason while the tunnel is down."""
+    from kubeflow_tpu.serve.disaggbench import run_disaggbench
+
+    result = run_disaggbench(quick="--quick" in sys.argv)
+    with open("DISAGGBENCH.json", "w") as fh:
+        json.dump(result, fh, indent=1)
+    print(json.dumps({
+        "metric": "disaggbench_ttft_p99_ratio",
+        "value": result.get("ttft_p99_ratio"),
+        "unit": "disagg_over_unified",
+        "goodput_ratio": result.get("goodput_ratio"),
+        "decode_tail_p99_ratio": result.get("decode_tail_p99_ratio"),
+        "detail": "DISAGGBENCH.json",
+    }))
+
+
 def main_longctx() -> None:
     """`python bench.py --longctx`: the long-context evidence row
     (PROFILE.md §6). On a live chip: measured tok/s + MFU at s>=2048
@@ -590,6 +615,8 @@ if __name__ == "__main__":
         main_ctrlbench()
     elif "--routerbench" in sys.argv:
         main_routerbench()
+    elif "--disaggbench" in sys.argv:
+        main_disaggbench()
     elif "--serve" in sys.argv:
         main_serve()
     elif "--longctx-tune" in sys.argv:

@@ -274,19 +274,24 @@ def cmd_replicas(args) -> int:
     if args.json:
         print(json.dumps(out, indent=2))
         return 0
-    fmt = "{:<12} {:<28} {:<9} {:>9} {:>8} {:>8} {:>10}"
-    print(fmt.format("NAME", "ADDRESS", "STATE", "OUT", "INFLIGHT",
-                     "KV_FREE", "SCRAPE_AGE"))
+    fmt = "{:<12} {:<28} {:<8} {:<9} {:>9} {:>8} {:>8} {:>10}"
+    print(fmt.format("NAME", "ADDRESS", "ROLE", "STATE", "OUT",
+                     "INFLIGHT", "KV_FREE", "SCRAPE_AGE"))
 
     def cell(v, unit=""):
         return "-" if v is None else f"{v:g}{unit}"
 
     for r in out.get("replicas", []):
-        print(fmt.format(r["name"], r["url"], r["state"],
-                         str(r["outstanding"]),
+        print(fmt.format(r["name"], r["url"], r.get("role", "any"),
+                         r["state"], str(r["outstanding"]),
                          cell(r["decode_inflight"]),
                          cell(r["kv_blocks_free"]),
                          cell(r["scrape_age_s"], "s")))
+    handoffs = out.get("router", {}).get("handoffs", 0)
+    if handoffs:
+        print(f"disagg: handoffs={handoffs} "
+              f"handoff_retries="
+              f"{out['router'].get('handoff_retries', 0)}")
     stats = out.get("router", {})
     if stats:
         print(f"router: placed={stats.get('placed', 0)} "

@@ -112,6 +112,13 @@ class LlamaConfig:
     mask_kind: str = "causal"
     mask_window: int = 0
     mask_prefix: int = 0
+    # Weight-only int8 serving (serve/quant.py): dense/embed sites
+    # consume Int8Leaf params natively — raw-int8 matmul operands with
+    # the per-channel scale applied OUTPUT-side, so no full-size
+    # dequantized weight is ever materialized (the SERVEBENCH 0.747x
+    # fix). Only QuantizedModule sets this; the default False path
+    # constructs exactly the historical modules.
+    quantized_dense: bool = False
 
     @property
     def mask_spec(self):
@@ -136,6 +143,18 @@ class LlamaConfig:
         per_layer = attn + mlp + norms
         emb = v * h * (1 if self.tie_embeddings else 2)
         return self.num_layers * per_layer + emb + h
+
+
+def _dense_cls(cfg: LlamaConfig):
+    """The projection layer class: `nn.DenseGeneral` normally, its
+    Int8Leaf-aware twin under quantized serving (cfg.quantized_dense —
+    see serve/quant.py Int8DenseGeneral: raw-int8 matmul operand,
+    output-side scale). Resolved per call so the default path has zero
+    import-time coupling to the serve package."""
+    if not cfg.quantized_dense:
+        return nn.DenseGeneral
+    from kubeflow_tpu.serve.quant import Int8DenseGeneral
+    return Int8DenseGeneral
 
 
 def llama3_8b() -> LlamaConfig:
@@ -308,7 +327,7 @@ class Attention(nn.Module):
                  rope_local: tuple | None = None):
         cfg = self.cfg
         dense = partial(
-            nn.DenseGeneral, use_bias=False, dtype=cfg.dtype,
+            _dense_cls(cfg), use_bias=False, dtype=cfg.dtype,
             param_dtype=cfg.param_dtype)
         qkv_bias = dict()
         if cfg.attention_bias:
@@ -584,7 +603,7 @@ class MLPBlock(nn.Module):
     def __call__(self, x, adapter: dict | None = None,
                  adapter_ids: jax.Array | None = None):
         cfg = self.cfg
-        dense = partial(nn.DenseGeneral, use_bias=False, dtype=cfg.dtype,
+        dense = partial(_dense_cls(cfg), use_bias=False, dtype=cfg.dtype,
                         param_dtype=cfg.param_dtype)
         lora_mlp = cfg.lora_rank > 0 and cfg.lora_targets == "attn_mlp"
         multi_mlp = adapter is not None and "gate_proj" in adapter
@@ -730,7 +749,13 @@ class Llama(nn.Module):
             "embed", nn.with_logical_partitioning(
                 nn.initializers.normal(0.02), ("vocab", "embed")),
             (cfg.vocab_size, cfg.hidden_size), cfg.param_dtype)
-        x = embed.astype(cfg.dtype)[tokens]
+        if cfg.quantized_dense:
+            # Int8-aware gather: rows dequantize AFTER the lookup
+            # ([B,S,D] work, not [V,D] per call — see serve/quant.py).
+            from kubeflow_tpu.serve.quant import quant_embed_lookup
+            x = quant_embed_lookup(embed, tokens, cfg.dtype)
+        else:
+            x = embed.astype(cfg.dtype)[tokens]
         if cfg.embed_scale:
             # Gemma scales token embeddings by sqrt(hidden) at input; the
             # multiplier is cast to the activation dtype first (HF rounds
@@ -827,9 +852,14 @@ class Llama(nn.Module):
             # logits buffer is never materialized (ops/ROADMAP.md item 1).
             return (x, new_cache) if cache is not None else x
         if cfg.tie_embeddings:
-            logits = jnp.einsum("bsh,vh->bsv", x, embed.astype(cfg.dtype))
+            if cfg.quantized_dense:
+                from kubeflow_tpu.serve.quant import quant_unembed
+                logits = quant_unembed(x, embed, cfg.dtype)
+            else:
+                logits = jnp.einsum("bsh,vh->bsv", x,
+                                    embed.astype(cfg.dtype))
         else:
-            logits = nn.DenseGeneral(
+            logits = _dense_cls(cfg)(
                 features=cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
                 param_dtype=cfg.param_dtype,
                 kernel_init=nn.with_logical_partitioning(

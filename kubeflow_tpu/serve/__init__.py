@@ -23,6 +23,7 @@ _EXPORTS = {
     "DEADLINE_HEADER": "kubeflow_tpu.serve.headers",
     "Fleet": "kubeflow_tpu.serve.fleet",
     "FleetAutoscaler": "kubeflow_tpu.serve.fleet",
+    "HostKVTier": "kubeflow_tpu.serve.kv_transfer",
     "JAXModel": "kubeflow_tpu.serve.model",
     "Model": "kubeflow_tpu.serve.model",
     "ModelRepository": "kubeflow_tpu.serve.server",

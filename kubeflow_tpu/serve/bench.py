@@ -153,7 +153,16 @@ def bench_ttft(model, params, cfg, *, max_len: int, chunk: int, buckets,
 
 def bench_quant(model, params, cfg, *, max_len: int, chunk: int, buckets,
                 decode_tokens: int, rng: np.random.Generator) -> dict:
-    """Weight-only int8 vs bf16 decode throughput + HBM saving."""
+    """Weight-only int8 vs bf16 decode throughput + HBM saving.
+
+    Three arms since the dequant-placement fix (ROADMAP item 4 first
+    half): `int8` is the FIXED path (Int8DenseGeneral — raw-int8 matmul
+    operand, output-side scale, no full-weight dequant anywhere in the
+    program), `int8_legacy` the old dequantize-per-apply wrapper that
+    SERVEBENCH pinned at 0.747x bf16 (the per-step full-weight multiply
+    inside the decode scan). The HLO-shape guard in
+    tests/test_quant_dequant.py pins the mechanism on CPU; this row
+    records the throughput outcome whenever a chip window runs it."""
     from kubeflow_tpu.serve.generation import GenerationEngine
     from kubeflow_tpu.serve.quant import (QuantizedModule, quantize_tree,
                                           quantized_bytes)
@@ -161,8 +170,12 @@ def bench_quant(model, params, cfg, *, max_len: int, chunk: int, buckets,
     res = {}
     qparams = quantize_tree(params)
     sizes = quantized_bytes(qparams)
-    for label, m, p in (("bf16", model, params),
-                        ("int8", QuantizedModule(model, cfg.dtype), qparams)):
+    for label, m, p in (
+            ("bf16", model, params),
+            ("int8", QuantizedModule(model, cfg.dtype), qparams),
+            ("int8_legacy",
+             QuantizedModule(model, cfg.dtype, legacy_dequant=True),
+             qparams)):
         eng = GenerationEngine(m, p, cfg, slots=4, max_len=max_len,
                                chunk=chunk, prefill_buckets=buckets,
                                prefix_cache=0)
@@ -177,7 +190,12 @@ def bench_quant(model, params, cfg, *, max_len: int, chunk: int, buckets,
     return {
         "bf16_tok_s": round(res["bf16"], 1),
         "int8_tok_s": round(res["int8"], 1),
+        "int8_legacy_tok_s": round(res["int8_legacy"], 1),
         "int8_vs_bf16": round(res["int8"] / max(res["bf16"], 1e-9), 3),
+        "int8_legacy_vs_bf16": round(
+            res["int8_legacy"] / max(res["bf16"], 1e-9), 3),
+        "fixed_vs_legacy": round(
+            res["int8"] / max(res["int8_legacy"], 1e-9), 3),
         "param_bytes": sizes,
     }
 

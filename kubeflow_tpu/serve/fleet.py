@@ -52,6 +52,21 @@ STATES = ("starting", "ready", "draining", "drained", "down")
 #: Consecutive probe failures before a replica is marked down.
 DOWN_AFTER_FAILURES = 3
 
+#: Replica roles (disaggregated prefill/decode, ISSUE 13). "any" is the
+#: unified default; role-split fleets register prefill-heavy and
+#: decode-heavy replicas and the router runs the two-phase handoff.
+REPLICA_ROLES = ("any", "prefill", "decode")
+
+#: Placement intent → replica roles that can serve it. `None`
+#: (metadata/control traffic, and every load accessor's default) spans
+#: every role; a FULL generate needs a replica that runs both phases
+#: ("generate"); the split intents take their phase's specialists plus
+#: unified replicas.
+INTENT_ROLES = {None: REPLICA_ROLES,
+                "generate": ("any",),
+                "prefill": ("any", "prefill"),
+                "decode": ("any", "decode")}
+
 #: Drain-completion grace for replicas that expose NO in-flight gauge
 #: (admission disabled / non-generative): their own traffic is
 #: unobservable, so the drain holds this long past drain start instead
@@ -63,15 +78,24 @@ class Replica:
     """One replica's record. Instances are internal to the Fleet (mutated
     under its lock); the router sees `snapshot()` copies."""
 
-    __slots__ = ("name", "url", "grpc", "state", "outstanding",
+    __slots__ = ("name", "url", "grpc", "role", "state", "outstanding",
                  "decode_inflight", "admission_inflight", "kv_blocks_free",
                  "last_scrape", "scrape_failures", "on_drained",
                  "draining_since", "probe_ready")
 
-    def __init__(self, name: str, url: str, grpc: str | None = None):
+    def __init__(self, name: str, url: str, grpc: str | None = None,
+                 role: str = "any"):
+        if role not in REPLICA_ROLES:
+            raise ValueError(
+                f"replica role {role!r}: must be one of {REPLICA_ROLES}")
         self.name = name
         self.url = url.rstrip("/")
         self.grpc = grpc
+        #: Disaggregation role (ISSUE 13): "any" serves every surface
+        #: (the unified default); "prefill"/"decode" replicas only take
+        #: their phase's placements — the router keys placement intents
+        #: against this.
+        self.role = role
         self.state = "starting"
         #: Router-owned live count of requests this process has in
         #: flight against the replica — fresher than any scrape.
@@ -110,10 +134,14 @@ class Replica:
         return (self.state in ("starting", "ready")
                 and self.probe_ready is not False)
 
+    def serves(self, intent: str | None) -> bool:
+        return self.role in INTENT_ROLES[intent]
+
     def view(self) -> dict:
         """JSON-safe copy for admin/CLI surfaces."""
         return {
             "name": self.name, "url": self.url, "grpc": self.grpc,
+            "role": self.role,
             "state": self.state, "ready": self.probe_ready,
             "outstanding": self.outstanding,
             "decode_inflight": self.decode_inflight,
@@ -192,15 +220,17 @@ class Fleet:
 
     # -- membership ---------------------------------------------------------
 
-    def add(self, name: str, url: str, grpc: str | None = None) -> None:
+    def add(self, name: str, url: str, grpc: str | None = None,
+            role: str = "any") -> None:
         """Register a replica (idempotent on the same address; a new
-        address replaces the record — the controller relaunched it)."""
+        address or role replaces the record — the controller relaunched
+        it)."""
         with self._lock:
             cur = self._replicas.get(name)
             if cur is not None and cur.url == url.rstrip("/") \
-                    and cur.grpc == grpc:
+                    and cur.grpc == grpc and cur.role == role:
                 return
-            self._replicas[name] = Replica(name, url, grpc)
+            self._replicas[name] = Replica(name, url, grpc, role=role)
             client = self._grpc_clients.pop(name, None)
             self._version += 1
             n = len(self._replicas)
@@ -264,15 +294,45 @@ class Fleet:
         with self._lock:
             return [r.view() for _, r in sorted(self._replicas.items())]
 
-    def loads(self, names=None) -> dict[str, float]:
+    def loads(self, names=None, intent: str | None = None) -> dict[str, float]:
         """name -> load score for the given (default: placeable)
-        replicas. One lock hop, no I/O — safe on the placement path."""
+        replicas, optionally filtered by placement `intent` ("prefill" /
+        "decode" / None = full-request — see INTENT_ROLES). One lock
+        hop, no I/O — safe on the placement path."""
         with self._lock:
             if names is None:
                 return {n: r.load() for n, r in self._replicas.items()
-                        if r.placeable()}
+                        if r.placeable() and r.serves(intent)}
             return {n: self._replicas[n].load() for n in names
                     if n in self._replicas}
+
+    def signals(self, intent: str | None = None) -> dict[str, tuple]:
+        """name -> (load, kv_blocks_free) for placeable replicas
+        serving `intent` — the decode-phase placement reads pool
+        headroom alongside load (ISSUE 13: decode placement is
+        load/pool-driven). One lock hop."""
+        with self._lock:
+            return {n: (r.load(), r.kv_blocks_free or 0.0)
+                    for n, r in self._replicas.items()
+                    if r.placeable() and r.serves(intent)}
+
+    def role_split(self) -> bool:
+        """True when the fleet contains a placeable SPLIT replica
+        (prefill or decode role) whose complementary phase is also
+        covered (by the other split role, or by an "any" replica) —
+        the router runs the two-phase handoff for generative traffic
+        iff this holds. Symmetric on purpose: an "any"+"decode" fleet
+        disaggregates (the "any" replica prefills, the decode
+        specialists decode) just like "any"+"prefill" — otherwise the
+        decode-role replicas, whose engines refuse local :generate,
+        would sit silently stranded."""
+        with self._lock:
+            roles = {r.role for r in self._replicas.values()
+                     if r.placeable()}
+        prefill_capable = "prefill" in roles or "any" in roles
+        decode_capable = "decode" in roles or "any" in roles
+        has_split = "prefill" in roles or "decode" in roles
+        return has_split and prefill_capable and decode_capable
 
     def get(self, name: str) -> dict | None:
         with self._lock:

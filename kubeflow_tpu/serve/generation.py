@@ -45,10 +45,22 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from kubeflow_tpu.serve.kv_transfer import (HostKVTier, ShipmentError,
+                                            pack_shipment,
+                                            unpack_shipment)
 from kubeflow_tpu.serve.model import Model
 from kubeflow_tpu.serve.paging import BlockAllocator, blocks_for
 from kubeflow_tpu.utils import obs
 from kubeflow_tpu.utils.resilience import Deadline, DeadlineExceeded
+
+#: Engine roles (disaggregated prefill/decode, ISSUE 13). "unified" is
+#: the escape hatch — today's engine bit-for-bit, serving both phases
+#: from one loop. A "prefill" engine only chunk-prefills and SHIPS
+#: committed KV blocks (prefill_ship); a "decode" engine only admits
+#: shipped blocks (submit_remote) and never runs a prefill chunk, so
+#: long-prompt admission cannot steal decode dispatches from in-flight
+#: streams.
+ENGINE_ROLES = ("unified", "prefill", "decode")
 
 NEG_INF = -1e30
 
@@ -387,9 +399,31 @@ def build_engine_fns(model, cfg, *, max_len: int, chunk: int,
                     f, g.astype(f.dtype), (0,) * f.ndim)
             return jax.tree.map(leaf, empty, pool)
 
+        def export_blocks(pool, table):
+            """Gather `mb` whole blocks off the pool ([mb] table, NULL
+            pads) — the device half of the KV wire format (serve/
+            kv_transfer.py). Pad gathers return NULL-block garbage the
+            host side slices away; committed rows come back exactly as
+            the pool holds them, so pool → wire → pool round-trips
+            byte-identically (test-pinned)."""
+            return jax.tree.map(lambda p: jnp.take(p, table, axis=1),
+                                pool)
+
+        def import_blocks(pool, blocks, table):
+            """Scatter shipped host blocks into the pool at `table` —
+            the H2D half. Table entries masked to NULL absorb the
+            shipment's pad blocks in the reserved garbage block; real
+            entries land a remote prefill's committed rows without a
+            single local prefill chunk."""
+            return jax.tree.map(
+                lambda p, b: p.at[:, table].set(b.astype(p.dtype)),
+                pool, blocks)
+
         fns.update(make_decode_paged=make_decode_paged,
                    insert_paged=insert_paged,
-                   frag_from_pool=frag_from_pool)
+                   frag_from_pool=frag_from_pool,
+                   export_blocks=export_blocks,
+                   import_blocks=import_blocks)
     return fns
 
 
@@ -638,7 +672,8 @@ class GenerationEngine:
                  prefix_cache: int = 0, seed: int = 0,
                  mesh=None, rules=None, draft: dict | None = None,
                  adapters: dict | None = None, pipeline_depth: int = 2,
-                 kv_block_size: int = 0, kv_blocks: int = 0):
+                 kv_block_size: int = 0, kv_blocks: int = 0,
+                 role: str = "unified", kv_host_tier_blocks: int = 0):
         self.model, self.cfg = model, cfg
         self.max_len, self.chunk, self.n_slots = int(max_len), int(chunk), int(slots)
         msl = int(getattr(cfg, "max_seq_len", 0) or 0)
@@ -690,12 +725,16 @@ class GenerationEngine:
                                           mask_window=0,
                                           attention_impl="auto")
                 if isinstance(model, QuantizedModule):
-                    # Rebuild the INNER module; the wrapper takes (module,
-                    # dtype), not a config.
-                    model = QuantizedModule(type(model.module)(cfg),
-                                            model.dtype)
+                    # Rebuild the INNER module by replacing its cfg
+                    # field (flax modules are dataclasses) — a
+                    # type(module)(cfg) reconstruction would drop every
+                    # other field, e.g. an MoE trunk's mlp_cls.
+                    model = QuantizedModule(
+                        dataclasses.replace(model.module, cfg=cfg),
+                        model.dtype,
+                        legacy_dequant=model.legacy_dequant)
                 else:
-                    model = type(model)(cfg)
+                    model = dataclasses.replace(model, cfg=cfg)
                 self.model, self.cfg = model, cfg
         elif mask_kind != "causal":
             raise ValueError(
@@ -739,6 +778,31 @@ class GenerationEngine:
         self._paged = int(kv_block_size) > 0
         self._kv_bs = int(kv_block_size)
         self._kv_stash: deque = deque()  # admissions waiting for blocks
+        # Disaggregated prefill/decode (ISSUE 13): KV blocks are the
+        # wire format, so both split roles and the host-RAM spill tier
+        # require the paged pool. role="unified" with no tier is the
+        # escape hatch — bit-for-bit today's engine (same RNG splits,
+        # same sync points, no extra compiles).
+        if role not in ENGINE_ROLES:
+            raise ValueError(
+                f"role {role!r}: must be one of {ENGINE_ROLES}")
+        if role != "unified" and not self._paged:
+            raise ValueError(
+                f"role={role!r} needs the paged KV cache (KV blocks are "
+                "the prefill→decode wire unit); set kv_block_size > 0")
+        if int(kv_host_tier_blocks) and not self._paged:
+            raise ValueError(
+                "kv_host_tier_blocks > 0 needs the paged KV cache (the "
+                "host tier spills whole blocks); set kv_block_size > 0")
+        if role != "unified" and draft is not None:
+            raise ValueError(
+                "prefill/decode roles do not compose with speculative "
+                "decoding yet (the draft cache has no wire format); "
+                "role='unified' to use a draft")
+        self.role = role
+        self._host_tier = (HostKVTier(int(kv_host_tier_blocks))
+                           if self._paged and int(kv_host_tier_blocks)
+                           else None)
         if self._paged:
             if self._rolling:
                 raise ValueError(
@@ -808,12 +872,15 @@ class GenerationEngine:
                                            attention_impl="auto")
                 dmodel = draft["model"]
                 if isinstance(dmodel, QuantizedModule):
-                    # Rebuild the INNER module; the wrapper takes
-                    # (module, dtype), not a config.
-                    dmodel = QuantizedModule(type(dmodel.module)(dcfg),
-                                             dmodel.dtype)
+                    # Replace the INNER module's cfg field (see the
+                    # target rebuild above — reconstruction drops
+                    # non-cfg module fields).
+                    dmodel = QuantizedModule(
+                        dataclasses.replace(dmodel.module, cfg=dcfg),
+                        dmodel.dtype,
+                        legacy_dequant=dmodel.legacy_dequant)
                 else:
-                    dmodel = type(dmodel)(dcfg)
+                    dmodel = dataclasses.replace(dmodel, cfg=dcfg)
                 draft = dict(draft, cfg=dcfg, model=dmodel)
             elif dmask != "causal":
                 raise ValueError(
@@ -932,7 +999,16 @@ class GenerationEngine:
                       "spec_dispatches": 0, "spec_proposed": 0,
                       "spec_accepted": 0, "spec_demotions": 0,
                       "spec_readmissions": 0, "spec_stale_rides": 0,
-                      "kv_cow_copies": 0, "prefix_zero_copy_hits": 0}
+                      "kv_cow_copies": 0, "prefix_zero_copy_hits": 0,
+                      # Disaggregation + host tier (ISSUE 13):
+                      # prefill_chunks counts prefill/extend dispatches
+                      # (a decode-role engine must pin it at 0 —
+                      # DISAGGBENCH mechanism assertion), shipped/
+                      # received count wire blocks, spilled/restored
+                      # the host-tier traffic.
+                      "prefill_chunks": 0, "remote_admits": 0,
+                      "kv_blocks_shipped": 0, "kv_blocks_received": 0,
+                      "kv_spilled_blocks": 0, "kv_restored_blocks": 0}
         self._compile()
         from kubeflow_tpu.models.llama import init_cache
         with self._scope():
@@ -1085,6 +1161,20 @@ class GenerationEngine:
             self._insert = jax.jit(fns["insert_paged"],
                                    donate_argnums=(0,))
             self._frag_from_pool = jax.jit(fns["frag_from_pool"])
+            # KV wire format halves (ISSUE 13): export gathers blocks
+            # for a shipment/spill, import scatters shipped blocks in.
+            # Jitted lazily on first use — a unified engine that never
+            # ships pays nothing. DELIBERATE: one compiled shape each,
+            # max_len-blocks wide — the device copy moves the full
+            # width and the host slices/pads to the real block count
+            # (the HTTP wire carries only committed blocks). Bucketing
+            # the width like decode would shrink the D2H/H2D copies for
+            # short prompts at the cost of a per-bucket executable
+            # pair; revisit when a chip profile shows the copy, not the
+            # handoff hop, dominating.
+            self._export_blocks = jax.jit(fns["export_blocks"])
+            self._import_blocks = jax.jit(fns["import_blocks"],
+                                          donate_argnums=(0,))
             self._decode = {
                 (b, trunc): jax.jit(fns["make_decode_paged"](trunc, b),
                                     donate_argnums=(1,))
@@ -1156,6 +1246,16 @@ class GenerationEngine:
             if self._prefix_cap:
                 frag = self._frag_from_pool(self._cache,
                                             jnp.zeros((mb,), jnp.int32))
+            if self.role != "unified" or self._host_tier is not None:
+                # Warm the wire-format halves: a role engine's first
+                # handoff (or first spill) must not pay a compile.
+                mb = self.max_len // self._kv_bs
+                gt = jnp.zeros((mb,), jnp.int32)
+                gathered = self._export_blocks(self._cache, gt)
+                # All-NULL table: the import lands in the reserved
+                # garbage block, never in allocatable pool blocks.
+                self._cache = self._import_blocks(self._cache, gathered,
+                                                  gt)
             for (b, _), fn in self._decode.items():
                 self._cache, _, _ = fn(
                     self._params, self._cache,
@@ -1241,6 +1341,14 @@ class GenerationEngine:
         it at admission and every chunk boundary, and an expired request
         raises DeadlineExceeded AND frees its decode slot — it stops
         burning batch capacity the moment its 504 is decided."""
+        if self.role != "unified":
+            # Role discipline IS the isolation claim: a decode engine
+            # that ran this path would chunk-prefill locally (stealing
+            # decode dispatches), a prefill engine would decode.
+            raise RuntimeError(
+                f"{self.role}-role engine refuses a local generate: "
+                "prefill engines take prefill_ship(), decode engines "
+                "take submit_remote()")
         if not input_ids:
             raise ValueError("input_ids must be non-empty")
         if len(input_ids) > self.max_len - 1:
@@ -1306,6 +1414,201 @@ class GenerationEngine:
             "output_ids": req["out"],
             "output_logprobs": req["out_logprobs"],
             "num_input_tokens": len(req["input_ids"]),
+            "num_output_tokens": len(req["out"]),
+            "latency_s": time.monotonic() - req["t0"],
+        }
+
+    def prefill_ship(self, input_ids: Sequence[int], *,
+                     max_tokens: int = 32, temperature: float = 0.0,
+                     top_k: int = 0, top_p: float = 1.0,
+                     eos_id: int | None = None, timeout: float = 300.0,
+                     adapter: str | None = None,
+                     deadline: Deadline | None = None,
+                     trace_id: str = "", extra: dict | None = None) -> dict:
+        """Chunk-prefill a prompt into pool blocks and return them as a
+        WIRE SHIPMENT instead of decoding (the prefill half of
+        disaggregation): committed KV blocks + the prompt tokens + the
+        sampled first token/logprob + this engine's post-prefill RNG key
+        state, packed by serve/kv_transfer.py. The blocks are released
+        back to the pool the moment they are serialized — a prefill
+        replica's pool only ever holds in-flight prefills (plus its
+        prefix cache, which keeps sharing/spilling as usual).
+
+        `extra` rides the shipment metadata verbatim (the server stashes
+        the caller's stream flag there). Returns {"shipment": bytes,
+        "num_input_tokens", "first_token", "latency_s"}."""
+        if self.role == "decode":
+            raise RuntimeError(
+                "decode-role engine refuses prefill work (zero prefill "
+                "chunks is the disaggregation invariant)")
+        if not self._paged:
+            raise RuntimeError(
+                "prefill_ship needs the paged KV cache (KV blocks are "
+                "the wire unit); set kv_block_size > 0")
+        if not input_ids:
+            raise ValueError("input_ids must be non-empty")
+        if len(input_ids) > self.max_len - 1:
+            raise ValueError(
+                f"prompt of {len(input_ids)} tokens exceeds max_len "
+                f"{self.max_len}")
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        if top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {top_k}")
+        need = blocks_for(len(input_ids), self._kv_bs)
+        if need > self._kv_alloc.n_blocks:
+            raise KVCapacityExceeded(
+                f"prompt needs {need} KV blocks but the pool has "
+                f"{self._kv_alloc.n_blocks}")
+        req = {
+            "mode": "ship",
+            "input_ids": [int(t) for t in input_ids],
+            "max_tokens": int(max_tokens),
+            "temperature": float(temperature),
+            "top_k": int(top_k),
+            "top_p": float(top_p),
+            "aid": self._resolve_adapter(adapter),
+            "adapter": adapter,
+            "eos_id": eos_id,
+            "timeout": float(timeout),
+            "extra": dict(extra or {}),
+            "out": [], "out_logprobs": [],
+            "done": threading.Event(),
+            "error": None,
+            "result": None,
+            "deadline": deadline,
+            "t0": time.monotonic(),
+            "trace": trace_id,
+            "t_enq": time.perf_counter(),
+            "cb": None,
+        }
+        self._queue.put(req)
+        self._wake.set()
+        wait_s = deadline.bound(timeout) if deadline is not None else timeout
+        if not req["done"].wait(wait_s):
+            if deadline is not None and deadline.expired():
+                req["error"] = DeadlineExceeded(
+                    "request deadline expired during prefill")
+            else:
+                req["error"] = f"prefill timed out after {timeout}s"
+        if isinstance(req["error"], BaseException):
+            raise req["error"]
+        if req["error"]:
+            raise RuntimeError(req["error"])
+        out = dict(req["result"])
+        out["latency_s"] = time.monotonic() - req["t0"]
+        return out
+
+    def submit_remote(self, shipment, *, timeout: float | None = None,
+                      deadline: Deadline | None = None, on_tokens=None,
+                      trace_id: str = "") -> dict:
+        """Admit a shipped prefill (prefill_ship bytes) straight into
+        decode — the decode half of disaggregation. The shipped blocks
+        scatter into this pool under a freshly reserved table (full
+        decode-budget reservation, exactly the local admission
+        discipline — transient exhaustion stashes head-of-line in
+        `_kv_stash` like any admission), the shipped first token seeds
+        the decode carry, and the shipped RNG key state is adopted so a
+        single disaggregated stream is token+logprob-identical to the
+        unified engine on the same seed. Sampling params AND the
+        caller's request timeout travel IN the shipment (they were
+        fixed at prefill; `timeout=None` adopts the shipped budget so
+        a role split never silently shrinks it). Never runs a prefill
+        chunk."""
+        if self.role == "prefill":
+            raise RuntimeError(
+                "prefill-role engine refuses decode work; route "
+                "shipments to a decode or unified replica")
+        if not self._paged:
+            raise RuntimeError(
+                "submit_remote needs the paged KV cache; set "
+                "kv_block_size > 0")
+        meta, arrays = unpack_shipment(shipment)
+        if int(meta.get("fmt", 0)) != 1:
+            raise ShipmentError(
+                f"unknown shipment fmt {meta.get('fmt')!r}")
+        if int(meta.get("block_size", 0)) != self._kv_bs:
+            raise ShipmentError(
+                f"shipment block_size {meta.get('block_size')} != this "
+                f"pool's {self._kv_bs} (pair replicas with identical "
+                "kv_block_size)")
+        if int(meta.get("vocab_size", 0)) != int(self.cfg.vocab_size):
+            raise ShipmentError(
+                f"shipment vocab {meta.get('vocab_size')} != model "
+                f"vocab {self.cfg.vocab_size}")
+        ids = [int(t) for t in meta["tokens"]]
+        if not ids or len(ids) > self.max_len - 1:
+            raise ShipmentError(
+                f"shipped prompt of {len(ids)} tokens does not fit "
+                f"max_len {self.max_len}")
+        n_blocks = blocks_for(len(ids), self._kv_bs)
+        mb = self.max_len // self._kv_bs
+        ref = self._cache["k"].shape  # [L, NB+1, bs, KH, D]
+        blocks = {}
+        for name in ("k", "v"):
+            arr = arrays.get(name)
+            if arr is None:
+                raise ShipmentError(f"shipment missing {name!r} blocks")
+            want = (ref[0], n_blocks, ref[2], ref[3], ref[4])
+            if tuple(arr.shape) != want:
+                raise ShipmentError(
+                    f"shipment {name} blocks shaped {tuple(arr.shape)}, "
+                    f"this engine needs {want}")
+            # Pad to the compiled [mb]-block import width; pads scatter
+            # into the NULL block.
+            pad = np.zeros((ref[0], mb, ref[2], ref[3], ref[4]),
+                           arr.dtype)
+            pad[:, :n_blocks] = arr
+            blocks[name] = pad
+        if timeout is None:
+            timeout = float(meta.get("timeout", 300.0))
+        max_tokens = int(meta.get("max_tokens", 32))
+        need = blocks_for(self._paged_need_tokens(len(ids), max_tokens),
+                          self._kv_bs)
+        if need > self._kv_alloc.n_blocks:
+            raise KVCapacityExceeded(
+                f"shipped request needs {need} KV blocks worst-case but "
+                f"the pool has {self._kv_alloc.n_blocks}")
+        req = {
+            "mode": "remote",
+            "input_ids": ids,
+            "max_tokens": max_tokens,
+            "temperature": float(meta.get("temperature", 0.0)),
+            "top_k": int(meta.get("top_k", 0)),
+            "top_p": float(meta.get("top_p", 1.0)),
+            "aid": self._resolve_adapter(meta.get("adapter")),
+            "eos_id": meta.get("eos_id"),
+            "first_tok": int(meta["first_token"]),
+            "first_lp": float(meta["first_logprob"]),
+            "kv_blocks": blocks,
+            "n_blocks": n_blocks,
+            "rng_key": arrays.get("rng_key"),
+            "out": [], "out_logprobs": [],
+            "done": threading.Event(),
+            "error": None,
+            "deadline": deadline,
+            "t0": time.monotonic(),
+            "trace": trace_id,
+            "t_enq": time.perf_counter(),
+            "cb": on_tokens,
+        }
+        self._queue.put(req)
+        self._wake.set()
+        wait_s = deadline.bound(timeout) if deadline is not None else timeout
+        if not req["done"].wait(wait_s):
+            if deadline is not None and deadline.expired():
+                req["error"] = DeadlineExceeded(
+                    "request deadline expired during generation")
+            else:
+                req["error"] = f"generation timed out after {timeout}s"
+        if isinstance(req["error"], BaseException):
+            raise req["error"]
+        if req["error"]:
+            raise RuntimeError(req["error"])
+        return {
+            "output_ids": req["out"],
+            "output_logprobs": req["out_logprobs"],
+            "num_input_tokens": len(ids),
             "num_output_tokens": len(req["out"]),
             "latency_s": time.monotonic() - req["t0"],
         }
@@ -1457,7 +1760,13 @@ class GenerationEngine:
         """Drop one prefix entry + its length-index bookkeeping — shared
         by both cache flavors. The payload is a fragment tree (flat:
         Python GC reclaims it) or a block-id tuple (paged: the refs must
-        be returned to the allocator explicitly)."""
+        be returned to the allocator explicitly). With a host tier
+        configured, a paged eviction SPILLS the blocks first (cold
+        blocks move down-tier instead of vanishing — restore-on-hit
+        brings them back, lifting the effective pool beyond HBM)."""
+        if self._paged and self._host_tier is not None:
+            kt, blocks = self._prefix_lru[key]
+            self._spill_prefix(key, kt, blocks)
         _, payload = self._prefix_lru.pop(key)
         eaid, en, _ = key
         per = self._prefix_lens.get(eaid, {})
@@ -1490,12 +1799,23 @@ class GenerationEngine:
         only way to fit — an admission can never wipe the cache while
         freeing nothing, and never destroys its own hit needlessly."""
         ids = req["input_ids"]
-        total = blocks_for(
-            self._paged_need_tokens(len(ids), req["max_tokens"]),
-            self._kv_bs)
+        mode = req.get("mode")
+        if mode == "ship":
+            # Prefill-only: the decode budget is the DECODE replica's
+            # to reserve; this pool holds just the prompt blocks until
+            # the shipment serializes.
+            total = blocks_for(len(ids), self._kv_bs)
+        else:
+            total = blocks_for(
+                self._paged_need_tokens(len(ids), req["max_tokens"]),
+                self._kv_bs)
         aid = req.get("aid", 0)
+        # Remote admissions never discount by a prefix hit: their blocks
+        # arrive on the wire and the reserve below allocates the FULL
+        # need — a discount here could pass a request the reserve can
+        # never satisfy (permanent head-of-line stall).
         hit = (self._prefix_probe_paged(ids, aid, touch=False)
-               if self._prefix_cap else None)
+               if self._prefix_cap and mode != "remote" else None)
         shared = hit[0] // self._kv_bs if hit is not None else 0
         hit_key = ((aid, hit[0], hash(tuple(ids[:hit[0]])))
                    if hit is not None else None)
@@ -1557,10 +1877,20 @@ class GenerationEngine:
         """Paged-pool snapshot for metadata()/debugging (None = flat)."""
         if not self._paged:
             return None
-        return {"block_size": self._kv_bs,
+        info = {"block_size": self._kv_bs,
                 "blocks": self._kv_alloc.n_blocks,
                 "blocks_free": self._kv_alloc.free_blocks,
                 "blocks_used": self._kv_alloc.used_blocks}
+        if self._host_tier is not None:
+            info["host_tier"] = self._host_tier.stats_snapshot()
+        return info
+
+    @property
+    def kv_spill_blocks(self):
+        """Host-tier resident blocks (None = no tier) — the
+        tpk_kv_spill_blocks gauge."""
+        return (self._host_tier.resident_blocks
+                if self._host_tier is not None else None)
 
     def _admit_inner_paged(self, slot: int, req: dict) -> None:
         """Paged admission: the fragment pipeline (prefill/extend over a
@@ -1599,20 +1929,45 @@ class GenerationEngine:
         gather_tbl: tuple | None = None
         cow_fork = False
         hit = None
+        ship = req.get("mode") == "ship"
         if self._prefix_cap:
             hit = self._prefix_probe_paged(ids, aid, touch=True)
+            if hit is None and self._host_tier is not None:
+                # Host-tier restore-on-hit: a prefix spilled under pool
+                # pressure comes back through the same wire format and
+                # re-publishes as an HBM cache entry before this
+                # admission consumes it like any zero-copy hit. The
+                # request rides along so the restore can prove THIS
+                # admission still fits afterwards (see the livelock
+                # note in _restore_spilled).
+                hit = self._restore_spilled(ids, aid, req)
             if hit is not None:
                 done, hit_blocks = hit
                 shared = list(hit_blocks[:done // bs])
                 cow_fork = done % bs > 0
                 gather_tbl = hit_blocks
-        need = blocks_for(self._paged_need_tokens(len(ids),
-                                                  req["max_tokens"]), bs)
-        fresh = self._kv_alloc.alloc(max(0, need - len(shared)))
-        if fresh is None:
-            # _admit_waiting's _kv_fits precheck makes this unreachable
-            # in the normal flow; defense against future reordering.
-            raise _NeedKVBlocks()
+        if ship:
+            # Prompt blocks only — see _kv_fits; the decode budget is
+            # reserved by the decode replica at submit_remote.
+            need = blocks_for(len(ids), bs)
+            fresh = self._kv_alloc.alloc(max(0, need - len(shared)))
+            if fresh is None:
+                raise _NeedKVBlocks()
+        else:
+            # _admit_waiting's _kv_fits precheck makes the reserve
+            # failure unreachable in the normal flow; defense against
+            # future reordering. The remote-admit twin must reserve by
+            # the IDENTICAL worst-case rule — a drifted copy would let
+            # a shipped request out-reserve (or under-reserve) a local
+            # one and break the pool accounting.
+            # tpk-sync: begin kv-block-reserve admit
+            need = blocks_for(
+                self._paged_need_tokens(len(ids), req["max_tokens"]),
+                bs)
+            fresh = self._kv_alloc.alloc(max(0, need - len(shared)))
+            if fresh is None:
+                raise _NeedKVBlocks()
+            # tpk-sync: end kv-block-reserve
         if self._prefix_cap:
             with self._stats_lock:
                 if hit is not None:
@@ -1627,6 +1982,7 @@ class GenerationEngine:
         self._kv_alloc.incref(shared)
         table = shared + fresh
         boundaries: list[int] = []
+        start_done = done
         try:
             if gather_tbl is not None:
                 # Resume chunked prefill mid-prompt: seed the fragment
@@ -1684,6 +2040,12 @@ class GenerationEngine:
         for m in boundaries:
             self._prefix_store_paged(aid, tuple(ids[:m]),
                                      table[:blocks_for(m, bs)])
+        with self._stats_lock:
+            self.stats["prefill_chunks"] += -(-(len(ids) - start_done)
+                                              // big)
+        if ship:
+            self._finish_ship(req, table, tok0, lp0)
+            return
         # tpk-sync: begin admit-slot-state paged
         # tpk-sync: sub 'draft_ok': draft_ok -> 'draft_ok': False
         # tpk-sync: sub 'aid': aid} -> 'aid': aid, 'blocks': table}
@@ -1710,6 +2072,217 @@ class GenerationEngine:
             self._emit(slot, st, [st["last"]], [float(lp0[0])])
         # tpk-sync: end admit-slot-state
 
+    def _finish_ship(self, req: dict, table: list[int], tok0,
+                     lp0) -> None:
+        """Serialize a ship-mode admission's committed blocks into the
+        wire format and release them. Runs on the worker thread right
+        after the fragment insert. The fetches here ARE device syncs:
+        on a prefill-role engine there are never decode chunks in
+        flight to stall, which is the design point. KNOWN COST on an
+        "any"-role replica playing the prefill phase (the symmetric
+        role_split fallback): each handoff's export fetch completes
+        behind any in-flight decode dispatches — a per-handoff stall
+        the dedicated prefill role exists to avoid. Prefer real
+        prefill replicas under mixed load; the fallback trades tail
+        latency for not stranding decode specialists."""
+        ids = req["input_ids"]
+        mb = self.max_len // self._kv_bs
+        gt = np.zeros((mb,), np.int32)
+        gt[:len(table)] = table
+        gathered = self._export_blocks(self._cache, jnp.asarray(gt))
+        arrays = {name: np.asarray(leaf)[:, :len(table)]
+                  for name, leaf in gathered.items()}
+        # Post-prefill RNG state: a decode engine adopting it continues
+        # the exact key-split stream the unified engine would have used
+        # (the disagg-vs-unified identity pin).
+        arrays["rng_key"] = np.asarray(jax.random.key_data(self._key))
+        first_tok = int(np.asarray(tok0)[0])
+        meta = {
+            "fmt": 1,
+            "block_size": self._kv_bs,
+            "vocab_size": int(self.cfg.vocab_size),
+            "tokens": list(ids),
+            "committed": len(ids),
+            "first_token": first_tok,
+            "first_logprob": float(np.asarray(lp0)[0]),
+            "max_tokens": req["max_tokens"],
+            "temperature": req["temperature"],
+            "top_k": req.get("top_k", 0),
+            "top_p": req.get("top_p", 1.0),
+            "eos_id": req.get("eos_id"),
+            "adapter": req.get("adapter"),
+            # The CALLER's request timeout rides the shipment so the
+            # decode replica waits as long as the unified engine would
+            # have — a role split must not silently shrink budgets.
+            "timeout": req.get("timeout", 300.0),
+            "extra": req.get("extra") or {},
+        }
+        payload = pack_shipment(meta, arrays)
+        self._kv_alloc.decref(table)
+        with self._stats_lock:
+            self.stats["requests"] += 1
+            self.stats["prompt_tokens"] += len(ids)
+            self.stats["kv_blocks_shipped"] += len(table)
+            aid = req.get("aid", 0)
+            if aid:
+                per = dict(self.stats.get("adapter_requests", {}))
+                name = self._ml_names[aid]
+                per[name] = per.get(name, 0) + 1
+                self.stats["adapter_requests"] = per
+        req["result"] = {"shipment": payload,
+                         "num_input_tokens": len(ids),
+                         "first_token": first_tok,
+                         "kv_blocks": len(table)}
+        req["done"].set()
+
+    # Decode-side admission of a shipped prefill: import + bookkeeping
+    # only — NO prefill chunk, no host fetch of device values (the
+    # shipped first token/logprob are already host scalars), so remote
+    # admission composes with pipeline_depth > 1 exactly like local
+    # paged admission (allocation off the decode critical path).
+    # tpk-hot: remote-admit
+    def _admit_remote_paged(self, slot: int, req: dict) -> None:
+        ids = req["input_ids"]
+        aid = req.get("aid", 0)
+        bs = self._kv_bs
+        mb = self.max_len // bs
+        shared: list[int] = []
+        # tpk-sync: begin kv-block-reserve remote
+        need = blocks_for(
+            self._paged_need_tokens(len(ids), req["max_tokens"]),
+            bs)
+        fresh = self._kv_alloc.alloc(max(0, need - len(shared)))
+        if fresh is None:
+            raise _NeedKVBlocks()
+        # tpk-sync: end kv-block-reserve
+        table = shared + fresh
+        n_blocks = req["n_blocks"]
+        try:
+            # Scatter the shipped blocks into the FIRST n_blocks table
+            # entries; the reservation's decode-budget tail keeps its
+            # stale contents (decode writes every row before any query
+            # position can attend it, exactly as local admission does)
+            # and the shipment's pad blocks land in the NULL block.
+            st_tbl = np.zeros((mb,), np.int32)
+            st_tbl[:n_blocks] = table[:n_blocks]
+            dev_blocks = {name: jnp.asarray(arr)
+                          for name, arr in req["kv_blocks"].items()}
+            self._cache = self._import_blocks(self._cache, dev_blocks,
+                                              jnp.asarray(st_tbl))
+        except BaseException:
+            self._kv_alloc.decref(table)
+            raise
+        kd = req.get("rng_key")
+        if kd is not None:
+            # Adopt the prefill engine's post-admission key stream —
+            # concurrent shipments multiplex this one key exactly as
+            # concurrent local admissions always have (last admit
+            # wins); per-stream identity is what the seeded test pins.
+            self._key = jax.random.wrap_key_data(jnp.asarray(kd))
+        st = {"req": req, "idx": len(ids), "disp": len(ids),
+              "last": req["first_tok"], "pending": None,
+              "draft_ok": False, "aid": aid, "blocks": table}
+        self._slots[slot] = st
+        with self._stats_lock:
+            self.stats["requests"] += 1
+            self.stats["remote_admits"] += 1
+            self.stats["kv_blocks_received"] += n_blocks
+        self._emit(slot, st, [req["first_tok"]], [req["first_lp"]])
+
+    def _restore_spilled(self, ids: list[int], aid: int,
+                         req: dict) -> tuple[int, tuple] | None:
+        """Restore the longest host-tier prefix covering `ids` back into
+        pool blocks and re-publish it as an HBM prefix-cache entry —
+        returns the (matched_len, block_ids) contract of
+        `_prefix_probe_paged`, or None (no spill, no pool room, or a
+        payload this engine cannot verify). The tier entry retires on
+        take(); an un-restorable payload is simply dropped (the pool
+        recomputes — never serves bytes it cannot validate).
+
+        LIVELOCK GUARD: the restore must leave room for THIS
+        admission's own reserve (full need minus the restored full
+        blocks it maps zero-copy). Checking `can_alloc(n_blocks)` alone
+        allowed a tight pool to ping-pong forever: _kv_fits sacrifices
+        the hit (spill), the admission restores it (consuming the last
+        headroom), its reserve then fails and stashes head-of-line, and
+        the next pass spills/restores the same prefix again — so the
+        restore is attempted only when restore + reserve provably fit
+        together; otherwise the admission proceeds cold, which always
+        terminates."""
+        tier = self._host_tier
+        n = tier.probe_longest(aid, ids)
+        if n is None:
+            return None
+        n_blocks = blocks_for(n, self._kv_bs)
+        if req.get("mode") == "ship":
+            total = blocks_for(len(ids), self._kv_bs)
+        else:
+            total = blocks_for(
+                self._paged_need_tokens(len(ids), req["max_tokens"]),
+                self._kv_bs)
+        shared_after = n // self._kv_bs  # full blocks mapped zero-copy
+        if not self._kv_alloc.can_alloc(n_blocks + total - shared_after):
+            return None  # leave it spilled; admission proceeds cold
+        kt = tuple(ids[:n])
+        taken = tier.take(aid, kt)
+        if taken is None:
+            return None
+        _, payload = taken
+        try:
+            meta, arrays = unpack_shipment(payload)
+            ref = self._cache["k"].shape
+            want = (ref[0], n_blocks, ref[2], ref[3], ref[4])
+            if (int(meta.get("block_size", 0)) != self._kv_bs
+                    or list(meta.get("tokens", ())) != list(kt)
+                    or any(tuple(arrays[x].shape) != want
+                           for x in ("k", "v"))):
+                raise ShipmentError("spilled payload mismatch")
+        except ShipmentError:
+            return None
+        blocks = self._kv_alloc.alloc(n_blocks)
+        if blocks is None:
+            return None
+        mb = self.max_len // self._kv_bs
+        st_tbl = np.zeros((mb,), np.int32)
+        st_tbl[:n_blocks] = blocks
+        dev = {}
+        for name in ("k", "v"):
+            pad = np.zeros((ref[0], mb, ref[2], ref[3], ref[4]),
+                           arrays[name].dtype)
+            pad[:, :n_blocks] = arrays[name]
+            dev[name] = jnp.asarray(pad)
+        self._cache = self._import_blocks(self._cache, dev,
+                                          jnp.asarray(st_tbl))
+        # Publish as a cache entry (its incref owns the blocks), then
+        # drop our allocation ref — restore-on-hit leaves exactly the
+        # refcounts an HBM-resident entry would have had.
+        self._prefix_store_paged(aid, kt, blocks)
+        self._kv_alloc.decref(blocks)
+        with self._stats_lock:
+            self.stats["kv_restored_blocks"] += n_blocks
+        return n, tuple(blocks)
+
+    def _spill_prefix(self, key: tuple, kt: tuple,
+                      blocks: tuple) -> None:
+        """Serialize one evicted prefix entry's blocks into the host
+        tier (same wire format as a prefill shipment). Called just
+        before the eviction decrefs — the gather must happen while the
+        blocks still hold the committed rows."""
+        aid, _, _ = key
+        mb = self.max_len // self._kv_bs
+        gt = np.zeros((mb,), np.int32)
+        gt[:len(blocks)] = blocks
+        gathered = self._export_blocks(self._cache, jnp.asarray(gt))
+        arrays = {name: np.asarray(leaf)[:, :len(blocks)]
+                  for name, leaf in gathered.items()}
+        payload = pack_shipment(
+            {"fmt": 1, "block_size": self._kv_bs,
+             "vocab_size": int(self.cfg.vocab_size),
+             "tokens": list(kt), "committed": len(kt)}, arrays)
+        if self._host_tier.put(aid, kt, len(blocks), payload):
+            with self._stats_lock:
+                self.stats["kv_spilled_blocks"] += len(blocks)
+
     def _admit(self, slot: int, req: dict) -> None:
         tracer = obs.get_tracer()
         if tracer.enabled:
@@ -1726,6 +2299,8 @@ class GenerationEngine:
                 self._admit_inner(slot, req)
 
     def _admit_inner(self, slot: int, req: dict) -> None:
+        if req.get("mode") == "remote":
+            return self._admit_remote_paged(slot, req)
         if self._paged:
             return self._admit_inner_paged(slot, req)
         ids = req["input_ids"]
@@ -1759,6 +2334,7 @@ class GenerationEngine:
             else:
                 with self._stats_lock:
                     self.stats["prefix_misses"] += 1
+        start_done = done
         # tpk-sync: begin admit-chunked-prefill flat
         while done < len(ids):
             piece = ids[done:done + big]
@@ -1796,6 +2372,9 @@ class GenerationEngine:
                     self._prefix_store(aid, tuple(ids[:done]), frag,
                                        copy=done < len(ids))
         # tpk-sync: end admit-chunked-prefill
+        with self._stats_lock:
+            self.stats["prefill_chunks"] += -(-(len(ids) - start_done)
+                                              // big)
         self._cache = self._insert(self._cache, frag, jnp.int32(slot))
         spec_able = (req.get("top_k", 0) == 0
                      and req.get("top_p", 1.0) >= 1.0)
@@ -2571,6 +3150,86 @@ class GenerativeJAXModel(Model):
                 sent_text += ev["text_delta"]
             yield ev
 
+    def prefill_ship(self, payload: dict) -> dict:
+        """POST :prefill backend — chunk-prefill and return the KV
+        shipment (disaggregation phase 1). The caller's stream flag and
+        requested surface ride the shipment's `extra` so the decode
+        replica can answer in the right shape."""
+        if not self.ready or self.engine is None:
+            raise RuntimeError(f"model {self.name} is not loaded")
+        ids = self._resolve_ids(payload)
+        kwargs = self._submit_kwargs(payload)
+        kwargs.pop("timeout", None)
+        deadline = kwargs.pop("deadline", None)
+        trace = kwargs.pop("trace_id", "")
+        return self.engine.prefill_ship(
+            ids, deadline=deadline, trace_id=trace,
+            timeout=float(payload.get("timeout", 300.0)),
+            extra={"stream": bool(payload.get("stream"))}, **kwargs)
+
+    def decode_remote(self, shipment, *, deadline=None,
+                      trace_id: str = "") -> dict:
+        """POST :decode backend (non-stream): admit a shipment straight
+        into decode and block for the full result."""
+        if not self.ready or self.engine is None:
+            raise RuntimeError(f"model {self.name} is not loaded")
+        out = self.engine.submit_remote(shipment, deadline=deadline,
+                                        trace_id=trace_id)
+        if self.tokenizer is not None:
+            out["text"] = self._decode_text(out["output_ids"])
+        out["decode_tokens_per_sec"] = round(self.engine.throughput(), 2)
+        return out
+
+    def decode_remote_stream(self, shipment, *, deadline=None,
+                             trace_id: str = ""):
+        """Streaming :decode backend: the generate_stream event shape
+        (chunk token events, final done summary) over a remote
+        admission."""
+        if not self.ready or self.engine is None:
+            raise RuntimeError(f"model {self.name} is not loaded")
+        from kubeflow_tpu.serve.kv_transfer import peek_meta
+
+        # Bound the event wait by the SHIPPED request budget (+ grace),
+        # mirroring generate_stream's clock — never a magic constant
+        # coupled to submit_remote's default.
+        timeout_s = float(peek_meta(shipment).get("timeout", 300.0))
+        events: queue.Queue = queue.Queue()
+
+        def on_tokens(tokens, done):
+            events.put(("tok", tokens, done))
+
+        def run():
+            try:
+                events.put(("final", self.engine.submit_remote(
+                    shipment, deadline=deadline, trace_id=trace_id,
+                    on_tokens=on_tokens), None))
+            except Exception as e:
+                events.put(("error", e, None))
+
+        threading.Thread(target=run, daemon=True,
+                         name="tpk-decode-remote-stream").start()
+        stream_deadline = time.monotonic() + timeout_s + 10.0
+        while True:
+            try:
+                kind, val, _done = events.get(
+                    timeout=max(stream_deadline - time.monotonic(), 1.0))
+            except queue.Empty:
+                raise RuntimeError(
+                    f"remote decode stream timed out after "
+                    f"{timeout_s}s") from None
+            if kind == "error":
+                raise val
+            if kind == "final":
+                out = dict(val)
+                if self.tokenizer is not None:
+                    out["text"] = self._decode_text(out["output_ids"])
+                out["decode_tokens_per_sec"] = round(
+                    self.engine.throughput(), 2)
+                yield {"done": True, **out}
+                return
+            if val:
+                yield {"tokens": [int(t) for t in val]}
+
     def predict(self, inputs):
         """Full-forward logits (no cache) — v1/v2 infer parity."""
         toks = jnp.asarray(np.asarray(inputs[0], np.int32))
@@ -2591,6 +3250,7 @@ class GenerativeJAXModel(Model):
             md["pipeline_depth"] = self.engine.pipeline_depth
             md["speculative"] = self.engine._spec is not None
             md["paged_kv"] = self.engine.kv_info()
+            md["role"] = self.engine.role
             if self.engine.adapter_names():
                 md["adapters"] = self.engine.adapter_names()
         return md

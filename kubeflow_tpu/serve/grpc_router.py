@@ -66,11 +66,16 @@ class GrpcRouterServicer:
     def _grpc_replicas(self) -> dict[str, str]:
         """placeable replica name -> gRPC address. Mirrors the HTTP
         plane's Replica.placeable(): a degraded readiness probe routes
-        the replica out of placement on BOTH planes."""
+        the replica out of placement on BOTH planes. Role-split
+        replicas (ISSUE 13) are excluded: the gRPC plane has no
+        :prefill/:decode verbs, so only a replica serving BOTH phases
+        can answer a generate RPC — disaggregated fleets serve gRPC
+        traffic from their unified replicas (or not at all, loudly)."""
         out = {}
         for r in self.server.fleet.snapshot():
             if r["grpc"] and r["state"] in ("starting", "ready") \
-                    and r["ready"] is not False:
+                    and r["ready"] is not False \
+                    and r.get("role", "any") == "any":
                 out[r["name"]] = r["grpc"]
         return out
 

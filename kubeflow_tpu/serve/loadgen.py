@@ -63,6 +63,8 @@ class FakeEngine:
         self.stats = {  # guarded-by: _lock
             "requests": 0, "decode_tokens": 0,
             "prefix_hits": 0, "prefix_misses": 0,
+            "prefill_chunks": 0, "remote_admits": 0,
+            "kv_blocks_shipped": 0, "kv_blocks_received": 0,
         }
         self.inflight_depth = 0  # single int store, GIL-atomic reads
         self._lock = threading.Lock()
@@ -144,6 +146,69 @@ class FakeGenerativeModel(Model):
     def generate(self, payload: dict) -> dict:
         out: dict = {}
         for ev in self.generate_stream(payload):
+            if ev.get("done"):
+                out = {k: v for k, v in ev.items() if k != "done"}
+        return out
+
+    # -- disaggregation fakes (ISSUE 13): timed stand-ins for the real
+    # prefill_ship / decode_remote engine surface, through the REAL wire
+    # format, so router handoff tests measure the router. ---------------
+
+    def prefill_ship(self, payload: dict) -> dict:
+        from kubeflow_tpu.serve.kv_transfer import pack_shipment
+
+        hit = self._prefix_probe(payload)
+        ids = [int(t) for t in (payload.get("input_ids") or [0])]
+        with self._slots_sem:
+            self.engine.enter()
+            try:
+                time.sleep(self.hit_prefill_s if hit else self.prefill_s)
+            finally:
+                self.engine.exit()
+        nb = max(1, -(-len(ids) // 8))
+        self.engine.bump(requests=1, prefill_chunks=1,
+                         kv_blocks_shipped=nb)
+        meta = {"fmt": 1, "block_size": 8, "tokens": ids,
+                "first_token": 0, "first_logprob": 0.0,
+                "max_tokens": int(payload.get("max_tokens", 16)),
+                "prefix_hit": hit,
+                "extra": {"stream": bool(payload.get("stream"))}}
+        shipment = pack_shipment(
+            meta, {"k": np.zeros((1, nb, 8, 1, 2), np.float32),
+                   "v": np.zeros((1, nb, 8, 1, 2), np.float32)})
+        return {"shipment": shipment, "num_input_tokens": len(ids),
+                "first_token": 0, "kv_blocks": nb}
+
+    def decode_remote_stream(self, shipment, *, deadline=None,
+                             trace_id: str = ""):
+        from kubeflow_tpu.serve.kv_transfer import peek_meta
+
+        meta = peek_meta(shipment)
+        max_tokens = int(meta.get("max_tokens", 16))
+        nb = max(1, -(-len(meta.get("tokens", [0])) // 8))
+        with self._slots_sem:
+            self.engine.enter()
+            try:
+                emitted = 0
+                while emitted < max_tokens:
+                    n = min(8, max_tokens - emitted)
+                    time.sleep(n * self.per_token_s)
+                    yield {"tokens": list(range(emitted, emitted + n))}
+                    emitted += n
+            finally:
+                self.engine.exit()
+        self.engine.bump(requests=1, remote_admits=1,
+                         kv_blocks_received=nb,
+                         decode_tokens=max_tokens)
+        yield {"done": True, "output_ids": list(range(max_tokens)),
+               "num_output_tokens": max_tokens,
+               "prefix_hit": bool(meta.get("prefix_hit"))}
+
+    def decode_remote(self, shipment, *, deadline=None,
+                      trace_id: str = "") -> dict:
+        out: dict = {}
+        for ev in self.decode_remote_stream(shipment, deadline=deadline,
+                                            trace_id=trace_id):
             if ev.get("done"):
                 out = {k: v for k, v in ev.items() if k != "done"}
         return out

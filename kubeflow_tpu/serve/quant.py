@@ -15,12 +15,31 @@ registered pytree node (`Int8Leaf`), so the quantized tree flows through
 jit / device_put / AOT lowering like any params tree, and `QuantizedModule`
 makes it transparent to every consumer that calls `model.apply` (the
 generation engine, AOT-bucketed predictors, graph nodes).
+
+Dequant placement (the SERVEBENCH 0.747x defect, ROADMAP item 4): the
+original wrapper dequantized the WHOLE tree per `apply` — `(q * scale)`
+is a full-weight-shaped multiply, and a multiply feeding a dot operand
+does not fuse into the matmul's operand read, so every decode step
+inside the chunk scan materialized every weight at full bf16 width
+(verified in the compiled HLO: the convert+multiply fusions carry
+`while/body` metadata). Per step that is int8 + bf16 weight traffic —
+~1.5x the bf16 baseline's bytes, which is exactly the measured 0.747x
+throughput. The fix moves the scale to the OTHER side of the matmul:
+`x @ (q * s) == (x @ q) * s` when `s` is per-output-channel (the
+contraction dims of the scale are 1), so `Int8DenseGeneral` feeds the
+dot the RAW int8 kernel through a bare convert — which XLA does fuse
+into the operand read — and applies the scale to the `[B, S, out]`
+output, a bandwidth-trivial multiply. No full-size dequantized weight
+tensor exists anywhere in the program; the HLO-shape guard test pins
+this (tests/test_kv_transfer.py is the serving suite; the guard lives
+in tests/test_quant_dequant.py).
 """
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Callable, Sequence, Union
 
+import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -133,19 +152,149 @@ def quantized_bytes(params: Any) -> dict:
     return {"quantized": int(qb), "full": int(fb)}
 
 
-class QuantizedModule:
-    """Wraps a flax module so `apply` sees dequantized params — quantization
-    becomes a storage detail invisible to the model code and to every
-    serving path that holds a (module, params) pair."""
+class Int8DenseGeneral(nn.Module):
+    """`nn.DenseGeneral` twin that understands `Int8Leaf` kernels.
 
-    def __init__(self, module: Any, dtype: Any = jnp.bfloat16):
-        self.module = module
+    Same constructor surface as the subset the model families use
+    (features tuple, `axis`, optional bias, dtype/param_dtype, inits)
+    and the same param names/shapes, so a quantized tree produced from
+    an `nn.DenseGeneral` init slots straight in. With a plain-array
+    kernel it reproduces DenseGeneral's math (promote + dot_general) —
+    but the plain path only ever runs at init: the class is selected by
+    `cfg.quantized_dense`, which only `QuantizedModule` sets, so
+    unquantized serving never constructs it.
+
+    The Int8 path is the dequant-placement fix (module docstring): the
+    dot reads the int8 kernel through a bare convert (fusable into the
+    operand read — no full-size weight temp), and the per-output-channel
+    scale lands on the `[..., out]` OUTPUT in f32 before the cast back,
+    which is also where the legacy scheme's precision lived (f32
+    multiply, then cast)."""
+
+    features: Union[int, Sequence[int]]
+    axis: Union[int, Sequence[int]] = -1
+    use_bias: bool = True
+    dtype: Any = None
+    param_dtype: Any = jnp.float32
+    kernel_init: Callable = nn.initializers.lecun_normal()
+    bias_init: Callable = nn.initializers.zeros_init()
+
+    @nn.compact
+    def __call__(self, inputs):
+        feats = ((self.features,) if isinstance(self.features, int)
+                 else tuple(self.features))
+        axes = ((self.axis,) if isinstance(self.axis, int)
+                else tuple(self.axis))
+        axes = tuple(a % inputs.ndim for a in axes)
+        kshape = tuple(inputs.shape[a] for a in axes) + feats
+        kernel = self.param("kernel", self.kernel_init, kshape,
+                            self.param_dtype)
+        bias = (self.param("bias", self.bias_init, feats,
+                           self.param_dtype) if self.use_bias else None)
+        contract = ((axes, tuple(range(len(axes)))), ((), ()))
+        if isinstance(kernel, Int8Leaf):
+            out_dtype = self.dtype or inputs.dtype
+            # f32 accumulation: int8 dots natively accumulate wide (the
+            # MXU does this for free), and the f32 partials + f32 scale
+            # make this path strictly MORE precise than the legacy
+            # dequantize-then-bf16-matmul, not just cheaper.
+            y = jax.lax.dot_general(inputs.astype(out_dtype),
+                                    kernel.q.astype(out_dtype), contract,
+                                    preferred_element_type=jnp.float32)
+            scale = kernel.scale.reshape(feats)  # contraction dims are 1
+            y = (y * scale).astype(out_dtype)
+        else:
+            inputs, kernel = nn.dtypes.promote_dtype(inputs, kernel,
+                                                     dtype=self.dtype)
+            y = jax.lax.dot_general(inputs, kernel, contract)
+        if bias is not None:
+            bias = jnp.asarray(bias, y.dtype)
+            y = y + bias.reshape((1,) * (y.ndim - len(feats)) + feats)
+        return y
+
+
+def quant_embed_lookup(embed: Any, tokens, dtype):
+    """Token-embedding gather with Int8Leaf awareness: gather the int8
+    rows and the matching per-row scales, multiply AFTER the gather —
+    `[B, S, D]` work instead of dequantizing the whole `[V, D]` table
+    per call (which the decode scan would otherwise pay per step)."""
+    if not isinstance(embed, Int8Leaf):
+        return embed.astype(dtype)[tokens]
+    rows = embed.q[tokens].astype(jnp.float32)
+    return (rows * embed.scale[tokens]).astype(dtype)
+
+
+def quant_unembed(x, embed: Any, dtype):
+    """Tied-embedding unembed `x @ embed.T` with the scale applied to
+    the logits (per-vocab-row scale = per-output-channel of the
+    transposed matmul) — the same output-side placement as
+    Int8DenseGeneral."""
+    if not isinstance(embed, Int8Leaf):
+        return jnp.einsum("bsh,vh->bsv", x, embed.astype(dtype))
+    logits = jnp.einsum("bsh,vh->bsv", x, embed.q.astype(dtype))
+    return (logits.astype(jnp.float32)
+            * embed.scale.reshape(1, 1, -1)).astype(dtype)
+
+
+class QuantizedModule:
+    """Wraps a flax module so `apply` serves a quantized params tree —
+    quantization stays a storage detail invisible to every serving path
+    that holds a (module, params) pair.
+
+    Modules whose config carries a `quantized_dense` field (the Llama
+    family — llama/mistral/qwen/gemma configs) are REBUILT with the flag
+    set: their dense/embed sites consume `Int8Leaf` leaves natively
+    (`Int8DenseGeneral` — output-side scale, no full-weight dequant), so
+    `apply` passes `kernel`/`embed` leaves through raw and dequantizes
+    only the rest (MoE expert stacks, other families' tensors).
+    `legacy_dequant=True` restores the old dequantize-everything wrapper
+    — the A/B control for the SERVEBENCH `quant` row."""
+
+    def __init__(self, module: Any, dtype: Any = jnp.bfloat16,
+                 legacy_dequant: bool = False):
         self.dtype = dtype
+        self.legacy_dequant = bool(legacy_dequant)
+        cfg = getattr(module, "cfg", None)
+        self._native_quant = (not legacy_dequant and cfg is not None
+                              and hasattr(cfg, "quantized_dense"))
+        if self._native_quant and not cfg.quantized_dense:
+            import dataclasses
+
+            # Rebuild by REPLACING the module's cfg field, never by
+            # re-constructing `type(module)(cfg)`: flax modules are
+            # dataclasses, and reconstruction would drop every other
+            # field (MoELlama's mlp_cls=MoEBlock — the routed-expert
+            # trunk would silently become a dense MLPBlock whose params
+            # don't exist).
+            module = dataclasses.replace(
+                module,
+                cfg=dataclasses.replace(cfg, quantized_dense=True))
+        self.module = module
+
+    def _prepare(self, params: Any) -> Any:
+        if not self._native_quant:
+            return dequantize_tree(params, self.dtype)
+
+        flat, treedef = jax.tree_util.tree_flatten_with_path(
+            params, is_leaf=_is_quant_leaf)
+
+        def prep(path, leaf):
+            if not _is_quant_leaf(leaf):
+                return leaf
+            names = [str(k.key) for k in path if hasattr(k, "key")]
+            tail = names[-1] if names else ""
+            # Handled natively by the quant-aware sites; everything else
+            # (MoE expert stacks etc.) keeps the legacy dequant.
+            if tail in ("kernel", "embed"):
+                return leaf
+            return leaf.dequantize(self.dtype)
+
+        return jax.tree_util.tree_unflatten(
+            treedef, [prep(p, l) for p, l in flat])
 
     def apply(self, variables: dict, *args, **kwargs):
         variables = dict(variables)
-        variables["params"] = dequantize_tree(variables["params"],
-                                              self.dtype)
+        variables["params"] = self._prepare(variables["params"])
         return self.module.apply(variables, *args, **kwargs)
 
     def __getattr__(self, name):  # cfg etc. pass through

@@ -137,12 +137,14 @@ class Router:
         self.affinity = bool(affinity)
         self.spill_margin = float(spill_margin)
         self.vnodes = int(vnodes)
-        self._ring: list[tuple[int, str]] = []  # guarded-by: _ring_lock
-        self._ring_version = -1  # guarded-by: _ring_lock
+        #: intent -> (version, ring) — the prefill/unified rings differ
+        #: in a role-split fleet, so each intent caches its own.
+        self._rings: dict = {}  # guarded-by: _ring_lock
         self._ring_lock = threading.Lock()
         self.stats = {  # guarded-by: _stats_lock
             "placed": 0, "affinity_hits": 0, "spills": 0,
-            "least_loaded": 0, "retries": 0, "ok": 0,
+            "least_loaded": 0, "decode_pool": 0, "retries": 0, "ok": 0,
+            "handoffs": 0, "handoff_retries": 0,
             "sheds_forwarded": 0, "no_replica": 0, "errors": 0,
         }
         self._stats_lock = threading.Lock()
@@ -155,21 +157,24 @@ class Router:
         with self._stats_lock:
             self.stats[key] = self.stats.get(key, 0) + n
 
-    def _ring_for(self, names: list[str],
-                  version: int) -> list[tuple[int, str]]:
+    def _ring_for(self, names: list[str], version: int,
+                  intent: str | None = None) -> list[tuple[int, str]]:
         """The consistent-hash ring over `names`, rebuilt only when fleet
-        membership/state changed (cheap version check otherwise).
-        `version` must have been read BEFORE `names` was snapshotted: a
-        membership change between the two then stamps the fresher set
-        with the older version — over-invalidation (one spare rebuild),
-        never a stale ring cached under the newest version."""
+        membership/state changed (cheap version check otherwise); cached
+        PER INTENT, since a role-split fleet's prefill ring covers a
+        different replica set than the unified one. `version` must have
+        been read BEFORE `names` was snapshotted: a membership change
+        between the two then stamps the fresher set with the older
+        version — over-invalidation (one spare rebuild), never a stale
+        ring cached under the newest version."""
         with self._ring_lock:
-            if version == self._ring_version:
-                return self._ring
+            cached = self._rings.get(intent)
+            if cached is not None and cached[0] == version:
+                return cached[1]
         ring = sorted((_hash64(f"{name}#{i}"), name)
                       for name in names for i in range(self.vnodes))
         with self._ring_lock:
-            self._ring, self._ring_version = ring, version
+            self._rings[intent] = (version, ring)
         return ring
 
     def _ring_lookup(self, ring, point: int) -> str | None:
@@ -183,14 +188,37 @@ class Router:
     # hash math only — the fleet poller already cached every load
     # signal, so nothing here blocks on a scrape, a device, or I/O.
     # tpk-hot: router-placement
-    def place(self, key: str | None,
-              exclude: frozenset = frozenset()) -> tuple[str | None, str]:
+    def place(self, key: str | None, exclude: frozenset = frozenset(),
+              intent: str | None = None) -> tuple[str | None, str]:
         """Choose a replica for a request with affinity key `key`
         (None = no prefix signal). Returns (replica_name, reason);
         (None, "no_replica") when nothing is placeable. `exclude` drops
-        replicas that already failed this request (retry path)."""
+        replicas that already failed this request (retry path).
+
+        `intent` selects the disaggregation phase (ISSUE 13): "prefill"
+        placements keep the prefix-affinity logic over prefill-capable
+        replicas (cache warmth lives where prefills run); "decode"
+        placements are load/pool-driven — least loaded, ties broken by
+        the LARGEST free-block pool (the admission currency), then name
+        — affinity would be meaningless there, the KV arrives on the
+        wire. None is the unified full-request intent."""
         version = self.fleet.version()  # before loads() — see _ring_for
-        loads = self.fleet.loads()
+        if intent == "decode":
+            sig = self.fleet.signals("decode")
+            candidates = {n: v for n, v in sig.items()
+                          if n not in exclude}
+            if not candidates:
+                self._bump("no_replica")
+                return None, "no_replica"
+            chosen = min(candidates,
+                         key=lambda n: (candidates[n][0],
+                                        -candidates[n][1], n))
+            res_metrics.inc("tpk_router_placement_total",
+                            reason="decode-pool")
+            self._bump("placed")
+            self._bump("decode_pool")
+            return chosen, "decode-pool"
+        loads = self.fleet.loads(intent=intent)
         candidates = loads if not exclude else \
             {n: v for n, v in loads.items() if n not in exclude}
         if not candidates:
@@ -205,7 +233,7 @@ class Router:
             # exclusions must apply at lookup time, never to the ring
             # itself (a poisoned cache would silently drop a healthy
             # replica from affinity until the next membership change).
-            ring = self._ring_for(sorted(loads), version)
+            ring = self._ring_for(sorted(loads), version, intent)
             target = self._ring_lookup(ring, _hash64(key))
             if target in candidates:
                 if candidates[target] - floor < self.spill_margin:
@@ -391,15 +419,30 @@ class ProxyHandler(_RouterBase):
                 # picks the relay mode (a false positive only costs
                 # chunk-wise relay of a non-streamed reply).
                 wants_stream = b'"stream"' in raw
+        if (is_generative and self.request.method == "POST"
+                and self.fleet.role_split()):
+            # Disaggregated fleet (ISSUE 13): two-phase handoff —
+            # prefill replica ships KV blocks, decode replica streams
+            # the tokens. Falls through to the unified path when no
+            # prefill-capable replica is placeable (or the surface has
+            # no :prefill mapping, e.g. the OpenAI facade).
+            if await self._proxy_disagg(route, trace_id, deadline, key,
+                                        wants_stream):
+                return
         loop = asyncio.get_event_loop()
         attempts = 0
         exclude: set[str] = set()
         max_attempts = max(len(self.fleet.names()), 1)
+        # A full generate needs a replica serving BOTH phases (a
+        # decode-role replica would refuse the prefill); metadata and
+        # tensor-infer traffic places over every role.
+        intent = "generate" if is_generative else None
         while True:
             with obs.span("router.place", trace_id=trace_id,
                           path=full_path) as sp:
                 name, reason = self.router.place(key,
-                                                 exclude=frozenset(exclude))
+                                                 exclude=frozenset(exclude),
+                                                 intent=intent)
                 sp.set(replica=name or "-", reason=reason)
             if name is None:
                 self._count(None, "no_replica")
@@ -503,6 +546,238 @@ class ProxyHandler(_RouterBase):
                 self.fleet.checkin(name)
             return
 
+    def _remaining_headers(self, trace_id: str,
+                           deadline: Deadline | None,
+                           content_type: str | None = None) -> dict:
+        headers = {REQUEST_ID_HEADER: trace_id}
+        if content_type:
+            headers["Content-Type"] = content_type
+        if deadline is not None:
+            rem = deadline.remaining()
+            headers[DEADLINE_HEADER] = str(max(int((rem or 0.0) * 1e3), 1))
+        return headers
+
+    async def _proxy_disagg(self, route: str, trace_id: str,
+                            deadline: Deadline | None, key: str | None,
+                            wants_stream: bool) -> bool:
+        """The prefill→decode handoff (ISSUE 13). Phase 1 places by
+        PREFIX AFFINITY over prefill-capable replicas (cache warmth
+        lives where prefills run) and receives the KV shipment; phase 2
+        places by load/pool over decode-capable replicas and relays the
+        token stream. THE ROUTER HOLDS THE SHIPMENT between phases:
+        once phase 1 returns, the prefill replica owes this request
+        nothing — its death cannot force a re-prefill, and a decode
+        replica failing at connect retries on ANOTHER decode replica
+        with the same bytes (`tpk_router_retry_total{reason=
+        "prefill_handoff"}`), never replaying prefill work. Returns
+        False to fall through to the unified single-phase path (no
+        prefill replica placeable / unmapped surface).
+
+        KEEP IN SYNC with _proxy's forward/retry loop: both phases
+        below deliberately mirror its place → checkout → forward →
+        checkin → classify machinery (the phases differ in intent,
+        path, body, retry reason, and read_body mode, so the loops are
+        parameter-shaped rather than textually twinnable) — a
+        hardening fix landing in the unified loop (deadline guards,
+        draining classification, checkin ordering) almost certainly
+        belongs in both phases here too."""
+        if route.endswith(":generate"):
+            model = route.rsplit("/", 1)[-1][:-len(":generate")]
+        elif route.endswith("/generate"):
+            parts = route.split("/")
+            model = parts[-2] if len(parts) >= 2 else ""
+        else:
+            return False  # no :prefill mapping for this surface
+        if not model:
+            return False
+        loop = asyncio.get_event_loop()
+        prefill_path = f"/v1/models/{model}:prefill"
+        decode_path = f"/v1/models/{model}:decode"
+        max_attempts = max(len(self.fleet.names()), 1)
+        t_handoff0 = time.perf_counter()
+
+        # -- phase 1: chunked prefill → KV shipment ----------------------
+        shipment: bytes | None = None
+        exclude: set[str] = set()
+        attempts = 0
+        while shipment is None:
+            with obs.span("router.place", trace_id=trace_id,
+                          path=prefill_path) as sp:
+                name, reason = self.router.place(
+                    key, frozenset(exclude), intent="prefill")
+                sp.set(replica=name or "-", reason=reason)
+            if name is None:
+                if attempts == 0:
+                    return False  # no prefill capacity: unified path
+                self._count(None, "no_replica")
+                self.router._bump("errors")
+                self.set_header("Retry-After", "1")
+                self.write_json({"error": "no live prefill replica"},
+                                status=503)
+                return True
+            url = self.fleet.url_of(name)
+            if url is None:
+                exclude.add(name)
+                continue
+            if deadline is not None and deadline.expired():
+                self._count(name, "deadline")
+                res_metrics.inc("tpk_deadline_expired_total",
+                                component="router")
+                raise tornado.web.HTTPError(
+                    504, reason="request deadline exceeded (router)")
+            headers = self._remaining_headers(trace_id, deadline,
+                                              "application/json")
+            timeout_s = (deadline.bound(self.server.forward_timeout_s)
+                         if deadline is not None
+                         else self.server.forward_timeout_s)
+            self.fleet.checkout(name)
+            attempts += 1
+            t0 = time.perf_counter()
+            try:
+                result = await loop.run_in_executor(
+                    self.server.executor, _forward_once, url, "POST",
+                    prefill_path, self.request.body or None, headers,
+                    timeout_s, True)
+            except RetryableForwardError as e:
+                # Pre-ship failure: nothing was computed for this
+                # request yet, so re-placing the PREFILL is safe — the
+                # plain connect/draining retry class, not a handoff.
+                self.fleet.checkin(name,
+                                   failed="draining" not in str(e))
+                obs.record("router.forward", t0, time.perf_counter(),
+                           trace_id=trace_id, replica=name,
+                           error=str(e)[:120])
+                expired = deadline is not None and deadline.expired()
+                if attempts <= max_attempts and not expired:
+                    exclude.add(name)
+                    res_metrics.inc(
+                        "tpk_router_retry_total",
+                        reason=("draining" if "draining" in str(e)
+                                else "connect"))
+                    self.router._bump("retries")
+                    continue
+                self._count(name, "deadline" if expired
+                            else "retry_exhausted")
+                self.router._bump("errors")
+                if expired:
+                    res_metrics.inc("tpk_deadline_expired_total",
+                                    component="router")
+                    raise tornado.web.HTTPError(
+                        504, reason="request deadline exceeded "
+                                    "(router retries)") from e
+                raise tornado.web.HTTPError(
+                    502, reason=f"prefill replica {name} unreachable: "
+                                f"{e}") from e
+            except ForwardTimeoutError as e:
+                self.fleet.checkin(name)
+                self._count(name, "upstream_error")
+                self.router._bump("errors")
+                raise tornado.web.HTTPError(
+                    504, reason=f"prefill replica {name} timed out: "
+                                f"{e}") from e
+            except Exception:
+                self.fleet.checkin(name)
+                raise
+            self.fleet.checkin(name)
+            if result.status != 200:
+                # Sheds forward as backpressure, errors relay as-is —
+                # exactly the unified path's contract.
+                await self._relay(result, name, trace_id, t0)
+                return True
+            obs.record("router.forward", t0, time.perf_counter(),
+                       trace_id=trace_id, replica=name, status=200,
+                       phase="prefill")
+            shipment = result.body
+        res_metrics.observe("tpk_prefill_handoff_seconds",
+                            time.perf_counter() - t_handoff0)
+        self.router._bump("handoffs")
+
+        # -- phase 2: shipment → decode replica → caller -----------------
+        exclude2: set[str] = set()
+        attempts2 = 0
+        while True:
+            with obs.span("router.place", trace_id=trace_id,
+                          path=decode_path) as sp:
+                dname, reason = self.router.place(
+                    None, frozenset(exclude2), intent="decode")
+                sp.set(replica=dname or "-", reason=reason)
+            if dname is None:
+                self._count(None, "no_replica")
+                self.router._bump("errors")
+                self.set_header("Retry-After", "1")
+                self.write_json({"error": "no live decode replica"},
+                                status=503)
+                return True
+            url = self.fleet.url_of(dname)
+            if url is None:
+                exclude2.add(dname)
+                continue
+            if deadline is not None and deadline.expired():
+                self._count(dname, "deadline")
+                res_metrics.inc("tpk_deadline_expired_total",
+                                component="router")
+                raise tornado.web.HTTPError(
+                    504, reason="request deadline exceeded (router)")
+            headers = self._remaining_headers(
+                trace_id, deadline, "application/x-tpk-kv")
+            timeout_s = (deadline.bound(self.server.forward_timeout_s)
+                         if deadline is not None
+                         else self.server.forward_timeout_s)
+            self.fleet.checkout(dname)
+            attempts2 += 1
+            t0 = time.perf_counter()
+            try:
+                result = await loop.run_in_executor(
+                    self.server.executor, _forward_once, url, "POST",
+                    decode_path, shipment, headers, timeout_s,
+                    not wants_stream)
+            except RetryableForwardError as e:
+                # THE handoff-resume path: the prefill work is safe in
+                # the router-held shipment, so a dead/draining decode
+                # target costs one re-placement and ZERO re-prefill.
+                self.fleet.checkin(dname,
+                                   failed="draining" not in str(e))
+                obs.record("router.forward", t0, time.perf_counter(),
+                           trace_id=trace_id, replica=dname,
+                           error=str(e)[:120])
+                expired = deadline is not None and deadline.expired()
+                if attempts2 <= max_attempts and not expired:
+                    exclude2.add(dname)
+                    res_metrics.inc("tpk_router_retry_total",
+                                    reason="prefill_handoff")
+                    self.router._bump("retries")
+                    self.router._bump("handoff_retries")
+                    continue
+                self._count(dname, "deadline" if expired
+                            else "retry_exhausted")
+                self.router._bump("errors")
+                if expired:
+                    res_metrics.inc("tpk_deadline_expired_total",
+                                    component="router")
+                    raise tornado.web.HTTPError(
+                        504, reason="request deadline exceeded "
+                                    "(router retries)") from e
+                raise tornado.web.HTTPError(
+                    502, reason=f"decode replica {dname} unreachable: "
+                                f"{e}") from e
+            except ForwardTimeoutError as e:
+                # The decode replica may still be generating: 504, no
+                # replay (a replay would duplicate decode work).
+                self.fleet.checkin(dname)
+                self._count(dname, "upstream_error")
+                self.router._bump("errors")
+                raise tornado.web.HTTPError(
+                    504, reason=f"decode replica {dname} timed out: "
+                                f"{e}") from e
+            except Exception:
+                self.fleet.checkin(dname)
+                raise
+            try:
+                await self._relay(result, dname, trace_id, t0)
+            finally:
+                self.fleet.checkin(dname)
+            return True
+
     async def _relay(self, result: _ForwardResult, name: str,
                      trace_id: str, t0: float) -> None:
         """Stream one upstream response back to the caller."""
@@ -592,7 +867,11 @@ class AdminReplicasHandler(_RouterBase):
         if not name or not url:
             raise tornado.web.HTTPError(
                 400, reason="replica registration needs name and url")
-        self.fleet.add(name, url, grpc=body.get("grpc"))
+        try:
+            self.fleet.add(name, url, grpc=body.get("grpc"),
+                           role=body.get("role", "any"))
+        except ValueError as e:
+            raise tornado.web.HTTPError(400, reason=str(e)) from None
         self.write_json({"added": name})
 
 
@@ -711,8 +990,9 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--port", type=int, default=8090)
     p.add_argument("--grpc-port", type=int, default=None)
     p.add_argument("--replica", action="append", default=[],
-                   metavar="NAME=URL[,GRPC]",
-                   help="replica registration (repeatable)")
+                   metavar="NAME=URL[,GRPC][,role=ROLE]",
+                   help="replica registration (repeatable); role is "
+                        "any|prefill|decode (disaggregated fleets)")
     p.add_argument("--no-affinity", action="store_true",
                    help="disable prefix/adapter affinity (least-loaded "
                         "only; the A/B control)")
@@ -724,9 +1004,16 @@ def main(argv: list[str] | None = None) -> int:
     for spec in args.replica:
         name, _, rest = spec.partition("=")
         if not rest:
-            p.error(f"--replica must be NAME=URL[,GRPC], got {spec!r}")
-        url, _, grpc = rest.partition(",")
-        server.fleet.add(name, url, grpc=grpc or None)
+            p.error(f"--replica must be NAME=URL[,GRPC][,role=ROLE], "
+                    f"got {spec!r}")
+        url, _, tail = rest.partition(",")
+        grpc, role = None, "any"
+        for part in (tail.split(",") if tail else []):
+            if part.startswith("role="):
+                role = part[len("role="):]
+            elif part:
+                grpc = part
+        server.fleet.add(name, url, grpc=grpc, role=role)
     if args.grpc_port is not None:
         bound = server.start_grpc(args.grpc_port)
         print(json.dumps({"event": "router_grpc", "port": bound}),

@@ -88,6 +88,17 @@ _ENGINE_METRICS = (
      "counter"),
     ("__kv_free__", "tpk_kv_blocks_free", "gauge"),
     ("__kv_used__", "tpk_kv_blocks_used", "gauge"),
+    # Disaggregated prefill/decode + host-RAM spill tier (ISSUE 13):
+    # prefill-chunk dispatches (a decode-role replica must read 0 —
+    # the DISAGGBENCH mechanism pin), shipped/received wire blocks,
+    # remote admissions, spill-tier traffic and residency.
+    ("prefill_chunks", "tpk_engine_prefill_chunks_total", "counter"),
+    ("remote_admits", "tpk_engine_remote_admits_total", "counter"),
+    ("kv_blocks_shipped", "tpk_kv_blocks_shipped_total", "counter"),
+    ("kv_blocks_received", "tpk_kv_blocks_received_total", "counter"),
+    ("kv_spilled_blocks", "tpk_kv_spilled_blocks_total", "counter"),
+    ("kv_restored_blocks", "tpk_kv_restored_blocks_total", "counter"),
+    ("__kv_spill__", "tpk_kv_spill_blocks", "gauge"),
     # Live in-flight dispatch count (0 when drained; stuck at ≤1 means
     # the pipeline re-serialized) vs the configured ceiling.
     ("__inflight__", "tpk_decode_inflight_depth", "gauge"),
@@ -599,6 +610,7 @@ class _Base(tornado.web.RequestHandler):
         path = self.request.path
         if (rl is not None and self.request.method == "POST"
                 and (path.endswith(":predict") or path.endswith(":generate")
+                     or path.endswith(":prefill") or path.endswith(":decode")
                      or path.endswith("/infer")
                      or path.endswith("/generate"))):
             args = self.path_args or (None,)
@@ -769,6 +781,112 @@ class GenerateHandler(_Base):
 
         await pump_stream(self, it, render, render_error)
         self.server.observe(name, tokens_out, time.monotonic() - t0)
+
+
+#: Content type of a KV shipment (serve/kv_transfer.py wire format) —
+#: the router relays these bytes opaquely between prefill and decode
+#: replicas.
+KV_SHIPMENT_CONTENT_TYPE = "application/x-tpk-kv"
+
+
+class PrefillHandler(_Base):
+    """POST /v1/models/{name}:prefill — disaggregation phase 1 (ISSUE
+    13): the :generate request body in, a binary KV shipment out. The
+    router (or any caller) forwards those bytes to a decode replica's
+    :decode; the prefill replica's pool holds nothing for this request
+    once the response is on the wire."""
+
+    @admission_gated
+    async def post(self, name: str):
+        model = self.repo.get(name)
+        ship = getattr(model, "prefill_ship", None)
+        if ship is None:
+            raise tornado.web.HTTPError(
+                400, reason=f"model {name!r} cannot prefill-ship "
+                            "(not generative, or no paged KV pool)")
+        body = self.body_json()
+        body.pop("_deadline", None)
+        body.pop("_trace", None)
+        body["_trace"] = self.trace_id
+        deadline = self.request_deadline()
+        if deadline is not None:
+            body["_deadline"] = deadline
+        t0 = time.monotonic()
+        try:
+            out = await self.await_bounded(
+                self.submit_blocking(ship, body), deadline)
+        except KVCapacityExceeded as e:
+            self.write_capacity_shed(str(e))
+            return
+        except (ValueError, RuntimeError) as e:
+            raise tornado.web.HTTPError(400, reason=str(e)) from None
+        self.server.observe(name, out.get("num_input_tokens", 0),
+                            time.monotonic() - t0)
+        self.set_header("Content-Type", KV_SHIPMENT_CONTENT_TYPE)
+        self.finish(out["shipment"])
+
+
+class DecodeHandler(_Base):
+    """POST /v1/models/{name}:decode — disaggregation phase 2: a KV
+    shipment in, the :generate response shape out (streaming when the
+    original caller asked to stream — the flag rides the shipment
+    metadata). The engine admits the shipped blocks straight into
+    decode; this replica never runs a prefill chunk."""
+
+    @admission_gated
+    async def post(self, name: str):
+        from kubeflow_tpu.serve.kv_transfer import (ShipmentError,
+                                                    peek_meta)
+
+        model = self.repo.get(name)
+        dec = getattr(model, "decode_remote", None)
+        if dec is None:
+            raise tornado.web.HTTPError(
+                400, reason=f"model {name!r} cannot decode a shipment "
+                            "(not generative, or no paged KV pool)")
+        shipment = self.request.body or b""
+        try:
+            meta = peek_meta(shipment)
+        except ShipmentError as e:
+            raise tornado.web.HTTPError(
+                400, reason=f"bad KV shipment: {e}") from None
+        deadline = self.request_deadline()
+        t0 = time.monotonic()
+        if (meta.get("extra") or {}).get("stream"):
+            it = model.decode_remote_stream(shipment, deadline=deadline,
+                                            trace_id=self.trace_id)
+            tokens_out = 0
+
+            def render(ev, first):
+                nonlocal tokens_out
+                if first:
+                    self.set_header("Content-Type",
+                                    "application/x-ndjson")
+                tokens_out += len(ev.get("tokens", ()))
+                self.write(json.dumps({"model_name": name, **ev}) + "\n")
+                return bool(ev.get("done"))
+
+            def render_error(msg):
+                return json.dumps({"model_name": name,
+                                   "error": msg}) + "\n"
+
+            await pump_stream(self, it, render, render_error)
+            self.server.observe(name, tokens_out, time.monotonic() - t0)
+            return
+        try:
+            out = await self.await_bounded(
+                self.submit_blocking(
+                    functools.partial(dec, shipment, deadline=deadline,
+                                      trace_id=self.trace_id)),
+                deadline)
+        except KVCapacityExceeded as e:
+            self.write_capacity_shed(str(e))
+            return
+        except (ValueError, RuntimeError) as e:
+            raise tornado.web.HTTPError(400, reason=str(e)) from None
+        self.server.observe(name, out.get("num_output_tokens", 0),
+                            time.monotonic() - t0)
+        self.write_json({"model_name": name, **out})
 
 
 class V2HealthHandler(_Base):
@@ -1095,13 +1213,15 @@ class ModelServer:
                     val = getattr(engine, "pipeline_depth", 1)
                 elif stat_key == "__inflight__":
                     val = getattr(engine, "inflight_depth", 0)
-                elif stat_key in ("__kv_free__", "__kv_used__"):
+                elif stat_key in ("__kv_free__", "__kv_used__",
+                                  "__kv_spill__"):
                     # None on flat engines — the pool gauges only exist
-                    # where a pool does.
-                    val = getattr(engine,
-                                  "kv_blocks_free" if stat_key ==
-                                  "__kv_free__" else "kv_blocks_used",
-                                  None)
+                    # where a pool does (and the spill gauge only where
+                    # a host tier does).
+                    attr = {"__kv_free__": "kv_blocks_free",
+                            "__kv_used__": "kv_blocks_used",
+                            "__kv_spill__": "kv_spill_blocks"}[stat_key]
+                    val = getattr(engine, attr, None)
                     if val is None:
                         continue
                 else:
@@ -1114,6 +1234,19 @@ class ModelServer:
                 v = (int(val) if float(val).is_integer()
                      else round(float(val), 6))
                 lines.append(f'{metric}{{model="{name}"}} {v}')
+        # Engine role as a labeled presence gauge (the fleet poller and
+        # operators read which phase of disaggregated serving a replica
+        # runs): one series per model, value always 1.
+        typed = False
+        for name, engine, _stats in rows:
+            role = getattr(engine, "role", None)
+            if not role:
+                continue
+            if not typed:
+                lines.append("# TYPE tpk_engine_role gauge")
+                typed = True
+            lines.append(
+                f'tpk_engine_role{{model="{name}",role="{role}"}} 1')
         return lines
 
     def app(self) -> tornado.web.Application:
@@ -1126,6 +1259,8 @@ class ModelServer:
             (r"/v1/models/([^/:]+):predict", V1PredictHandler, kw),
             (r"/v1/models/([^/:]+):explain", V1ExplainHandler, kw),
             (r"/v1/models/([^/:]+):generate", GenerateHandler, kw),
+            (r"/v1/models/([^/:]+):prefill", PrefillHandler, kw),
+            (r"/v1/models/([^/:]+):decode", DecodeHandler, kw),
             (r"/v2/models/([^/]+)/generate", GenerateHandler, kw),
             (r"/v2/health/(live|ready)", V2HealthHandler, kw),
             (r"/v2/models/([^/]+)/infer", V2InferHandler, kw),

@@ -117,6 +117,11 @@ GENERATIVE_KNOBS: dict[str, dict] = {
     # Paged KV cache (serve/paging.py): 0 = flat escape hatch.
     "kv_block_size": {"type": "int", "min": 0},
     "kv_blocks": {"type": "int", "min": 0},
+    # Disaggregated prefill/decode (ISSUE 13): "unified" (default) |
+    # "prefill" | "decode"; split roles need kv_block_size > 0.
+    "role": {"type": "string_or_null"},
+    # Host-RAM KV spill tier capacity in blocks (0 = off).
+    "kv_host_tier_blocks": {"type": "int", "min": 0},
     "mesh": {"type": "object"},
     "draft": {"type": "object"},
     "adapters": {"type": "object"},

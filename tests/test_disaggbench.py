@@ -1,0 +1,94 @@
+"""Pins the disaggregation benchmark (kubeflow_tpu/serve/disaggbench.py
+→ DISAGGBENCH.json, ISSUE 13) two ways, per the test_servebench /
+test_ctrlbench conventions:
+
+  * a tier-1 pin on the COMMITTED DISAGGBENCH.json artifact — shape +
+    the mechanism assertions the acceptance criteria name (blocks
+    shipped > 0, ZERO decode-replica prefill chunks, spill/restore
+    counters consistent, disagg p99 TTFT beating unified at goodput no
+    worse) so the recorded claim can't silently rot or be edited into
+    nonsense;
+  * a slow-tier re-run of the quick shape, so the harness itself can't
+    rot between recordings.
+
+Absolute latencies are CPU-tiny-model numbers (the artifact says so);
+assertions here are mechanism-strong / absolute-weak.
+"""
+
+import json
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ARTIFACT = os.path.join(REPO, "DISAGGBENCH.json")
+
+
+def _check_shape(r: dict, *, recorded: bool) -> None:
+    assert r["metric"] == "disaggbench"
+    assert r["mode"] == "real-tiny-engines-cpu"
+    assert "REAL GenerationEngine" in r["note"]  # honest labeling
+    assert "skipped" in r["chip_row"]  # chip row carries its reason
+    uni, dis = r["arms"]["unified"], r["arms"]["disagg"]
+    for arm in (uni, dis):
+        assert arm["requests"] > 0
+        assert arm["completed_ok"] > 0
+        assert arm["errors"] == 0
+        assert arm["ttft_p50_ms"] and arm["ttft_p99_ms"]
+        assert arm["ttft_p99_ms"] >= arm["ttft_p50_ms"]
+        assert arm["decode_tail_p99_ms"] and arm["decode_tail_p99_ms"] > 0
+
+    # -- mechanism: the role split actually happened ---------------------
+    roles = {v["role"] for v in dis["replicas"].values()}
+    assert roles == {"prefill", "decode"}
+    shipped = received = 0
+    for rep in dis["replicas"].values():
+        if rep["role"] == "decode":
+            # THE disaggregation invariant: zero prefill chunks ever
+            # ran on a decode replica; every admission came off the
+            # wire.
+            assert rep["prefill_chunks"] == 0
+            assert rep["remote_admits"] == dis["completed_ok"]
+            received += rep["kv_blocks_received"]
+        else:
+            assert rep["decode_dispatches"] == 0
+            assert rep["prefill_chunks"] > 0
+            shipped += rep["kv_blocks_shipped"]
+        # Spill counters consistent: restored never exceeds spilled.
+        assert rep["kv_restored_blocks"] <= rep["kv_spilled_blocks"]
+    assert shipped > 0
+    assert shipped == received  # every shipped block landed
+    assert dis["router"]["handoffs"] == dis["completed_ok"]
+    assert dis["router"]["decode_pool"] == dis["router"]["handoffs"]
+    # The unified arm never ships — it IS the escape hatch.
+    for rep in uni["replicas"].values():
+        assert rep["role"] == "unified"
+        assert rep["kv_blocks_shipped"] == 0
+        assert rep["remote_admits"] == 0
+    assert uni["router"]["handoffs"] == 0
+
+    if recorded:
+        # The acceptance claim lives in the RECORDED artifact: disagg
+        # beats unified on p99 TTFT under mixed long-prompt traffic at
+        # equal engines, with goodput no worse. (The re-run pin below
+        # does not repeat the latency claim — single quick runs on a
+        # shared CI host are too noisy to gate on; the recorded run is
+        # the evidence.)
+        assert r["ttft_p99_ratio"] < 1.0
+        assert r["short_ttft_p99_ratio"] < 1.0
+        assert r["goodput_ratio"] >= 0.99
+        assert dis["shed_rate"] <= uni["shed_rate"] + 1e-9
+
+
+def test_recorded_artifact_shape_and_claims():
+    with open(ARTIFACT) as fh:
+        r = json.load(fh)
+    _check_shape(r, recorded=True)
+    assert r["params"]["quick"] is False  # the real recording
+
+
+@pytest.mark.slow
+def test_disaggbench_quick_shape():
+    from kubeflow_tpu.serve.disaggbench import run_disaggbench
+
+    _check_shape(run_disaggbench(quick=True), recorded=False)

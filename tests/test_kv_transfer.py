@@ -1,0 +1,485 @@
+"""Disaggregated prefill/decode + host-RAM KV tier (ISSUE 13).
+
+Covers the tentpole's correctness surface end to end:
+
+  * wire format: pack/unpack byte identity, malformed-bytes refusal,
+    header peek;
+  * pool → wire → pool BYTE identity through the jitted export/import
+    halves (a KV row must survive serialization exactly — close is
+    wrong);
+  * refcount conservation across export/spill/restore — no leak, no
+    double-free, and the CoW tail fork still happens on a restored
+    prefix;
+  * seeded disagg-vs-unified token+logprob identity (the ISSUE 6
+    methodology applied across two engines and a wire hop);
+  * host-tier LRU spill/restore under pool pressure;
+  * decode-side transient exhaustion: shipped admissions stash
+    head-of-line exactly like local ones;
+  * role discipline: the refusals that make "zero prefill chunks on a
+    decode replica" structural.
+"""
+
+import dataclasses
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models.llama import Llama, llama_tiny
+from kubeflow_tpu.serve.generation import GenerationEngine
+from kubeflow_tpu.serve.kv_transfer import (HostKVTier, ShipmentError,
+                                            pack_shipment, peek_meta,
+                                            unpack_shipment)
+from kubeflow_tpu.serve.paging import blocks_for
+
+CFG = dataclasses.replace(llama_tiny(), dtype=jnp.float32, num_layers=2)
+GEN_KW = dict(max_len=64, chunk=4, prefill_buckets=(8, 16),
+              kv_block_size=8)
+
+
+@pytest.fixture(scope="module")
+def built():
+    model = Llama(CFG)
+    params = jax.jit(lambda r: model.init(
+        r, jnp.zeros((1, 8), jnp.int32))["params"])(jax.random.key(0))
+    return model, params
+
+
+def make_engine(built, **kw):
+    model, params = built
+    merged = dict(GEN_KW, slots=2, kv_blocks=24, seed=0)
+    merged.update(kw)
+    return GenerationEngine(model, params, CFG, **merged)
+
+
+def rng_prompt(seed, n):
+    return list(map(int, np.random.default_rng(seed).integers(
+        1, CFG.vocab_size, n)))
+
+
+# -- wire format ------------------------------------------------------------
+
+
+def test_pack_unpack_roundtrip_byte_identity():
+    rng = np.random.default_rng(0)
+    arrays = {
+        "k": rng.normal(size=(2, 3, 8, 2, 16)).astype(np.float32),
+        "v": rng.normal(size=(2, 3, 8, 2, 16)).astype(np.float32),
+        "rng_key": rng.integers(0, 2**31, 4, dtype=np.uint32),
+    }
+    meta = {"fmt": 1, "tokens": [1, 2, 3], "nested": {"a": None}}
+    data = pack_shipment(meta, arrays)
+    meta2, arrays2 = unpack_shipment(data)
+    assert meta2 == meta
+    assert peek_meta(data) == meta
+    for name, arr in arrays.items():
+        assert arrays2[name].dtype == arr.dtype
+        assert arrays2[name].shape == arr.shape
+        assert arrays2[name].tobytes() == arr.tobytes()
+
+
+def test_pack_unpack_bfloat16_roundtrip():
+    import ml_dtypes
+
+    arr = np.arange(16, dtype=np.float32).astype(ml_dtypes.bfloat16)
+    meta2, arrays2 = unpack_shipment(pack_shipment({}, {"k": arr}))
+    assert arrays2["k"].dtype == arr.dtype
+    assert arrays2["k"].tobytes() == arr.tobytes()
+
+
+def test_unpack_refuses_malformed():
+    good = pack_shipment({"fmt": 1}, {"k": np.zeros(4, np.float32)})
+    for bad in (b"", b"garbage-bytes", good[:10], good[:-3],
+                good + b"trailing", b"TPKV9\n" + good[6:]):
+        with pytest.raises(ShipmentError):
+            unpack_shipment(bad)
+    with pytest.raises(ShipmentError):
+        peek_meta(b"not a shipment")
+    with pytest.raises(ShipmentError):
+        unpack_shipment("not-bytes")
+
+
+# -- host tier units --------------------------------------------------------
+
+
+def test_host_tier_lru_and_counters():
+    tier = HostKVTier(10)
+    assert tier.put(0, (1, 2), 4, b"a")
+    assert tier.put(0, (1, 2, 3), 4, b"b")
+    # Third entry overflows: LRU (the first put) evicts.
+    assert tier.put(0, (9,), 4, b"c")
+    s = tier.stats_snapshot()
+    assert s["resident_blocks"] == 8 and s["evicted_blocks"] == 4
+    assert tier.probe_longest(0, [1, 2, 3, 4]) == 3
+    assert tier.probe_longest(0, [1, 2, 3]) is None  # strictly shorter
+    assert tier.probe_longest(1, [1, 2, 3, 4]) is None  # adapter-keyed
+    assert tier.take(0, (1, 2, 3)) == (4, b"b")
+    assert tier.take(0, (1, 2, 3)) is None  # retired on take
+    s = tier.stats_snapshot()
+    assert s["restored_blocks"] == 4 and s["resident_blocks"] == 4
+    # An entry larger than the whole tier is refused, not thrashed in.
+    assert not tier.put(0, (7, 7), 11, b"x")
+    assert tier.stats_snapshot()["rejected_blocks"] == 11
+    # Hash-verification: same hash family, different tokens never serve.
+    assert tier.put(2, (5, 6), 2, b"y")
+    assert tier.take(2, (5, 7)) is None
+
+
+# -- pool → wire → pool -----------------------------------------------------
+
+
+def test_pool_wire_pool_byte_identity(built):
+    """Committed blocks gather → serialize → scatter into fresh blocks
+    → gather again BYTE-identically (the wire can never perturb a KV
+    row)."""
+    eng = make_engine(built, prefix_cache=1)
+    try:
+        prompt = rng_prompt(3, 17)
+        eng.submit(prompt, max_tokens=2)
+        (kt, blocks) = next(iter(eng._prefix_lru.values()))
+        blocks = list(blocks)
+        mb = eng.max_len // eng._kv_bs
+        gt = np.zeros((mb,), np.int32)
+        gt[:len(blocks)] = blocks
+        g1 = eng._export_blocks(eng._cache, jnp.asarray(gt))
+        arrays = {k: np.asarray(v)[:, :len(blocks)].copy()
+                  for k, v in g1.items()}
+        payload = pack_shipment({"fmt": 1, "tokens": list(kt)}, arrays)
+        meta2, arrays2 = unpack_shipment(payload)
+        for k in arrays:
+            assert arrays2[k].tobytes() == arrays[k].tobytes()
+        fresh = eng._kv_alloc.alloc(len(blocks))
+        assert fresh is not None and set(fresh).isdisjoint(blocks)
+        st_tbl = np.zeros((mb,), np.int32)
+        st_tbl[:len(fresh)] = fresh
+        dev = {}
+        for name in ("k", "v"):
+            pad = np.zeros((arrays2[name].shape[0], mb)
+                           + arrays2[name].shape[2:],
+                           arrays2[name].dtype)
+            pad[:, :len(blocks)] = arrays2[name]
+            dev[name] = jnp.asarray(pad)
+        eng._cache = eng._import_blocks(eng._cache, dev,
+                                       jnp.asarray(st_tbl))
+        g2 = eng._export_blocks(eng._cache, jnp.asarray(st_tbl))
+        for name in ("k", "v"):
+            got = np.asarray(g2[name])[:, :len(blocks)]
+            assert got.tobytes() == arrays[name].tobytes()
+        eng._kv_alloc.decref(fresh)
+    finally:
+        eng.close()
+
+
+# -- disagg-vs-unified identity ---------------------------------------------
+
+
+def test_disagg_identical_to_unified_sampled(built):
+    """THE identity pin (ISSUE 6 methodology across the wire): a
+    seeded SAMPLED stream through prefill_ship → shipment → decode
+    replica is token+logprob-identical to the unified engine on the
+    same seed — the shipped RNG key state continues the exact key-split
+    stream."""
+    prompt = rng_prompt(7, 21)
+    uni = make_engine(built, seed=5)
+    try:
+        ref = uni.submit(prompt, max_tokens=10, temperature=0.8)
+    finally:
+        uni.close()
+    pre = make_engine(built, seed=5, role="prefill")
+    dec = make_engine(built, seed=999, role="decode")
+    try:
+        ship = pre.prefill_ship(prompt, max_tokens=10, temperature=0.8,
+                                timeout=77.0)
+        assert ship["kv_blocks"] == blocks_for(len(prompt), 8)
+        # The caller's budget rides the shipment: the decode replica
+        # must wait as long as the unified engine would have, not a
+        # role-local default.
+        assert peek_meta(ship["shipment"])["timeout"] == 77.0
+        assert pre.stats_snapshot()["kv_blocks_shipped"] == \
+            ship["kv_blocks"]
+        out = dec.submit_remote(ship["shipment"])
+        assert out["output_ids"] == ref["output_ids"]
+        assert out["output_logprobs"] == ref["output_logprobs"]
+        s = dec.stats_snapshot()
+        assert s["prefill_chunks"] == 0
+        assert s["remote_admits"] == 1
+        assert s["kv_blocks_received"] == ship["kv_blocks"]
+        # Prefill side never decoded, and its pool drained fully.
+        sp = pre.stats_snapshot()
+        assert sp["decode_dispatches"] == 0
+        assert pre._kv_alloc.used_blocks == 0
+    finally:
+        pre.close()
+        dec.close()
+
+
+def test_disagg_identical_to_unified_greedy_chunked(built):
+    """Greedy + a prompt long enough to chunk (2 prefill chunks) — and
+    the unified path itself accepts shipments (role='unified' serves
+    both phases)."""
+    prompt = rng_prompt(11, 30)
+    uni = make_engine(built, seed=2)
+    try:
+        ref = uni.submit(prompt, max_tokens=8)
+        # Unified engines can ALSO ship/receive — same identity.
+        ship = uni.prefill_ship(prompt, max_tokens=8)
+    finally:
+        uni.close()
+    uni2 = make_engine(built, seed=2)
+    try:
+        out = uni2.submit_remote(ship["shipment"])
+        assert out["output_ids"] == ref["output_ids"]
+        assert out["output_logprobs"] == ref["output_logprobs"]
+    finally:
+        uni2.close()
+
+
+def test_unified_default_untouched(built):
+    """The escape hatch: a default engine is role='unified' with no
+    host tier, refuses nothing, and a flat engine refuses the wire
+    paths loudly (KV blocks are the unit — there are none)."""
+    eng = make_engine(built)
+    try:
+        assert eng.role == "unified"
+        assert eng._host_tier is None
+        assert eng.kv_spill_blocks is None
+    finally:
+        eng.close()
+    model, params = built
+    flat = GenerationEngine(model, params, CFG, slots=1, max_len=32,
+                            chunk=4, prefill_buckets=(8,))
+    try:
+        with pytest.raises(RuntimeError, match="paged"):
+            flat.prefill_ship([1, 2, 3])
+        with pytest.raises(RuntimeError, match="paged"):
+            flat.submit_remote(b"anything")
+    finally:
+        flat.close()
+    with pytest.raises(ValueError, match="paged KV"):
+        GenerationEngine(model, params, CFG, slots=1, max_len=32,
+                         chunk=4, prefill_buckets=(8,), role="decode")
+    with pytest.raises(ValueError, match="role"):
+        GenerationEngine(model, params, CFG, slots=1, max_len=32,
+                         chunk=4, prefill_buckets=(8,),
+                         role="bogus")
+
+
+def test_role_discipline(built):
+    pre = make_engine(built, role="prefill")
+    dec = make_engine(built, role="decode")
+    try:
+        with pytest.raises(RuntimeError, match="refuses a local"):
+            pre.submit([1, 2, 3], max_tokens=2)
+        with pytest.raises(RuntimeError, match="refuses a local"):
+            dec.submit([1, 2, 3], max_tokens=2)
+        with pytest.raises(RuntimeError, match="refuses prefill"):
+            dec.prefill_ship([1, 2, 3])
+        with pytest.raises(RuntimeError, match="refuses decode"):
+            pre.submit_remote(b"x")
+    finally:
+        pre.close()
+        dec.close()
+
+
+def test_shipment_compat_guards(built):
+    """Mismatched pools/models refuse loudly instead of decoding
+    garbage."""
+    pre = make_engine(built, role="prefill")
+    try:
+        ship = pre.prefill_ship(rng_prompt(1, 9), max_tokens=4)
+    finally:
+        pre.close()
+    model, params = built
+    other = GenerationEngine(model, params, CFG, slots=2, max_len=64,
+                             chunk=4, prefill_buckets=(8, 16),
+                             kv_block_size=16, kv_blocks=12,
+                             role="decode")
+    try:
+        with pytest.raises(ShipmentError, match="block_size"):
+            other.submit_remote(ship["shipment"])
+        with pytest.raises(ShipmentError):
+            other.submit_remote(b"TPKV1\n garbage")
+    finally:
+        other.close()
+
+
+# -- refcounts across export / spill / restore ------------------------------
+
+
+def test_refcount_conservation_and_cow_after_restore(built):
+    """Blocks cross export → host tier → restore with exact refcount
+    conservation: after every request retires and every cache entry
+    evicts, the pool is whole (no leak); the allocator's loud
+    double-free guard never fires; and a restored prefix still forks
+    its partial tail block (CoW) instead of sharing it."""
+    eng = make_engine(built, prefix_cache=2, kv_host_tier_blocks=64,
+                      kv_blocks=20)
+    try:
+        alloc = eng._kv_alloc
+        p1 = rng_prompt(21, 17)  # boundaries at 8, 16; tail partial
+        eng.submit(p1 + [5], max_tokens=4)
+        # Crowd the cache so p1's entries spill to the host tier.
+        eng.submit(rng_prompt(22, 17) + [6], max_tokens=4)
+        eng.submit(rng_prompt(23, 17) + [7], max_tokens=4)
+        s = eng.stats_snapshot()
+        assert s["kv_spilled_blocks"] > 0
+        # Restore-on-hit: the 18-token spilled prefix (NOT
+        # block-aligned — 18 % 8 = 2 committed rows in its tail block)
+        # comes back, maps its 2 full blocks zero-copy, and FORKS the
+        # partial tail (CoW) for the new request.
+        cow0 = s["kv_cow_copies"]
+        probe = p1 + [5, 9, 9]  # extends the stored 18-token prefix
+        r = eng.submit(probe, max_tokens=4)
+        s = eng.stats_snapshot()
+        assert s["kv_restored_blocks"] > 0
+        assert s["prefix_hits"] >= 1
+        assert s["kv_cow_copies"] > cow0
+        # Restored KV must be CORRECT: a fresh engine recomputing the
+        # same prompt greedily emits the same tokens.
+        fresh = make_engine(built, kv_blocks=20)
+        try:
+            ref = fresh.submit(probe, max_tokens=4)
+        finally:
+            fresh.close()
+        assert r["output_ids"] == ref["output_ids"]
+        # Conservation: retire everything — only cache refs remain;
+        # evict them all (each spills, then decrefs) and the pool must
+        # be exactly whole. A double-free would have raised in decref.
+        while eng._prefix_lru:
+            eng._prefix_evict(next(iter(eng._prefix_lru)))
+        assert alloc.used_blocks == 0
+        assert alloc.free_blocks == alloc.n_blocks
+        tier = eng._host_tier.stats_snapshot()
+        assert (tier["spilled_blocks"]
+                == tier["restored_blocks"] + tier["evicted_blocks"]
+                + tier["resident_blocks"])
+    finally:
+        eng.close()
+
+
+def test_tier_lru_under_pool_pressure(built):
+    """A tier smaller than the spilled set LRU-evicts: the oldest
+    spilled prefix falls off, the newest restores."""
+    eng = make_engine(built, prefix_cache=1, kv_host_tier_blocks=4,
+                      kv_blocks=20)
+    try:
+        p1, p2 = rng_prompt(31, 17), rng_prompt(32, 17)
+        eng.submit(p1 + [1], max_tokens=2)   # cache holds p1 tail
+        eng.submit(p2 + [2], max_tokens=2)   # evicts+spills p1 (2 blocks)
+        eng.submit(rng_prompt(33, 17) + [3], max_tokens=2)  # spills p2
+        tier = eng._host_tier.stats_snapshot()
+        # Tier capacity 4 = two 2-block prefixes... p1's spill was
+        # followed by p2's and a third — LRU keeps only the newest two.
+        assert tier["resident_blocks"] <= 4
+        assert tier["evicted_blocks"] > 0 or tier["resident_blocks"] == 4
+    finally:
+        eng.close()
+
+
+def test_restore_skipped_when_admission_would_not_fit(built):
+    """Livelock guard: on a pool where restore + the admission's own
+    reserve cannot coexist, the restore is SKIPPED and the admission
+    proceeds cold — without the guard, _kv_fits would sacrifice-spill
+    the prefix, the admission would restore it back (eating the last
+    headroom), its reserve would stash head-of-line, and the pair would
+    ping-pong forever."""
+    eng = make_engine(built, prefix_cache=2, kv_host_tier_blocks=16,
+                      kv_blocks=3, prefill_buckets=(8,))
+    try:
+        p18 = rng_prompt(61, 18)  # boundaries at 16 and 18 (partial tail)
+        eng.submit(p18, max_tokens=2)
+        while eng._prefix_lru:  # evict everything → spill to the tier
+            eng._prefix_evict(next(iter(eng._prefix_lru)))
+        assert eng._kv_alloc.free_blocks == 3
+        assert eng._host_tier.resident_blocks > 0
+        # 20-token prompt: restore of the 18-token spill (3 blocks)
+        # plus the reserve (3 total − 2 zero-copy) needs 4 blocks — one
+        # more than the pool. Must complete COLD, never hang.
+        r = eng.submit(p18 + [9, 9], max_tokens=4, timeout=60.0)
+        assert len(r["output_ids"]) == 4
+        assert eng.stats_snapshot()["kv_restored_blocks"] == 0
+        fresh = make_engine(built, kv_blocks=8, prefill_buckets=(8,))
+        try:
+            ref = fresh.submit(p18 + [9, 9], max_tokens=4)
+        finally:
+            fresh.close()
+        assert r["output_ids"] == ref["output_ids"]
+    finally:
+        eng.close()
+
+
+# -- decode-side head-of-line on transient exhaustion -----------------------
+
+
+def test_remote_admission_stashes_head_of_line(built):
+    """Two shipped requests whose combined worst case exceeds the
+    decode pool: the second stashes in _kv_stash (head-of-line, FIFO)
+    and admits only as the first retires — and both streams complete
+    correctly."""
+    prompt = rng_prompt(41, 17)
+    pre = make_engine(built, role="prefill")
+    try:
+        ship1 = pre.prefill_ship(prompt, max_tokens=40)
+        ship2 = pre.prefill_ship(rng_prompt(42, 17), max_tokens=40)
+    finally:
+        pre.close()
+    # Worst case per request: 17 prompt + 40 budget tokens → 8 blocks
+    # of 8; a 12-block pool fits one, not two.
+    dec = make_engine(built, role="decode", kv_blocks=12)
+    try:
+        outs = {}
+
+        def run(tag, ship):
+            outs[tag] = dec.submit_remote(ship["shipment"])
+
+        t1 = threading.Thread(target=run, args=("a", ship1))
+        t1.start()
+        # Wait until the first is admitted (occupies the pool).
+        deadline = time.monotonic() + 20
+        while not any(dec._slots) and time.monotonic() < deadline:
+            time.sleep(0.002)
+        assert any(dec._slots), "first shipment never admitted"
+        t2 = threading.Thread(target=run, args=("b", ship2))
+        t2.start()
+        # The second CANNOT fit: it must appear in the head-of-line
+        # stash while the first still decodes.
+        stashed = False
+        while time.monotonic() < deadline:
+            if dec._kv_stash:
+                stashed = True
+                break
+            if outs.get("b") is not None:
+                break
+            time.sleep(0.002)
+        t1.join(timeout=60)
+        t2.join(timeout=60)
+        assert stashed, "second shipment never hit the stash"
+        assert len(outs["a"]["output_ids"]) == 40
+        assert len(outs["b"]["output_ids"]) == 40
+        s = dec.stats_snapshot()
+        assert s["remote_admits"] == 2 and s["prefill_chunks"] == 0
+        assert dec._kv_alloc.used_blocks == 0
+    finally:
+        dec.close()
+
+
+def test_remote_never_fits_sheds(built):
+    """A shipment whose worst case exceeds the whole decode pool sheds
+    as KVCapacityExceeded (503 contract), exactly like a local
+    never-fits admission."""
+    from kubeflow_tpu.serve.generation import KVCapacityExceeded
+
+    pre = make_engine(built, role="prefill")
+    try:
+        ship = pre.prefill_ship(rng_prompt(51, 17), max_tokens=40)
+    finally:
+        pre.close()
+    dec = make_engine(built, role="decode", kv_blocks=4)
+    try:
+        with pytest.raises(KVCapacityExceeded):
+            dec.submit_remote(ship["shipment"])
+    finally:
+        dec.close()

@@ -966,6 +966,255 @@ def test_admin_replica_table_and_cli(duo, capsys):
     assert {r["name"] for r in parsed["replicas"]} == {"r0", "r1"}
 
 
+# -- disaggregated prefill/decode handoff (ISSUE 13) ------------------------
+
+
+def _disagg_fleet(n_decode=2, prefill_kw=None, decode_kw=None):
+    """1 prefill-role + N decode-role fake replicas behind one router."""
+    pre = make_fake_replica("m", **(prefill_kw or {}))
+    decs = [make_fake_replica("m", **(decode_kw or {}))
+            for _ in range(n_decode)]
+    router = RouterServer()
+    router.fleet.poll_interval_s = 0.1
+    router.fleet.add("pre0", pre[1], role="prefill")
+    for i, (_, url, _) in enumerate(decs):
+        router.fleet.add(f"dec{i}", url, role="decode")
+    base = f"http://127.0.0.1:{router.start_background()}"
+    return base, router, pre, decs
+
+
+def test_disagg_two_phase_flow():
+    base, router, pre, decs = _disagg_fleet()
+    try:
+        time.sleep(0.3)  # first scrape
+        code, hdrs, body = _http(
+            "POST", f"{base}/v1/models/m:generate",
+            {"input_ids": list(range(40)), "max_tokens": 8},
+            headers={"X-Request-Id": "trace-disagg-1",
+                     "Content-Type": "application/json"})
+        assert code == 200
+        assert body["num_output_tokens"] == 8
+        assert hdrs.get("X-Request-Id") == "trace-disagg-1"
+        # Phase split: the prefill replica prefilled and shipped, a
+        # decode replica imported and decoded — and NEVER prefilled.
+        ps = pre[2].engine.stats_snapshot()
+        assert ps["prefill_chunks"] == 1
+        assert ps["kv_blocks_shipped"] > 0
+        dstats = [d[2].engine.stats_snapshot() for d in decs]
+        assert sum(s.get("remote_admits", 0) for s in dstats) == 1
+        assert all(s.get("prefill_chunks", 0) == 0 for s in dstats)
+        rs = router.router.stats_snapshot()
+        assert rs["handoffs"] == 1 and rs["decode_pool"] == 1
+        assert rs["handoff_retries"] == 0
+    finally:
+        router.stop()
+        pre[0].stop()
+        for d in decs:
+            d[0].stop()
+
+
+def test_disagg_streaming_flows_through_decode(duo=None):
+    base, router, pre, decs = _disagg_fleet(n_decode=1)
+    try:
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"{base}/v1/models/m:generate",
+            data=json.dumps({"input_ids": [1, 2, 3], "max_tokens": 16,
+                             "stream": True}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            lines = [json.loads(ln) for ln in r.read().splitlines()]
+        assert lines[-1].get("done") is True
+        assert sum(len(ln.get("tokens", [])) for ln in lines[:-1]) == 16
+    finally:
+        router.stop()
+        pre[0].stop()
+        for d in decs:
+            d[0].stop()
+
+
+def test_disagg_decode_retry_resumes_without_reprefill():
+    """THE mid-handoff regression (ISSUE 13 satellite): the decode
+    target dies between phases — the router retries the shipment on a
+    surviving decode replica, counted reason="prefill_handoff", and the
+    prefill work is NEVER replayed."""
+    pre = make_fake_replica("m")
+    dec = make_fake_replica("m")
+    router = RouterServer()
+    # Slow the poller right down: the dead decode target must still be
+    # "starting" (placeable) when the request arrives, or the retry
+    # path under test never fires.
+    router.fleet.poll_interval_s = 30.0
+    router.fleet.add("pre0", pre[1], role="prefill")
+    # Name-tiebreak-first decode target on an unbound port: connect
+    # refused = the replica died between phases.
+    router.fleet.add("dec0", "http://127.0.0.1:1", role="decode")
+    router.fleet.add("dec1", dec[1], role="decode")
+    base = f"http://127.0.0.1:{router.start_background()}"
+    try:
+        from kubeflow_tpu.utils.resilience import metrics as res_metrics
+
+        before = res_metrics.get("tpk_router_retry_total",
+                                 reason="prefill_handoff") or 0
+        code, _, body = _http(
+            "POST", f"{base}/v1/models/m:generate",
+            {"input_ids": list(range(20)), "max_tokens": 8},
+            headers={"Content-Type": "application/json"})
+        assert code == 200
+        assert body["num_output_tokens"] == 8
+        # Exactly ONE prefill happened fleet-wide: the handoff resumed
+        # from the router-held shipment, no duplicate prefill work.
+        assert pre[2].engine.stats_snapshot()["prefill_chunks"] == 1
+        assert dec[2].engine.stats_snapshot()["remote_admits"] == 1
+        rs = router.router.stats_snapshot()
+        assert rs["handoff_retries"] >= 1
+        after = res_metrics.get("tpk_router_retry_total",
+                                reason="prefill_handoff") or 0
+        assert after > before
+    finally:
+        router.stop()
+        pre[0].stop()
+        dec[0].stop()
+
+
+def test_disagg_prefill_death_after_ship_completes():
+    """A prefill replica dying AFTER the KV ship cannot hurt the
+    request: the router holds the shipment, decode proceeds, zero
+    retries."""
+    base, router, pre, decs = _disagg_fleet(
+        n_decode=1, decode_kw=dict(per_token_s=0.01))
+    try:
+        time.sleep(0.3)
+        out: dict = {}
+
+        def go():
+            out["resp"] = _http(
+                "POST", f"{base}/v1/models/m:generate",
+                {"input_ids": [1, 2, 3], "max_tokens": 32},
+                headers={"Content-Type": "application/json"})
+
+        th = threading.Thread(target=go)
+        th.start()
+        # Wait until the DECODE replica is visibly generating (the
+        # shipment has fully left the prefill replica), then kill the
+        # prefill replica mid-stream (~0.3 s of decode left).
+        deadline = time.monotonic() + 10
+        while (decs[0][2].engine.inflight_depth < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.005)
+        assert decs[0][2].engine.inflight_depth >= 1
+        pre[0].stop()
+        th.join(timeout=30)
+        code, _, body = out["resp"]
+        assert code == 200 and body["num_output_tokens"] == 32
+        assert pre[2].engine.stats_snapshot()["prefill_chunks"] == 1
+        assert router.router.stats_snapshot()["handoff_retries"] == 0
+    finally:
+        router.stop()
+        for d in decs:
+            d[0].stop()
+
+
+def test_disagg_falls_back_to_unified_without_prefill_capacity():
+    """Role-split fleet whose prefill replica is unplaceable: the
+    request falls back to the single-phase path over an 'any' replica
+    instead of failing."""
+    any_srv, any_url, any_model = make_fake_replica("m")
+    router = RouterServer()
+    router.fleet.poll_interval_s = 0.1
+    router.fleet.add("pre0", "http://127.0.0.1:9", role="prefill")
+    router.fleet.add("dec0", "http://127.0.0.1:9", role="decode")
+    router.fleet.add("uni0", any_url, role="any")
+    base = f"http://127.0.0.1:{router.start_background()}"
+    try:
+        # Mark the dead split replicas down so placement skips them.
+        for name in ("pre0", "dec0"):
+            for _ in range(3):
+                router.fleet.update_load(name, None)
+        code, _, body = _http(
+            "POST", f"{base}/v1/models/m:generate",
+            {"input_ids": [1, 2, 3], "max_tokens": 4},
+            headers={"Content-Type": "application/json"})
+        assert code == 200 and body["num_output_tokens"] == 4
+        assert any_model.engine.stats_snapshot()["requests"] == 1
+    finally:
+        router.stop()
+        any_srv.stop()
+
+
+def test_role_split_symmetric_any_plus_decode():
+    """An "any"+"decode" fleet disaggregates (the unified replica
+    prefills, the specialists decode) — without this, decode-role
+    replicas would sit silently stranded behind role_split()."""
+    fleet = Fleet(start_poller=False)
+    try:
+        fleet.add("u0", "http://x:1", role="any")
+        assert not fleet.role_split()  # no split replica at all
+        fleet.add("d0", "http://x:2", role="decode")
+        assert fleet.role_split()
+        fleet.remove("u0")
+        assert not fleet.role_split()  # decode alone: nothing prefills
+        fleet.add("p0", "http://x:3", role="prefill")
+        assert fleet.role_split()
+    finally:
+        fleet.close()
+
+
+def test_disagg_handoff_with_any_prefill_side():
+    """E2E: unified replica plays the prefill phase in an
+    "any"+"decode" fleet; the decode specialist gets the stream."""
+    uni = make_fake_replica("m")
+    dec = make_fake_replica("m")
+    router = RouterServer()
+    router.fleet.poll_interval_s = 0.1
+    router.fleet.add("u0", uni[1], role="any")
+    router.fleet.add("d0", dec[1], role="decode")
+    base = f"http://127.0.0.1:{router.start_background()}"
+    try:
+        time.sleep(0.25)
+        code, _, body = _http(
+            "POST", f"{base}/v1/models/m:generate",
+            {"input_ids": [1, 2, 3], "max_tokens": 8},
+            headers={"Content-Type": "application/json"})
+        assert code == 200 and body["num_output_tokens"] == 8
+        assert uni[2].engine.stats_snapshot()["prefill_chunks"] == 1
+        assert dec[2].engine.stats_snapshot()["remote_admits"] == 1
+    finally:
+        router.stop()
+        uni[0].stop()
+        dec[0].stop()
+
+
+def test_place_decode_intent_prefers_pool_headroom():
+    """Decode placement is load/pool-driven: equal load, the replica
+    with the LARGER free-block pool wins."""
+    fleet = Fleet(start_poller=False)
+    fleet.add("d0", "http://x:1", role="decode")
+    fleet.add("d1", "http://x:2", role="decode")
+    fleet.add("p0", "http://x:3", role="prefill")
+    router = Router(fleet)
+    try:
+        fleet.update_load("d0", {"decode_inflight": 1.0,
+                                 "kv_blocks_free": 4.0})
+        fleet.update_load("d1", {"decode_inflight": 1.0,
+                                 "kv_blocks_free": 64.0})
+        name, reason = router.place(None, intent="decode")
+        assert (name, reason) == ("d1", "decode-pool")
+        # The prefill replica is never a decode candidate.
+        fleet.update_load("d1", {"decode_inflight": 9.0,
+                                 "kv_blocks_free": 64.0})
+        fleet.update_load("d0", {"decode_inflight": 9.0,
+                                 "kv_blocks_free": 64.0})
+        name, _ = router.place(None, intent="decode")
+        assert name in ("d0", "d1")
+        # Prefill intent keeps affinity over prefill-capable replicas.
+        name, reason = router.place("model|adapter|ids:1", intent="prefill")
+        assert name == "p0"
+    finally:
+        fleet.close()
+
+
 # -- ROUTERBENCH shape pin (slow tier, test_ctrlbench conventions) ---------
 
 

@@ -43,9 +43,15 @@ def test_servebench_quick_shape():
     assert all(v > 0 for v in r["ttft_s"].values())
     assert r["chunked_prefill"]["prompt_len"] > 16
     assert r["chunked_prefill"]["admission_s"] > 0
-    # Quantization delta: both engines decoded; int8 params are smaller.
+    # Quantization deltas: all three arms decoded (bf16, the FIXED
+    # output-side-scale int8 path, and the legacy dequant-per-apply
+    # control — ROADMAP item 4 first half); int8 params are smaller.
+    # The throughput ordering is a chip claim (the HLO-shape guard in
+    # test_quant_dequant.py pins the mechanism on CPU).
     q = r["quant"]
     assert q["bf16_tok_s"] > 0 and q["int8_tok_s"] > 0
+    assert q["int8_legacy_tok_s"] > 0
+    assert q["fixed_vs_legacy"] > 0
     assert q["param_bytes"]["quantized"] < q["param_bytes"]["full"]
     # Long-max_len bucketed-decode row (where the win can appear).
     dbl = r["decode_buckets_long"]

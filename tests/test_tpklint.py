@@ -750,6 +750,62 @@ def test_deleting_hot_markers_turns_red(tmp_path):
     assert any("engine-fetch" in f.message for f in fs)
 
 
+def test_mutating_kv_reserve_twin_turns_red(tmp_path):
+    """ISSUE 13: the decode-side remote admission must reserve pool
+    blocks by the exact local-admission rule — drifting the remote copy
+    alone is a tier-1 finding, not a latent accounting bug."""
+    dst = _copy_engine_tree(tmp_path)
+    src = dst.read_text()
+    needle = "fresh = self._kv_alloc.alloc(max(0, need - len(shared)))"
+    # ship-mode reserve + the admit twin + the remote twin.
+    assert src.count(needle) == 3
+    head, _, tail = src.rpartition(needle)
+    dst.write_text(head + needle.replace("need", "need + 1", 1) + tail)
+    fs = lint(tmp_path, rules=["sync-regions"])
+    assert len(fs) == 1 and "kv-block-reserve" in fs[0].message
+
+
+def test_deleting_remote_admit_marker_turns_red(tmp_path):
+    dst = _copy_engine_tree(tmp_path)
+    dst.write_text(dst.read_text().replace(
+        "    # tpk-hot: remote-admit\n", ""))
+    fs = lint(tmp_path, rules=["host-sync"])
+    assert any("remote-admit" in f.message for f in fs)
+
+
+def test_host_fetch_in_remote_admit_turns_red(tmp_path):
+    """A host sync inside the decode-side remote-admit loop would stall
+    every in-flight decode chunk behind the handoff — the isolation the
+    role split exists to buy."""
+    dst = _copy_engine_tree(tmp_path)
+    marker = '        kd = req.get("rng_key")'
+    src = dst.read_text()
+    assert marker in src
+    dst.write_text(src.replace(
+        marker, "        _ = self._cache.item()\n" + marker))
+    fs = lint(tmp_path, rules=["host-sync"])
+    assert len(fs) == 1 and "remote-admit" in fs[0].message
+
+
+def test_tier_state_outside_lock_turns_red(tmp_path):
+    """HostKVTier's transfer/spill state is guarded-by-declared; an
+    access escaping `with self._lock:` is a finding on a copy of the
+    REAL file."""
+    rel = "kubeflow_tpu/serve/kv_transfer.py"
+    dst = tmp_path / rel
+    dst.parent.mkdir(parents=True)
+    shutil.copy(os.path.join(REPO, rel), dst)
+    assert lint(tmp_path, rules=["lock-discipline"]) == []
+    src = dst.read_text()
+    marker = "    def probe_longest(self, aid: int, ids) -> int | None:"
+    dst.write_text(src.replace(
+        marker,
+        "    def sneaky(self):\n        return len(self._lru)\n\n"
+        + marker))
+    fs = lint(tmp_path, rules=["lock-discipline"])
+    assert len(fs) == 1 and "_lru" in fs[0].message
+
+
 def test_staling_real_schema_turns_red(tmp_path):
     for rel in ("kubeflow_tpu/utils/spec_schema.py", "spec_schema.json",
                 "cpp/spec_schema.gen.h"):

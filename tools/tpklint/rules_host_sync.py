@@ -60,6 +60,12 @@ REQUIRED_HOT_PATHS = {
     # poller-cached load signals only — a blocking scrape or host sync
     # here would serialize the whole front door (ISSUE 9).
     "router-placement": "kubeflow_tpu/serve/router.py",
+    # Decode-side remote admission (ISSUE 13): import + bookkeeping
+    # only — a host fetch here would stall every in-flight decode
+    # chunk behind the handoff, undoing the isolation the role split
+    # exists to buy (the shipped first token/logprob are already host
+    # scalars; nothing may sync).
+    "remote-admit": "kubeflow_tpu/serve/generation.py",
 }
 
 _MARK = re.compile(r"#\s*tpk-hot:\s*(.+?)\s*$")

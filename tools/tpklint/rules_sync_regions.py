@@ -39,6 +39,12 @@ RULE = "sync-regions"
 REQUIRED_TAGS = {
     "admit-chunked-prefill": "kubeflow_tpu/serve/generation.py",
     "admit-slot-state": "kubeflow_tpu/serve/generation.py",
+    # ISSUE 13: local paged admission and decode-side remote admission
+    # must reserve pool blocks by the IDENTICAL worst-case rule — a
+    # drifted copy would let shipped requests out-reserve (or
+    # under-reserve) local ones and break the free-block accounting
+    # the refcount/CoW discipline sits on.
+    "kv-block-reserve": "kubeflow_tpu/serve/generation.py",
 }
 
 _MARK = re.compile(r"#\s*tpk-sync:\s*(begin|end|sub)\s*(.*?)\s*$")
