@@ -441,6 +441,33 @@ def main_disaggbench() -> None:
     }))
 
 
+def main_chaosbench() -> None:
+    """`python bench.py --chaosbench`: fabric chaos harness →
+    CHAOSBENCH.json + one JSON line (kubeflow_tpu/serve/chaosbench.py).
+
+    REAL tiny-engine replicas in their own subprocesses behind the real
+    router under open-loop Poisson load, while a seeded fault schedule
+    SIGKILLs, SIGSTOP/CONT-stalls, and drains replicas mid-run — the
+    disagg mid-stream resume, gray-failure ejection vs control, and
+    replicated-control-plane leader-kill claims, computed from
+    per-request provenance rows."""
+    from kubeflow_tpu.serve.chaosbench import run_chaosbench
+
+    result = run_chaosbench(quick="--quick" in sys.argv)
+    with open("CHAOSBENCH.json", "w") as fh:
+        json.dump(result, fh, indent=1)
+    disagg = result["arms"]["disagg_decode_kill"]
+    gray = result["arms"]["gray_stall"]
+    print(json.dumps({
+        "metric": "chaosbench_disagg_caller_visible_errors",
+        "value": disagg.get("caller_visible_errors"),
+        "resumes": disagg.get("resumes"),
+        "goodput_recovery_ratio": disagg.get("goodput_recovery_ratio"),
+        "gray_p99_ratio_on_vs_off": gray.get("p99_ratio_on_vs_off"),
+        "detail": "CHAOSBENCH.json",
+    }))
+
+
 def main_longctx() -> None:
     """`python bench.py --longctx`: the long-context evidence row
     (PROFILE.md §6). On a live chip: measured tok/s + MFU at s>=2048
@@ -617,6 +644,8 @@ if __name__ == "__main__":
         main_routerbench()
     elif "--disaggbench" in sys.argv:
         main_disaggbench()
+    elif "--chaosbench" in sys.argv:
+        main_chaosbench()
     elif "--serve" in sys.argv:
         main_serve()
     elif "--longctx-tune" in sys.argv:

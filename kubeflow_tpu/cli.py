@@ -274,9 +274,9 @@ def cmd_replicas(args) -> int:
     if args.json:
         print(json.dumps(out, indent=2))
         return 0
-    fmt = "{:<12} {:<28} {:<8} {:<9} {:>9} {:>8} {:>8} {:>10}"
+    fmt = "{:<12} {:<28} {:<8} {:<9} {:>9} {:>8} {:>8} {:>8} {:>10}"
     print(fmt.format("NAME", "ADDRESS", "ROLE", "STATE", "OUT",
-                     "INFLIGHT", "KV_FREE", "SCRAPE_AGE"))
+                     "INFLIGHT", "KV_FREE", "LAT_MS", "SCRAPE_AGE"))
 
     def cell(v, unit=""):
         return "-" if v is None else f"{v:g}{unit}"
@@ -286,12 +286,16 @@ def cmd_replicas(args) -> int:
                          r["state"], str(r["outstanding"]),
                          cell(r["decode_inflight"]),
                          cell(r["kv_blocks_free"]),
+                         cell(r.get("fwd_ewma_ms")),
                          cell(r["scrape_age_s"], "s")))
     handoffs = out.get("router", {}).get("handoffs", 0)
     if handoffs:
         print(f"disagg: handoffs={handoffs} "
               f"handoff_retries="
-              f"{out['router'].get('handoff_retries', 0)}")
+              f"{out['router'].get('handoff_retries', 0)} "
+              f"resumes={out['router'].get('resumes', 0)} "
+              f"resume_failures="
+              f"{out['router'].get('resume_failures', 0)}")
     stats = out.get("router", {})
     if stats:
         print(f"router: placed={stats.get('placed', 0)} "

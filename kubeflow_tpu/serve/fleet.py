@@ -34,20 +34,28 @@ EXCEPT placement itself:
 from __future__ import annotations
 
 import json
+import random
+import statistics
 import threading
 import time
 import urllib.error
 import urllib.request
 from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import wait as futures_wait
 
 from kubeflow_tpu.utils.resilience import metrics as res_metrics
 
 #: Replica states. `starting` = registered, not yet probed; optimistic —
 #: placement may try it (a connect failure retries elsewhere and the
 #: poller downgrades it). `down` = N consecutive probe failures.
-#: `draining` = no new placements; `drained` = drain completed (nothing
-#: in flight anywhere), safe to retire.
-STATES = ("starting", "ready", "draining", "drained", "down")
+#: `slow` = GRAY-FAILURE ejection (ISSUE 14): the replica answers
+#: probes — it is ALIVE — but its forward-latency / probe-RTT EWMA is a
+#: statistical outlier against the rest of the fleet, so placement
+#: routes around it while its in-flight work drains normally; it
+#: rejoins after the half-open probes show it recovered. `draining` =
+#: no new placements; `drained` = drain completed (nothing in flight
+#: anywhere), safe to retire.
+STATES = ("starting", "ready", "slow", "draining", "drained", "down")
 
 #: Consecutive probe failures before a replica is marked down.
 DOWN_AFTER_FAILURES = 3
@@ -81,7 +89,10 @@ class Replica:
     __slots__ = ("name", "url", "grpc", "role", "state", "outstanding",
                  "decode_inflight", "admission_inflight", "kv_blocks_free",
                  "last_scrape", "scrape_failures", "on_drained",
-                 "draining_since", "probe_ready")
+                 "draining_since", "probe_ready",
+                 "fwd_ewma", "fwd_last", "probe_rtt_ewma",
+                 "probe_rtt_last", "slow_strikes", "slow_since",
+                 "scrape_seq")
 
     def __init__(self, name: str, url: str, grpc: str | None = None,
                  role: str = "any"):
@@ -108,6 +119,20 @@ class Replica:
         self.scrape_failures = 0
         self.on_drained = None
         self.draining_since: float | None = None
+        #: Gray-failure signals (ISSUE 14): EWMA of router-observed
+        #: forward latency and of probe round-trips, plus the ejection
+        #: hysteresis bookkeeping (consecutive outlier passes before
+        #: `slow`, and when the ejection happened).
+        self.fwd_ewma: float | None = None
+        self.fwd_last: float | None = None
+        self.probe_rtt_ewma: float | None = None
+        self.probe_rtt_last: float | None = None
+        self.slow_strikes = 0
+        self.slow_since: float | None = None
+        #: Highest poll-pass sequence whose scrape has applied — a
+        #: straggler from an OLDER pass landing late must not overwrite
+        #: fresher state (see poll_once's bounded wait).
+        self.scrape_seq = 0
         #: Last readiness-probe answer (None until first probe). False
         #: = the replica itself degraded (ISSUE-1 shedding window, an
         #: out-of-band drain): placement routes around it until the
@@ -151,6 +176,10 @@ class Replica:
                              else round(time.monotonic() - self.last_scrape,
                                         3)),
             "scrape_failures": self.scrape_failures,
+            "fwd_ewma_ms": (None if self.fwd_ewma is None
+                            else round(self.fwd_ewma * 1e3, 2)),
+            "probe_rtt_ms": (None if self.probe_rtt_ewma is None
+                             else round(self.probe_rtt_ewma * 1e3, 2)),
             "load": self.load(),
         }
 
@@ -197,15 +226,41 @@ class Fleet:
 
     def __init__(self, poll_interval_s: float = 0.25,
                  scrape_timeout_s: float = 2.0,
-                 start_poller: bool = True):
+                 start_poller: bool = True,
+                 gray_ejection: bool = True,
+                 eject_ratio: float = 3.0, eject_min_s: float = 0.2,
+                 eject_strikes: int = 3, rejoin_ratio: float = 1.5,
+                 slow_min_s: float = 1.0, ewma_alpha: float = 0.3,
+                 min_remaining: int = 2):
         self._replicas: dict[str, Replica] = {}  # guarded-by: _lock
         #: Membership generation — bumped on add/remove/state change so
         #: the router knows to rebuild its hash ring.
         self._version = 0  # guarded-by: _lock
         self._grpc_clients: dict = {}  # guarded-by: _lock
+        #: Poll-pass sequence clock for stale-straggler filtering.
+        self._poll_seq = 0  # guarded-by: _lock
         self._lock = threading.Lock()
         self.poll_interval_s = float(poll_interval_s)
         self.scrape_timeout_s = float(scrape_timeout_s)
+        # Gray-failure ejection knobs (ISSUE 14). A replica is an
+        # OUTLIER when its latency score exceeds BOTH `eject_min_s` (an
+        # absolute floor so microsecond-scale noise on an idle fleet
+        # can't eject anything) and `eject_ratio` x the median of the
+        # other candidates; it must stay an outlier for `eject_strikes`
+        # consecutive poll passes before it ejects (one GC pause must
+        # not flap the ring), never ejects when fewer than
+        # `min_remaining` placeable replicas would remain, and rejoins
+        # only after `slow_min_s` in the slow state with a succeeding
+        # half-open probe whose RTT is back inside `rejoin_ratio` x the
+        # fleet baseline.
+        self.gray_ejection = bool(gray_ejection)
+        self.eject_ratio = float(eject_ratio)
+        self.eject_min_s = float(eject_min_s)
+        self.eject_strikes = int(eject_strikes)
+        self.rejoin_ratio = float(rejoin_ratio)
+        self.slow_min_s = float(slow_min_s)
+        self.ewma_alpha = float(ewma_alpha)
+        self.min_remaining = int(min_remaining)
         self._closed = threading.Event()
         # Scrapes fan out on this pool (threads are lazy): one stalled
         # replica must not serialize the pass and stale every OTHER
@@ -363,18 +418,37 @@ class Fleet:
             if failed:
                 # A connect-level failure is evidence ahead of the next
                 # poll: nudge the failure count so repeated resets take
-                # the replica out of placement quickly.
+                # the replica out of placement quickly. A `slow` replica
+                # that starts refusing connections is dead, not gray.
                 r.scrape_failures += 1
                 if (r.scrape_failures >= DOWN_AFTER_FAILURES
-                        and r.state in ("starting", "ready")):
+                        and r.state in ("starting", "ready", "slow")):
                     r.state = "down"
                     self._version += 1
+
+    def observe_forward(self, name: str, seconds: float) -> None:
+        """Fold one router-observed forward latency into the replica's
+        gray-failure EWMA. The router calls this on every completed
+        forward (including timeouts and mid-stream deaths — a stalled
+        replica's inflated wall time IS the gray signal)."""
+        with self._lock:
+            r = self._replicas.get(name)
+            if r is None:
+                return
+            a = self.ewma_alpha
+            r.fwd_last = float(seconds)
+            r.fwd_ewma = (seconds if r.fwd_ewma is None
+                          else (1 - a) * r.fwd_ewma + a * seconds)
 
     # -- polling ------------------------------------------------------------
 
     def _scrape_one(self, name: str, url: str, grpc: str | None) -> dict:
         """One replica's load signals + readiness, via the existing
-        surfaces. Runs on the scrape pool only (network I/O)."""
+        surfaces. Runs on the scrape pool only (network I/O). The
+        probe's wall time rides along as `rtt_s` — it feeds the
+        gray-failure EWMA, and keeps observing a replica placement
+        already routes around (the half-open side of ejection)."""
+        t0 = time.perf_counter()
         if grpc:
             client = self._grpc_client(name, grpc)
             text = client.metrics(timeout=self.scrape_timeout_s)
@@ -384,6 +458,7 @@ class Fleet:
                 text = r.read().decode()
         out = parse_scrape(text)
         out["ready"] = self._probe_ready(url)
+        out["rtt_s"] = time.perf_counter() - t0
         return out
 
     def _grpc_client(self, name: str, grpc_addr: str):
@@ -406,16 +481,26 @@ class Fleet:
             return False  # 503 = degraded/draining, the probe answered
 
     def _poll_loop(self) -> None:
-        while not self._closed.wait(self.poll_interval_s):
+        # Jittered interval (ISSUE 14): a fixed period phase-locks every
+        # pass to the same replicas' slow windows and to other pollers
+        # on the host; +-25% keeps the probes decorrelated.
+        while not self._closed.wait(
+                self.poll_interval_s * (0.75 + 0.5 * random.random())):
             self.poll_once()
 
     def poll_once(self) -> None:
         """One scrape pass over the fleet — the poller's body, public so
         tests (and CLI one-shots) can drive it synchronously. Replicas
-        scrape in parallel on the pool; the pass still blocks until
-        every result (bounded by the per-request scrape timeouts) is
-        applied, so synchronous drivers see a complete table."""
+        scrape in parallel on the pool; the pass waits for results only
+        up to a BOUND (2x the per-probe timeout + slack) per future —
+        N stalled replicas whose probes serialize behind the 8-worker
+        pool must not wedge the pass (their results still apply
+        whenever the worker finishes, via scrape_and_apply itself).
+        Ends with the gray-failure ejection pass over whatever
+        signals landed."""
         with self._lock:
+            self._poll_seq += 1
+            seq = self._poll_seq
             targets = [(r.name, r.url, r.grpc)
                        for r in self._replicas.values()
                        if r.state != "drained"]
@@ -430,26 +515,41 @@ class Fleet:
                 sig = None
             # Apply HERE, as each scrape lands — an in-order gather
             # would hold every fast replica's fresh signals hostage to
-            # the slowest scrape's timeout.
-            self.update_load(name, sig)
+            # the slowest scrape's timeout. The pass seq rides along:
+            # stragglers outlive the bounded wait below, and a STALE
+            # pass's result landing after a fresher one must be
+            # dropped (three queued stale failures draining after a
+            # recovery probe would mark a healthy replica down).
+            self.update_load(name, sig, seq=seq)
 
-        for f in [self._scrape_pool.submit(scrape_and_apply, t)
-                  for t in targets]:
-            f.result()
+        # ONE shared deadline for the whole set (a per-future wait
+        # would re-pay its floor for every straggler); leftovers apply
+        # themselves whenever their worker finishes.
+        futures_wait([self._scrape_pool.submit(scrape_and_apply, t)
+                      for t in targets],
+                     timeout=2.0 * self.scrape_timeout_s + 1.0)
+        self.eject_pass()
 
-    def update_load(self, name: str, sig: dict | None) -> None:
+    def update_load(self, name: str, sig: dict | None,
+                    seq: int | None = None) -> None:
         """Apply one scrape result (None = probe failed) to the table.
         The poller's write path — and the unit-test hook for driving
-        placement scenarios without live replicas."""
+        placement scenarios without live replicas. `seq` is the poll
+        pass that produced the result: older-pass stragglers are
+        dropped (None = direct caller, always applies)."""
         fire_drained = None
         with self._lock:
             r = self._replicas.get(name)
             if r is None:
                 return
+            if seq is not None:
+                if seq < r.scrape_seq:
+                    return  # stale straggler from an earlier pass
+                r.scrape_seq = seq
             if sig is None:
                 r.scrape_failures += 1
                 if (r.scrape_failures >= DOWN_AFTER_FAILURES
-                        and r.state in ("starting", "ready")):
+                        and r.state in ("starting", "ready", "slow")):
                     r.state = "down"
                     self._version += 1
             else:
@@ -459,6 +559,13 @@ class Fleet:
                           "kv_blocks_free"):
                     if k in sig:
                         setattr(r, k, sig[k])
+                if sig.get("rtt_s") is not None:
+                    a = self.ewma_alpha
+                    rtt = float(sig["rtt_s"])
+                    r.probe_rtt_last = rtt
+                    r.probe_rtt_ewma = (
+                        rtt if r.probe_rtt_ewma is None
+                        else (1 - a) * r.probe_rtt_ewma + a * rtt)
                 if "ready" in sig and sig["ready"] != r.probe_ready:
                     # A degradation flip changes placeability — bump the
                     # version so the router rebuilds its ring.
@@ -481,6 +588,125 @@ class Fleet:
                 fire_drained(name)
             except Exception:
                 pass  # a retire hook must never kill the poller
+
+    def eject_pass(self) -> list[tuple[str, str]]:
+        """The gray-failure evaluation (ISSUE 14): runs after every poll
+        pass (and synchronously from tests). Compares each candidate's
+        latency score (worse of forward-EWMA and probe-RTT-EWMA)
+        against the MEDIAN of the other candidates':
+
+          * a `ready` replica that has been an outlier (> eject_min_s
+            AND > eject_ratio x median) for `eject_strikes` consecutive
+            passes EJECTS to `slow` — out of placement, still draining
+            its in-flight, still probed (the binary `down` path is
+            untouched: a replica whose probes FAIL outright still trips
+            DOWN_AFTER_FAILURES);
+          * a `slow` replica rejoins (`ready`) once it has served its
+            `slow_min_s` hysteresis, its half-open probe succeeds, and
+            its probe RTT is back inside rejoin_ratio x the baseline —
+            the forward EWMA resets on rejoin (it is stale by
+            construction: placement sent the replica nothing while
+            slow), so re-ejection needs fresh evidence.
+
+        Returns the transitions taken, as (name, "eject"|"rejoin") —
+        telemetry and tests."""
+        if not self.gray_ejection:
+            return []
+        transitions: list[tuple[str, str]] = []
+        now = time.monotonic()
+        with self._lock:
+            candidates = [r for r in self._replicas.values()
+                          if r.state in ("starting", "ready", "slow")]
+            placeable_by_role: dict[str, int] = {}
+            for r in candidates:
+                if r.state in ("starting", "ready"):
+                    placeable_by_role[r.role] = \
+                        placeable_by_role.get(r.role, 0) + 1
+            # Each signal is compared only WITHIN ITS OWN population:
+            # forward latency (whole-request wall, streams included)
+            # and probe RTT live on different scales, so judging one
+            # replica's stream wall time against its idle peers' probe
+            # RTTs would eject the fleet's only ACTIVE replica — found
+            # the hard way by the seeded decode-kill test, where the
+            # lone serving decode replica got ejected and the resume
+            # had nowhere to land. Forward latency is ALSO partitioned
+            # by ROLE: in a disaggregated fleet, prefill forwards
+            # finish in milliseconds while decode forwards stream for
+            # seconds BY DESIGN — pooled, every healthy decode replica
+            # is a structural outlier against its prefill peers and
+            # the whole decode side would flap out of placement. Probe
+            # RTT stays fleet-wide (the scrape is role-independent).
+            fwd_pop: dict[str, dict] = {}
+            for r in candidates:
+                if r.fwd_ewma is not None:
+                    fwd_pop.setdefault(r.role, {})[r.name] = r.fwd_ewma
+            rtt_pop = {r.name: r.probe_rtt_ewma for r in candidates
+                       if r.probe_rtt_ewma is not None}
+
+            def outlier(pop: dict, me: str, ewma, last) -> bool:
+                # A strike needs the SMOOTHED and the INSTANTANEOUS
+                # sample to both be outliers: the EWMA keeps one GC
+                # pause's spike alive for several polls, and counting
+                # strikes off its decay tail alone would turn a single
+                # pause into an ejection.
+                if ewma is None:
+                    return False
+                others = [v for n, v in pop.items() if n != me]
+                if len(others) < 2:
+                    return False  # no population, no statistics
+                med = max(statistics.median(others), 1e-9)
+                val = ewma if last is None else min(ewma, last)
+                return (val > self.eject_min_s
+                        and val > self.eject_ratio * med)
+
+            def rtt_baseline(me: str) -> float:
+                others = [v for n, v in rtt_pop.items() if n != me]
+                return max(statistics.median(others), 1e-9) if others \
+                    else 1e-9
+
+            for r in candidates:
+                if r.state == "ready":
+                    is_out = (outlier(fwd_pop.get(r.role, {}), r.name,
+                                      r.fwd_ewma, r.fwd_last)
+                              or outlier(rtt_pop, r.name,
+                                         r.probe_rtt_ewma,
+                                         r.probe_rtt_last))
+                    r.slow_strikes = r.slow_strikes + 1 if is_out else 0
+                    if (r.slow_strikes >= self.eject_strikes
+                            and placeable_by_role.get(r.role, 0) - 1
+                            >= self.min_remaining):
+                        r.state = "slow"
+                        r.slow_since = now
+                        r.slow_strikes = 0
+                        placeable_by_role[r.role] -= 1
+                        self._version += 1
+                        transitions.append((r.name, "eject"))
+                elif r.state == "slow":
+                    probe_ok = (r.scrape_failures == 0
+                                and r.probe_rtt_ewma is not None
+                                and r.probe_ready is not False)
+                    recovered = (probe_ok
+                                 and r.probe_rtt_ewma
+                                 <= max(self.eject_min_s,
+                                        self.rejoin_ratio
+                                        * rtt_baseline(r.name)))
+                    if (recovered and r.slow_since is not None
+                            and now - r.slow_since >= self.slow_min_s):
+                        r.state = "ready"
+                        r.slow_since = None
+                        r.fwd_ewma = None
+                        placeable_by_role[r.role] = \
+                            placeable_by_role.get(r.role, 0) + 1
+                        self._version += 1
+                        transitions.append((r.name, "rejoin"))
+        for name, kind in transitions:
+            if kind == "eject":
+                res_metrics.inc("tpk_fleet_ejections_total",
+                                replica=name)
+            else:
+                res_metrics.inc("tpk_fleet_rejoins_total",
+                                replica=name)
+        return transitions
 
     @staticmethod
     def _quiesced_locked(r: Replica, sig: dict | None) -> bool:
@@ -595,9 +821,13 @@ class FleetAutoscaler:
         # but drained/down ones are no longer capacity and must not
         # consume max_replicas headroom: past scale-ins (whose retired
         # table entries a count-based ControlPlaneScaler never removes)
-        # would otherwise permanently block future scale-outs.
+        # would otherwise permanently block future scale-outs. A gray
+        # `slow` replica counts too: it is ALIVE and expected back — a
+        # GC pause must not buy a whole new replica, and it must never
+        # be picked as a drain victim (placeable_names excludes it).
         total = len([r for r in self.fleet.snapshot()
-                     if r["state"] in ("starting", "ready", "draining")])
+                     if r["state"] in ("starting", "ready", "slow",
+                                       "draining")])
         if (shed_delta > 0 or occ >= self.high_water) \
                 and total < self.max_replicas:
             self._low_streak = 0
@@ -656,18 +886,40 @@ class ControlPlaneScaler:
         self.client = client
         self.isvc = isvc_name
 
-    def _replicas(self) -> int:
-        res = self.client.get("InferenceService", self.isvc)
-        return int(res.get("spec", {}).get("replicas", 1))
+    # `update_spec` is a FULL-SPEC replace on the control plane (the
+    # server re-validates the whole document) — so the patch must be
+    # read-modify-write. Sending a bare {"replicas": N} looked fine
+    # against test fakes but the REAL binary rejects it ("model is
+    # required") — found by the ISSUE 14 combined-plane failover test,
+    # which runs the scaler's reconcile against a live promoted
+    # follower. The replace rides the store's CAS (`expected_version`
+    # = the read's resourceVersion, the wake_service precedent) so a
+    # concurrent spec writer's change is never clobbered by our stale
+    # copy — a version conflict re-reads and retries.
+
+    def _patch_replicas(self, delta: int) -> None:
+        for _ in range(4):
+            res = self.client.get("InferenceService", self.isvc)
+            spec = dict(res.get("spec", {}))
+            spec["replicas"] = max(
+                int(spec.get("replicas", 1)) + delta, 0)
+            try:
+                self.client.update_spec(
+                    "InferenceService", self.isvc, spec,
+                    expected_version=res.get("resourceVersion"))
+                return
+            except Exception as e:
+                if "conflict" not in str(e):
+                    raise
+        raise RuntimeError(
+            f"spec.replicas patch on {self.isvc!r} kept losing the "
+            "CAS race")
 
     def scale_up(self) -> None:
-        self.client.update_spec("InferenceService", self.isvc,
-                                {"replicas": self._replicas() + 1})
+        self._patch_replicas(+1)
 
     def retire(self, name: str) -> None:
-        self.client.update_spec(
-            "InferenceService", self.isvc,
-            {"replicas": max(self._replicas() - 1, 0)})
+        self._patch_replicas(-1)
 
 
 def fetch_replicas(router_url: str, timeout_s: float = 5.0) -> dict:

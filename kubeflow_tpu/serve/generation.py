@@ -3184,15 +3184,31 @@ class GenerativeJAXModel(Model):
                              trace_id: str = ""):
         """Streaming :decode backend: the generate_stream event shape
         (chunk token events, final done summary) over a remote
-        admission."""
+        admission.
+
+        RESUME CURSOR (ISSUE 14): `resume_skip` in the shipment meta is
+        the number of leading tokens the original caller was already
+        served before its previous decode replica died mid-stream. The
+        engine replays the SAME deterministic token stream (the shipment
+        carries the post-prefill RNG key and sampling params), and this
+        layer suppresses the first `resume_skip` tokens from the CHUNK
+        events — the resumed stream continues exactly where the dead one
+        stopped. The final done event still carries the FULL output_ids/
+        logprobs, identical to an uninterrupted run's."""
         if not self.ready or self.engine is None:
             raise RuntimeError(f"model {self.name} is not loaded")
         from kubeflow_tpu.serve.kv_transfer import peek_meta
 
+        meta = peek_meta(shipment)
+        skip = int(meta.get("resume_skip", 0))
+        if skip < 0 or skip > int(meta.get("max_tokens", 32)):
+            raise ValueError(
+                f"resume_skip {skip} outside [0, max_tokens="
+                f"{meta.get('max_tokens')}]")
         # Bound the event wait by the SHIPPED request budget (+ grace),
         # mirroring generate_stream's clock — never a magic constant
         # coupled to submit_remote's default.
-        timeout_s = float(peek_meta(shipment).get("timeout", 300.0))
+        timeout_s = float(meta.get("timeout", 300.0))
         events: queue.Queue = queue.Queue()
 
         def on_tokens(tokens, done):
@@ -3227,6 +3243,12 @@ class GenerativeJAXModel(Model):
                     self.engine.throughput(), 2)
                 yield {"done": True, **out}
                 return
+            if skip:
+                # Replayed tokens the caller already holds: drop them
+                # from the chunk stream (the done summary stays full).
+                dropped = min(skip, len(val))
+                skip -= dropped
+                val = val[dropped:]
             if val:
                 yield {"tokens": [int(t) for t in val]}
 

@@ -16,6 +16,7 @@ the trailing metadata — one trace identity across both planes.
 from __future__ import annotations
 
 import threading
+import time
 from concurrent import futures
 from typing import TYPE_CHECKING
 
@@ -43,6 +44,11 @@ class GrpcRouterServicer:
         #: replica relaunched elsewhere doesn't keep being dialed at
         #: its dead old port through a stale cached channel.
         self._channels: dict[str, tuple[str, grpc.Channel]] = {}  # guarded-by: _lock
+        #: (name, addr) pairs that have served at least one successful
+        #: RPC — a later died RPC on such a channel is a MID-RPC death
+        #: (the replica was up and serving), not a connect failure;
+        #: the two are counted apart (HTTP-plane parity, ISSUE 14).
+        self._served: set[tuple[str, str]] = set()  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def _channel(self, name: str, addr: str) -> grpc.Channel:
@@ -115,32 +121,63 @@ class GrpcRouterServicer:
                                 outcome="deadline")
                 context.abort(grpc.StatusCode.DEADLINE_EXCEEDED,
                               "request deadline exceeded (router)")
-            rpc = self._channel(name, candidates[name]).unary_unary(
+            addr = candidates[name]
+            rpc = self._channel(name, addr).unary_unary(
                 full_method,
                 request_serializer=lambda b: b,
                 response_deserializer=lambda b: b)
             attempts += 1
             self.fleet.checkout(name)
+            t0 = time.perf_counter()
             try:
                 resp = rpc(request, timeout=timeout,
                            metadata=(("x-request-id", trace_id),))
             except grpc.RpcError as e:
                 code = e.code()
-                retryable = code == grpc.StatusCode.UNAVAILABLE
-                draining = "draining" in (e.details() or "")
-                self.fleet.checkin(name,
-                                   failed=retryable and not draining)
-                last_err = f"{name}: {code.name}: {e.details()}"
-                if retryable and attempts <= max(len(addrs), 1):
+                # UNAVAILABLE covers BOTH a refused connect and a
+                # replica dying mid-RPC (socket closed / GOAWAY with the
+                # request in flight); INTERNAL's RST_STREAM flavor is
+                # the same death seen through http2. Both are the HTTP
+                # plane's "nothing reached the caller, replay is safe"
+                # class for these unary methods — but they are COUNTED
+                # apart (reason=midstream vs connect), keyed on whether
+                # this (name, addr) channel had already served traffic:
+                # a previously-serving replica failing is a mid-stream
+                # death, not a placement mistake (ISSUE 14 parity with
+                # tpk_router_requests_total{outcome="upstream_error"}).
+                details = e.details() or ""
+                died = (code == grpc.StatusCode.UNAVAILABLE
+                        or (code == grpc.StatusCode.INTERNAL
+                            and ("RST_STREAM" in details
+                                 or "Received RST" in details)))
+                draining = "draining" in details
+                with self._lock:
+                    midstream = (died and not draining
+                                 and (name, addr) in self._served)
+                    if midstream:
+                        # One death event per served channel: the
+                        # FIRST failure after service is the mid-RPC
+                        # death; every subsequent attempt against the
+                        # dead port is a plain connect refusal and
+                        # must count as such (a success re-arms it).
+                        self._served.discard((name, addr))
+                self.fleet.checkin(name, failed=died and not draining)
+                if midstream:
+                    self.fleet.observe_forward(
+                        name, time.perf_counter() - t0)
+                last_err = f"{name}: {code.name}: {details}"
+                if died and attempts <= max(len(addrs), 1):
                     exclude.add(name)
                     res_metrics.inc("tpk_router_retry_total",
                                     reason=("draining" if draining
+                                            else "midstream" if midstream
                                             else "connect"))
                     self.router._bump("retries")
                     continue
                 outcome = ("shed" if code ==
                            grpc.StatusCode.RESOURCE_EXHAUSTED
-                           else "retry_exhausted" if retryable
+                           else "upstream_error" if midstream
+                           else "retry_exhausted" if died
                            else "upstream_error")
                 res_metrics.inc("tpk_router_requests_total",
                                 replica=name, outcome=outcome)
@@ -148,9 +185,25 @@ class GrpcRouterServicer:
                                   if outcome == "shed" else "errors")
                 # Forward the replica's status verbatim — a shed's
                 # RESOURCE_EXHAUSTED is backpressure, not retry fodder.
-                context.abort(code, e.details() or code.name)
+                context.abort(code, details or code.name)
+            except Exception as e:  # noqa: BLE001 — parity with HTTP 502
+                # A non-RpcError escaping here would surface to the
+                # caller as a bare UNKNOWN with no counter trace — the
+                # exact "uncounted raw error" the HTTP plane never
+                # emits. Count it and abort with a named INTERNAL.
+                self.fleet.checkin(name)
+                res_metrics.inc("tpk_router_requests_total",
+                                replica=name, outcome="upstream_error")
+                self.router._bump("errors")
+                context.abort(grpc.StatusCode.INTERNAL,
+                              f"router forward failed: "
+                              f"{type(e).__name__}: {e}")
             else:
                 self.fleet.checkin(name)
+                self.fleet.observe_forward(name,
+                                           time.perf_counter() - t0)
+                with self._lock:
+                    self._served.add((name, addr))
                 res_metrics.inc("tpk_router_requests_total",
                                 replica=name, outcome="ok")
                 self.router._bump("ok")

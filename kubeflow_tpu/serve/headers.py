@@ -20,3 +20,12 @@ REQUEST_ID_HEADER = "X-Request-Id"
 #: router can tell "draining — retry elsewhere" from "overloaded —
 #: forward the backpressure".
 DRAINING_HEADER = "X-Tpk-Draining"
+
+#: Router-set response provenance (ISSUE 14): the replica that served
+#: the request (for streams, the FIRST replica — later mid-stream
+#: resumes ride the ndjson done frame's `_router` field, since response
+#: headers are already on the wire by then) and how many placement
+#: attempts the request took. Load harnesses read these so chaos-claim
+#: arithmetic runs on per-request truth, not aggregates.
+REPLICA_HEADER = "X-Tpk-Replica"
+ATTEMPTS_HEADER = "X-Tpk-Attempts"

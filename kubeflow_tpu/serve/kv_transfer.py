@@ -86,10 +86,12 @@ def pack_shipment(meta: dict, arrays: dict) -> bytes:
     return b"".join([MAGIC, _LEN.pack(len(header)), header] + bufs)
 
 
-def unpack_shipment(data: bytes) -> tuple[dict, dict]:
-    """Inverse of `pack_shipment` → (meta, {name: np.ndarray}). Every
-    malformation raises ShipmentError — truncated or alien bytes must
-    never come back as a half-parsed cache."""
+def _parse_header(data) -> tuple[dict, memoryview, int]:
+    """Shared frame parse: validate magic + length, decode the JSON
+    header → (header, data_view, payload_offset). THE single home of
+    the header layout — unpack_shipment, peek_meta, and rewrite_meta
+    all go through it, so a format change cannot silently diverge the
+    three parsers. Every malformation raises ShipmentError."""
     if not isinstance(data, (bytes, bytearray, memoryview)):
         raise ShipmentError(f"shipment must be bytes, got {type(data)}")
     data = memoryview(data)
@@ -106,11 +108,25 @@ def unpack_shipment(data: bytes) -> tuple[dict, dict]:
         raise ShipmentError("truncated shipment header")
     try:
         header = json.loads(bytes(data[off:off + hlen]))
+    except ValueError as e:
+        raise ShipmentError(f"bad shipment header: {e}") from e
+    if not isinstance(header, dict):
+        raise ShipmentError(
+            f"bad shipment header: expected object, got "
+            f"{type(header).__name__}")
+    return header, data, off + hlen
+
+
+def unpack_shipment(data: bytes) -> tuple[dict, dict]:
+    """Inverse of `pack_shipment` → (meta, {name: np.ndarray}). Every
+    malformation raises ShipmentError — truncated or alien bytes must
+    never come back as a half-parsed cache."""
+    header, data, off = _parse_header(data)
+    try:
         meta = header["meta"]
         specs = header["arrays"]
-    except (ValueError, KeyError, TypeError) as e:
+    except KeyError as e:
         raise ShipmentError(f"bad shipment header: {e}") from e
-    off += hlen
     arrays = {}
     for spec in specs:
         try:
@@ -131,26 +147,33 @@ def unpack_shipment(data: bytes) -> tuple[dict, dict]:
     return meta, arrays
 
 
+def rewrite_meta(data, **updates) -> bytes:
+    """Return a copy of a shipment with `updates` merged into its meta
+    header — the array payload bytes are spliced through UNTOUCHED (no
+    unpack, no array copies), so annotating a multi-MB shipment costs
+    one header re-encode. The router uses this to stamp the RESUME
+    CURSOR (`resume_skip`) onto a held shipment before re-submitting it
+    to a surviving decode replica: the decode engine replays the same
+    deterministic token stream and the cursor tells it how many leading
+    tokens the caller has already been served (ISSUE 14)."""
+    header, data, off = _parse_header(data)
+    try:
+        header["meta"].update(updates)
+    except (KeyError, AttributeError) as e:
+        raise ShipmentError(f"bad shipment header: {e}") from e
+    new_header = json.dumps(header, sort_keys=True).encode()
+    return b"".join([MAGIC, _LEN.pack(len(new_header)), new_header,
+                     bytes(data[off:])])
+
+
 def peek_meta(data) -> dict:
     """Parse ONLY the metadata header of a shipment (no array copies) —
     the server's :decode handler reads the stream flag and sizing here
     before handing the full payload to the engine."""
-    if not isinstance(data, (bytes, bytearray, memoryview)):
-        raise ShipmentError(f"shipment must be bytes, got {type(data)}")
-    data = memoryview(data)
-    if bytes(data[:len(MAGIC)]) != MAGIC:
-        raise ShipmentError(
-            f"bad shipment magic {bytes(data[:len(MAGIC)])!r}")
-    off = len(MAGIC)
-    if len(data) < off + _LEN.size:
-        raise ShipmentError("truncated shipment header length")
-    (hlen,) = _LEN.unpack(bytes(data[off:off + _LEN.size]))
-    off += _LEN.size
-    if len(data) < off + hlen:
-        raise ShipmentError("truncated shipment header")
+    header, _data, _off = _parse_header(data)
     try:
-        return json.loads(bytes(data[off:off + hlen]))["meta"]
-    except (ValueError, KeyError, TypeError) as e:
+        return header["meta"]
+    except KeyError as e:
         raise ShipmentError(f"bad shipment header: {e}") from e
 
 

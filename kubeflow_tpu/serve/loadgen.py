@@ -185,6 +185,11 @@ class FakeGenerativeModel(Model):
 
         meta = peek_meta(shipment)
         max_tokens = int(meta.get("max_tokens", 16))
+        # Resume cursor (ISSUE 14): same contract as the real engine —
+        # the deterministic stream replays, the first `resume_skip`
+        # tokens are dropped from chunk events, the done summary stays
+        # full.
+        skip = int(meta.get("resume_skip", 0))
         nb = max(1, -(-len(meta.get("tokens", [0])) // 8))
         with self._slots_sem:
             self.engine.enter()
@@ -193,8 +198,14 @@ class FakeGenerativeModel(Model):
                 while emitted < max_tokens:
                     n = min(8, max_tokens - emitted)
                     time.sleep(n * self.per_token_s)
-                    yield {"tokens": list(range(emitted, emitted + n))}
+                    toks = list(range(emitted, emitted + n))
                     emitted += n
+                    if skip:
+                        dropped = min(skip, len(toks))
+                        skip -= dropped
+                        toks = toks[dropped:]
+                    if toks:
+                        yield {"tokens": toks}
             finally:
                 self.engine.exit()
         self.engine.bump(requests=1, remote_admits=1,
@@ -241,7 +252,9 @@ def make_fake_replica(name: str = "m", *, slots: int = 4,
 
 def _post_generate(base_url: str, model: str, payload: dict,
                    deadline_ms: float | None,
-                   timeout_s: float = 30.0) -> tuple[int, dict]:
+                   timeout_s: float = 30.0) -> tuple[int, dict, dict]:
+    """Returns (status, body, response_headers) — the headers carry the
+    router's per-request provenance (X-Tpk-Replica / X-Tpk-Attempts)."""
     req = urllib.request.Request(
         f"{base_url}/v1/models/{model}:generate",
         data=json.dumps(payload).encode(), method="POST",
@@ -250,15 +263,15 @@ def _post_generate(base_url: str, model: str, payload: dict,
         req.add_header(DEADLINE_HEADER, str(int(deadline_ms)))
     try:
         with urllib.request.urlopen(req, timeout=timeout_s) as r:
-            return r.status, json.loads(r.read() or b"{}")
+            return r.status, json.loads(r.read() or b"{}"), dict(r.headers)
     except urllib.error.HTTPError as e:
         try:
             body = json.loads(e.read() or b"{}")
         except json.JSONDecodeError:
             body = {}
-        return e.code, body
+        return e.code, body, dict(e.headers or {})
     except Exception as e:
-        return -1, {"error": f"{type(e).__name__}: {e}"}
+        return -1, {"error": f"{type(e).__name__}: {e}"}, {}
 
 
 def open_loop(base_url: str, model: str, prompts: list[list[int]], *,
@@ -283,14 +296,25 @@ def open_loop(base_url: str, model: str, prompts: list[list[int]], *,
         payload = {"input_ids": prompts[i % len(prompts)],
                    "max_tokens": max_tokens}
         t0 = time.monotonic()
-        status, body = _post_generate(base_url, model, payload,
-                                      deadline_ms)
-        latency = time.monotonic() - t0
+        status, body, hdrs = _post_generate(base_url, model, payload,
+                                            deadline_ms)
+        t1 = time.monotonic()
+        try:
+            attempts = int(hdrs.get("X-Tpk-Attempts", 1))
+        except (TypeError, ValueError):
+            attempts = 1
         with rec_lock:
             records.append({
                 "sched_s": sched, "status": status,
-                "latency_ms": latency * 1e3,
+                "latency_ms": (t1 - t0) * 1e3,
                 "prefix_hit": bool(body.get("prefix_hit")),
+                # Per-request provenance (ISSUE 14): which replica
+                # served it, how many placement attempts it took, and
+                # its actual wall window — so fault-overlap claims are
+                # computed from per-request truth, not aggregates.
+                "replica": hdrs.get("X-Tpk-Replica"),
+                "retries": max(attempts - 1, 0),
+                "t_start_s": t0 - start, "t_end_s": t1 - start,
             })
 
     start = time.monotonic()

@@ -65,7 +65,8 @@ import tornado.netutil
 import tornado.web
 
 from kubeflow_tpu.serve.fleet import Fleet
-from kubeflow_tpu.serve.headers import (DEADLINE_HEADER, DRAINING_HEADER,
+from kubeflow_tpu.serve.headers import (ATTEMPTS_HEADER, DEADLINE_HEADER,
+                                        DRAINING_HEADER, REPLICA_HEADER,
                                         REQUEST_ID_HEADER)
 from kubeflow_tpu.utils import obs
 from kubeflow_tpu.utils.resilience import (Deadline,
@@ -145,6 +146,7 @@ class Router:
             "placed": 0, "affinity_hits": 0, "spills": 0,
             "least_loaded": 0, "decode_pool": 0, "retries": 0, "ok": 0,
             "handoffs": 0, "handoff_retries": 0,
+            "resumes": 0, "resume_failures": 0,
             "sheds_forwarded": 0, "no_replica": 0, "errors": 0,
         }
         self._stats_lock = threading.Lock()
@@ -526,8 +528,10 @@ class ProxyHandler(_RouterBase):
             except ForwardTimeoutError as e:
                 # The replica may still be executing the request: no
                 # replay (that would duplicate decode work) and no
-                # failure mark (slow is not dead) — just a 504.
+                # failure mark (slow is not dead) — just a 504. The
+                # gray-ejection EWMA still gets the latency evidence.
                 self.fleet.checkin(name)
+                self.fleet.observe_forward(name, timeout_s)
                 obs.record("router.forward", t0, time.perf_counter(),
                            trace_id=trace_id, replica=name,
                            error=str(e)[:120])
@@ -540,6 +544,8 @@ class ProxyHandler(_RouterBase):
                 # count, or a drain on this replica would wait forever.
                 self.fleet.checkin(name)
                 raise
+            self.set_header(REPLICA_HEADER, name)
+            self.set_header(ATTEMPTS_HEADER, str(attempts))
             try:
                 await self._relay(result, name, trace_id, t0)
             finally:
@@ -670,6 +676,7 @@ class ProxyHandler(_RouterBase):
                                 f"{e}") from e
             except ForwardTimeoutError as e:
                 self.fleet.checkin(name)
+                self.fleet.observe_forward(name, timeout_s)
                 self._count(name, "upstream_error")
                 self.router._bump("errors")
                 raise tornado.web.HTTPError(
@@ -681,9 +688,14 @@ class ProxyHandler(_RouterBase):
             self.fleet.checkin(name)
             if result.status != 200:
                 # Sheds forward as backpressure, errors relay as-is —
-                # exactly the unified path's contract.
+                # exactly the unified path's contract. (_relay observes
+                # the forward latency itself — observing here too would
+                # double-count the sample into the gray EWMA.)
+                self.set_header(REPLICA_HEADER, name)
+                self.set_header(ATTEMPTS_HEADER, str(attempts))
                 await self._relay(result, name, trace_id, t0)
                 return True
+            self.fleet.observe_forward(name, time.perf_counter() - t0)
             obs.record("router.forward", t0, time.perf_counter(),
                        trace_id=trace_id, replica=name, status=200,
                        phase="prefill")
@@ -693,8 +705,23 @@ class ProxyHandler(_RouterBase):
         self.router._bump("handoffs")
 
         # -- phase 2: shipment → decode replica → caller -----------------
+        # The resume loop (ISSUE 14): because the router HOLDS the
+        # shipment, a decode replica dying MID-STREAM is recoverable —
+        # the same bytes are re-submitted to a surviving decode replica
+        # with a `resume_skip` cursor stamped into the shipment meta
+        # (the count of tokens already relayed to the caller), and the
+        # replica's deterministic replay continues the stream exactly
+        # where it stopped: zero re-prefill, zero duplicated or lost
+        # tokens, no caller-visible error. Bounded by `max_resumes` and
+        # the caller's riding deadline; once those run out the stream
+        # ends with a terminal error frame + honest abrupt close.
         exclude2: set[str] = set()
         attempts2 = 0
+        resumes = 0
+        delivered = 0           # whole-frame tokens already at the caller
+        stream_started = False  # status+headers already on the wire
+        served: list[str] = []
+        active_shipment = shipment
         while True:
             with obs.span("router.place", trace_id=trace_id,
                           path=decode_path) as sp:
@@ -704,6 +731,11 @@ class ProxyHandler(_RouterBase):
             if dname is None:
                 self._count(None, "no_replica")
                 self.router._bump("errors")
+                if stream_started:
+                    self.router._bump("resume_failures")
+                    await self._stream_error_close(
+                        "no live decode replica to resume on")
+                    return True
                 self.set_header("Retry-After", "1")
                 self.write_json({"error": "no live decode replica"},
                                 status=503)
@@ -716,6 +748,12 @@ class ProxyHandler(_RouterBase):
                 self._count(dname, "deadline")
                 res_metrics.inc("tpk_deadline_expired_total",
                                 component="router")
+                if stream_started:
+                    self.router._bump("errors")
+                    self.router._bump("resume_failures")
+                    await self._stream_error_close(
+                        "request deadline exceeded (router resume)")
+                    return True
                 raise tornado.web.HTTPError(
                     504, reason="request deadline exceeded (router)")
             headers = self._remaining_headers(
@@ -729,7 +767,7 @@ class ProxyHandler(_RouterBase):
             try:
                 result = await loop.run_in_executor(
                     self.server.executor, _forward_once, url, "POST",
-                    decode_path, shipment, headers, timeout_s,
+                    decode_path, active_shipment, headers, timeout_s,
                     not wants_stream)
             except RetryableForwardError as e:
                 # THE handoff-resume path: the prefill work is safe in
@@ -754,9 +792,20 @@ class ProxyHandler(_RouterBase):
                 if expired:
                     res_metrics.inc("tpk_deadline_expired_total",
                                     component="router")
+                    if stream_started:
+                        self.router._bump("resume_failures")
+                        await self._stream_error_close(
+                            "request deadline exceeded (router resume)")
+                        return True
                     raise tornado.web.HTTPError(
                         504, reason="request deadline exceeded "
                                     "(router retries)") from e
+                if stream_started:
+                    self.router._bump("resume_failures")
+                    await self._stream_error_close(
+                        f"decode replica {dname} unreachable during "
+                        f"resume: {e}")
+                    return True
                 raise tornado.web.HTTPError(
                     502, reason=f"decode replica {dname} unreachable: "
                                 f"{e}") from e
@@ -764,19 +813,224 @@ class ProxyHandler(_RouterBase):
                 # The decode replica may still be generating: 504, no
                 # replay (a replay would duplicate decode work).
                 self.fleet.checkin(dname)
+                self.fleet.observe_forward(dname, timeout_s)
                 self._count(dname, "upstream_error")
                 self.router._bump("errors")
+                if stream_started:
+                    self.router._bump("resume_failures")
+                    await self._stream_error_close(
+                        f"decode replica {dname} timed out: {e}")
+                    return True
                 raise tornado.web.HTTPError(
                     504, reason=f"decode replica {dname} timed out: "
                                 f"{e}") from e
             except Exception:
                 self.fleet.checkin(dname)
                 raise
-            try:
-                await self._relay(result, dname, trace_id, t0)
-            finally:
+            if not wants_stream:
+                self.set_header(REPLICA_HEADER, dname)
+                self.set_header(ATTEMPTS_HEADER,
+                                str(attempts + attempts2))
+                try:
+                    await self._relay(result, dname, trace_id, t0)
+                finally:
+                    self.fleet.checkin(dname)
+                return True
+            if stream_started and result.status != 200:
+                # A resume attempt answered an error/shed AFTER the 200
+                # status already went out — nothing left to forward it
+                # as; terminal error frame.
+                if result.conn is not None:
+                    result.conn.close()
                 self.fleet.checkin(dname)
-            return True
+                self._count(dname, "upstream_error")
+                self.router._bump("errors")
+                self.router._bump("resume_failures")
+                await self._stream_error_close(
+                    f"decode resume on {dname} answered "
+                    f"{result.status}")
+                return True
+            if result.body is not None or result.status != 200:
+                # Pre-stream shed/error from the FIRST attempt: relay it
+                # verbatim (sheds forward as backpressure, errors as-is
+                # — exactly the unified path's contract).
+                self.set_header(REPLICA_HEADER, dname)
+                self.set_header(ATTEMPTS_HEADER,
+                                str(attempts + attempts2))
+                try:
+                    await self._relay(result, dname, trace_id, t0)
+                finally:
+                    self.fleet.checkin(dname)
+                return True
+            if not stream_started:
+                self.set_header(REPLICA_HEADER, dname)
+                self.set_header(ATTEMPTS_HEADER,
+                                str(attempts + attempts2))
+            prov = {"replicas": served + [dname], "resumes": resumes}
+            try:
+                status, delta, err, flushed = await self._relay_ndjson(
+                    result, dname, trace_id, t0,
+                    started=stream_started, prov=prov)
+            except Exception:
+                # Unexpected relay failure (executor shutdown, handler
+                # teardown): the outstanding count must still release,
+                # or this replica's load stays inflated and a drain on
+                # it never completes.
+                self.fleet.checkin(dname)
+                raise
+            # Committed only once bytes actually reached the caller: an
+            # attempt that died pre-flush leaves the status line free,
+            # so terminal failures can still answer a real 5xx.
+            stream_started = stream_started or flushed
+            delivered += delta
+            served.append(dname)
+            dt = time.perf_counter() - t0
+            if status in ("done", "caller_gone"):
+                self.fleet.checkin(dname)
+                self.fleet.observe_forward(dname, dt)
+                return True
+            # Died mid-stream. A read timeout means the replica is
+            # STALLED, not dead — no failure nudge (the gray-ejection
+            # EWMA gets the latency evidence instead); anything else is
+            # a death and counts toward the probe-failure trip.
+            stalled = isinstance(err, TimeoutError)
+            self.fleet.checkin(dname, failed=not stalled)
+            self.fleet.observe_forward(dname, dt)
+            self._count(dname, "upstream_error")
+            expired = deadline is not None and deadline.expired()
+            if resumes >= self.server.max_resumes or expired:
+                self.router._bump("errors")
+                self.router._bump("resume_failures")
+                if expired:
+                    res_metrics.inc("tpk_deadline_expired_total",
+                                    component="router")
+                msg = (f"decode replica {dname} died mid-stream and "
+                       f"the resume budget is exhausted "
+                       f"({resumes}/{self.server.max_resumes}): {err}")
+                if stream_started:
+                    await self._stream_error_close(msg)
+                    return True
+                # Nothing reached the caller yet: a real status beats
+                # a 200 + error frame.
+                raise tornado.web.HTTPError(504 if expired else 502,
+                                            reason=msg)
+            resumes += 1
+            res_metrics.inc("tpk_router_resume_total",
+                            reason="stall" if stalled else "death")
+            self.router._bump("resumes")
+            exclude2.add(dname)
+            # Stamp the cursor on the ORIGINAL held bytes (idempotent —
+            # each resume restates the full delivered count).
+            from kubeflow_tpu.serve.kv_transfer import rewrite_meta
+
+            active_shipment = rewrite_meta(shipment,
+                                           resume_skip=delivered)
+
+    async def _stream_error_close(self, msg: str) -> None:
+        """Terminal error envelope for an already-started ndjson stream,
+        followed by an honest ABRUPT close: the envelope names the
+        failure for clients that parse frames, the missing terminator
+        keeps the truncation visible to clients that don't."""
+        try:
+            self.write(json.dumps({"error": msg}) + "\n")
+            await self.flush()
+        except Exception:
+            pass
+        try:
+            self.request.connection.stream.close()
+        except Exception:
+            pass
+
+    async def _relay_ndjson(
+            self, result: _ForwardResult, name: str, trace_id: str,
+            t0: float, *, started: bool,
+            prov: dict) -> tuple[str, int, Exception | None, bool]:
+        """Relay one decode replica's x-ndjson token stream LINE
+        BUFFERED: only COMPLETE frames reach the caller (a death
+        mid-frame must not deliver a torn line — the resume cursor
+        counts tokens from whole frames, so router-delivered and
+        replica-skipped counts always agree), tokens are tallied as
+        frames pass, and the terminal done frame is enriched with the
+        router's provenance (`_router`: serving replicas + resume
+        count) so load harnesses get per-request truth. Returns
+        (status, delivered_tokens, err, flushed) with status one of
+        "done" (terminal frame relayed), "caller_gone" (client
+        disconnected), "died" (upstream ended without a done frame);
+        `flushed` reports whether any bytes actually reached the
+        caller's socket — an attempt that died before flushing leaves
+        the response UNCOMMITTED, so a later terminal failure can still
+        answer a proper 5xx instead of a 200 + error frame."""
+        loop = asyncio.get_event_loop()
+        if not started:
+            self.set_status(result.status)
+            hdrs = dict(result.headers or ())
+            for h in _FORWARD_RESP_HEADERS:
+                if h in hdrs:
+                    self.set_header(h, hdrs[h])
+        conn, resp = result.conn, result.resp
+        delivered = 0
+        done = False
+        flushed = False
+        err: Exception | None = None
+        buf = b""
+        try:
+            while not done:
+                try:
+                    chunk = await loop.run_in_executor(
+                        self.server.executor, resp.read1, 65536)
+                except (OSError, http.client.HTTPException) as e:
+                    err = e
+                    break
+                if not chunk:
+                    break
+                buf += chunk
+                out: list[bytes] = []
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    if not line.strip():
+                        out.append(line + b"\n")
+                        continue
+                    try:
+                        ev = json.loads(line)
+                    except ValueError:
+                        out.append(line + b"\n")
+                        continue
+                    if isinstance(ev, dict):
+                        delivered += len(ev.get("tokens") or ())
+                        if ev.get("done"):
+                            done = True
+                            ev["_router"] = dict(prov)
+                            out.append(json.dumps(ev).encode() + b"\n")
+                            break
+                    out.append(line + b"\n")
+                if out:
+                    self.write(b"".join(out))
+                    try:
+                        await self.flush()
+                        flushed = True
+                    except tornado.iostream.StreamClosedError:
+                        self._count(name, "ok")
+                        self.router._bump("ok")
+                        return ("caller_gone", delivered, None, flushed)
+        finally:
+            conn.close()
+        if done:
+            self._count(name, "ok")
+            self.router._bump("ok")
+            obs.record("router.forward", t0, time.perf_counter(),
+                       trace_id=trace_id, replica=name,
+                       status=result.status)
+            try:
+                self.finish()
+            except tornado.iostream.StreamClosedError:
+                pass
+            return ("done", delivered, None, True)
+        if err is None:
+            err = RuntimeError("upstream closed before the done frame")
+        obs.record("router.forward", t0, time.perf_counter(),
+                   trace_id=trace_id, replica=name,
+                   error=str(err)[:120])
+        return ("died", delivered, err, flushed)
 
     async def _relay(self, result: _ForwardResult, name: str,
                      trace_id: str, t0: float) -> None:
@@ -796,6 +1050,8 @@ class ProxyHandler(_RouterBase):
                 outcome, stat = "ok", "ok"
             self._count(name, outcome)
             self.router._bump(stat)
+            self.fleet.observe_forward(name,
+                                       time.perf_counter() - t0)
             obs.record("router.forward", t0, time.perf_counter(),
                        trace_id=trace_id, replica=name,
                        status=result.status)
@@ -828,15 +1084,41 @@ class ProxyHandler(_RouterBase):
                     break  # caller went away; stop pulling
             self._count(name, outcome)
             self.router._bump("ok" if outcome == "ok" else "errors")
+            self.fleet.observe_forward(name,
+                                       time.perf_counter() - t0)
             obs.record("router.forward", t0, time.perf_counter(),
                        trace_id=trace_id, replica=name,
                        status=result.status,
                        **({"error": str(upstream_err)[:120]}
                           if upstream_err is not None else {}))
             if upstream_err is not None:
-                # Headers (and chunks) are already on the wire: the only
-                # honest signal left is an abrupt close — a clean chunked
-                # terminator would make the truncation invisible.
+                # Headers (and chunks) are already on the wire: the
+                # abrupt close below stays the honest truncation signal
+                # — but where the surface has an in-band error envelope
+                # (ndjson frames, SSE events), write one terminal error
+                # frame first so parsing clients see the failure named
+                # instead of a bare connection reset (ISSUE 14).
+                ct = hdrs.get("Content-Type") or ""
+                msg = (f"upstream replica {name} died mid-stream: "
+                       f"{type(upstream_err).__name__}")
+                frame = None
+                # Leading newline: this relay forwards RAW chunks, so
+                # the upstream may have died mid-line — appending the
+                # envelope straight after a torn partial line would
+                # make it unparseable to exactly the line-parsing
+                # clients it exists for (blank lines are skipped by
+                # both surfaces' parsers).
+                if ct.startswith("application/x-ndjson"):
+                    frame = "\n" + json.dumps({"error": msg}) + "\n"
+                elif ct.startswith("text/event-stream"):
+                    frame = "\n\ndata: " + json.dumps(
+                        {"error": {"message": msg}}) + "\n\n"
+                if frame is not None:
+                    try:
+                        self.write(frame)
+                        await self.flush()
+                    except Exception:
+                        pass
                 try:
                     self.request.connection.stream.close()
                 except Exception:
@@ -909,11 +1191,16 @@ class RouterServer:
     def __init__(self, fleet: Fleet | None = None, *,
                  affinity: bool = True, spill_margin: float = 4.0,
                  forward_timeout_s: float = 300.0,
+                 max_resumes: int = 3,
                  max_workers: int = 128):
         self.fleet = fleet or Fleet()
         self.router = Router(self.fleet, affinity=affinity,
                              spill_margin=spill_margin)
         self.forward_timeout_s = float(forward_timeout_s)
+        #: Mid-stream decode-failover cap (ISSUE 14): how many times one
+        #: disaggregated stream may be resumed on a fresh decode replica
+        #: before the router gives up with a terminal error frame.
+        self.max_resumes = int(max_resumes)
         # One worker is HELD for the whole upstream round trip of one
         # in-flight request (blocking http.client forward), so the pool
         # must cover peak CONCURRENT requests, not CPU count — the

@@ -173,6 +173,77 @@ def test_pool_wire_pool_byte_identity(built):
         eng.close()
 
 
+def test_rewrite_meta_splices_header_only():
+    """ISSUE 14: rewrite_meta stamps the resume cursor by re-encoding
+    ONLY the JSON header — payload bytes splice through untouched, the
+    update round-trips, and malformed inputs refuse loudly."""
+    from kubeflow_tpu.serve.kv_transfer import rewrite_meta
+
+    rng = np.random.default_rng(3)
+    arrays = {"k": rng.normal(size=(2, 3, 8, 2, 4)).astype(np.float32),
+              "rng_key": rng.integers(0, 2**31, 4, dtype=np.uint32)}
+    data = pack_shipment({"fmt": 1, "tokens": [5, 6]}, arrays)
+    stamped = rewrite_meta(data, resume_skip=7)
+    meta2, arrays2 = unpack_shipment(stamped)
+    assert meta2 == {"fmt": 1, "tokens": [5, 6], "resume_skip": 7}
+    for name, arr in arrays.items():
+        assert arrays2[name].tobytes() == arr.tobytes()
+    # Idempotent restating: a second stamp replaces, never accumulates.
+    meta3, _ = unpack_shipment(rewrite_meta(stamped, resume_skip=9))
+    assert meta3["resume_skip"] == 9
+    for bad in (b"", b"junk", data[:16]):
+        with pytest.raises(ShipmentError):
+            rewrite_meta(bad, resume_skip=1)
+
+
+def test_resume_skip_stream_replay_identity(built):
+    """ISSUE 14, replica side of mid-stream failover: re-submitting the
+    SAME shipment with a `resume_skip` cursor replays the identical
+    deterministic seeded-sampled stream, suppresses exactly the first K
+    tokens from the chunk events (no duplicate, no loss), and keeps the
+    done summary full — token+logprob-identical to the uninterrupted
+    run. Out-of-range cursors refuse loudly."""
+    from kubeflow_tpu.serve.generation import GenerativeJAXModel
+    from kubeflow_tpu.serve.kv_transfer import rewrite_meta
+
+    model, params = built
+    pre = make_engine(built, seed=5, role="prefill")
+    try:
+        ship = pre.prefill_ship(rng_prompt(13, 9), max_tokens=10,
+                                temperature=0.7)["shipment"]
+    finally:
+        pre.close()
+    dec = make_engine(built, seed=222, role="decode")
+    m = GenerativeJAXModel("m", model, params, CFG)
+    m.engine, m.ready = dec, True
+
+    def run(shipment):
+        chunks, final = [], None
+        for ev in m.decode_remote_stream(shipment):
+            if ev.get("done"):
+                final = ev
+            else:
+                chunks.extend(ev["tokens"])
+        return chunks, final
+
+    try:
+        full, fin1 = run(ship)
+        assert full == fin1["output_ids"]
+        k = 4
+        tail, fin2 = run(rewrite_meta(ship, resume_skip=k))
+        assert tail == full[k:]
+        assert fin2["output_ids"] == fin1["output_ids"]
+        assert fin2["output_logprobs"] == fin1["output_logprobs"]
+        with pytest.raises(ValueError):
+            list(m.decode_remote_stream(
+                rewrite_meta(ship, resume_skip=99)))
+        with pytest.raises(ValueError):
+            list(m.decode_remote_stream(
+                rewrite_meta(ship, resume_skip=-1)))
+    finally:
+        dec.close()
+
+
 # -- disagg-vs-unified identity ---------------------------------------------
 
 

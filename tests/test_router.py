@@ -355,26 +355,48 @@ def test_autoscaler_scale_out_not_blocked_by_past_scale_ins():
 
 
 def test_controlplane_scaler_patches_isvc_replicas():
+    """The reconcile must be READ-MODIFY-WRITE of the whole spec:
+    `update_spec` is a full replace on the control plane, and a bare
+    {"replicas": N} patch is rejected by the real binary's admission
+    ("model is required") — the ISSUE 14 combined-plane test runs this
+    against a live cluster; this unit pins the full-spec shape."""
     calls = []
 
     class FakeClient:
         def __init__(self):
-            self.replicas = 2
+            self.spec = {"model": {"name": "m", "model_dir": "/b"},
+                         "replicas": 2}
+            self.version = 7
+            self.conflict_once = False
 
         def get(self, kind, name):
             assert (kind, name) == ("InferenceService", "svc")
-            return {"spec": {"replicas": self.replicas}}
+            return {"spec": dict(self.spec),
+                    "resourceVersion": self.version}
 
-        def update_spec(self, kind, name, spec):
+        def update_spec(self, kind, name, spec, expected_version=None):
+            # The real server validates the WHOLE document (a patch
+            # that dropped `model` would be rejected) and the replace
+            # must ride CAS so a concurrent writer is never clobbered.
+            assert "model" in spec
+            assert expected_version == self.version or \
+                self.conflict_once
+            if self.conflict_once:
+                self.conflict_once = False
+                raise RuntimeError("conflict: version mismatch")
             calls.append((kind, name, spec))
-            self.replicas = spec["replicas"]
+            self.spec = dict(spec)
+            self.version += 1
 
     client = FakeClient()
     scaler = ControlPlaneScaler(client, "svc")
     scaler.scale_up()
+    # A lost CAS race re-reads and retries instead of clobbering.
+    client.conflict_once = True
     scaler.retire("r9")
-    assert calls == [("InferenceService", "svc", {"replicas": 3}),
-                     ("InferenceService", "svc", {"replicas": 2})]
+    assert [c[2]["replicas"] for c in calls] == [3, 2]
+    assert all(c[2]["model"] == {"name": "m", "model_dir": "/b"}
+               for c in calls)
 
 
 # -- fake-replica e2e -------------------------------------------------------
@@ -1219,6 +1241,430 @@ def test_place_decode_intent_prefers_pool_headroom():
 
 
 @pytest.mark.slow
+# -- ISSUE 14: mid-stream decode failover + gray-failure ejection -----------
+
+
+def _dying_decode_server(frames, extra_headers=b""):
+    """A raw one-shot HTTP server: accepts one connection, answers a
+    chunked 200 x-ndjson stream of `frames`, then dies ABRUPTLY (no
+    terminal chunk) — a decode replica SIGKILLed mid-stream, seen from
+    the router's side of the socket. Returns (lsock, port)."""
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(2)
+
+    def run():
+        try:
+            c, _ = lsock.accept()
+        except OSError:
+            return
+        c.settimeout(2.0)
+        try:
+            c.recv(1 << 20)  # request headers + (small) shipment body
+        except OSError:
+            pass
+        out = [b"HTTP/1.1 200 OK\r\n"
+               b"Content-Type: application/x-ndjson\r\n"
+               + extra_headers +
+               b"Transfer-Encoding: chunked\r\n\r\n"]
+        for fr in frames:
+            line = (json.dumps(fr) + "\n").encode()
+            out.append(b"%x\r\n%s\r\n" % (len(line), line))
+        try:
+            c.sendall(b"".join(out))
+            time.sleep(0.25)
+            c.close()
+        except OSError:
+            pass
+
+    threading.Thread(target=run, daemon=True).start()
+    return lsock, lsock.getsockname()[1]
+
+
+def test_e2e_disagg_midstream_death_resumes_seamlessly():
+    """THE ISSUE 14 tentpole, router side: a decode replica dying
+    MID-STREAM costs the caller nothing — the router re-submits the
+    held shipment to a surviving decode replica with the resume cursor
+    stamped, the replica's deterministic replay skips the tokens
+    already delivered, and the caller sees one seamless stream: every
+    token exactly once, zero error frames, zero re-prefill, the resume
+    counted and the provenance in the done frame."""
+    from kubeflow_tpu.serve.fleet import Fleet as _Fleet
+    from kubeflow_tpu.utils.resilience import metrics as res_metrics
+
+    # The dying replica streams tokens 0..7 then drops the socket; the
+    # healthy fake decode replica (which honors resume_skip) must pick
+    # up at token 8.
+    _lsock, dport = _dying_decode_server(
+        [{"model_name": "m", "tokens": [0, 1, 2, 3]},
+         {"model_name": "m", "tokens": [4, 5, 6, 7]}])
+    pre = make_fake_replica("m")
+    dec = make_fake_replica("m", per_token_s=0.001)
+    router = RouterServer(_Fleet(start_poller=False))
+    router.fleet.add("pre0", pre[1], role="prefill")
+    router.fleet.add("dec0", f"http://127.0.0.1:{dport}", role="decode")
+    router.fleet.add("dec1", dec[1], role="decode")
+    base = f"http://127.0.0.1:{router.start_background()}"
+    before = res_metrics.get("tpk_router_resume_total",
+                             reason="death") or 0
+    try:
+        req = urllib.request.Request(
+            f"{base}/v1/models/m:generate",
+            data=json.dumps({"input_ids": [1, 2, 3], "max_tokens": 24,
+                             "stream": True}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.headers.get("X-Tpk-Replica") == "dec0"
+            lines = [json.loads(ln) for ln in r.read().splitlines()
+                     if ln.strip()]
+        assert all("error" not in ln for ln in lines)
+        done = lines[-1]
+        assert done.get("done") is True
+        toks = [t for ln in lines[:-1] for t in ln.get("tokens", [])]
+        # Every token exactly once, in order, across the failover seam.
+        assert toks == list(range(24))
+        assert done["_router"] == {"replicas": ["dec0", "dec1"],
+                                   "resumes": 1}
+        # Zero re-prefill: the held shipment resumed, fleet-wide
+        # prefill count stays exactly one.
+        assert pre[2].engine.stats_snapshot()["prefill_chunks"] == 1
+        rs = router.router.stats_snapshot()
+        assert rs["resumes"] == 1 and rs["resume_failures"] == 0
+        assert rs["handoffs"] == 1
+        after = res_metrics.get("tpk_router_resume_total",
+                                reason="death") or 0
+        assert after == before + 1
+    finally:
+        router.stop()
+        pre[0].stop()
+        dec[0].stop()
+        _lsock.close()
+
+
+def test_e2e_disagg_resume_exhaustion_gets_error_envelope():
+    """When every decode replica is gone mid-stream, the caller gets a
+    TERMINAL ERROR FRAME (the ndjson surface supports one) and then the
+    honest abrupt close — never a clean terminator that would hide the
+    truncation, and never a silent hang."""
+    import http.client as hc
+
+    from kubeflow_tpu.serve.fleet import Fleet as _Fleet
+
+    _lsock, dport = _dying_decode_server(
+        [{"model_name": "m", "tokens": [0, 1]}])
+    pre = make_fake_replica("m")
+    router = RouterServer(_Fleet(start_poller=False))
+    router.fleet.add("pre0", pre[1], role="prefill")
+    router.fleet.add("dec0", f"http://127.0.0.1:{dport}", role="decode")
+    base_port = router.start_background()
+    try:
+        conn = hc.HTTPConnection("127.0.0.1", base_port, timeout=30)
+        conn.request("POST", "/v1/models/m:generate",
+                     body=json.dumps({"input_ids": [1], "max_tokens": 8,
+                                      "stream": True}),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        try:
+            raw = resp.read()
+        except hc.IncompleteRead as e:
+            raw = e.partial  # the abrupt close IS the honest signal
+        finally:
+            conn.close()
+        lines = [json.loads(ln) for ln in raw.splitlines()
+                 if ln.strip()]
+        assert lines[0]["tokens"] == [0, 1]
+        assert "error" in lines[-1]  # the terminal envelope
+        rs = router.router.stats_snapshot()
+        assert rs["resume_failures"] >= 1
+    finally:
+        router.stop()
+        pre[0].stop()
+        _lsock.close()
+
+
+def test_e2e_unified_midstream_death_error_envelope():
+    """Unified (non-disagg) streams keep the honest abrupt-close on a
+    mid-stream replica death — but the ndjson surface now carries a
+    terminal error envelope first, so parsing clients see the failure
+    named instead of a bare reset (ISSUE 14)."""
+    import http.client as hc
+
+    from kubeflow_tpu.serve.fleet import Fleet as _Fleet
+
+    _lsock, dport = _dying_decode_server(
+        [{"model_name": "m", "tokens": [0, 1, 2]}])
+    router = RouterServer(_Fleet(start_poller=False))
+    router.fleet.add("r0", f"http://127.0.0.1:{dport}")
+    base_port = router.start_background()
+    try:
+        conn = hc.HTTPConnection("127.0.0.1", base_port, timeout=30)
+        conn.request("POST", "/v1/models/m:generate",
+                     body=json.dumps({"input_ids": [1], "max_tokens": 8,
+                                      "stream": True}),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        try:
+            raw = resp.read()
+        except hc.IncompleteRead as e:
+            raw = e.partial
+        finally:
+            conn.close()
+        lines = [json.loads(ln) for ln in raw.splitlines()
+                 if ln.strip()]
+        assert lines[0]["tokens"] == [0, 1, 2]
+        assert "died mid-stream" in lines[-1].get("error", "")
+        stats = router.router.stats_snapshot()
+        assert stats["errors"] >= 1
+    finally:
+        router.stop()
+        _lsock.close()
+
+
+def _latency_fleet(n=3, **kw):
+    fleet = Fleet(start_poller=False, **kw)
+    for i in range(n):
+        fleet.add(f"r{i}", f"http://127.0.0.1:{11000 + i}")
+    # All probed healthy with a fast baseline RTT.
+    for i in range(n):
+        fleet.update_load(f"r{i}", {"ready": True, "rtt_s": 0.02})
+    return fleet
+
+
+def test_gray_ejection_and_half_open_rejoin():
+    """ISSUE 14 gray-failure tentpole: a slow-but-alive replica (probes
+    answer, latency a statistical outlier) ejects to `slow` after the
+    strike hysteresis — out of placement but ALIVE — and rejoins once
+    half-open probes show it recovered."""
+    fleet = _latency_fleet(3, slow_min_s=0.0)
+    router = Router(fleet)
+    # r0 goes gray: forwards crawl, probes still answer (slowly).
+    for _ in range(4):
+        fleet.observe_forward("r0", 3.0)
+        for i in range(3):
+            fleet.update_load(f"r{i}", {"ready": True,
+                                        "rtt_s": 3.0 if i == 0 else 0.02})
+        transitions = fleet.eject_pass()
+    assert ("r0", "eject") in transitions or \
+        fleet.get("r0")["state"] == "slow"
+    assert fleet.get("r0")["state"] == "slow"
+    assert "r0" not in fleet.placeable_names()
+    # Placement routes around it without a version of doubt.
+    for _ in range(8):
+        name, _reason = router.place(None)
+        assert name != "r0"
+    # Still draining in-flight: outstanding is untouched by ejection.
+    fleet.checkout("r0")
+    assert fleet.get("r0")["outstanding"] == 1
+    fleet.checkin("r0")
+    # Recovery: probes come back fast; the EWMA decays under the rejoin
+    # bound and the replica re-enters placement.
+    for _ in range(30):
+        for i in range(3):
+            fleet.update_load(f"r{i}", {"ready": True, "rtt_s": 0.02})
+        fleet.eject_pass()
+        if fleet.get("r0")["state"] == "ready":
+            break
+    assert fleet.get("r0")["state"] == "ready"
+    assert "r0" in fleet.placeable_names()
+
+
+def test_poll_once_pass_bounded_by_stalled_probes():
+    """Probe hardening (ISSUE 14): N stalled replicas whose scrapes
+    serialize behind the 8-worker pool must not wedge the whole pass —
+    poll_once waits only a bound (2x scrape timeout + slack) per pass;
+    stragglers apply their own results whenever they land."""
+    fleet = Fleet(start_poller=False, scrape_timeout_s=0.1)
+    for i in range(12):
+        fleet.add(f"s{i}", f"http://127.0.0.1:{12000 + i}")
+
+    def stalled_scrape(name, url, grpc):
+        time.sleep(3.0)  # a TCP black hole past every per-probe bound
+        return {"ready": True}
+
+    fleet._scrape_one = stalled_scrape
+    t0 = time.perf_counter()
+    fleet.poll_once()
+    elapsed = time.perf_counter() - t0
+    # Unbounded, 12 scrapes x 3s over 8 workers would take ~6s.
+    assert elapsed < 2.5
+
+
+def test_update_load_drops_stale_pass_stragglers():
+    """poll_once's bounded wait lets stragglers outlive their pass —
+    a STALE pass's result landing after a fresher one must be dropped,
+    or three queued stale failures draining after a recovery probe
+    would mark a healthy replica down (and a stale success could mask
+    a real outage)."""
+    fleet = Fleet(start_poller=False)
+    fleet.add("r0", "http://127.0.0.1:11000")
+    fleet.update_load("r0", {"ready": True, "rtt_s": 0.01}, seq=5)
+    assert fleet.get("r0")["state"] == "ready"
+    for old_seq in (2, 3, 4):  # stale failures drain late
+        fleet.update_load("r0", None, seq=old_seq)
+    assert fleet.get("r0")["state"] == "ready"
+    assert fleet.get("r0")["scrape_failures"] == 0
+    fleet.update_load("r0", None, seq=6)  # fresh failures still count
+    assert fleet.get("r0")["scrape_failures"] == 1
+
+
+def test_gray_ejection_one_spike_does_not_flap():
+    """Hysteresis: a single outlier pass (one GC pause) must NOT eject
+    — it takes eject_strikes consecutive outlier passes."""
+    fleet = _latency_fleet(3)
+    fleet.update_load("r0", {"ready": True, "rtt_s": 8.0})  # one pause
+    fleet.eject_pass()  # strike 1
+    assert fleet.get("r0")["state"] == "ready"
+    # Recovery before the strikes accumulate resets the count.
+    for _ in range(6):
+        fleet.update_load("r0", {"ready": True, "rtt_s": 0.02})
+        fleet.eject_pass()
+    assert fleet.get("r0")["state"] == "ready"
+    assert "r0" in fleet.placeable_names()
+
+
+def test_gray_ejection_needs_signal_population():
+    """Apples to apples: a replica's FORWARD latency is judged only
+    against peers that also have forward observations — the fleet's
+    only ACTIVE replica (streams = long wall times) must never be
+    ejected for out-running its idle peers' probe RTTs. Regression for
+    the seeded decode-kill test's 'resume had nowhere to land'."""
+    fleet = _latency_fleet(3, slow_min_s=0.0)
+    for _ in range(6):
+        fleet.observe_forward("r0", 0.6)  # the only serving replica
+        for i in range(3):
+            fleet.update_load(f"r{i}", {"ready": True, "rtt_s": 0.01})
+        fleet.eject_pass()
+    assert fleet.get("r0")["state"] == "ready"
+    # With a second active peer at comparable wall times, a genuinely
+    # slow third IS an outlier within the forward population. (Its
+    # probes stay fast, so it may half-open rejoin with slow_min_s=0 —
+    # the claim here is that the EJECTION fires at all.)
+    transitions = []
+    for _ in range(5):
+        fleet.observe_forward("r0", 5.0)
+        fleet.observe_forward("r1", 0.5)
+        fleet.observe_forward("r2", 0.6)
+        for i in range(3):
+            fleet.update_load(f"r{i}", {"ready": True, "rtt_s": 0.01})
+        transitions += fleet.eject_pass()
+    assert ("r0", "eject") in transitions
+
+
+def test_gray_ejection_never_strands_small_fleet():
+    """min_remaining: with too few healthy peers the outlier stays
+    placeable (slow beats nothing)."""
+    fleet = _latency_fleet(2)
+    for _ in range(6):
+        fleet.observe_forward("r0", 5.0)
+        fleet.update_load("r0", {"ready": True, "rtt_s": 5.0})
+        fleet.update_load("r1", {"ready": True, "rtt_s": 0.02})
+        fleet.eject_pass()
+    assert fleet.get("r0")["state"] == "ready"
+
+
+def test_gray_ejection_partitions_forward_population_by_role():
+    """Disaggregated fleets: decode forwards STREAM for seconds while
+    prefill forwards finish in milliseconds BY DESIGN — pooled into one
+    population, every healthy decode replica would be a structural
+    outlier against its prefill peers and the whole decode side would
+    flap out of placement. Forward latency is judged per role."""
+    fleet = Fleet(start_poller=False, slow_min_s=0.0)
+    for name, role, port in (("p0", "prefill", 11100),
+                             ("p1", "prefill", 11101),
+                             ("d0", "decode", 11102),
+                             ("d1", "decode", 11103),
+                             ("d2", "decode", 11104)):
+        fleet.add(name, f"http://127.0.0.1:{port}", role=role)
+    for _ in range(6):
+        for n in ("p0", "p1"):
+            fleet.observe_forward(n, 0.05)   # fast phase-1 forwards
+        for n in ("d0", "d1", "d2"):
+            fleet.observe_forward(n, 2.0)    # streams: slow by design
+        for n in ("p0", "p1", "d0", "d1", "d2"):
+            fleet.update_load(n, {"ready": True, "rtt_s": 0.01})
+        fleet.eject_pass()
+    # No healthy decode replica ejected for out-streaming prefills.
+    assert all(fleet.get(n)["state"] == "ready"
+               for n in ("d0", "d1", "d2"))
+    # A decode replica slow AGAINST ITS OWN ROLE still ejects.
+    transitions = []
+    for _ in range(4):
+        for n in ("p0", "p1"):
+            fleet.observe_forward(n, 0.05)
+        fleet.observe_forward("d0", 20.0)
+        for n in ("d1", "d2"):
+            fleet.observe_forward(n, 2.0)
+        for n in ("p0", "p1", "d0", "d1", "d2"):
+            fleet.update_load(n, {"ready": True, "rtt_s": 0.01})
+        transitions += fleet.eject_pass()
+    assert ("d0", "eject") in transitions
+
+
+def test_autoscaler_counts_slow_as_alive():
+    """A gray-ejected replica is non-placeable but ALIVE: it still
+    consumes max_replicas headroom (a GC pause must not buy a whole
+    new replica) and is never a drain victim."""
+    fleet = _latency_fleet(3, slow_min_s=0.0)
+    for _ in range(4):
+        fleet.observe_forward("r0", 3.0)
+        for i in range(3):
+            fleet.update_load(f"r{i}", {"ready": True,
+                                        "rtt_s": 3.0 if i == 0 else 0.02})
+        fleet.eject_pass()
+    assert fleet.get("r0")["state"] == "slow"
+    calls = []
+    stub = _StatsStub()
+    stub.sheds = 1
+    scaler = FleetAutoscaler(
+        fleet, stub,
+        scale_up=lambda: calls.append("up"),
+        retire=lambda n: calls.append(f"retire:{n}"),
+        max_replicas=3)
+    # Sheds demand scale-out, but slow r0 still counts toward the cap
+    # of 3 — no scale-up fires.
+    assert scaler.evaluate() is None
+    assert calls == []
+
+
+def test_grpc_router_midstream_death_counted_and_retried():
+    """ISSUE 14 satellite: a replica dying mid-RPC on the gRPC plane is
+    counted apart from a connect failure (reason="midstream") and the
+    unary request is retried on a survivor — HTTP-plane parity instead
+    of an uncounted raw error."""
+    from kubeflow_tpu.serve.grpc_server import InferenceClient
+    from kubeflow_tpu.utils.resilience import metrics as res_metrics
+
+    srv0, url0, _ = make_fake_replica("m", grpc=True)
+    srv1, url1, _ = make_fake_replica("m", grpc=True)
+    router = RouterServer()
+    router.fleet.poll_interval_s = 30.0  # placement stays table-driven
+    router.fleet.add("r0", url0, grpc=f"127.0.0.1:{srv0.grpc_port}")
+    router.fleet.add("r1", url1, grpc=f"127.0.0.1:{srv1.grpc_port}")
+    router.start_background()
+    gport = router.start_grpc()
+    client = InferenceClient(f"127.0.0.1:{gport}")
+    before = res_metrics.get("tpk_router_retry_total",
+                             reason="midstream") or 0
+    try:
+        # Prime: r0 (name tie-break) serves and is marked as having
+        # served on its channel.
+        assert client.server_ready()
+        # Kill r0's gRPC plane: the next RPC dies on a channel that WAS
+        # serving — the mid-RPC death class, retried on r1.
+        srv0._grpc.stop(grace=None)
+        assert client.server_ready()
+        after = res_metrics.get("tpk_router_retry_total",
+                                reason="midstream") or 0
+        assert after >= before + 1
+        assert router.router.stats_snapshot()["ok"] >= 2
+    finally:
+        client.close()
+        router.stop()
+        srv0.stop()
+        srv1.stop()
+
+
 def test_routerbench_quick_shape():
     from kubeflow_tpu.serve.loadgen import run_routerbench
 
