@@ -227,6 +227,43 @@ inline std::string ValidateSpec(const std::string& kind, const Json& spec) {
       if (batch >= 0 && accum >= 1 && batch % accum) {
         return "runtime.batch_size must be divisible by accum_steps";
       }
+      // grad_accum (canonical) vs accum_steps (legacy alias): both set
+      // and disagreeing would train a different global-batch split than
+      // one of the two knobs promises — refuse at submit, mirroring the
+      // Python Trainer.
+      int64_t gaccum = rt.get("grad_accum").as_int(0);
+      if (gaccum >= 1) {
+        if (batch >= 0 && batch % gaccum) {
+          return "runtime.batch_size must be divisible by grad_accum";
+        }
+        if (rt.has("accum_steps") && accum > 1 && accum != gaccum) {
+          return "runtime.grad_accum and accum_steps disagree — set one";
+        }
+      }
+      // FSDP master-state sharding: the shorthand fills mesh.fsdp, so a
+      // mesh that names a DIFFERENT fsdp degree is a contradiction the
+      // worker would refuse anyway — fail it at submit. param_dtype only
+      // configures the fsdp runtime's gathered compute copies.
+      int64_t fsdp = rt.get("fsdp").as_int(0);
+      if (fsdp >= 1) {
+        const Json& mesh_fsdp = rt.get("mesh").get("fsdp");
+        if (mesh_fsdp.is_number() && IsIntegralNumber(mesh_fsdp) &&
+            mesh_fsdp.as_int() != fsdp) {
+          return "runtime.fsdp conflicts with runtime.mesh.fsdp — set one";
+        }
+        const Json& pipe = rt.get("mesh").get("pipe");
+        if (pipe.is_number() && pipe.as_number() > 1) {
+          return "runtime.fsdp doesn't compose with pipeline "
+                 "parallelism (mesh.pipe > 1)";
+        }
+        if (rt.get("lora").is_object() && rt.get("lora").size() > 0) {
+          return "runtime.fsdp doesn't compose with lora (the "
+                 "adapter-only optimizer state is the memory win there)";
+        }
+      }
+      // (param_dtype without fsdp is refused by the worker's Trainer —
+      // admission's job here is typos/types, and the schema enum
+      // already pins the dtype spelling.)
       // runtime.lora contents (the schema types it as an object; the
       // knob semantics live here so a typo'd rank fails at submit,
       // mirroring the Python Trainer's validation).

@@ -79,7 +79,14 @@ int main() {
       }
       CHECK(!ValidateRuntime(One(field, 5)).empty());
     } else if (type == "string_or_null") {
-      CHECK(ValidateRuntime(One(field, "x")).empty());
+      if (entry.has("enum")) {
+        for (const auto& e : entry.get("enum").elements()) {
+          CHECK(ValidateRuntime(One(field, e.as_string())).empty());
+        }
+        CHECK(!ValidateRuntime(One(field, "no-such-enum-value")).empty());
+      } else {
+        CHECK(ValidateRuntime(One(field, "x")).empty());
+      }
       CHECK(ValidateRuntime(One(field, nullptr)).empty());
       CHECK(!ValidateRuntime(One(field, 5)).empty());
     } else if (type == "bool_or_string") {
@@ -110,6 +117,39 @@ int main() {
   CHECK(!ValidateRuntime(rt).empty());
   rt["accum_steps"] = 2;
   CHECK(ValidateRuntime(rt).empty());
+
+  // grad_accum (canonical) mirrors the divisibility rule and must not
+  // silently disagree with its legacy alias.
+  rt = Json::Object();
+  rt["batch_size"] = 8;
+  rt["grad_accum"] = 3;
+  CHECK(ValidateRuntime(rt).find("divisible by grad_accum") !=
+        std::string::npos);
+  rt["grad_accum"] = 4;
+  CHECK(ValidateRuntime(rt).empty());
+  rt["accum_steps"] = 2;
+  CHECK(ValidateRuntime(rt).find("disagree") != std::string::npos);
+  rt["accum_steps"] = 4;
+  CHECK(ValidateRuntime(rt).empty());
+
+  // FSDP knob contradictions fail at submit, not as a worker crash.
+  rt = Json::Object();
+  rt["fsdp"] = 4;
+  Json mesh = Json::Object();
+  mesh["fsdp"] = 2;
+  rt["mesh"] = mesh;
+  CHECK(ValidateRuntime(rt).find("conflicts with runtime.mesh.fsdp") !=
+        std::string::npos);
+  rt["mesh"]["fsdp"] = 4;
+  CHECK(ValidateRuntime(rt).empty());
+  rt["mesh"] = Json::Object();
+  rt["mesh"]["pipe"] = 2;
+  CHECK(ValidateRuntime(rt).find("pipeline") != std::string::npos);
+  rt["mesh"] = Json::Object();
+  Json lora = Json::Object();
+  lora["rank"] = 4;
+  rt["lora"] = lora;
+  CHECK(ValidateRuntime(rt).find("lora") != std::string::npos);
 
   printf("spec schema drift guard: %d fields enforced\n", checked);
 

@@ -1,0 +1,180 @@
+"""FSDP master-state runtime: ZeRO-style param/optimizer-state sharding.
+
+The sharding-rule engine (parallel/sharding.py) decides how params are laid
+out *for compute*; under the hybrid rules a Llama kernel's embed dim already
+shards over `fsdp`. What the rules do NOT guarantee is that the **training
+state** — fp32 master params and both Adam moments, 10+ bytes/param of pure
+storage — divides by the fsdp axis on *every* leaf: norm scales, bias-like
+vectors and any dim the rules replicate ride along replicated, and the
+compute copies stay in master precision. This module is the missing half
+(ROADMAP item 1 / PROFILE §4 "next unlock is optimizer-state sharding"):
+
+  * `master_spec` adds the `fsdp` mesh axis to the largest divisible
+    unsharded dim of every state leaf, so fp32 params + Adam moments are
+    born sharded 1/fsdp (on top of whatever tensor/expert sharding the
+    rules already give them) — the ZeRO-3 storage layout, expressed as
+    NamedShardings instead of a parameter-flattening runtime.
+  * `FSDP.gather_params` runs INSIDE the jitted step: cast the master
+    shard to the compute dtype (bf16 halves every all-gather byte), then
+    `with_sharding_constraint` to the rules-derived compute layout. XLA
+    emits the all-gathers and overlaps them with compute, and the
+    backward of the same pair is a reduce(-scatter) of grads straight
+    into the fp32 master layout — gather-for-compute and
+    grad-reduce-for-update are one differentiable function, not runtime
+    hooks.
+  * Checkpoints stay **topology-portable** for free: orbax saves logical
+    arrays, and restore targets whatever shardings the *current* mesh
+    derives — save on N-way fsdp, restore on M-way (tests pin kill-9
+    resume across topologies bit-identically).
+
+`compute_dtype=None` is the exact escape hatch: the gather is a pure
+layout constraint, numerics identical to the unsharded trainer (the
+CPU-mesh equivalence tests pin fsdp=4 against replicated fsdp=1).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+#: The mesh axis master state shards over (parallel/mesh.py vocabulary).
+AXIS = "fsdp"
+
+#: Spec-knob spelling -> dtype. `param_dtype` on the JAXJob runtime picks
+#: the COMPUTE dtype of the gathered copies; the master stays fp32.
+COMPUTE_DTYPES = {
+    "float32": jnp.float32,
+    "bfloat16": jnp.bfloat16,
+}
+
+
+def parse_compute_dtype(name: str | None) -> Any:
+    """spec.param_dtype -> jnp dtype (None = keep master dtype, exact)."""
+    if name is None:
+        return None
+    try:
+        return COMPUTE_DTYPES[name]
+    except KeyError:
+        raise ValueError(
+            f"param_dtype {name!r}: one of {sorted(COMPUTE_DTYPES)}"
+        ) from None
+
+
+def master_spec(spec: P, shape: tuple[int, ...], axis_size: int,
+                axis: str = AXIS) -> P:
+    """Add `axis` to the largest divisible unsharded dim of `spec`.
+
+    Identity when the rules already put `axis` somewhere on the leaf (the
+    hybrid rules shard embed dims over fsdp — double-sharding would be a
+    shape error) or when no dim divides (small odd leaves stay replicated;
+    they are noise in the byte budget)."""
+    entries: list[Any] = list(spec) + [None] * (len(shape) - len(spec))
+    for e in entries:
+        if e == axis or (isinstance(e, tuple) and axis in e):
+            return spec
+    best = -1
+    for i, (e, n) in enumerate(zip(entries, shape)):
+        if e is None and n >= axis_size and n % axis_size == 0:
+            if best < 0 or n > shape[best]:
+                best = i
+    if best < 0:
+        return spec
+    entries[best] = axis
+    while entries and entries[-1] is None:
+        entries.pop()
+    return P(*entries)
+
+
+def tree_bytes_per_device(tree: Any) -> int:
+    """Per-device bytes of a tree of sharded arrays (or ShapeDtypeStructs
+    with shardings — the AOT scale-proof path uses the same accounting).
+    Pure metadata: no device sync, safe to call from the trainer."""
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        shape = getattr(leaf, "shape", None)
+        if shape is None:
+            continue
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is not None and hasattr(sharding, "shard_shape"):
+            shape = sharding.shard_shape(tuple(shape))
+        total += math.prod(shape) * jnp.dtype(leaf.dtype).itemsize
+    return total
+
+
+@dataclasses.dataclass
+class FSDP:
+    """The sharded-training-runtime plan, threaded through
+    `abstract_train_state` / `init_train_state` / `make_train_step`.
+
+    `prepare()` is called by abstract_train_state once the rules-derived
+    compute shardings exist; init and the step factory then share one
+    consistent (master layout, compute layout) pair."""
+
+    mesh: Mesh
+    compute_dtype: Any = None  # None = master dtype (exact escape hatch)
+    axis: str = AXIS
+    # Filled by prepare() (train/step.abstract_train_state):
+    compute_param_shardings: Any = None
+    master_param_shardings: Any = None
+
+    @property
+    def axis_size(self) -> int:
+        return self.mesh.shape[self.axis]
+
+    def master_state_shardings(self, abstract_state: Any,
+                               shardings: Any) -> Any:
+        """Rewrite the rules-derived TrainState shardings so every array
+        leaf (params AND opt-state moments; scalars like step/count stay
+        replicated) carries the fsdp axis."""
+        def one(a, s):
+            shape = tuple(getattr(a, "shape", ()))
+            if not shape:
+                return s
+            return NamedSharding(
+                self.mesh, master_spec(s.spec, shape, self.axis_size,
+                                       self.axis))
+        return jax.tree.map(one, abstract_state, shardings)
+
+    def prepare(self, abstract_params: Any, param_shardings: Any) -> None:
+        """Record the (compute, master) param layout pair. Runs inside
+        abstract_train_state so init and the train step can't diverge."""
+        self.compute_param_shardings = param_shardings
+        self.master_param_shardings = self.master_state_shardings(
+            abstract_params, param_shardings)
+
+    def _require_prepared(self) -> None:
+        if self.compute_param_shardings is None:
+            raise ValueError(
+                "FSDP plan not prepared — initialize the train state "
+                "first (init_train_state/abstract_train_state with "
+                "fsdp=plan) so the step shares init's layout")
+
+    def gather_params(self, master: Any) -> Any:
+        """Inside jit: master fp32 shards -> compute-dtype copies in the
+        rules-derived compute layout. The cast runs BEFORE the layout
+        constraint so the all-gather moves compute-dtype (half the bytes
+        at bf16); XLA overlaps the gathers with compute and derives the
+        backward reduce into the master layout from the same pair."""
+        self._require_prepared()
+        dt = self.compute_dtype
+
+        def one(p, s):
+            q = (p.astype(dt)
+                 if dt is not None and jnp.issubdtype(p.dtype, jnp.floating)
+                 else p)
+            return jax.lax.with_sharding_constraint(q, s)
+        return jax.tree.map(one, master, self.compute_param_shardings)
+
+    def constrain_master_grads(self, grads: Any) -> Any:
+        """Pin grads (already master-dtype via the gather's backward) to
+        the master layout, so the accumulation carry and the optimizer
+        update run sharded — never materializing a replicated fp32
+        grad tree."""
+        self._require_prepared()
+        return jax.tree.map(jax.lax.with_sharding_constraint, grads,
+                            self.master_param_shardings)

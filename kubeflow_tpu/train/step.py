@@ -123,6 +123,7 @@ def abstract_train_state(
     rules: Rules = DEFAULT_RULES,
     example_kwargs: dict | None = None,
     trainable: str | None = None,
+    fsdp=None,
 ):
     """(init_fn, abstract_state, shardings): the sharding-layout derivation
     shared by real initialization (init_train_state) and AOT scale proofs
@@ -130,7 +131,12 @@ def abstract_train_state(
     through the rules to NamedShardings. `abstract_state` is unboxed
     ShapeDtypeStructs; `shardings` is the matching NamedSharding tree.
     Callers must be inside `with mesh, nn.logical_axis_rules(rules)` when
-    tracing `init_fn`."""
+    tracing `init_fn`.
+
+    `fsdp` (a parallel/fsdp.FSDP plan) rewrites the STATE shardings to the
+    ZeRO-style master layout — every param/moment leaf gains the fsdp
+    axis — and records the (compute, master) layout pair on the plan for
+    make_train_step's gather-for-compute."""
     example_kwargs = example_kwargs or {}
 
     def _init(rng):
@@ -151,7 +157,16 @@ def abstract_train_state(
         abstract = jax.eval_shape(_init, jax.random.key(0))
         logical_specs = nn.get_partition_spec(abstract)
         shardings = nn.logical_to_mesh_sharding(logical_specs, mesh, rules)
-    return _init, nn.meta.unbox(abstract), shardings
+    abstract = nn.meta.unbox(abstract)
+    if fsdp is not None:
+        if trainable == "lora":
+            raise ValueError(
+                "fsdp master sharding doesn't compose with trainable="
+                "'lora' (the adapter-only optimizer state is the memory "
+                "win there)")
+        fsdp.prepare(abstract.params, shardings.params)
+        shardings = fsdp.master_state_shardings(abstract, shardings)
+    return _init, abstract, shardings
 
 
 def init_train_state(
@@ -163,6 +178,7 @@ def init_train_state(
     rules: Rules = DEFAULT_RULES,
     example_kwargs: dict | None = None,
     trainable: str | None = None,
+    fsdp=None,
 ) -> TrainState:
     """Initialize params already laid out per the sharding rules: we eval_shape
     the init, derive NamedShardings from logical metadata, then run the real
@@ -171,13 +187,27 @@ def init_train_state(
 
     `example_kwargs` rides into model.init for impls whose trace needs the
     full call contract (e.g. zigzag attention requires explicit positions).
-    `trainable="lora"` restricts the optimizer state to adapter leaves."""
+    `trainable="lora"` restricts the optimizer state to adapter leaves.
+    `fsdp` (parallel/fsdp.FSDP) births the state in the ZeRO-style master
+    layout — fp32 params + Adam moments sharded over the fsdp axis."""
     _init, _, shardings = abstract_train_state(
-        model, tx, example_inputs, mesh, rules, example_kwargs, trainable)
-    with mesh, nn.logical_axis_rules(rules):
-        state = jax.jit(_init, out_shardings=shardings)(rng)
-        # Unbox flax logical-partitioning metadata for downstream use.
-        return nn.meta.unbox(state)
+        model, tx, example_inputs, mesh, rules, example_kwargs, trainable,
+        fsdp=fsdp)
+    # Partitionable threefry for the init trace: the legacy generator's
+    # bits depend on how XLA partitions the RNG op, so born-sharded
+    # params would differ BY LAYOUT — fsdp=K could never equal fsdp=1,
+    # and a topology change would be a silent reseed. Value-semantics
+    # threefry makes init a function of (key, shape) alone; restored to
+    # the ambient setting right after (serving RNG is untouched).
+    old_threefry = jax.config.jax_threefry_partitionable
+    jax.config.update("jax_threefry_partitionable", True)
+    try:
+        with mesh, nn.logical_axis_rules(rules):
+            state = jax.jit(_init, out_shardings=shardings)(rng)
+            # Unbox flax logical-partitioning metadata for downstream use.
+            return nn.meta.unbox(state)
+    finally:
+        jax.config.update("jax_threefry_partitionable", old_threefry)
 
 
 def make_train_step(
@@ -191,6 +221,7 @@ def make_train_step(
     pipeline: dict | None = None,
     accum_steps: int = 1,
     trainable: str | None = None,
+    fsdp=None,
 ) -> Callable[[TrainState, dict], tuple[TrainState, dict]]:
     """Build the jitted train step for a causal-LM-style batch:
       batch = {"inputs": [B,S] int32, "targets": [B,S] int32,
@@ -210,7 +241,16 @@ def make_train_step(
     accum_steps > 1 scans the loss+grad over accum_steps row-slices of the
     batch, averaging grads before the (single) optimizer update — identical
     optimizer math to the full batch at 1/accum_steps the activation
-    memory (the reference SDK's gradient_accumulation_steps)."""
+    memory (the reference SDK's gradient_accumulation_steps). The
+    accumulator carries the master dtype (fp32) and the scan adds in
+    microbatch order — deterministic, so K x (B/K) reproduces 1 x B.
+
+    fsdp (a prepared parallel/fsdp.FSDP plan): the state holds fp32
+    master shards; each (micro)batch's forward starts from
+    fsdp.gather_params — cast to the compute dtype, then all-gather into
+    the rules-derived compute layout, both inside the jitted step so XLA
+    overlaps the gathers with compute — and grads flow back through the
+    same pair as master-layout fp32 reduce(-scatter)s."""
     model_kwargs = model_kwargs or {}
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
@@ -330,6 +370,21 @@ def make_train_step(
         raise ValueError(
             "LoRA doesn't compose with pipeline parallelism (the stage "
             "forward has no adapter path)")
+    if fsdp is not None:
+        if pipeline is not None:
+            raise ValueError(
+                "fsdp master sharding doesn't compose with pipeline "
+                "parallelism (stage params keep the scanned pipe layout)")
+        if trainable == "lora":
+            raise ValueError(
+                "fsdp master sharding doesn't compose with trainable="
+                "'lora' (the adapter-only optimizer state is the memory "
+                "win there)")
+        fsdp._require_prepared()
+        inner_loss_fn = loss_impl_fn
+
+        def loss_impl_fn(master, b):  # noqa: F811 — deliberate rebind
+            return inner_loss_fn(fsdp.gather_params(master), b)
 
     def loss_and_grads(loss_fn, target, batch):
         """(loss, aux, grads) w.r.t. `target`, with the gradient-
@@ -352,6 +407,12 @@ def make_train_step(
                 mb = jax.tree.map(constrain_batch, mb)
                 (mloss, maux), mgrads = jax.value_and_grad(
                     loss_fn, has_aux=True)(target, mb)
+                if fsdp is not None:
+                    # Keep every partial grad — and therefore the fp32
+                    # accumulator carry — in the sharded master layout;
+                    # a replicated grad tree would undo the state's
+                    # memory win for the duration of the scan.
+                    mgrads = fsdp.constrain_master_grads(mgrads)
                 gsum, lsum, asum = carry
                 return (jax.tree.map(jnp.add, gsum, mgrads), lsum + mloss,
                         asum + maux), None
@@ -365,6 +426,8 @@ def make_train_step(
         batch = jax.tree.map(constrain_batch, batch)
         (loss, aux), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(target, batch)
+        if fsdp is not None:
+            grads = fsdp.constrain_master_grads(grads)
         return loss, aux, grads
 
     def lora_step(state: TrainState, batch: dict):

@@ -65,6 +65,22 @@ class TrainJobSpec:
     # inside the jitted step, averaging grads — same optimizer math at
     # 1/accum_steps the activation memory.
     accum_steps: int = 1
+    # Canonical name for the same knob (the reference SDK's
+    # gradient_accumulation_steps): 0 defers to accum_steps; setting both
+    # to different values is refused. fp32 accumulator, ordered adds —
+    # grad_accum=K on batch B reproduces K=1 on batch B (test-pinned).
+    grad_accum: int = 0
+    # FSDP master-state sharding (parallel/fsdp.py): 0 = off (today's
+    # rules-only layout); N >= 1 shards fp32 master params + both Adam
+    # moments N-way over the `fsdp` mesh axis on every state leaf,
+    # filling mesh.fsdp = N when the mesh doesn't set it. Checkpoints
+    # stay topology-portable: save on N-way, restore on M-way.
+    fsdp: int = 0
+    # Compute dtype of the gathered per-use param copies when fsdp >= 1:
+    # null keeps the master dtype (bit-exact escape hatch); "bfloat16"
+    # halves all-gather bytes and compute-copy memory. The master state
+    # and the grad accumulator stay fp32 either way.
+    param_dtype: str | None = None
     seed: int = 0
     # False | True/"ring" (contiguous ring CP) | "ring_flash" (fused Pallas
     # inner block) | "zigzag"/"zigzag_flash" (balanced causal schedule: the
@@ -162,6 +178,24 @@ class Trainer:
             model_kwargs["attention_impl"] = spec.ring_attention
         mesh_fields = dict(spec.mesh)
         mesh_fields.setdefault("num_slices", self.penv.num_slices)
+        if spec.fsdp < 0:
+            raise ValueError(f"fsdp must be >= 0, got {spec.fsdp}")
+        if spec.fsdp:
+            declared = mesh_fields.get("fsdp")
+            if declared not in (None, spec.fsdp):
+                raise ValueError(
+                    f"spec.fsdp={spec.fsdp} conflicts with "
+                    f"mesh.fsdp={declared} — set one (fsdp is the "
+                    "shorthand that fills the mesh axis)")
+            mesh_fields["fsdp"] = spec.fsdp
+        from kubeflow_tpu.parallel.fsdp import parse_compute_dtype
+
+        if spec.param_dtype is not None and not spec.fsdp:
+            raise ValueError(
+                "param_dtype configures the fsdp runtime's gathered "
+                "compute copies — set fsdp >= 1 (fsdp=1 is the "
+                "single-shard escape hatch)")
+        self._fsdp_dtype = parse_compute_dtype(spec.param_dtype)
         self.mesh = build_mesh(MeshConfig(**mesh_fields))
         strategy = spec.strategy
         if self.mesh.shape["pipe"] > 1:
@@ -283,10 +317,32 @@ class Trainer:
         if spec.accum_steps < 1:
             raise ValueError(f"accum_steps must be >= 1, got "
                              f"{spec.accum_steps}")
-        if spec.batch_size % spec.accum_steps:
+        if spec.grad_accum < 0:
+            raise ValueError(f"grad_accum must be >= 0, got "
+                             f"{spec.grad_accum}")
+        if (spec.grad_accum and spec.accum_steps > 1
+                and spec.grad_accum != spec.accum_steps):
+            raise ValueError(
+                f"grad_accum={spec.grad_accum} and its legacy alias "
+                f"accum_steps={spec.accum_steps} disagree — set one")
+        # The effective microbatch count (grad_accum is canonical,
+        # accum_steps the legacy alias).
+        self.grad_accum = spec.grad_accum or spec.accum_steps
+        if spec.batch_size % self.grad_accum:
             raise ValueError(
                 f"batch_size {spec.batch_size} not divisible by "
-                f"accum_steps {spec.accum_steps}")
+                f"grad_accum/accum_steps {self.grad_accum}")
+        if spec.fsdp:
+            if self._pipeline is not None:
+                raise ValueError(
+                    "fsdp master sharding doesn't compose with pipeline "
+                    "parallelism (stage params keep the scanned pipe "
+                    "layout)")
+            if self._trainable == "lora":
+                raise ValueError(
+                    "fsdp master sharding doesn't compose with LoRA "
+                    "(the adapter-only optimizer state is the memory "
+                    "win there)")
         if spec.eval_every < 0 or spec.eval_batches < 1:
             raise ValueError("eval_every must be >= 0 and eval_batches "
                              ">= 1")
@@ -577,10 +633,17 @@ class Trainer:
                 (spec.batch_size, spec.seq_len))
             init_kwargs = model_kwargs  # zigzag's init needs positions too
 
+        fsdp_plan = None
+        if spec.fsdp:
+            from kubeflow_tpu.parallel.fsdp import FSDP
+
+            fsdp_plan = FSDP(self.mesh, compute_dtype=self._fsdp_dtype)
+
         state = init_train_state(
             self.model, self.tx, jax.random.key(spec.seed),
             self._example_inputs(), self.mesh, self.rules,
-            example_kwargs=init_kwargs, trainable=self._trainable)
+            example_kwargs=init_kwargs, trainable=self._trainable,
+            fsdp=fsdp_plan)
 
         start_step = 0
         if self._ckpt is not None:
@@ -598,14 +661,34 @@ class Trainer:
                 start_step = int(latest)
                 self.logger.log(start_step, {"event": "restored"})
 
+        # State-layout accounting (pure sharding metadata — no device
+        # sync): how many bytes of params/optimizer state each chip
+        # actually holds, the number the fsdp knob exists to divide.
+        from kubeflow_tpu.parallel.fsdp import tree_bytes_per_device
+
+        param_bytes = tree_bytes_per_device(state.params)
+        opt_bytes = tree_bytes_per_device(state.opt_state)
+        resilience.metrics.set_gauge("tpk_train_param_bytes_per_chip",
+                                     param_bytes, component="train")
+        resilience.metrics.set_gauge("tpk_train_opt_state_bytes_per_chip",
+                                     opt_bytes, component="train")
+        resilience.metrics.set_gauge("tpk_train_grad_accum_steps",
+                                     self.grad_accum, component="train")
+        self.logger.log(start_step, {
+            "event": "state_sharding", "fsdp": spec.fsdp,
+            "param_bytes_per_chip": param_bytes,
+            "opt_state_bytes_per_chip": opt_bytes,
+            "grad_accum_steps": self.grad_accum})
+
         step_fn = make_train_step(self.model, self.mesh, self.rules,
                                   loss_fn=self._loss_fn(),
                                   model_kwargs=model_kwargs,
                                   loss_impl=spec.loss_impl,
                                   loss_chunk=spec.loss_chunk,
                                   pipeline=self._pipeline,
-                                  accum_steps=spec.accum_steps,
-                                  trainable=self._trainable)
+                                  accum_steps=self.grad_accum,
+                                  trainable=self._trainable,
+                                  fsdp=fsdp_plan)
 
         eval_step = None
         if spec.eval_every:

@@ -46,6 +46,7 @@ GIB = 1024**3
 CASES = (
     "train_8b_v5p8",
     "train_8b_v5p8_long",
+    "train_8b_v5p8_fsdp",
     "train_8b_v5p32_2slice",
     "serve_8b_tp8",
 )
@@ -53,6 +54,7 @@ CASES = (
 _CASE_DEVICES = {
     "train_8b_v5p8": 8,
     "train_8b_v5p8_long": 8,
+    "train_8b_v5p8_fsdp": 8,
     "train_8b_v5p32_2slice": 32,
     "serve_8b_tp8": 8,
 }
@@ -68,6 +70,10 @@ def _mem_report(compiled, *, hbm_bytes: int = V5P_HBM_BYTES,
     temp = int(ma.temp_size_in_bytes)
     out = int(ma.output_size_in_bytes)
     alias = int(ma.alias_size_in_bytes)
+    # Newer jaxlibs dropped peak_memory_in_bytes from CompiledMemoryStats;
+    # args+temp is the same conservative stand-in the total already uses
+    # (peak <= live arguments + live temps at the worst program point).
+    peak = int(getattr(ma, "peak_memory_in_bytes", 0)) or (args + temp)
     # Conservative per-device live set: arguments + temps + outputs with no
     # donation credit (alias_size already subtracts what XLA aliased; the
     # CPU backend typically reports 0, so this double-counts donated state
@@ -78,7 +84,7 @@ def _mem_report(compiled, *, hbm_bytes: int = V5P_HBM_BYTES,
         "temp_bytes": temp,
         "output_bytes": out,
         "alias_bytes": alias,
-        "peak_memory_bytes": int(ma.peak_memory_in_bytes),
+        "peak_memory_bytes": peak,
         "total_conservative_bytes": total,
         "total_conservative_gib": round(total / GIB, 2),
         f"fits_{chip}_hbm": total <= hbm_bytes,
@@ -86,7 +92,10 @@ def _mem_report(compiled, *, hbm_bytes: int = V5P_HBM_BYTES,
     }
 
 
-def _train_case(mesh_cfg_kwargs: dict, batch: int, seq: int) -> dict:
+def _train_case(mesh_cfg_kwargs: dict, batch: int, seq: int, *,
+                fsdp_runtime: bool = False,
+                param_dtype: str | None = None,
+                grad_accum: int = 1) -> dict:
     import dataclasses
 
     import jax
@@ -95,6 +104,8 @@ def _train_case(mesh_cfg_kwargs: dict, batch: int, seq: int) -> dict:
     import optax
 
     from kubeflow_tpu.models.llama import Llama, llama3_8b
+    from kubeflow_tpu.parallel.fsdp import FSDP, parse_compute_dtype, \
+        tree_bytes_per_device
     from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
     from kubeflow_tpu.parallel.sharding import DEFAULT_RULES
     from kubeflow_tpu.train.step import abstract_train_state, make_train_step
@@ -111,10 +122,17 @@ def _train_case(mesh_cfg_kwargs: dict, batch: int, seq: int) -> dict:
 
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    # The fsdp master-state runtime (parallel/fsdp.py), exactly as the
+    # trainer would launch it: every fp32-param/Adam-moment leaf carries
+    # the fsdp axis, gathers for compute happen inside the step.
+    plan = None
+    if fsdp_runtime:
+        plan = FSDP(mesh, compute_dtype=parse_compute_dtype(param_dtype))
+
     # The SAME layout derivation the trainer uses (train/step.py) — the
     # proof must measure the production layout, not a reimplementation.
     _, abstract, shardings = abstract_train_state(
-        model, tx, (jnp.zeros((1, 8), jnp.int32),), mesh, rules)
+        model, tx, (jnp.zeros((1, 8), jnp.int32),), mesh, rules, fsdp=plan)
     state_args = jax.tree.map(
         lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
         abstract, shardings)
@@ -129,7 +147,8 @@ def _train_case(mesh_cfg_kwargs: dict, batch: int, seq: int) -> dict:
         }
 
         step = make_train_step(model, mesh, rules, loss_impl="chunked",
-                               loss_chunk=2048)
+                               loss_chunk=2048, fsdp=plan,
+                               accum_steps=grad_accum)
         lowered = step.jitted.lower(state_args, batch_args)
     compiled = lowered.compile()
 
@@ -149,7 +168,25 @@ def _train_case(mesh_cfg_kwargs: dict, batch: int, seq: int) -> dict:
         # sharded over every mesh axis the param rules use.
         "analytic_state_gib": round(
             n_params * (4 + 2 + 4) / mesh.devices.size / GIB, 2),
+        # State-layout accounting from the ACTUAL shardings (the same
+        # arithmetic the trainer's tpk_train_*_bytes_per_chip gauges
+        # report): what one chip holds of params / optimizer state.
+        "param_bytes_per_chip": tree_bytes_per_device(state_args.params),
+        "opt_state_bytes_per_chip": tree_bytes_per_device(
+            state_args.opt_state),
     })
+    if fsdp_runtime:
+        report.update({
+            "fsdp_runtime": True,
+            "param_dtype": param_dtype or "master",
+            "grad_accum": grad_accum,
+            # What pure-DP replication would pin on EVERY chip (fp32
+            # params + bf16 mu + fp32 nu) — the number the fsdp axis
+            # divides; the measured per-chip fields above are the
+            # divided reality.
+            "analytic_state_replicated_gib": round(
+                n_params * (4 + 2 + 4) / GIB, 2),
+        })
     return report
 
 
@@ -159,6 +196,18 @@ def _case_train_8b_v5p8() -> dict:
 
 def _case_train_8b_v5p8_long() -> dict:
     return _train_case(dict(data=1, fsdp=4, tensor=2), batch=8, seq=8192)
+
+
+def _case_train_8b_v5p8_fsdp() -> dict:
+    """ISSUE 15 tentpole row: the same v5p-8 bench point as
+    train_8b_v5p8, but through the fsdp master-state runtime — fp32
+    params + Adam moments sharded over fsdp on EVERY leaf, bf16 gathered
+    compute copies, grad_accum=2 decoupling global batch from per-chip
+    activation memory. The delta against train_8b_v5p8 is the
+    optimizer-state unlock PROFILE §4 names."""
+    return _train_case(dict(data=1, fsdp=4, tensor=2), batch=8, seq=4096,
+                       fsdp_runtime=True, param_dtype="bfloat16",
+                       grad_accum=2)
 
 
 def _case_train_8b_v5p32_2slice() -> dict:
@@ -296,9 +345,17 @@ def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--out", default="SCALEPROOF.json")
     parser.add_argument("--cases", nargs="*", default=list(CASES))
+    parser.add_argument(
+        "--merge", action="store_true",
+        help="update only --cases inside an existing --out document "
+             "(other rows kept verbatim; all_fit recomputed over the "
+             "union) instead of rewriting it with just this run")
     args = parser.parse_args(argv)
 
     results, ok = {}, True
+    if args.merge and os.path.exists(args.out):
+        with open(args.out) as fh:
+            results = dict(json.load(fh).get("cases", {}))
     for name in args.cases:
         print(f"[scaleproof] compiling {name} "
               f"({_CASE_DEVICES[name]} virtual devices)...",
@@ -313,6 +370,10 @@ def main(argv: list[str] | None = None) -> int:
             results[name] = {"error": str(e)}
             ok = False
             print(f"[scaleproof] {name}: ERROR {e}", file=sys.stderr)
+    # all_fit covers the whole document — including rows a --merge run
+    # kept verbatim — so a merge can never launder a failing row.
+    ok = ok and all("error" not in r and bool(r.get("fits_v5p_hbm"))
+                    for r in results.values())
     payload = {
         "contract": "Llama-3-8B fine-tune via JAXJob on v5p (BASELINE.json)",
         "method": "AOT jit().lower().compile() + memory_analysis() on "

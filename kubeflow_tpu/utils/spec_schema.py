@@ -69,6 +69,15 @@ KNOBS: dict[str, dict] = {
     "lr_final": {"type": "number", "min": 0},
     "max_grad_norm": {"type": "number", "min": 0},
     "accum_steps": {"type": "int", "min": 1},
+    # Canonical gradient-accumulation knob (0 defers to the legacy
+    # accum_steps alias; both set and disagreeing is refused).
+    "grad_accum": {"type": "int", "min": 0},
+    # FSDP master-state sharding degree over the `fsdp` mesh axis
+    # (parallel/fsdp.py); 0 = off, N fills mesh.fsdp = N.
+    "fsdp": {"type": "int", "min": 0},
+    # Compute dtype of the fsdp runtime's gathered param copies.
+    "param_dtype": {"type": "string_or_null",
+                    "enum": ["float32", "bfloat16"]},
     "seed": {"type": "int", "min": 0},
     "ring_attention": {"type": "bool_or_string"},
     "loss_impl": {"type": "string", "enum": ["full", "chunked"]},

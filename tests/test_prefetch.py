@@ -238,14 +238,17 @@ def test_trainer_prefetch_spec_validation(devices8):
                              strategy="dp", mesh={"data": 8}, prefetch=-1))
 
 
-def test_hot_loop_host_sync_guard(monkeypatch, devices8):
+@pytest.mark.parametrize("grad_accum", [1, 2])
+def test_hot_loop_host_sync_guard(monkeypatch, devices8, grad_accum):
     """The training analog of test_decode_pipeline.py's dispatch-count
     guard: between logging boundaries the hot loop must issue ZERO host
     fetches (no float() on device arrays, no block_until_ready) — that
     is the whole point of overlapping host data prep with device
     compute. 6 steps at log_every=3 = exactly 2 boundaries; each
     boundary is 1 block_until_ready + 3 scalar fetches (loss, grad_norm,
-    the aux_loss probe). Any mid-window fetch breaks the budget."""
+    the aux_loss probe). Any mid-window fetch breaks the budget —
+    including at grad_accum>1, where the microbatch loop lives INSIDE
+    the jitted step (ISSUE 15: accumulation adds zero host syncs)."""
     from jax._src.array import ArrayImpl
 
     from kubeflow_tpu.train.trainer import TrainJobSpec, Trainer
@@ -263,7 +266,7 @@ def test_hot_loop_host_sync_guard(monkeypatch, devices8):
     spec = TrainJobSpec(model="mnist_mlp", dataset="mnist_like",
                         strategy="dp", mesh={"data": 8}, steps=6,
                         batch_size=16, learning_rate=1e-2, log_every=3,
-                        prefetch=2)
+                        prefetch=2, grad_accum=grad_accum)
     result = Trainer(spec).run()
     assert result["final_step"] == 6
     boundaries = 2

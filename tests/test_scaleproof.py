@@ -26,6 +26,25 @@ def test_train_8b_fits_v5p(devices8, case):
     assert r["argument_bytes"] > r["analytic_state_gib"] * 0.9 * 1024**3
 
 
+def test_train_8b_fsdp_row(devices8):
+    """ISSUE 15 row: the fsdp master-state runtime at the v5p-8 bench
+    point — fits, and the Adam-state/master-param terms divide by the
+    mesh (fsdp x tensor), leaf-exactly, from the REAL shardings."""
+    r = scaleproof.run_case("train_8b_v5p8_fsdp")
+    assert r["fits_v5p_hbm"], r
+    assert r["fsdp_runtime"] and r["param_dtype"] == "bfloat16"
+    assert r["grad_accum"] == 2
+    n, dev = r["num_params"], r["num_devices"]
+    # adamw(mu=bf16): fp32 nu + bf16 mu = 6 bytes/param, sharded.
+    expect_opt = n * 6 / dev
+    assert abs(r["opt_state_bytes_per_chip"] - expect_opt) < 0.02 * expect_opt
+    # fp32 master params: 4 bytes/param, sharded.
+    expect_p = n * 4 / dev
+    assert abs(r["param_bytes_per_chip"] - expect_p) < 0.02 * expect_p
+    # What replication would hold per chip instead (the ZeRO story).
+    assert r["analytic_state_replicated_gib"] > 70
+
+
 def test_serve_8b_tp8_fits(devices8):
     r = scaleproof.run_case("serve_8b_tp8")
     assert r["fits_v5p_hbm"], r

@@ -1,0 +1,38 @@
+"""Pins the sharded-training benchmark harness
+(kubeflow_tpu/train/fsdpbench.py): the quick shape must produce every
+artifact section with sane values, so the chip run
+(`bench.py --train-fsdp` → TRAINBENCH.json) can't silently rot.
+Follows the test_servebench pattern."""
+
+import numpy as np
+import pytest
+
+from kubeflow_tpu.train.fsdpbench import run_trainbench
+
+pytestmark = pytest.mark.slow  # multi-process/e2e/AOT tier
+
+
+def test_trainbench_quick_shape(devices8):
+    r = run_trainbench(quick=True)
+    assert r["shard_degree"] == 4
+    # Every arm measured, with the state-bytes accounting populated.
+    for arm in ("replicated", "fsdp_master", "fsdp_grad_accum2",
+                "fsdp_bf16_compute"):
+        a = r[arm]
+        assert a["ms_per_step"] > 0
+        assert np.isfinite(a["final_loss"])
+        assert a["param_bytes_per_chip"] > 0
+        assert a["opt_state_bytes_per_chip"] > 0
+        assert len(a["losses"]) == r["timed_steps"] + 2
+    # The layout claims: fsdp divides replicated bytes by the degree...
+    assert r["memory"]["opt_state_ratio_replicated_over_fsdp"] >= 3.9
+    assert r["memory"]["param_ratio_replicated_over_fsdp"] >= 3.9
+    # ...and the master state is identical across fsdp arms (bf16 only
+    # changes the gathered compute copies).
+    assert (r["fsdp_bf16_compute"]["param_bytes_per_chip"]
+            == r["fsdp_master"]["param_bytes_per_chip"])
+    # The equivalence claims, at the tolerances the runtime promises.
+    eq = r["equivalence"]
+    assert eq["fsdp_vs_replicated_max_rel_delta"] < 1e-5
+    assert eq["grad_accum2_vs_1_max_rel_delta"] < 1e-5
+    assert eq["bf16_vs_fp32_max_rel_delta"] < 5e-2  # bf16 rounding, bounded

@@ -22,10 +22,17 @@ def _base(tmp_path, **over):
 
 
 def test_accum_steps_matches_full_batch(tmp_path, devices8):
-    """accum_steps=2 is the same optimizer math as the full batch."""
-    full = Trainer(_base(tmp_path, steps=5)).run()
-    accum = Trainer(_base(tmp_path, steps=5, accum_steps=2)).run()
-    np.testing.assert_allclose(accum["loss"], full["loss"], rtol=2e-4)
+    """accum_steps=2 is the same optimizer math as the full batch. Pinned
+    at fp32 compute where the only residual is reduction order (~1e-7);
+    the default bf16 compute adds microbatch-shape rounding noise that
+    would force a tolerance too loose to mean anything."""
+    kw = dict(model_kwargs={"dtype": "float32"})
+    full = Trainer(_base(tmp_path, steps=5, **kw)).run()
+    accum = Trainer(_base(tmp_path, steps=5, accum_steps=2, **kw)).run()
+    np.testing.assert_allclose(accum["loss"], full["loss"], rtol=1e-5)
+    # grad_accum is the canonical spelling of the same knob.
+    alias = Trainer(_base(tmp_path, steps=5, grad_accum=2, **kw)).run()
+    assert alias["loss"] == accum["loss"]
 
 
 def test_accum_divisibility_rejected(tmp_path):
