@@ -468,6 +468,34 @@ def main_chaosbench() -> None:
     }))
 
 
+def main_trainchaos() -> None:
+    """`python bench.py --trainchaos`: train-plane chaos harness →
+    TRAINCHAOS.json + one JSON line (kubeflow_tpu/train/trainchaos.py).
+
+    REAL trainer workers launched by the REAL tpk-controlplane binary
+    under a seeded SIGKILL/SIGSTOP schedule: fault-free control vs
+    unattended elastic 4 -> 2 resize vs restart-from-scratch, goodput
+    (useful steps/wall-second) per arm, plus the mechanism claims —
+    resize event chain observed, zero lost acked checkpoints."""
+    from kubeflow_tpu.controlplane.client import find_binary
+    from kubeflow_tpu.train.trainchaos import run_trainchaos
+
+    find_binary()  # fail fast with the build hint, not mid-bench
+    result = run_trainchaos(quick="--quick" in sys.argv)
+    with open("TRAINCHAOS.json", "w") as fh:
+        json.dump(result, fh, indent=1)
+    claims = result["claims"]
+    print(json.dumps({
+        "metric": "trainchaos_goodput_elastic_over_restart",
+        "value": claims["goodput_elastic_over_restart"],
+        "unit": "x_restart_from_scratch_goodput",
+        "zero_lost_acked_checkpoints":
+            claims["zero_lost_acked_checkpoints"],
+        "resize_event_observed": claims["resize_event_observed"],
+        "detail": "TRAINCHAOS.json",
+    }))
+
+
 def main_trainfsdp() -> None:
     """`python bench.py --train-fsdp`: sharded-training A/B →
     TRAINBENCH.json + one JSON line (kubeflow_tpu/train/fsdpbench.py).
@@ -696,6 +724,8 @@ if __name__ == "__main__":
         main_disaggbench()
     elif "--chaosbench" in sys.argv:
         main_chaosbench()
+    elif "--trainchaos" in sys.argv:
+        main_trainchaos()
     elif "--serve" in sys.argv:
         main_serve()
     elif "--train-fsdp" in sys.argv:

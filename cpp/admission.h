@@ -324,13 +324,71 @@ inline std::string ValidateSpec(const std::string& kind, const Json& spec) {
         return true;
       };
       int64_t replicas = spec.get("replicas").as_int(1);
-      if (!small_int(elastic.get("min"), 1, replicas)) {
-        return "elastic.min must be an integer in [1, replicas]";
-      }
-      int64_t emin = elastic.get("min").as_int();
-      if (elastic.has("max") &&
-          !small_int(elastic.get("max"), emin, replicas)) {
-        return "elastic.max must be an integer in [min, replicas]";
+      if (elastic.has("min_fsdp")) {
+        // fsdp elasticity: the resize unit is the fsdp mesh axis, not
+        // the replica count. Field-by-field like the fsdp cross-field
+        // checks above, plus the divisibility contract the controller's
+        // candidate picker relies on (targets are divisors of max_fsdp,
+        // so the master-state sharding plan survives every resize).
+        if (elastic.has("min") || elastic.has("max")) {
+          return "elastic.min/max and elastic.min_fsdp are mutually "
+                 "exclusive (replica vs fsdp elasticity)";
+        }
+        const Json& rtf = spec.get("runtime").get("fsdp");
+        if (!small_int(rtf, 1, 1 << 20)) {
+          return "elastic.min_fsdp needs runtime.fsdp >= 1";
+        }
+        const int64_t fsdp = rtf.as_int();
+        int64_t dpp = spec.get("devices_per_proc").as_int(1);
+        if (replicas * dpp != fsdp) {
+          return "elastic fsdp resize needs runtime.fsdp == replicas * "
+                 "devices_per_proc (the fsdp axis spans the gang)";
+        }
+        if (!small_int(elastic.get("min_fsdp"), 1, fsdp)) {
+          return "elastic.min_fsdp must be an integer in "
+                 "[1, runtime.fsdp]";
+        }
+        const int64_t fmin = elastic.get("min_fsdp").as_int();
+        int64_t fmax = fsdp;
+        if (elastic.has("max_fsdp")) {
+          if (!small_int(elastic.get("max_fsdp"), fsdp, 1 << 20)) {
+            return "elastic.max_fsdp must be an integer >= runtime.fsdp";
+          }
+          fmax = elastic.get("max_fsdp").as_int();
+          if (fmax % fsdp != 0) {
+            return "elastic.max_fsdp must be a multiple of runtime.fsdp "
+                   "(resize targets are divisors of max_fsdp and the "
+                   "launch shape must be one of them)";
+          }
+        }
+        if (elastic.has("resize_policy")) {
+          const std::string& pol =
+              elastic.get("resize_policy").as_string();
+          if (pol != "auto" && pol != "manual") {
+            return "elastic.resize_policy must be auto | manual";
+          }
+        }
+        if (elastic.has("target_fsdp")) {
+          if (!small_int(elastic.get("target_fsdp"), fmin, fmax) ||
+              fmax % elastic.get("target_fsdp").as_int() != 0) {
+            return "elastic.target_fsdp must be a divisor of max_fsdp "
+                   "in [min_fsdp, max_fsdp]";
+          }
+        }
+      } else {
+        if (elastic.has("max_fsdp") || elastic.has("resize_policy") ||
+            elastic.has("target_fsdp")) {
+          return "elastic.max_fsdp/resize_policy/target_fsdp need "
+                 "elastic.min_fsdp";
+        }
+        if (!small_int(elastic.get("min"), 1, replicas)) {
+          return "elastic.min must be an integer in [1, replicas]";
+        }
+        int64_t emin = elastic.get("min").as_int();
+        if (elastic.has("max") &&
+            !small_int(elastic.get("max"), emin, replicas)) {
+          return "elastic.max must be an integer in [min, replicas]";
+        }
       }
       if (elastic.has("heartbeat_timeout_s") &&
           (!elastic.get("heartbeat_timeout_s").is_number() ||

@@ -27,6 +27,13 @@
 //     Restarted → Scheduled → Launched) append normally; that history
 //     is real and bounded by backoff_limit.
 //   * different reason → appended.
+// `merge_same_reason=false` opts a caller out of the second rule:
+// distinct STATE TRANSITIONS that share a reason (two ElasticDownsize
+// steps, "fsdp 4 -> 2" then "fsdp 2 -> 1") must stay two entries with
+// count 1 each — merging would collapse the resize history into one
+// event whose count lies about how many transitions happened. The
+// exact-repeat no-op still applies (level-triggered reconciles must
+// not churn the WAL).
 // Bounded at kMaxStatusEvents, trimmed oldest-first (like upstream
 // Events, old entries expire; the conditions array keeps the phase
 // transitions).
@@ -44,7 +51,8 @@ inline constexpr size_t kMaxStatusEvents = 48;
 
 inline Json AppendStatusEvent(Json status, const std::string& type,
                               const std::string& reason,
-                              const std::string& message, double now_s) {
+                              const std::string& message, double now_s,
+                              bool merge_same_reason = true) {
   if (!(now_s > 0)) now_s = NowWall();
   Json events = Json::Array();
   if (status.get("events").is_array()) events = status.get("events");
@@ -55,18 +63,21 @@ inline Json AppendStatusEvent(Json status, const std::string& type,
       if (last.get("message").as_string() == message) {
         return status;  // exact repeat: no-op, no status churn
       }
-      Json rebuilt = Json::Array();
-      for (size_t i = 0; i + 1 < events.size(); ++i) {
-        rebuilt.push_back(events.elements()[i]);
+      if (merge_same_reason) {
+        Json rebuilt = Json::Array();
+        for (size_t i = 0; i + 1 < events.size(); ++i) {
+          rebuilt.push_back(events.elements()[i]);
+        }
+        Json merged = last;
+        merged["count"] = last.get("count").as_int(1) + 1;
+        merged["message"] = message;
+        merged["lastTimestamp"] = Timestamp(now_s);
+        merged["lastUnix"] = now_s;
+        rebuilt.push_back(merged);
+        status["events"] = rebuilt;
+        return status;
       }
-      Json merged = last;
-      merged["count"] = last.get("count").as_int(1) + 1;
-      merged["message"] = message;
-      merged["lastTimestamp"] = Timestamp(now_s);
-      merged["lastUnix"] = now_s;
-      rebuilt.push_back(merged);
-      status["events"] = rebuilt;
-      return status;
+      // merge_same_reason=false: fall through to append a new entry.
     }
   }
   Json ev = Json::Object();

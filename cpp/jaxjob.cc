@@ -55,6 +55,76 @@ namespace {
 // (SpecNamespace; Python mirror: controlplane/client.py namespace_of).
 std::string NamespaceOf(const Json& spec) { return SpecNamespace(spec); }
 
+// fsdp elasticity policy parsed from spec.elastic. Enabled iff
+// elastic.min_fsdp >= 1 AND runtime.fsdp >= 1 (admission enforces both
+// plus the divisibility contract; the re-checks here keep the controller
+// safe against specs that predate admission).
+struct FsdpPolicy {
+  bool enabled = false;
+  bool auto_resize = true;  // resize_policy "auto" (default) | "manual"
+  int base = 0;             // runtime.fsdp as submitted
+  int min = 0;              // elastic.min_fsdp
+  int max = 0;              // elastic.max_fsdp (default: base)
+};
+
+FsdpPolicy FsdpPolicyOf(const Json& spec) {
+  FsdpPolicy p;
+  const Json& el = spec.get("elastic");
+  if (!el.is_object()) return p;
+  const int min_fsdp = static_cast<int>(el.get("min_fsdp").as_int(0));
+  if (min_fsdp < 1) return p;
+  const int base =
+      static_cast<int>(spec.get("runtime").get("fsdp").as_int(0));
+  if (base < 1) return p;
+  p.enabled = true;
+  p.base = base;
+  p.min = min_fsdp;
+  p.max = static_cast<int>(el.get("max_fsdp").as_int(base));
+  if (p.max < base) p.max = base;
+  p.auto_resize = el.get("resize_policy").as_string() != "manual";
+  return p;
+}
+
+// Gang shape for an fsdp size. The fsdp axis spans the whole gang
+// (admission pins runtime.fsdp == replicas * devices_per_proc), so a
+// resize either drops workers at the spec'd per-proc device share
+// (multi-worker downsize) or rescales the per-proc share across
+// spec.replicas workers (single-proc CPU meshes, and upsizes past the
+// base shape). Returns false when `fsdp` fits neither way — callers
+// skip such candidates.
+bool FsdpGangShape(const Json& spec, int fsdp, int* replicas, int* devices) {
+  const int spec_r =
+      std::max(1, static_cast<int>(spec.get("replicas").as_int(1)));
+  const int dpp =
+      std::max(1, static_cast<int>(spec.get("devices_per_proc").as_int(1)));
+  if (fsdp >= dpp && fsdp % dpp == 0 && fsdp / dpp <= spec_r) {
+    *replicas = fsdp / dpp;
+    *devices = dpp;
+    return true;
+  }
+  if (fsdp >= spec_r && fsdp % spec_r == 0) {
+    *replicas = spec_r;
+    *devices = fsdp / spec_r;
+    return true;
+  }
+  return false;
+}
+
+// Largest resize target below `cur`: a divisor of max_fsdp (the
+// master-state sharding plan is anchored there — every leaf dim the
+// plan shards is divisible by max_fsdp, hence by any divisor, so the
+// plan survives the resize), >= min_fsdp, expressible as a gang shape.
+// 0 = no smaller topology exists.
+int NextFsdpDown(const Json& spec, const FsdpPolicy& p, int cur) {
+  int r = 0, d = 0;
+  for (int t = std::min(cur - 1, p.max); t >= p.min; --t) {
+    if (p.max % t != 0) continue;
+    if (!FsdpGangShape(spec, t, &r, &d)) continue;
+    return t;
+  }
+  return 0;
+}
+
 }  // namespace
 
 void JaxJobController::SetPhase(JobView& job, const std::string& phase,
@@ -92,9 +162,11 @@ void JaxJobController::SetPhase(JobView& job, const std::string& phase,
 
 void JaxJobController::AppendEvent(JobView& job, const std::string& type,
                                    const std::string& reason,
-                                   const std::string& message) {
+                                   const std::string& message,
+                                   bool merge_same_reason) {
   job.status = AppendStatusEvent(job.status, type, reason, message,
-                                 now_s_ ? now_s_ : NowWall());
+                                 now_s_ ? now_s_ : NowWall(),
+                                 merge_same_reason);
 }
 
 void JaxJobController::KillAll(const JobView& job) {
@@ -153,11 +225,61 @@ int JaxJobController::EffectiveReplicas(const JobView& job) const {
   return eff;
 }
 
+int JaxJobController::EffectiveFsdp(const JobView& job) const {
+  const FsdpPolicy p = FsdpPolicyOf(job.spec);
+  if (!p.enabled) return 0;
+  int eff = static_cast<int>(job.status.get("effectiveFsdp").as_int(p.base));
+  if (eff < p.min) eff = p.min;
+  if (eff > p.max) eff = p.max;
+  return eff;
+}
+
+void JaxJobController::ElasticResizeFsdp(JobView& job, int from, int target,
+                                         const std::string& phase,
+                                         const std::string& reason,
+                                         const std::string& detail,
+                                         bool count_restart) {
+  int from_r = 0, from_d = 0, to_r = 0, to_d = 0;
+  FsdpGangShape(job.spec, from, &from_r, &from_d);
+  FsdpGangShape(job.spec, target, &to_r, &to_d);
+  // The event carries the old -> new topology in full (fsdp axis AND
+  // the derived gang shape); merge is disabled so two distinct
+  // transitions sharing this reason stay two entries (events.h).
+  const std::string message =
+      "fsdp " + std::to_string(from) + " -> " + std::to_string(target) +
+      " (gang " + std::to_string(from_r) + "x" + std::to_string(from_d) +
+      " -> " + std::to_string(to_r) + "x" + std::to_string(to_d) +
+      " procs x devices): " + detail;
+  AppendEvent(job, "Normal", reason, message, /*merge_same_reason=*/false);
+  job.status["effectiveFsdp"] = target;
+  if (to_r >= 1) job.status["effectiveReplicas"] = to_r;
+  job.status["lastResizeUnix"] = now_s_ ? now_s_ : NowWall();
+  if (count_restart) {
+    job.status["restarts"] = job.status.get("restarts").as_int(0) + 1;
+  }
+  metrics_.elastic_resizes++;
+  SetPhase(job, phase, reason, message, now_s_);
+}
+
 void JaxJobController::LaunchGang(JobView& job) {
   const std::string& name = job.res.name;
   int replicas = EffectiveReplicas(job);
   int devices = static_cast<int>(job.spec.get("devices_per_proc").as_int(1));
   int num_slices = static_cast<int>(job.spec.get("num_slices").as_int(1));
+  const int spec_devices = devices;
+  const FsdpPolicy fsdp_policy = FsdpPolicyOf(job.spec);
+  const int eff_fsdp = fsdp_policy.enabled ? EffectiveFsdp(job) : 0;
+  if (eff_fsdp >= 1) {
+    // fsdp-elastic gangs derive their shape from the effective fsdp
+    // size — the axis spans the gang's devices, so a resize is a new
+    // (replicas, devices_per_proc) pair, re-derived here every launch
+    // (status survives controller restarts; the shape must too).
+    int r = 0, d = 0;
+    if (FsdpGangShape(job.spec, eff_fsdp, &r, &d)) {
+      replicas = r;
+      devices = d;
+    }
+  }
 
   // Namespace device quota — the Profile-controller stub (SURVEY.md §2.5
   // row "Profile", §7.4 descope: namespace field + quota, no RBAC/Istio).
@@ -188,6 +310,17 @@ void JaxJobController::LaunchGang(JobView& job) {
     // the full size, walk the gang down toward elastic.min one step per
     // reconcile — the checkpoint-resume path reshards to whatever size
     // finally fits (SURVEY.md §2.6 Elastic DP).
+    if (fsdp_policy.enabled && fsdp_policy.auto_resize &&
+        eff_fsdp > fsdp_policy.min) {
+      const int t = NextFsdpDown(job.spec, fsdp_policy, eff_fsdp);
+      if (t >= 1) {
+        // No gang attempt was consumed — the workers never launched.
+        ElasticResizeFsdp(job, eff_fsdp, t, "Pending", "ElasticDownsize",
+                          "insufficient capacity; retrying smaller",
+                          /*count_restart=*/false);
+        return;
+      }
+    }
     const Json& el = job.spec.get("elastic");
     int min_r = static_cast<int>(el.get("min").as_int(0));
     if (el.is_object() && min_r >= 1 && replicas > min_r) {
@@ -223,6 +356,20 @@ void JaxJobController::LaunchGang(JobView& job) {
   std::string spec_path = dir + "/runtime.json";
   {
     Json runtime = job.spec.get("runtime");
+    // An fsdp resize lands in the worker through runtime.json: the
+    // relaunched gang reads the resized topology at startup and
+    // reshards its checkpoint to it — the spec itself is never edited
+    // (the submitted runtime.fsdp stays the declared intent).
+    if (eff_fsdp >= 1 && runtime.is_object() &&
+        static_cast<int>(runtime.get("fsdp").as_int(0)) != eff_fsdp) {
+      runtime["fsdp"] = eff_fsdp;
+      if (runtime.get("mesh").is_object() &&
+          runtime.get("mesh").has("fsdp")) {
+        Json mesh = runtime.get("mesh");
+        mesh["fsdp"] = eff_fsdp;
+        runtime["mesh"] = mesh;
+      }
+    }
     FILE* f = fopen(spec_path.c_str(), "w");
     if (f) {
       std::string text = runtime.is_null() ? "{}" : runtime.dump();
@@ -239,6 +386,13 @@ void JaxJobController::LaunchGang(JobView& job) {
   std::string coordinator = "127.0.0.1:" + std::to_string(port);
   int cpu_devices =
       static_cast<int>(job.spec.get("cpu_devices_per_proc").as_int(0));
+  // CPU meshes virtualize devices per proc — an fsdp resize must scale
+  // the virtual-device count with the per-proc device share or the
+  // relaunched worker would build the old mesh.
+  if (cpu_devices > 0 && eff_fsdp >= 1 && devices != spec_devices &&
+      (cpu_devices * devices) % spec_devices == 0) {
+    cpu_devices = cpu_devices * devices / spec_devices;
+  }
 
   std::vector<LaunchSpec> specs;
   for (int i = 0; i < replicas; ++i) {
@@ -408,6 +562,27 @@ void JaxJobController::HandleExits(JobView& job) {
   // checkpoint-restart elasticity, now with an automatic trigger;
   // SURVEY.md §2.6 Elastic DP / §5.3 ElasticPolicy analog).
   if (retryable) {
+    // fsdp elasticity first: the resize unit is the fsdp axis — pick the
+    // next divisor of max_fsdp down (the master-state plan survives any
+    // divisor), derive the gang shape, and let the relaunch reshard the
+    // checkpoint. Mutually exclusive with replica elasticity (admission).
+    const FsdpPolicy fp = FsdpPolicyOf(job.spec);
+    const int cur_fsdp = fp.enabled ? EffectiveFsdp(job) : 0;
+    if (fp.enabled && fp.auto_resize && cur_fsdp > fp.min) {
+      const int target = NextFsdpDown(job.spec, fp, cur_fsdp);
+      if (target >= 1) {
+        // count_restart: this consumed a gang attempt — per-attempt
+        // gates (spec.fault's first-attempt default) must see a nonzero
+        // count or the fault would re-arm on every elastic relaunch.
+        ElasticResizeFsdp(
+            job, cur_fsdp, target, "Restarting", "ElasticDownsize",
+            std::to_string(failed) + " worker exit(s) past backoff "
+                "(first exit " + std::to_string(first_fail_code) +
+                "); resuming from latest checkpoint",
+            /*count_restart=*/true);
+        return;
+      }
+    }
     const Json& el = job.spec.get("elastic");
     int min_r = static_cast<int>(el.get("min").as_int(0));
     if (el.is_object() && min_r >= 1 && replicas > min_r) {
@@ -481,6 +656,13 @@ void JaxJobController::MaybeUpsize(JobView& job) {
   // downsize path.
   const Json& el = job.spec.get("elastic");
   if (!el.is_object()) return;
+  if (FsdpPolicyOf(job.spec).enabled) {
+    // fsdp-elastic gangs regrow along the fsdp axis, never the replica
+    // path — effectiveReplicas is derived state here and the replica
+    // upsize would fight the fsdp shape.
+    MaybeUpsizeFsdp(job);
+    return;
+  }
   int spec_r = static_cast<int>(job.spec.get("replicas").as_int(1));
   int cap = static_cast<int>(el.get("max").as_int(spec_r));
   if (cap > spec_r) cap = spec_r;
@@ -548,6 +730,107 @@ void JaxJobController::MaybeUpsize(JobView& job) {
                     std::to_string(target) +
                     " workers, resuming from latest checkpoint",
                 /*count_restart=*/false);
+}
+
+void JaxJobController::MaybeUpsizeFsdp(JobView& job) {
+  // The fsdp twin of MaybeUpsize: a gang resized below max_fsdp grows
+  // back when freed devices can host a bigger divisor — kill, release,
+  // relaunch; the runtime reshards its checkpoint up. Same probe
+  // discipline (real allocations, restore the books on failure) and the
+  // same cooldown keyed on lastResizeUnix to prevent thrash.
+  const FsdpPolicy fp = FsdpPolicyOf(job.spec);
+  if (!fp.enabled || !fp.auto_resize) return;
+  const int cur = EffectiveFsdp(job);
+  if (cur >= fp.max) return;
+  const Json& el = job.spec.get("elastic");
+  double cooldown = el.get("upsize_cooldown_s").as_number(30.0);
+  double last = job.status.get("lastResizeUnix").as_number(0);
+  double now = now_s_ ? now_s_ : NowWall();
+  if (last > 0 && now - last < cooldown) return;
+  int num_slices = static_cast<int>(job.spec.get("num_slices").as_int(1));
+  int cur_r = 0, cur_d = 0;
+  if (!FsdpGangShape(job.spec, cur, &cur_r, &cur_d)) return;
+
+  Allocation current = AllocFromStatus(job.status);
+  scheduler_->Release(current);
+  int target = 0, tgt_r = 0, tgt_d = 0;
+  std::optional<Allocation> probe;
+  for (int t = fp.max; t > cur; --t) {
+    if (fp.max % t != 0) continue;
+    int r = 0, d = 0;
+    if (!FsdpGangShape(job.spec, t, &r, &d)) continue;
+    probe = scheduler_->Allocate(r * d, num_slices);
+    if (probe) {
+      target = t;
+      tgt_r = r;
+      tgt_d = d;
+      break;
+    }
+  }
+  if (target == 0) {
+    auto back = scheduler_->Allocate(cur_r * cur_d, num_slices);
+    if (back) {
+      Json alloc_json = Json::Object();
+      for (const auto& [slice, n] : back->slices) alloc_json[slice] = n;
+      job.status["allocation"] = alloc_json;
+    }
+    return;
+  }
+  scheduler_->Release(*probe);  // LaunchGang re-allocates for real
+
+  const std::string ns = NamespaceOf(job.spec);
+  auto profile = store_->Get("Profile", ns);
+  int64_t quota =
+      profile ? profile->spec.get("max_devices").as_int(-1) : -1;
+  if (quota >= 0 && UsedInNamespace(ns, job.res.name) +
+                            static_cast<int64_t>(tgt_r) * tgt_d >
+                        quota) {
+    auto back = scheduler_->Allocate(cur_r * cur_d, num_slices);
+    if (back) {
+      Json alloc_json = Json::Object();
+      for (const auto& [slice, n] : back->slices) alloc_json[slice] = n;
+      job.status["allocation"] = alloc_json;
+    }
+    return;
+  }
+
+  KillAll(job);
+  job.status["active"] = false;
+  job.status["allocation"] = Json::Object();  // already released above
+  ElasticResizeFsdp(job, cur, target, "Restarting", "ElasticUpsize",
+                    "capacity freed; resuming from latest checkpoint",
+                    /*count_restart=*/false);
+}
+
+bool JaxJobController::MaybeApplyFsdpTarget(JobView& job) {
+  // Explicit resize request: elastic.target_fsdp on a Running gang.
+  // status.fsdpTargetApplied latches the last honored value — the
+  // request fires once per distinct target, so automatic resizes that
+  // later move effectiveFsdp away don't re-trigger a stale request.
+  const FsdpPolicy fp = FsdpPolicyOf(job.spec);
+  if (!fp.enabled) return false;
+  const int target = static_cast<int>(
+      job.spec.get("elastic").get("target_fsdp").as_int(0));
+  if (target < fp.min || target > fp.max || fp.max % target != 0) {
+    return false;  // admission refuses these; stale specs just no-op
+  }
+  const int applied = static_cast<int>(
+      job.status.get("fsdpTargetApplied").as_int(0));
+  if (target == applied) return false;
+  const int cur = EffectiveFsdp(job);
+  if (target == cur) {
+    job.status["fsdpTargetApplied"] = target;  // already there: latch only
+    return false;
+  }
+  int r = 0, d = 0;
+  if (!FsdpGangShape(job.spec, target, &r, &d)) return false;
+  KillAll(job);
+  job.status["active"] = false;
+  ReleaseAlloc(job);
+  job.status["fsdpTargetApplied"] = target;
+  ElasticResizeFsdp(job, cur, target, "Restarting", "ElasticResizeRequested",
+                    "explicit resize request", /*count_restart=*/false);
+  return true;
 }
 
 void JaxJobController::Recover() {
@@ -660,8 +943,12 @@ void JaxJobController::Tick(double now_s) {
       pending.push_back(res.name);
     }
     if (phase == "Running" && job.status.get("active").as_bool(false)) {
-      CheckHeartbeats(job);  // hung-worker kills reaped on a later Poll
-      MaybeUpsize(job);
+      // An explicit resize request supersedes this tick's health/upsize
+      // checks — the gang it would inspect is already being replaced.
+      if (!MaybeApplyFsdpTarget(job)) {
+        CheckHeartbeats(job);  // hung-worker kills reaped on a later Poll
+        MaybeUpsize(job);
+      }
       if (job.status.dump() != res.status.dump()) {
         store_->UpdateStatus("JAXJob", res.name, job.status);
       }

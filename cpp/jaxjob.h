@@ -106,6 +106,29 @@ class JaxJobController {
   void ElasticResize(JobView& job, int target, const std::string& phase,
                      const std::string& reason, const std::string& message,
                      bool count_restart);
+  // fsdp elasticity (spec.elastic {min_fsdp, max_fsdp?, resize_policy?,
+  // target_fsdp?}): the resize unit is the fsdp mesh axis, not the
+  // replica count — the controller picks a new fsdp size (a divisor of
+  // max_fsdp, so the master-state sharding plan survives), derives the
+  // gang shape from it, rewrites runtime.json, and relaunches; the
+  // runtime reshards from its own latest checkpoint (ROADMAP item 5).
+  // Current size lives in status.effectiveFsdp (default runtime.fsdp).
+  int EffectiveFsdp(const JobView& job) const;
+  // The fsdp resize transition: stamps an ElasticResize-family event
+  // carrying the old -> new topology (merge disabled: two distinct
+  // transitions must stay two entries), records effectiveFsdp + the
+  // derived effectiveReplicas, bumps metrics, sets phase/condition.
+  void ElasticResizeFsdp(JobView& job, int from, int target,
+                         const std::string& phase, const std::string& reason,
+                         const std::string& detail, bool count_restart);
+  // Capacity-driven fsdp regrow (the fsdp twin of MaybeUpsize): probe
+  // the scheduler for a bigger divisor under the upsize cooldown.
+  void MaybeUpsizeFsdp(JobView& job);
+  // Explicit resize request: spec.elastic.target_fsdp applied to a
+  // Running gang exactly once per distinct value (status.fsdpTargetApplied
+  // latches it so automatic resizes can supersede without re-firing).
+  // Returns true when a resize was initiated.
+  bool MaybeApplyFsdpTarget(JobView& job);
   // Devices running jobs in `ns` (excluding `exclude`) actually hold —
   // recorded allocations, so elastically resized gangs charge what they
   // use, not their spec maximum.
@@ -117,8 +140,11 @@ class JaxJobController {
   // Append one entry to the job's structured event log (events.h):
   // ordered, deduped, bounded, WAL-persisted with the status write the
   // caller's reconcile already makes. type: "Normal" | "Warning".
+  // `merge_same_reason=false` keeps distinct same-reason transitions as
+  // separate entries (events.h).
   void AppendEvent(JobView& job, const std::string& type,
-                   const std::string& reason, const std::string& message);
+                   const std::string& reason, const std::string& message,
+                   bool merge_same_reason = true);
   void KillAll(const JobView& job);
   void ReleaseAlloc(JobView& job);
   Allocation AllocFromStatus(const Json& status) const;

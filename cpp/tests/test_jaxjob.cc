@@ -534,5 +534,277 @@ int main() {
     CHECK(v1 == v2);  // event dedup kept the status write-free
   }
 
+  // --- fsdp elasticity: the resize unit is the mesh axis ----------------
+  // Spec shape: 1 proc x 4 devices, runtime.fsdp=4, min_fsdp=1 — the
+  // CPU-provable topology (a single proc virtualizes its devices).
+  auto FsdpSpec = [] {
+    Json spec = BaseSpec(1);
+    spec["devices_per_proc"] = 4;
+    spec["cpu_devices_per_proc"] = 4;
+    spec["backoff_limit"] = 0;
+    Json rt = Json::Object();
+    rt["fsdp"] = 4;
+    rt["steps"] = 8;
+    spec["runtime"] = rt;
+    Json el = Json::Object();
+    el["min_fsdp"] = 1;
+    spec["elastic"] = el;
+    return spec;
+  };
+
+  // --- fsdp downsize past backoff: 4 -> 2 -> 1, then Failed -------------
+  {
+    Harness h;
+    Json spec = FsdpSpec();
+    CHECK(tpk::ValidateSpec("JAXJob", spec).empty());
+    h.store.Create("JAXJob", "jfsdp", spec);
+    h.Settle();
+    CHECK(Phase(h.store, "jfsdp") == "Running");
+    CHECK(h.exec.launched.size() == 1);
+    CHECK(h.sched.Slices()[0].used == 4);
+
+    // SIGKILL (137 = retryable) past the zero backoff: the job must NOT
+    // fail — it reshards to the next divisor down and relaunches.
+    h.exec.Finish("jfsdp/0", 137);
+    h.Settle();
+    CHECK(Phase(h.store, "jfsdp") == "Running");
+    auto r = h.store.Get("JAXJob", "jfsdp");
+    CHECK(r->status.get("effectiveFsdp").as_int() == 2);
+    CHECK(r->status.get("restarts").as_int() == 1);  // attempt consumed
+    CHECK(h.exec.launched.size() == 2);
+    CHECK(h.sched.Slices()[0].used == 2);  // downsized gang holds less
+    // The worker learns the new topology through its launch shape: the
+    // virtual-device count scales with the per-proc device share.
+    {
+      const auto& argv = h.exec.launched[1].argv;
+      bool saw = false;
+      for (size_t i = 0; i + 1 < argv.size(); ++i) {
+        if (argv[i] == "--cpu-devices") {
+          saw = true;
+          CHECK(argv[i + 1] == "2");
+        }
+      }
+      CHECK(saw);
+    }
+    // ...and through runtime.json, rewritten with the resized fsdp.
+    {
+      FILE* f = fopen("/tmp/tpk_test_ctl/jfsdp/runtime.json", "r");
+      CHECK(f != nullptr);
+      char buf[4096];
+      size_t n = fread(buf, 1, sizeof(buf) - 1, f);
+      fclose(f);
+      buf[n] = '\0';
+      Json rt = Json::parse(buf);
+      CHECK(rt.get("fsdp").as_int() == 2);
+      CHECK(rt.get("steps").as_int() == 8);  // rest of runtime intact
+    }
+    CHECK(h.ctl.metrics().elastic_resizes == 1);
+
+    // Second death: 2 -> 1 (min_fsdp floor).
+    h.exec.Finish("jfsdp/0", 137);
+    h.Settle();
+    CHECK(Phase(h.store, "jfsdp") == "Running");
+    r = h.store.Get("JAXJob", "jfsdp");
+    CHECK(r->status.get("effectiveFsdp").as_int() == 1);
+    CHECK(h.ctl.metrics().elastic_resizes == 2);
+
+    // Event hygiene (satellite of ISSUE 17): the two transitions are
+    // TWO entries carrying old -> new topology, count 1 each — the
+    // same-reason merge must not collapse distinct resizes.
+    {
+      const Json& evs = r->status.get("events");
+      int down = 0;
+      bool saw42 = false, saw21 = false;
+      for (const auto& e : evs.elements()) {
+        if (e.get("reason").as_string() != "ElasticDownsize") continue;
+        down++;
+        CHECK(e.get("count").as_int() == 1);
+        const std::string& m = e.get("message").as_string();
+        if (m.find("fsdp 4 -> 2") != std::string::npos) saw42 = true;
+        if (m.find("fsdp 2 -> 1") != std::string::npos) saw21 = true;
+      }
+      CHECK(down == 2);
+      CHECK(saw42 && saw21);
+    }
+
+    // At the floor there is nowhere left to shrink: next death fails.
+    h.exec.Finish("jfsdp/0", 137);
+    h.Settle();
+    CHECK(Phase(h.store, "jfsdp") == "Failed");
+    CHECK(h.sched.Slices()[0].used == 0);
+  }
+
+  // --- fsdp downsize when the full mesh never fits: 4 -> 2 -> 1 ---------
+  // Back-to-back capacity step-downs produce NO interleaving events, so
+  // this is the path where same-reason merge would have collapsed two
+  // distinct transitions into one lying count — pin that they stay two.
+  {
+    Harness h(1);  // capacity 1 device
+    h.store.Create("JAXJob", "jtight", FsdpSpec());
+    h.Settle();
+    CHECK(Phase(h.store, "jtight") == "Running");
+    auto r = h.store.Get("JAXJob", "jtight");
+    CHECK(r->status.get("effectiveFsdp").as_int() == 1);
+    CHECK(h.exec.launched.size() == 1);
+    int down = 0;
+    bool saw42 = false, saw21 = false;
+    for (const auto& e : r->status.get("events").elements()) {
+      if (e.get("reason").as_string() != "ElasticDownsize") continue;
+      down++;
+      CHECK(e.get("count").as_int() == 1);
+      const std::string& m = e.get("message").as_string();
+      if (m.find("fsdp 4 -> 2") != std::string::npos) saw42 = true;
+      if (m.find("fsdp 2 -> 1") != std::string::npos) saw21 = true;
+    }
+    CHECK(down == 2);
+    CHECK(saw42 && saw21);
+  }
+
+  // --- fsdp upsize: regrow to a bigger divisor past the cooldown --------
+  {
+    Harness h;
+    h.store.Create("JAXJob", "jgrow", FsdpSpec());
+    h.Settle();
+    h.exec.Finish("jgrow/0", 137);
+    h.Settle();
+    auto r = h.store.Get("JAXJob", "jgrow");
+    CHECK(r->status.get("effectiveFsdp").as_int() == 2);
+    CHECK(Phase(h.store, "jgrow") == "Running");
+
+    h.now += 31;  // past the 30s default upsize cooldown
+    h.Settle();
+    r = h.store.Get("JAXJob", "jgrow");
+    CHECK(r->status.get("effectiveFsdp").as_int() == 4);
+    CHECK(Phase(h.store, "jgrow") == "Running");
+    CHECK(h.sched.Slices()[0].used == 4);
+    bool saw_up = false;
+    for (const auto& e : r->status.get("events").elements()) {
+      if (e.get("reason").as_string() == "ElasticUpsize" &&
+          e.get("message").as_string().find("fsdp 2 -> 4") !=
+              std::string::npos) {
+        saw_up = true;
+      }
+    }
+    CHECK(saw_up);
+  }
+
+  // --- fsdp explicit resize request: target_fsdp fires exactly once -----
+  {
+    Harness h;
+    Json spec = FsdpSpec();
+    h.store.Create("JAXJob", "jreq", spec);
+    h.Settle();
+    CHECK(Phase(h.store, "jreq") == "Running");
+    size_t launches = h.exec.launched.size();
+
+    Json el = Json::Object();
+    el["min_fsdp"] = 1;
+    el["target_fsdp"] = 2;
+    el["resize_policy"] = std::string("manual");
+    spec["elastic"] = el;
+    CHECK(tpk::ValidateSpec("JAXJob", spec).empty());
+    CHECK(h.store.UpdateSpec("JAXJob", "jreq", spec).ok);
+    h.Settle();
+    auto r = h.store.Get("JAXJob", "jreq");
+    CHECK(r->status.get("effectiveFsdp").as_int() == 2);
+    CHECK(Phase(h.store, "jreq") == "Running");
+    CHECK(h.exec.launched.size() == launches + 1);
+    bool saw_req = false;
+    for (const auto& e : r->status.get("events").elements()) {
+      if (e.get("reason").as_string() == "ElasticResizeRequested" &&
+          e.get("message").as_string().find("fsdp 4 -> 2") !=
+              std::string::npos) {
+        saw_req = true;
+      }
+    }
+    CHECK(saw_req);
+
+    // The latch: the same target must not re-fire (no kill churn), and
+    // manual policy means no automatic regrow past the cooldown either.
+    launches = h.exec.launched.size();
+    h.now += 61;
+    h.Settle();
+    r = h.store.Get("JAXJob", "jreq");
+    CHECK(r->status.get("effectiveFsdp").as_int() == 2);
+    CHECK(h.exec.launched.size() == launches);
+  }
+
+  // --- fsdp elastic admission -------------------------------------------
+  {
+    Json spec = FsdpSpec();
+    CHECK(tpk::ValidateSpec("JAXJob", spec).empty());
+    Json el = Json::Object();
+
+    el["min_fsdp"] = 1;
+    el["min"] = 1;  // replica + fsdp elasticity: mutually exclusive
+    spec["elastic"] = el;
+    CHECK(!tpk::ValidateSpec("JAXJob", spec).empty());
+    el.erase("min");
+
+    Json norust = FsdpSpec();  // min_fsdp without runtime.fsdp
+    Json rt0 = Json::Object();
+    rt0["steps"] = 8;
+    norust["runtime"] = rt0;
+    CHECK(!tpk::ValidateSpec("JAXJob", norust).empty());
+
+    Json badshape = FsdpSpec();  // fsdp != replicas * devices_per_proc
+    badshape["devices_per_proc"] = 2;
+    CHECK(!tpk::ValidateSpec("JAXJob", badshape).empty());
+
+    el["min_fsdp"] = 5;  // > runtime.fsdp
+    spec["elastic"] = el;
+    CHECK(!tpk::ValidateSpec("JAXJob", spec).empty());
+    el["min_fsdp"] = 1;
+
+    el["max_fsdp"] = 6;  // not a multiple of runtime.fsdp
+    spec["elastic"] = el;
+    CHECK(!tpk::ValidateSpec("JAXJob", spec).empty());
+    el["max_fsdp"] = 8;
+    spec["elastic"] = el;
+    CHECK(tpk::ValidateSpec("JAXJob", spec).empty());
+
+    el["target_fsdp"] = 3;  // not a divisor of max_fsdp
+    spec["elastic"] = el;
+    CHECK(!tpk::ValidateSpec("JAXJob", spec).empty());
+    el["target_fsdp"] = 2;
+    spec["elastic"] = el;
+    CHECK(tpk::ValidateSpec("JAXJob", spec).empty());
+
+    el["resize_policy"] = std::string("sometimes");
+    spec["elastic"] = el;
+    CHECK(!tpk::ValidateSpec("JAXJob", spec).empty());
+    el["resize_policy"] = std::string("manual");
+    spec["elastic"] = el;
+    CHECK(tpk::ValidateSpec("JAXJob", spec).empty());
+
+    Json orphan = BaseSpec(2);  // fsdp-only knobs without min_fsdp
+    Json el2 = Json::Object();
+    el2["min"] = 1;
+    el2["max_fsdp"] = 8;
+    orphan["elastic"] = el2;
+    CHECK(!tpk::ValidateSpec("JAXJob", orphan).empty());
+  }
+
+  // --- AppendStatusEvent merge_same_reason=false: transitions stay ------
+  {
+    Json st = Json::Object();
+    st = tpk::AppendStatusEvent(st, "Normal", "ElasticDownsize",
+                                "fsdp 4 -> 2", 100.0,
+                                /*merge_same_reason=*/false);
+    std::string before = st.dump();
+    // Exact repeat is still a no-op (level-triggered reconciles).
+    st = tpk::AppendStatusEvent(st, "Normal", "ElasticDownsize",
+                                "fsdp 4 -> 2", 101.0,
+                                /*merge_same_reason=*/false);
+    CHECK(st.dump() == before);
+    // A DISTINCT transition with the same reason appends, never merges.
+    st = tpk::AppendStatusEvent(st, "Normal", "ElasticDownsize",
+                                "fsdp 2 -> 1", 102.0,
+                                /*merge_same_reason=*/false);
+    CHECK(st.get("events").size() == 2);
+    CHECK(st.get("events").elements()[0].get("count").as_int() == 1);
+    CHECK(st.get("events").elements()[1].get("count").as_int() == 1);
+  }
+
   return 0;
 }

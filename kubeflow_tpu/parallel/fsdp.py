@@ -90,6 +90,25 @@ def master_spec(spec: P, shape: tuple[int, ...], axis_size: int,
     return P(*entries)
 
 
+def resize_candidates(max_fsdp: int, min_fsdp: int = 1) -> list[int]:
+    """The fsdp sizes an elastic resize may target: divisors of
+    `max_fsdp` in [min_fsdp, max_fsdp], descending.
+
+    Divisors are the set that preserves the master-state sharding plan:
+    `master_spec` shards a leaf dim only when it divides by the axis
+    size, and every dim divisible by max_fsdp is divisible by each of
+    its divisors — so the SAME leaves stay sharded (just into fewer,
+    larger shards) and no leaf flips between sharded and replicated
+    across a resize. The C++ controller's candidate picker
+    (cpp/jaxjob.cc NextFsdpDown) walks this exact set; this mirror
+    exists so Python tests and the train chaos harness can assert the
+    controller never picks outside it."""
+    if max_fsdp < 1:
+        return []
+    return [d for d in range(max_fsdp, max(min_fsdp, 1) - 1, -1)
+            if max_fsdp % d == 0]
+
+
 def tree_bytes_per_device(tree: Any) -> int:
     """Per-device bytes of a tree of sharded arrays (or ShapeDtypeStructs
     with shardings — the AOT scale-proof path uses the same accounting).

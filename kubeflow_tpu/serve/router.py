@@ -49,6 +49,7 @@ from __future__ import annotations
 
 import asyncio
 import bisect
+import dataclasses
 import hashlib
 import http.client
 import json
@@ -329,6 +330,42 @@ def _forward_once(url: str, method: str, path: str, body: bytes | None,
                           resp=resp)
 
 
+@dataclasses.dataclass
+class _ForwardState:
+    """Mutable retry bookkeeping threaded through `_forward_attempt`.
+    Lives OUTSIDE the helper so a caller that re-enters the loop (the
+    decode resume path) keeps its exclusions and its attempt budget
+    across entries — a resumed stream must not get a fresh budget."""
+
+    exclude: set = dataclasses.field(default_factory=set)
+    attempts: int = 0
+
+
+@dataclasses.dataclass
+class _Attempt:
+    """Terminal outcome of one `_forward_attempt` run.
+
+    kind:
+      * ``no_replica`` — placement found nothing live.
+      * ``deadline``   — the caller's deadline expired before a forward
+        (already counted + metered by the helper).
+      * ``exhausted``  — a connect-class failure and no retry budget /
+        deadline left; `expired`/`draining` say which terminal flavor.
+      * ``timeout``    — post-connect timeout (replica may still be
+        working: no replay; already counted + metered by the helper).
+      * ``ok``         — `result` is live and `name` is STILL CHECKED
+        OUT: the caller owns the matching `fleet.checkin`.
+    """
+
+    kind: str
+    name: str | None = None
+    result: _ForwardResult | None = None
+    t0: float = 0.0
+    error: Exception | None = None
+    expired: bool = False
+    draining: bool = False
+
+
 class _RouterBase(tornado.web.RequestHandler):
     def initialize(self, server: "RouterServer"):
         self.server = server
@@ -431,126 +468,59 @@ class ProxyHandler(_RouterBase):
             if await self._proxy_disagg(route, trace_id, deadline, key,
                                         wants_stream):
                 return
-        loop = asyncio.get_event_loop()
-        attempts = 0
-        exclude: set[str] = set()
-        max_attempts = max(len(self.fleet.names()), 1)
         # A full generate needs a replica serving BOTH phases (a
         # decode-role replica would refuse the prefill); metadata and
         # tensor-infer traffic places over every role.
         intent = "generate" if is_generative else None
-        while True:
-            with obs.span("router.place", trace_id=trace_id,
-                          path=full_path) as sp:
-                name, reason = self.router.place(key,
-                                                 exclude=frozenset(exclude),
-                                                 intent=intent)
-                sp.set(replica=name or "-", reason=reason)
-            if name is None:
-                self._count(None, "no_replica")
-                self.router._bump("errors")
-                self.set_header("Retry-After", "1")
-                self.write_json({"error": "no live replica"}, status=503)
-                return
-            url = self.fleet.url_of(name)
-            if url is None:
-                exclude.add(name)
-                continue
-            if deadline is not None and deadline.expired():
-                self._count(name, "deadline")
-                res_metrics.inc("tpk_deadline_expired_total",
-                                component="router")
-                raise tornado.web.HTTPError(
-                    504, reason="request deadline exceeded (router)")
-            headers = {REQUEST_ID_HEADER: trace_id}
-            ct = self.request.headers.get("Content-Type")
-            if ct:
-                headers["Content-Type"] = ct
-            if deadline is not None:
-                rem = deadline.remaining()
-                headers[DEADLINE_HEADER] = str(
-                    max(int((rem or 0.0) * 1e3), 1))
-            timeout_s = (deadline.bound(self.server.forward_timeout_s)
-                         if deadline is not None
-                         else self.server.forward_timeout_s)
-            self.fleet.checkout(name)
-            attempts += 1
-            t0 = time.perf_counter()
-            try:
-                result = await loop.run_in_executor(
-                    self.server.executor, _forward_once, url,
-                    self.request.method, full_path,
-                    self.request.body or None, headers, timeout_s,
-                    not wants_stream)
-            except RetryableForwardError as e:
-                self.fleet.checkin(
-                    name, failed="draining" not in str(e))
-                obs.record("router.forward", t0, time.perf_counter(),
-                           trace_id=trace_id, replica=name,
-                           error=str(e)[:120])
-                retryable = (is_inference or self.request.method == "GET")
-                expired = deadline is not None and deadline.expired()
-                draining = "draining" in str(e)
-                if (retryable and attempts <= max_attempts
-                        and not expired):
-                    exclude.add(name)
-                    res_metrics.inc(
-                        "tpk_router_retry_total",
-                        reason=("draining" if draining else "connect"))
-                    self.router._bump("retries")
-                    continue
-                self._count(name, "deadline" if expired
-                            else "draining" if draining
-                            else "retry_exhausted")
-                if expired:
-                    self.router._bump("errors")
-                    res_metrics.inc("tpk_deadline_expired_total",
-                                    component="router")
-                    raise tornado.web.HTTPError(
-                        504, reason="request deadline exceeded "
-                                    "(router retries)") from e
-                if draining:
-                    # The replica answered cleanly — reflect its drain
-                    # rejection as the 503 it was, not a 502. NOT
-                    # counted as a shed: sheds feed the autoscaler's
-                    # scale-out signal, and a drain rejection is the
-                    # opposite of overload evidence.
-                    self.router._bump("draining_rejects")
-                    self.set_header("Retry-After", "1")
-                    self.set_header(DRAINING_HEADER, "1")
-                    self.write_json(
-                        {"error": f"replica {name} draining"}, status=503)
-                    return
-                self.router._bump("errors")
-                raise tornado.web.HTTPError(
-                    502, reason=f"replica {name} unreachable: {e}") \
-                    from e
-            except ForwardTimeoutError as e:
-                # The replica may still be executing the request: no
-                # replay (that would duplicate decode work) and no
-                # failure mark (slow is not dead) — just a 504. The
-                # gray-ejection EWMA still gets the latency evidence.
-                self.fleet.checkin(name)
-                self.fleet.observe_forward(name, timeout_s)
-                obs.record("router.forward", t0, time.perf_counter(),
-                           trace_id=trace_id, replica=name,
-                           error=str(e)[:120])
-                self._count(name, "upstream_error")
-                self.router._bump("errors")
-                raise tornado.web.HTTPError(
-                    504, reason=f"replica {name} timed out: {e}") from e
-            except Exception:
-                # Anything non-retryable still releases the outstanding
-                # count, or a drain on this replica would wait forever.
-                self.fleet.checkin(name)
-                raise
-            self.set_header(REPLICA_HEADER, name)
-            self.set_header(ATTEMPTS_HEADER, str(attempts))
-            try:
-                await self._relay(result, name, trace_id, t0)
-            finally:
-                self.fleet.checkin(name)
+        state = _ForwardState()
+        a = await self._forward_attempt(
+            state=state, key=key, intent=intent,
+            method=self.request.method, path=full_path,
+            body=self.request.body or None,
+            content_type=self.request.headers.get("Content-Type"),
+            trace_id=trace_id, deadline=deadline,
+            read_body=not wants_stream,
+            retryable=(is_inference or self.request.method == "GET"),
+            drain_rejects=True)
+        if a.kind == "no_replica":
+            self._count(None, "no_replica")
+            self.router._bump("errors")
+            self.set_header("Retry-After", "1")
+            self.write_json({"error": "no live replica"}, status=503)
             return
+        if a.kind == "deadline":
+            raise tornado.web.HTTPError(
+                504, reason="request deadline exceeded (router)")
+        if a.kind == "exhausted":
+            if a.expired:
+                raise tornado.web.HTTPError(
+                    504, reason="request deadline exceeded "
+                                "(router retries)") from a.error
+            if a.draining:
+                # The replica answered cleanly — reflect its drain
+                # rejection as the 503 it was, not a 502. NOT counted
+                # as a shed: sheds feed the autoscaler's scale-out
+                # signal, and a drain rejection is the opposite of
+                # overload evidence.
+                self.router._bump("draining_rejects")
+                self.set_header("Retry-After", "1")
+                self.set_header(DRAINING_HEADER, "1")
+                self.write_json(
+                    {"error": f"replica {a.name} draining"}, status=503)
+                return
+            raise tornado.web.HTTPError(
+                502, reason=f"replica {a.name} unreachable: {a.error}") \
+                from a.error
+        if a.kind == "timeout":
+            raise tornado.web.HTTPError(
+                504, reason=f"replica {a.name} timed out: {a.error}") \
+                from a.error
+        self.set_header(REPLICA_HEADER, a.name)
+        self.set_header(ATTEMPTS_HEADER, str(state.attempts))
+        try:
+            await self._relay(a.result, a.name, trace_id, a.t0)
+        finally:
+            self.fleet.checkin(a.name)
 
     def _remaining_headers(self, trace_id: str,
                            deadline: Deadline | None,
@@ -562,6 +532,116 @@ class ProxyHandler(_RouterBase):
             rem = deadline.remaining()
             headers[DEADLINE_HEADER] = str(max(int((rem or 0.0) * 1e3), 1))
         return headers
+
+    async def _forward_attempt(
+            self, *, state: _ForwardState, key: str | None,
+            intent: str | None, method: str, path: str,
+            body: bytes | None, content_type: str | None,
+            trace_id: str, deadline: Deadline | None, read_body: bool,
+            retryable: bool = True, retry_reason: str | None = None,
+            drain_rejects: bool = False,
+            count_handoff: bool = False) -> _Attempt:
+        """ONE place → checkout → forward → classify pass, shared by
+        the unified proxy loop and both disaggregation phases (prefill,
+        decode/resume). Owns the retry loop for connect-class failures
+        and drain rejections: nothing reached the caller on those, so
+        re-placing elsewhere is safe — `state` carries the exclusions
+        and attempt budget so a re-entrant caller (decode resume) keeps
+        both across calls. All counting the three callers share lives
+        here (pre-forward deadline, retry/exhausted/timeout metrics);
+        terminal outcomes come back as an `_Attempt` for the caller to
+        render, because the renders legitimately differ (the unified
+        path raises HTTPErrors, a started decode stream must close with
+        an error frame instead). On ``ok`` the replica is STILL checked
+        out — the caller owns the checkin after relaying.
+
+        `retryable=False` (non-inference non-GET traffic) turns the
+        first connect failure terminal. `retry_reason` overrides the
+        draining/connect retry label (decode passes "prefill_handoff");
+        `count_handoff` adds the handoff_retries bump. `drain_rejects`
+        (unified path only) keeps a drain-exhausted terminal out of the
+        error count — a drain rejection is the opposite of overload
+        evidence — so the caller can render it as the 503 it was."""
+        loop = asyncio.get_event_loop()
+        max_attempts = max(len(self.fleet.names()), 1)
+        while True:
+            with obs.span("router.place", trace_id=trace_id,
+                          path=path) as sp:
+                name, reason = self.router.place(
+                    key, exclude=frozenset(state.exclude), intent=intent)
+                sp.set(replica=name or "-", reason=reason)
+            if name is None:
+                return _Attempt("no_replica")
+            url = self.fleet.url_of(name)
+            if url is None:
+                state.exclude.add(name)
+                continue
+            if deadline is not None and deadline.expired():
+                self._count(name, "deadline")
+                res_metrics.inc("tpk_deadline_expired_total",
+                                component="router")
+                return _Attempt("deadline", name=name)
+            headers = self._remaining_headers(trace_id, deadline,
+                                              content_type)
+            timeout_s = (deadline.bound(self.server.forward_timeout_s)
+                         if deadline is not None
+                         else self.server.forward_timeout_s)
+            self.fleet.checkout(name)
+            state.attempts += 1
+            t0 = time.perf_counter()
+            try:
+                result = await loop.run_in_executor(
+                    self.server.executor, _forward_once, url, method,
+                    path, body, headers, timeout_s, read_body)
+            except RetryableForwardError as e:
+                draining = "draining" in str(e)
+                self.fleet.checkin(name, failed=not draining)
+                obs.record("router.forward", t0, time.perf_counter(),
+                           trace_id=trace_id, replica=name,
+                           error=str(e)[:120])
+                expired = deadline is not None and deadline.expired()
+                if (retryable and state.attempts <= max_attempts
+                        and not expired):
+                    state.exclude.add(name)
+                    res_metrics.inc(
+                        "tpk_router_retry_total",
+                        reason=(retry_reason if retry_reason
+                                else "draining" if draining
+                                else "connect"))
+                    self.router._bump("retries")
+                    if count_handoff:
+                        self.router._bump("handoff_retries")
+                    continue
+                self._count(name, "deadline" if expired
+                            else "draining" if draining and drain_rejects
+                            else "retry_exhausted")
+                if expired or not (draining and drain_rejects):
+                    self.router._bump("errors")
+                if expired:
+                    res_metrics.inc("tpk_deadline_expired_total",
+                                    component="router")
+                return _Attempt("exhausted", name=name, error=e,
+                                expired=expired, draining=draining)
+            except ForwardTimeoutError as e:
+                # The replica may still be executing the request: no
+                # replay (that would duplicate decode work) and no
+                # failure mark (slow is not dead) — the caller renders
+                # a 504. The gray-ejection EWMA still gets the latency
+                # evidence.
+                self.fleet.checkin(name)
+                self.fleet.observe_forward(name, timeout_s)
+                obs.record("router.forward", t0, time.perf_counter(),
+                           trace_id=trace_id, replica=name,
+                           error=str(e)[:120])
+                self._count(name, "upstream_error")
+                self.router._bump("errors")
+                return _Attempt("timeout", name=name, error=e)
+            except Exception:
+                # Anything non-retryable still releases the outstanding
+                # count, or a drain on this replica would wait forever.
+                self.fleet.checkin(name)
+                raise
+            return _Attempt("ok", name=name, result=result, t0=t0)
 
     async def _proxy_disagg(self, route: str, trace_id: str,
                             deadline: Deadline | None, key: str | None,
@@ -577,16 +657,9 @@ class ProxyHandler(_RouterBase):
         with the same bytes (`tpk_router_retry_total{reason=
         "prefill_handoff"}`), never replaying prefill work. Returns
         False to fall through to the unified single-phase path (no
-        prefill replica placeable / unmapped surface).
-
-        KEEP IN SYNC with _proxy's forward/retry loop: both phases
-        below deliberately mirror its place → checkout → forward →
-        checkin → classify machinery (the phases differ in intent,
-        path, body, retry reason, and read_body mode, so the loops are
-        parameter-shaped rather than textually twinnable) — a
-        hardening fix landing in the unified loop (deadline guards,
-        draining classification, checkin ordering) almost certainly
-        belongs in both phases here too."""
+        prefill replica placeable / unmapped surface). Both phases ride
+        `_forward_attempt` — the same place → checkout → forward →
+        classify machinery as the unified loop."""
         if route.endswith(":generate"):
             model = route.rsplit("/", 1)[-1][:-len(":generate")]
         elif route.endswith("/generate"):
@@ -596,110 +669,60 @@ class ProxyHandler(_RouterBase):
             return False  # no :prefill mapping for this surface
         if not model:
             return False
-        loop = asyncio.get_event_loop()
         prefill_path = f"/v1/models/{model}:prefill"
         decode_path = f"/v1/models/{model}:decode"
-        max_attempts = max(len(self.fleet.names()), 1)
         t_handoff0 = time.perf_counter()
 
         # -- phase 1: chunked prefill → KV shipment ----------------------
-        shipment: bytes | None = None
-        exclude: set[str] = set()
-        attempts = 0
-        while shipment is None:
-            with obs.span("router.place", trace_id=trace_id,
-                          path=prefill_path) as sp:
-                name, reason = self.router.place(
-                    key, frozenset(exclude), intent="prefill")
-                sp.set(replica=name or "-", reason=reason)
-            if name is None:
-                if attempts == 0:
-                    return False  # no prefill capacity: unified path
-                self._count(None, "no_replica")
-                self.router._bump("errors")
-                self.set_header("Retry-After", "1")
-                self.write_json({"error": "no live prefill replica"},
-                                status=503)
-                return True
-            url = self.fleet.url_of(name)
-            if url is None:
-                exclude.add(name)
-                continue
-            if deadline is not None and deadline.expired():
-                self._count(name, "deadline")
-                res_metrics.inc("tpk_deadline_expired_total",
-                                component="router")
+        # A pre-ship failure computed nothing for this request yet, so
+        # re-placing the prefill is the plain connect/draining retry
+        # class, not a handoff.
+        pstate = _ForwardState()
+        a = await self._forward_attempt(
+            state=pstate, key=key, intent="prefill", method="POST",
+            path=prefill_path, body=self.request.body or None,
+            content_type="application/json", trace_id=trace_id,
+            deadline=deadline, read_body=True)
+        if a.kind == "no_replica":
+            if pstate.attempts == 0:
+                return False  # no prefill capacity: unified path
+            self._count(None, "no_replica")
+            self.router._bump("errors")
+            self.set_header("Retry-After", "1")
+            self.write_json({"error": "no live prefill replica"},
+                            status=503)
+            return True
+        if a.kind == "deadline":
+            raise tornado.web.HTTPError(
+                504, reason="request deadline exceeded (router)")
+        if a.kind == "exhausted":
+            if a.expired:
                 raise tornado.web.HTTPError(
-                    504, reason="request deadline exceeded (router)")
-            headers = self._remaining_headers(trace_id, deadline,
-                                              "application/json")
-            timeout_s = (deadline.bound(self.server.forward_timeout_s)
-                         if deadline is not None
-                         else self.server.forward_timeout_s)
-            self.fleet.checkout(name)
-            attempts += 1
-            t0 = time.perf_counter()
-            try:
-                result = await loop.run_in_executor(
-                    self.server.executor, _forward_once, url, "POST",
-                    prefill_path, self.request.body or None, headers,
-                    timeout_s, True)
-            except RetryableForwardError as e:
-                # Pre-ship failure: nothing was computed for this
-                # request yet, so re-placing the PREFILL is safe — the
-                # plain connect/draining retry class, not a handoff.
-                self.fleet.checkin(name,
-                                   failed="draining" not in str(e))
-                obs.record("router.forward", t0, time.perf_counter(),
-                           trace_id=trace_id, replica=name,
-                           error=str(e)[:120])
-                expired = deadline is not None and deadline.expired()
-                if attempts <= max_attempts and not expired:
-                    exclude.add(name)
-                    res_metrics.inc(
-                        "tpk_router_retry_total",
-                        reason=("draining" if "draining" in str(e)
-                                else "connect"))
-                    self.router._bump("retries")
-                    continue
-                self._count(name, "deadline" if expired
-                            else "retry_exhausted")
-                self.router._bump("errors")
-                if expired:
-                    res_metrics.inc("tpk_deadline_expired_total",
-                                    component="router")
-                    raise tornado.web.HTTPError(
-                        504, reason="request deadline exceeded "
-                                    "(router retries)") from e
-                raise tornado.web.HTTPError(
-                    502, reason=f"prefill replica {name} unreachable: "
-                                f"{e}") from e
-            except ForwardTimeoutError as e:
-                self.fleet.checkin(name)
-                self.fleet.observe_forward(name, timeout_s)
-                self._count(name, "upstream_error")
-                self.router._bump("errors")
-                raise tornado.web.HTTPError(
-                    504, reason=f"prefill replica {name} timed out: "
-                                f"{e}") from e
-            except Exception:
-                self.fleet.checkin(name)
-                raise
-            self.fleet.checkin(name)
-            if result.status != 200:
-                # Sheds forward as backpressure, errors relay as-is —
-                # exactly the unified path's contract. (_relay observes
-                # the forward latency itself — observing here too would
-                # double-count the sample into the gray EWMA.)
-                self.set_header(REPLICA_HEADER, name)
-                self.set_header(ATTEMPTS_HEADER, str(attempts))
-                await self._relay(result, name, trace_id, t0)
-                return True
-            self.fleet.observe_forward(name, time.perf_counter() - t0)
-            obs.record("router.forward", t0, time.perf_counter(),
-                       trace_id=trace_id, replica=name, status=200,
-                       phase="prefill")
-            shipment = result.body
+                    504, reason="request deadline exceeded "
+                                "(router retries)") from a.error
+            raise tornado.web.HTTPError(
+                502, reason=f"prefill replica {a.name} unreachable: "
+                            f"{a.error}") from a.error
+        if a.kind == "timeout":
+            raise tornado.web.HTTPError(
+                504, reason=f"prefill replica {a.name} timed out: "
+                            f"{a.error}") from a.error
+        name, result, t0 = a.name, a.result, a.t0
+        self.fleet.checkin(name)
+        if result.status != 200:
+            # Sheds forward as backpressure, errors relay as-is —
+            # exactly the unified path's contract. (_relay observes
+            # the forward latency itself — observing here too would
+            # double-count the sample into the gray EWMA.)
+            self.set_header(REPLICA_HEADER, name)
+            self.set_header(ATTEMPTS_HEADER, str(pstate.attempts))
+            await self._relay(result, name, trace_id, t0)
+            return True
+        self.fleet.observe_forward(name, time.perf_counter() - t0)
+        obs.record("router.forward", t0, time.perf_counter(),
+                   trace_id=trace_id, replica=name, status=200,
+                   phase="prefill")
+        shipment = result.body
         res_metrics.observe("tpk_prefill_handoff_seconds",
                             time.perf_counter() - t_handoff0)
         self.router._bump("handoffs")
@@ -715,20 +738,25 @@ class ProxyHandler(_RouterBase):
         # tokens, no caller-visible error. Bounded by `max_resumes` and
         # the caller's riding deadline; once those run out the stream
         # ends with a terminal error frame + honest abrupt close.
-        exclude2: set[str] = set()
-        attempts2 = 0
+        dstate = _ForwardState()
         resumes = 0
         delivered = 0           # whole-frame tokens already at the caller
         stream_started = False  # status+headers already on the wire
         served: list[str] = []
         active_shipment = shipment
         while True:
-            with obs.span("router.place", trace_id=trace_id,
-                          path=decode_path) as sp:
-                dname, reason = self.router.place(
-                    None, frozenset(exclude2), intent="decode")
-                sp.set(replica=dname or "-", reason=reason)
-            if dname is None:
+            # THE handoff-resume path: the prefill work is safe in the
+            # router-held shipment, so a dead/draining decode target
+            # costs one re-placement and ZERO re-prefill. One `dstate`
+            # across resume iterations: a resumed stream keeps its
+            # exclusions and does NOT get a fresh attempt budget.
+            a = await self._forward_attempt(
+                state=dstate, key=None, intent="decode", method="POST",
+                path=decode_path, body=active_shipment,
+                content_type="application/x-tpk-kv", trace_id=trace_id,
+                deadline=deadline, read_body=not wants_stream,
+                retry_reason="prefill_handoff", count_handoff=True)
+            if a.kind == "no_replica":
                 self._count(None, "no_replica")
                 self.router._bump("errors")
                 if stream_started:
@@ -740,14 +768,7 @@ class ProxyHandler(_RouterBase):
                 self.write_json({"error": "no live decode replica"},
                                 status=503)
                 return True
-            url = self.fleet.url_of(dname)
-            if url is None:
-                exclude2.add(dname)
-                continue
-            if deadline is not None and deadline.expired():
-                self._count(dname, "deadline")
-                res_metrics.inc("tpk_deadline_expired_total",
-                                component="router")
+            if a.kind == "deadline":
                 if stream_started:
                     self.router._bump("errors")
                     self.router._bump("resume_failures")
@@ -756,42 +777,8 @@ class ProxyHandler(_RouterBase):
                     return True
                 raise tornado.web.HTTPError(
                     504, reason="request deadline exceeded (router)")
-            headers = self._remaining_headers(
-                trace_id, deadline, "application/x-tpk-kv")
-            timeout_s = (deadline.bound(self.server.forward_timeout_s)
-                         if deadline is not None
-                         else self.server.forward_timeout_s)
-            self.fleet.checkout(dname)
-            attempts2 += 1
-            t0 = time.perf_counter()
-            try:
-                result = await loop.run_in_executor(
-                    self.server.executor, _forward_once, url, "POST",
-                    decode_path, active_shipment, headers, timeout_s,
-                    not wants_stream)
-            except RetryableForwardError as e:
-                # THE handoff-resume path: the prefill work is safe in
-                # the router-held shipment, so a dead/draining decode
-                # target costs one re-placement and ZERO re-prefill.
-                self.fleet.checkin(dname,
-                                   failed="draining" not in str(e))
-                obs.record("router.forward", t0, time.perf_counter(),
-                           trace_id=trace_id, replica=dname,
-                           error=str(e)[:120])
-                expired = deadline is not None and deadline.expired()
-                if attempts2 <= max_attempts and not expired:
-                    exclude2.add(dname)
-                    res_metrics.inc("tpk_router_retry_total",
-                                    reason="prefill_handoff")
-                    self.router._bump("retries")
-                    self.router._bump("handoff_retries")
-                    continue
-                self._count(dname, "deadline" if expired
-                            else "retry_exhausted")
-                self.router._bump("errors")
-                if expired:
-                    res_metrics.inc("tpk_deadline_expired_total",
-                                    component="router")
+            if a.kind == "exhausted":
+                if a.expired:
                     if stream_started:
                         self.router._bump("resume_failures")
                         await self._stream_error_close(
@@ -799,38 +786,32 @@ class ProxyHandler(_RouterBase):
                         return True
                     raise tornado.web.HTTPError(
                         504, reason="request deadline exceeded "
-                                    "(router retries)") from e
+                                    "(router retries)") from a.error
                 if stream_started:
                     self.router._bump("resume_failures")
                     await self._stream_error_close(
-                        f"decode replica {dname} unreachable during "
-                        f"resume: {e}")
+                        f"decode replica {a.name} unreachable during "
+                        f"resume: {a.error}")
                     return True
                 raise tornado.web.HTTPError(
-                    502, reason=f"decode replica {dname} unreachable: "
-                                f"{e}") from e
-            except ForwardTimeoutError as e:
+                    502, reason=f"decode replica {a.name} unreachable: "
+                                f"{a.error}") from a.error
+            if a.kind == "timeout":
                 # The decode replica may still be generating: 504, no
                 # replay (a replay would duplicate decode work).
-                self.fleet.checkin(dname)
-                self.fleet.observe_forward(dname, timeout_s)
-                self._count(dname, "upstream_error")
-                self.router._bump("errors")
                 if stream_started:
                     self.router._bump("resume_failures")
                     await self._stream_error_close(
-                        f"decode replica {dname} timed out: {e}")
+                        f"decode replica {a.name} timed out: {a.error}")
                     return True
                 raise tornado.web.HTTPError(
-                    504, reason=f"decode replica {dname} timed out: "
-                                f"{e}") from e
-            except Exception:
-                self.fleet.checkin(dname)
-                raise
+                    504, reason=f"decode replica {a.name} timed out: "
+                                f"{a.error}") from a.error
+            dname, result, t0 = a.name, a.result, a.t0
             if not wants_stream:
                 self.set_header(REPLICA_HEADER, dname)
                 self.set_header(ATTEMPTS_HEADER,
-                                str(attempts + attempts2))
+                                str(pstate.attempts + dstate.attempts))
                 try:
                     await self._relay(result, dname, trace_id, t0)
                 finally:
@@ -856,7 +837,7 @@ class ProxyHandler(_RouterBase):
                 # — exactly the unified path's contract).
                 self.set_header(REPLICA_HEADER, dname)
                 self.set_header(ATTEMPTS_HEADER,
-                                str(attempts + attempts2))
+                                str(pstate.attempts + dstate.attempts))
                 try:
                     await self._relay(result, dname, trace_id, t0)
                 finally:
@@ -865,7 +846,7 @@ class ProxyHandler(_RouterBase):
             if not stream_started:
                 self.set_header(REPLICA_HEADER, dname)
                 self.set_header(ATTEMPTS_HEADER,
-                                str(attempts + attempts2))
+                                str(pstate.attempts + dstate.attempts))
             prov = {"replicas": served + [dname], "resumes": resumes}
             try:
                 status, delta, err, flushed = await self._relay_ndjson(
@@ -918,7 +899,7 @@ class ProxyHandler(_RouterBase):
             res_metrics.inc("tpk_router_resume_total",
                             reason="stall" if stalled else "death")
             self.router._bump("resumes")
-            exclude2.add(dname)
+            dstate.exclude.add(dname)
             # Stamp the cursor on the ORIGINAL held bytes (idempotent —
             # each resume restates the full delivered count).
             from kubeflow_tpu.serve.kv_transfer import rewrite_meta

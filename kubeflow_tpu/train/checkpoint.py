@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import logging
 import os
+import shutil
 from typing import Any
 
 import orbax.checkpoint as ocp
@@ -30,6 +31,39 @@ _LOG = logging.getLogger(__name__)
 #: deleted) so an operator can post-mortem the torn write.
 QUARANTINE_DIR = "quarantine"
 
+#: Marker orbax puts in its in-flight save directories
+#: (`<step>.orbax-checkpoint-tmp-<n>`). One left on disk at manager init
+#: is torn garbage from a killed attempt.
+_TMP_MARKER = ".orbax-checkpoint-tmp-"
+
+
+def _sweep_stale_tmp(directory: str) -> list[str]:
+    """Delete torn `*.orbax-checkpoint-tmp-*` dirs under `directory`.
+
+    A kill mid-async-save (the elastic-downsize SIGKILL path) leaves the
+    in-flight tmp dir behind; the relaunched attempt then re-saves the
+    same step and the collision can abort the writer natively — no
+    Python traceback, just a signal exit that the controller reads as
+    yet another worker failure and answers with a second (spurious)
+    downsize. At manager init no save can be in flight — the gang
+    restarts as a unit — so anything matching the marker is garbage.
+    Per-entry errors are swallowed: gang peers may sweep concurrently,
+    and a tmp dir we cannot remove only costs what it always did."""
+    swept: list[str] = []
+    try:
+        entries = os.listdir(directory)
+    except OSError:
+        return swept
+    for name in entries:
+        if _TMP_MARKER not in name:
+            continue
+        try:
+            shutil.rmtree(os.path.join(directory, name))
+        except OSError:
+            continue
+        swept.append(name)
+    return swept
+
 
 class CheckpointManager:
     """Thin wrapper over ocp.CheckpointManager for TrainState pytrees."""
@@ -40,6 +74,13 @@ class CheckpointManager:
         self.interval = interval
         self._keep = keep
         self._async_save = async_save
+        swept = _sweep_stale_tmp(self.directory)
+        if swept:
+            resilience.metrics.inc("tpk_checkpoint_tmp_swept_total",
+                                   float(len(swept)), component="train")
+            _LOG.warning(
+                "swept %d torn orbax tmp dir(s) under %s: %s",
+                len(swept), self.directory, ", ".join(sorted(swept)))
         options = ocp.CheckpointManagerOptions(
             save_interval_steps=interval,
             max_to_keep=keep,
@@ -136,7 +177,16 @@ class CheckpointManager:
         the next-newest — so a torn checkpoint costs one interval of
         recompute instead of burning the whole backoff budget on a
         permanently poisoned restore. Returns (state, step, quarantined);
-        (template, None, [...]) when nothing restorable remains."""
+        (template, None, [...]) when nothing restorable remains.
+
+        Elastic-resize contract: steps on disk may have been written by
+        a DIFFERENT fsdp topology — orbax saves logical arrays and
+        restores into whatever shardings `state_template` carries, so
+        the template's (current) mesh governs and the fallback chain is
+        topology-agnostic. A SIGKILL mid-save of the first post-resize
+        checkpoint therefore quarantines that torn step and lands on the
+        last good PRE-resize step, resharding it on the way in
+        (tests/test_faults.py pins the crash-during-resize case)."""
         quarantined: list[int] = []
         while True:
             step = self.latest_step()

@@ -378,7 +378,8 @@ class Trainer:
             self._ckpt = CheckpointManager(
                 spec.checkpoint["dir"],
                 interval=spec.checkpoint.get("interval", 50),
-                keep=spec.checkpoint.get("keep", 3))
+                keep=spec.checkpoint.get("keep", 3),
+                async_save=spec.checkpoint.get("async_save", True))
         self.logger = MetricsLogger(spec.metrics_path)
 
     def _post_event(self, reason: str, message: str = "") -> None:
@@ -674,6 +675,11 @@ class Trainer:
                                      opt_bytes, component="train")
         resilience.metrics.set_gauge("tpk_train_grad_accum_steps",
                                      self.grad_accum, component="train")
+        # The live fsdp topology this attempt is training at — under an
+        # elastic resize the controller rewrites runtime.json, so this
+        # gauge is how dashboards see the post-resize mesh.
+        resilience.metrics.set_gauge("tpk_train_fsdp_size",
+                                     spec.fsdp, component="train")
         self.logger.log(start_step, {
             "event": "state_sharding", "fsdp": spec.fsdp,
             "param_bytes_per_chip": param_bytes,
@@ -786,8 +792,13 @@ class Trainer:
                 return None
             # The iterator state is only valid for the same per-process
             # shard layout; tag it so an elastic resize (different world
-            # size) restarts the stream instead of mis-seeking.
-            return {"process_count": jax.process_count(), "state": st}
+            # size) restarts the stream instead of mis-seeking. The fsdp
+            # tag records the mesh the checkpoint trained at — resize
+            # detection on resume, not a seek invalidator (the stream is
+            # process-sharded, so a same-process-count fsdp resize seeks
+            # the exact trajectory).
+            return {"process_count": jax.process_count(), "state": st,
+                    "fsdp": spec.fsdp}
 
         dataset = self._data()
         data = iter(dataset)
@@ -798,6 +809,24 @@ class Trainer:
                 for _ in range(start_step):
                     next(data)
             elif (isinstance(saved, dict) and "process_count" in saved):
+                saved_fsdp = saved.get("fsdp")
+                if saved_fsdp is not None and saved_fsdp != spec.fsdp:
+                    # Elastic resize: the checkpoint was written by a
+                    # different fsdp topology and orbax just resharded it
+                    # into this one (restore_latest_good above). Record
+                    # the transition — the trajectory itself must not
+                    # notice (fp32 fsdp=N trains the replicated
+                    # trajectory exactly; PROFILE §14/§15).
+                    resilience.metrics.inc("tpk_train_reshard_restores_total",
+                                           component="train")
+                    self.logger.log(start_step, {
+                        "event": "resharded",
+                        "from_fsdp": int(saved_fsdp),
+                        "to_fsdp": int(spec.fsdp), "step": start_step})
+                    self._post_event(
+                        "Resharded",
+                        f"fsdp {int(saved_fsdp)} -> {int(spec.fsdp)} "
+                        f"at step {start_step}")
                 if saved["process_count"] == jax.process_count():
                     # Checkpointable iterators (grain) seek in O(1).
                     restore_iterator(data, saved.get("state"))

@@ -709,6 +709,129 @@ def test_kill9_resume_on_different_fsdp_topology(tmp_path):
                                rtol=1e-5)
 
 
+@pytest.mark.slow  # real-process kill-9 e2e
+def test_crash_during_resize_falls_back_to_pre_resize_step(tmp_path):
+    """ISSUE 17 resize-crash semantics: a 4-way run is SIGKILLed at step
+    5, resumes on a 2-way mesh (the elastic downsize), and is SIGKILLed
+    AGAIN mid-save of its first post-resize checkpoint (step 6) — the
+    on-disk shape is a torn 2-way step sitting newest above good 4-way
+    steps. The next 2-way attempt must quarantine the torn step, fall
+    back to the last good PRE-resize step (4, written at 4-way —
+    restore_latest_good's fallback chain is topology-agnostic because
+    orbax reshards into the current template), and converge to the same
+    trajectory as a resize that never crashed."""
+    import shutil
+    import subprocess
+    import sys
+
+    path = tmp_path / "corpus.npy"
+    np.save(path, np.random.default_rng(31).integers(0, 64, 20000,
+                                                     dtype=np.int32))
+
+    def spec_file(name, fsdp, ckpt_name, metrics=None):
+        from kubeflow_tpu.train.trainer import TrainJobSpec
+
+        sp = TrainJobSpec(
+            model="llama_tiny", model_kwargs={"dtype": "float32"},
+            dataset="token_file", dataset_kwargs={"path": str(path)},
+            fsdp=fsdp, steps=8, batch_size=4, seq_len=16,
+            learning_rate=1e-3, log_every=4, prefetch=2,
+            metrics_path=str(tmp_path / metrics) if metrics else None,
+            checkpoint={"dir": str(tmp_path / ckpt_name), "interval": 2})
+        f = tmp_path / f"{name}.json"
+        f.write_text(sp.to_json())
+        return str(f)
+
+    def run(spec_path, devices, fault=None, expect_kill=False):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("TPK_FAULT", None)
+        if fault:
+            env["TPK_FAULT"] = fault
+        p = subprocess.run(
+            [sys.executable, "-m", "kubeflow_tpu.train.trainer",
+             "--spec", spec_path, "--cpu-devices", str(devices)],
+            capture_output=True, text=True, env=env, timeout=600)
+        if expect_kill:
+            assert p.returncode == -signal.SIGKILL, (p.returncode,
+                                                     p.stderr[-2000:])
+            return None
+        assert p.returncode == 0, p.stderr[-2000:]
+        line = [l for l in p.stdout.splitlines() if '"result"' in l][-1]
+        return json.loads(line)["result"]
+
+    # Crash on the 4-way mesh at step 5: good checkpoints at 2 and 4.
+    run(spec_file("rc4", 4, "rcdir"), devices=4,
+        fault="step=5;signal=9", expect_kill=True)
+
+    # Reference arm: the resize that never crashes again — resumes the
+    # same step-4 checkpoint on 2-way and runs clean to completion.
+    shutil.copytree(tmp_path / "rcdir", tmp_path / "rcref")
+    reference = run(spec_file("rcref2", 2, "rcref"), devices=2)
+    assert reference["final_step"] == 8
+
+    # Crash arm: the 2-way resume is killed at step 7 — right after its
+    # first post-resize checkpoint (step 6, written at 2-way) lands.
+    # Then tear that step 6: the torn-first-post-resize-checkpoint case.
+    run(spec_file("rc2", 2, "rcdir"), devices=2,
+        fault="step=7;signal=9", expect_kill=True)
+    from kubeflow_tpu.train.checkpoint import CheckpointManager
+
+    assert 6 in CheckpointManager(str(tmp_path / "rcdir")).all_steps()
+    _corrupt_step_dir(tmp_path / "rcdir", 6)
+
+    final = run(spec_file("rc2b", 2, "rcdir", metrics="rc.jsonl"),
+                devices=2)
+
+    # Torn post-resize step quarantined (kept for post-mortem, skipped
+    # by the step scan)...
+    from kubeflow_tpu.train.checkpoint import QUARANTINE_DIR
+
+    qdir = os.path.join(str(tmp_path / "rcdir"), QUARANTINE_DIR)
+    assert os.path.isdir(qdir) and "6" in os.listdir(qdir)
+    # ...and the run fell back to the pre-resize step 4 — visible as the
+    # reshard-on-restore event (4 -> 2 again, from the 4-way step), the
+    # quarantine event, and a completed run.
+    events = [json.loads(l)
+              for l in (tmp_path / "rc.jsonl").read_text().splitlines()]
+    assert any(e.get("event") == "checkpoint_quarantined"
+               and e["step"] == 6 for e in events)
+    resharded = [e for e in events if e.get("event") == "resharded"]
+    assert resharded and resharded[0]["from_fsdp"] == 4 \
+        and resharded[0]["to_fsdp"] == 2
+    assert any(e.get("event") == "restored" and e["step"] == 4
+               for e in events)
+    # Same checkpoint bytes, same 2-way topology, same data seek as the
+    # reference resize: the recovered trajectory is bit-identical.
+    assert final["final_step"] == 8
+    assert final["loss"] == reference["loss"]
+
+
+def test_stale_orbax_tmp_swept_at_manager_init(tmp_path):
+    """A SIGKILL mid-async-save leaves `<step>.orbax-checkpoint-tmp-<n>`
+    on disk. Left in place, the relaunched attempt's re-save of that
+    same step collides with it and can abort the writer natively — no
+    traceback, a signal exit the controller reads as another worker
+    failure and answers with a second (spurious) elastic downsize.
+    Manager init must sweep the torn tmp dirs: at init no save can be
+    in flight, because the gang restarts as a unit."""
+    from kubeflow_tpu.train.checkpoint import CheckpointManager
+
+    root = tmp_path / "ck"
+    torn = root / "6.orbax-checkpoint-tmp-21"
+    (torn / "state").mkdir(parents=True)
+    (torn / "state" / "array.bin").write_bytes(b"\x00" * 16)
+    before = resilience.metrics.get("tpk_checkpoint_tmp_swept_total",
+                                    component="train")
+    mgr = CheckpointManager(str(root), interval=2)
+    try:
+        assert not torn.exists()
+        assert mgr.all_steps() == []
+        assert resilience.metrics.get("tpk_checkpoint_tmp_swept_total",
+                                      component="train") == before + 1
+    finally:
+        mgr.close()
+
+
 def test_trainer_restart_policy_validation(devices8):
     from kubeflow_tpu.train.trainer import TrainJobSpec, Trainer
 

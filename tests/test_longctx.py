@@ -32,6 +32,7 @@ def test_measure_tiny_shape():
     assert r["device_kind"] == jax.devices()[0].device_kind
 
 
+@pytest.mark.slow  # live knob sweep; heaviest representative here
 def test_tune_point_tiny_shape():
     """The knob sweep (bench.py --longctx-tune) runs off-chip on the
     tiny shape: every variant measured or its failure recorded inline,

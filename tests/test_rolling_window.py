@@ -64,6 +64,7 @@ def test_rolling_cache_layout():
     assert "pos" not in within and within["k"].shape[2] == WINDOW
 
 
+@pytest.mark.slow  # heaviest representative; full tier covers it
 def test_engine_rolls_past_window(windowed_model):
     """Long prompt (chunked admission) + decode across the wrap boundary,
     token-identical to the full-forward oracle."""

@@ -1665,6 +1665,7 @@ def test_grpc_router_midstream_death_counted_and_retried():
         srv1.stop()
 
 
+@pytest.mark.slow  # live quick bench re-run; the artifact pin is tier-1
 def test_routerbench_quick_shape():
     from kubeflow_tpu.serve.loadgen import run_routerbench
 
