@@ -313,13 +313,16 @@ def main_serve() -> None:
                           "representative of chip performance; relative "
                           "metrics (bucket speedup, int8 delta, batcher "
                           "percentiles) remain meaningful.")
-        for ab in ("pipelined_vs_sync", "paged_vs_flat"):
-            # Chip-sensitive A/Bs: the tunnel-RTT-hiding claim and the
-            # paged pool's HBM headroom both need the chip; record the
-            # chip measurement as skipped-with-reason per BENCH_r05
-            # precedent while keeping the CPU harness numbers (the
-            # mechanism proofs — overlapped fetches, host-stall split,
-            # peak paged concurrency over flat slots — still populate).
+        for ab in ("pipelined_vs_sync", "paged_vs_flat", "spec_paged"):
+            # Chip-sensitive A/Bs: the tunnel-RTT-hiding claim, the
+            # paged pool's HBM headroom, and the spec-decode speedup
+            # (draft-step cost is chip-relative) all need the chip;
+            # record the chip measurement as skipped-with-reason per
+            # BENCH_r05 precedent while keeping the CPU harness numbers
+            # (the mechanism proofs — overlapped fetches, host-stall
+            # split, peak paged concurrency over flat slots, greedy
+            # identity + mixed-traffic speculation counters — still
+            # populate).
             if ab in result:
                 result[ab]["tpu_measurement"] = {
                     "skipped": "tpu_unavailable",

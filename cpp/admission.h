@@ -587,6 +587,56 @@ inline std::string ValidateSpec(const std::string& kind, const Json& spec) {
             field, value, gtable.get(field), "model.generative.");
         if (!gerr.empty()) return gerr;
       }
+      // Cross-field composition rules (ISSUE 18): the engine refusals
+      // that are expressible from the spec alone move here, so an
+      // invalid composition rejects at submit instead of crash-looping
+      // the replica at load. Checkpoint-derived refusals (sliding-
+      // window draft past its window, draft/target vocab mismatch,
+      // rolling-window × paged) stay load-time — admission cannot see
+      // the checkpoint.
+      const int64_t kv_bs = gen.get("kv_block_size").as_int(0);
+      const Json& role = gen.get("role");
+      if (role.is_string() && role.as_string() != "unified" &&
+          kv_bs == 0) {
+        return "model.generative.role=" + role.as_string() +
+               " needs kv_block_size > 0 (KV blocks are the "
+               "prefill->decode wire unit)";
+      }
+      if (gen.get("kv_blocks").as_int(0) > 0 && kv_bs == 0) {
+        return "model.generative.kv_blocks needs kv_block_size > 0 "
+               "(a block count without a block size is meaningless)";
+      }
+      if (gen.get("kv_host_tier_blocks").as_int(0) > 0 && kv_bs == 0) {
+        return "model.generative.kv_host_tier_blocks needs "
+               "kv_block_size > 0 (the host tier spills whole blocks)";
+      }
+      const Json& draft = gen.get("draft");
+      if (draft.is_object()) {
+        static const std::set<std::string> kDraftKeys = {
+            "checkpoint", "gamma", "model_overrides"};
+        for (const auto& [dk, dv] : draft.items()) {
+          (void)dv;
+          if (!kDraftKeys.count(dk)) {
+            return "model.generative.draft." + dk +
+                   " is not a draft knob (checkpoint | gamma | "
+                   "model_overrides)";
+          }
+        }
+        if (draft.get("checkpoint").as_string().empty()) {
+          return "model.generative.draft needs a checkpoint (HF dir "
+                 "of the draft model)";
+        }
+        const Json& gamma = draft.get("gamma");
+        if (!gamma.is_null() &&
+            (!IsIntegralNumber(gamma) || gamma.as_int() < 1)) {
+          return "model.generative.draft.gamma must be an integer >= 1";
+        }
+        const Json& ovr = draft.get("model_overrides");
+        if (!ovr.is_null() && !ovr.is_object()) {
+          return "model.generative.draft.model_overrides must be an "
+                 "object";
+        }
+      }
     }
     // Tensor-parallel serving mesh: {"tensor": 8} etc. The axis product
     // is the device count one replica's SPMD program spans — it must be

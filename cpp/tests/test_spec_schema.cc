@@ -198,12 +198,32 @@ int main() {
           CHECK(!ValidateGenerative(One(field, low)).empty());
         }
       } else if (type == "object") {
-        CHECK(ValidateGenerative(One(field, Json::Object())).empty());
+        // draft has cross-field content rules (below) — an empty
+        // object is rightly rejected, so probe with a minimal valid
+        // instance instead.
+        Json obj = Json::Object();
+        if (field == "draft") obj["checkpoint"] = "/d";
+        CHECK(ValidateGenerative(One(field, obj)).empty());
         CHECK(!ValidateGenerative(One(field, 5)).empty());
       } else if (type == "string_or_null") {
-        CHECK(ValidateGenerative(One(field, "x")).empty());
-        CHECK(ValidateGenerative(One(field, nullptr)).empty());
-        CHECK(!ValidateGenerative(One(field, 5)).empty());
+        // role additionally has a cross-field rule (split roles need
+        // kv_block_size > 0) — satisfy it so the enum probe isolates
+        // the schema check.
+        auto probe = [&](Json v) {
+          Json g = One(field, std::move(v));
+          if (field == "role") g["kv_block_size"] = 16;
+          return ValidateGenerative(std::move(g));
+        };
+        if (entry.has("enum")) {
+          for (const auto& e : entry.get("enum").elements()) {
+            CHECK(probe(Json(e.as_string())).empty());
+          }
+          CHECK(!probe(Json("no-such-enum-value")).empty());
+        } else {
+          CHECK(probe(Json("x")).empty());
+        }
+        CHECK(probe(Json(nullptr)).empty());
+        CHECK(!probe(Json(int64_t{5})).empty());
       } else {
         fprintf(stderr, "FAIL: generative schema type %s unhandled\n",
                 type.c_str());
@@ -223,6 +243,57 @@ int main() {
                             Json::parse(R"({"model": {"model_dir": "/m"}})"))
               .empty());
     printf("generative knob table: %d fields enforced\n", gchecked);
+
+    // Cross-field composition rules (ISSUE 18): what used to crash-
+    // loop the replica at load now rejects at submit.
+    // Split roles need the paged pool.
+    Json gen = Json::Object();
+    gen["role"] = "prefill";
+    CHECK(ValidateGenerative(gen).find("needs kv_block_size") !=
+          std::string::npos);
+    gen["kv_block_size"] = 16;
+    CHECK(ValidateGenerative(gen).empty());
+    gen["role"] = "unified";
+    gen["kv_block_size"] = 0;
+    CHECK(ValidateGenerative(gen).empty());  // unified never needs it
+    // Block counts / host tier without a block size are meaningless.
+    CHECK(!ValidateGenerative(One("kv_blocks", 64)).empty());
+    CHECK(!ValidateGenerative(One("kv_host_tier_blocks", 64)).empty());
+    gen = One("kv_blocks", 64);
+    gen["kv_block_size"] = 16;
+    CHECK(ValidateGenerative(gen).empty());
+    // Draft spec contents: checkpoint required, gamma integral >= 1,
+    // typo'd keys loud. The draft COMPOSES with role + paging now, so
+    // the old draft-x-role / draft-x-paged refusals must NOT resurface.
+    Json draft = Json::Object();
+    CHECK(ValidateGenerative(One("draft", draft))
+              .find("needs a checkpoint") != std::string::npos);
+    draft["checkpoint"] = "/drafts/tiny";
+    CHECK(ValidateGenerative(One("draft", draft)).empty());
+    draft["gamma"] = 0;
+    CHECK(ValidateGenerative(One("draft", draft))
+              .find("gamma") != std::string::npos);
+    draft["gamma"] = 2.5;
+    CHECK(!ValidateGenerative(One("draft", draft)).empty());
+    draft["gamma"] = 4;
+    CHECK(ValidateGenerative(One("draft", draft)).empty());
+    draft["gamm"] = 4;
+    CHECK(ValidateGenerative(One("draft", draft))
+              .find("not a draft knob") != std::string::npos);
+    gen = Json::Object();
+    draft = Json::Object();
+    draft["checkpoint"] = "/drafts/tiny";
+    draft["model_overrides"] = 5;
+    CHECK(ValidateGenerative(One("draft", draft))
+              .find("model_overrides") != std::string::npos);
+    draft["model_overrides"] = Json::Object();
+    gen["draft"] = draft;
+    gen["role"] = "decode";
+    gen["kv_block_size"] = 16;
+    gen["kv_blocks"] = 64;
+    gen["pipeline_depth"] = 2;
+    CHECK(ValidateGenerative(gen).empty());  // spec x paged x disagg
+    printf("generative cross-field composition rules OK\n");
   }
 
   // --- Namespace defaults (PodDefaults analog) -------------------------

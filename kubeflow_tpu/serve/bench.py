@@ -470,6 +470,87 @@ def bench_paged_vs_flat(model, params, cfg, *, slots: int, max_len: int,
     return res
 
 
+def bench_spec_paged(model, params, cfg, *, slots: int, max_len: int,
+                     chunk: int, buckets, decode_tokens: int,
+                     rng: np.random.Generator) -> dict:
+    """ISSUE 18 tentpole A/B: speculative decoding composed with the
+    paged engine at pipeline_depth=2 — vanilla-paged vs spec-paged
+    (self-draft: the acceptance≈1 mechanism ceiling) on identical
+    seeded MIXED traffic, greedy rows plus one top-p row per wave, so
+    the per-sub-batch dispatch is what's measured (the old batch-wide
+    gate would zero speculation on exactly this traffic). The pool
+    carries both footprints (target + per-slot draft rows).
+    Fetch-synced per PROFILE §1: _drain returns when every request's
+    tokens are host-side. After the timed waves the SAME greedy prompt
+    runs through both engines — the spec output must be token+logprob-
+    identical, the lossless claim measured on the composed path."""
+    from kubeflow_tpu.serve.generation import GenerationEngine
+
+    bs = 16  # divides max_len and every power-of-two decode bucket
+    # Worst-case admission reserve doubles under speculation (the
+    # draft's per-slot rows live in the same pool) — size it so `slots`
+    # spec-able requests still fit concurrently.
+    pool_blocks = 2 * slots * max_len // bs
+    n_req = 2 * slots
+    prompts = [list(rng.integers(1, cfg.vocab_size, 16))
+               for _ in range(n_req)]
+    kws: list[dict] = [{"temperature": 0.0}] * (n_req - 1)
+    kws.append({"temperature": 0.9, "top_p": 0.9})
+    probe = list(rng.integers(1, cfg.vocab_size, 16))
+    res: dict[str, Any] = {}
+    ident: dict[str, Any] = {}
+    for label, draft in (
+            ("vanilla_paged", None),
+            ("spec_paged", {"model": model, "params": params,
+                            "cfg": cfg, "gamma": 4})):
+        eng = GenerationEngine(model, params, cfg, slots=slots,
+                               max_len=max_len, chunk=chunk,
+                               prefill_buckets=buckets, prefix_cache=0,
+                               pipeline_depth=2, kv_block_size=bs,
+                               kv_blocks=pool_blocks, draft=draft)
+        try:
+            dt, done = _drain(eng, prompts, decode_tokens,
+                              per_prompt_kwargs=kws)
+            s = eng.stats
+            emitted = sum(r["num_output_tokens"] for r in done)
+            row: dict[str, Any] = {
+                "pipeline_depth": 2,
+                "kv_block_size": bs,
+                "kv_blocks": pool_blocks,
+                "requests": n_req,
+                "wall_s": round(dt, 4),
+                "tok_s_e2e": round(emitted / max(dt, 1e-9), 1),
+                "decode_dispatches": s["decode_dispatches"],
+            }
+            if draft is not None:
+                row["spec_dispatches"] = s["spec_dispatches"]
+                row["spec_proposed"] = s["spec_proposed"]
+                row["spec_accepted"] = s["spec_accepted"]
+                row["spec_stale_rides"] = s["spec_stale_rides"]
+                row["acceptance"] = round(
+                    s["spec_accepted"] / max(s["spec_proposed"], 1), 3)
+            res[label] = row
+            out = eng.submit(probe, max_tokens=decode_tokens,
+                             temperature=0.0)
+            ident[label] = (out["output_ids"], out["output_logprobs"])
+        finally:
+            eng.close()
+    res["speedup_wall"] = round(
+        res["vanilla_paged"]["wall_s"]
+        / max(res["spec_paged"]["wall_s"], 1e-9), 3)
+    ids_v, lps_v = ident["vanilla_paged"]
+    ids_s, lps_s = ident["spec_paged"]
+    res["greedy_identical"] = bool(
+        ids_v == ids_s and np.allclose(lps_v, lps_s, rtol=1e-4,
+                                       atol=1e-5))
+    # The sub-batch split proof: a top-p row rode every wave, yet the
+    # greedy rows still proposed and accepted draft tokens.
+    res["mixed_traffic_speculated"] = bool(
+        res["spec_paged"]["spec_dispatches"] > 0
+        and res["spec_paged"]["spec_accepted"] > 0)
+    return res
+
+
 def bench_batcher(*, requests: int = 200, threads: int = 8,
                   max_batch_size: int = 32,
                   max_latency_ms: float = 2.0) -> dict:
@@ -589,6 +670,10 @@ def run_servebench(*, size: str = "1b", quick: bool = False,
         chunk=chunk, buckets=buckets, decode_tokens=decode_tokens, rng=rng)
     log("paged vs flat KV cache (block-table memory A/B)")
     result["paged_vs_flat"] = bench_paged_vs_flat(
+        model, params, cfg, slots=2 if quick else 4, max_len=max_len,
+        chunk=chunk, buckets=buckets, decode_tokens=decode_tokens, rng=rng)
+    log("spec x paged at depth 2 (speculation composition A/B)")
+    result["spec_paged"] = bench_spec_paged(
         model, params, cfg, slots=2 if quick else 4, max_len=max_len,
         chunk=chunk, buckets=buckets, decode_tokens=decode_tokens, rng=rng)
     log("decode throughput vs slots")

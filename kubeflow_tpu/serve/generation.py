@@ -486,7 +486,7 @@ def spec_acceptance(drafts, dlogits, tlogits, temperature, key):
 
 def build_spec_decode(model, draft_model, *, gamma: int, n_spec: int,
                       max_len: int, rolling_window: int = 0,
-                      adapters=None):
+                      adapters=None, kv_block_size: int = 0):
     """Speculative decoding step functions (vLLM's draft-model speedup,
     XLA-shaped): per spec step the DRAFT autoregressively proposes `gamma`
     tokens (gamma cheap forwards inside the scan), then the TARGET scores
@@ -522,8 +522,35 @@ def build_spec_decode(model, draft_model, *, gamma: int, n_spec: int,
     row's adapter while the draft proposes from its own base weights — a
     base-model draft can only cost acceptance rate, never correctness,
     because every emitted token comes from the target's (adapted) logits
-    via exact-match/rejection acceptance."""
+    via exact-match/rejection acceptance.
+
+    `kv_block_size` > 0 (spec x paged, ISSUE 18): make(bucket) returns
+    the PAGED signature instead — spec_chunk(params, dparams, pool,
+    dpool, tables, dtables, last_tok, index, temperature, key) — which
+    gathers per-row block views of the target AND draft pools (tables /
+    dtables [B, bucket//bs], pad entries 0 = NULL block), runs the flat
+    spec core on the views verbatim, and scatters both back. Paged spec
+    decode is token-identical to flat spec decode by construction, the
+    same argument as make_decode_paged; the draft pool shares the
+    target's block-id space but its tables are per-slot and never
+    prefix-shared (a draft cache is private working state)."""
     rolling = int(rolling_window) > 0
+    bs = int(kv_block_size)
+    if bs and rolling:
+        raise ValueError(
+            "paged spec decode does not compose with the rolling cache")
+
+    def _gather_view(pool_leaf, tables):
+        g = jnp.take(pool_leaf, tables, axis=1)  # [L, B, nb, bs, ...]
+        return g.reshape(g.shape[0], g.shape[1],
+                         g.shape[2] * g.shape[3], *g.shape[4:])
+
+    def _scatter_view(pool_leaf, view_leaf, tables):
+        b, nb = tables.shape
+        v = view_leaf.reshape(view_leaf.shape[0], b, nb, bs,
+                              *view_leaf.shape[3:])
+        v = v.reshape(v.shape[0], b * nb, bs, *v.shape[4:])
+        return pool_leaf.at[:, tables.reshape(-1)].set(v)
 
     def make(bucket: int):
         def spec_chunk(params, dparams, cache, dcache, last_tok, index,
@@ -624,7 +651,24 @@ def build_spec_decode(model, draft_model, *, gamma: int, n_spec: int,
 
             return (wb(cache, sliced), wb(dcache, dsliced),
                     outs.transpose(1, 0, 2), lps.transpose(1, 0, 2), ks.T)
-        return spec_chunk
+        if not bs:
+            return spec_chunk
+
+        def spec_chunk_paged(params, dparams, pool, dpool, tables,
+                             dtables, last_tok, index, temperature, key,
+                             aid=None):
+            cache = jax.tree.map(lambda p: _gather_view(p, tables), pool)
+            dcache = jax.tree.map(lambda p: _gather_view(p, dtables),
+                                  dpool)
+            cache, dcache, toks, lps, ks = spec_chunk(
+                params, dparams, cache, dcache, last_tok, index,
+                temperature, key, aid)
+            pool = jax.tree.map(
+                lambda p, v: _scatter_view(p, v, tables), pool, cache)
+            dpool = jax.tree.map(
+                lambda p, v: _scatter_view(p, v, dtables), dpool, dcache)
+            return pool, dpool, toks, lps, ks
+        return spec_chunk_paged
     return make
 
 
@@ -794,11 +838,6 @@ class GenerationEngine:
             raise ValueError(
                 "kv_host_tier_blocks > 0 needs the paged KV cache (the "
                 "host tier spills whole blocks); set kv_block_size > 0")
-        if role != "unified" and draft is not None:
-            raise ValueError(
-                "prefill/decode roles do not compose with speculative "
-                "decoding yet (the draft cache has no wire format); "
-                "role='unified' to use a draft")
         self.role = role
         self._host_tier = (HostKVTier(int(kv_host_tier_blocks))
                            if self._paged and int(kv_host_tier_blocks)
@@ -810,11 +849,6 @@ class GenerationEngine:
                     "sliding-window serving (rolling rows are not "
                     "prefix-ordered, so block tables cannot address "
                     "them); set kv_block_size=0")
-            if draft is not None:
-                raise ValueError(
-                    "kv_block_size > 0 does not yet compose with "
-                    "speculative decoding (the draft cache is unpaged); "
-                    "set kv_block_size=0 to use a draft")
             if self.max_len % self._kv_bs:
                 raise ValueError(
                     f"kv_block_size {self._kv_bs} must divide max_len "
@@ -966,12 +1000,15 @@ class GenerationEngine:
         if int(pipeline_depth) < 1:
             raise ValueError(
                 f"pipeline_depth must be >= 1, got {pipeline_depth}")
-        # Spec engines always run synchronously: the spec chunk's advance
-        # is data-dependent (accepted counts pick the next index), so its
-        # carry cannot chain on device — and the spec dispatch already
-        # amortizes the tunnel RTT across n_spec*(gamma+1) tokens.
-        self.pipeline_depth = (1 if self._spec is not None
-                               else int(pipeline_depth))
+        # Spec x pipelining (ISSUE 18 move 3): the spec chunk's advance is
+        # data-dependent (accepted counts pick the next index), so depth>1
+        # chains spec chunk k+1 on the WORST-CASE carry — the last bonus
+        # token under full acceptance. Any rejection dooms the chained
+        # in-flight chunks; the fetch reconciles them exactly like
+        # speculatively-dead chunks (bounded waste: depth-1 chunks per
+        # rejection event). pipeline_depth bounds each sub-batch chain
+        # (spec and vanilla pipeline independently since move 2).
+        self.pipeline_depth = int(pipeline_depth)
         #: Live in-flight dispatch count (worker-thread writes, metrics
         #: reads — a plain int store, GIL-atomic). 0 when idle/drained;
         #: a pipeline that silently re-serializes never reads above 1.
@@ -1038,10 +1075,22 @@ class GenerationEngine:
                 dcache_sh = (None if self._dcache_sharding is None else
                              {"k": self._dcache_sharding,
                               "v": self._dcache_sharding})
-                self._dcache = jax.jit(
-                    lambda: init_cache(self._spec["cfg"], self.n_slots,
-                                       self.max_len),
-                    out_shardings=dcache_sh)()
+                if self._paged:
+                    # Paged draft KV (ISSUE 18 move 1): the draft gets
+                    # its own pool in the SAME block-id space as the
+                    # target's (one allocator governs both), so a slot's
+                    # draft blocks are ordinary allocations — per-slot,
+                    # never prefix-shared, freed with the slot.
+                    self._dcache = jax.jit(
+                        lambda: init_cache(self._spec["cfg"],
+                                           self._kv_alloc.n_blocks + 1,
+                                           self._kv_bs),
+                        out_shardings=dcache_sh)()
+                else:
+                    self._dcache = jax.jit(
+                        lambda: init_cache(self._spec["cfg"], self.n_slots,
+                                           self.max_len),
+                        out_shardings=dcache_sh)()
             self._warmup()
         self._slots = [None] * self.n_slots  # per-slot host state
         self._thread = threading.Thread(
@@ -1193,10 +1242,23 @@ class GenerationEngine:
                 max_len=self.max_len, chunk=self.chunk,
                 prefill_buckets=self.prefill_buckets,
                 offset_writes=True,
-                cache_sharding=self._dcache_sharding)
+                cache_sharding=self._dcache_sharding,
+                kv_block_size=self._kv_bs if self._paged else 0)
             self._dextend_mid = jax.jit(dfns["extend_mid"],
                                         donate_argnums=(1,))
-            self._dinsert = jax.jit(dfns["insert"], donate_argnums=(0,))
+            if self._paged:
+                # Paged draft pool (ISSUE 18 move 1): insert scatters a
+                # replayed draft fragment into the slot's draft blocks;
+                # export/import are the wire halves for the shipment's
+                # optional draft section (fmt 2) — compiled only on role
+                # engines' warmup, like the target's.
+                self._dinsert = jax.jit(dfns["insert_paged"],
+                                        donate_argnums=(0,))
+                self._dexport_blocks = jax.jit(dfns["export_blocks"])
+                self._dimport_blocks = jax.jit(dfns["import_blocks"],
+                                               donate_argnums=(0,))
+            else:
+                self._dinsert = jax.jit(dfns["insert"], donate_argnums=(0,))
             self._dfrag_len = dfns["frag_len"]
             from kubeflow_tpu.models.llama import init_cache
 
@@ -1206,7 +1268,8 @@ class GenerationEngine:
                 self.model, self._spec["model"],
                 gamma=self._spec["gamma"], n_spec=self._spec["n_spec"],
                 max_len=self.max_len, rolling_window=self._rolling,
-                adapters=self._ml_stacks)
+                adapters=self._ml_stacks,
+                kv_block_size=self._kv_bs if self._paged else 0)
             self._spec_decode = {
                 b: jax.jit(spec_make(b), donate_argnums=(2, 3))
                 for b in self.decode_buckets}
@@ -1282,13 +1345,38 @@ class GenerationEngine:
                 dfrag = self._dextend_mid(
                     self._dparams, dfrag, jnp.zeros((1, b), jnp.int32),
                     zero_k)
-            self._dcache = self._dinsert(self._dcache, dfrag, jnp.int32(0))
-            for fn in self._spec_decode.values():
-                self._cache, self._dcache, _, _, _ = fn(
-                    self._params, self._dparams, self._cache, self._dcache,
-                    jnp.zeros((n,), jnp.int32), jnp.zeros((n,), jnp.int32),
-                    jnp.zeros((n,), jnp.float32), self._key,
-                    aid=self._aid_batch([0] * n))
+            if self._paged:
+                mb = self.max_len // self._kv_bs
+                # All-NULL scatter/gather tables, like the target's pool
+                # warmup: nothing lands in allocatable blocks.
+                self._dcache = self._dinsert(self._dcache, dfrag,
+                                             jnp.zeros((mb,), jnp.int32))
+                if self.role != "unified":
+                    gt = jnp.zeros((mb,), jnp.int32)
+                    gathered = self._dexport_blocks(self._dcache, gt)
+                    self._dcache = self._dimport_blocks(self._dcache,
+                                                        gathered, gt)
+                for b, fn in self._spec_decode.items():
+                    self._cache, self._dcache, _, _, _ = fn(
+                        self._params, self._dparams, self._cache,
+                        self._dcache,
+                        jnp.zeros((n, b // self._kv_bs), jnp.int32),
+                        jnp.zeros((n, b // self._kv_bs), jnp.int32),
+                        jnp.zeros((n,), jnp.int32),
+                        jnp.zeros((n,), jnp.int32),
+                        jnp.zeros((n,), jnp.float32), self._key,
+                        aid=self._aid_batch([0] * n))
+            else:
+                self._dcache = self._dinsert(self._dcache, dfrag,
+                                             jnp.int32(0))
+                for fn in self._spec_decode.values():
+                    self._cache, self._dcache, _, _, _ = fn(
+                        self._params, self._dparams, self._cache,
+                        self._dcache,
+                        jnp.zeros((n,), jnp.int32),
+                        jnp.zeros((n,), jnp.int32),
+                        jnp.zeros((n,), jnp.float32), self._key,
+                        aid=self._aid_batch([0] * n))
 
     # -- multi-LoRA ----------------------------------------------------------
 
@@ -1363,6 +1451,11 @@ class GenerationEngine:
             need = blocks_for(
                 self._paged_need_tokens(len(input_ids), int(max_tokens)),
                 self._kv_bs)
+            if (self._spec is not None and int(top_k) == 0
+                    and float(top_p) >= 1.0):
+                # Spec-able: the draft pool reserves the same worst-case
+                # footprint again (ISSUE 18 move 1).
+                need *= 2
             if need > self._kv_alloc.n_blocks:
                 # Permanent: even an empty pool can't cover it — shed
                 # now (503), don't let it camp in the queue to 504.
@@ -1456,6 +1549,9 @@ class GenerationEngine:
         if top_k < 0:
             raise ValueError(f"top_k must be >= 0, got {top_k}")
         need = blocks_for(len(input_ids), self._kv_bs)
+        if (self._spec is not None and int(top_k) == 0
+                and float(top_p) >= 1.0):
+            need *= 2  # prompt-width draft blocks ride the shipment
         if need > self._kv_alloc.n_blocks:
             raise KVCapacityExceeded(
                 f"prompt needs {need} KV blocks but the pool has "
@@ -1524,9 +1620,21 @@ class GenerationEngine:
                 "submit_remote needs the paged KV cache; set "
                 "kv_block_size > 0")
         meta, arrays = unpack_shipment(shipment)
-        if int(meta.get("fmt", 0)) != 1:
+        fmt = int(meta.get("fmt", 0))
+        if fmt not in (1, 2):
             raise ShipmentError(
                 f"unknown shipment fmt {meta.get('fmt')!r}")
+        if fmt == 2 and self._spec is None:
+            # The versioned draft section is refused loudly, never
+            # silently dropped: a fleet pairing draft-carrying prefill
+            # replicas with draft-less decode replicas is misconfigured
+            # (the decode side would re-pay the replay the shipment
+            # exists to avoid) and must surface at submit.
+            raise ShipmentError(
+                "shipment fmt 2 carries a draft-KV section but this "
+                "engine has no draft model; pair draft-carrying "
+                "prefill replicas with draft-configured decode "
+                "replicas (or drop generative.draft fleet-wide)")
         if int(meta.get("block_size", 0)) != self._kv_bs:
             raise ShipmentError(
                 f"shipment block_size {meta.get('block_size')} != this "
@@ -1560,11 +1668,53 @@ class GenerationEngine:
                            arr.dtype)
             pad[:, :n_blocks] = arr
             blocks[name] = pad
+        draft_blocks = None
+        dn_blocks = 0
+        if fmt == 2:
+            dmeta = dict(meta.get("draft") or {})
+            dref = self._dcache["k"]
+            if (int(dmeta.get("block_size", 0)) != self._kv_bs
+                    or int(dmeta.get("vocab_size", 0))
+                    != int(self._spec["cfg"].vocab_size)
+                    or int(dmeta.get("num_layers", 0)) != int(dref.shape[0])
+                    or list(dmeta.get("kv_shape", ()))
+                    != list(dref.shape[2:])
+                    or str(dmeta.get("dtype")) != str(dref.dtype)):
+                raise ShipmentError(
+                    f"shipment draft section {dmeta} does not match "
+                    f"this engine's draft model (layers={dref.shape[0]}, "
+                    f"kv_shape={list(dref.shape[2:])}, "
+                    f"dtype={dref.dtype}, block_size={self._kv_bs}) — "
+                    "mixed-precision or mixed-config fleets cannot "
+                    "exchange draft KV")
+            dn_blocks = int(dmeta.get("n_blocks", 0))
+            if dn_blocks < 1 or dn_blocks > mb:
+                raise ShipmentError(
+                    f"shipment draft section claims {dn_blocks} blocks; "
+                    f"this engine fits at most {mb}")
+            draft_blocks = {}
+            for name in ("k", "v"):
+                arr = arrays.get("draft_" + name)
+                if arr is None:
+                    raise ShipmentError(
+                        f"fmt 2 shipment missing draft_{name!r} blocks")
+                want = (dref.shape[0], dn_blocks, *dref.shape[2:])
+                if tuple(arr.shape) != want:
+                    raise ShipmentError(
+                        f"shipment draft_{name} blocks shaped "
+                        f"{tuple(arr.shape)}, this engine needs {want}")
+                pad = np.zeros((dref.shape[0], mb, *dref.shape[2:]),
+                               arr.dtype)
+                pad[:, :dn_blocks] = arr
+                draft_blocks[name] = pad
         if timeout is None:
             timeout = float(meta.get("timeout", 300.0))
         max_tokens = int(meta.get("max_tokens", 32))
         need = blocks_for(self._paged_need_tokens(len(ids), max_tokens),
                           self._kv_bs)
+        if (self._spec is not None and int(meta.get("top_k", 0)) == 0
+                and float(meta.get("top_p", 1.0)) >= 1.0):
+            need *= 2  # worst-case: the draft table mirrors the target's
         if need > self._kv_alloc.n_blocks:
             raise KVCapacityExceeded(
                 f"shipped request needs {need} KV blocks worst-case but "
@@ -1582,6 +1732,8 @@ class GenerationEngine:
             "first_lp": float(meta["first_logprob"]),
             "kv_blocks": blocks,
             "n_blocks": n_blocks,
+            "draft_blocks": draft_blocks,
+            "dn_blocks": dn_blocks,
             "rng_key": arrays.get("rng_key"),
             "out": [], "out_logprobs": [],
             "done": threading.Event(),
@@ -1702,6 +1854,32 @@ class GenerationEngine:
         chunks = -(-max(int(max_tokens), 1) // self.chunk)
         return min(self.max_len, prompt + chunks * self.chunk)
 
+    def _spec_able(self, req: dict) -> bool:
+        """A request rides the spec sub-batch iff it has no truncated
+        sampling: greedy and plain-temperature rows compose with the
+        rejection scheme; top-k/top-p rows decode on the vanilla
+        sub-batch (ISSUE 18 move 2 — per-request, not batch-wide)."""
+        return (self._spec is not None
+                and req.get("top_k", 0) == 0
+                and req.get("top_p", 1.0) >= 1.0)
+
+    def _draft_need_blocks(self, req: dict) -> int:
+        """Worst-case DRAFT pool blocks a spec-able request reserves on
+        top of the target's (ISSUE 18 move 1): the same bound as the
+        target's, because the draft cache mirrors the committed index.
+        Draft blocks are per-slot private working state — never
+        prefix-shared, never discounted by a hit. Ship-mode reserves
+        prompt blocks only, like the target (the decode replica reserves
+        the decode budget)."""
+        if not (self._paged and self._spec_able(req)):
+            return 0
+        ids = req["input_ids"]
+        if req.get("mode") == "ship":
+            return blocks_for(len(ids), self._kv_bs)
+        return blocks_for(
+            self._paged_need_tokens(len(ids), req["max_tokens"]),
+            self._kv_bs)
+
     def _prefix_probe_paged(self, ids: list[int], aid: int, *,
                             touch: bool) -> tuple[int, tuple] | None:
         """Paged twin of `_prefix_lookup`: longest strictly-shorter
@@ -1809,6 +1987,9 @@ class GenerationEngine:
             total = blocks_for(
                 self._paged_need_tokens(len(ids), req["max_tokens"]),
                 self._kv_bs)
+        # Spec-able requests also cover the draft pool's footprint —
+        # fresh blocks only, so the prefix-hit discount never applies.
+        total += self._draft_need_blocks(req)
         aid = req.get("aid", 0)
         # Remote admissions never discount by a prefix hit: their blocks
         # arrive on the wire and the reserve below allocates the FULL
@@ -1864,6 +2045,9 @@ class GenerationEngine:
         blocks = st.pop("blocks", None)
         if blocks:
             self._kv_alloc.decref(blocks)
+        dblocks = st.pop("dblocks", None)
+        if dblocks:
+            self._kv_alloc.decref(dblocks)
 
     @property
     def kv_blocks_free(self):
@@ -1968,6 +2152,19 @@ class GenerationEngine:
             if fresh is None:
                 raise _NeedKVBlocks()
             # tpk-sync: end kv-block-reserve
+        # Draft blocks ride the same pool, per-slot and never
+        # prefix-shared (the draft cache holds draft-model activations —
+        # a target prefix block would be garbage to it). Allocated
+        # atomically with the target reserve: both or neither, so the
+        # _kv_fits precheck (which counts both) stays the single
+        # admission gate.
+        dtable: list[int] | None = None
+        dneed = self._draft_need_blocks(req)
+        if dneed:
+            dtable = self._kv_alloc.alloc(dneed)
+            if dtable is None:
+                self._kv_alloc.decref(fresh)
+                raise _NeedKVBlocks()
         if self._prefix_cap:
             with self._stats_lock:
                 if hit is not None:
@@ -2034,8 +2231,21 @@ class GenerationEngine:
             st_tbl[len(shared):len(table)] = fresh
             self._cache = self._insert(self._cache, frag,
                                        jnp.asarray(st_tbl))
+            if dtable is not None:
+                # The draft must hold the same prompt history (flat
+                # admission's rule): chunked replay over the draft's own
+                # fragment cache, scattered into this slot's draft
+                # blocks. Never prefix-shared, so the whole table is a
+                # fresh scatter target.
+                dt = np.zeros((mb,), np.int32)
+                dt[:len(dtable)] = dtable
+                self._dcache = self._dinsert(self._dcache,
+                                             self._draft_replay(ids),
+                                             jnp.asarray(dt))
         except BaseException:
             self._kv_alloc.decref(table)
+            if dtable is not None:
+                self._kv_alloc.decref(dtable)
             raise
         for m in boundaries:
             self._prefix_store_paged(aid, tuple(ids[:m]),
@@ -2044,14 +2254,14 @@ class GenerationEngine:
             self.stats["prefill_chunks"] += -(-(len(ids) - start_done)
                                               // big)
         if ship:
-            self._finish_ship(req, table, tok0, lp0)
+            self._finish_ship(req, table, tok0, lp0, dtable)
             return
+        draft_ok = dtable is not None
         # tpk-sync: begin admit-slot-state paged
-        # tpk-sync: sub 'draft_ok': draft_ok -> 'draft_ok': False
-        # tpk-sync: sub 'aid': aid} -> 'aid': aid, 'blocks': table}
+        # tpk-sync: sub 'aid': aid} -> 'aid': aid, 'blocks': table, 'dblocks': dtable}
         st = {"req": req, "idx": len(ids), "disp": len(ids), "last": None,
-              "pending": None, "draft_ok": False, "aid": aid,
-              "blocks": table}
+              "pending": None, "draft_ok": draft_ok, "aid": aid,
+              "blocks": table, "dblocks": dtable}
         if self.pipeline_depth > 1:
             for arr in (tok0, lp0):
                 getattr(arr, "copy_to_host_async", lambda: None)()
@@ -2073,7 +2283,7 @@ class GenerationEngine:
         # tpk-sync: end admit-slot-state
 
     def _finish_ship(self, req: dict, table: list[int], tok0,
-                     lp0) -> None:
+                     lp0, dtable: list[int] | None = None) -> None:
         """Serialize a ship-mode admission's committed blocks into the
         wire format and release them. Runs on the worker thread right
         after the fragment insert. The fetches here ARE device syncs:
@@ -2092,13 +2302,36 @@ class GenerationEngine:
         gathered = self._export_blocks(self._cache, jnp.asarray(gt))
         arrays = {name: np.asarray(leaf)[:, :len(table)]
                   for name, leaf in gathered.items()}
+        draft_meta = None
+        if dtable is not None:
+            # Optional draft-block section (fmt 2): the decode replica
+            # speculates from position 0 without replaying the prompt
+            # through its own draft. The section's config identity lets
+            # a mismatched fleet refuse loudly at submit_remote instead
+            # of decoding garbage.
+            dgt = np.zeros((mb,), np.int32)
+            dgt[:len(dtable)] = dtable
+            dgathered = self._dexport_blocks(self._dcache,
+                                             jnp.asarray(dgt))
+            for name, leaf in dgathered.items():
+                arrays["draft_" + name] = np.asarray(leaf)[:, :len(dtable)]
+            self._kv_alloc.decref(dtable)
+            dref = self._dcache["k"]
+            draft_meta = {
+                "block_size": self._kv_bs,
+                "vocab_size": int(self._spec["cfg"].vocab_size),
+                "n_blocks": len(dtable),
+                "kv_shape": list(dref.shape[2:]),
+                "num_layers": int(dref.shape[0]),
+                "dtype": str(dref.dtype),
+            }
         # Post-prefill RNG state: a decode engine adopting it continues
         # the exact key-split stream the unified engine would have used
         # (the disagg-vs-unified identity pin).
         arrays["rng_key"] = np.asarray(jax.random.key_data(self._key))
         first_tok = int(np.asarray(tok0)[0])
         meta = {
-            "fmt": 1,
+            "fmt": 2 if draft_meta is not None else 1,
             "block_size": self._kv_bs,
             "vocab_size": int(self.cfg.vocab_size),
             "tokens": list(ids),
@@ -2117,6 +2350,8 @@ class GenerationEngine:
             "timeout": req.get("timeout", 300.0),
             "extra": req.get("extra") or {},
         }
+        if draft_meta is not None:
+            meta["draft"] = draft_meta
         payload = pack_shipment(meta, arrays)
         self._kv_alloc.decref(table)
         with self._stats_lock:
@@ -2155,6 +2390,13 @@ class GenerationEngine:
         if fresh is None:
             raise _NeedKVBlocks()
         # tpk-sync: end kv-block-reserve
+        dtable: list[int] | None = None
+        dneed = self._draft_need_blocks(req)
+        if dneed:
+            dtable = self._kv_alloc.alloc(dneed)
+            if dtable is None:
+                self._kv_alloc.decref(fresh)
+                raise _NeedKVBlocks()
         table = shared + fresh
         n_blocks = req["n_blocks"]
         try:
@@ -2169,8 +2411,33 @@ class GenerationEngine:
                           for name, arr in req["kv_blocks"].items()}
             self._cache = self._import_blocks(self._cache, dev_blocks,
                                               jnp.asarray(st_tbl))
+            if dtable is not None:
+                dship = req.get("draft_blocks")
+                if dship is not None:
+                    # fmt 2: the prompt's draft KV rode the shipment —
+                    # import into the first dn_blocks entries, exactly
+                    # like the target import above.
+                    dst = np.zeros((mb,), np.int32)
+                    dn = min(req["dn_blocks"], len(dtable))
+                    dst[:dn] = dtable[:dn]
+                    ddev = {name: jnp.asarray(arr)
+                            for name, arr in dship.items()}
+                    self._dcache = self._dimport_blocks(
+                        self._dcache, ddev, jnp.asarray(dst))
+                else:
+                    # fmt 1 from a draft-less prefill replica: rebuild
+                    # the draft history locally (one replay — the cost
+                    # fmt 2 shipments avoid), so this decode replica
+                    # still speculates.
+                    dt = np.zeros((mb,), np.int32)
+                    dt[:len(dtable)] = dtable
+                    self._dcache = self._dinsert(self._dcache,
+                                                 self._draft_replay(ids),
+                                                 jnp.asarray(dt))
         except BaseException:
             self._kv_alloc.decref(table)
+            if dtable is not None:
+                self._kv_alloc.decref(dtable)
             raise
         kd = req.get("rng_key")
         if kd is not None:
@@ -2181,7 +2448,8 @@ class GenerationEngine:
             self._key = jax.random.wrap_key_data(jnp.asarray(kd))
         st = {"req": req, "idx": len(ids), "disp": len(ids),
               "last": req["first_tok"], "pending": None,
-              "draft_ok": False, "aid": aid, "blocks": table}
+              "draft_ok": dtable is not None, "aid": aid,
+              "blocks": table, "dblocks": dtable}
         self._slots[slot] = st
         with self._stats_lock:
             self.stats["requests"] += 1
@@ -2462,8 +2730,16 @@ class GenerationEngine:
         the rest of every concurrent request (r4 advisor finding)."""
         req = st["req"]
         ids = req["input_ids"] + req["out"][:-1]
+        if self._paged:
+            mb = self.max_len // self._kv_bs
+            dt = np.zeros((mb,), np.int32)
+            dblocks = st["dblocks"]
+            dt[:len(dblocks)] = dblocks
+            target = jnp.asarray(dt)
+        else:
+            target = jnp.int32(slot)
         self._dcache = self._dinsert(self._dcache, self._draft_replay(ids),
-                                     jnp.int32(slot))
+                                     target)
         st["draft_ok"] = True
         with self._stats_lock:
             self.stats["spec_readmissions"] += 1
@@ -2621,125 +2897,320 @@ class GenerationEngine:
                 return True
         return False
 
-    def _try_spec_chunk(self, active: list[int]) -> bool:
-        """Speculative path: greedy traffic decodes draft-then-verify
-        (token-identical to vanilla greedy) and plain-temperature
-        traffic via rejection sampling (the emitted marginal IS the
-        tempered target distribution — spec_acceptance); top-k/
-        top-p requests fall back to plain decode. Worst-case
-        advance is n_spec*(gamma+1) tokens, so the spec dispatch
-        needs that much cache headroom — near max_len the tail
-        decodes vanilla.
-        draft_ok: a slot's draft cache mirrors its target history
-        only while every advance went through the spec path — a
-        vanilla chunk (mixed batch) leaves draft rows unwritten, and
-        the draft would attend garbage there (acceptance collapses,
-        spec becomes pure overhead). Once the batch is all
-        spec-able again, demoted slots RE-ADMIT their draft cache
-        from token history instead of decoding vanilla forever.
-        Runs only with the pipe empty (spec engines are depth-1): the
-        accepted counts decide each slot's next index, so the advance
-        must round-trip to the host every dispatch. Returns True when a
-        spec chunk ran (dispatch + fetch + emit)."""
+    def _van_riders_fit(self, van_batch: list[int]) -> bool:
+        """Flat-mode guard for the vanilla sub-batch: live rows OUTSIDE
+        the batch (spec rows) park their batch-wide write at their own
+        disp — near the context end that write would clamp backwards
+        over committed rows, so the dispatch waits the few chunks until
+        those rows retire. Paged riders write the NULL block; nothing
+        to check."""
+        if self._paged:
+            return True
+        vb = set(van_batch)
+        for j, stj in enumerate(self._slots):
+            if (stj is not None and j not in vb
+                    and stj["disp"] + self.chunk > self.max_len):
+                return False
+        return True
+
+    def _spec_batch(self, active: list[int], van_covered: set,
+                    spec_chain: list) -> tuple[list[int], list[int]]:
+        """Plan this round's SPEC sub-batch (per-sub-batch dispatch):
+        greedy + plain-temperature rows speculate; top-k/top-p rows
+        decode vanilla in their own sub-batch — one truncated-sampling
+        request no longer disables speculation for concurrent traffic.
+
+        Returns (parts, fallback): `parts` rows ride a spec dispatch
+        now; `fallback` rows join the vanilla sub-batch this round
+        (possible only when no spec chunk is in flight — a row covered
+        by an in-flight spec record has its true last token on device,
+        so it can neither splice into a vanilla dispatch nor re-admit
+        its draft until the chain drains back to disp == idx)."""
         if self._spec is None:
-            return False
-        sts = [self._slots[i] for i in active]
-        if not all(st["req"].get("top_k", 0) == 0
-                   and st["req"].get("top_p", 1.0) >= 1.0 for st in sts):
-            return False
+            return [], []
+        rows = [i for i in active
+                if self._spec_able(self._slots[i]["req"])
+                and i not in van_covered]
+        if not rows:
+            return [], []
+        chained = bool(spec_chain)
+        if chained and spec_chain[-1]["doomed"]:
+            return [], []  # drain the doomed chain before re-dispatching
+        if chained and self._rolling:
+            # Rolling cache: a doomed over-dispatch would have
+            # wrap-written window rows still inside every later query's
+            # attention span — unrecoverable at reconcile, so rolling
+            # engines pin the spec chain to depth 1.
+            return [], []
         worst = self._spec["n_spec"] * (self._spec["gamma"] + 1)
-        need = max(st["idx"] for st in sts) + worst
-        if need > self.max_len:
-            return False
-        # Re-admission is PER SLOT (ADVICE r5 partial fix): worthy
-        # demoted slots replay their draft cache; permanently-unworthy
-        # ones (near budget / history dwarfs the remainder — the replay
-        # can't pay for itself, and the gap only widens) are excluded
-        # from the re-admission group and ride the chunk with STALE
-        # draft rows. That's a pure acceptance-rate cost, never a
-        # correctness one: every emitted token still comes from the
-        # target's verify forward (exact-match / rejection acceptance),
-        # so one near-budget request no longer disables speculation for
-        # all concurrent greedy traffic. (Truncated-sampling requests
-        # still gate the whole batch above — their sampling law can't
-        # ride a spec dispatch at all; the full spec/vanilla split
-        # dispatch is ROADMAP item 4.)
-        demoted = [i for i in active if not self._slots[i].get("draft_ok")]
-        worthy = [i for i in demoted
-                  if self._readmit_worthwhile(self._slots[i])]
-        stale = len(demoted) - len(worthy)
-        if stale == len(active):
-            # Nobody would propose from a live draft cache — the spec
-            # dispatch would be pure overhead over a vanilla chunk.
-            return False
-        last = np.zeros((self.n_slots,), np.int32)
-        idx = np.zeros((self.n_slots,), np.int32)
-        temps = np.zeros((self.n_slots,), np.float32)
-        aids = np.zeros((self.n_slots,), np.int32)
-        for i in active:
+        if self._rolling and len(rows) != len(active):
+            # Rolling riders would wrap-clobber live window rows the
+            # same way; mixed traffic keeps the all-or-nothing gate on
+            # the (flat, rolling) escape hatch.
+            return [], (rows if not chained else [])
+        if self._paged:
+            # Per-row block tables: rows that no longer fit a worst-case
+            # advance drop to the vanilla tail individually.
+            fit = [i for i in rows
+                   if self._slots[i]["disp"] + worst <= self.max_len]
+        else:
+            # Flat: one batch-wide bucket, and rider rows park their
+            # writes at their own disp — the headroom gate must cover
+            # every live row or a clamped write would walk backwards
+            # over committed KV.
+            high = max(st["disp"] for st in self._slots if st is not None)
+            fit = rows if high + worst <= self.max_len else []
+        tail = [i for i in rows if i not in fit]
+        def usable(i: int) -> bool:
             st = self._slots[i]
-            last[i], idx[i] = st["last"], st["idx"]
-            temps[i] = st["req"]["temperature"]
-            aids[i] = st.get("aid", 0)
-        self._key, sub = jax.random.split(self._key)
-        t0 = time.monotonic()
-        p0 = time.perf_counter()
+            return bool(st.get("draft_ok")) or (
+                st["disp"] == st["idx"] and st["pending"] is None
+                and self._readmit_worthwhile(st))
+        if fit and not any(usable(i) for i in fit):
+            # Nobody would propose from a live (or replayable) draft
+            # cache — the spec dispatch would be pure overhead over a
+            # vanilla chunk.
+            tail, fit = rows, []
+        if not fit:
+            return [], (tail if not chained else [])
+        if chained:
+            # Chain chunk k+1 only while some participant's budget is
+            # not already covered in flight (same rule as the vanilla
+            # chain) — otherwise the over-dispatch is pure waste.
+            for i in fit:
+                st = self._slots[i]
+                infl = (st["disp"] - st["idx"]
+                        + (1 if st["pending"] else 0))
+                if len(st["req"]["out"]) + infl < st["req"]["max_tokens"]:
+                    break
+            else:
+                return [], []
+        return fit, (tail if not chained else [])
+
+    # tpk-hot: spec-dispatch
+    def _dispatch_spec_chunk(self, parts: list[int],
+                             carry: dict | None = None) -> dict:
+        """Issue one speculative dispatch over the spec sub-batch
+        WITHOUT fetching: draft proposes gamma tokens per step, target
+        verifies (greedy rows exact-match the target argmax — token-
+        identical to vanilla greedy; tempered rows rejection-sample the
+        exact target marginal). `carry` chains chunk k+1 on chunk k's
+        WORST-CASE carry — the last bonus token, valid iff every
+        proposal was accepted; `_fetch_spec_chunk` dooms over-advanced
+        records at reconcile exactly like speculatively-dead chunks,
+        which is what lifts the old forced pipeline_depth=1.
+
+        Per-slot draft re-admission rides here (gated to rows with no
+        chunk in flight: the replay reads finalized token history);
+        permanently-unworthy demoted rows ride with STALE draft rows —
+        a pure acceptance-rate cost counted in spec_stale_rides, never
+        a correctness one."""
+        spec = self._spec
+        worst = spec["n_spec"] * (spec["gamma"] + 1)
+        worthy = []
+        stale = 0
+        for i in parts:
+            st = self._slots[i]
+            if st.get("draft_ok"):
+                continue
+            if (st["disp"] == st["idx"] and st["pending"] is None
+                    and self._readmit_worthwhile(st)):
+                worthy.append(i)
+            else:
+                stale += 1
         with self._scope():
             for i in worthy:
                 self._readmit_draft(i, self._slots[i])
         if stale:
             with self._stats_lock:
                 self.stats["spec_stale_rides"] += stale
+        last = np.zeros((self.n_slots,), np.int32)
+        idx = np.zeros((self.n_slots,), np.int32)
+        temps = np.zeros((self.n_slots,), np.float32)
+        ks = np.zeros((self.n_slots,), np.int32)
+        ps = np.ones((self.n_slots,), np.float32)
+        aids = np.zeros((self.n_slots,), np.int32)
+        # The row-gather below is the tpk-sync twin of the vanilla
+        # dispatch loop's: the spec sub-batch must snapshot slot state
+        # by the identical recipe (ks/ps are gathered for the twinning
+        # but never dispatched — spec rows are never truncated).
+        # tpk-sync: begin dispatch-row-gather spec
+        # tpk-sync: sub for i in active: -> for i in parts:
+        for i in parts:
+            st = self._slots[i]
+            idx[i] = st["disp"]
+            temps[i] = st["req"]["temperature"]
+            ks[i] = st["req"].get("top_k", 0)
+            ps[i] = st["req"].get("top_p", 1.0)
+            aids[i] = st.get("aid", 0)
+            if st["pending"] is None and st["last"] is not None:
+                last[i] = st["last"]
+        # tpk-sync: end dispatch-row-gather
+        assumed = {i: self._slots[i]["disp"] for i in parts}
+        partset = set(parts)
+        if not self._paged:
+            for j, stj in enumerate(self._slots):
+                if stj is None or j in partset:
+                    continue
+                # Rider parking: a live row excluded from this sub-batch
+                # aims its batch-wide write at its own uncommitted tail
+                # (idx 0 would clobber committed prompt KV; paged riders
+                # write the NULL block instead and need no parking).
+                idx[j] = stj["disp"]
+        need = int(max(idx)) + worst
         bucket = next((b for b in self.decode_buckets if b >= need),
                       self.decode_buckets[-1])
+        self._key, sub = jax.random.split(self._key)
+        t0 = time.monotonic()
+        p0 = time.perf_counter()
         with self._scope():
-            self._cache, self._dcache, toks, lps, acc = \
-                self._spec_decode[bucket](
-                    self._params, self._dparams, self._cache,
-                    self._dcache, jnp.asarray(last),
-                    jnp.asarray(idx), jnp.asarray(temps), sub,
-                    aid=self._aid_batch(aids))
-        toks = np.asarray(toks)  # [B, n_spec, gamma+1]
-        lps = np.asarray(lps)
-        acc = np.asarray(acc)    # [B, n_spec] accepted counts
-        now = time.monotonic()
-        tracer = obs.get_tracer()
-        if tracer.enabled:
-            p1 = time.perf_counter()
-            for i in active:
-                tracer.record("serve.decode_chunk", p0, p1,
-                              self._slots[i]["req"].get("trace", ""),
-                              slot=i, spec=True)
+            last_dev = (jnp.asarray(last) if carry is None
+                        else carry["toks"][:, -1, -1])
+            for i in parts:
+                st = self._slots[i]
+                if carry is not None and carry["parts"].get(i) is st:
+                    continue  # row rides the on-device worst-case carry
+                if st["pending"] is not None:
+                    last_dev = last_dev.at[i].set(st["pending"][0][0])
+                elif carry is not None:
+                    last_dev = last_dev.at[i].set(np.int32(st["last"]))
+            if self._paged:
+                nb = bucket // self._kv_bs
+                tables = np.zeros((self.n_slots, nb), np.int32)
+                dtables = np.zeros((self.n_slots, nb), np.int32)
+                for i in parts:
+                    st = self._slots[i]
+                    blk = st["blocks"]
+                    k = min(len(blk), nb)
+                    tables[i, :k] = blk[:k]
+                    dbl = st["dblocks"]
+                    k = min(len(dbl), nb)
+                    dtables[i, :k] = dbl[:k]
+                self._cache, self._dcache, toks, lps, acc = \
+                    self._spec_decode[bucket](
+                        self._params, self._dparams, self._cache,
+                        self._dcache, jnp.asarray(tables),
+                        jnp.asarray(dtables), last_dev,
+                        jnp.asarray(idx), jnp.asarray(temps), sub,
+                        aid=self._aid_batch(aids))
+            else:
+                self._cache, self._dcache, toks, lps, acc = \
+                    self._spec_decode[bucket](
+                        self._params, self._dparams, self._cache,
+                        self._dcache, last_dev, jnp.asarray(idx),
+                        jnp.asarray(temps), sub,
+                        aid=self._aid_batch(aids))
+        for arr in (toks, lps, acc):
+            getattr(arr, "copy_to_host_async", lambda: None)()
         with self._stats_lock:
-            self.stats["decode_seconds"] += now - t0
-            self.stats["host_stall_seconds"] += now - t0
-            self.stats["decode_fetch_blocking"] += 1
             self.stats["decode_dispatches"] += 1
             self.stats["spec_dispatches"] += 1
+        rec_parts: dict[int, dict] = {}
+        for i in parts:
+            st = self._slots[i]
+            st["disp"] += worst
+            rec_parts[i] = st
+        return {"kind": "spec", "toks": toks, "lps": lps, "acc": acc,
+                "parts": rec_parts, "assumed": assumed, "worst": worst,
+                "doomed": False, "t0": t0, "p0": p0}
+
+    # tpk-hot: spec-reconcile
+    def _fetch_spec_chunk(self, rec: dict, inflight,
+                          overlapped: bool) -> None:
+        """Fetch one spec record (the host sync point) and reconcile.
+        Three row outcomes, mirroring the vanilla dead-chunk reconcile:
+          * dead — the dispatch-time occupant retired; rows dropped.
+          * over-advanced — an earlier record's partial acceptance
+            falsified this record's all-accepted start assumption (or
+            it was doomed wholesale): rows dropped, disp rolled back by
+            this record's worst-case width. The garbage KV it wrote
+            sits past the committed index, masked until sequential
+            decode rewrites it.
+          * valid — emit per the accepted counts; any acceptance short
+            of worst-case dooms every LATER in-flight spec record (its
+            carry token and start indices are fabrications).
+        The doomed protocol is whole-record: bounded waste
+        (pipeline_depth-1 records per rejection event), zero carry
+        splicing."""
+        t0 = time.monotonic()
+        pf0 = time.perf_counter()
+        # tpk-lint: allow(host-sync) reason=the designed per-spec-chunk fetch boundary; D2H was prestaged by copy_to_host_async at dispatch
+        toks = np.asarray(rec["toks"])  # [B, n_spec, gamma+1]
+        # tpk-lint: allow(host-sync) reason=second half of the designed spec fetch boundary (logprobs ride the same prestaged copy)
+        lps = np.asarray(rec["lps"])
+        # tpk-lint: allow(host-sync) reason=accepted counts ARE the reconcile input — each row's next index is decided by them, on host, once per record
+        acc = np.asarray(rec["acc"])    # [B, n_spec] accepted counts
+        now = time.monotonic()
+        pf1 = time.perf_counter()
+        tracer = obs.get_tracer()
+        if tracer.enabled:
+            for i, st in rec["parts"].items():
+                trace = st["req"].get("trace", "")
+                tracer.record("serve.decode_chunk", rec["p0"], pf0,
+                              trace, slot=i, spec=True,
+                              overlapped=overlapped)
+                tracer.record("serve.fetch", pf0, pf1, trace, slot=i)
+        start = (rec["t0"] if self._busy_mark is None
+                 else max(self._busy_mark, rec["t0"]))
+        with self._stats_lock:
+            self.stats["host_stall_seconds"] += now - t0
+            self.stats["decode_fetch_overlapped" if overlapped
+                        else "decode_fetch_blocking"] += 1
+            self.stats["decode_seconds"] += now - start
         self._busy_mark = now
-        for i in active:
+        worst = rec["worst"]
+        spec = self._spec
+
+        def doom_later() -> None:
+            for r in inflight:
+                if r.get("kind") == "spec":
+                    r["doomed"] = True
+
+        for i, st in rec["parts"].items():
+            if self._slots[i] is not st:
+                with self._stats_lock:
+                    self.stats["decode_dead_slot_chunks"] += 1
+                    self.stats["decode_wasted_tokens"] += worst
+                continue
+            if st["pending"] is not None:
+                # First token of a mid-pipe admission: emit it before
+                # the spec tokens (the record decoded FROM it).
+                self._emit_pending(i, st)
+                if self._slots[i] is not st:  # EOS/budget at token 1
+                    with self._stats_lock:
+                        self.stats["decode_dead_slot_chunks"] += 1
+                        self.stats["decode_wasted_tokens"] += worst
+                    continue
+            if rec["doomed"] or st["idx"] != rec["assumed"][i]:
+                # Over-advanced: decoded from a start index that partial
+                # acceptance upstream made fictional. Settle this
+                # record's disp contribution and drop the rows.
+                st["disp"] -= worst
+                with self._stats_lock:
+                    self.stats["decode_wasted_tokens"] += worst
+                continue
             emit_t: list[int] = []
             emit_l: list[float] = []
             accepted = 0
-            for s in range(self._spec["n_spec"]):
+            for s in range(spec["n_spec"]):
                 kk = int(acc[i, s])
                 emit_t += [int(t) for t in toks[i, s, :kk + 1]]
                 emit_l += [float(v) for v in lps[i, s, :kk + 1]]
                 accepted += kk
-            st = self._slots[i]
             st["idx"] += len(emit_t)
-            st["disp"] = st["idx"]
+            st["disp"] -= worst - len(emit_t)
             st["last"] = emit_t[-1]
-            # One acquisition per slot (not per speculative step): the
-            # counters are accumulated locally first — same totals,
-            # bounded contention with metrics readers on the hot path.
+            if len(emit_t) < worst:
+                # Partial acceptance: every later in-flight spec record
+                # chained on the all-accepted assumption — doom them
+                # wholesale (they reconcile as drops above).
+                doom_later()
             with self._stats_lock:
-                self.stats["spec_proposed"] += (self._spec["gamma"]
-                                                * self._spec["n_spec"])
+                self.stats["spec_proposed"] += (spec["gamma"]
+                                                * spec["n_spec"])
                 self.stats["spec_accepted"] += accepted
                 self.stats["decode_tokens"] += len(emit_t)
             self._emit(i, st, emit_t, emit_l)
-        return True
 
     # tpk-hot: engine-dispatch
     def _dispatch_chunk(self, active: list[int],
@@ -2762,6 +3233,7 @@ class GenerationEngine:
         ks = np.zeros((self.n_slots,), np.int32)
         ps = np.ones((self.n_slots,), np.float32)
         aids = np.zeros((self.n_slots,), np.int32)
+        # tpk-sync: begin dispatch-row-gather van
         for i in active:
             st = self._slots[i]
             idx[i] = st["disp"]
@@ -2771,8 +3243,20 @@ class GenerationEngine:
             aids[i] = st.get("aid", 0)
             if st["pending"] is None and st["last"] is not None:
                 last[i] = st["last"]
+        # tpk-sync: end dispatch-row-gather
         trunc = any(ks[i] > 0 or ps[i] < 1.0 for i in active)
-        need = int(max(idx[i] for i in active)) + self.chunk
+        if not self._paged:
+            partset = set(active)
+            for j, stj in enumerate(self._slots):
+                if stj is None or j in partset:
+                    continue
+                # Rider parking: a live row excluded from this sub-batch
+                # (it belongs to the spec sub-batch) aims its batch-wide
+                # write at its own uncommitted tail — idx 0 would
+                # clobber committed prompt KV (paged riders write the
+                # NULL block instead and need no parking).
+                idx[j] = stj["disp"]
+        need = int(max(idx)) + self.chunk
         bucket = next((b for b in self.decode_buckets if b >= need),
                       self.decode_buckets[-1])
         self._key, sub = jax.random.split(self._key)
@@ -2823,8 +3307,8 @@ class GenerationEngine:
             st = self._slots[i]
             st["disp"] += self.chunk
             parts[i] = st
-        return {"toks": toks, "lps": lps, "parts": parts, "t0": t0,
-                "p0": p0, "chunk": self.chunk}
+        return {"kind": "van", "toks": toks, "lps": lps, "parts": parts,
+                "t0": t0, "p0": p0, "chunk": self.chunk}
 
     # tpk-hot: engine-fetch
     def _fetch_chunk(self, rec: dict, overlapped: bool) -> None:
@@ -2908,7 +3392,18 @@ class GenerationEngine:
         points). At depth >= 2 the fetch of chunk k overlaps the device
         executing chunk k+1 (and any admission dispatches), hiding the
         host/tunnel round-trip that capped 1-slot decode at ~200 tok/s
-        regardless of chip speed (PROFILE.md §5)."""
+        regardless of chip speed (PROFILE.md §5).
+
+        Each round splits the batch into TWO sub-batches dispatched
+        independently (per-sub-batch dispatch): the SPEC sub-batch
+        (greedy + plain-temperature rows, when a draft model is
+        configured) and the VANILLA sub-batch (top-k/top-p rows, plus
+        spec rows falling back near the context end). Each kind keeps
+        its own chain of up to `pipeline_depth` records in flight;
+        fetches drain oldest-first across both. Pure-vanilla traffic
+        reduces bit-for-bit to the single-chain loop above; pure-spec
+        traffic at depth 1 reproduces the classic synchronous spec
+        engine."""
         inflight: deque = deque()
         while not self._stop:
             self._admit_waiting(overlap=bool(inflight))
@@ -2928,18 +3423,46 @@ class GenerationEngine:
                 self._wake.wait(0.05)
                 self._wake.clear()
                 continue
-            if active and not inflight and self._try_spec_chunk(active):
-                continue
-            while active and len(inflight) < self.pipeline_depth:
-                if inflight and not self._worth_speculating(active):
+            while active:
+                dispatched = False
+                spec_chain = [r for r in inflight if r["kind"] == "spec"]
+                van_chain = [r for r in inflight if r["kind"] == "van"]
+                van_covered = {i for r in van_chain
+                               for i, st in r["parts"].items()
+                               if self._slots[i] is st}
+                parts, fallback = self._spec_batch(active, van_covered,
+                                                   spec_chain)
+                if parts and len(spec_chain) < self.pipeline_depth:
+                    inflight.append(self._dispatch_spec_chunk(
+                        parts,
+                        carry=spec_chain[-1] if spec_chain else None))
+                    self.inflight_depth = len(inflight)
+                    dispatched = True
+                spec_rows = {i for i in active
+                             if self._spec is not None
+                             and self._spec_able(self._slots[i]["req"])}
+                fb = set(fallback)
+                van_batch = [i for i in active
+                             if i not in spec_rows or i in fb]
+                if (van_batch and len(van_chain) < self.pipeline_depth
+                        and (not van_chain
+                             or self._worth_speculating(van_batch))
+                        and self._van_riders_fit(van_batch)):
+                    inflight.append(self._dispatch_chunk(
+                        van_batch,
+                        carry=van_chain[-1] if van_chain else None))
+                    self.inflight_depth = len(inflight)
+                    dispatched = True
+                if not dispatched:
                     break
-                inflight.append(self._dispatch_chunk(
-                    active, carry=inflight[-1] if inflight else None))
-                self.inflight_depth = len(inflight)
             if inflight:
                 rec = inflight.popleft()
                 self.inflight_depth = len(inflight)
-                self._fetch_chunk(rec, overlapped=bool(inflight))
+                if rec["kind"] == "spec":
+                    self._fetch_spec_chunk(rec, inflight,
+                                           overlapped=bool(inflight))
+                else:
+                    self._fetch_chunk(rec, overlapped=bool(inflight))
 
     def stats_snapshot(self) -> dict:
         """Tear-free copy of the engine counters for metrics/metadata

@@ -79,6 +79,17 @@ _ENGINE_METRICS = (
     ("decode_wasted_tokens", "tpk_engine_decode_wasted_tokens_total",
      "counter"),
     ("spec_dispatches", "tpk_engine_spec_dispatch_total", "counter"),
+    # Speculative decoding observability (ISSUE 18): proposal/accept
+    # volume and stale draft rides per model, so the sub-batch split
+    # ("mixed traffic still speculates") and draft quality are
+    # observable in production, not just in SERVEBENCH.json. The
+    # accept-rate gauge is computed at scrape (accepted/proposed) and
+    # only emitted once proposals flowed — draft-less engines and
+    # idle spec engines emit no rate, never a fake 0.
+    ("spec_proposed", "tpk_spec_proposed_total", "counter"),
+    ("spec_accepted", "tpk_spec_accepted_total", "counter"),
+    ("spec_stale_rides", "tpk_spec_stale_rides_total", "counter"),
+    ("__spec_accept_rate__", "tpk_spec_accept_rate", "gauge"),
     # Paged KV cache (ISSUE 6): prefix hits served as zero-copy block
     # references, copy-on-write tail-block forks, and the live pool
     # occupancy admission decides by. Flat engines (kv_block_size=0)
@@ -1213,6 +1224,11 @@ class ModelServer:
                     val = getattr(engine, "pipeline_depth", 1)
                 elif stat_key == "__inflight__":
                     val = getattr(engine, "inflight_depth", 0)
+                elif stat_key == "__spec_accept_rate__":
+                    proposed = stats.get("spec_proposed") or 0
+                    if not proposed:
+                        continue
+                    val = stats.get("spec_accepted", 0) / proposed
                 elif stat_key in ("__kv_free__", "__kv_used__",
                                   "__kv_spill__"):
                     # None on flat engines — the pool gauges only exist

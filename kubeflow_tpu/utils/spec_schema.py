@@ -127,11 +127,21 @@ GENERATIVE_KNOBS: dict[str, dict] = {
     "kv_block_size": {"type": "int", "min": 0},
     "kv_blocks": {"type": "int", "min": 0},
     # Disaggregated prefill/decode (ISSUE 13): "unified" (default) |
-    # "prefill" | "decode"; split roles need kv_block_size > 0.
-    "role": {"type": "string_or_null"},
+    # "prefill" | "decode"; split roles need kv_block_size > 0 (the
+    # cross-field rule lives in cpp/admission.h next to the table).
+    "role": {"type": "string_or_null",
+             "enum": ["unified", "prefill", "decode"]},
     # Host-RAM KV spill tier capacity in blocks (0 = off).
     "kv_host_tier_blocks": {"type": "int", "min": 0},
     "mesh": {"type": "object"},
+    # Speculative decoding draft spec: {"checkpoint": hf_dir,
+    # "gamma"?: int >= 1, "model_overrides"?: {...}} — contents are
+    # cross-field-validated in cpp/admission.h (ISSUE 18): a draft
+    # without a checkpoint, a fractional gamma, or a typo'd key fails
+    # at submit instead of crash-looping the replica at load. Since
+    # ISSUE 18 the draft COMPOSES with kv_block_size, role and
+    # pipeline_depth; only checkpoint-derived refusals (sliding-window
+    # drafts past their window, vocab mismatch) remain load-time.
     "draft": {"type": "object"},
     "adapters": {"type": "object"},
     "eos_id": {"type": "int_or_null"},

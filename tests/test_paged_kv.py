@@ -355,6 +355,16 @@ def test_paged_rejects_bad_compositions(tiny):
     model, params = tiny
     with pytest.raises(ValueError, match="divide max_len"):
         _engine(tiny, kv_block_size=7)
-    with pytest.raises(ValueError, match="speculative"):
-        _engine(tiny, kv_block_size=8,
-                draft={"model": model, "params": params, "cfg": CFG})
+    # Spec x paged composes now that the draft's KV lives in pool
+    # blocks (its own block-table rows, per-slot): construction must
+    # succeed, not refuse. The degenerate-gamma guard still holds.
+    eng = _engine(tiny, kv_block_size=8, kv_blocks=48,
+                  draft={"model": model, "params": params, "cfg": CFG})
+    try:
+        assert eng._spec is not None
+    finally:
+        eng.close()
+    with pytest.raises(ValueError, match="gamma"):
+        _engine(tiny, kv_block_size=8, kv_blocks=48,
+                draft={"model": model, "params": params, "cfg": CFG,
+                       "gamma": 0})

@@ -30,6 +30,20 @@ def test_servebench_quick_shape():
     assert pf["paged"]["pool_tokens"] == pf["flat"]["pool_tokens"]
     assert pf["paged"]["peak_inflight_requests"] > pf["flat"]["slots"]
     assert pf["concurrency_gain"] > 1
+    # Spec × paged × depth-2 A/B (ISSUE 18 tentpole): both arms on the
+    # same paged pool at pipeline_depth=2; the greedy probe is token+
+    # logprob-identical across arms (lossless claim on the composed
+    # path), and the mixed waves (one top-p row each) still speculated
+    # for their greedy rows — the sub-batch split proven by counters.
+    sg = r["spec_paged"]
+    assert sg["vanilla_paged"]["tok_s_e2e"] > 0
+    assert sg["spec_paged"]["tok_s_e2e"] > 0
+    assert sg["spec_paged"]["pipeline_depth"] == 2
+    assert sg["spec_paged"]["kv_block_size"] == 16
+    assert sg["greedy_identical"] is True
+    assert sg["mixed_traffic_speculated"] is True
+    assert sg["spec_paged"]["acceptance"] > 0.9  # self-draft ceiling
+    assert sg["speedup_wall"] > 0
     # Decode concurrency section: throughput positive at each slot count.
     assert set(r["decode"]) == {"slots_1", "slots_2"}
     for v in r["decode"].values():

@@ -239,13 +239,13 @@ def test_spec_mixed_batch_stays_correct(target):
         spec.close()
 
 
-def test_spec_readmission_after_mixed_traffic(target):
-    """r4 advisor finding (round-5 fix): a demoted slot re-admits its
-    draft cache from token history once the batch is all-spec-able
-    again, instead of decoding vanilla for the rest of its request.
-    The long greedy request must (a) stay token-identical to reference
-    greedy and (b) actually resume speculating after the short sampled
-    request retires."""
+def test_spec_mixed_traffic_keeps_speculating(target):
+    """ISSUE 18 tentpole (per-sub-batch dispatch): one truncated-
+    sampling request no longer disables speculation batch-wide. The
+    concurrent greedy request (a) stays token-identical to reference
+    greedy and (b) NEVER demotes — its chunks ride the spec sub-batch
+    while the top-p request decodes in its own vanilla sub-batch
+    (proven by the counters: spec acceptance with zero demotions)."""
     import threading
 
     cfg, model, params = target
@@ -255,7 +255,7 @@ def test_spec_readmission_after_mixed_traffic(target):
     finally:
         vanilla.close()
     # chunk=4: the sampled request spans many dispatches, so the greedy
-    # request reliably shares chunks with it (deterministic demotion).
+    # request reliably shares rounds with it (deterministic overlap).
     spec = _engine(target, chunk=4,
                    draft={"model": model, "params": params,
                           "cfg": cfg, "gamma": 3})
@@ -264,9 +264,7 @@ def test_spec_readmission_after_mixed_traffic(target):
 
         # Back-to-back submits (CPU dispatches are ~3 ms — sleeps can't
         # sequence this): both live in the slot batch from the first
-        # chunks, the sampled request forces vanilla (demotion), and its
-        # smaller budget retires it with the greedy request still owing
-        # >= 32 tokens — the re-admission window.
+        # rounds, so spec and vanilla sub-batches dispatch side by side.
         def greedy():
             results["g"] = spec.submit([5, 9, 2], max_tokens=48,
                                        temperature=0.0)
@@ -282,10 +280,17 @@ def test_spec_readmission_after_mixed_traffic(target):
         for t in ts:
             t.join(timeout=180)
         assert results["g"]["output_ids"] == ref["output_ids"]
+        assert len(results["s"]["output_ids"]) == 16
         s = spec.stats
-        assert s["spec_demotions"] >= 1, s
-        assert s["spec_readmissions"] >= 1, s
+        # The split itself: speculation ran, accepted tokens, and the
+        # greedy row never rode a vanilla chunk (no demotion — the old
+        # batch-wide gate would have demoted it every mixed round).
         assert s["spec_dispatches"] > 0, s
+        assert s["spec_accepted"] > 0, s
+        assert s["spec_demotions"] == 0, s
+        assert s["spec_readmissions"] == 0, s
+        # The top-p rows really decoded in their own vanilla sub-batch.
+        assert s["decode_dispatches"] > s["spec_dispatches"], s
     finally:
         spec.close()
 
@@ -328,13 +333,17 @@ def test_spec_composes_with_mesh(target):
 
 def test_spec_stale_ride_excludes_unworthy_from_readmission(target):
     """ADVICE r5 partial fix: a permanently-unworthy demoted slot (the
-    replay can never pay for itself) no longer gates speculation for
-    the whole batch — worthy traffic speculates while the unworthy slot
-    rides the spec chunk with STALE draft rows, and its output stays
-    token-identical (every emitted token comes from the target verify).
-    `_readmit_worthwhile` is forced False to model the permanently-
-    unworthy class deterministically (near-budget / history >> remainder
-    are timing windows on CPU)."""
+    replay can never pay for itself) does not gate speculation for the
+    rest of the batch — worthy traffic speculates while the unworthy
+    slot rides the spec chunk with STALE draft rows, and its output
+    stays token-identical (every emitted token comes from the target
+    verify). Per-sub-batch dispatch means mixed traffic alone no longer
+    demotes anyone, so the demotion is forced deterministically: the
+    first spec-eligible rounds are gated to full vanilla fallback
+    (modelling e.g. a post-resize window where the draft pool is cold),
+    and `_readmit_worthwhile` is forced False to model the permanently-
+    unworthy class (near-budget / history >> remainder are timing
+    windows on CPU)."""
     import threading
 
     cfg, model, params = target
@@ -348,6 +357,22 @@ def test_spec_stale_ride_excludes_unworthy_from_readmission(target):
                    draft={"model": model, "params": params,
                           "cfg": cfg, "gamma": 3})
     spec._readmit_worthwhile = lambda st: False
+    orig_split = spec._spec_batch
+    calls = {"n": 0}
+
+    def gated_split(active, van_covered, spec_chain):
+        # Force the first spec-eligible no-chain rounds to full vanilla
+        # fallback: the spec-able rows ride vanilla chunks, which stales
+        # their draft rows (spec_demotions). Safe only while no spec
+        # chunk is in flight — rows covered by one must not dispatch
+        # vanilla at a stale idx.
+        calls["n"] += 1
+        parts, fb = orig_split(active, van_covered, spec_chain)
+        if calls["n"] <= 3 and not spec_chain:
+            return [], parts + fb
+        return parts, fb
+
+    spec._spec_batch = gated_split
     try:
         results = {}
 
@@ -355,10 +380,10 @@ def test_spec_stale_ride_excludes_unworthy_from_readmission(target):
             results["a"] = spec.submit([5, 9, 2], max_tokens=48)
 
         def sampled_then_greedy():
-            # The truncated-sampling request forces vanilla chunks
-            # (demoting A's draft cache); once it retires, the fresh
-            # greedy C makes the batch spec-able again — under the old
-            # batch-wide gate, unworthy-A would keep everyone vanilla.
+            # Once the sampled request retires, the fresh greedy C
+            # (clean draft cache) re-opens speculation; demoted
+            # unworthy-A rides its chunks stale instead of replaying
+            # or gating C back to vanilla.
             results["s"] = spec.submit([8, 1], max_tokens=12,
                                        temperature=0.9, top_p=0.9)
             results["c"] = spec.submit([4, 4, 1], max_tokens=16)
@@ -378,3 +403,239 @@ def test_spec_stale_ride_excludes_unworthy_from_readmission(target):
         assert s["spec_dispatches"] > 0, s
     finally:
         spec.close()
+
+
+# -- ISSUE 18 determinism matrix: spec × {paged, depth-2, disagg, resume} ----
+
+
+_PAGED_KW = dict(kv_block_size=8, kv_blocks=40, max_len=64, chunk=8)
+
+
+def test_spec_paged_identical_to_flat(target):
+    """spec × paged: the draft's own block-table rows in the shared
+    pool decode token+logprob-identically to the flat draft cache, for
+    both greedy (exact argmax match) and plain temperature (rejection
+    sampling on the same key stream)."""
+    cfg, model, params = target
+    draft = {"model": model, "params": params, "cfg": cfg, "gamma": 3}
+    flat = _engine(target, draft=draft)
+    try:
+        ref_g = flat.submit([5, 9, 2], max_tokens=24, temperature=0.0)
+        ref_t = flat.submit([8, 1, 4], max_tokens=16, temperature=0.7)
+    finally:
+        flat.close()
+    paged = _engine(target, draft=draft, **_PAGED_KW)
+    try:
+        out_g = paged.submit([5, 9, 2], max_tokens=24, temperature=0.0)
+        out_t = paged.submit([8, 1, 4], max_tokens=16, temperature=0.7)
+        assert out_g["output_ids"] == ref_g["output_ids"]
+        np.testing.assert_allclose(out_g["output_logprobs"],
+                                   ref_g["output_logprobs"], rtol=1e-4,
+                                   atol=1e-5)
+        assert out_t["output_ids"] == ref_t["output_ids"]
+        np.testing.assert_allclose(out_t["output_logprobs"],
+                                   ref_t["output_logprobs"], rtol=1e-4,
+                                   atol=1e-5)
+        s = paged.stats
+        assert s["spec_dispatches"] > 0, s
+        assert s["spec_accepted"] > 0, s
+    finally:
+        paged.close()
+
+
+def test_spec_depth2_weak_draft_identical(target):
+    """spec × pipeline_depth=2 with a WEAK draft (different init):
+    rejections are frequent, so chained spec chunks over-dispatch on
+    the all-accepted carry and get doomed + reconciled at fetch — and
+    the output must STILL be token-identical to vanilla greedy (the
+    strongest check on the disp bookkeeping: a single unreconciled
+    over-advance diverges immediately)."""
+    cfg, model, params = target
+    _, wparams = _params(cfg, seed=1)
+    weak = {"model": model, "params": wparams, "cfg": cfg, "gamma": 3}
+    vanilla = _engine(target, pipeline_depth=1)
+    try:
+        ref = vanilla.submit([5, 9, 2], max_tokens=32, temperature=0.0)
+    finally:
+        vanilla.close()
+    for kw in ({}, _PAGED_KW):  # flat AND paged (ISSUE 18 acceptance)
+        spec = _engine(target, draft=weak, pipeline_depth=2, **kw)
+        try:
+            out = spec.submit([5, 9, 2], max_tokens=32, temperature=0.0)
+            assert out["output_ids"] == ref["output_ids"], kw
+            np.testing.assert_allclose(out["output_logprobs"],
+                                       ref["output_logprobs"],
+                                       rtol=1e-4, atol=1e-5)
+            s = spec.stats
+            assert s["spec_dispatches"] > 0, s
+            # The weak draft actually got rejected somewhere.
+            assert s["spec_accepted"] < s["spec_proposed"], s
+        finally:
+            spec.close()
+
+
+def test_spec_disagg_draft_shipment_identity(target):
+    """spec × disagg: a draft-carrying (fmt 2) TPKV1 shipment admits on
+    a draft-configured decode replica which then SPECULATES, seeded
+    stream token+logprob-identical to the unified spec engine."""
+    from kubeflow_tpu.serve.kv_transfer import peek_meta
+
+    cfg, model, params = target
+    draft = {"model": model, "params": params, "cfg": cfg, "gamma": 3}
+    prompt = [5, 9, 2, 7, 3]
+    uni = _engine(target, draft=draft, seed=5, **_PAGED_KW)
+    try:
+        ref = uni.submit(prompt, max_tokens=12, temperature=0.0)
+    finally:
+        uni.close()
+    pre = _engine(target, draft=draft, seed=5, role="prefill",
+                  **_PAGED_KW)
+    dec = _engine(target, draft=draft, seed=999, role="decode",
+                  **_PAGED_KW)
+    try:
+        ship = pre.prefill_ship(prompt, max_tokens=12, temperature=0.0)
+        meta = peek_meta(ship["shipment"])
+        assert meta["fmt"] == 2 and "draft" in meta
+        out = dec.submit_remote(ship["shipment"])
+        assert out["output_ids"] == ref["output_ids"]
+        np.testing.assert_allclose(out["output_logprobs"],
+                                   ref["output_logprobs"], rtol=1e-4,
+                                   atol=1e-5)
+        s = dec.stats
+        assert s["spec_dispatches"] > 0, s
+        assert s["spec_accepted"] > 0, s
+        # Both pools drained fully — draft blocks freed with the slot.
+        assert pre._kv_alloc.used_blocks == 0
+        assert dec._kv_alloc.used_blocks == 0
+    finally:
+        pre.close()
+        dec.close()
+
+
+def test_spec_disagg_draft_section_refusals(target):
+    """Failure semantics on the wire: a draft-less decode replica
+    REFUSES a fmt-2 (draft-carrying) shipment loudly at admission; a
+    draft-configured decode replica ACCEPTS a fmt-1 (draft-less)
+    shipment by replaying the draft cache locally — and still
+    speculates."""
+    from kubeflow_tpu.serve.kv_transfer import ShipmentError
+
+    cfg, model, params = target
+    draft = {"model": model, "params": params, "cfg": cfg, "gamma": 3}
+    prompt = [5, 9, 2]
+    pre_spec = _engine(target, draft=draft, seed=5, role="prefill",
+                       **_PAGED_KW)
+    pre_van = _engine(target, seed=5, role="prefill", **_PAGED_KW)
+    try:
+        ship2 = pre_spec.prefill_ship(prompt, max_tokens=8)["shipment"]
+        ship1 = pre_van.prefill_ship(prompt, max_tokens=8)["shipment"]
+    finally:
+        pre_spec.close()
+        pre_van.close()
+    # fmt 2 on a draft-less decode replica: loud refusal, not a crash
+    # loop or silent draft drop.
+    dec_van = _engine(target, seed=5, role="decode", **_PAGED_KW)
+    try:
+        with pytest.raises(ShipmentError, match="draft"):
+            dec_van.submit_remote(ship2)
+        ref = dec_van.submit_remote(ship1)
+        assert dec_van._kv_alloc.used_blocks == 0
+    finally:
+        dec_van.close()
+    # fmt 1 on a spec decode replica: local draft replay at admission,
+    # then full speculation — token-identical to the vanilla decode.
+    dec_spec = _engine(target, draft=draft, seed=5, role="decode",
+                       **_PAGED_KW)
+    try:
+        out = dec_spec.submit_remote(ship1)
+        assert out["output_ids"] == ref["output_ids"]
+        assert dec_spec.stats["spec_dispatches"] > 0
+        assert dec_spec._kv_alloc.used_blocks == 0
+    finally:
+        dec_spec.close()
+
+
+def test_spec_resume_cursor_replays_through_spec_engine(target):
+    """spec × mid-stream resume (ISSUE 14 router failover): re-playing
+    the SAME draft-carrying shipment with a `resume_skip` cursor on a
+    spec decode replica suppresses exactly the first K chunk tokens and
+    keeps the done summary token+logprob-identical — the replay runs
+    through the spec engine, not a vanilla fallback."""
+    from kubeflow_tpu.serve.generation import GenerativeJAXModel
+    from kubeflow_tpu.serve.kv_transfer import rewrite_meta
+
+    cfg, model, params = target
+    draft = {"model": model, "params": params, "cfg": cfg, "gamma": 3}
+    pre = _engine(target, draft=draft, seed=5, role="prefill",
+                  **_PAGED_KW)
+    try:
+        ship = pre.prefill_ship([5, 9, 2, 7], max_tokens=10,
+                                temperature=0.7)["shipment"]
+    finally:
+        pre.close()
+    dec = _engine(target, draft=draft, seed=222, role="decode",
+                  **_PAGED_KW)
+    m = GenerativeJAXModel("m", model, params, cfg)
+    m.engine, m.ready = dec, True
+
+    def run(shipment):
+        chunks, final = [], None
+        for ev in m.decode_remote_stream(shipment):
+            if ev.get("done"):
+                final = ev
+            else:
+                chunks.extend(ev["tokens"])
+        return chunks, final
+
+    try:
+        full, fin1 = run(ship)
+        assert full == fin1["output_ids"]
+        tail, fin2 = run(rewrite_meta(ship, resume_skip=4))
+        assert tail == full[4:]
+        assert fin2["output_ids"] == fin1["output_ids"]
+        assert fin2["output_logprobs"] == fin1["output_logprobs"]
+        assert dec.stats["spec_dispatches"] > 0
+    finally:
+        dec.close()
+
+
+def test_spec_paged_pool_accounting(target):
+    """Draft blocks free with their slot: across EOS-by-budget
+    completions and a mixed (spec + vanilla sub-batch) round, the
+    allocator returns to zero used blocks — no refcount leak from the
+    draft's per-slot rows. Mid-request, the slot really holds BOTH
+    footprints (target + draft)."""
+    import threading
+
+    from kubeflow_tpu.serve.paging import blocks_for
+
+    cfg, model, params = target
+    draft = {"model": model, "params": params, "cfg": cfg, "gamma": 3}
+    eng = _engine(target, draft=draft, **_PAGED_KW)
+    peak = {"used": 0}
+
+    def watch(toks, lps):
+        peak["used"] = max(peak["used"], eng._kv_alloc.used_blocks)
+
+    try:
+        eng.submit([5, 9, 2], max_tokens=16, on_tokens=watch)
+        assert eng._kv_alloc.used_blocks == 0
+        # Target alone would hold blocks_for(3 + 16) = 3 blocks; the
+        # draft's private rows at least double the slot's footprint.
+        assert peak["used"] >= 2 * blocks_for(3 + 16, 8), peak
+
+        results = {}
+        ts = [threading.Thread(target=lambda: results.setdefault(
+                  "g", eng.submit([5, 9, 2], max_tokens=16))),
+              threading.Thread(target=lambda: results.setdefault(
+                  "s", eng.submit([8, 1], max_tokens=8,
+                                  temperature=0.9, top_p=0.9)))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=180)
+        assert len(results["g"]["output_ids"]) == 16
+        assert len(results["s"]["output_ids"]) == 8
+        assert eng._kv_alloc.used_blocks == 0, eng.stats
+    finally:
+        eng.close()

@@ -787,6 +787,45 @@ def test_host_fetch_in_remote_admit_turns_red(tmp_path):
     assert len(fs) == 1 and "remote-admit" in fs[0].message
 
 
+def test_mutating_dispatch_row_gather_twin_turns_red(tmp_path):
+    """ISSUE 18: the spec sub-batch must gather per-row dispatch state
+    by the IDENTICAL recipe as the vanilla dispatch loop — drifting one
+    side alone (e.g. reading idx where the twin reads disp) is a tier-1
+    finding, not a depth-2 race found in production."""
+    dst = _copy_engine_tree(tmp_path)
+    src = dst.read_text()
+    needle = 'temps[i] = st["req"]["temperature"]'
+    assert src.count(needle) == 2  # spec gather + van gather
+    dst.write_text(src.replace(
+        needle, 'temps[i] = float(st["req"]["temperature"])', 1))
+    fs = lint(tmp_path, rules=["sync-regions"])
+    assert len(fs) == 1 and "dispatch-row-gather" in fs[0].message
+
+
+def test_deleting_spec_hot_markers_turns_red(tmp_path):
+    for label in ("spec-dispatch", "spec-reconcile"):
+        dst = _copy_engine_tree(tmp_path / label)
+        dst.write_text(dst.read_text().replace(
+            f"    # tpk-hot: {label}\n", ""))
+        fs = lint(tmp_path / label, rules=["host-sync"])
+        assert any(label in f.message for f in fs)
+
+
+def test_host_fetch_in_spec_reconcile_turns_red(tmp_path):
+    """The spec reconcile owns the disp-invariant bookkeeping for BOTH
+    sub-batch chains — an unmarked host sync here re-serializes the
+    whole pipelined loop, exactly what the hot-path guard exists to
+    catch."""
+    dst = _copy_engine_tree(tmp_path)
+    marker = "        def doom_later() -> None:"
+    src = dst.read_text()
+    assert src.count(marker) == 1
+    dst.write_text(src.replace(
+        marker, "        _ = self._cache.item()\n" + marker))
+    fs = lint(tmp_path, rules=["host-sync"])
+    assert len(fs) == 1 and "spec-reconcile" in fs[0].message
+
+
 def test_tier_state_outside_lock_turns_red(tmp_path):
     """HostKVTier's transfer/spill state is guarded-by-declared; an
     access escaping `with self._lock:` is a finding on a copy of the

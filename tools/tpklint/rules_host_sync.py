@@ -66,6 +66,13 @@ REQUIRED_HOT_PATHS = {
     # exists to buy (the shipped first token/logprob are already host
     # scalars; nothing may sync).
     "remote-admit": "kubeflow_tpu/serve/generation.py",
+    # Speculative sub-batch dispatch + reconcile (ISSUE 18): the spec
+    # twin of engine-dispatch/engine-fetch. The reconcile owns the
+    # disp-invariant bookkeeping (over-dispatch carry vs emitted
+    # width) — an unmarked host fetch here would re-serialize BOTH
+    # sub-batch chains, not just the spec one.
+    "spec-dispatch": "kubeflow_tpu/serve/generation.py",
+    "spec-reconcile": "kubeflow_tpu/serve/generation.py",
 }
 
 _MARK = re.compile(r"#\s*tpk-hot:\s*(.+?)\s*$")

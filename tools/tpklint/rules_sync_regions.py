@@ -45,6 +45,12 @@ REQUIRED_TAGS = {
     # under-reserve) local ones and break the free-block accounting
     # the refcount/CoW discipline sits on.
     "kv-block-reserve": "kubeflow_tpu/serve/generation.py",
+    # ISSUE 18: the spec sub-batch gathers per-row dispatch state by
+    # the IDENTICAL row walk as the vanilla dispatch loop — a drifted
+    # copy would dispatch the two sub-batches from inconsistent slot
+    # snapshots (e.g. one reading idx, the other disp) and the
+    # token-identity pins would only catch it at depth > 1 races.
+    "dispatch-row-gather": "kubeflow_tpu/serve/generation.py",
 }
 
 _MARK = re.compile(r"#\s*tpk-sync:\s*(begin|end|sub)\s*(.*?)\s*$")
