@@ -313,10 +313,12 @@ def main_serve() -> None:
                           "representative of chip performance; relative "
                           "metrics (bucket speedup, int8 delta, batcher "
                           "percentiles) remain meaningful.")
-        for ab in ("pipelined_vs_sync", "paged_vs_flat", "spec_paged"):
+        for ab in ("pipelined_vs_sync", "paged_vs_flat", "spec_paged",
+                   "quant_paged"):
             # Chip-sensitive A/Bs: the tunnel-RTT-hiding claim, the
-            # paged pool's HBM headroom, and the spec-decode speedup
-            # (draft-step cost is chip-relative) all need the chip;
+            # paged pool's HBM headroom, the spec-decode speedup
+            # (draft-step cost is chip-relative), and the quantized
+            # pool's concurrency-at-HBM-parity claim all need the chip;
             # record the chip measurement as skipped-with-reason per
             # BENCH_r05 precedent while keeping the CPU harness numbers
             # (the mechanism proofs — overlapped fetches, host-stall

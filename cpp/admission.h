@@ -610,6 +610,26 @@ inline std::string ValidateSpec(const std::string& kind, const Json& spec) {
         return "model.generative.kv_host_tier_blocks needs "
                "kv_block_size > 0 (the host tier spills whole blocks)";
       }
+      // Quantized KV blocks (ISSUE 19). Enum validity ("none" | "int8"
+      // | "fp8") is schema-table-driven above; the composition rules
+      // live here: the scale pool is a paged structure (no flat-cache
+      // quantization), and a speculative rejection rewind would
+      // re-quantize committed rows, so kv_quant x draft is refused —
+      // the engine raises the same refusals at load, this just moves
+      // them to submit.
+      const Json& kvq = gen.get("kv_quant");
+      const bool quantized = kvq.is_string() && kvq.as_string() != "none";
+      if (quantized && kv_bs == 0) {
+        return "model.generative.kv_quant=" + kvq.as_string() +
+               " needs kv_block_size > 0 (the quantized scale pool "
+               "is paged; the flat cache has no quantized form)";
+      }
+      if (quantized && gen.get("draft").is_object()) {
+        return "model.generative.kv_quant=" + kvq.as_string() +
+               " does not compose with draft (speculative decoding): "
+               "a rejection rewind would re-quantize committed KV "
+               "rows — drop one of the two";
+      }
       const Json& draft = gen.get("draft");
       if (draft.is_object()) {
         static const std::set<std::string> kDraftKeys = {

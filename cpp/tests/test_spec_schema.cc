@@ -206,12 +206,14 @@ int main() {
         CHECK(ValidateGenerative(One(field, obj)).empty());
         CHECK(!ValidateGenerative(One(field, 5)).empty());
       } else if (type == "string_or_null") {
-        // role additionally has a cross-field rule (split roles need
-        // kv_block_size > 0) — satisfy it so the enum probe isolates
-        // the schema check.
+        // role and kv_quant additionally have cross-field rules (both
+        // need kv_block_size > 0) — satisfy them so the enum probe
+        // isolates the schema check.
         auto probe = [&](Json v) {
           Json g = One(field, std::move(v));
-          if (field == "role") g["kv_block_size"] = 16;
+          if (field == "role" || field == "kv_quant") {
+            g["kv_block_size"] = 16;
+          }
           return ValidateGenerative(std::move(g));
         };
         if (entry.has("enum")) {
@@ -293,6 +295,28 @@ int main() {
     gen["kv_blocks"] = 64;
     gen["pipeline_depth"] = 2;
     CHECK(ValidateGenerative(gen).empty());  // spec x paged x disagg
+    // Quantized KV blocks (ISSUE 19): table row pinned by name; the
+    // scale pool is paged, so kv_quant needs kv_block_size > 0; and
+    // kv_quant x draft is refused (a rejection rewind would
+    // re-quantize committed rows). "none" is the escape hatch and
+    // composes with everything, including draft.
+    CHECK(gtable.has("kv_quant"));
+    CHECK(ValidateGenerative(One("kv_quant", "int8"))
+              .find("needs kv_block_size") != std::string::npos);
+    gen = One("kv_quant", "fp8");
+    gen["kv_block_size"] = 16;
+    CHECK(ValidateGenerative(gen).empty());
+    gen["role"] = "prefill";  // quant x disagg composes
+    CHECK(ValidateGenerative(gen).empty());
+    gen["role"] = nullptr;
+    draft = Json::Object();
+    draft["checkpoint"] = "/drafts/tiny";
+    gen["draft"] = draft;
+    CHECK(ValidateGenerative(gen).find("does not compose with draft") !=
+          std::string::npos);
+    gen["kv_quant"] = "none";  // escape hatch composes with draft
+    CHECK(ValidateGenerative(gen).empty());
+    CHECK(ValidateGenerative(One("kv_quant", "none")).empty());
     printf("generative cross-field composition rules OK\n");
   }
 

@@ -245,7 +245,7 @@ from kubeflow_tpu.ops.reference import naive_attention  # noqa: E402,F401
 
 
 def init_cache(cfg: LlamaConfig, batch: int, max_len: int | None = None,
-               dtype: Any = None) -> dict:
+               dtype: Any = None, kv_quant: str = "none") -> dict:
     """Decode KV cache: {"k","v"} of [L, B, T, KH, D] (layer-stacked so the
     scanned trunk consumes it as a per-layer scan input). Functional — the
     cache is passed into and returned from `Llama.__call__`, never stored as
@@ -258,7 +258,13 @@ def init_cache(cfg: LlamaConfig, batch: int, max_len: int | None = None,
     rows, writes wrap modularly, and a "pos" plane [L, B, T] records each
     row's absolute position (sentinel -(window+1) = never written) so
     attention can mask reads exactly — the vLLM/HF rolling-buffer
-    capability, XLA-shaped (static shapes, pure fns)."""
+    capability, XLA-shaped (static shapes, pure fns).
+
+    `kv_quant` != "none" (ISSUE 19) stores K/V as int8/fp8 with per-row
+    f32 scale planes "ks"/"vs" of [L, B, T, KH] — the paged pool's
+    quantized layout (serve/quant.py KV helpers). Rolling caches never
+    quantize (the engine refuses the combination upstream: quantization
+    requires the paged pool, rolling requires the flat layout)."""
     t = max_len or cfg.max_seq_len
     dt = dtype or cfg.dtype
     window = int(getattr(cfg, "mask_window", 0) or 0)
@@ -274,6 +280,18 @@ def init_cache(cfg: LlamaConfig, batch: int, max_len: int | None = None,
         cache["pos"] = jnp.full((cfg.num_layers, batch, t),
                                 -(window + 1), jnp.int32)
     shape = (cfg.num_layers, batch, t, cfg.num_kv_heads, cfg.head_dim)
+    if kv_quant != "none":
+        from kubeflow_tpu.serve.quant import kv_qdtype
+
+        if "pos" in cache:
+            raise ValueError("kv_quant does not compose with a rolling "
+                             "sliding-window cache")
+        qdt = kv_qdtype(kv_quant)
+        cache.update({"k": jnp.zeros(shape, qdt),
+                      "v": jnp.zeros(shape, qdt),
+                      "ks": jnp.zeros(shape[:-1], jnp.float32),
+                      "vs": jnp.zeros(shape[:-1], jnp.float32)})
+        return cache
     cache.update({"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)})
     return cache
 
@@ -287,6 +305,17 @@ def _update_cache(cache_k, cache_v, k, v, index):
                 jax.lax.dynamic_update_slice(cv, vv, (i, 0, 0)))
     return jax.vmap(row)(cache_k, cache_v, k.astype(cache_k.dtype),
                          v.astype(cache_v.dtype), index)
+
+
+def _update_rows(cache_leaf, new_rows, index):
+    """`_update_cache` generalized over trailing rank: writes `new_rows`
+    [B, S, ...] into a per-layer plane [B, T, ...] at per-row offsets —
+    the quantized cache's f32 scale planes [B, T, KH] ride next to the
+    value planes [B, T, KH, D] through the same per-row write."""
+    def row(c, n, i):
+        return jax.lax.dynamic_update_slice(c, n, (i,) + (0,) * (c.ndim - 1))
+    return jax.vmap(row)(cache_leaf, new_rows.astype(cache_leaf.dtype),
+                         index)
 
 
 def _update_cache_rolling(cache, k, v, positions, index, window):
@@ -430,9 +459,36 @@ class Attention(nn.Module):
                 "cache is built with max_len > window)")
 
         new_cache = None
+        k_scale = v_scale = None
         if cache is not None:
-            ck, cv = _update_cache(cache["k"], cache["v"], k, v, cache_index)
-            new_cache = {"k": ck, "v": cv}
+            if "ks" in cache:
+                # Quantized pool view (ISSUE 19): quantize ONLY the
+                # newly written rows, write values + scales through the
+                # generic per-row updater, and hand attention the RAW
+                # quantized cache plus the scale planes — dequant is
+                # output-side inside naive_attention (scores × k_scale,
+                # probs × v_scale), so no full-width fp cache exists in
+                # the scan carry and committed rows' bytes never change.
+                from kubeflow_tpu.serve.quant import kv_quantize_rows
+
+                qmode = ("int8" if cache["k"].dtype == jnp.int8
+                         else "fp8")
+                # tpk-sync: begin kv-quant-scatter decode
+                kq, ks = kv_quantize_rows(k, qmode)
+                vq, vs = kv_quantize_rows(v, qmode)
+                # tpk-sync: end kv-quant-scatter
+                new_cache = {
+                    "k": _update_rows(cache["k"], kq, cache_index),
+                    "v": _update_rows(cache["v"], vq, cache_index),
+                    "ks": _update_rows(cache["ks"], ks, cache_index),
+                    "vs": _update_rows(cache["vs"], vs, cache_index)}
+                ck = new_cache["k"].astype(k.dtype)  # bare convert
+                cv = new_cache["v"].astype(v.dtype)
+                k_scale, v_scale = new_cache["ks"], new_cache["vs"]
+            else:
+                ck, cv = _update_cache(cache["k"], cache["v"], k, v,
+                                       cache_index)
+                new_cache = {"k": ck, "v": cv}
             if x.shape[1] == 1 or attend_full_cache:
                 # Single-token decode — or a continuation chunk
                 # (attend_full_cache: S new tokens at a nonzero offset,
@@ -451,7 +507,7 @@ class Attention(nn.Module):
                     positions_kv=jnp.broadcast_to(jnp.arange(t), (ck.shape[0], t)),
                     softcap=cfg.attn_softcap,
                     mask=(mask_spec if sliding is not None else None),
-                    windowed=sliding)
+                    windowed=sliding, k_scale=k_scale, v_scale=v_scale)
                 return o_proj(out), new_cache
             # Prefill (cache_index must be 0): nothing precedes the new
             # tokens, so attention over just k/v is exact — the fast flash

@@ -16,7 +16,7 @@ def naive_attention(q, k, v, *, causal: bool = True,
                     positions_q=None, positions_kv=None,
                     segment_ids=None, segment_ids_kv=None,
                     mask=None, softcap: float = 0.0,
-                    windowed=None) -> jax.Array:
+                    windowed=None, k_scale=None, v_scale=None) -> jax.Array:
     """q: [B,S,H,D]; k,v: [B,T,KH,D] with H % KH == 0; fp32 softmax.
     Causality is masked by absolute positions when given (packed/offset
     sequences), else by array index. `segment_ids` [B,S] (and optionally a
@@ -28,7 +28,16 @@ def naive_attention(q, k, v, *, causal: bool = True,
     tanh(s/cap)*cap after scaling, before masking. `windowed` (traced
     scalar bool, Gemma-2's alternating layers) gates a sliding_window
     mask's band per call: where False the mask degrades to plain causal
-    — dynamic, so one scanned trunk serves both layer types."""
+    — dynamic, so one scanned trunk serves both layer types.
+
+    `k_scale`/`v_scale` [B,T,KH] f32 are per-row dequant scales for a
+    QUANTIZED cache (serve/quant.py KV helpers): k/v arrive as the raw
+    quantized values through a bare convert, and the scales land on the
+    score/prob tensors — `scores * k_scale` after Q·Kᵀ, `probs *
+    v_scale` before probs·V (the scale varies along the contraction
+    axis, so pre-contraction on probs is the output-side placement). No
+    cache-width `[..., T, KH, D]` multiply ever exists; the HLO guard
+    in tests/test_kv_quant.py pins this."""
     if (mask is not None and mask.kind == "prefix_lm"
             and segment_ids is not None):
         # Same refusal as flash_attention: a global prefix boundary is
@@ -44,6 +53,8 @@ def naive_attention(q, k, v, *, causal: bool = True,
     group = h // kh
     qg = q.reshape(b, s, kh, group, d)
     scores = jnp.einsum("bskgd,btkd->bkgst", qg, k).astype(jnp.float32)
+    if k_scale is not None:
+        scores = scores * k_scale.transpose(0, 2, 1)[:, :, None, None, :]
     scores = scores / jnp.sqrt(d).astype(jnp.float32)
     if softcap:
         scores = jnp.tanh(scores / softcap) * softcap
@@ -75,6 +86,9 @@ def naive_attention(q, k, v, *, causal: bool = True,
         seg = (segment_ids[:, None, None, :, None]
                == sk[:, None, None, None, :])
         scores = jnp.where(seg, scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    probs = jax.nn.softmax(scores, axis=-1)
+    if v_scale is not None:
+        probs = probs * v_scale.transpose(0, 2, 1)[:, :, None, None, :]
+    probs = probs.astype(q.dtype)
     out = jnp.einsum("bkgst,btkd->bskgd", probs, v)
     return out.reshape(b, s, h, d)

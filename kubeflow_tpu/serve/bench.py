@@ -470,6 +470,117 @@ def bench_paged_vs_flat(model, params, cfg, *, slots: int, max_len: int,
     return res
 
 
+def bench_quant_paged(model, params, cfg, *, slots: int, max_len: int,
+                      chunk: int, buckets, decode_tokens: int,
+                      rng: np.random.Generator) -> dict:
+    """ISSUE 19 tentpole A/B: int8 KV pool against the full-precision
+    paged pool at EQUAL pool HBM — the quantized arm's block count is
+    scaled by the per-token byte ratio (D·itemsize vs D+4 with the f32
+    scale, ≈2x at bf16/D=64), floor-rounded so its pool never exceeds
+    the full arm's bytes, and its decode width doubled again so the
+    extra blocks can become extra concurrent requests.
+    `peak_inflight_requests` is the mechanism proof (the quant pool
+    RUNS more requests in the same memory); wall/tok_s the outcome.
+    Two side rows make the rest of the claim: the same greedy probe
+    through both arms (quality delta = max per-token |Δlogprob| —
+    measured, not asserted) and one prefill handoff per arm (fmt-3
+    wire bytes vs fmt-1 for the identical prompt). Fetch-synced per
+    PROFILE §1: _drain returns when every token is host-side."""
+    from kubeflow_tpu.serve.generation import GenerationEngine
+    from kubeflow_tpu.serve.kv_transfer import peek_meta
+
+    bs = 16  # divides max_len and every power-of-two decode bucket
+    d = int(cfg.head_dim)
+    fitem = int(jnp.dtype(cfg.dtype).itemsize)
+    pool_blocks = slots * max_len // bs
+    # Equal HBM: int8 rows cost D bytes + one f32 scale per row-head.
+    q_blocks = pool_blocks * (d * fitem) // (d + 4)
+    n_req = 8 * slots
+    prompts = [list(rng.integers(
+        1, cfg.vocab_size, int(rng.integers(8, max(10, max_len // 8)))))
+        for _ in range(n_req)]
+    probe = list(rng.integers(1, cfg.vocab_size, 16))
+    res: dict[str, Any] = {}
+    ident: dict[str, Any] = {}
+    for label, kw, width, blocks in (
+            ("full_paged", {}, 2 * slots, pool_blocks),
+            ("quant_paged", {"kv_quant": "int8"}, 4 * slots, q_blocks)):
+        eng = GenerationEngine(model, params, cfg, slots=width,
+                               max_len=max_len, chunk=chunk,
+                               prefill_buckets=buckets, prefix_cache=0,
+                               pipeline_depth=2, kv_block_size=bs,
+                               kv_blocks=blocks, **kw)
+        peak = [0]
+        orig = eng._dispatch_chunk
+
+        def spy(active, carry=None, _orig=orig, _peak=peak):
+            _peak[0] = max(_peak[0], len(active))
+            return _orig(active, carry)
+
+        eng._dispatch_chunk = spy
+        try:
+            dt, done = _drain(eng, prompts, decode_tokens)
+            s = eng.stats
+            emitted = sum(r["num_output_tokens"] for r in done)
+            res[label] = {
+                "slots": width,
+                "kv_block_size": bs,
+                "kv_blocks": blocks,
+                # Measured, not derived: the device pool's actual bytes
+                # (values + scale planes + the reserved garbage block).
+                "pool_bytes": int(sum(np.asarray(a).nbytes
+                                      for a in eng._cache.values())),
+                "requests": n_req,
+                "wall_s": round(dt, 4),
+                "tok_s_e2e": round(emitted / max(dt, 1e-9), 1),
+                "decode_dispatches": s["decode_dispatches"],
+                "peak_inflight_requests": peak[0],
+            }
+            out = eng.submit(probe, max_tokens=decode_tokens,
+                             temperature=0.0)
+            ident[label] = (out["output_ids"], out["output_logprobs"])
+        finally:
+            eng.close()
+    res["kv_blocks_ratio"] = round(q_blocks / max(pool_blocks, 1), 3)
+    res["concurrency_gain"] = round(
+        res["quant_paged"]["peak_inflight_requests"]
+        / max(res["full_paged"]["peak_inflight_requests"], 1), 3)
+    res["speedup_wall"] = round(
+        res["full_paged"]["wall_s"]
+        / max(res["quant_paged"]["wall_s"], 1e-9), 3)
+    ids_f, lps_f = ident["full_paged"]
+    ids_q, lps_q = ident["quant_paged"]
+    res["quality"] = {
+        "probe_tokens": len(ids_f),
+        "greedy_ids_identical": bool(ids_f == ids_q),
+        "max_logprob_delta": round(max(
+            abs(a - b) for a, b in zip(lps_f, lps_q)), 5),
+    }
+    # Wire row: one prefill handoff per arm, identical prompt — the
+    # quantized shipment (fmt 3) against the full-precision fmt 1.
+    wire: dict[str, Any] = {}
+    ship_prompt = list(rng.integers(1, cfg.vocab_size, 24))
+    for label, kw in (("fmt1_bytes", {}),
+                      ("fmt3_bytes", {"kv_quant": "int8"})):
+        eng = GenerationEngine(model, params, cfg, slots=1,
+                               max_len=max_len, chunk=chunk,
+                               prefill_buckets=buckets, prefix_cache=0,
+                               role="prefill", kv_block_size=bs,
+                               kv_blocks=pool_blocks, **kw)
+        try:
+            ship = eng.prefill_ship(ship_prompt,
+                                    max_tokens=decode_tokens)
+            wire[label] = len(ship["shipment"])
+            wire[label.replace("bytes", "fmt")] = peek_meta(
+                ship["shipment"])["fmt"]
+        finally:
+            eng.close()
+    wire["fmt3_vs_fmt1"] = round(
+        wire["fmt3_bytes"] / max(wire["fmt1_bytes"], 1), 3)
+    res["wire"] = wire
+    return res
+
+
 def bench_spec_paged(model, params, cfg, *, slots: int, max_len: int,
                      chunk: int, buckets, decode_tokens: int,
                      rng: np.random.Generator) -> dict:
@@ -670,6 +781,10 @@ def run_servebench(*, size: str = "1b", quick: bool = False,
         chunk=chunk, buckets=buckets, decode_tokens=decode_tokens, rng=rng)
     log("paged vs flat KV cache (block-table memory A/B)")
     result["paged_vs_flat"] = bench_paged_vs_flat(
+        model, params, cfg, slots=2 if quick else 4, max_len=max_len,
+        chunk=chunk, buckets=buckets, decode_tokens=decode_tokens, rng=rng)
+    log("quantized vs full-precision KV pool (equal-HBM A/B)")
+    result["quant_paged"] = bench_quant_paged(
         model, params, cfg, slots=2 if quick else 4, max_len=max_len,
         chunk=chunk, buckets=buckets, decode_tokens=decode_tokens, rng=rng)
     log("spec x paged at depth 2 (speculation composition A/B)")

@@ -50,8 +50,18 @@ from kubeflow_tpu.serve.kv_transfer import (HostKVTier, ShipmentError,
                                             unpack_shipment)
 from kubeflow_tpu.serve.model import Model
 from kubeflow_tpu.serve.paging import BlockAllocator, blocks_for
+from kubeflow_tpu.serve.quant import (KV_QUANT_MODES, kv_dequantize_rows,
+                                      kv_qdtype, kv_quantize_rows)
 from kubeflow_tpu.utils import obs
-from kubeflow_tpu.utils.resilience import Deadline, DeadlineExceeded
+from kubeflow_tpu.utils.resilience import (Deadline, DeadlineExceeded,
+                                           metrics as res_metrics)
+
+#: tpk_kv_shipment_bytes buckets — wire-payload-shaped (1 KiB tiny-model
+#: handoffs to multi-MiB production blocks), NOT the latency-shaped
+#: default. Quantified wire savings: fmt-3 shipments of the same blocks
+#: land ≈2 buckets lower than fmt-1 (DISAGGBENCH reports only wall).
+_SHIPMENT_BUCKETS = (1024.0, 4096.0, 16384.0, 65536.0, 262144.0,
+                     1048576.0, 4194304.0, 16777216.0, 67108864.0)
 
 #: Engine roles (disaggregated prefill/decode, ISSUE 13). "unified" is
 #: the escape hatch — today's engine bit-for-bit, serving both phases
@@ -131,7 +141,8 @@ def build_engine_fns(model, cfg, *, max_len: int, chunk: int,
                      offset_writes: bool,
                      cache_sharding=None, adapters=None,
                      rolling_window: int = 0,
-                     kv_block_size: int = 0) -> dict:
+                     kv_block_size: int = 0,
+                     kv_quant: str = "none") -> dict:
     """The engine's pure device functions, as unjitted closures.
 
     Single source of truth shared by the live `GenerationEngine` (which
@@ -166,6 +177,18 @@ def build_engine_fns(model, cfg, *, max_len: int, chunk: int,
     block 0, so duplicate scatter indices can only ever disagree on
     garbage nobody reads (absolute-position masking hides every row past
     a request's write index, exactly as it hides stale flat slots).
+
+    `kv_quant` != "none" (ISSUE 19, paged only) stores the pool as
+    int8/fp8 payloads with per-row f32 scale planes "ks"/"vs" addressed
+    by the same block ids. The decode path is UNCHANGED TEXT: gather/
+    scatter and the scan carry are tree-generic, so the quantized view
+    (values + scales) flows through `make_decode_paged` verbatim and
+    the model applies scales output-side (models/llama.py decode
+    branch) — no full-width dequantized cache ever exists in the scan.
+    Only the admission boundary changes: `insert_paged` quantizes the
+    fragment's rows (the identical encode as the scan's row writes —
+    tpk-sync pins it) and `frag_from_pool` dequantizes into the full-
+    precision fragment (admission-side, outside any scan).
     """
     from kubeflow_tpu.models.llama import init_cache
 
@@ -419,11 +442,67 @@ def build_engine_fns(model, cfg, *, max_len: int, chunk: int,
                 lambda p, b: p.at[:, table].set(b.astype(p.dtype)),
                 pool, blocks)
 
+        def insert_paged_quant(pool, frag, table):
+            """`insert_paged` for the quantized pool: the fragment
+            arrives at FULL precision (admission computes exact rows),
+            and the scatter quantizes them — with the IDENTICAL encode
+            as the decode scan's per-row writes (models/llama.py), so a
+            row reaches the same bytes whether it was admitted or
+            decoded; the tpk-sync twin pins that equivalence. Shared
+            prefix blocks are masked to NULL exactly as in the plain
+            path — their committed bytes never change."""
+            qmode = kv_quant
+            rows_k = jax.lax.slice_in_dim(frag["k"], 0, mb * bs, axis=2)
+            rows_v = jax.lax.slice_in_dim(frag["v"], 0, mb * bs, axis=2)
+            # tpk-sync: begin kv-quant-scatter admit
+            # tpk-sync: sub kv_quantize_rows(k, qmode) -> kv_quantize_rows(rows_k, qmode)
+            # tpk-sync: sub kv_quantize_rows(v, qmode) -> kv_quantize_rows(rows_v, qmode)
+            kq, ks = kv_quantize_rows(rows_k, qmode)
+            vq, vs = kv_quantize_rows(rows_v, qmode)
+            # tpk-sync: end kv-quant-scatter
+
+            def blocked(r):
+                return r.reshape(r.shape[0], mb, bs, *r.shape[3:])
+
+            out = dict(pool)
+            for name, arr in (("k", kq), ("v", vq), ("ks", ks),
+                              ("vs", vs)):
+                out[name] = out[name].at[:, table].set(blocked(arr))
+            return out
+
+        def frag_from_pool_quant(pool, table):
+            """`frag_from_pool` for the quantized pool: gather blocks +
+            scale blocks, dequantize into the full-precision fragment.
+            This is the ONE place full-width dequantized rows may
+            materialize — admission-side reconstruction for a prefix hit
+            or continuation, outside any scan (each call is a dequant
+            fallback; the engine counts them)."""
+            empty = init_cache(cfg, 1, frag_len)
+
+            def rowed(g):
+                return g.reshape(g.shape[0], 1, mb * bs, *g.shape[3:])
+
+            out = {}
+            for name, sname in (("k", "ks"), ("v", "vs")):
+                vals = rowed(jnp.take(pool[name], table, axis=1))
+                scales = rowed(jnp.take(pool[sname], table, axis=1))
+                rows = kv_dequantize_rows(vals, scales,
+                                          empty[name].dtype)
+                out[name] = jax.lax.dynamic_update_slice(
+                    empty[name], rows, (0,) * empty[name].ndim)
+            return out
+
         fns.update(make_decode_paged=make_decode_paged,
                    insert_paged=insert_paged,
                    frag_from_pool=frag_from_pool,
                    export_blocks=export_blocks,
                    import_blocks=import_blocks)
+        if kv_quant != "none":
+            # The plain fns above stay textually untouched (the
+            # kv_quant="none" bit-exactness pin); quantized pools swap
+            # ONLY the admission boundary.
+            fns.update(insert_paged=insert_paged_quant,
+                       frag_from_pool=frag_from_pool_quant)
     return fns
 
 
@@ -717,7 +796,8 @@ class GenerationEngine:
                  mesh=None, rules=None, draft: dict | None = None,
                  adapters: dict | None = None, pipeline_depth: int = 2,
                  kv_block_size: int = 0, kv_blocks: int = 0,
-                 role: str = "unified", kv_host_tier_blocks: int = 0):
+                 role: str = "unified", kv_host_tier_blocks: int = 0,
+                 kv_quant: str = "none"):
         self.model, self.cfg = model, cfg
         self.max_len, self.chunk, self.n_slots = int(max_len), int(chunk), int(slots)
         msl = int(getattr(cfg, "max_seq_len", 0) or 0)
@@ -862,6 +942,37 @@ class GenerationEngine:
             n_blocks = int(kv_blocks) or -(-self.n_slots * self.max_len
                                            // self._kv_bs)
             self._kv_alloc = BlockAllocator(n_blocks, self._kv_bs)
+        # Quantized KV blocks (ISSUE 19): the pool stores int8/fp8
+        # payloads + per-row f32 scale planes addressed by the same
+        # block ids, so ≈2× kv_blocks fit the same HBM, host-tier
+        # spills charge about half the block units, and TPKV1 fmt-3
+        # ships quantized bytes. "none" (default) is the bit-exact
+        # escape hatch — the unquantized code paths, textually.
+        self.kv_quant = str(kv_quant or "none")
+        if self.kv_quant not in KV_QUANT_MODES:
+            raise ValueError(
+                f"kv_quant {self.kv_quant!r}: must be one of "
+                f"{KV_QUANT_MODES}")
+        if self.kv_quant != "none":
+            if not self._paged:
+                raise ValueError(
+                    "kv_quant requires the paged KV cache (quantization "
+                    "is a property of pool blocks); set kv_block_size "
+                    "> 0")
+            if draft is not None:
+                # Measured decision (bench.py quant A/B, PROFILE.md
+                # §17): draft-assisted acceptance degrades measurably
+                # when the verify forward reads a quantized cache, and
+                # a spec rewind would re-quantize rows that were NOT
+                # newly written (breaking the immutable-committed-rows
+                # discipline CoW and shipments rely on). Refused loudly
+                # — cpp/admission.h enforces the same cross-field rule
+                # at submit time.
+                raise ValueError(
+                    "kv_quant does not compose with speculative "
+                    "decoding (draft): a rejection rewind would "
+                    "re-quantize committed rows; drop the draft or "
+                    "set kv_quant='none'")
         # Prefix cache: LRU of prompt-chunk-boundary KV fragments keyed by
         # the exact token prefix; admission resumes chunked prefill after
         # the longest hit instead of recomputing it (the vLLM prefix-reuse
@@ -1045,7 +1156,12 @@ class GenerationEngine:
                       # the host-tier traffic.
                       "prefill_chunks": 0, "remote_admits": 0,
                       "kv_blocks_shipped": 0, "kv_blocks_received": 0,
-                      "kv_spilled_blocks": 0, "kv_restored_blocks": 0}
+                      "kv_spilled_blocks": 0, "kv_restored_blocks": 0,
+                      # Quantized KV (ISSUE 19): admission-side
+                      # full-width dequant events (prefix-hit fragment
+                      # reconstruction / fmt-1 import) and shipped wire
+                      # bytes (fmt-3 pays about half fmt-1's).
+                      "kv_dequant_fallbacks": 0, "kv_shipment_bytes": 0}
         self._compile()
         from kubeflow_tpu.models.llama import init_cache
         with self._scope():
@@ -1060,12 +1176,21 @@ class GenerationEngine:
                     cache_sh["pos"] = NamedSharding(self._mesh,
                                                     PartitionSpec())
             if self._paged:
+                if cache_sh is not None and self.kv_quant != "none":
+                    # Scale planes [L, NB+1, bs, KH]: KH shards over
+                    # `tensor` exactly like the value planes' head axis
+                    # (the scale must be co-resident with its rows).
+                    from jax.sharding import NamedSharding, PartitionSpec
+                    cache_sh["ks"] = cache_sh["vs"] = NamedSharding(
+                        self._mesh,
+                        PartitionSpec(*self._cache_sharding.spec[:4]))
                 # The pool: kv_blocks usable blocks + NULL block 0. Block
                 # axis rides the slot axis's (replicated) spec; heads
                 # still shard over `tensor` under TP.
                 self._cache = jax.jit(
                     lambda: init_cache(cfg, self._kv_alloc.n_blocks + 1,
-                                       self._kv_bs),
+                                       self._kv_bs,
+                                       kv_quant=self.kv_quant),
                     out_shardings=cache_sh)()
             else:
                 self._cache = jax.jit(
@@ -1197,7 +1322,8 @@ class GenerationEngine:
             cache_sharding=self._cache_sharding,
             adapters=self._ml_stacks,
             rolling_window=self._rolling,
-            kv_block_size=self._kv_bs if self._paged else 0)
+            kv_block_size=self._kv_bs if self._paged else 0,
+            kv_quant=self.kv_quant)
         prefill_jit = jax.jit(fns["prefill"])
         self._prefill = {b: prefill_jit for b in self.prefill_buckets}
         self._extend = jax.jit(fns["extend"], donate_argnums=(1,))
@@ -1621,9 +1747,25 @@ class GenerationEngine:
                 "kv_block_size > 0")
         meta, arrays = unpack_shipment(shipment)
         fmt = int(meta.get("fmt", 0))
-        if fmt not in (1, 2):
+        if fmt not in (1, 2, 3):
             raise ShipmentError(
                 f"unknown shipment fmt {meta.get('fmt')!r}")
+        if fmt == 3 and self.kv_quant == "none":
+            # Never silently dequant-upcast: accepting quantized blocks
+            # into a full-precision pool would make this stream's
+            # numerics depend on WHICH replica prefilled it — the
+            # fleet-skew failure mode the compat guard exists to refuse.
+            raise ShipmentError(
+                f"shipment fmt 3 carries {meta.get('kv_quant')!r}-"
+                "quantized KV blocks but this engine runs "
+                "kv_quant='none'; pair quantized prefill replicas with "
+                "decode replicas running the same kv_quant (or drop "
+                "generative.kv_quant fleet-wide)")
+        if fmt == 3 and str(meta.get("kv_quant")) != self.kv_quant:
+            raise ShipmentError(
+                f"shipment kv_quant {meta.get('kv_quant')!r} != this "
+                f"engine's {self.kv_quant!r} — mixed-precision fleets "
+                "cannot exchange KV blocks (align generative.kv_quant)")
         if fmt == 2 and self._spec is None:
             # The versioned draft section is refused loudly, never
             # silently dropped: a fleet pairing draft-carrying prefill
@@ -1652,20 +1794,36 @@ class GenerationEngine:
         n_blocks = blocks_for(len(ids), self._kv_bs)
         mb = self.max_len // self._kv_bs
         ref = self._cache["k"].shape  # [L, NB+1, bs, KH, D]
+        quantized = self.kv_quant != "none"
+        if quantized and fmt != 3:
+            # fmt-1 full-precision blocks into a quantized pool:
+            # quantize at import, host-side at the admission boundary,
+            # with the SAME encode decode writes and local admission use
+            # — so a remotely prefilled row reaches the identical bytes
+            # a local prefill of the same prompt would have written.
+            for name in ("k", "v"):
+                arr = arrays.get(name)
+                if arr is None:
+                    raise ShipmentError(
+                        f"shipment missing {name!r} blocks")
+                q, s = kv_quantize_rows(jnp.asarray(arr), self.kv_quant)
+                arrays[name] = np.asarray(q)
+                arrays[name + "s"] = np.asarray(s)
         blocks = {}
-        for name in ("k", "v"):
+        for name in (("k", "v", "ks", "vs") if quantized
+                     else ("k", "v")):
             arr = arrays.get(name)
             if arr is None:
                 raise ShipmentError(f"shipment missing {name!r} blocks")
-            want = (ref[0], n_blocks, ref[2], ref[3], ref[4])
+            lref = self._cache[name].shape  # scale planes drop the D axis
+            want = (lref[0], n_blocks, *lref[2:])
             if tuple(arr.shape) != want:
                 raise ShipmentError(
                     f"shipment {name} blocks shaped {tuple(arr.shape)}, "
                     f"this engine needs {want}")
             # Pad to the compiled [mb]-block import width; pads scatter
             # into the NULL block.
-            pad = np.zeros((ref[0], mb, ref[2], ref[3], ref[4]),
-                           arr.dtype)
+            pad = np.zeros((lref[0], mb, *lref[2:]), arr.dtype)
             pad[:, :n_blocks] = arr
             blocks[name] = pad
         draft_blocks = None
@@ -2188,6 +2346,13 @@ class GenerationEngine:
                 gt = np.zeros((mb,), np.int32)
                 gt[:len(gather_tbl)] = gather_tbl
                 frag = self._frag_from_pool(self._cache, jnp.asarray(gt))
+                if self.kv_quant != "none":
+                    # The ONE full-width dequant materialization the
+                    # quantized design permits (admission-side fragment
+                    # rebuild, outside any scan) — counted so a fleet
+                    # can see when prefix-hit traffic pays it.
+                    with self._stats_lock:
+                        self.stats["kv_dequant_fallbacks"] += 1
             # tpk-sync: begin admit-chunked-prefill paged
             # tpk-sync: sub self._prefix_store(aid, tuple(ids[:done]), frag, copy=done < len(ids)) -> boundaries.append(done)
             while done < len(ids):
@@ -2330,8 +2495,15 @@ class GenerationEngine:
         # (the disagg-vs-unified identity pin).
         arrays["rng_key"] = np.asarray(jax.random.key_data(self._key))
         first_tok = int(np.asarray(tok0)[0])
+        # fmt 3: quantized blocks — the arrays dict already carries the
+        # ks/vs scale planes (export is tree-generic over the pool), so
+        # the wire ships quantized bytes + f32 scales, ≈2× smaller than
+        # the same blocks at fmt 1. kv_quant in the meta lets the decode
+        # side refuse a precision-skewed fleet loudly at submit_remote.
+        # (fmt 2 never combines: kv_quant × draft is refused at init.)
         meta = {
-            "fmt": 2 if draft_meta is not None else 1,
+            "fmt": (2 if draft_meta is not None
+                    else 3 if self.kv_quant != "none" else 1),
             "block_size": self._kv_bs,
             "vocab_size": int(self.cfg.vocab_size),
             "tokens": list(ids),
@@ -2352,12 +2524,17 @@ class GenerationEngine:
         }
         if draft_meta is not None:
             meta["draft"] = draft_meta
+        if self.kv_quant != "none":
+            meta["kv_quant"] = self.kv_quant
         payload = pack_shipment(meta, arrays)
+        res_metrics.observe("tpk_kv_shipment_bytes", len(payload),
+                            buckets=_SHIPMENT_BUCKETS)
         self._kv_alloc.decref(table)
         with self._stats_lock:
             self.stats["requests"] += 1
             self.stats["prompt_tokens"] += len(ids)
             self.stats["kv_blocks_shipped"] += len(table)
+            self.stats["kv_shipment_bytes"] += len(payload)
             aid = req.get("aid", 0)
             if aid:
                 per = dict(self.stats.get("adapter_requests", {}))
@@ -2496,14 +2673,22 @@ class GenerationEngine:
         if taken is None:
             return None
         _, payload = taken
+        names = (("k", "v", "ks", "vs") if self.kv_quant != "none"
+                 else ("k", "v"))
         try:
             meta, arrays = unpack_shipment(payload)
-            ref = self._cache["k"].shape
-            want = (ref[0], n_blocks, ref[2], ref[3], ref[4])
+            # A quantized pool restores only payloads it spilled itself
+            # (same kv_quant, scale planes present); anything else —
+            # including a full-precision spill left over from a config
+            # change — is un-verifiable here and drops to recompute.
             if (int(meta.get("block_size", 0)) != self._kv_bs
                     or list(meta.get("tokens", ())) != list(kt)
-                    or any(tuple(arrays[x].shape) != want
-                           for x in ("k", "v"))):
+                    or str(meta.get("kv_quant", "none")) != self.kv_quant
+                    or any(x not in arrays
+                           or tuple(arrays[x].shape)
+                           != (self._cache[x].shape[0], n_blocks,
+                               *self._cache[x].shape[2:])
+                           for x in names)):
                 raise ShipmentError("spilled payload mismatch")
         except ShipmentError:
             return None
@@ -2514,8 +2699,9 @@ class GenerationEngine:
         st_tbl = np.zeros((mb,), np.int32)
         st_tbl[:n_blocks] = blocks
         dev = {}
-        for name in ("k", "v"):
-            pad = np.zeros((ref[0], mb, ref[2], ref[3], ref[4]),
+        for name in names:
+            lref = self._cache[name].shape
+            pad = np.zeros((lref[0], mb, *lref[2:]),
                            arrays[name].dtype)
             pad[:, :n_blocks] = arrays[name]
             dev[name] = jnp.asarray(pad)
@@ -2543,11 +2729,23 @@ class GenerationEngine:
         gathered = self._export_blocks(self._cache, jnp.asarray(gt))
         arrays = {name: np.asarray(leaf)[:, :len(blocks)]
                   for name, leaf in gathered.items()}
-        payload = pack_shipment(
-            {"fmt": 1, "block_size": self._kv_bs,
-             "vocab_size": int(self.cfg.vocab_size),
-             "tokens": list(kt), "committed": len(kt)}, arrays)
-        if self._host_tier.put(aid, kt, len(blocks), payload):
+        meta = {"fmt": 3 if self.kv_quant != "none" else 1,
+                "block_size": self._kv_bs,
+                "vocab_size": int(self.cfg.vocab_size),
+                "tokens": list(kt), "committed": len(kt)}
+        charge = len(blocks)
+        if self.kv_quant != "none":
+            meta["kv_quant"] = self.kv_quant
+            # Charge the tier by actual payload weight, in full-
+            # precision-block units: a quantized block is D bytes of
+            # values + 4 bytes of f32 scale per row-head against
+            # D·itemsize full-width — so an unchanged
+            # kv_host_tier_blocks budget holds ≈2× the entries.
+            d = int(self._cache["k"].shape[-1])
+            fitem = jnp.dtype(self.cfg.dtype).itemsize
+            charge = max(1, -(-len(blocks) * (d + 4) // (d * fitem)))
+        payload = pack_shipment(meta, arrays)
+        if self._host_tier.put(aid, kt, charge, payload):
             with self._stats_lock:
                 self.stats["kv_spilled_blocks"] += len(blocks)
 

@@ -27,6 +27,23 @@ pool and back:
     verification and a per-adapter length index for longest-prefix
     probes. Capacity is counted in BLOCKS (the pool's own currency).
 
+Format versions (the `fmt` meta field — the frame itself never
+changes, only what rides in it):
+
+  * **fmt 1** — full-precision K/V blocks.
+  * **fmt 2** — fmt 1 plus a versioned draft-KV section (speculative
+    prefill handoff); refused by draft-less decode replicas.
+  * **fmt 3** — QUANTIZED blocks (ISSUE 19): `k`/`v` arrays carry the
+    raw int8/fp8 payloads, `ks`/`vs` carry the per-row-per-head f32
+    scale planes, and `meta["kv_quant"]` names the mode. ≈2× smaller
+    on the wire than fmt 1 for the same blocks. A decode replica whose
+    `kv_quant` does not match refuses at submit_remote — never a
+    silent dequant-upcast (mixed-precision fleets must not split a
+    stream's numerics by which replica prefilled it). fmt 1 into a
+    quantized replica is accepted: it quantizes at import with the
+    same encode local admission uses. fmt 3 never combines with the
+    draft section (`kv_quant × draft` is refused at engine init).
+
 Determinism note: the shipment carries the prefill engine's RNG key
 state (post-admission-splits, `jax.random.key_data`). A decode engine
 that adopts it continues the exact key-split stream the unified engine

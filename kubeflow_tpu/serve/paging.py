@@ -31,6 +31,15 @@ Sharing model (copy-on-write prefix reuse):
     into a new table: the hit forks it (fresh block, committed rows
     copied via the admission fragment) because the new request will
     append into that block — the one copy CoW pays.
+
+Quantized pools (`kv_quant=int8|fp8`, ISSUE 19) change NOTHING here:
+the pool grows parallel per-row-per-head scale planes (`ks`/`vs`,
+`[L, n_blocks, block_size, KH]`) addressed by the SAME block ids, so
+one table entry names a value block and its scale block together.
+Allocation, refcounts, CoW forks, and the NULL block are identical —
+a shared quantized prefix shares its scales by construction, and a
+tail fork copies them through the same admission-fragment scatter.
+Blocks stay opaque above the engine; this module never sees a dtype.
 """
 
 from __future__ import annotations

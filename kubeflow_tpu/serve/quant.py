@@ -152,6 +152,65 @@ def quantized_bytes(params: Any) -> dict:
     return {"quantized": int(qb), "full": int(fb)}
 
 
+# --- KV-cache block quantization (ISSUE 19) ---------------------------
+#
+# The paged KV pool's blocks become int8/fp8 payloads with f32 scales in
+# a parallel pool, addressed by the SAME block ids ("ks"/"vs" next to
+# "k"/"v") — so every layer that trades in block ids (prefix refs, CoW
+# forks, host-tier spills, TPKV1 shipments) carries scales by carrying
+# ids, and `BlockAllocator` never learns about quantization. Scales are
+# per-row-per-head (amax over head_dim): a coarser per-block scale could
+# not honor "scatter-back re-quantizes only newly written rows" — the
+# new row would either move the shared scale (silently re-encoding every
+# committed row in the block) or clip against the old one. Row scales
+# make each row's encoding independent, so committed rows are immutable
+# bytes exactly like the unquantized pool.
+#
+# Dequant placement mirrors Int8DenseGeneral (the ISSUE 13 lesson),
+# lifted to attention: Q·Kᵀ and probs·V read the RAW quantized cache
+# through a bare convert, and the row scales land on the score/prob
+# tensors ([B, KH, G, S, T]-shaped — no [..., T, KH, D] cache-width
+# multiply anywhere in the decode scan). See ops/reference.py
+# `naive_attention(k_scale=, v_scale=)`.
+
+#: Legal `kv_quant` knob values. "none" is the bit-exact escape hatch.
+KV_QUANT_MODES = ("none", "int8", "fp8")
+
+
+def kv_qdtype(mode: str):
+    """Storage dtype of a quantized KV pool."""
+    return {"int8": jnp.int8, "fp8": jnp.float8_e4m3fn}[mode]
+
+
+def kv_qmax(mode: str) -> float:
+    """Largest representable magnitude the scale normalizes amax onto."""
+    return {"int8": 127.0, "fp8": 448.0}[mode]
+
+
+def kv_quantize_rows(rows, mode: str):
+    """Quantize `[..., D]` float rows → (q `[..., D]`, scale `[...]` f32).
+
+    Symmetric per-row max-abs over the head_dim axis; int8 rounds to
+    nearest, fp8 relies on the cast's RNE. All-zero rows get the eps
+    scale and encode to exact zeros, so NULL-block garbage stays inert.
+    """
+    r32 = rows.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(r32), axis=-1),
+                        1e-12) / kv_qmax(mode)
+    q = r32 / scale[..., None]
+    if mode == "int8":
+        q = jnp.clip(jnp.round(q), -127, 127)
+    return q.astype(kv_qdtype(mode)), scale
+
+
+def kv_dequantize_rows(q, scale, dtype=jnp.bfloat16):
+    """Inverse of kv_quantize_rows — ADMISSION-side only (fragment
+    reconstruction for prefix reuse / shipment import). The decode scan
+    never calls this: it would be exactly the full-width materialization
+    the HLO guard forbids."""
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
 class Int8DenseGeneral(nn.Module):
     """`nn.DenseGeneral` twin that understands `Int8Leaf` kernels.
 

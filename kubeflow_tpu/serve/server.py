@@ -110,6 +110,13 @@ _ENGINE_METRICS = (
     ("kv_spilled_blocks", "tpk_kv_spilled_blocks_total", "counter"),
     ("kv_restored_blocks", "tpk_kv_restored_blocks_total", "counter"),
     ("__kv_spill__", "tpk_kv_spill_blocks", "gauge"),
+    # Quantized KV blocks (ISSUE 19): admission-side full-width dequant
+    # materializations (prefix-hit fragment rebuilds — the ONE place
+    # the quantized design allows one; the decode scan never pays it).
+    # The mode itself renders as the tpk_kv_quant_mode info gauge
+    # below, next to tpk_engine_role.
+    ("kv_dequant_fallbacks", "tpk_kv_dequant_fallbacks_total",
+     "counter"),
     # Live in-flight dispatch count (0 when drained; stuck at ≤1 means
     # the pipeline re-serialized) vs the configured ceiling.
     ("__inflight__", "tpk_decode_inflight_depth", "gauge"),
@@ -1263,6 +1270,21 @@ class ModelServer:
                 typed = True
             lines.append(
                 f'tpk_engine_role{{model="{name}",role="{role}"}} 1')
+        # KV quantization mode as a labeled info gauge (ISSUE 19):
+        # which encode a replica's pool blocks use — operators pair
+        # disagg fleets by this series (mismatched modes refuse at
+        # submit_remote), and "none" is rendered too so the escape
+        # hatch is as observable as the quantized modes.
+        typed = False
+        for name, engine, _stats in rows:
+            mode = getattr(engine, "kv_quant", None)
+            if not mode:
+                continue
+            if not typed:
+                lines.append("# TYPE tpk_kv_quant_mode gauge")
+                typed = True
+            lines.append(
+                f'tpk_kv_quant_mode{{model="{name}",mode="{mode}"}} 1')
         return lines
 
     def app(self) -> tornado.web.Application:

@@ -133,6 +133,14 @@ GENERATIVE_KNOBS: dict[str, dict] = {
              "enum": ["unified", "prefill", "decode"]},
     # Host-RAM KV spill tier capacity in blocks (0 = off).
     "kv_host_tier_blocks": {"type": "int", "min": 0},
+    # Quantized KV pool blocks (ISSUE 19): "none" (default, bit-exact
+    # escape hatch) | "int8" | "fp8". Cross-field rules live in
+    # cpp/admission.h next to the table: kv_quant requires
+    # kv_block_size > 0 (the scale pool is a paged structure) and
+    # refuses draft (a speculative rejection rewind would re-quantize
+    # committed rows — see PROFILE.md §17 for the measured decision).
+    "kv_quant": {"type": "string_or_null",
+                 "enum": ["none", "int8", "fp8"]},
     "mesh": {"type": "object"},
     # Speculative decoding draft spec: {"checkpoint": hf_dir,
     # "gamma"?: int >= 1, "model_overrides"?: {...}} — contents are

@@ -1,0 +1,505 @@
+"""Quantized KV pool blocks (ISSUE 19).
+
+The tentpole's correctness surface:
+
+  * `kv_quant="none"` is the bit-exact escape hatch — a seeded sampled
+    stream is token+logprob IDENTICAL to the default engine (the plain
+    paged fns are swapped, never edited);
+  * the quantized pool carries parallel per-row-per-head f32 scale
+    planes addressed by the same block ids — prefix hits fork tails
+    with their scales, refcounts conserve exactly as unquantized;
+  * the wire: fmt-3 shipments roundtrip byte-identically, refuse
+    loudly on quantless replicas and precision-skewed fleets (never
+    silent dequant-upcast), and fmt-1 quantizes at import with the
+    identical encode as local admission;
+  * host-tier spills restore greedy-identical, charged at quantized
+    weight (≈2× entries per block budget);
+  * quality: per-token logprob drift vs the unquantized engine is
+    BOUNDED on the tiny model, and fp8-with-garbage-scales visibly
+    fails the same bound (the measurement has teeth);
+  * the HLO guard: the compiled decode program contains ZERO
+    cache-shaped dequant multiplies — scales land output-side on
+    scores/probs (the ISSUE 13 lesson), never on a rebuilt full-width
+    cache — with a red-switch proving the guard catches the naive
+    dequant.
+"""
+
+import dataclasses
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+#: Tier split (the ISSUE 17 precedent: the pinned 870s tier-1 budget
+#: is load-bearing): tests that build full engines — each pays the
+#: warmup compile set — carry `pytest.mark.slow` below; tier-1 keeps
+#: the codec pins, the refusal trio, the bit-exact escape hatch, and
+#: the HLO-guard red-switch.
+_SLOW = pytest.mark.slow
+
+from kubeflow_tpu.models.llama import Llama, init_cache, llama_tiny
+from kubeflow_tpu.serve.generation import GenerationEngine
+from kubeflow_tpu.serve.kv_transfer import (ShipmentError, pack_shipment,
+                                            peek_meta, rewrite_meta,
+                                            unpack_shipment)
+from kubeflow_tpu.serve.quant import (kv_dequantize_rows, kv_qdtype,
+                                      kv_quantize_rows)
+
+CFG = dataclasses.replace(llama_tiny(), dtype=jnp.float32, num_layers=2)
+GEN_KW = dict(max_len=64, chunk=4, prefill_buckets=(8, 16),
+              kv_block_size=8)
+
+
+@pytest.fixture(scope="module")
+def built():
+    model = Llama(CFG)
+    params = jax.jit(lambda r: model.init(
+        r, jnp.zeros((1, 8), jnp.int32))["params"])(jax.random.key(0))
+    return model, params
+
+
+def make_engine(built, **kw):
+    model, params = built
+    merged = dict(GEN_KW, slots=2, kv_blocks=24, seed=0)
+    merged.update(kw)
+    return GenerationEngine(model, params, CFG, **merged)
+
+
+def rng_prompt(seed, n):
+    return list(map(int, np.random.default_rng(seed).integers(
+        1, CFG.vocab_size, n)))
+
+
+# -- helpers ----------------------------------------------------------------
+
+
+def kv_quantize_roundtrip_err(rows, mode):
+    q, s = kv_quantize_rows(rows, mode)
+    back = kv_dequantize_rows(q, s, jnp.float32)
+    return float(jnp.max(jnp.abs(back - rows.astype(jnp.float32))))
+
+
+def test_row_codec_shapes_and_error():
+    rows = jax.random.normal(jax.random.key(0), (2, 1, 24, 2, 16),
+                             jnp.float32) * 3.0
+    rmax = float(jnp.max(jnp.abs(rows)))
+    # int8 is a uniform grid: error <= one step of the row's range.
+    # fp8 e4m3 error is RELATIVE (3 mantissa bits, ~2^-4 half-ulp of
+    # the value), so the bound scales with magnitude, not step count.
+    bound = {"int8": rmax / 127.0 * 1.01, "fp8": rmax * 0.0625}
+    for mode in ("int8", "fp8"):
+        q, s = kv_quantize_rows(rows, mode)
+        assert q.dtype == kv_qdtype(mode)
+        assert q.shape == rows.shape
+        assert s.dtype == jnp.float32 and s.shape == rows.shape[:-1]
+        assert kv_quantize_roundtrip_err(rows, mode) <= bound[mode]
+    # All-zero rows must not divide by zero.
+    z = jnp.zeros((1, 1, 8, 2, 16), jnp.float32)
+    q, s = kv_quantize_rows(z, "int8")
+    assert float(jnp.max(jnp.abs(q.astype(jnp.float32)))) == 0.0
+
+
+# -- the escape hatch -------------------------------------------------------
+
+
+def test_kv_quant_none_seeded_bit_exact(built):
+    """kv_quant='none' IS today's engine: a seeded sampled stream is
+    token+logprob bit-identical to an engine that never heard of the
+    knob, and the pool grows no scale planes."""
+    prompt = rng_prompt(3, 19)
+    ref_eng = make_engine(built, seed=11)
+    try:
+        assert "ks" not in ref_eng._cache
+        ref = ref_eng.submit(prompt, max_tokens=8, temperature=0.7)
+    finally:
+        ref_eng.close()
+    eng = make_engine(built, seed=11, kv_quant="none")
+    try:
+        assert "ks" not in eng._cache
+        out = eng.submit(prompt, max_tokens=8, temperature=0.7)
+    finally:
+        eng.close()
+    assert out["output_ids"] == ref["output_ids"]
+    assert out["output_logprobs"] == ref["output_logprobs"]
+
+
+def test_engine_refusals(built):
+    with pytest.raises(ValueError, match="must be one of"):
+        make_engine(built, kv_quant="int4")
+    with pytest.raises(ValueError, match="requires the paged KV"):
+        make_engine(built, kv_block_size=0, kv_quant="int8")
+    with pytest.raises(ValueError, match="does not compose with"):
+        make_engine(built, kv_quant="int8", draft={})
+
+
+@_SLOW
+def test_quantized_pool_structure(built):
+    for mode in ("int8", "fp8"):
+        eng = make_engine(built, kv_quant=mode)
+        try:
+            assert eng.kv_quant == mode
+            c = eng._cache
+            assert c["k"].dtype == kv_qdtype(mode)
+            assert c["v"].dtype == kv_qdtype(mode)
+            # Scale planes: value shape minus the head_dim axis, f32,
+            # same block addressing.
+            assert c["ks"].shape == c["k"].shape[:-1]
+            assert c["vs"].shape == c["v"].shape[:-1]
+            assert c["ks"].dtype == c["vs"].dtype == jnp.float32
+        finally:
+            eng.close()
+
+
+# -- quality: bounded drift, red-switched measurement -----------------------
+
+#: Max per-token |Δ logprob| vs the fp32 paged engine on the seeded
+#: tiny-model stream below. Measured on prompt seed 23: int8 ≈ 0.006,
+#: fp8 ≈ 0.058 — the bounds carry ~4-8× headroom and still sit far
+#: below the garbage-scales failure, so the red-switch separation is
+#: wide. (The tiny 2-layer model has greedy near-ties; the prompt seed
+#: is chosen where both modes keep token identity.)
+QUALITY_BOUND = {"int8": 0.05, "fp8": 0.25}
+
+
+def _greedy_quality_delta(built, mode, corrupt_scales=False):
+    """Greedy tokens + max per-token logprob drift vs the unquantized
+    paged engine, on one seeded prompt. `corrupt_scales` multiplies
+    every inserted scale plane by 8 — the garbage-scales red-switch."""
+    prompt = rng_prompt(23, 21)
+    ref_eng = make_engine(built)
+    try:
+        ref = ref_eng.submit(prompt, max_tokens=8)
+    finally:
+        ref_eng.close()
+    eng = make_engine(built, kv_quant=mode)
+    try:
+        if corrupt_scales:
+            orig = eng._insert
+
+            def corrupted(pool, frag, table):
+                out = dict(orig(pool, frag, table))
+                out["ks"] = out["ks"] * 8.0
+                out["vs"] = out["vs"] * 8.0
+                return out
+
+            eng._insert = corrupted
+        out = eng.submit(prompt, max_tokens=8)
+    finally:
+        eng.close()
+    drift = max(abs(a - b) for a, b in zip(out["output_logprobs"],
+                                           ref["output_logprobs"]))
+    return out["output_ids"], ref["output_ids"], drift
+
+
+@_SLOW
+@pytest.mark.parametrize("mode", ["int8", "fp8"])
+def test_quality_delta_bounded(built, mode):
+    ids, ref_ids, drift = _greedy_quality_delta(built, mode)
+    assert ids == ref_ids  # tiny-model greedy survives quantization
+    assert drift <= QUALITY_BOUND[mode], (
+        f"{mode} logprob drift {drift} exceeds {QUALITY_BOUND[mode]}")
+
+
+@_SLOW
+def test_quality_bound_red_switch_garbage_scales(built):
+    """The bound has teeth: fp8 blocks dequantized through garbage
+    scales (×8) must FAIL the same measurement — if this ever passes,
+    the quality test is measuring nothing."""
+    ids, ref_ids, drift = _greedy_quality_delta(
+        built, "fp8", corrupt_scales=True)
+    assert ids != ref_ids or drift > QUALITY_BOUND["fp8"]
+
+
+# -- prefix cache: CoW forks carry scales, refcounts conserve ---------------
+
+
+@_SLOW
+def test_quantized_prefix_cow_and_refcount_conservation(built):
+    """A quantized prefix hit maps full blocks zero-copy and forks the
+    partial tail WITH its scale rows (a dropped scale would corrupt
+    every dequant of the forked block — the recompute check below
+    would fail loudly); after everything retires the pool is exactly
+    whole. Resume is token-identical to a fresh recompute but NOT
+    logprob-bit-exact: the hit path rebuilds the fragment through the
+    one permitted dequant, so the extension chunk attends dequantized
+    prompt rows while the fresh path attends exact ones."""
+    eng = make_engine(built, prefix_cache=2, kv_quant="int8")
+    try:
+        alloc = eng._kv_alloc
+        p1 = rng_prompt(21, 17)  # 17 tokens: partial tail block
+        eng.submit(p1 + [5], max_tokens=4)
+        s = eng.stats_snapshot()
+        cow0, fb0 = s["kv_cow_copies"], s["kv_dequant_fallbacks"]
+        probe = p1 + [5, 9, 9]
+        r = eng.submit(probe, max_tokens=4)
+        s = eng.stats_snapshot()
+        assert s["prefix_hits"] >= 1
+        assert s["kv_cow_copies"] > cow0
+        # The resume-from-hit fragment rebuild is the ONE permitted
+        # full-width dequant — counted.
+        assert s["kv_dequant_fallbacks"] > fb0
+        fresh = make_engine(built, kv_quant="int8")
+        try:
+            ref = fresh.submit(probe, max_tokens=4)
+        finally:
+            fresh.close()
+        assert r["output_ids"] == ref["output_ids"]
+        np.testing.assert_allclose(r["output_logprobs"],
+                                   ref["output_logprobs"], rtol=0,
+                                   atol=0.05)
+        while eng._prefix_lru:
+            eng._prefix_evict(next(iter(eng._prefix_lru)))
+        assert alloc.used_blocks == 0
+        assert alloc.free_blocks == alloc.n_blocks
+    finally:
+        eng.close()
+
+
+# -- the wire: fmt-3 --------------------------------------------------------
+
+
+@_SLOW
+def test_fmt3_pool_wire_pool_byte_identity(built):
+    """Quantized blocks + scale planes gather → serialize → scatter →
+    gather BYTE-identically; the shipment's meta names the mode."""
+    eng = make_engine(built, prefix_cache=1, kv_quant="int8")
+    try:
+        prompt = rng_prompt(5, 17)
+        eng.submit(prompt, max_tokens=2)
+        (kt, blocks) = next(iter(eng._prefix_lru.values()))
+        blocks = list(blocks)
+        mb = eng.max_len // eng._kv_bs
+        gt = np.zeros((mb,), np.int32)
+        gt[:len(blocks)] = blocks
+        g1 = eng._export_blocks(eng._cache, jnp.asarray(gt))
+        assert set(g1) == {"k", "v", "ks", "vs"}
+        arrays = {k: np.asarray(v)[:, :len(blocks)].copy()
+                  for k, v in g1.items()}
+        payload = pack_shipment(
+            {"fmt": 3, "kv_quant": "int8", "tokens": list(kt)}, arrays)
+        meta2, arrays2 = unpack_shipment(payload)
+        assert meta2["kv_quant"] == "int8"
+        for k in arrays:
+            assert arrays2[k].dtype == arrays[k].dtype
+            assert arrays2[k].tobytes() == arrays[k].tobytes()
+        fresh = eng._kv_alloc.alloc(len(blocks))
+        assert fresh is not None and set(fresh).isdisjoint(blocks)
+        st_tbl = np.zeros((mb,), np.int32)
+        st_tbl[:len(fresh)] = fresh
+        dev = {}
+        for name in ("k", "v", "ks", "vs"):
+            pad = np.zeros((arrays2[name].shape[0], mb)
+                           + arrays2[name].shape[2:],
+                           arrays2[name].dtype)
+            pad[:, :len(blocks)] = arrays2[name]
+            dev[name] = jnp.asarray(pad)
+        eng._cache = eng._import_blocks(eng._cache, dev,
+                                        jnp.asarray(st_tbl))
+        g2 = eng._export_blocks(eng._cache, jnp.asarray(st_tbl))
+        for name in ("k", "v", "ks", "vs"):
+            got = np.asarray(g2[name])[:, :len(blocks)]
+            assert got.tobytes() == arrays[name].tobytes()
+        eng._kv_alloc.decref(fresh)
+    finally:
+        eng.close()
+
+
+@_SLOW
+def test_quant_disagg_identical_to_unified_and_wire_savings(built):
+    """Seeded sampled stream through a quantized prefill→decode pair
+    is token+logprob-identical to the quantized unified engine; the
+    shipment is fmt 3 and ≤ 0.55× the fmt-1 bytes for the same
+    prompt."""
+    prompt = rng_prompt(7, 21)
+    uni = make_engine(built, seed=5, kv_quant="int8")
+    try:
+        ref = uni.submit(prompt, max_tokens=10, temperature=0.8)
+    finally:
+        uni.close()
+    pre = make_engine(built, seed=5, role="prefill", kv_quant="int8")
+    dec = make_engine(built, seed=999, role="decode", kv_quant="int8")
+    plain = make_engine(built, seed=5, role="prefill")
+    try:
+        ship = pre.prefill_ship(prompt, max_tokens=10, temperature=0.8)
+        meta = peek_meta(ship["shipment"])
+        assert meta["fmt"] == 3 and meta["kv_quant"] == "int8"
+        assert pre.stats_snapshot()["kv_shipment_bytes"] == len(
+            ship["shipment"])
+        out = dec.submit_remote(ship["shipment"])
+        assert out["output_ids"] == ref["output_ids"]
+        assert out["output_logprobs"] == ref["output_logprobs"]
+        ship1 = plain.prefill_ship(prompt, max_tokens=10,
+                                   temperature=0.8)
+        assert peek_meta(ship1["shipment"])["fmt"] == 1
+        assert (len(ship["shipment"])
+                <= 0.55 * len(ship1["shipment"]))
+    finally:
+        pre.close()
+        dec.close()
+        plain.close()
+
+
+@_SLOW
+def test_fmt3_refusals_and_fmt12_compat(built):
+    """The compat matrix: fmt-3 on a quantless replica and on a
+    precision-skewed replica refuse LOUDLY (never silent
+    dequant-upcast); fmt-1 into a quantized replica quantizes at
+    import with the identical encode as local admission (greedy
+    stream matches the quantized unified engine); fmt-2's draft
+    section is refused because a quantized engine can never hold a
+    draft."""
+    prompt = rng_prompt(13, 17)
+    pre8 = make_engine(built, role="prefill", kv_quant="int8")
+    plain = make_engine(built)
+    try:
+        ship3 = pre8.prefill_ship(prompt, max_tokens=6)
+        with pytest.raises(ShipmentError, match="kv_quant='none'"):
+            plain.submit_remote(ship3["shipment"])
+        fp8 = make_engine(built, role="decode", kv_quant="fp8")
+        try:
+            with pytest.raises(ShipmentError,
+                               match="mixed-precision"):
+                fp8.submit_remote(ship3["shipment"])
+        finally:
+            fp8.close()
+        # fmt-1 → quantized replica: quantize-at-import, identical
+        # greedy stream to the quantized unified engine (admission
+        # quantizes the same exact full-precision rows either way).
+        uni8 = make_engine(built, kv_quant="int8")
+        try:
+            ref = uni8.submit(prompt, max_tokens=6)
+        finally:
+            uni8.close()
+        ship1 = plain.prefill_ship(prompt, max_tokens=6)
+        dec8 = make_engine(built, role="decode", kv_quant="int8")
+        try:
+            out = dec8.submit_remote(ship1["shipment"])
+            assert out["output_ids"] == ref["output_ids"]
+            # fmt-2 (draft section) on the same quantized replica:
+            # refused via the draft-less guard — kv_quant x draft can
+            # never configure, so the engine truthfully has no draft.
+            ship2 = rewrite_meta(ship1["shipment"], fmt=2,
+                                 draft={"block_size": 8})
+            with pytest.raises(ShipmentError, match="draft"):
+                dec8.submit_remote(ship2)
+        finally:
+            dec8.close()
+    finally:
+        pre8.close()
+        plain.close()
+
+
+# -- host tier --------------------------------------------------------------
+
+
+@_SLOW
+def test_quantized_spill_restore_greedy_identical(built):
+    """Quantized payloads spill → restore → the restored stream is
+    greedy token-identical to a cold recompute (logprobs within the
+    dequant tolerance — the restore rebuilds the fragment through the
+    one permitted dequant, like the prefix-hit path); the tier charges
+    quantized payloads at quantized weight, so the same block budget
+    holds ≈2× the entries (engine-side spill counters stay in
+    pool-block units)."""
+    eng = make_engine(built, prefix_cache=2, kv_host_tier_blocks=64,
+                      kv_blocks=20, kv_quant="int8")
+    try:
+        p1 = rng_prompt(21, 17)
+        eng.submit(p1 + [5], max_tokens=4)
+        eng.submit(rng_prompt(22, 17) + [6], max_tokens=4)
+        eng.submit(rng_prompt(23, 17) + [7], max_tokens=4)
+        s = eng.stats_snapshot()
+        assert s["kv_spilled_blocks"] > 0
+        tier = eng._host_tier.stats_snapshot()
+        # Discounted charge: strictly fewer tier block units than pool
+        # blocks spilled (int8 + f32 scales ≈ 0.3× of f32 rows here).
+        assert 0 < tier["spilled_blocks"] < s["kv_spilled_blocks"]
+        probe = p1 + [5, 9, 9]
+        r = eng.submit(probe, max_tokens=4)
+        assert eng.stats_snapshot()["kv_restored_blocks"] > 0
+        fresh = make_engine(built, kv_blocks=20, kv_quant="int8")
+        try:
+            ref = fresh.submit(probe, max_tokens=4)
+        finally:
+            fresh.close()
+        assert r["output_ids"] == ref["output_ids"]
+        np.testing.assert_allclose(r["output_logprobs"],
+                                   ref["output_logprobs"], rtol=0,
+                                   atol=0.05)
+    finally:
+        eng.close()
+
+
+# -- the HLO guard ----------------------------------------------------------
+
+_RESULT_SHAPE = re.compile(r"=\s*\w+\[([\d,]*)\][^ ]*\s+multiply\(")
+
+
+def fullwidth_dequant_multiplies(hlo: str, kh: int, d: int,
+                                 t_min: int) -> list[str]:
+    """Lines whose multiply produces a cache-shaped tensor — trailing
+    dims (T, KH, D) with T >= t_min. Per-step row writes quantize
+    (T == 1, allowed); output-side scale lands on scores/probs (no D
+    axis, allowed); a rebuilt full-width dequantized cache is the
+    regression this guard exists to catch."""
+    bad = []
+    for ln in hlo.splitlines():
+        m = _RESULT_SHAPE.search(ln)
+        if not m or not m.group(1):
+            continue
+        dims = [int(x) for x in m.group(1).split(",")]
+        if (len(dims) >= 3 and dims[-1] == d and dims[-2] == kh
+                and dims[-3] >= t_min):
+            bad.append(ln.strip())
+    return bad
+
+
+def test_hlo_guard_red_switch():
+    """The naive dequant (quantized cache × broadcast scales, full
+    width) MUST be flagged — if the guard goes blind, the decode check
+    below proves nothing."""
+    q = jnp.zeros((2, 9, 8, 2, 16), jnp.int8)
+    s = jnp.zeros((2, 9, 8, 2), jnp.float32)
+    hlo = (jax.jit(lambda q, s: q.astype(jnp.float32) * s[..., None])
+           .lower(q, s).compile().as_text())
+    assert fullwidth_dequant_multiplies(hlo, kh=2, d=16, t_min=8)
+
+
+@_SLOW
+@pytest.mark.parametrize("mode", ["int8", "fp8"])
+def test_decode_hlo_has_no_fullwidth_dequant(built, mode):
+    """THE acceptance pin: the compiled quantized decode program
+    contains zero cache-shaped dequant multiplies — the quantized
+    values flow through bare converts and the scales land output-side
+    on scores/probs, so the full-width cache never materializes
+    HLO-visibly per step."""
+    eng = make_engine(built, kv_quant=mode)
+    try:
+        n = eng.n_slots
+        kh = int(eng._cache["k"].shape[-2])
+        d = int(eng._cache["k"].shape[-1])
+        checked = 0
+        for (b, _), fn in eng._decode.items():
+            args = (eng._params, eng._cache,
+                    jnp.zeros((n, b // eng._kv_bs), jnp.int32),
+                    jnp.zeros((n,), jnp.int32),
+                    jnp.zeros((n,), jnp.int32),
+                    jnp.zeros((n,), jnp.float32),
+                    jnp.zeros((n,), jnp.int32),
+                    jnp.ones((n,), jnp.float32), eng._key)
+            hlo = fn.lower(*args, aid=eng._aid_batch([0] * n)) \
+                    .compile().as_text()
+            # Sanity: this program really reads a quantized pool.
+            qtag = "s8[" if mode == "int8" else "f8e4m3fn["
+            assert qtag in hlo
+            bad = fullwidth_dequant_multiplies(hlo, kh=kh, d=d,
+                                               t_min=eng._kv_bs)
+            assert not bad, (
+                f"full-width dequant materialized in decode "
+                f"(bucket {b}): {bad[:3]}")
+            checked += 1
+        assert checked >= 1
+    finally:
+        eng.close()

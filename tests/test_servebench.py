@@ -44,6 +44,26 @@ def test_servebench_quick_shape():
     assert sg["mixed_traffic_speculated"] is True
     assert sg["spec_paged"]["acceptance"] > 0.9  # self-draft ceiling
     assert sg["speedup_wall"] > 0
+    # Quant × paged A/B (ISSUE 19 tentpole): equal pool HBM, the int8
+    # arm's block count scaled by the byte ratio (>1.5× everywhere,
+    # ≈2× at bf16/D=64, 3.2× on the f32 tiny model) — and the extra
+    # blocks became extra CONCURRENT requests (peak in-flight ≥1.8×
+    # the full-precision arm). Quality delta is measured (greedy probe
+    # token-identical on the tiny model, logprob drift reported), and
+    # the fmt-3 handoff ships ≤0.55× the fmt-1 bytes for the same
+    # prompt.
+    qp = r["quant_paged"]
+    assert qp["full_paged"]["tok_s_e2e"] > 0
+    assert qp["quant_paged"]["tok_s_e2e"] > 0
+    assert qp["quant_paged"]["pool_bytes"] <= qp["full_paged"]["pool_bytes"]
+    assert qp["kv_blocks_ratio"] > 1.5
+    assert (qp["quant_paged"]["kv_blocks"]
+            > 1.5 * qp["full_paged"]["kv_blocks"])
+    assert qp["concurrency_gain"] >= 1.8
+    assert qp["quality"]["greedy_ids_identical"] is True
+    assert qp["quality"]["max_logprob_delta"] < 0.05
+    assert qp["wire"]["fmt1_fmt"] == 1 and qp["wire"]["fmt3_fmt"] == 3
+    assert qp["wire"]["fmt3_vs_fmt1"] <= 0.55
     # Decode concurrency section: throughput positive at each slot count.
     assert set(r["decode"]) == {"slots_1", "slots_2"}
     for v in r["decode"].values():

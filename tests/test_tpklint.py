@@ -711,11 +711,16 @@ def test_metrics_shim_keeps_cli_and_api():
 
 
 def _copy_engine_tree(tmp_path):
-    rel = "kubeflow_tpu/serve/generation.py"
-    dst = tmp_path / rel
-    dst.parent.mkdir(parents=True)
-    shutil.copy(os.path.join(REPO, rel), dst)
-    return dst
+    # models/llama.py rides along since ISSUE 19: the kv-quant-scatter
+    # twin's canonical side (the decode scan's row quantize) lives
+    # there, and a tree holding only the admit side would rightly fire
+    # the single-sided-tag finding.
+    for rel in ("kubeflow_tpu/serve/generation.py",
+                "kubeflow_tpu/models/llama.py"):
+        dst = tmp_path / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(os.path.join(REPO, rel), dst)
+    return tmp_path / "kubeflow_tpu/serve/generation.py"
 
 
 def test_real_engine_copy_is_clean(tmp_path):
@@ -800,6 +805,54 @@ def test_mutating_dispatch_row_gather_twin_turns_red(tmp_path):
         needle, 'temps[i] = float(st["req"]["temperature"])', 1))
     fs = lint(tmp_path, rules=["sync-regions"])
     assert len(fs) == 1 and "dispatch-row-gather" in fs[0].message
+
+
+def test_mutating_kv_quant_encode_twin_turns_red(tmp_path):
+    """ISSUE 19: admission's scatter must quantize fragment rows with
+    the IDENTICAL encode as the decode scan's per-row writes — a
+    drifted admit-side encode would make prefix-hit / restored rows
+    numerically diverge from decoded rows of the same tokens."""
+    dst = _copy_engine_tree(tmp_path)
+    src = dst.read_text()
+    needle = "kq, ks = kv_quantize_rows(rows_k, qmode)"
+    assert src.count(needle) == 1  # the admit twin (insert_paged_quant)
+    dst.write_text(src.replace(
+        needle, "kq, ks = kv_quantize_rows(rows_k * 1, qmode)"))
+    fs = lint(tmp_path, rules=["sync-regions"])
+    assert len(fs) == 1 and "kv-quant-scatter" in fs[0].message
+
+
+def test_mutating_kv_quant_decode_side_turns_red(tmp_path):
+    """The canonical (decode-write) side drifting out from under the
+    admit side's declared substitutions is equally loud."""
+    _copy_engine_tree(tmp_path)
+    llama = tmp_path / "kubeflow_tpu/models/llama.py"
+    src = llama.read_text()
+    needle = "kq, ks = kv_quantize_rows(k, qmode)"
+    assert src.count(needle) == 1
+    llama.write_text(src.replace(
+        needle, "kq, ks = kv_quantize_rows(k * 1, qmode)"))
+    fs = lint(tmp_path, rules=["sync-regions"])
+    assert len(fs) >= 1
+    assert all("kv-quant-scatter" in f.message for f in fs)
+
+
+def test_deleting_kv_quant_markers_turns_red(tmp_path):
+    """kv-quant-scatter is a REQUIRED tag: stripping both sides'
+    markers (the lazy way out of the drift finding) is itself a
+    finding on the home file."""
+    dst = _copy_engine_tree(tmp_path)
+    llama = tmp_path / "kubeflow_tpu/models/llama.py"
+    # begin/end lines name the tag; the admit side's sub lines name
+    # the substituted call — both families must go.
+    strip = re.compile(
+        r"^\s*# tpk-sync: (?:(?:begin|end) kv-quant-scatter"
+        r"|sub kv_quantize_rows).*\n", re.M)
+    dst.write_text(strip.sub("", dst.read_text()))
+    llama.write_text(strip.sub("", llama.read_text()))
+    fs = lint(tmp_path, rules=["sync-regions"])
+    assert len(fs) == 1 and "kv-quant-scatter" in fs[0].message
+    assert fs[0].path == "kubeflow_tpu/serve/generation.py"
 
 
 def test_deleting_spec_hot_markers_turns_red(tmp_path):

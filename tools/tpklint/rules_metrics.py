@@ -25,8 +25,10 @@ from .core import Context, Finding, rule
 RULE = "metrics"
 
 #: Histograms that measure something other than time (exempt from the
-#: `_seconds` suffix rule). None today — add deliberately.
-NON_TIME_HISTOGRAMS: set[str] = set()
+#: `_seconds` suffix rule). Add deliberately.
+#:   tpk_kv_shipment_bytes — disagg wire payload sizes (ISSUE 19): the
+#:   unit is bytes by design, quantified wire savings per handoff.
+NON_TIME_HISTOGRAMS: set[str] = {"tpk_kv_shipment_bytes"}
 
 _CALL = re.compile(
     r"metrics\.(inc|observe|set_gauge)\(\s*\n?\s*\"(tpk_\w+)\"")

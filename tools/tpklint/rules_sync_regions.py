@@ -51,6 +51,13 @@ REQUIRED_TAGS = {
     # snapshots (e.g. one reading idx, the other disp) and the
     # token-identity pins would only catch it at depth > 1 races.
     "dispatch-row-gather": "kubeflow_tpu/serve/generation.py",
+    # ISSUE 19: a quantized pool row must reach the same bytes whether
+    # the decode scan wrote it (models/llama.py) or admission scattered
+    # it (insert_paged_quant) — a drifted encode would make prefix
+    # hits / restores numerically diverge from decoded rows. The admit
+    # side lives in the home file; the canonical side is the model's
+    # per-step write.
+    "kv-quant-scatter": "kubeflow_tpu/serve/generation.py",
 }
 
 _MARK = re.compile(r"#\s*tpk-sync:\s*(begin|end|sub)\s*(.*?)\s*$")
