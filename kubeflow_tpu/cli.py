@@ -307,9 +307,26 @@ def cmd_replicas(args) -> int:
 
 
 def cmd_trace(args) -> int:
-    """The control plane's span ring as Chrome trace-event JSON — load
-    the output in chrome://tracing or https://ui.perfetto.dev."""
-    doc = _client(args).trace()
+    """Spans as Chrome trace-event JSON — load the output in
+    chrome://tracing or https://ui.perfetto.dev. Two sources: the
+    control plane's span ring (default), or, with `--router URL
+    TRACE_ID`, the router's ASSEMBLED distributed trace — router +
+    prefill + decode (+ resume) replica spans merged onto one
+    clock-aligned timeline."""
+    if args.router:
+        if not args.trace_id:
+            print("error: tpukit trace --router needs a TRACE_ID "
+                  "(the request's X-Request-Id)", file=sys.stderr)
+            return 1
+        import urllib.parse
+        import urllib.request
+
+        url = (f"{args.router.rstrip('/')}/debug/trace?trace_id="
+               f"{urllib.parse.quote(args.trace_id)}")
+        with urllib.request.urlopen(url, timeout=10.0) as r:
+            doc = json.loads(r.read().decode())
+    else:
+        doc = _client(args).trace()
     text = json.dumps(doc, indent=1)
     if args.output:
         with open(args.output, "w") as fh:
@@ -318,6 +335,45 @@ def cmd_trace(args) -> int:
               f"({len(doc.get('traceEvents', []))} spans)")
     else:
         print(text)
+    return 0
+
+
+def cmd_requests(args) -> int:
+    """The router's flight recorder (last-K per-request outcomes): who
+    served each request, how many resumes/retries, TTFT/e2e, and the
+    shed/deadline reason — the postmortem surface for 'what happened to
+    request X' without a live debugger."""
+    import urllib.request
+
+    url = f"{args.router.rstrip('/')}/admin/flightrecorder"
+    if args.n:
+        url += f"?n={int(args.n)}"
+    with urllib.request.urlopen(url, timeout=10.0) as r:
+        out = json.loads(r.read().decode())
+    if args.json:
+        print(json.dumps(out, indent=2))
+        return 0
+    fmt = "{:<34} {:<10} {:<14} {:>8} {:>8} {:>7} {:>7} {}"
+    print(fmt.format("TRACE_ID", "INTENT", "OUTCOME", "TTFT_MS",
+                     "E2E_MS", "RESUME", "TRIES", "REPLICAS"))
+
+    def ms(v):
+        return "-" if v is None else f"{v * 1e3:.1f}"
+
+    for rec in out.get("records", []):
+        line = fmt.format(
+            str(rec.get("trace_id", ""))[:34],
+            rec.get("intent", "-"), rec.get("outcome", "-"),
+            ms(rec.get("ttft_s")), ms(rec.get("e2e_s")),
+            str(rec.get("resumes", 0)), str(rec.get("attempts", 0)),
+            ",".join(rec.get("replicas") or []) or "-")
+        if rec.get("reason"):
+            line += f"  [{rec['reason']}]"
+        print(line)
+    snaps = out.get("snapshots", [])
+    if snaps:
+        print(f"snapshots: "
+              + " ".join(s.get("reason", "?") for s in snaps))
     return 0
 
 
@@ -407,9 +463,27 @@ def main(argv=None) -> int:
     p.set_defaults(fn=cmd_replicas)
 
     p = sub.add_parser("trace",
-                       help="control-plane spans as Chrome trace JSON")
+                       help="control-plane spans as Chrome trace JSON; "
+                            "with --router URL TRACE_ID, the router's "
+                            "assembled distributed trace")
+    p.add_argument("trace_id", nargs="?", default=None,
+                   help="request id to assemble (with --router)")
+    p.add_argument("--router", default=None,
+                   help="router base URL — assemble the distributed "
+                        "trace for TRACE_ID instead of dumping the "
+                        "control-plane ring")
     p.add_argument("-o", "--output")
     p.set_defaults(fn=cmd_trace)
+
+    p = sub.add_parser("requests",
+                       help="router flight recorder: last-K per-request "
+                            "outcome records (trail, resumes, TTFT/e2e)")
+    p.add_argument("--router", default="http://127.0.0.1:8090",
+                   help="router base URL (tpk-router --port)")
+    p.add_argument("-n", type=int, default=0,
+                   help="only the last N records (0 = all retained)")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_requests)
 
     args = parser.parse_args(argv)
     try:

@@ -500,6 +500,26 @@ def arm_disagg_decode_kill(duration: float, rate: float,
                           - resumes0)
         prefill_chunks = _metric_value(
             pre.scrape(), "tpk_engine_prefill_chunks_total")
+        # Flight-recorder provenance (ISSUE 20): fetched over the admin
+        # endpoint (not in-process) so the artifact pins what an
+        # operator would actually see, and fetched BEFORE teardown —
+        # the ring dies with the router.
+        with urllib.request.urlopen(f"{base}/admin/flightrecorder",
+                                    timeout=5.0) as r:
+            fr = json.loads(r.read())
+        fr_resumed_ok = [rec for rec in fr["records"]
+                         if rec.get("resumes", 0) > 0
+                         and rec.get("outcome") == "ok"]
+        flightrecorder = {
+            "records": len(fr["records"]),
+            "snapshots": len(fr["snapshots"]),
+            "snapshot_reasons": sorted({s.get("reason", "")
+                                        for s in fr["snapshots"]}),
+            "resumed_ok": len(fr_resumed_ok),
+            "resumed_ok_multi_replica": sum(
+                1 for rec in fr_resumed_ok
+                if len(rec.get("replicas", [])) >= 2),
+        }
         return {
             "schedule": sched,
             "kill_fired_t_s": fired.get("kill_t_s"),
@@ -521,6 +541,7 @@ def arm_disagg_decode_kill(duration: float, rate: float,
             "goodput_recovery_ratio": round(g_rec / max(g_pre, 1e-9), 3),
             "ttft_p50_ms": _pct([r["ttft_ms"] for r in completed], .5),
             "ttft_p99_ms": _pct([r["ttft_ms"] for r in completed], .99),
+            "flightrecorder": flightrecorder,
             "router": {k: v for k, v in
                        router.router.stats_snapshot().items()
                        if k in ("handoffs", "handoff_retries", "resumes",

@@ -92,7 +92,7 @@ class Replica:
                  "draining_since", "probe_ready",
                  "fwd_ewma", "fwd_last", "probe_rtt_ewma",
                  "probe_rtt_last", "slow_strikes", "slow_since",
-                 "scrape_seq")
+                 "scrape_seq", "metrics_text")
 
     def __init__(self, name: str, url: str, grpc: str | None = None,
                  role: str = "any"):
@@ -117,6 +117,11 @@ class Replica:
         self.kv_blocks_free: float | None = None
         self.last_scrape: float | None = None
         self.scrape_failures = 0
+        #: Raw exposition text of the last successful scrape — the
+        #: router's /fleet/metrics merges these cached documents, so
+        #: fleet aggregation piggybacks on the poll it already pays for
+        #: (no second scrape storm).
+        self.metrics_text: str | None = None
         self.on_drained = None
         self.draining_since: float | None = None
         #: Gray-failure signals (ISSUE 14): EWMA of router-observed
@@ -261,6 +266,11 @@ class Fleet:
         self.slow_min_s = float(slow_min_s)
         self.ewma_alpha = float(ewma_alpha)
         self.min_remaining = int(min_remaining)
+        #: Optional callback `(name, kind)` fired (outside the lock)
+        #: for every eject/rejoin transition — the router hooks its
+        #: flight-recorder snapshot here so chaos postmortems capture
+        #: the requests surrounding an ejection.
+        self.on_transition = None
         self._closed = threading.Event()
         # Scrapes fan out on this pool (threads are lazy): one stalled
         # replica must not serialize the pass and stale every OTHER
@@ -457,6 +467,7 @@ class Fleet:
                                         timeout=self.scrape_timeout_s) as r:
                 text = r.read().decode()
         out = parse_scrape(text)
+        out["metrics_text"] = text
         out["ready"] = self._probe_ready(url)
         out["rtt_s"] = time.perf_counter() - t0
         return out
@@ -559,6 +570,8 @@ class Fleet:
                           "kv_blocks_free"):
                     if k in sig:
                         setattr(r, k, sig[k])
+                if "metrics_text" in sig:
+                    r.metrics_text = sig["metrics_text"]
                 if sig.get("rtt_s") is not None:
                     a = self.ewma_alpha
                     rtt = float(sig["rtt_s"])
@@ -706,7 +719,21 @@ class Fleet:
             else:
                 res_metrics.inc("tpk_fleet_rejoins_total",
                                 replica=name)
+            if self.on_transition is not None:
+                try:
+                    self.on_transition(name, kind)
+                except Exception:
+                    pass  # an observer hook must never kill the poller
         return transitions
+
+    def metrics_texts(self) -> dict[str, str]:
+        """Replica name -> raw exposition text of its last successful
+        scrape (replicas never scraped are absent) — the cached inputs
+        for the router's /fleet/metrics merge."""
+        with self._lock:
+            return {r.name: r.metrics_text
+                    for r in self._replicas.values()
+                    if r.metrics_text is not None}
 
     @staticmethod
     def _quiesced_locked(r: Replica, sig: dict | None) -> bool:

@@ -1898,7 +1898,10 @@ class GenerationEngine:
             "error": None,
             "deadline": deadline,
             "t0": time.monotonic(),
-            "trace": trace_id,
+            # The trace id rides the shipment meta too (router-stamped
+            # via rewrite_meta): a caller that didn't thread an explicit
+            # id still joins the request's distributed trace.
+            "trace": trace_id or str(meta.get("trace") or ""),
             "t_enq": time.perf_counter(),
             "cb": on_tokens,
         }

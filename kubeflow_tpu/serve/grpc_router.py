@@ -94,6 +94,25 @@ class GrpcRouterServicer:
         exclude: set[str] = set()
         attempts = 0
         last_err = "no gRPC-capable replica registered"
+        t_start = time.perf_counter()
+        t_start_unix = time.time()
+        trail: list[str] = []
+
+        def conclude(outcome: str, reason: str = "") -> None:
+            # SLO + flight-recorder parity with the HTTP plane: every
+            # terminal path (abort or return) reports an e2e sample and
+            # one outcome record. Unary RPCs have no flush boundary, so
+            # there is no gRPC TTFT sample — e2e IS the delivery time.
+            res_metrics.observe("tpk_router_e2e_seconds",
+                                time.perf_counter() - t_start,
+                                outcome=outcome)
+            self.server.flight_recorder.record(
+                trace_id=trace_id, path=full_method, intent="grpc",
+                stream=False, t_start_unix=t_start_unix, ttft_s=None,
+                e2e_s=time.perf_counter() - t_start, outcome=outcome,
+                reason=reason, replicas=list(trail), resumes=0,
+                attempts=attempts,
+                deadline_miss=outcome == "deadline")
         while True:
             candidates = {n: a for n, a in addrs.items()
                           if n not in exclude}
@@ -102,6 +121,7 @@ class GrpcRouterServicer:
                 res_metrics.inc("tpk_router_requests_total", replica="-",
                                 outcome="no_replica")
                 self.router._bump("no_replica")
+                conclude("no_replica", last_err)
                 context.abort(grpc.StatusCode.UNAVAILABLE,
                               f"no live replica: {last_err}")
             with obs.span("router.place", trace_id=trace_id,
@@ -119,9 +139,12 @@ class GrpcRouterServicer:
             if timeout <= 0:
                 res_metrics.inc("tpk_router_requests_total", replica=name,
                                 outcome="deadline")
+                conclude("deadline", "request deadline exceeded (router)")
                 context.abort(grpc.StatusCode.DEADLINE_EXCEEDED,
                               "request deadline exceeded (router)")
             addr = candidates[name]
+            if not trail or trail[-1] != name:
+                trail.append(name)
             rpc = self._channel(name, addr).unary_unary(
                 full_method,
                 request_serializer=lambda b: b,
@@ -183,6 +206,7 @@ class GrpcRouterServicer:
                                 replica=name, outcome=outcome)
                 self.router._bump("sheds_forwarded"
                                   if outcome == "shed" else "errors")
+                conclude(outcome, last_err)
                 # Forward the replica's status verbatim — a shed's
                 # RESOURCE_EXHAUSTED is backpressure, not retry fodder.
                 context.abort(code, details or code.name)
@@ -195,6 +219,8 @@ class GrpcRouterServicer:
                 res_metrics.inc("tpk_router_requests_total",
                                 replica=name, outcome="upstream_error")
                 self.router._bump("errors")
+                conclude("upstream_error",
+                         f"{type(e).__name__}: {e}")
                 context.abort(grpc.StatusCode.INTERNAL,
                               f"router forward failed: "
                               f"{type(e).__name__}: {e}")
@@ -207,6 +233,7 @@ class GrpcRouterServicer:
                 res_metrics.inc("tpk_router_requests_total",
                                 replica=name, outcome="ok")
                 self.router._bump("ok")
+                conclude("ok")
                 return resp
 
 

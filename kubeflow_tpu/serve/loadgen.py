@@ -182,7 +182,9 @@ class FakeGenerativeModel(Model):
     def decode_remote_stream(self, shipment, *, deadline=None,
                              trace_id: str = ""):
         from kubeflow_tpu.serve.kv_transfer import peek_meta
+        from kubeflow_tpu.utils import obs
 
+        t_decode0 = time.perf_counter()
         meta = peek_meta(shipment)
         max_tokens = int(meta.get("max_tokens", 16))
         # Resume cursor (ISSUE 14): same contract as the real engine —
@@ -211,6 +213,15 @@ class FakeGenerativeModel(Model):
         self.engine.bump(requests=1, remote_admits=1,
                          kv_blocks_received=nb,
                          decode_tokens=max_tokens)
+        # Span parity with the real engine surface (ISSUE 20): the
+        # caller's trace id (header-forwarded OR adopted from the
+        # shipment meta by the decode handler) tags the decode work, so
+        # assembled distributed traces see the remote-decode leg even
+        # against fake replicas.
+        obs.record("serve.decode_remote", t_decode0,
+                   time.perf_counter(), trace_id,
+                   tokens=max_tokens, resume_skip=int(
+                       meta.get("resume_skip", 0)))
         yield {"done": True, "output_ids": list(range(max_tokens)),
                "num_output_tokens": max_tokens,
                "prefix_hit": bool(meta.get("prefix_hit"))}
@@ -252,26 +263,35 @@ def make_fake_replica(name: str = "m", *, slots: int = 4,
 
 def _post_generate(base_url: str, model: str, payload: dict,
                    deadline_ms: float | None,
-                   timeout_s: float = 30.0) -> tuple[int, dict, dict]:
-    """Returns (status, body, response_headers) — the headers carry the
-    router's per-request provenance (X-Tpk-Replica / X-Tpk-Attempts)."""
+                   timeout_s: float = 30.0
+                   ) -> tuple[int, dict, dict, float | None]:
+    """Returns (status, body, response_headers, ttft_s) — the headers
+    carry the router's per-request provenance (X-Tpk-Replica /
+    X-Tpk-Attempts); `ttft_s` is the CLIENT-side time to first body
+    byte (None on failures), the ground truth the router's
+    tpk_router_ttft_seconds histogram is cross-checked against."""
     req = urllib.request.Request(
         f"{base_url}/v1/models/{model}:generate",
         data=json.dumps(payload).encode(), method="POST",
         headers={"Content-Type": "application/json"})
     if deadline_ms is not None:
         req.add_header(DEADLINE_HEADER, str(int(deadline_ms)))
+    t0 = time.monotonic()
     try:
         with urllib.request.urlopen(req, timeout=timeout_s) as r:
-            return r.status, json.loads(r.read() or b"{}"), dict(r.headers)
+            first = r.read(1)
+            ttft_s = time.monotonic() - t0
+            body = first + r.read()
+            return (r.status, json.loads(body or b"{}"),
+                    dict(r.headers), ttft_s)
     except urllib.error.HTTPError as e:
         try:
             body = json.loads(e.read() or b"{}")
         except json.JSONDecodeError:
             body = {}
-        return e.code, body, dict(e.headers or {})
+        return e.code, body, dict(e.headers or {}), None
     except Exception as e:
-        return -1, {"error": f"{type(e).__name__}: {e}"}, {}
+        return -1, {"error": f"{type(e).__name__}: {e}"}, {}, None
 
 
 def open_loop(base_url: str, model: str, prompts: list[list[int]], *,
@@ -296,8 +316,8 @@ def open_loop(base_url: str, model: str, prompts: list[list[int]], *,
         payload = {"input_ids": prompts[i % len(prompts)],
                    "max_tokens": max_tokens}
         t0 = time.monotonic()
-        status, body, hdrs = _post_generate(base_url, model, payload,
-                                            deadline_ms)
+        status, body, hdrs, ttft_s = _post_generate(
+            base_url, model, payload, deadline_ms)
         t1 = time.monotonic()
         try:
             attempts = int(hdrs.get("X-Tpk-Attempts", 1))
@@ -307,6 +327,8 @@ def open_loop(base_url: str, model: str, prompts: list[list[int]], *,
             records.append({
                 "sched_s": sched, "status": status,
                 "latency_ms": (t1 - t0) * 1e3,
+                "ttft_ms": (None if ttft_s is None
+                            else ttft_s * 1e3),
                 "prefix_hit": bool(body.get("prefix_hit")),
                 # Per-request provenance (ISSUE 14): which replica
                 # served it, how many placement attempts it took, and
@@ -417,6 +439,39 @@ def _hist_snapshot(model: str) -> dict:
                                      model=model)
 
 
+def _router_ttft_snapshot() -> dict:
+    from kubeflow_tpu.utils.resilience import metrics as res_metrics
+
+    return res_metrics.get_histogram("tpk_router_ttft_seconds",
+                                     intent="generate")
+
+
+def _ttft_crosscheck(records: list[dict], before: dict,
+                     after: dict) -> dict:
+    """Client-recorded TTFT vs the router's tpk_router_ttft_seconds
+    histogram (section delta — the registry is process-global across
+    arms): the same request population counted on both sides, and the
+    means must agree. Client TTFT sits ABOVE the router's flush-
+    boundary sample by client loop/socket overhead, never structurally
+    below; tests/test_router.py pins the agreement bound."""
+    client = [r["ttft_ms"] for r in records
+              if r["status"] == 200 and r.get("ttft_ms") is not None]
+    count = after.get("count", 0) - before.get("count", 0)
+    total_s = after.get("sum", 0.0) - before.get("sum", 0.0)
+    router_mean_ms = (total_s / count * 1e3) if count else None
+    client_mean_ms = (sum(client) / len(client)) if client else None
+    out = {
+        "client_count": len(client), "router_count": count,
+        "client_mean_ms": (round(client_mean_ms, 2)
+                           if client_mean_ms is not None else None),
+        "router_mean_ms": (round(router_mean_ms, 2)
+                           if router_mean_ms is not None else None),
+    }
+    if client_mean_ms is not None and router_mean_ms is not None:
+        out["agreement_ms"] = round(client_mean_ms - router_mean_ms, 2)
+    return out
+
+
 def _hist_delta(before: dict, after: dict) -> dict:
     """SECTION DELTA of the serve-latency histogram (the CTRLBENCH.json
     precedent): the registry is process-global, so an arm's view must
@@ -520,6 +575,7 @@ def run_routerbench(quick: bool = False, seed: int = 0) -> dict:
             prompts = prompts or _prompt_mix(
                 rng, prefixes=16, repeats=12)
             hist0 = _hist_snapshot("m")
+            ttft0 = _router_ttft_snapshot()
             records = open_loop(base, "m", prompts, rate_rps=rate,
                                 duration_s=duration,
                                 max_tokens=max_tokens,
@@ -532,6 +588,8 @@ def run_routerbench(quick: bool = False, seed: int = 0) -> dict:
             arm["histogram"] = _hist_delta(hist0, _hist_snapshot("m"))
             if router is not None:
                 arm["router_stats"] = router.router.stats_snapshot()
+                arm["ttft"] = _ttft_crosscheck(records, ttft0,
+                                               _router_ttft_snapshot())
             return arm
         finally:
             if router is not None:

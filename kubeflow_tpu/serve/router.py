@@ -56,6 +56,7 @@ import json
 import math
 import threading
 import time
+import urllib.request
 from concurrent.futures import ThreadPoolExecutor
 from urllib.parse import urlsplit
 
@@ -70,7 +71,8 @@ from kubeflow_tpu.serve.headers import (ATTEMPTS_HEADER, DEADLINE_HEADER,
                                         DRAINING_HEADER, REPLICA_HEADER,
                                         REQUEST_ID_HEADER)
 from kubeflow_tpu.utils import obs
-from kubeflow_tpu.utils.resilience import (Deadline,
+from kubeflow_tpu.utils.resilience import (Deadline, MetricsMergeError,
+                                           merge_prometheus_texts,
                                            metrics as res_metrics)
 
 #: Headers copied replica → caller. Everything else is router-owned
@@ -404,6 +406,78 @@ class ProxyHandler(_RouterBase):
     def _count(self, replica: str | None, outcome: str) -> None:
         res_metrics.inc("tpk_router_requests_total",
                         replica=replica or "-", outcome=outcome)
+        # Every terminal count doubles as SLO/flight-recorder evidence:
+        # the latest outcome wins (a resumed stream's mid-loop
+        # upstream_error is overwritten by the final ok) and the replica
+        # joins the request's trail.
+        slo = getattr(self, "_slo", None)
+        if slo is not None:
+            slo["outcome"] = outcome
+            if replica:
+                self._slo_replica(replica)
+
+    def _slo_replica(self, name: str) -> None:
+        """Append `name` to the request's replica trail (consecutive
+        duplicates collapsed — retries against the same replica are an
+        attempt count, not a trail hop)."""
+        slo = getattr(self, "_slo", None)
+        if slo is not None and name and (not slo["replicas"]
+                                         or slo["replicas"][-1] != name):
+            slo["replicas"].append(name)
+
+    def _observe_flush(self) -> None:
+        """SLO accounting at the byte-flush boundary: the FIRST flushed
+        content frame is TTFT (what the caller experienced — placement,
+        queueing, prefill, handoff all included); subsequent flushes on
+        a stream are inter-token-latency gaps."""
+        slo = getattr(self, "_slo", None)
+        if slo is None:
+            return
+        now = time.perf_counter()
+        if slo["ttft_s"] is None:
+            slo["ttft_s"] = now - slo["t0"]
+            # tpk-slo: router-ttft-observe — THE TTFT observe site
+            # (tpklint's red-switch test pins this marker: deleting the
+            # observation silently is a finding).
+            res_metrics.observe("tpk_router_ttft_seconds",
+                                slo["ttft_s"], intent=slo["intent"])
+        elif slo["stream"] and slo["last_flush"] is not None:
+            res_metrics.observe("tpk_router_itl_seconds",
+                                now - slo["last_flush"])
+        slo["last_flush"] = now
+
+    def _finalize_slo(self) -> None:
+        """Conclude one proxied request: e2e/deadline-miss observations
+        plus the flight-recorder record — the one place every request
+        (ok, shed, resumed, died) reports what actually happened.
+        Idempotent: the relay paths can conclude through several exits."""
+        slo = getattr(self, "_slo", None)
+        if slo is None or slo["final"]:
+            return
+        slo["final"] = True
+        e2e = time.perf_counter() - slo["t0"]
+        outcome = slo["outcome"]
+        if outcome is None:
+            status = self.get_status()
+            outcome = ("ok" if status < 400 else
+                       "shed" if status == 503 else
+                       "deadline" if status == 504 else
+                       "client_error" if status < 500
+                       else "upstream_error")
+        missed = (slo["deadline"] is not None
+                  and slo["deadline"].expired())
+        res_metrics.observe("tpk_router_e2e_seconds", e2e,
+                            outcome=outcome)
+        if missed:
+            res_metrics.inc("tpk_router_deadline_miss_total",
+                            intent=slo["intent"])
+        self.server.flight_recorder.record(
+            trace_id=slo["trace_id"], path=slo["path"],
+            intent=slo["intent"], stream=slo["stream"],
+            t_start_unix=slo["t_start_unix"], ttft_s=slo["ttft_s"],
+            e2e_s=e2e, outcome=outcome, reason=slo["reason"],
+            replicas=list(slo["replicas"]), resumes=slo["resumes"],
+            attempts=slo["attempts"], deadline_miss=missed)
 
     def _deadline(self) -> Deadline | None:
         raw = self.request.headers.get(DEADLINE_HEADER)
@@ -427,17 +501,47 @@ class ProxyHandler(_RouterBase):
         trace_id = obs.sanitize_trace_id(
             self.request.headers.get(REQUEST_ID_HEADER))
         self.set_header(REQUEST_ID_HEADER, trace_id)
-        deadline = self._deadline()
         route = "/" + path
-        full_path = route
-        if self.request.query:
-            full_path += "?" + self.request.query
         # Classify (and key affinity) on the bare ROUTE: a query string
         # must not reclassify inference traffic as metadata — that would
         # drop both the affinity key and the drain-retry contract.
         is_generative = (route.endswith(_GENERATIVE_SUFFIXES)
                          or route in _OPENAI_PATHS)
         is_inference = is_generative or route.endswith(_INFER_SUFFIXES)
+        self._slo = {
+            "t0": time.perf_counter(), "t_start_unix": time.time(),
+            "trace_id": trace_id, "path": route,
+            "intent": ("generate" if is_generative else
+                       "infer" if is_inference else "meta"),
+            "deadline": None, "stream": False, "ttft_s": None,
+            "last_flush": None, "replicas": [], "resumes": 0,
+            "attempts": 0, "outcome": None, "reason": None,
+            "final": False,
+        }
+        try:
+            await self._proxy_impl(route, trace_id, is_generative,
+                                   is_inference)
+        except tornado.web.HTTPError as e:
+            slo = self._slo
+            if slo["outcome"] is None:
+                slo["outcome"] = ("shed" if e.status_code == 503 else
+                                  "deadline" if e.status_code == 504 else
+                                  "upstream_error" if e.status_code >= 500
+                                  else "client_error")
+            if not slo["reason"]:
+                slo["reason"] = e.reason or ""
+            raise
+        finally:
+            self._finalize_slo()
+
+    async def _proxy_impl(self, route: str, trace_id: str,
+                          is_generative: bool,
+                          is_inference: bool) -> None:
+        deadline = self._deadline()
+        self._slo["deadline"] = deadline
+        full_path = route
+        if self.request.query:
+            full_path += "?" + self.request.query
         key = None
         wants_stream = False
         if is_generative and self.request.body:
@@ -458,6 +562,7 @@ class ProxyHandler(_RouterBase):
                 # picks the relay mode (a false positive only costs
                 # chunk-wise relay of a non-streamed reply).
                 wants_stream = b'"stream"' in raw
+        self._slo["stream"] = wants_stream
         if (is_generative and self.request.method == "POST"
                 and self.fleet.role_split()):
             # Disaggregated fleet (ISSUE 13): two-phase handoff —
@@ -588,6 +693,9 @@ class ProxyHandler(_RouterBase):
                          else self.server.forward_timeout_s)
             self.fleet.checkout(name)
             state.attempts += 1
+            slo = getattr(self, "_slo", None)
+            if slo is not None:
+                slo["attempts"] += 1
             t0 = time.perf_counter()
             try:
                 result = await loop.run_in_executor(
@@ -722,7 +830,19 @@ class ProxyHandler(_RouterBase):
         obs.record("router.forward", t0, time.perf_counter(),
                    trace_id=trace_id, replica=name, status=200,
                    phase="prefill")
-        shipment = result.body
+        self._slo_replica(name)
+        # Stamp the caller's trace id into the held shipment meta
+        # (header splice via rewrite_meta — array bytes untouched, fmt
+        # unchanged, older replicas ignore the key): the decode
+        # replica's spans join the caller's trace even though the
+        # :decode POST body is opaque TPKV1, and every resume
+        # re-submission restates the stamp along with its cursor.
+        from kubeflow_tpu.serve.kv_transfer import rewrite_meta
+
+        try:
+            shipment = rewrite_meta(result.body, trace=trace_id)
+        except Exception:
+            shipment = result.body  # unparseable meta: ship verbatim
         res_metrics.observe("tpk_prefill_handoff_seconds",
                             time.perf_counter() - t_handoff0)
         self.router._bump("handoffs")
@@ -899,19 +1019,37 @@ class ProxyHandler(_RouterBase):
             res_metrics.inc("tpk_router_resume_total",
                             reason="stall" if stalled else "death")
             self.router._bump("resumes")
+            self._slo["resumes"] = resumes
+            # The resume SEAM is a first-class trace event: a zero-
+            # duration span on the router timeline marking where the
+            # stream crossed replicas — the assembled distributed trace
+            # shows the kill and the continuation either side of it.
+            t_seam = time.perf_counter()
+            obs.record("router.resume", t_seam, t_seam,
+                       trace_id=trace_id, from_replica=dname,
+                       delivered=delivered,
+                       reason="stall" if stalled else "death")
+            self.server.flight_recorder.snapshot(
+                f"resume:{dname}", trace_id=trace_id,
+                cause="stall" if stalled else "death",
+                delivered=delivered, resumes=resumes)
             dstate.exclude.add(dname)
             # Stamp the cursor on the ORIGINAL held bytes (idempotent —
-            # each resume restates the full delivered count).
-            from kubeflow_tpu.serve.kv_transfer import rewrite_meta
-
+            # each resume restates the full delivered count; the trace
+            # stamp above rides along, rewrite_meta splices into the
+            # already-stamped shipment).
             active_shipment = rewrite_meta(shipment,
-                                           resume_skip=delivered)
+                                           resume_skip=delivered,
+                                           trace=trace_id)
 
     async def _stream_error_close(self, msg: str) -> None:
         """Terminal error envelope for an already-started ndjson stream,
         followed by an honest ABRUPT close: the envelope names the
         failure for clients that parse frames, the missing terminator
         keeps the truncation visible to clients that don't."""
+        slo = getattr(self, "_slo", None)
+        if slo is not None and not slo["reason"]:
+            slo["reason"] = msg
         try:
             self.write(json.dumps({"error": msg}) + "\n")
             await self.flush()
@@ -989,6 +1127,7 @@ class ProxyHandler(_RouterBase):
                     try:
                         await self.flush()
                         flushed = True
+                        self._observe_flush()
                     except tornado.iostream.StreamClosedError:
                         self._count(name, "ok")
                         self.router._bump("ok")
@@ -1037,6 +1176,11 @@ class ProxyHandler(_RouterBase):
                        trace_id=trace_id, replica=name,
                        status=result.status)
             self.finish(result.body)
+            if result.status < 400:
+                # Non-streamed content: the one body flush IS the first
+                # content frame (sheds/errors are accounted by the e2e
+                # outcome histogram, not TTFT).
+                self._observe_flush()
             return
         conn, resp = result.conn, result.resp
         outcome = "ok" if result.status < 500 else "upstream_error"
@@ -1061,6 +1205,8 @@ class ProxyHandler(_RouterBase):
                 self.write(chunk)
                 try:
                     await self.flush()
+                    if result.status < 400:
+                        self._observe_flush()
                 except tornado.iostream.StreamClosedError:
                     break  # caller went away; stop pulling
             self._count(name, outcome)
@@ -1158,10 +1304,121 @@ class RouterMetricsHandler(_RouterBase):
         self.finish(res_metrics.prometheus_text())
 
 
-class RouterTraceHandler(_RouterBase):
+class FleetMetricsHandler(_RouterBase):
+    """GET /fleet/metrics — ONE exposition for the whole fleet, merged
+    from the poller's already-scraped per-replica documents (zero extra
+    scrape traffic: aggregation rides the poll the fleet already pays
+    for). Counters sum, gauges keep a `replica` label, same-bucket
+    histograms sum bucket-wise; incompatible families answer 500 —
+    refusal is the contract, silent merging never happens."""
+
     def get(self):
+        texts = self.fleet.metrics_texts()
+        try:
+            merged = merge_prometheus_texts(texts)
+        except MetricsMergeError as e:
+            self.write_json(
+                {"error": f"fleet metrics merge refused: {e}"},
+                status=500)
+            return
+        self.set_header("Content-Type", "text/plain; version=0.0.4")
+        self.finish(merged)
+
+
+class FlightRecorderHandler(_RouterBase):
+    """GET /admin/flightrecorder[?n=K] — the per-request outcome ring
+    (most recent last) plus the chaos snapshots frozen at resume/eject
+    events. Bounded by construction: `capacity` records, ever."""
+
+    def get(self):
+        raw = self.get_query_argument("n", default=None)
+        n = None
+        if raw is not None:
+            try:
+                n = int(raw)
+            except ValueError:
+                raise tornado.web.HTTPError(
+                    400, reason=f"n must be an integer, got {raw!r}") \
+                    from None
+        fr = self.server.flight_recorder
+        self.write_json({"records": fr.tail(n),
+                         "snapshots": fr.snapshots(),
+                         "capacity": fr.capacity})
+
+
+class RouterTraceHandler(_RouterBase):
+    """GET /debug/trace[?trace_id=] — without a trace id, this process's
+    own span ring (the ISSUE-5 behavior, unchanged). WITH one:
+    distributed assembly — fan out to the replicas on that request's
+    flight-recorder trail (the whole fleet when the trail is unknown),
+    pull each ring over the same per-replica /debug/trace surface,
+    estimate each replica's clock offset from the fetch RTT midpoint,
+    and serve ONE merged Chrome trace: router place/forward spans,
+    prefill chunks, the shipment hop, decode chunks, and the resume
+    seam on a single timeline, with the alignment error bars stated."""
+
+    async def get(self):
         tid = self.get_query_argument("trace_id", default=None)
-        self.write_json(obs.get_tracer().chrome_trace(tid))
+        if tid is None:
+            self.write_json(obs.get_tracer().chrome_trace(None))
+            return
+        tid = obs.sanitize_trace_id(tid)
+        rec = self.server.flight_recorder.lookup(tid)
+        names = list((rec or {}).get("replicas") or self.fleet.names())
+        loop = asyncio.get_event_loop()
+        fetches = []
+        for name in dict.fromkeys(names):
+            url = self.fleet.url_of(name)
+            if url is not None:
+                fetches.append(loop.run_in_executor(
+                    self.server.executor, self._fetch_replica_trace,
+                    name, url, tid))
+        results = await asyncio.gather(*fetches) if fetches else []
+        parts = [{"process": "router",
+                  "doc": obs.get_tracer().chrome_trace(tid),
+                  "offset_us": 0.0, "err_us": 0.0}]
+        unreachable = []
+        for name, doc, offset_us, err_us, err in results:
+            if err is not None:
+                # A dead replica's ring died with it — say so instead
+                # of silently serving a partial trace as complete.
+                unreachable.append({"replica": name, "error": err})
+                continue
+            parts.append({"process": name, "doc": doc,
+                          "offset_us": offset_us, "err_us": err_us})
+        merged = obs.merge_chrome_traces(parts)
+        merged["trace_id"] = tid
+        if rec is not None:
+            merged["flight_record"] = rec
+        if unreachable:
+            merged["unreachable"] = unreachable
+        self.write_json(merged)
+
+    def _fetch_replica_trace(self, name: str, url: str, tid: str):
+        """One blocking per-replica ring fetch (executor only) + the
+        RTT-midpoint clock-offset estimate: the replica stamps its own
+        `now_us` while serving the fetch, which on OUR timeline happened
+        ~at the fetch midpoint — so offset = our_midpoint - its_now,
+        with half the RTT as the honest error bar. Returns
+        (name, doc, offset_us, err_us, error)."""
+        t0 = time.perf_counter()
+        try:
+            # tid came through sanitize_trace_id: URL-safe charset.
+            with urllib.request.urlopen(
+                    f"{url}/debug/trace?trace_id={tid}",
+                    timeout=self.server.trace_timeout_s) as r:
+                doc = json.loads(r.read().decode())
+        except Exception as e:
+            return name, None, 0.0, None, f"{type(e).__name__}: {e}"
+        t1 = time.perf_counter()
+        now_us = doc.get("now_us") if isinstance(doc, dict) else None
+        if now_us is None:
+            # Older replica without the export stamp: spans ride
+            # un-shifted, marked unaligned in clock_alignment.
+            return name, doc, 0.0, None, None
+        mid_us = obs.perf_to_us((t0 + t1) / 2.0)
+        return (name, doc, mid_us - float(now_us),
+                (t1 - t0) / 2.0 * 1e6, None)
 
 
 class RouterServer:
@@ -1173,11 +1430,21 @@ class RouterServer:
                  affinity: bool = True, spill_margin: float = 4.0,
                  forward_timeout_s: float = 300.0,
                  max_resumes: int = 3,
-                 max_workers: int = 128):
+                 max_workers: int = 128,
+                 trace_timeout_s: float = 5.0):
         self.fleet = fleet or Fleet()
         self.router = Router(self.fleet, affinity=affinity,
                              spill_margin=spill_margin)
         self.forward_timeout_s = float(forward_timeout_s)
+        #: Per-replica budget for the distributed-trace fan-out fetch
+        #: (a dead replica must not wedge assembly of everyone else's
+        #: spans — it lands in the `unreachable` list instead).
+        self.trace_timeout_s = float(trace_timeout_s)
+        #: Per-request outcome ring (+ chaos snapshots). The fleet's
+        #: eject transitions freeze a snapshot so postmortems keep the
+        #: requests surrounding an ejection.
+        self.flight_recorder = obs.FlightRecorder()
+        self.fleet.on_transition = self._on_fleet_transition
         #: Mid-stream decode-failover cap (ISSUE 14): how many times one
         #: disaggregated stream may be resumed on a fresh decode replica
         #: before the router gives up with a terminal error frame.
@@ -1196,13 +1463,19 @@ class RouterServer:
         self._grpc = None
         self.grpc_port: int | None = None
 
+    def _on_fleet_transition(self, name: str, kind: str) -> None:
+        if kind == "eject":
+            self.flight_recorder.snapshot(f"eject:{name}", replica=name)
+
     def app(self) -> tornado.web.Application:
         kw = {"server": self}
         return tornado.web.Application([
             (r"/admin/replicas", AdminReplicasHandler, kw),
             (r"/admin/replicas/([^/]+)", AdminReplicaHandler, kw),
             (r"/admin/drain/([^/]+)", AdminDrainHandler, kw),
+            (r"/admin/flightrecorder", FlightRecorderHandler, kw),
             (r"/metrics", RouterMetricsHandler, kw),
+            (r"/fleet/metrics", FleetMetricsHandler, kw),
             (r"/debug/trace", RouterTraceHandler, kw),
             (r"/(.*)", ProxyHandler, kw),
         ])

@@ -868,6 +868,15 @@ class DecodeHandler(_Base):
         except ShipmentError as e:
             raise tornado.web.HTTPError(
                 400, reason=f"bad KV shipment: {e}") from None
+        if meta.get("trace") and \
+                REQUEST_ID_HEADER not in self.request.headers:
+            # The router stamps the caller's trace id into the shipment
+            # meta: a :decode POST without an explicit X-Request-Id
+            # (direct tooling, older routers' resumes) still joins the
+            # caller's distributed trace. A forwarded header wins — the
+            # router already threads the id on its own requests.
+            self.trace_id = obs.sanitize_trace_id(str(meta["trace"]))
+            self.set_header(REQUEST_ID_HEADER, self.trace_id)
         deadline = self.request_deadline()
         t0 = time.monotonic()
         if (meta.get("extra") or {}).get("stream"):

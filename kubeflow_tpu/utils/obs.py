@@ -54,6 +54,12 @@ def new_trace_id() -> str:
     return uuid.uuid4().hex
 
 
+def perf_to_us(t: float) -> float:
+    """A time.perf_counter() reading as microseconds on this process's
+    span timeline (the `ts` unit chrome_trace exports)."""
+    return (t - _EPOCH) * 1e6
+
+
 def sanitize_trace_id(raw: str | None) -> str:
     """A caller-supplied id, made safe for logs/exposition: restricted
     charset, bounded length; empty/None gets a fresh id."""
@@ -199,7 +205,13 @@ class Tracer:
                 "pid": pid, "tid": ev["tid"] or "main",
                 "args": {"trace_id": ev["trace_id"], **ev["attrs"]},
             })
-        return {"traceEvents": events, "displayTimeUnit": "ms"}
+        # `now_us` stamps export time on this process's own µs timeline.
+        # A fetcher that measured the request's RTT can estimate the
+        # clock offset between its timeline and ours (midpoint method,
+        # see merge_chrome_traces) — Chrome/Perfetto ignore unknown
+        # top-level keys.
+        return {"traceEvents": events, "displayTimeUnit": "ms",
+                "now_us": perf_to_us(time.perf_counter())}
 
     def __len__(self) -> int:
         with self._lock:
@@ -234,3 +246,124 @@ def span(name: str, trace_id: str = "", **attrs):
 def record(name: str, t0: float, t1: float, trace_id: str = "",
            **attrs) -> None:
     _TRACER.record(name, t0, t1, trace_id, **attrs)
+
+
+def merge_chrome_traces(parts: list[dict]) -> dict:
+    """Merge per-process Chrome trace documents onto ONE timeline.
+
+    Each part is `{"process": name, "doc": chrome_trace() output,
+    "offset_us": float, "err_us": float | None}` — `offset_us` shifts
+    that process's span timestamps onto the merging process's timeline
+    (add it to every `ts`), `err_us` is the honest uncertainty of that
+    estimate (half the fetch RTT with the midpoint method; None means
+    the part was NOT aligned — e.g. an old replica whose export lacks
+    `now_us` — and rides un-shifted).
+
+    Every process gets a synthetic pid (original pids can collide across
+    hosts) plus a `ph: "M"` process_name metadata event, so Perfetto
+    shows one labeled track per process. The alignment estimates are
+    kept in the output under `clock_alignment` — the merged timeline is
+    an ESTIMATE with a stated error bar, never presented as exact.
+    """
+    events: list[dict] = []
+    alignment: dict[str, dict] = {}
+    for pid, part in enumerate(parts):
+        name = str(part.get("process") or f"proc{pid}")
+        doc = part.get("doc") or {}
+        offset_us = float(part.get("offset_us") or 0.0)
+        err_us = part.get("err_us")
+        alignment[name] = {
+            "offset_us": round(offset_us, 3),
+            "skew_err_us": (round(float(err_us), 3)
+                            if err_us is not None else None),
+            "aligned": err_us is not None,
+        }
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "tid": 0, "args": {"name": name}})
+        for ev in doc.get("traceEvents") or []:
+            ev = dict(ev)
+            ev["pid"] = pid
+            if "ts" in ev:
+                ev["ts"] = round(float(ev["ts"]) + offset_us, 3)
+            events.append(ev)
+    events.sort(key=lambda ev: (ev.get("ph") != "M", ev.get("ts", 0.0)))
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "clock_alignment": alignment}
+
+
+class FlightRecorder:
+    """Bounded ring of per-request outcome records + chaos snapshots.
+
+    The router drops one record per concluded request (trace id, replica
+    trail including resumes, TTFT/e2e, outcome, shed/deadline reason) —
+    a postmortem of the last-K requests that costs one dict append, no
+    live debugger, no log scraping. `snapshot(reason)` freezes the tail
+    at interesting moments (resume fired, replica ejected) so the
+    context *around* a chaos event survives ring turnover.
+    """
+
+    def __init__(self, capacity: int = 512, snapshot_capacity: int = 16,
+                 snapshot_tail: int = 32):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self.snapshot_tail = int(snapshot_tail)
+        self._lock = threading.Lock()
+        self._records: deque[dict] = deque(
+            maxlen=self.capacity)  # guarded-by: _lock
+        self._snapshots: deque[dict] = deque(
+            maxlen=int(snapshot_capacity))  # guarded-by: _lock
+        self._seq = 0  # guarded-by: _lock
+
+    def record(self, **fields) -> dict:
+        """Append one concluded-request record; returns it (with its
+        monotone `seq` stamped)."""
+        rec = dict(fields)
+        with self._lock:
+            self._seq += 1
+            rec["seq"] = self._seq
+            self._records.append(rec)
+        return rec
+
+    def tail(self, n: int | None = None) -> list[dict]:
+        """Most-recent-last copies of the last `n` records (all, when
+        n is None)."""
+        with self._lock:
+            recs = list(self._records)
+        if n is not None:
+            recs = recs[-max(int(n), 0):] if n else []
+        return [dict(r) for r in recs]
+
+    def lookup(self, trace_id: str) -> dict | None:
+        """The most recent record for `trace_id`, or None."""
+        with self._lock:
+            for rec in reversed(self._records):
+                if rec.get("trace_id") == trace_id:
+                    return dict(rec)
+        return None
+
+    def snapshot(self, reason: str, **context) -> dict:
+        """Freeze the last `snapshot_tail` records under `reason` (e.g.
+        ``resume:dec0``, ``eject:m1``) with a wall-clock stamp."""
+        with self._lock:
+            snap = {
+                "reason": reason, "t_unix": time.time(),
+                "context": dict(context),
+                "records": [dict(r) for r in
+                            list(self._records)[-self.snapshot_tail:]],
+            }
+            self._snapshots.append(snap)
+        return snap
+
+    def snapshots(self) -> list[dict]:
+        with self._lock:
+            return [dict(s) for s in self._snapshots]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._records)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._records.clear()
+            self._snapshots.clear()

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import dataclasses
 import random
+import re
 import threading
 import time
 from typing import Any, Callable
@@ -355,3 +356,212 @@ class Counters:
 
 
 metrics = Counters()
+
+
+# ---------------------------------------------------------------------------
+# Exposition-format parse + fleet merge (router's /fleet/metrics).
+#
+# The fleet poller already scrapes every replica's /metrics text; merging
+# those cached expositions gives one whole-fleet document without a
+# Prometheus server in the loop. Merge semantics are deliberate:
+# counters SUM, gauges stay PER-REPLICA (summing a gauge like
+# tpk_decode_inflight across replicas is meaningful but summing
+# tpk_serve_batch_size is nonsense — so gauges uniformly keep a
+# `replica` label and the reader decides), histograms sum BUCKET-WISE
+# only when every replica agrees on the bucket layout. A layout
+# mismatch REFUSES loudly (MetricsMergeError): silently merging
+# incompatible buckets would fabricate quantiles.
+# ---------------------------------------------------------------------------
+
+class MetricsMergeError(ValueError):
+    """Fleet metrics merge refused — incompatible per-replica
+    expositions (same family, different kind or bucket layout)."""
+
+
+_EXPO_TYPE = re.compile(r"^# TYPE ([A-Za-z_:][A-Za-z0-9_:]*) (\S+)\s*$")
+_EXPO_SAMPLE = re.compile(
+    r"^([A-Za-z_:][A-Za-z0-9_:]*)(?:\{(.*)\})?\s+(\S+)\s*$")
+_EXPO_LABEL = re.compile(r'([A-Za-z_][A-Za-z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _unescape_label(value: str) -> str:
+    """Reverse `_escape_label` (char walk — a regex sub would mis-handle
+    runs of backslashes)."""
+    out, i = [], 0
+    while i < len(value):
+        ch = value[i]
+        if ch == "\\" and i + 1 < len(value):
+            nxt = value[i + 1]
+            out.append({"n": "\n", "\\": "\\", '"': '"'}.get(nxt,
+                                                            "\\" + nxt))
+            i += 2
+        else:
+            out.append(ch)
+            i += 1
+    return "".join(out)
+
+
+def _parse_labels(raw: str | None) -> dict[str, str]:
+    if not raw:
+        return {}
+    return {m.group(1): _unescape_label(m.group(2))
+            for m in _EXPO_LABEL.finditer(raw)}
+
+
+def parse_prometheus_text(text: str) -> dict[str, dict]:
+    """Parse one exposition document into families.
+
+    Returns `{family: {"kind": counter|gauge|histogram|untyped, ...}}`:
+    scalar families carry `"samples": {labels_tuple: value}`, histogram
+    families carry `"hist": {labels_tuple_without_le: {"buckets":
+    {le_float: cumulative}, "sum": x, "count": n}}` (+Inf as
+    `float("inf")`). Unparseable lines are skipped — a scrape is partial
+    truth, not a schema."""
+    kinds: dict[str, str] = {}
+    for line in text.splitlines():
+        m = _EXPO_TYPE.match(line)
+        if m:
+            kinds[m.group(1)] = m.group(2)
+    hist_families = {n for n, k in kinds.items() if k == "histogram"}
+
+    def hist_family_of(name: str) -> tuple[str, str] | None:
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[:-len(suffix)] in \
+                    hist_families:
+                return name[:-len(suffix)], suffix
+        return None
+
+    out: dict[str, dict] = {}
+    for name in hist_families:
+        out[name] = {"kind": "histogram", "hist": {}}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _EXPO_SAMPLE.match(line)
+        if not m:
+            continue
+        name, raw_labels, raw_value = m.groups()
+        try:
+            value = float(raw_value)
+        except ValueError:
+            continue
+        labels = _parse_labels(raw_labels)
+        hf = hist_family_of(name)
+        if hf is not None:
+            family, suffix = hf
+            le = labels.pop("le", None)
+            key = tuple(sorted(labels.items()))
+            series = out[family]["hist"].setdefault(
+                key, {"buckets": {}, "sum": 0.0, "count": 0.0})
+            if suffix == "_bucket":
+                if le is None:
+                    continue
+                series["buckets"][float(le)] = value
+            elif suffix == "_sum":
+                series["sum"] = value
+            else:
+                series["count"] = value
+            continue
+        fam = out.setdefault(
+            name, {"kind": kinds.get(name, "untyped"), "samples": {}})
+        fam.setdefault("samples", {})[
+            tuple(sorted(labels.items()))] = value
+    return out
+
+
+def _fmt_le(le: float) -> str:
+    return "+Inf" if le == float("inf") else f"{le:g}"
+
+
+def merge_prometheus_texts(texts: dict[str, str]) -> str:
+    """Merge per-replica exposition documents into one fleet document.
+
+    `texts` maps replica name -> its scraped /metrics text. Counters are
+    summed across replicas; gauges (and untyped samples) are re-emitted
+    per replica with a `replica` label added; histogram families whose
+    bucket layouts agree across every exposing replica are bucket-wise
+    summed (cumulative counts, sum, count). Disagreements — one family
+    declared with two kinds, or two bucket layouts — raise
+    `MetricsMergeError` naming the family: refusal is the contract,
+    silent merging of incompatible series never happens.
+    """
+    parsed = {replica: parse_prometheus_text(text)
+              for replica, text in sorted(texts.items())}
+
+    kinds: dict[str, str] = {}
+    for replica, families in parsed.items():
+        for name, fam in families.items():
+            prev = kinds.get(name)
+            if prev is not None and prev != fam["kind"] and \
+                    "untyped" not in (prev, fam["kind"]):
+                raise MetricsMergeError(
+                    f"family {name}: declared {prev} by one replica but "
+                    f"{fam['kind']} by {replica} — refusing to merge")
+            if prev is None or prev == "untyped":
+                kinds[name] = fam["kind"]
+
+    counters: dict[str, dict[tuple, float]] = {}
+    per_replica: dict[str, dict[tuple, float]] = {}
+    hists: dict[str, dict[tuple, dict]] = {}
+    hist_layout: dict[str, tuple[frozenset, str]] = {}
+    for replica, families in parsed.items():
+        for name, fam in families.items():
+            if kinds[name] == "counter":
+                dst = counters.setdefault(name, {})
+                for lbl, v in fam.get("samples", {}).items():
+                    dst[lbl] = dst.get(lbl, 0.0) + v
+            elif kinds[name] == "histogram":
+                dst = hists.setdefault(name, {})
+                for lbl, series in fam.get("hist", {}).items():
+                    layout = frozenset(series["buckets"])
+                    prev = hist_layout.get(name)
+                    if prev is None:
+                        hist_layout[name] = (layout, replica)
+                    elif prev[0] != layout:
+                        raise MetricsMergeError(
+                            f"histogram {name}: bucket layout "
+                            f"[{', '.join(_fmt_le(b) for b in sorted(prev[0]))}]"
+                            f" (from {prev[1]}) != "
+                            f"[{', '.join(_fmt_le(b) for b in sorted(layout))}]"
+                            f" (from {replica}) — refusing to merge "
+                            "mismatched buckets")
+                    agg = dst.setdefault(
+                        lbl, {"buckets": {}, "sum": 0.0, "count": 0.0})
+                    for le, v in series["buckets"].items():
+                        agg["buckets"][le] = agg["buckets"].get(le,
+                                                                0.0) + v
+                    agg["sum"] += series["sum"]
+                    agg["count"] += series["count"]
+            else:  # gauge / untyped: per-replica, never summed
+                dst = per_replica.setdefault(name, {})
+                for lbl, v in fam.get("samples", {}).items():
+                    labeled = dict(lbl)
+                    labeled["replica"] = replica
+                    dst[tuple(sorted(labeled.items()))] = v
+
+    lines: list[str] = []
+    for name in sorted(kinds):
+        kind = kinds[name]
+        lines.append(f"# TYPE {name} {kind}")
+        if kind == "counter":
+            for lbl, v in sorted(counters.get(name, {}).items()):
+                tag = "{%s}" % _label_str(lbl) if lbl else ""
+                lines.append(f"{name}{tag} {_fmt_value(v)}")
+        elif kind == "histogram":
+            for lbl, agg in sorted(hists.get(name, {}).items()):
+                base = _label_str(lbl)
+                for le in sorted(agg["buckets"]):
+                    tag = (base + "," if base else "") + \
+                        f'le="{_fmt_le(le)}"'
+                    lines.append(f"{name}_bucket{{{tag}}} "
+                                 f"{_fmt_value(agg['buckets'][le])}")
+                suffix = "{%s}" % base if base else ""
+                lines.append(f"{name}_sum{suffix} "
+                             f"{_fmt_value(agg['sum'])}")
+                lines.append(f"{name}_count{suffix} "
+                             f"{_fmt_value(agg['count'])}")
+        else:
+            for lbl, v in sorted(per_replica.get(name, {}).items()):
+                tag = "{%s}" % _label_str(lbl) if lbl else ""
+                lines.append(f"{name}{tag} {_fmt_value(v)}")
+    return "\n".join(lines) + ("\n" if lines else "")

@@ -171,7 +171,12 @@ def test_tracer_chrome_trace_valid_and_filterable():
         pass
     t.record("serve.fetch", 1.0, 1.5, "req-2", slot=0)
     doc = json.loads(json.dumps(t.chrome_trace()))
-    assert set(doc) == {"traceEvents", "displayTimeUnit"}
+    assert set(doc) == {"traceEvents", "displayTimeUnit", "now_us"}
+    # now_us is the exporter's own clock at export time, on the same
+    # perf_counter timebase as event ts — the anchor the router's
+    # RTT-midpoint clock alignment reads (ISSUE 20).
+    assert doc["now_us"] >= max(ev["ts"] + ev["dur"]
+                                for ev in doc["traceEvents"])
     for ev in doc["traceEvents"]:
         assert ev["ph"] == "X"
         assert isinstance(ev["ts"], (int, float))
@@ -239,5 +244,255 @@ def test_metric_conventions_and_readme_in_sync():
     for expect in ("tpk_retry_attempts_total",
                    "tpk_serve_request_latency_seconds",
                    "tpk_controlplane_rpc_latency_seconds",
-                   "tpk_engine_pipeline_depth"):
+                   "tpk_engine_pipeline_depth",
+                   "tpk_router_ttft_seconds",
+                   "tpk_router_deadline_miss_total"):
         assert expect in series, expect
+
+
+def test_ttft_slo_marker_red_switch(tmp_path):
+    """Red-switch (ISSUE 20): observing tpk_router_ttft_seconds in a
+    file WITHOUT the `# tpk-slo: router-ttft-observe` marker is a lint
+    finding — the TTFT observe site can't be moved or deleted without
+    touching the marker deliberately."""
+    import os
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    from tools.tpklint import rules_metrics
+
+    pkg = tmp_path / "kubeflow_tpu"
+    pkg.mkdir()
+    (tmp_path / "README.md").write_text(
+        "| `tpk_router_ttft_seconds` | histogram | ttft |\n")
+    code = ('from kubeflow_tpu.utils.resilience import metrics\n\n'
+            'metrics.observe("tpk_router_ttft_seconds", 0.1,\n'
+            '                intent="generate")\n')
+    (pkg / "rogue.py").write_text(code)
+    problems = rules_metrics.check(str(tmp_path))
+    assert any("SLO-pinned" in p and "rogue.py" in p
+               for p in problems), problems
+    # Same observe WITH the marker in the file: the finding clears.
+    (pkg / "rogue.py").write_text(
+        "# tpk-slo: router-ttft-observe\n" + code)
+    assert not rules_metrics.check(str(tmp_path))
+
+
+# -- distributed trace assembly (ISSUE 20) -----------------------------------
+
+
+def test_merge_chrome_traces_synthetic_pids_and_alignment():
+    t1 = obs.Tracer(capacity=8, enabled=True)
+    t2 = obs.Tracer(capacity=8, enabled=True)
+    with t1.span("router.place", trace_id="rq"):
+        pass
+    t2.record("serve.decode", 5.0, 5.5, "rq", slot=1)
+    merged = obs.merge_chrome_traces([
+        {"process": "router", "doc": t1.chrome_trace("rq"),
+         "offset_us": 0.0, "err_us": 0.0},
+        {"process": "dec1", "doc": t2.chrome_trace("rq"),
+         "offset_us": 1000.0, "err_us": 250.0},
+        {"process": "dead", "doc": {"traceEvents": []},
+         "offset_us": 0.0, "err_us": None},
+    ])
+    assert set(merged) == {"traceEvents", "displayTimeUnit",
+                           "clock_alignment"}
+    evs = merged["traceEvents"]
+    # One process_name metadata event per part, first in the list.
+    metas = [e for e in evs if e["ph"] == "M"]
+    assert [m["args"]["name"] for m in metas] == ["router", "dec1",
+                                                 "dead"]
+    assert {m["pid"] for m in metas} == {0, 1, 2}
+    assert evs[:len(metas)] == metas
+    # dec1's span rode its offset onto the router timeline.
+    (dec_ev,) = [e for e in evs if e.get("name") == "serve.decode"]
+    assert dec_ev["pid"] == 1
+    # ts is on the process-local _EPOCH timeline; the merge adds the
+    # part's offset on top of whatever the exporter rendered.
+    assert dec_ev["ts"] == pytest.approx(obs.perf_to_us(5.0) + 1000.0,
+                                         abs=0.01)
+    # Honest alignment annotation: estimates with error bars, and the
+    # unaligned part says so instead of faking an offset.
+    al = merged["clock_alignment"]
+    assert al["dec1"] == {"offset_us": 1000.0, "skew_err_us": 250.0,
+                          "aligned": True}
+    assert al["dead"]["aligned"] is False
+    assert al["dead"]["skew_err_us"] is None
+    # Valid JSON end to end.
+    json.loads(json.dumps(merged))
+
+
+def test_merge_chrome_traces_sorts_spans_across_processes():
+    a = obs.Tracer(capacity=4, enabled=True)
+    b = obs.Tracer(capacity=4, enabled=True)
+    a.record("late", 10.0, 11.0, "x")
+    b.record("early", 1.0, 2.0, "x")
+    merged = obs.merge_chrome_traces([
+        {"process": "a", "doc": a.chrome_trace(), "offset_us": 0.0,
+         "err_us": 0.0},
+        {"process": "b", "doc": b.chrome_trace(), "offset_us": 0.0,
+         "err_us": 0.0},
+    ])
+    names = [e["name"] for e in merged["traceEvents"]
+             if e["ph"] != "M"]
+    assert names == ["early", "late"]
+
+
+# -- flight recorder (ISSUE 20) ----------------------------------------------
+
+
+def test_flight_recorder_ring_tail_lookup():
+    fr = obs.FlightRecorder(capacity=4)
+    for i in range(6):
+        fr.record(trace_id=f"t{i}", outcome="ok", i=i)
+    assert len(fr) == 4
+    # Oldest evicted, seq monotone across eviction.
+    tail = fr.tail()
+    assert [r["trace_id"] for r in tail] == ["t2", "t3", "t4", "t5"]
+    assert [r["seq"] for r in tail] == [3, 4, 5, 6]
+    assert [r["trace_id"] for r in fr.tail(2)] == ["t4", "t5"]
+    assert fr.tail(0) == []
+    assert fr.lookup("t3")["i"] == 3
+    assert fr.lookup("t0") is None  # evicted
+    # lookup returns the MOST RECENT record for a reused id.
+    fr.record(trace_id="t3", outcome="retry", i=99)
+    assert fr.lookup("t3")["i"] == 99
+
+
+def test_flight_recorder_snapshot_freezes_tail():
+    fr = obs.FlightRecorder(capacity=64, snapshot_capacity=2,
+                            snapshot_tail=3)
+    for i in range(5):
+        fr.record(trace_id=f"t{i}")
+    snap = fr.snapshot("resume:dec0", delivered=16)
+    assert snap["reason"] == "resume:dec0"
+    assert snap["context"] == {"delivered": 16}
+    assert [r["trace_id"] for r in snap["records"]] == ["t2", "t3", "t4"]
+    # Frozen: later ring turnover must not mutate the snapshot.
+    for i in range(100):
+        fr.record(trace_id=f"u{i}")
+    (kept,) = [s for s in fr.snapshots()
+               if s["reason"] == "resume:dec0"]
+    assert [r["trace_id"] for r in kept["records"]] == ["t2", "t3", "t4"]
+    # Snapshot ring itself is bounded.
+    fr.snapshot("eject:a")
+    fr.snapshot("eject:b")
+    assert [s["reason"] for s in fr.snapshots()] == ["eject:a",
+                                                     "eject:b"]
+
+
+def test_flight_recorder_capacity_validation():
+    with pytest.raises(ValueError):
+        obs.FlightRecorder(capacity=0)
+
+
+# -- fleet metrics merge (ISSUE 20) ------------------------------------------
+
+
+def test_merge_prometheus_texts_counters_sum_exact():
+    from kubeflow_tpu.utils.resilience import merge_prometheus_texts
+
+    a, b = Counters(), Counters()
+    a.inc("tpk_m_total", 3, outcome="ok")
+    a.inc("tpk_m_total", 1, outcome="err")
+    b.inc("tpk_m_total", 5, outcome="ok")
+    merged = merge_prometheus_texts(
+        {"r1": a.prometheus_text(), "r2": b.prometheus_text()})
+    types, samples = parse_exposition(merged)
+    assert types["tpk_m_total"] == "counter"
+    # Counters sum EXACTLY across replicas; per-replica identity is
+    # deliberately dropped (a counter answers "how many, fleet-wide").
+    assert samples[("tpk_m_total", (("outcome", "ok"),))] == 8
+    assert samples[("tpk_m_total", (("outcome", "err"),))] == 1
+
+
+def test_merge_prometheus_texts_gauges_keep_replica_identity():
+    from kubeflow_tpu.utils.resilience import merge_prometheus_texts
+
+    a, b = Counters(), Counters()
+    a.set_gauge("tpk_depth", 2, model="m")
+    b.set_gauge("tpk_depth", 7, model="m")
+    types, samples = parse_exposition(merge_prometheus_texts(
+        {"r1": a.prometheus_text(), "r2": b.prometheus_text()}))
+    assert types["tpk_depth"] == "gauge"
+    # Summing gauges would fabricate a meaningless number — each
+    # replica's level survives under its own replica label.
+    assert samples[("tpk_depth",
+                    (("model", "m"), ("replica", "r1")))] == 2
+    assert samples[("tpk_depth",
+                    (("model", "m"), ("replica", "r2")))] == 7
+
+
+def test_merge_prometheus_texts_histograms_bucket_exact():
+    from kubeflow_tpu.utils.resilience import merge_prometheus_texts
+
+    a, b = Counters(), Counters()
+    for v in (0.0005, 0.07):
+        a.observe("tpk_lat_seconds", v, verb="get")
+    b.observe("tpk_lat_seconds", 0.003, verb="get")
+    merged = merge_prometheus_texts(
+        {"r1": a.prometheus_text(), "r2": b.prometheus_text()})
+    types, samples = parse_exposition(merged)
+    assert types["tpk_lat_seconds"] == "histogram"
+    # Same bucket layout → bucket-wise EXACT sums, and sum/count are
+    # exact too (no re-bucketing, no quantile estimation).
+    assert samples[("tpk_lat_seconds_count", (("verb", "get"),))] == 3
+    assert samples[("tpk_lat_seconds_sum", (("verb", "get"),))] == \
+        pytest.approx(0.0735)
+    assert samples[("tpk_lat_seconds_bucket",
+                    (("le", "0.001"), ("verb", "get")))] == 1
+    assert samples[("tpk_lat_seconds_bucket",
+                    (("le", "0.005"), ("verb", "get")))] == 2
+    assert samples[("tpk_lat_seconds_bucket",
+                    (("le", "+Inf"), ("verb", "get")))] == 3
+
+
+def test_merge_prometheus_texts_refuses_mismatched_buckets():
+    from kubeflow_tpu.utils.resilience import (MetricsMergeError,
+                                               merge_prometheus_texts)
+
+    a, b = Counters(), Counters()
+    a.observe("tpk_lat_seconds", 0.5)
+    b.observe("tpk_lat_seconds", 0.5, buckets=(0.1, 1.0))
+    with pytest.raises(MetricsMergeError) as ei:
+        merge_prometheus_texts(
+            {"r1": a.prometheus_text(), "r2": b.prometheus_text()})
+    # The refusal NAMES the family and both layouts — loud, not a
+    # silently-wrong bucket-wise sum over incompatible layouts.
+    msg = str(ei.value)
+    assert "tpk_lat_seconds" in msg and "refusing" in msg
+    assert "r1" in msg and "r2" in msg
+
+
+def test_merge_prometheus_texts_refuses_kind_conflict():
+    from kubeflow_tpu.utils.resilience import (MetricsMergeError,
+                                               merge_prometheus_texts)
+
+    a, b = Counters(), Counters()
+    a.inc("tpk_thing_total")
+    b.set_gauge("tpk_thing_total", 4)
+    with pytest.raises(MetricsMergeError):
+        merge_prometheus_texts(
+            {"r1": a.prometheus_text(), "r2": b.prometheus_text()})
+
+
+def test_merge_prometheus_texts_round_trips_own_renderer():
+    """The merged exposition re-parses under the same conforming parser
+    used for single-replica expositions — merge output IS exposition
+    format, not a lookalike."""
+    from kubeflow_tpu.utils.resilience import (merge_prometheus_texts,
+                                               parse_prometheus_text)
+
+    a = Counters()
+    a.inc("tpk_a_total", 2, model='e"vil\n')
+    a.observe("tpk_b_seconds", 0.2)
+    a.set_gauge("tpk_c_depth", 1)
+    merged = merge_prometheus_texts({"r1": a.prometheus_text()})
+    parse_exposition(merged)  # asserts internally
+    fams = parse_prometheus_text(merged)
+    assert fams["tpk_a_total"]["kind"] == "counter"
+    assert fams["tpk_b_seconds"]["kind"] == "histogram"
+    # The nasty label survived one render → parse → render cycle.
+    assert (("model", 'e"vil\n'),) in fams["tpk_a_total"]["samples"]

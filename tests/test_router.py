@@ -1688,6 +1688,352 @@ def test_routerbench_quick_shape():
     s = r4["router_stats"]
     assert (s["affinity_hits"] + s["spills"] + s["least_loaded"]
             == s["placed"])
+    # TTFT cross-check rides the artifact (ISSUE 20): client-side and
+    # router-histogram views of the same arm, both populated. The hard
+    # agreement bound is pinned by the FAST test
+    # test_router_ttft_histogram_agrees_with_client_ttft below.
+    for arm in ("routed_1", "routed_4"):
+        t = r["arms"][arm]["ttft"]
+        assert t["client_count"] > 0 and t["router_count"] > 0
+        assert t["client_mean_ms"] is not None
+        assert t["router_mean_ms"] is not None
     aff = r["affinity"]
     assert aff["hit_rate_on"] > aff["hit_rate_off"]  # strictly above
     json.dumps(r)  # artifact stays serializable
+
+
+# -- ISSUE 20: fleet observability plane ------------------------------------
+
+
+def test_e2e_assembled_trace_after_midstream_resume():
+    """THE ISSUE 20 tentpole, end to end: a disaggregated stream whose
+    decode replica dies mid-stream resumes on the survivor, and the
+    router's `GET /debug/trace?trace_id=` then serves ONE merged Chrome
+    trace for the caller's X-Request-Id — router spans, prefill spans,
+    the surviving decode replica's spans and the resume seam on a
+    single timeline, clock alignment stated, the dead replica reported
+    unreachable instead of silently missing."""
+    from kubeflow_tpu.serve.fleet import Fleet as _Fleet
+
+    tid = "e2e-assembled-trace"
+    _lsock, dport = _dying_decode_server(
+        [{"model_name": "m", "tokens": [0, 1, 2, 3]},
+         {"model_name": "m", "tokens": [4, 5, 6, 7]}])
+    pre = make_fake_replica("m")
+    dec = make_fake_replica("m", per_token_s=0.001)
+    router = RouterServer(_Fleet(start_poller=False))
+    router.fleet.add("pre0", pre[1], role="prefill")
+    router.fleet.add("dec0", f"http://127.0.0.1:{dport}", role="decode")
+    router.fleet.add("dec1", dec[1], role="decode")
+    base = f"http://127.0.0.1:{router.start_background()}"
+    try:
+        req = urllib.request.Request(
+            f"{base}/v1/models/m:generate",
+            data=json.dumps({"input_ids": [1, 2, 3], "max_tokens": 24,
+                             "stream": True}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Request-Id": tid})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            lines = [json.loads(ln) for ln in r.read().splitlines()
+                     if ln.strip()]
+        assert lines[-1].get("done") is True
+        assert lines[-1]["_router"]["resumes"] == 1
+        # Close the dead replica's listener so the trace fan-out gets a
+        # fast refusal (the SIGKILLed-process case) instead of a stall.
+        _lsock.close()
+
+        code, _, merged = _http("GET",
+                                f"{base}/debug/trace?trace_id={tid}")
+        assert code == 200
+        assert merged["trace_id"] == tid
+        # The dead replica is REPORTED, not silently absent.
+        assert [u["replica"] for u in merged["unreachable"]] == ["dec0"]
+        # The flight record rode along: outcome + the resume trail.
+        rec = merged["flight_record"]
+        assert rec["outcome"] == "ok" and rec["resumes"] == 1
+        assert rec["replicas"][-2:] == ["dec0", "dec1"]
+        spans = [e for e in merged["traceEvents"] if e["ph"] == "X"]
+        # >= 5 linked spans, every one carrying the caller's id.
+        assert len(spans) >= 5
+        assert all(e["args"]["trace_id"] == tid for e in spans)
+        # >= 3 distinct processes on the one timeline (router + prefill
+        # + surviving decode), each with a process_name track label.
+        assert len({e["pid"] for e in spans}) >= 3
+        names = {e["args"]["name"] for e in merged["traceEvents"]
+                 if e["ph"] == "M" and e["name"] == "process_name"}
+        assert {"router", "pre0", "dec1"} <= names
+        # The resume seam is IN the assembled trace.
+        assert any(e["name"] == "router.resume" for e in spans)
+        # Honest clock alignment: the router IS the timeline; fetched
+        # replicas carry midpoint estimates with error bars.
+        al = merged["clock_alignment"]
+        assert al["router"] == {"offset_us": 0.0, "skew_err_us": 0.0,
+                                "aligned": True}
+        for name in ("pre0", "dec1"):
+            assert al[name]["aligned"] is True
+            assert al[name]["skew_err_us"] >= 0.0
+        json.dumps(merged)  # one valid JSON document end to end
+    finally:
+        router.stop()
+        pre[0].stop()
+        dec[0].stop()
+        _lsock.close()
+
+
+def test_decode_ring_adopts_shipment_meta_trace():
+    """Trace-context gap regression (ISSUE 20): a decode replica
+    reached over the raw-bytes :decode wire with NO X-Request-Id header
+    adopts the trace id stamped into the shipment meta — its ring spans
+    land under the caller's id instead of a fresh anonymous one."""
+    from kubeflow_tpu.serve.kv_transfer import rewrite_meta
+
+    tid = "ring-regress-1"
+    pre = make_fake_replica("m")
+    dec = make_fake_replica("m")
+    try:
+        req = urllib.request.Request(
+            f"{pre[1]}/v1/models/m:prefill",
+            data=json.dumps({"input_ids": [5, 6, 7],
+                             "max_tokens": 6}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            shipment = r.read()
+        stamped = rewrite_meta(shipment, trace=tid)
+        req = urllib.request.Request(
+            f"{dec[1]}/v1/models/m:decode", data=stamped,
+            headers={"Content-Type": "application/octet-stream"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            # The adopted id is echoed, the caller-header contract.
+            assert r.headers.get("X-Request-Id") == tid
+            r.read()
+        code, _, doc = _http("GET",
+                             f"{dec[1]}/debug/trace?trace_id={tid}")
+        assert code == 200
+        assert len(doc["traceEvents"]) >= 1
+        assert all(e["args"]["trace_id"] == tid
+                   for e in doc["traceEvents"])
+    finally:
+        pre[0].stop()
+        dec[0].stop()
+
+
+def test_fleet_metrics_endpoint_sum_exact_and_refusal():
+    """/fleet/metrics (ISSUE 20): counters sum EXACTLY across replicas,
+    same-layout histograms sum bucket-exactly, gauges keep per-replica
+    identity — and a mismatched bucket layout answers a loud 500 naming
+    the family, never a silently-wrong merge."""
+    from kubeflow_tpu.serve.fleet import Fleet as _Fleet
+    from kubeflow_tpu.utils.resilience import (Counters,
+                                               parse_prometheus_text)
+
+    c0, c1 = Counters(), Counters()
+    c0.inc("tpk_serve_requests_total", 3, model="m")
+    c1.inc("tpk_serve_requests_total", 4, model="m")
+    for v in (0.002, 0.03):
+        c0.observe("tpk_serve_request_latency_seconds", v, model="m")
+    c1.observe("tpk_serve_request_latency_seconds", 0.3, model="m")
+    c0.set_gauge("tpk_serve_inflight", 2)
+    c1.set_gauge("tpk_serve_inflight", 5)
+
+    fleet = _Fleet(start_poller=False)
+    router = RouterServer(fleet)
+    fleet.add("r0", "http://127.0.0.1:1")
+    fleet.add("r1", "http://127.0.0.1:2")
+    fleet.update_load("r0", {"ready": True,
+                             "metrics_text": c0.prometheus_text()})
+    fleet.update_load("r1", {"ready": True,
+                             "metrics_text": c1.prometheus_text()})
+    base = f"http://127.0.0.1:{router.start_background()}"
+    try:
+        with urllib.request.urlopen(f"{base}/fleet/metrics",
+                                    timeout=10) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"].startswith("text/plain")
+            fams = parse_prometheus_text(r.read().decode())
+        # Counter: 3 + 4, exactly.
+        assert fams["tpk_serve_requests_total"]["samples"][
+            (("model", "m"),)] == 7
+        # Histogram: bucket-exact sums, sum/count exact.
+        hist = fams["tpk_serve_request_latency_seconds"]["hist"][
+            (("model", "m"),)]
+        assert hist["count"] == 3
+        assert hist["sum"] == pytest.approx(0.332)
+        assert hist["buckets"][float("inf")] == 3
+        # Every per-replica cumulative count survived the merge: the
+        # merged bucket counts equal the sum of the replicas' own.
+        for le, v in hist["buckets"].items():
+            part0 = c0.get_histogram("tpk_serve_request_latency_seconds",
+                                     model="m")["buckets"]
+            part1 = c1.get_histogram("tpk_serve_request_latency_seconds",
+                                     model="m")["buckets"]
+            key = "+Inf" if le == float("inf") else le
+            assert v == part0[key] + part1[key]
+        # Gauge: one sample PER replica, replica label added.
+        g = fams["tpk_serve_inflight"]["samples"]
+        assert g[(("replica", "r0"),)] == 2
+        assert g[(("replica", "r1"),)] == 5
+
+        # Mismatched bucket layout: refusal, loudly, naming the family.
+        bad = Counters()
+        bad.observe("tpk_serve_request_latency_seconds", 0.3,
+                    model="m", buckets=(0.5, 2.0))
+        fleet.update_load("r1", {"ready": True,
+                                 "metrics_text": bad.prometheus_text()})
+        code, _, body = _http("GET", f"{base}/fleet/metrics")
+        assert code == 500
+        assert "refused" in body["error"]
+        assert "tpk_serve_request_latency_seconds" in body["error"]
+    finally:
+        router.stop()
+
+
+def test_flight_recorder_endpoint_and_eject_snapshot():
+    """/admin/flightrecorder (ISSUE 20): one outcome record per
+    concluded request (trace id, intent, outcome, replica trail), a bad
+    ?n= answers 400 — and a gray-failure ejection freezes a snapshot of
+    the surrounding requests through the fleet's transition callback."""
+    rep = make_fake_replica("m")
+    router = RouterServer()
+    router.fleet.poll_interval_s = 0.1
+    router.fleet.add("r0", rep[1])
+    base = f"http://127.0.0.1:{router.start_background()}"
+    try:
+        time.sleep(0.25)
+        code, _, _ = _http(
+            "POST", f"{base}/v1/models/m:generate",
+            {"input_ids": [1, 2, 3], "max_tokens": 4},
+            headers={"Content-Type": "application/json",
+                     "X-Request-Id": "fr-req-1"})
+        assert code == 200
+        code, _, body = _http("GET", f"{base}/admin/flightrecorder")
+        assert code == 200
+        (rec,) = [r for r in body["records"]
+                  if r["trace_id"] == "fr-req-1"]
+        assert rec["intent"] == "generate"
+        assert rec["outcome"] == "ok"
+        assert rec["replicas"] == ["r0"]
+        assert rec["attempts"] == 1 and rec["resumes"] == 0
+        assert rec["e2e_s"] > 0
+        assert rec["deadline_miss"] is False
+        assert body["capacity"] == 512
+        code, _, _ = _http("GET", f"{base}/admin/flightrecorder?n=bogus")
+        assert code == 400
+    finally:
+        router.stop()
+        rep[0].stop()
+
+    # Eject snapshot: the fleet's transition callback freezes the tail.
+    fleet = _latency_fleet(3, slow_min_s=0.0)
+    router2 = RouterServer(fleet)
+    try:
+        router2.flight_recorder.record(trace_id="pre-eject", outcome="ok")
+        for _ in range(4):
+            fleet.observe_forward("r0", 3.0)
+            for i in range(3):
+                fleet.update_load(f"r{i}", {
+                    "ready": True, "rtt_s": 3.0 if i == 0 else 0.02})
+            fleet.eject_pass()
+        assert fleet.get("r0")["state"] == "slow"
+        (snap,) = [s for s in router2.flight_recorder.snapshots()
+                   if s["reason"] == "eject:r0"]
+        assert [r["trace_id"] for r in snap["records"]] == ["pre-eject"]
+    finally:
+        router2.stop()
+
+
+def test_router_ttft_histogram_agrees_with_client_ttft():
+    """ROUTERBENCH cross-check bound (ISSUE 20), pinned FAST: the
+    router's tpk_router_ttft_seconds (observed at the byte-flush
+    boundary) must agree with the client's measured time-to-first-byte
+    — same request count, router mean at or below the client mean
+    (the client pays connect/read overhead on top), and the gap bounded
+    well under the TTFT magnitudes that matter."""
+    from kubeflow_tpu.serve.loadgen import (_post_generate,
+                                            _router_ttft_snapshot,
+                                            _ttft_crosscheck)
+
+    rep = make_fake_replica("m", per_token_s=0.002)
+    router = RouterServer()
+    router.fleet.poll_interval_s = 0.1
+    router.fleet.add("r0", rep[1])
+    base = f"http://127.0.0.1:{router.start_background()}"
+    try:
+        time.sleep(0.25)
+        before = _router_ttft_snapshot()
+        records = []
+        for i in range(6):
+            status, _, _, ttft_s = _post_generate(
+                base, "m", {"input_ids": [i, i + 1, i + 2],
+                            "max_tokens": 6}, None)
+            records.append({"status": status,
+                            "ttft_ms": (None if ttft_s is None
+                                        else ttft_s * 1e3)})
+        assert all(r["status"] == 200 for r in records)
+        # The router observes TTFT in a flush callback on its IOLoop,
+        # so the client can finish reading the last body a beat before
+        # the 6th observation lands — settle before snapshotting.
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            x = _ttft_crosscheck(records, before,
+                                 _router_ttft_snapshot())
+            if x["router_count"] >= 6:
+                break
+            time.sleep(0.05)
+        # Same population on both sides of the boundary.
+        assert x["client_count"] == x["router_count"] == 6
+        # The agreement bound: the client can only sit ABOVE the
+        # router's flush-boundary sample (modulo scheduler jitter), and
+        # the gap is loopback plumbing, not decode time.
+        assert x["agreement_ms"] > -25.0
+        assert x["agreement_ms"] < 500.0
+    finally:
+        router.stop()
+        rep[0].stop()
+
+
+def test_cli_requests_and_trace_router_verbs(tmp_path, capsys):
+    """`tpukit requests --router` renders the flight recorder as a
+    table (and --json raw); `tpukit trace --router URL TRACE_ID` writes
+    the ASSEMBLED distributed trace — and refuses, loudly, when the
+    trace id is missing."""
+    from kubeflow_tpu import cli
+
+    rep = make_fake_replica("m")
+    router = RouterServer()
+    router.fleet.poll_interval_s = 0.1
+    router.fleet.add("r0", rep[1])
+    base = f"http://127.0.0.1:{router.start_background()}"
+    try:
+        time.sleep(0.25)
+        code, _, _ = _http(
+            "POST", f"{base}/v1/models/m:generate",
+            {"input_ids": [1, 2, 3], "max_tokens": 4},
+            headers={"Content-Type": "application/json",
+                     "X-Request-Id": "cli-req-1"})
+        assert code == 200
+
+        assert cli.main(["requests", "--router", base]) == 0
+        out = capsys.readouterr().out
+        assert "TRACE_ID" in out and "cli-req-1" in out
+        assert "ok" in out and "r0" in out
+
+        assert cli.main(["requests", "--router", base, "--json"]) == 0
+        body = json.loads(capsys.readouterr().out)
+        assert any(r["trace_id"] == "cli-req-1"
+                   for r in body["records"])
+
+        dst = tmp_path / "trace.json"
+        assert cli.main(["trace", "--router", base, "cli-req-1",
+                         "-o", str(dst)]) == 0
+        capsys.readouterr()
+        doc = json.loads(dst.read_text())
+        assert doc["trace_id"] == "cli-req-1"
+        assert doc["clock_alignment"]["router"]["aligned"] is True
+        assert any(e["ph"] == "X" for e in doc["traceEvents"])
+
+        # --router without a trace id: error, not the local ring.
+        assert cli.main(["trace", "--router", base]) == 1
+        assert "TRACE_ID" in capsys.readouterr().err
+    finally:
+        router.stop()
+        rep[0].stop()

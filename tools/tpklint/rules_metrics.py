@@ -41,6 +41,15 @@ _README_ROW = re.compile(r"^\|\s*`(tpk_\w+)`\s*\|\s*(\w+)", re.M)
 _KIND_OF_CALL = {"inc": "counter", "observe": "histogram",
                  "set_gauge": "gauge"}
 
+#: The router's TTFT observation is an SLO commitment (ISSUE 20): every
+#: file that observes it must carry this marker next to the observe
+#: site, so the sample can't be silently deleted or drift away from the
+#: byte-flush boundary it is defined at — removing the marker (or the
+#: observe) is a finding, not a quiet regression.
+SLO_MARKER = "# tpk-slo: router-ttft-observe"
+_TTFT_OBSERVE = re.compile(
+    r"observe\(\s*\n?\s*\"tpk_router_ttft_seconds\"")
+
 SCAN_SUBDIR = "kubeflow_tpu"
 README = "README.md"
 
@@ -86,6 +95,14 @@ def _scan_code_located(ctx: Context) -> tuple[
             add(m.group(1), m.group(2), rel, _line_of(text, m.start()))
         for m in _TABLE_ROW.finditer(text):
             add(m.group(1), m.group(2), rel, _line_of(text, m.start()))
+        if SLO_MARKER not in text:
+            for m in _TTFT_OBSERVE.finditer(text):
+                problems.append((rel, _line_of(text, m.start()),
+                                 f"{rel}: tpk_router_ttft_seconds is "
+                                 "observed without the `" + SLO_MARKER
+                                 + "` marker — the router TTFT observe "
+                                 "site is SLO-pinned; move or change "
+                                 "it deliberately, marker included"))
 
     for name, kind in sorted(series.items()):
         rel, line = where[name]
